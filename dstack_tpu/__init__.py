@@ -1,0 +1,13 @@
+"""dstack-tpu: a TPU-native AI workload orchestrator.
+
+A from-scratch control plane for AI workloads on Google TPUs with the
+capabilities of dstack (reference: /root/reference): declarative
+task/service/dev-environment/fleet/volume/gateway configurations, cloud and
+SSH-fleet provisioning, native host agents, a service gateway with
+autoscaling — plus the part the reference lacks: gang-scheduled multi-host
+TPU pod slices with JAX coordinator/process_id/process_count env injection.
+"""
+
+from dstack_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,115 @@
+"""Framework-wide exception hierarchy.
+
+Mirrors the role of the reference's dstack._internal.core.errors (client/server
+error split + typed API errors) with a flat, TPU-first taxonomy.
+"""
+
+from typing import Any, Dict, List, Optional
+
+
+class DstackTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ConfigurationError(DstackTpuError):
+    """Invalid user-supplied YAML/spec."""
+
+
+class ServerError(DstackTpuError):
+    """Unexpected server-side failure."""
+
+
+class ClientError(DstackTpuError):
+    """Client-side (CLI/SDK) failure."""
+
+
+class SSHError(DstackTpuError):
+    """SSH tunnel / remote-exec failure."""
+
+
+class BackendError(DstackTpuError):
+    """Cloud backend failure."""
+
+
+class BackendAuthError(BackendError):
+    """Cloud credentials rejected."""
+
+
+class NoCapacityError(BackendError):
+    """Provider has no capacity for the requested offer."""
+
+
+class PlacementGroupInUseError(BackendError):
+    pass
+
+
+class ComputeError(BackendError):
+    pass
+
+
+class NotYetTerminated(ComputeError):
+    """Instance termination is in progress; poll again later."""
+
+
+class ApiError(DstackTpuError):
+    """Typed error returned over the REST API as JSON."""
+
+    code = "error"
+    status = 400
+
+    def __init__(self, msg: str = "", details: Optional[List[Dict[str, Any]]] = None):
+        super().__init__(msg)
+        self.msg = msg
+        self.details = details or []
+
+    def to_json(self) -> Dict[str, Any]:
+        detail = [{"msg": self.msg, "code": self.code}] if self.msg else []
+        detail += self.details
+        return {"detail": detail}
+
+
+class ResourceNotExistsError(ApiError):
+    code = "resource_not_exists"
+    status = 400
+
+    def __init__(self, msg: str = "The resource does not exist", **kwargs):
+        super().__init__(msg, **kwargs)
+
+
+class ResourceExistsError(ApiError):
+    code = "resource_exists"
+    status = 400
+
+    def __init__(self, msg: str = "The resource already exists", **kwargs):
+        super().__init__(msg, **kwargs)
+
+
+class ForbiddenError(ApiError):
+    code = "forbidden"
+    status = 403
+
+    def __init__(self, msg: str = "Access denied", **kwargs):
+        super().__init__(msg, **kwargs)
+
+
+class UnauthorizedError(ApiError):
+    code = "unauthorized"
+    status = 401
+
+    def __init__(self, msg: str = "Unauthorized", **kwargs):
+        super().__init__(msg, **kwargs)
+
+
+class BadRequestError(ApiError):
+    code = "bad_request"
+    status = 400
+
+
+class ConflictError(ApiError):
+    code = "conflict"
+    status = 409
+
+
+class MethodNotAllowedError(ApiError):
+    code = "method_not_allowed"
+    status = 405

@@ -1,0 +1,56 @@
+"""Backend type enum + capability lists.
+
+Parity: src/dstack/_internal/core/models/backends/base.py and
+src/dstack/_internal/core/backends/__init__.py:3-42 (capability lists).
+TPU-first: GCP is the flagship cloud backend; `ssh` covers on-prem TPU VM
+fleets; `local` is the in-process dev/test backend.
+"""
+
+from enum import Enum
+from typing import List
+
+
+class BackendType(str, Enum):
+    GCP = "gcp"
+    SSH = "ssh"  # SSH fleets (on-prem TPU VMs); reference calls this "remote"
+    LOCAL = "local"
+    DSTACK = "dstack"  # placeholder for marketplace-style pooled capacity
+
+    # Reference-compat aliases accepted in YAML `backends:` lists
+    @classmethod
+    def cast(cls, v: str) -> "BackendType":
+        v = v.lower()
+        if v == "remote":
+            return cls.SSH
+        return cls(v)
+
+
+# Backends able to run multi-node (gang-scheduled) tasks.
+BACKENDS_WITH_MULTINODE_SUPPORT: List[BackendType] = [
+    BackendType.GCP,
+    BackendType.SSH,
+    BackendType.LOCAL,
+]
+
+# Backends able to create standalone instances for fleets.
+BACKENDS_WITH_CREATE_INSTANCE_SUPPORT: List[BackendType] = [
+    BackendType.GCP,
+    BackendType.LOCAL,
+]
+
+# Backends able to provision gateway VMs.
+BACKENDS_WITH_GATEWAY_SUPPORT: List[BackendType] = [
+    BackendType.GCP,
+    BackendType.LOCAL,
+]
+
+# Backends able to create/attach network volumes.
+BACKENDS_WITH_VOLUMES_SUPPORT: List[BackendType] = [
+    BackendType.GCP,
+    BackendType.LOCAL,
+]
+
+# Backends with reservation / queued-resources support (TPU capacity).
+BACKENDS_WITH_RESERVATION_SUPPORT: List[BackendType] = [
+    BackendType.GCP,
+]

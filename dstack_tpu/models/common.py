@@ -1,0 +1,170 @@
+"""Shared model primitives: CoreModel base, Duration, Env, registry auth.
+
+Parity: src/dstack/_internal/core/models/common.py and envs.py in the
+reference, re-done on pydantic v2 (the reference is pydantic v1).
+"""
+
+import re
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, GetCoreSchemaHandler, model_validator
+from pydantic_core import core_schema
+
+
+class CoreModel(BaseModel):
+    """Base for all domain DTOs: tolerant input, stable JSON output."""
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    def dict_json(self) -> Dict[str, Any]:
+        import json
+
+        return json.loads(self.model_dump_json())
+
+
+class Duration(int):
+    """Duration in seconds; parses `90`, `"45s"`, `"2m"`, `"3h"`, `"1d"`, `"1w"`."""
+
+    _UNITS = {"s": 1, "m": 60, "h": 3600, "d": 24 * 3600, "w": 7 * 24 * 3600}
+
+    @classmethod
+    def parse(cls, v: Union[int, str]) -> "Duration":
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return cls(int(v))
+        if isinstance(v, str):
+            m = re.fullmatch(r"(-?\d+)\s*([smhdw]?)", v.strip().lower())
+            if not m:
+                raise ValueError(f"Cannot parse duration: {v}")
+            value, unit = m.groups()
+            return cls(int(value) * cls._UNITS.get(unit or "s", 1))
+        raise ValueError(f"Cannot parse duration: {v}")
+
+    @classmethod
+    def __get_pydantic_core_schema__(
+        cls, source_type: Any, handler: GetCoreSchemaHandler
+    ) -> core_schema.CoreSchema:
+        return core_schema.no_info_plain_validator_function(
+            cls.parse,
+            serialization=core_schema.plain_serializer_function_ser_schema(int),
+        )
+
+    def pretty(self) -> str:
+        s = int(self)
+        if s < 0:
+            return "off"
+        for unit, mul in (("w", 604800), ("d", 86400), ("h", 3600), ("m", 60)):
+            if s >= mul and s % mul == 0:
+                return f"{s // mul}{unit}"
+        return f"{s}s"
+
+
+class NetworkMode(str, Enum):
+    HOST = "host"
+    BRIDGE = "bridge"
+
+
+class ApplyAction(str, Enum):
+    CREATE = "create"
+    UPDATE = "update"
+
+
+class RegistryAuth(CoreModel):
+    """Private image registry credentials."""
+
+    username: Optional[str] = None
+    password: Optional[str] = None
+
+
+class Env(CoreModel):
+    """Environment variables as a mapping or a list.
+
+    List items may be `NAME=value` or bare `NAME` (value taken from the
+    caller's environment at submit time — "pass-through" vars).
+    Parity: reference core/models/envs.py.
+    """
+
+    values: Dict[str, Optional[str]] = {}
+
+    @model_validator(mode="before")
+    @classmethod
+    def _convert(cls, v: Any) -> Any:
+        if v is None:
+            return {"values": {}}
+        if isinstance(v, Env):
+            return {"values": dict(v.values)}
+        if isinstance(v, dict):
+            if set(v.keys()) == {"values"} and isinstance(v["values"], dict):
+                return v
+            return {
+                "values": {
+                    str(k): None if val is None else str(val) for k, val in v.items()
+                }
+            }
+        if isinstance(v, list):
+            values: Dict[str, Optional[str]] = {}
+            for item in v:
+                if not isinstance(item, str):
+                    raise ValueError(f"Invalid env entry: {item!r}")
+                if "=" in item:
+                    name, _, value = item.partition("=")
+                    values[name] = value
+                else:
+                    values[item] = None
+            return {"values": values}
+        raise ValueError(f"Invalid env: {v!r}")
+
+    @classmethod
+    def parse(cls, v: Any) -> "Env":
+        return cls.model_validate(v)
+
+    def as_dict(self) -> Dict[str, Optional[str]]:
+        return dict(self.values)
+
+    def __bool__(self) -> bool:
+        return bool(self.values)
+
+    def update(self, other: "Env") -> None:
+        self.values.update(other.values)
+
+
+class UnixUser(CoreModel):
+    """`user[:group]` each a name or numeric id. Parity: core/models/unix.py."""
+
+    username: Optional[str] = None
+    uid: Optional[int] = None
+    groupname: Optional[str] = None
+    gid: Optional[int] = None
+
+    @classmethod
+    def parse(cls, v: str) -> "UnixUser":
+        parts = v.split(":")
+        if len(parts) > 2 or not parts[0]:
+            raise ValueError(f"Invalid unix user: {v}")
+        user = parts[0]
+        group = parts[1] if len(parts) == 2 else None
+        if group == "":
+            raise ValueError(f"Invalid unix user: {v}")
+        result = cls()
+        if user.isdigit():
+            result.uid = int(user)
+        else:
+            result.username = user
+        if group is not None:
+            if group.isdigit():
+                result.gid = int(group)
+            else:
+                result.groupname = group
+        return result
+
+
+def parse_env_lines(lines: List[str]) -> Dict[str, str]:
+    """Parse `KEY=value` lines (e.g. from a dotenv-ish blob)."""
+    out: Dict[str, str] = {}
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        k, _, val = line.partition("=")
+        out[k.strip()] = val
+    return out

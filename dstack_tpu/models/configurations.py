@@ -1,0 +1,271 @@
+"""Run configuration YAML schema: task / service / dev-environment.
+
+Parity: src/dstack/_internal/core/models/configurations.py:27-405 — same field
+names and string syntaxes so existing `.dstack.yml` files parse unchanged
+(BASELINE.json: "examples/fine-tuning and examples/deployment configs run
+unmodified"). Differences are TPU-first only: `resources.tpu` is native
+(`resources.gpu: v5litepod-4` still accepted and lifted), and `nodes` on a
+task may be left at 1 while a multi-host TPU slice still fans out into one
+job per worker host at planning time.
+"""
+
+import re
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field, field_validator, model_validator
+from typing_extensions import Annotated, Literal
+
+from dstack_tpu.errors import ConfigurationError
+from dstack_tpu.models.common import CoreModel, Duration, Env, RegistryAuth, UnixUser
+from dstack_tpu.models.fleets import FleetConfiguration
+from dstack_tpu.models.gateways import GatewayConfiguration
+from dstack_tpu.models.profiles import ProfileParams
+from dstack_tpu.models.resources import Range, ResourcesSpec
+from dstack_tpu.models.services import AnyModel, BaseChatModel, parse_model
+from dstack_tpu.models.volumes import MountPoint, VolumeConfiguration, parse_mount_points
+
+SERVICE_HTTPS_DEFAULT = True
+STRIP_PREFIX_DEFAULT = True
+
+
+class RunConfigurationType(str, Enum):
+    DEV_ENVIRONMENT = "dev-environment"
+    TASK = "task"
+    SERVICE = "service"
+
+
+class PortMapping(CoreModel):
+    local_port: Optional[int] = None
+    container_port: int
+
+    @classmethod
+    def parse(cls, v: str) -> "PortMapping":
+        """`8080`, `80:8080`, or `*:8080`."""
+        m = re.fullmatch(r"(?:(\d+|\*):)?(\d+)", v)
+        if not m:
+            raise ValueError(f"Invalid port mapping: {v}")
+        local, container = m.groups()
+        container_port = int(container)
+        if local is None:
+            local_port: Optional[int] = container_port
+        elif local == "*":
+            local_port = None
+        else:
+            local_port = int(local)
+        return cls(local_port=local_port, container_port=container_port)
+
+    @field_validator("container_port", "local_port")
+    @classmethod
+    def _v_port(cls, v: Optional[int]) -> Optional[int]:
+        if v is not None and not (0 < v <= 65536):
+            raise ValueError(f"Invalid port: {v}")
+        return v
+
+
+def _parse_ports(items: List[Any]) -> List[PortMapping]:
+    out = []
+    for v in items:
+        if isinstance(v, int):
+            out.append(PortMapping(local_port=v, container_port=v))
+        elif isinstance(v, str):
+            out.append(PortMapping.parse(v))
+        elif isinstance(v, PortMapping):
+            out.append(v)
+        else:
+            out.append(PortMapping.model_validate(v))
+    return out
+
+
+class ScalingSpec(CoreModel):
+    metric: Literal["rps"]
+    target: float
+    scale_up_delay: Duration = Duration.parse("5m")
+    scale_down_delay: Duration = Duration.parse("10m")
+
+
+class BaseRunConfiguration(ProfileParams):
+    type: str = "none"
+    name: Optional[str] = None
+    image: Optional[str] = None
+    user: Optional[str] = None
+    privileged: bool = False
+    entrypoint: Optional[str] = None
+    working_dir: Optional[str] = None
+    registry_auth: Optional[RegistryAuth] = None
+    python: Optional[str] = None
+    env: Env = Env()
+    resources: ResourcesSpec = ResourcesSpec()
+    volumes: List[MountPoint] = []
+    single_branch: Optional[bool] = None
+
+    @field_validator("python", mode="before")
+    @classmethod
+    def _v_python(cls, v: Any) -> Any:
+        if v is None:
+            return None
+        if isinstance(v, float):
+            v = f"{v:.2f}".rstrip("0") if v == 3.1 else str(v)
+            if v == "3.1":
+                v = "3.10"
+        v = str(v)
+        if v not in ("3.9", "3.10", "3.11", "3.12", "3.13"):
+            raise ValueError(f"Unsupported python version: {v}")
+        return v
+
+    @model_validator(mode="after")
+    def _check_python_image(self) -> "BaseRunConfiguration":
+        if self.python is not None and self.image is not None:
+            raise ValueError("`image` and `python` are mutually exclusive fields")
+        return self
+
+    @field_validator("volumes", mode="before")
+    @classmethod
+    def _v_volumes(cls, v: Any) -> Any:
+        if isinstance(v, list):
+            return parse_mount_points(v)
+        return v
+
+    @field_validator("user")
+    @classmethod
+    def _v_user(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None:
+            UnixUser.parse(v)
+        return v
+
+
+class PortsMixin(CoreModel):
+    ports: List[PortMapping] = []
+
+    @field_validator("ports", mode="before")
+    @classmethod
+    def _v_ports(cls, v: Any) -> Any:
+        if isinstance(v, list):
+            return _parse_ports(v)
+        return v
+
+
+class CommandsMixin(CoreModel):
+    commands: List[str] = []
+
+    @model_validator(mode="after")
+    def _check_commands_or_image(self) -> "CommandsMixin":
+        if not self.commands and not getattr(self, "image", None):
+            raise ValueError("Either `commands` or `image` must be set")
+        return self
+
+
+class TaskConfiguration(BaseRunConfiguration, PortsMixin, CommandsMixin):
+    """`type: task` — a (possibly multi-node, possibly multi-host-TPU) batch job."""
+
+    type: Literal["task"] = "task"
+    nodes: int = Field(default=1, ge=1)
+
+
+class DevEnvironmentConfiguration(BaseRunConfiguration, PortsMixin):
+    type: Literal["dev-environment"] = "dev-environment"
+    ide: Literal["vscode"] = "vscode"
+    version: Optional[str] = None
+    init: List[str] = []
+
+
+class ServiceConfiguration(BaseRunConfiguration, CommandsMixin):
+    type: Literal["service"] = "service"
+    port: PortMapping
+    gateway: Optional[Union[bool, str]] = None
+    strip_prefix: bool = STRIP_PREFIX_DEFAULT
+    model: Optional[AnyModel] = None
+    https: bool = SERVICE_HTTPS_DEFAULT
+    auth: bool = True
+    replicas: Range[int] = Range[int](min=1, max=1)
+    scaling: Optional[ScalingSpec] = None
+
+    @field_validator("port", mode="before")
+    @classmethod
+    def _v_port(cls, v: Any) -> Any:
+        if isinstance(v, int):
+            return PortMapping(local_port=80, container_port=v)
+        if isinstance(v, str):
+            return PortMapping.parse(v)
+        return v
+
+    @field_validator("model", mode="before")
+    @classmethod
+    def _v_model(cls, v: Any) -> Any:
+        if isinstance(v, (str, dict)) or v is None:
+            return parse_model(v)
+        return v
+
+    @field_validator("gateway")
+    @classmethod
+    def _v_gateway(cls, v: Any) -> Any:
+        if v is True:
+            raise ValueError(
+                "The `gateway` property must be a string or boolean `false`,"
+                " not boolean `true`"
+            )
+        return v
+
+    @model_validator(mode="after")
+    def _check_scaling(self) -> "ServiceConfiguration":
+        if self.replicas.max is None:
+            raise ValueError("The maximum number of replicas is required")
+        if (self.replicas.min or 0) < 0:
+            raise ValueError("The minimum number of replicas must be >= 0")
+        if self.replicas.min != self.replicas.max and self.scaling is None:
+            raise ValueError("When you set `replicas` to a range, specify `scaling`")
+        if self.replicas.min == self.replicas.max and self.scaling is not None:
+            raise ValueError("To use `scaling`, `replicas` must be set to a range")
+        return self
+
+
+AnyRunConfiguration = Union[
+    DevEnvironmentConfiguration, TaskConfiguration, ServiceConfiguration
+]
+
+_RUN_TYPES: Dict[str, type] = {
+    "task": TaskConfiguration,
+    "service": ServiceConfiguration,
+    "dev-environment": DevEnvironmentConfiguration,
+}
+_APPLY_TYPES: Dict[str, type] = {
+    **_RUN_TYPES,
+    "fleet": FleetConfiguration,
+    "gateway": GatewayConfiguration,
+    "volume": VolumeConfiguration,
+}
+
+AnyApplyConfiguration = Union[
+    AnyRunConfiguration, FleetConfiguration, GatewayConfiguration, VolumeConfiguration
+]
+
+
+class ApplyConfigurationType(str, Enum):
+    DEV_ENVIRONMENT = "dev-environment"
+    TASK = "task"
+    SERVICE = "service"
+    FLEET = "fleet"
+    GATEWAY = "gateway"
+    VOLUME = "volume"
+
+
+def parse_run_configuration(data: Dict[str, Any]) -> AnyRunConfiguration:
+    return _parse(data, _RUN_TYPES)
+
+
+def parse_apply_configuration(data: Dict[str, Any]) -> AnyApplyConfiguration:
+    return _parse(data, _APPLY_TYPES)
+
+
+def _parse(data: Dict[str, Any], types: Dict[str, type]):
+    if not isinstance(data, dict):
+        raise ConfigurationError(f"Configuration must be a mapping, got {type(data).__name__}")
+    conf_type = data.get("type")
+    if conf_type not in types:
+        raise ConfigurationError(
+            f"Unknown configuration type {conf_type!r}; expected one of {sorted(types)}"
+        )
+    try:
+        return types[conf_type].model_validate(data)
+    except Exception as e:
+        raise ConfigurationError(str(e)) from e

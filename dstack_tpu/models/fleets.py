@@ -1,0 +1,115 @@
+"""Fleet domain models (cloud fleets + SSH fleets of on-prem TPU VMs).
+
+Parity: src/dstack/_internal/core/models/fleets.py:42-291. TPU-first: a cloud
+fleet provisioned for a multi-host pod slice is *gang-scheduled* — all worker
+hosts are created/terminated atomically (the reference has no equivalent; it
+filters multi-host TPUs out).
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import Any, List, Optional, Union
+
+from pydantic import field_validator, model_validator
+
+from dstack_tpu.models.common import CoreModel, Env
+from dstack_tpu.models.instances import Instance, SSHConnectionParams
+from dstack_tpu.models.profiles import ProfileParams
+from dstack_tpu.models.resources import Range, ResourcesSpec
+
+
+class InstanceGroupPlacement(str, Enum):
+    ANY = "any"
+    CLUSTER = "cluster"
+
+
+class SSHHostParams(CoreModel):
+    hostname: str
+    port: Optional[int] = None
+    user: Optional[str] = None
+    identity_file: Optional[str] = None
+    internal_ip: Optional[str] = None
+    ssh_key: Optional[str] = None  # inline private key (stored encrypted)
+    blocks: Union[int, str] = 1  # fractional-host sharing; TPU hosts: always 1
+
+    @field_validator("blocks")
+    @classmethod
+    def _v_blocks(cls, v: Any) -> Any:
+        if isinstance(v, str) and v != "auto":
+            raise ValueError('blocks must be an int or "auto"')
+        if isinstance(v, int) and v < 1:
+            raise ValueError("blocks must be >= 1")
+        return v
+
+
+class SSHParams(CoreModel):
+    user: Optional[str] = None
+    port: Optional[int] = None
+    identity_file: Optional[str] = None
+    ssh_key: Optional[str] = None
+    proxy_jump: Optional[SSHConnectionParams] = None
+    hosts: List[Union[SSHHostParams, str]] = []
+    network: Optional[str] = None
+
+    @field_validator("hosts", mode="before")
+    @classmethod
+    def _v_hosts(cls, v: Any) -> Any:
+        if isinstance(v, list):
+            return [SSHHostParams(hostname=h) if isinstance(h, str) else h for h in v]
+        return v
+
+
+class FleetConfiguration(ProfileParams):
+    type: str = "fleet"
+    name: Optional[str] = None
+    env: Env = Env()
+    ssh_config: Optional[SSHParams] = None
+    nodes: Optional[Range[int]] = None
+    placement: Optional[InstanceGroupPlacement] = None
+    resources: Optional[ResourcesSpec] = ResourcesSpec()
+    blocks: Union[int, str] = 1
+
+    @model_validator(mode="after")
+    def _check(self) -> "FleetConfiguration":
+        if self.ssh_config is None and self.nodes is None:
+            raise ValueError("Either `ssh_config` or `nodes` must be specified")
+        if self.ssh_config is not None and self.nodes is not None:
+            raise ValueError("`ssh_config` and `nodes` are mutually exclusive")
+        if self.ssh_config is not None and not self.ssh_config.hosts:
+            raise ValueError("`ssh_config.hosts` must not be empty")
+        return self
+
+
+class FleetStatus(str, Enum):
+    ACTIVE = "active"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    FAILED = "failed"
+
+
+class FleetSpec(CoreModel):
+    configuration: FleetConfiguration
+    configuration_path: Optional[str] = None
+    profile: Optional[ProfileParams] = None
+    autocreated: bool = False
+
+
+class Fleet(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    spec: FleetSpec
+    created_at: datetime
+    status: FleetStatus
+    status_message: Optional[str] = None
+    instances: List[Instance] = []
+
+
+class FleetPlan(CoreModel):
+    project_name: str
+    user: str
+    spec: FleetSpec
+    current_resource: Optional[Fleet] = None
+    offers: List[Any] = []  # InstanceOfferWithAvailability
+    total_offers: int = 0
+    max_offer_price: Optional[float] = None

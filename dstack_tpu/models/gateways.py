@@ -1,0 +1,60 @@
+"""Gateway domain models.
+
+Parity: src/dstack/_internal/core/models/gateways.py.
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.common import CoreModel
+
+
+class GatewayStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    RUNNING = "running"
+    FAILED = "failed"
+
+
+class GatewayConfiguration(CoreModel):
+    type: str = "gateway"
+    name: Optional[str] = None
+    backend: BackendType
+    region: str
+    domain: Optional[str] = None
+    default: bool = False
+    public_ip: bool = True
+    certificate: Optional[str] = "lets-encrypt"
+
+
+class GatewayComputeConfiguration(CoreModel):
+    project_name: str
+    instance_name: str
+    backend: BackendType
+    region: str
+    public_ip: bool = True
+    ssh_key_pub: str = ""
+
+
+class GatewayProvisioningData(CoreModel):
+    instance_id: str
+    ip_address: Optional[str] = None
+    region: str
+    availability_zone: Optional[str] = None
+    hostname: Optional[str] = None
+    backend_data: Optional[str] = None
+
+
+class Gateway(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    configuration: GatewayConfiguration
+    created_at: datetime
+    status: GatewayStatus
+    status_message: Optional[str] = None
+    ip_address: Optional[str] = None
+    hostname: Optional[str] = None
+    default: bool = False

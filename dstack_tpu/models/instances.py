@@ -1,0 +1,136 @@
+"""Instance / offer domain models.
+
+Parity: src/dstack/_internal/core/models/instances.py. TPU-first changes:
+`Resources` carries an optional `TpuTopology` (chips-first, not GPU list),
+and an offer for a multi-host pod slice advertises `hosts > 1` — the
+orchestrator gang-schedules one instance per worker host against it.
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import List, Optional
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.common import CoreModel
+from dstack_tpu.models.resources import Memory
+from dstack_tpu.models.topology import TpuTopology
+
+
+class Gpu(CoreModel):
+    """Non-TPU accelerator (kept for SSH fleets of GPU hosts; not the focus)."""
+
+    vendor: str = "nvidia"
+    name: str
+    memory_mib: int
+
+
+class Resources(CoreModel):
+    cpus: int
+    memory_mib: int
+    spot: bool = False
+    disk_size_mib: int = 102400
+    tpu: Optional[TpuTopology] = None  # the whole slice this host belongs to
+    gpus: List[Gpu] = []
+    description: str = ""
+
+    def pretty_format(self) -> str:
+        parts = [f"{self.cpus}xCPU", f"{self.memory_mib / 1024:g}GB"]
+        if self.tpu is not None:
+            parts.append(f"{self.tpu.display_name} ({self.tpu.topology_string})")
+        if self.gpus:
+            parts.append(f"{len(self.gpus)}x{self.gpus[0].name}")
+        if self.spot:
+            parts.append("spot")
+        return ", ".join(parts)
+
+
+class InstanceType(CoreModel):
+    name: str
+    resources: Resources
+
+
+class InstanceAvailability(str, Enum):
+    UNKNOWN = "unknown"
+    AVAILABLE = "available"
+    NOT_AVAILABLE = "not_available"
+    NO_QUOTA = "no_quota"
+    IDLE = "idle"  # an existing idle fleet instance
+    BUSY = "busy"
+
+    def is_available(self) -> bool:
+        return self in (self.UNKNOWN, self.AVAILABLE, self.IDLE)
+
+
+class InstanceOffer(CoreModel):
+    backend: BackendType
+    instance: InstanceType
+    region: str
+    zone: Optional[str] = None
+    price: float  # $/hr for the WHOLE slice (all hosts), TPU-first semantics
+    # Number of worker VMs provisioned together for this offer (pod slice
+    # hosts). 1 for plain VMs. The scheduler fans this out into per-host jobs.
+    hosts: int = 1
+
+    @property
+    def total_chips(self) -> int:
+        tpu = self.instance.resources.tpu
+        return tpu.chips if tpu else 0
+
+
+class InstanceOfferWithAvailability(InstanceOffer):
+    availability: InstanceAvailability = InstanceAvailability.UNKNOWN
+    instance_id: Optional[str] = None  # set for pool (existing-instance) offers
+
+
+class SSHConnectionParams(CoreModel):
+    hostname: str
+    username: str
+    port: int = 22
+
+
+class RemoteConnectionInfo(CoreModel):
+    """How to reach an SSH-fleet host."""
+
+    host: str
+    port: int = 22
+    ssh_user: str = "root"
+    ssh_proxy: Optional[SSHConnectionParams] = None
+    identity_file: Optional[str] = None
+    ssh_private_key: Optional[str] = None
+    internal_ip: Optional[str] = None
+
+
+class InstanceStatus(str, Enum):
+    PENDING = "pending"
+    PROVISIONING = "provisioning"
+    IDLE = "idle"
+    BUSY = "busy"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+
+    def is_active(self) -> bool:
+        return self not in (self.TERMINATED,)
+
+    def is_available(self) -> bool:
+        return self == self.IDLE
+
+
+class Instance(CoreModel):
+    id: str
+    project_name: str
+    name: str
+    fleet_id: Optional[str] = None
+    fleet_name: Optional[str] = None
+    instance_num: int = 0
+    status: InstanceStatus
+    unreachable: bool = False
+    termination_reason: Optional[str] = None
+    created: datetime
+    backend: Optional[BackendType] = None
+    region: Optional[str] = None
+    availability_zone: Optional[str] = None
+    instance_type: Optional[InstanceType] = None
+    hostname: Optional[str] = None
+    price: Optional[float] = None
+    total_blocks: int = 1
+    busy_blocks: int = 0

@@ -1,0 +1,35 @@
+"""Log event domain models. Parity: src/dstack/_internal/core/models/logs.py."""
+
+import base64
+from datetime import datetime
+from enum import Enum
+from typing import List
+
+from dstack_tpu.models.common import CoreModel
+
+
+class LogProducer(str, Enum):
+    RUNNER = "runner"  # agent/daemon logs
+    JOB = "job"  # the user command's stdout/stderr
+
+
+class LogEvent(CoreModel):
+    timestamp: datetime
+    log_source: LogProducer = LogProducer.JOB
+    message: str  # base64-encoded bytes over the API
+
+    @classmethod
+    def create(cls, timestamp: datetime, message: bytes, source: LogProducer) -> "LogEvent":
+        return cls(
+            timestamp=timestamp,
+            log_source=source,
+            message=base64.b64encode(message).decode(),
+        )
+
+    def decoded(self) -> bytes:
+        return base64.b64decode(self.message)
+
+
+class JobSubmissionLogs(CoreModel):
+    logs: List[LogEvent]
+    next_token: str = ""

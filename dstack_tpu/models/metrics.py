@@ -1,0 +1,29 @@
+"""Job metrics domain models — chips-first.
+
+Parity: src/dstack/_internal/server/services/metrics.py DTOs, with TPU chip
+metrics (duty cycle, HBM) replacing per-GPU util/vram from nvidia-smi.
+"""
+
+from datetime import datetime
+from typing import List, Optional
+
+from dstack_tpu.models.common import CoreModel
+
+
+class TpuChipMetrics(CoreModel):
+    chip_index: int
+    duty_cycle_pct: Optional[float] = None  # TensorCore duty cycle
+    hbm_used_bytes: Optional[int] = None
+    hbm_total_bytes: Optional[int] = None
+
+
+class MetricsPoint(CoreModel):
+    timestamp: datetime
+    cpu_usage_micro: int = 0  # cumulative cpu usage, microseconds
+    memory_usage_bytes: int = 0
+    memory_working_set_bytes: int = 0
+    tpu_chips: List[TpuChipMetrics] = []
+
+
+class JobMetrics(CoreModel):
+    points: List[MetricsPoint]

@@ -1,0 +1,155 @@
+"""Run/fleet profile parameters (provisioning policies).
+
+Parity: src/dstack/_internal/core/models/profiles.py (SpotPolicy,
+CreationPolicy, retry, durations, ProfileParams/Profile), on pydantic v2.
+"""
+
+from enum import Enum
+from typing import Any, List, Optional, Union
+
+from pydantic import field_validator, model_validator
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.common import CoreModel, Duration
+
+DEFAULT_RETRY_DURATION = 3600
+DEFAULT_RUN_IDLE_DURATION = 5 * 60
+DEFAULT_FLEET_IDLE_DURATION = 72 * 3600
+DEFAULT_STOP_DURATION = 300
+
+
+class SpotPolicy(str, Enum):
+    SPOT = "spot"
+    ONDEMAND = "on-demand"
+    AUTO = "auto"
+
+
+class CreationPolicy(str, Enum):
+    REUSE = "reuse"
+    REUSE_OR_CREATE = "reuse-or-create"
+
+
+class RetryEvent(str, Enum):
+    NO_CAPACITY = "no-capacity"
+    INTERRUPTION = "interruption"
+    ERROR = "error"
+
+
+class ProfileRetry(CoreModel):
+    on_events: List[RetryEvent]
+    duration: Optional[Duration] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if v is True:
+            return {
+                "on_events": [e for e in RetryEvent],
+                "duration": DEFAULT_RETRY_DURATION,
+            }
+        return v
+
+    @model_validator(mode="after")
+    def _check(self) -> "ProfileRetry":
+        if not self.on_events:
+            raise ValueError("`on_events` cannot be empty")
+        if self.duration is None:
+            self.duration = Duration(DEFAULT_RETRY_DURATION)
+        return self
+
+
+def _parse_off_duration(v: Any) -> Any:
+    """`off`/False → "off" (unlimited); True → None (use default)."""
+    if v == "off" or v is False:
+        return "off"
+    if v is True:
+        return None
+    if v is None:
+        return None
+    return Duration.parse(v)
+
+
+def _parse_idle_duration(v: Any) -> Any:
+    if v is False or v == "off":
+        return -1
+    if v is True or v is None:
+        return None
+    return Duration.parse(v)
+
+
+class ProfileParams(CoreModel):
+    """Provisioning knobs shared by run configurations, fleets and profiles."""
+
+    backends: Optional[List[BackendType]] = None
+    regions: Optional[List[str]] = None
+    zones: Optional[List[str]] = None  # TPU capacity is zonal; first-class here
+    instance_types: Optional[List[str]] = None
+    reservation: Optional[str] = None
+    spot_policy: Optional[SpotPolicy] = None
+    retry: Optional[Union[ProfileRetry, bool]] = None
+    max_duration: Optional[Union[str, int]] = None
+    stop_duration: Optional[Union[str, int]] = None
+    max_price: Optional[float] = None
+    creation_policy: Optional[CreationPolicy] = None
+    idle_duration: Optional[Union[str, int]] = None
+    pool_name: Optional[str] = None
+    instance_name: Optional[str] = None
+
+    @field_validator("backends", mode="before")
+    @classmethod
+    def _cast_backends(cls, v: Any) -> Any:
+        if isinstance(v, list):
+            return [BackendType.cast(b) if isinstance(b, str) else b for b in v]
+        return v
+
+    @field_validator("max_duration", "stop_duration", mode="before")
+    @classmethod
+    def _v_off_durations(cls, v: Any) -> Any:
+        return _parse_off_duration(v)
+
+    @field_validator("idle_duration", mode="before")
+    @classmethod
+    def _v_idle(cls, v: Any) -> Any:
+        return _parse_idle_duration(v)
+
+    @field_validator("retry", mode="before")
+    @classmethod
+    def _v_retry(cls, v: Any) -> Any:
+        if v is False:
+            return None
+        return v
+
+    @field_validator("max_price")
+    @classmethod
+    def _v_price(cls, v: Optional[float]) -> Optional[float]:
+        if v is not None and v <= 0:
+            raise ValueError("max_price must be positive")
+        return v
+
+    def get_retry(self) -> Optional[ProfileRetry]:
+        if self.retry is None or self.retry is False:
+            return None
+        if self.retry is True:
+            return ProfileRetry.model_validate(True)
+        return self.retry
+
+
+class Profile(ProfileParams):
+    name: str = "default"
+    default: bool = False
+
+
+class ProfilesConfig(CoreModel):
+    profiles: List[Profile]
+
+    def default_profile(self) -> Optional[Profile]:
+        for p in self.profiles:
+            if p.default:
+                return p
+        return None
+
+    def get(self, name: str) -> Profile:
+        for p in self.profiles:
+            if p.name == name:
+                return p
+        raise KeyError(name)

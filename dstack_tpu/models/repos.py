@@ -1,0 +1,64 @@
+"""Repo domain models (remote git repos, local dirs, virtual repos).
+
+Parity: src/dstack/_internal/core/models/repos/*.
+"""
+
+import hashlib
+from enum import Enum
+from typing import Optional, Union
+
+from pydantic import Field
+from typing_extensions import Annotated, Literal
+
+from dstack_tpu.models.common import CoreModel
+
+
+class RepoType(str, Enum):
+    REMOTE = "remote"
+    LOCAL = "local"
+    VIRTUAL = "virtual"
+
+
+class RemoteRepoCreds(CoreModel):
+    clone_url: str
+    private_key: Optional[str] = None
+    oauth_token: Optional[str] = None
+
+
+class RemoteRunRepoData(CoreModel):
+    repo_type: Literal["remote"] = "remote"
+    repo_host_name: Optional[str] = None
+    repo_port: Optional[int] = None
+    repo_user_name: Optional[str] = None
+    repo_name: Optional[str] = None
+    repo_branch: Optional[str] = None
+    repo_hash: Optional[str] = None
+    repo_diff: Optional[str] = None  # uploaded separately as a code blob
+
+    def make_url(self) -> str:
+        port = f":{self.repo_port}" if self.repo_port else ""
+        return f"https://{self.repo_host_name}{port}/{self.repo_user_name}/{self.repo_name}"
+
+
+class LocalRunRepoData(CoreModel):
+    repo_type: Literal["local"] = "local"
+    repo_dir: str = ""
+
+
+class VirtualRunRepoData(CoreModel):
+    repo_type: Literal["virtual"] = "virtual"
+
+
+AnyRunRepoData = Annotated[
+    Union[RemoteRunRepoData, LocalRunRepoData, VirtualRunRepoData],
+    Field(discriminator="repo_type"),
+]
+
+
+class Repo(CoreModel):
+    repo_id: str
+    repo_info: AnyRunRepoData
+
+
+def default_virtual_repo_id(project_name: str) -> str:
+    return hashlib.sha256(f"virtual:{project_name}".encode()).hexdigest()[:16]

@@ -1,0 +1,330 @@
+"""Resource requirement specs: ranges, memory, TPU-first accelerator spec.
+
+Parity: src/dstack/_internal/core/models/resources.py (Range, Memory, GPUSpec,
+DiskSpec, ResourcesSpec), redesigned so the accelerator model is
+topology-bearing TPU first (`tpu: v5p-256`) with the reference's
+`gpu: v5litepod-4` syntax still accepted for drop-in compatibility with
+existing example configs (examples/deployment/vllm/tpu/.dstack.yml).
+"""
+
+import math
+from enum import Enum
+from typing import Any, Dict, Generic, List, Optional, TypeVar, Union
+
+from pydantic import BaseModel, ConfigDict, Field, GetCoreSchemaHandler, model_validator
+from pydantic_core import core_schema
+
+from dstack_tpu.models.common import CoreModel
+from dstack_tpu.models.topology import TpuGeneration, TpuTopology
+
+T = TypeVar("T", int, float)
+
+
+class Memory(float):
+    """Memory size in GB; parses `512`, `"8GB"`, `"512MB"`, `"1.5TB"`."""
+
+    @classmethod
+    def parse(cls, v: Any) -> "Memory":
+        if isinstance(v, (float, int)) and not isinstance(v, bool):
+            return cls(v)
+        if isinstance(v, str):
+            s = v.replace(" ", "").lower()
+            for suffix, mul in (("tb", 1024.0), ("gb", 1.0), ("mb", 1 / 1024)):
+                if s.endswith(suffix):
+                    return cls(float(s[: -len(suffix)]) * mul)
+            return cls(float(s))
+        raise ValueError(f"Invalid memory size: {v!r}")
+
+    @classmethod
+    def __get_pydantic_core_schema__(
+        cls, source_type: Any, handler: GetCoreSchemaHandler
+    ) -> core_schema.CoreSchema:
+        return core_schema.no_info_plain_validator_function(
+            cls.parse,
+            serialization=core_schema.plain_serializer_function_ser_schema(float),
+        )
+
+    def __repr__(self) -> str:
+        return f"{self:g}GB"
+
+
+class Range(BaseModel, Generic[T]):
+    """Inclusive numeric range; parses `4`, `"2..8"`, `"4.."`, `"..16"`."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    min: Optional[T] = None
+    max: Optional[T] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, str) and ".." in v:
+            lo, _, hi = v.replace(" ", "").partition("..")
+            return {"min": lo or None, "max": hi or None}
+        if isinstance(v, (int, float, str)) and not isinstance(v, bool):
+            return {"min": v, "max": v}
+        if isinstance(v, Range):
+            return {"min": v.min, "max": v.max}
+        return v
+
+    @model_validator(mode="after")
+    def _check(self) -> "Range[T]":
+        if self.min is None and self.max is None:
+            raise ValueError("Invalid empty range: ..")
+        if self.min is not None and self.max is not None and self.min > self.max:
+            raise ValueError(f"Invalid range order: {self.min}..{self.max}")
+        return self
+
+    def __str__(self) -> str:
+        lo = "" if self.min is None else f"{self.min:g}"
+        hi = "" if self.max is None else f"{self.max:g}"
+        return lo if lo == hi else f"{lo}..{hi}"
+
+    def contains(self, value: Union[int, float]) -> bool:
+        if self.min is not None and value < self.min:
+            return False
+        if self.max is not None and value > self.max:
+            return False
+        return True
+
+    def intersect(self, other: "Range") -> Optional["Range"]:
+        lo = max(
+            self.min if self.min is not None else -math.inf,
+            other.min if other.min is not None else -math.inf,
+        )
+        hi = min(
+            self.max if self.max is not None else math.inf,
+            other.max if other.max is not None else math.inf,
+        )
+        if lo > hi:
+            return None
+        return Range(
+            min=None if lo == -math.inf else lo,
+            max=None if hi == math.inf else hi,
+        )
+
+
+class AcceleratorVendor(str, Enum):
+    GOOGLE = "google"
+    NVIDIA = "nvidia"
+    AMD = "amd"
+    INTEL = "intel"
+
+    @classmethod
+    def cast(cls, v: str) -> "AcceleratorVendor":
+        v = v.lower()
+        if v == "tpu":
+            return cls.GOOGLE
+        return cls(v)
+
+
+DEFAULT_CPU_COUNT = Range[int](min=2)
+DEFAULT_MEMORY_SIZE = Range[Memory](min=Memory.parse("8GB"))
+DEFAULT_ACCEL_COUNT = Range[int](min=1, max=1)
+
+
+class TpuSpec(CoreModel):
+    """TPU slice requirement — topology-bearing.
+
+    Accepts:
+      - `tpu: v5p-256` (accelerator-type string)
+      - `tpu: {generation: v5e, chips: 16}` / `{generation: v5p, cores: 256}`
+      - `tpu: {generation: [v5e, v6e], chips: 8..256}` (flexible matching)
+    """
+
+    generation: Optional[List[TpuGeneration]] = None
+    chips: Optional[Range[int]] = None
+    topology: Optional[str] = None  # exact ICI grid, e.g. "4x4" or "8x8x2"
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            topo = TpuTopology.parse(v)
+            return {
+                "generation": [topo.generation],
+                "chips": {"min": topo.chips, "max": topo.chips},
+            }
+        if isinstance(v, dict):
+            v = dict(v)
+            gen = v.get("generation")
+            if isinstance(gen, (str, TpuGeneration)):
+                v["generation"] = [gen]
+            if "cores" in v and "chips" not in v:
+                cores = v.pop("cores")
+                gens = v.get("generation") or []
+                cpc = 2 if not gens else _cores_per_chip(gens[0])
+                rng = Range[int].model_validate(cores)
+                v["chips"] = {
+                    "min": None if rng.min is None else max(1, rng.min // cpc),
+                    "max": None if rng.max is None else max(1, rng.max // cpc),
+                }
+            if isinstance(v.get("generation"), list):
+                v["generation"] = [_cast_generation(g) for g in v["generation"]]
+        return v
+
+    def matches(self, topo: TpuTopology) -> bool:
+        if self.generation and topo.generation not in self.generation:
+            return False
+        if self.chips and not self.chips.contains(topo.chips):
+            return False
+        if self.topology and topo.topology_string != self.topology:
+            return False
+        return True
+
+    def pretty(self) -> str:
+        gens = ",".join(g.value for g in self.generation) if self.generation else "tpu"
+        chips = f"-{self.chips}" if self.chips else ""
+        return f"{gens}{chips}"
+
+
+def _cast_generation(g: Any) -> TpuGeneration:
+    if isinstance(g, TpuGeneration):
+        return g
+    s = str(g).lower()
+    aliases = {"v5litepod": "v5e", "v5lite": "v5e", "trillium": "v6e"}
+    return TpuGeneration(aliases.get(s, s))
+
+
+def _cores_per_chip(gen: Any) -> int:
+    from dstack_tpu.models.topology import GENERATIONS
+
+    return GENERATIONS[_cast_generation(gen)].cores_per_chip
+
+
+class GPUSpec(CoreModel):
+    """Generic accelerator spec (reference-compatible `gpu:` field).
+
+    Parses the reference's string syntax `"A100:2:40GB"` / `"tpu:v5p-8"` and —
+    crucially for config compatibility — recognises TPU accelerator-type names
+    (`v5litepod-4`) and converts them to a `TpuSpec` on the parent
+    ResourcesSpec (see ResourcesSpec._lift_tpu).
+    """
+
+    vendor: Optional[AcceleratorVendor] = None
+    name: Optional[List[str]] = None
+    count: Range[int] = DEFAULT_ACCEL_COUNT
+    memory: Optional[Range[Memory]] = None
+    total_memory: Optional[Range[Memory]] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, int):
+            v = str(v)
+        if isinstance(v, str):
+            spec: Dict[str, Any] = {}
+            for token in v.replace(" ", "").split(":"):
+                if not token:
+                    raise ValueError(f"GPU spec contains an empty token: {v}")
+                vendor = _try_vendor(token)
+                if vendor is not None:
+                    if "vendor" in spec:
+                        raise ValueError(f"GPU spec vendor conflict: {v}")
+                    spec["vendor"] = vendor
+                elif token[0].isalpha():
+                    if "name" in spec:
+                        raise ValueError(f"GPU spec name conflict: {v}")
+                    spec["name"] = token.split(",")
+                elif any(c.isalpha() for c in token):
+                    if "memory" in spec:
+                        raise ValueError(f"GPU spec memory conflict: {v}")
+                    spec["memory"] = token
+                else:
+                    if "count" in spec:
+                        raise ValueError(f"GPU spec count conflict: {v}")
+                    spec["count"] = token
+            return spec
+        if isinstance(v, dict):
+            v = dict(v)
+            if isinstance(v.get("name"), str):
+                v["name"] = [v["name"]]
+            if isinstance(v.get("vendor"), str):
+                v["vendor"] = AcceleratorVendor.cast(v["vendor"])
+            return v
+        return v
+
+    @model_validator(mode="after")
+    def _strip_tpu_prefix(self) -> "GPUSpec":
+        if self.name:
+            names = []
+            for n in self.name:
+                if n.startswith("tpu-"):
+                    n = n[4:]
+                    self.vendor = AcceleratorVendor.GOOGLE
+                names.append(n)
+            self.name = names
+        return self
+
+    def tpu_names(self) -> List[str]:
+        """Names that are TPU accelerator types (e.g. `v5litepod-4`)."""
+        return [n for n in (self.name or []) if TpuTopology.is_tpu_type(n)]
+
+
+def _try_vendor(token: str) -> Optional[AcceleratorVendor]:
+    try:
+        return AcceleratorVendor.cast(token)
+    except ValueError:
+        return None
+
+
+class DiskSpec(CoreModel):
+    size: Range[Memory]
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, (str, int, float)) and not isinstance(v, bool):
+            return {"size": v}
+        return v
+
+
+DEFAULT_DISK = DiskSpec(size=Range[Memory](min=Memory.parse("100GB")))
+
+
+class ResourcesSpec(CoreModel):
+    """`resources:` block of a run configuration.
+
+    TPU-first: `tpu:` is the native accelerator field; `gpu:` is accepted for
+    reference compatibility and auto-lifted to `tpu:` when it names a TPU type
+    (`gpu: v5litepod-4`) or uses the `tpu` vendor alias.
+    """
+
+    cpu: Range[int] = DEFAULT_CPU_COUNT
+    memory: Range[Memory] = DEFAULT_MEMORY_SIZE
+    shm_size: Optional[Memory] = None
+    tpu: Optional[TpuSpec] = None
+    gpu: Optional[GPUSpec] = None
+    disk: Optional[DiskSpec] = DEFAULT_DISK
+
+    @model_validator(mode="after")
+    def _lift_tpu(self) -> "ResourcesSpec":
+        if self.tpu is not None or self.gpu is None:
+            return self
+        gpu = self.gpu
+        tpu_names = gpu.tpu_names()
+        if tpu_names:
+            topos = [TpuTopology.parse(n) for n in tpu_names]
+            chips_min = min(t.chips for t in topos)
+            chips_max = max(t.chips for t in topos)
+            self.tpu = TpuSpec(
+                generation=sorted({t.generation for t in topos}, key=lambda g: g.value),
+                chips=Range[int](min=chips_min, max=chips_max),
+            )
+            self.gpu = None
+        elif gpu.vendor == AcceleratorVendor.GOOGLE and gpu.name:
+            # e.g. gpu: "tpu:v5p-8" already stripped to name v5p-8 above
+            pass
+        return self
+
+    def pretty_format(self) -> str:
+        parts = [f"cpu={self.cpu}", f"mem={self.memory:g}GB" if isinstance(self.memory, float) else f"mem={self.memory}"]
+        if self.tpu:
+            parts.append(f"tpu={self.tpu.pretty()}")
+        if self.gpu:
+            name = ",".join(self.gpu.name) if self.gpu.name else "gpu"
+            parts.append(f"gpu={name}:{self.gpu.count}")
+        if self.disk:
+            parts.append(f"disk={self.disk.size}GB")
+        return " ".join(parts)

@@ -1,0 +1,398 @@
+"""Run / Job domain: FSM enums, specs, provisioning data, cluster info.
+
+Parity: src/dstack/_internal/core/models/runs.py (JobStatus:43,
+JobTerminationReason:103, JobProvisioningData:201, ClusterInfo:262,
+RunSpec:357-374). TPU-first deltas:
+  - `ClusterInfo` carries chips/topology (not `gpus_per_job`) plus everything
+    needed to assemble the JAX distributed bootstrap env
+    (coordinator ip:port, process_id, process_count).
+  - `JobSpec` has an explicit `tpu_slice` (the TpuTopology the job's host
+    belongs to) and `host_rank` within the slice.
+"""
+
+import uuid
+from datetime import datetime
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+from pydantic import Field, model_validator
+from typing_extensions import Annotated
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.common import CoreModel, NetworkMode, RegistryAuth, UnixUser
+from dstack_tpu.models.configurations import AnyRunConfiguration, parse_run_configuration
+from dstack_tpu.models.instances import (
+    InstanceOfferWithAvailability,
+    InstanceType,
+    SSHConnectionParams,
+)
+from dstack_tpu.models.profiles import (
+    CreationPolicy,
+    Profile,
+    ProfileParams,
+    ProfileRetry,
+    RetryEvent,
+    SpotPolicy,
+)
+from dstack_tpu.models.repos import AnyRunRepoData
+from dstack_tpu.models.resources import Memory, ResourcesSpec
+from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.models.volumes import MountPoint
+
+
+class AppSpec(CoreModel):
+    port: int
+    map_to_port: Optional[int] = None
+    app_name: str
+    url_path: Optional[str] = None
+    url_query_params: Optional[Dict[str, str]] = None
+
+
+class JobStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    PULLING = "pulling"
+    RUNNING = "running"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    ABORTED = "aborted"
+    FAILED = "failed"
+    DONE = "done"
+
+    @classmethod
+    def finished_statuses(cls) -> List["JobStatus"]:
+        return [cls.TERMINATED, cls.ABORTED, cls.FAILED, cls.DONE]
+
+    def is_finished(self) -> bool:
+        return self in self.finished_statuses()
+
+
+class RunStatus(str, Enum):
+    PENDING = "pending"
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    RUNNING = "running"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    FAILED = "failed"
+    DONE = "done"
+
+    @classmethod
+    def finished_statuses(cls) -> List["RunStatus"]:
+        return [cls.TERMINATED, cls.FAILED, cls.DONE]
+
+    def is_finished(self) -> bool:
+        return self in self.finished_statuses()
+
+
+class JobTerminationReason(str, Enum):
+    # Set by the server
+    FAILED_TO_START_DUE_TO_NO_CAPACITY = "failed_to_start_due_to_no_capacity"
+    INTERRUPTED_BY_NO_CAPACITY = "interrupted_by_no_capacity"
+    WAITING_INSTANCE_LIMIT_EXCEEDED = "waiting_instance_limit_exceeded"
+    WAITING_RUNNER_LIMIT_EXCEEDED = "waiting_runner_limit_exceeded"
+    TERMINATED_BY_USER = "terminated_by_user"
+    VOLUME_ERROR = "volume_error"
+    GATEWAY_ERROR = "gateway_error"
+    SCALED_DOWN = "scaled_down"
+    DONE_BY_RUNNER = "done_by_runner"
+    ABORTED_BY_USER = "aborted_by_user"
+    TERMINATED_BY_SERVER = "terminated_by_server"
+    GANG_MEMBER_FAILED = "gang_member_failed"  # TPU-first: any-worker death kills the gang
+    # Set by the runner/agents
+    CONTAINER_EXITED_WITH_ERROR = "container_exited_with_error"
+    PORTS_BINDING_FAILED = "ports_binding_failed"
+    CREATING_CONTAINER_ERROR = "creating_container_error"
+    EXECUTOR_ERROR = "executor_error"
+    MAX_DURATION_EXCEEDED = "max_duration_exceeded"
+
+    def to_status(self) -> JobStatus:
+        mapping = {
+            self.FAILED_TO_START_DUE_TO_NO_CAPACITY: JobStatus.FAILED,
+            self.INTERRUPTED_BY_NO_CAPACITY: JobStatus.FAILED,
+            self.WAITING_INSTANCE_LIMIT_EXCEEDED: JobStatus.FAILED,
+            self.WAITING_RUNNER_LIMIT_EXCEEDED: JobStatus.FAILED,
+            self.TERMINATED_BY_USER: JobStatus.TERMINATED,
+            self.VOLUME_ERROR: JobStatus.FAILED,
+            self.GATEWAY_ERROR: JobStatus.FAILED,
+            self.SCALED_DOWN: JobStatus.TERMINATED,
+            self.DONE_BY_RUNNER: JobStatus.DONE,
+            self.ABORTED_BY_USER: JobStatus.ABORTED,
+            self.TERMINATED_BY_SERVER: JobStatus.TERMINATED,
+            self.GANG_MEMBER_FAILED: JobStatus.FAILED,
+            self.CONTAINER_EXITED_WITH_ERROR: JobStatus.FAILED,
+            self.PORTS_BINDING_FAILED: JobStatus.FAILED,
+            self.CREATING_CONTAINER_ERROR: JobStatus.FAILED,
+            self.EXECUTOR_ERROR: JobStatus.FAILED,
+            self.MAX_DURATION_EXCEEDED: JobStatus.TERMINATED,
+        }
+        return mapping[self]
+
+    def pretty_repr(self) -> str:
+        return " ".join(self.value.split("_")).capitalize()
+
+
+class RunTerminationReason(str, Enum):
+    ALL_JOBS_DONE = "all_jobs_done"
+    JOB_FAILED = "job_failed"
+    RETRY_LIMIT_EXCEEDED = "retry_limit_exceeded"
+    STOPPED_BY_USER = "stopped_by_user"
+    ABORTED_BY_USER = "aborted_by_user"
+    SERVER_ERROR = "server_error"
+
+    def to_job_termination_reason(self) -> JobTerminationReason:
+        mapping = {
+            self.ALL_JOBS_DONE: JobTerminationReason.DONE_BY_RUNNER,
+            self.JOB_FAILED: JobTerminationReason.TERMINATED_BY_SERVER,
+            self.RETRY_LIMIT_EXCEEDED: JobTerminationReason.TERMINATED_BY_SERVER,
+            self.STOPPED_BY_USER: JobTerminationReason.TERMINATED_BY_USER,
+            self.ABORTED_BY_USER: JobTerminationReason.ABORTED_BY_USER,
+            self.SERVER_ERROR: JobTerminationReason.TERMINATED_BY_SERVER,
+        }
+        return mapping[self]
+
+    def to_status(self) -> RunStatus:
+        mapping = {
+            self.ALL_JOBS_DONE: RunStatus.DONE,
+            self.JOB_FAILED: RunStatus.FAILED,
+            self.RETRY_LIMIT_EXCEEDED: RunStatus.FAILED,
+            self.STOPPED_BY_USER: RunStatus.TERMINATED,
+            self.ABORTED_BY_USER: RunStatus.TERMINATED,
+            self.SERVER_ERROR: RunStatus.FAILED,
+        }
+        return mapping[self]
+
+
+class Retry(CoreModel):
+    on_events: List[RetryEvent]
+    duration: int
+
+    def pretty_format(self) -> str:
+        events = ", ".join(e.value for e in self.on_events)
+        return f"{self.duration}s[{events}]"
+
+
+class Requirements(CoreModel):
+    resources: ResourcesSpec
+    max_price: Optional[float] = None
+    spot: Optional[bool] = None
+    reservation: Optional[str] = None
+
+    def pretty_format(self, resources_only: bool = False) -> str:
+        res = self.resources.pretty_format()
+        if not resources_only:
+            if self.spot is not None:
+                res += ", spot" if self.spot else ", on-demand"
+            if self.max_price is not None:
+                res += f" under ${self.max_price:g}/hr"
+        return res
+
+
+class JobSpec(CoreModel):
+    replica_num: int = 0
+    job_num: int = 0
+    job_name: str
+    jobs_per_replica: int = 1
+    app_specs: List[AppSpec] = []
+    user: Optional[UnixUser] = None
+    commands: List[str] = []
+    env: Dict[str, str] = {}
+    image_name: str = ""
+    privileged: bool = False
+    single_branch: Optional[bool] = None
+    max_duration: Optional[int] = None
+    stop_duration: Optional[int] = None
+    registry_auth: Optional[RegistryAuth] = None
+    requirements: Requirements
+    retry: Optional[Retry] = None
+    volumes: List[MountPoint] = []
+    working_dir: Optional[str] = None
+    # TPU-first:
+    tpu_slice: Optional[TpuTopology] = None  # slice this job's host belongs to
+    host_rank: int = 0  # worker index within the slice (== process_id)
+
+
+class JobProvisioningData(CoreModel):
+    backend: BackendType
+    base_backend: Optional[BackendType] = None
+    instance_type: InstanceType
+    instance_id: str
+    hostname: Optional[str] = None
+    internal_ip: Optional[str] = None
+    public_ip_enabled: bool = True
+    instance_network: Optional[str] = None
+    region: str
+    availability_zone: Optional[str] = None
+    reservation: Optional[str] = None
+    price: float = 0.0
+    username: str = "root"
+    ssh_port: Optional[int] = 22
+    dockerized: bool = True  # True if the backend starts a shim agent
+    ssh_proxy: Optional[SSHConnectionParams] = None
+    backend_data: Optional[str] = None
+    # TPU-first: the cloud TPU node this host is a worker of, and its index.
+    tpu_node_id: Optional[str] = None
+    tpu_worker_index: int = 0
+
+    def get_base_backend(self) -> BackendType:
+        return self.base_backend or self.backend
+
+
+class JobRuntimeData(CoreModel):
+    network_mode: NetworkMode = NetworkMode.HOST
+    cpu: Optional[float] = None
+    memory: Optional[Memory] = None
+    ports: Optional[Dict[int, int]] = None
+    volume_names: Optional[List[str]] = None
+    offer: Optional[InstanceOfferWithAvailability] = None
+
+
+class ClusterInfo(CoreModel):
+    """Everything a job needs to join its gang.
+
+    The TPU-first replacement for the reference's
+    `ClusterInfo(job_ips, master_job_ip, gpus_per_job)` (runs.py:262):
+    feeds `dstack_tpu.parallel.env.make_cluster_env`, which renders the JAX
+    distributed bootstrap (`coordinator_address`/`process_id`/`process_count`)
+    instead of torchrun's MASTER_ADDR.
+    """
+
+    job_ips: List[str]
+    master_job_ip: str
+    coordinator_port: int = 8476
+    chips_per_host: int = 0
+    tpu_slice: Optional[TpuTopology] = None
+    # Multi-slice (DCN) runs: list of per-slice coordinator addresses.
+    slice_count: int = 1
+    slice_id: int = 0
+
+
+class JobSubmission(CoreModel):
+    id: str
+    submission_num: int = 0
+    submitted_at: datetime
+    last_processed_at: datetime
+    finished_at: Optional[datetime] = None
+    status: JobStatus
+    termination_reason: Optional[JobTerminationReason] = None
+    termination_reason_message: Optional[str] = None
+    exit_status: Optional[int] = None
+    job_provisioning_data: Optional[JobProvisioningData] = None
+    job_runtime_data: Optional[JobRuntimeData] = None
+
+
+class Job(CoreModel):
+    job_spec: JobSpec
+    job_submissions: List[JobSubmission]
+
+
+class RunSpec(CoreModel):
+    run_name: Optional[str] = None
+    repo_id: Optional[str] = None
+    repo_data: Optional[AnyRunRepoData] = None
+    repo_code_hash: Optional[str] = None
+    working_dir: Optional[str] = None
+    configuration_path: Optional[str] = None
+    configuration: AnyRunConfiguration
+    profile: Optional[Profile] = None
+    ssh_key_pub: str = ""
+    merged_profile: Annotated[Optional[Profile], Field(exclude=True)] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse_conf(cls, values: Any) -> Any:
+        if isinstance(values, dict) and isinstance(values.get("configuration"), dict):
+            values = dict(values)
+            values["configuration"] = parse_run_configuration(values["configuration"])
+        return values
+
+    @model_validator(mode="after")
+    def _merge_profile(self) -> "RunSpec":
+        merged = Profile(name="default") if self.profile is None else self.profile.model_copy(deep=True)
+        for key in ProfileParams.model_fields:
+            conf_val = getattr(self.configuration, key, None)
+            if conf_val is not None:
+                setattr(merged, key, conf_val)
+        if merged.creation_policy is None:
+            merged.creation_policy = CreationPolicy.REUSE_OR_CREATE
+        self.merged_profile = merged
+        return self
+
+
+class ServiceModelSpec(CoreModel):
+    name: str
+    base_url: str
+    type: str
+
+
+class ServiceSpec(CoreModel):
+    url: str
+    model: Optional[ServiceModelSpec] = None
+    options: Dict[str, Any] = {}
+
+
+class Run(CoreModel):
+    id: str
+    project_name: str
+    user: str
+    submitted_at: datetime
+    last_processed_at: datetime
+    status: RunStatus
+    termination_reason: Optional[RunTerminationReason] = None
+    run_spec: RunSpec
+    jobs: List[Job] = []
+    latest_job_submission: Optional[JobSubmission] = None
+    cost: float = 0
+    service: Optional[ServiceSpec] = None
+    deleted: bool = False
+
+    @property
+    def error(self) -> str:
+        if self.termination_reason is None:
+            return ""
+        if len(self.jobs) > 1:
+            return self.termination_reason.name
+        job_reason = None
+        for job in self.jobs:
+            if job.job_submissions and job.job_submissions[-1].termination_reason:
+                job_reason = job.job_submissions[-1].termination_reason
+        if job_reason is not None and self.termination_reason in (
+            RunTerminationReason.JOB_FAILED,
+            RunTerminationReason.SERVER_ERROR,
+            RunTerminationReason.RETRY_LIMIT_EXCEEDED,
+        ):
+            return f"{self.termination_reason.name}\n({job_reason.name})"
+        return self.termination_reason.name
+
+
+class JobPlan(CoreModel):
+    job_spec: JobSpec
+    offers: List[InstanceOfferWithAvailability] = []
+    total_offers: int = 0
+    max_price: Optional[float] = None
+
+
+class RunPlan(CoreModel):
+    project_name: str
+    user: str
+    run_spec: RunSpec
+    job_plans: List[JobPlan]
+    current_resource: Optional[Run] = None
+    action: str = "create"
+
+
+class ApplyRunPlanInput(CoreModel):
+    run_spec: RunSpec
+    current_resource: Optional[Run] = None
+
+
+def get_policy_map(spot_policy: Optional[SpotPolicy], default: SpotPolicy) -> Optional[bool]:
+    if spot_policy is None:
+        spot_policy = default
+    return {SpotPolicy.AUTO: None, SpotPolicy.SPOT: True, SpotPolicy.ONDEMAND: False}[
+        spot_policy
+    ]
+
+
+def generate_job_id() -> str:
+    return str(uuid.uuid4())

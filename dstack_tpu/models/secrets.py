@@ -1,0 +1,14 @@
+"""Secret domain models. Parity: src/dstack/_internal/core/models/secrets.py."""
+
+from typing import Optional
+
+from dstack_tpu.models.common import CoreModel
+
+
+class Secret(CoreModel):
+    id: Optional[str] = None
+    name: str
+    value: Optional[str] = None  # omitted in listings
+
+    def __str__(self) -> str:
+        return f"Secret({self.name}=***)"

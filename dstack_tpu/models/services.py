@@ -1,0 +1,49 @@
+"""Service-model (OpenAI endpoint mapping) domain models.
+
+Parity: src/dstack/_internal/core/models/services.py.
+"""
+
+from typing import Optional, Union
+
+from pydantic import Field
+from typing_extensions import Annotated, Literal
+
+from dstack_tpu.models.common import CoreModel
+
+
+class BaseChatModel(CoreModel):
+    type: Literal["chat"] = "chat"
+    name: str
+    format: str
+
+
+class OpenAIChatModel(BaseChatModel):
+    """An OpenAI-compatible API served by the container (vLLM-TPU, JetStream
+    with an OpenAI adapter, ...)."""
+
+    format: Literal["openai"] = "openai"
+    prefix: str = "/v1"
+
+
+class TGIChatModel(BaseChatModel):
+    """A TGI-style generate API; the model proxy translates chat-completions
+    requests to it (reference: proxy/lib/services/model_proxy/clients/tgi.py)."""
+
+    format: Literal["tgi"] = "tgi"
+    chat_template: Optional[str] = None
+    eos_token: Optional[str] = None
+
+
+ChatModel = Annotated[Union[OpenAIChatModel, TGIChatModel], Field(discriminator="format")]
+AnyModel = ChatModel
+
+
+def parse_model(v: Union[str, dict, BaseChatModel, None]) -> Optional[BaseChatModel]:
+    if v is None or isinstance(v, BaseChatModel):
+        return v
+    if isinstance(v, str):
+        return OpenAIChatModel(name=v)
+    fmt = v.get("format", "openai")
+    if fmt == "tgi":
+        return TGIChatModel.model_validate(v)
+    return OpenAIChatModel.model_validate(v)

@@ -1,0 +1,288 @@
+"""TPU generation & pod-slice topology catalog.
+
+This is the TPU-first replacement for the reference's flat GPU naming
+(`GPUSpec.name=["H100"]`, src/dstack/_internal/core/models/resources.py:130).
+A TPU accelerator type such as ``v5p-256`` is *topology-bearing*: it implies a
+chip count, an ICI mesh shape, a host (worker VM) count, and per-chip
+HBM/flops — all of which the orchestrator needs for gang scheduling
+(one InstanceModel per worker host) and for the JAX distributed bootstrap env
+(process_count == hosts).
+
+The reference explicitly filters multi-host TPUs out of offers
+(src/dstack/_internal/core/backends/gcp/compute.py:711-713,804-821); here
+multi-host slices are first-class.
+
+Facts encoded below follow Google Cloud TPU public documentation
+(accelerator types, chips per host VM, topologies).
+"""
+
+import math
+import re
+from dataclasses import dataclass
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+from pydantic import GetCoreSchemaHandler
+from pydantic_core import core_schema
+
+from dstack_tpu.models.common import CoreModel
+
+
+class TpuGeneration(str, Enum):
+    V2 = "v2"
+    V3 = "v3"
+    V4 = "v4"
+    V5E = "v5e"  # aka v5litepod
+    V5P = "v5p"
+    V6E = "v6e"  # Trillium
+
+
+@dataclass(frozen=True)
+class TpuGenerationInfo:
+    generation: TpuGeneration
+    # How the numeric suffix of the accelerator type is counted.
+    suffix_is_cores: bool  # v2/v3/v4/v5p count TensorCores; v5e/v6e count chips
+    cores_per_chip: int
+    hbm_per_chip_gb: float
+    bf16_tflops_per_chip: float
+    # chips on a single-host VM at the largest single-host size
+    max_chips_single_host: int
+    # chips per worker VM in a multi-host slice
+    chips_per_host_multihost: int
+    max_chips: int
+    # GCE machine types used for the TPU VM workers (single-host, multi-host)
+    machine_type_single: str
+    machine_type_multi: str
+    # runtime (software) version the backend requests by default
+    default_runtime: str
+    # 3D ICI torus (v4/v5p) vs 2D mesh (v2/v3/v5e/v6e)
+    ici_dims: int
+    # accelerator type prefix used by the cloud API, e.g. "v5litepod"
+    api_prefix: str
+
+
+GENERATIONS: Dict[TpuGeneration, TpuGenerationInfo] = {
+    TpuGeneration.V2: TpuGenerationInfo(
+        TpuGeneration.V2, True, 2, 8, 23, 4, 4, 512, "n/a", "n/a", "tpu-ubuntu2204-base", 2, "v2"
+    ),
+    TpuGeneration.V3: TpuGenerationInfo(
+        TpuGeneration.V3, True, 2, 16, 61, 4, 4, 2048, "n/a", "n/a", "tpu-ubuntu2204-base", 2, "v3"
+    ),
+    TpuGeneration.V4: TpuGenerationInfo(
+        TpuGeneration.V4, True, 2, 32, 138, 4, 4, 8192,
+        "ct4p-hightpu-4t", "ct4p-hightpu-4t", "tpu-ubuntu2204-base", 3, "v4",
+    ),
+    TpuGeneration.V5E: TpuGenerationInfo(
+        TpuGeneration.V5E, False, 1, 16, 197, 8, 4, 256,
+        "ct5lp-hightpu-8t", "ct5lp-hightpu-4t", "v2-alpha-tpuv5-lite", 2, "v5litepod",
+    ),
+    TpuGeneration.V5P: TpuGenerationInfo(
+        TpuGeneration.V5P, True, 2, 95, 459, 4, 4, 17920,
+        "ct5p-hightpu-4t", "ct5p-hightpu-4t", "v2-alpha-tpuv5", 3, "v5p",
+    ),
+    TpuGeneration.V6E: TpuGenerationInfo(
+        TpuGeneration.V6E, False, 1, 32, 918, 8, 4, 256,
+        "ct6e-standard-8t", "ct6e-standard-4t", "v2-alpha-tpuv6e", 2, "v6e",
+    ),
+}
+
+# Published slice topologies (chips -> ICI grid) for the generations we can
+# gang-schedule. Grids are (x, y) or (x, y, z) chip meshes.
+_TOPOLOGIES: Dict[TpuGeneration, Dict[int, Tuple[int, ...]]] = {
+    TpuGeneration.V5E: {
+        1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+        64: (8, 8), 128: (8, 16), 256: (16, 16),
+    },
+    TpuGeneration.V6E: {
+        1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+        64: (8, 8), 128: (8, 16), 256: (16, 16),
+    },
+    TpuGeneration.V4: {
+        # chips = suffix/2; topologies from 2x2x1 up (v4-8 .. v4-4096 subset)
+        4: (2, 2, 1), 8: (2, 2, 2), 16: (2, 2, 4), 32: (2, 4, 4),
+        64: (4, 4, 4), 128: (4, 4, 8), 256: (4, 8, 8), 512: (8, 8, 8),
+        1024: (8, 8, 16), 2048: (8, 16, 16), 4096: (16, 16, 16),
+    },
+    TpuGeneration.V5P: {
+        4: (2, 2, 1), 8: (2, 2, 2), 16: (2, 2, 4), 32: (2, 4, 4),
+        64: (4, 4, 4), 128: (4, 4, 8), 256: (4, 8, 8), 512: (8, 8, 8),
+        1024: (8, 8, 16), 2048: (8, 16, 16), 4096: (16, 16, 16),
+        8960: (16, 20, 28),
+    },
+    TpuGeneration.V2: {4: (2, 2), 16: (4, 4), 32: (4, 8), 128: (8, 16), 256: (16, 16)},
+    TpuGeneration.V3: {4: (2, 2), 16: (4, 4), 32: (4, 8), 128: (8, 16),
+                       256: (16, 16), 512: (16, 32), 1024: (32, 32)},
+}
+
+_ALIASES = {
+    "v5litepod": TpuGeneration.V5E,
+    "v5lite": TpuGeneration.V5E,
+    "v5e": TpuGeneration.V5E,
+    "v5p": TpuGeneration.V5P,
+    "v6e": TpuGeneration.V6E,
+    "trillium": TpuGeneration.V6E,
+    "v2": TpuGeneration.V2,
+    "v3": TpuGeneration.V3,
+    "v4": TpuGeneration.V4,
+}
+
+_TPU_TYPE_RE = re.compile(
+    r"^(?:tpu-)?(v5litepod|v5lite|v5e|v5p|v6e|trillium|v[234])-(\d+)$", re.IGNORECASE
+)
+
+
+class TpuTopology(CoreModel):
+    """A concrete TPU pod slice: generation + chip count + ICI grid + hosts.
+
+    ``accelerator_type`` round-trips to the cloud API name (`v5litepod-16`).
+    """
+
+    generation: TpuGeneration
+    chips: int
+    grid: List[int]
+    hosts: int
+
+    @property
+    def info(self) -> TpuGenerationInfo:
+        return GENERATIONS[self.generation]
+
+    @property
+    def cores(self) -> int:
+        return self.chips * self.info.cores_per_chip
+
+    @property
+    def accelerator_type(self) -> str:
+        info = self.info
+        suffix = self.cores if info.suffix_is_cores else self.chips
+        return f"{info.api_prefix}-{suffix}"
+
+    @property
+    def display_name(self) -> str:
+        suffix = self.cores if self.info.suffix_is_cores else self.chips
+        return f"{self.generation.value}-{suffix}"
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    @property
+    def topology_string(self) -> str:
+        return "x".join(str(d) for d in self.grid)
+
+    @property
+    def hbm_total_gb(self) -> float:
+        return self.chips * self.info.hbm_per_chip_gb
+
+    @property
+    def bf16_tflops(self) -> float:
+        return self.chips * self.info.bf16_tflops_per_chip
+
+    @property
+    def machine_type(self) -> str:
+        info = self.info
+        return info.machine_type_multi if self.is_multihost else info.machine_type_single
+
+    @property
+    def runtime_version(self) -> str:
+        return self.info.default_runtime
+
+    def mesh_axes(self) -> Dict[str, int]:
+        """Suggested physical mesh for jax.sharding.Mesh over this slice.
+
+        Returns `{"data": hosts, "model": chips_per_host}` as the safe
+        default: the model axis stays within one host's ICI-contiguous chips,
+        the data axis spans hosts (still ICI within a slice). Workloads are
+        free to reshape — all chips in a slice are ICI-connected.
+        """
+        return {"data": self.hosts, "model": self.chips_per_host}
+
+    @classmethod
+    def parse(cls, value: str) -> "TpuTopology":
+        """Parse `v5p-256`, `v5litepod-4`, `tpu-v6e-16`, `v4-8`, ..."""
+        m = _TPU_TYPE_RE.match(value.strip())
+        if not m:
+            raise ValueError(f"Not a TPU accelerator type: {value!r}")
+        gen = _ALIASES[m.group(1).lower()]
+        suffix = int(m.group(2))
+        info = GENERATIONS[gen]
+        if info.suffix_is_cores:
+            if suffix % info.cores_per_chip != 0:
+                raise ValueError(
+                    f"{value}: suffix must be a multiple of {info.cores_per_chip} TensorCores"
+                )
+            chips = suffix // info.cores_per_chip
+        else:
+            chips = suffix
+        return cls.from_chips(gen, chips)
+
+    @classmethod
+    def from_chips(cls, generation: TpuGeneration, chips: int) -> "TpuTopology":
+        info = GENERATIONS[generation]
+        if chips < 1 or chips > info.max_chips:
+            raise ValueError(
+                f"{generation.value}: chip count {chips} out of range 1..{info.max_chips}"
+            )
+        grid = _TOPOLOGIES.get(generation, {}).get(chips)
+        if grid is None:
+            grid = _factor_grid(chips, info.ici_dims)
+        hosts = cls._hosts_for(info, chips)
+        return cls(generation=generation, chips=chips, grid=list(grid), hosts=hosts)
+
+    @staticmethod
+    def _hosts_for(info: TpuGenerationInfo, chips: int) -> int:
+        if chips <= info.max_chips_single_host:
+            return 1
+        if chips % info.chips_per_host_multihost != 0:
+            raise ValueError(
+                f"{info.generation.value}: multi-host slice needs a multiple of "
+                f"{info.chips_per_host_multihost} chips, got {chips}"
+            )
+        return chips // info.chips_per_host_multihost
+
+    @classmethod
+    def is_tpu_type(cls, value: str) -> bool:
+        return bool(_TPU_TYPE_RE.match(value.strip()))
+
+    def __str__(self) -> str:
+        return self.display_name
+
+
+def _factor_grid(chips: int, dims: int) -> Tuple[int, ...]:
+    """Near-square factorisation of a chip count into an ICI grid."""
+    if dims == 2:
+        x = int(math.isqrt(chips))
+        while x > 1 and chips % x != 0:
+            x -= 1
+        return (x, chips // x)
+    best: Tuple[int, ...] = (1, 1, chips)
+    best_score = chips * 3
+    for x in range(1, int(round(chips ** (1 / 3))) + 2):
+        if chips % x:
+            continue
+        rest = chips // x
+        for y in range(x, int(math.isqrt(rest)) + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            score = x + y + z
+            if score < best_score:
+                best_score = score
+                best = (x, y, z)
+    return best
+
+
+def list_accelerator_types(generation: Optional[TpuGeneration] = None) -> List[TpuTopology]:
+    """Enumerate all published slice sizes (used by the offers catalog)."""
+    out: List[TpuTopology] = []
+    gens = [generation] if generation else list(_TOPOLOGIES)
+    for gen in gens:
+        info = GENERATIONS[gen]
+        for chips in sorted(_TOPOLOGIES[gen]):
+            # v5e/v6e also have an 8-chip single-host size not always in the
+            # topology table; chips keys cover published sizes already.
+            out.append(TpuTopology.from_chips(gen, chips))
+    return out

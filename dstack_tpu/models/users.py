@@ -1,0 +1,55 @@
+"""User / project / member domain models.
+
+Parity: src/dstack/_internal/core/models/users.py, projects.py.
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import List, Optional
+
+from dstack_tpu.models.common import CoreModel
+
+
+class GlobalRole(str, Enum):
+    ADMIN = "admin"
+    USER = "user"
+
+
+class ProjectRole(str, Enum):
+    ADMIN = "admin"
+    MANAGER = "manager"
+    USER = "user"
+
+
+class User(CoreModel):
+    id: str
+    username: str
+    global_role: GlobalRole
+    email: Optional[str] = None
+    created_at: Optional[datetime] = None
+    active: bool = True
+
+
+class UserWithCreds(User):
+    creds: Optional["UserTokenCreds"] = None
+
+
+class UserTokenCreds(CoreModel):
+    token: str
+
+
+class Member(CoreModel):
+    user: User
+    project_role: ProjectRole
+
+
+class Project(CoreModel):
+    id: str
+    project_name: str
+    owner: User
+    created_at: Optional[datetime] = None
+    backends: List[str] = []
+    members: List[Member] = []
+
+
+UserWithCreds.model_rebuild()

@@ -1,0 +1,113 @@
+"""Volume domain models.
+
+Parity: src/dstack/_internal/core/models/volumes.py — network volumes
+(GCP persistent disks first-class, incl. attach to TPU VMs via the
+UpdateNode path, reference gcp/compute.py:567-642) and instance mounts.
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import Any, List, Optional, Union
+
+from pydantic import model_validator
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.common import CoreModel
+from dstack_tpu.models.resources import Memory
+
+
+class VolumeStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    ACTIVE = "active"
+    FAILED = "failed"
+
+    def is_active(self) -> bool:
+        return self == self.ACTIVE
+
+
+class VolumeConfiguration(CoreModel):
+    type: str = "volume"
+    name: Optional[str] = None
+    backend: BackendType
+    region: str
+    availability_zone: Optional[str] = None
+    size: Optional[Memory] = None
+    volume_id: Optional[str] = None  # register an existing cloud disk
+
+    @model_validator(mode="after")
+    def _check(self) -> "VolumeConfiguration":
+        if self.size is None and self.volume_id is None:
+            raise ValueError("Either `size` or `volume_id` must be set")
+        return self
+
+
+class VolumeProvisioningData(CoreModel):
+    backend: Optional[BackendType] = None
+    volume_id: str
+    size_gb: int
+    availability_zone: Optional[str] = None
+    price: Optional[float] = None
+    attachable: bool = True
+    detachable: bool = True
+    backend_data: Optional[str] = None
+
+
+class VolumeAttachmentData(CoreModel):
+    device_name: Optional[str] = None
+
+
+class Volume(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    configuration: VolumeConfiguration
+    external: bool = False
+    created_at: datetime
+    status: VolumeStatus
+    status_message: Optional[str] = None
+    volume_id: Optional[str] = None
+    provisioning_data: Optional[VolumeProvisioningData] = None
+    attachment_data: Optional[VolumeAttachmentData] = None
+    attached_to: List[str] = []
+    deleted: bool = False
+
+
+class VolumeMountPoint(CoreModel):
+    name: str
+    path: str
+
+
+class InstanceMountPoint(CoreModel):
+    instance_path: str
+    path: str
+
+
+MountPoint = Union[VolumeMountPoint, InstanceMountPoint]
+
+
+def parse_mount_point(v: str) -> MountPoint:
+    """`name:/container/path` or `/host/path:/container/path`."""
+    src, sep, dst = v.partition(":")
+    if not sep or not src or not dst:
+        raise ValueError(f"Invalid mount point: {v!r}")
+    if src.startswith("/"):
+        return InstanceMountPoint(instance_path=src, path=dst)
+    return VolumeMountPoint(name=src, path=dst)
+
+
+def parse_mount_points(items: List[Any]) -> List[MountPoint]:
+    out: List[MountPoint] = []
+    for item in items:
+        if isinstance(item, str):
+            out.append(parse_mount_point(item))
+        elif isinstance(item, (VolumeMountPoint, InstanceMountPoint)):
+            out.append(item)
+        elif isinstance(item, dict):
+            if "name" in item:
+                out.append(VolumeMountPoint.model_validate(item))
+            else:
+                out.append(InstanceMountPoint.model_validate(item))
+        else:
+            raise ValueError(f"Invalid mount point: {item!r}")
+    return out

@@ -1,0 +1,90 @@
+"""JAX distributed bootstrap env assembly — the TPU-native replacement for the
+reference's `DSTACK_MASTER_NODE_IP` / `MASTER_ADDR` + NCCL env injection
+(runner/internal/executor/executor.go:213-230, SURVEY §2.7).
+
+The orchestrator's contract with the container is pure environment:
+
+  Single-slice (ICI) pod run, one process per worker host:
+    JAX_COORDINATOR_ADDRESS = <master ip>:<port>       (jax.distributed)
+    JAX_PROCESS_ID          = <host rank in slice>
+    JAX_NUM_PROCESSES       = <hosts in slice>
+    PJRT_DEVICE             = TPU
+    TPU_WORKER_ID           = <host rank>              (libtpu)
+    TPU_WORKER_HOSTNAMES    = ip0,ip1,...              (libtpu)
+
+  Multi-slice (DCN) runs additionally get MEGASCALE_* so XLA stitches
+  slices over the data-center network.
+
+  DSTACK_* vars are kept for compatibility with the reference's examples
+  (e.g. scripts branching on DSTACK_NODE_RANK).
+
+`jax.distributed.initialize()` with no args consumes exactly these variables,
+so user code needs zero bootstrap logic.
+"""
+
+from typing import Dict, List, Optional
+
+from dstack_tpu.models.runs import ClusterInfo
+
+DEFAULT_COORDINATOR_PORT = 8476
+DEFAULT_MEGASCALE_PORT = 8576
+
+
+def make_cluster_env(
+    cluster: ClusterInfo,
+    node_rank: int,
+) -> Dict[str, str]:
+    """Env for one worker host of a gang-scheduled run."""
+    n = len(cluster.job_ips)
+    coordinator = f"{cluster.master_job_ip}:{cluster.coordinator_port}"
+    env = {
+        # JAX-native bootstrap (jax.distributed.initialize reads these).
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_COORDINATOR_PORT": str(cluster.coordinator_port),
+        "JAX_PROCESS_ID": str(node_rank),
+        "JAX_NUM_PROCESSES": str(n),
+        "PJRT_DEVICE": "TPU",
+        # libtpu topology discovery for multi-host slices.
+        "TPU_WORKER_ID": str(node_rank),
+        "TPU_WORKER_HOSTNAMES": ",".join(cluster.job_ips),
+        # Reference-compatible vars so existing example scripts keep working
+        # (reference: executor.go:219-230).
+        "DSTACK_NODES_IPS": "\n".join(cluster.job_ips),
+        "DSTACK_MASTER_NODE_IP": cluster.master_job_ip,
+        "DSTACK_NODE_RANK": str(node_rank),
+        "DSTACK_NODES_NUM": str(n),
+        "DSTACK_GPUS_PER_NODE": str(cluster.chips_per_host),
+        "DSTACK_GPUS_NUM": str(cluster.chips_per_host * n),
+        # Chips-first aliases.
+        "DSTACK_CHIPS_PER_HOST": str(cluster.chips_per_host),
+        "DSTACK_CHIPS_NUM": str(cluster.chips_per_host * n),
+    }
+    if cluster.tpu_slice is not None:
+        env["DSTACK_TPU_ACCELERATOR_TYPE"] = cluster.tpu_slice.accelerator_type
+        env["DSTACK_TPU_TOPOLOGY"] = cluster.tpu_slice.topology_string
+    if cluster.slice_count > 1:
+        env.update(make_megascale_env(cluster))
+    return env
+
+
+def make_megascale_env(cluster: ClusterInfo) -> Dict[str, str]:
+    """Multi-slice (DCN) env: XLA's megascale runtime coordinates slices.
+
+    `MEGASCALE_COORDINATOR_ADDRESS` must be the same host for every process
+    in every slice; slice 0's master is used.
+    """
+    return {
+        "MEGASCALE_COORDINATOR_ADDRESS": f"{cluster.master_job_ip}:{DEFAULT_MEGASCALE_PORT}",
+        "MEGASCALE_NUM_SLICES": str(cluster.slice_count),
+        "MEGASCALE_SLICE_ID": str(cluster.slice_id),
+    }
+
+
+def jax_initialize_kwargs(env: Dict[str, str]) -> Dict[str, object]:
+    """The `jax.distributed.initialize(**kwargs)` equivalent of the env —
+    used by docs/tests to assert the env is sufficient and consistent."""
+    return {
+        "coordinator_address": env["JAX_COORDINATOR_ADDRESS"],
+        "num_processes": int(env["JAX_NUM_PROCESSES"]),
+        "process_id": int(env["JAX_PROCESS_ID"]),
+    }

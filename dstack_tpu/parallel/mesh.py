@@ -1,0 +1,47 @@
+"""Device-mesh planning helpers for workloads launched by the orchestrator.
+
+Maps a TpuTopology (physical: hosts x chips-per-host over ICI) to logical
+`jax.sharding.Mesh` axis layouts for common parallelism styles (dp/fsdp/tp).
+These helpers are used by the bundled example workloads
+(dstack_tpu/workloads/) and by `__graft_entry__.dryrun_multichip`; user code
+is free to build its own mesh — every chip in a slice is ICI-connected.
+"""
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from dstack_tpu.models.topology import TpuTopology
+
+
+def plan_mesh(
+    topo: TpuTopology,
+    tensor_parallel: Optional[int] = None,
+    fsdp: Optional[int] = None,
+) -> Dict[str, int]:
+    """Plan `{axis: size}` for a slice.
+
+    Defaults: tensor-parallel axis = chips per host (stays on one host's
+    ICI-contiguous chips, where all-reduce latency is lowest); remaining
+    factor is (fs)dp across hosts — the layout the scaling-book recipe
+    starts from.
+    """
+    chips = topo.chips
+    tp = tensor_parallel or topo.chips_per_host
+    if chips % tp != 0:
+        raise ValueError(f"tensor_parallel={tp} does not divide {chips} chips")
+    rest = chips // tp
+    if fsdp is None:
+        fsdp = rest
+    if fsdp == 0 or rest % fsdp != 0:
+        raise ValueError(f"fsdp={fsdp} does not divide {rest}")
+    dp = rest // fsdp
+    axes = {"data": dp, "fsdp": fsdp, "model": tp}
+    return {k: v for k, v in axes.items() if v > 1} or {"data": 1}
+
+
+def mesh_shape_for_devices(
+    n_devices: int, tensor_parallel: int = 1
+) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """(shape, axis_names) for `jax.sharding.Mesh` over n flat devices."""
+    if n_devices % tensor_parallel != 0:
+        raise ValueError(f"{tensor_parallel=} does not divide {n_devices=}")
+    return (n_devices // tensor_parallel, tensor_parallel), ("data", "model")

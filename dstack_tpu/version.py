@@ -1,0 +1,5 @@
+__version__ = "0.1.0"
+
+# Minimum client version the server accepts; used by the version-check
+# middleware (reference: src/dstack/_internal/server/app.py middleware).
+MIN_CLIENT_VERSION = "0.1.0"

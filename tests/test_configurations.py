@@ -1,0 +1,173 @@
+import pytest
+
+from dstack_tpu.errors import ConfigurationError
+from dstack_tpu.models.configurations import (
+    DevEnvironmentConfiguration,
+    PortMapping,
+    ServiceConfiguration,
+    TaskConfiguration,
+    parse_apply_configuration,
+    parse_run_configuration,
+)
+from dstack_tpu.models.services import OpenAIChatModel
+from dstack_tpu.models.volumes import InstanceMountPoint, VolumeMountPoint
+
+
+class TestTask:
+    def test_minimal(self):
+        conf = parse_run_configuration({"type": "task", "commands": ["echo hi"]})
+        assert isinstance(conf, TaskConfiguration)
+        assert conf.nodes == 1
+
+    def test_reference_tpu_service_yaml(self):
+        """The vLLM TPU example from the reference parses unchanged."""
+        conf = parse_run_configuration(
+            {
+                "type": "service",
+                "name": "llama31-service-vllm-tpu",
+                "image": "vllm/vllm-tpu:nightly",
+                "env": ["HF_TOKEN", "MODEL_ID=meta-llama/Meta-Llama-3.1-8B-Instruct"],
+                "commands": ["vllm serve $MODEL_ID --port 8000"],
+                "port": 8000,
+                "model": "meta-llama/Meta-Llama-3.1-8B-Instruct",
+                "resources": {"gpu": "v5litepod-4"},
+            }
+        )
+        assert isinstance(conf, ServiceConfiguration)
+        assert conf.port == PortMapping(local_port=80, container_port=8000)
+        assert isinstance(conf.model, OpenAIChatModel)
+        assert conf.resources.tpu is not None
+        assert conf.env.as_dict()["HF_TOKEN"] is None
+
+    def test_multinode(self):
+        conf = parse_run_configuration(
+            {
+                "type": "task",
+                "nodes": 4,
+                "commands": ["python train.py"],
+                "resources": {"tpu": "v5p-64"},
+            }
+        )
+        assert conf.nodes == 4
+
+    def test_no_commands_no_image_fails(self):
+        with pytest.raises(ConfigurationError):
+            parse_run_configuration({"type": "task"})
+
+    def test_ports(self):
+        conf = parse_run_configuration(
+            {"type": "task", "commands": ["x"], "ports": [8000, "80:8080", "*:9000"]}
+        )
+        assert conf.ports[0] == PortMapping(local_port=8000, container_port=8000)
+        assert conf.ports[1] == PortMapping(local_port=80, container_port=8080)
+        assert conf.ports[2] == PortMapping(local_port=None, container_port=9000)
+
+    def test_volumes_syntax(self):
+        conf = parse_run_configuration(
+            {
+                "type": "task",
+                "commands": ["x"],
+                "volumes": ["my-vol:/checkpoints", "/mnt/data:/data"],
+            }
+        )
+        assert conf.volumes[0] == VolumeMountPoint(name="my-vol", path="/checkpoints")
+        assert conf.volumes[1] == InstanceMountPoint(instance_path="/mnt/data", path="/data")
+
+    def test_python_image_exclusive(self):
+        with pytest.raises(ConfigurationError):
+            parse_run_configuration(
+                {"type": "task", "commands": ["x"], "python": "3.12", "image": "img"}
+            )
+
+    def test_profile_params_inline(self):
+        conf = parse_run_configuration(
+            {
+                "type": "task",
+                "commands": ["x"],
+                "spot_policy": "auto",
+                "max_duration": "2h",
+                "backends": ["gcp"],
+            }
+        )
+        assert conf.max_duration == 7200
+
+
+class TestService:
+    def test_replicas_range_needs_scaling(self):
+        with pytest.raises(ConfigurationError):
+            parse_run_configuration(
+                {"type": "service", "commands": ["x"], "port": 80, "replicas": "1..4"}
+            )
+
+    def test_replicas_with_scaling(self):
+        conf = parse_run_configuration(
+            {
+                "type": "service",
+                "commands": ["x"],
+                "port": 80,
+                "replicas": "1..4",
+                "scaling": {"metric": "rps", "target": 10},
+            }
+        )
+        assert conf.replicas.min == 1
+        assert conf.replicas.max == 4
+        assert conf.scaling.scale_up_delay == 300
+
+    def test_gateway_true_rejected(self):
+        with pytest.raises(ConfigurationError):
+            parse_run_configuration(
+                {"type": "service", "commands": ["x"], "port": 80, "gateway": True}
+            )
+
+
+class TestDevEnvironment:
+    def test_minimal(self):
+        conf = parse_run_configuration({"type": "dev-environment", "ide": "vscode"})
+        assert isinstance(conf, DevEnvironmentConfiguration)
+
+
+class TestApply:
+    def test_fleet(self):
+        conf = parse_apply_configuration(
+            {"type": "fleet", "name": "f", "nodes": 2, "resources": {"tpu": "v4-8"}}
+        )
+        assert conf.type == "fleet"
+
+    def test_ssh_fleet(self):
+        conf = parse_apply_configuration(
+            {
+                "type": "fleet",
+                "name": "onprem",
+                "ssh_config": {
+                    "user": "ubuntu",
+                    "identity_file": "~/.ssh/id_rsa",
+                    "hosts": ["10.0.0.1", {"hostname": "10.0.0.2", "blocks": 1}],
+                },
+            }
+        )
+        assert conf.ssh_config.hosts[0].hostname == "10.0.0.1"
+
+    def test_volume(self):
+        conf = parse_apply_configuration(
+            {"type": "volume", "name": "v", "backend": "gcp", "region": "us-central2", "size": "200GB"}
+        )
+        assert conf.size == 200.0
+
+    def test_unknown_type(self):
+        with pytest.raises(ConfigurationError):
+            parse_apply_configuration({"type": "nope"})
+
+
+class TestRunSpecMerge:
+    def test_merged_profile(self):
+        from dstack_tpu.models.profiles import Profile, SpotPolicy
+        from dstack_tpu.models.runs import RunSpec
+
+        spec = RunSpec(
+            configuration=parse_run_configuration(
+                {"type": "task", "commands": ["x"], "spot_policy": "spot"}
+            ),
+            profile=Profile(name="p", max_price=2.0),
+        )
+        assert spec.merged_profile.spot_policy == SpotPolicy.SPOT
+        assert spec.merged_profile.max_price == 2.0

@@ -1,0 +1,72 @@
+from dstack_tpu.models.runs import ClusterInfo
+from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.parallel.env import jax_initialize_kwargs, make_cluster_env
+from dstack_tpu.parallel.mesh import mesh_shape_for_devices, plan_mesh
+
+
+def _cluster(hosts=4):
+    topo = TpuTopology.parse("v5p-32")
+    ips = [f"10.0.0.{i}" for i in range(hosts)]
+    return ClusterInfo(
+        job_ips=ips,
+        master_job_ip=ips[0],
+        chips_per_host=topo.chips_per_host,
+        tpu_slice=topo,
+    )
+
+
+class TestClusterEnv:
+    def test_jax_bootstrap(self):
+        env = make_cluster_env(_cluster(), node_rank=2)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.0:8476"
+        assert env["JAX_PROCESS_ID"] == "2"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["PJRT_DEVICE"] == "TPU"
+        assert env["TPU_WORKER_ID"] == "2"
+        assert env["TPU_WORKER_HOSTNAMES"] == "10.0.0.0,10.0.0.1,10.0.0.2,10.0.0.3"
+
+    def test_reference_compat_vars(self):
+        env = make_cluster_env(_cluster(), node_rank=0)
+        assert env["DSTACK_MASTER_NODE_IP"] == "10.0.0.0"
+        assert env["DSTACK_NODE_RANK"] == "0"
+        assert env["DSTACK_NODES_NUM"] == "4"
+        assert env["DSTACK_GPUS_PER_NODE"] == "4"  # chips, chips-first
+        assert env["DSTACK_TPU_ACCELERATOR_TYPE"] == "v5p-32"
+
+    def test_no_megascale_single_slice(self):
+        env = make_cluster_env(_cluster(), node_rank=0)
+        assert "MEGASCALE_NUM_SLICES" not in env
+
+    def test_megascale_multislice(self):
+        c = _cluster()
+        c.slice_count = 2
+        c.slice_id = 1
+        env = make_cluster_env(c, node_rank=0)
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+
+    def test_initialize_kwargs_consistent(self):
+        env = make_cluster_env(_cluster(), node_rank=3)
+        kw = jax_initialize_kwargs(env)
+        assert kw["process_id"] == 3
+        assert kw["num_processes"] == 4
+
+
+class TestMeshPlan:
+    def test_default_v5p_256(self):
+        topo = TpuTopology.parse("v5p-256")
+        axes = plan_mesh(topo)
+        total = 1
+        for v in axes.values():
+            total *= v
+        assert total == topo.chips
+
+    def test_tp_override(self):
+        topo = TpuTopology.parse("v5e-16")
+        axes = plan_mesh(topo, tensor_parallel=8)
+        assert axes["model"] == 8
+
+    def test_shape_for_devices(self):
+        shape, names = mesh_shape_for_devices(8, tensor_parallel=2)
+        assert shape == (4, 2)
+        assert names == ("data", "model")

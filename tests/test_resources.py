@@ -1,0 +1,126 @@
+import pytest
+
+from dstack_tpu.models.resources import (
+    AcceleratorVendor,
+    GPUSpec,
+    Memory,
+    Range,
+    ResourcesSpec,
+    TpuSpec,
+)
+from dstack_tpu.models.topology import TpuGeneration, TpuTopology
+
+
+class TestMemory:
+    @pytest.mark.parametrize(
+        "raw,expected",
+        [("8GB", 8.0), ("512MB", 0.5), ("1.5TB", 1536.0), (16, 16.0), ("24", 24.0)],
+    )
+    def test_parse(self, raw, expected):
+        assert Memory.parse(raw) == expected
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            Memory.parse("8QB")
+
+
+class TestRange:
+    def test_scalar(self):
+        r = Range[int].model_validate(4)
+        assert (r.min, r.max) == (4, 4)
+
+    def test_str_range(self):
+        r = Range[int].model_validate("2..8")
+        assert (r.min, r.max) == (2, 8)
+
+    def test_open_ranges(self):
+        assert Range[int].model_validate("4..").max is None
+        assert Range[int].model_validate("..16").min is None
+
+    def test_memory_range(self):
+        r = Range[Memory].model_validate("16GB..80GB")
+        assert (r.min, r.max) == (16.0, 80.0)
+
+    def test_empty_invalid(self):
+        with pytest.raises(ValueError):
+            Range[int].model_validate("..")
+
+    def test_order_invalid(self):
+        with pytest.raises(ValueError):
+            Range[int].model_validate("8..2")
+
+    def test_intersect(self):
+        a = Range[int](min=2, max=8)
+        b = Range[int](min=4, max=None)
+        c = a.intersect(b)
+        assert (c.min, c.max) == (4, 8)
+        assert a.intersect(Range[int](min=9, max=None)) is None
+
+
+class TestTpuSpec:
+    def test_from_accelerator_type(self):
+        spec = TpuSpec.model_validate("v5p-256")
+        assert spec.generation == [TpuGeneration.V5P]
+        assert spec.chips.min == spec.chips.max == 128
+
+    def test_structured(self):
+        spec = TpuSpec.model_validate({"generation": "v5e", "chips": "8..256"})
+        assert spec.generation == [TpuGeneration.V5E]
+        assert spec.chips.min == 8
+
+    def test_cores_to_chips(self):
+        spec = TpuSpec.model_validate({"generation": "v5p", "cores": 256})
+        assert spec.chips.min == 128
+
+    def test_matches(self):
+        spec = TpuSpec.model_validate({"generation": ["v5e", "v6e"], "chips": "8.."})
+        assert spec.matches(TpuTopology.parse("v5e-16"))
+        assert spec.matches(TpuTopology.parse("v6e-8"))
+        assert not spec.matches(TpuTopology.parse("v5e-4"))
+        assert not spec.matches(TpuTopology.parse("v5p-64"))
+
+
+class TestGpuCompat:
+    def test_reference_tpu_example_syntax(self):
+        """`resources: gpu: v5litepod-4` from examples/deployment/vllm/tpu."""
+        res = ResourcesSpec.model_validate({"gpu": "v5litepod-4"})
+        assert res.gpu is None  # lifted
+        assert res.tpu is not None
+        assert res.tpu.generation == [TpuGeneration.V5E]
+        assert res.tpu.chips.min == 4
+
+    def test_gpu_string_spec(self):
+        spec = GPUSpec.model_validate("A100:2:40GB")
+        assert spec.name == ["A100"]
+        assert (spec.count.min, spec.count.max) == (2, 2)
+        assert spec.memory.min == 40.0
+
+    def test_tpu_vendor_alias(self):
+        spec = GPUSpec.model_validate({"vendor": "tpu", "name": "v5p-8"})
+        assert spec.vendor == AcceleratorVendor.GOOGLE
+
+    def test_tpu_name_prefix_deprecated(self):
+        spec = GPUSpec.model_validate({"name": ["tpu-v5litepod-8"]})
+        assert spec.vendor == AcceleratorVendor.GOOGLE
+        assert spec.name == ["v5litepod-8"]
+
+    def test_count_only(self):
+        spec = GPUSpec.model_validate(2)
+        assert (spec.count.min, spec.count.max) == (2, 2)
+
+
+class TestResourcesSpec:
+    def test_defaults(self):
+        res = ResourcesSpec()
+        assert res.cpu.min == 2
+        assert res.memory.min == 8.0
+        assert res.disk.size.min == 100.0
+        assert res.tpu is None
+
+    def test_native_tpu_field(self):
+        res = ResourcesSpec.model_validate({"tpu": "v5p-256", "cpu": 8})
+        assert res.tpu.chips.min == 128
+
+    def test_shm_size(self):
+        res = ResourcesSpec.model_validate({"shm_size": "16GB"})
+        assert res.shm_size == 16.0

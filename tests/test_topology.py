@@ -1,0 +1,97 @@
+import pytest
+
+from dstack_tpu.models.topology import (
+    GENERATIONS,
+    TpuGeneration,
+    TpuTopology,
+    list_accelerator_types,
+)
+
+
+class TestParse:
+    def test_v5p_256_is_32_hosts(self):
+        topo = TpuTopology.parse("v5p-256")
+        assert topo.generation == TpuGeneration.V5P
+        assert topo.cores == 256
+        assert topo.chips == 128
+        assert topo.hosts == 32
+        assert topo.is_multihost
+        assert topo.chips_per_host == 4
+        assert topo.accelerator_type == "v5p-256"
+
+    def test_v5litepod_4_single_host(self):
+        topo = TpuTopology.parse("v5litepod-4")
+        assert topo.generation == TpuGeneration.V5E
+        assert topo.chips == 4
+        assert topo.hosts == 1
+        assert not topo.is_multihost
+        assert topo.accelerator_type == "v5litepod-4"
+
+    def test_v5e_alias(self):
+        assert TpuTopology.parse("v5e-16") == TpuTopology.parse("v5litepod-16")
+
+    def test_v5e_16_multihost(self):
+        topo = TpuTopology.parse("v5litepod-16")
+        assert topo.hosts == 4  # multi-host v5e uses 4-chip workers
+        assert topo.topology_string == "4x4"
+
+    def test_v5e_8_single_host(self):
+        topo = TpuTopology.parse("v5litepod-8")
+        assert topo.hosts == 1
+        assert topo.chips == 8
+
+    def test_v6e(self):
+        topo = TpuTopology.parse("v6e-256")
+        assert topo.generation == TpuGeneration.V6E
+        assert topo.chips == 256
+        assert topo.hosts == 64
+
+    def test_v4(self):
+        topo = TpuTopology.parse("v4-8")
+        assert topo.chips == 4
+        assert topo.hosts == 1
+        topo = TpuTopology.parse("v4-64")
+        assert topo.chips == 32
+        assert topo.hosts == 8
+        assert len(topo.grid) == 3
+
+    def test_tpu_prefix(self):
+        assert TpuTopology.parse("tpu-v5p-8").chips == 4
+
+    def test_odd_cores_rejected(self):
+        with pytest.raises(ValueError):
+            TpuTopology.parse("v5p-7")
+
+    def test_not_tpu(self):
+        assert not TpuTopology.is_tpu_type("A100")
+        assert not TpuTopology.is_tpu_type("H100:8")
+        assert TpuTopology.is_tpu_type("v5litepod-4")
+
+    def test_round_trip_all_published(self):
+        for topo in list_accelerator_types():
+            again = TpuTopology.parse(topo.accelerator_type)
+            assert again.chips == topo.chips
+            assert again.hosts == topo.hosts
+
+
+class TestDerived:
+    def test_hbm_and_flops(self):
+        topo = TpuTopology.parse("v5p-8")
+        assert topo.hbm_total_gb == 4 * 95
+        assert topo.bf16_tflops == 4 * 459
+
+    def test_mesh_axes(self):
+        topo = TpuTopology.parse("v5p-256")
+        axes = topo.mesh_axes()
+        assert axes["data"] * axes["model"] == topo.chips
+
+    def test_machine_types(self):
+        assert TpuTopology.parse("v5litepod-8").machine_type == "ct5lp-hightpu-8t"
+        assert TpuTopology.parse("v5litepod-32").machine_type == "ct5lp-hightpu-4t"
+
+    def test_grid_product_is_chips(self):
+        for topo in list_accelerator_types():
+            prod = 1
+            for d in topo.grid:
+                prod *= d
+            assert prod == topo.chips, topo.accelerator_type
