@@ -1,0 +1,147 @@
+"""Wire schemas between the server and the host agents (shim + runner).
+
+Parity: src/dstack/_internal/server/schemas/runner.py (the Python mirror of
+runner/internal/schemas). Implemented by BOTH the Python reference agent
+(dstack_tpu/agents/runner.py) and the native C++ agents (agents/native/) —
+one protocol, two implementations, so every backend path is testable without
+the native build and the native build is drop-in.
+
+Runner HTTP API (in-container, :10999):
+  GET  /api/healthcheck          -> HealthcheckResponse
+  POST /api/submit               <- SubmitBody
+  POST /api/upload_code          <- raw bytes (repo tar/diff)
+  POST /api/run                  -> starts execution
+  GET  /api/pull?timestamp=T     -> PullResponse (logs + job state since T)
+  POST /api/stop
+  GET  /api/metrics              -> MetricsPoint
+
+Shim HTTP API (host, :10998) — v2 task API:
+  GET  /api/healthcheck
+  POST /api/tasks                <- TaskSubmitRequest
+  GET  /api/tasks/{id}           -> TaskInfo
+  POST /api/tasks/{id}/terminate <- TaskTerminateRequest
+  DELETE /api/tasks/{id}
+"""
+
+from enum import Enum
+from typing import Dict, List, Optional
+
+from dstack_tpu.models.common import CoreModel
+from dstack_tpu.models.metrics import MetricsPoint
+from dstack_tpu.models.runs import ClusterInfo, JobSpec, JobStatus, JobTerminationReason
+
+RUNNER_PORT = 10999
+SHIM_PORT = 10998
+
+
+class HealthcheckResponse(CoreModel):
+    service: str
+    version: str = "0.1.0"
+
+
+class SubmitBody(CoreModel):
+    run_name: str
+    job_spec: JobSpec
+    cluster_info: Optional[ClusterInfo] = None
+    node_rank: int = 0
+    secrets: Dict[str, str] = {}
+    repo_archive: bool = False  # expect /api/upload_code before /api/run
+    working_dir_root: str = "/workflow"
+
+
+class JobStateEvent(CoreModel):
+    state: JobStatus
+    timestamp: int  # monotonic-ish ms
+    termination_reason: Optional[JobTerminationReason] = None
+    termination_message: Optional[str] = None
+    exit_status: Optional[int] = None
+
+
+class LogEventOut(CoreModel):
+    timestamp: int  # ms since epoch
+    source: str  # "stdout" | "runner"
+    message: str  # base64
+
+
+class PullResponse(CoreModel):
+    job_states: List[JobStateEvent] = []
+    job_logs: List[LogEventOut] = []
+    runner_logs: List[LogEventOut] = []
+    last_updated: int = 0
+    has_more: bool = True
+
+
+class StopBody(CoreModel):
+    grace_seconds: float = 5.0
+
+
+class MetricsResponse(MetricsPoint):
+    pass
+
+
+# ---- shim task API ---------------------------------------------------------
+
+
+class TaskStatus(str, Enum):
+    PENDING = "pending"
+    PREPARING = "preparing"
+    PULLING = "pulling"
+    CREATING = "creating"
+    RUNNING = "running"
+    TERMINATED = "terminated"
+
+
+class PortMappingOut(CoreModel):
+    container_port: int
+    host_port: int
+
+
+class TaskSubmitRequest(CoreModel):
+    id: str
+    name: str
+    image_name: str = ""
+    container_user: Optional[str] = None
+    privileged: bool = False
+    registry_username: Optional[str] = None
+    registry_password: Optional[str] = None
+    shm_size_bytes: int = 0
+    network_mode: str = "host"
+    volumes: List[Dict[str, str]] = []  # {name|instance_path, path}
+    host_ssh_user: str = "root"
+    host_ssh_keys: List[str] = []
+    container_ssh_keys: List[str] = []
+    # TPU passthrough (the shim mounts /dev/accel*, /dev/vfio, libtpu and
+    # sets PJRT_DEVICE; chips cannot be fractionally shared — offers.py:110).
+    tpu_chips: int = 0
+    env: Dict[str, str] = {}
+
+
+class TaskInfo(CoreModel):
+    id: str
+    status: TaskStatus
+    termination_reason: Optional[str] = None
+    termination_message: Optional[str] = None
+    ports: List[PortMappingOut] = []
+    container_name: Optional[str] = None
+    runner_port: int = RUNNER_PORT
+
+
+class TaskTerminateRequest(CoreModel):
+    termination_reason: str = ""
+    termination_message: str = ""
+    timeout: float = 10.0
+
+
+class HostInfo(CoreModel):
+    """Host inventory the shim reports (ssh fleets read this after deploy).
+
+    Parity: shim host_info.json (runner/cmd/shim/main.go service mode);
+    chips via tpu-info/device files instead of nvidia-smi.
+    """
+
+    cpus: int = 0
+    memory_mib: int = 0
+    disk_size_mib: int = 0
+    tpu_chip_count: int = 0
+    tpu_accelerator_type: Optional[str] = None
+    addresses: List[str] = []

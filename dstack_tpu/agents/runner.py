@@ -1,0 +1,367 @@
+"""Python reference implementation of the runner agent.
+
+Parity: runner/internal/executor + runner/internal/runner/api (Go) — the
+in-container agent that receives a job spec, injects the cluster env (JAX
+coordinator bootstrap), executes the user's commands, buffers logs/state,
+and serves the pull API. The native C++ agent (agents/native/) implements
+the same protocol; this one backs the `local` backend and the test suite,
+and works as a pure-Python fallback on any host.
+
+Run: python -m dstack_tpu.agents.runner --port 10999 [--host 127.0.0.1]
+"""
+
+import argparse
+import asyncio
+import base64
+import os
+import signal
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from dstack_tpu.agents.protocol import (
+    HealthcheckResponse,
+    JobStateEvent,
+    LogEventOut,
+    MetricsResponse,
+    PullResponse,
+    StopBody,
+    SubmitBody,
+)
+from dstack_tpu.errors import ApiError
+from dstack_tpu.models.metrics import MetricsPoint, TpuChipMetrics
+from dstack_tpu.models.runs import JobStatus, JobTerminationReason
+from dstack_tpu.parallel.env import make_cluster_env
+from dstack_tpu.server.http import App, Request, Response, Router, Server
+from dstack_tpu.utils.common import utcnow
+
+IDLE_SHUTDOWN_SECONDS = 300.0  # parity: runner self-terminates if no job (server.go:56)
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class Executor:
+    """One job lifecycle: submit -> (upload_code) -> run -> pull -> stop."""
+
+    def __init__(self, working_root: Optional[str] = None):
+        self.submission: Optional[SubmitBody] = None
+        self.code_path: Optional[Path] = None
+        self.working_root = working_root
+        self.job_states: List[JobStateEvent] = []
+        self.job_logs: List[LogEventOut] = []
+        self.runner_logs: List[LogEventOut] = []
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.started = False
+        self.finished = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+
+    # -- state/log plumbing --------------------------------------------------
+
+    def set_state(
+        self,
+        state: JobStatus,
+        reason: Optional[JobTerminationReason] = None,
+        message: Optional[str] = None,
+        exit_status: Optional[int] = None,
+    ) -> None:
+        self.job_states.append(
+            JobStateEvent(
+                state=state,
+                timestamp=_now_ms(),
+                termination_reason=reason,
+                termination_message=message,
+                exit_status=exit_status,
+            )
+        )
+        if state.is_finished():
+            self.finished.set()
+
+    def log_runner(self, message: str) -> None:
+        self.runner_logs.append(
+            LogEventOut(
+                timestamp=_now_ms(),
+                source="runner",
+                message=base64.b64encode(message.encode()).decode(),
+            )
+        )
+
+    def log_job(self, data: bytes) -> None:
+        self.job_logs.append(
+            LogEventOut(
+                timestamp=_now_ms(),
+                source="stdout",
+                message=base64.b64encode(data).decode(),
+            )
+        )
+
+    # -- execution -----------------------------------------------------------
+
+    def build_env(self) -> Dict[str, str]:
+        assert self.submission is not None
+        sub = self.submission
+        env = dict(os.environ)
+        if sub.cluster_info is not None:
+            env.update(make_cluster_env(sub.cluster_info, sub.node_rank))
+        env.update({k: v for k, v in sub.job_spec.env.items() if v is not None})
+        env.update(sub.secrets)
+        env["DSTACK_RUN_NAME"] = sub.run_name
+        env["DSTACK_REPLICA_NUM"] = str(sub.job_spec.replica_num)
+        env["DSTACK_JOB_NUM"] = str(sub.job_spec.job_num)
+        return env
+
+    async def run(self) -> None:
+        assert self.submission is not None
+        if self.started:
+            raise ApiError("Job already started")
+        self.started = True
+        sub = self.submission
+        workdir = Path(self.working_root or tempfile.mkdtemp(prefix="dstack-job-"))
+        workdir.mkdir(parents=True, exist_ok=True)
+        if self.code_path is not None:
+            await self._extract_code(workdir)
+        if sub.job_spec.working_dir:
+            workdir = workdir / sub.job_spec.working_dir
+            workdir.mkdir(parents=True, exist_ok=True)
+        script = "set -eo pipefail\n" + "\n".join(sub.job_spec.commands)
+        self.set_state(JobStatus.RUNNING)
+        self.log_runner(f"Executing {len(sub.job_spec.commands)} command(s)")
+        try:
+            self.proc = await asyncio.create_subprocess_exec(
+                "/bin/bash", "-c", script,
+                cwd=str(workdir),
+                env=self.build_env(),
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT,
+                preexec_fn=os.setsid,  # own process group for clean kill
+            )
+        except OSError as e:
+            self.set_state(
+                JobStatus.FAILED, JobTerminationReason.EXECUTOR_ERROR, str(e)
+            )
+            return
+        self._tasks.append(asyncio.get_event_loop().create_task(self._pump_output()))
+        self._tasks.append(asyncio.get_event_loop().create_task(self._wait_proc()))
+        if sub.job_spec.max_duration:
+            self._tasks.append(
+                asyncio.get_event_loop().create_task(
+                    self._enforce_max_duration(sub.job_spec.max_duration)
+                )
+            )
+
+    async def _extract_code(self, workdir: Path) -> None:
+        import tarfile
+
+        assert self.code_path is not None
+        if self.code_path.stat().st_size == 0:
+            return
+        try:
+            with tarfile.open(self.code_path) as tar:
+                tar.extractall(workdir, filter="data")
+        except tarfile.TarError as e:
+            self.log_runner(f"Failed to extract code archive: {e}")
+
+    async def _pump_output(self) -> None:
+        assert self.proc is not None and self.proc.stdout is not None
+        while True:
+            chunk = await self.proc.stdout.read(65536)
+            if not chunk:
+                break
+            self.log_job(chunk)
+
+    async def _wait_proc(self) -> None:
+        assert self.proc is not None
+        code = await self.proc.wait()
+        # Let the output pump drain before the final state flips.
+        await asyncio.sleep(0)
+        if code == 0:
+            self.set_state(JobStatus.DONE, JobTerminationReason.DONE_BY_RUNNER, exit_status=0)
+        elif code < 0 and self._stopping:
+            self.set_state(
+                JobStatus.TERMINATED,
+                JobTerminationReason.TERMINATED_BY_USER,
+                exit_status=code,
+            )
+        else:
+            self.set_state(
+                JobStatus.FAILED,
+                JobTerminationReason.CONTAINER_EXITED_WITH_ERROR,
+                f"exit status {code}",
+                exit_status=code,
+            )
+
+    _stopping = False
+
+    async def _enforce_max_duration(self, max_duration: int) -> None:
+        await asyncio.sleep(max_duration)
+        if self.proc is not None and self.proc.returncode is None:
+            self.log_runner(f"Max duration {max_duration}s exceeded; terminating")
+            self._stopping = True
+            self._kill()
+            # _wait_proc records TERMINATED; upgrade the reason.
+            await self.finished.wait()
+            if self.job_states:
+                self.job_states[-1].termination_reason = (
+                    JobTerminationReason.MAX_DURATION_EXCEEDED
+                )
+
+    def _kill(self, sig: int = signal.SIGTERM) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), sig)
+            except ProcessLookupError:
+                pass
+
+    async def stop(self, grace_seconds: float = 5.0) -> None:
+        self._stopping = True
+        if self.proc is None or self.proc.returncode is not None:
+            if not self.job_states or not self.job_states[-1].state.is_finished():
+                self.set_state(JobStatus.TERMINATED, JobTerminationReason.TERMINATED_BY_USER)
+            return
+        self._kill(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(self.proc.wait(), grace_seconds)
+        except asyncio.TimeoutError:
+            self._kill(signal.SIGKILL)
+
+    def pull(self, since_ms: int) -> PullResponse:
+        done = bool(self.job_states) and self.job_states[-1].state.is_finished()
+        return PullResponse(
+            job_states=[s for s in self.job_states if s.timestamp > since_ms],
+            job_logs=[e for e in self.job_logs if e.timestamp > since_ms],
+            runner_logs=[e for e in self.runner_logs if e.timestamp > since_ms],
+            last_updated=_now_ms(),
+            has_more=not done,
+        )
+
+    def metrics(self) -> MetricsPoint:
+        point = MetricsPoint(timestamp=utcnow())
+        if self.proc is not None and self.proc.returncode is None:
+            try:
+                with open(f"/proc/{self.proc.pid}/statm") as f:
+                    pages = int(f.read().split()[1])
+                point.memory_usage_bytes = pages * os.sysconf("SC_PAGE_SIZE")
+                point.memory_working_set_bytes = point.memory_usage_bytes
+                with open(f"/proc/{self.proc.pid}/stat") as f:
+                    parts = f.read().rsplit(")", 1)[1].split()
+                ticks = int(parts[11]) + int(parts[12])  # utime+stime
+                point.cpu_usage_micro = ticks * 1_000_000 // os.sysconf("SC_CLK_TCK")
+            except (OSError, IndexError, ValueError):
+                pass
+        point.tpu_chips = collect_tpu_metrics()
+        return point
+
+
+def collect_tpu_metrics() -> List[TpuChipMetrics]:
+    """Best-effort chip metrics via libtpu's /dev/accel* presence + tpu-info.
+
+    Parity: runner/internal/metrics/metrics.go:31-160 which shells out to
+    nvidia-smi/amd-smi/hl-smi; here `tpu-info` (gated: absent on dev boxes).
+    """
+    chips: List[TpuChipMetrics] = []
+    try:
+        accel = sorted(p for p in os.listdir("/dev") if p.startswith("accel"))
+    except OSError:
+        accel = []
+    for i, _ in enumerate(accel):
+        chips.append(TpuChipMetrics(chip_index=i))
+    return chips
+
+
+def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = False) -> App:
+    app = App()
+    router = Router(prefix="/api")
+    executor = Executor(working_root)
+    app.state["executor"] = executor
+    state = {"deadline": time.monotonic() + IDLE_SHUTDOWN_SECONDS}
+
+    @router.get("/healthcheck")
+    async def healthcheck(request: Request):
+        return HealthcheckResponse(service="dstack-tpu-runner")
+
+    @router.post("/submit")
+    async def submit(request: Request):
+        if executor.submission is not None:
+            raise ApiError("Job already submitted")
+        executor.submission = request.parse(SubmitBody)
+        state["deadline"] = None
+        executor.log_runner(f"Job {executor.submission.job_spec.job_name} submitted")
+        return {}
+
+    @router.post("/upload_code")
+    async def upload_code(request: Request):
+        if executor.submission is None:
+            raise ApiError("Submit the job first")
+        fd, path = tempfile.mkstemp(prefix="dstack-code-")
+        with os.fdopen(fd, "wb") as f:
+            f.write(request.body)
+        executor.code_path = Path(path)
+        return {}
+
+    @router.post("/run")
+    async def run(request: Request):
+        if executor.submission is None:
+            raise ApiError("Submit the job first")
+        await executor.run()
+        return {}
+
+    @router.get("/pull")
+    async def pull(request: Request):
+        since = int(request.query_param("timestamp", "0") or 0)
+        return executor.pull(since)
+
+    @router.post("/stop")
+    async def stop(request: Request):
+        body = request.parse(StopBody) if request.body else StopBody()
+        await executor.stop(body.grace_seconds)
+        return {}
+
+    @router.get("/metrics")
+    async def metrics(request: Request):
+        return MetricsResponse(**executor.metrics().model_dump())
+
+    app.include_router(router)
+
+    if idle_shutdown:
+        async def _idle_watchdog() -> None:
+            while True:
+                await asyncio.sleep(10)
+                if state["deadline"] is not None and time.monotonic() > state["deadline"]:
+                    os._exit(0)
+                if executor.finished.is_set():
+                    # serve-logs-then-exit grace period (parity: server.go shutdown)
+                    await asyncio.sleep(60)
+                    os._exit(0)
+
+        async def _start_watchdog() -> None:
+            asyncio.get_event_loop().create_task(_idle_watchdog())
+
+        app.on_startup.append(_start_watchdog)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10999)
+    parser.add_argument("--working-root", default=None)
+    parser.add_argument("--idle-shutdown", action="store_true")
+    args = parser.parse_args()
+
+    async def _serve() -> None:
+        app = create_runner_app(args.working_root, idle_shutdown=args.idle_shutdown)
+        server = Server(app, args.host, args.port)
+        await server.start()
+        print(f"runner listening on {args.host}:{server.port}", flush=True)
+        assert server._server is not None
+        async with server._server:
+            await server._server.serve_forever()
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

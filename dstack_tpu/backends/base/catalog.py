@@ -1,0 +1,107 @@
+"""Static TPU offer catalog.
+
+The reference pulls offers from the external `gpuhunt` catalog
+(base/offers.py:18-43). gpuhunt has no multi-host TPU entries, so this
+framework carries its own table: generation × published slice size × region,
+priced per chip-hour (approximate GCP list prices), with hosts derived from
+the topology catalog. Offers for multi-host slices advertise `hosts > 1`
+and are gang-provisioned.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.instances import (
+    InstanceAvailability,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+)
+from dstack_tpu.models.resources import Memory
+from dstack_tpu.models.topology import TpuGeneration, TpuTopology, list_accelerator_types
+
+# $/chip/hr on-demand (approximate public list prices, us-central*).
+CHIP_HOUR_PRICES: Dict[TpuGeneration, float] = {
+    TpuGeneration.V2: 1.125,
+    TpuGeneration.V3: 2.00,
+    TpuGeneration.V4: 3.22,
+    TpuGeneration.V5E: 1.20,
+    TpuGeneration.V5P: 4.20,
+    TpuGeneration.V6E: 2.70,
+}
+SPOT_DISCOUNT = 0.6  # spot ≈ 40% of on-demand
+
+# Which regions offer which generation (subset of real GCP availability).
+GENERATION_REGIONS: Dict[TpuGeneration, List[Tuple[str, str]]] = {
+    TpuGeneration.V2: [("us-central1", "us-central1-b")],
+    TpuGeneration.V3: [("europe-west4", "europe-west4-a")],
+    TpuGeneration.V4: [("us-central2", "us-central2-b")],
+    TpuGeneration.V5E: [
+        ("us-central1", "us-central1-a"),
+        ("us-west4", "us-west4-1"),
+        ("europe-west4", "europe-west4-b"),
+    ],
+    TpuGeneration.V5P: [("us-east5", "us-east5-a"), ("us-central1", "us-central1-a")],
+    TpuGeneration.V6E: [
+        ("us-east5", "us-east5-b"),
+        ("europe-west4", "europe-west4-a"),
+        ("asia-northeast1", "asia-northeast1-b"),
+    ],
+}
+
+# Host VM resources that come with each TPU worker (vCPUs, RAM GB).
+HOST_RESOURCES: Dict[TpuGeneration, Tuple[int, int]] = {
+    TpuGeneration.V2: (96, 334),
+    TpuGeneration.V3: (96, 334),
+    TpuGeneration.V4: (240, 407),
+    TpuGeneration.V5E: (112, 192),
+    TpuGeneration.V5P: (208, 448),
+    TpuGeneration.V6E: (180, 720),
+}
+
+
+def tpu_offer(
+    topo: TpuTopology,
+    region: str,
+    zone: str,
+    spot: bool,
+    backend: BackendType = BackendType.GCP,
+) -> InstanceOfferWithAvailability:
+    cpus, mem_gb = HOST_RESOURCES[topo.generation]
+    price = CHIP_HOUR_PRICES[topo.generation] * topo.chips
+    if spot:
+        price *= 1 - SPOT_DISCOUNT
+    # Single-host sub-8-chip slices share one host VM's resources.
+    per_host_cpus = cpus if topo.chips_per_host >= 4 else max(24, cpus // 4)
+    return InstanceOfferWithAvailability(
+        backend=backend,
+        instance=InstanceType(
+            name=topo.accelerator_type,
+            resources=Resources(
+                cpus=per_host_cpus,
+                memory_mib=mem_gb * 1024,
+                spot=spot,
+                tpu=topo,
+                description=f"{topo.display_name} {topo.topology_string}",
+            ),
+        ),
+        region=region,
+        zone=zone,
+        price=round(price, 2),
+        hosts=topo.hosts,
+        availability=InstanceAvailability.UNKNOWN,
+    )
+
+
+def get_tpu_catalog(
+    generations: Optional[List[TpuGeneration]] = None,
+    backend: BackendType = BackendType.GCP,
+) -> List[InstanceOfferWithAvailability]:
+    offers: List[InstanceOfferWithAvailability] = []
+    for topo in list_accelerator_types():
+        if generations and topo.generation not in generations:
+            continue
+        for region, zone in GENERATION_REGIONS.get(topo.generation, []):
+            for spot in (False, True):
+                offers.append(tpu_offer(topo, region, zone, spot, backend))
+    return offers

@@ -1,0 +1,135 @@
+"""Compute ABC — the per-cloud provisioning interface.
+
+Parity: src/dstack/_internal/core/backends/base/compute.py:45-209. TPU-first
+delta: `run_job` returns a *list* of JobProvisioningData — one per worker
+host of the provisioned resource. A plain VM yields a single-element list; a
+multi-host TPU pod slice yields `offer.hosts` elements that the scheduler
+gang-assigns to the replica's jobs. The reference's single-instance signature
+cannot express an atomically-provisioned N-host slice.
+"""
+
+import abc
+from typing import Dict, List, Optional
+
+from dstack_tpu.models.gateways import (
+    GatewayComputeConfiguration,
+    GatewayProvisioningData,
+)
+from dstack_tpu.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.models.volumes import (
+    Volume,
+    VolumeAttachmentData,
+    VolumeProvisioningData,
+)
+
+
+class Compute(abc.ABC):
+    BACKEND_TYPE: str = ""
+
+    @abc.abstractmethod
+    async def get_offers(
+        self, requirements: Requirements
+    ) -> List[InstanceOfferWithAvailability]:
+        ...
+
+    @abc.abstractmethod
+    async def run_job(
+        self,
+        project_name: str,
+        run_name: str,
+        offer: InstanceOfferWithAvailability,
+        ssh_public_key: str,
+        instance_name: str,
+        env: Optional[Dict[str, str]] = None,
+    ) -> List[JobProvisioningData]:
+        """Provision the compute for one replica. Returns per-host data."""
+
+    async def create_instance(
+        self,
+        project_name: str,
+        offer: InstanceOfferWithAvailability,
+        ssh_public_key: str,
+        instance_name: str,
+    ) -> List[JobProvisioningData]:
+        """Provision standalone fleet instance(s). Defaults to run_job."""
+        return await self.run_job(
+            project_name, instance_name, offer, ssh_public_key, instance_name
+        )
+
+    @abc.abstractmethod
+    async def terminate_instance(
+        self, instance_id: str, region: str, backend_data: Optional[str] = None
+    ) -> None:
+        ...
+
+    async def update_provisioning_data(
+        self, jpd: JobProvisioningData
+    ) -> JobProvisioningData:
+        """Poll the cloud until hostname/IPs are known. Default: no-op."""
+        return jpd
+
+    # --- volumes -----------------------------------------------------------
+    async def create_volume(self, volume: Volume) -> VolumeProvisioningData:
+        raise NotImplementedError("volumes are not supported by this backend")
+
+    async def register_volume(self, volume: Volume) -> VolumeProvisioningData:
+        raise NotImplementedError("volumes are not supported by this backend")
+
+    async def delete_volume(self, volume: Volume) -> None:
+        raise NotImplementedError("volumes are not supported by this backend")
+
+    async def attach_volume(
+        self, volume: Volume, provisioning_data: JobProvisioningData
+    ) -> VolumeAttachmentData:
+        raise NotImplementedError("volumes are not supported by this backend")
+
+    async def detach_volume(
+        self, volume: Volume, provisioning_data: JobProvisioningData
+    ) -> None:
+        raise NotImplementedError("volumes are not supported by this backend")
+
+    # --- gateways ----------------------------------------------------------
+    async def create_gateway(
+        self, configuration: GatewayComputeConfiguration
+    ) -> GatewayProvisioningData:
+        raise NotImplementedError("gateways are not supported by this backend")
+
+    async def terminate_gateway(
+        self, instance_id: str, region: str, backend_data: Optional[str] = None
+    ) -> None:
+        await self.terminate_instance(instance_id, region, backend_data)
+
+
+def get_shim_commands(
+    authorized_key: str,
+    agent_download_url: str = "",
+    tpu: bool = True,
+) -> List[str]:
+    """Instance bootstrap: install + launch the shim host agent.
+
+    Parity: base/compute.py:220-309 (`get_shim_commands`/`get_user_data`);
+    the reference threads `--pjrt-device=TPU` here (:303-309), we default
+    TPU-on.
+    """
+    cmds = [
+        "mkdir -p /root/.ssh && chmod 700 /root/.ssh",
+        f'echo "{authorized_key}" >> /root/.ssh/authorized_keys',
+        "chmod 600 /root/.ssh/authorized_keys",
+        "mkdir -p /usr/local/bin /var/lib/dstack-tpu",
+    ]
+    if agent_download_url:
+        cmds += [
+            f"curl -fsSL {agent_download_url}/dstack-tpu-shim -o /usr/local/bin/dstack-tpu-shim",
+            "chmod +x /usr/local/bin/dstack-tpu-shim",
+        ]
+    shim_flags = "--home /var/lib/dstack-tpu"
+    if tpu:
+        shim_flags += " --pjrt-device TPU"
+    cmds.append(f"nohup /usr/local/bin/dstack-tpu-shim {shim_flags} >/var/log/dstack-shim.log 2>&1 &")
+    return cmds
+
+
+def get_user_data(authorized_key: str, agent_download_url: str = "") -> str:
+    commands = "\n".join(get_shim_commands(authorized_key, agent_download_url))
+    return f"#!/bin/sh\n{commands}\n"

@@ -1,0 +1,65 @@
+"""Offer filtering against job requirements.
+
+Parity: src/dstack/_internal/core/backends/base/offers.py:18-43 +
+server/services/offers.py matching logic, chips-first.
+"""
+
+from typing import List, Optional
+
+from dstack_tpu.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.models.runs import Requirements
+from dstack_tpu.models.topology import TpuTopology
+
+
+def offer_matches_requirements(
+    offer: InstanceOfferWithAvailability, req: Requirements
+) -> bool:
+    res = req.resources
+    ir = offer.instance.resources
+    if req.max_price is not None and offer.price > req.max_price:
+        return False
+    if req.spot is not None and ir.spot != req.spot:
+        return False
+    if res.cpu and not res.cpu.contains(ir.cpus):
+        return False
+    if res.memory and not res.memory.contains(ir.memory_mib / 1024):
+        return False
+    if res.tpu is not None:
+        if ir.tpu is None:
+            return False
+        if not res.tpu.matches(ir.tpu):
+            return False
+    elif res.gpu is not None:
+        names = set(n.lower() for n in (res.gpu.name or []))
+        if not ir.gpus:
+            return False
+        if names and ir.gpus[0].name.lower() not in names:
+            return False
+        if not res.gpu.count.contains(len(ir.gpus)):
+            return False
+    else:
+        # No accelerator requested: don't burn TPU slices on cpu jobs.
+        if ir.tpu is not None or ir.gpus:
+            return False
+    return True
+
+
+def filter_offers(
+    offers: List[InstanceOfferWithAvailability], req: Requirements
+) -> List[InstanceOfferWithAvailability]:
+    matched = [o for o in offers if offer_matches_requirements(o, req)]
+    matched.sort(key=lambda o: (o.price, o.instance.name))
+    return matched
+
+
+def resolve_target_topology(req: Requirements) -> Optional[TpuTopology]:
+    """Smallest published slice matching the TPU spec — fixed at plan time so
+    the gang size (jobs per replica) is deterministic before provisioning."""
+    if req.resources.tpu is None:
+        return None
+    from dstack_tpu.models.topology import list_accelerator_types
+
+    candidates = [t for t in list_accelerator_types() if req.resources.tpu.matches(t)]
+    if not candidates:
+        return None
+    return min(candidates, key=lambda t: t.chips)

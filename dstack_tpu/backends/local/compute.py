@@ -1,0 +1,182 @@
+"""Local backend: provisions "instances" as processes on the server host.
+
+Parity: src/dstack/_internal/core/backends/local/ (114 LoC dev backend), but
+substantially more capable: it spawns a real runner agent per "host", so the
+entire submit→provision→run→logs pipeline executes end-to-end in tests and
+dev setups with zero cloud access — including *gang-scheduled multi-host TPU
+slices*, which it simulates by advertising TPU offers (`tpu_sim`) and
+spawning one runner process per worker host.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from dstack_tpu.backends.base.catalog import tpu_offer
+from dstack_tpu.backends.base.compute import Compute
+from dstack_tpu.backends.base.offers import filter_offers
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.common import CoreModel
+from dstack_tpu.models.instances import (
+    InstanceAvailability,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+)
+from dstack_tpu.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.models.volumes import (
+    Volume,
+    VolumeAttachmentData,
+    VolumeProvisioningData,
+)
+from dstack_tpu.utils.ssh import find_free_port
+
+
+class LocalBackendConfig(CoreModel):
+    type: str = "local"
+    # TPU accelerator types to advertise as simulated offers (e.g.
+    # ["v5litepod-16"]); each worker host becomes a local runner process.
+    tpu_sim: List[str] = []
+    cpu_offers: bool = True
+    price_per_hour: float = 0.0
+
+
+class LocalCompute(Compute):
+    BACKEND_TYPE = "local"
+
+    def __init__(self, config: Optional[LocalBackendConfig] = None):
+        self.config = config or LocalBackendConfig()
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    async def get_offers(
+        self, requirements: Requirements
+    ) -> List[InstanceOfferWithAvailability]:
+        offers: List[InstanceOfferWithAvailability] = []
+        if self.config.cpu_offers:
+            offers.append(
+                InstanceOfferWithAvailability(
+                    backend=BackendType.LOCAL,
+                    instance=InstanceType(
+                        name="local",
+                        resources=Resources(
+                            cpus=os.cpu_count() or 1,
+                            memory_mib=16 * 1024,
+                            description="local process",
+                        ),
+                    ),
+                    region="local",
+                    price=self.config.price_per_hour,
+                    hosts=1,
+                    availability=InstanceAvailability.AVAILABLE,
+                )
+            )
+        for acc_type in self.config.tpu_sim:
+            topo = TpuTopology.parse(acc_type)
+            offer = tpu_offer(topo, "local", "local-a", spot=False, backend=BackendType.LOCAL)
+            offer.price = self.config.price_per_hour
+            offer.availability = InstanceAvailability.AVAILABLE
+            offers.append(offer)
+        return filter_offers(offers, requirements)
+
+    async def run_job(
+        self,
+        project_name: str,
+        run_name: str,
+        offer: InstanceOfferWithAvailability,
+        ssh_public_key: str,
+        instance_name: str,
+        env: Optional[Dict[str, str]] = None,
+    ) -> List[JobProvisioningData]:
+        out: List[JobProvisioningData] = []
+        for worker in range(offer.hosts):
+            port = find_free_port()
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dstack_tpu.agents.runner",
+                    "--host", "127.0.0.1", "--port", str(port),
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                env={**os.environ, **(env or {})},
+                start_new_session=True,
+            )
+            instance_id = f"local-{proc.pid}"
+            self._procs[instance_id] = proc
+            await self._wait_port(port)
+            out.append(
+                JobProvisioningData(
+                    backend=BackendType.LOCAL,
+                    instance_type=offer.instance,
+                    instance_id=instance_id,
+                    hostname="127.0.0.1",
+                    internal_ip="127.0.0.1",
+                    region=offer.region,
+                    availability_zone=offer.zone,
+                    price=offer.price,
+                    username="root",
+                    ssh_port=None,
+                    dockerized=False,  # server talks to the runner directly
+                    backend_data=json.dumps({"port": port, "pid": proc.pid}),
+                    tpu_node_id=instance_name if offer.hosts > 1 else None,
+                    tpu_worker_index=worker,
+                )
+            )
+        return out
+
+    @staticmethod
+    async def _wait_port(port: int, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            try:
+                _, writer = await asyncio.open_connection("127.0.0.1", port)
+                writer.close()
+                return
+            except OSError:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError(f"local runner on :{port} did not start")
+                await asyncio.sleep(0.05)
+
+    async def terminate_instance(
+        self, instance_id: str, region: str, backend_data: Optional[str] = None
+    ) -> None:
+        proc = self._procs.pop(instance_id, None)
+        pid: Optional[int] = proc.pid if proc else None
+        if pid is None and backend_data:
+            pid = json.loads(backend_data).get("pid")
+        if pid is not None:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    # Volumes: directory-backed fakes so the volume FSM is testable.
+    async def create_volume(self, volume: Volume) -> VolumeProvisioningData:
+        import tempfile
+
+        path = tempfile.mkdtemp(prefix=f"dstack-vol-{volume.name}-")
+        return VolumeProvisioningData(
+            backend=BackendType.LOCAL,
+            volume_id=path,
+            size_gb=int(volume.configuration.size or 1),
+        )
+
+    async def delete_volume(self, volume: Volume) -> None:
+        import shutil
+
+        if volume.volume_id and os.path.isdir(volume.volume_id):
+            shutil.rmtree(volume.volume_id, ignore_errors=True)
+
+    async def attach_volume(
+        self, volume: Volume, provisioning_data: JobProvisioningData
+    ) -> VolumeAttachmentData:
+        return VolumeAttachmentData(device_name=volume.volume_id)
+
+    async def detach_volume(
+        self, volume: Volume, provisioning_data: JobProvisioningData
+    ) -> None:
+        return None

@@ -1,0 +1,118 @@
+"""Server application factory.
+
+Parity: src/dstack/_internal/server/app.py:67-188 — lifespan (migrate DB,
+admin user, default project, start background tasks), router registration,
+version middleware. Background processors are started via
+`dstack_tpu.server.background.start_background_tasks`.
+"""
+
+import logging
+from pathlib import Path
+from typing import Optional
+
+from dstack_tpu.models.users import GlobalRole
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.http import App, Request, Response, Server
+from dstack_tpu.server.security import Encryption
+import dstack_tpu.server.schema  # noqa: F401  (registers migrations)
+
+logger = logging.getLogger(__name__)
+
+
+def create_app(
+    db_path: Optional[str] = None,
+    admin_token: Optional[str] = None,
+    run_background_tasks: bool = True,
+) -> App:
+    app = App()
+    db = Database(db_path or ":memory:")
+    ctx = ServerContext(db, Encryption(settings.ENCRYPTION_KEY))
+    app.state["ctx"] = ctx
+
+    async def _inject_ctx(request: Request) -> Optional[Response]:
+        request.state["ctx"] = ctx
+        return None
+
+    app.add_middleware(_inject_ctx)
+
+    from dstack_tpu.server.routers import (
+        backends as backends_router,
+        fleets as fleets_router,
+        instances as instances_router,
+        logs as logs_router,
+        metrics as metrics_router,
+        projects as projects_router,
+        repos as repos_router,
+        runs as runs_router,
+        secrets as secrets_router,
+        server_info as server_info_router,
+        users as users_router,
+        volumes as volumes_router,
+        gateways as gateways_router,
+        services_proxy as services_proxy_router,
+    )
+
+    for mod in (
+        users_router, projects_router, runs_router, fleets_router,
+        instances_router, volumes_router, gateways_router, backends_router,
+        repos_router, secrets_router, logs_router, metrics_router,
+        server_info_router, services_proxy_router,
+    ):
+        app.include_router(mod.router)
+
+    async def _startup() -> None:
+        if db.path != ":memory:":
+            Path(db.path).parent.mkdir(parents=True, exist_ok=True)
+        await db.connect()
+        from dstack_tpu.server.services import logs as logs_service
+        from dstack_tpu.server.services import projects as projects_service
+        from dstack_tpu.server.services import users as users_service
+
+        ctx.log_storage = logs_service.default_log_storage(ctx)
+        admin = await users_service.get_or_create_admin(
+            ctx, admin_token or settings.SERVER_ADMIN_TOKEN
+        )
+        app.state["admin_token"] = admin.creds.token
+        try:
+            await projects_service.get_project(ctx, settings.DEFAULT_PROJECT_NAME)
+        except Exception:
+            from dstack_tpu.models.users import User
+
+            admin_user = User(**{k: v for k, v in admin.model_dump().items() if k != "creds"})
+            await projects_service.create_project(
+                ctx, admin_user, settings.DEFAULT_PROJECT_NAME
+            )
+        from dstack_tpu.server.services import backends as backends_service
+
+        await backends_service.init_backends(ctx)
+        if run_background_tasks:
+            from dstack_tpu.server.background import start_background_tasks
+
+            start_background_tasks(ctx)
+        logger.info("server started; admin token: %s", admin.creds.token)
+
+    async def _shutdown() -> None:
+        await ctx.stop_tasks()
+        await db.close()
+
+    app.on_startup.append(_startup)
+    app.on_shutdown.append(_shutdown)
+    return app
+
+
+async def serve(
+    host: str = settings.SERVER_HOST,
+    port: int = settings.SERVER_PORT,
+    db_path: Optional[str] = None,
+    admin_token: Optional[str] = None,
+) -> None:
+    app = create_app(db_path=db_path or settings.get_db_path(), admin_token=admin_token)
+    server = Server(app, host, port)
+    await server.start()
+    print(f"The dstack-tpu server is running at http://{host}:{server.port}")
+    print(f"Admin token: {app.state['admin_token']}")
+    assert server._server is not None
+    async with server._server:
+        await server._server.serve_forever()

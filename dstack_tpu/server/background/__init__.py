@@ -1,0 +1,74 @@
+"""Background processors (the run/job/instance FSM engines).
+
+Parity: src/dstack/_internal/server/background/__init__.py:34-87, which runs
+11 APScheduler interval jobs at 2-10s ticks. Here each processor is an
+asyncio loop that wakes EITHER on its interval OR immediately when another
+component kicks its channel (ctx.kick) — state transitions cascade in
+milliseconds instead of waiting out poll ticks, the main lever for the
+"apply→first step < 5 min on 32 hosts" target (BASELINE.md).
+"""
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+
+logger = logging.getLogger(__name__)
+
+
+def start_background_tasks(ctx: ServerContext) -> None:
+    from dstack_tpu.server.background.tasks.process_runs import process_runs
+    from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+        process_submitted_jobs,
+    )
+    from dstack_tpu.server.background.tasks.process_running_jobs import (
+        process_running_jobs,
+        process_terminating_jobs,
+    )
+    from dstack_tpu.server.background.tasks.process_instances import process_instances
+    from dstack_tpu.server.background.tasks.process_fleets import process_fleets
+    from dstack_tpu.server.background.tasks.process_volumes import process_volumes
+    from dstack_tpu.server.background.tasks.process_gateways import process_gateways
+    from dstack_tpu.server.background.tasks.process_metrics import (
+        collect_metrics,
+        delete_expired_metrics,
+    )
+
+    loops = [
+        ("runs", settings.PROCESS_RUNS_INTERVAL, process_runs),
+        ("submitted_jobs", settings.PROCESS_JOBS_INTERVAL, process_submitted_jobs),
+        ("running_jobs", settings.PROCESS_JOBS_INTERVAL, process_running_jobs),
+        ("terminating_jobs", settings.PROCESS_JOBS_INTERVAL, process_terminating_jobs),
+        ("instances", settings.PROCESS_INSTANCES_INTERVAL, process_instances),
+        ("fleets", settings.PROCESS_FLEETS_INTERVAL, process_fleets),
+        ("volumes", settings.PROCESS_VOLUMES_INTERVAL, process_volumes),
+        ("gateways", settings.PROCESS_GATEWAYS_INTERVAL, process_gateways),
+        ("metrics", settings.PROCESS_METRICS_INTERVAL, collect_metrics),
+        ("metrics_gc", 60.0, delete_expired_metrics),
+    ]
+    for channel, interval, fn in loops:
+        ctx.spawn(_loop(ctx, channel, interval, fn))
+
+
+async def _loop(
+    ctx: ServerContext,
+    channel: str,
+    interval: float,
+    fn: Callable[[ServerContext], Awaitable[None]],
+) -> None:
+    signal = ctx.signal(channel)
+    while not ctx.stopping:
+        try:
+            await asyncio.wait_for(signal.wait(), timeout=interval)
+        except asyncio.TimeoutError:
+            pass
+        signal.clear()
+        try:
+            await fn(ctx)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("background task %s failed", channel)
+            await asyncio.sleep(1.0)

@@ -1,0 +1,50 @@
+"""Fleet GC: delete autocreated fleets whose instances are all terminated.
+
+Parity: src/dstack/_internal/server/background/tasks/process_fleets.py (83
+LoC).
+"""
+
+import logging
+
+from dstack_tpu.models.fleets import FleetStatus
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.utils.common import utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def process_fleets(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM fleets WHERE deleted = 0 AND status IN ('active', 'terminating')"
+    )
+    for row in rows:
+        instances = await ctx.db.fetchall(
+            "SELECT status FROM instances WHERE fleet_id = ? AND deleted = 0", (row["id"],)
+        )
+        active = [i for i in instances if i["status"] != "terminated"]
+        if row["status"] == FleetStatus.TERMINATING.value:
+            for i in await ctx.db.fetchall(
+                "SELECT id, status FROM instances WHERE fleet_id = ? AND deleted = 0",
+                (row["id"],),
+            ):
+                if i["status"] not in ("terminated", "terminating"):
+                    await ctx.db.execute(
+                        "UPDATE instances SET status = 'terminating' WHERE id = ?",
+                        (i["id"],),
+                    )
+                    ctx.kick("instances")
+            if not active:
+                await ctx.db.execute(
+                    "UPDATE fleets SET status = 'terminated', deleted = 1,"
+                    " last_processed_at = ? WHERE id = ?",
+                    (utcnow_iso(), row["id"]),
+                )
+                logger.info("fleet %s terminated", row["name"])
+        elif row["auto_cleanup"] and instances and not active:
+            # Autocreated run fleet whose instances are gone.
+            await ctx.db.execute(
+                "UPDATE fleets SET status = 'terminated', deleted = 1,"
+                " last_processed_at = ? WHERE id = ?",
+                (utcnow_iso(), row["id"]),
+            )
+            logger.info("autocreated fleet %s cleaned up", row["name"])

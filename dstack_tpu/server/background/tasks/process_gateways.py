@@ -1,0 +1,96 @@
+"""Gateway FSM: SUBMITTED -> PROVISIONING -> RUNNING.
+
+Parity: src/dstack/_internal/server/background/tasks/process_gateways.py
+(provisioning + connection upkeep).
+"""
+
+import logging
+
+from dstack_tpu.models.gateways import GatewayComputeConfiguration, GatewayStatus
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.utils.common import utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def process_gateways(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM gateways WHERE status IN ('submitted', 'provisioning')"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("gateways", row["id"]):
+            continue
+        try:
+            await _process_gateway(ctx, row)
+        except Exception:
+            logger.exception("failed to process gateway %s", row["name"])
+        finally:
+            ctx.locker.unlock_nowait("gateways", row["id"])
+
+
+async def _process_gateway(ctx: ServerContext, row) -> None:
+    import json
+
+    from dstack_tpu.models.gateways import GatewayConfiguration
+    from dstack_tpu.server.services import backends as backends_service
+    from dstack_tpu.utils.ssh import generate_rsa_keypair
+
+    conf = GatewayConfiguration.model_validate_json(row["configuration"])
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    try:
+        compute = await backends_service.get_project_backend(ctx, row["project_id"], conf.backend)
+        private_key, public_key = generate_rsa_keypair()
+        pd = await compute.create_gateway(
+            GatewayComputeConfiguration(
+                project_name=project_row["name"],
+                instance_name=f"gw-{row['name']}",
+                backend=conf.backend,
+                region=conf.region,
+                public_ip=conf.public_ip,
+                ssh_key_pub=public_key,
+            )
+        )
+        compute_id = generate_id()
+        await ctx.db.execute(
+            "INSERT INTO gateway_computes (id, instance_id, ip_address, hostname,"
+            " region, backend, ssh_private_key, ssh_public_key, provisioning_data)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                compute_id,
+                pd.instance_id,
+                pd.ip_address,
+                pd.hostname or pd.ip_address,
+                pd.region,
+                conf.backend.value,
+                private_key,
+                public_key,
+                pd.model_dump_json(),
+            ),
+        )
+        await ctx.db.execute(
+            "UPDATE gateways SET status = ?, gateway_compute_id = ?, last_processed_at = ?"
+            " WHERE id = ?",
+            (GatewayStatus.RUNNING.value, compute_id, utcnow_iso(), row["id"]),
+        )
+        logger.info("gateway %s running at %s", row["name"], pd.ip_address)
+    except NotImplementedError:
+        await ctx.db.execute(
+            "UPDATE gateways SET status = ?, status_message = ?, last_processed_at = ?"
+            " WHERE id = ?",
+            (
+                GatewayStatus.FAILED.value,
+                "backend does not support gateways",
+                utcnow_iso(),
+                row["id"],
+            ),
+        )
+    except Exception as e:
+        await ctx.db.execute(
+            "UPDATE gateways SET status = ?, status_message = ?, last_processed_at = ?"
+            " WHERE id = ?",
+            (GatewayStatus.FAILED.value, str(e)[:500], utcnow_iso(), row["id"]),
+        )
+        logger.warning("gateway %s failed: %s", row["name"], e)

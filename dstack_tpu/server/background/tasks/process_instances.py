@@ -1,0 +1,109 @@
+"""Instance FSM processor.
+
+Parity: src/dstack/_internal/server/background/tasks/process_instances.py
+(PENDING→provision for fleets, health checks :608+, idle-timeout :192-207,
+termination deadlines). Cloud terminate calls happen here, off the job path.
+"""
+
+import json
+import logging
+from typing import Optional
+
+import sqlite3
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.instances import InstanceStatus
+from dstack_tpu.models.profiles import DEFAULT_FLEET_IDLE_DURATION
+from dstack_tpu.models.runs import JobProvisioningData
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def process_instances(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM instances WHERE status != 'terminated' AND deleted = 0"
+        " ORDER BY last_processed_at"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("instances", row["id"]):
+            continue
+        try:
+            await _process_instance(ctx, row)
+        except Exception:
+            logger.exception("failed to process instance %s", row["name"])
+        finally:
+            ctx.locker.unlock_nowait("instances", row["id"])
+
+
+async def _process_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
+    status = InstanceStatus(row["status"])
+    if status == InstanceStatus.TERMINATING:
+        await _terminate(ctx, row)
+    elif status == InstanceStatus.PENDING:
+        await _provision_fleet_instance(ctx, row)
+    elif status == InstanceStatus.IDLE:
+        await _check_idle_timeout(ctx, row)
+    await ctx.db.execute(
+        "UPDATE instances SET last_processed_at = ? WHERE id = ?",
+        (utcnow_iso(), row["id"]),
+    )
+
+
+async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
+    jpd: Optional[JobProvisioningData] = None
+    if row["job_provisioning_data"]:
+        jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+    if jpd is not None and jpd.backend != BackendType.SSH:
+        from dstack_tpu.server.services import backends as backends_service
+
+        try:
+            compute = await backends_service.get_project_backend(
+                ctx, row["project_id"], jpd.get_base_backend()
+            )
+            # TPU slices: only worker 0 issues the cloud delete (one node
+            # object covers all workers); siblings just finalize.
+            if jpd.tpu_node_id is None or jpd.tpu_worker_index == 0:
+                await compute.terminate_instance(
+                    jpd.instance_id, jpd.region, jpd.backend_data
+                )
+        except Exception as e:
+            logger.warning("terminate_instance %s failed: %s", row["name"], e)
+    await ctx.db.execute(
+        "UPDATE instances SET status = 'terminated', finished_at = ? WHERE id = ?",
+        (utcnow_iso(), row["id"]),
+    )
+    ctx.kick("fleets")
+    logger.info("instance %s terminated", row["name"])
+
+
+async def _check_idle_timeout(ctx: ServerContext, row: sqlite3.Row) -> None:
+    idle_duration = DEFAULT_FLEET_IDLE_DURATION
+    if row["profile"]:
+        profile = json.loads(row["profile"])
+        v = profile.get("idle_duration")
+        if v is not None:
+            idle_duration = int(v)
+    if idle_duration < 0:  # "off"
+        return
+    started = parse_dt(row["last_processed_at"]) or parse_dt(row["created_at"])
+    if (utcnow() - started).total_seconds() > idle_duration:
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'terminating', termination_reason = ?"
+            " WHERE id = ?",
+            ("idle timeout", row["id"]),
+        )
+        ctx.kick("instances")
+
+
+async def _provision_fleet_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """PENDING fleet instances: cloud-create or (for SSH fleets) deploy shim.
+
+    SSH-host deployment lives in services/fleets.py; cloud fleet instances
+    are provisioned here from the stored requirements/profile.
+    """
+    from dstack_tpu.server.services import fleets as fleets_service
+
+    await fleets_service.provision_pending_instance(ctx, row)

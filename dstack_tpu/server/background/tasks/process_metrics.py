@@ -1,0 +1,65 @@
+"""Metrics collector: poll running jobs' runners, store points, TTL-delete.
+
+Parity: src/dstack/_internal/server/background/tasks/process_metrics.py
+(collect every 10s :28-137, TTL delete :45-51). Chips-first: TPU duty cycle
+and HBM come from the agent (tpu-info / libtpu), not nvidia-smi.
+"""
+
+import json
+import logging
+from datetime import timedelta
+
+from dstack_tpu.models.runs import JobProvisioningData
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services.connections import get_connection_pool
+from dstack_tpu.utils.common import utcnow, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def collect_metrics(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall("SELECT * FROM jobs WHERE status = 'running'")
+    for row in rows:
+        if not row["job_provisioning_data"] or not row["instance_id"]:
+            continue
+        jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        try:
+            conn = await get_connection_pool(ctx).get(
+                ctx, row["instance_id"], jpd,
+                ssh_private_key=project_row["ssh_private_key"],
+            )
+            runner = conn.runner_client()
+            try:
+                point = await runner.metrics()
+            finally:
+                await runner.close()
+        except Exception:
+            continue
+        if point is None:
+            continue
+        await ctx.db.execute(
+            "INSERT INTO job_metrics_points (id, job_id, timestamp, cpu_usage_micro,"
+            " memory_usage_bytes, memory_working_set_bytes, tpu_metrics)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?)",
+            (
+                generate_id(),
+                row["id"],
+                utcnow_iso(),
+                point.cpu_usage_micro,
+                point.memory_usage_bytes,
+                point.memory_working_set_bytes,
+                json.dumps([c.model_dump() for c in point.tpu_chips]),
+            ),
+        )
+
+
+async def delete_expired_metrics(ctx: ServerContext) -> None:
+    cutoff = (utcnow() - timedelta(seconds=settings.METRICS_TTL_SECONDS)).isoformat()
+    await ctx.db.execute(
+        "DELETE FROM job_metrics_points WHERE timestamp < ?", (cutoff,)
+    )

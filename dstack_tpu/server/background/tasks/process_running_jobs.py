@@ -1,0 +1,470 @@
+"""Running-job processor: drive PROVISIONING→(PULLING)→RUNNING, pull logs.
+
+Parity: src/dstack/_internal/server/background/tasks/
+process_running_jobs.py (wait replica provisioned :129-187, ClusterInfo
+:620-639, shim submit :359-481, runner submit :660-715, pull :573-617) plus
+process_terminating_jobs.py. TPU-first: ClusterInfo carries the slice
+topology and the runner injects the JAX coordinator env
+(dstack_tpu/parallel/env.py) instead of MASTER_ADDR/NCCL vars.
+"""
+
+import logging
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.agents.protocol import TaskStatus, TaskSubmitRequest
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.instances import InstanceStatus
+from dstack_tpu.models.logs import LogProducer
+from dstack_tpu.models.runs import (
+    ClusterInfo,
+    JobProvisioningData,
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.services.connections import get_connection_pool
+from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def process_running_jobs(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE status IN ('provisioning', 'pulling', 'running')"
+        " ORDER BY last_processed_at"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("jobs", row["id"]):
+            continue
+        try:
+            await _process_job(ctx, row)
+        except Exception:
+            logger.exception("failed to process running job %s", row["id"])
+        finally:
+            ctx.locker.unlock_nowait("jobs", row["id"])
+
+
+async def process_terminating_jobs(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("jobs", row["id"]):
+            continue
+        try:
+            await _terminate_job(ctx, row)
+        except Exception:
+            logger.exception("failed to terminate job %s", row["id"])
+        finally:
+            ctx.locker.unlock_nowait("jobs", row["id"])
+
+
+async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:
+    status = JobStatus(row["status"])
+    if status == JobStatus.PROVISIONING:
+        await _process_provisioning(ctx, row)
+    elif status == JobStatus.PULLING:
+        await _process_pulling(ctx, row)
+    elif status == JobStatus.RUNNING:
+        await _pull_runner(ctx, row)
+    await ctx.db.execute(
+        "UPDATE jobs SET last_processed_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
+    )
+
+
+async def _replica_rows(ctx: ServerContext, row: sqlite3.Row) -> List[sqlite3.Row]:
+    return await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND submission_num = ?"
+        " ORDER BY job_num",
+        (row["run_id"], row["replica_num"], row["submission_num"]),
+    )
+
+
+def _jpd(row: sqlite3.Row) -> Optional[JobProvisioningData]:
+    if not row["job_provisioning_data"]:
+        return None
+    return JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+
+
+async def _update_jpd_ip(ctx: ServerContext, row: sqlite3.Row) -> Optional[JobProvisioningData]:
+    """Poll the backend for the instance IP if not yet known."""
+    jpd = _jpd(row)
+    if jpd is None:
+        return None
+    if jpd.hostname is not None and jpd.internal_ip is not None:
+        return jpd
+    from dstack_tpu.server.services import backends as backends_service
+
+    try:
+        compute = await backends_service.get_project_backend(
+            ctx, row["project_id"], jpd.get_base_backend()
+        )
+        jpd = await compute.update_provisioning_data(jpd)
+    except Exception as e:
+        logger.debug("update_provisioning_data failed: %s", e)
+        return None
+    if jpd.hostname is not None:
+        await ctx.db.execute(
+            "UPDATE jobs SET job_provisioning_data = ? WHERE id = ?",
+            (jpd.model_dump_json(), row["id"]),
+        )
+        if row["instance_id"]:
+            await ctx.db.execute(
+                "UPDATE instances SET job_provisioning_data = ? WHERE id = ?",
+                (jpd.model_dump_json(), row["instance_id"]),
+            )
+    return jpd
+
+
+def _build_cluster_info(
+    job_spec: JobSpec, replica_jpds: List[JobProvisioningData]
+) -> ClusterInfo:
+    ips = [jpd.internal_ip or jpd.hostname or "" for jpd in replica_jpds]
+    topo = job_spec.tpu_slice
+    slice_hosts = topo.hosts if topo else 1
+    slice_count = max(1, job_spec.jobs_per_replica // slice_hosts)
+    return ClusterInfo(
+        job_ips=ips,
+        master_job_ip=ips[0] if ips else "",
+        chips_per_host=topo.chips_per_host if topo else 0,
+        tpu_slice=topo,
+        slice_count=slice_count,
+        slice_id=job_spec.job_num // slice_hosts,
+    )
+
+
+async def _get_secrets(ctx: ServerContext, project_id: str) -> dict:
+    rows = await ctx.db.fetchall(
+        "SELECT name, value FROM secrets WHERE project_id = ?", (project_id,)
+    )
+    return {r["name"]: ctx.encryption.decrypt(r["value"]) for r in rows}
+
+
+async def _runner_deadline_exceeded(ctx: ServerContext, row: sqlite3.Row) -> bool:
+    submitted = parse_dt(row["submitted_at"])
+    return (utcnow() - submitted).total_seconds() > settings.RUNNER_READY_TIMEOUT
+
+
+async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """Wait for the whole gang's IPs, then hand the job to its agent."""
+    jpd = await _update_jpd_ip(ctx, row)
+    if jpd is None or jpd.hostname is None:
+        if await _runner_deadline_exceeded(ctx, row):
+            await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
+                        "instance IP was not assigned in time")
+        return
+    replica = await _replica_rows(ctx, row)
+    replica_jpds = []
+    for sibling in replica:
+        sjpd = _jpd(sibling)
+        if sjpd is None or sjpd.hostname is None:
+            return  # gang not fully provisioned yet (reference :176-187)
+        replica_jpds.append(sjpd)
+
+    job_spec = JobSpec.model_validate_json(row["job_spec"])
+    cluster_info = _build_cluster_info(job_spec, replica_jpds)
+    secrets = await _get_secrets(ctx, row["project_id"])
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    pool = get_connection_pool(ctx)
+    conn = await pool.get(
+        ctx, row["instance_id"] or jpd.instance_id, jpd,
+        ssh_private_key=project_row["ssh_private_key"],
+    )
+
+    if jpd.dockerized and not row["shim_task_submitted"]:
+        # Shim path: create the container first (reference :359-481).
+        shim = conn.shim_client()
+        try:
+            health = await shim.healthcheck()
+            if health is None:
+                if await _runner_deadline_exceeded(ctx, row):
+                    await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
+                                "shim did not become ready in time")
+                return
+            tpu_chips = job_spec.tpu_slice.chips_per_host if job_spec.tpu_slice else 0
+            await shim.submit_task(
+                TaskSubmitRequest(
+                    id=row["id"],
+                    name=job_spec.job_name,
+                    image_name=job_spec.image_name,
+                    container_user=None,
+                    privileged=job_spec.privileged,
+                    shm_size_bytes=int((job_spec.requirements.resources.shm_size or 0) * (1 << 30)),
+                    network_mode="host",
+                    volumes=[v.model_dump() for v in job_spec.volumes],
+                    host_ssh_keys=[project_row["ssh_public_key"]],
+                    container_ssh_keys=[project_row["ssh_public_key"]],
+                    tpu_chips=tpu_chips,
+                    env={},
+                )
+            )
+            await ctx.db.execute(
+                "UPDATE jobs SET shim_task_submitted = 1, status = ? WHERE id = ?",
+                (JobStatus.PULLING.value, row["id"]),
+            )
+            ctx.kick("running_jobs")
+        finally:
+            await shim.close()
+        return
+
+    await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets)
+
+
+async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """Poll the shim until the container is up, then submit to the runner."""
+    jpd = _jpd(row)
+    if jpd is None:
+        return
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    pool = get_connection_pool(ctx)
+    conn = await pool.get(
+        ctx, row["instance_id"] or jpd.instance_id, jpd,
+        ssh_private_key=project_row["ssh_private_key"],
+    )
+    shim = conn.shim_client()
+    try:
+        task = await shim.get_task(row["id"])
+    except Exception:
+        if await _runner_deadline_exceeded(ctx, row):
+            await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
+                        "container was not created in time")
+        return
+    finally:
+        await shim.close()
+    if task.status == TaskStatus.TERMINATED:
+        await _fail(
+            ctx, row, JobTerminationReason.CREATING_CONTAINER_ERROR,
+            task.termination_message or task.termination_reason or "container failed",
+        )
+        return
+    if task.status != TaskStatus.RUNNING:
+        return
+    replica = await _replica_rows(ctx, row)
+    replica_jpds = [j for j in (_jpd(s) for s in replica) if j is not None]
+    if len(replica_jpds) != len(replica):
+        return
+    job_spec = JobSpec.model_validate_json(row["job_spec"])
+    cluster_info = _build_cluster_info(job_spec, replica_jpds)
+    secrets = await _get_secrets(ctx, row["project_id"])
+    await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets)
+
+
+async def _submit_to_runner(
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    conn,
+    job_spec: JobSpec,
+    cluster_info: ClusterInfo,
+    secrets: dict,
+) -> None:
+    runner = conn.runner_client()
+    try:
+        health = await runner.healthcheck()
+        if health is None:
+            if await _runner_deadline_exceeded(ctx, row):
+                await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
+                            "runner did not become ready in time")
+            return
+        code_blob = await _get_code_blob(ctx, row)
+        await runner.submit_job(
+            run_name=row["run_name"],
+            job_spec=job_spec,
+            cluster_info=cluster_info,
+            node_rank=job_spec.job_num,
+            secrets=secrets,
+            has_code=code_blob is not None,
+        )
+        if code_blob is not None:
+            await runner.upload_code(code_blob)
+        await runner.run_job()
+        await ctx.db.execute(
+            "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
+        )
+        logger.info(
+            "job %s (%s rank %d/%d) running",
+            job_spec.job_name, row["run_name"], job_spec.job_num, job_spec.jobs_per_replica,
+        )
+        ctx.kick("runs")
+    finally:
+        await runner.close()
+
+
+async def _get_code_blob(ctx: ServerContext, row: sqlite3.Row) -> Optional[bytes]:
+    run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+    if run_row is None:
+        return None
+    from dstack_tpu.models.runs import RunSpec
+
+    run_spec = RunSpec.model_validate_json(run_row["run_spec"])
+    if run_spec.repo_code_hash is None or run_row["repo_id"] is None:
+        return None
+    code_row = await ctx.db.fetchone(
+        "SELECT blob FROM codes WHERE repo_id = ? AND blob_hash = ?",
+        (run_row["repo_id"], run_spec.repo_code_hash),
+    )
+    return code_row["blob"] if code_row else None
+
+
+async def _pull_runner(ctx: ServerContext, row: sqlite3.Row) -> None:
+    jpd = _jpd(row)
+    if jpd is None:
+        return
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    pool = get_connection_pool(ctx)
+    conn = await pool.get(
+        ctx, row["instance_id"] or jpd.instance_id, jpd,
+        ssh_private_key=project_row["ssh_private_key"],
+    )
+    runner = conn.runner_client()
+    try:
+        resp = await runner.pull(row["runner_timestamp"])
+    except Exception:
+        await _handle_disconnect(ctx, row)
+        return
+    finally:
+        await runner.close()
+    await ctx.db.execute(
+        "UPDATE jobs SET runner_timestamp = ?, disconnected_at = NULL WHERE id = ?",
+        (resp.last_updated, row["id"]),
+    )
+    if ctx.log_storage is not None and (resp.job_logs or resp.runner_logs):
+        await ctx.log_storage.write(
+            project_id=row["project_id"],
+            run_name=row["run_name"],
+            job_submission_id=row["id"],
+            job_logs=resp.job_logs,
+            runner_logs=resp.runner_logs,
+        )
+    for event in resp.job_states:
+        if event.state.is_finished():
+            reason = event.termination_reason or JobTerminationReason.DONE_BY_RUNNER
+            await ctx.db.execute(
+                "UPDATE jobs SET status = ?, termination_reason = ?,"
+                " termination_reason_message = ?, exit_status = ?, finished_at = ?"
+                " WHERE id = ?",
+                (
+                    event.state.value,
+                    reason.value,
+                    event.termination_message,
+                    event.exit_status,
+                    utcnow_iso(),
+                    row["id"],
+                ),
+            )
+            await _release_instance(ctx, row)
+            ctx.kick("runs")
+            logger.info("job %s finished: %s", row["id"][:8], event.state.value)
+            return
+
+
+async def _handle_disconnect(ctx: ServerContext, row: sqlite3.Row) -> None:
+    if row["disconnected_at"] is None:
+        await ctx.db.execute(
+            "UPDATE jobs SET disconnected_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
+        )
+        return
+    disconnected = parse_dt(row["disconnected_at"])
+    if (utcnow() - disconnected).total_seconds() > 120:
+        await _fail(
+            ctx, row, JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+            "runner unreachable for 120s",
+        )
+
+
+async def _fail(
+    ctx: ServerContext, row: sqlite3.Row, reason: JobTerminationReason, message: str
+) -> None:
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ?, termination_reason = ?,"
+        " termination_reason_message = ?, finished_at = ? WHERE id = ?",
+        (reason.to_status().value, reason.value, message, utcnow_iso(), row["id"]),
+    )
+    await _release_instance(ctx, row)
+    ctx.kick("runs")
+    logger.info("job %s failed: %s", row["id"][:8], message)
+
+
+async def _terminate_job(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """TERMINATING → stop the agent, release the instance, finalize."""
+    jpd = _jpd(row)
+    reason = (
+        JobTerminationReason(row["termination_reason"])
+        if row["termination_reason"]
+        else JobTerminationReason.TERMINATED_BY_SERVER
+    )
+    if jpd is not None and row["instance_id"]:
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        pool = get_connection_pool(ctx)
+        try:
+            conn = await pool.get(
+                ctx, row["instance_id"], jpd,
+                ssh_private_key=project_row["ssh_private_key"],
+            )
+            if jpd.dockerized and row["shim_task_submitted"]:
+                shim = conn.shim_client()
+                try:
+                    await shim.terminate_task(row["id"], reason.value)
+                except Exception:
+                    pass
+                finally:
+                    await shim.close()
+            else:
+                runner = conn.runner_client()
+                try:
+                    await runner.stop()
+                except Exception:
+                    pass
+                finally:
+                    await runner.close()
+        except Exception:
+            logger.debug("could not reach agent while terminating job %s", row["id"][:8])
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ?, finished_at = ?, last_processed_at = ? WHERE id = ?",
+        (reason.to_status().value, utcnow_iso(), utcnow_iso(), row["id"]),
+    )
+    await _release_instance(ctx, row)
+    ctx.kick("runs")
+
+
+async def _release_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """Give the instance back: idle for reusable fleets, terminate otherwise."""
+    if not row["instance_id"]:
+        return
+    irow = await ctx.db.fetchone("SELECT * FROM instances WHERE id = ?", (row["instance_id"],))
+    if irow is None:
+        return
+    get_connection_pool(ctx).drop(irow["id"])
+    jpd = (
+        JobProvisioningData.model_validate_json(irow["job_provisioning_data"])
+        if irow["job_provisioning_data"]
+        else None
+    )
+    fleet_row = None
+    if irow["fleet_id"]:
+        fleet_row = await ctx.db.fetchone("SELECT * FROM fleets WHERE id = ?", (irow["fleet_id"],))
+    reusable = jpd is not None and jpd.dockerized
+    autocreated = bool(fleet_row["auto_cleanup"]) if fleet_row else True
+    if reusable and not autocreated:
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'idle', busy_blocks = 0, last_processed_at = ?"
+            " WHERE id = ?",
+            (utcnow_iso(), irow["id"]),
+        )
+    else:
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'terminating', last_processed_at = ? WHERE id = ?",
+            (utcnow_iso(), irow["id"]),
+        )
+        ctx.kick("instances")

@@ -1,0 +1,245 @@
+"""Run FSM processor.
+
+Parity: src/dstack/_internal/server/background/tasks/process_runs.py
+(_process_pending_run:129-182, _process_active_run:185). Gang semantics are
+TPU-first: ANY worker job of a replica failing terminates the whole replica
+(a pod slice cannot make progress with a dead host); the reference only
+special-cases the master job.
+"""
+
+import logging
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.models.runs import (
+    JobStatus,
+    JobTerminationReason,
+    RunStatus,
+    RunSpec,
+    RunTerminationReason,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.services.runs import (
+    JOB_TERMINATION_REASONS_RETRYABLE,
+    create_replica_jobs,
+)
+from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def process_runs(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM runs WHERE status NOT IN ('terminated','failed','done')"
+        " AND deleted = 0 ORDER BY last_processed_at"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("runs", row["id"]):
+            continue
+        try:
+            await _process_run(ctx, row)
+        except Exception:
+            logger.exception("failed to process run %s", row["run_name"])
+        finally:
+            ctx.locker.unlock_nowait("runs", row["id"])
+
+
+async def _process_run(ctx: ServerContext, row: sqlite3.Row) -> None:
+    status = RunStatus(row["status"])
+    if status == RunStatus.TERMINATING:
+        await _process_terminating_run(ctx, row)
+    elif status == RunStatus.PENDING:
+        await _process_pending_run(ctx, row)
+    else:
+        await _process_active_run(ctx, row)
+    await ctx.db.execute(
+        "UPDATE runs SET last_processed_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
+    )
+
+
+async def _latest_jobs(ctx: ServerContext, run_id: str) -> List[sqlite3.Row]:
+    """Latest submission of each (replica, job)."""
+    return await ctx.db.fetchall(
+        "SELECT j.* FROM jobs j JOIN ("
+        "  SELECT replica_num, job_num, MAX(submission_num) AS sn FROM jobs"
+        "  WHERE run_id = ? GROUP BY replica_num, job_num"
+        ") latest ON j.replica_num = latest.replica_num AND j.job_num = latest.job_num"
+        "  AND j.submission_num = latest.sn WHERE j.run_id = ?"
+        " ORDER BY j.replica_num, j.job_num",
+        (run_id, run_id),
+    )
+
+
+async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
+    jobs = await _latest_jobs(ctx, row["id"])
+    if not jobs:
+        return
+    statuses = [JobStatus(j["status"]) for j in jobs]
+
+    # Gang failure: a failed/aborted job in a replica with live siblings
+    # takes the replica down.
+    failed_replicas = set()
+    for j, s in zip(jobs, statuses):
+        if s in (JobStatus.FAILED, JobStatus.ABORTED) or (
+            s == JobStatus.TERMINATED
+            and j["termination_reason"] != JobTerminationReason.SCALED_DOWN.value
+        ):
+            failed_replicas.add(j["replica_num"])
+    if failed_replicas:
+        retryable = await _maybe_retry(ctx, row, jobs, failed_replicas)
+        if retryable:
+            return
+        for j, s in zip(jobs, statuses):
+            if j["replica_num"] in failed_replicas and not s.is_finished() and s != JobStatus.TERMINATING:
+                await ctx.db.execute(
+                    "UPDATE jobs SET status = ?, termination_reason = ?,"
+                    " last_processed_at = ? WHERE id = ?",
+                    (
+                        JobStatus.TERMINATING.value,
+                        JobTerminationReason.GANG_MEMBER_FAILED.value,
+                        utcnow_iso(),
+                        j["id"],
+                    ),
+                )
+        await ctx.db.execute(
+            "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
+            (RunStatus.TERMINATING.value, RunTerminationReason.JOB_FAILED.value, row["id"]),
+        )
+        ctx.kick("terminating_jobs")
+        return
+
+    if all(s == JobStatus.DONE for s in statuses):
+        await ctx.db.execute(
+            "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
+            (RunStatus.TERMINATING.value, RunTerminationReason.ALL_JOBS_DONE.value, row["id"]),
+        )
+        ctx.kick("runs")
+        return
+
+    new_status: Optional[RunStatus] = None
+    if any(s == JobStatus.RUNNING for s in statuses):
+        new_status = RunStatus.RUNNING
+    elif any(s in (JobStatus.PROVISIONING, JobStatus.PULLING) for s in statuses):
+        new_status = RunStatus.PROVISIONING
+    if new_status is not None and new_status.value != row["status"]:
+        await ctx.db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?", (new_status.value, row["id"])
+        )
+
+
+async def _maybe_retry(
+    ctx: ServerContext, row: sqlite3.Row, jobs: List[sqlite3.Row], failed_replicas: set
+) -> bool:
+    """Resubmit failed replicas when the retry policy covers the failure."""
+    run_spec = RunSpec.model_validate_json(row["run_spec"])
+    profile = run_spec.merged_profile
+    retry = profile.get_retry() if profile else None
+    if retry is None:
+        return False
+    now = utcnow()
+    for replica in failed_replicas:
+        replica_jobs = [j for j in jobs if j["replica_num"] == replica]
+        # All jobs of the failed replica must be finished before resubmission.
+        if not all(JobStatus(j["status"]).is_finished() for j in replica_jobs):
+            # Terminate the survivors first; retry on a later tick.
+            for j in replica_jobs:
+                if not JobStatus(j["status"]).is_finished() and j["status"] != "terminating":
+                    await ctx.db.execute(
+                        "UPDATE jobs SET status = ?, termination_reason = ?,"
+                        " last_processed_at = ? WHERE id = ?",
+                        (
+                            JobStatus.TERMINATING.value,
+                            JobTerminationReason.GANG_MEMBER_FAILED.value,
+                            utcnow_iso(),
+                            j["id"],
+                        ),
+                    )
+            ctx.kick("terminating_jobs")
+            return True
+        reasons = {
+            j["termination_reason"] for j in replica_jobs if j["termination_reason"]
+        } - {JobTerminationReason.GANG_MEMBER_FAILED.value}
+        retry_events = {e.value for e in retry.on_events}
+        covered = True
+        for reason in reasons:
+            r = JobTerminationReason(reason)
+            if r in JOB_TERMINATION_REASONS_RETRYABLE:
+                needed = {"no-capacity", "interruption"}
+            else:
+                needed = {"error"}
+            if not (needed & retry_events):
+                covered = False
+        if not covered:
+            return False
+        # Retry-duration budget: measured from the first submission.
+        first = min(parse_dt(j["submitted_at"]) for j in replica_jobs)
+        if (now - first).total_seconds() > retry.duration:
+            await ctx.db.execute(
+                "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
+                (
+                    RunStatus.TERMINATING.value,
+                    RunTerminationReason.RETRY_LIMIT_EXCEEDED.value,
+                    row["id"],
+                ),
+            )
+            return True
+        submission_num = max(j["submission_num"] for j in replica_jobs) + 1
+        await create_replica_jobs(
+            ctx, row["project_id"], row["id"], run_spec, replica, submission_num
+        )
+        await ctx.db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?", (RunStatus.PENDING.value, row["id"])
+        )
+        logger.info(
+            "run %s: resubmitted replica %s (submission %s)",
+            row["run_name"], replica, submission_num,
+        )
+    ctx.kick("submitted_jobs")
+    return True
+
+
+async def _process_pending_run(ctx: ServerContext, row: sqlite3.Row) -> None:
+    # Resubmitted replicas exist already; flip back to SUBMITTED after the
+    # retry delay (reference: RETRY_DELAY=15s, process_runs.py:43).
+    last = parse_dt(row["last_processed_at"])
+    if (utcnow() - last).total_seconds() < settings.RETRY_PENDING_RUN_DELAY:
+        return
+    await ctx.db.execute(
+        "UPDATE runs SET status = ? WHERE id = ?", (RunStatus.SUBMITTED.value, row["id"])
+    )
+    ctx.kick("submitted_jobs")
+
+
+async def _process_terminating_run(ctx: ServerContext, row: sqlite3.Row) -> None:
+    reason = (
+        RunTerminationReason(row["termination_reason"])
+        if row["termination_reason"]
+        else RunTerminationReason.SERVER_ERROR
+    )
+    jobs = await _latest_jobs(ctx, row["id"])
+    all_finished = True
+    for j in jobs:
+        s = JobStatus(j["status"])
+        if s.is_finished():
+            continue
+        all_finished = False
+        if s != JobStatus.TERMINATING:
+            await ctx.db.execute(
+                "UPDATE jobs SET status = ?, termination_reason = ?, last_processed_at = ?"
+                " WHERE id = ?",
+                (
+                    JobStatus.TERMINATING.value,
+                    reason.to_job_termination_reason().value,
+                    utcnow_iso(),
+                    j["id"],
+                ),
+            )
+    if not all_finished:
+        ctx.kick("terminating_jobs")
+        return
+    await ctx.db.execute(
+        "UPDATE runs SET status = ? WHERE id = ?", (reason.to_status().value, row["id"])
+    )
+    logger.info("run %s: %s", row["run_name"], reason.to_status().value)

@@ -1,0 +1,381 @@
+"""Submitted-job processor: assign instances / provision gangs.
+
+Parity: src/dstack/_internal/server/background/tasks/
+process_submitted_jobs.py:83-331 (two-phase: pool assign under lock, else
+provision via offers; cluster fleet creation :493-520; master-wait
+:138-154). TPU-first deltas:
+  - Provisioning is *slice-granular*: the slice-leader job (host_rank 0)
+    provisions one cloud resource that yields `hosts` worker VMs atomically
+    (Compute.run_job returns a list) and assigns every sibling job its
+    worker instance. The reference provisions 1 instance per job and cannot
+    express pod slices.
+  - Pool reuse matches whole slices: H idle workers of the same TPU node.
+"""
+
+import json
+import logging
+from typing import List, Optional, Tuple
+
+import sqlite3
+
+from dstack_tpu.errors import BackendError, NoCapacityError
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.fleets import FleetStatus
+from dstack_tpu.models.instances import InstanceStatus
+from dstack_tpu.models.runs import (
+    JobProvisioningData,
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+    RunSpec,
+)
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services import offers as offers_service
+from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+MAX_OFFERS_TRIED = 15  # parity: offer loop cap (process_submitted_jobs.py:450-490)
+MASTER_WAIT_TIMEOUT = 600.0
+
+
+async def process_submitted_jobs(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE status = 'submitted' ORDER BY last_processed_at"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("jobs", row["id"]):
+            continue
+        try:
+            await _process_job(ctx, row)
+        except Exception:
+            logger.exception("failed to process submitted job %s", row["id"])
+        finally:
+            ctx.locker.unlock_nowait("jobs", row["id"])
+
+
+async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:
+    job_spec = JobSpec.model_validate_json(row["job_spec"])
+    run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+    if run_row is None or run_row["status"] in ("terminating", "terminated", "failed", "done"):
+        return
+    run_spec = RunSpec.model_validate_json(run_row["run_spec"])
+    slice_hosts = job_spec.tpu_slice.hosts if job_spec.tpu_slice else 1
+
+    if row["instance_assigned"]:
+        await _mark_provisioning(ctx, row)
+        return
+
+    if job_spec.host_rank != 0:
+        # Worker jobs wait for their slice leader to provision the slice and
+        # assign instances (parity: master-wait :138-154).
+        await _check_wait_timeout(ctx, row)
+        return
+
+    is_master = job_spec.job_num == 0
+    master_jpd: Optional[JobProvisioningData] = None
+    if not is_master:
+        master_jpd = await _get_master_jpd(ctx, row)
+        if master_jpd is None:
+            await _check_wait_timeout(ctx, row)
+            return
+
+    # Phase 1: reuse idle pool/fleet instances (shim-managed only).
+    assigned = await _try_assign_pool_instances(ctx, row, job_spec, run_spec, slice_hosts)
+    if assigned:
+        ctx.kick("running_jobs")
+        return
+
+    # Phase 2: provision a fresh slice via backend offers.
+    from dstack_tpu.models.profiles import CreationPolicy
+
+    profile = run_spec.merged_profile
+    if profile is not None and profile.creation_policy == CreationPolicy.REUSE:
+        await _fail_job(
+            ctx, row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
+            "no idle instances and creation_policy=reuse",
+        )
+        return
+    multinode = job_spec.jobs_per_replica > 1
+    pairs = await offers_service.get_offers_by_requirements(
+        ctx,
+        run_row["project_id"],
+        job_spec.requirements,
+        profile,
+        multinode=multinode,
+        master_jpd=master_jpd,
+    )
+    if not pairs:
+        await _fail_job(
+            ctx, row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
+            "no matching offers",
+        )
+        return
+
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (run_row["project_id"],)
+    )
+    last_error = "no capacity"
+    for compute, offer in pairs[:MAX_OFFERS_TRIED]:
+        try:
+            instance_name = f"{row['run_name']}-{row['job_num']}-{generate_id()[:8]}"
+            jpds = await compute.run_job(
+                project_name=project_row["name"],
+                run_name=row["run_name"],
+                offer=offer,
+                ssh_public_key=project_row["ssh_public_key"],
+                instance_name=instance_name,
+            )
+        except (NoCapacityError, BackendError) as e:
+            last_error = str(e)
+            logger.info("offer %s failed: %s", offer.instance.name, e)
+            continue
+        await _commit_provisioned_slice(ctx, row, run_row, run_spec, offer, jpds)
+        ctx.kick("running_jobs")
+        return
+    await _fail_job(
+        ctx, row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY, last_error
+    )
+
+
+async def _get_master_jpd(
+    ctx: ServerContext, row: sqlite3.Row
+) -> Optional[JobProvisioningData]:
+    master = await ctx.db.fetchone(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND job_num = 0"
+        " AND submission_num = ?",
+        (row["run_id"], row["replica_num"], row["submission_num"]),
+    )
+    if master is None or not master["job_provisioning_data"]:
+        return None
+    return JobProvisioningData.model_validate_json(master["job_provisioning_data"])
+
+
+async def _check_wait_timeout(ctx: ServerContext, row: sqlite3.Row) -> None:
+    submitted = parse_dt(row["submitted_at"])
+    if (utcnow() - submitted).total_seconds() > MASTER_WAIT_TIMEOUT:
+        await _fail_job(
+            ctx, row, JobTerminationReason.WAITING_INSTANCE_LIMIT_EXCEEDED,
+            "timed out waiting for the slice leader to provision",
+        )
+
+
+async def _try_assign_pool_instances(
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    job_spec: JobSpec,
+    run_spec: RunSpec,
+    slice_hosts: int,
+) -> bool:
+    """Find idle shim-managed instances that satisfy the whole slice group."""
+    from dstack_tpu.backends.base.offers import offer_matches_requirements
+    from dstack_tpu.models.instances import InstanceOfferWithAvailability
+
+    idle_rows = await ctx.db.fetchall(
+        "SELECT * FROM instances WHERE project_id = ? AND status = 'idle'"
+        " AND deleted = 0 ORDER BY price",
+        (row["project_id"],),
+    )
+    candidates: List[sqlite3.Row] = []
+    for irow in idle_rows:
+        if not irow["offer"]:
+            continue
+        offer = InstanceOfferWithAvailability.model_validate_json(irow["offer"])
+        if not offer_matches_requirements(offer, job_spec.requirements):
+            continue
+        jpd = (
+            JobProvisioningData.model_validate_json(irow["job_provisioning_data"])
+            if irow["job_provisioning_data"]
+            else None
+        )
+        if jpd is None or not jpd.dockerized:
+            continue  # one-shot (runner-direct) instances cannot be reused
+        candidates.append(irow)
+    if slice_hosts == 1:
+        if not candidates:
+            return False
+        await _assign_jobs_to_instances(ctx, [row], [candidates[0]])
+        return True
+    # Multi-host: need all H workers of one TPU node idle.
+    by_node = {}
+    for irow in candidates:
+        node = None
+        if irow["job_provisioning_data"]:
+            node = JobProvisioningData.model_validate_json(
+                irow["job_provisioning_data"]
+            ).tpu_node_id
+        by_node.setdefault(node or irow["id"], []).append(irow)
+    group_rows = await _slice_group_jobs(ctx, row, slice_hosts)
+    if group_rows is None:
+        return False
+    for node, members in by_node.items():
+        if len(members) == slice_hosts:
+            members.sort(
+                key=lambda r: JobProvisioningData.model_validate_json(
+                    r["job_provisioning_data"]
+                ).tpu_worker_index
+            )
+            await _assign_jobs_to_instances(ctx, group_rows, members)
+            return True
+    return False
+
+
+async def _slice_group_jobs(
+    ctx: ServerContext, leader_row: sqlite3.Row, slice_hosts: int
+) -> Optional[List[sqlite3.Row]]:
+    """The leader's slice group: jobs [job_num, job_num+slice_hosts)."""
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND submission_num = ?"
+        " AND job_num >= ? AND job_num < ? ORDER BY job_num",
+        (
+            leader_row["run_id"],
+            leader_row["replica_num"],
+            leader_row["submission_num"],
+            leader_row["job_num"],
+            leader_row["job_num"] + slice_hosts,
+        ),
+    )
+    if len(rows) != slice_hosts:
+        return None
+    return rows
+
+
+async def _assign_jobs_to_instances(
+    ctx: ServerContext, job_rows: List[sqlite3.Row], instance_rows: List[sqlite3.Row]
+) -> None:
+    now = utcnow_iso()
+    for job_row, irow in zip(job_rows, instance_rows):
+        jpd = irow["job_provisioning_data"]
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'busy', busy_blocks = total_blocks,"
+            " last_processed_at = ? WHERE id = ?",
+            (now, irow["id"]),
+        )
+        await ctx.db.execute(
+            "UPDATE jobs SET instance_id = ?, instance_assigned = 1, status = ?,"
+            " job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
+            (irow["id"], JobStatus.PROVISIONING.value, jpd, now, job_row["id"]),
+        )
+        logger.info("job %s assigned to idle instance %s", job_row["id"][:8], irow["name"])
+
+
+async def _commit_provisioned_slice(
+    ctx: ServerContext,
+    leader_row: sqlite3.Row,
+    run_row: sqlite3.Row,
+    run_spec: RunSpec,
+    offer,
+    jpds: List[JobProvisioningData],
+) -> None:
+    """Create fleet+instances for a freshly provisioned slice and assign the
+    slice group's jobs."""
+    now = utcnow_iso()
+    slice_hosts = len(jpds)
+    group_rows = await _slice_group_jobs(ctx, leader_row, slice_hosts)
+    if group_rows is None:
+        group_rows = [leader_row]
+
+    fleet_id = run_row["fleet_id"]
+    if fleet_id is None:
+        fleet_id = generate_id()
+        placement = "cluster" if (len(jpds) > 1 or leader_row["job_num"] > 0) else "any"
+        fleet_spec = {
+            "configuration": {
+                "type": "fleet",
+                "name": run_row["run_name"],
+                "placement": placement,
+            },
+            "autocreated": True,
+        }
+        await ctx.db.execute(
+            "INSERT INTO fleets (id, project_id, name, status, spec, created_at,"
+            " last_processed_at, auto_cleanup) VALUES (?, ?, ?, ?, ?, ?, ?, 1)",
+            (
+                fleet_id,
+                run_row["project_id"],
+                run_row["run_name"],
+                FleetStatus.ACTIVE.value,
+                json.dumps(fleet_spec),
+                now,
+                now,
+            ),
+        )
+        await ctx.db.execute(
+            "UPDATE runs SET fleet_id = ? WHERE id = ?", (fleet_id, run_row["id"])
+        )
+
+    for worker, (job_row, jpd) in enumerate(zip(group_rows, jpds)):
+        instance_id = generate_id()
+        await ctx.db.execute(
+            "INSERT INTO instances (id, project_id, fleet_id, name, instance_num,"
+            " status, created_at, started_at, last_processed_at, backend, region,"
+            " availability_zone, price, offer, job_provisioning_data, tpu_node,"
+            " tpu_worker_index, busy_blocks)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, 1)",
+            (
+                instance_id,
+                run_row["project_id"],
+                fleet_id,
+                f"{run_row['run_name']}-{leader_row['job_num'] + worker}",
+                leader_row["job_num"] + worker,
+                InstanceStatus.BUSY.value,
+                now,
+                now,
+                now,
+                jpd.backend.value,
+                jpd.region,
+                jpd.availability_zone,
+                jpd.price,
+                offer.model_dump_json(),
+                jpd.model_dump_json(),
+                jpd.tpu_node_id,
+                jpd.tpu_worker_index,
+            ),
+        )
+        await ctx.db.execute(
+            "UPDATE jobs SET instance_id = ?, instance_assigned = 1, status = ?,"
+            " job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
+            (
+                instance_id,
+                JobStatus.PROVISIONING.value,
+                jpd.model_dump_json(),
+                now,
+                job_row["id"],
+            ),
+        )
+    logger.info(
+        "run %s: provisioned %s (%d host(s)) via %s",
+        run_row["run_name"], offer.instance.name, slice_hosts, offer.backend.value,
+    )
+
+
+async def _mark_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ?, last_processed_at = ? WHERE id = ?",
+        (JobStatus.PROVISIONING.value, utcnow_iso(), row["id"]),
+    )
+    ctx.kick("running_jobs")
+
+
+async def _fail_job(
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    reason: JobTerminationReason,
+    message: str,
+) -> None:
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ?, termination_reason = ?,"
+        " termination_reason_message = ?, finished_at = ?, last_processed_at = ?"
+        " WHERE id = ?",
+        (
+            reason.to_status().value,
+            reason.value,
+            message,
+            utcnow_iso(),
+            utcnow_iso(),
+            row["id"],
+        ),
+    )
+    logger.info("job %s failed to start: %s", row["id"][:8], message)
+    ctx.kick("runs")

@@ -1,0 +1,61 @@
+"""Volume FSM: SUBMITTED -> PROVISIONING -> ACTIVE (or FAILED).
+
+Parity: src/dstack/_internal/server/background/tasks/process_volumes.py.
+"""
+
+import logging
+
+from dstack_tpu.models.volumes import Volume, VolumeStatus
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.utils.common import utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def process_volumes(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM volumes WHERE deleted = 0 AND status IN ('submitted', 'provisioning')"
+    )
+    for row in rows:
+        if not ctx.locker.try_lock_nowait("volumes", row["id"]):
+            continue
+        try:
+            await _process_volume(ctx, row)
+        except Exception:
+            logger.exception("failed to process volume %s", row["name"])
+        finally:
+            ctx.locker.unlock_nowait("volumes", row["id"])
+
+
+async def _process_volume(ctx: ServerContext, row) -> None:
+    from dstack_tpu.server.services import backends as backends_service
+    from dstack_tpu.server.services.volumes import volume_row_to_volume
+
+    volume = await volume_row_to_volume(ctx, row)
+    try:
+        compute = await backends_service.get_project_backend(
+            ctx, row["project_id"], volume.configuration.backend
+        )
+        if volume.configuration.volume_id:
+            pd = await compute.register_volume(volume)
+        else:
+            pd = await compute.create_volume(volume)
+        await ctx.db.execute(
+            "UPDATE volumes SET status = ?, provisioning_data = ?, volume_id = ?,"
+            " last_processed_at = ? WHERE id = ?",
+            (
+                VolumeStatus.ACTIVE.value,
+                pd.model_dump_json(),
+                pd.volume_id,
+                utcnow_iso(),
+                row["id"],
+            ),
+        )
+        logger.info("volume %s active (%s)", row["name"], pd.volume_id)
+    except Exception as e:
+        await ctx.db.execute(
+            "UPDATE volumes SET status = ?, status_message = ?, last_processed_at = ?"
+            " WHERE id = ?",
+            (VolumeStatus.FAILED.value, str(e)[:500], utcnow_iso(), row["id"]),
+        )
+        logger.warning("volume %s failed: %s", row["name"], e)

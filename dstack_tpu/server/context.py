@@ -1,0 +1,58 @@
+"""ServerContext — the wiring hub passed to services and background tasks.
+
+The reference reaches module-level singletons (db session maker, locker,
+backend registry). Here everything hangs off one context object, which makes
+tests hermetic (each test builds its own context on a temp DB).
+
+Event-driven FSM: `kick(channel)` wakes the corresponding background
+processor immediately instead of waiting for its poll tick — a key latency
+lever vs the reference's fixed 2-4s APScheduler intervals
+(BASELINE.md north star: apply→first-step < 5 min on 32 hosts).
+"""
+
+import asyncio
+from typing import Any, Callable, Dict, List, Optional
+
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.security import Encryption
+from dstack_tpu.server.services.locking import ResourceLocker
+
+
+class ServerContext:
+    def __init__(self, db: Database, encryption: Optional[Encryption] = None):
+        self.db = db
+        self.locker = ResourceLocker()
+        self.encryption = encryption or Encryption()
+        self.backends: Dict[str, Any] = {}  # (project_id, type) -> Backend; see services/backends.py
+        self.log_storage: Any = None  # set at startup; see services/logs.py
+        self._signals: Dict[str, asyncio.Event] = {}
+        self._tasks: List[asyncio.Task] = []
+        self.stopping = False
+        # Test hooks: services look up optional fakes here.
+        self.overrides: Dict[str, Any] = {}
+
+    def signal(self, channel: str) -> asyncio.Event:
+        if channel not in self._signals:
+            self._signals[channel] = asyncio.Event()
+        return self._signals[channel]
+
+    def kick(self, channel: str) -> None:
+        """Wake the background processor for `channel` now."""
+        self.signal(channel).set()
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_event_loop().create_task(coro)
+        self._tasks.append(task)
+        task.add_done_callback(self._tasks.remove)
+        return task
+
+    async def stop_tasks(self) -> None:
+        self.stopping = True
+        for task in list(self._tasks):
+            task.cancel()
+        for task in list(self._tasks):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()

@@ -1,0 +1,108 @@
+"""Async sqlite persistence layer.
+
+The reference uses SQLAlchemy async + alembic (server/db.py, migrations/);
+neither is in this environment, so the control plane carries its own thin
+layer: one sqlite connection in WAL mode driven through an executor with an
+asyncio write lock (sqlite allows one writer), plus a linear migration
+runner keyed off PRAGMA user_version.
+
+Multi-statement atomicity: `Database.run_sync(fn)` executes `fn(conn)` in
+the worker thread inside a transaction — the moral equivalent of the
+reference's async-session-with-commit blocks.
+"""
+
+import asyncio
+import sqlite3
+from pathlib import Path
+from typing import Any, Callable, Iterable, List, Optional, Sequence, TypeVar, Union
+
+T = TypeVar("T")
+
+# Ordered migrations; index+1 == resulting user_version.
+MIGRATIONS: List[str] = []
+
+
+def migration(sql: str) -> None:
+    MIGRATIONS.append(sql)
+
+
+class Database:
+    def __init__(self, path: Union[str, Path] = ":memory:"):
+        self.path = str(path)
+        self._conn: Optional[sqlite3.Connection] = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> None:
+        def _open() -> sqlite3.Connection:
+            conn = sqlite3.connect(self.path, check_same_thread=False)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA foreign_keys=ON")
+            conn.execute("PRAGMA busy_timeout=10000")
+            return conn
+
+        self._conn = await asyncio.to_thread(_open)
+        await self.migrate()
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            conn = self._conn
+            self._conn = None
+            await asyncio.to_thread(conn.close)
+
+    @property
+    def conn(self) -> sqlite3.Connection:
+        assert self._conn is not None, "Database is not connected"
+        return self._conn
+
+    async def migrate(self) -> None:
+        def _migrate(conn: sqlite3.Connection) -> None:
+            version = conn.execute("PRAGMA user_version").fetchone()[0]
+            for i, sql in enumerate(MIGRATIONS[version:], start=version + 1):
+                conn.executescript(sql)
+                conn.execute(f"PRAGMA user_version = {i}")
+                conn.commit()
+
+        await self.run_sync(_migrate)
+
+    async def run_sync(self, fn: Callable[[sqlite3.Connection], T]) -> T:
+        """Run `fn(conn)` in the worker thread under the write lock; commits
+        on success, rolls back on error."""
+        async with self._lock:
+            def _call() -> T:
+                try:
+                    result = fn(self.conn)
+                    self.conn.commit()
+                    return result
+                except BaseException:
+                    self.conn.rollback()
+                    raise
+
+            return await asyncio.to_thread(_call)
+
+    async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        def _exec(conn: sqlite3.Connection) -> int:
+            cur = conn.execute(sql, params)
+            return cur.rowcount
+
+        return await self.run_sync(_exec)
+
+    async def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
+        rows = list(rows)
+
+        def _exec(conn: sqlite3.Connection) -> None:
+            conn.executemany(sql, rows)
+
+        await self.run_sync(_exec)
+
+    async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> Optional[sqlite3.Row]:
+        def _fetch(conn: sqlite3.Connection) -> Optional[sqlite3.Row]:
+            return conn.execute(sql, params).fetchone()
+
+        return await self.run_sync(_fetch)
+
+    async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> List[sqlite3.Row]:
+        def _fetch(conn: sqlite3.Connection) -> List[sqlite3.Row]:
+            return conn.execute(sql, params).fetchall()
+
+        return await self.run_sync(_fetch)

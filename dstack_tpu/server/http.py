@@ -1,0 +1,542 @@
+"""Minimal asyncio HTTP/1.1 framework: router, request/response, server.
+
+The reference runs FastAPI+uvicorn (server/app.py:67-188); neither is in this
+environment, so the control plane ships its own small framework. It covers
+exactly what the API surface needs: path params, JSON bodies validated by
+pydantic, bearer auth hooks, typed ApiError → JSON mapping, keep-alive,
+streaming responses (log follow), and WebSocket upgrades (attach/logs_ws).
+"""
+
+import asyncio
+import base64
+import hashlib
+import json
+import logging
+import re
+import struct
+import traceback
+from dataclasses import dataclass, field
+from typing import (
+    Any,
+    AsyncIterator,
+    Awaitable,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Tuple,
+    Union,
+)
+from urllib.parse import parse_qs, unquote
+
+from pydantic import BaseModel, ValidationError
+
+from dstack_tpu.errors import ApiError
+
+logger = logging.getLogger(__name__)
+
+MAX_BODY = 512 * 1024 * 1024  # code uploads can be large
+MAX_HEADER = 64 * 1024
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: Dict[str, List[str]],
+        headers: Dict[str, str],
+        body: bytes,
+        path_params: Optional[Dict[str, str]] = None,
+    ):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.path_params: Dict[str, str] = path_params or {}
+        self.state: Dict[str, Any] = {}  # per-request context (auth user, ...)
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"Invalid JSON body: {e}") from e
+
+    def parse(self, model: type) -> Any:
+        """Validate the JSON body against a pydantic model."""
+        data = self.json()
+        if data is None:
+            data = {}
+        try:
+            return model.model_validate(data)
+        except ValidationError as e:
+            raise ApiError(
+                "Request validation error",
+                details=[
+                    {
+                        "msg": err.get("msg"),
+                        "loc": list(err.get("loc", ())),
+                        "code": "validation_error",
+                    }
+                    for err in e.errors()
+                ],
+            ) from e
+
+    def query_param(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    @property
+    def bearer_token(self) -> Optional[str]:
+        auth = self.headers.get("authorization", "")
+        if auth.lower().startswith("bearer "):
+            return auth[7:].strip()
+        return None
+
+
+class Response:
+    def __init__(
+        self,
+        content: Union[bytes, str, dict, list, BaseModel, None] = None,
+        status: int = 200,
+        headers: Optional[Dict[str, str]] = None,
+        media_type: Optional[str] = None,
+        stream: Optional[AsyncIterator[bytes]] = None,
+    ):
+        self.status = status
+        self.headers = headers or {}
+        self.stream = stream
+        if stream is not None:
+            self.body = b""
+            self.headers.setdefault("content-type", media_type or "application/octet-stream")
+        elif isinstance(content, BaseModel):
+            self.body = content.model_dump_json().encode()
+            self.headers.setdefault("content-type", "application/json")
+        elif isinstance(content, (dict, list)):
+            self.body = json.dumps(content, default=_json_default).encode()
+            self.headers.setdefault("content-type", "application/json")
+        elif isinstance(content, str):
+            self.body = content.encode()
+            self.headers.setdefault("content-type", media_type or "text/plain; charset=utf-8")
+        elif content is None:
+            self.body = b""
+        else:
+            self.body = content
+            self.headers.setdefault("content-type", media_type or "application/octet-stream")
+
+
+def _json_default(o: Any) -> Any:
+    import datetime
+    import enum
+    import uuid
+
+    if isinstance(o, BaseModel):
+        return json.loads(o.model_dump_json())
+    if isinstance(o, (datetime.datetime, datetime.date)):
+        return o.isoformat()
+    if isinstance(o, enum.Enum):
+        return o.value
+    if isinstance(o, uuid.UUID):
+        return str(o)
+    raise TypeError(f"Cannot serialize {type(o)}")
+
+
+Handler = Callable[..., Awaitable[Union[Response, BaseModel, dict, list, str, None]]]
+
+_PARAM_RE = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}")
+
+
+def _compile_path(pattern: str) -> re.Pattern:
+    regex = _PARAM_RE.sub(lambda m: f"(?P<{m.group(1)}>[^/]+)", pattern.rstrip("/") or "/")
+    return re.compile(f"^{regex}/?$")
+
+
+@dataclass
+class Route:
+    method: str
+    pattern: str
+    regex: re.Pattern
+    handler: Handler
+    websocket: bool = False
+
+
+class Router:
+    """A group of routes under a common prefix."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix.rstrip("/")
+        self.routes: List[Route] = []
+
+    def add(self, method: str, path: str, handler: Handler, websocket: bool = False) -> None:
+        full = self.prefix + path
+        self.routes.append(Route(method.upper(), full, _compile_path(full), handler, websocket))
+
+    def post(self, path: str) -> Callable[[Handler], Handler]:
+        return self._decorator("POST", path)
+
+    def get(self, path: str) -> Callable[[Handler], Handler]:
+        return self._decorator("GET", path)
+
+    def delete(self, path: str) -> Callable[[Handler], Handler]:
+        return self._decorator("DELETE", path)
+
+    def websocket(self, path: str) -> Callable[[Handler], Handler]:
+        def deco(fn: Handler) -> Handler:
+            self.add("GET", path, fn, websocket=True)
+            return fn
+
+        return deco
+
+    def _decorator(self, method: str, path: str) -> Callable[[Handler], Handler]:
+        def deco(fn: Handler) -> Handler:
+            self.add(method, path, fn)
+            return fn
+
+        return deco
+
+
+Middleware = Callable[[Request], Awaitable[Optional[Response]]]
+
+
+class App:
+    """Route table + middleware + lifespan, served by `Server`."""
+
+    def __init__(self):
+        self.routers: List[Router] = []
+        self.middleware: List[Middleware] = []
+        self.on_startup: List[Callable[[], Awaitable[None]]] = []
+        self.on_shutdown: List[Callable[[], Awaitable[None]]] = []
+        self.state: Dict[str, Any] = {}
+
+    def include_router(self, router: Router) -> None:
+        self.routers.append(router)
+
+    def add_middleware(self, mw: Middleware) -> None:
+        self.middleware.append(mw)
+
+    def _find_route(self, method: str, path: str) -> Tuple[Optional[Route], Dict[str, str], bool]:
+        path_matched = False
+        for router in self.routers:
+            for route in router.routes:
+                m = route.regex.match(path)
+                if m:
+                    path_matched = True
+                    if route.method == method:
+                        return route, {k: unquote(v) for k, v in m.groupdict().items()}, True
+        return None, {}, path_matched
+
+    async def handle(self, request: Request) -> Response:
+        try:
+            for mw in self.middleware:
+                resp = await mw(request)
+                if resp is not None:
+                    return resp
+            route, params, path_matched = self._find_route(request.method, request.path)
+            if route is None:
+                if path_matched:
+                    return Response({"detail": "Method not allowed"}, status=405)
+                return Response({"detail": "Not found"}, status=404)
+            request.path_params = params
+            result = await route.handler(request, **params)
+            if isinstance(result, Response):
+                return result
+            return Response(result)
+        except ApiError as e:
+            return Response(e.to_json(), status=e.status)
+        except ValidationError as e:
+            return Response(
+                {"detail": [{"msg": str(e), "code": "validation_error"}]}, status=400
+            )
+        except Exception:
+            logger.exception("Unhandled server error: %s %s", request.method, request.path)
+            return Response(
+                {"detail": [{"msg": "Internal server error", "code": "server_error"}]},
+                status=500,
+            )
+
+    async def startup(self) -> None:
+        for fn in self.on_startup:
+            await fn()
+
+    async def shutdown(self) -> None:
+        for fn in self.on_shutdown:
+            await fn()
+
+
+class WebSocket:
+    """Server side of an accepted RFC6455 connection (no extensions)."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+        self.closed = False
+
+    async def send_text(self, data: str) -> None:
+        await self._send_frame(0x1, data.encode())
+
+    async def send_bytes(self, data: bytes) -> None:
+        await self._send_frame(0x2, data)
+
+    async def _send_frame(self, opcode: int, payload: bytes) -> None:
+        if self.closed:
+            return
+        header = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            header += bytes([n])
+        elif n < (1 << 16):
+            header += bytes([126]) + struct.pack(">H", n)
+        else:
+            header += bytes([127]) + struct.pack(">Q", n)
+        self._writer.write(header + payload)
+        await self._writer.drain()
+
+    async def receive(self) -> Optional[bytes]:
+        """Next data frame payload, or None when the peer closes."""
+        while True:
+            try:
+                head = await self._reader.readexactly(2)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                self.closed = True
+                return None
+            opcode = head[0] & 0x0F
+            masked = head[1] & 0x80
+            n = head[1] & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", await self._reader.readexactly(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", await self._reader.readexactly(8))[0]
+            mask = await self._reader.readexactly(4) if masked else b"\x00" * 4
+            payload = await self._reader.readexactly(n)
+            if masked:
+                payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+            if opcode == 0x8:  # close
+                self.closed = True
+                try:
+                    await self._send_frame(0x8, b"")
+                except ConnectionError:
+                    pass
+                return None
+            if opcode == 0x9:  # ping
+                await self._send_frame(0xA, payload)
+                continue
+            if opcode in (0x1, 0x2, 0x0):
+                return payload
+
+    async def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            try:
+                await self._send_frame(0x8, b"")
+            except ConnectionError:
+                pass
+
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _ws_accept_key(key: str) -> str:
+    return base64.b64encode(hashlib.sha1((key + _WS_GUID).encode()).digest()).decode()
+
+
+class Server:
+    """asyncio socket server speaking HTTP/1.1 for an `App`."""
+
+    def __init__(self, app: App, host: str = "127.0.0.1", port: int = 3000):
+        self.app = app
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        await self.app.startup()
+        self._server = await asyncio.start_server(self._client, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.app.shutdown()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                # WebSocket upgrade?
+                if request.headers.get("upgrade", "").lower() == "websocket":
+                    await self._handle_websocket(request, reader, writer)
+                    break
+                response = await self.app.handle(request)
+                keep_alive = request.headers.get("connection", "").lower() != "close"
+                await self._write_response(writer, response, keep_alive)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            logger.debug("connection handler error:\n%s", traceback.format_exc())
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Optional[Request]:
+        try:
+            request_line = await reader.readline()
+        except (ConnectionError, asyncio.LimitOverrunError):
+            return None
+        if not request_line:
+            return None
+        try:
+            method, target, _version = request_line.decode("latin1").strip().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        total = 0
+        while True:
+            line = await reader.readline()
+            total += len(line)
+            if total > MAX_HEADER:
+                return None
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode("latin1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        body = b""
+        if "content-length" in headers:
+            n = int(headers["content-length"])
+            if n > MAX_BODY:
+                return None
+            body = await reader.readexactly(n)
+        elif headers.get("transfer-encoding", "").lower() == "chunked":
+            chunks = []
+            while True:
+                size_line = await reader.readline()
+                size = int(size_line.strip().split(b";")[0], 16)
+                if size == 0:
+                    await reader.readline()
+                    break
+                chunks.append(await reader.readexactly(size))
+                await reader.readexactly(2)  # trailing CRLF
+            body = b"".join(chunks)
+        path, _, query_string = target.partition("?")
+        return Request(
+            method=method.upper(),
+            path=unquote(path),
+            query=parse_qs(query_string),
+            headers=headers,
+            body=body,
+        )
+
+    async def _write_response(
+        self, writer: asyncio.StreamWriter, response: Response, keep_alive: bool
+    ) -> None:
+        status_text = {200: "OK", 400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+                       404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
+                       500: "Internal Server Error"}.get(response.status, "")
+        lines = [f"HTTP/1.1 {response.status} {status_text}"]
+        headers = dict(response.headers)
+        if response.stream is None:
+            headers["content-length"] = str(len(response.body))
+        else:
+            headers["transfer-encoding"] = "chunked"
+        headers["connection"] = "keep-alive" if keep_alive else "close"
+        for k, v in headers.items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+        if response.stream is None:
+            writer.write(response.body)
+            await writer.drain()
+        else:
+            async for chunk in response.stream:
+                if chunk:
+                    writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                    await writer.drain()
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+
+    async def _handle_websocket(
+        self, request: Request, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        route, params, _ = self.app._find_route("GET", request.path)
+        if route is None or not route.websocket:
+            await self._write_response(writer, Response({"detail": "Not found"}, status=404), False)
+            return
+        key = request.headers.get("sec-websocket-key", "")
+        accept = _ws_accept_key(key)
+        writer.write(
+            (
+                "HTTP/1.1 101 Switching Protocols\r\n"
+                "Upgrade: websocket\r\n"
+                "Connection: Upgrade\r\n"
+                f"Sec-WebSocket-Accept: {accept}\r\n\r\n"
+            ).encode()
+        )
+        await writer.drain()
+        request.path_params = params
+        ws = WebSocket(reader, writer)
+        try:
+            await route.handler(request, ws, **params)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            await ws.close()
+
+
+class TestClient:
+    """In-process client: drives `App.handle` directly (no sockets needed)."""
+
+    def __init__(self, app: App, token: Optional[str] = None):
+        self.app = app
+        self.token = token
+
+    async def request(
+        self,
+        method: str,
+        path: str,
+        json_body: Any = None,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+        token: Optional[str] = None,
+    ) -> Response:
+        hdrs = {k.lower(): v for k, v in (headers or {}).items()}
+        tok = self.token if token is None else token  # explicit "" = unauthenticated
+        if tok and "authorization" not in hdrs:
+            hdrs["authorization"] = f"Bearer {tok}"
+        if json_body is not None:
+            body = json.dumps(json_body, default=_json_default).encode()
+            hdrs["content-type"] = "application/json"
+        path_only, _, qs = path.partition("?")
+        req = Request(
+            method=method.upper(),
+            path=path_only,
+            query=parse_qs(qs),
+            headers=hdrs,
+            body=body or b"",
+        )
+        return await self.app.handle(req)
+
+    async def post(self, path: str, json_body: Any = None, **kw) -> Response:
+        return await self.request("POST", path, json_body=json_body, **kw)
+
+    async def get(self, path: str, **kw) -> Response:
+        return await self.request("GET", path, **kw)
+
+
+def response_json(resp: Response) -> Any:
+    return json.loads(resp.body) if resp.body else None

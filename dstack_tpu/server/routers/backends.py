@@ -1,0 +1,59 @@
+"""/api/project/{project}/backends — parity: reference routers/backends.py."""
+
+from typing import Any, Dict, List
+
+from pydantic import BaseModel
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.users import ProjectRole
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, auth_user, get_ctx
+from dstack_tpu.server.services import backends as backends_service
+
+router = Router()
+
+
+class CreateBackendRequest(BaseModel):
+    type: BackendType
+    config: Dict[str, Any] = {}
+
+
+class DeleteBackendsRequest(BaseModel):
+    backends_names: List[str]
+
+
+@router.post("/api/backends/list_types")
+async def list_backend_types(request: Request):
+    await auth_user(request)
+    return [b.value for b in (BackendType.GCP, BackendType.SSH, BackendType.LOCAL)]
+
+
+@router.post("/api/project/{project_name}/backends/list")
+async def list_backends(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    pairs = await backends_service.list_project_backends(get_ctx(request), project_row["id"])
+    return [{"name": t.value, "config": {"type": t.value}} for t, _ in pairs]
+
+
+@router.post("/api/project/{project_name}/backends/create")
+async def create_backend(request: Request, project_name: str):
+    _, project_row = await auth_project_member(
+        request, project_name, require_role=ProjectRole.ADMIN
+    )
+    body = request.parse(CreateBackendRequest)
+    await backends_service.create_backend(
+        get_ctx(request), project_row["id"], body.type, body.config
+    )
+    return {}
+
+
+@router.post("/api/project/{project_name}/backends/delete")
+async def delete_backends(request: Request, project_name: str):
+    _, project_row = await auth_project_member(
+        request, project_name, require_role=ProjectRole.ADMIN
+    )
+    body = request.parse(DeleteBackendsRequest)
+    await backends_service.delete_backends(
+        get_ctx(request), project_row["id"], body.backends_names
+    )
+    return {}

@@ -1,0 +1,44 @@
+"""Router helpers: auth + project access extraction.
+
+Parity: the reference's FastAPI `Depends(Authenticated/ProjectMember)` chain
+(server/security/permissions.py), flattened to two awaitables.
+"""
+
+import sqlite3
+from typing import Optional, Tuple
+
+from dstack_tpu.errors import UnauthorizedError
+from dstack_tpu.models.users import ProjectRole, User
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.http import Request
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import users as users_service
+
+
+def get_ctx(request: Request) -> ServerContext:
+    return request.state["ctx"]
+
+
+async def auth_user(request: Request) -> User:
+    ctx = get_ctx(request)
+    token = request.bearer_token
+    if not token:
+        raise UnauthorizedError("Missing token")
+    user = await users_service.get_user_by_token(ctx, token)
+    if user is None:
+        raise UnauthorizedError("Invalid token")
+    request.state["user"] = user
+    return user
+
+
+async def auth_project_member(
+    request: Request,
+    project_name: str,
+    require_role: Optional[ProjectRole] = None,
+) -> Tuple[User, sqlite3.Row]:
+    user = await auth_user(request)
+    ctx = get_ctx(request)
+    project_row = await projects_service.check_access(
+        ctx, user, project_name, require_role=require_role
+    )
+    return user, project_row

@@ -1,0 +1,53 @@
+"""/api/project/{project}/fleets — parity: reference routers/fleets.py."""
+
+from typing import List
+
+from pydantic import BaseModel
+
+from dstack_tpu.models.fleets import FleetSpec
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.server.services import fleets as fleets_service
+
+router = Router()
+
+
+class ApplyFleetRequest(BaseModel):
+    spec: FleetSpec
+
+
+class GetFleetRequest(BaseModel):
+    name: str
+
+
+class DeleteFleetsRequest(BaseModel):
+    names: List[str]
+
+
+@router.post("/api/project/{project_name}/fleets/apply")
+async def apply_fleet(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(ApplyFleetRequest)
+    return await fleets_service.create_fleet(get_ctx(request), project_row["id"], body.spec)
+
+
+@router.post("/api/project/{project_name}/fleets/list")
+async def list_fleets(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    fleets = await fleets_service.list_fleets(get_ctx(request), project_row["id"])
+    return [f.model_dump() for f in fleets]
+
+
+@router.post("/api/project/{project_name}/fleets/get")
+async def get_fleet(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(GetFleetRequest)
+    return await fleets_service.get_fleet(get_ctx(request), project_row["id"], body.name)
+
+
+@router.post("/api/project/{project_name}/fleets/delete")
+async def delete_fleets(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(DeleteFleetsRequest)
+    await fleets_service.delete_fleets(get_ctx(request), project_row["id"], body.names)
+    return {}

@@ -1,0 +1,140 @@
+"""/api/project/{project}/gateways — parity: reference routers/gateways.py."""
+
+from typing import List, Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.errors import ResourceExistsError, ResourceNotExistsError
+from dstack_tpu.models.gateways import Gateway, GatewayConfiguration, GatewayStatus
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.utils.common import parse_dt, utcnow_iso
+
+router = Router()
+
+
+class CreateGatewayRequest(BaseModel):
+    configuration: GatewayConfiguration
+
+
+class GatewayNameRequest(BaseModel):
+    name: str
+
+
+class DeleteGatewaysRequest(BaseModel):
+    names: List[str]
+
+
+async def _row_to_gateway(ctx, row) -> Gateway:
+    ip = None
+    hostname = None
+    if row["gateway_compute_id"]:
+        compute_row = await ctx.db.fetchone(
+            "SELECT * FROM gateway_computes WHERE id = ?", (row["gateway_compute_id"],)
+        )
+        if compute_row is not None:
+            ip = compute_row["ip_address"]
+            hostname = compute_row["hostname"]
+    return Gateway(
+        id=row["id"],
+        name=row["name"],
+        project_name="",
+        configuration=GatewayConfiguration.model_validate_json(row["configuration"]),
+        created_at=parse_dt(row["created_at"]),
+        status=GatewayStatus(row["status"]),
+        status_message=row["status_message"],
+        ip_address=ip,
+        hostname=hostname,
+        default=bool(row["is_default"]),
+    )
+
+
+@router.post("/api/project/{project_name}/gateways/create")
+async def create_gateway(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    body = request.parse(CreateGatewayRequest)
+    name = body.configuration.name or f"gateway-{generate_id()[:8]}"
+    body.configuration.name = name
+    existing = await ctx.db.fetchone(
+        "SELECT id FROM gateways WHERE project_id = ? AND name = ?",
+        (project_row["id"], name),
+    )
+    if existing is not None:
+        raise ResourceExistsError(f"Gateway {name} already exists")
+    now = utcnow_iso()
+    await ctx.db.execute(
+        "INSERT INTO gateways (id, project_id, name, status, configuration,"
+        " created_at, last_processed_at, is_default) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            generate_id(), project_row["id"], name, GatewayStatus.SUBMITTED.value,
+            body.configuration.model_dump_json(), now, now,
+            1 if body.configuration.default else 0,
+        ),
+    )
+    ctx.kick("gateways")
+    row = await ctx.db.fetchone(
+        "SELECT * FROM gateways WHERE project_id = ? AND name = ?",
+        (project_row["id"], name),
+    )
+    return await _row_to_gateway(ctx, row)
+
+
+@router.post("/api/project/{project_name}/gateways/list")
+async def list_gateways(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM gateways WHERE project_id = ? ORDER BY name", (project_row["id"],)
+    )
+    return [(await _row_to_gateway(ctx, r)).model_dump() for r in rows]
+
+
+@router.post("/api/project/{project_name}/gateways/get")
+async def get_gateway(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    body = request.parse(GatewayNameRequest)
+    row = await ctx.db.fetchone(
+        "SELECT * FROM gateways WHERE project_id = ? AND name = ?",
+        (project_row["id"], body.name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"Gateway {body.name} does not exist")
+    return await _row_to_gateway(ctx, row)
+
+
+@router.post("/api/project/{project_name}/gateways/delete")
+async def delete_gateways(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    body = request.parse(DeleteGatewaysRequest)
+    for name in body.names:
+        row = await ctx.db.fetchone(
+            "SELECT * FROM gateways WHERE project_id = ? AND name = ?",
+            (project_row["id"], name),
+        )
+        if row is None:
+            continue
+        if row["gateway_compute_id"]:
+            compute_row = await ctx.db.fetchone(
+                "SELECT * FROM gateway_computes WHERE id = ?", (row["gateway_compute_id"],)
+            )
+            if compute_row is not None and compute_row["provisioning_data"]:
+                from dstack_tpu.models.gateways import GatewayProvisioningData
+                from dstack_tpu.server.services import backends as backends_service
+
+                pd = GatewayProvisioningData.model_validate_json(
+                    compute_row["provisioning_data"]
+                )
+                conf = GatewayConfiguration.model_validate_json(row["configuration"])
+                try:
+                    compute = await backends_service.get_project_backend(
+                        ctx, project_row["id"], conf.backend
+                    )
+                    await compute.terminate_gateway(pd.instance_id, pd.region)
+                except Exception:
+                    pass
+        await ctx.db.execute("DELETE FROM gateways WHERE id = ?", (row["id"],))
+    return {}

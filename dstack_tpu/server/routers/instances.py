@@ -1,0 +1,42 @@
+"""/api/project/{project}/instances + pools view — parity: reference
+routers/pools.py + instances listing."""
+
+from typing import List, Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.server.services import fleets as fleets_service
+
+router = Router()
+
+
+class ListInstancesRequest(BaseModel):
+    fleet_name: Optional[str] = None
+
+
+@router.post("/api/project/{project_name}/instances/list")
+async def list_instances(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    body = request.parse(ListInstancesRequest) if request.body else ListInstancesRequest()
+    sql = "SELECT * FROM instances WHERE project_id = ? AND deleted = 0"
+    params: list = [project_row["id"]]
+    if body.fleet_name:
+        fleet_row = await ctx.db.fetchone(
+            "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+            (project_row["id"], body.fleet_name),
+        )
+        if fleet_row is None:
+            return []
+        sql += " AND fleet_id = ?"
+        params.append(fleet_row["id"])
+    sql += " ORDER BY name"
+    rows = await ctx.db.fetchall(sql, params)
+    out = []
+    for r in rows:
+        inst = await fleets_service.instance_row_to_instance(r)
+        inst.project_name = project_name
+        out.append(inst.model_dump())
+    return out

@@ -1,0 +1,42 @@
+"""/api/project/{project}/logs — parity: reference routers/logs.py
+(poll_logs against the pluggable LogStorage)."""
+
+from typing import Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.errors import ResourceNotExistsError
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+
+router = Router()
+
+
+class PollLogsRequest(BaseModel):
+    run_name: str
+    job_submission_id: str
+    start_after: Optional[str] = None
+    limit: int = 1000
+    diagnose: bool = False
+
+
+@router.post("/api/project/{project_name}/logs/poll")
+async def poll_logs(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    body = request.parse(PollLogsRequest)
+    job_row = await ctx.db.fetchone(
+        "SELECT id FROM jobs WHERE id = ? AND project_id = ?",
+        (body.job_submission_id, project_row["id"]),
+    )
+    if job_row is None:
+        raise ResourceNotExistsError("Job submission does not exist")
+    logs = await ctx.log_storage.poll(
+        project_id=project_row["id"],
+        run_name=body.run_name,
+        job_submission_id=body.job_submission_id,
+        start_after=body.start_after,
+        limit=body.limit,
+        diagnose=body.diagnose,
+    )
+    return logs

@@ -1,0 +1,47 @@
+"""/api/project/{project}/metrics — parity: reference routers/metrics.py +
+services/metrics.py window aggregation, chips-first."""
+
+import json
+from typing import Optional
+
+from dstack_tpu.errors import ResourceNotExistsError
+from dstack_tpu.models.metrics import JobMetrics, MetricsPoint, TpuChipMetrics
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.utils.common import parse_dt
+
+router = Router()
+
+
+@router.get("/api/project/{project_name}/metrics/job/{run_name}")
+async def get_job_metrics(request: Request, project_name: str, run_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    replica_num = int(request.query_param("replica_num", "0"))
+    job_num = int(request.query_param("job_num", "0"))
+    limit = int(request.query_param("limit", "60"))
+    job_row = await ctx.db.fetchone(
+        "SELECT j.id FROM jobs j JOIN runs r ON r.id = j.run_id"
+        " WHERE r.project_id = ? AND r.run_name = ? AND r.deleted = 0"
+        " AND j.replica_num = ? AND j.job_num = ? ORDER BY j.submission_num DESC LIMIT 1",
+        (project_row["id"], run_name, replica_num, job_num),
+    )
+    if job_row is None:
+        raise ResourceNotExistsError("Job not found")
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM job_metrics_points WHERE job_id = ? ORDER BY timestamp DESC LIMIT ?",
+        (job_row["id"], limit),
+    )
+    points = [
+        MetricsPoint(
+            timestamp=parse_dt(r["timestamp"]),
+            cpu_usage_micro=r["cpu_usage_micro"],
+            memory_usage_bytes=r["memory_usage_bytes"],
+            memory_working_set_bytes=r["memory_working_set_bytes"],
+            tpu_chips=[
+                TpuChipMetrics.model_validate(c) for c in json.loads(r["tpu_metrics"] or "[]")
+            ],
+        )
+        for r in rows
+    ]
+    return JobMetrics(points=points)

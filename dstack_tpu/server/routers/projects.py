@@ -1,0 +1,66 @@
+"""/api/projects — parity: reference routers/projects.py."""
+
+from typing import List
+
+from pydantic import BaseModel
+
+from dstack_tpu.models.users import ProjectRole
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, auth_user, get_ctx
+from dstack_tpu.server.services import projects as projects_service
+
+router = Router(prefix="/api/projects")
+
+
+class CreateProjectRequest(BaseModel):
+    project_name: str
+
+
+class DeleteProjectsRequest(BaseModel):
+    projects_names: List[str]
+
+
+class MemberSetting(BaseModel):
+    username: str
+    project_role: ProjectRole
+
+
+class SetMembersRequest(BaseModel):
+    members: List[MemberSetting]
+
+
+@router.post("/list")
+async def list_projects(request: Request):
+    user = await auth_user(request)
+    return [p.model_dump() for p in await projects_service.list_projects(get_ctx(request), user)]
+
+
+@router.post("/create")
+async def create_project(request: Request):
+    user = await auth_user(request)
+    body = request.parse(CreateProjectRequest)
+    return await projects_service.create_project(get_ctx(request), user, body.project_name)
+
+
+@router.post("/delete")
+async def delete_projects(request: Request):
+    user = await auth_user(request)
+    body = request.parse(DeleteProjectsRequest)
+    await projects_service.delete_projects(get_ctx(request), user, body.projects_names)
+    return {}
+
+
+@router.post("/{project_name}/get")
+async def get_project(request: Request, project_name: str):
+    await auth_project_member(request, project_name)
+    return await projects_service.get_project(get_ctx(request), project_name)
+
+
+@router.post("/{project_name}/set_members")
+async def set_members(request: Request, project_name: str):
+    await auth_project_member(request, project_name, require_role=ProjectRole.MANAGER)
+    body = request.parse(SetMembersRequest)
+    await projects_service.set_members(
+        get_ctx(request), project_name, [m.model_dump() for m in body.members]
+    )
+    return await projects_service.get_project(get_ctx(request), project_name)

@@ -1,0 +1,83 @@
+"""/api/project/{project}/repos — parity: reference routers/repos.py
+(init repo, upload code blob keyed by hash)."""
+
+import hashlib
+from typing import Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.errors import ResourceNotExistsError
+from dstack_tpu.models.repos import AnyRunRepoData
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.server.security import generate_id
+
+router = Router()
+
+
+class InitRepoRequest(BaseModel):
+    repo_id: str
+    repo_info: AnyRunRepoData
+
+
+class GetRepoRequest(BaseModel):
+    repo_id: str
+
+
+@router.post("/api/project/{project_name}/repos/init")
+async def init_repo(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    body = request.parse(InitRepoRequest)
+    await ctx.db.execute(
+        "INSERT INTO repos (id, project_id, name, type, info) VALUES (?, ?, ?, ?, ?)"
+        " ON CONFLICT (project_id, name) DO UPDATE SET info = excluded.info,"
+        " type = excluded.type",
+        (
+            generate_id(),
+            project_row["id"],
+            body.repo_id,
+            body.repo_info.repo_type,
+            body.repo_info.model_dump_json(),
+        ),
+    )
+    return {}
+
+
+@router.post("/api/project/{project_name}/repos/get")
+async def get_repo(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(GetRepoRequest)
+    row = await get_ctx(request).db.fetchone(
+        "SELECT * FROM repos WHERE project_id = ? AND name = ?",
+        (project_row["id"], body.repo_id),
+    )
+    if row is None:
+        raise ResourceNotExistsError("Repo does not exist")
+    import json
+
+    return {"repo_id": row["name"], "repo_info": json.loads(row["info"])}
+
+
+@router.post("/api/project/{project_name}/repos/upload_code")
+async def upload_code(request: Request, project_name: str):
+    """Raw blob body; repo_id passed as a query param. Returns the hash."""
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    repo_id = request.query_param("repo_id")
+    if not repo_id:
+        raise ResourceNotExistsError("repo_id query param is required")
+    repo_row = await ctx.db.fetchone(
+        "SELECT * FROM repos WHERE project_id = ? AND name = ?",
+        (project_row["id"], repo_id),
+    )
+    if repo_row is None:
+        raise ResourceNotExistsError("Repo does not exist; call /repos/init first")
+    blob = request.body
+    blob_hash = hashlib.sha256(blob).hexdigest()
+    await ctx.db.execute(
+        "INSERT INTO codes (id, repo_id, blob_hash, blob) VALUES (?, ?, ?, ?)"
+        " ON CONFLICT (repo_id, blob_hash) DO NOTHING",
+        (generate_id(), repo_row["id"], blob_hash, blob),
+    )
+    return {"blob_hash": blob_hash}

@@ -1,0 +1,108 @@
+"""/api/project/{project}/runs + /api/runs — parity: reference routers/runs.py."""
+
+from typing import List, Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.models.runs import ApplyRunPlanInput, RunSpec
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, auth_user, get_ctx
+from dstack_tpu.server.services import runs as runs_service
+
+router = Router()
+
+
+class GetPlanRequest(BaseModel):
+    run_spec: RunSpec
+
+
+class SubmitRequest(BaseModel):
+    run_spec: RunSpec
+
+
+class GetRunRequest(BaseModel):
+    run_name: str
+
+
+class StopRunsRequest(BaseModel):
+    runs_names: List[str]
+    abort: bool = False
+
+
+class DeleteRunsRequest(BaseModel):
+    runs_names: List[str]
+
+
+class ListRunsRequest(BaseModel):
+    project_name: Optional[str] = None
+    only_active: bool = False
+    limit: int = 100
+
+
+@router.post("/api/runs/list")
+async def list_all_runs(request: Request):
+    user = await auth_user(request)
+    ctx = get_ctx(request)
+    body = request.parse(ListRunsRequest)
+    project_id = None
+    if body.project_name:
+        _, project_row = await auth_project_member(request, body.project_name)
+        project_id = project_row["id"]
+    runs = await runs_service.list_runs(
+        ctx, project_id=project_id, only_active=body.only_active, limit=body.limit
+    )
+    return [r.model_dump() for r in runs]
+
+
+@router.post("/api/project/{project_name}/runs/get_plan")
+async def get_plan(request: Request, project_name: str):
+    user, project_row = await auth_project_member(request, project_name)
+    body = request.parse(GetPlanRequest)
+    plan = await runs_service.get_plan(get_ctx(request), project_row, user, body.run_spec)
+    return plan
+
+
+@router.post("/api/project/{project_name}/runs/apply")
+async def apply_plan(request: Request, project_name: str):
+    user, project_row = await auth_project_member(request, project_name)
+    body = request.parse(ApplyRunPlanInput)
+    return await runs_service.submit_run(get_ctx(request), user, project_row, body.run_spec)
+
+
+@router.post("/api/project/{project_name}/runs/submit")
+async def submit_run(request: Request, project_name: str):
+    user, project_row = await auth_project_member(request, project_name)
+    body = request.parse(SubmitRequest)
+    return await runs_service.submit_run(get_ctx(request), user, project_row, body.run_spec)
+
+
+@router.post("/api/project/{project_name}/runs/get")
+async def get_run(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(GetRunRequest)
+    return await runs_service.get_run(get_ctx(request), project_row["id"], body.run_name)
+
+
+@router.post("/api/project/{project_name}/runs/list")
+async def list_runs(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    runs = await runs_service.list_runs(get_ctx(request), project_id=project_row["id"])
+    return [r.model_dump() for r in runs]
+
+
+@router.post("/api/project/{project_name}/runs/stop")
+async def stop_runs(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(StopRunsRequest)
+    await runs_service.stop_runs(
+        get_ctx(request), project_row["id"], body.runs_names, abort=body.abort
+    )
+    return {}
+
+
+@router.post("/api/project/{project_name}/runs/delete")
+async def delete_runs(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(DeleteRunsRequest)
+    await runs_service.delete_runs(get_ctx(request), project_row["id"], body.runs_names)
+    return {}

@@ -1,0 +1,82 @@
+"""/api/project/{project}/secrets — parity: reference secrets handling
+(values stored encrypted, never returned in listings)."""
+
+from typing import List, Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.errors import ResourceNotExistsError
+from dstack_tpu.models.secrets import Secret
+from dstack_tpu.models.users import ProjectRole
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.server.security import generate_id
+
+router = Router()
+
+
+class CreateSecretRequest(BaseModel):
+    name: str
+    value: str
+
+
+class SecretNameRequest(BaseModel):
+    name: str
+
+
+class DeleteSecretsRequest(BaseModel):
+    secrets_names: List[str]
+
+
+@router.post("/api/project/{project_name}/secrets/list")
+async def list_secrets(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    rows = await get_ctx(request).db.fetchall(
+        "SELECT name FROM secrets WHERE project_id = ? ORDER BY name", (project_row["id"],)
+    )
+    return [Secret(name=r["name"]).model_dump(exclude={"value"}) for r in rows]
+
+
+@router.post("/api/project/{project_name}/secrets/create_or_update")
+async def create_secret(request: Request, project_name: str):
+    _, project_row = await auth_project_member(
+        request, project_name, require_role=ProjectRole.MANAGER
+    )
+    ctx = get_ctx(request)
+    body = request.parse(CreateSecretRequest)
+    await ctx.db.execute(
+        "INSERT INTO secrets (id, project_id, name, value) VALUES (?, ?, ?, ?)"
+        " ON CONFLICT (project_id, name) DO UPDATE SET value = excluded.value",
+        (generate_id(), project_row["id"], body.name, ctx.encryption.encrypt(body.value)),
+    )
+    return Secret(name=body.name).model_dump(exclude={"value"})
+
+
+@router.post("/api/project/{project_name}/secrets/get")
+async def get_secret(request: Request, project_name: str):
+    _, project_row = await auth_project_member(
+        request, project_name, require_role=ProjectRole.MANAGER
+    )
+    ctx = get_ctx(request)
+    body = request.parse(SecretNameRequest)
+    row = await ctx.db.fetchone(
+        "SELECT * FROM secrets WHERE project_id = ? AND name = ?",
+        (project_row["id"], body.name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"Secret {body.name} does not exist")
+    return Secret(name=row["name"], value=ctx.encryption.decrypt(row["value"])).model_dump()
+
+
+@router.post("/api/project/{project_name}/secrets/delete")
+async def delete_secrets(request: Request, project_name: str):
+    _, project_row = await auth_project_member(
+        request, project_name, require_role=ProjectRole.MANAGER
+    )
+    body = request.parse(DeleteSecretsRequest)
+    qs = ",".join("?" for _ in body.secrets_names)
+    await get_ctx(request).db.execute(
+        f"DELETE FROM secrets WHERE project_id = ? AND name IN ({qs})",
+        [project_row["id"], *body.secrets_names],
+    )
+    return {}

@@ -1,0 +1,16 @@
+"""/api/server — version/info endpoint (parity: reference /api/server/get_info)."""
+
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.version import __version__
+
+router = Router()
+
+
+@router.post("/api/server/get_info")
+async def get_info(request: Request):
+    return {"server_version": __version__}
+
+
+@router.get("/api/server/healthcheck")
+async def healthcheck(request: Request):
+    return {"status": "ok", "version": __version__}

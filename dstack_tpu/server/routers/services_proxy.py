@@ -1,0 +1,92 @@
+"""In-server service proxy: /proxy/services/{project}/{run}/...
+
+Parity: src/dstack/_internal/server/services/proxy/services/service_proxy.py
+(the no-gateway fallback path, app.py:184-185). Requests are forwarded to a
+RUNNING replica's app port; replicas are selected round-robin.
+"""
+
+import itertools
+import logging
+import re
+
+import httpx
+
+from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.models.runs import JobProvisioningData, JobSpec
+from dstack_tpu.server.http import Request, Response, Route, Router
+from dstack_tpu.server.routers.deps import get_ctx
+
+logger = logging.getLogger(__name__)
+
+router = Router()
+_rr = itertools.count()
+
+_HOP_HEADERS = {
+    "connection", "keep-alive", "transfer-encoding", "upgrade", "host",
+    "content-length", "proxy-authorization", "te", "trailer",
+}
+
+
+async def _pick_replica(ctx, project_name: str, run_name: str):
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project_row is None:
+        raise ResourceNotExistsError("Project not found")
+    run_row = await ctx.db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise ResourceNotExistsError("Run not found")
+    if run_row["service_spec"] is None:
+        raise BadRequestError("Run is not a service")
+    job_rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND status = 'running' ORDER BY replica_num",
+        (run_row["id"],),
+    )
+    job_rows = [j for j in job_rows if j["job_provisioning_data"]]
+    if not job_rows:
+        raise BadRequestError("No running replicas")
+    row = job_rows[next(_rr) % len(job_rows)]
+    spec = JobSpec.model_validate_json(row["job_spec"])
+    jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+    port = spec.app_specs[0].port if spec.app_specs else 80
+    return jpd, port
+
+
+async def proxy_service(request: Request, project_name: str, run_name: str, rest: str):
+    ctx = get_ctx(request)
+    jpd, port = await _pick_replica(ctx, project_name, run_name)
+    # Host-network containers expose the app port on the instance address;
+    # local backend runs directly on the server host.
+    target = f"http://{jpd.hostname}:{port}/{rest}"
+    headers = {k: v for k, v in request.headers.items() if k not in _HOP_HEADERS}
+    try:
+        async with httpx.AsyncClient(timeout=60.0) as client:
+            upstream = await client.request(
+                request.method, target, content=request.body or None, headers=headers,
+                params=request.query,
+            )
+    except httpx.HTTPError as e:
+        return Response({"detail": f"Service unreachable: {e}"}, status=502)
+    resp_headers = {
+        k: v for k, v in upstream.headers.items()
+        if k.lower() not in _HOP_HEADERS
+    }
+    return Response(upstream.content, status=upstream.status_code, headers=resp_headers)
+
+
+# Catch-all routes (the generic {param} matcher stops at "/", so these are
+# registered with hand-built regexes).
+for method in ("GET", "POST", "PUT", "PATCH", "DELETE", "HEAD"):
+    router.routes.append(
+        Route(
+            method=method,
+            pattern="/proxy/services/{project_name}/{run_name}/{rest}",
+            regex=re.compile(
+                r"^/proxy/services/(?P<project_name>[^/]+)/(?P<run_name>[^/]+)/(?P<rest>.*)$"
+            ),
+            handler=proxy_service,
+        )
+    )

@@ -1,0 +1,75 @@
+"""/api/users — parity: src/dstack/_internal/server/app.py router registration
++ routers/users.py."""
+
+from typing import List, Optional
+
+from pydantic import BaseModel
+
+from dstack_tpu.errors import ForbiddenError
+from dstack_tpu.models.users import GlobalRole, User
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_user, get_ctx
+from dstack_tpu.server.services import users as users_service
+
+router = Router(prefix="/api/users")
+
+
+class CreateUserRequest(BaseModel):
+    username: str
+    global_role: GlobalRole = GlobalRole.USER
+    email: Optional[str] = None
+
+
+class UsernamesRequest(BaseModel):
+    users: List[str]
+
+
+class GetUserRequest(BaseModel):
+    username: str
+
+
+@router.post("/list")
+async def list_users(request: Request):
+    await auth_user(request)
+    return [u.model_dump() for u in await users_service.list_users(get_ctx(request))]
+
+
+@router.post("/get_my_user")
+async def get_my_user(request: Request):
+    user = await auth_user(request)
+    return user
+
+
+@router.post("/get_user")
+async def get_user(request: Request):
+    user = await auth_user(request)
+    body = request.parse(GetUserRequest)
+    return await users_service.get_user_with_creds(get_ctx(request), user, body.username)
+
+
+@router.post("/create")
+async def create_user(request: Request):
+    user = await auth_user(request)
+    if user.global_role != GlobalRole.ADMIN:
+        raise ForbiddenError()
+    body = request.parse(CreateUserRequest)
+    return await users_service.create_user(
+        get_ctx(request), body.username, body.global_role, body.email
+    )
+
+
+@router.post("/refresh_token")
+async def refresh_token(request: Request):
+    user = await auth_user(request)
+    body = request.parse(GetUserRequest)
+    return await users_service.refresh_token(get_ctx(request), user, body.username)
+
+
+@router.post("/delete")
+async def delete_users(request: Request):
+    user = await auth_user(request)
+    if user.global_role != GlobalRole.ADMIN:
+        raise ForbiddenError()
+    body = request.parse(UsernamesRequest)
+    await users_service.delete_users(get_ctx(request), body.users)
+    return {}

@@ -1,0 +1,55 @@
+"""/api/project/{project}/volumes — parity: reference routers/volumes.py."""
+
+from typing import List
+
+from pydantic import BaseModel
+
+from dstack_tpu.models.volumes import VolumeConfiguration
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
+from dstack_tpu.server.services import volumes as volumes_service
+
+router = Router()
+
+
+class CreateVolumeRequest(BaseModel):
+    configuration: VolumeConfiguration
+
+
+class GetVolumeRequest(BaseModel):
+    name: str
+
+
+class DeleteVolumesRequest(BaseModel):
+    names: List[str]
+
+
+@router.post("/api/project/{project_name}/volumes/create")
+async def create_volume(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(CreateVolumeRequest)
+    return await volumes_service.create_volume(
+        get_ctx(request), project_row["id"], body.configuration
+    )
+
+
+@router.post("/api/project/{project_name}/volumes/list")
+async def list_volumes(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    volumes = await volumes_service.list_volumes(get_ctx(request), project_row["id"])
+    return [v.model_dump() for v in volumes]
+
+
+@router.post("/api/project/{project_name}/volumes/get")
+async def get_volume(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(GetVolumeRequest)
+    return await volumes_service.get_volume(get_ctx(request), project_row["id"], body.name)
+
+
+@router.post("/api/project/{project_name}/volumes/delete")
+async def delete_volumes(request: Request, project_name: str):
+    _, project_row = await auth_project_member(request, project_name)
+    body = request.parse(DeleteVolumesRequest)
+    await volumes_service.delete_volumes(get_ctx(request), project_row["id"], body.names)
+    return {}

@@ -1,0 +1,260 @@
+"""Database schema (migration 1) — the control plane's tables.
+
+Parity: the 17 SQLAlchemy tables in src/dstack/_internal/server/models.py
+(users:*, projects, members, backends, repos, codes, runs:286, jobs:330,
+instances:476, fleets:449, volumes, gateways, gateway_computes,
+placement_groups, job_metrics_points, secrets) re-done as sqlite DDL with
+JSON document columns for specs. TPU-first addition: instances carry
+`tpu_node` (the cloud TPU pod-slice object a host belongs to) and
+`tpu_worker_index` for gang addressing.
+"""
+
+from dstack_tpu.server.db import migration
+
+migration(
+    """
+CREATE TABLE users (
+    id TEXT PRIMARY KEY,
+    username TEXT NOT NULL UNIQUE,
+    global_role TEXT NOT NULL,
+    email TEXT,
+    token TEXT NOT NULL UNIQUE,
+    active INTEGER NOT NULL DEFAULT 1,
+    created_at TEXT NOT NULL
+);
+
+CREATE TABLE projects (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL UNIQUE,
+    owner_id TEXT NOT NULL REFERENCES users(id),
+    ssh_private_key TEXT NOT NULL DEFAULT '',
+    ssh_public_key TEXT NOT NULL DEFAULT '',
+    created_at TEXT NOT NULL,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE members (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    user_id TEXT NOT NULL REFERENCES users(id),
+    project_role TEXT NOT NULL,
+    UNIQUE (project_id, user_id)
+);
+
+CREATE TABLE backends (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    type TEXT NOT NULL,
+    config TEXT NOT NULL DEFAULT '{}',
+    auth TEXT NOT NULL DEFAULT '{}',
+    UNIQUE (project_id, type)
+);
+
+CREATE TABLE repos (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    type TEXT NOT NULL,
+    info TEXT NOT NULL DEFAULT '{}',
+    creds TEXT,
+    UNIQUE (project_id, name)
+);
+
+CREATE TABLE codes (
+    id TEXT PRIMARY KEY,
+    repo_id TEXT NOT NULL REFERENCES repos(id),
+    blob_hash TEXT NOT NULL,
+    blob BLOB,
+    UNIQUE (repo_id, blob_hash)
+);
+
+CREATE TABLE secrets (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    value TEXT NOT NULL,
+    UNIQUE (project_id, name)
+);
+
+CREATE TABLE fleets (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    status TEXT NOT NULL,
+    status_message TEXT,
+    spec TEXT NOT NULL,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT NOT NULL,
+    auto_cleanup INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+CREATE INDEX ix_fleets_project ON fleets(project_id, deleted);
+
+CREATE TABLE instances (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    fleet_id TEXT REFERENCES fleets(id),
+    name TEXT NOT NULL,
+    instance_num INTEGER NOT NULL DEFAULT 0,
+    status TEXT NOT NULL,
+    unreachable INTEGER NOT NULL DEFAULT 0,
+    termination_reason TEXT,
+    termination_deadline TEXT,
+    health_status TEXT,
+    created_at TEXT NOT NULL,
+    started_at TEXT,
+    finished_at TEXT,
+    last_processed_at TEXT NOT NULL,
+    backend TEXT,
+    region TEXT,
+    availability_zone TEXT,
+    price REAL,
+    instance_configuration TEXT,
+    requirements TEXT,
+    profile TEXT,
+    offer TEXT,
+    job_provisioning_data TEXT,
+    remote_connection_info TEXT,
+    tpu_node TEXT,
+    tpu_worker_index INTEGER NOT NULL DEFAULT 0,
+    total_blocks INTEGER NOT NULL DEFAULT 1,
+    busy_blocks INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+CREATE INDEX ix_instances_project ON instances(project_id, deleted);
+CREATE INDEX ix_instances_status ON instances(status);
+
+CREATE TABLE runs (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    user_id TEXT NOT NULL REFERENCES users(id),
+    repo_id TEXT REFERENCES repos(id),
+    fleet_id TEXT REFERENCES fleets(id),
+    run_name TEXT NOT NULL,
+    submitted_at TEXT NOT NULL,
+    last_processed_at TEXT NOT NULL,
+    status TEXT NOT NULL,
+    termination_reason TEXT,
+    run_spec TEXT NOT NULL,
+    service_spec TEXT,
+    desired_replica_count INTEGER NOT NULL DEFAULT 1,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+CREATE UNIQUE INDEX ix_runs_project_name_active
+    ON runs(project_id, run_name) WHERE deleted = 0;
+CREATE INDEX ix_runs_status ON runs(status);
+
+CREATE TABLE jobs (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    run_id TEXT NOT NULL REFERENCES runs(id),
+    run_name TEXT NOT NULL,
+    job_num INTEGER NOT NULL,
+    replica_num INTEGER NOT NULL DEFAULT 0,
+    submission_num INTEGER NOT NULL DEFAULT 0,
+    submitted_at TEXT NOT NULL,
+    last_processed_at TEXT NOT NULL,
+    finished_at TEXT,
+    status TEXT NOT NULL,
+    termination_reason TEXT,
+    termination_reason_message TEXT,
+    exit_status INTEGER,
+    job_spec TEXT NOT NULL,
+    job_provisioning_data TEXT,
+    job_runtime_data TEXT,
+    instance_id TEXT REFERENCES instances(id),
+    used_instance_ids TEXT,
+    instance_assigned INTEGER NOT NULL DEFAULT 0,
+    runner_timestamp INTEGER NOT NULL DEFAULT 0,
+    shim_task_submitted INTEGER NOT NULL DEFAULT 0,
+    disconnected_at TEXT
+);
+CREATE INDEX ix_jobs_run ON jobs(run_id);
+CREATE INDEX ix_jobs_status ON jobs(status);
+
+CREATE TABLE volumes (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    status TEXT NOT NULL,
+    status_message TEXT,
+    configuration TEXT NOT NULL,
+    external INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT NOT NULL,
+    provisioning_data TEXT,
+    attachment_data TEXT,
+    volume_id TEXT,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+CREATE UNIQUE INDEX ix_volumes_project_name_active
+    ON volumes(project_id, name) WHERE deleted = 0;
+
+CREATE TABLE volume_attachments (
+    id TEXT PRIMARY KEY,
+    volume_id TEXT NOT NULL REFERENCES volumes(id),
+    instance_id TEXT NOT NULL REFERENCES instances(id),
+    UNIQUE (volume_id, instance_id)
+);
+
+CREATE TABLE gateways (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    status TEXT NOT NULL,
+    status_message TEXT,
+    configuration TEXT NOT NULL,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT NOT NULL,
+    gateway_compute_id TEXT,
+    is_default INTEGER NOT NULL DEFAULT 0,
+    UNIQUE (project_id, name)
+);
+
+CREATE TABLE gateway_computes (
+    id TEXT PRIMARY KEY,
+    instance_id TEXT,
+    ip_address TEXT,
+    hostname TEXT,
+    region TEXT,
+    backend TEXT,
+    ssh_private_key TEXT NOT NULL DEFAULT '',
+    ssh_public_key TEXT NOT NULL DEFAULT '',
+    provisioning_data TEXT,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE placement_groups (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    fleet_id TEXT REFERENCES fleets(id),
+    name TEXT NOT NULL,
+    configuration TEXT NOT NULL DEFAULT '{}',
+    provisioning_data TEXT,
+    fleet_deleted INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE job_metrics_points (
+    id TEXT PRIMARY KEY,
+    job_id TEXT NOT NULL REFERENCES jobs(id),
+    timestamp TEXT NOT NULL,
+    cpu_usage_micro INTEGER NOT NULL DEFAULT 0,
+    memory_usage_bytes INTEGER NOT NULL DEFAULT 0,
+    memory_working_set_bytes INTEGER NOT NULL DEFAULT 0,
+    tpu_metrics TEXT
+);
+CREATE INDEX ix_metrics_job ON job_metrics_points(job_id, timestamp);
+
+CREATE TABLE logs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    project_id TEXT NOT NULL,
+    run_name TEXT NOT NULL,
+    job_submission_id TEXT NOT NULL,
+    timestamp TEXT NOT NULL,
+    log_source TEXT NOT NULL,
+    message BLOB NOT NULL
+);
+CREATE INDEX ix_logs_submission ON logs(job_submission_id, id);
+"""
+)

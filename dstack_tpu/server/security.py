@@ -1,0 +1,49 @@
+"""Tokens + secret encryption.
+
+Parity: src/dstack/_internal/server/services/encryption/ (pluggable
+EncryptionKey: AES / identity) and user token auth.
+"""
+
+import base64
+import os
+import uuid
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+def generate_token() -> str:
+    return uuid.uuid4().hex + uuid.uuid4().hex[:8]
+
+
+def generate_id() -> str:
+    return str(uuid.uuid4())
+
+
+class Encryption:
+    """AES-GCM when a key is configured; identity otherwise."""
+
+    PREFIX = "enc:aes:"
+
+    def __init__(self, key_b64: Optional[str] = None):
+        self._key = base64.b64decode(key_b64) if key_b64 else None
+
+    @staticmethod
+    def generate_key_b64() -> str:
+        return base64.b64encode(AESGCM.generate_key(bit_length=256)).decode()
+
+    def encrypt(self, plaintext: str) -> str:
+        if self._key is None:
+            return plaintext
+        nonce = os.urandom(12)
+        ct = AESGCM(self._key).encrypt(nonce, plaintext.encode(), b"")
+        return self.PREFIX + base64.b64encode(nonce + ct).decode()
+
+    def decrypt(self, stored: str) -> str:
+        if not stored.startswith(self.PREFIX):
+            return stored
+        if self._key is None:
+            raise ValueError("Encrypted value present but no encryption key configured")
+        raw = base64.b64decode(stored[len(self.PREFIX):])
+        nonce, ct = raw[:12], raw[12:]
+        return AESGCM(self._key).decrypt(nonce, ct, b"").decode()

@@ -1,0 +1,105 @@
+"""Backend registry: per-project configured backends -> Compute instances.
+
+Parity: src/dstack/_internal/server/services/backends/ (configurators +
+cached Backend objects). The `local` backend is implicitly available to all
+projects unless disabled (DSTACK_TPU_LOCAL_BACKEND=0).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from dstack_tpu.backends.base.compute import Compute
+from dstack_tpu.backends.local.compute import LocalBackendConfig, LocalCompute
+from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+
+
+def local_backend_enabled() -> bool:
+    return os.getenv("DSTACK_TPU_LOCAL_BACKEND", "1") != "0"
+
+
+def _make_compute(backend_type: BackendType, config: Dict[str, Any]) -> Compute:
+    if backend_type == BackendType.LOCAL:
+        return LocalCompute(LocalBackendConfig.model_validate(config))
+    if backend_type == BackendType.GCP:
+        from dstack_tpu.backends.gcp.compute import GCPBackendConfig, GCPCompute
+
+        return GCPCompute(GCPBackendConfig.model_validate(config))
+    if backend_type == BackendType.SSH:
+        raise BadRequestError("ssh backend instances are created via SSH fleets")
+    raise BadRequestError(f"Unsupported backend type: {backend_type}")
+
+
+async def init_backends(ctx: ServerContext) -> None:
+    rows = await ctx.db.fetchall("SELECT * FROM backends")
+    for row in rows:
+        try:
+            config = json.loads(ctx.encryption.decrypt(row["config"]))
+            ctx.backends[(row["project_id"], row["type"])] = _make_compute(
+                BackendType(row["type"]), config
+            )
+        except Exception:  # a broken backend config must not kill startup
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "failed to init backend %s of project %s", row["type"], row["project_id"]
+            )
+
+
+async def create_backend(
+    ctx: ServerContext, project_id: str, backend_type: BackendType, config: Dict[str, Any]
+) -> None:
+    compute = _make_compute(backend_type, config)  # validates config
+    stored = ctx.encryption.encrypt(json.dumps(config))
+    await ctx.db.execute(
+        "INSERT INTO backends (id, project_id, type, config) VALUES (?, ?, ?, ?)"
+        " ON CONFLICT (project_id, type) DO UPDATE SET config = excluded.config",
+        (generate_id(), project_id, backend_type.value, stored),
+    )
+    ctx.backends[(project_id, backend_type.value)] = compute
+
+
+async def delete_backends(
+    ctx: ServerContext, project_id: str, backend_types: List[str]
+) -> None:
+    qs = ",".join("?" for _ in backend_types)
+    await ctx.db.execute(
+        f"DELETE FROM backends WHERE project_id = ? AND type IN ({qs})",
+        [project_id, *backend_types],
+    )
+    for t in backend_types:
+        ctx.backends.pop((project_id, t), None)
+
+
+async def list_project_backends(
+    ctx: ServerContext, project_id: str
+) -> List[Tuple[BackendType, Compute]]:
+    out: List[Tuple[BackendType, Compute]] = []
+    rows = await ctx.db.fetchall(
+        "SELECT type FROM backends WHERE project_id = ?", (project_id,)
+    )
+    for row in rows:
+        compute = ctx.backends.get((project_id, row["type"]))
+        if compute is not None:
+            out.append((BackendType(row["type"]), compute))
+    if local_backend_enabled():
+        key = (project_id, BackendType.LOCAL.value)
+        if key not in ctx.backends:
+            ctx.backends[key] = _make_compute(
+                BackendType.LOCAL, ctx.overrides.get("local_backend_config", {})
+            )
+        if all(t != BackendType.LOCAL for t, _ in out):
+            out.append((BackendType.LOCAL, ctx.backends[key]))
+    return out
+
+
+async def get_project_backend(
+    ctx: ServerContext, project_id: str, backend_type: BackendType
+) -> Compute:
+    for t, compute in await list_project_backends(ctx, project_id):
+        if t == backend_type:
+            return compute
+    raise ResourceNotExistsError(f"Backend {backend_type.value} is not configured")

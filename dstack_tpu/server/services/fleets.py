@@ -1,0 +1,286 @@
+"""Fleets service: CRUD + cloud fleet provisioning + SSH fleet deployment.
+
+Parity: src/dstack/_internal/server/services/fleets.py (793 LoC) +
+process_instances._add_remote (SSH host deploy). TPU-first: a cloud fleet
+whose resources resolve to a multi-host slice creates `nodes × slice_hosts`
+gang instances.
+"""
+
+import json
+import logging
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.errors import (
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerError,
+)
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.fleets import (
+    Fleet,
+    FleetConfiguration,
+    FleetSpec,
+    FleetStatus,
+    SSHHostParams,
+)
+from dstack_tpu.models.instances import (
+    Instance,
+    InstanceStatus,
+    InstanceType,
+    Resources,
+)
+from dstack_tpu.models.profiles import Profile
+from dstack_tpu.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.utils.common import parse_dt, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def instance_row_to_instance(row: sqlite3.Row) -> Instance:
+    from dstack_tpu.models.instances import InstanceOfferWithAvailability
+
+    itype = None
+    hostname = None
+    price = row["price"]
+    if row["offer"]:
+        offer = InstanceOfferWithAvailability.model_validate_json(row["offer"])
+        itype = offer.instance
+    if row["job_provisioning_data"]:
+        jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+        hostname = jpd.hostname
+        itype = itype or jpd.instance_type
+    return Instance(
+        id=row["id"],
+        project_name="",
+        name=row["name"],
+        fleet_id=row["fleet_id"],
+        instance_num=row["instance_num"],
+        status=InstanceStatus(row["status"]),
+        unreachable=bool(row["unreachable"]),
+        termination_reason=row["termination_reason"],
+        created=parse_dt(row["created_at"]),
+        backend=BackendType(row["backend"]) if row["backend"] else None,
+        region=row["region"],
+        availability_zone=row["availability_zone"],
+        instance_type=itype,
+        hostname=hostname,
+        price=price,
+        total_blocks=row["total_blocks"],
+        busy_blocks=row["busy_blocks"],
+    )
+
+
+async def fleet_row_to_fleet(ctx: ServerContext, row: sqlite3.Row) -> Fleet:
+    instance_rows = await ctx.db.fetchall(
+        "SELECT * FROM instances WHERE fleet_id = ? AND deleted = 0 ORDER BY instance_num",
+        (row["id"],),
+    )
+    return Fleet(
+        id=row["id"],
+        name=row["name"],
+        project_name="",
+        spec=FleetSpec.model_validate_json(row["spec"]),
+        created_at=parse_dt(row["created_at"]),
+        status=FleetStatus(row["status"]),
+        status_message=row["status_message"],
+        instances=[await instance_row_to_instance(r) for r in instance_rows],
+    )
+
+
+async def create_fleet(
+    ctx: ServerContext, project_id: str, spec: FleetSpec
+) -> Fleet:
+    conf = spec.configuration
+    name = conf.name or f"fleet-{generate_id()[:8]}"
+    existing = await ctx.db.fetchone(
+        "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, name),
+    )
+    if existing is not None:
+        raise ResourceExistsError(f"Fleet {name} already exists")
+    fleet_id = generate_id()
+    now = utcnow_iso()
+    conf.name = name
+    await ctx.db.execute(
+        "INSERT INTO fleets (id, project_id, name, status, spec, created_at,"
+        " last_processed_at) VALUES (?, ?, ?, ?, ?, ?, ?)",
+        (fleet_id, project_id, name, FleetStatus.ACTIVE.value, spec.model_dump_json(), now, now),
+    )
+    if conf.ssh_config is not None:
+        await _create_ssh_instances(ctx, project_id, fleet_id, name, conf)
+    else:
+        nodes = int(conf.nodes.min or 1) if conf.nodes else 1
+        for num in range(nodes):
+            await _create_pending_cloud_instance(ctx, project_id, fleet_id, name, conf, num)
+    ctx.kick("instances")
+    row = await ctx.db.fetchone("SELECT * FROM fleets WHERE id = ?", (fleet_id,))
+    return await fleet_row_to_fleet(ctx, row)
+
+
+async def _create_ssh_instances(
+    ctx: ServerContext, project_id: str, fleet_id: str, fleet_name: str,
+    conf: FleetConfiguration,
+) -> None:
+    assert conf.ssh_config is not None
+    now = utcnow_iso()
+    for num, host in enumerate(conf.ssh_config.hosts):
+        if isinstance(host, str):
+            host = SSHHostParams(hostname=host)
+        rci = {
+            "host": host.hostname,
+            "port": host.port or conf.ssh_config.port or 22,
+            "ssh_user": host.user or conf.ssh_config.user or "root",
+            "identity_file": host.identity_file or conf.ssh_config.identity_file,
+            "ssh_private_key": host.ssh_key or conf.ssh_config.ssh_key,
+            "internal_ip": host.internal_ip,
+        }
+        await ctx.db.execute(
+            "INSERT INTO instances (id, project_id, fleet_id, name, instance_num,"
+            " status, created_at, last_processed_at, backend, region,"
+            " remote_connection_info) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                generate_id(), project_id, fleet_id, f"{fleet_name}-{num}", num,
+                InstanceStatus.PENDING.value, now, now, BackendType.SSH.value,
+                "remote", json.dumps(rci),
+            ),
+        )
+
+
+async def _create_pending_cloud_instance(
+    ctx: ServerContext, project_id: str, fleet_id: str, fleet_name: str,
+    conf: FleetConfiguration, num: int,
+) -> None:
+    now = utcnow_iso()
+    profile = Profile(name="fleet", **{
+        k: getattr(conf, k) for k in (
+            "backends", "regions", "zones", "spot_policy", "max_price",
+            "reservation", "idle_duration",
+        ) if getattr(conf, k, None) is not None
+    })
+    requirements = Requirements(resources=conf.resources or None)
+    await ctx.db.execute(
+        "INSERT INTO instances (id, project_id, fleet_id, name, instance_num, status,"
+        " created_at, last_processed_at, requirements, profile)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            generate_id(), project_id, fleet_id, f"{fleet_name}-{num}", num,
+            InstanceStatus.PENDING.value, now, now,
+            requirements.model_dump_json(), profile.model_dump_json(),
+        ),
+    )
+
+
+async def provision_pending_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """Provision a PENDING fleet instance (cloud) or deploy an SSH host."""
+    if row["remote_connection_info"]:
+        from dstack_tpu.server.services import ssh_fleets
+
+        await ssh_fleets.deploy_ssh_instance(ctx, row)
+        return
+    if not row["requirements"]:
+        return
+    from dstack_tpu.server.services import offers as offers_service
+
+    requirements = Requirements.model_validate_json(row["requirements"])
+    profile = (
+        Profile.model_validate_json(row["profile"]) if row["profile"] else Profile(name="fleet")
+    )
+    pairs = await offers_service.get_offers_by_requirements(
+        ctx, row["project_id"], requirements, profile
+    )
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    for compute, offer in pairs[:5]:
+        try:
+            jpds = await compute.create_instance(
+                project_name=project_row["name"],
+                offer=offer,
+                ssh_public_key=project_row["ssh_public_key"],
+                instance_name=row["name"],
+            )
+        except Exception as e:
+            logger.info("fleet instance offer failed: %s", e)
+            continue
+        # First worker replaces this row; extra workers (pod slices) are
+        # appended as sibling instances.
+        now = utcnow_iso()
+        for worker, jpd in enumerate(jpds):
+            if worker == 0:
+                await ctx.db.execute(
+                    "UPDATE instances SET status = ?, backend = ?, region = ?,"
+                    " availability_zone = ?, price = ?, offer = ?,"
+                    " job_provisioning_data = ?, tpu_node = ?, tpu_worker_index = 0,"
+                    " started_at = ?, last_processed_at = ? WHERE id = ?",
+                    (
+                        InstanceStatus.IDLE.value, jpd.backend.value, jpd.region,
+                        jpd.availability_zone, jpd.price, offer.model_dump_json(),
+                        jpd.model_dump_json(), jpd.tpu_node_id, now, now, row["id"],
+                    ),
+                )
+            else:
+                await ctx.db.execute(
+                    "INSERT INTO instances (id, project_id, fleet_id, name,"
+                    " instance_num, status, created_at, started_at, last_processed_at,"
+                    " backend, region, availability_zone, price, offer,"
+                    " job_provisioning_data, tpu_node, tpu_worker_index)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    (
+                        generate_id(), row["project_id"], row["fleet_id"],
+                        f"{row['name']}-w{worker}", row["instance_num"] * 1000 + worker,
+                        InstanceStatus.IDLE.value, now, now, now, jpd.backend.value,
+                        jpd.region, jpd.availability_zone, jpd.price,
+                        offer.model_dump_json(), jpd.model_dump_json(),
+                        jpd.tpu_node_id, jpd.tpu_worker_index,
+                    ),
+                )
+        logger.info("fleet instance %s provisioned (%d workers)", row["name"], len(jpds))
+        return
+    await ctx.db.execute(
+        "UPDATE instances SET status = 'terminated', termination_reason = ?,"
+        " finished_at = ? WHERE id = ?",
+        ("no offers matched", utcnow_iso(), row["id"]),
+    )
+
+
+async def list_fleets(ctx: ServerContext, project_id: str) -> List[Fleet]:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM fleets WHERE project_id = ? AND deleted = 0 ORDER BY name",
+        (project_id,),
+    )
+    return [await fleet_row_to_fleet(ctx, r) for r in rows]
+
+
+async def get_fleet(ctx: ServerContext, project_id: str, name: str) -> Fleet:
+    row = await ctx.db.fetchone(
+        "SELECT * FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"Fleet {name} does not exist")
+    return await fleet_row_to_fleet(ctx, row)
+
+
+async def delete_fleets(ctx: ServerContext, project_id: str, names: List[str]) -> None:
+    for name in names:
+        row = await ctx.db.fetchone(
+            "SELECT * FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+            (project_id, name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"Fleet {name} does not exist")
+        busy = await ctx.db.fetchone(
+            "SELECT id FROM instances WHERE fleet_id = ? AND status = 'busy' AND deleted = 0",
+            (row["id"],),
+        )
+        if busy is not None:
+            raise ServerError(f"Fleet {name} has busy instances")
+        await ctx.db.execute(
+            "UPDATE fleets SET status = 'terminating', last_processed_at = ? WHERE id = ?",
+            (utcnow_iso(), row["id"]),
+        )
+    ctx.kick("fleets")

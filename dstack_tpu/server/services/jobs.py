@@ -1,0 +1,156 @@
+"""Job configurators: RunSpec -> JobSpecs (gang fan-out for TPU slices).
+
+Parity: src/dstack/_internal/server/services/jobs/configurators/
+(base.py:95-122 `_get_job_spec`, task.py:14-23 nodes fan-out). TPU-first
+delta: a task requesting a multi-host slice fans out into
+`nodes × hosts_per_slice` jobs — one per worker VM — fixed at plan time from
+the resolved target topology (backends/base/offers.resolve_target_topology).
+`nodes` counts *slices* (multi-slice DCN runs), not VMs.
+"""
+
+from typing import List, Optional
+
+from dstack_tpu.backends.base.offers import resolve_target_topology
+from dstack_tpu.errors import ServerError
+from dstack_tpu.models.common import UnixUser
+from dstack_tpu.models.configurations import (
+    DevEnvironmentConfiguration,
+    PortMapping,
+    ServiceConfiguration,
+    TaskConfiguration,
+)
+from dstack_tpu.models.profiles import DEFAULT_STOP_DURATION, Profile
+from dstack_tpu.models.runs import (
+    AppSpec,
+    JobSpec,
+    Requirements,
+    Retry,
+    RunSpec,
+)
+from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.server.services.offers import requirements_from_profile
+
+DEFAULT_MAX_DURATION_TASK = None  # off by default (parity: profiles "off")
+DEFAULT_IMAGE = "python:3.12-slim"  # base image when only `python` is set
+
+
+def get_default_image(python_version: Optional[str]) -> str:
+    if python_version:
+        return f"python:{python_version}-slim"
+    return DEFAULT_IMAGE
+
+
+def _shared_spec_fields(conf, run_spec: RunSpec, profile: Profile) -> dict:
+    requirements = requirements_from_profile(conf.resources, profile)
+    retry_profile = profile.get_retry()
+    retry = None
+    if retry_profile is not None:
+        retry = Retry(on_events=retry_profile.on_events, duration=int(retry_profile.duration))
+    max_duration = profile.max_duration
+    if max_duration == "off":
+        max_duration = None
+    stop_duration = profile.stop_duration
+    if stop_duration == "off":
+        stop_duration = None
+    elif stop_duration is None:
+        stop_duration = DEFAULT_STOP_DURATION
+    return dict(
+        user=UnixUser.parse(conf.user) if conf.user else None,
+        env={k: v for k, v in conf.env.as_dict().items() if v is not None},
+        image_name=conf.image or get_default_image(conf.python),
+        privileged=conf.privileged,
+        single_branch=conf.single_branch,
+        max_duration=int(max_duration) if max_duration is not None else None,
+        stop_duration=int(stop_duration) if stop_duration is not None else None,
+        registry_auth=conf.registry_auth,
+        requirements=requirements,
+        retry=retry,
+        volumes=conf.volumes,
+        working_dir=conf.working_dir or run_spec.working_dir,
+    )
+
+
+def _app_specs(ports: List[PortMapping]) -> List[AppSpec]:
+    return [
+        AppSpec(port=p.container_port, map_to_port=p.local_port, app_name=f"app-{i}")
+        for i, p in enumerate(ports)
+    ]
+
+
+def get_target_topology(run_spec: RunSpec) -> Optional[TpuTopology]:
+    req = Requirements(resources=run_spec.configuration.resources)
+    return resolve_target_topology(req)
+
+
+def hosts_per_node(run_spec: RunSpec) -> int:
+    topo = get_target_topology(run_spec)
+    return topo.hosts if topo is not None else 1
+
+
+def get_job_specs(run_spec: RunSpec, replica_num: int) -> List[JobSpec]:
+    """All jobs of one replica (the gang)."""
+    conf = run_spec.configuration
+    profile = run_spec.merged_profile
+    assert profile is not None
+    run_name = run_spec.run_name or "run"
+    shared = _shared_spec_fields(conf, run_spec, profile)
+    topo = get_target_topology(run_spec)
+    slice_hosts = topo.hosts if topo is not None else 1
+
+    if isinstance(conf, TaskConfiguration):
+        nodes = conf.nodes
+        total = nodes * slice_hosts
+        jobs = []
+        for job_num in range(total):
+            jobs.append(
+                JobSpec(
+                    replica_num=replica_num,
+                    job_num=job_num,
+                    job_name=f"{run_name}-{job_num}-{replica_num}",
+                    jobs_per_replica=total,
+                    app_specs=_app_specs(conf.ports),
+                    commands=list(conf.commands),
+                    tpu_slice=topo,
+                    host_rank=job_num % slice_hosts,
+                    **shared,
+                )
+            )
+        return jobs
+
+    if isinstance(conf, ServiceConfiguration):
+        jobs = []
+        for job_num in range(slice_hosts):
+            jobs.append(
+                JobSpec(
+                    replica_num=replica_num,
+                    job_num=job_num,
+                    job_name=f"{run_name}-{job_num}-{replica_num}",
+                    jobs_per_replica=slice_hosts,
+                    app_specs=_app_specs([conf.port]),
+                    commands=list(conf.commands),
+                    tpu_slice=topo,
+                    host_rank=job_num,
+                    **shared,
+                )
+            )
+        return jobs
+
+    if isinstance(conf, DevEnvironmentConfiguration):
+        commands = ["echo 'Dev environment started'", "sleep infinity"]
+        if conf.init:
+            commands = list(conf.init) + commands
+        return [
+            JobSpec(
+                replica_num=replica_num,
+                job_num=0,
+                job_name=f"{run_name}-0-{replica_num}",
+                jobs_per_replica=1,
+                app_specs=_app_specs(conf.ports),
+                commands=commands,
+                tpu_slice=topo,
+                host_rank=0,
+                **shared,
+            )
+        ]
+
+    raise ServerError(f"Unsupported configuration type: {type(conf)}")

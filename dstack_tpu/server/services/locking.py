@@ -1,0 +1,56 @@
+"""In-process resource locking.
+
+Parity: src/dstack/_internal/server/services/locking.py:13-81 — namespaced
+locksets guarding FSM transitions. The reference pairs these with
+`SELECT ... FOR UPDATE SKIP LOCKED` on Postgres; with a single-process server
+on sqlite the asyncio locksets are authoritative.
+"""
+
+import asyncio
+from contextlib import asynccontextmanager
+from typing import AsyncIterator, Dict, Iterable, List, Set
+
+
+class ResourceLocker:
+    def __init__(self):
+        self._namespaces: Dict[str, Set[str]] = {}
+        self._cond = asyncio.Condition()
+
+    @asynccontextmanager
+    async def lock_ctx(self, namespace: str, keys: Iterable[str]) -> AsyncIterator[None]:
+        keys = sorted(set(keys))  # stable order prevents deadlock
+        await self._acquire(namespace, keys)
+        try:
+            yield
+        finally:
+            await self._release(namespace, keys)
+
+    async def _acquire(self, namespace: str, keys: List[str]) -> None:
+        async with self._cond:
+            held = self._namespaces.setdefault(namespace, set())
+            while any(k in held for k in keys):
+                await self._cond.wait()
+            held.update(keys)
+
+    async def _release(self, namespace: str, keys: List[str]) -> None:
+        async with self._cond:
+            held = self._namespaces.get(namespace, set())
+            held.difference_update(keys)
+            self._cond.notify_all()
+
+    def try_lock_nowait(self, namespace: str, key: str) -> bool:
+        """Non-blocking single-key acquire (used by `SKIP LOCKED`-style polls)."""
+        held = self._namespaces.setdefault(namespace, set())
+        if key in held:
+            return False
+        held.add(key)
+        return True
+
+    def unlock_nowait(self, namespace: str, key: str) -> None:
+        self._namespaces.get(namespace, set()).discard(key)
+        # Waiters in lock_ctx need a wakeup; schedule it.
+        asyncio.get_event_loop().create_task(self._notify())
+
+    async def _notify(self) -> None:
+        async with self._cond:
+            self._cond.notify_all()

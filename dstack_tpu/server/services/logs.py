@@ -1,0 +1,162 @@
+"""Log storage: pluggable sinks for job/runner logs.
+
+Parity: src/dstack/_internal/server/services/logs.py (FileLogStorage
+:344-433 + CloudWatchLogStorage :65-341, selected by env). Default here is
+the sqlite `logs` table (single-file deployments); FileLogStorage mirrors
+the reference's on-disk layout.
+"""
+
+import abc
+import base64
+import json
+import os
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import List, Optional
+
+from dstack_tpu.agents.protocol import LogEventOut
+from dstack_tpu.models.logs import JobSubmissionLogs, LogEvent, LogProducer
+from dstack_tpu.server.context import ServerContext
+
+
+class LogStorage(abc.ABC):
+    @abc.abstractmethod
+    async def write(
+        self,
+        project_id: str,
+        run_name: str,
+        job_submission_id: str,
+        job_logs: List[LogEventOut],
+        runner_logs: List[LogEventOut],
+    ) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def poll(
+        self,
+        project_id: str,
+        run_name: str,
+        job_submission_id: str,
+        start_after: Optional[str] = None,
+        limit: int = 1000,
+        diagnose: bool = False,
+    ) -> JobSubmissionLogs:
+        ...
+
+
+def _event_ts(ms: int) -> datetime:
+    return datetime.fromtimestamp(ms / 1000, tz=timezone.utc)
+
+
+class DbLogStorage(LogStorage):
+    def __init__(self, ctx: ServerContext):
+        self.ctx = ctx
+
+    async def write(
+        self, project_id, run_name, job_submission_id, job_logs, runner_logs
+    ) -> None:
+        rows = []
+        for source, events in (("stdout", job_logs), ("runner", runner_logs)):
+            for e in events:
+                rows.append(
+                    (
+                        project_id,
+                        run_name,
+                        job_submission_id,
+                        _event_ts(e.timestamp).isoformat(),
+                        source,
+                        base64.b64decode(e.message),
+                    )
+                )
+        if rows:
+            await self.ctx.db.executemany(
+                "INSERT INTO logs (project_id, run_name, job_submission_id, timestamp,"
+                " log_source, message) VALUES (?, ?, ?, ?, ?, ?)",
+                rows,
+            )
+
+    async def poll(
+        self, project_id, run_name, job_submission_id, start_after=None, limit=1000,
+        diagnose=False,
+    ) -> JobSubmissionLogs:
+        source = "runner" if diagnose else "stdout"
+        sql = (
+            "SELECT * FROM logs WHERE job_submission_id = ? AND log_source = ?"
+        )
+        params: list = [job_submission_id, source]
+        if start_after:
+            sql += " AND id > ?"
+            params.append(int(start_after))
+        sql += " ORDER BY id LIMIT ?"
+        params.append(limit)
+        rows = await self.ctx.db.fetchall(sql, params)
+        events = [
+            LogEvent.create(
+                timestamp=datetime.fromisoformat(r["timestamp"]),
+                message=r["message"],
+                source=LogProducer.RUNNER if diagnose else LogProducer.JOB,
+            )
+            for r in rows
+        ]
+        next_token = str(rows[-1]["id"]) if len(rows) == limit else ""
+        return JobSubmissionLogs(logs=events, next_token=next_token)
+
+
+class FileLogStorage(LogStorage):
+    """~/.dstack-tpu/server/projects/<project>/logs/<run>/<submission>.jsonl"""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+
+    def _path(self, project_id: str, run_name: str, job_submission_id: str, source: str) -> Path:
+        return (
+            self.root / "projects" / project_id / "logs" / run_name
+            / f"{job_submission_id}.{source}.jsonl"
+        )
+
+    async def write(
+        self, project_id, run_name, job_submission_id, job_logs, runner_logs
+    ) -> None:
+        for source, events in (("stdout", job_logs), ("runner", runner_logs)):
+            if not events:
+                continue
+            path = self._path(project_id, run_name, job_submission_id, source)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with open(path, "a") as f:
+                for e in events:
+                    f.write(json.dumps({"ts": e.timestamp, "b64": e.message}) + "\n")
+
+    async def poll(
+        self, project_id, run_name, job_submission_id, start_after=None, limit=1000,
+        diagnose=False,
+    ) -> JobSubmissionLogs:
+        source = "runner" if diagnose else "stdout"
+        path = self._path(project_id, run_name, job_submission_id, source)
+        if not path.exists():
+            return JobSubmissionLogs(logs=[])
+        events: List[LogEvent] = []
+        start_line = int(start_after) if start_after else 0
+        next_token = ""
+        with open(path) as f:
+            for i, line in enumerate(f):
+                if i < start_line:
+                    continue
+                if len(events) >= limit:
+                    next_token = str(i)
+                    break
+                data = json.loads(line)
+                events.append(
+                    LogEvent(
+                        timestamp=_event_ts(data["ts"]),
+                        log_source=LogProducer.RUNNER if diagnose else LogProducer.JOB,
+                        message=data["b64"],
+                    )
+                )
+        return JobSubmissionLogs(logs=events, next_token=next_token)
+
+
+def default_log_storage(ctx: ServerContext) -> LogStorage:
+    root = os.getenv("DSTACK_TPU_FILE_LOGS_DIR")
+    if root:
+        return FileLogStorage(Path(root))
+    return DbLogStorage(ctx)

@@ -1,0 +1,165 @@
+"""Project management service.
+
+Parity: src/dstack/_internal/server/services/projects.py (create/list/delete,
+members, per-project SSH keypair used for instance access).
+"""
+
+from datetime import datetime, timezone
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.errors import ForbiddenError, ResourceExistsError, ResourceNotExistsError
+from dstack_tpu.models.users import (
+    GlobalRole,
+    Member,
+    Project,
+    ProjectRole,
+    User,
+)
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.utils.ssh import generate_rsa_keypair
+
+_NAME_MAX = 60
+
+
+async def _row_to_project(ctx: ServerContext, row: sqlite3.Row) -> Project:
+    owner_row = await ctx.db.fetchone("SELECT * FROM users WHERE id = ?", (row["owner_id"],))
+    from dstack_tpu.server.services.users import _row_to_user
+
+    member_rows = await ctx.db.fetchall(
+        "SELECT m.project_role, u.* FROM members m JOIN users u ON u.id = m.user_id"
+        " WHERE m.project_id = ?",
+        (row["id"],),
+    )
+    backend_rows = await ctx.db.fetchall(
+        "SELECT type FROM backends WHERE project_id = ?", (row["id"],)
+    )
+    return Project(
+        id=row["id"],
+        project_name=row["name"],
+        owner=_row_to_user(owner_row),
+        created_at=datetime.fromisoformat(row["created_at"]),
+        backends=[b["type"] for b in backend_rows],
+        members=[
+            Member(user=_row_to_user(m), project_role=ProjectRole(m["project_role"]))
+            for m in member_rows
+        ],
+    )
+
+
+def _validate_name(name: str) -> None:
+    import re
+
+    if not re.fullmatch(r"[a-zA-Z0-9][a-zA-Z0-9-_]{0,%d}" % (_NAME_MAX - 1), name):
+        raise ResourceExistsError(f"Invalid project name: {name!r}")
+
+
+async def create_project(ctx: ServerContext, user: User, project_name: str) -> Project:
+    _validate_name(project_name)
+    existing = await ctx.db.fetchone(
+        "SELECT id FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if existing is not None:
+        raise ResourceExistsError(f"Project {project_name} already exists")
+    project_id = generate_id()
+    private_key, public_key = generate_rsa_keypair()
+    await ctx.db.execute(
+        "INSERT INTO projects (id, name, owner_id, ssh_private_key, ssh_public_key, created_at)"
+        " VALUES (?, ?, ?, ?, ?, ?)",
+        (project_id, project_name, user.id, private_key, public_key,
+         datetime.now(timezone.utc).isoformat()),
+    )
+    await ctx.db.execute(
+        "INSERT INTO members (id, project_id, user_id, project_role) VALUES (?, ?, ?, ?)",
+        (generate_id(), project_id, user.id, ProjectRole.ADMIN.value),
+    )
+    return await get_project(ctx, project_name)
+
+
+async def get_project(ctx: ServerContext, project_name: str) -> Project:
+    row = await get_project_row(ctx, project_name)
+    return await _row_to_project(ctx, row)
+
+
+async def get_project_row(ctx: ServerContext, project_name: str) -> sqlite3.Row:
+    row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"Project {project_name} does not exist")
+    return row
+
+
+async def list_projects(ctx: ServerContext, user: User) -> List[Project]:
+    if user.global_role == GlobalRole.ADMIN:
+        rows = await ctx.db.fetchall("SELECT * FROM projects WHERE deleted = 0 ORDER BY name")
+    else:
+        rows = await ctx.db.fetchall(
+            "SELECT p.* FROM projects p JOIN members m ON m.project_id = p.id"
+            " WHERE m.user_id = ? AND p.deleted = 0 ORDER BY p.name",
+            (user.id,),
+        )
+    return [await _row_to_project(ctx, r) for r in rows]
+
+
+async def delete_projects(ctx: ServerContext, user: User, project_names: List[str]) -> None:
+    for name in project_names:
+        role = await get_member_role(ctx, user, name)
+        if role != ProjectRole.ADMIN and user.global_role != GlobalRole.ADMIN:
+            raise ForbiddenError(f"Not an admin of project {name}")
+    qs = ",".join("?" for _ in project_names)
+    await ctx.db.execute(f"UPDATE projects SET deleted = 1 WHERE name IN ({qs})", project_names)
+
+
+async def get_member_role(
+    ctx: ServerContext, user: User, project_name: str
+) -> Optional[ProjectRole]:
+    row = await ctx.db.fetchone(
+        "SELECT m.project_role FROM members m JOIN projects p ON p.id = m.project_id"
+        " WHERE p.name = ? AND p.deleted = 0 AND m.user_id = ?",
+        (project_name, user.id),
+    )
+    return ProjectRole(row["project_role"]) if row else None
+
+
+async def set_members(
+    ctx: ServerContext, project_name: str, members: List[dict]
+) -> None:
+    project_row = await get_project_row(ctx, project_name)
+    await ctx.db.execute("DELETE FROM members WHERE project_id = ?", (project_row["id"],))
+    for m in members:
+        user_row = await ctx.db.fetchone(
+            "SELECT id FROM users WHERE username = ?", (m["username"],)
+        )
+        if user_row is None:
+            raise ResourceNotExistsError(f"User {m['username']} does not exist")
+        await ctx.db.execute(
+            "INSERT INTO members (id, project_id, user_id, project_role) VALUES (?, ?, ?, ?)",
+            (generate_id(), project_row["id"], user_row["id"],
+             ProjectRole(m["project_role"]).value),
+        )
+
+
+async def check_access(
+    ctx: ServerContext,
+    user: User,
+    project_name: str,
+    require_role: Optional[ProjectRole] = None,
+) -> sqlite3.Row:
+    """Raise unless `user` can access `project_name`; returns the project row."""
+    row = await get_project_row(ctx, project_name)
+    if user.global_role == GlobalRole.ADMIN:
+        return row
+    role = await get_member_role(ctx, user, project_name)
+    if role is None:
+        raise ForbiddenError(f"Not a member of project {project_name}")
+    if require_role == ProjectRole.ADMIN and role != ProjectRole.ADMIN:
+        raise ForbiddenError("Project admin role required")
+    if require_role == ProjectRole.MANAGER and role not in (
+        ProjectRole.ADMIN,
+        ProjectRole.MANAGER,
+    ):
+        raise ForbiddenError("Project manager role required")
+    return row

@@ -1,0 +1,145 @@
+"""HTTP clients for the host agents (runner + shim).
+
+Parity: src/dstack/_internal/server/services/runner/client.py:47-389
+(RunnerClient + ShimClient v2 task API), over httpx.
+"""
+
+import json
+from typing import Dict, Optional
+
+import httpx
+
+from dstack_tpu.agents.protocol import (
+    HealthcheckResponse,
+    MetricsResponse,
+    PullResponse,
+    SubmitBody,
+    TaskInfo,
+    TaskSubmitRequest,
+    TaskTerminateRequest,
+)
+from dstack_tpu.errors import ServerError
+from dstack_tpu.models.runs import ClusterInfo, JobSpec
+
+
+class AgentHTTPError(ServerError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"agent returned {status}: {body[:200]}")
+        self.status = status
+
+
+class RunnerClient:
+    def __init__(self, base_url: str, timeout: float = 20.0):
+        self.base_url = base_url.rstrip("/")
+        self._client = httpx.AsyncClient(timeout=timeout)
+
+    async def close(self) -> None:
+        await self._client.aclose()
+
+    async def _request(self, method: str, path: str, **kwargs) -> httpx.Response:
+        resp = await self._client.request(method, self.base_url + path, **kwargs)
+        if resp.status_code >= 400:
+            raise AgentHTTPError(resp.status_code, resp.text)
+        return resp
+
+    async def healthcheck(self) -> Optional[HealthcheckResponse]:
+        try:
+            resp = await self._request("GET", "/api/healthcheck")
+            return HealthcheckResponse.model_validate(resp.json())
+        except (httpx.HTTPError, AgentHTTPError):
+            return None
+
+    async def submit_job(
+        self,
+        run_name: str,
+        job_spec: JobSpec,
+        cluster_info: Optional[ClusterInfo],
+        node_rank: int,
+        secrets: Dict[str, str],
+        has_code: bool,
+    ) -> None:
+        body = SubmitBody(
+            run_name=run_name,
+            job_spec=job_spec,
+            cluster_info=cluster_info,
+            node_rank=node_rank,
+            secrets=secrets,
+            repo_archive=has_code,
+        )
+        await self._request(
+            "POST", "/api/submit", content=body.model_dump_json(),
+            headers={"content-type": "application/json"},
+        )
+
+    async def upload_code(self, blob: bytes) -> None:
+        await self._request("POST", "/api/upload_code", content=blob)
+
+    async def run_job(self) -> None:
+        await self._request("POST", "/api/run")
+
+    async def pull(self, timestamp_ms: int) -> PullResponse:
+        resp = await self._request("GET", f"/api/pull?timestamp={timestamp_ms}")
+        return PullResponse.model_validate(resp.json())
+
+    async def stop(self, grace_seconds: float = 5.0) -> None:
+        await self._request(
+            "POST", "/api/stop",
+            content=json.dumps({"grace_seconds": grace_seconds}),
+            headers={"content-type": "application/json"},
+        )
+
+    async def metrics(self) -> Optional[MetricsResponse]:
+        try:
+            resp = await self._request("GET", "/api/metrics")
+            return MetricsResponse.model_validate(resp.json())
+        except (httpx.HTTPError, AgentHTTPError):
+            return None
+
+
+class ShimClient:
+    """v2 task-based shim API (reference negotiates v1/v2; only v2 here)."""
+
+    def __init__(self, base_url: str, timeout: float = 20.0):
+        self.base_url = base_url.rstrip("/")
+        self._client = httpx.AsyncClient(timeout=timeout)
+
+    async def close(self) -> None:
+        await self._client.aclose()
+
+    async def _request(self, method: str, path: str, **kwargs) -> httpx.Response:
+        resp = await self._client.request(method, self.base_url + path, **kwargs)
+        if resp.status_code >= 400:
+            raise AgentHTTPError(resp.status_code, resp.text)
+        return resp
+
+    async def healthcheck(self) -> Optional[HealthcheckResponse]:
+        try:
+            resp = await self._request("GET", "/api/healthcheck")
+            return HealthcheckResponse.model_validate(resp.json())
+        except (httpx.HTTPError, AgentHTTPError):
+            return None
+
+    async def submit_task(self, task: TaskSubmitRequest) -> None:
+        await self._request(
+            "POST", "/api/tasks", content=task.model_dump_json(),
+            headers={"content-type": "application/json"},
+        )
+
+    async def get_task(self, task_id: str) -> TaskInfo:
+        resp = await self._request("GET", f"/api/tasks/{task_id}")
+        return TaskInfo.model_validate(resp.json())
+
+    async def terminate_task(
+        self, task_id: str, reason: str = "", message: str = "", timeout: float = 10.0
+    ) -> None:
+        body = TaskTerminateRequest(
+            termination_reason=reason, termination_message=message, timeout=timeout
+        )
+        await self._request(
+            "POST", f"/api/tasks/{task_id}/terminate",
+            content=body.model_dump_json(),
+            headers={"content-type": "application/json"},
+        )
+
+    async def remove_task(self, task_id: str) -> None:
+        await self._request("DELETE", f"/api/tasks/{task_id}")

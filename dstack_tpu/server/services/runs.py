@@ -1,0 +1,355 @@
+"""Runs service: plan / submit / stop / list / delete.
+
+Parity: src/dstack/_internal/server/services/runs.py (get_plan:273,
+submit_run:421-493, stop, scale_run_replicas:925). Jobs for every replica are
+created at submit time; for TPU slices each replica is a gang of
+`nodes × slice_hosts` jobs (services/jobs.py).
+"""
+
+import json
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.errors import (
+    ResourceExistsError,
+    ResourceNotExistsError,
+    ServerError,
+)
+from dstack_tpu.models.configurations import ServiceConfiguration
+from dstack_tpu.models.runs import (
+    Job,
+    JobPlan,
+    JobProvisioningData,
+    JobRuntimeData,
+    JobSpec,
+    JobStatus,
+    JobSubmission,
+    JobTerminationReason,
+    Run,
+    RunPlan,
+    RunSpec,
+    RunStatus,
+    RunTerminationReason,
+    ServiceSpec,
+)
+from dstack_tpu.models.users import User
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services import offers as offers_service
+from dstack_tpu.utils.common import generate_run_name, utcnow, utcnow_iso
+
+JOB_TERMINATION_REASONS_RETRYABLE = {
+    JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
+    JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+}
+
+
+def job_row_to_submission(row: sqlite3.Row) -> JobSubmission:
+    from dstack_tpu.utils.common import parse_dt
+
+    jpd = row["job_provisioning_data"]
+    jrd = row["job_runtime_data"]
+    return JobSubmission(
+        id=row["id"],
+        submission_num=row["submission_num"],
+        submitted_at=parse_dt(row["submitted_at"]),
+        last_processed_at=parse_dt(row["last_processed_at"]),
+        finished_at=parse_dt(row["finished_at"]),
+        status=JobStatus(row["status"]),
+        termination_reason=(
+            JobTerminationReason(row["termination_reason"])
+            if row["termination_reason"]
+            else None
+        ),
+        termination_reason_message=row["termination_reason_message"],
+        exit_status=row["exit_status"],
+        job_provisioning_data=(
+            JobProvisioningData.model_validate_json(jpd) if jpd else None
+        ),
+        job_runtime_data=(JobRuntimeData.model_validate_json(jrd) if jrd else None),
+    )
+
+
+def job_rows_to_jobs(job_rows: List[sqlite3.Row]) -> List[Job]:
+    """Group submissions of the same job (project, replica_num, job_num)."""
+    by_key = {}
+    for row in sorted(job_rows, key=lambda r: (r["replica_num"], r["job_num"], r["submission_num"])):
+        key = (row["replica_num"], row["job_num"])
+        spec = JobSpec.model_validate_json(row["job_spec"])
+        if key not in by_key:
+            by_key[key] = Job(job_spec=spec, job_submissions=[])
+        by_key[key].job_spec = spec
+        by_key[key].job_submissions.append(job_row_to_submission(row))
+    return [by_key[k] for k in sorted(by_key)]
+
+
+async def run_row_to_run(ctx: ServerContext, row: sqlite3.Row, user_name: Optional[str] = None) -> Run:
+    from dstack_tpu.utils.common import parse_dt
+
+    job_rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? ORDER BY replica_num, job_num, submission_num",
+        (row["id"],),
+    )
+    if user_name is None:
+        user_row = await ctx.db.fetchone("SELECT username FROM users WHERE id = ?", (row["user_id"],))
+        user_name = user_row["username"] if user_row else "unknown"
+    project_row = await ctx.db.fetchone("SELECT name FROM projects WHERE id = ?", (row["project_id"],))
+    jobs = job_rows_to_jobs(job_rows)
+    latest = None
+    if jobs and jobs[0].job_submissions:
+        latest = jobs[0].job_submissions[-1]
+    cost = 0.0
+    for job in jobs:
+        for sub in job.job_submissions:
+            if sub.job_provisioning_data is not None and sub.finished_at is not None:
+                hours = max(0.0, (sub.finished_at - sub.submitted_at).total_seconds() / 3600)
+                cost += sub.job_provisioning_data.price * hours
+            elif sub.job_provisioning_data is not None and not sub.status.is_finished():
+                hours = max(0.0, (utcnow() - sub.submitted_at).total_seconds() / 3600)
+                cost += sub.job_provisioning_data.price * hours
+    return Run(
+        id=row["id"],
+        project_name=project_row["name"] if project_row else "unknown",
+        user=user_name,
+        submitted_at=parse_dt(row["submitted_at"]),
+        last_processed_at=parse_dt(row["last_processed_at"]),
+        status=RunStatus(row["status"]),
+        termination_reason=(
+            RunTerminationReason(row["termination_reason"]) if row["termination_reason"] else None
+        ),
+        run_spec=RunSpec.model_validate_json(row["run_spec"]),
+        jobs=jobs,
+        latest_job_submission=latest,
+        cost=round(cost, 4),
+        service=(ServiceSpec.model_validate_json(row["service_spec"]) if row["service_spec"] else None),
+        deleted=bool(row["deleted"]),
+    )
+
+
+async def get_plan(
+    ctx: ServerContext, project_row: sqlite3.Row, user: User, run_spec: RunSpec
+) -> RunPlan:
+    if run_spec.run_name is None:
+        run_spec = run_spec.model_copy(deep=True)
+        run_spec.run_name = generate_run_name()
+    profile = run_spec.merged_profile
+    assert profile is not None
+    job_specs = jobs_service.get_job_specs(run_spec, replica_num=0)
+    multinode = len(job_specs) > 1
+    job_plans = []
+    for job_spec in job_specs[:1]:  # offers identical across the gang; plan once
+        pairs = await offers_service.get_offers_by_requirements(
+            ctx, project_row["id"], job_spec.requirements, profile, multinode=multinode
+        )
+        offers = [offer for _, offer in pairs]
+        job_plans.append(
+            JobPlan(
+                job_spec=job_spec,
+                offers=offers[:50],
+                total_offers=len(offers),
+                max_price=max((o.price for o in offers), default=None),
+            )
+        )
+    # Remaining gang members share the first job's offers.
+    for job_spec in job_specs[1:]:
+        job_plans.append(
+            JobPlan(
+                job_spec=job_spec,
+                offers=job_plans[0].offers,
+                total_offers=job_plans[0].total_offers,
+                max_price=job_plans[0].max_price,
+            )
+        )
+    current = None
+    row = await ctx.db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_spec.run_name),
+    )
+    if row is not None:
+        current = await run_row_to_run(ctx, row)
+    return RunPlan(
+        project_name=project_row["name"],
+        user=user.username,
+        run_spec=run_spec,
+        job_plans=job_plans,
+        current_resource=current,
+        action="update" if current is not None else "create",
+    )
+
+
+def _desired_replica_count(run_spec: RunSpec) -> int:
+    conf = run_spec.configuration
+    if isinstance(conf, ServiceConfiguration):
+        return int(conf.replicas.min or 0) or 1
+    return 1
+
+
+async def submit_run(
+    ctx: ServerContext, user: User, project_row: sqlite3.Row, run_spec: RunSpec
+) -> Run:
+    async with ctx.locker.lock_ctx("run_names", [project_row["id"]]):
+        if run_spec.run_name is None:
+            run_spec = run_spec.model_copy(deep=True)
+            while True:
+                run_spec.run_name = generate_run_name()
+                exists = await ctx.db.fetchone(
+                    "SELECT id FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+                    (project_row["id"], run_spec.run_name),
+                )
+                if exists is None:
+                    break
+        else:
+            existing = await ctx.db.fetchone(
+                "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+                (project_row["id"], run_spec.run_name),
+            )
+            if existing is not None:
+                if not RunStatus(existing["status"]).is_finished():
+                    raise ResourceExistsError(
+                        f"Run {run_spec.run_name} already exists and is active"
+                    )
+                # Finished run with the same name: soft-delete it (reference
+                # allows resubmission under the same name).
+                await ctx.db.execute(
+                    "UPDATE runs SET deleted = 1 WHERE id = ?", (existing["id"],)
+                )
+        run_id = generate_id()
+        now = utcnow_iso()
+        service_spec = None
+        if isinstance(run_spec.configuration, ServiceConfiguration):
+            service_spec = ServiceSpec(
+                url=f"/proxy/services/{project_row['name']}/{run_spec.run_name}/"
+            )
+            if run_spec.configuration.model is not None:
+                from dstack_tpu.models.runs import ServiceModelSpec
+
+                service_spec.model = ServiceModelSpec(
+                    name=run_spec.configuration.model.name,
+                    base_url=f"/proxy/models/{project_row['name']}",
+                    type=run_spec.configuration.model.type,
+                )
+        await ctx.db.execute(
+            "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+            " last_processed_at, status, run_spec, service_spec, desired_replica_count)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                run_id,
+                project_row["id"],
+                user.id,
+                run_spec.run_name,
+                now,
+                now,
+                RunStatus.SUBMITTED.value,
+                run_spec.model_dump_json(),
+                service_spec.model_dump_json() if service_spec else None,
+                _desired_replica_count(run_spec),
+            ),
+        )
+        for replica_num in range(_desired_replica_count(run_spec)):
+            await create_replica_jobs(ctx, project_row["id"], run_id, run_spec, replica_num)
+    ctx.kick("submitted_jobs")
+    ctx.kick("runs")
+    row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+    return await run_row_to_run(ctx, row, user.username)
+
+
+async def create_replica_jobs(
+    ctx: ServerContext,
+    project_id: str,
+    run_id: str,
+    run_spec: RunSpec,
+    replica_num: int,
+    submission_num: int = 0,
+) -> None:
+    now = utcnow_iso()
+    for job_spec in jobs_service.get_job_specs(run_spec, replica_num):
+        await ctx.db.execute(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+            " submission_num, submitted_at, last_processed_at, status, job_spec)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                generate_id(),
+                project_id,
+                run_id,
+                run_spec.run_name,
+                job_spec.job_num,
+                replica_num,
+                submission_num,
+                now,
+                now,
+                JobStatus.SUBMITTED.value,
+                job_spec.model_dump_json(),
+            ),
+        )
+
+
+async def list_runs(
+    ctx: ServerContext,
+    project_id: Optional[str] = None,
+    include_deleted: bool = False,
+    only_active: bool = False,
+    limit: int = 100,
+) -> List[Run]:
+    sql = "SELECT * FROM runs WHERE 1=1"
+    params: list = []
+    if project_id is not None:
+        sql += " AND project_id = ?"
+        params.append(project_id)
+    if not include_deleted:
+        sql += " AND deleted = 0"
+    if only_active:
+        qs = ",".join(f"'{s.value}'" for s in RunStatus.finished_statuses())
+        sql += f" AND status NOT IN ({qs})"
+    sql += " ORDER BY submitted_at DESC LIMIT ?"
+    params.append(limit)
+    rows = await ctx.db.fetchall(sql, params)
+    return [await run_row_to_run(ctx, r) for r in rows]
+
+
+async def get_run(ctx: ServerContext, project_id: str, run_name: str) -> Run:
+    row = await ctx.db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_id, run_name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"Run {run_name} does not exist")
+    return await run_row_to_run(ctx, row)
+
+
+async def stop_runs(
+    ctx: ServerContext, project_id: str, run_names: List[str], abort: bool = False
+) -> None:
+    reason = (
+        RunTerminationReason.ABORTED_BY_USER if abort else RunTerminationReason.STOPPED_BY_USER
+    )
+    for run_name in run_names:
+        row = await ctx.db.fetchone(
+            "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+            (project_id, run_name),
+        )
+        if row is None:
+            continue
+        status = RunStatus(row["status"])
+        if status.is_finished():
+            continue
+        await ctx.db.execute(
+            "UPDATE runs SET status = ?, termination_reason = ?, last_processed_at = ?"
+            " WHERE id = ?",
+            (RunStatus.TERMINATING.value, reason.value, utcnow_iso(), row["id"]),
+        )
+    ctx.kick("runs")
+
+
+async def delete_runs(ctx: ServerContext, project_id: str, run_names: List[str]) -> None:
+    for run_name in run_names:
+        row = await ctx.db.fetchone(
+            "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+            (project_id, run_name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"Run {run_name} does not exist")
+        if not RunStatus(row["status"]).is_finished():
+            raise ServerError(f"Run {run_name} is not finished")
+        await ctx.db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))

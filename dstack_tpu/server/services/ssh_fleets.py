@@ -1,0 +1,199 @@
+"""SSH fleet host deployment: bootstrap the shim agent over SSH.
+
+Parity: src/dstack/_internal/server/background/tasks/
+process_instances.py:210-428 (_add_remote: paramiko connect, install shim as
+a systemd unit, read host_info.json, healthcheck) — using the OpenSSH binary
+instead of paramiko (not in this image). TPU-first: host inventory reports
+chips via /dev/accel* + tpu-info rather than nvidia-smi.
+"""
+
+import json
+import logging
+import shlex
+from typing import Optional
+
+import sqlite3
+
+from dstack_tpu.agents.protocol import SHIM_PORT, HostInfo
+from dstack_tpu.errors import SSHError
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.instances import (
+    InstanceStatus,
+    InstanceType,
+    RemoteConnectionInfo,
+    Resources,
+)
+from dstack_tpu.models.runs import JobProvisioningData
+from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+from dstack_tpu.utils.ssh import SSHTarget, ssh_execute
+
+logger = logging.getLogger(__name__)
+
+SYSTEMD_UNIT = """\
+[Unit]
+Description=dstack-tpu shim
+After=network.target
+
+[Service]
+ExecStart=/usr/local/bin/dstack-tpu-shim --home /var/lib/dstack-tpu --pjrt-device TPU
+Restart=always
+RestartSec=2
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+HOST_INFO_SCRIPT = r"""
+python3 - <<'EOF'
+import json, os
+info = {
+    "cpus": os.cpu_count() or 0,
+    "memory_mib": 0,
+    "disk_size_mib": 0,
+    "tpu_chip_count": 0,
+    "tpu_accelerator_type": None,
+    "addresses": [],
+}
+try:
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal"):
+                info["memory_mib"] = int(line.split()[1]) // 1024
+except OSError:
+    pass
+try:
+    st = os.statvfs("/")
+    info["disk_size_mib"] = st.f_blocks * st.f_frsize // (1024 * 1024)
+except OSError:
+    pass
+try:
+    info["tpu_chip_count"] = len([d for d in os.listdir("/dev") if d.startswith("accel")])
+except OSError:
+    pass
+env_path = "/var/lib/tpu/env.json"
+if os.path.exists(env_path):
+    try:
+        info["tpu_accelerator_type"] = json.load(open(env_path)).get("ACCELERATOR_TYPE")
+    except Exception:
+        pass
+if info["tpu_accelerator_type"] is None:
+    at = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if at:
+        info["tpu_accelerator_type"] = at
+print(json.dumps(info))
+EOF
+"""
+
+
+def _target_from_rci(rci: RemoteConnectionInfo) -> SSHTarget:
+    return SSHTarget(
+        hostname=rci.host,
+        username=rci.ssh_user,
+        port=rci.port,
+        identity_file=rci.identity_file,
+        private_key=rci.ssh_private_key,
+    )
+
+
+async def deploy_ssh_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """PENDING ssh-fleet instance -> deploy agents -> IDLE."""
+    created = parse_dt(row["created_at"])
+    if (utcnow() - created).total_seconds() > settings.INSTANCE_PROVISIONING_TIMEOUT:
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'terminated', termination_reason = ?,"
+            " finished_at = ? WHERE id = ?",
+            ("ssh deploy timed out", utcnow_iso(), row["id"]),
+        )
+        return
+    rci = RemoteConnectionInfo.model_validate_json(row["remote_connection_info"])
+    target = _target_from_rci(rci)
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    try:
+        host_info_raw = await ssh_execute(target, HOST_INFO_SCRIPT, timeout=60)
+        host_info = HostInfo.model_validate(json.loads(host_info_raw.strip().splitlines()[-1]))
+        authorized_key = project_row["ssh_public_key"].strip()
+        setup = (
+            "mkdir -p ~/.ssh && chmod 700 ~/.ssh && "
+            f"grep -qF {shlex.quote(authorized_key)} ~/.ssh/authorized_keys 2>/dev/null || "
+            f"echo {shlex.quote(authorized_key)} >> ~/.ssh/authorized_keys"
+        )
+        await ssh_execute(target, setup, timeout=30)
+        deployer = ctx.overrides.get("ssh_shim_deployer")
+        if deployer is not None:
+            await deployer(target, row)  # tests inject a local agent here
+        else:
+            await _install_shim_systemd(target)
+    except SSHError as e:
+        logger.info("ssh deploy of %s failed (will retry): %s", rci.host, e)
+        return
+    resources = Resources(
+        cpus=host_info.cpus,
+        memory_mib=host_info.memory_mib,
+        disk_size_mib=host_info.disk_size_mib or 102400,
+        tpu=(
+            TpuTopology.parse(host_info.tpu_accelerator_type)
+            if host_info.tpu_accelerator_type
+            else None
+        ),
+    )
+    jpd = JobProvisioningData(
+        backend=BackendType.SSH,
+        instance_type=InstanceType(name="ssh", resources=resources),
+        instance_id=f"ssh-{row['id'][:8]}",
+        hostname=rci.host,
+        internal_ip=rci.internal_ip or rci.host,
+        region="remote",
+        price=0.0,
+        username=rci.ssh_user,
+        ssh_port=rci.port,
+        dockerized=True,
+        backend_data=None,
+    )
+    from dstack_tpu.models.instances import (
+        InstanceAvailability,
+        InstanceOfferWithAvailability,
+    )
+
+    offer = InstanceOfferWithAvailability(
+        backend=BackendType.SSH,
+        instance=jpd.instance_type,
+        region="remote",
+        price=0.0,
+        hosts=1,
+        availability=InstanceAvailability.IDLE,
+    )
+    await ctx.db.execute(
+        "UPDATE instances SET status = ?, backend = ?, region = 'remote', price = 0,"
+        " offer = ?, job_provisioning_data = ?, started_at = ?, last_processed_at = ?"
+        " WHERE id = ?",
+        (
+            InstanceStatus.IDLE.value,
+            BackendType.SSH.value,
+            offer.model_dump_json(),
+            jpd.model_dump_json(),
+            utcnow_iso(),
+            utcnow_iso(),
+            row["id"],
+        ),
+    )
+    logger.info(
+        "ssh host %s deployed: %s cpus, %s chips (%s)",
+        rci.host, host_info.cpus, host_info.tpu_chip_count,
+        host_info.tpu_accelerator_type,
+    )
+
+
+async def _install_shim_systemd(target: SSHTarget) -> None:
+    """Install + start the shim as a systemd unit (reference
+    remote/provisioning.py:98-138)."""
+    cmds = (
+        "sudo mkdir -p /var/lib/dstack-tpu /usr/local/bin && "
+        f"sudo tee /etc/systemd/system/dstack-tpu-shim.service >/dev/null <<'EOF'\n{SYSTEMD_UNIT}EOF\n"
+        "sudo systemctl daemon-reload && sudo systemctl enable --now dstack-tpu-shim"
+    )
+    await ssh_execute(target, cmds, timeout=120)

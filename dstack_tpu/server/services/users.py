@@ -1,0 +1,103 @@
+"""User management service.
+
+Parity: src/dstack/_internal/server/services/users.py.
+"""
+
+from datetime import datetime, timezone
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.errors import ForbiddenError, ResourceExistsError, ResourceNotExistsError
+from dstack_tpu.models.users import GlobalRole, User, UserTokenCreds, UserWithCreds
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id, generate_token
+
+
+def _row_to_user(row: sqlite3.Row) -> User:
+    return User(
+        id=row["id"],
+        username=row["username"],
+        global_role=GlobalRole(row["global_role"]),
+        email=row["email"],
+        created_at=datetime.fromisoformat(row["created_at"]),
+        active=bool(row["active"]),
+    )
+
+
+async def get_user_by_token(ctx: ServerContext, token: str) -> Optional[User]:
+    if not token:
+        return None
+    row = await ctx.db.fetchone("SELECT * FROM users WHERE token = ? AND active = 1", (token,))
+    return _row_to_user(row) if row else None
+
+
+async def get_user_by_name(ctx: ServerContext, username: str) -> Optional[User]:
+    row = await ctx.db.fetchone("SELECT * FROM users WHERE username = ?", (username,))
+    return _row_to_user(row) if row else None
+
+
+async def list_users(ctx: ServerContext) -> List[User]:
+    rows = await ctx.db.fetchall("SELECT * FROM users ORDER BY username")
+    return [_row_to_user(r) for r in rows]
+
+
+async def create_user(
+    ctx: ServerContext,
+    username: str,
+    global_role: GlobalRole = GlobalRole.USER,
+    email: Optional[str] = None,
+    token: Optional[str] = None,
+) -> UserWithCreds:
+    existing = await get_user_by_name(ctx, username)
+    if existing is not None:
+        raise ResourceExistsError(f"User {username} already exists")
+    token = token or generate_token()
+    user_id = generate_id()
+    await ctx.db.execute(
+        "INSERT INTO users (id, username, global_role, email, token, active, created_at)"
+        " VALUES (?, ?, ?, ?, ?, 1, ?)",
+        (user_id, username, global_role.value, email, token,
+         datetime.now(timezone.utc).isoformat()),
+    )
+    user = await get_user_by_name(ctx, username)
+    return UserWithCreds(**user.model_dump(), creds=UserTokenCreds(token=token))
+
+
+async def get_user_with_creds(
+    ctx: ServerContext, actor: User, username: str
+) -> UserWithCreds:
+    if actor.global_role != GlobalRole.ADMIN and actor.username != username:
+        raise ForbiddenError()
+    row = await ctx.db.fetchone("SELECT * FROM users WHERE username = ?", (username,))
+    if row is None:
+        raise ResourceNotExistsError(f"User {username} does not exist")
+    user = _row_to_user(row)
+    return UserWithCreds(**user.model_dump(), creds=UserTokenCreds(token=row["token"]))
+
+
+async def refresh_token(ctx: ServerContext, actor: User, username: str) -> UserWithCreds:
+    if actor.global_role != GlobalRole.ADMIN and actor.username != username:
+        raise ForbiddenError()
+    token = generate_token()
+    n = await ctx.db.execute("UPDATE users SET token = ? WHERE username = ?", (token, username))
+    if n == 0:
+        raise ResourceNotExistsError(f"User {username} does not exist")
+    return await get_user_with_creds(ctx, actor, username)
+
+
+async def delete_users(ctx: ServerContext, usernames: List[str]) -> None:
+    qs = ",".join("?" for _ in usernames)
+    await ctx.db.execute(f"UPDATE users SET active = 0 WHERE username IN ({qs})", usernames)
+
+
+async def get_or_create_admin(ctx: ServerContext, token: Optional[str] = None) -> UserWithCreds:
+    user = await get_user_by_name(ctx, "admin")
+    if user is None:
+        return await create_user(ctx, "admin", GlobalRole.ADMIN, token=token)
+    if token is not None:
+        await ctx.db.execute("UPDATE users SET token = ? WHERE username = 'admin'", (token,))
+    row = await ctx.db.fetchone("SELECT * FROM users WHERE username = 'admin'")
+    return UserWithCreds(
+        **_row_to_user(row).model_dump(), creds=UserTokenCreds(token=row["token"])
+    )

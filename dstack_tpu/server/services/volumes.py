@@ -1,0 +1,124 @@
+"""Volumes service. Parity: src/dstack/_internal/server/services/volumes.py."""
+
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.errors import ResourceExistsError, ResourceNotExistsError, ServerError
+from dstack_tpu.models.volumes import (
+    Volume,
+    VolumeAttachmentData,
+    VolumeConfiguration,
+    VolumeProvisioningData,
+    VolumeStatus,
+)
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.utils.common import parse_dt, utcnow_iso
+
+
+async def volume_row_to_volume(ctx: ServerContext, row: sqlite3.Row) -> Volume:
+    attachments = await ctx.db.fetchall(
+        "SELECT i.name FROM volume_attachments va JOIN instances i ON i.id = va.instance_id"
+        " WHERE va.volume_id = ?",
+        (row["id"],),
+    )
+    return Volume(
+        id=row["id"],
+        name=row["name"],
+        project_name="",
+        configuration=VolumeConfiguration.model_validate_json(row["configuration"]),
+        external=bool(row["external"]),
+        created_at=parse_dt(row["created_at"]),
+        status=VolumeStatus(row["status"]),
+        status_message=row["status_message"],
+        volume_id=row["volume_id"],
+        provisioning_data=(
+            VolumeProvisioningData.model_validate_json(row["provisioning_data"])
+            if row["provisioning_data"]
+            else None
+        ),
+        attachment_data=(
+            VolumeAttachmentData.model_validate_json(row["attachment_data"])
+            if row["attachment_data"]
+            else None
+        ),
+        attached_to=[a["name"] for a in attachments],
+        deleted=bool(row["deleted"]),
+    )
+
+
+async def create_volume(
+    ctx: ServerContext, project_id: str, configuration: VolumeConfiguration
+) -> Volume:
+    name = configuration.name or f"volume-{generate_id()[:8]}"
+    existing = await ctx.db.fetchone(
+        "SELECT id FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, name),
+    )
+    if existing is not None:
+        raise ResourceExistsError(f"Volume {name} already exists")
+    volume_id = generate_id()
+    now = utcnow_iso()
+    await ctx.db.execute(
+        "INSERT INTO volumes (id, project_id, name, status, configuration, external,"
+        " created_at, last_processed_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            volume_id,
+            project_id,
+            name,
+            VolumeStatus.SUBMITTED.value,
+            configuration.model_dump_json(),
+            1 if configuration.volume_id else 0,
+            now,
+            now,
+        ),
+    )
+    ctx.kick("volumes")
+    row = await ctx.db.fetchone("SELECT * FROM volumes WHERE id = ?", (volume_id,))
+    return await volume_row_to_volume(ctx, row)
+
+
+async def list_volumes(ctx: ServerContext, project_id: str) -> List[Volume]:
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM volumes WHERE project_id = ? AND deleted = 0 ORDER BY name",
+        (project_id,),
+    )
+    return [await volume_row_to_volume(ctx, r) for r in rows]
+
+
+async def get_volume(ctx: ServerContext, project_id: str, name: str) -> Volume:
+    row = await get_volume_row(ctx, project_id, name)
+    return await volume_row_to_volume(ctx, row)
+
+
+async def get_volume_row(ctx: ServerContext, project_id: str, name: str) -> sqlite3.Row:
+    row = await ctx.db.fetchone(
+        "SELECT * FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_id, name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"Volume {name} does not exist")
+    return row
+
+
+async def delete_volumes(ctx: ServerContext, project_id: str, names: List[str]) -> None:
+    from dstack_tpu.server.services import backends as backends_service
+
+    for name in names:
+        row = await get_volume_row(ctx, project_id, name)
+        attachments = await ctx.db.fetchall(
+            "SELECT id FROM volume_attachments WHERE volume_id = ?", (row["id"],)
+        )
+        if attachments:
+            raise ServerError(f"Volume {name} is attached; detach it first")
+        volume = await volume_row_to_volume(ctx, row)
+        if not volume.external and volume.status == VolumeStatus.ACTIVE:
+            try:
+                compute = await backends_service.get_project_backend(
+                    ctx, project_id, volume.configuration.backend
+                )
+                await compute.delete_volume(volume)
+            except Exception:
+                pass
+        await ctx.db.execute("UPDATE volumes SET deleted = 1 WHERE id = ?", (row["id"],))

@@ -1,0 +1,49 @@
+"""Server settings from environment variables.
+
+Parity: src/dstack/_internal/server/settings.py:1-73 (DSTACK_SERVER_* vars);
+same knobs, TPU-flavoured defaults.
+"""
+
+import os
+from pathlib import Path
+
+SERVER_DIR_PATH = Path(os.getenv("DSTACK_TPU_SERVER_DIR", "~/.dstack-tpu/server")).expanduser()
+
+SERVER_HOST = os.getenv("DSTACK_TPU_SERVER_HOST", "127.0.0.1")
+SERVER_PORT = int(os.getenv("DSTACK_TPU_SERVER_PORT", "3000"))
+
+SERVER_URL = os.getenv("DSTACK_TPU_SERVER_URL", f"http://{SERVER_HOST}:{SERVER_PORT}")
+
+DEFAULT_PROJECT_NAME = "main"
+
+SERVER_ADMIN_TOKEN = os.getenv("DSTACK_TPU_SERVER_ADMIN_TOKEN")
+
+# Background processing capacity (reference: background/__init__.py:40-46
+# documents 150 active jobs/runs/instances per replica at 2-4s ticks; the
+# event-driven scheduler here has no per-tick batch caps, these bound
+# concurrent FSM steps instead).
+MAX_CONCURRENT_JOB_STEPS = int(os.getenv("DSTACK_TPU_MAX_CONCURRENT_JOB_STEPS", "64"))
+MAX_CONCURRENT_PROVISIONS = int(os.getenv("DSTACK_TPU_MAX_CONCURRENT_PROVISIONS", "32"))
+
+# FSM tick intervals, seconds (reference: 2-4s with jitter).
+PROCESS_RUNS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_RUNS_INTERVAL", "1.0"))
+PROCESS_JOBS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_JOBS_INTERVAL", "1.0"))
+PROCESS_INSTANCES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_INSTANCES_INTERVAL", "2.0"))
+PROCESS_METRICS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_METRICS_INTERVAL", "10.0"))
+PROCESS_VOLUMES_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_VOLUMES_INTERVAL", "5.0"))
+PROCESS_FLEETS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_FLEETS_INTERVAL", "10.0"))
+PROCESS_GATEWAYS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_GATEWAYS_INTERVAL", "10.0"))
+
+METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL_SECONDS", "3600"))
+
+# Provisioning deadlines, seconds.
+RUNNER_READY_TIMEOUT = int(os.getenv("DSTACK_TPU_RUNNER_READY_TIMEOUT", "600"))
+INSTANCE_PROVISIONING_TIMEOUT = int(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))
+INSTANCE_UNREACHABLE_DEADLINE = int(os.getenv("DSTACK_TPU_UNREACHABLE_DEADLINE", "1200"))
+RETRY_PENDING_RUN_DELAY = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY", "15"))
+
+ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); identity if unset
+
+
+def get_db_path() -> str:
+    return os.getenv("DSTACK_TPU_DB", str(SERVER_DIR_PATH / "data" / "sqlite.db"))

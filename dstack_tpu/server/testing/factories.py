@@ -1,0 +1,90 @@
+"""Test factories — parity: src/tests (reference) server/testing/common.py
+(create_user/project/run/job/instance/... :96-803), adapted to the sqlite
+layer. Used by the framework's own tests and available to users."""
+
+import json
+from typing import Optional
+
+from dstack_tpu.models.configurations import parse_run_configuration
+from dstack_tpu.models.instances import InstanceStatus
+from dstack_tpu.models.runs import JobStatus, RunSpec, RunStatus
+from dstack_tpu.models.users import GlobalRole, User
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import users as users_service
+from dstack_tpu.utils.common import utcnow_iso
+
+
+async def create_user(
+    ctx: ServerContext, username: str = "test-user", role: GlobalRole = GlobalRole.ADMIN
+):
+    return await users_service.create_user(ctx, username, role)
+
+
+async def create_project(ctx: ServerContext, user, project_name: str = "test-proj"):
+    plain_user = User(**{k: v for k, v in user.model_dump().items() if k != "creds"})
+    return await projects_service.create_project(ctx, plain_user, project_name)
+
+
+def make_task_run_spec(
+    commands=None,
+    run_name: Optional[str] = "test-run",
+    nodes: int = 1,
+    tpu: Optional[str] = None,
+    **conf_extra,
+) -> RunSpec:
+    conf = {
+        "type": "task",
+        "commands": commands or ["echo hello"],
+        "nodes": nodes,
+        **conf_extra,
+    }
+    if tpu is not None:
+        conf["resources"] = {"tpu": tpu, "cpu": "1..", "memory": "0.1.."}
+    else:
+        conf.setdefault("resources", {"cpu": "1..", "memory": "0.1..", "disk": None})
+    return RunSpec(
+        run_name=run_name,
+        configuration=parse_run_configuration(conf),
+        ssh_key_pub="ssh-rsa TESTKEY",
+    )
+
+
+async def create_run_row(
+    ctx: ServerContext,
+    project_id: str,
+    user_id: str,
+    run_spec: RunSpec,
+    status: RunStatus = RunStatus.SUBMITTED,
+) -> str:
+    run_id = generate_id()
+    now = utcnow_iso()
+    await ctx.db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+        " last_processed_at, status, run_spec) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        (run_id, project_id, user_id, run_spec.run_name, now, now, status.value,
+         run_spec.model_dump_json()),
+    )
+    return run_id
+
+
+async def create_job_row(
+    ctx: ServerContext,
+    project_id: str,
+    run_id: str,
+    run_name: str,
+    job_spec,
+    status: JobStatus = JobStatus.SUBMITTED,
+    replica_num: int = 0,
+) -> str:
+    job_id = generate_id()
+    now = utcnow_iso()
+    await ctx.db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " submitted_at, last_processed_at, status, job_spec)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (job_id, project_id, run_id, run_name, job_spec.job_num, replica_num,
+         now, now, status.value, job_spec.model_dump_json()),
+    )
+    return job_id

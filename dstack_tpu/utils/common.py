@@ -1,0 +1,61 @@
+"""Small shared helpers (time, sizes, run-name generation)."""
+
+import random
+import re
+import string
+from datetime import datetime, timezone
+from typing import Optional
+
+
+def utcnow() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def utcnow_iso() -> str:
+    return utcnow().isoformat()
+
+
+def parse_dt(v: Optional[str]) -> Optional[datetime]:
+    if v is None:
+        return None
+    dt = datetime.fromisoformat(v)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
+
+
+_ADJECTIVES = [
+    "ancient", "bold", "brave", "bright", "calm", "clever", "cosmic", "crisp",
+    "eager", "fast", "fierce", "fuzzy", "gentle", "happy", "keen", "lively",
+    "lucid", "mellow", "nimble", "proud", "quiet", "rapid", "sharp", "shiny",
+    "swift", "vivid", "warm", "wise", "witty", "zesty",
+]
+_NOUNS = [
+    "antelope", "badger", "bison", "cheetah", "condor", "coral", "crane",
+    "dolphin", "falcon", "fox", "gazelle", "heron", "ibex", "jaguar", "koala",
+    "lemur", "lynx", "marmot", "mole", "narwhal", "orca", "otter", "panda",
+    "puffin", "quokka", "raven", "seal", "tapir", "toucan", "walrus",
+]
+
+
+def generate_run_name() -> str:
+    return f"{random.choice(_ADJECTIVES)}-{random.choice(_NOUNS)}-{random.randint(1, 99)}"
+
+
+def random_suffix(n: int = 8) -> str:
+    return "".join(random.choices(string.ascii_lowercase + string.digits, k=n))
+
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9-]{1,58}[a-z0-9]$")
+
+
+def is_valid_resource_name(name: str) -> bool:
+    return bool(_NAME_RE.fullmatch(name))
+
+
+def pretty_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"

@@ -1,0 +1,172 @@
+"""SSH primitives: keypair generation and subprocess-based tunnels.
+
+The reference shells out to OpenSSH for tunnels (core/services/ssh/tunnel.py)
+and uses paramiko for remote provisioning. paramiko is not in this image, so
+both tunnels and remote exec go through the `ssh` binary here.
+"""
+
+import asyncio
+import os
+import shlex
+import subprocess
+import tempfile
+from contextlib import asynccontextmanager
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+from dstack_tpu.errors import SSHError
+
+
+def generate_rsa_keypair() -> Tuple[str, str]:
+    """(private_pem, public_openssh)."""
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    private_pem = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.TraditionalOpenSSL,
+        encryption_algorithm=serialization.NoEncryption(),
+    ).decode()
+    public_openssh = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH,
+    ).decode()
+    return private_pem, public_openssh + " dstack-tpu"
+
+
+_SSH_OPTS = [
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "LogLevel=ERROR",
+    "-o", "ServerAliveInterval=15",
+    "-o", "ConnectTimeout=10",
+]
+
+
+@dataclass
+class PortForward:
+    local_port: int
+    remote_host: str
+    remote_port: int
+
+
+@dataclass
+class SSHTarget:
+    hostname: str
+    username: str = "root"
+    port: int = 22
+    identity_file: Optional[str] = None
+    private_key: Optional[str] = None  # written to a temp file when set
+    proxy: Optional["SSHTarget"] = None
+
+
+class SSHTunnel:
+    """`ssh -N -L ...` tunnel as a child process.
+
+    Parity: reference core/services/ssh/tunnel.py:61-265 (which also drives
+    the OpenSSH client); control-socket multiplexing included.
+    """
+
+    def __init__(self, target: SSHTarget, forwards: List[PortForward]):
+        self.target = target
+        self.forwards = forwards
+        self._proc: Optional[subprocess.Popen] = None
+        self._tmp: Optional[tempfile.TemporaryDirectory] = None
+
+    def _build_cmd(self) -> List[str]:
+        cmd = ["ssh", "-N", *_SSH_OPTS]
+        key_file = self.target.identity_file
+        if self.target.private_key and not key_file:
+            assert self._tmp is not None
+            key_file = os.path.join(self._tmp.name, "id")
+            with open(key_file, "w") as f:
+                f.write(self.target.private_key)
+            os.chmod(key_file, 0o600)
+        if key_file:
+            cmd += ["-i", key_file]
+        if self.target.proxy is not None:
+            proxy = self.target.proxy
+            cmd += ["-J", f"{proxy.username}@{proxy.hostname}:{proxy.port}"]
+        for fwd in self.forwards:
+            cmd += ["-L", f"127.0.0.1:{fwd.local_port}:{fwd.remote_host}:{fwd.remote_port}"]
+        cmd += ["-p", str(self.target.port), f"{self.target.username}@{self.target.hostname}"]
+        return cmd
+
+    async def open(self, timeout: float = 20.0) -> None:
+        self._tmp = tempfile.TemporaryDirectory()
+        cmd = self._build_cmd()
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
+        )
+        # Wait until the local port accepts connections.
+        deadline = asyncio.get_event_loop().time() + timeout
+        port = self.forwards[0].local_port if self.forwards else None
+        while port is not None:
+            if self._proc.poll() is not None:
+                err = self._proc.stderr.read().decode() if self._proc.stderr else ""
+                raise SSHError(f"ssh tunnel failed: {err.strip()}")
+            try:
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                writer.close()
+                break
+            except OSError:
+                if asyncio.get_event_loop().time() > deadline:
+                    self.close()
+                    raise SSHError("ssh tunnel timed out")
+                await asyncio.sleep(0.2)
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+            self._tmp = None
+
+
+@asynccontextmanager
+async def ssh_tunnel(target: SSHTarget, forwards: List[PortForward]) -> AsyncIterator[SSHTunnel]:
+    tunnel = SSHTunnel(target, forwards)
+    await tunnel.open()
+    try:
+        yield tunnel
+    finally:
+        tunnel.close()
+
+
+async def ssh_execute(target: SSHTarget, command: str, timeout: float = 60.0) -> str:
+    """Run a command on a remote host; returns stdout, raises SSHError on failure."""
+    with tempfile.TemporaryDirectory() as tmp:
+        cmd = ["ssh", *_SSH_OPTS]
+        key_file = target.identity_file
+        if target.private_key and not key_file:
+            key_file = os.path.join(tmp, "id")
+            with open(key_file, "w") as f:
+                f.write(target.private_key)
+            os.chmod(key_file, 0o600)
+        if key_file:
+            cmd += ["-i", key_file]
+        cmd += ["-p", str(target.port), f"{target.username}@{target.hostname}", command]
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE
+        )
+        try:
+            stdout, stderr = await asyncio.wait_for(proc.communicate(), timeout)
+        except asyncio.TimeoutError:
+            proc.kill()
+            raise SSHError(f"ssh command timed out: {command}")
+        if proc.returncode != 0:
+            raise SSHError(f"ssh failed ({proc.returncode}): {stderr.decode().strip()}")
+        return stdout.decode()
+
+
+def find_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

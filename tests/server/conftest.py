@@ -1,0 +1,23 @@
+import pytest
+
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.http import TestClient
+
+
+class ServerFixture:
+    def __init__(self, app):
+        self.app = app
+        self.ctx = app.state["ctx"]
+        self.client = TestClient(app)
+
+    @property
+    def admin_token(self) -> str:
+        return self.app.state["admin_token"]
+
+
+async def make_server(run_background_tasks: bool = True) -> ServerFixture:
+    app = create_app(db_path=":memory:", run_background_tasks=run_background_tasks)
+    await app.startup()
+    fx = ServerFixture(app)
+    fx.client.token = fx.admin_token
+    return fx

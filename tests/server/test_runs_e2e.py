@@ -1,0 +1,187 @@
+"""End-to-end run pipeline tests against the local (process) backend.
+
+Parity with the reference's background-task tests
+(src/tests/_internal/server/background/tasks/) but stronger: jobs actually
+execute as processes through the real runner agent, including a simulated
+multi-host TPU gang.
+"""
+
+import asyncio
+import base64
+
+from dstack_tpu.server.http import response_json
+from tests.server.conftest import make_server
+
+
+def _task_body(commands, run_name, resources=None, nodes=1):
+    conf = {
+        "type": "task",
+        "commands": commands,
+        "nodes": nodes,
+        "resources": resources or {"cpu": "1..", "memory": "0.1.."},
+    }
+    return {
+        "run_spec": {
+            "run_name": run_name,
+            "configuration": conf,
+            "ssh_key_pub": "ssh-rsa TEST",
+        }
+    }
+
+
+async def _wait_run(fx, run_name, target_statuses, timeout=30.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        resp = await fx.client.post(
+            "/api/project/main/runs/get", json_body={"run_name": run_name}
+        )
+        assert resp.status == 200, resp.body
+        run = response_json(resp)
+        if run["status"] in target_statuses:
+            return run
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"run stuck in {run['status']}; jobs: "
+                + str([
+                    (j['job_submissions'][-1]['status'],
+                     j['job_submissions'][-1]['termination_reason_message'])
+                    for j in run['jobs']
+                ])
+            )
+        await asyncio.sleep(0.2)
+
+
+async def test_get_plan_local_offer():
+    fx = await make_server()
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/get_plan",
+            json_body=_task_body(["echo hi"], "plan-run"),
+        )
+        assert resp.status == 200, resp.body
+        plan = response_json(resp)
+        assert plan["job_plans"][0]["total_offers"] >= 1
+        assert plan["job_plans"][0]["offers"][0]["backend"] == "local"
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_single_job_run_to_done():
+    fx = await make_server()
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(["echo 'hello world'", "echo done"], "cpu-run"),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(fx, "cpu-run", {"done", "failed", "terminated"})
+        assert run["status"] == "done", run
+        sub = run["jobs"][0]["job_submissions"][-1]
+        assert sub["exit_status"] == 0
+
+        # Logs made it into storage.
+        resp = await fx.client.post(
+            "/api/project/main/logs/poll",
+            json_body={"run_name": "cpu-run", "job_submission_id": sub["id"]},
+        )
+        logs = response_json(resp)["logs"]
+        text = b"".join(base64.b64decode(e["message"]) for e in logs).decode()
+        assert "hello world" in text
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_failed_job_marks_run_failed():
+    fx = await make_server()
+    try:
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(["exit 3"], "fail-run"),
+        )
+        run = await _wait_run(fx, "fail-run", {"done", "failed", "terminated"})
+        assert run["status"] == "failed"
+        sub = run["jobs"][0]["job_submissions"][-1]
+        assert sub["termination_reason"] == "container_exited_with_error"
+        assert sub["exit_status"] == 3
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_stop_run():
+    fx = await make_server()
+    try:
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(["sleep 60"], "stop-run"),
+        )
+        await _wait_run(fx, "stop-run", {"running"})
+        await fx.client.post(
+            "/api/project/main/runs/stop", json_body={"runs_names": ["stop-run"]}
+        )
+        run = await _wait_run(fx, "stop-run", {"terminated", "failed", "done"})
+        assert run["status"] == "terminated"
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_tpu_gang_run_multihost():
+    """A v5litepod-16 task fans out into 4 gang jobs (4 worker hosts), each
+    runner process receives the JAX coordinator env, and the run completes."""
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {"tpu_sim": ["v5litepod-16"]}
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo rank=$JAX_PROCESS_ID of $JAX_NUM_PROCESSES at $JAX_COORDINATOR_ADDRESS"],
+                "tpu-gang",
+                resources={"tpu": "v5litepod-16"},
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = response_json(resp)
+        assert len(run["jobs"]) == 4  # 16 chips / 4 per host
+
+        run = await _wait_run(fx, "tpu-gang", {"done", "failed", "terminated"}, timeout=60)
+        assert run["status"] == "done", run
+
+        texts = []
+        for job in run["jobs"]:
+            sub = job["job_submissions"][-1]
+            resp = await fx.client.post(
+                "/api/project/main/logs/poll",
+                json_body={"run_name": "tpu-gang", "job_submission_id": sub["id"]},
+            )
+            logs = response_json(resp)["logs"]
+            texts.append(
+                b"".join(base64.b64decode(e["message"]) for e in logs).decode()
+            )
+        joined = "\n".join(texts)
+        for rank in range(4):
+            assert f"rank={rank} of 4" in joined, joined
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_gang_member_failure_kills_gang():
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {"tpu_sim": ["v5litepod-16"]}
+    try:
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                # Rank 2 dies; everyone else would sleep forever.
+                ['if [ "$JAX_PROCESS_ID" = "2" ]; then exit 7; else sleep 300; fi'],
+                "gang-fail",
+                resources={"tpu": "v5litepod-16"},
+            ),
+        )
+        run = await _wait_run(fx, "gang-fail", {"failed", "terminated", "done"}, timeout=60)
+        assert run["status"] == "failed"
+        reasons = {
+            j["job_submissions"][-1]["termination_reason"] for j in run["jobs"]
+        }
+        assert "container_exited_with_error" in reasons
+        assert "gang_member_failed" in reasons
+    finally:
+        await fx.app.shutdown()

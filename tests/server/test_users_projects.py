@@ -1,0 +1,103 @@
+from dstack_tpu.server.http import response_json
+from tests.server.conftest import make_server
+
+
+async def test_auth_required():
+    fx = await make_server(run_background_tasks=False)
+    try:
+        resp = await fx.client.post("/api/users/list", token="")
+        assert resp.status == 401
+        resp = await fx.client.post("/api/users/list", token="bogus")
+        assert resp.status == 401
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_admin_and_default_project_created():
+    fx = await make_server(run_background_tasks=False)
+    try:
+        resp = await fx.client.post("/api/users/get_my_user")
+        assert resp.status == 200
+        assert response_json(resp)["username"] == "admin"
+        resp = await fx.client.post("/api/projects/list")
+        names = [p["project_name"] for p in response_json(resp)]
+        assert "main" in names
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_create_user_and_project_membership():
+    fx = await make_server(run_background_tasks=False)
+    try:
+        resp = await fx.client.post(
+            "/api/users/create", json_body={"username": "alice", "global_role": "user"}
+        )
+        assert resp.status == 200
+        alice_token = response_json(resp)["creds"]["token"]
+
+        # Alice is not a member of main.
+        resp = await fx.client.post("/api/projects/main/get", token=alice_token)
+        assert resp.status == 403
+
+        # Alice creates her own project.
+        resp = await fx.client.post(
+            "/api/projects/create", json_body={"project_name": "alice-proj"},
+            token=alice_token,
+        )
+        assert resp.status == 200
+
+        resp = await fx.client.post("/api/projects/alice-proj/get", token=alice_token)
+        assert resp.status == 200
+        data = response_json(resp)
+        assert data["members"][0]["user"]["username"] == "alice"
+        assert data["members"][0]["project_role"] == "admin"
+
+        # Admin adds bob as user.
+        await fx.client.post("/api/users/create", json_body={"username": "bob"})
+        resp = await fx.client.post(
+            "/api/projects/alice-proj/set_members",
+            json_body={
+                "members": [
+                    {"username": "alice", "project_role": "admin"},
+                    {"username": "bob", "project_role": "user"},
+                ]
+            },
+            token=alice_token,
+        )
+        assert resp.status == 200
+        assert len(response_json(resp)["members"]) == 2
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_non_admin_cannot_create_user():
+    fx = await make_server(run_background_tasks=False)
+    try:
+        resp = await fx.client.post(
+            "/api/users/create", json_body={"username": "eve", "global_role": "user"}
+        )
+        eve_token = response_json(resp)["creds"]["token"]
+        resp = await fx.client.post(
+            "/api/users/create", json_body={"username": "mallory"}, token=eve_token
+        )
+        assert resp.status == 403
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_secrets_roundtrip():
+    fx = await make_server(run_background_tasks=False)
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/secrets/create_or_update",
+            json_body={"name": "HF_TOKEN", "value": "s3cret"},
+        )
+        assert resp.status == 200
+        resp = await fx.client.post("/api/project/main/secrets/list")
+        assert response_json(resp) == [{"id": None, "name": "HF_TOKEN"}]
+        resp = await fx.client.post(
+            "/api/project/main/secrets/get", json_body={"name": "HF_TOKEN"}
+        )
+        assert response_json(resp)["value"] == "s3cret"
+    finally:
+        await fx.app.shutdown()
