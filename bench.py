@@ -1,0 +1,131 @@
+"""Benchmark: flagship fine-tune train-step throughput vs bare-metal JAX.
+
+The north-star target (BASELINE.md) is "tokens/s within 5% of bare-metal TPU
+VM": the orchestrator must add nothing on the compute path. This bench
+measures the framework's sharded train step (the exact fn
+`dstack_tpu.workloads.train.make_train_step` gives every launched job, with
+its NamedSharding pinning, donation, and ring-attention dispatch machinery)
+against a hand-written bare jax.jit of the same math, on the same chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+value = framework tokens/s and vs_baseline = framework/bare ratio
+(target >= 0.95; ~1.0 expected since both lower to the same XLA program).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.sharding import make_mesh
+from dstack_tpu.workloads.train import (
+    TrainState,
+    init_train_state,
+    loss_fn,
+    make_optimizer,
+    make_train_step,
+    synthetic_batch,
+)
+from dstack_tpu.workloads.transformer import init_params
+
+WARMUP = 2
+CHUNK = 8  # steps per timed chunk; one host readback forces the chain
+CHUNKS = 3
+
+
+def _bench(step_fn, state, batch) -> float:
+    """Median seconds/step.
+
+    Each step consumes the previous (donated) state, so the chain is
+    serialized on device; reading the final loss back to the host forces
+    the whole chain. On tunneled platforms `block_until_ready` alone does
+    not guarantee remote execution finished, and a per-step readback would
+    be dominated by tunnel round-trips — so time CHUNK steps per readback.
+    """
+    for _ in range(WARMUP):
+        state, m = step_fn(state, batch)
+    float(m["loss"])
+    times = []
+    for _ in range(CHUNKS):
+        t0 = time.perf_counter()
+        for _ in range(CHUNK):
+            state, m = step_fn(state, batch)
+        float(m["loss"])
+        times.append((time.perf_counter() - t0) / CHUNK)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        # ~0.5B params: fits params + f32 Adam moments for both the
+        # framework state and the bare-baseline state on one 16GB chip.
+        config = PRESETS["smol-1b"].with_(n_layers=8)
+        batch_size, seq_len = 4, 1024
+    else:  # keep CI/CPU runs quick
+        config = PRESETS["tiny"]
+        batch_size, seq_len = 4, 128
+
+    tokens_per_step = batch_size * seq_len
+
+    # --- framework path: the step every orchestrated job runs -------------
+    mesh = make_mesh(jax.devices()[:1])  # single chip: 1x1x1x1 mesh
+    state = init_train_state(config, jax.random.PRNGKey(0), mesh=mesh)
+    step = make_train_step(config, mesh)
+    batch = synthetic_batch(config, batch_size, seq_len, mesh=mesh)
+    fw_sec = _bench(step, state, batch)
+    del state, batch
+    import gc
+
+    gc.collect()
+
+    # --- bare-metal baseline: hand-rolled jit of the same math ------------
+    optimizer = make_optimizer()
+    params = init_params(config, jax.random.PRNGKey(0))
+    bare_state = TrainState(
+        jnp.zeros((), jnp.int32), params, optimizer.init(params)
+    )
+
+    @jax.jit
+    def bare_step(state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(config, p, batch)
+        )(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(state.step + 1, new_params, opt_state), {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+
+    bare_batch = synthetic_batch(config, batch_size, seq_len)
+    bare_sec = _bench(bare_step, bare_state, bare_batch)
+
+    fw_tps = tokens_per_step / fw_sec
+    bare_tps = tokens_per_step / bare_sec
+    mfu_note = config.flops_per_token() * fw_tps / 1e12
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_tokens_per_s",
+                "value": round(fw_tps, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(fw_tps / bare_tps, 4),
+            }
+        )
+    )
+    # Context (not parsed by the driver): per-device TFLOP/s achieved.
+    print(
+        f"# {config.dtype} {'TPU' if on_tpu else 'CPU'} bare={bare_tps:.1f} tok/s "
+        f"framework={fw_tps:.1f} tok/s ~{mfu_note:.1f} TFLOP/s",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,9 +2,11 @@
 
 Maps a TpuTopology (physical: hosts x chips-per-host over ICI) to logical
 `jax.sharding.Mesh` axis layouts for common parallelism styles (dp/fsdp/tp).
-These helpers are used by the bundled example workloads
-(dstack_tpu/workloads/) and by `__graft_entry__.dryrun_multichip`; user code
-is free to build its own mesh — every chip in a slice is ICI-connected.
+This is the orchestrator-side planner (offer display, docs, sanity checks);
+`plan_mesh`'s `{axis: size}` output feeds
+`dstack_tpu.workloads.sharding.make_mesh`, which builds the actual Mesh
+inside a job. User code is free to build its own mesh — every chip in a
+slice is ICI-connected.
 """
 
 from typing import Dict, Optional, Sequence, Tuple

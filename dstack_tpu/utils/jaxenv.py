@@ -1,0 +1,28 @@
+"""Escape hatch for environments that pin JAX to a tunneled single chip.
+
+A sitecustomize may import jax at interpreter start and register a remote
+single-chip TPU platform, ignoring `JAX_PLATFORMS` set later. Multi-device
+tests and dry runs need the virtual CPU platform instead; this helper is the
+single place that knows the full recipe (env vars + live-config override +
+dropping any already-initialized backend). XLA parses `XLA_FLAGS` once at
+first client creation, so callers that can should also set it before the
+process starts.
+"""
+
+import os
+
+
+def force_virtual_cpu_devices(n_devices: int = 8) -> None:
+    """Point JAX at a CPU platform with `n_devices` virtual devices."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    from jax.extend import backend
+
+    jax.config.update("jax_platforms", "cpu")
+    backend.clear_backends()

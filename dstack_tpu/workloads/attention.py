@@ -1,0 +1,174 @@
+"""Attention: fused local path + ring attention for sequence parallelism.
+
+Long-context is first-class here (the reference's only long-context knob is
+the user's `MAX_MODEL_LEN` vLLM flag — SURVEY §5): when the device mesh has a
+"seq" axis, q/k/v live sequence-sharded on the devices and attention runs as
+a ring — each step computes one block of the streaming-softmax accumulation
+while `jax.lax.ppermute` rotates the k/v shard one hop around the ICI ring,
+overlapping compute with neighbor-to-neighbor transfer (the RDMA pattern in
+pallas_guide "Patterns: Ring Collectives", expressed with XLA collectives so
+the compiler schedules the overlap).
+
+All matmuls accumulate in f32 (`preferred_element_type`) regardless of the
+bf16 storage dtype.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, KV, hd) -> (B, S, KV*n_rep, hd) for grouped-query attention."""
+    if n_rep == 1:
+        return x
+    b, s, kv, hd = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, hd)).reshape(
+        b, s, kv * n_rep, hd
+    )
+
+
+def plain_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Reference-semantics causal attention; XLA fuses this well on one chip.
+
+    q: (B, Sq, H, hd); k/v: (B, Sk, KV, hd). Returns (B, Sq, H, hd).
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attend(q, k, v, mask):
+    """One streaming-softmax block: returns (o_blk, logsumexp-pieces).
+
+    q: (B, Sq, H, hd) local; k/v: (B, Sk, H, hd) (kv already GQA-expanded).
+    mask: (Sq, Sk) bool or None. Returns unnormalised o, plus (m, l) stats.
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # (B, H, Sq)
+    # Guard fully-masked rows (first ring steps of rank-0 queries).
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(logits - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)  # (B, H, Sq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m_safe, l
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool,
+) -> jnp.ndarray:
+    """Per-device body run under shard_map: q/k/v are local seq shards."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    n_rep = q.shape[2] // k.shape[2]
+    b, sq, h, hd = q.shape
+    sk = k.shape[1]
+
+    # Block-level causal masks, selected per ring step by traced scalars:
+    # kv block strictly after my queries -> fully masked; same block ->
+    # lower-triangular; earlier block -> full attend. (Fully-masked rows
+    # come out as l=0/o=0 via the NEG_INF guard in _block_attend.)
+    tril = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+    full = jnp.ones((sq, sk), dtype=bool)
+    empty = jnp.zeros((sq, sk), dtype=bool)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        o, m, l, k_t, v_t = carry
+        # k/v travel the ring unexpanded; GQA-expand only for the local
+        # compute so each ppermute hop moves 1/n_rep of the bytes.
+        k_exp = _repeat_kv(k_t, n_rep)
+        v_exp = _repeat_kv(v_t, n_rep)
+        if causal:
+            kv_idx = (my_idx - t) % n  # whose shard we hold at ring step t
+            mask = jnp.where(
+                kv_idx > my_idx, empty, jnp.where(kv_idx == my_idx, tril, full)
+            )
+        else:
+            mask = None
+        blk_o, blk_m, blk_l = _block_attend(q, k_exp, v_exp, mask)
+        # Streaming-softmax merge of (o,m,l) with the new block.
+        m_new = jnp.maximum(m, blk_m)
+        alpha = jnp.exp(m - m_new)  # rescale old accumulation
+        beta = jnp.exp(blk_m - m_new)
+        l_new = l * alpha + blk_l * beta
+        o_new = (
+            o * alpha.transpose(0, 2, 1)[..., None].astype(o.dtype)
+            + blk_o * beta.transpose(0, 2, 1)[..., None].astype(o.dtype)
+        )
+        # Rotate k/v one hop around the ICI ring (overlaps with next compute).
+        k_nxt = lax.ppermute(k_t, axis_name, perm)
+        v_nxt = lax.ppermute(v_t, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, sq, h, hd), dtype=jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF / 2, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)
+
+
+def make_attention_fn(
+    mesh: Optional[Mesh] = None,
+    *,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    heads_axis: str = "model",
+    causal: bool = True,
+):
+    """Pick the attention implementation for a mesh.
+
+    No mesh / no "seq" axis / seq axis of size 1 -> plain fused attention
+    (XLA shards heads/batch itself from the surrounding constraints).
+    Otherwise -> ring attention under shard_map over the seq axis.
+    """
+    if mesh is None or seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+        return functools.partial(plain_attention, causal=causal)
+
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    heads = heads_axis if heads_axis in mesh.axis_names else None
+    spec = P(batch if batch else None, seq_axis, heads, None)
+    body = functools.partial(
+        _ring_attention_local, axis_name=seq_axis, causal=causal
+    )
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )

@@ -1,0 +1,69 @@
+"""Flagship model configuration (llama-family decoder).
+
+Frozen dataclass so configs are hashable and can ride through `jax.jit`
+static args. Dimensions are kept multiples of 128 so every matmul tiles
+cleanly onto the 128x128 MXU (pallas_guide: Tiling Constraints).
+"""
+
+from dataclasses import dataclass, replace
+from typing import Dict
+
+import jax.numpy as jnp
+
+_DTYPE = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4  # grouped-query attention
+    d_ff: int = 1536
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: str = "bfloat16"
+    remat: bool = True  # jax.checkpoint each block: trade FLOPs for HBM
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def activation_dtype(self):
+        return _DTYPE[self.dtype]
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+    def flops_per_token(self) -> float:
+        """Approximate forward+backward FLOPs per token (3x forward, dense)."""
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd = self.head_dim
+        attn_proj = 2 * d * (self.n_heads + 2 * self.n_kv_heads) * hd + 2 * self.n_heads * hd * d
+        mlp = 3 * 2 * d * f
+        per_layer = attn_proj + mlp
+        embed = 2 * d * v
+        fwd = self.n_layers * per_layer + embed
+        return 3.0 * fwd
+
+
+# Named presets: tiny for tests/dryrun, the rest sized for real slices.
+PRESETS: Dict[str, ModelConfig] = {
+    "tiny": ModelConfig(
+        vocab_size=512, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=256, max_seq_len=256, remat=False,
+    ),
+    # Single v5e/v6e chip fine-tune scale; the bench.py flagship.
+    "smol-1b": ModelConfig(
+        vocab_size=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=5632, max_seq_len=2048,
+    ),
+    # llama-8b-shaped, for v5p-8 and up.
+    "llama-8b": ModelConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192,
+    ),
+}

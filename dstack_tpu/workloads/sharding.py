@@ -1,0 +1,108 @@
+"""Sharding rules for the flagship workload (scaling-book recipe).
+
+Pick a mesh, annotate params + activations with NamedSharding, let XLA
+insert the collectives; the axes follow the standard layout:
+
+  data  — pure data parallelism across slices/hosts (gradient psum on ICI/DCN)
+  fsdp  — data parallelism with weights sharded (all-gather on use,
+          reduce-scatter on grad) — the default way to span hosts
+  seq   — sequence/context parallelism; activations sharded over sequence,
+          attention runs as a ppermute ring (attention.py)
+  model — tensor parallelism within a host's ICI-contiguous chips
+
+Weight matrices are sharded ("fsdp" on the input dim, "model" on the output
+dim) or transposed for the second matmul of each pair, so forward needs only
+all-gathers on "fsdp" and one psum on "model" per block — the layout the
+scaling-book derives for dense transformers.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "fsdp", "seq", "model")
+
+
+def make_mesh(
+    devices=None,
+    *,
+    data: int = 1,
+    fsdp: Optional[int] = None,
+    seq: int = 1,
+    model: int = 1,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    `fsdp=None` absorbs whatever factor remains after data*seq*model.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if fsdp is None:
+        denom = data * seq * model
+        if n % denom:
+            raise ValueError(f"{denom=} does not divide {n} devices")
+        fsdp = n // denom
+    shape = (data, fsdp, seq, model)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh {dict(zip(AXES, shape))} != {n} devices")
+    return Mesh(np.array(devices).reshape(shape), AXES)
+
+
+# Param-tree partition specs; layer stacks carry a leading None (layer dim).
+PARAM_SPECS: Dict[str, Any] = {
+    "embed": P(None, "fsdp"),
+    "layers": {
+        "wq": P(None, "fsdp", "model"),
+        "wk": P(None, "fsdp", "model"),
+        "wv": P(None, "fsdp", "model"),
+        "wo": P(None, "model", "fsdp"),
+        "w_gate": P(None, "fsdp", "model"),
+        "w_up": P(None, "fsdp", "model"),
+        "w_down": P(None, "model", "fsdp"),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    },
+    "final_norm": P(None),
+    "lm_head": P("fsdp", "model"),
+}
+
+# Activations: batch over (data, fsdp), sequence over seq.
+BATCH_SPEC = P(("data", "fsdp"), "seq")
+
+
+def param_shardings(mesh: Mesh, params_like: Any) -> Any:
+    """NamedSharding tree matching a params (or opt-state) pytree.
+
+    Optimizer states mirror their param's spec; scalars are replicated.
+    """
+    specs = _broadcast_specs(params_like)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _broadcast_specs(tree: Any) -> Any:
+    """Map PARAM_SPECS onto an arbitrary pytree shaped like params (e.g. the
+    adam mu/nu trees), replicating anything that isn't a weight array."""
+
+    def spec_for(path: Tuple, leaf: Any) -> P:
+        node: Any = PARAM_SPECS
+        for p in path:
+            key = getattr(p, "key", getattr(p, "name", None))
+            if isinstance(node, dict) and key in node:
+                node = node[key]
+        if isinstance(node, P):
+            if hasattr(leaf, "ndim") and leaf.ndim == len(node):
+                return node
+            return P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def shard_tree(mesh: Mesh, tree: Any) -> Any:
+    """Device_put a pytree with its canonical shardings."""
+    return jax.device_put(tree, param_shardings(mesh, tree))

@@ -1,0 +1,155 @@
+"""Sharded training step for the flagship workload.
+
+`make_train_step(config, mesh)` returns a jitted function whose inputs and
+outputs carry NamedShardings — donate the state, constrain the batch, let
+XLA lay in the all-gathers/reduce-scatters (fsdp), psums (model) and
+ppermutes (seq ring attention). Optimizer is AdamW with f32 moments sharded
+exactly like their params, so optimizer memory scales down with fsdp.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.workloads.attention import make_attention_fn
+from dstack_tpu.workloads.config import ModelConfig
+from dstack_tpu.workloads.sharding import (
+    BATCH_SPEC,
+    param_shardings,
+    shard_tree,
+)
+from dstack_tpu.workloads.transformer import forward, init_params
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1):
+    return optax.adamw(
+        learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay,
+        mu_dtype=jnp.float32,
+    )
+
+
+def init_train_state(
+    config: ModelConfig,
+    key: jax.Array,
+    mesh: Optional[Mesh] = None,
+    learning_rate: float = 3e-4,
+) -> TrainState:
+    params = init_params(config, key)
+    opt_state = make_optimizer(learning_rate).init(params)
+    state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
+    if mesh is not None:
+        state = shard_tree(mesh, state)
+    return state
+
+
+def loss_fn(
+    config: ModelConfig,
+    params: Any,
+    batch: Dict[str, jnp.ndarray],
+    attention_fn=None,
+) -> jnp.ndarray:
+    """Next-token cross-entropy.
+
+    batch: inputs (B, S) int32, targets (B, S) int32, optional loss_mask
+    (B, S). inputs/targets are pre-shifted so both shard evenly over the
+    "seq" mesh axis.
+    """
+    inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(config, params, inputs, attention_fn=attention_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def make_train_step(
+    config: ModelConfig,
+    mesh: Optional[Mesh] = None,
+    learning_rate: float = 3e-4,
+):
+    """Returns `train_step(state, batch) -> (state, metrics)`, jitted.
+
+    With a mesh the returned fn is committed to NamedShardings (in/out) and
+    the state buffer is donated; without one it is a plain single-device jit.
+    """
+    optimizer = make_optimizer(learning_rate)
+    attention_fn = make_attention_fn(mesh)
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(config, p, batch, attention_fn)
+        )(state.params)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(state.step + 1, params, opt_state)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    if mesh is None:
+        return jax.jit(train_step, donate_argnums=0)
+
+    def shardings_of(tree):
+        return param_shardings(mesh, tree)
+
+    # Build sharding pytrees lazily from the first state's structure to pin
+    # in/out layouts (opt-state structure depends on the optimizer).
+    replicated = NamedSharding(mesh, P())
+    data_sharding = NamedSharding(mesh, BATCH_SPEC)
+    _cache = {}
+
+    def jitted(state: TrainState, batch):
+        key = (
+            jax.tree_util.tree_structure(state),
+            tuple(sorted(batch.keys())),
+        )
+        if key not in _cache:
+            state_sh = TrainState(
+                replicated, shardings_of(state.params), shardings_of(state.opt_state)
+            )
+            batch_sh = {k: data_sharding for k in batch}
+            _cache[key] = jax.jit(
+                train_step,
+                in_shardings=(state_sh, batch_sh),
+                out_shardings=(
+                    state_sh,
+                    {"loss": replicated, "grad_norm": replicated},
+                ),
+                donate_argnums=0,
+            )
+        return _cache[key](state, batch)
+
+    return jitted
+
+
+def synthetic_batch(
+    config: ModelConfig,
+    batch_size: int,
+    seq_len: Optional[int] = None,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Deterministic fake pre-shifted token batch: inputs/targets (B, S)."""
+    s = (seq_len or config.max_seq_len) + 1
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(
+        key, (batch_size, s), 0, config.vocab_size, dtype=jnp.int32
+    )
+    batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+    if mesh is not None:
+        sh = NamedSharding(mesh, BATCH_SPEC)
+        batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+    return batch

@@ -1,0 +1,140 @@
+"""Llama-family decoder in pure JAX, written for XLA/TPU.
+
+Design (deliberately not a torch translation):
+- one stacked parameter pytree per weight kind with a leading layer dim,
+  consumed by `lax.scan` — a single traced block regardless of depth, so
+  compile time and HLO size are O(1) in n_layers;
+- `jax.checkpoint` around the scanned block body (policy: keep nothing)
+  trades FLOPs for HBM, the standard TPU remat recipe;
+- bf16 storage, f32 accumulation on the MXU via preferred_element_type;
+- RMSNorm computed in f32;
+- attention is injected (`attention_fn`) so the same forward serves the
+  single-chip fused path and the ring/sequence-parallel path.
+
+Parity target: the reference's fine-tuning examples run llama-style models
+via TRL/torch inside containers (reference: examples/fine-tuning/trl/,
+examples/accelerators/tpu/README.md); this module is the TPU-native
+equivalent workload the orchestrator launches.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dstack_tpu.workloads.attention import plain_attention
+from dstack_tpu.workloads.config import ModelConfig
+
+Params = Dict[str, Any]
+AttentionFn = Callable[..., jnp.ndarray]
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Initialise bf16 params. Layer weights are stacked on axis 0 for scan."""
+    c = config
+    hd = c.head_dim
+    dt = c.activation_dtype
+    keys = jax.random.split(key, 8)
+
+    def norm_init(shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5).astype(dt)
+
+    L, D, F, V = c.n_layers, c.d_model, c.d_ff, c.vocab_size
+    return {
+        "embed": dense(keys[0], (V, D), D),
+        "layers": {
+            "wq": dense(keys[1], (L, D, c.n_heads * hd), D),
+            "wk": dense(keys[2], (L, D, c.n_kv_heads * hd), D),
+            "wv": dense(keys[3], (L, D, c.n_kv_heads * hd), D),
+            "wo": dense(keys[4], (L, c.n_heads * hd, D), c.n_heads * hd),
+            "w_gate": dense(keys[5], (L, D, F), D),
+            "w_up": dense(keys[6], (L, D, F), D),
+            "w_down": dense(keys[7], (L, F, D), F),
+            "attn_norm": norm_init((L, D)),
+            "mlp_norm": norm_init((L, D)),
+        },
+        "final_norm": norm_init((D,)),
+        "lm_head": dense(jax.random.fold_in(key, 99), (D, V), D),
+    }
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms * weight).astype(x.dtype)
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary embedding. x: (B, S, H, hd); positions: (S,) or (B, S)."""
+    hd = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    ang = positions[..., None].astype(jnp.float32) * inv_freq  # (B, S, hd/2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _block(
+    c: ModelConfig,
+    x: jnp.ndarray,
+    p: Params,
+    positions: jnp.ndarray,
+    attention_fn: AttentionFn,
+) -> jnp.ndarray:
+    b, s, d = x.shape
+    hd = c.head_dim
+
+    h = rms_norm(x, p["attn_norm"], c.norm_eps)
+    q = (h @ p["wq"]).reshape(b, s, c.n_heads, hd)
+    k = (h @ p["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = (h @ p["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    attn = attention_fn(q, k, v).reshape(b, s, c.n_heads * hd)
+    x = x + attn @ p["wo"]
+
+    h = rms_norm(x, p["mlp_norm"], c.norm_eps)
+    gate = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    up = h @ p["w_up"]
+    x = x + (gate * up) @ p["w_down"]
+    return x
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    *,
+    attention_fn: Optional[AttentionFn] = None,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """tokens (B, S) int32 -> logits (B, S, V) in f32."""
+    c = config
+    attn = attention_fn or plain_attention
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def body(x, layer_p):
+        return _block(c, x, layer_p, positions, attn), None
+
+    if c.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
+    )
+    return logits

@@ -1,11 +1,11 @@
 import os
 
-# Force JAX onto a virtual 8-device CPU platform BEFORE any jax import so
-# sharding tests exercise real multi-chip code paths without TPU hardware.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Force JAX onto a virtual 8-device CPU platform so sharding tests exercise
+# real multi-chip code paths without TPU hardware (the environment may have
+# pinned JAX to a tunneled single-chip TPU platform at interpreter start).
+from dstack_tpu.utils.jaxenv import force_virtual_cpu_devices
+
+force_virtual_cpu_devices(8)
 
 import asyncio
 import inspect
