@@ -1,0 +1,203 @@
+#include "http.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <sstream>
+
+#include "util.hpp"
+
+namespace dstack {
+
+static std::string url_decode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+void HttpServer::route(const std::string& method, const std::string& pattern,
+                       Handler h) {
+  Route r;
+  r.method = method;
+  r.segments = split(pattern, '/');
+  r.handler = std::move(h);
+  routes_.push_back(std::move(r));
+}
+
+int HttpServer::start() {
+  listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return -1;
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port_));
+  if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1)
+    addr.sin_addr.s_addr = INADDR_ANY;
+  if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(listen_fd_, 64) != 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  bound_port_ = ntohs(addr.sin_port);
+  running_ = true;
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  return bound_port_;
+}
+
+void HttpServer::stop() {
+  if (!running_.exchange(false)) return;
+  if (listen_fd_ >= 0) {
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+}
+
+void HttpServer::accept_loop() {
+  while (running_) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (!running_) break;
+      continue;
+    }
+    std::thread([this, fd] { handle_connection(fd); }).detach();
+  }
+}
+
+static bool read_exact(int fd, std::string& buf, size_t upto) {
+  char tmp[8192];
+  while (buf.size() < upto) {
+    ssize_t n = read(fd, tmp, std::min(sizeof(tmp), upto - buf.size()));
+    if (n <= 0) return false;
+    buf.append(tmp, n);
+  }
+  return true;
+}
+
+void HttpServer::handle_connection(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Read until end of headers.
+  std::string data;
+  char tmp[8192];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = read(fd, tmp, sizeof(tmp));
+    if (n <= 0) { close(fd); return; }
+    data.append(tmp, n);
+    header_end = data.find("\r\n\r\n");
+    if (data.size() > 1 << 20 && header_end == std::string::npos) {
+      close(fd);
+      return;
+    }
+  }
+  HttpRequest req;
+  {
+    std::istringstream hs(data.substr(0, header_end));
+    std::string line;
+    std::getline(hs, line);
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    std::istringstream rl(line);
+    std::string target, version;
+    rl >> req.method >> target >> version;
+    auto qpos = target.find('?');
+    req.path = qpos == std::string::npos ? target : target.substr(0, qpos);
+    if (qpos != std::string::npos) {
+      for (const auto& pair : split(target.substr(qpos + 1), '&')) {
+        auto eq = pair.find('=');
+        if (eq == std::string::npos) req.query[url_decode(pair)] = "";
+        else req.query[url_decode(pair.substr(0, eq))] = url_decode(pair.substr(eq + 1));
+      }
+    }
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      auto colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      std::transform(key.begin(), key.end(), key.begin(), ::tolower);
+      std::string value = line.substr(colon + 1);
+      while (!value.empty() && value.front() == ' ') value.erase(value.begin());
+      req.headers[key] = value;
+    }
+  }
+  size_t content_length = 0;
+  auto cl = req.headers.find("content-length");
+  if (cl != req.headers.end()) content_length = std::stoul(cl->second);
+  req.body = data.substr(header_end + 4);
+  if (req.body.size() < content_length) {
+    std::string rest = req.body;
+    req.body.clear();
+    if (!read_exact(fd, rest, content_length)) { close(fd); return; }
+    req.body = std::move(rest);
+  } else {
+    req.body.resize(content_length);
+  }
+
+  HttpResponse resp = dispatch(req);
+  std::ostringstream out;
+  out << "HTTP/1.1 " << resp.status << " "
+      << (resp.status == 200 ? "OK" : resp.status == 404 ? "Not Found" : "Error")
+      << "\r\nContent-Type: " << resp.content_type
+      << "\r\nContent-Length: " << resp.body.size()
+      << "\r\nConnection: close\r\n\r\n"
+      << resp.body;
+  std::string payload = out.str();
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t n = write(fd, payload.data() + off, payload.size() - off);
+    if (n <= 0) break;
+    off += n;
+  }
+  close(fd);
+}
+
+HttpResponse HttpServer::dispatch(HttpRequest& req) {
+  auto path_segments = split(req.path, '/');
+  bool path_matched = false;
+  for (const auto& r : routes_) {
+    if (r.segments.size() != path_segments.size()) continue;
+    bool match = true;
+    std::map<std::string, std::string> captures;
+    for (size_t i = 0; i < r.segments.size(); ++i) {
+      const std::string& pat = r.segments[i];
+      if (pat.size() >= 2 && pat.front() == '{' && pat.back() == '}') {
+        captures[pat.substr(1, pat.size() - 2)] = path_segments[i];
+      } else if (pat != path_segments[i]) {
+        match = false;
+        break;
+      }
+    }
+    if (!match) continue;
+    path_matched = true;
+    if (r.method != req.method) continue;
+    for (auto& [k, v] : captures) req.query[k] = v;
+    try {
+      return r.handler(req);
+    } catch (const std::exception& e) {
+      return HttpResponse::error(400, e.what());
+    }
+  }
+  return path_matched ? HttpResponse::error(405, "method not allowed")
+                      : HttpResponse::error(404, "not found");
+}
+
+}  // namespace dstack

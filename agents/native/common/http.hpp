@@ -1,0 +1,84 @@
+// Minimal blocking HTTP/1.1 server, thread-per-connection.
+//
+// The agents serve single-digit concurrent clients (the control-plane server
+// over an SSH tunnel), so a small, auditable server beats an event loop.
+// Parity: runner/internal/api/server.go (Go net/http JSON router).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.hpp"
+
+namespace dstack {
+
+struct HttpRequest {
+  std::string method;
+  std::string path;               // without query string
+  std::map<std::string, std::string> query;
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+
+  std::string query_param(const std::string& key, const std::string& def = "") const {
+    auto it = query.find(key);
+    return it == query.end() ? def : it->second;
+  }
+  Json json() const { return body.empty() ? Json::object() : Json::parse(body); }
+};
+
+struct HttpResponse {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body = "{}";
+
+  static HttpResponse ok(const Json& j) { return {200, "application/json", j.dump()}; }
+  static HttpResponse error(int status, const std::string& msg) {
+    Json j = Json::object();
+    j.set("detail", msg);
+    return {status, "application/json", j.dump()};
+  }
+};
+
+// Handler receives the request; throw std::runtime_error -> 400 with detail.
+using Handler = std::function<HttpResponse(const HttpRequest&)>;
+
+class HttpServer {
+ public:
+  HttpServer(std::string host, int port) : host_(std::move(host)), port_(port) {}
+  ~HttpServer() { stop(); }
+
+  // route("GET", "/api/tasks/{id}", ...): "{...}" segments match any value;
+  // matched values appear in request.query under the brace name.
+  void route(const std::string& method, const std::string& pattern, Handler h);
+
+  // Binds and starts the accept loop on a background thread.
+  // Returns the bound port (for port=0) or -1 on failure.
+  int start();
+  void stop();
+  int port() const { return bound_port_; }
+
+ private:
+  struct Route {
+    std::string method;
+    std::vector<std::string> segments;
+    Handler handler;
+  };
+
+  void accept_loop();
+  void handle_connection(int fd);
+  HttpResponse dispatch(HttpRequest& req);
+
+  std::string host_;
+  int port_;
+  int bound_port_ = -1;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::vector<Route> routes_;
+};
+
+}  // namespace dstack

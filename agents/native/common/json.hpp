@@ -1,0 +1,333 @@
+// Minimal JSON value + parser + serializer (header-only, no deps).
+// The wire schemas are small (agents/protocol.py), so a compact DOM is fine.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace dstack {
+
+class Json;
+using JsonArray = std::vector<Json>;
+// std::map keeps key order deterministic for tests/goldens.
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int i) : type_(Type::Int), int_(i) {}
+  Json(int64_t i) : type_(Type::Int), int_(i) {}
+  Json(uint64_t i) : type_(Type::Int), int_(static_cast<int64_t>(i)) {}
+  Json(double d) : type_(Type::Double), double_(d) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Int || type_ == Type::Double; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool def = false) const { return type_ == Type::Bool ? bool_ : def; }
+  int64_t as_int(int64_t def = 0) const {
+    if (type_ == Type::Int) return int_;
+    if (type_ == Type::Double) return static_cast<int64_t>(double_);
+    return def;
+  }
+  double as_double(double def = 0) const {
+    if (type_ == Type::Double) return double_;
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    return def;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+
+  // Object access (null when missing).
+  const Json& operator[](const std::string& key) const {
+    static const Json null_value;
+    if (type_ != Type::Object) return null_value;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_value : it->second;
+  }
+  Json& set(const std::string& key, Json v) {
+    if (type_ != Type::Object) { type_ = Type::Object; obj_.clear(); }
+    obj_[key] = std::move(v);
+    return *this;
+  }
+  void push_back(Json v) {
+    if (type_ != Type::Array) { type_ = Type::Array; arr_.clear(); }
+    arr_.push_back(std::move(v));
+  }
+  bool contains(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON content");
+    return v;
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Int: os << int_; break;
+      case Type::Double: {
+        if (std::isfinite(double_)) {
+          std::ostringstream tmp;
+          tmp.precision(17);
+          tmp << double_;
+          os << tmp.str();
+        } else {
+          os << "null";
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) os << ',';
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, k);
+          os << ':';
+          v.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\b': os << "\\b"; break;
+        case '\f': os << "\\f"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& pos) {
+    while (pos < t.size() &&
+           (t[pos] == ' ' || t[pos] == '\t' || t[pos] == '\n' || t[pos] == '\r'))
+      ++pos;
+  }
+
+  static Json parse_value(const std::string& t, size_t& pos) {
+    skip_ws(t, pos);
+    if (pos >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[pos];
+    if (c == '{') return parse_object(t, pos);
+    if (c == '[') return parse_array(t, pos);
+    if (c == '"') return Json(parse_string(t, pos));
+    if (c == 't' || c == 'f') return parse_bool(t, pos);
+    if (c == 'n') { expect(t, pos, "null"); return Json(); }
+    return parse_number(t, pos);
+  }
+
+  static void expect(const std::string& t, size_t& pos, const char* lit) {
+    size_t n = strlen(lit);
+    if (t.compare(pos, n, lit) != 0) throw std::runtime_error("bad JSON literal");
+    pos += n;
+  }
+
+  static Json parse_object(const std::string& t, size_t& pos) {
+    ++pos;  // '{'
+    Json obj = Json::object();
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == '}') { ++pos; return obj; }
+    while (true) {
+      skip_ws(t, pos);
+      std::string key = parse_string(t, pos);
+      skip_ws(t, pos);
+      if (pos >= t.size() || t[pos] != ':') throw std::runtime_error("expected ':'");
+      ++pos;
+      obj.set(key, parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[pos] == ',') { ++pos; continue; }
+      if (t[pos] == '}') { ++pos; return obj; }
+      throw std::runtime_error("expected ',' or '}'");
+    }
+  }
+
+  static Json parse_array(const std::string& t, size_t& pos) {
+    ++pos;  // '['
+    Json arr = Json::array();
+    skip_ws(t, pos);
+    if (pos < t.size() && t[pos] == ']') { ++pos; return arr; }
+    while (true) {
+      arr.push_back(parse_value(t, pos));
+      skip_ws(t, pos);
+      if (pos >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[pos] == ',') { ++pos; continue; }
+      if (t[pos] == ']') { ++pos; return arr; }
+      throw std::runtime_error("expected ',' or ']'");
+    }
+  }
+
+  static Json parse_bool(const std::string& t, size_t& pos) {
+    if (t[pos] == 't') { expect(t, pos, "true"); return Json(true); }
+    expect(t, pos, "false");
+    return Json(false);
+  }
+
+  static Json parse_number(const std::string& t, size_t& pos) {
+    size_t start = pos;
+    if (pos < t.size() && (t[pos] == '-' || t[pos] == '+')) ++pos;
+    bool is_double = false;
+    while (pos < t.size()) {
+      char c = t[pos];
+      if (isdigit(static_cast<unsigned char>(c))) { ++pos; }
+      else if (c == '.' || c == 'e' || c == 'E' || c == '-' || c == '+') {
+        if (c == '.' || c == 'e' || c == 'E') is_double = true;
+        ++pos;
+      } else break;
+    }
+    std::string num = t.substr(start, pos - start);
+    if (num.empty()) throw std::runtime_error("bad JSON number");
+    if (is_double) return Json(std::stod(num));
+    try {
+      return Json(static_cast<int64_t>(std::stoll(num)));
+    } catch (const std::out_of_range&) {
+      return Json(std::stod(num));
+    }
+  }
+
+  static std::string parse_string(const std::string& t, size_t& pos) {
+    if (t[pos] != '"') throw std::runtime_error("expected string");
+    ++pos;
+    std::string out;
+    while (pos < t.size()) {
+      char c = t[pos++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (pos >= t.size()) break;
+        char e = t[pos++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos + 4 > t.size()) throw std::runtime_error("bad \\u escape");
+            unsigned cp = std::stoul(t.substr(pos, 4), nullptr, 16);
+            pos += 4;
+            // Surrogate pair.
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos + 6 <= t.size() &&
+                t[pos] == '\\' && t[pos + 1] == 'u') {
+              unsigned lo = std::stoul(t.substr(pos + 2, 4), nullptr, 16);
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                pos += 6;
+              }
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    throw std::runtime_error("unterminated string");
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) { out += static_cast<char>(cp); }
+    else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+};
+
+}  // namespace dstack

@@ -1,0 +1,137 @@
+#include "util.hpp"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/time.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+namespace dstack {
+
+int64_t now_ms() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return static_cast<int64_t>(tv.tv_sec) * 1000 + tv.tv_usec / 1000;
+}
+
+static const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string base64_encode(const char* data, size_t len) {
+  std::string out;
+  out.reserve((len + 2) / 3 * 4);
+  for (size_t i = 0; i < len; i += 3) {
+    uint32_t chunk = static_cast<unsigned char>(data[i]) << 16;
+    if (i + 1 < len) chunk |= static_cast<unsigned char>(data[i + 1]) << 8;
+    if (i + 2 < len) chunk |= static_cast<unsigned char>(data[i + 2]);
+    out += kB64[(chunk >> 18) & 63];
+    out += kB64[(chunk >> 12) & 63];
+    out += i + 1 < len ? kB64[(chunk >> 6) & 63] : '=';
+    out += i + 2 < len ? kB64[chunk & 63] : '=';
+  }
+  return out;
+}
+
+std::string base64_encode(const std::string& data) {
+  return base64_encode(data.data(), data.size());
+}
+
+std::vector<std::string> split(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : s) {
+    if (c == sep) { out.push_back(cur); cur.clear(); }
+    else cur += c;
+  }
+  out.push_back(cur);
+  return out;
+}
+
+std::string join(const std::vector<std::string>& parts, const std::string& sep) {
+  std::string out;
+  for (size_t i = 0; i < parts.size(); ++i) {
+    if (i) out += sep;
+    out += parts[i];
+  }
+  return out;
+}
+
+bool starts_with(const std::string& s, const std::string& prefix) {
+  return s.rfind(prefix, 0) == 0;
+}
+
+std::optional<std::string> read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return std::nullopt;
+  std::ostringstream os;
+  os << f.rdbuf();
+  return os.str();
+}
+
+bool write_file(const std::string& path, const std::string& content) {
+  std::ofstream f(path, std::ios::binary | std::ios::trunc);
+  if (!f) return false;
+  f << content;
+  return static_cast<bool>(f);
+}
+
+int run_command(const std::vector<std::string>& argv, std::string* output,
+                int timeout_seconds) {
+  if (argv.empty()) return -1;
+  int pipefd[2];
+  if (pipe(pipefd) != 0) return -1;
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(pipefd[0]);
+    close(pipefd[1]);
+    return -1;
+  }
+  if (pid == 0) {
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[0]);
+    close(pipefd[1]);
+    std::vector<char*> args;
+    for (const auto& a : argv) args.push_back(const_cast<char*>(a.c_str()));
+    args.push_back(nullptr);
+    execvp(args[0], args.data());
+    _exit(127);
+  }
+  close(pipefd[1]);
+  std::string out;
+  char buf[4096];
+  int64_t deadline = timeout_seconds > 0 ? now_ms() + timeout_seconds * 1000 : 0;
+  bool timed_out = false;
+  while (true) {
+    if (deadline) {
+      int64_t left = deadline - now_ms();
+      if (left <= 0) { timed_out = true; break; }
+      struct pollfd pfd = {pipefd[0], POLLIN, 0};
+      int pr = poll(&pfd, 1, static_cast<int>(left));
+      if (pr == 0) { timed_out = true; break; }
+      if (pr < 0 && errno != EINTR) break;
+      if (pr < 0) continue;
+    }
+    ssize_t n = read(pipefd[0], buf, sizeof(buf));
+    if (n > 0) out.append(buf, n);
+    else if (n == 0) break;
+    else if (errno != EINTR) break;
+  }
+  close(pipefd[0]);
+  if (timed_out) kill(pid, SIGKILL);
+  int status = 0;
+  waitpid(pid, &status, 0);
+  if (output) *output = std::move(out);
+  if (timed_out) return -2;
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return -WTERMSIG(status);
+  return -1;
+}
+
+}  // namespace dstack

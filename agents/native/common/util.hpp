@@ -1,0 +1,28 @@
+// Shared helpers: base64, time, string/file utilities, subprocess capture.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace dstack {
+
+int64_t now_ms();  // wall-clock ms since epoch
+
+std::string base64_encode(const std::string& data);
+std::string base64_encode(const char* data, size_t len);
+
+std::vector<std::string> split(const std::string& s, char sep);
+std::string join(const std::vector<std::string>& parts, const std::string& sep);
+bool starts_with(const std::string& s, const std::string& prefix);
+
+std::optional<std::string> read_file(const std::string& path);
+bool write_file(const std::string& path, const std::string& content);
+
+// Run argv, capture combined stdout+stderr. Returns exit code (-1 on spawn
+// failure). No shell involved.
+int run_command(const std::vector<std::string>& argv, std::string* output,
+                int timeout_seconds = 0);
+
+}  // namespace dstack

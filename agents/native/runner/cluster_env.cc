@@ -1,0 +1,62 @@
+#include "cluster_env.hpp"
+
+#include "../common/util.hpp"
+
+namespace dstack {
+
+std::map<std::string, std::string> make_cluster_env(const Json& cluster,
+                                                    int node_rank) {
+  std::map<std::string, std::string> env;
+  std::vector<std::string> ips;
+  for (const auto& ip : cluster["job_ips"].as_array()) ips.push_back(ip.as_string());
+  const std::string master = cluster["master_job_ip"].as_string();
+  int64_t port = cluster["coordinator_port"].as_int(8476);
+  int64_t chips_per_host = cluster["chips_per_host"].as_int(0);
+  int64_t n = static_cast<int64_t>(ips.size());
+
+  env["JAX_COORDINATOR_ADDRESS"] = master + ":" + std::to_string(port);
+  env["JAX_COORDINATOR_PORT"] = std::to_string(port);
+  env["JAX_PROCESS_ID"] = std::to_string(node_rank);
+  env["JAX_NUM_PROCESSES"] = std::to_string(n);
+  env["PJRT_DEVICE"] = "TPU";
+  env["TPU_WORKER_ID"] = std::to_string(node_rank);
+  env["TPU_WORKER_HOSTNAMES"] = join(ips, ",");
+  env["DSTACK_NODES_IPS"] = join(ips, "\n");
+  env["DSTACK_MASTER_NODE_IP"] = master;
+  env["DSTACK_NODE_RANK"] = std::to_string(node_rank);
+  env["DSTACK_NODES_NUM"] = std::to_string(n);
+  env["DSTACK_GPUS_PER_NODE"] = std::to_string(chips_per_host);
+  env["DSTACK_GPUS_NUM"] = std::to_string(chips_per_host * n);
+  env["DSTACK_CHIPS_PER_HOST"] = std::to_string(chips_per_host);
+  env["DSTACK_CHIPS_NUM"] = std::to_string(chips_per_host * n);
+
+  const Json& slice = cluster["tpu_slice"];
+  if (slice.is_object()) {
+    // TpuTopology serializes its fields (generation/chips/grid/hosts);
+    // accelerator_type & topology_string are computed — mirror of
+    // dstack_tpu/models/topology.py (GENERATIONS table).
+    const std::string gen = slice["generation"].as_string();
+    int64_t chips = slice["chips"].as_int(0);
+    std::string prefix = gen;
+    bool suffix_is_cores = true;
+    if (gen == "v5e") { prefix = "v5litepod"; suffix_is_cores = false; }
+    else if (gen == "v6e") { suffix_is_cores = false; }
+    int64_t suffix = suffix_is_cores ? chips * 2 : chips;
+    env["DSTACK_TPU_ACCELERATOR_TYPE"] = prefix + "-" + std::to_string(suffix);
+    std::vector<std::string> dims;
+    for (const auto& d : slice["grid"].as_array())
+      dims.push_back(std::to_string(d.as_int()));
+    env["DSTACK_TPU_TOPOLOGY"] = join(dims, "x");
+  }
+
+  int64_t slice_count = cluster["slice_count"].as_int(1);
+  if (slice_count > 1) {
+    env["MEGASCALE_COORDINATOR_ADDRESS"] =
+        master + ":" + std::to_string(kDefaultMegascalePort);
+    env["MEGASCALE_NUM_SLICES"] = std::to_string(slice_count);
+    env["MEGASCALE_SLICE_ID"] = std::to_string(cluster["slice_id"].as_int(0));
+  }
+  return env;
+}
+
+}  // namespace dstack

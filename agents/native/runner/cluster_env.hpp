@@ -1,0 +1,22 @@
+// JAX distributed bootstrap env assembly — C++ mirror of
+// dstack_tpu/parallel/env.py (kept in lockstep; tests in
+// tests/test_native_agents.py assert both produce identical env).
+// Parity: reference runner/internal/executor/executor.go:213-230, which
+// injects DSTACK_MASTER_NODE_IP / DSTACK_NODE_RANK for torchrun users.
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../common/json.hpp"
+
+namespace dstack {
+
+constexpr int kDefaultMegascalePort = 8576;
+
+// cluster: the ClusterInfo JSON object from SubmitBody.
+std::map<std::string, std::string> make_cluster_env(const Json& cluster,
+                                                    int node_rank);
+
+}  // namespace dstack

@@ -1,0 +1,363 @@
+#include "executor.hpp"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <pty.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <ctime>
+
+#include "../common/util.hpp"
+#include "cluster_env.hpp"
+
+namespace dstack {
+
+namespace {
+
+bool is_finished_state(const std::string& s) {
+  return s == "done" || s == "failed" || s == "terminated" || s == "aborted";
+}
+
+std::string iso_utc_now() {
+  char buf[40];
+  time_t t = time(nullptr);
+  struct tm tm;
+  gmtime_r(&t, &tm);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%S+00:00", &tm);
+  return buf;
+}
+
+}  // namespace
+
+Executor::~Executor() {
+  stopping_ = true;
+  kill_group(SIGKILL);
+  if (worker_.joinable()) worker_.join();
+}
+
+bool Executor::submit(const Json& body, std::string* error) {
+  if (submitted_.exchange(true)) {
+    *error = "Job already submitted";
+    return false;
+  }
+  submission_ = body;
+  log_runner("Job " + body["job_spec"]["job_name"].as_string() + " submitted");
+  return true;
+}
+
+bool Executor::upload_code(const std::string& bytes, std::string* error) {
+  if (!submitted_) {
+    *error = "Submit the job first";
+    return false;
+  }
+  char tmpl[] = "/tmp/dstack-code-XXXXXX";
+  int fd = mkstemp(tmpl);
+  if (fd < 0) {
+    *error = std::string("mkstemp: ") + strerror(errno);
+    return false;
+  }
+  size_t off = 0;
+  while (off < bytes.size()) {
+    ssize_t n = write(fd, bytes.data() + off, bytes.size() - off);
+    if (n <= 0) { close(fd); *error = "short write"; return false; }
+    off += n;
+  }
+  close(fd);
+  code_path_ = tmpl;
+  return true;
+}
+
+bool Executor::run(std::string* error) {
+  if (!submitted_) {
+    *error = "Submit the job first";
+    return false;
+  }
+  if (started_.exchange(true)) {
+    *error = "Job already started";
+    return false;
+  }
+  worker_ = std::thread([this] { exec_thread(); });
+  return true;
+}
+
+std::vector<std::string> Executor::build_env() const {
+  std::map<std::string, std::string> env;
+  for (char** e = environ; *e; ++e) {
+    std::string kv(*e);
+    auto eq = kv.find('=');
+    if (eq != std::string::npos) env[kv.substr(0, eq)] = kv.substr(eq + 1);
+  }
+  const Json& cluster = submission_["cluster_info"];
+  if (cluster.is_object()) {
+    int rank = static_cast<int>(submission_["node_rank"].as_int(0));
+    for (auto& [k, v] : make_cluster_env(cluster, rank)) env[k] = v;
+  }
+  for (const auto& [k, v] : submission_["job_spec"]["env"].as_object())
+    if (!v.is_null()) env[k] = v.as_string();
+  for (const auto& [k, v] : submission_["secrets"].as_object())
+    env[k] = v.as_string();
+  env["DSTACK_RUN_NAME"] = submission_["run_name"].as_string();
+  env["DSTACK_REPLICA_NUM"] =
+      std::to_string(submission_["job_spec"]["replica_num"].as_int(0));
+  env["DSTACK_JOB_NUM"] =
+      std::to_string(submission_["job_spec"]["job_num"].as_int(0));
+  std::vector<std::string> out;
+  for (auto& [k, v] : env) out.push_back(k + "=" + v);
+  return out;
+}
+
+void Executor::exec_thread() {
+  const Json& spec = submission_["job_spec"];
+  std::string workdir = working_root_.empty() ? "/workflow" : working_root_;
+  mkdir(workdir.c_str(), 0755);
+
+  if (!code_path_.empty()) {
+    struct stat st;
+    if (stat(code_path_.c_str(), &st) == 0 && st.st_size > 0) {
+      std::string out;
+      int rc = run_command({"tar", "-xf", code_path_, "-C", workdir}, &out);
+      if (rc != 0) log_runner("Failed to extract code archive: " + out);
+    }
+  }
+  if (!spec["working_dir"].as_string().empty()) {
+    workdir += "/" + spec["working_dir"].as_string();
+    run_command({"mkdir", "-p", workdir}, nullptr);
+  }
+
+  std::string script = "set -eo pipefail\n";
+  size_t n_cmds = spec["commands"].as_array().size();
+  for (const auto& cmd : spec["commands"].as_array())
+    script += cmd.as_string() + "\n";
+
+  set_state("running");
+  log_runner("Executing " + std::to_string(n_cmds) + " command(s)");
+
+  // Build everything the child needs BEFORE forking: this process is
+  // multithreaded (HTTP handler threads), so the child must not allocate
+  // between fork and exec or it can deadlock on a malloc lock another
+  // thread held at fork time.
+  std::vector<std::string> envv = build_env();
+  std::vector<char*> envp;
+  for (auto& e : envv) envp.push_back(const_cast<char*>(e.c_str()));
+  envp.push_back(nullptr);
+  const char* child_argv[] = {"/bin/bash", "-c", script.c_str(), nullptr};
+
+  // Spawn under a pty so user programs line-buffer/colorize like a terminal
+  // (parity: executor.go pty exec :555-592).
+  int master_fd = -1;
+  pid_t pid = forkpty(&master_fd, nullptr, nullptr, nullptr);
+  if (pid < 0) {
+    set_state("failed", "executor_error", strerror(errno));
+    return;
+  }
+  if (pid == 0) {
+    if (chdir(workdir.c_str()) != 0) _exit(126);
+    execve("/bin/bash", const_cast<char**>(child_argv), envp.data());
+    _exit(127);
+  }
+  child_pid_ = pid;
+
+  int64_t deadline_ms = 0;
+  if (!spec["max_duration"].is_null() && spec["max_duration"].as_int(0) > 0)
+    deadline_ms = now_ms() + spec["max_duration"].as_int() * 1000;
+  bool max_duration_hit = false;
+
+  char buf[65536];
+  while (true) {
+    struct pollfd pfd = {master_fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, 200);
+    if (pr > 0 && (pfd.revents & (POLLIN | POLLHUP))) {
+      ssize_t n = read(master_fd, buf, sizeof(buf));
+      if (n > 0) {
+        std::lock_guard<std::mutex> lock(mu_);
+        job_logs_.push_back({next_event_ts(), "stdout", std::string(buf, n)});
+        continue;  // drain before checking exit
+      }
+      if (n == 0 || (n < 0 && errno != EINTR && errno != EAGAIN)) break;
+    }
+    if (deadline_ms && now_ms() > deadline_ms && !max_duration_hit) {
+      max_duration_hit = true;
+      log_runner("Max duration exceeded; terminating");
+      stopping_ = true;
+      kill_group(SIGTERM);
+      deadline_ms = now_ms() + 10'000;  // escalate to KILL in 10s
+    } else if (max_duration_hit && now_ms() > deadline_ms) {
+      kill_group(SIGKILL);
+      deadline_ms = 0;
+    }
+    // Child gone and pty drained?
+    int status;
+    pid_t w = waitpid(pid, &status, WNOHANG);
+    if (w == pid) {
+      // Drain any remaining output.
+      while (true) {
+        ssize_t n = read(master_fd, buf, sizeof(buf));
+        if (n <= 0) break;
+        std::lock_guard<std::mutex> lock(mu_);
+        job_logs_.push_back({next_event_ts(), "stdout", std::string(buf, n)});
+      }
+      close(master_fd);
+      child_pid_ = -1;
+      if (WIFEXITED(status) && WEXITSTATUS(status) == 0) {
+        set_state("done", "done_by_runner", "", 0);
+      } else if (max_duration_hit) {
+        set_state("terminated", "max_duration_exceeded", "",
+                  WIFEXITED(status) ? WEXITSTATUS(status) : -WTERMSIG(status));
+      } else if (stopping_) {
+        set_state("terminated", "terminated_by_user", "",
+                  WIFEXITED(status) ? WEXITSTATUS(status) : -WTERMSIG(status));
+      } else {
+        int code = WIFEXITED(status) ? WEXITSTATUS(status) : -WTERMSIG(status);
+        set_state("failed", "container_exited_with_error",
+                  "exit status " + std::to_string(code), code);
+      }
+      return;
+    }
+  }
+  // pty EOF before waitpid saw the exit: reap now.
+  int status = 0;
+  waitpid(pid, &status, 0);
+  close(master_fd);
+  child_pid_ = -1;
+  int code = WIFEXITED(status) ? WEXITSTATUS(status) : -WTERMSIG(status);
+  if (code == 0) set_state("done", "done_by_runner", "", 0);
+  else if (max_duration_hit) set_state("terminated", "max_duration_exceeded", "", code);
+  else if (stopping_) set_state("terminated", "terminated_by_user", "", code);
+  else set_state("failed", "container_exited_with_error",
+                 "exit status " + std::to_string(code), code);
+}
+
+void Executor::kill_group(int sig) {
+  pid_t pid = child_pid_;
+  if (pid > 0) kill(-pid, sig);
+}
+
+void Executor::stop(double grace_seconds) {
+  stopping_ = true;
+  if (child_pid_ <= 0) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (states_.empty() || !is_finished_state(states_.back().state)) {
+      states_.push_back({"terminated", now_ms(), "terminated_by_user", "", std::nullopt});
+      finished_ = true;
+    }
+    return;
+  }
+  kill_group(SIGTERM);
+  int64_t deadline = now_ms() + static_cast<int64_t>(grace_seconds * 1000);
+  while (child_pid_ > 0 && now_ms() < deadline)
+    usleep(50'000);
+  if (child_pid_ > 0) kill_group(SIGKILL);
+}
+
+int64_t Executor::next_event_ts() {
+  // Strictly increasing per-event timestamps close the pull race completely:
+  // with unique, ordered timestamps, `> last_updated` can never skip an
+  // event appended after a pull returned (they sort after everything the
+  // pull saw). May run a few ms ahead of wall clock under bursts.
+  int64_t ts = now_ms();
+  if (ts <= last_event_ts_) ts = last_event_ts_ + 1;
+  last_event_ts_ = ts;
+  return ts;
+}
+
+void Executor::set_state(const std::string& state, const std::string& reason,
+                         const std::string& message,
+                         std::optional<int> exit_status) {
+  std::lock_guard<std::mutex> lock(mu_);
+  states_.push_back({state, next_event_ts(), reason, message, exit_status});
+  if (is_finished_state(state)) finished_ = true;
+}
+
+void Executor::log_runner(const std::string& message) {
+  std::lock_guard<std::mutex> lock(mu_);
+  runner_logs_.push_back({next_event_ts(), "runner", message});
+}
+
+Json Executor::pull(int64_t since_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  Json resp = Json::object();
+  // last_updated must be the max timestamp actually returned, NOT "now":
+  // an event recorded in the same millisecond as a wall-clock last_updated
+  // would be filtered by `> since` on the next poll and lost forever.
+  int64_t last = since_ms;
+  Json states = Json::array();
+  for (const auto& s : states_) {
+    if (s.timestamp <= since_ms) continue;
+    if (s.timestamp > last) last = s.timestamp;
+    Json j = Json::object();
+    j.set("state", s.state);
+    j.set("timestamp", s.timestamp);
+    j.set("termination_reason",
+          s.termination_reason.empty() ? Json() : Json(s.termination_reason));
+    j.set("termination_message",
+          s.termination_message.empty() ? Json() : Json(s.termination_message));
+    j.set("exit_status", s.exit_status ? Json(*s.exit_status) : Json());
+    states.push_back(j);
+  }
+  auto dump_logs = [since_ms, &last](const std::vector<LogEvent>& logs) {
+    Json arr = Json::array();
+    for (const auto& e : logs) {
+      if (e.timestamp <= since_ms) continue;
+      if (e.timestamp > last) last = e.timestamp;
+      Json j = Json::object();
+      j.set("timestamp", e.timestamp);
+      j.set("source", e.source);
+      j.set("message", base64_encode(e.message));
+      arr.push_back(j);
+    }
+    return arr;
+  };
+  bool done = !states_.empty() && is_finished_state(states_.back().state);
+  resp.set("job_states", states);
+  resp.set("job_logs", dump_logs(job_logs_));
+  resp.set("runner_logs", dump_logs(runner_logs_));
+  resp.set("last_updated", last);
+  resp.set("has_more", !done);
+  return resp;
+}
+
+Json Executor::metrics() {
+  Json point = Json::object();
+  point.set("timestamp", iso_utc_now());
+  int64_t cpu_micro = 0, mem_bytes = 0;
+  pid_t pid = child_pid_;
+  if (pid > 0) {
+    if (auto statm = read_file("/proc/" + std::to_string(pid) + "/statm")) {
+      auto parts = split(*statm, ' ');
+      if (parts.size() > 1)
+        mem_bytes = std::stoll(parts[1]) * sysconf(_SC_PAGESIZE);
+    }
+    if (auto stat = read_file("/proc/" + std::to_string(pid) + "/stat")) {
+      auto rp = stat->rfind(')');
+      if (rp != std::string::npos) {
+        auto parts = split(stat->substr(rp + 2), ' ');
+        if (parts.size() > 12) {
+          int64_t ticks = std::stoll(parts[11]) + std::stoll(parts[12]);
+          cpu_micro = ticks * 1'000'000 / sysconf(_SC_CLK_TCK);
+        }
+      }
+    }
+  }
+  point.set("cpu_usage_micro", cpu_micro);
+  point.set("memory_usage_bytes", mem_bytes);
+  point.set("memory_working_set_bytes", mem_bytes);
+  // TPU chips: enumerate /dev/accel* (tpu-info integration lives in the shim
+  // host-info path; per-chip utilisation needs libtpu's monitoring socket).
+  Json chips = Json::array();
+  for (int i = 0; i < 64; ++i) {
+    struct stat st;
+    if (stat(("/dev/accel" + std::to_string(i)).c_str(), &st) != 0) break;
+    Json c = Json::object();
+    c.set("chip_index", i);
+    chips.push_back(c);
+  }
+  point.set("tpu_chips", chips);
+  return point;
+}
+
+}  // namespace dstack

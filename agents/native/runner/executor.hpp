@@ -1,0 +1,76 @@
+// In-container job executor: one job lifecycle per runner process.
+// Parity: runner/internal/executor/executor.go (RunExecutor.Run:79-172,
+// execJob:213-359) — env injection, pty exec, state history, max_duration.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../common/json.hpp"
+
+namespace dstack {
+
+struct StateEvent {
+  std::string state;  // JobStatus value
+  int64_t timestamp;
+  std::string termination_reason;   // empty -> null
+  std::string termination_message;  // empty -> null
+  std::optional<int> exit_status;
+};
+
+struct LogEvent {
+  int64_t timestamp;
+  std::string source;   // "stdout" | "runner"
+  std::string message;  // raw bytes (base64-encoded at serialization)
+};
+
+class Executor {
+ public:
+  explicit Executor(std::string working_root) : working_root_(std::move(working_root)) {}
+  ~Executor();
+
+  // API surface (all thread-safe).
+  bool submit(const Json& body, std::string* error);
+  bool upload_code(const std::string& bytes, std::string* error);
+  bool run(std::string* error);
+  void stop(double grace_seconds);
+  Json pull(int64_t since_ms);
+  Json metrics();
+
+  bool submitted() const { return submitted_; }
+  bool finished() const { return finished_; }
+
+ private:
+  void exec_thread();
+  void set_state(const std::string& state, const std::string& reason = "",
+                 const std::string& message = "",
+                 std::optional<int> exit_status = std::nullopt);
+  void log_runner(const std::string& message);
+  void kill_group(int sig);
+  std::vector<std::string> build_env() const;
+
+  std::string working_root_;
+  Json submission_;
+  std::string code_path_;
+
+  mutable std::mutex mu_;
+  int64_t last_event_ts_ = 0;  // events get strictly increasing timestamps
+  int64_t next_event_ts();     // call with mu_ held
+  std::vector<StateEvent> states_;
+  std::vector<LogEvent> job_logs_;
+  std::vector<LogEvent> runner_logs_;
+
+  std::atomic<bool> submitted_{false};
+  std::atomic<bool> started_{false};
+  std::atomic<bool> finished_{false};
+  std::atomic<bool> stopping_{false};
+  std::atomic<pid_t> child_pid_{-1};
+  std::thread worker_;
+};
+
+}  // namespace dstack

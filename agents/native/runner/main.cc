@@ -1,0 +1,107 @@
+// dstack-tpu-runner: in-container job agent (C++).
+// Protocol: dstack_tpu/agents/protocol.py (runner HTTP API, :10999).
+// Parity: runner/cmd/runner/main.go + runner/internal/runner/api/server.go.
+#include <getopt.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "../common/http.hpp"
+#include "../common/util.hpp"
+#include "executor.hpp"
+
+using namespace dstack;
+
+// Parity: runner self-terminates if no job submitted in 5 min (server.go:56)
+// and serves logs for a grace period after the job finishes.
+constexpr int64_t kIdleShutdownMs = 300'000;
+constexpr int64_t kPostFinishGraceMs = 60'000;
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 10999;
+  std::string working_root;
+  bool idle_shutdown = false;
+
+  static option longopts[] = {
+      {"host", required_argument, nullptr, 'h'},
+      {"port", required_argument, nullptr, 'p'},
+      {"working-root", required_argument, nullptr, 'w'},
+      {"idle-shutdown", no_argument, nullptr, 'i'},
+      {nullptr, 0, nullptr, 0},
+  };
+  int c;
+  while ((c = getopt_long(argc, argv, "h:p:w:i", longopts, nullptr)) != -1) {
+    switch (c) {
+      case 'h': host = optarg; break;
+      case 'p': port = atoi(optarg); break;
+      case 'w': working_root = optarg; break;
+      case 'i': idle_shutdown = true; break;
+      default: fprintf(stderr, "usage: %s [--host H] [--port P] [--working-root D] [--idle-shutdown]\n", argv[0]); return 2;
+    }
+  }
+
+  Executor executor(working_root);
+  HttpServer server(host, port);
+
+  server.route("GET", "/api/healthcheck", [](const HttpRequest&) {
+    Json j = Json::object();
+    j.set("service", "dstack-tpu-runner");
+    j.set("version", "0.1.0");
+    return HttpResponse::ok(j);
+  });
+  server.route("POST", "/api/submit", [&](const HttpRequest& req) {
+    std::string err;
+    if (!executor.submit(req.json(), &err)) return HttpResponse::error(400, err);
+    return HttpResponse::ok(Json::object());
+  });
+  server.route("POST", "/api/upload_code", [&](const HttpRequest& req) {
+    std::string err;
+    if (!executor.upload_code(req.body, &err)) return HttpResponse::error(400, err);
+    return HttpResponse::ok(Json::object());
+  });
+  server.route("POST", "/api/run", [&](const HttpRequest&) {
+    std::string err;
+    if (!executor.run(&err)) return HttpResponse::error(400, err);
+    return HttpResponse::ok(Json::object());
+  });
+  server.route("GET", "/api/pull", [&](const HttpRequest& req) {
+    int64_t since = std::stoll(req.query_param("timestamp", "0"));
+    return HttpResponse::ok(executor.pull(since));
+  });
+  server.route("POST", "/api/stop", [&](const HttpRequest& req) {
+    double grace = 5.0;
+    if (!req.body.empty()) grace = req.json()["grace_seconds"].as_double(5.0);
+    executor.stop(grace);
+    return HttpResponse::ok(Json::object());
+  });
+  server.route("GET", "/api/metrics", [&](const HttpRequest&) {
+    return HttpResponse::ok(executor.metrics());
+  });
+
+  int bound = server.start();
+  if (bound < 0) {
+    fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
+    return 1;
+  }
+  printf("runner listening on %s:%d\n", host.c_str(), bound);
+  fflush(stdout);
+
+  int64_t started = now_ms();
+  int64_t finished_at = 0;
+  while (true) {
+    usleep(500'000);
+    if (!idle_shutdown) continue;
+    if (!executor.submitted() && now_ms() - started > kIdleShutdownMs) break;
+    if (executor.finished()) {
+      if (finished_at == 0) finished_at = now_ms();
+      // serve-logs-then-exit (parity: server.go shutdown sequence)
+      else if (now_ms() - finished_at > kPostFinishGraceMs) break;
+    }
+  }
+  server.stop();
+  return 0;
+}

@@ -1,0 +1,202 @@
+// dstack-tpu-shim: host agent (C++). Drives the container runtime, reports
+// host inventory (TPU chips first), serves the v2 task API on :10998.
+// Protocol: dstack_tpu/agents/protocol.py. Parity: runner/cmd/shim/main.go
+// + runner/internal/shim/{api,docker,host}.
+#include <getopt.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/sysinfo.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "../common/http.hpp"
+#include "../common/util.hpp"
+#include "runtime.hpp"
+#include "task.hpp"
+
+using namespace dstack;
+
+namespace {
+
+Json host_info() {
+  // Parity: shim host_info.json (main.go service mode); chips via
+  // /dev/accel* + env instead of nvidia-smi (SURVEY §2.4 host/gpu.go:50-61).
+  Json j = Json::object();
+  j.set("cpus", static_cast<int64_t>(sysconf(_SC_NPROCESSORS_ONLN)));
+  struct sysinfo si;
+  if (sysinfo(&si) == 0)
+    j.set("memory_mib", static_cast<int64_t>(si.totalram) * si.mem_unit / (1 << 20));
+  struct statvfs vfs;
+  if (statvfs("/", &vfs) == 0)
+    j.set("disk_size_mib",
+          static_cast<int64_t>(vfs.f_blocks) * vfs.f_frsize / (1 << 20));
+  int chips = 0;
+  struct stat st;
+  while (stat(("/dev/accel" + std::to_string(chips)).c_str(), &st) == 0) ++chips;
+  j.set("tpu_chip_count", chips);
+  const char* acc = getenv("TPU_ACCELERATOR_TYPE");  // set by GCE metadata bootstrap
+  j.set("tpu_accelerator_type", acc ? Json(std::string(acc)) : Json());
+  j.set("addresses", Json::array());
+  return j;
+}
+
+class TaskStore {
+ public:
+  explicit TaskStore(Runtime* runtime) : runtime_(runtime) {}
+
+  HttpResponse submit(const Json& body) {
+    TaskSpec spec = TaskSpec::from_json(body);
+    if (spec.id.empty()) return HttpResponse::error(400, "task id required");
+    std::unique_lock<std::mutex> lock(mu_);
+    if (tasks_.count(spec.id)) return HttpResponse::error(409, "task exists");
+    TaskState& task = tasks_[spec.id];
+    task.spec = spec;
+    lock.unlock();
+    // Launch synchronously in a detached thread; the server polls status.
+    std::thread([this, id = spec.id] {
+      std::unique_lock<std::mutex> l(mu_);
+      TaskState copy = tasks_[id];
+      l.unlock();
+      runtime_->launch(copy);
+      l.lock();
+      tasks_[id] = copy;
+    }).detach();
+    return HttpResponse::ok(Json::object().set("ok", true));
+  }
+
+  HttpResponse get(const std::string& id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = tasks_.find(id);
+    if (it == tasks_.end()) return HttpResponse::error(404, "no such task");
+    runtime_->refresh(it->second);
+    return HttpResponse::ok(it->second.to_json());
+  }
+
+  HttpResponse terminate(const std::string& id, const Json& body) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = tasks_.find(id);
+    if (it == tasks_.end()) return HttpResponse::error(404, "no such task");
+    if (!body["termination_reason"].as_string().empty())
+      it->second.termination_reason = body["termination_reason"].as_string();
+    runtime_->terminate(it->second, body["timeout"].as_double(10.0));
+    return HttpResponse::ok(it->second.to_json());
+  }
+
+  HttpResponse remove(const std::string& id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = tasks_.find(id);
+    if (it == tasks_.end()) return HttpResponse::error(404, "no such task");
+    runtime_->remove(it->second);
+    tasks_.erase(it);
+    return HttpResponse::ok(Json::object());
+  }
+
+  // Rebuild task state from container labels after a shim restart
+  // (parity: shim/docker.go:101-185).
+  void restore_from_docker() {
+    std::string out;
+    if (run_command({"docker", "ps", "-a", "--filter", "label=dstack.task_id",
+                     "--format", "{{.Label \"dstack.task_id\"}} {{.Names}} {{.State}}"},
+                    &out, 10) != 0)
+      return;
+    std::lock_guard<std::mutex> lock(mu_);
+    for (const auto& line : split(out, '\n')) {
+      auto parts = split(line, ' ');
+      if (parts.size() < 3 || parts[0].empty()) continue;
+      TaskState& task = tasks_[parts[0]];
+      task.spec.id = parts[0];
+      task.container_name = parts[1];
+      task.status = parts[2] == "running" ? "running" : "terminated";
+    }
+  }
+
+ private:
+  Runtime* runtime_;
+  std::mutex mu_;
+  std::map<std::string, TaskState> tasks_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 10998;
+  std::string runtime_name = "docker";
+  std::string runner_binary = "/usr/local/bin/dstack-tpu-runner";
+  std::string host_info_path;
+
+  static option longopts[] = {
+      {"host", required_argument, nullptr, 'h'},
+      {"port", required_argument, nullptr, 'p'},
+      {"runtime", required_argument, nullptr, 'r'},
+      {"runner-binary", required_argument, nullptr, 'b'},
+      {"host-info", required_argument, nullptr, 'o'},
+      {nullptr, 0, nullptr, 0},
+  };
+  int c;
+  while ((c = getopt_long(argc, argv, "h:p:r:b:o:", longopts, nullptr)) != -1) {
+    switch (c) {
+      case 'h': host = optarg; break;
+      case 'p': port = atoi(optarg); break;
+      case 'r': runtime_name = optarg; break;
+      case 'b': runner_binary = optarg; break;
+      case 'o': host_info_path = optarg; break;
+      default:
+        fprintf(stderr,
+                "usage: %s [--host H] [--port P] [--runtime docker|process] "
+                "[--runner-binary PATH] [--host-info PATH]\n",
+                argv[0]);
+        return 2;
+    }
+  }
+
+  std::unique_ptr<Runtime> runtime =
+      runtime_name == "process" ? make_process_runtime(runner_binary)
+                                : make_docker_runtime(runner_binary);
+  TaskStore store(runtime.get());
+  if (runtime_name == "docker") store.restore_from_docker();
+
+  if (!host_info_path.empty())
+    write_file(host_info_path, host_info().dump());
+
+  HttpServer server(host, port);
+  server.route("GET", "/api/healthcheck", [](const HttpRequest&) {
+    Json j = Json::object();
+    j.set("service", "dstack-tpu-shim");
+    j.set("version", "0.1.0");
+    return HttpResponse::ok(j);
+  });
+  server.route("GET", "/api/host_info", [](const HttpRequest&) {
+    return HttpResponse::ok(host_info());
+  });
+  server.route("POST", "/api/tasks", [&](const HttpRequest& req) {
+    return store.submit(req.json());
+  });
+  server.route("GET", "/api/tasks/{id}", [&](const HttpRequest& req) {
+    return store.get(req.query_param("id"));
+  });
+  server.route("POST", "/api/tasks/{id}/terminate", [&](const HttpRequest& req) {
+    return store.terminate(req.query_param("id"), req.json());
+  });
+  server.route("DELETE", "/api/tasks/{id}", [&](const HttpRequest& req) {
+    return store.remove(req.query_param("id"));
+  });
+
+  int bound = server.start();
+  if (bound < 0) {
+    fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
+    return 1;
+  }
+  printf("shim listening on %s:%d (runtime=%s)\n", host.c_str(), bound,
+         runtime_name.c_str());
+  fflush(stdout);
+  while (true) pause();
+  return 0;
+}

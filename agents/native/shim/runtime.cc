@@ -1,0 +1,287 @@
+#include "runtime.hpp"
+
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "../common/util.hpp"
+
+namespace dstack {
+
+namespace {
+
+constexpr int kPullTimeoutSeconds = 20 * 60;  // parity: shim/docker.go:42
+
+int count_tpu_devices() {
+  int n = 0;
+  struct stat st;
+  while (stat(("/dev/accel" + std::to_string(n)).c_str(), &st) == 0) ++n;
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+
+class DockerRuntime : public Runtime {
+ public:
+  explicit DockerRuntime(std::string runner_binary)
+      : runner_binary_(std::move(runner_binary)) {}
+
+  void launch(TaskState& task) override {
+    const TaskSpec& spec = task.spec;
+    task.status = "preparing";
+
+    if (!spec.image_name.empty()) {
+      task.status = "pulling";
+      std::string out;
+      int rc = run_command({"docker", "pull", spec.image_name}, &out,
+                           kPullTimeoutSeconds);
+      if (rc != 0) {
+        fail(task, "creating_container_error", "docker pull failed: " + out);
+        return;
+      }
+    }
+
+    task.status = "creating";
+    task.container_name = "dstack-" + spec.id;
+    std::vector<std::string> cmd = {
+        "docker", "create", "--name", task.container_name,
+        "--label", "dstack.task_id=" + spec.id,
+        "--label", "dstack.task_name=" + spec.name,
+        "--network", spec.network_mode,
+    };
+    if (spec.privileged) cmd.push_back("--privileged");
+    if (spec.container_user) { cmd.push_back("--user"); cmd.push_back(*spec.container_user); }
+    if (spec.shm_size_bytes > 0) {
+      cmd.push_back("--shm-size");
+      cmd.push_back(std::to_string(spec.shm_size_bytes) + "b");
+    }
+    // TPU passthrough: chips appear as /dev/accel*; vfio for newer runtimes;
+    // /run/tpu holds the libtpu socket/lockfile. TPUs are never fractionally
+    // shared (offers.py), so all host chips go to the one task.
+    if (spec.tpu_chips > 0) {
+      int n = count_tpu_devices();
+      for (int i = 0; i < n; ++i) {
+        cmd.push_back("--device");
+        cmd.push_back("/dev/accel" + std::to_string(i));
+      }
+      struct stat st;
+      if (stat("/dev/vfio", &st) == 0) {
+        cmd.push_back("--device");
+        cmd.push_back("/dev/vfio");
+      }
+      if (stat("/run/tpu", &st) == 0) {
+        cmd.push_back("-v");
+        cmd.push_back("/run/tpu:/run/tpu");
+      }
+      cmd.push_back("-e");
+      cmd.push_back("PJRT_DEVICE=TPU");
+      // libtpu coordination wants the host's ulimits opened up.
+      cmd.push_back("--ulimit");
+      cmd.push_back("memlock=-1:-1");
+    }
+    for (const auto& [k, v] : spec.env) {
+      cmd.push_back("-e");
+      cmd.push_back(k + "=" + v);
+    }
+    for (const auto& [host, container] : spec.volumes) {
+      cmd.push_back("-v");
+      cmd.push_back(host + ":" + container);
+    }
+    // Mount the runner binary and bootstrap: sshd (if present) + runner.
+    cmd.push_back("-v");
+    cmd.push_back(runner_binary_ + ":/usr/local/bin/dstack-tpu-runner:ro");
+    cmd.push_back(spec.image_name);
+    cmd.push_back("/bin/sh");
+    cmd.push_back("-c");
+    cmd.push_back(bootstrap_script(spec));
+
+    std::string out;
+    if (run_command(cmd, &out) != 0) {
+      fail(task, "creating_container_error", "docker create failed: " + out);
+      return;
+    }
+    if (run_command({"docker", "start", task.container_name}, &out) != 0) {
+      fail(task, "creating_container_error", "docker start failed: " + out);
+      return;
+    }
+    task.status = "running";
+  }
+
+  void refresh(TaskState& task) override {
+    if (task.status != "running") return;
+    std::string out;
+    int rc = run_command(
+        {"docker", "inspect", "-f", "{{.State.Running}} {{.State.ExitCode}}",
+         task.container_name},
+        &out);
+    if (rc != 0) {
+      fail(task, "container_lost", "docker inspect failed");
+      return;
+    }
+    if (starts_with(out, "true")) return;
+    auto parts = split(out, ' ');
+    int exit_code = parts.size() > 1 ? atoi(parts[1].c_str()) : -1;
+    task.status = "terminated";
+    if (exit_code != 0) {
+      task.termination_reason = "container_exited_with_error";
+      task.termination_message = "exit code " + std::to_string(exit_code);
+    } else {
+      task.termination_reason = "done_by_runner";
+    }
+  }
+
+  void terminate(TaskState& task, double timeout_seconds) override {
+    if (!task.container_name.empty()) {
+      run_command({"docker", "stop", "-t",
+                   std::to_string(static_cast<int>(timeout_seconds)),
+                   task.container_name},
+                  nullptr);
+    }
+    if (task.status != "terminated") {
+      task.status = "terminated";
+      if (task.termination_reason.empty())
+        task.termination_reason = "terminated_by_user";
+    }
+  }
+
+  void remove(TaskState& task) override {
+    if (!task.container_name.empty())
+      run_command({"docker", "rm", "-f", task.container_name}, nullptr);
+  }
+
+ private:
+  static std::string bootstrap_script(const TaskSpec& spec) {
+    // sshd bootstrap enables `attach` (parity: docker.go:873-911); tolerate
+    // images without sshd. Then exec the runner as PID-ish 1.
+    std::string keys;
+    for (const auto& k : spec.container_ssh_keys) keys += k + "\n";
+    std::string script =
+        "mkdir -p /run/sshd ~/.ssh && chmod 700 ~/.ssh\n";
+    if (!keys.empty())
+      script += "printf '" + keys + "' >> ~/.ssh/authorized_keys && "
+                "chmod 600 ~/.ssh/authorized_keys\n";
+    script +=
+        "(command -v sshd >/dev/null && sshd -p 10022) || true\n"
+        "exec /usr/local/bin/dstack-tpu-runner --host 0.0.0.0 --port 10999 "
+        "--working-root /workflow --idle-shutdown\n";
+    return script;
+  }
+
+  void fail(TaskState& task, const std::string& reason, const std::string& msg) {
+    task.status = "terminated";
+    task.termination_reason = reason;
+    task.termination_message = msg;
+  }
+
+  std::string runner_binary_;
+};
+
+// ---------------------------------------------------------------------------
+
+class ProcessRuntime : public Runtime {
+ public:
+  explicit ProcessRuntime(std::string runner_binary)
+      : runner_binary_(std::move(runner_binary)) {}
+
+  void launch(TaskState& task) override {
+    const TaskSpec& spec = task.spec;
+    task.status = "creating";
+    // Allocate an ephemeral port by letting the runner bind :0 would lose
+    // the port; instead derive one per task from the pid after spawn is
+    // racy too — so bind a fixed base + hash offset and retry upward.
+    int port = 20000 + static_cast<int>(std::hash<std::string>{}(spec.id) % 10000);
+    std::string workdir = "/tmp/dstack-task-" + spec.id;
+    mkdir(workdir.c_str(), 0755);
+
+    // Pre-build argv/envp before fork: the shim is multithreaded, and the
+    // child must not allocate between fork and exec.
+    std::vector<std::string> envv;
+    for (char** e = environ; *e; ++e) envv.emplace_back(*e);
+    for (const auto& [k, v] : spec.env) envv.push_back(k + "=" + v);
+    if (spec.tpu_chips > 0) envv.push_back("PJRT_DEVICE=TPU");
+    std::vector<char*> envp;
+    for (auto& e : envv) envp.push_back(const_cast<char*>(e.c_str()));
+    envp.push_back(nullptr);
+    std::string port_s = std::to_string(port);
+    const char* child_argv[] = {
+        "dstack-tpu-runner", "--host", "127.0.0.1", "--port", port_s.c_str(),
+        "--working-root", workdir.c_str(), "--idle-shutdown", nullptr};
+
+    pid_t pid = fork();
+    if (pid < 0) {
+      task.status = "terminated";
+      task.termination_reason = "creating_container_error";
+      task.termination_message = strerror(errno);
+      return;
+    }
+    if (pid == 0) {
+      setsid();
+      execve(runner_binary_.c_str(), const_cast<char**>(child_argv), envp.data());
+      _exit(127);
+    }
+    task.process_pid = pid;
+    task.runner_port = port;
+    task.container_name = "process-" + std::to_string(pid);
+    task.status = "running";
+  }
+
+  void refresh(TaskState& task) override {
+    if (task.status != "running" || task.process_pid <= 0) return;
+    int status;
+    pid_t w = waitpid(task.process_pid, &status, WNOHANG);
+    if (w == task.process_pid) {
+      task.status = "terminated";
+      int code = WIFEXITED(status) ? WEXITSTATUS(status) : -WTERMSIG(status);
+      if (code == 0) task.termination_reason = "done_by_runner";
+      else {
+        task.termination_reason = "container_exited_with_error";
+        task.termination_message = "exit code " + std::to_string(code);
+      }
+      task.process_pid = -1;
+    }
+  }
+
+  void terminate(TaskState& task, double timeout_seconds) override {
+    if (task.process_pid > 0) {
+      kill(-task.process_pid, SIGTERM);
+      int64_t deadline = now_ms() + static_cast<int64_t>(timeout_seconds * 1000);
+      while (now_ms() < deadline) {
+        int status;
+        if (waitpid(task.process_pid, &status, WNOHANG) == task.process_pid) {
+          task.process_pid = -1;
+          break;
+        }
+        usleep(50'000);
+      }
+      if (task.process_pid > 0) {
+        kill(-task.process_pid, SIGKILL);
+        waitpid(task.process_pid, nullptr, 0);
+        task.process_pid = -1;
+      }
+    }
+    if (task.status != "terminated") {
+      task.status = "terminated";
+      if (task.termination_reason.empty())
+        task.termination_reason = "terminated_by_user";
+    }
+  }
+
+  void remove(TaskState& task) override { terminate(task, 0.5); }
+
+ private:
+  std::string runner_binary_;
+};
+
+}  // namespace
+
+std::unique_ptr<Runtime> make_docker_runtime(const std::string& runner_binary) {
+  return std::make_unique<DockerRuntime>(runner_binary);
+}
+std::unique_ptr<Runtime> make_process_runtime(const std::string& runner_binary) {
+  return std::make_unique<ProcessRuntime>(runner_binary);
+}
+
+}  // namespace dstack

@@ -1,0 +1,36 @@
+// Container runtime drivers for the shim.
+//
+// DockerRuntime shells out to the docker CLI and is the production path:
+// image pull with a cap, container create with TPU device passthrough
+// (/dev/accel*, /dev/vfio, /run/tpu libtpu socket dir, PJRT_DEVICE=TPU),
+// shm tmpfs, host networking, volume binds, label-based state restore.
+// Parity: runner/internal/shim/docker.go (DockerRunner.Run:240-378, TPU
+// env hook :770-772, device passthrough :978-1037, restore :101-185).
+//
+// ProcessRuntime runs the runner binary directly as a host process — no
+// container engine needed; backs the `local` backend and the test suite.
+#pragma once
+
+#include <memory>
+#include <string>
+
+#include "task.hpp"
+
+namespace dstack {
+
+class Runtime {
+ public:
+  virtual ~Runtime() = default;
+  // Drives pending -> running (sets status/pid/container fields in place);
+  // on failure sets status=terminated + termination_reason.
+  virtual void launch(TaskState& task) = 0;
+  // Polls liveness; flips running -> terminated when the workload exits.
+  virtual void refresh(TaskState& task) = 0;
+  virtual void terminate(TaskState& task, double timeout_seconds) = 0;
+  virtual void remove(TaskState& task) = 0;
+};
+
+std::unique_ptr<Runtime> make_docker_runtime(const std::string& runner_binary);
+std::unique_ptr<Runtime> make_process_runtime(const std::string& runner_binary);
+
+}  // namespace dstack

@@ -1,0 +1,40 @@
+#include "task.hpp"
+
+namespace dstack {
+
+TaskSpec TaskSpec::from_json(const Json& j) {
+  TaskSpec s;
+  s.id = j["id"].as_string();
+  s.name = j["name"].as_string();
+  s.image_name = j["image_name"].as_string();
+  if (j["container_user"].is_string()) s.container_user = j["container_user"].as_string();
+  s.privileged = j["privileged"].as_bool(false);
+  s.shm_size_bytes = j["shm_size_bytes"].as_int(0);
+  if (j["network_mode"].is_string()) s.network_mode = j["network_mode"].as_string();
+  s.tpu_chips = static_cast<int>(j["tpu_chips"].as_int(0));
+  for (const auto& [k, v] : j["env"].as_object()) s.env[k] = v.as_string();
+  for (const auto& vol : j["volumes"].as_array()) {
+    std::string host = vol["instance_path"].as_string();
+    if (host.empty()) host = vol["name"].as_string();
+    s.volumes.emplace_back(host, vol["path"].as_string());
+  }
+  for (const auto& key : j["container_ssh_keys"].as_array())
+    s.container_ssh_keys.push_back(key.as_string());
+  return s;
+}
+
+Json TaskState::to_json() const {
+  Json j = Json::object();
+  j.set("id", spec.id);
+  j.set("status", status);
+  j.set("termination_reason",
+        termination_reason.empty() ? Json() : Json(termination_reason));
+  j.set("termination_message",
+        termination_message.empty() ? Json() : Json(termination_message));
+  j.set("ports", Json::array());
+  j.set("container_name", container_name.empty() ? Json() : Json(container_name));
+  j.set("runner_port", runner_port);
+  return j;
+}
+
+}  // namespace dstack

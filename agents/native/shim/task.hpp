@@ -1,0 +1,45 @@
+// Shim task model: lifecycle pending -> preparing -> pulling -> creating ->
+// running -> terminated. Parity: runner/internal/shim/task.go:14-25 and the
+// v2 task API (shim/api/server.go).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "../common/json.hpp"
+
+namespace dstack {
+
+struct TaskSpec {
+  std::string id;
+  std::string name;
+  std::string image_name;
+  std::optional<std::string> container_user;
+  bool privileged = false;
+  int64_t shm_size_bytes = 0;
+  std::string network_mode = "host";
+  int tpu_chips = 0;
+  std::map<std::string, std::string> env;
+  std::vector<std::pair<std::string, std::string>> volumes;  // host path -> container path
+  std::vector<std::string> container_ssh_keys;
+
+  static TaskSpec from_json(const Json& j);
+};
+
+struct TaskState {
+  TaskSpec spec;
+  std::string status = "pending";
+  std::string termination_reason;
+  std::string termination_message;
+  std::string container_name;
+  int runner_port = 10999;
+  pid_t process_pid = -1;  // process runtime only
+
+  Json to_json() const;
+};
+
+}  // namespace dstack

@@ -48,6 +48,7 @@ class Executor:
     """One job lifecycle: submit -> (upload_code) -> run -> pull -> stop."""
 
     def __init__(self, working_root: Optional[str] = None):
+        self._last_event_ts = 0
         self.submission: Optional[SubmitBody] = None
         self.code_path: Optional[Path] = None
         self.working_root = working_root
@@ -61,6 +62,14 @@ class Executor:
 
     # -- state/log plumbing --------------------------------------------------
 
+    def _next_ts(self) -> int:
+        """Strictly increasing event timestamps: with unique ordered
+        timestamps, the pull API's `> last_updated` filter can never skip an
+        event appended concurrently with a poll (same-millisecond race)."""
+        ts = max(_now_ms(), self._last_event_ts + 1)
+        self._last_event_ts = ts
+        return ts
+
     def set_state(
         self,
         state: JobStatus,
@@ -71,7 +80,7 @@ class Executor:
         self.job_states.append(
             JobStateEvent(
                 state=state,
-                timestamp=_now_ms(),
+                timestamp=self._next_ts(),
                 termination_reason=reason,
                 termination_message=message,
                 exit_status=exit_status,
@@ -83,7 +92,7 @@ class Executor:
     def log_runner(self, message: str) -> None:
         self.runner_logs.append(
             LogEventOut(
-                timestamp=_now_ms(),
+                timestamp=self._next_ts(),
                 source="runner",
                 message=base64.b64encode(message.encode()).decode(),
             )
@@ -92,7 +101,7 @@ class Executor:
     def log_job(self, data: bytes) -> None:
         self.job_logs.append(
             LogEventOut(
-                timestamp=_now_ms(),
+                timestamp=self._next_ts(),
                 source="stdout",
                 message=base64.b64encode(data).decode(),
             )
@@ -229,11 +238,21 @@ class Executor:
 
     def pull(self, since_ms: int) -> PullResponse:
         done = bool(self.job_states) and self.job_states[-1].state.is_finished()
+        states = [s for s in self.job_states if s.timestamp > since_ms]
+        job_logs = [e for e in self.job_logs if e.timestamp > since_ms]
+        runner_logs = [e for e in self.runner_logs if e.timestamp > since_ms]
+        # last_updated is the max timestamp returned, NOT "now": an event
+        # recorded in the same millisecond as a wall-clock last_updated would
+        # be filtered by `> since` on the next poll and lost forever.
+        last = max(
+            (e.timestamp for e in states + job_logs + runner_logs),
+            default=since_ms,
+        )
         return PullResponse(
-            job_states=[s for s in self.job_states if s.timestamp > since_ms],
-            job_logs=[e for e in self.job_logs if e.timestamp > since_ms],
-            runner_logs=[e for e in self.runner_logs if e.timestamp > since_ms],
-            last_updated=_now_ms(),
+            job_states=states,
+            job_logs=job_logs,
+            runner_logs=runner_logs,
+            last_updated=last,
             has_more=not done,
         )
 

@@ -58,7 +58,10 @@ def plain_attention(
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
 
 
 def _block_attend(q, k, v, mask):
@@ -78,7 +81,10 @@ def _block_attend(q, k, v, mask):
     m_safe = jnp.maximum(m, NEG_INF / 2)
     p = jnp.exp(logits - m_safe[..., None])
     l = jnp.sum(p, axis=-1)  # (B, H, Sq)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return o, m_safe, l
 
 

@@ -94,10 +94,23 @@ def _broadcast_specs(tree: Any) -> Any:
             key = getattr(p, "key", getattr(p, "name", None))
             if isinstance(node, dict) and key in node:
                 node = node[key]
+        ndim = getattr(leaf, "ndim", 0)
         if isinstance(node, P):
-            if hasattr(leaf, "ndim") and leaf.ndim == len(node):
+            if ndim == len(node):
                 return node
-            return P()
+            if ndim == 0:
+                return P()  # optimizer scalars (step counts etc.)
+            raise ValueError(
+                f"param at {jax.tree_util.keystr(path)} has ndim={ndim} but "
+                f"its PARAM_SPECS entry is {node} — update sharding rules"
+            )
+        if ndim >= 2:
+            # A weight-sized array with no matching rule would silently
+            # replicate (and so would its f32 optimizer moments) — fail loud.
+            raise ValueError(
+                f"no PARAM_SPECS entry for weight at {jax.tree_util.keystr(path)} "
+                f"(shape {getattr(leaf, 'shape', '?')}) — add a sharding rule"
+            )
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, tree)

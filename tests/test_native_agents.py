@@ -1,0 +1,270 @@
+"""Integration tests for the C++ native agents (shim + runner).
+
+Builds agents/native with cmake (session fixture), launches the real
+binaries, and drives them over their HTTP APIs — the same protocol the
+server's RunnerClient/ShimClient speak (dstack_tpu/agents/protocol.py).
+"""
+
+import base64
+import json
+import re
+import shutil
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from dstack_tpu.models.runs import ClusterInfo
+from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.parallel.env import make_cluster_env
+
+ROOT = Path(__file__).resolve().parent.parent
+NATIVE = ROOT / "agents" / "native"
+BUILD = NATIVE / "build"
+
+
+@pytest.fixture(scope="session")
+def binaries():
+    if not shutil.which("cmake"):
+        pytest.skip("cmake not available")
+    subprocess.run(
+        ["cmake", "-B", "build", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        cwd=NATIVE, check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", "build"], cwd=NATIVE, check=True, capture_output=True
+    )
+    return {
+        "runner": BUILD / "dstack-tpu-runner",
+        "shim": BUILD / "dstack-tpu-shim",
+    }
+
+
+def _start(cmd):
+    """Start an agent; parse 'X listening on host:port' for the bound port."""
+    proc = subprocess.Popen(
+        [str(c) for c in cmd], stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+    )
+    line = proc.stdout.readline().decode()
+    assert "listening on" in line, line
+    port = int(re.search(r":(\d+)", line).group(1))
+    return proc, port
+
+
+def _req(method, url, body=None):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _job_spec(commands, **kw):
+    spec = {
+        "job_name": "test-job-0-0",
+        "commands": commands,
+        "requirements": {},
+        "env": {},
+    }
+    spec.update(kw)
+    return spec
+
+
+def _wait_done(port, timeout=15.0):
+    deadline = time.time() + timeout
+    states, logs = [], []
+    since = 0
+    while time.time() < deadline:
+        pull = _req("GET", f"http://127.0.0.1:{port}/api/pull?timestamp={since}")
+        states += pull["job_states"]
+        logs += pull["job_logs"]
+        since = pull["last_updated"]
+        if states and states[-1]["state"] in ("done", "failed", "terminated"):
+            return states, logs
+        time.sleep(0.2)
+    raise AssertionError(f"job did not finish; states={states}")
+
+
+def _logs_text(logs):
+    return b"".join(base64.b64decode(e["message"]) for e in logs).decode(errors="replace")
+
+
+@pytest.fixture
+def runner(binaries, tmp_path):
+    proc, port = _start(
+        [binaries["runner"], "--port", 0, "--working-root", tmp_path / "work"]
+    )
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+class TestRunner:
+    def test_healthcheck(self, runner):
+        resp = _req("GET", f"http://127.0.0.1:{runner}/api/healthcheck")
+        assert resp == {"service": "dstack-tpu-runner", "version": "0.1.0"}
+
+    def test_job_lifecycle_with_cluster_env(self, runner):
+        cluster = ClusterInfo(
+            job_ips=["10.0.0.1", "10.0.0.2"],
+            master_job_ip="10.0.0.1",
+            chips_per_host=4,
+            tpu_slice=TpuTopology.parse("v5p-16"),
+        )
+        body = {
+            "run_name": "test-run",
+            "job_spec": _job_spec(
+                ["echo JAX=$JAX_COORDINATOR_ADDRESS", "echo RANK=$JAX_PROCESS_ID",
+                 "echo TYPE=$DSTACK_TPU_ACCELERATOR_TYPE", "echo TOPO=$DSTACK_TPU_TOPOLOGY",
+                 "echo SECRET=$MY_SECRET"],
+            ),
+            "cluster_info": json.loads(cluster.model_dump_json()),
+            "node_rank": 1,
+            "secrets": {"MY_SECRET": "s3cr3t"},
+        }
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit", body)
+        _req("POST", f"{base}/run", {})
+        states, logs = _wait_done(runner)
+        assert states[-1]["state"] == "done"
+        assert states[-1]["exit_status"] == 0
+        text = _logs_text(logs)
+        # Env must match the Python implementation exactly.
+        expect = make_cluster_env(cluster, node_rank=1)
+        assert f"JAX={expect['JAX_COORDINATOR_ADDRESS']}" in text
+        assert "RANK=1" in text
+        assert f"TYPE={expect['DSTACK_TPU_ACCELERATOR_TYPE']}" in text
+        assert expect["DSTACK_TPU_ACCELERATOR_TYPE"] == "v5p-16"
+        assert f"TOPO={expect['DSTACK_TPU_TOPOLOGY']}" in text
+        assert "SECRET=s3cr3t" in text
+
+    def test_failing_job(self, runner):
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["exit 3"])})
+        _req("POST", f"{base}/run", {})
+        states, _ = _wait_done(runner)
+        assert states[-1]["state"] == "failed"
+        assert states[-1]["exit_status"] == 3
+        assert states[-1]["termination_reason"] == "container_exited_with_error"
+
+    def test_stop(self, runner):
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["sleep 60"])})
+        _req("POST", f"{base}/run", {})
+        time.sleep(0.5)
+        _req("POST", f"{base}/stop", {"grace_seconds": 2.0})
+        states, _ = _wait_done(runner)
+        assert states[-1]["state"] == "terminated"
+        assert states[-1]["termination_reason"] == "terminated_by_user"
+
+    def test_max_duration(self, runner):
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r",
+              "job_spec": _job_spec(["sleep 60"], max_duration=1)})
+        _req("POST", f"{base}/run", {})
+        states, _ = _wait_done(runner, timeout=20)
+        assert states[-1]["state"] == "terminated"
+        assert states[-1]["termination_reason"] == "max_duration_exceeded"
+
+    def test_upload_code(self, runner, tmp_path):
+        import tarfile
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "hello.txt").write_text("from-archive")
+        tar_path = tmp_path / "code.tar"
+        with tarfile.open(tar_path, "w") as tar:
+            tar.add(src / "hello.txt", arcname="hello.txt")
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["cat hello.txt"]),
+              "repo_archive": True})
+        _req("POST", f"{base}/upload_code", tar_path.read_bytes())
+        _req("POST", f"{base}/run", {})
+        states, logs = _wait_done(runner)
+        assert states[-1]["state"] == "done"
+        assert "from-archive" in _logs_text(logs)
+
+    def test_double_submit_rejected(self, runner):
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit", {"run_name": "r", "job_spec": _job_spec([])})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _req("POST", f"{base}/submit", {"run_name": "r", "job_spec": _job_spec([])})
+        assert exc.value.code == 400
+
+    def test_metrics(self, runner):
+        resp = _req("GET", f"http://127.0.0.1:{runner}/api/metrics")
+        assert "timestamp" in resp
+        assert "cpu_usage_micro" in resp
+
+
+class TestShim:
+    @pytest.fixture
+    def shim(self, binaries):
+        proc, port = _start(
+            [binaries["shim"], "--host", "127.0.0.1", "--port", 0,
+             "--runtime", "process", "--runner-binary", binaries["runner"]]
+        )
+        yield port
+        proc.kill()
+        proc.wait()
+
+    def test_healthcheck_and_host_info(self, shim):
+        resp = _req("GET", f"http://127.0.0.1:{shim}/api/healthcheck")
+        assert resp["service"] == "dstack-tpu-shim"
+        info = _req("GET", f"http://127.0.0.1:{shim}/api/host_info")
+        assert info["cpus"] >= 1
+        assert info["memory_mib"] > 0
+
+    def test_task_lifecycle_end_to_end(self, shim):
+        """Shim spawns a runner (process runtime); drive a job through it."""
+        base = f"http://127.0.0.1:{shim}/api"
+        _req("POST", f"{base}/tasks",
+             {"id": "task-1", "name": "test", "env": {"FOO": "bar"}})
+        deadline = time.time() + 10
+        task = None
+        while time.time() < deadline:
+            task = _req("GET", f"{base}/tasks/task-1")
+            if task["status"] == "running":
+                break
+            assert task["status"] != "terminated", task
+            time.sleep(0.2)
+        assert task["status"] == "running"
+        rport = task["runner_port"]
+
+        # Wait for the spawned runner to accept connections.
+        rbase = f"http://127.0.0.1:{rport}/api"
+        for _ in range(50):
+            try:
+                _req("GET", f"{rbase}/healthcheck")
+                break
+            except (urllib.error.URLError, ConnectionError):
+                time.sleep(0.1)
+        _req("POST", f"{rbase}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["echo FOO=$FOO"])})
+        _req("POST", f"{rbase}/run", {})
+        states, logs = _wait_done(rport)
+        assert states[-1]["state"] == "done"
+        assert "FOO=bar" in _logs_text(logs)
+
+        # Terminate + remove through the shim API.
+        task = _req("POST", f"{base}/tasks/task-1/terminate",
+                    {"termination_reason": "terminated_by_user", "timeout": 2})
+        assert task["status"] == "terminated"
+        _req("DELETE", f"{base}/tasks/task-1")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _req("GET", f"{base}/tasks/task-1")
+        assert exc.value.code == 404
+
+    def test_unknown_task_404(self, shim):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _req("GET", f"http://127.0.0.1:{shim}/api/tasks/nope")
+        assert exc.value.code == 404

@@ -116,4 +116,4 @@ def test_unsharded_train_step_matches_sharded():
     s1 = init_train_state(CFG, jax.random.PRNGKey(0), mesh=mesh)
     step1 = make_train_step(CFG, mesh)
     _, m1 = step1(s1, synthetic_batch(CFG, batch_size=2, seq_len=32, mesh=mesh))
-    assert abs(float(m0["loss"]) - float(m1["loss"])) < 1e-3
+    assert abs(float(m0["loss"]) - float(m1["loss"])) < 5e-3
