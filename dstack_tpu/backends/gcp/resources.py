@@ -1,0 +1,203 @@
+"""GCP request-body builders (pure functions, fully unit-testable).
+
+Parity: src/dstack/_internal/core/backends/gcp/resources.py (434 LoC of
+instance/TPU-node structs). TPU-first deltas: multi-host slices are built,
+not filtered (reference filters them at gcp/compute.py:711-713,804-821);
+queued-resource bodies cover the capacity-wait path the reference lacks.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from dstack_tpu.backends.base.compute import get_shim_commands
+from dstack_tpu.models.topology import TpuTopology
+
+LABEL_PREFIX = "dstack-tpu"
+
+
+def tpu_node_name(project_id: str, zone: str, node_id: str) -> str:
+    return f"projects/{project_id}/locations/{zone}/nodes/{node_id}"
+
+
+def tpu_parent(project_id: str, zone: str) -> str:
+    return f"projects/{project_id}/locations/{zone}"
+
+
+def startup_script(authorized_key: str, agent_download_url: str = "") -> str:
+    """TPU-VM startup script: bootstrap the shim host agent.
+
+    Parity: gcp/compute.py:773-779 (TPU startup script = shim commands with
+    `--pjrt-device=TPU` threaded via base/compute.py:303-309).
+    """
+    commands = "\n".join(get_shim_commands(authorized_key, agent_download_url, tpu=True))
+    return f"#!/bin/bash\n{commands}\n"
+
+
+def tpu_node_body(
+    *,
+    topo: TpuTopology,
+    authorized_key: str,
+    project_name: str,
+    run_name: str,
+    spot: bool = False,
+    runtime_version: Optional[str] = None,
+    network: str = "default",
+    subnetwork: Optional[str] = None,
+    agent_download_url: str = "",
+    data_disks: Optional[List[str]] = None,
+    reservation: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Body for tpu.projects.locations.nodes.create.
+
+    Multi-host slices come out of the same call: `accelerator_type`
+    (e.g. "v5p-256") implies the worker-VM count; the created node exposes
+    one `networkEndpoints[]` entry per worker (gcp/compute.py:320-342).
+    """
+    body: Dict[str, Any] = {
+        "acceleratorType": topo.accelerator_type,
+        "runtimeVersion": runtime_version or topo.runtime_version,
+        "networkConfig": {
+            "network": network,
+            "enableExternalIps": True,
+        },
+        "metadata": {
+            "startup-script": startup_script(authorized_key, agent_download_url),
+        },
+        "labels": {
+            f"{LABEL_PREFIX}-project": project_name,
+            f"{LABEL_PREFIX}-run": run_name,
+        },
+        "tags": [LABEL_PREFIX],
+    }
+    if subnetwork:
+        body["networkConfig"]["subnetwork"] = subnetwork
+    if env:
+        # Surface-level env for debugging; the shim gets real env via API.
+        body["metadata"].update({k.lower().replace("_", "-"): v for k, v in env.items()})
+    if spot:
+        body["schedulingConfig"] = {"preemptible": False, "spot": True}
+    if reservation:
+        body["schedulingConfig"] = {
+            **body.get("schedulingConfig", {}),
+            "reserved": True,
+        }
+    if data_disks:
+        body["dataDisks"] = [
+            {"sourceDisk": disk, "mode": "READ_WRITE"} for disk in data_disks
+        ]
+    return body
+
+
+def queued_resource_body(
+    *,
+    node_id: str,
+    node_body: Dict[str, Any],
+    spot: bool = False,
+    reservation: Optional[str] = None,
+    valid_until_duration: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Body for tpu.projects.locations.queuedResources.create — the
+    capacity-wait path (queued resources API; absent from the reference).
+
+    `spot`/`guaranteed.reservationName` are QueuedResource-level fields, so
+    the node spec's schedulingConfig is stripped.
+    """
+    body: Dict[str, Any] = {
+        "tpu": {
+            "nodeSpec": [
+                {
+                    "parent": "",  # filled by compute with the location parent
+                    "nodeId": node_id,
+                    "node": {k: v for k, v in node_body.items() if k != "schedulingConfig"},
+                }
+            ]
+        },
+    }
+    if spot:
+        body["spot"] = {}
+    elif reservation:
+        body["guaranteed"] = {"reserved": True}
+        body["reservationName"] = reservation
+    if valid_until_duration:
+        body["queueingPolicy"] = {"validUntilDuration": valid_until_duration}
+    return body
+
+
+def disk_body(
+    project_id: str,
+    zone: str,
+    name: str,
+    size_gb: int,
+    disk_type: str = "pd-balanced",
+) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "sizeGb": str(size_gb),
+        "type": f"projects/{project_id}/zones/{zone}/diskTypes/{disk_type}",
+        "labels": {f"{LABEL_PREFIX}-volume": name},
+    }
+
+
+def attach_disk_patch(existing_disks: List[Dict[str, Any]], source_disk: str) -> Dict[str, Any]:
+    """UpdateNode body attaching a PD to a (possibly running) TPU node.
+
+    Parity: gcp/compute.py:592-622 (TPU disk attach via UpdateNodeRequest
+    with update_mask=data_disks).
+    """
+    disks = [d for d in existing_disks if d.get("sourceDisk") != source_disk]
+    disks.append({"sourceDisk": source_disk, "mode": "READ_WRITE"})
+    return {"dataDisks": disks}
+
+
+def parse_node_endpoints(node: Dict[str, Any]) -> List[Dict[str, Optional[str]]]:
+    """[{internal_ip, external_ip}] per worker host, in worker order
+    (gcp/compute.py:320-342 reads network_endpoints the same way)."""
+    out: List[Dict[str, Optional[str]]] = []
+    for ep in node.get("networkEndpoints", []):
+        access = ep.get("accessConfig") or {}
+        out.append(
+            {
+                "internal_ip": ep.get("ipAddress"),
+                "external_ip": access.get("externalIp"),
+            }
+        )
+    return out
+
+
+def gateway_instance_body(
+    *,
+    name: str,
+    zone: str,
+    machine_type: str = "e2-small",
+    authorized_key: str = "",
+    startup: str = "",
+) -> Dict[str, Any]:
+    """Small GCE VM for the gateway (nginx + gateway app)."""
+    return {
+        "name": name,
+        "machineType": f"zones/{zone}/machineTypes/{machine_type}",
+        "disks": [
+            {
+                "boot": True,
+                "autoDelete": True,
+                "initializeParams": {
+                    "sourceImage": "projects/debian-cloud/global/images/family/debian-12",
+                    "diskSizeGb": "20",
+                },
+            }
+        ],
+        "networkInterfaces": [
+            {
+                "network": "global/networks/default",
+                "accessConfigs": [{"type": "ONE_TO_ONE_NAT", "name": "External NAT"}],
+            }
+        ],
+        "metadata": {
+            "items": [
+                {"key": "ssh-keys", "value": f"ubuntu:{authorized_key}"},
+                {"key": "startup-script", "value": startup},
+            ]
+        },
+        "labels": {f"{LABEL_PREFIX}-gateway": name},
+        "tags": {"items": [f"{LABEL_PREFIX}-gateway"]},
+    }

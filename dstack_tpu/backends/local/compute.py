@@ -117,7 +117,9 @@ class LocalCompute(Compute):
                     internal_ip="127.0.0.1",
                     region=offer.region,
                     availability_zone=offer.zone,
-                    price=offer.price,
+                    # offer.price covers the whole slice; each worker carries
+                    # its share so per-job cost sums correctly.
+                    price=offer.price / offer.hosts,
                     username="root",
                     ssh_port=None,
                     dockerized=False,  # server talks to the runner directly

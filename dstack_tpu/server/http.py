@@ -31,7 +31,7 @@ from urllib.parse import parse_qs, unquote
 
 from pydantic import BaseModel, ValidationError
 
-from dstack_tpu.errors import ApiError
+from dstack_tpu.errors import ApiError, ConfigurationError
 
 logger = logging.getLogger(__name__)
 
@@ -246,6 +246,13 @@ class App:
             return Response(result)
         except ApiError as e:
             return Response(e.to_json(), status=e.status)
+        except ConfigurationError as e:
+            # Invalid user YAML/spec nested inside a request body (e.g. a bad
+            # `tpu:` accelerator type) is the client's error, not a 500.
+            return Response(
+                {"detail": [{"msg": str(e), "code": "configuration_error"}]},
+                status=400,
+            )
         except ValidationError as e:
             return Response(
                 {"detail": [{"msg": str(e), "code": "validation_error"}]}, status=400

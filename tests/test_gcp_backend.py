@@ -1,0 +1,262 @@
+"""GCP backend tests against a fake REST transport (no network, as in the
+reference's test strategy — SURVEY §4: cloud Compute calls are faked)."""
+
+import json
+import re
+
+import pytest
+
+from dstack_tpu.backends.gcp.api import GcpApiError
+from dstack_tpu.backends.gcp.compute import GCPBackendConfig, GCPCompute
+from dstack_tpu.errors import ComputeError
+from dstack_tpu.models.resources import ResourcesSpec
+from dstack_tpu.models.runs import Requirements
+from dstack_tpu.models.volumes import Volume, VolumeConfiguration
+
+def tpu_req():
+    """Broad TPU requirement: match every catalog slice."""
+    return Requirements(resources=ResourcesSpec(tpu={"chips": {"min": 1}}))
+
+
+class FakeGcpApi:
+    """Simulates the TPU v2 REST surface: node create/get/delete/patch,
+    queued resources, GCE disks."""
+
+    def __init__(self):
+        self.requests = []
+        self.nodes = {}  # name -> node dict
+        self.queued = {}
+
+    async def request(self, method, url, body=None):
+        self.requests.append((method, url, body))
+        if method == "POST" and "/nodes?nodeId=" in url:
+            node_id = url.rsplit("nodeId=", 1)[1]
+            parent = url.split("/nodes?")[0].split("/v2/")[1]
+            name = f"{parent}/nodes/{node_id}"
+            n_hosts = self._hosts_for(body["acceleratorType"])
+            self.nodes[name] = {
+                **body,
+                "name": name,
+                "state": "CREATING",
+                "networkEndpoints": [
+                    {"ipAddress": f"10.0.0.{i + 1}",
+                     "accessConfig": {"externalIp": f"34.1.2.{i + 1}"}}
+                    for i in range(n_hosts)
+                ],
+            }
+            return {"name": f"{name}/operations/op-1"}
+        if method == "POST" and "/queuedResources?" in url:
+            qr_id = url.rsplit("queuedResourceId=", 1)[1]
+            self.queued[qr_id] = {**body, "state": {"state": "WAITING_FOR_RESOURCES"}}
+            return {}
+        if method == "GET" and "/queuedResources/" in url:
+            qr_id = url.rsplit("/", 1)[1]
+            if qr_id not in self.queued:
+                raise GcpApiError(f"GET {url}: not found", status=404)
+            return self.queued[qr_id]
+        if method == "GET" and "/nodes/" in url:
+            name = url.split("/v2/")[1]
+            if name not in self.nodes:
+                raise GcpApiError(f"GET {url}: not found", status=404)
+            node = self.nodes[name]
+            # Nodes become READY on the second poll.
+            if node["state"] == "CREATING":
+                node["state"] = "CREATING_POLLED"
+            elif node["state"] == "CREATING_POLLED":
+                node["state"] = "READY"
+            return node
+        if method == "DELETE":
+            name = url.split("/v2/")[-1].split("?")[0]
+            for store in (self.nodes, self.queued):
+                for k in list(store):
+                    if k.endswith(name) or name.endswith(k):
+                        del store[k]
+                        return {}
+            if "disks" in url or "instances" in url:
+                return {}
+            raise GcpApiError(f"DELETE {url}: not found", status=404)
+        if method == "PATCH":
+            name = url.split("/v2/")[1].split("?")[0]
+            self.nodes[name].update(body)
+            return {}
+        if method == "POST" and "/disks" in url:
+            return {}
+        if method == "POST" and "/instances" in url:
+            return {}
+        raise AssertionError(f"unexpected request {method} {url}")
+
+    @staticmethod
+    def _hosts_for(acc_type):
+        from dstack_tpu.models.topology import TpuTopology
+
+        return TpuTopology.parse(acc_type).hosts
+
+
+@pytest.fixture
+def api():
+    return FakeGcpApi()
+
+
+@pytest.fixture
+def compute(api):
+    return GCPCompute(
+        GCPBackendConfig(project_id="proj", regions=["us-east5", "us-central1"]),
+        api=api,
+    )
+
+
+async def test_offers_include_multihost_slices(compute):
+    offers = await compute.get_offers(tpu_req())
+    names = {o.instance.name for o in offers}
+    # The reference filters multi-host TPUs out entirely; we must offer them.
+    assert "v5p-256" in names
+    big = next(o for o in offers if o.instance.name == "v5p-256")
+    assert big.hosts == 32
+    assert big.instance.resources.tpu.chips == 128
+    # region filtering applies
+    assert all(o.region in ("us-east5", "us-central1") for o in offers)
+
+
+async def test_run_job_multihost_gang(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-16" and not o.instance.resources.spot)
+    jpds = await compute.run_job("proj", "run1", offer, "ssh-ed25519 KEY", "run1-inst")
+    assert len(jpds) == offer.hosts == 2
+    assert all(j.tpu_node_id == jpds[0].tpu_node_id for j in jpds)
+    assert [j.tpu_worker_index for j in jpds] == [0, 1]
+    assert all(j.hostname is None for j in jpds)
+
+    # One CreateNode call total — the slice is one atomic cloud resource.
+    creates = [r for r in api.requests if r[0] == "POST" and "/nodes?" in r[1]]
+    assert len(creates) == 1
+    body = creates[0][2]
+    assert body["acceleratorType"] == "v5p-16"
+    assert "startup-script" in body["metadata"]
+    assert "dstack-tpu-shim" in body["metadata"]["startup-script"]
+    assert "--pjrt-device TPU" in body["metadata"]["startup-script"]
+
+    # Poll to READY: each worker gets its own endpoint's IPs.
+    for _ in range(3):
+        jpds = [await compute.update_provisioning_data(j) for j in jpds]
+    assert jpds[0].internal_ip == "10.0.0.1"
+    assert jpds[1].internal_ip == "10.0.0.2"
+    assert jpds[1].hostname == "34.1.2.2"
+
+
+async def test_spot_offer_sets_scheduling(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5litepod-8" and o.instance.resources.spot)
+    await compute.run_job("proj", "run2", offer, "KEY", "run2-inst")
+    body = api.requests[-1][2]
+    assert body["schedulingConfig"] == {"preemptible": False, "spot": True}
+    # spot is cheaper than on-demand
+    on_demand = next(
+        o for o in offers if o.instance.name == "v5litepod-8" and not o.instance.resources.spot
+    )
+    assert offer.price < on_demand.price
+
+
+async def test_queued_provisioning(api):
+    compute = GCPCompute(
+        GCPBackendConfig(project_id="proj", queued_provisioning=True), api=api
+    )
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v6e-16")
+    jpds = await compute.run_job("proj", "run3", offer, "KEY", "run3-inst")
+    assert len(api.queued) == 1
+    qr = next(iter(api.queued.values()))
+    assert qr["tpu"]["nodeSpec"][0]["nodeId"] == "run3-inst"
+    # While queued, the node doesn't exist: update is a graceful no-op.
+    jpd = await compute.update_provisioning_data(jpds[0])
+    assert jpd.hostname is None
+
+
+async def test_terminate_removes_node(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-8")
+    jpds = await compute.run_job("proj", "run4", offer, "KEY", "run4-inst")
+    assert len(api.nodes) == 1
+    await compute.terminate_instance(
+        jpds[0].instance_id, jpds[0].region, jpds[0].backend_data
+    )
+    assert len(api.nodes) == 0
+    # Idempotent: second terminate swallows the 404.
+    await compute.terminate_instance(
+        jpds[0].instance_id, jpds[0].region, jpds[0].backend_data
+    )
+
+
+async def test_node_failure_surfaces(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-8")
+    jpds = await compute.run_job("proj", "run5", offer, "KEY", "run5-inst")
+    next(iter(api.nodes.values()))["state"] = "FAILED"
+    with pytest.raises(ComputeError, match="FAILED"):
+        await compute.update_provisioning_data(jpds[0])
+
+
+async def test_volume_attach_patches_node_disks(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-8")
+    jpds = await compute.run_job("proj", "run6", offer, "KEY", "run6-inst")
+    from datetime import datetime, timezone
+
+    from dstack_tpu.models.volumes import VolumeStatus
+
+    volume = Volume(
+        id="v1",
+        name="ckpt",
+        project_name="proj",
+        configuration=VolumeConfiguration(
+            backend="gcp", region="us-east5", size=200
+        ),
+        volume_id="ckpt",
+        created_at=datetime.now(timezone.utc),
+        status=VolumeStatus.SUBMITTED,
+    )
+    await compute.create_volume(volume)
+    attach = await compute.attach_volume(volume, jpds[0])
+    assert attach.device_name == "/dev/disk/by-id/google-ckpt"
+    node = next(iter(api.nodes.values()))
+    assert node["dataDisks"][0]["sourceDisk"].endswith("/disks/ckpt")
+    await compute.detach_volume(volume, jpds[0])
+    node = next(iter(api.nodes.values()))
+    assert node["dataDisks"] == []
+
+
+async def test_node_id_sanitized(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-8")
+    await compute.run_job("proj", "r", offer, "KEY", "My_Weird NAME!!x")
+    create_url = [u for m, u, _ in api.requests if m == "POST" and "/nodes?" in u][0]
+    node_id = create_url.rsplit("nodeId=", 1)[1]
+    assert re.fullmatch(r"[a-z0-9-]{1,60}", node_id)
+
+
+async def test_queued_failure_surfaces(api):
+    compute = GCPCompute(
+        GCPBackendConfig(project_id="proj", queued_provisioning=True), api=api
+    )
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v6e-16")
+    jpds = await compute.run_job("proj", "run7", offer, "KEY", "run7-inst")
+    next(iter(api.queued.values()))["state"] = {"state": "FAILED"}
+    with pytest.raises(ComputeError, match="FAILED"):
+        await compute.update_provisioning_data(jpds[0])
+
+
+async def test_per_worker_price_sums_to_slice_price(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-16" and not o.instance.resources.spot)
+    jpds = await compute.run_job("proj", "run8", offer, "KEY", "run8-inst")
+    assert abs(sum(j.price for j in jpds) - offer.price) < 1e-6
+
+
+async def test_node_id_rfc1035(compute, api):
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.name == "v5p-8")
+    await compute.run_job("proj", "r", offer, "KEY", "2024-retrain" + "x" * 60 + "-")
+    create_url = [u for m, u, _ in api.requests if m == "POST" and "/nodes?" in u][0]
+    node_id = create_url.rsplit("nodeId=", 1)[1]
+    assert re.fullmatch(r"[a-z]([a-z0-9-]*[a-z0-9])?", node_id)
+    assert len(node_id) <= 60
