@@ -49,12 +49,26 @@ class HttpGcpApi:
     credentials (if the package is present), GCE/TPU-VM metadata server.
     """
 
+    TOKEN_TTL_SECONDS = 45 * 60  # refresh before the ~1h expiry
+
     def __init__(self, access_token: Optional[str] = None):
         self._token = access_token
+        # An explicitly provided token is trusted indefinitely (tests,
+        # short-lived jobs); fetched tokens get a refresh deadline.
+        self._token_expiry: Optional[float] = None
+
+    def _invalidate_token(self) -> None:
+        self._token = None
+        self._token_expiry = None
 
     def _get_token(self) -> str:
-        if self._token:
+        import time as _time
+
+        if self._token and (
+            self._token_expiry is None or _time.monotonic() < self._token_expiry
+        ):
             return self._token
+        self._token = None
         try:  # pragma: no cover - depends on environment
             import google.auth
             import google.auth.transport.requests
@@ -64,6 +78,7 @@ class HttpGcpApi:
             )
             creds.refresh(google.auth.transport.requests.Request())
             self._token = creds.token
+            self._token_expiry = _time.monotonic() + self.TOKEN_TTL_SECONDS
             return self._token
         except Exception:
             pass
@@ -74,7 +89,13 @@ class HttpGcpApi:
                 headers={"Metadata-Flavor": "Google"},
             )
             with urllib.request.urlopen(req, timeout=5) as resp:
-                self._token = json.loads(resp.read())["access_token"]
+                payload = json.loads(resp.read())
+                self._token = payload["access_token"]
+                ttl = min(
+                    float(payload.get("expires_in", self.TOKEN_TTL_SECONDS)) - 300,
+                    self.TOKEN_TTL_SECONDS,
+                )
+                self._token_expiry = _time.monotonic() + max(ttl, 60.0)
                 return self._token
         except Exception as e:
             raise BackendError(f"No GCP credentials available: {e}")
@@ -95,6 +116,10 @@ class HttpGcpApi:
                     payload = resp.read()
                     return json.loads(payload) if payload else {}
             except urllib.error.HTTPError as e:
+                if e.code == 401:
+                    # Token expired/revoked: drop it so the next call
+                    # re-authenticates instead of failing until restart.
+                    self._invalidate_token()
                 detail = e.read().decode(errors="replace")
                 raise GcpApiError(
                     f"GCP API {method} {url}: {e.code} {detail}", status=e.code

@@ -300,8 +300,10 @@ class GCPCompute(Compute):
         name = res.tpu_node_name(self.config.project_id, zone, node_id)
         try:
             node = await self.api.request("GET", f"{TPU_API}/{name}")
-        except BackendError:
-            return  # node already gone; nothing to detach from
+        except GcpApiError as e:
+            if e.status == 404:
+                return  # node already gone; nothing to detach from
+            raise
         source_suffix = f"/disks/{volume.volume_id or volume.name}"
         disks = [
             d for d in node.get("dataDisks", [])

@@ -73,7 +73,16 @@ def tpu_node_body(
         body["networkConfig"]["subnetwork"] = subnetwork
     if env:
         # Surface-level env for debugging; the shim gets real env via API.
-        body["metadata"].update({k.lower().replace("_", "-"): v for k, v in env.items()})
+        # Reserved metadata keys (the bootstrap script!) must never be
+        # clobbered by user env names.
+        reserved = set(body["metadata"])
+        body["metadata"].update(
+            {
+                k.lower().replace("_", "-"): v
+                for k, v in env.items()
+                if k.lower().replace("_", "-") not in reserved
+            }
+        )
     if spot:
         body["schedulingConfig"] = {"preemptible": False, "spot": True}
     if reservation:

@@ -1,0 +1,216 @@
+"""Gateway registry app — runs on the gateway VM behind nginx.
+
+Parity: src/dstack/_internal/proxy/gateway/app.py + routers/registry.py:
+the control-plane server reaches this API over an SSH tunnel to register
+services and replicas; each replica is exposed to nginx as an upstream.
+Stats (per-service request counts parsed from the nginx access log) feed
+back to the server's autoscaler.
+
+Run: python -m dstack_tpu.gateway.app --port 8001
+"""
+
+import argparse
+import asyncio
+import logging
+import re
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from dstack_tpu.gateway.nginx import NginxManager, SiteConfig, Upstream
+from dstack_tpu.server.http import App, Request, Response, Router, Server
+
+logger = logging.getLogger(__name__)
+
+ACCESS_LOG = Path("/var/log/nginx/dstack.access.log")
+
+
+class Registry:
+    def __init__(self, nginx: Optional[NginxManager] = None):
+        self.nginx = nginx or NginxManager()
+        self.services: Dict[str, dict] = {}  # "{project}/{run}" -> info
+
+    def register_service(
+        self,
+        project_name: str,
+        run_name: str,
+        domain: str,
+        https: bool = False,
+        auth: bool = False,
+        auth_tokens: Optional[List[str]] = None,
+        options: Optional[dict] = None,
+    ) -> None:
+        key = f"{project_name}/{run_name}"
+        self.services[key] = {
+            "project_name": project_name,
+            "run_name": run_name,
+            "domain": domain,
+            "https": https,
+            "auth": auth,
+            # Tokens allowed through nginx auth_request; pushed by the
+            # control-plane server (project member tokens).
+            "auth_tokens": set(auth_tokens or []),
+            "options": options or {},
+            "replicas": {},
+        }
+        self._apply(key)
+
+    def authorize(self, host: str, token: Optional[str]) -> bool:
+        """auth_request decision for a request to `host` with bearer `token`."""
+        for info in self.services.values():
+            if info["domain"] == host:
+                if not info["auth"]:
+                    return True
+                return bool(token) and token in info["auth_tokens"]
+        return False  # unknown domain: deny
+
+    def register_replica(
+        self, project_name: str, run_name: str, replica_id: str, address: str
+    ) -> None:
+        key = f"{project_name}/{run_name}"
+        if key not in self.services:
+            raise KeyError(f"service {key} is not registered")
+        self.services[key]["replicas"][replica_id] = address
+        self._apply(key)
+
+    def unregister_replica(self, project_name: str, run_name: str, replica_id: str) -> None:
+        key = f"{project_name}/{run_name}"
+        if key in self.services:
+            self.services[key]["replicas"].pop(replica_id, None)
+            self._apply(key)
+
+    def unregister_service(self, project_name: str, run_name: str) -> None:
+        key = f"{project_name}/{run_name}"
+        info = self.services.pop(key, None)
+        if info:
+            site = self._site(info)
+            self.nginx.remove(site.upstream_name)
+
+    def _site(self, info: dict) -> SiteConfig:
+        return SiteConfig(
+            domain=info["domain"],
+            project_name=info["project_name"],
+            run_name=info["run_name"],
+            https=info["https"],
+            auth=info["auth"],
+            upstreams=[Upstream(a) for a in info["replicas"].values()],
+        )
+
+    def _apply(self, key: str) -> None:
+        self.nginx.apply(self._site(self.services[key]))
+
+
+# Access-log stats: one window counter per service domain.
+_LOG_RE = re.compile(r'^\S+ - \S+ \[[^\]]+\] "(?:\S+) (?P<path>\S+)[^"]*" (?P<status>\d+)')
+
+
+def parse_access_log_window(
+    lines: List[str], domains_to_service: Dict[str, str]
+) -> Dict[str, int]:
+    """Count requests per service from access-log lines.
+
+    The default combined log format carries no Host, so the gateway logs
+    with `$host` prefixed; fall back to path-prefix mapping otherwise.
+    """
+    counts: Dict[str, int] = {}
+    for line in lines:
+        host, _, rest = line.partition(" ")
+        service = domains_to_service.get(host)
+        if service is not None:
+            counts[service] = counts.get(service, 0) + 1
+    return counts
+
+
+def create_gateway_app(registry: Optional[Registry] = None) -> App:
+    app = App()
+    reg = registry or Registry()
+    app.state["registry"] = reg
+    router = Router(prefix="/api")
+
+    @router.get("/healthcheck")
+    async def healthcheck(request: Request):
+        return {"service": "dstack-tpu-gateway", "version": "0.1.0"}
+
+    @router.post("/registry/services/register")
+    async def register_service(request: Request):
+        b = request.json()
+        reg.register_service(
+            b["project_name"], b["run_name"], b["domain"],
+            https=b.get("https", False), auth=b.get("auth", False),
+            auth_tokens=b.get("auth_tokens"),
+            options=b.get("options"),
+        )
+        return {}
+
+    @router.post("/registry/services/unregister")
+    async def unregister_service(request: Request):
+        b = request.json()
+        reg.unregister_service(b["project_name"], b["run_name"])
+        return {}
+
+    @router.post("/registry/replicas/register")
+    async def register_replica(request: Request):
+        b = request.json()
+        try:
+            reg.register_replica(
+                b["project_name"], b["run_name"], b["replica_id"], b["address"]
+            )
+        except KeyError as e:
+            return Response({"detail": str(e)}, status=404)
+        return {}
+
+    @router.post("/registry/replicas/unregister")
+    async def unregister_replica(request: Request):
+        b = request.json()
+        reg.unregister_replica(b["project_name"], b["run_name"], b["replica_id"])
+        return {}
+
+    @router.get("/stats")
+    async def stats(request: Request):
+        """Requests per service since the last call (server polls this)."""
+        state = app.state.setdefault("stats_offset", 0)
+        lines: List[str] = []
+        if ACCESS_LOG.exists():
+            with ACCESS_LOG.open() as f:
+                f.seek(app.state["stats_offset"])
+                lines = f.readlines()
+                app.state["stats_offset"] = f.tell()
+        domains = {
+            info["domain"]: key for key, info in reg.services.items()
+        }
+        return {"window_requests": parse_access_log_window(lines, domains), "ts": time.time()}
+
+    @router.get("/auth")
+    async def auth(request: Request):
+        # nginx auth_request subrequest: 200 allows, 401 denies. The original
+        # Host arrives via X-Forwarded-Host (nginx.py auth location); the
+        # token must be one the control plane registered for that service.
+        host = request.headers.get("x-forwarded-host", "")
+        if reg.authorize(host, request.bearer_token):
+            return Response({}, status=200)
+        return Response({}, status=401)
+
+    app.include_router(router)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    args = parser.parse_args()
+
+    async def _serve() -> None:
+        app = create_gateway_app()
+        server = Server(app, args.host, args.port)
+        await server.start()
+        print(f"gateway listening on {args.host}:{server.port}", flush=True)
+        assert server._server is not None
+        async with server._server:
+            await server._server.serve_forever()
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Nginx site-config rendering for the gateway VM.
+
+Parity: src/dstack/_internal/proxy/gateway/services/nginx.py:23-152 (jinja2
+site configs per service domain + certbot ACME + reload). Rendering is pure
+string-building so it is unit-testable; applying (write + `nginx -s reload`,
+certbot) is side-effectful and gated behind NginxManager.
+"""
+
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+CONF_DIR = Path("/etc/nginx/sites-enabled")
+ACME_ROOT = Path("/var/www/html")
+
+
+@dataclass
+class Upstream:
+    address: str  # "unix:/run/dstack/svc-0.sock" or "10.0.0.5:8000"
+    weight: int = 1
+
+
+@dataclass
+class SiteConfig:
+    domain: str
+    project_name: str
+    run_name: str
+    upstreams: List[Upstream] = field(default_factory=list)
+    https: bool = False
+    cert_path: Optional[str] = None
+    key_path: Optional[str] = None
+    auth: bool = False  # bearer-token auth via the registry's auth endpoint
+    client_max_body_size: str = "64m"
+
+    @property
+    def upstream_name(self) -> str:
+        return f"{self.project_name}-{self.run_name}".replace(".", "-")
+
+
+def render_site(site: SiteConfig) -> str:
+    lines: List[str] = []
+    lines.append(f"upstream {site.upstream_name} {{")
+    for up in site.upstreams or [Upstream("127.0.0.1:9")]:  # 9 = discard, no replicas
+        addr = up.address if "/" not in up.address else f"unix:{up.address.removeprefix('unix:')}"
+        lines.append(f"    server {addr} weight={up.weight};")
+    lines.append("}")
+    lines.append("server {")
+    if site.https and site.cert_path:
+        lines.append("    listen 443 ssl;")
+        lines.append(f"    ssl_certificate {site.cert_path};")
+        lines.append(f"    ssl_certificate_key {site.key_path};")
+    else:
+        lines.append("    listen 80;")
+    lines.append(f"    server_name {site.domain};")
+    lines.append(f"    client_max_body_size {site.client_max_body_size};")
+    # ACME challenge always served over http for issuance/renewal.
+    lines.append("    location /.well-known/acme-challenge/ {")
+    lines.append(f"        root {ACME_ROOT};")
+    lines.append("    }")
+    lines.append("    location / {")
+    if site.auth:
+        lines.append("        auth_request /_dstack_auth;")
+    lines.append(f"        proxy_pass http://{site.upstream_name};")
+    lines.append("        proxy_set_header Host $host;")
+    lines.append("        proxy_set_header X-Real-IP $remote_addr;")
+    lines.append("        proxy_http_version 1.1;")
+    lines.append('        proxy_set_header Upgrade $http_upgrade;')
+    lines.append('        proxy_set_header Connection "upgrade";')
+    lines.append("        proxy_read_timeout 300s;")
+    lines.append("    }")
+    if site.auth:
+        lines.append("    location = /_dstack_auth {")
+        lines.append("        internal;")
+        lines.append("        proxy_pass http://127.0.0.1:8001/api/auth;")
+        lines.append("        proxy_pass_request_body off;")
+        lines.append('        proxy_set_header Content-Length "";')
+        lines.append("        proxy_set_header X-Original-URI $request_uri;")
+        lines.append("        proxy_set_header X-Forwarded-Host $host;")
+        lines.append("    }")
+    lines.append("    access_log /var/log/nginx/dstack.access.log;")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+class NginxManager:
+    """Writes site configs and reloads nginx (gateway VM only)."""
+
+    def __init__(self, conf_dir: Path = CONF_DIR):
+        self.conf_dir = conf_dir
+
+    def apply(self, site: SiteConfig) -> None:
+        self.conf_dir.mkdir(parents=True, exist_ok=True)
+        path = self.conf_dir / f"dstack-{site.upstream_name}.conf"
+        path.write_text(render_site(site))
+        self.reload()
+
+    def remove(self, site_upstream_name: str) -> None:
+        path = self.conf_dir / f"dstack-{site_upstream_name}.conf"
+        if path.exists():
+            path.unlink()
+            self.reload()
+
+    def reload(self) -> None:
+        try:
+            subprocess.run(["nginx", "-s", "reload"], check=False, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            pass  # dev boxes without nginx: configs still written for tests

@@ -323,6 +323,10 @@ class ServiceModelSpec(CoreModel):
     name: str
     base_url: str
     type: str
+    # Adapter selection for the model proxy (model_proxy.py): which wire
+    # format the container speaks and, for openai, its path prefix.
+    format: str = "openai"
+    prefix: str = "/v1"
 
 
 class ServiceSpec(CoreModel):

@@ -51,6 +51,7 @@ def create_app(
         users as users_router,
         volumes as volumes_router,
         gateways as gateways_router,
+        model_proxy as model_proxy_router,
         services_proxy as services_proxy_router,
     )
 
@@ -58,7 +59,7 @@ def create_app(
         users_router, projects_router, runs_router, fleets_router,
         instances_router, volumes_router, gateways_router, backends_router,
         repos_router, secrets_router, logs_router, metrics_router,
-        server_info_router, services_proxy_router,
+        server_info_router, services_proxy_router, model_proxy_router,
     ):
         app.include_router(mod.router)
 

@@ -27,6 +27,40 @@ async def process_gateways(ctx: ServerContext) -> None:
             logger.exception("failed to process gateway %s", row["name"])
         finally:
             ctx.locker.unlock_nowait("gateways", row["id"])
+    await _poll_gateway_stats(ctx)
+
+
+async def _poll_gateway_stats(ctx: ServerContext) -> None:
+    """Pull per-service request counters from RUNNING gateways into the
+    autoscaler's stats collector (reference: gateway nginx access-log stats
+    feeding process_runs' autoscaler hook)."""
+    rows = await ctx.db.fetchall(
+        "SELECT g.id, gc.hostname, gc.ip_address FROM gateways g"
+        " JOIN gateway_computes gc ON g.gateway_compute_id = gc.id"
+        " WHERE g.status = 'running'"
+    )
+    client = ctx.overrides.get("gateway_stats_client") or _http_gateway_stats
+    for row in rows:
+        host = row["hostname"] or row["ip_address"]
+        if not host:
+            continue
+        try:
+            stats = await client(host)
+        except Exception as e:
+            logger.debug("gateway %s stats poll failed: %s", host, e)
+            continue
+        for service_key, count in (stats.get("window_requests") or {}).items():
+            project_name, _, run_name = service_key.partition("/")
+            ctx.service_stats.ingest(project_name, run_name, int(count), window=0.0)
+
+
+async def _http_gateway_stats(host: str) -> dict:
+    import httpx
+
+    async with httpx.AsyncClient(timeout=10.0) as client:
+        resp = await client.get(f"http://{host}:8001/api/stats")
+        resp.raise_for_status()
+        return resp.json()
 
 
 async def _process_gateway(ctx: ServerContext, row) -> None:

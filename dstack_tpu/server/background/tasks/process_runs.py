@@ -128,6 +128,72 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
             "UPDATE runs SET status = ? WHERE id = ?", (new_status.value, row["id"])
         )
 
+    if (new_status or RunStatus(row["status"])) == RunStatus.RUNNING:
+        await _maybe_autoscale(ctx, row, jobs)
+
+
+async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
+    """Replica autoscaling for RUNNING services (reference:
+    _process_pending_run autoscaler hook, process_runs.py:142-153)."""
+    run_spec = RunSpec.model_validate_json(row["run_spec"])
+    conf = run_spec.configuration
+    if conf.type != "service":
+        return
+    from dstack_tpu.server.services.autoscalers import get_service_scaler
+
+    scaler = get_service_scaler(conf)
+    active_replicas = sorted(
+        {
+            j["replica_num"]
+            for j in jobs
+            if not JobStatus(j["status"]).is_finished()
+            and j["status"] != JobStatus.TERMINATING.value
+        }
+    )
+    current = len(active_replicas)
+    project = await ctx.db.fetchone(
+        "SELECT name FROM projects WHERE id = ?", (row["project_id"],)
+    )
+    rps = ctx.service_stats.get_rps(project["name"], row["run_name"])
+    last_scaled = parse_dt(row["last_scaled_at"]) if row["last_scaled_at"] else None
+    decision = scaler.scale(current, rps, utcnow(), last_scaled)
+    if decision.desired == current:
+        return
+    logger.info(
+        "run %s: scaling %s -> %s (%s)",
+        row["run_name"], current, decision.desired, decision.reason,
+    )
+    if decision.desired > current:
+        next_replica = max((j["replica_num"] for j in jobs), default=-1) + 1
+        for replica in range(next_replica, next_replica + decision.desired - current):
+            await create_replica_jobs(
+                ctx, row["project_id"], row["id"], run_spec, replica, 0
+            )
+        ctx.kick("submitted_jobs")
+    else:
+        # Scale down the highest-numbered replicas first.
+        excess = current - decision.desired
+        for replica in active_replicas[-excess:]:
+            for j in jobs:
+                if j["replica_num"] != replica:
+                    continue
+                if not JobStatus(j["status"]).is_finished():
+                    await ctx.db.execute(
+                        "UPDATE jobs SET status = ?, termination_reason = ?,"
+                        " last_processed_at = ? WHERE id = ?",
+                        (
+                            JobStatus.TERMINATING.value,
+                            JobTerminationReason.SCALED_DOWN.value,
+                            utcnow_iso(),
+                            j["id"],
+                        ),
+                    )
+        ctx.kick("terminating_jobs")
+    await ctx.db.execute(
+        "UPDATE runs SET desired_replica_count = ?, last_scaled_at = ? WHERE id = ?",
+        (decision.desired, utcnow_iso(), row["id"]),
+    )
+
 
 async def _maybe_retry(
     ctx: ServerContext, row: sqlite3.Row, jobs: List[sqlite3.Row], failed_replicas: set

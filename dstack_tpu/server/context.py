@@ -25,6 +25,9 @@ class ServerContext:
         self.encryption = encryption or Encryption()
         self.backends: Dict[str, Any] = {}  # (project_id, type) -> Backend; see services/backends.py
         self.log_storage: Any = None  # set at startup; see services/logs.py
+        from dstack_tpu.server.services.stats import ServiceStatsCollector
+
+        self.service_stats = ServiceStatsCollector()
         self._signals: Dict[str, asyncio.Event] = {}
         self._tasks: List[asyncio.Task] = []
         self.stopping = False

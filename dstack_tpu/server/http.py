@@ -264,11 +264,21 @@ class App:
                 status=500,
             )
 
+    _started = False
+
     async def startup(self) -> None:
+        # Idempotent: Server.start() calls this too, and running the hooks
+        # twice re-initializes state (an in-memory DB would be wiped).
+        if self._started:
+            return
+        self._started = True
         for fn in self.on_startup:
             await fn()
 
     async def shutdown(self) -> None:
+        if not self._started:
+            return
+        self._started = False
         for fn in self.on_shutdown:
             await fn()
 

@@ -1,0 +1,152 @@
+"""OpenAI-compatible model API: /proxy/models/{project}/...
+
+Parity: src/dstack/_internal/proxy/lib/services/model_proxy/ — `/models`
+listing plus chat-completions routed to the service replica that serves the
+requested model, with format adapters:
+  - openai: passthrough to the container's own OpenAI-compatible server
+    (vLLM-TPU, JetStream+adapter)
+  - tgi: translate chat-completions <-> TGI /generate
+"""
+
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import httpx
+
+from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.server.http import Request, Response, Router
+from dstack_tpu.server.routers.deps import get_ctx
+
+logger = logging.getLogger(__name__)
+
+router = Router(prefix="/proxy/models")
+
+
+async def _service_models(ctx, project_name: str) -> List[Dict[str, Any]]:
+    """All models served by RUNNING services of a project."""
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project_row is None:
+        raise ResourceNotExistsError("Project not found")
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0"
+        " AND service_spec IS NOT NULL AND status = 'running'",
+        (project_row["id"],),
+    )
+    models = []
+    for row in rows:
+        spec = json.loads(row["service_spec"])
+        model = spec.get("model")
+        if model:
+            models.append(
+                {
+                    "run_id": row["id"],
+                    "run_name": row["run_name"],
+                    "name": model["name"],
+                    "format": model.get("format", "openai"),
+                    "prefix": model.get("prefix", "/v1"),
+                }
+            )
+    return models
+
+
+@router.get("/{project_name}/models")
+async def list_models(request: Request, project_name: str):
+    models = await _service_models(get_ctx(request), project_name)
+    return {
+        "object": "list",
+        "data": [
+            {
+                "id": m["name"],
+                "object": "model",
+                "created": 0,
+                "owned_by": m["run_name"],
+            }
+            for m in models
+        ],
+    }
+
+
+@router.post("/{project_name}/chat/completions")
+async def chat_completions(request: Request, project_name: str):
+    ctx = get_ctx(request)
+    body = request.json() or {}
+    model_name = body.get("model")
+    if not model_name:
+        raise BadRequestError("`model` is required")
+    models = await _service_models(ctx, project_name)
+    match = next((m for m in models if m["name"] == model_name), None)
+    if match is None:
+        raise ResourceNotExistsError(f"Model {model_name} not found")
+    ctx.service_stats.record(project_name, match["run_name"])
+    from dstack_tpu.server.routers.services_proxy import pick_replica
+
+    jpd, port = await pick_replica(ctx, project_name, match["run_name"])
+    base = f"http://{jpd.hostname}:{port}"
+    if match["format"] == "tgi":
+        return await _tgi_chat(base, body)
+    return await _openai_passthrough(base + match["prefix"], body)
+
+
+async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
+    try:
+        async with httpx.AsyncClient(timeout=300.0) as client:
+            upstream = await client.post(f"{base}/chat/completions", json=body)
+    except httpx.HTTPError as e:
+        return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+    return Response(
+        upstream.content,
+        status=upstream.status_code,
+        headers={"content-type": upstream.headers.get("content-type", "application/json")},
+    )
+
+
+def _messages_to_prompt(messages: List[Dict[str, Any]]) -> str:
+    """Minimal chat template for TGI backends without one (reference:
+    model_proxy/clients/tgi.py renders the model's chat_template; without
+    tokenizer access we use a plain role-tagged prompt)."""
+    parts = []
+    for m in messages:
+        parts.append(f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}")
+    parts.append("<|assistant|>\n")
+    return "\n".join(parts)
+
+
+async def _tgi_chat(base: str, body: Dict[str, Any]) -> Response:
+    prompt = _messages_to_prompt(body.get("messages", []))
+    tgi_body = {
+        "inputs": prompt,
+        "parameters": {
+            "max_new_tokens": body.get("max_tokens", 512),
+            "temperature": body.get("temperature") or None,
+            "top_p": body.get("top_p") or None,
+            "stop": body.get("stop") or [],
+        },
+    }
+    try:
+        async with httpx.AsyncClient(timeout=300.0) as client:
+            upstream = await client.post(f"{base}/generate", json=tgi_body)
+    except httpx.HTTPError as e:
+        return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+    if upstream.status_code != 200:
+        return Response(upstream.content, status=upstream.status_code)
+    generated = upstream.json().get("generated_text", "")
+    return Response(
+        {
+            "id": f"chatcmpl-{int(time.time() * 1000)}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model"),
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": generated},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {},
+        }
+    )

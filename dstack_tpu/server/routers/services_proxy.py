@@ -27,7 +27,7 @@ _HOP_HEADERS = {
 }
 
 
-async def _pick_replica(ctx, project_name: str, run_name: str):
+async def pick_replica(ctx, project_name: str, run_name: str):
     project_row = await ctx.db.fetchone(
         "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
     )
@@ -57,7 +57,8 @@ async def _pick_replica(ctx, project_name: str, run_name: str):
 
 async def proxy_service(request: Request, project_name: str, run_name: str, rest: str):
     ctx = get_ctx(request)
-    jpd, port = await _pick_replica(ctx, project_name, run_name)
+    ctx.service_stats.record(project_name, run_name)  # feeds the autoscaler
+    jpd, port = await pick_replica(ctx, project_name, run_name)
     # Host-network containers expose the app port on the instance address;
     # local backend runs directly on the server host.
     target = f"http://{jpd.hostname}:{port}/{rest}"

@@ -258,3 +258,10 @@ CREATE TABLE logs (
 CREATE INDEX ix_logs_submission ON logs(job_submission_id, id);
 """
 )
+
+# Migration 2: replica-scaling bookkeeping for services.
+migration(
+    """
+ALTER TABLE runs ADD COLUMN last_scaled_at TEXT;
+"""
+)

@@ -1,0 +1,95 @@
+"""Service replica autoscalers.
+
+Parity: src/dstack/_internal/server/services/services/autoscalers.py:24-126
+(ManualScaler + RPSAutoscaler with target RPS and asymmetric up/down delays).
+"""
+
+import math
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+from typing import Optional
+
+from dstack_tpu.models.configurations import ScalingSpec, ServiceConfiguration
+
+
+@dataclass
+class ScalingDecision:
+    desired: int
+    reason: str = ""
+
+
+class ManualScaler:
+    """No automatic scaling: desired count only changes via `apply`."""
+
+    def __init__(self, min_replicas: int, max_replicas: int):
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+
+    def scale(
+        self,
+        current: int,
+        avg_rps: float,
+        now: datetime,
+        last_scaled_at: Optional[datetime],
+    ) -> ScalingDecision:
+        desired = min(max(current, self.min_replicas), self.max_replicas)
+        return ScalingDecision(desired=desired)
+
+
+class RPSAutoscaler:
+    """Scale to ceil(rps / target), clamped, rate-limited by delays.
+
+    Scale-to-zero is allowed when min_replicas == 0 (the reference supports
+    this for services; a v5e slice idling at $10/hr is worth releasing).
+    """
+
+    def __init__(
+        self,
+        min_replicas: int,
+        max_replicas: int,
+        target: float,
+        scale_up_delay: float,
+        scale_down_delay: float,
+    ):
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target = target
+        self.scale_up_delay = scale_up_delay
+        self.scale_down_delay = scale_down_delay
+
+    def scale(
+        self,
+        current: int,
+        avg_rps: float,
+        now: datetime,
+        last_scaled_at: Optional[datetime],
+    ) -> ScalingDecision:
+        desired = math.ceil(avg_rps / self.target) if self.target > 0 else current
+        desired = min(max(desired, self.min_replicas), self.max_replicas)
+        if desired == current:
+            return ScalingDecision(desired=current)
+        delay = self.scale_up_delay if desired > current else self.scale_down_delay
+        if last_scaled_at is not None and (now - last_scaled_at) < timedelta(seconds=delay):
+            return ScalingDecision(
+                desired=current,
+                reason=f"waiting out {'up' if desired > current else 'down'}-delay",
+            )
+        return ScalingDecision(
+            desired=desired,
+            reason=f"rps={avg_rps:.2f} target={self.target} -> {desired} replicas",
+        )
+
+
+def get_service_scaler(conf: ServiceConfiguration):
+    min_r = conf.replicas.min if conf.replicas.min is not None else 1
+    max_r = conf.replicas.max if conf.replicas.max is not None else min_r
+    scaling: Optional[ScalingSpec] = conf.scaling
+    if scaling is None:
+        return ManualScaler(min_r, max_r)
+    return RPSAutoscaler(
+        min_replicas=min_r,
+        max_replicas=max_r,
+        target=scaling.target,
+        scale_up_delay=float(scaling.scale_up_delay),
+        scale_down_delay=float(scaling.scale_down_delay),
+    )

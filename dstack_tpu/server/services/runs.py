@@ -225,10 +225,13 @@ async def submit_run(
             if run_spec.configuration.model is not None:
                 from dstack_tpu.models.runs import ServiceModelSpec
 
+                model_conf = run_spec.configuration.model
                 service_spec.model = ServiceModelSpec(
-                    name=run_spec.configuration.model.name,
+                    name=model_conf.name,
                     base_url=f"/proxy/models/{project_row['name']}",
-                    type=run_spec.configuration.model.type,
+                    type=model_conf.type,
+                    format=getattr(model_conf, "format", "openai"),
+                    prefix=getattr(model_conf, "prefix", "/v1"),
                 )
         await ctx.db.execute(
             "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"

@@ -1,0 +1,53 @@
+"""Per-service request stats (feeds the RPS autoscaler).
+
+The reference collects RPS from the gateway's nginx access log
+(proxy/gateway/services/stats.py); the in-server proxy records requests
+here directly, and gateways push their per-window counters through
+`ingest` (gateway registry API).
+"""
+
+import time
+from collections import defaultdict, deque
+from typing import Deque, Dict, Tuple
+
+WINDOW_SECONDS = 60.0
+
+
+class ServiceStatsCollector:
+    def __init__(self, window: float = WINDOW_SECONDS):
+        self.window = window
+        self._events: Dict[Tuple[str, str], Deque[Tuple[float, int]]] = defaultdict(deque)
+
+    def record(self, project_name: str, run_name: str, count: int = 1) -> None:
+        key = (project_name, run_name)
+        self._events[key].append((time.monotonic(), count))
+        self._trim(key)
+
+    def ingest(
+        self, project_name: str, run_name: str, requests: int, window: float = 0.0
+    ) -> None:
+        """Absorb a gateway-reported window total.
+
+        The gateway reports "N requests since my last poll"; recording the
+        whole count at `now` keeps the collector's own window math correct
+        as long as polls are more frequent than the window (they are:
+        gateway poll interval << 60s window). `window` is accepted for
+        future smearing but unused.
+        """
+        del window
+        if requests > 0:
+            self.record(project_name, run_name, requests)
+
+    def get_rps(self, project_name: str, run_name: str) -> float:
+        key = (project_name, run_name)
+        self._trim(key)
+        total = sum(c for _, c in self._events.get(key, ()))
+        return total / self.window
+
+    def _trim(self, key: Tuple[str, str]) -> None:
+        horizon = time.monotonic() - self.window
+        q = self._events.get(key)
+        if q is None:
+            return
+        while q and q[0][0] < horizon:
+            q.popleft()

@@ -1,0 +1,421 @@
+"""Services layer: autoscalers, stats, nginx rendering, gateway registry,
+model proxy, and replica autoscaling through the run FSM."""
+
+import asyncio
+import json
+import sys
+from datetime import timedelta
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from dstack_tpu.gateway.app import Registry, create_gateway_app
+from dstack_tpu.gateway.nginx import NginxManager, SiteConfig, Upstream, render_site
+from dstack_tpu.models.configurations import ServiceConfiguration
+from dstack_tpu.models.runs import JobStatus, RunStatus
+from dstack_tpu.server.http import TestClient, response_json
+from dstack_tpu.server.services.autoscalers import (
+    ManualScaler,
+    RPSAutoscaler,
+    get_service_scaler,
+)
+from dstack_tpu.server.services.stats import ServiceStatsCollector
+from dstack_tpu.utils.common import utcnow
+
+from server.conftest import make_server
+
+
+# --- autoscalers ------------------------------------------------------------
+
+
+def test_rps_autoscaler_scales_up():
+    s = RPSAutoscaler(1, 10, target=5.0, scale_up_delay=0, scale_down_delay=0)
+    d = s.scale(current=1, avg_rps=23.0, now=utcnow(), last_scaled_at=None)
+    assert d.desired == 5  # ceil(23/5)
+
+
+def test_rps_autoscaler_clamps():
+    s = RPSAutoscaler(1, 3, target=1.0, scale_up_delay=0, scale_down_delay=0)
+    assert s.scale(1, 100.0, utcnow(), None).desired == 3
+    assert s.scale(3, 0.0, utcnow(), None).desired == 1
+
+
+def test_rps_autoscaler_scale_to_zero():
+    s = RPSAutoscaler(0, 3, target=1.0, scale_up_delay=0, scale_down_delay=0)
+    assert s.scale(1, 0.0, utcnow(), None).desired == 0
+
+
+def test_rps_autoscaler_respects_delays():
+    now = utcnow()
+    s = RPSAutoscaler(1, 10, target=1.0, scale_up_delay=300, scale_down_delay=600)
+    recently = now - timedelta(seconds=60)
+    # Wants to scale up but last scaling was 60s ago < 300s delay.
+    assert s.scale(1, 5.0, now, recently).desired == 1
+    long_ago = now - timedelta(seconds=400)
+    assert s.scale(1, 5.0, now, long_ago).desired == 5
+    # Down delay is longer: 400s ago still blocks scale-down.
+    assert s.scale(5, 0.0, now, long_ago).desired == 5
+
+
+def test_manual_scaler_noop():
+    s = ManualScaler(1, 5)
+    assert s.scale(3, 1000.0, utcnow(), None).desired == 3
+
+
+def test_get_service_scaler_picks_impl():
+    conf = ServiceConfiguration(
+        name="svc", port=8000, commands=["serve"], replicas="1..4",
+        scaling={"metric": "rps", "target": 10},
+    )
+    assert isinstance(get_service_scaler(conf), RPSAutoscaler)
+    conf2 = ServiceConfiguration(name="svc", port=8000, commands=["serve"])
+    assert isinstance(get_service_scaler(conf2), ManualScaler)
+
+
+def test_stats_collector_window():
+    c = ServiceStatsCollector(window=60)
+    for _ in range(120):
+        c.record("p", "r")
+    assert c.get_rps("p", "r") == pytest.approx(2.0)
+    assert c.get_rps("p", "other") == 0.0
+
+
+# --- nginx rendering --------------------------------------------------------
+
+
+def test_render_site_http():
+    conf = render_site(
+        SiteConfig(
+            domain="svc.example.com",
+            project_name="main",
+            run_name="llama-svc",
+            upstreams=[Upstream("10.0.0.5:8000"), Upstream("unix:/run/r1.sock")],
+        )
+    )
+    assert "upstream main-llama-svc {" in conf
+    assert "server 10.0.0.5:8000 weight=1;" in conf
+    assert "server unix:/run/r1.sock weight=1;" in conf
+    assert "listen 80;" in conf
+    assert "server_name svc.example.com;" in conf
+    assert "acme-challenge" in conf
+    assert "auth_request" not in conf
+
+
+def test_render_site_https_auth():
+    conf = render_site(
+        SiteConfig(
+            domain="svc.example.com", project_name="p", run_name="r",
+            https=True, cert_path="/etc/ssl/c.pem", key_path="/etc/ssl/k.pem",
+            auth=True,
+        )
+    )
+    assert "listen 443 ssl;" in conf
+    assert "ssl_certificate /etc/ssl/c.pem;" in conf
+    assert "auth_request /_dstack_auth;" in conf
+
+
+# --- gateway registry app ---------------------------------------------------
+
+
+async def test_gateway_registry(tmp_path):
+    registry = Registry(nginx=NginxManager(conf_dir=tmp_path))
+    app = create_gateway_app(registry)
+    client = TestClient(app)
+
+    r = await client.get("/api/healthcheck")
+    assert response_json(r)["service"] == "dstack-tpu-gateway"
+
+    r = await client.post("/api/registry/services/register", {
+        "project_name": "main", "run_name": "svc", "domain": "svc.gw.example.com",
+    })
+    assert r.status == 200
+    conf_path = tmp_path / "dstack-main-svc.conf"
+    assert conf_path.exists()
+
+    r = await client.post("/api/registry/replicas/register", {
+        "project_name": "main", "run_name": "svc",
+        "replica_id": "r0", "address": "10.0.0.7:8000",
+    })
+    assert r.status == 200
+    assert "10.0.0.7:8000" in conf_path.read_text()
+
+    # Registering a replica of an unknown service 404s.
+    r = await client.post("/api/registry/replicas/register", {
+        "project_name": "main", "run_name": "nope", "replica_id": "x",
+        "address": "1.2.3.4:1",
+    })
+    assert r.status == 404
+
+    r = await client.post("/api/registry/services/unregister",
+                          {"project_name": "main", "run_name": "svc"})
+    assert r.status == 200
+    assert not conf_path.exists()
+
+
+# --- model proxy through the server -----------------------------------------
+
+
+class _StubModelServer:
+    """Acts as a service replica serving an OpenAI-compatible endpoint."""
+
+    def __init__(self):
+        self.requests = []
+
+    async def start(self):
+        async def handle(reader, writer):
+            data = await reader.read(65536)
+            head, _, body = data.partition(b"\r\n\r\n")
+            first_line = head.split(b"\r\n", 1)[0].decode()
+            self.requests.append((first_line, body))
+            if b"/generate" in head.split(b"\r\n")[0]:
+                payload = json.dumps({"generated_text": "hi from tgi"})
+            else:
+                payload = json.dumps(
+                    {"object": "chat.completion",
+                     "choices": [{"message": {"content": "hi from vllm"}}]}
+                )
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                + f"Content-Length: {len(payload)}\r\n\r\n{payload}".encode()
+            )
+            await writer.drain()
+            writer.close()
+
+        self.server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    def stop(self):
+        self.server.close()
+
+
+async def _make_service_run(fx, run_name, model, port):
+    """Insert a RUNNING service run + one RUNNING replica job directly."""
+    ctx = fx.ctx
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    from dstack_tpu.server.security import generate_id
+    from dstack_tpu.utils.common import utcnow_iso
+
+    run_id = generate_id()
+    now = utcnow_iso()
+    run_spec = {
+        "run_name": run_name, "repo_id": "local",
+        "configuration": {"type": "service", "name": run_name, "port": port,
+                          "commands": ["serve"], "model": model},
+    }
+    from dstack_tpu.models.runs import RunSpec
+
+    spec = RunSpec.model_validate(run_spec)
+    service_spec = {"url": f"/proxy/services/main/{run_name}/", "model": None}
+    if model:
+        service_spec["model"] = {"name": model, "format": "openai", "prefix": "/v1"}
+    await ctx.db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+        " last_processed_at, status, run_spec, service_spec)"
+        " VALUES (?, ?, ?, ?, ?, ?, 'running', ?, ?)",
+        (run_id, project["id"], user["id"], run_name, now, now,
+         spec.model_dump_json(), json.dumps(service_spec)),
+    )
+    job_spec = {
+        "job_name": f"{run_name}-0-0", "commands": ["serve"],
+        "requirements": {"resources": {}},
+        "app_specs": [{"app_name": "app", "port": port}],
+    }
+    jpd = {
+        "backend": "local", "instance_type": {"name": "local", "resources": {"cpus": 1, "memory_mib": 1024}},
+        "instance_id": "i-1", "hostname": "127.0.0.1", "internal_ip": "127.0.0.1",
+        "region": "local", "price": 0.0, "username": "root", "dockerized": False,
+    }
+    from dstack_tpu.models.runs import JobProvisioningData, JobSpec
+
+    await ctx.db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " submitted_at, last_processed_at, status, job_spec, job_provisioning_data)"
+        " VALUES (?, ?, ?, ?, 0, 0, ?, ?, 'running', ?, ?)",
+        (generate_id(), project["id"], run_id, run_name, now, now,
+         JobSpec.model_validate(job_spec).model_dump_json(),
+         JobProvisioningData.model_validate(jpd).model_dump_json()),
+    )
+    return run_id
+
+
+async def test_model_proxy_openai_passthrough():
+    stub = _StubModelServer()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "llama-svc", "llama-3-8b", port)
+        r = await fx.client.get("/proxy/models/main/models")
+        models = response_json(r)
+        assert [m["id"] for m in models["data"]] == ["llama-3-8b"]
+
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "llama-3-8b", "messages": [{"role": "user", "content": "hello"}]},
+        )
+        assert r.status == 200
+        body = json.loads(r.body)
+        assert body["choices"][0]["message"]["content"] == "hi from vllm"
+        assert any("/v1/chat/completions" in line for line, _ in stub.requests)
+
+        # Unknown model -> resource_not_exists (400, reference API style).
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "nope", "messages": []},
+        )
+        assert r.status == 400
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+# --- autoscaling through the run FSM ----------------------------------------
+
+
+async def test_service_run_scales_up_on_rps():
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_service_run(fx, "scaled-svc", None, 8000)
+        # Give the run a scaling spec: 1..4 replicas, target 1 rps.
+        row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        spec = json.loads(row["run_spec"])
+        spec["configuration"]["replicas"] = "1..4"
+        spec["configuration"]["scaling"] = {"metric": "rps", "target": 1,
+                                            "scale_up_delay": "0s",
+                                            "scale_down_delay": "0s"}
+        from dstack_tpu.models.runs import RunSpec
+
+        await ctx.db.execute(
+            "UPDATE runs SET run_spec = ? WHERE id = ?",
+            (RunSpec.model_validate(spec).model_dump_json(), run_id),
+        )
+        # Simulate traffic: 3 rps over the window.
+        for _ in range(180):
+            ctx.service_stats.record("main", "scaled-svc")
+
+        from dstack_tpu.server.background.tasks.process_runs import process_runs
+
+        await process_runs(ctx)
+
+        jobs = await ctx.db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY replica_num", (run_id,)
+        )
+        replicas = {j["replica_num"] for j in jobs}
+        assert len(replicas) == 3  # ceil(3 rps / 1) = 3 replicas
+        run = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        assert run["desired_replica_count"] == 3
+        assert run["last_scaled_at"] is not None
+
+        # Traffic stops: next tick scales back down to min=1.
+        ctx.service_stats._events.clear()
+        await ctx.db.execute("UPDATE runs SET last_scaled_at = NULL WHERE id = ?", (run_id,))
+        await process_runs(ctx)
+        jobs = await ctx.db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? AND status = 'terminating'", (run_id,)
+        )
+        assert {j["termination_reason"] for j in jobs} == {"scaled_down"}
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_model_proxy_tgi_adapter():
+    stub = _StubModelServer()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_service_run(fx, "tgi-svc", "flan-t5", port)
+        # Flip the model format to tgi.
+        row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        spec = json.loads(row["service_spec"])
+        spec["model"]["format"] = "tgi"
+        await ctx.db.execute(
+            "UPDATE runs SET service_spec = ? WHERE id = ?", (json.dumps(spec), run_id)
+        )
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "flan-t5", "messages": [{"role": "user", "content": "hello"}]},
+        )
+        assert r.status == 200
+        body = json.loads(r.body)
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["content"] == "hi from tgi"
+        # The upstream got a TGI /generate call with a role-tagged prompt.
+        line, payload = next((l, p) for l, p in stub.requests if "/generate" in l)
+        assert b"<|user|>" in payload and b"hello" in payload
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_gateway_auth_tokens(tmp_path):
+    registry = Registry(nginx=NginxManager(conf_dir=tmp_path))
+    app = create_gateway_app(registry)
+    client = TestClient(app)
+    await client.post("/api/registry/services/register", {
+        "project_name": "main", "run_name": "svc", "domain": "svc.example.com",
+        "auth": True, "auth_tokens": ["tok-1", "tok-2"],
+    })
+    # Valid token for the right domain passes.
+    r = await client.request("GET", "/api/auth", headers={
+        "x-forwarded-host": "svc.example.com", "authorization": "Bearer tok-1"}, token="")
+    assert r.status == 200
+    # Wrong token denied (presence of a bearer header is NOT enough).
+    r = await client.request("GET", "/api/auth", headers={
+        "x-forwarded-host": "svc.example.com", "authorization": "Bearer wrong"}, token="")
+    assert r.status == 401
+    # Unknown domain denied.
+    r = await client.request("GET", "/api/auth", headers={
+        "x-forwarded-host": "ghost.example.com", "authorization": "Bearer tok-1"}, token="")
+    assert r.status == 401
+    # auth=False service: no token needed.
+    await client.post("/api/registry/services/register", {
+        "project_name": "main", "run_name": "open", "domain": "open.example.com",
+        "auth": False,
+    })
+    r = await client.request("GET", "/api/auth",
+                             headers={"x-forwarded-host": "open.example.com"}, token="")
+    assert r.status == 200
+
+
+async def test_gateway_stats_feed_autoscaler():
+    """RUNNING gateway stats flow into the server's stats collector."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        from dstack_tpu.server.security import generate_id
+        from dstack_tpu.utils.common import utcnow_iso
+
+        gc_id, gw_id = generate_id(), generate_id()
+        project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+        await ctx.db.execute(
+            "INSERT INTO gateway_computes (id, instance_id, ip_address, hostname,"
+            " region, backend, ssh_private_key, ssh_public_key) VALUES (?,?,?,?,?,?,?,?)",
+            (gc_id, "i-gw", "10.9.9.9", "10.9.9.9", "r", "gcp", "", ""),
+        )
+        await ctx.db.execute(
+            "INSERT INTO gateways (id, project_id, name, status, configuration,"
+            " gateway_compute_id, created_at, last_processed_at)"
+            " VALUES (?,?,?,?,?,?,?,?)",
+            (gw_id, project["id"], "gw", "running",
+             '{"type": "gateway", "name": "gw", "backend": "gcp", "region": "r"}',
+             gc_id, utcnow_iso(), utcnow_iso()),
+        )
+
+        polled_hosts = []
+
+        async def fake_stats(host):
+            polled_hosts.append(host)
+            return {"window_requests": {"main/llama-svc": 42}}
+
+        ctx.overrides["gateway_stats_client"] = fake_stats
+        from dstack_tpu.server.background.tasks.process_gateways import process_gateways
+
+        await process_gateways(ctx)
+        assert polled_hosts == ["10.9.9.9"]
+        assert ctx.service_stats.get_rps("main", "llama-svc") > 0
+    finally:
+        await fx.app.shutdown()
