@@ -7,6 +7,8 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cerrno>
+#include <cstdlib>
 #include <cstring>
 #include <sstream>
 
@@ -14,11 +16,20 @@
 
 namespace dstack {
 
+static int hex_val(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
 static std::string url_decode(const std::string& s) {
   std::string out;
   for (size_t i = 0; i < s.size(); ++i) {
-    if (s[i] == '%' && i + 2 < s.size()) {
-      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+    int hi, lo;
+    if (s[i] == '%' && i + 2 < s.size() && (hi = hex_val(s[i + 1])) >= 0 &&
+        (lo = hex_val(s[i + 2])) >= 0) {
+      out += static_cast<char>(hi * 16 + lo);
       i += 2;
     } else if (s[i] == '+') {
       out += ' ';
@@ -28,6 +39,12 @@ static std::string url_decode(const std::string& s) {
   }
   return out;
 }
+
+// Agents listen on VM interfaces that may be internet-reachable (TPU VMs
+// created with external IPs); any malformed request from a scanner must be
+// answered with 4xx, never allowed to throw in this detached thread (an
+// uncaught exception would std::terminate the whole agent mid-job).
+static constexpr size_t kMaxBodyBytes = 1ull << 30;  // 1 GiB
 
 void HttpServer::route(const std::string& method, const std::string& pattern,
                        Handler h) {
@@ -94,6 +111,19 @@ static bool read_exact(int fd, std::string& buf, size_t upto) {
 }
 
 void HttpServer::handle_connection(int fd) {
+  try {
+    handle_connection_impl(fd);
+  } catch (...) {
+    // Never let a parsing/handler exception escape a detached thread.
+    static const char kBadReq[] =
+        "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\nConnection: "
+        "close\r\n\r\n";
+    (void)!write(fd, kBadReq, sizeof(kBadReq) - 1);
+    close(fd);
+  }
+}
+
+void HttpServer::handle_connection_impl(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   // Read until end of headers.
@@ -141,7 +171,22 @@ void HttpServer::handle_connection(int fd) {
   }
   size_t content_length = 0;
   auto cl = req.headers.find("content-length");
-  if (cl != req.headers.end()) content_length = std::stoul(cl->second);
+  if (cl != req.headers.end()) {
+    errno = 0;
+    char* end = nullptr;
+    unsigned long long v = strtoull(cl->second.c_str(), &end, 10);
+    bool ok = end != cl->second.c_str() && errno == 0 && v <= kMaxBodyBytes;
+    while (ok && end && *end) ok = *end == ' ' && (++end, true);
+    if (!ok) {
+      static const char kBad[] =
+          "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\nConnection: "
+          "close\r\n\r\n";
+      (void)!write(fd, kBad, sizeof(kBad) - 1);
+      close(fd);
+      return;
+    }
+    content_length = static_cast<size_t>(v);
+  }
   req.body = data.substr(header_end + 4);
   if (req.body.size() < content_length) {
     std::string rest = req.body;

@@ -70,6 +70,7 @@ class HttpServer {
 
   void accept_loop();
   void handle_connection(int fd);
+  void handle_connection_impl(int fd);
   HttpResponse dispatch(HttpRequest& req);
 
   std::string host_;

@@ -12,7 +12,6 @@ Run: python -m dstack_tpu.gateway.app --port 8001
 import argparse
 import asyncio
 import logging
-import re
 import time
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -100,21 +99,18 @@ class Registry:
         self.nginx.apply(self._site(self.services[key]))
 
 
-# Access-log stats: one window counter per service domain.
-_LOG_RE = re.compile(r'^\S+ - \S+ \[[^\]]+\] "(?:\S+) (?P<path>\S+)[^"]*" (?P<status>\d+)')
-
-
 def parse_access_log_window(
     lines: List[str], domains_to_service: Dict[str, str]
 ) -> Dict[str, int]:
     """Count requests per service from access-log lines.
 
-    The default combined log format carries no Host, so the gateway logs
-    with `$host` prefixed; fall back to path-prefix mapping otherwise.
+    Lines are in the `dstack` log_format emitted by nginx.render_site
+    (`$host $remote_addr [$time_local] "$request" $status $body_bytes_sent`),
+    so the first space-separated field is the service domain.
     """
     counts: Dict[str, int] = {}
     for line in lines:
-        host, _, rest = line.partition(" ")
+        host, _, _ = line.partition(" ")
         service = domains_to_service.get(host)
         if service is not None:
             counts[service] = counts.get(service, 0) + 1
@@ -168,9 +164,13 @@ def create_gateway_app(registry: Optional[Registry] = None) -> App:
     @router.get("/stats")
     async def stats(request: Request):
         """Requests per service since the last call (server polls this)."""
-        state = app.state.setdefault("stats_offset", 0)
+        app.state.setdefault("stats_offset", 0)
         lines: List[str] = []
         if ACCESS_LOG.exists():
+            # Rotation/truncation makes the file shrink; a stale offset
+            # would seek past EOF and zero the stats forever.
+            if ACCESS_LOG.stat().st_size < app.state["stats_offset"]:
+                app.state["stats_offset"] = 0
             with ACCESS_LOG.open() as f:
                 f.seek(app.state["stats_offset"])
                 lines = f.readlines()

@@ -14,6 +14,17 @@ from typing import List, Optional
 CONF_DIR = Path("/etc/nginx/sites-enabled")
 ACME_ROOT = Path("/var/www/html")
 
+# Custom log format: the stats parser maps the FIRST field to a service
+# domain, which the default "combined" format does not carry ($remote_addr
+# comes first). Declared once at http-include level (sites-enabled files are
+# included in the http context; a duplicate declaration per site would be a
+# config error, hence the dedicated 00- file).
+LOG_FORMAT_NAME = "dstack"
+LOG_FORMAT_CONF = (
+    f"log_format {LOG_FORMAT_NAME} '$host $remote_addr [$time_local] "
+    f'"$request" $status $body_bytes_sent\';\n'
+)
+
 
 @dataclass
 class Upstream:
@@ -78,7 +89,7 @@ def render_site(site: SiteConfig) -> str:
         lines.append("        proxy_set_header X-Original-URI $request_uri;")
         lines.append("        proxy_set_header X-Forwarded-Host $host;")
         lines.append("    }")
-    lines.append("    access_log /var/log/nginx/dstack.access.log;")
+    lines.append(f"    access_log /var/log/nginx/dstack.access.log {LOG_FORMAT_NAME};")
     lines.append("}")
     return "\n".join(lines) + "\n"
 
@@ -91,6 +102,9 @@ class NginxManager:
 
     def apply(self, site: SiteConfig) -> None:
         self.conf_dir.mkdir(parents=True, exist_ok=True)
+        fmt = self.conf_dir / "dstack-00-log-format.conf"
+        if not fmt.exists() or fmt.read_text() != LOG_FORMAT_CONF:
+            fmt.write_text(LOG_FORMAT_CONF)
         path = self.conf_dir / f"dstack-{site.upstream_name}.conf"
         path.write_text(render_site(site))
         self.reload()

@@ -92,6 +92,8 @@ async def chat_completions(request: Request, project_name: str):
 
 
 async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
+    if body.get("stream"):
+        return await _openai_stream(base, body)
     try:
         async with httpx.AsyncClient(timeout=300.0) as client:
             upstream = await client.post(f"{base}/chat/completions", json=body)
@@ -101,6 +103,46 @@ async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
         upstream.content,
         status=upstream.status_code,
         headers={"content-type": upstream.headers.get("content-type", "application/json")},
+    )
+
+
+async def _openai_stream(base: str, body: Dict[str, Any]) -> Response:
+    """Token-by-token SSE relay: forward upstream chunks as they arrive
+    instead of buffering the full generation (reference model proxy streams).
+    Upstream errors keep their status/body rather than masquerading as a
+    successful empty stream."""
+    client = httpx.AsyncClient(timeout=300.0)
+    try:
+        upstream = await client.send(
+            client.build_request("POST", f"{base}/chat/completions", json=body),
+            stream=True,
+        )
+    except httpx.HTTPError as e:
+        await client.aclose()
+        return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+    if upstream.status_code != 200:
+        content = await upstream.aread()
+        await upstream.aclose()
+        await client.aclose()
+        return Response(
+            content,
+            status=upstream.status_code,
+            headers={"content-type": upstream.headers.get("content-type", "application/json")},
+        )
+
+    async def _gen():
+        try:
+            async for chunk in upstream.aiter_bytes():
+                yield chunk
+        except httpx.HTTPError:
+            pass  # mid-stream disconnect: terminate the chunked response
+        finally:
+            await upstream.aclose()
+            await client.aclose()
+
+    return Response(
+        stream=_gen(),
+        media_type=upstream.headers.get("content-type", "text/event-stream"),
     )
 
 
@@ -116,6 +158,10 @@ def _messages_to_prompt(messages: List[Dict[str, Any]]) -> str:
 
 
 async def _tgi_chat(base: str, body: Dict[str, Any]) -> Response:
+    if body.get("stream"):
+        # TGI translation is request/response; a buffered body dressed up as
+        # a chat.completion would break SSE-iterating SDKs, so be explicit.
+        raise BadRequestError("stream=true is not supported for tgi-format models")
     prompt = _messages_to_prompt(body.get("messages", []))
     tgi_body = {
         "inputs": prompt,

@@ -268,3 +268,42 @@ class TestShim:
         with pytest.raises(urllib.error.HTTPError) as exc:
             _req("GET", f"http://127.0.0.1:{shim}/api/tasks/nope")
         assert exc.value.code == 404
+
+
+class TestHttpHardening:
+    """Malformed requests from scanners must get 4xx, never kill the agent
+    (ADVICE r1 high: stoul/stoi threw in a detached thread -> std::terminate)."""
+
+    def _raw(self, port, payload: bytes) -> bytes:
+        import socket
+
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(payload)
+            s.settimeout(5)
+            out = b""
+            while True:
+                try:
+                    chunk = s.recv(4096)
+                except TimeoutError:
+                    break
+                if not chunk:
+                    break
+                out += chunk
+            return out
+
+    def test_bad_content_length_and_escapes(self, runner):
+        # Non-numeric Content-Length.
+        resp = self._raw(runner, b"POST /api/submit HTTP/1.1\r\nContent-Length: abc\r\n\r\n")
+        assert resp.startswith(b"HTTP/1.1 400")
+        # Huge Content-Length (would buffer unboundedly) — must be capped.
+        resp = self._raw(
+            runner, b"POST /api/submit HTTP/1.1\r\nContent-Length: 999999999999999\r\n\r\n"
+        )
+        assert resp.startswith(b"HTTP/1.1 400")
+        # Invalid %-escape in query string: tolerated, not a crash.
+        resp = self._raw(runner, b"GET /api/healthcheck?x=%zz%4 HTTP/1.1\r\n\r\n")
+        assert resp.startswith(b"HTTP/1.1 200")
+        # Agent is still alive and serving after all of the above.
+        assert _req("GET", f"http://127.0.0.1:{runner}/api/healthcheck")["service"] == (
+            "dstack-tpu-runner"
+        )

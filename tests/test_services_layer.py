@@ -419,3 +419,53 @@ async def test_gateway_stats_feed_autoscaler():
         assert ctx.service_stats.get_rps("main", "llama-svc") > 0
     finally:
         await fx.app.shutdown()
+
+
+def test_nginx_log_format_matches_stats_parser(tmp_path):
+    """The rendered access_log format and the stats parser must agree:
+    first field = $host = service domain (ADVICE r1: default combined format
+    put $remote_addr first and every line missed the domain lookup)."""
+    from dstack_tpu.gateway.app import parse_access_log_window
+    from dstack_tpu.gateway.nginx import LOG_FORMAT_CONF, LOG_FORMAT_NAME
+
+    mgr = NginxManager(conf_dir=tmp_path)
+    site = SiteConfig(domain="svc.example.com", project_name="main", run_name="svc",
+                      upstreams=[Upstream("10.0.0.7:8000")])
+    mgr.apply(site)
+    # log_format declared once at http-include level, referenced per site.
+    fmt = (tmp_path / "dstack-00-log-format.conf").read_text()
+    assert fmt == LOG_FORMAT_CONF and fmt.startswith(f"log_format {LOG_FORMAT_NAME} '$host ")
+    conf = (tmp_path / "dstack-main-svc.conf").read_text()
+    assert f"access_log /var/log/nginx/dstack.access.log {LOG_FORMAT_NAME};" in conf
+
+    # Lines exactly as nginx renders them under that format.
+    lines = [
+        'svc.example.com 203.0.113.9 [12/Jul/2026:10:01:02 +0000] "POST /v1/chat/completions HTTP/1.1" 200 512\n',
+        'svc.example.com 203.0.113.9 [12/Jul/2026:10:01:03 +0000] "GET /health HTTP/1.1" 200 2\n',
+        'other.example.com 198.51.100.4 [12/Jul/2026:10:01:04 +0000] "GET / HTTP/1.1" 404 0\n',
+    ]
+    counts = parse_access_log_window(lines, {"svc.example.com": "main/svc"})
+    assert counts == {"main/svc": 2}
+
+
+async def test_gateway_stats_offset_resets_on_rotation(tmp_path, monkeypatch):
+    """After log rotation (file shrinks), the saved byte offset must reset
+    instead of seeking past EOF forever (ADVICE r1)."""
+    import dstack_tpu.gateway.app as gwapp
+
+    log = tmp_path / "access.log"
+    monkeypatch.setattr(gwapp, "ACCESS_LOG", log)
+    registry = Registry(nginx=NginxManager(conf_dir=tmp_path))
+    app = create_gateway_app(registry)
+    client = TestClient(app)
+    await client.post("/api/registry/services/register", {
+        "project_name": "main", "run_name": "svc", "domain": "svc.example.com",
+    })
+    line = 'svc.example.com 203.0.113.9 [t] "GET / HTTP/1.1" 200 1\n'
+    log.write_text(line * 3)
+    r = await client.get("/api/stats")
+    assert response_json(r)["window_requests"] == {"main/svc": 3}
+    # Rotate: new, shorter file. Old offset (3 lines) > new size (1 line).
+    log.write_text(line)
+    r = await client.get("/api/stats")
+    assert response_json(r)["window_requests"] == {"main/svc": 1}
