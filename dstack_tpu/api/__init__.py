@@ -1,0 +1,20 @@
+"""Public SDK: `from dstack_tpu.api import Client`.
+
+Parity: reference `src/dstack/api/__init__.py` — the supported programmatic
+surface (Client + collections + Run handle + typed REST client underneath).
+"""
+
+from dstack_tpu.api.client import (  # noqa: F401
+    Client,
+    FleetCollection,
+    Run,
+    RunCollection,
+    VolumeCollection,
+)
+from dstack_tpu.api.config import GlobalConfig  # noqa: F401
+from dstack_tpu.api.rest import (  # noqa: F401
+    APIClient,
+    ApiClientError,
+    NotFoundError,
+    UnauthorizedApiError,
+)

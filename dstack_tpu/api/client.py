@@ -1,0 +1,337 @@
+"""Public Python SDK.
+
+Parity: reference `src/dstack/api/_public/__init__.py` (Client) and
+`runs.py:393-607` (RunCollection.get_plan/exec_plan/submit/list) +
+`runs.py:124-354` (Run wrapper: refresh/stop/logs/attach). The CLI is built
+on this module; nothing in the CLI talks raw HTTP.
+
+    from dstack_tpu.api import Client
+    client = Client.from_config(project_name="main")
+    plan = client.runs.get_plan(conf)
+    run = client.runs.exec_plan(plan)
+    for line in run.logs(follow=True):
+        print(line, end="")
+"""
+
+import hashlib
+import time
+from base64 import b64decode
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+from dstack_tpu.errors import ClientError, ConfigurationError
+from dstack_tpu.models.configurations import AnyRunConfiguration
+from dstack_tpu.models.fleets import Fleet, FleetConfiguration, FleetSpec
+from dstack_tpu.models.runs import ApplyRunPlanInput, Run as RunDTO, RunPlan, RunSpec, RunStatus
+from dstack_tpu.models.volumes import Volume, VolumeConfiguration
+from dstack_tpu.api.repos import detect_remote_repo, pack_local_repo, repo_id_for_dir
+from dstack_tpu.api.rest import APIClient, NotFoundError
+
+DEFAULT_SERVER_URL = "http://127.0.0.1:3000"
+
+
+class Run:
+    """A live handle on a submitted run (reference api/_public/runs.py:124)."""
+
+    def __init__(self, client: "Client", dto: RunDTO):
+        self._client = client
+        self._dto = dto
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._dto.run_spec.run_name or ""
+
+    @property
+    def status(self) -> RunStatus:
+        return self._dto.status
+
+    @property
+    def dto(self) -> RunDTO:
+        return self._dto
+
+    @property
+    def service_url(self) -> Optional[str]:
+        return self._dto.service.url if self._dto.service else None
+
+    def refresh(self) -> "Run":
+        self._dto = self._client.api.runs.get(self._client.project, self.name)
+        return self
+
+    def wait(self, statuses: Optional[List[RunStatus]] = None,
+             timeout: float = 3600.0, poll: float = 2.0) -> RunStatus:
+        """Block until the run reaches a finished (or given) status."""
+        targets = statuses or RunStatus.finished_statuses()
+        deadline = time.monotonic() + timeout
+        while True:
+            self.refresh()
+            if self._dto.status in targets:
+                return self._dto.status
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"run {self.name} still {self._dto.status.value}")
+            time.sleep(poll)
+
+    # -- control -------------------------------------------------------------
+
+    def stop(self, abort: bool = False) -> None:
+        self._client.api.runs.stop(self._client.project, [self.name], abort=abort)
+
+    def delete(self) -> None:
+        self._client.api.runs.delete(self._client.project, [self.name])
+
+    # -- logs ----------------------------------------------------------------
+
+    def logs(self, follow: bool = False, replica_num: int = 0,
+             job_num: Optional[int] = None,
+             poll_interval: float = 1.0) -> Iterator[bytes]:
+        """Yield decoded log chunks; with follow=True, keep tailing until the
+        run finishes (server-side poll loop — reference uses the runner's
+        /logs_ws through an SSH tunnel; the server's log store is the
+        authoritative history either way)."""
+        self.refresh()
+        if not self._dto.jobs:
+            return
+        # Re-picked every round so a retried job's NEW submission gets tailed
+        # (submission ids change on retry); cursors key by submission id.
+        page = 1000
+        cursors: Dict[str, Optional[str]] = {}
+
+        def _picked():
+            jobs = self._dto.jobs
+            sel = [
+                j for j in jobs
+                if j.job_spec.replica_num == replica_num
+                and (job_num is None or j.job_spec.job_num == job_num)
+            ]
+            return sel or jobs[:1]
+
+        def _drain(sub_id: str) -> Iterator[bytes]:
+            while True:
+                data = self._client.api.logs.poll(
+                    self._client.project, self.name, sub_id,
+                    start_after=cursors.get(sub_id), limit=page,
+                )
+                events = data.get("logs", [])
+                for event in events:
+                    yield b64decode(event["message"])
+                if data.get("next_token"):
+                    cursors[sub_id] = data["next_token"]
+                if len(events) < page:  # drained to the current end
+                    return
+
+        while True:
+            for job in _picked():
+                if job.job_submissions:
+                    yield from _drain(job.job_submissions[-1].id)
+            if not follow:
+                break
+            if self._dto.status.is_finished():
+                break  # this round's drain ran after finish was observed
+            time.sleep(poll_interval)
+            self.refresh()
+
+    def __repr__(self) -> str:
+        return f"<Run {self.name!r} {self._dto.status.value}>"
+
+
+class RunCollection:
+    """client.runs — parity: reference RunCollection (runs.py:393-607)."""
+
+    def __init__(self, client: "Client"):
+        self._client = client
+        # Blobs packed at plan time, uploaded at exec time; per-instance and
+        # superseded on re-plan so an abandoned plan can't leak 256 MiB tars.
+        self._pending_blobs: Dict[Any, bytes] = {}
+
+    def get_plan(
+        self,
+        configuration: Union[AnyRunConfiguration, Dict[str, Any]],
+        run_name: Optional[str] = None,
+        repo_dir: Optional[str] = None,
+        working_dir: Optional[str] = None,
+        configuration_path: Optional[str] = None,
+        ssh_key_pub: str = "",
+    ) -> RunPlan:
+        run_spec = self._make_run_spec(
+            configuration, run_name, repo_dir, working_dir, configuration_path,
+            ssh_key_pub,
+        )
+        return self._client.api.runs.get_plan(self._client.project, run_spec)
+
+    def exec_plan(self, plan: RunPlan, repo_dir: Optional[str] = None) -> Run:
+        """Apply a plan: upload code for the repo (if any), then submit."""
+        self._upload_code(plan.run_spec, repo_dir)
+        dto = self._client.api.runs.apply_plan(
+            self._client.project,
+            ApplyRunPlanInput(run_spec=plan.run_spec, current_resource=plan.current_resource),
+        )
+        return Run(self._client, dto)
+
+    def submit(
+        self,
+        configuration: Union[AnyRunConfiguration, Dict[str, Any]],
+        run_name: Optional[str] = None,
+        repo_dir: Optional[str] = None,
+        **kwargs: Any,
+    ) -> Run:
+        run_spec = self._make_run_spec(configuration, run_name, repo_dir, **kwargs)
+        self._upload_code(run_spec, repo_dir)
+        dto = self._client.api.runs.submit(self._client.project, run_spec)
+        return Run(self._client, dto)
+
+    def get(self, run_name: str) -> Run:
+        return Run(self._client, self._client.api.runs.get(self._client.project, run_name))
+
+    def list(self, all_projects: bool = False, only_active: bool = False,
+             limit: int = 100) -> List[Run]:
+        dtos = self._client.api.runs.list(
+            None if all_projects else self._client.project,
+            only_active=only_active, limit=limit,
+        )
+        return [Run(self._client, d) for d in dtos]
+
+    def stop(self, run_names: List[str], abort: bool = False) -> None:
+        self._client.api.runs.stop(self._client.project, run_names, abort=abort)
+
+    def delete(self, run_names: List[str]) -> None:
+        self._client.api.runs.delete(self._client.project, run_names)
+
+    # -- internals -----------------------------------------------------------
+
+    def _make_run_spec(
+        self,
+        configuration: Union[AnyRunConfiguration, Dict[str, Any]],
+        run_name: Optional[str] = None,
+        repo_dir: Optional[str] = None,
+        working_dir: Optional[str] = None,
+        configuration_path: Optional[str] = None,
+        ssh_key_pub: str = "",
+    ) -> RunSpec:
+        conf = configuration if isinstance(configuration, dict) else configuration.model_dump()
+        spec = RunSpec(
+            run_name=run_name,
+            configuration=conf,
+            working_dir=working_dir,
+            configuration_path=configuration_path,
+            ssh_key_pub=ssh_key_pub or self._client.ssh_key_pub or "",
+        )
+        if repo_dir is not None:
+            remote = detect_remote_repo(repo_dir)
+            if remote is not None:
+                repo_data, blob = remote
+            else:
+                repo_data, blob = pack_local_repo(repo_dir)
+            spec.repo_data = repo_data
+            spec.repo_id = repo_id_for_dir(repo_dir)
+            spec.repo_code_hash = hashlib.sha256(blob).hexdigest()
+            self._pending_blobs.clear()
+            self._pending_blobs[(spec.repo_id, spec.repo_code_hash)] = blob
+        return spec
+
+    def _upload_code(self, run_spec: RunSpec, repo_dir: Optional[str]) -> None:
+        if run_spec.repo_id is None:
+            return
+        blob = self._pending_blobs.pop((run_spec.repo_id, run_spec.repo_code_hash), None)
+        if blob is None:
+            if repo_dir is None:
+                return
+            remote = detect_remote_repo(repo_dir)
+            _, blob = remote if remote is not None else pack_local_repo(repo_dir)
+        self._client.api.repos.init(
+            self._client.project, run_spec.repo_id,
+            run_spec.repo_data.model_dump() if run_spec.repo_data else {"repo_type": "virtual"},
+        )
+        uploaded = self._client.api.repos.upload_code(
+            self._client.project, run_spec.repo_id, blob
+        )
+        if run_spec.repo_code_hash and uploaded != run_spec.repo_code_hash:
+            raise ClientError("Code blob hash mismatch after upload")
+
+
+class FleetCollection:
+    def __init__(self, client: "Client"):
+        self._client = client
+
+    def apply(self, configuration: Union[FleetConfiguration, Dict[str, Any]]) -> Fleet:
+        conf = (
+            FleetConfiguration.model_validate(configuration)
+            if isinstance(configuration, dict) else configuration
+        )
+        return self._client.api.fleets.apply(
+            self._client.project, FleetSpec(configuration=conf)
+        )
+
+    def get(self, name: str) -> Fleet:
+        return self._client.api.fleets.get(self._client.project, name)
+
+    def list(self) -> List[Fleet]:
+        return self._client.api.fleets.list(self._client.project)
+
+    def delete(self, names: List[str]) -> None:
+        self._client.api.fleets.delete(self._client.project, names)
+
+
+class VolumeCollection:
+    def __init__(self, client: "Client"):
+        self._client = client
+
+    def create(self, configuration: Union[VolumeConfiguration, Dict[str, Any]]) -> Volume:
+        conf = (
+            VolumeConfiguration.model_validate(configuration)
+            if isinstance(configuration, dict) else configuration
+        )
+        return self._client.api.volumes.create(self._client.project, conf)
+
+    def get(self, name: str) -> Volume:
+        return self._client.api.volumes.get(self._client.project, name)
+
+    def list(self) -> List[Volume]:
+        return self._client.api.volumes.list(self._client.project)
+
+    def delete(self, names: List[str]) -> None:
+        self._client.api.volumes.delete(self._client.project, names)
+
+
+class Client:
+    """SDK entry point (reference api/_public/__init__.py Client)."""
+
+    def __init__(
+        self,
+        server_url: str = DEFAULT_SERVER_URL,
+        token: str = "",
+        project_name: str = "main",
+        ssh_key_pub: Optional[str] = None,
+    ):
+        self.project = project_name
+        self.ssh_key_pub = ssh_key_pub
+        self.api = APIClient(server_url, token)
+        self.runs = RunCollection(self)
+        self.fleets = FleetCollection(self)
+        self.volumes = VolumeCollection(self)
+
+    @classmethod
+    def from_config(
+        cls,
+        project_name: Optional[str] = None,
+        server_url: Optional[str] = None,
+        token: Optional[str] = None,
+        config_path: Optional[Path] = None,
+    ) -> "Client":
+        """Build a client from ~/.dstack-tpu/config.yml (written by the CLI's
+        `config` command / server login — reference core/services/configs)."""
+        from dstack_tpu.api.config import GlobalConfig
+
+        cfg = GlobalConfig.load(config_path)
+        proj = cfg.resolve(project_name)
+        if proj is None and (server_url is None or token is None):
+            raise ConfigurationError(
+                "No project configured. Run `dstack-tpu config --url ... --token ...`"
+                " or pass server_url/token explicitly."
+            )
+        return cls(
+            server_url=server_url or (proj.url if proj else DEFAULT_SERVER_URL),
+            token=token or (proj.token if proj else ""),
+            project_name=project_name or (proj.name if proj else "main"),
+            ssh_key_pub=cfg.ssh_key_pub,
+        )

@@ -1,0 +1,109 @@
+"""CLI/SDK global config: ~/.dstack-tpu/config.yml.
+
+Parity: reference `src/dstack/_internal/core/services/configs/__init__.py`
+(ConfigManager: projects with url+token, default project) — the file written
+by `dstack config` and read by every CLI command and `Client.from_config`.
+"""
+
+import os
+import subprocess
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import yaml
+
+DEFAULT_CONFIG_DIR = Path(os.environ.get("DSTACK_TPU_CONFIG_DIR", "~/.dstack-tpu")).expanduser()
+
+
+@dataclass
+class ProjectConfig:
+    name: str
+    url: str
+    token: str
+    default: bool = False
+
+
+class GlobalConfig:
+    def __init__(self, path: Path):
+        self.path = path
+        self.projects: List[ProjectConfig] = []
+
+    @classmethod
+    def load(cls, config_path: Optional[Path] = None) -> "GlobalConfig":
+        path = config_path or DEFAULT_CONFIG_DIR / "config.yml"
+        cfg = cls(path)
+        if path.is_file():
+            data = yaml.safe_load(path.read_text()) or {}
+            for p in data.get("projects", []):
+                cfg.projects.append(
+                    ProjectConfig(
+                        name=p["name"], url=p["url"], token=p["token"],
+                        default=bool(p.get("default", False)),
+                    )
+                )
+        return cfg
+
+    def save(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        data = {
+            "projects": [
+                {"name": p.name, "url": p.url, "token": p.token, "default": p.default}
+                for p in self.projects
+            ]
+        }
+        self.path.write_text(yaml.safe_dump(data, sort_keys=False))
+        self.path.chmod(0o600)  # tokens inside
+
+    def upsert(self, name: str, url: str, token: str, default: bool = False) -> None:
+        if default:
+            for p in self.projects:
+                p.default = False
+        for p in self.projects:
+            if p.name == name:
+                p.url, p.token = url, token
+                p.default = p.default or default
+                break
+        else:
+            self.projects.append(
+                ProjectConfig(name=name, url=url, token=token,
+                              default=default or not self.projects)
+            )
+
+    def resolve(self, name: Optional[str] = None) -> Optional[ProjectConfig]:
+        if name is not None:
+            return next((p for p in self.projects if p.name == name), None)
+        return next((p for p in self.projects if p.default),
+                    self.projects[0] if self.projects else None)
+
+    # -- SSH identity --------------------------------------------------------
+
+    @property
+    def ssh_dir(self) -> Path:
+        return self.path.parent / "ssh"
+
+    @property
+    def ssh_key_path(self) -> Path:
+        return self.ssh_dir / "id_ed25519"
+
+    @property
+    def ssh_key_pub(self) -> Optional[str]:
+        pub = self.ssh_key_path.with_suffix(".pub")
+        if pub.is_file():
+            return pub.read_text().strip()
+        return None
+
+    def ensure_ssh_key(self) -> Optional[str]:
+        """Generate the CLI's run identity key once (used for attach)."""
+        if self.ssh_key_pub is not None:
+            return self.ssh_key_pub
+        self.ssh_dir.mkdir(parents=True, exist_ok=True)
+        try:
+            subprocess.run(
+                ["ssh-keygen", "-t", "ed25519", "-N", "", "-q",
+                 "-f", str(self.ssh_key_path), "-C", "dstack-tpu"],
+                check=True, capture_output=True, timeout=30,
+            )
+        except (OSError, subprocess.CalledProcessError, subprocess.TimeoutExpired):
+            return None
+        return self.ssh_key_pub

@@ -1,0 +1,121 @@
+"""Local repo packaging for code upload.
+
+Parity: reference `src/dstack/api/_public/repos.py` + `core/services/repos`
+(local dirs are tarred and uploaded as a code blob; remote git repos upload
+only a diff against the pushed hash). The runner unpacks the blob into the
+job working dir (agents/native/runner/executor.cc repo handling).
+"""
+
+import fnmatch
+import hashlib
+import io
+import subprocess
+import tarfile
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from dstack_tpu.models.repos import LocalRunRepoData, RemoteRunRepoData
+
+# Always skipped regardless of .gitignore — build junk that would bloat the
+# blob or break unpacking (reference skips .git the same way).
+_ALWAYS_IGNORE = [".git", "__pycache__", "*.pyc", ".pytest_cache", ".venv", "node_modules"]
+
+MAX_BLOB_BYTES = 256 * 1024 * 1024
+
+
+def repo_id_for_dir(path: str) -> str:
+    """The repo identity for a working directory — shared by `init` and the
+    run-spec builder so they always register/resolve the same repo."""
+    return hashlib.sha256(str(Path(path).resolve()).encode()).hexdigest()[:16]
+
+
+def _load_ignore_patterns(root: Path) -> List[str]:
+    patterns = list(_ALWAYS_IGNORE)
+    for name in (".gitignore", ".dstackignore"):
+        f = root / name
+        if f.is_file():
+            for line in f.read_text().splitlines():
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    patterns.append(line.rstrip("/"))
+    return patterns
+
+
+def _ignored(rel: str, patterns: List[str]) -> bool:
+    parts = rel.split("/")
+    for pat in patterns:
+        pat = pat.lstrip("/")
+        if fnmatch.fnmatch(rel, pat) or any(fnmatch.fnmatch(p, pat) for p in parts):
+            return True
+    return False
+
+
+def pack_local_repo(path: str) -> Tuple[LocalRunRepoData, bytes]:
+    """Tar a local directory into a code blob (gitignore-aware)."""
+    root = Path(path).resolve()
+    if not root.is_dir():
+        raise FileNotFoundError(f"Repo dir does not exist: {root}")
+    patterns = _load_ignore_patterns(root)
+    buf = io.BytesIO()
+    total = 0
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for p in sorted(root.rglob("*")):
+            rel = p.relative_to(root).as_posix()
+            if _ignored(rel, patterns):
+                continue
+            if p.is_file():
+                total += p.stat().st_size
+                if total > MAX_BLOB_BYTES:
+                    raise ValueError(
+                        f"Repo exceeds {MAX_BLOB_BYTES >> 20} MiB; add a .dstackignore"
+                    )
+                tar.add(p, arcname=rel, recursive=False)
+    return LocalRunRepoData(repo_dir=str(root)), buf.getvalue()
+
+
+def _git(root: Path, *args: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(root), *args],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout.strip() if out.returncode == 0 else None
+
+
+def detect_remote_repo(path: str) -> Optional[Tuple[RemoteRunRepoData, bytes]]:
+    """If `path` is a git checkout with an upstream, return repo data + the
+    uncommitted diff as the code blob (reference: diff tar upload,
+    runner/internal/repo applies it after clone)."""
+    root = Path(path).resolve()
+    url = _git(root, "remote", "get-url", "origin")
+    head = _git(root, "rev-parse", "HEAD")
+    if not url or not head:
+        return None
+    branch = _git(root, "rev-parse", "--abbrev-ref", "HEAD")
+    diff = _git(root, "diff", "HEAD") or ""
+    host, user, name = _parse_git_url(url)
+    data = RemoteRunRepoData(
+        repo_host_name=host,
+        repo_user_name=user,
+        repo_name=name,
+        repo_branch=branch if branch != "HEAD" else None,
+        repo_hash=head,
+        repo_diff=None,  # carried as the code blob, not inline
+    )
+    return data, diff.encode()
+
+
+def _parse_git_url(url: str) -> Tuple[str, str, str]:
+    u = url.removesuffix(".git")
+    if u.startswith("git@"):  # git@host:user/name
+        hostpart, _, pathpart = u.removeprefix("git@").partition(":")
+        bits = pathpart.split("/")
+        return hostpart, bits[0] if bits else "", bits[-1] if bits else ""
+    u = u.split("://", 1)[-1]
+    bits = u.split("/")
+    host = bits[0]
+    user = bits[1] if len(bits) > 1 else ""
+    name = bits[-1] if len(bits) > 2 else ""
+    return host, user, name

@@ -1,0 +1,353 @@
+"""Typed REST client for the dstack-tpu server API.
+
+Parity: reference `src/dstack/api/server/__init__.py` (APIClient with
+per-resource wrappers: runs/fleets/volumes/gateways/secrets/repos/logs/
+users/projects/backends). One class per resource, every method a thin typed
+wrapper over one endpoint; server error payloads are re-raised as typed
+client exceptions so the CLI/SDK never sees raw HTTP.
+"""
+
+import json
+from typing import Any, Dict, List, Optional
+
+import httpx
+
+from dstack_tpu.errors import ClientError, ConfigurationError
+from dstack_tpu.models.fleets import Fleet, FleetSpec
+from dstack_tpu.models.gateways import Gateway
+from dstack_tpu.models.runs import ApplyRunPlanInput, Run, RunPlan, RunSpec
+from dstack_tpu.models.users import Project, User, UserWithCreds
+from dstack_tpu.models.volumes import Volume, VolumeConfiguration
+
+
+class ApiClientError(ClientError):
+    def __init__(self, status: int, detail: Any):
+        self.status = status
+        self.detail = detail
+        super().__init__(self._render())
+
+    def _render(self) -> str:
+        if isinstance(self.detail, list):
+            return "; ".join(str(d.get("msg", d)) for d in self.detail if isinstance(d, dict))
+        return str(self.detail)
+
+
+class NotFoundError(ApiClientError):
+    pass
+
+
+class UnauthorizedApiError(ApiClientError):
+    pass
+
+
+class APIClient:
+    """Low-level client: one method per endpoint, typed DTOs in and out."""
+
+    def __init__(self, base_url: str, token: str, timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self._http = httpx.Client(
+            base_url=self.base_url,
+            headers={"Authorization": f"Bearer {token}"},
+            timeout=timeout,
+        )
+        self.runs = _Runs(self)
+        self.fleets = _Fleets(self)
+        self.volumes = _Volumes(self)
+        self.gateways = _Gateways(self)
+        self.secrets = _Secrets(self)
+        self.repos = _Repos(self)
+        self.logs = _Logs(self)
+        self.users = _Users(self)
+        self.projects = _Projects(self)
+        self.backends = _Backends(self)
+        self.instances = _Instances(self)
+        self.metrics = _Metrics(self)
+        self.server = _ServerInfo(self)
+
+    def close(self) -> None:
+        self._http.close()
+
+    # -- plumbing ------------------------------------------------------------
+
+    def post(self, path: str, body: Any = None, raw: Optional[bytes] = None) -> Any:
+        try:
+            if raw is not None:
+                resp = self._http.post(
+                    path, content=raw, headers={"content-type": "application/octet-stream"}
+                )
+            else:
+                resp = self._http.post(path, json=body if body is not None else {})
+        except httpx.HTTPError as e:
+            raise ClientError(f"Cannot reach the server at {self.base_url}: {e}") from e
+        return self._handle(resp)
+
+    def get(self, path: str) -> Any:
+        try:
+            resp = self._http.get(path)
+        except httpx.HTTPError as e:
+            raise ClientError(f"Cannot reach the server at {self.base_url}: {e}") from e
+        return self._handle(resp)
+
+    @staticmethod
+    def _handle(resp: httpx.Response) -> Any:
+        if resp.status_code < 300:
+            return resp.json() if resp.content else None
+        try:
+            detail = resp.json().get("detail", resp.text)
+        except (json.JSONDecodeError, AttributeError):
+            detail = resp.text
+        codes = (
+            {d.get("code") for d in detail if isinstance(d, dict)}
+            if isinstance(detail, list) else set()
+        )
+        # The server signals typed errors via `code` in the detail payload
+        # (errors.ApiError.to_json); resource_not_exists rides a 400.
+        if resp.status_code == 404 or "resource_not_exists" in codes:
+            raise NotFoundError(resp.status_code, detail)
+        if resp.status_code in (401, 403):
+            raise UnauthorizedApiError(resp.status_code, detail)
+        if "configuration_error" in codes:
+            raise ConfigurationError(
+                "; ".join(str(d.get("msg")) for d in detail if isinstance(d, dict))
+            )
+        raise ApiClientError(resp.status_code, detail)
+
+
+class _Resource:
+    def __init__(self, api: APIClient):
+        self._api = api
+
+
+class _Runs(_Resource):
+    def get_plan(self, project: str, run_spec: RunSpec) -> RunPlan:
+        data = self._api.post(
+            f"/api/project/{project}/runs/get_plan",
+            {"run_spec": json.loads(run_spec.model_dump_json())},
+        )
+        return RunPlan.model_validate(data)
+
+    def apply_plan(self, project: str, plan: ApplyRunPlanInput) -> Run:
+        data = self._api.post(
+            f"/api/project/{project}/runs/apply", json.loads(plan.model_dump_json())
+        )
+        return Run.model_validate(data)
+
+    def submit(self, project: str, run_spec: RunSpec) -> Run:
+        data = self._api.post(
+            f"/api/project/{project}/runs/submit",
+            {"run_spec": json.loads(run_spec.model_dump_json())},
+        )
+        return Run.model_validate(data)
+
+    def get(self, project: str, run_name: str) -> Run:
+        data = self._api.post(f"/api/project/{project}/runs/get", {"run_name": run_name})
+        return Run.model_validate(data)
+
+    def list(self, project: Optional[str] = None, only_active: bool = False,
+             limit: int = 100) -> List[Run]:
+        # The global endpoint handles optional project scoping AND honors
+        # only_active/limit (the per-project endpoint does neither).
+        data = self._api.post(
+            "/api/runs/list",
+            {"project_name": project, "only_active": only_active, "limit": limit},
+        )
+        return [Run.model_validate(r) for r in data]
+
+    def stop(self, project: str, runs_names: List[str], abort: bool = False) -> None:
+        self._api.post(
+            f"/api/project/{project}/runs/stop",
+            {"runs_names": runs_names, "abort": abort},
+        )
+
+    def delete(self, project: str, runs_names: List[str]) -> None:
+        self._api.post(f"/api/project/{project}/runs/delete", {"runs_names": runs_names})
+
+
+class _Fleets(_Resource):
+    def apply(self, project: str, spec: FleetSpec) -> Fleet:
+        data = self._api.post(
+            f"/api/project/{project}/fleets/apply",
+            {"spec": json.loads(spec.model_dump_json())},
+        )
+        return Fleet.model_validate(data)
+
+    def get(self, project: str, name: str) -> Fleet:
+        data = self._api.post(f"/api/project/{project}/fleets/get", {"name": name})
+        return Fleet.model_validate(data)
+
+    def list(self, project: str) -> List[Fleet]:
+        data = self._api.post(f"/api/project/{project}/fleets/list", {})
+        return [Fleet.model_validate(f) for f in data]
+
+    def delete(self, project: str, names: List[str]) -> None:
+        self._api.post(f"/api/project/{project}/fleets/delete", {"names": names})
+
+
+class _Volumes(_Resource):
+    def create(self, project: str, configuration: VolumeConfiguration) -> Volume:
+        data = self._api.post(
+            f"/api/project/{project}/volumes/create",
+            {"configuration": json.loads(configuration.model_dump_json())},
+        )
+        return Volume.model_validate(data)
+
+    def get(self, project: str, name: str) -> Volume:
+        data = self._api.post(f"/api/project/{project}/volumes/get", {"name": name})
+        return Volume.model_validate(data)
+
+    def list(self, project: str) -> List[Volume]:
+        data = self._api.post(f"/api/project/{project}/volumes/list", {})
+        return [Volume.model_validate(v) for v in data]
+
+    def delete(self, project: str, names: List[str]) -> None:
+        self._api.post(f"/api/project/{project}/volumes/delete", {"names": names})
+
+
+class _Gateways(_Resource):
+    def create(self, project: str, configuration: Dict[str, Any]) -> Gateway:
+        data = self._api.post(
+            f"/api/project/{project}/gateways/create", {"configuration": configuration}
+        )
+        return Gateway.model_validate(data)
+
+    def get(self, project: str, name: str) -> Gateway:
+        data = self._api.post(f"/api/project/{project}/gateways/get", {"name": name})
+        return Gateway.model_validate(data)
+
+    def list(self, project: str) -> List[Gateway]:
+        data = self._api.post(f"/api/project/{project}/gateways/list", {})
+        return [Gateway.model_validate(g) for g in data]
+
+    def delete(self, project: str, names: List[str]) -> None:
+        self._api.post(f"/api/project/{project}/gateways/delete", {"names": names})
+
+
+class _Secrets(_Resource):
+    def list(self, project: str) -> List[Dict[str, Any]]:
+        return self._api.post(f"/api/project/{project}/secrets/list", {})
+
+    def create_or_update(self, project: str, name: str, value: str) -> None:
+        self._api.post(
+            f"/api/project/{project}/secrets/create_or_update",
+            {"name": name, "value": value},
+        )
+
+    def get(self, project: str, name: str) -> Dict[str, Any]:
+        return self._api.post(f"/api/project/{project}/secrets/get", {"name": name})
+
+    def delete(self, project: str, names: List[str]) -> None:
+        self._api.post(f"/api/project/{project}/secrets/delete", {"secrets_names": names})
+
+
+class _Repos(_Resource):
+    def init(self, project: str, repo_id: str, repo_info: Dict[str, Any]) -> None:
+        self._api.post(
+            f"/api/project/{project}/repos/init",
+            {"repo_id": repo_id, "repo_info": repo_info},
+        )
+
+    def get(self, project: str, repo_id: str) -> Dict[str, Any]:
+        return self._api.post(f"/api/project/{project}/repos/get", {"repo_id": repo_id})
+
+    def upload_code(self, project: str, repo_id: str, blob: bytes) -> str:
+        data = self._api.post(
+            f"/api/project/{project}/repos/upload_code?repo_id={repo_id}", raw=blob
+        )
+        return data["blob_hash"]
+
+
+class _Logs(_Resource):
+    def poll(self, project: str, run_name: str, job_submission_id: str,
+             start_after: Optional[str] = None, limit: int = 1000,
+             diagnose: bool = False) -> Dict[str, Any]:
+        return self._api.post(
+            f"/api/project/{project}/logs/poll",
+            {
+                "run_name": run_name,
+                "job_submission_id": job_submission_id,
+                "start_after": start_after,
+                "limit": limit,
+                "diagnose": diagnose,
+            },
+        )
+
+
+class _Users(_Resource):
+    def get_my_user(self) -> UserWithCreds:
+        return UserWithCreds.model_validate(self._api.post("/api/users/get_my_user", {}))
+
+    def list(self) -> List[User]:
+        return [User.model_validate(u) for u in self._api.post("/api/users/list", {})]
+
+    def create(self, username: str, global_role: str = "user") -> UserWithCreds:
+        data = self._api.post(
+            "/api/users/create", {"username": username, "global_role": global_role}
+        )
+        return UserWithCreds.model_validate(data)
+
+    def refresh_token(self, username: str) -> UserWithCreds:
+        data = self._api.post("/api/users/refresh_token", {"username": username})
+        return UserWithCreds.model_validate(data)
+
+    def delete(self, usernames: List[str]) -> None:
+        self._api.post("/api/users/delete", {"usernames": usernames})
+
+
+class _Projects(_Resource):
+    def list(self) -> List[Project]:
+        return [Project.model_validate(p) for p in self._api.post("/api/projects/list", {})]
+
+    def create(self, project_name: str) -> Project:
+        return Project.model_validate(
+            self._api.post("/api/projects/create", {"project_name": project_name})
+        )
+
+    def get(self, project_name: str) -> Project:
+        return Project.model_validate(
+            self._api.post(f"/api/projects/{project_name}/get", {})
+        )
+
+    def delete(self, projects_names: List[str]) -> None:
+        self._api.post("/api/projects/delete", {"projects_names": projects_names})
+
+    def set_members(self, project_name: str, members: List[Dict[str, str]]) -> None:
+        self._api.post(f"/api/projects/{project_name}/set_members", {"members": members})
+
+
+class _Backends(_Resource):
+    def list_types(self) -> List[str]:
+        return self._api.post("/api/backends/list_types", {})
+
+    def list(self, project: str) -> List[Dict[str, Any]]:
+        return self._api.post(f"/api/project/{project}/backends/list", {})
+
+    def create(self, project: str, config: Dict[str, Any]) -> None:
+        self._api.post(f"/api/project/{project}/backends/create", {"config": config})
+
+    def delete(self, project: str, backends_names: List[str]) -> None:
+        self._api.post(
+            f"/api/project/{project}/backends/delete", {"backends_names": backends_names}
+        )
+
+
+class _Instances(_Resource):
+    def list(self, project: str) -> List[Dict[str, Any]]:
+        return self._api.post(f"/api/project/{project}/instances/list", {})
+
+
+class _Metrics(_Resource):
+    def get_job_metrics(self, project: str, run_name: str,
+                        **params: Any) -> Dict[str, Any]:
+        qs = "&".join(f"{k}={v}" for k, v in params.items() if v is not None)
+        path = f"/api/project/{project}/metrics/job/{run_name}"
+        if qs:
+            path += f"?{qs}"
+        return self._api.get(path)
+
+
+class _ServerInfo(_Resource):
+    def get_info(self) -> Dict[str, Any]:
+        return self._api.post("/api/server/get_info", {})
+
+    def healthcheck(self) -> Dict[str, Any]:
+        return self._api.get("/api/server/healthcheck")

@@ -1,0 +1,3 @@
+from dstack_tpu.cli.main import main
+
+main()

@@ -1,0 +1,566 @@
+"""dstack-tpu CLI.
+
+Parity: reference `src/dstack/_internal/cli/main.py:60-75` — commands:
+apply, attach, config, delete, fleet, gateway, init, logs, ps, secrets,
+server, stats, stop, volume. Everything goes through the public SDK
+(`dstack_tpu.api`), never raw HTTP.
+"""
+
+import sys
+from pathlib import Path
+from typing import Optional
+
+import click
+import yaml
+
+from dstack_tpu.errors import ClientError, ConfigurationError, DstackTpuError
+from dstack_tpu.cli.render import (
+    console,
+    fleets_table,
+    fmt_status,
+    runs_table,
+    volumes_table,
+)
+
+
+def _fail(msg: str) -> "click.exceptions.Exit":
+    console.print(f"[red]Error:[/] {msg}")
+    return click.exceptions.Exit(1)
+
+
+def _make_client(project: Optional[str]):
+    from dstack_tpu.api import Client
+
+    try:
+        return Client.from_config(project_name=project)
+    except ConfigurationError as e:
+        raise _fail(str(e))
+
+
+def _version() -> str:
+    from dstack_tpu.version import __version__
+
+    return __version__
+
+
+@click.group(name="dstack-tpu")
+@click.version_option(package_name=None, version=_version())
+def cli() -> None:
+    """TPU-native AI workload orchestrator."""
+
+
+# --- server ------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=3000, show_default=True, type=int)
+@click.option("--db", "db_path", default=None, help="sqlite path (default: ~/.dstack-tpu/server/data.db)")
+@click.option("--token", default=None, help="admin token (default: generated)")
+def server(host: str, port: int, db_path: Optional[str], token: Optional[str]) -> None:
+    """Start the dstack-tpu server."""
+    import asyncio
+
+    from dstack_tpu.server.app import serve
+
+    try:
+        asyncio.run(serve(host=host, port=port, db_path=db_path, admin_token=token))
+    except KeyboardInterrupt:
+        pass
+
+
+# --- config ------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("--project", default="main", show_default=True)
+@click.option("--url", required=True, help="server URL, e.g. http://127.0.0.1:3000")
+@click.option("--token", required=True)
+@click.option("--default/--no-default", "make_default", default=True,
+              help="make this the default project")
+def config(project: str, url: str, token: str, make_default: bool) -> None:
+    """Save project credentials to ~/.dstack-tpu/config.yml."""
+    from dstack_tpu.api.config import GlobalConfig
+
+    cfg = GlobalConfig.load()
+    cfg.upsert(project, url, token, default=make_default)
+    cfg.save()
+    cfg.ensure_ssh_key()
+    console.print(f"Project [bold]{project}[/] configured at {url}")
+
+
+# --- init --------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("--project", default=None)
+def init(project: Optional[str]) -> None:
+    """Initialize the current directory as a repo for runs."""
+    client = _make_client(project)
+    from dstack_tpu.api.repos import detect_remote_repo, repo_id_for_dir
+
+    cwd = str(Path.cwd())
+    remote = detect_remote_repo(cwd)
+    repo_id = repo_id_for_dir(cwd)
+    if remote is not None:
+        repo_data, _ = remote
+        client.api.repos.init(client.project, repo_id, repo_data.model_dump())
+        console.print(f"Initialized remote repo [bold]{repo_data.repo_name}[/] ({repo_id})")
+    else:
+        from dstack_tpu.models.repos import LocalRunRepoData
+
+        client.api.repos.init(
+            client.project, repo_id, LocalRunRepoData(repo_dir=cwd).model_dump()
+        )
+        console.print(f"Initialized local repo at {cwd} ({repo_id})")
+    client.api.close()
+
+
+# --- apply -------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("-f", "--file", "config_file", required=True,
+              type=click.Path(exists=True, dir_okay=False))
+@click.option("-y", "--yes", is_flag=True, help="don't ask for confirmation")
+@click.option("-d", "--detach", is_flag=True, help="submit and exit (don't stream)")
+@click.option("--project", default=None)
+@click.option("--name", "run_name", default=None, help="override run/resource name")
+def apply(config_file: str, yes: bool, detach: bool, project: Optional[str],
+          run_name: Optional[str]) -> None:
+    """Apply a task/service/dev-environment/fleet/volume/gateway YAML."""
+    path = Path(config_file)
+    try:
+        data = yaml.safe_load(path.read_text())
+    except yaml.YAMLError as e:
+        raise _fail(f"Invalid YAML in {path}: {e}")
+    if not isinstance(data, dict) or "type" not in data:
+        raise _fail(f"{path}: configuration must be a mapping with a `type` key")
+    conf_type = data["type"]
+    client = _make_client(project)
+    try:
+        if conf_type in ("task", "service", "dev-environment"):
+            _apply_run(client, data, path, run_name, yes, detach)
+        elif conf_type == "fleet":
+            _apply_fleet(client, data, run_name, yes)
+        elif conf_type == "volume":
+            _apply_volume(client, data, run_name, yes)
+        elif conf_type == "gateway":
+            _apply_gateway(client, data, run_name, yes)
+        else:
+            raise _fail(f"Unknown configuration type {conf_type!r}")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+def _apply_run(client, data, path: Path, run_name: Optional[str], yes: bool,
+               detach: bool) -> None:
+    """Reference: cli/services/configurators/run.py:65-260 — the plan →
+    confirm → submit → attach loop."""
+    from dstack_tpu.cli.render import plan_table
+
+    repo_dir = str(path.parent.resolve())
+    plan = client.runs.get_plan(
+        data,
+        run_name=run_name or data.get("name"),
+        repo_dir=repo_dir,
+        configuration_path=str(path),
+    )
+    name = plan.run_spec.run_name or "(auto)"
+    console.print(f"Run [bold]{name}[/] in project [bold]{client.project}[/]:")
+    console.print(plan_table(plan))
+    if plan.job_plans[0].total_offers == 0:
+        raise _fail("No matching instance offers; check `resources` and backends")
+    if not yes and not click.confirm("Submit the run?", default=True):
+        raise click.exceptions.Exit(0)
+    run = client.runs.exec_plan(plan, repo_dir=repo_dir)
+    console.print(f"Run [bold]{run.name}[/] submitted")
+    if detach:
+        console.print(f"Detached. Follow with: dstack-tpu logs -f {run.name}")
+        return
+    _follow_run(client, run)
+
+
+def _follow_run(client, run) -> None:
+    """Stream status transitions + logs until the run finishes (Ctrl-C
+    detaches without stopping, matching the reference attach loop)."""
+    import time
+
+    last_status = None
+    try:
+        while True:
+            run.refresh()
+            if run.status != last_status:
+                console.print(f"[dim]{run.name}:[/] {fmt_status(run.status.value)}")
+                last_status = run.status
+            if run.status.value in ("running", "done", "failed", "terminated"):
+                break
+            time.sleep(1.0)
+        for chunk in run.logs(follow=True):
+            sys.stdout.buffer.write(chunk)
+            sys.stdout.buffer.flush()
+        run.refresh()
+        console.print(f"\n[dim]{run.name}:[/] {fmt_status(run.status.value)}")
+        if run.status.value in ("failed", "terminated"):
+            raise click.exceptions.Exit(1)
+    except KeyboardInterrupt:
+        console.print(
+            f"\nDetached (run keeps going). Stop with: dstack-tpu stop {run.name}"
+        )
+
+
+def _apply_fleet(client, data, name: Optional[str], yes: bool) -> None:
+    if name:
+        data = {**data, "name": name}
+    fleet = client.fleets.apply(data)
+    console.print(f"Fleet [bold]{fleet.name}[/] {fmt_status(fleet.status.value)}")
+
+
+def _apply_volume(client, data, name: Optional[str], yes: bool) -> None:
+    if name:
+        data = {**data, "name": name}
+    vol = client.volumes.create(data)
+    console.print(f"Volume [bold]{vol.name}[/] {fmt_status(vol.status.value)}")
+
+
+def _apply_gateway(client, data, name: Optional[str], yes: bool) -> None:
+    if name:
+        data = {**data, "name": name}
+    gw = client.api.gateways.create(client.project, data)
+    console.print(f"Gateway [bold]{gw.name}[/] {fmt_status(gw.status.value)}")
+
+
+# --- ps / logs / stop / delete / attach -------------------------------------
+
+
+@cli.command()
+@click.option("-a", "--all", "show_all", is_flag=True, help="include finished runs")
+@click.option("-v", "--verbose", is_flag=True)
+@click.option("--project", default=None)
+def ps(show_all: bool, verbose: bool, project: Optional[str]) -> None:
+    """List runs."""
+    client = _make_client(project)
+    try:
+        runs = client.runs.list()
+        if not show_all:
+            active = [r for r in runs if not r.dto.status.is_finished()]
+            # Reference `ps` shows the latest finished run too when nothing
+            # is active, so the table is never empty right after a run.
+            runs = active or runs[:1]
+        console.print(runs_table([r.dto for r in runs], verbose=verbose))
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("-f", "--follow", is_flag=True)
+@click.option("-d", "--diagnose", is_flag=True, help="runner/agent logs instead of job output")
+@click.option("--replica", default=0, type=int)
+@click.option("--job", "job_num", default=None, type=int,
+              help="worker host rank for gang runs (default: all)")
+@click.option("--project", default=None)
+def logs(run_name: str, follow: bool, diagnose: bool, replica: int,
+         job_num: Optional[int], project: Optional[str]) -> None:
+    """Print (or follow) run logs."""
+    client = _make_client(project)
+    try:
+        run = client.runs.get(run_name)
+        if diagnose:
+            for job in run.dto.jobs:
+                if not job.job_submissions:
+                    continue
+                data = client.api.logs.poll(
+                    client.project, run_name, job.job_submissions[-1].id, diagnose=True
+                )
+                from base64 import b64decode
+
+                for event in data.get("logs", []):
+                    sys.stdout.buffer.write(b64decode(event["message"]) + b"\n")
+            return
+        try:
+            for chunk in run.logs(follow=follow, replica_num=replica, job_num=job_num):
+                sys.stdout.buffer.write(chunk)
+                sys.stdout.buffer.flush()
+        except KeyboardInterrupt:
+            pass
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("-x", "--abort", is_flag=True, help="abort without graceful stop")
+@click.option("--project", default=None)
+def stop(run_name: str, abort: bool, project: Optional[str]) -> None:
+    """Stop a run."""
+    client = _make_client(project)
+    try:
+        client.runs.stop([run_name], abort=abort)
+        console.print(f"Run [bold]{run_name}[/] stop requested")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def delete(run_name: str, project: Optional[str], yes: bool) -> None:
+    """Delete a finished run."""
+    client = _make_client(project)
+    try:
+        if not yes and not click.confirm(f"Delete run {run_name}?", default=False):
+            raise click.exceptions.Exit(0)
+        client.runs.delete([run_name])
+        console.print(f"Run [bold]{run_name}[/] deleted")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+def attach(run_name: str, project: Optional[str]) -> None:
+    """Re-attach to a run: stream status + logs until it finishes."""
+    client = _make_client(project)
+    try:
+        run = client.runs.get(run_name)
+        _follow_run(client, run)
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+# --- stats -------------------------------------------------------------------
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+def stats(run_name: str, project: Optional[str]) -> None:
+    """Per-host CPU/memory/TPU metrics of a running run."""
+    client = _make_client(project)
+    try:
+        data = client.api.metrics.get_job_metrics(client.project, run_name)
+        from rich.table import Table
+
+        table = Table(box=None, header_style="bold")
+        for col in ("HOST", "CPU", "MEMORY", "TPU CHIPS", "TPU UTIL", "HBM"):
+            table.add_column(col)
+        for host in data.get("hosts", []):
+            table.add_row(
+                str(host.get("job_num", "")),
+                f"{host.get('cpu_percent', 0):.0f}%",
+                f"{(host.get('memory_usage_bytes') or 0) / 2**30:.2f}GB",
+                str(host.get("tpu_chips", "")),
+                f"{host.get('tpu_duty_cycle_percent', 0):.0f}%"
+                if host.get("tpu_duty_cycle_percent") is not None else "",
+                f"{(host.get('tpu_hbm_usage_bytes') or 0) / 2**30:.2f}GB"
+                if host.get("tpu_hbm_usage_bytes") is not None else "",
+            )
+        console.print(table)
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+# --- fleet / volume / gateway / secrets groups -------------------------------
+
+
+@cli.group()
+def fleet() -> None:
+    """Manage fleets."""
+
+
+@fleet.command("list")
+@click.option("--project", default=None)
+def fleet_list(project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        console.print(fleets_table(client.fleets.list()))
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@fleet.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def fleet_delete(name: str, project: Optional[str], yes: bool) -> None:
+    client = _make_client(project)
+    try:
+        if not yes and not click.confirm(f"Delete fleet {name}?", default=False):
+            raise click.exceptions.Exit(0)
+        client.fleets.delete([name])
+        console.print(f"Fleet [bold]{name}[/] delete requested")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.group()
+def volume() -> None:
+    """Manage volumes."""
+
+
+@volume.command("list")
+@click.option("--project", default=None)
+def volume_list(project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        console.print(volumes_table(client.volumes.list()))
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@volume.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def volume_delete(name: str, project: Optional[str], yes: bool) -> None:
+    client = _make_client(project)
+    try:
+        if not yes and not click.confirm(f"Delete volume {name}?", default=False):
+            raise click.exceptions.Exit(0)
+        client.volumes.delete([name])
+        console.print(f"Volume [bold]{name}[/] delete requested")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.group()
+def gateway() -> None:
+    """Manage gateways."""
+
+
+@gateway.command("list")
+@click.option("--project", default=None)
+def gateway_list(project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        from rich.table import Table
+
+        table = Table(box=None, header_style="bold")
+        for col in ("NAME", "BACKEND", "REGION", "HOSTNAME", "DOMAIN", "STATUS"):
+            table.add_column(col)
+        for gw in client.api.gateways.list(client.project):
+            table.add_row(
+                gw.name, gw.configuration.backend.value, gw.configuration.region,
+                gw.hostname or "", gw.wildcard_domain or "",
+                fmt_status(gw.status.value),
+            )
+        console.print(table)
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@gateway.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def gateway_delete(name: str, project: Optional[str], yes: bool) -> None:
+    client = _make_client(project)
+    try:
+        if not yes and not click.confirm(f"Delete gateway {name}?", default=False):
+            raise click.exceptions.Exit(0)
+        client.api.gateways.delete(client.project, [name])
+        console.print(f"Gateway [bold]{name}[/] delete requested")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@cli.group()
+def secrets() -> None:
+    """Manage project secrets."""
+
+
+@secrets.command("list")
+@click.option("--project", default=None)
+def secrets_list(project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        for s in client.api.secrets.list(client.project):
+            console.print(s["name"])
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@secrets.command("set")
+@click.argument("name")
+@click.argument("value")
+@click.option("--project", default=None)
+def secrets_set(name: str, value: str, project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        client.api.secrets.create_or_update(client.project, name, value)
+        console.print(f"Secret [bold]{name}[/] set")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@secrets.command("get")
+@click.argument("name")
+@click.option("--project", default=None)
+def secrets_get(name: str, project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        s = client.api.secrets.get(client.project, name)
+        console.print(s.get("value", ""))
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@secrets.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+def secrets_delete(name: str, project: Optional[str]) -> None:
+    client = _make_client(project)
+    try:
+        client.api.secrets.delete(client.project, [name])
+        console.print(f"Secret [bold]{name}[/] deleted")
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+def main() -> None:
+    try:
+        cli(standalone_mode=True)
+    except ClientError as e:
+        console.print(f"[red]Error:[/] {e}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""CLI table/format helpers (rich).
+
+Parity: reference `cli/utils/run.py` + `cli/utils/common.py` — run/fleet/
+volume tables and the live status display used by `apply`/`attach`.
+"""
+
+from datetime import datetime, timezone
+from typing import List, Optional
+
+from rich.console import Console
+from rich.table import Table
+
+from dstack_tpu.models.fleets import Fleet
+from dstack_tpu.models.runs import Run, RunPlan
+from dstack_tpu.models.volumes import Volume
+
+console = Console()
+
+
+def _age(ts: Optional[datetime]) -> str:
+    if ts is None:
+        return ""
+    now = datetime.now(timezone.utc)
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=timezone.utc)
+    delta = now - ts
+    secs = int(delta.total_seconds())
+    if secs < 0:
+        secs = 0
+    if secs < 60:
+        return f"{secs}s"
+    if secs < 3600:
+        return f"{secs // 60}m"
+    if secs < 86400:
+        return f"{secs // 3600}h"
+    return f"{secs // 86400}d"
+
+
+def _status_style(status: str) -> str:
+    return {
+        "done": "green",
+        "running": "green",
+        "failed": "red",
+        "terminated": "yellow",
+        "aborted": "red",
+    }.get(status, "cyan")
+
+
+def fmt_status(status: str) -> str:
+    return f"[{_status_style(status)}]{status}[/]"
+
+
+def runs_table(runs: List[Run], verbose: bool = False) -> Table:
+    table = Table(box=None, header_style="bold")
+    table.add_column("NAME")
+    table.add_column("BACKEND")
+    table.add_column("RESOURCES")
+    table.add_column("PRICE")
+    table.add_column("STATUS")
+    table.add_column("SUBMITTED")
+    if verbose:
+        table.add_column("ERROR")
+    for run in runs:
+        sub = run.latest_job_submission
+        jpd = sub.job_provisioning_data if sub else None
+        backend = jpd.backend.value if jpd else ""
+        if jpd and jpd.region:
+            backend = f"{backend} ({jpd.region})"
+        resources = ""
+        if run.jobs:
+            resources = run.jobs[0].job_spec.requirements.pretty_format(resources_only=True)
+        row = [
+            run.run_spec.run_name or "",
+            backend,
+            resources,
+            f"${jpd.price:g}" if jpd and jpd.price else "",
+            fmt_status(run.status.value),
+            _age(run.submitted_at),
+        ]
+        if verbose:
+            row.append(run.error)
+        table.add_row(*row)
+    return table
+
+
+def plan_table(plan: RunPlan, max_offers: int = 3) -> Table:
+    table = Table(box=None, header_style="bold")
+    table.add_column("#")
+    table.add_column("BACKEND")
+    table.add_column("REGION")
+    table.add_column("INSTANCE")
+    table.add_column("RESOURCES")
+    table.add_column("SPOT")
+    table.add_column("PRICE")
+    jp = plan.job_plans[0]
+    for i, offer in enumerate(jp.offers[:max_offers], start=1):
+        r = offer.instance.resources
+        table.add_row(
+            str(i),
+            offer.backend.value,
+            offer.region,
+            offer.instance.name,
+            r.pretty_format(),
+            "yes" if r.spot else "no",
+            f"${offer.price:g}",
+        )
+    if jp.total_offers > max_offers:
+        table.add_row("", "...", f"and {jp.total_offers - max_offers} more", "", "", "", "")
+    return table
+
+
+def fleets_table(fleets: List[Fleet]) -> Table:
+    table = Table(box=None, header_style="bold")
+    table.add_column("FLEET")
+    table.add_column("INSTANCES")
+    table.add_column("STATUS")
+    table.add_column("CREATED")
+    for f in fleets:
+        statuses = ", ".join(
+            f"{i.instance_num}:{i.status.value}" for i in f.instances
+        ) or "-"
+        table.add_row(f.name, str(len(f.instances)), statuses, _age(f.created_at))
+    return table
+
+
+def volumes_table(volumes: List[Volume]) -> Table:
+    table = Table(box=None, header_style="bold")
+    table.add_column("NAME")
+    table.add_column("BACKEND")
+    table.add_column("REGION")
+    table.add_column("SIZE")
+    table.add_column("STATUS")
+    table.add_column("CREATED")
+    for v in volumes:
+        table.add_row(
+            v.name,
+            v.configuration.backend.value,
+            v.configuration.region or "",
+            str(v.configuration.size) if v.configuration.size else "",
+            fmt_status(v.status.value),
+            _age(v.created_at),
+        )
+    return table

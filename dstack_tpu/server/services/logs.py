@@ -98,7 +98,9 @@ class DbLogStorage(LogStorage):
             )
             for r in rows
         ]
-        next_token = str(rows[-1]["id"]) if len(rows) == limit else ""
+        # Always a resumable cursor: follow-mode clients pass it back to get
+        # only new lines; empty only when nothing has been written yet.
+        next_token = str(rows[-1]["id"]) if rows else (start_after or "")
         return JobSubmissionLogs(logs=events, next_token=next_token)
 
 
@@ -136,13 +138,12 @@ class FileLogStorage(LogStorage):
             return JobSubmissionLogs(logs=[])
         events: List[LogEvent] = []
         start_line = int(start_after) if start_after else 0
-        next_token = ""
+        consumed = start_line
         with open(path) as f:
             for i, line in enumerate(f):
                 if i < start_line:
                     continue
                 if len(events) >= limit:
-                    next_token = str(i)
                     break
                 data = json.loads(line)
                 events.append(
@@ -152,7 +153,10 @@ class FileLogStorage(LogStorage):
                         message=data["b64"],
                     )
                 )
-        return JobSubmissionLogs(logs=events, next_token=next_token)
+                consumed = i + 1
+        # Always a resumable cursor (line number) so follow-mode clients can
+        # poll for lines appended later.
+        return JobSubmissionLogs(logs=events, next_token=str(consumed) if consumed else "")
 
 
 def default_log_storage(ctx: ServerContext) -> LogStorage:

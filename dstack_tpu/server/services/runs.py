@@ -217,6 +217,20 @@ async def submit_run(
                 )
         run_id = generate_id()
         now = utcnow_iso()
+        # Resolve the user-facing repo name to the internal repos.id so the
+        # running-jobs processor can fetch the uploaded code blob
+        # (process_running_jobs._get_code_blob joins codes on repos.id).
+        repo_row_id = None
+        if run_spec.repo_id is not None:
+            repo_row = await ctx.db.fetchone(
+                "SELECT id FROM repos WHERE project_id = ? AND name = ?",
+                (project_row["id"], run_spec.repo_id),
+            )
+            if repo_row is None:
+                raise ResourceNotExistsError(
+                    f"Repo {run_spec.repo_id} is not initialized; call /repos/init"
+                )
+            repo_row_id = repo_row["id"]
         service_spec = None
         if isinstance(run_spec.configuration, ServiceConfiguration):
             service_spec = ServiceSpec(
@@ -235,8 +249,9 @@ async def submit_run(
                 )
         await ctx.db.execute(
             "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
-            " last_processed_at, status, run_spec, service_spec, desired_replica_count)"
-            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            " last_processed_at, status, run_spec, service_spec, desired_replica_count,"
+            " repo_id)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
             (
                 run_id,
                 project_row["id"],
@@ -248,6 +263,7 @@ async def submit_run(
                 run_spec.model_dump_json(),
                 service_spec.model_dump_json() if service_spec else None,
                 _desired_replica_count(run_spec),
+                repo_row_id,
             ),
         )
         for replica_num in range(_desired_replica_count(run_spec)):

@@ -1,0 +1,151 @@
+"""CLI end-to-end against a live server (VERDICT r1 #1 acceptance: `apply -f
+task.yml` takes a multi-host simulated TPU gang to DONE with streamed logs).
+
+Commands run in-process via click's CliRunner; the server is real HTTP.
+"""
+
+import pytest
+from click.testing import CliRunner
+
+from dstack_tpu.cli.main import cli
+from tests.server.test_sdk import LiveServer
+
+
+@pytest.fixture()
+def gang_server():
+    srv = LiveServer(local_backend_config={"tpu_sim": ["v5litepod-16"]}).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def cli_env(gang_server, tmp_path, monkeypatch):
+    """Point the CLI's global config at a temp dir and log in."""
+    monkeypatch.setenv("DSTACK_TPU_CONFIG_DIR", str(tmp_path / "cfg"))
+    # config.py resolves the env var at import time; patch the resolved dir.
+    import dstack_tpu.api.config as cfgmod
+
+    monkeypatch.setattr(cfgmod, "DEFAULT_CONFIG_DIR", tmp_path / "cfg")
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        ["config", "--project", "main", "--url", gang_server.url,
+         "--token", gang_server.admin_token],
+    )
+    assert result.exit_code == 0, result.output
+    return runner
+
+
+def test_cli_entry_point_resolves():
+    """pyproject's console script target must import (VERDICT r1: it dangled)."""
+    import importlib
+
+    mod = importlib.import_module("dstack_tpu.cli.main")
+    assert callable(mod.main)
+
+
+def test_cli_apply_tpu_gang_to_done_with_logs(cli_env, gang_server, tmp_path):
+    task = tmp_path / "task.yml"
+    task.write_text(
+        "type: task\n"
+        "commands:\n"
+        "  - echo gangrank=$JAX_PROCESS_ID/$JAX_NUM_PROCESSES\n"
+        "resources:\n"
+        "  tpu: v5litepod-16\n"
+    )
+    result = cli_env.invoke(
+        cli, ["apply", "-f", str(task), "-y", "--name", "cli-gang"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    # Plan table rendered with the local TPU offer.
+    assert "local" in result.output
+    # Streamed logs from all 4 worker hosts of the v5litepod-16 slice.
+    for rank in range(4):
+        assert f"gangrank={rank}/4" in result.output
+    assert "done" in result.output
+
+
+def test_cli_ps_logs_stop_delete(cli_env, gang_server, tmp_path):
+    task = tmp_path / "sleep.yml"
+    task.write_text(
+        "type: task\n"
+        "commands: ['echo live-log-line', 'sleep 120']\n"
+        "resources: {cpu: '1..', memory: '0.1..'}\n"
+    )
+    r = cli_env.invoke(cli, ["apply", "-f", str(task), "-y", "-d", "--name", "cli-sleep"])
+    assert r.exit_code == 0, r.output
+    assert "submitted" in r.output
+
+    # Wait for RUNNING via SDK (CliRunner has no easy polling loop).
+    from dstack_tpu.api import Client
+    from dstack_tpu.models.runs import RunStatus
+
+    client = Client(server_url=gang_server.url, token=gang_server.admin_token,
+                    project_name="main")
+    run = client.runs.get("cli-sleep")
+    run.wait(statuses=[RunStatus.RUNNING], timeout=60)
+
+    r = cli_env.invoke(cli, ["ps"])
+    assert r.exit_code == 0, r.output
+    assert "cli-sleep" in r.output and "running" in r.output
+
+    r = cli_env.invoke(cli, ["logs", "cli-sleep"])
+    assert r.exit_code == 0, r.output
+    assert "live-log-line" in r.output
+
+    r = cli_env.invoke(cli, ["stop", "cli-sleep"])
+    assert r.exit_code == 0, r.output
+    assert run.wait(timeout=60) == RunStatus.TERMINATED
+
+    r = cli_env.invoke(cli, ["delete", "cli-sleep", "-y"])
+    assert r.exit_code == 0, r.output
+    r = cli_env.invoke(cli, ["ps", "-a"])
+    assert "cli-sleep" not in r.output
+    client.api.close()
+
+
+def test_cli_apply_failed_run_exits_nonzero(cli_env, tmp_path):
+    task = tmp_path / "fail.yml"
+    task.write_text(
+        "type: task\ncommands: ['exit 9']\nresources: {cpu: '1..', memory: '0.1..'}\n"
+    )
+    r = cli_env.invoke(cli, ["apply", "-f", str(task), "-y", "--name", "cli-fail"])
+    assert r.exit_code == 1, r.output
+    assert "failed" in r.output
+
+
+def test_cli_fleet_volume_secrets(cli_env, tmp_path):
+    fleet_yml = tmp_path / "fleet.yml"
+    fleet_yml.write_text("type: fleet\nname: cli-fleet\nnodes: 0..1\n")
+    r = cli_env.invoke(cli, ["apply", "-f", str(fleet_yml), "-y"])
+    assert r.exit_code == 0, r.output
+
+    r = cli_env.invoke(cli, ["fleet", "list"])
+    assert "cli-fleet" in r.output
+    r = cli_env.invoke(cli, ["fleet", "delete", "cli-fleet", "-y"])
+    assert r.exit_code == 0, r.output
+
+    vol_yml = tmp_path / "vol.yml"
+    vol_yml.write_text(
+        "type: volume\nname: cli-vol\nbackend: local\nregion: local\nsize: 1GB\n"
+    )
+    r = cli_env.invoke(cli, ["apply", "-f", str(vol_yml), "-y"])
+    assert r.exit_code == 0, r.output
+    r = cli_env.invoke(cli, ["volume", "list"])
+    assert "cli-vol" in r.output
+
+    r = cli_env.invoke(cli, ["secrets", "set", "tok", "s3cret"])
+    assert r.exit_code == 0, r.output
+    r = cli_env.invoke(cli, ["secrets", "list"])
+    assert "tok" in r.output
+    r = cli_env.invoke(cli, ["secrets", "get", "tok"])
+    assert "s3cret" in r.output
+
+
+def test_cli_bad_config_file(cli_env, tmp_path):
+    bad = tmp_path / "bad.yml"
+    bad.write_text("type: task\ncommands: ['echo x']\nresources: {tpu: warp9}\n")
+    r = cli_env.invoke(cli, ["apply", "-f", str(bad), "-y"])
+    assert r.exit_code == 1
+    assert "Error" in r.output
