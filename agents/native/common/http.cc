@@ -55,6 +55,135 @@ void HttpServer::route(const std::string& method, const std::string& pattern,
   routes_.push_back(std::move(r));
 }
 
+void HttpServer::route_ws(const std::string& pattern, WsHandler h) {
+  WsRoute r;
+  r.segments = split(pattern, '/');
+  r.handler = std::move(h);
+  ws_routes_.push_back(std::move(r));
+}
+
+// ---- SHA-1 (for the RFC6455 Sec-WebSocket-Accept digest only) --------------
+
+static void sha1(const std::string& input, unsigned char out[20]) {
+  uint32_t h[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0};
+  std::string msg = input;
+  uint64_t bitlen = static_cast<uint64_t>(msg.size()) * 8;
+  msg.push_back('\x80');
+  while (msg.size() % 64 != 56) msg.push_back('\0');
+  for (int i = 7; i >= 0; --i) msg.push_back(static_cast<char>((bitlen >> (i * 8)) & 0xFF));
+  for (size_t chunk = 0; chunk < msg.size(); chunk += 64) {
+    uint32_t w[80];
+    for (int i = 0; i < 16; ++i) {
+      w[i] = (static_cast<uint8_t>(msg[chunk + i * 4]) << 24) |
+             (static_cast<uint8_t>(msg[chunk + i * 4 + 1]) << 16) |
+             (static_cast<uint8_t>(msg[chunk + i * 4 + 2]) << 8) |
+             static_cast<uint8_t>(msg[chunk + i * 4 + 3]);
+    }
+    auto rol = [](uint32_t v, int s) { return (v << s) | (v >> (32 - s)); };
+    for (int i = 16; i < 80; ++i)
+      w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+    for (int i = 0; i < 80; ++i) {
+      uint32_t f, k;
+      if (i < 20) { f = (b & c) | (~b & d); k = 0x5A827999; }
+      else if (i < 40) { f = b ^ c ^ d; k = 0x6ED9EBA1; }
+      else if (i < 60) { f = (b & c) | (b & d) | (c & d); k = 0x8F1BBCDC; }
+      else { f = b ^ c ^ d; k = 0xCA62C1D6; }
+      uint32_t tmp = rol(a, 5) + f + e + k + w[i];
+      e = d; d = c; c = rol(b, 30); b = a; a = tmp;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d; h[4] += e;
+  }
+  for (int i = 0; i < 5; ++i) {
+    out[i * 4] = (h[i] >> 24) & 0xFF;
+    out[i * 4 + 1] = (h[i] >> 16) & 0xFF;
+    out[i * 4 + 2] = (h[i] >> 8) & 0xFF;
+    out[i * 4 + 3] = h[i] & 0xFF;
+  }
+}
+
+static std::string ws_accept_key(const std::string& client_key) {
+  static const char kGuid[] = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11";
+  unsigned char digest[20];
+  sha1(client_key + kGuid, digest);
+  return base64_encode(reinterpret_cast<const char*>(digest), 20);
+}
+
+// ---- WsConn ----------------------------------------------------------------
+
+bool WsConn::send_frame(uint8_t opcode, const std::string& payload) {
+  if (closed_) return false;
+  std::string frame;
+  frame.push_back(static_cast<char>(0x80 | opcode));
+  size_t n = payload.size();
+  if (n < 126) {
+    frame.push_back(static_cast<char>(n));
+  } else if (n < (1 << 16)) {
+    frame.push_back(126);
+    frame.push_back(static_cast<char>((n >> 8) & 0xFF));
+    frame.push_back(static_cast<char>(n & 0xFF));
+  } else {
+    frame.push_back(127);
+    for (int i = 7; i >= 0; --i)
+      frame.push_back(static_cast<char>((static_cast<uint64_t>(n) >> (i * 8)) & 0xFF));
+  }
+  frame += payload;
+  size_t off = 0;
+  while (off < frame.size()) {
+    ssize_t w = send(fd_, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+    if (w <= 0) {
+      closed_ = true;
+      return false;
+    }
+    off += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool WsConn::send_close() {
+  bool ok = send_frame(0x8, "");
+  closed_ = true;
+  return ok;
+}
+
+bool WsConn::peer_alive() {
+  if (closed_) return false;
+  // Non-blocking drain of client frames, scanning each for a close opcode
+  // (a ping before the close must not hide it). Client frames are masked:
+  // header = 2 bytes + extended length + 4-byte mask.
+  char buf[512];
+  ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
+  if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+    closed_ = true;
+    return false;
+  }
+  size_t pos = 0;
+  while (n > 0 && pos + 2 <= static_cast<size_t>(n)) {
+    uint8_t opcode = static_cast<uint8_t>(buf[pos]) & 0x0F;
+    if (opcode == 0x8) {
+      closed_ = true;
+      return false;
+    }
+    uint64_t len = static_cast<uint8_t>(buf[pos + 1]) & 0x7F;
+    size_t header = 2;
+    if (len == 126) {
+      if (pos + 4 > static_cast<size_t>(n)) break;
+      len = (static_cast<uint8_t>(buf[pos + 2]) << 8) |
+            static_cast<uint8_t>(buf[pos + 3]);
+      header = 4;
+    } else if (len == 127) {
+      if (pos + 10 > static_cast<size_t>(n)) break;
+      len = 0;
+      for (int i = 0; i < 8; ++i)
+        len = (len << 8) | static_cast<uint8_t>(buf[pos + 2 + i]);
+      header = 10;
+    }
+    if (static_cast<uint8_t>(buf[pos + 1]) & 0x80) header += 4;  // mask
+    pos += header + len;  // skip payload (data frames are ignored)
+  }
+  return true;
+}
+
 int HttpServer::start() {
   listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
   if (listen_fd_ < 0) return -1;
@@ -169,6 +298,18 @@ void HttpServer::handle_connection_impl(int fd) {
       req.headers[key] = value;
     }
   }
+  {
+    auto up = req.headers.find("upgrade");
+    if (up != req.headers.end()) {
+      std::string v = up->second;
+      std::transform(v.begin(), v.end(), v.begin(), ::tolower);
+      if (v == "websocket") {
+        try_websocket(fd, req);
+        close(fd);
+        return;
+      }
+    }
+  }
   size_t content_length = 0;
   auto cl = req.headers.find("content-length");
   if (cl != req.headers.end()) {
@@ -213,6 +354,48 @@ void HttpServer::handle_connection_impl(int fd) {
     off += n;
   }
   close(fd);
+}
+
+bool HttpServer::try_websocket(int fd, HttpRequest& req) {
+  auto path_segments = split(req.path, '/');
+  const WsRoute* found = nullptr;
+  std::map<std::string, std::string> captures;
+  for (const auto& r : ws_routes_) {
+    if (r.segments.size() != path_segments.size()) continue;
+    bool match = true;
+    captures.clear();
+    for (size_t i = 0; i < r.segments.size(); ++i) {
+      const std::string& pat = r.segments[i];
+      if (pat.size() >= 2 && pat.front() == '{' && pat.back() == '}') {
+        captures[pat.substr(1, pat.size() - 2)] = path_segments[i];
+      } else if (pat != path_segments[i]) {
+        match = false;
+        break;
+      }
+    }
+    if (match) {
+      found = &r;
+      break;
+    }
+  }
+  auto key = req.headers.find("sec-websocket-key");
+  if (found == nullptr || key == req.headers.end()) {
+    static const char kNotFound[] =
+        "HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\nConnection: close\r\n\r\n";
+    (void)!write(fd, kNotFound, sizeof(kNotFound) - 1);
+    return false;
+  }
+  std::string resp =
+      "HTTP/1.1 101 Switching Protocols\r\nUpgrade: websocket\r\n"
+      "Connection: Upgrade\r\nSec-WebSocket-Accept: " +
+      ws_accept_key(key->second) + "\r\n\r\n";
+  if (write(fd, resp.data(), resp.size()) != static_cast<ssize_t>(resp.size()))
+    return false;
+  for (auto& [k, v] : captures) req.query[k] = v;
+  WsConn conn(fd);
+  found->handler(req, conn);
+  conn.send_close();
+  return true;
 }
 
 HttpResponse HttpServer::dispatch(HttpRequest& req) {

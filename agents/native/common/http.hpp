@@ -46,6 +46,27 @@ struct HttpResponse {
 // Handler receives the request; throw std::runtime_error -> 400 with detail.
 using Handler = std::function<HttpResponse(const HttpRequest&)>;
 
+// Server side of an accepted RFC6455 websocket (no extensions). The handler
+// owns the connection for its lifetime; send failures mean the peer is gone.
+class WsConn {
+ public:
+  explicit WsConn(int fd) : fd_(fd) {}
+  bool send_text(const std::string& payload) { return send_frame(0x1, payload); }
+  bool send_binary(const std::string& payload) { return send_frame(0x2, payload); }
+  bool send_close();
+  // Drains any client frames already received; returns false once the peer
+  // sent a close frame or dropped the connection.
+  bool peer_alive();
+
+ private:
+  bool send_frame(uint8_t opcode, const std::string& payload);
+  int fd_;
+  bool closed_ = false;
+};
+
+// Websocket handler: runs on the connection thread until it returns.
+using WsHandler = std::function<void(const HttpRequest&, WsConn&)>;
+
 class HttpServer {
  public:
   HttpServer(std::string host, int port) : host_(std::move(host)), port_(port) {}
@@ -54,6 +75,9 @@ class HttpServer {
   // route("GET", "/api/tasks/{id}", ...): "{...}" segments match any value;
   // matched values appear in request.query under the brace name.
   void route(const std::string& method, const std::string& pattern, Handler h);
+
+  // Websocket upgrade endpoint (GET + Upgrade: websocket).
+  void route_ws(const std::string& pattern, WsHandler h);
 
   // Binds and starts the accept loop on a background thread.
   // Returns the bound port (for port=0) or -1 on failure.
@@ -67,6 +91,10 @@ class HttpServer {
     std::vector<std::string> segments;
     Handler handler;
   };
+  struct WsRoute {
+    std::vector<std::string> segments;
+    WsHandler handler;
+  };
 
   void accept_loop();
   void handle_connection(int fd);
@@ -77,9 +105,12 @@ class HttpServer {
   int port_;
   int bound_port_ = -1;
   int listen_fd_ = -1;
+  bool try_websocket(int fd, HttpRequest& req);
+
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
   std::vector<Route> routes_;
+  std::vector<WsRoute> ws_routes_;
 };
 
 }  // namespace dstack

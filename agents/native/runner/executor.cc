@@ -321,6 +321,12 @@ Json Executor::pull(int64_t since_ms) {
   return resp;
 }
 
+size_t Executor::job_logs_since(size_t index, std::vector<LogEvent>* out) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (size_t i = index; i < job_logs_.size(); ++i) out->push_back(job_logs_[i]);
+  return job_logs_.size();
+}
+
 Json Executor::metrics() {
   Json point = Json::object();
   point.set("timestamp", iso_utc_now());

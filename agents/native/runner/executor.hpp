@@ -42,6 +42,10 @@ class Executor {
   Json pull(int64_t since_ms);
   Json metrics();
 
+  // Copy job log events from `index` on; returns the new index. Feeds the
+  // /logs_ws stream (parity: runner/api/ws.go:28-62 jobLogsHistory replay).
+  size_t job_logs_since(size_t index, std::vector<LogEvent>* out) const;
+
   bool submitted() const { return submitted_; }
   bool finished() const { return finished_; }
 

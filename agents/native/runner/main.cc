@@ -81,6 +81,30 @@ int main(int argc, char** argv) {
   server.route("GET", "/api/metrics", [&](const HttpRequest&) {
     return HttpResponse::ok(executor.metrics());
   });
+  // Live job-output stream: full history replay, then frames as output
+  // arrives, closing once the job finished and everything was sent.
+  // Parity: runner/internal/runner/api/ws.go:18-62 (/logs_ws).
+  server.route_ws("/logs_ws", [&](const HttpRequest&, WsConn& conn) {
+    size_t idx = 0;
+    while (true) {
+      std::vector<LogEvent> batch;
+      idx = executor.job_logs_since(idx, &batch);
+      for (const auto& e : batch) {
+        if (!conn.send_binary(e.message)) return;
+      }
+      if (executor.finished()) {
+        std::vector<LogEvent> tail;
+        size_t end = executor.job_logs_since(idx, &tail);
+        for (const auto& e : tail) {
+          if (!conn.send_binary(e.message)) return;
+        }
+        idx = end;
+        return;
+      }
+      if (!conn.peer_alive()) return;
+      usleep(100'000);
+    }
+  });
 
   int bound = server.start();
   if (bound < 0) {

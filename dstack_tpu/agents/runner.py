@@ -342,7 +342,34 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
     async def metrics(request: Request):
         return MetricsResponse(**executor.metrics().model_dump())
 
+    ws_router = Router()
+
+    @ws_router.websocket("/logs_ws")
+    async def logs_ws(request: Request, ws) -> None:
+        """Live job-output stream: history replay then frames as output
+        arrives; closes when the job finishes (parity: runner/api/ws.go)."""
+        idx = 0
+        ticks = 0
+        while True:
+            batch = executor.job_logs[idx:]
+            idx += len(batch)
+            for event in batch:
+                await ws.send_bytes(base64.b64decode(event.message))
+            if executor.finished.is_set():
+                tail = executor.job_logs[idx:]
+                idx += len(tail)
+                for event in tail:
+                    await ws.send_bytes(base64.b64decode(event.message))
+                return
+            ticks += 1
+            if ticks % 20 == 0:  # ~2s: detect followers gone away on quiet jobs
+                await ws.ping()
+            if ws.closed:
+                return
+            await asyncio.sleep(0.1)
+
     app.include_router(router)
+    app.include_router(ws_router)
 
     if idle_shutdown:
         async def _idle_watchdog() -> None:

@@ -1,0 +1,118 @@
+"""Run attach: SSH config management + app-port forwarding.
+
+Parity: reference `api/_public/runs.py:246-353` (Run.attach) +
+`core/services/ssh/attach.py:27-110` (managed ~/.dstack/ssh/config blocks,
+multiplexed tunnel forwarding configured app ports). The host entry makes
+plain `ssh <run-name>` work; the tunnel exposes the job's app ports on
+localhost.
+"""
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from dstack_tpu.models.runs import Run as RunDTO
+from dstack_tpu.utils.ssh import PortForward, SSHTarget, SSHTunnel, find_free_port
+
+_BEGIN = "# >>> dstack-tpu {name} >>>"
+_END = "# <<< dstack-tpu {name} <<<"
+
+
+@dataclass
+class AttachInfo:
+    host_alias: str
+    hostname: str
+    ports: Dict[int, int]  # container port -> local port
+    tunnel: Optional[SSHTunnel] = None
+
+
+def ssh_config_block(
+    name: str,
+    hostname: str,
+    username: str,
+    port: int,
+    identity_file: Optional[str],
+    proxy_jump: Optional[str] = None,
+) -> str:
+    lines = [
+        _BEGIN.format(name=name),
+        f"Host {name}",
+        f"    HostName {hostname}",
+        f"    User {username}",
+        f"    Port {port}",
+        "    StrictHostKeyChecking no",
+        "    UserKnownHostsFile /dev/null",
+    ]
+    if identity_file:
+        lines.append(f"    IdentityFile {identity_file}")
+        lines.append("    IdentitiesOnly yes")
+    if proxy_jump:
+        lines.append(f"    ProxyJump {proxy_jump}")
+    lines.append(_END.format(name=name))
+    return "\n".join(lines) + "\n"
+
+
+def update_ssh_config(config_path: Path, name: str, block: Optional[str]) -> None:
+    """Insert/replace (block given) or remove (block=None) a managed entry.
+    Only text between this run's markers is ever touched."""
+    config_path.parent.mkdir(parents=True, exist_ok=True)
+    existing = config_path.read_text() if config_path.is_file() else ""
+    pattern = re.compile(
+        re.escape(_BEGIN.format(name=name)) + r".*?" + re.escape(_END.format(name=name)) + r"\n?",
+        re.DOTALL,
+    )
+    cleaned = pattern.sub("", existing)
+    if block:
+        if cleaned and not cleaned.endswith("\n"):
+            cleaned += "\n"
+        cleaned += block
+    config_path.write_text(cleaned)
+    config_path.chmod(0o600)
+
+
+def plan_port_forwards(run: RunDTO, replica_num: int = 0) -> List[PortForward]:
+    """One forward per configured app port of the replica's rank-0 job;
+    `map_to_port` pins the local port, otherwise any free port."""
+    forwards: List[PortForward] = []
+    for job in run.jobs:
+        spec = job.job_spec
+        if spec.replica_num != replica_num or spec.job_num != 0:
+            continue
+        for app in spec.app_specs:
+            local = app.map_to_port or find_free_port()
+            forwards.append(
+                PortForward(local_port=local, remote_host="localhost",
+                            remote_port=app.port)
+            )
+    return forwards
+
+
+def attach_target(run: RunDTO, identity_file: Optional[str],
+                  replica_num: int = 0) -> Optional[SSHTarget]:
+    """SSH target for the replica's rank-0 job host, or None if the run has
+    no provisioned host (not yet provisioned, or local backend)."""
+    for job in run.jobs:
+        if job.job_spec.replica_num != replica_num or job.job_spec.job_num != 0:
+            continue
+        if not job.job_submissions:
+            return None
+        jpd = job.job_submissions[-1].job_provisioning_data
+        if jpd is None or not jpd.hostname:
+            return None
+        proxy = None
+        if jpd.ssh_proxy is not None:
+            proxy = SSHTarget(
+                hostname=jpd.ssh_proxy.hostname,
+                username=jpd.ssh_proxy.username,
+                port=jpd.ssh_proxy.port,
+                identity_file=identity_file,
+            )
+        return SSHTarget(
+            hostname=jpd.hostname,
+            username=jpd.username,
+            port=jpd.ssh_port or 22,
+            identity_file=identity_file,
+            proxy=proxy,
+        )
+    return None

@@ -26,6 +26,7 @@ from dstack_tpu.models.runs import ApplyRunPlanInput, Run as RunDTO, RunPlan, Ru
 from dstack_tpu.models.volumes import Volume, VolumeConfiguration
 from dstack_tpu.api.repos import detect_remote_repo, pack_local_repo, repo_id_for_dir
 from dstack_tpu.api.rest import APIClient, NotFoundError
+from dstack_tpu.utils.ssh import SSHTunnel
 
 DEFAULT_SERVER_URL = "http://127.0.0.1:3000"
 
@@ -120,6 +121,28 @@ class Run:
                 if len(events) < page:  # drained to the current end
                     return
 
+        if follow:
+            picked = _picked()
+            if len(picked) == 1 and picked[0].job_submissions:
+                # Single-submission follow rides the server's websocket
+                # stream (no 1s poll latency); gangs interleave via polling.
+                sub_id = picked[0].job_submissions[-1].id
+                clean = False
+                try:
+                    for kind, payload in self._stream_ws(sub_id, cursors.get(sub_id)):
+                        if kind == "data":
+                            yield payload
+                        else:  # cursor checkpoint
+                            cursors[sub_id] = payload or cursors.get(sub_id)
+                    clean = True  # generator exhausted; check how it ended
+                except ConnectionError:
+                    pass
+                self.refresh()
+                if clean and self._ws_clean and self._dto.status.is_finished():
+                    return
+                # Disconnect or job retry: resume via the poll loop from the
+                # last checkpoint (no duplication — cursors carry over).
+
         while True:
             for job in _picked():
                 if job.job_submissions:
@@ -130,6 +153,93 @@ class Run:
                 break  # this round's drain ran after finish was observed
             time.sleep(poll_interval)
             self.refresh()
+
+    _ws_clean = False
+
+    def _stream_ws(self, job_submission_id: str,
+                   start_after: Optional[str] = None):
+        """Yield ("data", bytes) log frames and ("cursor", str) checkpoints
+        from the server's follow websocket; sets _ws_clean when the server
+        closed the stream deliberately (job finished) rather than dropping."""
+        import json as _json
+
+        from dstack_tpu.api.ws import WsClient
+
+        url = (
+            f"{self._client.api.base_url}/api/project/{self._client.project}"
+            f"/logs/ws/{self.name}/{job_submission_id}"
+        )
+        if start_after:
+            url += f"?start_after={start_after}"
+        self._ws_clean = False
+        ws = WsClient(url, token=self._client.api.token).connect()
+        try:
+            for opcode, payload in ws.typed_frames():
+                if opcode == 0x1:  # text = control (cursor checkpoint)
+                    try:
+                        yield "cursor", _json.loads(payload).get("next_token", "")
+                    except ValueError:
+                        pass
+                else:
+                    yield "data", payload
+            self._ws_clean = ws.clean_close
+        finally:
+            ws.close()
+
+    # -- attach --------------------------------------------------------------
+
+    def attach(self, replica_num: int = 0):
+        """Write a managed SSH config entry for the run's host and forward
+        its configured app ports to localhost. Returns AttachInfo (tunnel
+        is None when the run has no SSH-reachable host, e.g. local backend).
+        Call detach() when done."""
+        import asyncio
+
+        from dstack_tpu.api.attach import (
+            AttachInfo,
+            attach_target,
+            plan_port_forwards,
+            ssh_config_block,
+            update_ssh_config,
+        )
+        from dstack_tpu.api.config import GlobalConfig
+
+        self.refresh()
+        cfg = GlobalConfig.load()
+        identity = str(cfg.ssh_key_path) if cfg.ssh_key_pub else None
+        target = attach_target(self._dto, identity, replica_num)
+        info = AttachInfo(host_alias=self.name, hostname="", ports={})
+        if target is None:
+            return info
+        info.hostname = target.hostname
+        update_ssh_config(
+            cfg.ssh_dir / "config",
+            self.name,
+            ssh_config_block(
+                self.name, target.hostname, target.username, target.port,
+                identity,
+                proxy_jump=(
+                    f"{target.proxy.username}@{target.proxy.hostname}:{target.proxy.port}"
+                    if target.proxy else None
+                ),
+            ),
+        )
+        forwards = plan_port_forwards(self._dto, replica_num)
+        if forwards:
+            tunnel = SSHTunnel(target, forwards)
+            asyncio.run(tunnel.open())
+            info.tunnel = tunnel
+            info.ports = {f.remote_port: f.local_port for f in forwards}
+        return info
+
+    def detach(self, info=None) -> None:
+        from dstack_tpu.api.attach import update_ssh_config
+        from dstack_tpu.api.config import GlobalConfig
+
+        if info is not None and info.tunnel is not None:
+            info.tunnel.close()
+        cfg = GlobalConfig.load()
+        update_ssh_config(cfg.ssh_dir / "config", self.name, None)
 
     def __repr__(self) -> str:
         return f"<Run {self.name!r} {self._dto.status.value}>"
@@ -225,8 +335,11 @@ class RunCollection:
             spec.repo_data = repo_data
             spec.repo_id = repo_id_for_dir(repo_dir)
             spec.repo_code_hash = hashlib.sha256(blob).hexdigest()
-            self._pending_blobs.clear()
             self._pending_blobs[(spec.repo_id, spec.repo_code_hash)] = blob
+            # Keyed by (repo, content hash) so concurrent plans coexist; cap
+            # retained plans so abandoned ones can't pile up 256 MiB tars.
+            while len(self._pending_blobs) > 4:
+                self._pending_blobs.pop(next(iter(self._pending_blobs)))
         return spec
 
     def _upload_code(self, run_spec: RunSpec, repo_dir: Optional[str]) -> None:

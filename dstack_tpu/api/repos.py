@@ -85,14 +85,27 @@ def _git(root: Path, *args: str) -> Optional[str]:
 
 
 def detect_remote_repo(path: str) -> Optional[Tuple[RemoteRunRepoData, bytes]]:
-    """If `path` is a git checkout with an upstream, return repo data + the
-    uncommitted diff as the code blob (reference: diff tar upload,
-    runner/internal/repo applies it after clone)."""
+    """If `path` is a git checkout whose HEAD is fetchable from origin,
+    return repo data + the uncommitted diff as the code blob (reference:
+    diff tar upload, runner/internal/repo applies it after clone).
+
+    Falls back to None (-> full local pack) when the clone-and-diff recipe
+    would lose work: untracked files (git diff omits them) or local commits
+    origin doesn't have (the runner's clone couldn't check out repo_hash).
+    """
     root = Path(path).resolve()
     url = _git(root, "remote", "get-url", "origin")
     head = _git(root, "rev-parse", "HEAD")
     if not url or not head:
         return None
+    status = _git(root, "status", "--porcelain")
+    if status is not None and any(
+        line.startswith("??") for line in status.splitlines()
+    ):
+        return None  # untracked files would be silently dropped
+    remote_with_head = _git(root, "branch", "-r", "--contains", head)
+    if not remote_with_head:
+        return None  # HEAD not pushed; clone couldn't reach it
     branch = _git(root, "rev-parse", "--abbrev-ref", "HEAD")
     diff = _git(root, "diff", "HEAD") or ""
     host, user, name = _parse_git_url(url)

@@ -45,6 +45,7 @@ class APIClient:
 
     def __init__(self, base_url: str, token: str, timeout: float = 60.0):
         self.base_url = base_url.rstrip("/")
+        self.token = token
         self._http = httpx.Client(
             base_url=self.base_url,
             headers={"Authorization": f"Bearer {token}"},

@@ -1,0 +1,141 @@
+"""Minimal sync WebSocket client (RFC6455, no extensions).
+
+Used by the CLI/SDK to follow the server's log stream
+(`/api/project/{p}/logs/ws/...`) without extra dependencies; server frames
+are unmasked, client frames are masked per spec.
+"""
+
+import base64
+import os
+import socket
+import struct
+from typing import Iterator, Optional, Tuple
+from urllib.parse import urlsplit
+
+
+class WsError(ConnectionError):
+    pass
+
+
+class WsClient:
+    def __init__(self, url: str, token: Optional[str] = None, timeout: float = 60.0):
+        parts = urlsplit(url)
+        if parts.scheme not in ("ws", "http"):
+            raise WsError(f"Unsupported scheme {parts.scheme!r} (no TLS support)")
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or 80
+        self.path = parts.path + (f"?{parts.query}" if parts.query else "")
+        self.token = token
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+
+    def connect(self) -> "WsClient":
+        key = base64.b64encode(os.urandom(16)).decode()
+        headers = [
+            f"GET {self.path} HTTP/1.1",
+            f"Host: {self.host}:{self.port}",
+            "Upgrade: websocket",
+            "Connection: Upgrade",
+            f"Sec-WebSocket-Key: {key}",
+            "Sec-WebSocket-Version: 13",
+        ]
+        if self.token:
+            headers.append(f"Authorization: Bearer {self.token}")
+        self._sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        self._sock.sendall(("\r\n".join(headers) + "\r\n\r\n").encode())
+        status = self._read_until(b"\r\n\r\n")
+        if b" 101 " not in status.split(b"\r\n", 1)[0]:
+            raise WsError(f"Handshake rejected: {status.split(b'\r\n', 1)[0].decode()}")
+        return self
+
+    def _read_until(self, delim: bytes) -> bytes:
+        assert self._sock is not None
+        while delim not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise WsError("Connection closed during handshake")
+            self._buf += chunk
+        head, self._buf = self._buf.split(delim, 1)
+        return head
+
+    def _read_exact(self, n: int) -> bytes:
+        assert self._sock is not None
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise WsError("Connection closed mid-frame")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_frame(self) -> Tuple[int, bytes]:
+        head = self._read_exact(2)
+        opcode = head[0] & 0x0F
+        n = head[1] & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", self._read_exact(2))[0]
+        elif n == 127:
+            n = struct.unpack(">Q", self._read_exact(8))[0]
+        masked = head[1] & 0x80
+        mask = self._read_exact(4) if masked else b"\x00" * 4
+        payload = self._read_exact(n)
+        if masked:
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        return opcode, payload
+
+    def _send_frame(self, opcode: int, payload: bytes = b"") -> None:
+        assert self._sock is not None
+        mask = os.urandom(4)
+        header = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            header += bytes([0x80 | n])
+        elif n < (1 << 16):
+            header += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            header += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self._sock.sendall(header + mask + masked)
+
+    def frames(self) -> Iterator[bytes]:
+        """Yield data-frame payloads until the server closes.
+        `clean_close` tells whether the stream ended with a close frame
+        (True) or a transport drop (False)."""
+        yield from (p for op, p in self.typed_frames() if op in (0x1, 0x2, 0x0))
+
+    clean_close = False
+
+    def typed_frames(self) -> Iterator[Tuple[int, bytes]]:
+        """(opcode, payload) pairs — callers that multiplex data and control
+        payloads (e.g. log bytes vs cursor checkpoints) switch on opcode."""
+        self.clean_close = False
+        while True:
+            try:
+                opcode, payload = self._read_frame()
+            except (WsError, OSError):
+                return
+            if opcode == 0x8:  # close
+                self.clean_close = True
+                try:
+                    self._send_frame(0x8)
+                except OSError:
+                    pass
+                return
+            if opcode == 0x9:  # ping
+                try:
+                    self._send_frame(0xA, payload)
+                except OSError:
+                    return
+                continue
+            if opcode in (0x1, 0x2, 0x0):
+                yield opcode, payload
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._send_frame(0x8)
+            except OSError:
+                pass
+            self._sock.close()
+            self._sock = None

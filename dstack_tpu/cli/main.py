@@ -201,9 +201,11 @@ def _follow_run(client, run) -> None:
         for chunk in run.logs(follow=True):
             sys.stdout.buffer.write(chunk)
             sys.stdout.buffer.flush()
-        run.refresh()
-        console.print(f"\n[dim]{run.name}:[/] {fmt_status(run.status.value)}")
-        if run.status.value in ("failed", "terminated"):
+        # The log stream closes on job finish; the run-level status lags by
+        # one FSM tick (terminating -> terminated/failed), so wait it out.
+        status = run.wait(timeout=120, poll=0.3)
+        console.print(f"\n[dim]{run.name}:[/] {fmt_status(status.value)}")
+        if status.value in ("failed", "terminated"):
             raise click.exceptions.Exit(1)
     except KeyboardInterrupt:
         console.print(
@@ -331,15 +333,34 @@ def delete(run_name: str, project: Optional[str], yes: bool) -> None:
 @cli.command()
 @click.argument("run_name")
 @click.option("--project", default=None)
-def attach(run_name: str, project: Optional[str]) -> None:
-    """Re-attach to a run: stream status + logs until it finishes."""
+@click.option("--no-ssh", is_flag=True, help="skip SSH config/port-forward setup")
+def attach(run_name: str, project: Optional[str], no_ssh: bool) -> None:
+    """Attach to a run: SSH host entry + app-port forwards + log stream."""
     client = _make_client(project)
+    info = None
+    run = None
     try:
         run = client.runs.get(run_name)
+        if not no_ssh:
+            try:
+                info = run.attach()
+                if info.hostname:
+                    console.print(
+                        f"SSH: [bold]ssh {info.host_alias}[/] ({info.hostname})"
+                    )
+                for remote, local in info.ports.items():
+                    console.print(f"Forwarding localhost:{local} -> :{remote}")
+            except DstackTpuError as e:
+                console.print(f"[yellow]No SSH attach:[/] {e}")
         _follow_run(client, run)
     except DstackTpuError as e:
         raise _fail(str(e))
     finally:
+        if run is not None:
+            try:
+                run.detach(info)
+            except Exception:
+                pass
         client.api.close()
 
 

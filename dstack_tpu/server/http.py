@@ -297,6 +297,14 @@ class WebSocket:
     async def send_bytes(self, data: bytes) -> None:
         await self._send_frame(0x2, data)
 
+    async def ping(self) -> None:
+        """Liveness probe for idle streams: a dead peer surfaces as a write
+        error within a probe round or two, flipping `closed`."""
+        try:
+            await self._send_frame(0x9, b"")
+        except (ConnectionError, OSError):
+            self.closed = True
+
     async def _send_frame(self, opcode: int, payload: bytes) -> None:
         if self.closed:
             return
@@ -494,6 +502,13 @@ class Server:
         if route is None or not route.websocket:
             await self._write_response(writer, Response({"detail": "Not found"}, status=404), False)
             return
+        # Middleware (ctx injection, auth hooks) runs before the upgrade; a
+        # middleware response rejects the handshake with that response.
+        for mw in self.app.middleware:
+            resp = await mw(request)
+            if resp is not None:
+                await self._write_response(writer, resp, False)
+                return
         key = request.headers.get("sec-websocket-key", "")
         accept = _ws_accept_key(key)
         writer.write(

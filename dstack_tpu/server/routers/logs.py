@@ -1,6 +1,12 @@
 """/api/project/{project}/logs — parity: reference routers/logs.py
-(poll_logs against the pluggable LogStorage)."""
+(poll_logs against the pluggable LogStorage) plus a websocket follow
+endpoint feeding CLI `logs -f`/attach (reference streams the runner's
+/logs_ws through an SSH tunnel; the server re-serves its log store the
+same way so clients need no tunnel)."""
 
+import asyncio
+import base64
+import json
 from typing import Optional
 
 from pydantic import BaseModel
@@ -40,3 +46,62 @@ async def poll_logs(request: Request, project_name: str):
         diagnose=body.diagnose,
     )
     return logs
+
+
+@router.websocket("/api/project/{project_name}/logs/ws/{run_name}/{job_submission_id}")
+async def follow_logs_ws(request: Request, ws, project_name: str, run_name: str,
+                         job_submission_id: str) -> None:
+    """Stream decoded log bytes as binary frames until the job finishes.
+
+    Auth: bearer header, or `?token=` for clients that cannot set websocket
+    headers. History is replayed first, then new lines as they land in the
+    log store; the socket closes after the final drain.
+    """
+    token = request.query_param("token")
+    if token and "authorization" not in request.headers:
+        request.headers["authorization"] = f"Bearer {token}"
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    job_row = await ctx.db.fetchone(
+        "SELECT * FROM jobs WHERE id = ? AND project_id = ?",
+        (job_submission_id, project_row["id"]),
+    )
+    if job_row is None:
+        # Error, not log data: close without any data frame so clients never
+        # mistake the message for job output (poll API carries the detail).
+        return
+    from dstack_tpu.models.runs import JobStatus
+
+    # Clients may resume after a disconnect from a poll-API cursor.
+    cursor: Optional[str] = request.query_param("start_after") or None
+    page = 1000
+    while True:
+        # Observe finish BEFORE draining: logs land in storage before the
+        # status flips, so a drain after seeing `finished` is complete.
+        status_row = await ctx.db.fetchone(
+            "SELECT status FROM jobs WHERE id = ?", (job_submission_id,)
+        )
+        finished = status_row is None or JobStatus(status_row["status"]).is_finished()
+        while True:
+            data = await ctx.log_storage.poll(
+                project_id=project_row["id"],
+                run_name=run_name,
+                job_submission_id=job_submission_id,
+                start_after=cursor,
+                limit=page,
+            )
+            for event in data.logs:
+                await ws.send_bytes(base64.b64decode(event.message))
+            if data.next_token:
+                cursor = data.next_token
+            if len(data.logs) < page:
+                break
+        # Cursor checkpoint as a TEXT frame (binary = log payload): lets the
+        # client resume via poll/ws after a disconnect without duplication.
+        await ws.send_text(json.dumps({"next_token": cursor or ""}))
+        if finished or ws.closed:
+            return
+        # Ping probes for followers gone away on quiet jobs; the send error
+        # path flips ws.closed within a round or two.
+        await ws.ping()
+        await asyncio.sleep(0.5)

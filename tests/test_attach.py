@@ -1,0 +1,125 @@
+"""Attach plumbing: managed SSH config blocks, target/forward planning.
+
+Parity: reference core/services/ssh/attach.py tests — config text managed
+between per-run markers, never clobbering user entries.
+"""
+
+from pathlib import Path
+
+from dstack_tpu.api.attach import (
+    attach_target,
+    plan_port_forwards,
+    ssh_config_block,
+    update_ssh_config,
+)
+from dstack_tpu.models.runs import Run as RunDTO
+
+
+def test_ssh_config_block_render():
+    block = ssh_config_block(
+        "myrun", "34.1.2.3", "tpuuser", 22, "/home/u/.dstack-tpu/ssh/id_ed25519",
+        proxy_jump="jump@10.0.0.1:2222",
+    )
+    assert "Host myrun\n" in block
+    assert "    HostName 34.1.2.3" in block
+    assert "    User tpuuser" in block
+    assert "    IdentityFile /home/u/.dstack-tpu/ssh/id_ed25519" in block
+    assert "    ProxyJump jump@10.0.0.1:2222" in block
+    assert block.startswith("# >>> dstack-tpu myrun >>>")
+    assert block.rstrip().endswith("# <<< dstack-tpu myrun <<<")
+
+
+def test_update_ssh_config_add_replace_remove(tmp_path):
+    cfg = tmp_path / "config"
+    cfg.write_text("Host personal\n    HostName example.com\n")
+
+    update_ssh_config(cfg, "run-a", ssh_config_block("run-a", "1.1.1.1", "root", 22, None))
+    update_ssh_config(cfg, "run-b", ssh_config_block("run-b", "2.2.2.2", "root", 22, None))
+    text = cfg.read_text()
+    assert "Host personal" in text  # user entries untouched
+    assert "1.1.1.1" in text and "2.2.2.2" in text
+
+    # Replace run-a with a new address: old block fully gone.
+    update_ssh_config(cfg, "run-a", ssh_config_block("run-a", "9.9.9.9", "root", 22, None))
+    text = cfg.read_text()
+    assert "9.9.9.9" in text and "1.1.1.1" not in text
+    assert text.count("Host run-a") == 1
+
+    # Remove both; user entry survives alone.
+    update_ssh_config(cfg, "run-a", None)
+    update_ssh_config(cfg, "run-b", None)
+    text = cfg.read_text()
+    assert "Host personal" in text
+    assert "run-a" not in text and "run-b" not in text
+    assert (cfg.stat().st_mode & 0o777) == 0o600
+
+
+def _run_dto(jpd_overrides=None, app_ports=(8000,)):
+    jpd = {
+        "backend": "gcp",
+        "instance_type": {"name": "v5litepod-4",
+                          "resources": {"cpus": 24, "memory_mib": 48000}},
+        "instance_id": "i-1",
+        "hostname": "34.5.6.7",
+        "region": "us-central1",
+        "username": "tpu",
+        "ssh_port": 22,
+    }
+    jpd.update(jpd_overrides or {})
+    return RunDTO.model_validate({
+        "id": "r1",
+        "project_name": "main",
+        "user": "admin",
+        "submitted_at": "2026-07-29T00:00:00Z",
+        "last_processed_at": "2026-07-29T00:00:00Z",
+        "status": "running",
+        "run_spec": {
+            "run_name": "myrun",
+            "configuration": {"type": "task", "commands": ["sleep 1"]},
+            "ssh_key_pub": "k",
+        },
+        "jobs": [{
+            "job_spec": {
+                "job_name": "myrun-0-0",
+                "requirements": {"resources": {}},
+                "app_specs": [
+                    {"port": p, "app_name": f"app-{i}"}
+                    for i, p in enumerate(app_ports)
+                ],
+            },
+            "job_submissions": [{
+                "id": "sub1",
+                "submitted_at": "2026-07-29T00:00:00Z",
+                "last_processed_at": "2026-07-29T00:00:00Z",
+                "status": "running",
+                "job_provisioning_data": jpd,
+            }],
+        }],
+    })
+
+
+def test_attach_target_and_forwards():
+    run = _run_dto()
+    target = attach_target(run, "/id")
+    assert target is not None
+    assert target.hostname == "34.5.6.7"
+    assert target.username == "tpu"
+    forwards = plan_port_forwards(run)
+    assert len(forwards) == 1
+    assert forwards[0].remote_port == 8000
+    assert forwards[0].local_port > 0
+
+
+def test_attach_target_none_without_host():
+    run = _run_dto(jpd_overrides={"hostname": None})
+    assert attach_target(run, None) is None
+
+
+def test_attach_target_with_proxy():
+    run = _run_dto(jpd_overrides={
+        "ssh_proxy": {"hostname": "10.0.0.9", "username": "jump", "port": 2222}
+    })
+    target = attach_target(run, None)
+    assert target is not None and target.proxy is not None
+    assert target.proxy.hostname == "10.0.0.9"
+    assert target.proxy.port == 2222
