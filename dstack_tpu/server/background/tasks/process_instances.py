@@ -1,8 +1,10 @@
 """Instance FSM processor.
 
-Parity: src/dstack/_internal/server/background/tasks/process_instances.py
-(PENDING→provision for fleets, health checks :608+, idle-timeout :192-207,
-termination deadlines). Cloud terminate calls happen here, off the job path.
+Parity: src/dstack/_internal/server/background/tasks/process_instances.py —
+PENDING→provision for fleets, shim health checks with an
+unreachable→terminate deadline (ref :608+), idle-timeout termination
+(ref :192-207) measured from a dedicated `idle_since` timestamp, and a
+provisioning deadline for instances that never come up.
 """
 
 import json
@@ -43,9 +45,12 @@ async def _process_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
     if status == InstanceStatus.TERMINATING:
         await _terminate(ctx, row)
     elif status == InstanceStatus.PENDING:
+        await _check_provisioning_deadline(ctx, row)
         await _provision_fleet_instance(ctx, row)
-    elif status == InstanceStatus.IDLE:
-        await _check_idle_timeout(ctx, row)
+    elif status in (InstanceStatus.IDLE, InstanceStatus.BUSY):
+        terminated = await _healthcheck(ctx, row)
+        if not terminated and status == InstanceStatus.IDLE:
+            await _check_idle_timeout(ctx, row)
     await ctx.db.execute(
         "UPDATE instances SET last_processed_at = ? WHERE id = ?",
         (utcnow_iso(), row["id"]),
@@ -80,6 +85,12 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
 
 
 async def _check_idle_timeout(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """Terminate fleet instances idle longer than the profile allows.
+
+    Idleness is measured from `idle_since` (set when the instance becomes
+    idle, cleared on assignment) — NOT last_processed_at, which this very
+    processor rewrites every tick.
+    """
     idle_duration = DEFAULT_FLEET_IDLE_DURATION
     if row["profile"]:
         profile = json.loads(row["profile"])
@@ -88,22 +99,101 @@ async def _check_idle_timeout(ctx: ServerContext, row: sqlite3.Row) -> None:
             idle_duration = int(v)
     if idle_duration < 0:  # "off"
         return
-    started = parse_dt(row["last_processed_at"]) or parse_dt(row["created_at"])
+    started = (
+        parse_dt(row["idle_since"])
+        or parse_dt(row["started_at"])
+        or parse_dt(row["created_at"])
+    )
+    if started is None:
+        return
     if (utcnow() - started).total_seconds() > idle_duration:
         await ctx.db.execute(
             "UPDATE instances SET status = 'terminating', termination_reason = ?"
             " WHERE id = ?",
             ("idle timeout", row["id"]),
         )
+        logger.info("instance %s idle for > %ss; terminating", row["name"], idle_duration)
         ctx.kick("instances")
 
 
-async def _provision_fleet_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
-    """PENDING fleet instances: cloud-create or (for SSH fleets) deploy shim.
+async def _check_provisioning_deadline(ctx: ServerContext, row: sqlite3.Row) -> None:
+    """PENDING instances that never provision get reaped (ref :103-107)."""
+    created = parse_dt(row["created_at"])
+    if created is None:
+        return
+    if (utcnow() - created).total_seconds() > settings.INSTANCE_PROVISIONING_TIMEOUT:
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'terminating', termination_reason = ?"
+            " WHERE id = ?",
+            ("provisioning timeout", row["id"]),
+        )
+        ctx.kick("instances")
 
-    SSH-host deployment lives in services/fleets.py; cloud fleet instances
-    are provisioned here from the stored requirements/profile.
+
+async def _healthcheck(ctx: ServerContext, row: sqlite3.Row) -> bool:
+    """Probe the host agent; unreachable hosts get a termination deadline.
+
+    Parity: reference healthchecks the shim over the SSH tunnel every tick
+    and terminates after ~20 min unreachable (process_instances.py:608+).
+    Returns True when the instance was transitioned to terminating.
     """
-    from dstack_tpu.server.services import fleets as fleets_service
+    if not row["job_provisioning_data"]:
+        return False
+    jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+    healthy, detail = await _probe(ctx, row, jpd)
+    now = utcnow_iso()
+    if healthy:
+        await ctx.db.execute(
+            "UPDATE instances SET unreachable = 0, unreachable_since = NULL,"
+            " health_status = 'healthy' WHERE id = ?",
+            (row["id"],),
+        )
+        return False
+    unreachable_since = parse_dt(row["unreachable_since"]) or utcnow()
+    await ctx.db.execute(
+        "UPDATE instances SET unreachable = 1, unreachable_since = ?,"
+        " health_status = ? WHERE id = ?",
+        (
+            row["unreachable_since"] or now,
+            (detail or "unreachable")[:200],
+            row["id"],
+        ),
+    )
+    deadline = settings.INSTANCE_UNREACHABLE_DEADLINE
+    if (utcnow() - unreachable_since).total_seconds() > deadline:
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'terminating', termination_reason = ?"
+            " WHERE id = ?",
+            (f"unreachable for > {deadline}s", row["id"]),
+        )
+        logger.warning("instance %s unreachable past deadline; terminating", row["name"])
+        ctx.kick("instances")
+        return True
+    return False
 
-    await fleets_service.provision_pending_instance(ctx, row)
+
+async def _probe(ctx: ServerContext, row: sqlite3.Row, jpd: JobProvisioningData):
+    """(healthy, detail). Tests inject `instance_health_client`; the local
+    backend has no persistent agent to probe (runners are per-job), so it
+    reports healthy."""
+    probe = ctx.overrides.get("instance_health_client")
+    if probe is not None:
+        return await probe(row, jpd)
+    if jpd.backend == BackendType.LOCAL:
+        return True, None
+    from dstack_tpu.server.services.connections import get_connection_pool
+
+    try:
+        conn = await get_connection_pool(ctx).get(ctx, row["id"], jpd)
+        if jpd.dockerized and conn.shim_url:
+            client = conn.shim_client()
+            health = await client.healthcheck()
+        else:
+            client = conn.runner_client()
+            health = await client.healthcheck()
+        await client.close()
+        if health is None:
+            return False, "healthcheck failed"
+        return True, None
+    except Exception as e:  # tunnel failures etc.
+        return False, str(e)

@@ -458,9 +458,9 @@ async def _release_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
     autocreated = bool(fleet_row["auto_cleanup"]) if fleet_row else True
     if reusable and not autocreated:
         await ctx.db.execute(
-            "UPDATE instances SET status = 'idle', busy_blocks = 0, last_processed_at = ?"
-            " WHERE id = ?",
-            (utcnow_iso(), irow["id"]),
+            "UPDATE instances SET status = 'idle', busy_blocks = 0, idle_since = ?,"
+            " last_processed_at = ? WHERE id = ?",
+            (utcnow_iso(), utcnow_iso(), irow["id"]),
         )
     else:
         await ctx.db.execute(

@@ -249,7 +249,7 @@ async def _assign_jobs_to_instances(
         jpd = irow["job_provisioning_data"]
         await ctx.db.execute(
             "UPDATE instances SET status = 'busy', busy_blocks = total_blocks,"
-            " last_processed_at = ? WHERE id = ?",
+            " idle_since = NULL, last_processed_at = ? WHERE id = ?",
             (now, irow["id"]),
         )
         await ctx.db.execute(

@@ -265,3 +265,14 @@ migration(
 ALTER TABLE runs ADD COLUMN last_scaled_at TEXT;
 """
 )
+
+# Migration 3: instance lifecycle — idleness measured from a dedicated
+# timestamp (last_processed_at is rewritten every FSM tick, so measuring
+# idleness from it kept every instance "fresh" forever), and unreachable
+# tracking for shim health checks.
+migration(
+    """
+ALTER TABLE instances ADD COLUMN idle_since TEXT;
+ALTER TABLE instances ADD COLUMN unreachable_since TEXT;
+"""
+)

@@ -215,24 +215,27 @@ async def provision_pending_instance(ctx: ServerContext, row: sqlite3.Row) -> No
                     "UPDATE instances SET status = ?, backend = ?, region = ?,"
                     " availability_zone = ?, price = ?, offer = ?,"
                     " job_provisioning_data = ?, tpu_node = ?, tpu_worker_index = 0,"
-                    " started_at = ?, last_processed_at = ? WHERE id = ?",
+                    " started_at = ?, idle_since = ?, last_processed_at = ?"
+                    " WHERE id = ?",
                     (
                         InstanceStatus.IDLE.value, jpd.backend.value, jpd.region,
                         jpd.availability_zone, jpd.price, offer.model_dump_json(),
-                        jpd.model_dump_json(), jpd.tpu_node_id, now, now, row["id"],
+                        jpd.model_dump_json(), jpd.tpu_node_id, now, now, now,
+                        row["id"],
                     ),
                 )
             else:
                 await ctx.db.execute(
                     "INSERT INTO instances (id, project_id, fleet_id, name,"
-                    " instance_num, status, created_at, started_at, last_processed_at,"
-                    " backend, region, availability_zone, price, offer,"
-                    " job_provisioning_data, tpu_node, tpu_worker_index)"
-                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    " instance_num, status, created_at, started_at, idle_since,"
+                    " last_processed_at, backend, region, availability_zone, price,"
+                    " offer, job_provisioning_data, tpu_node, tpu_worker_index)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (
                         generate_id(), row["project_id"], row["fleet_id"],
                         f"{row['name']}-w{worker}", row["instance_num"] * 1000 + worker,
-                        InstanceStatus.IDLE.value, now, now, now, jpd.backend.value,
+                        InstanceStatus.IDLE.value, now, now, now, now,
+                        jpd.backend.value,
                         jpd.region, jpd.availability_zone, jpd.price,
                         offer.model_dump_json(), jpd.model_dump_json(),
                         jpd.tpu_node_id, jpd.tpu_worker_index,

@@ -169,13 +169,14 @@ async def deploy_ssh_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
     )
     await ctx.db.execute(
         "UPDATE instances SET status = ?, backend = ?, region = 'remote', price = 0,"
-        " offer = ?, job_provisioning_data = ?, started_at = ?, last_processed_at = ?"
-        " WHERE id = ?",
+        " offer = ?, job_provisioning_data = ?, started_at = ?, idle_since = ?,"
+        " last_processed_at = ? WHERE id = ?",
         (
             InstanceStatus.IDLE.value,
             BackendType.SSH.value,
             offer.model_dump_json(),
             jpd.model_dump_json(),
+            utcnow_iso(),
             utcnow_iso(),
             utcnow_iso(),
             row["id"],
