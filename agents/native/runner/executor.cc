@@ -14,6 +14,7 @@
 
 #include "../common/util.hpp"
 #include "cluster_env.hpp"
+#include "repo.hpp"
 
 namespace dstack {
 
@@ -116,13 +117,14 @@ void Executor::exec_thread() {
   std::string workdir = working_root_.empty() ? "/workflow" : working_root_;
   mkdir(workdir.c_str(), 0755);
 
-  if (!code_path_.empty()) {
-    struct stat st;
-    if (stat(code_path_.c_str(), &st) == 0 && st.st_size > 0) {
-      std::string out;
-      int rc = run_command({"tar", "-xf", code_path_, "-C", workdir}, &out);
-      if (rc != 0) log_runner("Failed to extract code archive: " + out);
-    }
+  // Repo manager: git clone + diff apply (remote) or tar unpack (local).
+  // A failure fails the job — never silently run in an empty workdir.
+  std::string repo_error;
+  if (!setup_repo(workdir, submission_, code_path_,
+                  [this](const std::string& m) { log_runner(m); }, &repo_error)) {
+    log_runner("Repo setup failed: " + repo_error);
+    set_state("failed", "executor_error", repo_error);
+    return;
   }
   if (!spec["working_dir"].as_string().empty()) {
     workdir += "/" + spec["working_dir"].as_string();

@@ -1,0 +1,157 @@
+#include "repo.hpp"
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include "../common/util.hpp"
+
+namespace dstack {
+
+namespace {
+
+constexpr int kGitTimeoutSeconds = 300;
+
+// git under `env` so GIT_TERMINAL_PROMPT / GIT_SSH_COMMAND apply without
+// mutating this multithreaded process's environment.
+int run_git(const std::string& workdir, const std::vector<std::string>& args,
+            const std::string& ssh_command, std::string* output) {
+  std::vector<std::string> argv = {"env", "GIT_TERMINAL_PROMPT=0"};
+  if (!ssh_command.empty()) argv.push_back("GIT_SSH_COMMAND=" + ssh_command);
+  argv.push_back("git");
+  argv.push_back("-C");
+  argv.push_back(workdir);
+  for (const auto& a : args) argv.push_back(a);
+  return run_command(argv, output, kGitTimeoutSeconds);
+}
+
+bool setup_remote(const std::string& workdir, const Json& repo_data,
+                  const Json& repo_creds, const std::string& code_path,
+                  const std::function<void(const std::string&)>& log,
+                  std::string* error) {
+  std::string hash = repo_data["repo_hash"].as_string();
+  if (hash.empty()) {
+    *error = "Remote repo submission is missing repo_hash";
+    return false;
+  }
+  std::string url = repo_clone_url(repo_data, repo_creds);
+
+  std::string key_path, ssh_command;
+  if (repo_creds.is_object() && !repo_creds["private_key"].as_string().empty()) {
+    char tmpl[] = "/tmp/dstack-git-key-XXXXXX";
+    int fd = mkstemp(tmpl);
+    if (fd < 0) {
+      *error = std::string("mkstemp for git key: ") + strerror(errno);
+      return false;
+    }
+    const std::string& key = repo_creds["private_key"].as_string();
+    size_t off = 0;
+    while (off < key.size()) {
+      ssize_t n = write(fd, key.data() + off, key.size() - off);
+      if (n <= 0) break;
+      off += n;
+    }
+    close(fd);
+    chmod(tmpl, 0600);
+    key_path = tmpl;
+    ssh_command = "ssh -i " + key_path +
+                  " -o IdentitiesOnly=yes -o StrictHostKeyChecking=no"
+                  " -o UserKnownHostsFile=/dev/null";
+  }
+  auto cleanup_key = [&] {
+    if (!key_path.empty()) unlink(key_path.c_str());
+  };
+
+  mkdir(workdir.c_str(), 0755);
+  log("Cloning " + repo_data["repo_name"].as_string() + " @ " + hash.substr(0, 12));
+  std::string out;
+  if (run_git(workdir, {"init", "-q"}, ssh_command, &out) != 0) {
+    *error = "git init failed: " + out;
+    cleanup_key();
+    return false;
+  }
+  if (run_git(workdir, {"remote", "add", "origin", url}, ssh_command, &out) != 0) {
+    *error = "git remote add failed: " + out;
+    cleanup_key();
+    return false;
+  }
+  // Depth-1 fetch of the exact commit first (fast on hosted remotes); full
+  // fetch as fallback (plain-path remotes refuse SHA fetches).
+  if (run_git(workdir, {"fetch", "-q", "--depth", "1", "origin", hash},
+              ssh_command, &out) != 0) {
+    if (run_git(workdir, {"fetch", "-q", "origin"}, ssh_command, &out) != 0) {
+      *error = "git fetch failed: " + out;
+      cleanup_key();
+      return false;
+    }
+  }
+  if (run_git(workdir, {"checkout", "-q", "--force", hash}, ssh_command, &out) != 0) {
+    *error = "git checkout " + hash.substr(0, 12) + " failed: " + out;
+    cleanup_key();
+    return false;
+  }
+  cleanup_key();
+
+  // The code blob for remote repos is the user's uncommitted diff.
+  struct stat st;
+  if (!code_path.empty() && stat(code_path.c_str(), &st) == 0 && st.st_size > 0) {
+    // git apply rejects a patch missing its final newline ("corrupt patch")
+    // — transports may strip it, so normalize before applying.
+    if (auto patch = read_file(code_path)) {
+      if (!patch->empty() && patch->back() != '\n')
+        write_file(code_path, *patch + "\n");
+    }
+    if (run_git(workdir, {"apply", "--whitespace=nowarn", code_path}, "", &out) != 0) {
+      *error = "git apply of uploaded diff failed: " + out;
+      return false;
+    }
+    log("Applied uncommitted diff on top of the checkout");
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string repo_clone_url(const Json& repo_data, const Json& repo_creds) {
+  std::string url;
+  if (repo_creds.is_object()) url = repo_creds["clone_url"].as_string();
+  if (url.empty()) {
+    url = "https://" + repo_data["repo_host_name"].as_string();
+    if (!repo_data["repo_port"].is_null() && repo_data["repo_port"].as_int(0) > 0)
+      url += ":" + std::to_string(repo_data["repo_port"].as_int());
+    url += "/" + repo_data["repo_user_name"].as_string() + "/" +
+           repo_data["repo_name"].as_string();
+  }
+  const std::string https = "https://";
+  if (repo_creds.is_object() && !repo_creds["oauth_token"].as_string().empty() &&
+      starts_with(url, https)) {
+    url = https + "oauth2:" + repo_creds["oauth_token"].as_string() + "@" +
+          url.substr(https.size());
+  }
+  return url;
+}
+
+bool setup_repo(const std::string& workdir, const Json& submission,
+                const std::string& code_path,
+                const std::function<void(const std::string&)>& log,
+                std::string* error) {
+  const Json& repo_data = submission["repo_data"];
+  if (repo_data.is_object() && repo_data["repo_type"].as_string() == "remote") {
+    return setup_remote(workdir, repo_data, submission["repo_creds"], code_path,
+                        log, error);
+  }
+  struct stat st;
+  if (!code_path.empty() && stat(code_path.c_str(), &st) == 0 && st.st_size > 0) {
+    std::string out;
+    if (run_command({"tar", "-xf", code_path, "-C", workdir}, &out) != 0) {
+      *error = "failed to extract code archive: " + out;
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace dstack

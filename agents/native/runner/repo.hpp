@@ -1,0 +1,26 @@
+// Runner-side repo manager: materialize the job's code into the workdir.
+// Parity: runner/internal/repo/manager.go + diff.go — remote repos are
+// git-cloned at the pinned commit and the uploaded diff applied on top;
+// local repos arrive as a tar blob and are unpacked. Mirrors the Python
+// implementation in dstack_tpu/agents/repo.py (one behavior, two agents).
+#pragma once
+
+#include <functional>
+#include <string>
+
+#include "../common/json.hpp"
+
+namespace dstack {
+
+// Returns false and fills *error on failure — the executor must fail the
+// job (executor_error), never silently run in an empty workdir.
+bool setup_repo(const std::string& workdir, const Json& submission,
+                const std::string& code_path,
+                const std::function<void(const std::string&)>& log,
+                std::string* error);
+
+// Exposed for tests: the clone URL with creds applied (oauth token spliced
+// into https URLs the way git credential helpers would present it).
+std::string repo_clone_url(const Json& repo_data, const Json& repo_creds);
+
+}  // namespace dstack

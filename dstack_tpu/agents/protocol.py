@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 from dstack_tpu.models.common import CoreModel
 from dstack_tpu.models.metrics import MetricsPoint
+from dstack_tpu.models.repos import AnyRunRepoData, RemoteRepoCreds
 from dstack_tpu.models.runs import ClusterInfo, JobSpec, JobStatus, JobTerminationReason
 
 RUNNER_PORT = 10999
@@ -46,6 +47,11 @@ class SubmitBody(CoreModel):
     node_rank: int = 0
     secrets: Dict[str, str] = {}
     repo_archive: bool = False  # expect /api/upload_code before /api/run
+    # Remote repos: the runner git-clones repo_data.repo_hash with repo_creds
+    # and applies the uploaded blob as a diff; local repos untar the blob.
+    # Parity: runner/internal/repo/manager.go.
+    repo_data: Optional[AnyRunRepoData] = None
+    repo_creds: Optional[RemoteRepoCreds] = None
     working_dir_root: str = "/workflow"
 
 

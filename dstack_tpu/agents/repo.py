@@ -1,0 +1,165 @@
+"""Runner-side repo manager: materialize the job's code into the workdir.
+
+Parity: runner/internal/repo/manager.go + diff.go (Go) — remote repos are
+git-cloned at the pinned commit and the uploaded diff is applied on top;
+local repos arrive as a tar blob and are unpacked. Used by both the Python
+runner (dstack_tpu/agents/runner.py) and mirrored by the C++ runner
+(agents/native/runner/repo.cc) — one behavior, two implementations.
+
+Unlike the round-2 code path, failures here are LOUD: a clone or diff-apply
+error raises RepoError and the executor fails the job with executor_error —
+a run must never silently execute in an empty workdir.
+"""
+
+import os
+import stat
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Callable, List, Optional
+
+from dstack_tpu.models.repos import RemoteRepoCreds, RemoteRunRepoData
+
+GIT_TIMEOUT_SECONDS = 300
+
+
+class RepoError(Exception):
+    """Raised when the job's code cannot be materialized; fails the job."""
+
+
+def _run_git(
+    args: List[str],
+    cwd: Path,
+    env: Optional[dict] = None,
+    timeout: int = GIT_TIMEOUT_SECONDS,
+) -> subprocess.CompletedProcess:
+    full_env = dict(os.environ)
+    # Never block on interactive credential prompts inside a container.
+    full_env["GIT_TERMINAL_PROMPT"] = "0"
+    if env:
+        full_env.update(env)
+    try:
+        return subprocess.run(
+            ["git", *args],
+            cwd=str(cwd),
+            env=full_env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except FileNotFoundError:
+        raise RepoError("git is not installed in the job image")
+    except subprocess.TimeoutExpired:
+        raise RepoError(f"git {' '.join(args[:2])} timed out after {timeout}s")
+
+
+def clone_url_with_creds(
+    repo_data: RemoteRunRepoData, creds: Optional[RemoteRepoCreds]
+) -> str:
+    """The URL to clone from: creds carry the user's actual origin URL
+    (may be ssh/file/local-path); fall back to the https URL derived from
+    host/user/name. An oauth token is spliced into https URLs the way git
+    credential helpers would present it."""
+    url = (creds.clone_url if creds and creds.clone_url else None) or repo_data.make_url()
+    if creds and creds.oauth_token and url.startswith("https://"):
+        url = "https://oauth2:" + creds.oauth_token + "@" + url[len("https://"):]
+    return url
+
+
+def redact_url(url: str) -> str:
+    """Strip userinfo (tokens) before a URL reaches user-visible logs."""
+    scheme, sep, rest = url.partition("://")
+    if sep and "@" in rest:
+        rest = rest.rsplit("@", 1)[1]
+    return scheme + sep + rest
+
+
+def setup_remote_repo(
+    workdir: Path,
+    repo_data: RemoteRunRepoData,
+    creds: Optional[RemoteRepoCreds],
+    diff_blob: Optional[bytes],
+    log: Callable[[str], None],
+) -> None:
+    """Clone the repo at repo_hash into workdir and apply the uploaded diff.
+
+    Fetch strategy: try a depth-1 fetch of the exact commit first (fast on
+    hosted remotes that allow reachable-SHA-in-want); fall back to a full
+    fetch of all branches (always works, required for plain-path remotes
+    that refuse SHA fetches).
+    """
+    if not repo_data.repo_hash:
+        raise RepoError("Remote repo submission is missing repo_hash")
+    url = clone_url_with_creds(repo_data, creds)
+    git_env = {}
+    key_path: Optional[str] = None
+    try:
+        if creds and creds.private_key:
+            fd, key_path = tempfile.mkstemp(prefix="dstack-git-key-")
+            with os.fdopen(fd, "w") as f:
+                f.write(creds.private_key)
+            os.chmod(key_path, stat.S_IRUSR | stat.S_IWUSR)
+            git_env["GIT_SSH_COMMAND"] = (
+                f"ssh -i {key_path} -o IdentitiesOnly=yes "
+                "-o StrictHostKeyChecking=no -o UserKnownHostsFile=/dev/null"
+            )
+        workdir.mkdir(parents=True, exist_ok=True)
+        log(
+            f"Cloning {repo_data.repo_name or redact_url(url)}"
+            f" @ {repo_data.repo_hash[:12]}"
+        )
+        for args in (["init", "-q"], ["remote", "add", "origin", url]):
+            r = _run_git(args, workdir, git_env)
+            if r.returncode != 0:
+                raise RepoError(f"git {args[0]} failed: {r.stderr.strip()}")
+        r = _run_git(
+            ["fetch", "-q", "--depth", "1", "origin", repo_data.repo_hash],
+            workdir, git_env,
+        )
+        if r.returncode != 0:
+            r = _run_git(["fetch", "-q", "origin"], workdir, git_env)
+            if r.returncode != 0:
+                raise RepoError(f"git fetch failed: {r.stderr.strip()}")
+        r = _run_git(
+            ["checkout", "-q", "--force", repo_data.repo_hash], workdir, git_env
+        )
+        if r.returncode != 0:
+            raise RepoError(
+                f"git checkout {repo_data.repo_hash[:12]} failed: {r.stderr.strip()}"
+            )
+    finally:
+        if key_path is not None:
+            try:
+                os.unlink(key_path)
+            except OSError:
+                pass
+
+    if diff_blob:
+        apply_diff(workdir, diff_blob, log)
+
+
+def apply_diff(workdir: Path, diff_blob: bytes, log: Callable[[str], None]) -> None:
+    """Apply the user's uncommitted changes (uploaded as the code blob for
+    remote repos) on top of the checkout. Parity: repo/diff.go.
+
+    The patch bytes are written VERBATIM: git apply needs the trailing
+    newline and the blank lines terminating binary base85 blocks, so any
+    strip/normalize here corrupts binary patches.
+    """
+    if not diff_blob.strip():
+        return
+    with tempfile.NamedTemporaryFile(
+        mode="wb", suffix=".patch", prefix="dstack-diff-", delete=False
+    ) as f:
+        f.write(diff_blob)
+        patch_path = f.name
+    try:
+        r = _run_git(["apply", "--whitespace=nowarn", patch_path], workdir)
+        if r.returncode != 0:
+            raise RepoError(f"git apply of uploaded diff failed: {r.stderr.strip()}")
+        log("Applied uncommitted diff on top of the checkout")
+    finally:
+        try:
+            os.unlink(patch_path)
+        except OSError:
+            pass

@@ -13,6 +13,7 @@ Run: python -m dstack_tpu.agents.runner --port 10999 [--host 127.0.0.1]
 import argparse
 import asyncio
 import base64
+import functools
 import os
 import signal
 import sys
@@ -20,6 +21,8 @@ import tempfile
 import time
 from pathlib import Path
 from typing import Dict, List, Optional
+
+from dstack_tpu.agents.repo import RepoError, setup_remote_repo
 
 from dstack_tpu.agents.protocol import (
     HealthcheckResponse,
@@ -130,8 +133,12 @@ class Executor:
         sub = self.submission
         workdir = Path(self.working_root or tempfile.mkdtemp(prefix="dstack-job-"))
         workdir.mkdir(parents=True, exist_ok=True)
-        if self.code_path is not None:
-            await self._extract_code(workdir)
+        try:
+            await self._setup_repo(workdir)
+        except RepoError as e:
+            self.log_runner(f"Repo setup failed: {e}")
+            self.set_state(JobStatus.FAILED, JobTerminationReason.EXECUTOR_ERROR, str(e))
+            return
         if sub.job_spec.working_dir:
             workdir = workdir / sub.job_spec.working_dir
             workdir.mkdir(parents=True, exist_ok=True)
@@ -161,17 +168,41 @@ class Executor:
                 )
             )
 
-    async def _extract_code(self, workdir: Path) -> None:
+    async def _setup_repo(self, workdir: Path) -> None:
+        """Materialize the job's code: git clone + diff apply for remote
+        repos, tar unpack for local ones. Runs in a thread — git can take a
+        while and must not stall the event loop (pull/ws handlers)."""
+        assert self.submission is not None
+        repo_data = self.submission.repo_data
+        has_code = (
+            self.code_path is not None and self.code_path.stat().st_size > 0
+        )
+        if repo_data is not None and repo_data.repo_type == "remote":
+            # Only the remote path needs the blob in memory (it's the diff,
+            # small); local tars stream straight from disk in _extract_tar.
+            blob = self.code_path.read_bytes() if has_code else None
+            await asyncio.get_event_loop().run_in_executor(
+                None,
+                functools.partial(
+                    setup_remote_repo,
+                    workdir, repo_data, self.submission.repo_creds, blob,
+                    self.log_runner,
+                ),
+            )
+        elif has_code:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._extract_tar, workdir
+            )
+
+    def _extract_tar(self, workdir: Path) -> None:
         import tarfile
 
         assert self.code_path is not None
-        if self.code_path.stat().st_size == 0:
-            return
         try:
             with tarfile.open(self.code_path) as tar:
                 tar.extractall(workdir, filter="data")
         except tarfile.TarError as e:
-            self.log_runner(f"Failed to extract code archive: {e}")
+            raise RepoError(f"failed to extract code archive: {e}")
 
     async def _pump_output(self) -> None:
         assert self.proc is not None and self.proc.stdout is not None

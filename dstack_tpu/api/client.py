@@ -252,7 +252,7 @@ class RunCollection:
         self._client = client
         # Blobs packed at plan time, uploaded at exec time; per-instance and
         # superseded on re-plan so an abandoned plan can't leak 256 MiB tars.
-        self._pending_blobs: Dict[Any, bytes] = {}
+        self._pending_blobs: Dict[Any, Any] = {}  # (repo_id, hash) -> (blob, creds)
 
     def get_plan(
         self,
@@ -329,13 +329,14 @@ class RunCollection:
         if repo_dir is not None:
             remote = detect_remote_repo(repo_dir)
             if remote is not None:
-                repo_data, blob = remote
+                repo_data, repo_creds, blob = remote
             else:
                 repo_data, blob = pack_local_repo(repo_dir)
+                repo_creds = None
             spec.repo_data = repo_data
             spec.repo_id = repo_id_for_dir(repo_dir)
             spec.repo_code_hash = hashlib.sha256(blob).hexdigest()
-            self._pending_blobs[(spec.repo_id, spec.repo_code_hash)] = blob
+            self._pending_blobs[(spec.repo_id, spec.repo_code_hash)] = (blob, repo_creds)
             # Keyed by (repo, content hash) so concurrent plans coexist; cap
             # retained plans so abandoned ones can't pile up 256 MiB tars.
             while len(self._pending_blobs) > 4:
@@ -345,15 +346,24 @@ class RunCollection:
     def _upload_code(self, run_spec: RunSpec, repo_dir: Optional[str]) -> None:
         if run_spec.repo_id is None:
             return
-        blob = self._pending_blobs.pop((run_spec.repo_id, run_spec.repo_code_hash), None)
-        if blob is None:
-            if repo_dir is None:
-                return
+        pending = self._pending_blobs.pop(
+            (run_spec.repo_id, run_spec.repo_code_hash), None
+        )
+        if pending is not None:
+            blob, creds = pending
+        elif repo_dir is not None:
             remote = detect_remote_repo(repo_dir)
-            _, blob = remote if remote is not None else pack_local_repo(repo_dir)
+            if remote is not None:
+                _, creds, blob = remote
+            else:
+                _, blob = pack_local_repo(repo_dir)
+                creds = None
+        else:
+            return
         self._client.api.repos.init(
             self._client.project, run_spec.repo_id,
             run_spec.repo_data.model_dump() if run_spec.repo_data else {"repo_type": "virtual"},
+            repo_creds=creds.model_dump() if creds is not None else None,
         )
         uploaded = self._client.api.repos.upload_code(
             self._client.project, run_spec.repo_id, blob

@@ -9,12 +9,13 @@ job working dir (agents/native/runner/executor.cc repo handling).
 import fnmatch
 import hashlib
 import io
+import os
 import subprocess
 import tarfile
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from dstack_tpu.models.repos import LocalRunRepoData, RemoteRunRepoData
+from dstack_tpu.models.repos import LocalRunRepoData, RemoteRepoCreds, RemoteRunRepoData
 
 # Always skipped regardless of .gitignore — build junk that would bloat the
 # blob or break unpacking (reference skips .git the same way).
@@ -84,10 +85,26 @@ def _git(root: Path, *args: str) -> Optional[str]:
     return out.stdout.strip() if out.returncode == 0 else None
 
 
-def detect_remote_repo(path: str) -> Optional[Tuple[RemoteRunRepoData, bytes]]:
+def _git_raw(root: Path, *args: str) -> Optional[bytes]:
+    """Byte-exact git output (no strip) — patch bytes must not be touched."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(root), *args], capture_output=True, timeout=30
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def detect_remote_repo(
+    path: str,
+) -> Optional[Tuple[RemoteRunRepoData, RemoteRepoCreds, bytes]]:
     """If `path` is a git checkout whose HEAD is fetchable from origin,
-    return repo data + the uncommitted diff as the code blob (reference:
-    diff tar upload, runner/internal/repo applies it after clone).
+    return repo data + clone creds + the uncommitted diff as the code blob
+    (reference: diff tar upload, runner/internal/repo applies it after
+    clone). The creds carry the user's actual origin URL (and a token from
+    DSTACK_GIT_TOKEN / GITHUB_TOKEN if set) so the runner clones exactly
+    what the user had, not a guessed https URL.
 
     Falls back to None (-> full local pack) when the clone-and-diff recipe
     would lose work: untracked files (git diff omits them) or local commits
@@ -103,11 +120,17 @@ def detect_remote_repo(path: str) -> Optional[Tuple[RemoteRunRepoData, bytes]]:
         line.startswith("??") for line in status.splitlines()
     ):
         return None  # untracked files would be silently dropped
-    remote_with_head = _git(root, "branch", "-r", "--contains", head)
-    if not remote_with_head:
-        return None  # HEAD not pushed; clone couldn't reach it
+    remote_with_head = _git(root, "branch", "-r", "--contains", head) or ""
+    if not any(
+        line.strip().startswith("origin/") for line in remote_with_head.splitlines()
+    ):
+        return None  # HEAD not on *origin* (a second remote doesn't help the clone)
     branch = _git(root, "rev-parse", "--abbrev-ref", "HEAD")
-    diff = _git(root, "diff", "HEAD") or ""
+    # --binary so modified tracked binaries survive the round-trip (a plain
+    # diff emits an unapplicable "Binary files differ" stub). Taken raw —
+    # git apply needs the trailing newline AND the blank line terminating
+    # base85 blocks, so the output must never be stripped.
+    diff = _git_raw(root, "diff", "--binary", "HEAD") or b""
     host, user, name = _parse_git_url(url)
     data = RemoteRunRepoData(
         repo_host_name=host,
@@ -117,7 +140,12 @@ def detect_remote_repo(path: str) -> Optional[Tuple[RemoteRunRepoData, bytes]]:
         repo_hash=head,
         repo_diff=None,  # carried as the code blob, not inline
     )
-    return data, diff.encode()
+    creds = RemoteRepoCreds(
+        clone_url=url,
+        oauth_token=os.environ.get("DSTACK_GIT_TOKEN")
+        or os.environ.get("GITHUB_TOKEN"),
+    )
+    return data, creds, diff
 
 
 def _parse_git_url(url: str) -> Tuple[str, str, str]:

@@ -241,10 +241,16 @@ class _Secrets(_Resource):
 
 
 class _Repos(_Resource):
-    def init(self, project: str, repo_id: str, repo_info: Dict[str, Any]) -> None:
+    def init(
+        self,
+        project: str,
+        repo_id: str,
+        repo_info: Dict[str, Any],
+        repo_creds: Optional[Dict[str, Any]] = None,
+    ) -> None:
         self._api.post(
             f"/api/project/{project}/repos/init",
-            {"repo_id": repo_id, "repo_info": repo_info},
+            {"repo_id": repo_id, "repo_info": repo_info, "repo_creds": repo_creds},
         )
 
     def get(self, project: str, repo_id: str) -> Dict[str, Any]:

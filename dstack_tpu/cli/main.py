@@ -103,8 +103,11 @@ def init(project: Optional[str]) -> None:
     remote = detect_remote_repo(cwd)
     repo_id = repo_id_for_dir(cwd)
     if remote is not None:
-        repo_data, _ = remote
-        client.api.repos.init(client.project, repo_id, repo_data.model_dump())
+        repo_data, repo_creds, _ = remote
+        client.api.repos.init(
+            client.project, repo_id, repo_data.model_dump(),
+            repo_creds=repo_creds.model_dump() if repo_creds else None,
+        )
         console.print(f"Initialized remote repo [bold]{repo_data.repo_name}[/] ({repo_id})")
     else:
         from dstack_tpu.models.repos import LocalRunRepoData

@@ -273,7 +273,7 @@ async def _submit_to_runner(
                 await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
                             "runner did not become ready in time")
             return
-        code_blob = await _get_code_blob(ctx, row)
+        code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
         await runner.submit_job(
             run_name=row["run_name"],
             job_spec=job_spec,
@@ -281,6 +281,8 @@ async def _submit_to_runner(
             node_rank=job_spec.job_num,
             secrets=secrets,
             has_code=code_blob is not None,
+            repo_data=repo_data,
+            repo_creds=repo_creds,
         )
         if code_blob is not None:
             await runner.upload_code(code_blob)
@@ -297,20 +299,46 @@ async def _submit_to_runner(
         await runner.close()
 
 
-async def _get_code_blob(ctx: ServerContext, row: sqlite3.Row) -> Optional[bytes]:
+async def _get_repo_payload(ctx: ServerContext, row: sqlite3.Row):
+    """The job's code payload: (code blob, repo data, repo creds). For remote
+    repos the blob is the uncommitted diff and repo_data/creds drive the
+    runner-side git clone (agents/repo.py); for local repos the blob is the
+    tar and repo_data is None-equivalent for the runner."""
     run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
     if run_row is None:
-        return None
+        return None, None, None
+    from pydantic import TypeAdapter
+
+    from dstack_tpu.models.repos import AnyRunRepoData, RemoteRepoCreds
     from dstack_tpu.models.runs import RunSpec
 
     run_spec = RunSpec.model_validate_json(run_row["run_spec"])
     if run_spec.repo_code_hash is None or run_row["repo_id"] is None:
-        return None
+        return None, None, None
     code_row = await ctx.db.fetchone(
         "SELECT blob FROM codes WHERE repo_id = ? AND blob_hash = ?",
         (run_row["repo_id"], run_spec.repo_code_hash),
     )
-    return code_row["blob"] if code_row else None
+    blob = code_row["blob"] if code_row else None
+    repo_data = repo_creds = None
+    repo_row = await ctx.db.fetchone(
+        "SELECT * FROM repos WHERE id = ?", (run_row["repo_id"],)
+    )
+    if repo_row is not None:
+        try:
+            repo_data = TypeAdapter(AnyRunRepoData).validate_json(repo_row["info"])
+        except ValueError:
+            logger.warning("repo %s has unparseable info; skipping", repo_row["name"])
+        if repo_row["creds"]:
+            # Broad catch: decrypt raises InvalidTag (NOT a ValueError) under
+            # a rotated key — degrade to creds-less clone, don't retry forever.
+            try:
+                repo_creds = RemoteRepoCreds.model_validate_json(
+                    ctx.encryption.decrypt(repo_row["creds"])
+                )
+            except Exception:
+                logger.warning("repo %s has undecryptable creds", repo_row["name"])
+    return blob, repo_data, repo_creds
 
 
 async def _pull_runner(ctx: ServerContext, row: sqlite3.Row) -> None:

@@ -7,7 +7,7 @@ from typing import Optional
 from pydantic import BaseModel
 
 from dstack_tpu.errors import ResourceNotExistsError
-from dstack_tpu.models.repos import AnyRunRepoData
+from dstack_tpu.models.repos import AnyRunRepoData, RemoteRepoCreds
 from dstack_tpu.server.http import Request, Router
 from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
 from dstack_tpu.server.security import generate_id
@@ -18,6 +18,9 @@ router = Router()
 class InitRepoRequest(BaseModel):
     repo_id: str
     repo_info: AnyRunRepoData
+    # Clone URL + token/key for the runner-side git clone of remote repos;
+    # stored encrypted at rest like secrets (parity: repo_creds table).
+    repo_creds: Optional[RemoteRepoCreds] = None
 
 
 class GetRepoRequest(BaseModel):
@@ -29,16 +32,24 @@ async def init_repo(request: Request, project_name: str):
     _, project_row = await auth_project_member(request, project_name)
     ctx = get_ctx(request)
     body = request.parse(InitRepoRequest)
+    creds = (
+        ctx.encryption.encrypt(body.repo_creds.model_dump_json())
+        if body.repo_creds is not None
+        else None
+    )
     await ctx.db.execute(
-        "INSERT INTO repos (id, project_id, name, type, info) VALUES (?, ?, ?, ?, ?)"
+        "INSERT INTO repos (id, project_id, name, type, info, creds)"
+        " VALUES (?, ?, ?, ?, ?, ?)"
         " ON CONFLICT (project_id, name) DO UPDATE SET info = excluded.info,"
-        " type = excluded.type",
+        " type = excluded.type,"
+        " creds = COALESCE(excluded.creds, repos.creds)",
         (
             generate_id(),
             project_row["id"],
             body.repo_id,
             body.repo_info.repo_type,
             body.repo_info.model_dump_json(),
+            creds,
         ),
     )
     return {}

@@ -57,6 +57,8 @@ class RunnerClient:
         node_rank: int,
         secrets: Dict[str, str],
         has_code: bool,
+        repo_data=None,
+        repo_creds=None,
     ) -> None:
         body = SubmitBody(
             run_name=run_name,
@@ -65,6 +67,8 @@ class RunnerClient:
             node_rank=node_rank,
             secrets=secrets,
             repo_archive=has_code,
+            repo_data=repo_data,
+            repo_creds=repo_creds,
         )
         await self._request(
             "POST", "/api/submit", content=body.model_dump_json(),

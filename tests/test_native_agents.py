@@ -193,6 +193,83 @@ class TestRunner:
         assert states[-1]["state"] == "done"
         assert "from-archive" in _logs_text(logs)
 
+    def _make_pushed_checkout(self, tmp_path):
+        def git(cwd, *args):
+            subprocess.run(
+                ["git", "-C", str(cwd), *args], capture_output=True, check=True
+            )
+
+        origin = tmp_path / "origin.git"
+        origin.mkdir()
+        git(origin, "init", "--bare", "-q")
+        checkout = tmp_path / "checkout"
+        subprocess.run(
+            ["git", "clone", "-q", str(origin), str(checkout)],
+            capture_output=True, check=True,
+        )
+        git(checkout, "config", "user.email", "t@t")
+        git(checkout, "config", "user.name", "t")
+        (checkout / "main.py").write_text("print('native-clone-works')\n")
+        git(checkout, "add", ".")
+        git(checkout, "commit", "-q", "-m", "initial")
+        git(checkout, "push", "-q", "origin", "HEAD")
+        head = subprocess.run(
+            ["git", "-C", str(checkout), "rev-parse", "HEAD"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        return origin, checkout, head
+
+    def test_remote_repo_clone(self, runner, tmp_path):
+        """The C++ runner git-clones remote repos at the pinned hash
+        (parity: repo/manager.go; VERDICT r2 #1)."""
+        origin, _, head = self._make_pushed_checkout(tmp_path)
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["cat main.py"]),
+              "repo_data": {"repo_type": "remote", "repo_name": "origin",
+                            "repo_hash": head},
+              "repo_creds": {"clone_url": str(origin)}})
+        _req("POST", f"{base}/run", {})
+        states, logs = _wait_done(runner)
+        assert states[-1]["state"] == "done"
+        assert "native-clone-works" in _logs_text(logs)
+
+    def test_remote_repo_diff_applied(self, runner, tmp_path):
+        origin, checkout, head = self._make_pushed_checkout(tmp_path)
+        (checkout / "main.py").write_text("print('native-diff-applied')\n")
+        diff = subprocess.run(
+            ["git", "-C", str(checkout), "diff", "HEAD"],
+            capture_output=True, text=True, check=True,
+        ).stdout.encode()
+        assert diff
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["cat main.py"]),
+              "repo_archive": True,
+              "repo_data": {"repo_type": "remote", "repo_name": "origin",
+                            "repo_hash": head},
+              "repo_creds": {"clone_url": str(origin)}})
+        _req("POST", f"{base}/upload_code", diff)
+        _req("POST", f"{base}/run", {})
+        states, logs = _wait_done(runner)
+        assert states[-1]["state"] == "done"
+        assert "native-diff-applied" in _logs_text(logs)
+
+    def test_remote_repo_clone_failure_fails_job(self, runner, tmp_path):
+        """A broken clone must FAIL the job, not silently run in an empty
+        workdir (the round-2 regression this feature closes)."""
+        base = f"http://127.0.0.1:{runner}/api"
+        _req("POST", f"{base}/submit",
+             {"run_name": "r", "job_spec": _job_spec(["echo should-not-run"]),
+              "repo_data": {"repo_type": "remote", "repo_name": "gone",
+                            "repo_hash": "0" * 40},
+              "repo_creds": {"clone_url": str(tmp_path / "does-not-exist")}})
+        _req("POST", f"{base}/run", {})
+        states, logs = _wait_done(runner, timeout=30)
+        assert states[-1]["state"] == "failed"
+        assert states[-1]["termination_reason"] == "executor_error"
+        assert "should-not-run" not in _logs_text(logs)
+
     def test_double_submit_rejected(self, runner):
         base = f"http://127.0.0.1:{runner}/api"
         _req("POST", f"{base}/submit", {"run_name": "r", "job_spec": _job_spec([])})
