@@ -117,6 +117,13 @@ void Executor::exec_thread() {
   std::string workdir = working_root_.empty() ? "/workflow" : working_root_;
   mkdir(workdir.c_str(), 0755);
 
+  // Volume mounts first (no-container path), then the repo manager.
+  std::string mount_error;
+  if (!setup_mounts(submission_, &mount_error)) {
+    log_runner("Volume mount failed: " + mount_error);
+    set_state("failed", "volume_error", mount_error);
+    return;
+  }
   // Repo manager: git clone + diff apply (remote) or tar unpack (local).
   // A failure fails the job — never silently run in an empty workdir.
   std::string repo_error;

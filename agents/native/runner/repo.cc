@@ -51,7 +51,12 @@ bool setup_remote(const std::string& workdir, const Json& repo_data,
     size_t off = 0;
     while (off < key.size()) {
       ssize_t n = write(fd, key.data() + off, key.size() - off);
-      if (n <= 0) break;
+      if (n <= 0) {
+        close(fd);
+        unlink(tmpl);
+        *error = std::string("writing git key failed: ") + strerror(errno);
+        return false;
+      }
       off += n;
     }
     close(fd);
@@ -132,6 +137,47 @@ std::string repo_clone_url(const Json& repo_data, const Json& repo_creds) {
           url.substr(https.size());
   }
   return url;
+}
+
+bool setup_mounts(const Json& submission, std::string* error) {
+  for (const auto& m : submission["mounts"].as_array()) {
+    std::string target = m["path"].as_string();
+    std::string source = m["device_name"].as_string();
+    if (source.empty()) source = m["instance_path"].as_string();
+    if (source.empty()) {
+      *error = "Mount " + target + " has no host source";
+      return false;
+    }
+    // Source dir + target parents on demand (mirrors the Python twin).
+    std::string partial;
+    for (const auto& part : split(source, '/')) {
+      if (part.empty()) continue;
+      partial += "/" + part;
+      mkdir(partial.c_str(), 0755);
+    }
+    auto slash = target.rfind('/');
+    if (slash != std::string::npos && slash > 0) {
+      partial.clear();
+      for (const auto& part : split(target.substr(0, slash), '/')) {
+        if (part.empty()) continue;
+        partial += "/" + part;
+        mkdir(partial.c_str(), 0755);
+      }
+    }
+    struct stat st;
+    if (lstat(target.c_str(), &st) == 0) {
+      char buf[4096];
+      ssize_t n = readlink(target.c_str(), buf, sizeof(buf) - 1);
+      if (n > 0 && std::string(buf, n) == source) continue;  // already linked
+      *error = "Mount path exists: " + target;
+      return false;
+    }
+    if (symlink(source.c_str(), target.c_str()) != 0) {
+      *error = "cannot link " + target + ": " + strerror(errno);
+      return false;
+    }
+  }
+  return true;
 }
 
 bool setup_repo(const std::string& workdir, const Json& submission,

@@ -23,4 +23,9 @@ bool setup_repo(const std::string& workdir, const Json& submission,
 // into https URLs the way git credential helpers would present it).
 std::string repo_clone_url(const Json& repo_data, const Json& repo_creds);
 
+// Link resolved volume mounts (SubmitBody.mounts) into place — the
+// no-container path's equivalent of the shim's mkfs/mount+bind. Returns
+// false with *error set on failure; the job fails with volume_error.
+bool setup_mounts(const Json& submission, std::string* error);
+
 }  // namespace dstack

@@ -8,6 +8,7 @@
 #include <cstring>
 
 #include "../common/util.hpp"
+#include "volumes.hpp"
 
 namespace dstack {
 
@@ -86,7 +87,16 @@ class DockerRuntime : public Runtime {
       cmd.push_back("-e");
       cmd.push_back(k + "=" + v);
     }
-    for (const auto& [host, container] : spec.volumes) {
+    // Volume data path: format/mount attached devices on the host, then
+    // bind the mounted dirs (parity: docker.go:496-646). A failure fails
+    // the task — jobs must not run without their durable storage.
+    std::vector<std::pair<std::string, std::string>> binds;
+    std::string vol_error;
+    if (!prepare_volumes(spec, &binds, &vol_error)) {
+      fail(task, "volume_error", vol_error);
+      return;
+    }
+    for (const auto& [host, container] : binds) {
       cmd.push_back("-v");
       cmd.push_back(host + ":" + container);
     }
@@ -189,6 +199,36 @@ class ProcessRuntime : public Runtime {
   void launch(TaskState& task) override {
     const TaskSpec& spec = task.spec;
     task.status = "creating";
+
+    // Volume data path (no container namespace here): prepare the host-side
+    // mounts, then link each container path to its host dir.
+    std::vector<std::pair<std::string, std::string>> binds;
+    std::string vol_error;
+    if (!prepare_volumes(spec, &binds, &vol_error)) {
+      task.status = "terminated";
+      task.termination_reason = "volume_error";
+      task.termination_message = vol_error;
+      return;
+    }
+    for (const auto& [host, path] : binds) {
+      struct stat st;
+      if (lstat(path.c_str(), &st) == 0) {
+        char target[4096];
+        ssize_t n = readlink(path.c_str(), target, sizeof(target) - 1);
+        if (n > 0 && std::string(target, n) == host) continue;  // relinked
+        task.status = "terminated";
+        task.termination_reason = "volume_error";
+        task.termination_message = "mount path exists: " + path;
+        return;
+      }
+      if (symlink(host.c_str(), path.c_str()) != 0) {
+        task.status = "terminated";
+        task.termination_reason = "volume_error";
+        task.termination_message = "cannot link " + path + ": " + strerror(errno);
+        return;
+      }
+    }
+
     // Allocate an ephemeral port by letting the runner bind :0 would lose
     // the port; instead derive one per task from the pid after spawn is
     // racy too — so bind a fixed base + hash offset and retry upward.

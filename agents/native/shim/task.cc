@@ -14,9 +14,12 @@ TaskSpec TaskSpec::from_json(const Json& j) {
   s.tpu_chips = static_cast<int>(j["tpu_chips"].as_int(0));
   for (const auto& [k, v] : j["env"].as_object()) s.env[k] = v.as_string();
   for (const auto& vol : j["volumes"].as_array()) {
-    std::string host = vol["instance_path"].as_string();
-    if (host.empty()) host = vol["name"].as_string();
-    s.volumes.emplace_back(host, vol["path"].as_string());
+    VolumeMount m;
+    m.name = vol["name"].as_string();
+    m.path = vol["path"].as_string();
+    m.device_name = vol["device_name"].as_string();
+    m.instance_path = vol["instance_path"].as_string();
+    s.volumes.push_back(std::move(m));
   }
   for (const auto& key : j["container_ssh_keys"].as_array())
     s.container_ssh_keys.push_back(key.as_string());

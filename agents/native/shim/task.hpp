@@ -14,6 +14,13 @@
 
 namespace dstack {
 
+struct VolumeMount {
+  std::string name;           // volume name (volume mounts)
+  std::string path;           // container mount path
+  std::string device_name;    // host block device, server-resolved (volume mounts)
+  std::string instance_path;  // host directory (instance mounts)
+};
+
 struct TaskSpec {
   std::string id;
   std::string name;
@@ -24,7 +31,7 @@ struct TaskSpec {
   std::string network_mode = "host";
   int tpu_chips = 0;
   std::map<std::string, std::string> env;
-  std::vector<std::pair<std::string, std::string>> volumes;  // host path -> container path
+  std::vector<VolumeMount> volumes;
   std::vector<std::string> container_ssh_keys;
 
   static TaskSpec from_json(const Json& j);

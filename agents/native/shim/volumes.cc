@@ -1,0 +1,113 @@
+#include "volumes.hpp"
+
+#include <sys/stat.h>
+
+#include <cerrno>
+#include <cstdlib>
+
+#include "../common/util.hpp"
+
+namespace dstack {
+
+namespace {
+
+constexpr int kFsTimeoutSeconds = 300;  // mkfs on a large PD can be slow
+
+// Dispatch a filesystem verb. With DSTACK_SHIM_FS_HELPER set, every verb is
+// `helper <verb> <args...>` (tests inject a recorder); otherwise the real
+// tool per verb. Returns exit code; combined output in *out.
+int run_fs(const std::string& verb, const std::vector<std::string>& args,
+           std::string* out) {
+  const char* helper = getenv("DSTACK_SHIM_FS_HELPER");
+  std::vector<std::string> argv;
+  if (helper && *helper) {
+    argv = {helper, verb};
+    for (const auto& a : args) argv.push_back(a);
+  } else if (verb == "fstype") {
+    argv = {"blkid", "-o", "value", "-s", "TYPE", args[0]};
+  } else if (verb == "mkfs") {
+    argv = {"mkfs.ext4", "-q", "-F", args[0]};
+  } else if (verb == "mount") {
+    argv = {"mount", args[0], args[1]};
+  } else if (verb == "mounted") {
+    argv = {"mountpoint", "-q", args[0]};
+  } else {
+    if (out) *out = "unknown fs verb " + verb;
+    return -1;
+  }
+  return run_command(argv, out, kFsTimeoutSeconds);
+}
+
+bool mkdir_p(const std::string& path) {
+  std::string partial;
+  for (const auto& part : split(path, '/')) {
+    if (part.empty()) continue;
+    partial += "/" + part;
+    if (mkdir(partial.c_str(), 0755) != 0 && errno != EEXIST) return false;
+  }
+  return true;
+}
+
+bool prepare_device_mount(const VolumeMount& m, std::string* host_dir,
+                          std::string* error) {
+  *host_dir = volume_mount_dir(m.name);
+  if (!mkdir_p(*host_dir)) {
+    *error = "cannot create mount dir " + *host_dir;
+    return false;
+  }
+  std::string out;
+  if (run_fs("mounted", {*host_dir}, &out) == 0) {
+    return true;  // already mounted (shim restart / second task)
+  }
+  // blkid exits nonzero for a blank device; empty TYPE means no filesystem.
+  int rc = run_fs("fstype", {m.device_name}, &out);
+  bool has_fs = rc == 0 && !out.empty() && out.find_first_not_of(" \n\t") != std::string::npos;
+  if (!has_fs) {
+    // Freshly provisioned disk: one-time format (parity: docker.go format
+    // step runs only when blkid reports no filesystem — never reformat data).
+    std::string mkfs_out;
+    if (run_fs("mkfs", {m.device_name}, &mkfs_out) != 0) {
+      *error = "mkfs.ext4 " + m.device_name + " failed: " + mkfs_out;
+      return false;
+    }
+  }
+  std::string mount_out;
+  if (run_fs("mount", {m.device_name, *host_dir}, &mount_out) != 0) {
+    *error = "mount " + m.device_name + " at " + *host_dir + " failed: " + mount_out;
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string volume_mount_dir(const std::string& name) {
+  return "/mnt/disks/dstack-" + name;
+}
+
+bool prepare_volumes(const TaskSpec& spec,
+                     std::vector<std::pair<std::string, std::string>>* binds,
+                     std::string* error) {
+  for (const auto& m : spec.volumes) {
+    if (!m.instance_path.empty()) {
+      // Instance mount: plain host directory bind, created on demand.
+      if (!mkdir_p(m.instance_path)) {
+        *error = "cannot create instance mount dir " + m.instance_path;
+        return false;
+      }
+      binds->emplace_back(m.instance_path, m.path);
+      continue;
+    }
+    if (m.device_name.empty()) {
+      *error = "volume " + (m.name.empty() ? m.path : m.name) +
+               " has no device_name (server did not attach it)";
+      return false;
+    }
+    std::string host_dir;
+    if (!prepare_device_mount(m, &host_dir, error)) return false;
+    binds->emplace_back(host_dir, m.path);
+  }
+  return true;
+}
+
+}  // namespace dstack

@@ -1,0 +1,29 @@
+// Host-side volume data path: detect filesystem, mkfs.ext4 if blank, mount
+// the attached block device, hand the mounted directory to the container as
+// a bind. Parity: runner/internal/shim/docker.go:496-646 (formatVolume /
+// mountDisk) — the step whose absence made round-2 volumes pure bookkeeping.
+//
+// All filesystem commands go through DSTACK_SHIM_FS_HELPER when set: tests
+// inject a recorder script; production uses blkid/mkfs.ext4/mount directly.
+#pragma once
+
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "task.hpp"
+
+namespace dstack {
+
+// Prepares every mount in the spec. On success fills `binds` with
+// (host_dir, container_path) pairs ready for `docker create -v`; on failure
+// returns false with *error set — the task must fail, never run without its
+// durable storage.
+bool prepare_volumes(const TaskSpec& spec,
+                     std::vector<std::pair<std::string, std::string>>* binds,
+                     std::string* error);
+
+// Where a named volume's device gets mounted on the host.
+std::string volume_mount_dir(const std::string& name);
+
+}  // namespace dstack

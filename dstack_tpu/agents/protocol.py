@@ -52,6 +52,10 @@ class SubmitBody(CoreModel):
     # Parity: runner/internal/repo/manager.go.
     repo_data: Optional[AnyRunRepoData] = None
     repo_creds: Optional[RemoteRepoCreds] = None
+    # Non-dockerized (local/process) path only: volume mounts resolved to
+    # host paths ({name, path, device_name}); the runner links them into
+    # place. Dockerized hosts mount volumes in the shim instead.
+    mounts: List[Dict[str, Optional[str]]] = []
     working_dir_root: str = "/workflow"
 
 

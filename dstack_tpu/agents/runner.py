@@ -43,6 +43,10 @@ from dstack_tpu.utils.common import utcnow
 IDLE_SHUTDOWN_SECONDS = 300.0  # parity: runner self-terminates if no job (server.go:56)
 
 
+class MountError(Exception):
+    """Volume mount setup failed; fails the job with VOLUME_ERROR."""
+
+
 def _now_ms() -> int:
     return int(time.time() * 1000)
 
@@ -134,8 +138,14 @@ class Executor:
         workdir = Path(self.working_root or tempfile.mkdtemp(prefix="dstack-job-"))
         workdir.mkdir(parents=True, exist_ok=True)
         try:
+            self._setup_mounts()
+        except (MountError, OSError) as e:
+            self.log_runner(f"Volume mount failed: {e}")
+            self.set_state(JobStatus.FAILED, JobTerminationReason.VOLUME_ERROR, str(e))
+            return
+        try:
             await self._setup_repo(workdir)
-        except RepoError as e:
+        except (RepoError, OSError) as e:
             self.log_runner(f"Repo setup failed: {e}")
             self.set_state(JobStatus.FAILED, JobTerminationReason.EXECUTOR_ERROR, str(e))
             return
@@ -167,6 +177,29 @@ class Executor:
                     self._enforce_max_duration(sub.job_spec.max_duration)
                 )
             )
+
+    def _setup_mounts(self) -> None:
+        """Link resolved volume mounts into place (no-container local path:
+        the 'device' is a host directory — a symlink at the mount path gives
+        the job the same durable-storage contract the shim's mkfs/mount path
+        gives containers; parity target: shim/docker.go:496-646)."""
+        assert self.submission is not None
+        for mount in self.submission.mounts:
+            target = Path(mount["path"])
+            source = mount.get("device_name") or mount.get("instance_path")
+            if not source:
+                raise MountError(f"Mount {mount.get('name') or target} has no host source")
+            source_path = Path(source)
+            source_path.mkdir(parents=True, exist_ok=True)
+            if target.is_symlink():
+                if target.resolve() == source_path.resolve():
+                    continue  # already linked (e.g. second run on this host)
+                raise MountError(f"Mount path {target} links elsewhere")
+            if target.exists():
+                raise MountError(f"Mount path {target} already exists on the host")
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.symlink_to(source_path)
+            self.log_runner(f"Mounted volume at {target}")
 
     async def _setup_repo(self, workdir: Path) -> None:
         """Materialize the job's code: git clone + diff apply for remote

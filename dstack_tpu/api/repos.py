@@ -130,7 +130,9 @@ def detect_remote_repo(
     # diff emits an unapplicable "Binary files differ" stub). Taken raw —
     # git apply needs the trailing newline AND the blank line terminating
     # base85 blocks, so the output must never be stripped.
-    diff = _git_raw(root, "diff", "--binary", "HEAD") or b""
+    diff = _git_raw(root, "diff", "--binary", "HEAD")
+    if diff is None:
+        return None  # diff failed/timed out: full local pack, never lose work
     host, user, name = _parse_git_url(url)
     data = RemoteRunRepoData(
         repo_host_name=host,

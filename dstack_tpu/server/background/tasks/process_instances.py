@@ -58,6 +58,11 @@ async def _process_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
 
 
 async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
+    from dstack_tpu.server.services import volumes as volumes_service
+
+    # Release attached volumes before the instance goes away (cloud detach
+    # best-effort, attachment rows always removed so volumes stay reusable).
+    await volumes_service.detach_instance_volumes(ctx, row)
     jpd: Optional[JobProvisioningData] = None
     if row["job_provisioning_data"]:
         jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])

@@ -24,8 +24,10 @@ from dstack_tpu.models.runs import (
     JobStatus,
     JobTerminationReason,
 )
+from dstack_tpu.errors import ServerError
 from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.services import volumes as volumes_service
 from dstack_tpu.server.services.connections import get_connection_pool
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
 
@@ -188,6 +190,14 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
                                 "shim did not become ready in time")
                 return
             tpu_chips = job_spec.tpu_slice.chips_per_host if job_spec.tpu_slice else 0
+            try:
+                resolved_volumes = await volumes_service.attach_job_volumes(
+                    ctx, row["project_id"], row["instance_id"] or jpd.instance_id,
+                    jpd, job_spec.volumes,
+                )
+            except ServerError as e:
+                await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
+                return
             await shim.submit_task(
                 TaskSubmitRequest(
                     id=row["id"],
@@ -197,7 +207,7 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
                     privileged=job_spec.privileged,
                     shm_size_bytes=int((job_spec.requirements.resources.shm_size or 0) * (1 << 30)),
                     network_mode="host",
-                    volumes=[v.model_dump() for v in job_spec.volumes],
+                    volumes=resolved_volumes,
                     host_ssh_keys=[project_row["ssh_public_key"]],
                     container_ssh_keys=[project_row["ssh_public_key"]],
                     tpu_chips=tpu_chips,
@@ -274,6 +284,19 @@ async def _submit_to_runner(
                             "runner did not become ready in time")
             return
         code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
+        jpd = _jpd(row)
+        mounts: List[dict] = []
+        if job_spec.volumes and jpd is not None and not jpd.dockerized:
+            # Dockerized hosts mount volumes in the shim; the direct-runner
+            # (local backend) path resolves them here instead.
+            try:
+                mounts = await volumes_service.attach_job_volumes(
+                    ctx, row["project_id"], row["instance_id"] or jpd.instance_id,
+                    jpd, job_spec.volumes,
+                )
+            except ServerError as e:
+                await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
+                return
         await runner.submit_job(
             run_name=row["run_name"],
             job_spec=job_spec,
@@ -283,6 +306,7 @@ async def _submit_to_runner(
             has_code=code_blob is not None,
             repo_data=repo_data,
             repo_creds=repo_creds,
+            mounts=mounts,
         )
         if code_blob is not None:
             await runner.upload_code(code_blob)

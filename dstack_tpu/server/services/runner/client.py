@@ -59,6 +59,7 @@ class RunnerClient:
         has_code: bool,
         repo_data=None,
         repo_creds=None,
+        mounts=None,
     ) -> None:
         body = SubmitBody(
             run_name=run_name,
@@ -69,6 +70,7 @@ class RunnerClient:
             repo_archive=has_code,
             repo_data=repo_data,
             repo_creds=repo_creds,
+            mounts=mounts or [],
         )
         await self._request(
             "POST", "/api/submit", content=body.model_dump_json(),

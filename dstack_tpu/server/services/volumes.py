@@ -122,3 +122,109 @@ async def delete_volumes(ctx: ServerContext, project_id: str, names: List[str]) 
             except Exception:
                 pass
         await ctx.db.execute("UPDATE volumes SET deleted = 1 WHERE id = ?", (row["id"],))
+
+
+async def attach_job_volumes(
+    ctx: ServerContext,
+    project_id: str,
+    instance_id: str,
+    jpd,
+    mount_points,
+) -> List[dict]:
+    """Resolve the job's mount points to host-side devices, attaching cloud
+    volumes to the instance on first use (backend attach_volume -> persistent
+    device path, e.g. /dev/disk/by-id/google-* for GCP PDs — reference
+    attaches via UpdateNode for TPU VMs, gcp/compute.py:567-642).
+
+    Returns shim/runner-ready dicts: {name, path, device_name, volume_id} for
+    volume mounts, {instance_path, path} for instance mounts. Raises
+    ServerError if a named volume is missing or not ACTIVE — the caller fails
+    the job with VOLUME_ERROR rather than running without durable storage.
+    """
+    from dstack_tpu.models.volumes import InstanceMountPoint
+    from dstack_tpu.server.services import backends as backends_service
+
+    resolved: List[dict] = []
+    for mp in mount_points:
+        if isinstance(mp, InstanceMountPoint):
+            resolved.append({"instance_path": mp.instance_path, "path": mp.path})
+            continue
+        row = await ctx.db.fetchone(
+            "SELECT * FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+            (project_id, mp.name),
+        )
+        if row is None:
+            raise ServerError(f"Volume {mp.name} does not exist")
+        if row["status"] != VolumeStatus.ACTIVE.value:
+            raise ServerError(f"Volume {mp.name} is not active (status={row['status']})")
+        volume = await volume_row_to_volume(ctx, row)
+        existing = await ctx.db.fetchone(
+            "SELECT id FROM volume_attachments WHERE volume_id = ? AND instance_id = ?",
+            (row["id"], instance_id),
+        )
+        if existing is None or volume.attachment_data is None:
+            compute = await backends_service.get_project_backend(
+                ctx, project_id, volume.configuration.backend
+            )
+            attachment = await compute.attach_volume(volume, jpd)
+            await ctx.db.execute(
+                "UPDATE volumes SET attachment_data = ? WHERE id = ?",
+                (attachment.model_dump_json(), row["id"]),
+            )
+            await ctx.db.execute(
+                "INSERT INTO volume_attachments (id, volume_id, instance_id)"
+                " VALUES (?, ?, ?) ON CONFLICT (volume_id, instance_id) DO NOTHING",
+                (generate_id(), row["id"], instance_id),
+            )
+        else:
+            attachment = volume.attachment_data
+        resolved.append(
+            {
+                "name": mp.name,
+                "path": mp.path,
+                "device_name": attachment.device_name,
+                "volume_id": row["volume_id"],
+            }
+        )
+    return resolved
+
+
+async def detach_instance_volumes(ctx: ServerContext, instance_row) -> None:
+    """Release every volume attached to a terminating instance (backend
+    detach + attachment row removal). Parity: the reference detaches in
+    process_terminating_jobs before the instance is released."""
+    from dstack_tpu.models.runs import JobProvisioningData
+    from dstack_tpu.server.services import backends as backends_service
+
+    # v.* first so row["id"] resolves to the volume id, not the alias.
+    attachments = await ctx.db.fetchall(
+        "SELECT v.*, va.id AS attachment_id FROM volume_attachments va"
+        " JOIN volumes v ON v.id = va.volume_id WHERE va.instance_id = ?",
+        (instance_row["id"],),
+    )
+    if not attachments:
+        return
+    jpd = (
+        JobProvisioningData.model_validate_json(instance_row["job_provisioning_data"])
+        if instance_row["job_provisioning_data"]
+        else None
+    )
+    for row in attachments:
+        volume = await volume_row_to_volume(ctx, row)
+        try:
+            compute = await backends_service.get_project_backend(
+                ctx, instance_row["project_id"], volume.configuration.backend
+            )
+            await compute.detach_volume(volume, jpd)
+        except Exception:
+            # Cloud-side detach is best-effort on teardown; the attachment
+            # row must go regardless so the volume can be reused/deleted.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "detach_volume %s from %s failed", row["name"], instance_row["name"],
+                exc_info=True,
+            )
+        await ctx.db.execute(
+            "DELETE FROM volume_attachments WHERE id = ?", (row["attachment_id"],)
+        )

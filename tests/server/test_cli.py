@@ -90,9 +90,18 @@ def test_cli_ps_logs_stop_delete(cli_env, gang_server, tmp_path):
     assert r.exit_code == 0, r.output
     assert "cli-sleep" in r.output and "running" in r.output
 
-    r = cli_env.invoke(cli, ["logs", "cli-sleep"])
-    assert r.exit_code == 0, r.output
-    assert "live-log-line" in r.output
+    # RUNNING flips before the first command's output reaches the server's
+    # log store — poll rather than assert on the first read.
+    import time as time_mod
+
+    deadline = time_mod.time() + 30
+    while True:
+        r = cli_env.invoke(cli, ["logs", "cli-sleep"])
+        assert r.exit_code == 0, r.output
+        if "live-log-line" in r.output:
+            break
+        assert time_mod.time() < deadline, f"log line never arrived: {r.output!r}"
+        time_mod.sleep(1)
 
     r = cli_env.invoke(cli, ["stop", "cli-sleep"])
     assert r.exit_code == 0, r.output

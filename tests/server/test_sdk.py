@@ -219,6 +219,48 @@ def test_sdk_fleet_and_volume_collections(live_server):
     client.api.close()
 
 
+def test_sdk_volume_data_round_trip(live_server, tmp_path):
+    """The volume data path end-to-end (VERDICT r2 #2): a job writes a file
+    to a mounted volume; a second run reads it back. Exercises volume
+    provisioning (FSM), server-side attach (device resolution), and the
+    runner-side mount."""
+    import time as time_mod
+    import uuid
+
+    client = _client(live_server)
+    client.volumes.create(
+        {"type": "volume", "name": "ckpt-vol", "backend": "local",
+         "region": "local", "size": "1GB"}
+    )
+    deadline = time_mod.time() + 30
+    while time_mod.time() < deadline:
+        vol = next(v for v in client.volumes.list() if v.name == "ckpt-vol")
+        if vol.status.value == "active":
+            break
+        assert vol.status.value != "failed", vol.status_message
+        time_mod.sleep(0.5)
+    assert vol.status.value == "active"
+
+    mnt = f"/tmp/dstack-sdk-vol-{uuid.uuid4().hex[:8]}"
+    run = client.runs.submit(
+        {"type": "task", "commands": [f"echo durable-data > {mnt}/ckpt.txt"],
+         "volumes": [f"ckpt-vol:{mnt}"],
+         "resources": {"cpu": "1..", "memory": "0.1.."}},
+        run_name="vol-writer",
+    )
+    assert run.wait(timeout=60) == RunStatus.DONE, b"".join(run.logs()).decode()
+
+    run2 = client.runs.submit(
+        {"type": "task", "commands": [f"cat {mnt}/ckpt.txt"],
+         "volumes": [f"ckpt-vol:{mnt}"],
+         "resources": {"cpu": "1..", "memory": "0.1.."}},
+        run_name="vol-reader",
+    )
+    assert run2.wait(timeout=60) == RunStatus.DONE, b"".join(run2.logs()).decode()
+    assert "durable-data" in b"".join(run2.logs()).decode()
+    client.api.close()
+
+
 def test_sdk_error_mapping(live_server):
     from dstack_tpu.api import NotFoundError
 
