@@ -1,0 +1,92 @@
+#include "tpu_telemetry.hpp"
+
+#include <sys/stat.h>
+
+#include <cstdlib>
+#include <regex>
+
+#include "../common/util.hpp"
+
+namespace dstack {
+
+namespace {
+
+constexpr double kGiB = 1073741824.0;
+
+Json from_device_files() {
+  Json chips = Json::array();
+  for (int i = 0; i < 64; ++i) {
+    struct stat st;
+    if (stat(("/dev/accel" + std::to_string(i)).c_str(), &st) != 0) break;
+    Json c = Json::object();
+    c.set("chip_index", i);
+    chips.push_back(c);
+  }
+  return chips;
+}
+
+bool from_env_cmd(Json* out) {
+  const char* cmd = getenv("DSTACK_TPU_METRICS_CMD");
+  if (!cmd || !*cmd) return false;
+  std::string text;
+  if (run_command({"/bin/sh", "-c", cmd}, &text, 10) != 0) return false;
+  try {
+    Json parsed = Json::parse(text);
+    if (!parsed.is_array()) return false;
+    *out = parsed;
+    return true;
+  } catch (...) {
+    return false;
+  }
+}
+
+bool from_tpu_info(Json* out) {
+  std::string text;
+  if (run_command({"tpu-info"}, &text, 10) != 0) return false;
+  Json chips = parse_tpu_info_table(text);
+  if (chips.as_array().empty()) return false;
+  *out = chips;
+  return true;
+}
+
+}  // namespace
+
+Json parse_tpu_info_table(const std::string& text) {
+  // Sanitize: rich tables use multibyte box-drawing separators; map every
+  // non-ASCII byte to '|' so a plain ASCII regex can parse the rows.
+  std::string ascii = text;
+  for (char& c : ascii)
+    if (static_cast<unsigned char>(c) >= 0x80) c = '|';
+  static const std::regex row_re(
+      R"((\d+)[|\s]+([0-9.]+)\s*GiB\s*/\s*([0-9.]+)\s*GiB[|\s]+([0-9.]+)\s*%)");
+  Json chips = Json::array();
+  std::string line;
+  size_t start = 0;
+  while (start <= ascii.size()) {
+    size_t end = ascii.find('\n', start);
+    if (end == std::string::npos) end = ascii.size();
+    line = ascii.substr(start, end - start);
+    std::smatch m;
+    if (std::regex_search(line, m, row_re)) {
+      Json c = Json::object();
+      c.set("chip_index", static_cast<int64_t>(std::stoll(m[1].str())));
+      c.set("hbm_used_bytes",
+            static_cast<int64_t>(std::stod(m[2].str()) * kGiB));
+      c.set("hbm_total_bytes",
+            static_cast<int64_t>(std::stod(m[3].str()) * kGiB));
+      c.set("duty_cycle_pct", std::stod(m[4].str()));
+      chips.push_back(c);
+    }
+    start = end + 1;
+  }
+  return chips;
+}
+
+Json collect_tpu_metrics() {
+  Json chips;
+  if (from_env_cmd(&chips)) return chips;
+  if (from_tpu_info(&chips)) return chips;
+  return from_device_files();
+}
+
+}  // namespace dstack

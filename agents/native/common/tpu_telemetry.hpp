@@ -1,0 +1,22 @@
+// TPU chip telemetry: duty cycle + HBM, layered like the Python twin
+// (dstack_tpu/agents/tpu_telemetry.py). Parity target:
+// runner/internal/metrics/metrics.go:31-160 (vendor smi table parsing).
+//
+// Layers: DSTACK_TPU_METRICS_CMD (JSON array, test/exporter injection) ->
+// `tpu-info` table parse -> /dev/accel* enumeration with metrics unset.
+#pragma once
+
+#include <string>
+
+#include "../common/json.hpp"
+
+namespace dstack {
+
+// Returns a JSON array of {chip_index, duty_cycle_pct?, hbm_used_bytes?,
+// hbm_total_bytes?} objects. Never throws; degrades to presence-only.
+Json collect_tpu_metrics();
+
+// Exposed for tests: parse tpu-info's utilization table text.
+Json parse_tpu_info_table(const std::string& text);
+
+}  // namespace dstack

@@ -3,6 +3,7 @@
 #include <fcntl.h>
 #include <poll.h>
 #include <signal.h>
+#include <sys/stat.h>
 #include <sys/time.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -132,6 +133,19 @@ int run_command(const std::vector<std::string>& argv, std::string* output,
   if (WIFEXITED(status)) return WEXITSTATUS(status);
   if (WIFSIGNALED(status)) return -WTERMSIG(status);
   return -1;
+}
+
+bool mkdir_p(const std::string& path, int mode) {
+  std::string partial;
+  for (const auto& part : split(path, '/')) {
+    if (part.empty()) continue;
+    partial += "/" + part;
+    if (mkdir(partial.c_str(), mode) != 0 && errno != EEXIST) return false;
+    // EEXIST from a non-directory (file in the way) must still fail.
+    struct stat st;
+    if (stat(partial.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) return false;
+  }
+  return true;
 }
 
 }  // namespace dstack

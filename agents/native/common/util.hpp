@@ -25,4 +25,8 @@ bool write_file(const std::string& path, const std::string& content);
 int run_command(const std::vector<std::string>& argv, std::string* output,
                 int timeout_seconds = 0);
 
+// mkdir -p: creates every missing component. Returns false if any component
+// cannot be created (exists-as-file, read-only fs, permissions).
+bool mkdir_p(const std::string& path, int mode = 0755);
+
 }  // namespace dstack

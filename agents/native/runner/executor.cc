@@ -15,6 +15,7 @@
 #include "../common/util.hpp"
 #include "cluster_env.hpp"
 #include "repo.hpp"
+#include "../common/tpu_telemetry.hpp"
 
 namespace dstack {
 
@@ -361,17 +362,7 @@ Json Executor::metrics() {
   point.set("cpu_usage_micro", cpu_micro);
   point.set("memory_usage_bytes", mem_bytes);
   point.set("memory_working_set_bytes", mem_bytes);
-  // TPU chips: enumerate /dev/accel* (tpu-info integration lives in the shim
-  // host-info path; per-chip utilisation needs libtpu's monitoring socket).
-  Json chips = Json::array();
-  for (int i = 0; i < 64; ++i) {
-    struct stat st;
-    if (stat(("/dev/accel" + std::to_string(i)).c_str(), &st) != 0) break;
-    Json c = Json::object();
-    c.set("chip_index", i);
-    chips.push_back(c);
-  }
-  point.set("tpu_chips", chips);
+  point.set("tpu_chips", collect_tpu_metrics());
   return point;
 }
 

@@ -148,21 +148,18 @@ bool setup_mounts(const Json& submission, std::string* error) {
       *error = "Mount " + target + " has no host source";
       return false;
     }
-    // Source dir + target parents on demand (mirrors the Python twin).
-    std::string partial;
-    for (const auto& part : split(source, '/')) {
-      if (part.empty()) continue;
-      partial += "/" + part;
-      mkdir(partial.c_str(), 0755);
+    // Source dir + target parents on demand (mirrors the Python twin);
+    // a source that cannot be created must fail the job, not leave the
+    // mount symlink dangling.
+    if (!mkdir_p(source)) {
+      *error = "cannot create mount source " + source;
+      return false;
     }
     auto slash = target.rfind('/');
-    if (slash != std::string::npos && slash > 0) {
-      partial.clear();
-      for (const auto& part : split(target.substr(0, slash), '/')) {
-        if (part.empty()) continue;
-        partial += "/" + part;
-        mkdir(partial.c_str(), 0755);
-      }
+    if (slash != std::string::npos && slash > 0 &&
+        !mkdir_p(target.substr(0, slash))) {
+      *error = "cannot create parent of mount path " + target;
+      return false;
     }
     struct stat st;
     if (lstat(target.c_str(), &st) == 0) {

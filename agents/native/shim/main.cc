@@ -17,6 +17,7 @@
 #include <thread>
 
 #include "../common/http.hpp"
+#include "../common/tpu_telemetry.hpp"
 #include "../common/util.hpp"
 #include "runtime.hpp"
 #include "task.hpp"
@@ -40,6 +41,10 @@ Json host_info() {
   int chips = 0;
   struct stat st;
   while (stat(("/dev/accel" + std::to_string(chips)).c_str(), &st) == 0) ++chips;
+  // tpu-info sees chips the device files may not (e.g. vfio-bound).
+  Json tpu = collect_tpu_metrics();
+  if (static_cast<int>(tpu.as_array().size()) > chips)
+    chips = static_cast<int>(tpu.as_array().size());
   j.set("tpu_chip_count", chips);
   const char* acc = getenv("TPU_ACCELERATOR_TYPE");  // set by GCE metadata bootstrap
   j.set("tpu_accelerator_type", acc ? Json(std::string(acc)) : Json());

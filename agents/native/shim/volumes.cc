@@ -38,16 +38,6 @@ int run_fs(const std::string& verb, const std::vector<std::string>& args,
   return run_command(argv, out, kFsTimeoutSeconds);
 }
 
-bool mkdir_p(const std::string& path) {
-  std::string partial;
-  for (const auto& part : split(path, '/')) {
-    if (part.empty()) continue;
-    partial += "/" + part;
-    if (mkdir(partial.c_str(), 0755) != 0 && errno != EEXIST) return false;
-  }
-  return true;
-}
-
 bool prepare_device_mount(const VolumeMount& m, std::string* host_dir,
                           std::string* error) {
   *host_dir = volume_mount_dir(m.name);

@@ -23,6 +23,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from dstack_tpu.agents.repo import RepoError, setup_remote_repo
+from dstack_tpu.agents.tpu_telemetry import collect_tpu_metrics
 
 from dstack_tpu.agents.protocol import (
     HealthcheckResponse,
@@ -34,7 +35,7 @@ from dstack_tpu.agents.protocol import (
     SubmitBody,
 )
 from dstack_tpu.errors import ApiError
-from dstack_tpu.models.metrics import MetricsPoint, TpuChipMetrics
+from dstack_tpu.models.metrics import MetricsPoint
 from dstack_tpu.models.runs import JobStatus, JobTerminationReason
 from dstack_tpu.parallel.env import make_cluster_env
 from dstack_tpu.server.http import App, Request, Response, Router, Server
@@ -338,20 +339,6 @@ class Executor:
         return point
 
 
-def collect_tpu_metrics() -> List[TpuChipMetrics]:
-    """Best-effort chip metrics via libtpu's /dev/accel* presence + tpu-info.
-
-    Parity: runner/internal/metrics/metrics.go:31-160 which shells out to
-    nvidia-smi/amd-smi/hl-smi; here `tpu-info` (gated: absent on dev boxes).
-    """
-    chips: List[TpuChipMetrics] = []
-    try:
-        accel = sorted(p for p in os.listdir("/dev") if p.startswith("accel"))
-    except OSError:
-        accel = []
-    for i, _ in enumerate(accel):
-        chips.append(TpuChipMetrics(chip_index=i))
-    return chips
 
 
 def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = False) -> App:

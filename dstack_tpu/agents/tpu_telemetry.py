@@ -1,0 +1,92 @@
+"""TPU chip telemetry: duty cycle + HBM via tpu-info, with graceful layers.
+
+Parity: runner/internal/metrics/metrics.go:31-160, which shells out to
+nvidia-smi/amd-smi/hl-smi and parses the table. Chips-first equivalent:
+
+1. `DSTACK_TPU_METRICS_CMD` (if set): run it, parse one JSON array of
+   {chip_index, duty_cycle_pct, hbm_used_bytes, hbm_total_bytes}. The
+   injection point for tests and for sites with custom telemetry exporters.
+2. `tpu-info` (libtpu's CLI, present on TPU VMs): parse its utilization
+   table — rows carry "N.NN GiB / M.MM GiB" memory and "P.P%" duty cycle.
+3. Fallback: enumerate /dev/accel* with metrics unset (chip presence only).
+"""
+
+import json
+import os
+import re
+import shlex
+import subprocess
+from typing import List, Optional
+
+from dstack_tpu.models.metrics import TpuChipMetrics
+
+_GIB = 1 << 30
+
+# A tpu-info utilization row: device index, "used GiB / total GiB", "pct%".
+# Tolerant of the box-drawing characters rich tables emit (│ ┃ |).
+_ROW_RE = re.compile(
+    r"(\d+)\s*[│┃|]\s*([\d.]+)\s*GiB\s*/\s*([\d.]+)\s*GiB\s*[│┃|]\s*([\d.]+)\s*%"
+)
+
+
+def collect_tpu_metrics(timeout: float = 10.0) -> List[TpuChipMetrics]:
+    chips = _from_env_cmd(timeout)
+    if chips is not None:
+        return chips
+    chips = _from_tpu_info(timeout)
+    if chips is not None:
+        return chips
+    return _from_device_files()
+
+
+def _from_env_cmd(timeout: float) -> Optional[List[TpuChipMetrics]]:
+    cmd = os.environ.get("DSTACK_TPU_METRICS_CMD")
+    if not cmd:
+        return None
+    try:
+        out = subprocess.run(
+            shlex.split(cmd), capture_output=True, text=True, timeout=timeout
+        )
+        if out.returncode != 0:
+            return None
+        return [TpuChipMetrics.model_validate(c) for c in json.loads(out.stdout)]
+    except (OSError, subprocess.TimeoutExpired, ValueError):
+        return None
+
+
+def _from_tpu_info(timeout: float) -> Optional[List[TpuChipMetrics]]:
+    try:
+        out = subprocess.run(
+            ["tpu-info"], capture_output=True, text=True, timeout=timeout
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    chips = parse_tpu_info_table(out.stdout)
+    return chips or None
+
+
+def parse_tpu_info_table(text: str) -> List[TpuChipMetrics]:
+    chips: List[TpuChipMetrics] = []
+    for line in text.splitlines():
+        m = _ROW_RE.search(line)
+        if m is None:
+            continue
+        chips.append(
+            TpuChipMetrics(
+                chip_index=int(m.group(1)),
+                duty_cycle_pct=float(m.group(4)),
+                hbm_used_bytes=int(float(m.group(2)) * _GIB),
+                hbm_total_bytes=int(float(m.group(3)) * _GIB),
+            )
+        )
+    return chips
+
+
+def _from_device_files() -> List[TpuChipMetrics]:
+    try:
+        accel = sorted(p for p in os.listdir("/dev") if p.startswith("accel"))
+    except OSError:
+        accel = []
+    return [TpuChipMetrics(chip_index=i) for i in range(len(accel))]

@@ -142,11 +142,13 @@ def detect_remote_repo(
         repo_hash=head,
         repo_diff=None,  # carried as the code blob, not inline
     )
-    creds = RemoteRepoCreds(
-        clone_url=url,
-        oauth_token=os.environ.get("DSTACK_GIT_TOKEN")
-        or os.environ.get("GITHUB_TOKEN"),
-    )
+    # DSTACK_GIT_TOKEN is dstack-specific (user opted in for this tool, any
+    # host); GITHUB_TOKEN is ambient in CI and must only ever reach
+    # github.com — never leak it to other git hosts.
+    token = os.environ.get("DSTACK_GIT_TOKEN")
+    if not token and host == "github.com":
+        token = os.environ.get("GITHUB_TOKEN")
+    creds = RemoteRepoCreds(clone_url=url, oauth_token=token)
     return data, creds, diff
 
 

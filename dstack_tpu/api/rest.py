@@ -351,6 +351,10 @@ class _Metrics(_Resource):
             path += f"?{qs}"
         return self._api.get(path)
 
+    def get_run_metrics(self, project: str, run_name: str) -> Dict[str, Any]:
+        """Per-host snapshot (CPU%, memory, TPU chips/duty/HBM) for stats."""
+        return self._api.get(f"/api/project/{project}/metrics/run/{run_name}")
+
 
 class _ServerInfo(_Resource):
     def get_info(self) -> Dict[str, Any]:

@@ -377,13 +377,20 @@ def stats(run_name: str, project: Optional[str]) -> None:
     """Per-host CPU/memory/TPU metrics of a running run."""
     client = _make_client(project)
     try:
-        data = client.api.metrics.get_job_metrics(client.project, run_name)
+        data = client.api.metrics.get_run_metrics(client.project, run_name)
         from rich.table import Table
 
         table = Table(box=None, header_style="bold")
         for col in ("HOST", "CPU", "MEMORY", "TPU CHIPS", "TPU UTIL", "HBM"):
             table.add_column(col)
         for host in data.get("hosts", []):
+            hbm = host.get("tpu_hbm_usage_bytes")
+            hbm_total = host.get("tpu_hbm_total_bytes")
+            hbm_cell = ""
+            if hbm is not None:
+                hbm_cell = f"{hbm / 2**30:.2f}GB"
+                if hbm_total:
+                    hbm_cell += f"/{hbm_total / 2**30:.0f}GB"
             table.add_row(
                 str(host.get("job_num", "")),
                 f"{host.get('cpu_percent', 0):.0f}%",
@@ -391,8 +398,7 @@ def stats(run_name: str, project: Optional[str]) -> None:
                 str(host.get("tpu_chips", "")),
                 f"{host.get('tpu_duty_cycle_percent', 0):.0f}%"
                 if host.get("tpu_duty_cycle_percent") is not None else "",
-                f"{(host.get('tpu_hbm_usage_bytes') or 0) / 2**30:.2f}GB"
-                if host.get("tpu_hbm_usage_bytes") is not None else "",
+                hbm_cell,
             )
         console.print(table)
     except DstackTpuError as e:

@@ -24,7 +24,7 @@ from dstack_tpu.models.runs import (
     JobStatus,
     JobTerminationReason,
 )
-from dstack_tpu.errors import ServerError
+from dstack_tpu.errors import BackendError, ServerError
 from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.services import volumes as volumes_service
@@ -195,7 +195,7 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
                     ctx, row["project_id"], row["instance_id"] or jpd.instance_id,
                     jpd, job_spec.volumes,
                 )
-            except ServerError as e:
+            except (ServerError, BackendError) as e:
                 await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
                 return
             await shim.submit_task(
@@ -294,7 +294,7 @@ async def _submit_to_runner(
                     ctx, row["project_id"], row["instance_id"] or jpd.instance_id,
                     jpd, job_spec.volumes,
                 )
-            except ServerError as e:
+            except (ServerError, BackendError) as e:
                 await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
                 return
         await runner.submit_job(

@@ -45,3 +45,68 @@ async def get_job_metrics(request: Request, project_name: str, run_name: str):
         for r in rows
     ]
     return JobMetrics(points=points)
+
+
+@router.get("/api/project/{project_name}/metrics/run/{run_name}")
+async def get_run_metrics(request: Request, project_name: str, run_name: str):
+    """Per-host snapshot for `dstack-tpu stats`: one row per job of the run's
+    latest submission — CPU% from the last two cumulative samples, memory,
+    and TPU chip count / mean duty cycle / summed HBM from the latest point.
+    """
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    run_row = await ctx.db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise ResourceNotExistsError("Run not found")
+    job_rows = await ctx.db.fetchall(
+        "SELECT j.* FROM jobs j WHERE j.run_id = ? AND j.submission_num ="
+        " (SELECT MAX(submission_num) FROM jobs WHERE run_id = ?)"
+        " ORDER BY j.replica_num, j.job_num",
+        (run_row["id"], run_row["id"]),
+    )
+    hosts = []
+    for job in job_rows:
+        points = await ctx.db.fetchall(
+            "SELECT * FROM job_metrics_points WHERE job_id = ?"
+            " ORDER BY timestamp DESC LIMIT 2",
+            (job["id"],),
+        )
+        host = {
+            "replica_num": job["replica_num"],
+            "job_num": job["job_num"],
+            "cpu_percent": 0.0,
+            "memory_usage_bytes": None,
+            "tpu_chips": 0,
+            "tpu_duty_cycle_percent": None,
+            "tpu_hbm_usage_bytes": None,
+            "tpu_hbm_total_bytes": None,
+        }
+        if points:
+            latest = points[0]
+            host["memory_usage_bytes"] = latest["memory_usage_bytes"]
+            if len(points) == 2:
+                dt = (
+                    parse_dt(points[0]["timestamp"]) - parse_dt(points[1]["timestamp"])
+                ).total_seconds()
+                dmicro = points[0]["cpu_usage_micro"] - points[1]["cpu_usage_micro"]
+                if dt > 0 and dmicro >= 0:
+                    host["cpu_percent"] = dmicro / (dt * 1e6) * 100.0
+            chips = [
+                TpuChipMetrics.model_validate(c)
+                for c in json.loads(latest["tpu_metrics"] or "[]")
+            ]
+            host["tpu_chips"] = len(chips)
+            duties = [c.duty_cycle_pct for c in chips if c.duty_cycle_pct is not None]
+            if duties:
+                host["tpu_duty_cycle_percent"] = sum(duties) / len(duties)
+            used = [c.hbm_used_bytes for c in chips if c.hbm_used_bytes is not None]
+            if used:
+                host["tpu_hbm_usage_bytes"] = sum(used)
+            totals = [c.hbm_total_bytes for c in chips if c.hbm_total_bytes is not None]
+            if totals:
+                host["tpu_hbm_total_bytes"] = sum(totals)
+        hosts.append(host)
+    return {"hosts": hosts}

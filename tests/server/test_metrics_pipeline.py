@@ -1,0 +1,115 @@
+"""TPU telemetry end-to-end (VERDICT r2 #3): runner collects duty/HBM via
+the injected metrics command, process_metrics stores points, and the run
+metrics endpoint + `stats` CLI render nonzero TPU columns.
+"""
+
+import json
+import time
+
+import pytest
+
+from dstack_tpu.api import Client
+from dstack_tpu.models.runs import RunStatus
+
+from tests.server.test_sdk import LiveServer
+
+
+@pytest.fixture()
+def telemetry_server(tmp_path, monkeypatch):
+    payload = [
+        {"chip_index": 0, "duty_cycle_pct": 80.0,
+         "hbm_used_bytes": 4 * 2**30, "hbm_total_bytes": 16 * 2**30},
+        {"chip_index": 1, "duty_cycle_pct": 60.0,
+         "hbm_used_bytes": 2 * 2**30, "hbm_total_bytes": 16 * 2**30},
+    ]
+    script = tmp_path / "fake_tpu_metrics.sh"
+    script.write_text(f"#!/bin/sh\necho '{json.dumps(payload)}'\n")
+    script.chmod(0o755)
+    # Spawned runners inherit the test process env; the server's collector
+    # interval is shortened so the e2e completes quickly.
+    monkeypatch.setenv("DSTACK_TPU_METRICS_CMD", str(script))
+    from dstack_tpu.server import settings
+
+    monkeypatch.setattr(settings, "PROCESS_METRICS_INTERVAL", 0.5)
+    srv = LiveServer().start()
+    yield srv
+    srv.stop()
+
+
+def test_tpu_metrics_flow_to_stats(telemetry_server):
+    client = Client(server_url=telemetry_server.url,
+                    token=telemetry_server.admin_token, project_name="main")
+    run = client.runs.submit(
+        {"type": "task", "commands": ["sleep 30"],
+         "resources": {"cpu": "1..", "memory": "0.1.."}},
+        run_name="telemetry-run",
+    )
+    run.wait(statuses=[RunStatus.RUNNING], timeout=60)
+
+    # Collector needs >= 2 samples for CPU%; duty/HBM need one.
+    deadline = time.time() + 30
+    hosts = []
+    while time.time() < deadline:
+        data = client.api.metrics.get_run_metrics(client.project, "telemetry-run")
+        hosts = data["hosts"]
+        if hosts and hosts[0]["tpu_duty_cycle_percent"] is not None:
+            break
+        time.sleep(0.5)
+    assert hosts, "no hosts in run metrics"
+    host = hosts[0]
+    assert host["tpu_chips"] == 2
+    assert host["tpu_duty_cycle_percent"] == pytest.approx(70.0)  # mean(80, 60)
+    assert host["tpu_hbm_usage_bytes"] == 6 * 2**30  # sum
+    assert host["tpu_hbm_total_bytes"] == 32 * 2**30
+    assert host["memory_usage_bytes"] is not None
+
+    # The per-job window endpoint carries the raw chips too.
+    jm = client.api.metrics.get_job_metrics(client.project, "telemetry-run")
+    assert jm["points"][0]["tpu_chips"][0]["duty_cycle_pct"] in (80.0, 60.0)
+
+    run.stop()
+    run.wait(timeout=60)
+    client.api.close()
+
+
+def test_stats_cli_renders_tpu_columns(telemetry_server, monkeypatch):
+    from click.testing import CliRunner
+
+    from dstack_tpu.cli.main import cli
+
+    client = Client(server_url=telemetry_server.url,
+                    token=telemetry_server.admin_token, project_name="main")
+    run = client.runs.submit(
+        {"type": "task", "commands": ["sleep 30"],
+         "resources": {"cpu": "1..", "memory": "0.1.."}},
+        run_name="stats-cli-run",
+    )
+    run.wait(statuses=[RunStatus.RUNNING], timeout=60)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        data = client.api.metrics.get_run_metrics(client.project, "stats-cli-run")
+        if data["hosts"] and data["hosts"][0]["tpu_duty_cycle_percent"] is not None:
+            break
+        time.sleep(0.5)
+
+    import tempfile
+    from pathlib import Path
+
+    import dstack_tpu.api.config as cfgmod
+
+    monkeypatch.setattr(cfgmod, "DEFAULT_CONFIG_DIR", Path(tempfile.mkdtemp()))
+    runner_cli = CliRunner()
+    r = runner_cli.invoke(
+        cli, ["config", "--project", "main", "--url", telemetry_server.url,
+              "--token", telemetry_server.admin_token])
+    assert r.exit_code == 0, r.output
+    r = runner_cli.invoke(cli, ["stats", "stats-cli-run"])
+    assert r.exit_code == 0, r.output
+    # Duty cycle 70% and HBM 6.00GB/32GB actually render (the round-2 gap:
+    # the columns existed but were permanently blank).
+    assert "70%" in r.output
+    assert "6.00GB/32GB" in r.output
+
+    run.stop()
+    run.wait(timeout=60)
+    client.api.close()

@@ -309,6 +309,71 @@ class TestRunner:
         assert "cpu_usage_micro" in resp
 
 
+class TestRunnerTelemetry:
+    """The C++ runner's TPU telemetry layers, driven over /api/metrics
+    against the real binary (parity: metrics.go:31-160)."""
+
+    def _start_with_env(self, binaries, extra_env):
+        import os
+
+        env = dict(os.environ, **extra_env)
+        proc = subprocess.Popen(
+            [str(binaries["runner"]), "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        )
+        line = proc.stdout.readline().decode()
+        port = int(re.search(r":(\d+)", line).group(1))
+        return proc, port
+
+    def test_metrics_cmd_injection(self, binaries, tmp_path):
+        payload = ('[{"chip_index": 0, "duty_cycle_pct": 91.5, '
+                   '"hbm_used_bytes": 1073741824, "hbm_total_bytes": 2147483648}]')
+        script = tmp_path / "m.sh"
+        script.write_text(f"#!/bin/sh\necho '{payload}'\n")
+        script.chmod(0o755)
+        proc, port = self._start_with_env(
+            binaries, {"DSTACK_TPU_METRICS_CMD": str(script)}
+        )
+        try:
+            m = _req("GET", f"http://127.0.0.1:{port}/api/metrics")
+            assert m["tpu_chips"] == [
+                {"chip_index": 0, "duty_cycle_pct": 91.5,
+                 "hbm_used_bytes": 1073741824, "hbm_total_bytes": 2147483648}
+            ]
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_tpu_info_table_parsed(self, binaries, tmp_path):
+        """A fake tpu-info on PATH exercises the C++ table parser."""
+        fake = tmp_path / "tpu-info"
+        fake.write_text(
+            "#!/bin/sh\n"
+            "cat <<'EOF'\n"
+            "TPU Runtime Utilization\n"
+            "┃ Device ┃ Memory usage ┃ Duty cycle ┃\n"
+            "│ 0      │ 2.00 GiB / 16.00 GiB │     75.50% │\n"
+            "│ 1      │ 0.50 GiB / 16.00 GiB │      5.00% │\n"
+            "EOF\n"
+        )
+        fake.chmod(0o755)
+        import os
+
+        proc, port = self._start_with_env(
+            binaries, {"PATH": f"{tmp_path}:{os.environ['PATH']}"}
+        )
+        try:
+            m = _req("GET", f"http://127.0.0.1:{port}/api/metrics")
+            chips = m["tpu_chips"]
+            assert len(chips) == 2
+            assert chips[0]["duty_cycle_pct"] == 75.5
+            assert chips[0]["hbm_used_bytes"] == 2 * 2**30
+            assert chips[1]["chip_index"] == 1
+        finally:
+            proc.kill()
+            proc.wait()
+
+
 class TestShim:
     @pytest.fixture
     def shim(self, binaries):

@@ -1,0 +1,78 @@
+"""TPU telemetry: duty cycle + HBM collection and parsing (VERDICT r2 #3).
+
+Unit-level: the tpu-info table parser and the DSTACK_TPU_METRICS_CMD
+injection layer (dstack_tpu/agents/tpu_telemetry.py). The C++ twin is
+covered in tests/test_native_agents.py against the real binary; the
+end-to-end pipeline (runner -> process_metrics -> stats endpoint) in
+tests/server/test_metrics_pipeline.py.
+"""
+
+import json
+
+from dstack_tpu.agents.tpu_telemetry import collect_tpu_metrics, parse_tpu_info_table
+
+# Realistic `tpu-info` output (rich box-drawing table, v5e host).
+TPU_INFO_SAMPLE = """\
+TPU Chips
+┏━━━━━━━━━━━━┳━━━━━━━━━━━━━┳━━━━━━━━━┳━━━━━━━━┓
+┃ Chip       ┃ Type        ┃ Devices ┃ PID    ┃
+┡━━━━━━━━━━━━╇━━━━━━━━━━━━━╇━━━━━━━━━╇━━━━━━━━┩
+│ /dev/accel0 │ TPU v5e    │ 1       │ 1234   │
+│ /dev/accel1 │ TPU v5e    │ 1       │ 1234   │
+└────────────┴─────────────┴─────────┴────────┘
+TPU Runtime Utilization
+┏━━━━━━━━┳━━━━━━━━━━━━━━━━━━━━━━┳━━━━━━━━━━━━┓
+┃ Device ┃ Memory usage         ┃ Duty cycle ┃
+┡━━━━━━━━╇━━━━━━━━━━━━━━━━━━━━━━╇━━━━━━━━━━━━┩
+│ 0      │ 8.50 GiB / 15.75 GiB │     97.30% │
+│ 1      │ 0.25 GiB / 15.75 GiB │      3.00% │
+└────────┴──────────────────────┴────────────┘
+"""
+
+
+def test_parse_tpu_info_table():
+    chips = parse_tpu_info_table(TPU_INFO_SAMPLE)
+    assert len(chips) == 2
+    assert chips[0].chip_index == 0
+    assert chips[0].duty_cycle_pct == 97.3
+    assert chips[0].hbm_used_bytes == int(8.5 * 2**30)
+    assert chips[0].hbm_total_bytes == int(15.75 * 2**30)
+    assert chips[1].chip_index == 1
+    assert chips[1].duty_cycle_pct == 3.0
+
+
+def test_parse_tpu_info_plain_ascii_variant():
+    # Older builds print plain pipes; the parser must not depend on the
+    # exact box-drawing characters.
+    text = "| 3 | 1.00 GiB / 31.25 GiB | 42.5% |"
+    chips = parse_tpu_info_table(text)
+    assert len(chips) == 1
+    assert chips[0].chip_index == 3
+    assert chips[0].duty_cycle_pct == 42.5
+
+
+def test_parse_ignores_non_metric_lines():
+    assert parse_tpu_info_table("TPU Chips\nno data here\n") == []
+
+
+def test_metrics_cmd_injection(monkeypatch, tmp_path):
+    payload = [
+        {"chip_index": 0, "duty_cycle_pct": 88.0,
+         "hbm_used_bytes": 7 * 2**30, "hbm_total_bytes": 16 * 2**30}
+    ]
+    script = tmp_path / "fake_metrics.sh"
+    script.write_text(f"#!/bin/sh\necho '{json.dumps(payload)}'\n")
+    script.chmod(0o755)
+    monkeypatch.setenv("DSTACK_TPU_METRICS_CMD", str(script))
+    chips = collect_tpu_metrics()
+    assert len(chips) == 1
+    assert chips[0].duty_cycle_pct == 88.0
+    assert chips[0].hbm_used_bytes == 7 * 2**30
+
+
+def test_metrics_cmd_failure_degrades(monkeypatch):
+    monkeypatch.setenv("DSTACK_TPU_METRICS_CMD", "false")
+    # Falls through to tpu-info (absent) then /dev/accel* (absent here):
+    # presence-only or empty, but never an exception.
+    chips = collect_tpu_metrics()
+    assert isinstance(chips, list)
