@@ -29,7 +29,12 @@ bool from_env_cmd(Json* out) {
   const char* cmd = getenv("DSTACK_TPU_METRICS_CMD");
   if (!cmd || !*cmd) return false;
   std::string text;
-  if (run_command({"/bin/sh", "-c", cmd}, &text, 10) != 0) return false;
+  // run_command merges stdout+stderr into one pipe; drop stderr in the
+  // shell so a warning line can't corrupt the JSON (the Python twin
+  // captures the streams separately and parses stdout only).
+  if (run_command({"/bin/sh", "-c", std::string(cmd) + " 2>/dev/null"},
+                  &text, 10) != 0)
+    return false;
   try {
     Json parsed = Json::parse(text);
     if (!parsed.is_array()) return false;
@@ -68,14 +73,20 @@ Json parse_tpu_info_table(const std::string& text) {
     line = ascii.substr(start, end - start);
     std::smatch m;
     if (std::regex_search(line, m, row_re)) {
-      Json c = Json::object();
-      c.set("chip_index", static_cast<int64_t>(std::stoll(m[1].str())));
-      c.set("hbm_used_bytes",
-            static_cast<int64_t>(std::stod(m[2].str()) * kGiB));
-      c.set("hbm_total_bytes",
-            static_cast<int64_t>(std::stod(m[3].str()) * kGiB));
-      c.set("duty_cycle_pct", std::stod(m[4].str()));
-      chips.push_back(c);
+      // stoll/stod can throw on degenerate matches (lone '.', overflowing
+      // index); a malformed row must be skipped, never crash the agent
+      // (the header promises no-throw).
+      try {
+        Json c = Json::object();
+        c.set("chip_index", static_cast<int64_t>(std::stoll(m[1].str())));
+        c.set("hbm_used_bytes",
+              static_cast<int64_t>(std::stod(m[2].str()) * kGiB));
+        c.set("hbm_total_bytes",
+              static_cast<int64_t>(std::stod(m[3].str()) * kGiB));
+        c.set("duty_cycle_pct", std::stod(m[4].str()));
+        chips.push_back(c);
+      } catch (...) {
+      }
     }
     start = end + 1;
   }

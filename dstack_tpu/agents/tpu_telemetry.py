@@ -14,7 +14,6 @@ nvidia-smi/amd-smi/hl-smi and parses the table. Chips-first equivalent:
 import json
 import os
 import re
-import shlex
 import subprocess
 from typing import List, Optional
 
@@ -44,8 +43,10 @@ def _from_env_cmd(timeout: float) -> Optional[List[TpuChipMetrics]]:
     if not cmd:
         return None
     try:
+        # shell=True to match the C++ twin (/bin/sh -c): pipelines in the
+        # command must behave identically on both runners.
         out = subprocess.run(
-            shlex.split(cmd), capture_output=True, text=True, timeout=timeout
+            cmd, shell=True, capture_output=True, text=True, timeout=timeout
         )
         if out.returncode != 0:
             return None

@@ -34,7 +34,7 @@ from dstack_tpu.models.volumes import (
     VolumeAttachmentData,
     VolumeProvisioningData,
 )
-from dstack_tpu.utils.ssh import find_free_port
+from dstack_tpu.utils.ssh import find_free_ports
 
 
 class LocalBackendConfig(CoreModel):
@@ -93,21 +93,35 @@ class LocalCompute(Compute):
         env: Optional[Dict[str, str]] = None,
     ) -> List[JobProvisioningData]:
         out: List[JobProvisioningData] = []
+        # -S skips site init: this environment's sitecustomize imports jax
+        # at interpreter start (~3s); the runner agent doesn't need it, and
+        # on real hosts the C++ runner starts in milliseconds. PYTHONPATH
+        # re-adds what site would have provided.
+        pythonpath = os.pathsep.join(p for p in sys.path if p)
+        spawned = []
+        # Distinct ports up front (held-socket allocation): with parallel
+        # boot, per-worker find_free_port could hand two workers the same
+        # port before either runner binds.
+        ports = find_free_ports(offer.hosts)
         for worker in range(offer.hosts):
-            port = find_free_port()
+            port = ports[worker]
             proc = subprocess.Popen(
                 [
-                    sys.executable, "-m", "dstack_tpu.agents.runner",
+                    sys.executable, "-S", "-m", "dstack_tpu.agents.runner",
                     "--host", "127.0.0.1", "--port", str(port),
                 ],
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
-                env={**os.environ, **(env or {})},
+                env={**os.environ, **(env or {}), "PYTHONPATH": pythonpath},
                 start_new_session=True,
             )
             instance_id = f"local-{proc.pid}"
             self._procs[instance_id] = proc
-            await self._wait_port(port)
+            spawned.append((worker, port, proc, instance_id))
+        # All workers of the slice boot in parallel — the real GCP path
+        # provisions one TPU node object whose workers come up together.
+        await asyncio.gather(*(self._wait_port(p) for _, p, _p2, _i in spawned))
+        for worker, port, proc, instance_id in spawned:
             out.append(
                 JobProvisioningData(
                     backend=BackendType.LOCAL,

@@ -165,8 +165,22 @@ async def ssh_execute(target: SSHTarget, command: str, timeout: float = 60.0) ->
 
 
 def find_free_port() -> int:
+    return find_free_ports(1)[0]
+
+
+def find_free_ports(n: int) -> "list[int]":
+    """n distinct free ports. All sockets are held open until every port is
+    chosen — closing between picks would let the kernel hand the same port
+    out twice (the race parallel worker spawn would otherwise hit)."""
     import socket
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()

@@ -1,0 +1,206 @@
+"""North-star latency probe: submit -> first-step per FSM stage.
+
+Measures the control-plane's share of the "`apply` -> first training step
+< 5 min" target (BASELINE.md) on the local backend, where cloud boot and
+image pull are out of the picture and ONLY orchestrator latency remains —
+submit, run FSM, instance provision+handshake, runner submit, first output.
+
+Two modes, same workload:
+  event-driven  — the shipped design: background loops wake on ctx.kick()
+                  the instant upstream state changes (background/__init__.py)
+  polling       — the reference's design, simulated: kicks disabled, loops
+                  tick at the reference's intervals (2s runs / 4s jobs,
+                  APScheduler parity: reference background/__init__.py:47-76)
+
+Emits ONE JSON document (LATENCY_r03.json via --out): per-stage timings for
+both modes, single-host and a 4-host v5litepod-16 gang.
+
+Run: python latency_probe.py [--out LATENCY_r03.json] [--runs 3]
+"""
+
+import argparse
+import asyncio
+import json
+import statistics
+import threading
+import time
+
+
+class ProbeServer:
+    """In-process server on a real socket, optionally polling-mode."""
+
+    def __init__(self, polling: bool):
+        self.polling = polling
+        self.url = None
+        self.token = None
+        self._loop = None
+        self._stop = None
+        self._thread = None
+
+    def start(self):
+        from dstack_tpu.server import settings
+
+        if self.polling:
+            # Reference cadence (background/__init__.py:47-76 of the ref).
+            settings.PROCESS_RUNS_INTERVAL = 2.0
+            settings.PROCESS_JOBS_INTERVAL = 4.0
+            settings.PROCESS_INSTANCES_INTERVAL = 4.0
+        else:
+            settings.PROCESS_RUNS_INTERVAL = 1.0
+            settings.PROCESS_JOBS_INTERVAL = 1.0
+            settings.PROCESS_INSTANCES_INTERVAL = 2.0
+        started = threading.Event()
+
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                from dstack_tpu.server.app import create_app
+                from dstack_tpu.server.http import Server
+
+                app = create_app(db_path=":memory:")
+                server = Server(app, "127.0.0.1", 0)
+                await server.start()
+                ctx = app.state["ctx"]
+                # Let the local backend advertise multi-host TPU slices.
+                ctx.overrides["local_backend_config"] = {"tpu_sim": ["v5litepod-16"]}
+                if self.polling:
+                    ctx.kick = lambda channel: None  # reference has no kicks
+                self.url = f"http://127.0.0.1:{server.port}"
+                self.token = app.state["admin_token"]
+                return server
+
+            server = self._loop.run_until_complete(boot())
+            self._stop = asyncio.Event()
+            started.set()
+            self._loop.run_until_complete(self._stop.wait())
+            self._loop.run_until_complete(server.stop())
+            self._loop.close()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        if not started.wait(20):
+            raise RuntimeError("probe server did not start")
+        return self
+
+    def stop(self):
+        if self._loop and self._stop:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=20)
+
+
+def measure_run(client, config, run_name, timeout=180.0):
+    """Submit and sample status at ~10ms; return per-stage offsets (s)."""
+    from dstack_tpu.models.runs import RunStatus
+
+    t0 = time.perf_counter()
+    plan = client.runs.get_plan(config, run_name=run_name)
+    t_plan = time.perf_counter() - t0
+    run = client.runs.exec_plan(plan)
+    t_submit = time.perf_counter() - t0
+
+    stages = {}
+    terminal = {RunStatus.DONE, RunStatus.FAILED, RunStatus.TERMINATED}
+    deadline = t0 + timeout
+    status = None
+    while time.perf_counter() < deadline:
+        run.refresh()
+        status = run.status
+        key = status.value
+        if key not in stages:
+            stages[key] = time.perf_counter() - t0
+        if status in terminal:
+            break
+        time.sleep(0.01)
+    if status not in terminal:
+        raise TimeoutError(f"{run_name} stuck in {status}")
+
+    # First log line arrival (the job echoes immediately -> proxy for
+    # "first training step started").
+    t_first_log = None
+    log_deadline = time.perf_counter() + 30
+    while time.perf_counter() < log_deadline:
+        if any(True for _ in run.logs()):
+            t_first_log = time.perf_counter() - t0
+            break
+        time.sleep(0.01)
+    return {
+        "plan_s": round(t_plan, 3),
+        "submit_s": round(t_submit, 3),
+        "stages_s": {k: round(v, 3) for k, v in stages.items()},
+        "first_log_s": round(t_first_log, 3) if t_first_log else None,
+        "final_status": status.value,
+    }
+
+
+def probe_mode(polling: bool, n_runs: int):
+    from dstack_tpu.api import Client
+
+    srv = ProbeServer(polling).start()
+    try:
+        client = Client(server_url=srv.url, token=srv.token, project_name="main")
+        single = {"type": "task", "commands": ["echo first-step"],
+                  "resources": {"cpu": "1..", "memory": "0.1.."}}
+        gang = {"type": "task", "commands": ["echo gang-step rank=$JAX_PROCESS_ID"],
+                "resources": {"tpu": "v5litepod-16", "memory": "0.1.."}}
+        out = {"single_host": [], "gang_4host": []}
+        for i in range(n_runs):
+            out["single_host"].append(
+                measure_run(client, single, f"lat-single-{i}"))
+        for i in range(n_runs):
+            out["gang_4host"].append(
+                measure_run(client, gang, f"lat-gang-{i}"))
+        client.api.close()
+        return out
+    finally:
+        srv.stop()
+
+
+def summarize(samples):
+    firsts = [s["first_log_s"] for s in samples if s["first_log_s"]]
+    runnings = [s["stages_s"].get("running") for s in samples]
+    runnings = [r for r in runnings if r is not None]
+    return {
+        "submit_to_running_s": round(statistics.median(runnings), 3) if runnings else None,
+        "submit_to_first_log_s": round(statistics.median(firsts), 3) if firsts else None,
+        "samples": len(samples),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="LATENCY_r03.json")
+    parser.add_argument("--runs", type=int, default=3)
+    args = parser.parse_args()
+
+    result = {"meta": {
+        "workloads": {
+            "single_host": "1-host cpu task",
+            "gang_4host": "v5litepod-16 = 4-host gang, full JAX env injection",
+        },
+        "target": "apply->first step < 5 min (BASELINE.md); local backend "
+                  "isolates orchestrator latency (no cloud boot/image pull)",
+    }}
+    for mode, polling in (("event_driven", False), ("polling_reference", True)):
+        runs = probe_mode(polling, args.runs)
+        result[mode] = {
+            "single_host": {"summary": summarize(runs["single_host"]),
+                            "runs": runs["single_host"]},
+            "gang_4host": {"summary": summarize(runs["gang_4host"]),
+                           "runs": runs["gang_4host"]},
+        }
+    ev = result["event_driven"]["gang_4host"]["summary"]["submit_to_first_log_s"]
+    poll = result["polling_reference"]["gang_4host"]["summary"]["submit_to_first_log_s"]
+    result["speedup_gang_first_log"] = round(poll / ev, 2) if ev and poll else None
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps({
+        "event_driven_gang_first_log_s": ev,
+        "polling_gang_first_log_s": poll,
+        "speedup": result["speedup_gang_first_log"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Smoke test for the north-star latency probe (VERDICT r2 #4): the
+instrumentation itself must keep working — LATENCY_r{N}.json is a driver
+artifact. Runs one event-driven single-host measurement only (the full
+A/B incl. reference-style polling takes minutes; `python latency_probe.py`
+produces the artifact)."""
+
+from latency_probe import ProbeServer, measure_run
+
+
+def test_probe_measures_stages():
+    srv = ProbeServer(polling=False).start()
+    try:
+        from dstack_tpu.api import Client
+
+        client = Client(server_url=srv.url, token=srv.token, project_name="main")
+        result = measure_run(
+            client,
+            {"type": "task", "commands": ["echo first-step"],
+             "resources": {"cpu": "1..", "memory": "0.1.."}},
+            "probe-smoke",
+        )
+        client.api.close()
+    finally:
+        srv.stop()
+    assert result["final_status"] == "done"
+    assert result["submit_s"] < 1.0
+    assert "running" in result["stages_s"]
+    assert result["first_log_s"] is not None
+    # The event-driven scheduler's whole point: no 4s-poll staircase on the
+    # critical path. Runner boot (~1s, python) dominates; anything beyond
+    # ~5s means kicks are broken and transitions wait out poll intervals.
+    assert result["stages_s"]["running"] < 5.0, result
