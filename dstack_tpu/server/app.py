@@ -25,6 +25,7 @@ def create_app(
     db_path: Optional[str] = None,
     admin_token: Optional[str] = None,
     run_background_tasks: bool = True,
+    server_config_path: Optional[str] = None,
 ) -> App:
     app = App()
     db = Database(db_path or ":memory:")
@@ -67,27 +68,54 @@ def create_app(
         if db.path != ":memory:":
             Path(db.path).parent.mkdir(parents=True, exist_ok=True)
         await db.connect()
+        from dstack_tpu.server.services import config as config_service
         from dstack_tpu.server.services import logs as logs_service
         from dstack_tpu.server.services import projects as projects_service
         from dstack_tpu.server.services import users as users_service
+
+        # Config file: resolve path; the encryption key in it must be
+        # installed before anything writes encrypted rows. The default
+        # (home-dir) path only applies to persistent servers — an in-memory
+        # server is a test/ephemeral boot and must not pick up the
+        # operator's real ~/.dstack-tpu/server/config.yml.
+        import os
+
+        config_path: Optional[Path] = None
+        if server_config_path:
+            config_path = Path(server_config_path)
+        elif os.environ.get("DSTACK_TPU_SERVER_CONFIG"):
+            config_path = Path(os.environ["DSTACK_TPU_SERVER_CONFIG"]).expanduser()
+        elif db.path != ":memory:":
+            config_path = config_service.DEFAULT_CONFIG_PATH
+        config_manager = (
+            config_service.ServerConfigManager(config_path) if config_path else None
+        )
+        if config_manager is not None and config_manager.load():
+            config_manager.apply_encryption(ctx)
 
         ctx.log_storage = logs_service.default_log_storage(ctx)
         admin = await users_service.get_or_create_admin(
             ctx, admin_token or settings.SERVER_ADMIN_TOKEN
         )
         app.state["admin_token"] = admin.creds.token
+        from dstack_tpu.models.users import User
+
+        admin_user = User(**{k: v for k, v in admin.model_dump().items() if k != "creds"})
         try:
             await projects_service.get_project(ctx, settings.DEFAULT_PROJECT_NAME)
         except Exception:
-            from dstack_tpu.models.users import User
-
-            admin_user = User(**{k: v for k, v in admin.model_dump().items() if k != "creds"})
             await projects_service.create_project(
                 ctx, admin_user, settings.DEFAULT_PROJECT_NAME
             )
+        if config_manager is not None:
+            await config_manager.apply_projects(ctx, admin_user)
         from dstack_tpu.server.services import backends as backends_service
 
         await backends_service.init_backends(ctx)
+        if config_manager is not None and db.path != ":memory:":
+            # Real servers keep the file in sync so first boot leaves a
+            # template; in-memory (test) servers never touch the home dir.
+            await config_manager.sync_from_db(ctx)
         if run_background_tasks:
             from dstack_tpu.server.background import start_background_tasks
 

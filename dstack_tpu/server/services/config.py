@@ -1,0 +1,128 @@
+"""Server config manager: `~/.dstack-tpu/server/config.yml` applied at boot.
+
+Parity: src/dstack/_internal/server/services/config.py — the file-based
+config tier between env vars and the REST API. A server booted with a
+config file serves fully configured projects/backends with no API calls;
+the file is also (re)generated with the current state so hand edits and
+API edits converge.
+
+Format:
+    encryption:
+      keys:
+        - type: aes
+          secret: <base64 key>   # first aes key becomes the active one
+    projects:
+      - name: main
+        backends:
+          - type: gcp
+            project_id: my-project
+            regions: [us-central2]
+"""
+
+import logging
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.users import User
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import Encryption
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CONFIG_PATH = settings.SERVER_DIR_PATH / "config.yml"
+
+
+class ServerConfigManager:
+    def __init__(self, path: Optional[Path] = None):
+        self.path = Path(path) if path else DEFAULT_CONFIG_PATH
+        self.config: Dict[str, Any] = {}
+
+    def load(self) -> bool:
+        """Read the file; False if absent. Raises on unparseable YAML — a
+        server must not silently boot with half its projects missing."""
+        if not self.path.is_file():
+            return False
+        loaded = yaml.safe_load(self.path.read_text())
+        if loaded is None:
+            return False
+        if not isinstance(loaded, dict):
+            raise ValueError(f"{self.path}: top level must be a mapping")
+        self.config = loaded
+        return True
+
+    def apply_encryption(self, ctx: ServerContext) -> None:
+        """Install the configured AES key (wins over the env var). Must run
+        before any DB writes that encrypt."""
+        for key in (self.config.get("encryption") or {}).get("keys") or []:
+            if key.get("type") == "aes" and key.get("secret"):
+                ctx.encryption = Encryption(key["secret"])
+                return
+
+    async def apply_projects(self, ctx: ServerContext, admin: User) -> None:
+        """Create configured projects and upsert their backends."""
+        from dstack_tpu.server.services import backends as backends_service
+        from dstack_tpu.server.services import projects as projects_service
+
+        for entry in self.config.get("projects") or []:
+            name = entry.get("name")
+            if not name:
+                logger.warning("config.yml: project entry without a name; skipped")
+                continue
+            try:
+                project = await projects_service.get_project(ctx, name)
+            except Exception:
+                project = await projects_service.create_project(ctx, admin, name)
+            project_row = await ctx.db.fetchone(
+                "SELECT id FROM projects WHERE name = ?", (name,)
+            )
+            for backend_conf in entry.get("backends") or []:
+                conf = dict(backend_conf)
+                btype = conf.pop("type", None)
+                if not btype:
+                    logger.warning(
+                        "config.yml: backend without a type in project %s", name
+                    )
+                    continue
+                try:
+                    await backends_service.create_backend(
+                        ctx, project_row["id"], BackendType(btype), conf
+                    )
+                    logger.info("config.yml: configured %s backend for %s", btype, name)
+                except Exception as e:
+                    # One broken backend must not block the rest of boot,
+                    # but it must be loud.
+                    logger.error(
+                        "config.yml: backend %s of project %s rejected: %s",
+                        btype, name, e,
+                    )
+
+    async def sync_from_db(self, ctx: ServerContext) -> None:
+        """Regenerate the file from current DB state (projects + backend
+        types; creds stay in the file only if they were there). Creates the
+        default file on first boot so users have a template to edit."""
+        projects: List[Dict[str, Any]] = []
+        existing = {p.get("name"): p for p in self.config.get("projects") or []}
+        rows = await ctx.db.fetchall("SELECT * FROM projects ORDER BY name")
+        for row in rows:
+            entry = existing.get(row["name"], {"name": row["name"]})
+            backend_rows = await ctx.db.fetchall(
+                "SELECT type FROM backends WHERE project_id = ?", (row["id"],)
+            )
+            known = {b.get("type") for b in entry.get("backends") or []}
+            for b in backend_rows:
+                if b["type"] not in known:
+                    entry.setdefault("backends", []).append({"type": b["type"]})
+            projects.append(entry)
+        self.config["projects"] = projects
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        # Atomic replace: this file may hold the only copy of the encryption
+        # key — a crash mid-write must never truncate it.
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(yaml.safe_dump(self.config, sort_keys=False))
+        tmp.rename(self.path)
+
+
