@@ -11,11 +11,13 @@ Run: python -m dstack_tpu.gateway.app --port 8001
 
 import argparse
 import asyncio
+import json
 import logging
 import time
 from pathlib import Path
 from typing import Dict, List, Optional
 
+from dstack_tpu.gateway.connections import ReplicaInfo, ServiceConnectionPool
 from dstack_tpu.gateway.nginx import NginxManager, SiteConfig, Upstream
 from dstack_tpu.server.http import App, Request, Response, Router, Server
 
@@ -25,9 +27,78 @@ ACCESS_LOG = Path("/var/log/nginx/dstack.access.log")
 
 
 class Registry:
-    def __init__(self, nginx: Optional[NginxManager] = None):
+    def __init__(
+        self,
+        nginx: Optional[NginxManager] = None,
+        tunnel_factory=None,
+        state_path: Optional[Path] = None,
+    ):
         self.nginx = nginx or NginxManager()
         self.services: Dict[str, dict] = {}  # "{project}/{run}" -> info
+        # Tunnels to replicas that are only reachable over SSH; nginx
+        # upstreams point at the tunnel's unix socket.
+        self.connections = ServiceConnectionPool(tunnel_factory)
+        # Registry state is in-memory; persisting it lets a restarted
+        # gateway (blue/green update, crash) restore routing and reopen
+        # tunnels without waiting for the server to re-register everything.
+        self.state_path = state_path
+        self._restoring = False
+
+    def _save_state(self) -> None:
+        # During restore() each partial registration would snapshot only the
+        # restored prefix; a crash mid-restore would then lose the rest.
+        if self.state_path is None or self._restoring:
+            return
+        state = {
+            "services": [
+                {
+                    **{k: v for k, v in info.items()
+                       if k not in ("auth_tokens", "replicas", "replica_defs")},
+                    "auth_tokens": sorted(info["auth_tokens"]),
+                    # Persist replica *definitions* (ssh coordinates or plain
+                    # address), not resolved socket paths — sockets die with
+                    # the tunnels.
+                    "replicas": info.get("replica_defs", {}),
+                }
+                for info in self.services.values()
+            ]
+        }
+        self.state_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.state_path.with_suffix(".tmp")
+        # 0600 from the first byte: replica defs carry ssh private keys.
+        import os
+
+        fd = os.open(str(tmp), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(state))
+        tmp.rename(self.state_path)
+
+    async def restore(self) -> None:
+        """Rebuild services, tunnels and nginx configs from the state file."""
+        if self.state_path is None or not self.state_path.exists():
+            return
+        state = json.loads(self.state_path.read_text())
+        self._restoring = True
+        try:
+            for svc in state.get("services", []):
+                self.register_service(
+                    svc["project_name"], svc["run_name"], svc["domain"],
+                    https=svc.get("https", False), auth=svc.get("auth", False),
+                    auth_tokens=svc.get("auth_tokens"), options=svc.get("options"),
+                )
+                for replica_id, rdef in (svc.get("replicas") or {}).items():
+                    try:
+                        await self.register_replica(
+                            svc["project_name"], svc["run_name"], replica_id,
+                            address=rdef.get("address"), ssh=rdef.get("ssh"),
+                        )
+                    except Exception as e:
+                        # A dead replica must not block restoring the others;
+                        # the server's next health pass re-registers survivors.
+                        logger.warning("could not restore replica %s: %s", replica_id, e)
+        finally:
+            self._restoring = False
+        self._save_state()
 
     def register_service(
         self,
@@ -40,6 +111,9 @@ class Registry:
         options: Optional[dict] = None,
     ) -> None:
         key = f"{project_name}/{run_name}"
+        # Registration is idempotent and runs once per replica transition:
+        # existing replicas must survive a re-register.
+        existing = self.services.get(key)
         self.services[key] = {
             "project_name": project_name,
             "run_name": run_name,
@@ -50,9 +124,11 @@ class Registry:
             # control-plane server (project member tokens).
             "auth_tokens": set(auth_tokens or []),
             "options": options or {},
-            "replicas": {},
+            "replicas": existing["replicas"] if existing else {},
+            "replica_defs": existing.get("replica_defs", {}) if existing else {},
         }
         self._apply(key)
+        self._save_state()
 
     def authorize(self, host: str, token: Optional[str]) -> bool:
         """auth_request decision for a request to `host` with bearer `token`."""
@@ -63,27 +139,63 @@ class Registry:
                 return bool(token) and token in info["auth_tokens"]
         return False  # unknown domain: deny
 
-    def register_replica(
-        self, project_name: str, run_name: str, replica_id: str, address: str
+    async def register_replica(
+        self,
+        project_name: str,
+        run_name: str,
+        replica_id: str,
+        address: Optional[str] = None,
+        ssh: Optional[dict] = None,
     ) -> None:
+        """`address` for directly-routable replicas; `ssh` (host/port/user/
+        private_key/app_port) for private replicas — the gateway opens a
+        tunnel and proxies through its unix socket."""
         key = f"{project_name}/{run_name}"
         if key not in self.services:
             raise KeyError(f"service {key} is not registered")
+        self.services[key].setdefault("replica_defs", {})[replica_id] = (
+            {"ssh": ssh} if ssh is not None else {"address": address}
+        )
+        if ssh is not None:
+            conn = await self.connections.add(
+                f"{key}/{replica_id}",
+                ReplicaInfo(
+                    replica_id=replica_id,
+                    app_port=int(ssh["app_port"]),
+                    ssh_host=ssh["host"],
+                    ssh_port=int(ssh.get("port", 22)),
+                    ssh_user=ssh.get("user", "root"),
+                    ssh_private_key=ssh.get("private_key"),
+                    ssh_proxy_host=ssh.get("proxy_host"),
+                    ssh_proxy_port=int(ssh.get("proxy_port", 22)),
+                )
+            )
+            address = f"unix:{conn.socket_path}"
+        if address is None:
+            self.services[key]["replica_defs"].pop(replica_id, None)
+            raise ValueError("either address or ssh is required")
         self.services[key]["replicas"][replica_id] = address
         self._apply(key)
+        self._save_state()
 
     def unregister_replica(self, project_name: str, run_name: str, replica_id: str) -> None:
         key = f"{project_name}/{run_name}"
         if key in self.services:
+            self.connections.remove(f"{key}/{replica_id}")
             self.services[key]["replicas"].pop(replica_id, None)
+            self.services[key].get("replica_defs", {}).pop(replica_id, None)
             self._apply(key)
+            self._save_state()
 
     def unregister_service(self, project_name: str, run_name: str) -> None:
         key = f"{project_name}/{run_name}"
         info = self.services.pop(key, None)
         if info:
+            for replica_id in list(info["replicas"]):
+                self.connections.remove(f"{key}/{replica_id}")
             site = self._site(info)
             self.nginx.remove(site.upstream_name)
+            self._save_state()
 
     def _site(self, info: dict) -> SiteConfig:
         return SiteConfig(
@@ -148,11 +260,14 @@ def create_gateway_app(registry: Optional[Registry] = None) -> App:
     async def register_replica(request: Request):
         b = request.json()
         try:
-            reg.register_replica(
-                b["project_name"], b["run_name"], b["replica_id"], b["address"]
+            await reg.register_replica(
+                b["project_name"], b["run_name"], b["replica_id"],
+                address=b.get("address"), ssh=b.get("ssh"),
             )
         except KeyError as e:
             return Response({"detail": str(e)}, status=404)
+        except ValueError as e:
+            return Response({"detail": str(e)}, status=400)
         return {}
 
     @router.post("/registry/replicas/unregister")
@@ -198,10 +313,24 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument(
+        "--state-file", default="/var/lib/dstack-tpu/gateway-state.json",
+        help="registry persistence; lets a restarted gateway restore routing",
+    )
+    parser.add_argument(
+        "--conf-dir", default=None,
+        help="nginx sites dir (default: /etc/nginx/sites-enabled)",
+    )
     args = parser.parse_args()
 
     async def _serve() -> None:
-        app = create_gateway_app()
+        nginx = NginxManager(conf_dir=Path(args.conf_dir)) if args.conf_dir else None
+        registry = Registry(nginx=nginx, state_path=Path(args.state_file))
+        try:
+            await registry.restore()
+        except Exception:
+            logger.exception("could not restore gateway state; starting empty")
+        app = create_gateway_app(registry)
         server = Server(app, args.host, args.port)
         await server.start()
         print(f"gateway listening on {args.host}:{server.port}", flush=True)

@@ -1,0 +1,152 @@
+"""Gateway→replica tunnel connections.
+
+Parity: src/dstack/_internal/proxy/lib/services/service_connection.py:35-100 —
+each registered replica that is only reachable over SSH gets a tunnel
+exposing its app port as a local unix socket; nginx upstreams point at the
+socket, so private-network replicas serve public traffic without opening any
+inbound port on the replica host.
+
+The tunnel transport is injectable: production uses `SSHTunnel` with a
+`SocketForward`; tests inject a loopback forwarder so the data path
+(unix socket → replica TCP) is exercised without sshd.
+"""
+
+import asyncio
+import logging
+import os
+import tempfile
+import threading
+from pathlib import Path
+from typing import Dict, Optional
+
+from dstack_tpu.utils.ssh import SocketForward, SSHTarget, SSHTunnel
+
+logger = logging.getLogger(__name__)
+
+OPEN_TUNNEL_TIMEOUT = 10.0
+
+
+class ReplicaInfo:
+    """Connection coordinates for one service replica."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        app_port: int,
+        ssh_host: Optional[str] = None,
+        ssh_port: int = 22,
+        ssh_user: str = "root",
+        ssh_private_key: Optional[str] = None,
+        ssh_proxy_host: Optional[str] = None,
+        ssh_proxy_port: int = 22,
+    ):
+        self.replica_id = replica_id
+        self.app_port = app_port
+        self.ssh_host = ssh_host
+        self.ssh_port = ssh_port
+        self.ssh_user = ssh_user
+        self.ssh_private_key = ssh_private_key
+        self.ssh_proxy_host = ssh_proxy_host
+        self.ssh_proxy_port = ssh_proxy_port
+
+
+class ServiceConnection:
+    """One tunnel: replica app port → local unix socket."""
+
+    def __init__(self, replica: ReplicaInfo, tunnel_factory=None):
+        self.replica = replica
+        # 0o755 so nginx's worker uid can traverse into the socket dir.
+        self._tmp = tempfile.TemporaryDirectory(prefix="dstack-svc-")
+        os.chmod(self._tmp.name, 0o755)
+        self.socket_path = str(Path(self._tmp.name) / "replica.sock")
+        self._tunnel_factory = tunnel_factory or self._ssh_tunnel
+        self._tunnel = None
+
+    def _ssh_tunnel(self, replica: ReplicaInfo, socket_path: str):
+        proxy = (
+            SSHTarget(
+                hostname=replica.ssh_proxy_host,
+                username=replica.ssh_user,
+                port=replica.ssh_proxy_port,
+                private_key=replica.ssh_private_key,
+            )
+            if replica.ssh_proxy_host
+            else None
+        )
+        return SSHTunnel(
+            SSHTarget(
+                hostname=replica.ssh_host,
+                username=replica.ssh_user,
+                port=replica.ssh_port,
+                private_key=replica.ssh_private_key,
+                proxy=proxy,
+            ),
+            forwards=[],
+            socket_forwards=[
+                SocketForward(
+                    local_socket=self.socket_path,
+                    remote_host="localhost",
+                    remote_port=replica.app_port,
+                )
+            ],
+        )
+
+    async def open(self) -> None:
+        self._tunnel = self._tunnel_factory(self.replica, self.socket_path)
+        await self._tunnel.open(timeout=OPEN_TUNNEL_TIMEOUT)
+
+    async def is_alive(self) -> bool:
+        """Probe the socket: a dead ssh process leaves a socket file that
+        refuses connections."""
+        try:
+            _, writer = await asyncio.wait_for(
+                asyncio.open_unix_connection(self.socket_path), timeout=2.0
+            )
+            writer.close()
+            return True
+        except (OSError, asyncio.TimeoutError):
+            return False
+
+    def close(self) -> None:
+        tunnel, self._tunnel = self._tunnel, None
+        if tunnel is not None:
+            # tunnel.close() can block up to 5s waiting on the ssh process;
+            # handlers call this from the event loop, so wait off-thread.
+            threading.Thread(target=tunnel.close, daemon=True).start()
+        self._tmp.cleanup()
+
+
+class ServiceConnectionPool:
+    """Connection key ("{project}/{run}/{replica_id}") → open
+    ServiceConnection; one tunnel per replica per service."""
+
+    def __init__(self, tunnel_factory=None):
+        self._tunnel_factory = tunnel_factory
+        self.connections: Dict[str, ServiceConnection] = {}
+
+    async def add(self, key: str, replica: ReplicaInfo) -> ServiceConnection:
+        existing = self.connections.get(key)
+        if existing is not None:
+            # Re-registration is the healing path: a dead tunnel (ssh died,
+            # replica restarted) must be replaced, not returned.
+            if await existing.is_alive():
+                return existing
+            self.remove(key)
+        conn = ServiceConnection(replica, tunnel_factory=self._tunnel_factory)
+        self.connections[key] = conn
+        try:
+            await conn.open()
+        except BaseException:
+            self.connections.pop(key, None)
+            conn.close()
+            raise
+        return conn
+
+    def remove(self, key: str) -> None:
+        conn = self.connections.pop(key, None)
+        if conn is not None:
+            conn.close()
+
+    def close_all(self) -> None:
+        for key in list(self.connections):
+            self.remove(key)

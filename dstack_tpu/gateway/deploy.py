@@ -1,0 +1,82 @@
+"""Blue/green gateway app deployment over SSH.
+
+Parity: src/dstack/_internal/server/services/gateways/__init__.py:440
+(configure_gateway) — the reference installs the gateway wheel into one of
+two venvs on the gateway VM and flips a symlink only after the new app
+passes a healthcheck, so a bad update never takes down a serving gateway.
+
+Everything shells out through an injectable async `run(command) -> str`
+(production: utils/ssh.ssh_execute to the gateway VM) so the sequencing is
+unit-testable without a VM.
+"""
+
+import logging
+from typing import Awaitable, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+GATEWAY_ROOT = "/opt/dstack-tpu-gateway"
+STAGING_PORT = 8002
+LIVE_PORT = 8001
+
+RunFn = Callable[[str], Awaitable[str]]
+
+
+class GatewayUpdateError(Exception):
+    pass
+
+
+class GatewayDeployer:
+    def __init__(self, run: RunFn, root: str = GATEWAY_ROOT):
+        self.run = run
+        self.root = root
+
+    async def active_color(self) -> Optional[str]:
+        """Which color the `current` symlink points at; None on first deploy."""
+        out = await self.run(f"readlink {self.root}/current || true")
+        out = out.strip()
+        if out.endswith("/blue"):
+            return "blue"
+        if out.endswith("/green"):
+            return "green"
+        return None
+
+    async def deploy(self, package_source: str, version: str) -> str:
+        """Install `package_source` (wheel path or pip spec) into the inactive
+        color, health-check it on the staging port, then cut over. Returns the
+        color now live. Raises GatewayUpdateError (leaving the old color
+        serving) if the staged app fails its healthcheck."""
+        active = await self.active_color()
+        target = "green" if active == "blue" else "blue"
+        tdir = f"{self.root}/{target}"
+        await self.run(f"mkdir -p {tdir}")
+        await self.run(f"python3 -m venv {tdir}/venv")
+        await self.run(f"{tdir}/venv/bin/pip install --upgrade {package_source}")
+
+        # Stage the new app on a side port and probe it before cutover.
+        await self.run(
+            f"nohup {tdir}/venv/bin/python -m dstack_tpu.gateway.app"
+            f" --port {STAGING_PORT} > {tdir}/staging.log 2>&1 &"
+            f" echo $! > {tdir}/staging.pid"
+        )
+        try:
+            await self.run(
+                "for i in $(seq 1 20); do"
+                f" curl -fsS http://127.0.0.1:{STAGING_PORT}/api/healthcheck && exit 0;"
+                " sleep 0.5; done; exit 1"
+            )
+        except Exception as e:
+            await self.run(f"kill $(cat {tdir}/staging.pid) || true")
+            raise GatewayUpdateError(
+                f"staged gateway {version} failed healthcheck; {active or 'nothing'}"
+                f" remains live: {e}"
+            )
+        await self.run(f"kill $(cat {tdir}/staging.pid) || true")
+
+        # Atomic cutover: symlink flip + unit restart. systemd unit execs
+        # {root}/current/venv/bin/python -m dstack_tpu.gateway.app --port 8001.
+        await self.run(f"ln -sfn {tdir} {self.root}/current.new"
+                       f" && mv -T {self.root}/current.new {self.root}/current")
+        await self.run("systemctl restart dstack-tpu-gateway || true")
+        logger.info("gateway updated to %s (%s live)", version, target)
+        return target

@@ -35,17 +35,22 @@ async def _poll_gateway_stats(ctx: ServerContext) -> None:
     autoscaler's stats collector (reference: gateway nginx access-log stats
     feeding process_runs' autoscaler hook)."""
     rows = await ctx.db.fetchall(
-        "SELECT g.id, gc.hostname, gc.ip_address FROM gateways g"
+        "SELECT g.id, gc.hostname, gc.ip_address, gc.ssh_private_key FROM gateways g"
         " JOIN gateway_computes gc ON g.gateway_compute_id = gc.id"
         " WHERE g.status = 'running'"
     )
-    client = ctx.overrides.get("gateway_stats_client") or _http_gateway_stats
+    client = ctx.overrides.get("gateway_stats_client")
     for row in rows:
         host = row["hostname"] or row["ip_address"]
         if not host:
             continue
         try:
-            stats = await client(host)
+            if client is not None:
+                stats = await client(host)
+            else:
+                stats = await _http_gateway_stats(
+                    {"host": host, "ssh_private_key": row["ssh_private_key"]}
+                )
         except Exception as e:
             logger.debug("gateway %s stats poll failed: %s", host, e)
             continue
@@ -54,11 +59,16 @@ async def _poll_gateway_stats(ctx: ServerContext) -> None:
             ctx.service_stats.ingest(project_name, run_name, int(count), window=0.0)
 
 
-async def _http_gateway_stats(host: str) -> dict:
+async def _http_gateway_stats(gateway: dict) -> dict:
+    """Stats ride the same server→gateway SSH tunnel as registry calls —
+    the gateway API binds 127.0.0.1 on the VM, nothing crosses in plaintext."""
     import httpx
 
+    from dstack_tpu.server.services.services import _gateway_tunnel_port
+
+    port = await _gateway_tunnel_port(gateway)
     async with httpx.AsyncClient(timeout=10.0) as client:
-        resp = await client.get(f"http://{host}:8001/api/stats")
+        resp = await client.get(f"http://127.0.0.1:{port}/api/stats")
         resp.raise_for_status()
         return resp.json()
 

@@ -314,6 +314,7 @@ async def _submit_to_runner(
         await ctx.db.execute(
             "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
         )
+        await _register_service_replica(ctx, row, jpd, job_spec)
         logger.info(
             "job %s (%s rank %d/%d) running",
             job_spec.job_name, row["run_name"], job_spec.job_num, job_spec.jobs_per_replica,
@@ -486,8 +487,46 @@ async def _terminate_job(ctx: ServerContext, row: sqlite3.Row) -> None:
         "UPDATE jobs SET status = ?, finished_at = ?, last_processed_at = ? WHERE id = ?",
         (reason.to_status().value, utcnow_iso(), utcnow_iso(), row["id"]),
     )
+    await _unregister_service_replica(ctx, row)
     await _release_instance(ctx, row)
     ctx.kick("runs")
+
+
+async def _register_service_replica(
+    ctx: ServerContext, row: sqlite3.Row, jpd: JobProvisioningData, job_spec: JobSpec
+) -> None:
+    """Service runs: expose this replica through the project's gateway
+    (services/services.py opens the gateway-side tunnel). Best-effort at this
+    level too — a registry hiccup must not disturb the job FSM (the job is
+    already RUNNING / the instance release must still happen); the in-server
+    proxy remains the fallback path."""
+    from dstack_tpu.server.services import services as services_service
+
+    try:
+        run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+        if run_row is None or run_row["service_spec"] is None:
+            return
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        await services_service.register_replica(ctx, project_row, run_row, row, jpd, job_spec)
+    except Exception as e:
+        logger.warning("gateway replica registration failed for job %s: %s", row["id"][:8], e)
+
+
+async def _unregister_service_replica(ctx: ServerContext, row: sqlite3.Row) -> None:
+    from dstack_tpu.server.services import services as services_service
+
+    try:
+        run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+        if run_row is None or run_row["service_spec"] is None:
+            return
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        )
+        await services_service.unregister_replica(ctx, project_row, run_row, row)
+    except Exception as e:
+        logger.debug("gateway replica unregistration failed for job %s: %s", row["id"][:8], e)
 
 
 async def _release_instance(ctx: ServerContext, row: sqlite3.Row) -> None:

@@ -308,4 +308,16 @@ async def _process_terminating_run(ctx: ServerContext, row: sqlite3.Row) -> None
     await ctx.db.execute(
         "UPDATE runs SET status = ? WHERE id = ?", (reason.to_status().value, row["id"])
     )
+    if row["service_spec"] is not None:
+        # Drop the service's gateway vhost so a dead run does not keep
+        # serving 502s from nginx (best-effort, like replica registration).
+        try:
+            from dstack_tpu.server.services import services as services_service
+
+            project_row = await ctx.db.fetchone(
+                "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+            )
+            await services_service.unregister_service(ctx, project_row, row)
+        except Exception as e:
+            logger.debug("gateway service unregister failed for %s: %s", row["run_name"], e)
     logger.info("run %s: %s", row["run_name"], reason.to_status().value)

@@ -1,0 +1,190 @@
+"""Service↔gateway registration.
+
+Parity: src/dstack/_internal/server/services/services/__init__.py
+(register_service/register_replica) — when a service replica goes RUNNING
+and the project has a RUNNING gateway, the server registers the service
+(domain = "{run}.{gateway domain}") and the replica's SSH coordinates with
+the gateway's registry API; the gateway then opens its own tunnel to the
+replica (gateway/connections.py), so replicas on private networks serve
+public traffic. Without a gateway the in-server proxy path
+(/proxy/services/...) keeps working as the fallback.
+
+The registry client is injectable via ctx.overrides["gateway_registry_client"]
+(same pattern as the stats poll in process_gateways).
+"""
+
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from dstack_tpu.models.runs import JobProvisioningData, JobSpec
+from dstack_tpu.server.context import ServerContext
+
+logger = logging.getLogger(__name__)
+
+GATEWAY_API_PORT = 8001
+
+# host -> (SSHTunnel, local port). The gateway's registry API binds
+# 127.0.0.1 on the gateway VM; the server reaches it through an SSH tunnel,
+# so replica ssh keys never cross the network in plaintext (parity:
+# reference gateways/connection.py — all server→gateway HTTP rides SSH).
+_gateway_tunnels: Dict[str, Any] = {}
+
+
+async def _gateway_tunnel_port(gateway: Dict[str, Any]) -> int:
+    from dstack_tpu.utils.ssh import PortForward, SSHTarget, SSHTunnel, find_free_port
+
+    host = gateway["host"]
+    cached = _gateway_tunnels.get(host)
+    if cached is not None:
+        tunnel, port = cached
+        if tunnel._proc is not None and tunnel._proc.poll() is None:
+            return port
+        _gateway_tunnels.pop(host, None)
+        tunnel.close()
+    local_port = find_free_port()
+    tunnel = SSHTunnel(
+        SSHTarget(
+            hostname=host,
+            username=gateway.get("ssh_user") or "ubuntu",
+            private_key=gateway.get("ssh_private_key"),
+        ),
+        forwards=[PortForward(local_port, "127.0.0.1", GATEWAY_API_PORT)],
+    )
+    await tunnel.open()
+    _gateway_tunnels[host] = (tunnel, local_port)
+    return local_port
+
+
+async def _registry_call(ctx: ServerContext, gateway: Dict[str, Any], path: str, body: dict) -> None:
+    client = ctx.overrides.get("gateway_registry_client")
+    if client is not None:
+        await client(gateway["host"], path, body)
+        return
+    import httpx
+
+    port = await _gateway_tunnel_port(gateway)
+    async with httpx.AsyncClient(timeout=15.0) as http:
+        resp = await http.post(f"http://127.0.0.1:{port}/api{path}", json=body)
+        resp.raise_for_status()
+
+
+async def get_project_gateway(ctx: ServerContext, project_id: str) -> Optional[Dict[str, Any]]:
+    """The project's RUNNING gateway: {host, domain, ssh creds} or None."""
+    row = await ctx.db.fetchone(
+        "SELECT g.configuration, gc.hostname, gc.ip_address, gc.ssh_private_key"
+        " FROM gateways g"
+        " JOIN gateway_computes gc ON g.gateway_compute_id = gc.id"
+        " WHERE g.project_id = ? AND g.status = 'running'"
+        " ORDER BY g.is_default DESC LIMIT 1",
+        (project_id,),
+    )
+    if row is None:
+        return None
+    conf = json.loads(row["configuration"])
+    host = row["hostname"] or row["ip_address"]
+    if not host:
+        return None
+    return {
+        "host": host,
+        "domain": conf.get("domain"),
+        "ssh_private_key": row["ssh_private_key"],
+    }
+
+
+def service_domain(run_name: str, gateway_domain: Optional[str]) -> Optional[str]:
+    """`{run}.{wildcard domain}` — the per-service vhost nginx serves."""
+    if not gateway_domain:
+        return None
+    return f"{run_name}.{gateway_domain.lstrip('*').lstrip('.')}"
+
+
+async def register_replica(
+    ctx: ServerContext,
+    project_row,
+    run_row,
+    job_row,
+    jpd: JobProvisioningData,
+    job_spec: JobSpec,
+) -> None:
+    """Register the service (idempotent) and this replica with the gateway.
+
+    Raises on registry failure — the caller (_register_service_replica) is
+    the best-effort boundary: registration failure must not fail the job,
+    the in-server proxy still serves the run.
+    """
+    if run_row["service_spec"] is None:
+        return
+    gateway = await get_project_gateway(ctx, project_row["id"])
+    if gateway is None:
+        return
+    domain = service_domain(run_row["run_name"], gateway["domain"])
+    if domain is None:
+        return
+    run_spec = json.loads(run_row["run_spec"])
+    conf = run_spec.get("configuration") or {}
+    app_port = job_spec.app_specs[0].port if job_spec.app_specs else conf.get("port") or 80
+    auth = bool(conf.get("auth", False))
+    auth_tokens = []
+    if auth:
+        # Project member tokens pass the gateway's nginx auth_request;
+        # without them an auth-enabled service would deny everyone.
+        token_rows = await ctx.db.fetchall(
+            "SELECT u.token FROM users u JOIN members m ON m.user_id = u.id"
+            " WHERE m.project_id = ?",
+            (project_row["id"],),
+        )
+        auth_tokens = [r["token"] for r in token_rows]
+    await _registry_call(ctx, gateway, "/registry/services/register", {
+        "project_name": project_row["name"],
+        "run_name": run_row["run_name"],
+        "domain": domain,
+        "https": bool(conf.get("https", False)),
+        "auth": auth,
+        "auth_tokens": auth_tokens,
+    })
+    ssh: Dict[str, Any] = {
+        "host": jpd.hostname,
+        "port": jpd.ssh_port or 22,
+        "user": jpd.username,
+        "private_key": project_row["ssh_private_key"],
+        "app_port": app_port,
+    }
+    if jpd.ssh_proxy is not None:
+        ssh["proxy_host"] = jpd.ssh_proxy.hostname
+        ssh["proxy_port"] = jpd.ssh_proxy.port
+    await _registry_call(ctx, gateway, "/registry/replicas/register", {
+        "project_name": project_row["name"],
+        "run_name": run_row["run_name"],
+        "replica_id": job_row["id"],
+        "ssh": ssh,
+    })
+    logger.info(
+        "registered replica %s of %s with gateway %s (%s)",
+        job_row["id"], run_row["run_name"], gateway["host"], domain,
+    )
+
+
+async def unregister_replica(ctx: ServerContext, project_row, run_row, job_row) -> None:
+    if run_row["service_spec"] is None:
+        return
+    gateway = await get_project_gateway(ctx, project_row["id"])
+    if gateway is None:
+        return
+    await _registry_call(ctx, gateway, "/registry/replicas/unregister", {
+        "project_name": project_row["name"],
+        "run_name": run_row["run_name"],
+        "replica_id": job_row["id"],
+    })
+
+
+async def unregister_service(ctx: ServerContext, project_row, run_row) -> None:
+    if run_row["service_spec"] is None:
+        return
+    gateway = await get_project_gateway(ctx, project_row["id"])
+    if gateway is None:
+        return
+    await _registry_call(ctx, gateway, "/registry/services/unregister", {
+        "project_name": project_row["name"],
+        "run_name": run_row["run_name"],
+    })

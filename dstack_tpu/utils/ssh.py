@@ -52,6 +52,21 @@ class PortForward:
 
 
 @dataclass
+class SocketForward:
+    """Forward a local unix socket to a remote host:port (`ssh -L sock:host:port`).
+
+    The gateway data path: nginx upstreams point at the socket, ssh carries
+    the bytes to the replica's app port (reference
+    proxy/lib/services/service_connection.py:35-68 forwards IPSocket->UnixSocket
+    the same way).
+    """
+
+    local_socket: str
+    remote_host: str
+    remote_port: int
+
+
+@dataclass
 class SSHTarget:
     hostname: str
     username: str = "root"
@@ -68,14 +83,24 @@ class SSHTunnel:
     the OpenSSH client); control-socket multiplexing included.
     """
 
-    def __init__(self, target: SSHTarget, forwards: List[PortForward]):
+    def __init__(
+        self,
+        target: SSHTarget,
+        forwards: List[PortForward],
+        socket_forwards: Optional[List[SocketForward]] = None,
+    ):
         self.target = target
         self.forwards = forwards
+        self.socket_forwards = socket_forwards or []
         self._proc: Optional[subprocess.Popen] = None
         self._tmp: Optional[tempfile.TemporaryDirectory] = None
 
     def _build_cmd(self) -> List[str]:
         cmd = ["ssh", "-N", *_SSH_OPTS]
+        if self.socket_forwards:
+            # A stale socket file from a previous tunnel would make bind fail;
+            # 0111 mask lets nginx (other uid) connect to the socket.
+            cmd += ["-o", "StreamLocalBindUnlink=yes", "-o", "StreamLocalBindMask=0111"]
         key_file = self.target.identity_file
         if self.target.private_key and not key_file:
             assert self._tmp is not None
@@ -90,6 +115,8 @@ class SSHTunnel:
             cmd += ["-J", f"{proxy.username}@{proxy.hostname}:{proxy.port}"]
         for fwd in self.forwards:
             cmd += ["-L", f"127.0.0.1:{fwd.local_port}:{fwd.remote_host}:{fwd.remote_port}"]
+        for sfwd in self.socket_forwards:
+            cmd += ["-L", f"{sfwd.local_socket}:{sfwd.remote_host}:{sfwd.remote_port}"]
         cmd += ["-p", str(self.target.port), f"{self.target.username}@{self.target.hostname}"]
         return cmd
 
@@ -99,15 +126,20 @@ class SSHTunnel:
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
         )
-        # Wait until the local port accepts connections.
+        # Wait until the first local forward (TCP port or unix socket)
+        # accepts connections.
         deadline = asyncio.get_event_loop().time() + timeout
         port = self.forwards[0].local_port if self.forwards else None
-        while port is not None:
+        sock = self.socket_forwards[0].local_socket if self.socket_forwards else None
+        while port is not None or sock is not None:
             if self._proc.poll() is not None:
                 err = self._proc.stderr.read().decode() if self._proc.stderr else ""
                 raise SSHError(f"ssh tunnel failed: {err.strip()}")
             try:
-                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                if port is not None:
+                    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                else:
+                    reader, writer = await asyncio.open_unix_connection(sock)
                 writer.close()
                 break
             except OSError:
