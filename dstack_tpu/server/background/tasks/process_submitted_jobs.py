@@ -177,6 +177,7 @@ async def _try_assign_pool_instances(
         " AND deleted = 0 ORDER BY price",
         (row["project_id"],),
     )
+    profile = run_spec.merged_profile
     candidates: List[sqlite3.Row] = []
     for irow in idle_rows:
         if not irow["offer"]:
@@ -184,6 +185,16 @@ async def _try_assign_pool_instances(
         offer = InstanceOfferWithAvailability.model_validate_json(irow["offer"])
         if not offer_matches_requirements(offer, job_spec.requirements):
             continue
+        # Profile placement constraints apply to reuse too (parity:
+        # filter_pool_instances, reference services/pools.py:409-465 — the
+        # same backends/regions/instance_types the offer path honors).
+        if profile is not None:
+            if profile.backends and offer.backend not in profile.backends:
+                continue
+            if profile.regions and offer.region not in profile.regions:
+                continue
+            if profile.instance_types and offer.instance.name not in profile.instance_types:
+                continue
         jpd = (
             JobProvisioningData.model_validate_json(irow["job_provisioning_data"])
             if irow["job_provisioning_data"]

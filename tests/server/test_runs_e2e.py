@@ -185,3 +185,74 @@ async def test_gang_member_failure_kills_gang():
         assert "gang_member_failed" in reasons
     finally:
         await fx.app.shutdown()
+
+
+async def test_pool_reuse_honors_profile_constraints():
+    """Idle-instance reuse applies the profile's regions/backends filters
+    (pools design note: filter_pool_instances semantics on fleet instances).
+    With creation_policy=reuse, a region mismatch fails the run instead of
+    silently landing on the wrong instance."""
+    import json
+
+    from dstack_tpu.server.background.tasks.process_runs import process_runs
+    from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+        process_submitted_jobs,
+    )
+    from dstack_tpu.server.security import generate_id
+    from dstack_tpu.utils.common import utcnow_iso
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+        offer = {
+            "backend": "gcp",
+            "instance": {"name": "v5litepod-4",
+                         "resources": {"cpus": 24, "memory_mib": 48000}},
+            "region": "us-central2", "price": 1.2, "hosts": 1,
+            "availability": "idle",
+        }
+        jpd = {
+            "backend": "gcp",
+            "instance_type": offer["instance"],
+            "instance_id": "i-reuse", "hostname": "10.0.0.9",
+            "region": "us-central2", "dockerized": True,
+        }
+        iid = generate_id()
+        now = utcnow_iso()
+        await ctx.db.execute(
+            "INSERT INTO instances (id, project_id, name, status, created_at,"
+            " started_at, last_processed_at, backend, offer, job_provisioning_data)"
+            " VALUES (?, ?, 'idle-1', 'idle', ?, ?, ?, 'gcp', ?, ?)",
+            (iid, project["id"], now, now, now, json.dumps(offer), json.dumps(jpd)),
+        )
+
+        async def submit(run_name, regions):
+            body = _task_body(["echo hi"], run_name)
+            body["run_spec"]["configuration"]["regions"] = regions
+            body["run_spec"]["configuration"]["creation_policy"] = "reuse"
+            resp = await fx.client.post("/api/project/main/runs/submit", json_body=body)
+            assert resp.status == 200, resp.body
+            await process_runs(ctx)
+            await process_submitted_jobs(ctx)
+
+        # Wrong region: the idle instance must NOT be reused.
+        await submit("wrong-region", ["europe-west4"])
+        row = await ctx.db.fetchone(
+            "SELECT j.* FROM jobs j JOIN runs r ON j.run_id = r.id"
+            " WHERE r.run_name = 'wrong-region'"
+        )
+        assert row["instance_id"] is None
+        assert row["status"] in ("terminating", "failed")
+
+        # Matching region: reused.
+        await submit("right-region", ["us-central2"])
+        row = await ctx.db.fetchone(
+            "SELECT j.* FROM jobs j JOIN runs r ON j.run_id = r.id"
+            " WHERE r.run_name = 'right-region'"
+        )
+        assert row["instance_id"] == iid
+        irow = await ctx.db.fetchone("SELECT * FROM instances WHERE id = ?", (iid,))
+        assert irow["status"] == "busy"
+    finally:
+        await fx.app.shutdown()
