@@ -24,23 +24,26 @@ int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
   int port = 10999;
   std::string working_root;
+  std::string port_file;
   bool idle_shutdown = false;
 
   static option longopts[] = {
       {"host", required_argument, nullptr, 'h'},
       {"port", required_argument, nullptr, 'p'},
+      {"port-file", required_argument, nullptr, 'f'},
       {"working-root", required_argument, nullptr, 'w'},
       {"idle-shutdown", no_argument, nullptr, 'i'},
       {nullptr, 0, nullptr, 0},
   };
   int c;
-  while ((c = getopt_long(argc, argv, "h:p:w:i", longopts, nullptr)) != -1) {
+  while ((c = getopt_long(argc, argv, "h:p:f:w:i", longopts, nullptr)) != -1) {
     switch (c) {
       case 'h': host = optarg; break;
       case 'p': port = atoi(optarg); break;
+      case 'f': port_file = optarg; break;
       case 'w': working_root = optarg; break;
       case 'i': idle_shutdown = true; break;
-      default: fprintf(stderr, "usage: %s [--host H] [--port P] [--working-root D] [--idle-shutdown]\n", argv[0]); return 2;
+      default: fprintf(stderr, "usage: %s [--host H] [--port P] [--port-file PATH] [--working-root D] [--idle-shutdown]\n", argv[0]); return 2;
     }
   }
 
@@ -110,6 +113,13 @@ int main(int argc, char** argv) {
   if (bound < 0) {
     fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
     return 1;
+  }
+  if (!port_file.empty()) {
+    // With --port 0 the kernel picked the port; report it to the shim
+    // atomically (rename) so a partial read can't see a truncated number.
+    std::string tmp = port_file + ".tmp";
+    write_file(tmp, std::to_string(bound));
+    rename(tmp.c_str(), port_file.c_str());
   }
   printf("runner listening on %s:%d\n", host.c_str(), bound);
   fflush(stdout);

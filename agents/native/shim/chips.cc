@@ -1,0 +1,64 @@
+#include "chips.hpp"
+
+#include <sys/stat.h>
+
+#include <set>
+#include <cstdlib>
+
+namespace dstack {
+
+int detect_tpu_chips() {
+  // Override for tests and forced subslicing; real hosts enumerate
+  // /dev/accel* (parity: host/gpu.go device-file detection).
+  if (const char* env = getenv("DSTACK_TPU_SHIM_CHIPS")) return atoi(env);
+  int n = 0;
+  struct stat st;
+  while (stat(("/dev/accel" + std::to_string(n)).c_str(), &st) == 0) ++n;
+  return n;
+}
+
+int ChipAllocator::total_locked() {
+  if (total_ < 0) total_ = detect_tpu_chips();
+  return total_;
+}
+
+int ChipAllocator::total() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return total_locked();
+}
+
+int ChipAllocator::free_count() {
+  std::lock_guard<std::mutex> lock(mu_);
+  int used = 0;
+  for (const auto& [_, chips] : held_) used += static_cast<int>(chips.size());
+  return total_locked() - used;
+}
+
+std::optional<std::vector<int>> ChipAllocator::acquire(const std::string& task_id, int n) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = held_.find(task_id);
+  if (it != held_.end()) return it->second;
+  int total = total_locked();
+  if (n <= 0 || total == 0) return std::vector<int>{};
+  std::set<int> used;
+  for (const auto& [_, chips] : held_)
+    for (int c : chips) used.insert(c);
+  std::vector<int> grant;
+  for (int i = 0; i < total && static_cast<int>(grant.size()) < n; ++i)
+    if (!used.count(i)) grant.push_back(i);
+  if (static_cast<int>(grant.size()) < n) return std::nullopt;
+  held_[task_id] = grant;
+  return grant;
+}
+
+void ChipAllocator::reacquire(const std::string& task_id, const std::vector<int>& chips) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!chips.empty()) held_[task_id] = chips;
+}
+
+void ChipAllocator::release(const std::string& task_id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  held_.erase(task_id);
+}
+
+}  // namespace dstack

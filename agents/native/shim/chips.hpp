@@ -1,0 +1,53 @@
+// TPU chip accounting: which /dev/accel* indices each task holds.
+//
+// Parity: runner/internal/shim/resources.go:23-131 (GpuLock) — the
+// reference serializes GPU handout so two concurrent tasks cannot both
+// claim every device; this is the chips-first equivalent. TPUs are never
+// fractionally shared across jobs (offers.py), but a shim can host more
+// than one task (dev environments next to a draining job), and each must
+// see only the chips it was granted.
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace dstack {
+
+// Host chip count: DSTACK_TPU_SHIM_CHIPS override, else /dev/accel*
+// enumeration. Shared by the allocator and host_info so the advertised
+// count and the allocatable capacity can never disagree.
+int detect_tpu_chips();
+
+class ChipAllocator {
+ public:
+  // total < 0: detect from /dev/accel* at first use.
+  explicit ChipAllocator(int total = -1) : total_(total) {}
+
+  // Grant `n` free chip indices to `task_id`, lowest-index first. Returns
+  // nullopt when fewer than n are free. n <= 0 or a chipless host grants
+  // the empty set (CPU tasks / dev boxes run fine without devices).
+  // Re-acquiring for a task that already holds chips returns its existing
+  // grant (idempotent relaunch).
+  std::optional<std::vector<int>> acquire(const std::string& task_id, int n);
+
+  // Re-register a grant recovered from container labels after a shim
+  // restart (parity: docker.go label-based state restore).
+  void reacquire(const std::string& task_id, const std::vector<int>& chips);
+
+  void release(const std::string& task_id);
+
+  int total();
+  int free_count();
+
+ private:
+  std::mutex mu_;
+  int total_;
+  std::map<std::string, std::vector<int>> held_;
+
+  int total_locked();
+};
+
+}  // namespace dstack

@@ -19,6 +19,7 @@
 #include "../common/http.hpp"
 #include "../common/tpu_telemetry.hpp"
 #include "../common/util.hpp"
+#include "chips.hpp"
 #include "runtime.hpp"
 #include "task.hpp"
 
@@ -38,9 +39,7 @@ Json host_info() {
   if (statvfs("/", &vfs) == 0)
     j.set("disk_size_mib",
           static_cast<int64_t>(vfs.f_blocks) * vfs.f_frsize / (1 << 20));
-  int chips = 0;
-  struct stat st;
-  while (stat(("/dev/accel" + std::to_string(chips)).c_str(), &st) == 0) ++chips;
+  int chips = detect_tpu_chips();
   // tpu-info sees chips the device files may not (e.g. vfio-bound).
   Json tpu = collect_tpu_metrics();
   if (static_cast<int>(tpu.as_array().size()) > chips)
@@ -71,7 +70,20 @@ class TaskStore {
       l.unlock();
       runtime_->launch(copy);
       l.lock();
-      tasks_[id] = copy;
+      auto it = tasks_.find(id);
+      bool cancelled = it == tasks_.end() || it->second.status == "terminated";
+      if (!cancelled) {
+        it->second = copy;
+        l.unlock();
+      } else {
+        l.unlock();
+        // Terminated/removed while launching: tear down whatever launch
+        // created (container, runner process, chip grant) instead of
+        // resurrecting the task — the write-back would otherwise revive a
+        // task the user already killed, with devices another task may need.
+        runtime_->terminate(copy, 2.0);
+        runtime_->remove(copy);
+      }
     }).detach();
     return HttpResponse::ok(Json::object().set("ok", true));
   }
@@ -108,7 +120,9 @@ class TaskStore {
   void restore_from_docker() {
     std::string out;
     if (run_command({"docker", "ps", "-a", "--filter", "label=dstack.task_id",
-                     "--format", "{{.Label \"dstack.task_id\"}} {{.Names}} {{.State}}"},
+                     "--format",
+                     "{{.Label \"dstack.task_id\"}} {{.Names}} {{.State}}"
+                     " {{.Label \"dstack.tpu_chips\"}}"},
                     &out, 10) != 0)
       return;
     std::lock_guard<std::mutex> lock(mu_);
@@ -119,6 +133,12 @@ class TaskStore {
       task.spec.id = parts[0];
       task.container_name = parts[1];
       task.status = parts[2] == "running" ? "running" : "terminated";
+      if (parts.size() > 3 && !parts[3].empty()) {
+        for (const auto& c : split(parts[3], ','))
+          if (!c.empty()) task.tpu_chips_held.push_back(atoi(c.c_str()));
+      }
+      // Re-register held chips so a restarted shim cannot double-book them.
+      runtime_->on_restore(task);
     }
   }
 

@@ -8,6 +8,7 @@
 #include <cstring>
 
 #include "../common/util.hpp"
+#include "chips.hpp"
 #include "volumes.hpp"
 
 namespace dstack {
@@ -16,11 +17,13 @@ namespace {
 
 constexpr int kPullTimeoutSeconds = 20 * 60;  // parity: shim/docker.go:42
 
-int count_tpu_devices() {
-  int n = 0;
-  struct stat st;
-  while (stat(("/dev/accel" + std::to_string(n)).c_str(), &st) == 0) ++n;
-  return n;
+std::string join_chips(const std::vector<int>& chips) {
+  std::string s;
+  for (int c : chips) {
+    if (!s.empty()) s += ",";
+    s += std::to_string(c);
+  }
+  return s;
 }
 
 // ---------------------------------------------------------------------------
@@ -60,13 +63,32 @@ class DockerRuntime : public Runtime {
       cmd.push_back(std::to_string(spec.shm_size_bytes) + "b");
     }
     // TPU passthrough: chips appear as /dev/accel*; vfio for newer runtimes;
-    // /run/tpu holds the libtpu socket/lockfile. TPUs are never fractionally
-    // shared (offers.py), so all host chips go to the one task.
+    // /run/tpu holds the libtpu socket/lockfile. Chips are handed out by
+    // the allocator so two concurrent tasks never see the same device
+    // (parity: GpuLock, resources.go:23-131).
     if (spec.tpu_chips > 0) {
-      int n = count_tpu_devices();
-      for (int i = 0; i < n; ++i) {
+      auto grant = chips_.acquire(spec.id, spec.tpu_chips);
+      if (!grant) {
+        fail(task, "creating_container_error",
+             "not enough free TPU chips: want " + std::to_string(spec.tpu_chips) +
+                 ", free " + std::to_string(chips_.free_count()) + "/" +
+                 std::to_string(chips_.total()));
+        return;
+      }
+      task.tpu_chips_held = *grant;
+      for (int i : task.tpu_chips_held) {
         cmd.push_back("--device");
         cmd.push_back("/dev/accel" + std::to_string(i));
+      }
+      if (!task.tpu_chips_held.empty()) {
+        // Label survives a shim restart; restore_from_docker re-registers
+        // the grant so a restarted shim cannot double-book chips.
+        cmd.push_back("--label");
+        cmd.push_back("dstack.tpu_chips=" + join_chips(task.tpu_chips_held));
+        if (static_cast<int>(task.tpu_chips_held.size()) < chips_.total()) {
+          cmd.push_back("-e");
+          cmd.push_back("TPU_VISIBLE_DEVICES=" + join_chips(task.tpu_chips_held));
+        }
       }
       struct stat st;
       if (stat("/dev/vfio", &st) == 0) {
@@ -141,6 +163,7 @@ class DockerRuntime : public Runtime {
     } else {
       task.termination_reason = "done_by_runner";
     }
+    release_chips(task);
   }
 
   void terminate(TaskState& task, double timeout_seconds) override {
@@ -155,11 +178,18 @@ class DockerRuntime : public Runtime {
       if (task.termination_reason.empty())
         task.termination_reason = "terminated_by_user";
     }
+    release_chips(task);
   }
 
   void remove(TaskState& task) override {
     if (!task.container_name.empty())
       run_command({"docker", "rm", "-f", task.container_name}, nullptr);
+    release_chips(task);
+  }
+
+  void on_restore(TaskState& task) override {
+    if (task.status == "running" && !task.tpu_chips_held.empty())
+      chips_.reacquire(task.spec.id, task.tpu_chips_held);
   }
 
  private:
@@ -184,9 +214,21 @@ class DockerRuntime : public Runtime {
     task.status = "terminated";
     task.termination_reason = reason;
     task.termination_message = msg;
+    release_chips(task);  // post-acquire failures must not strand the grant
+  }
+
+  void release_chips(TaskState& task) {
+    // Only release a grant this TaskState actually carries: a terminate on
+    // the stored (pre-launch) state must not free chips the in-flight
+    // launch copy holds — the launch thread's teardown releases those.
+    if (!task.tpu_chips_held.empty()) {
+      chips_.release(task.spec.id);
+      task.tpu_chips_held.clear();
+    }
   }
 
   std::string runner_binary_;
+  ChipAllocator chips_;
 };
 
 // ---------------------------------------------------------------------------
@@ -229,25 +271,47 @@ class ProcessRuntime : public Runtime {
       }
     }
 
-    // Allocate an ephemeral port by letting the runner bind :0 would lose
-    // the port; instead derive one per task from the pid after spawn is
-    // racy too — so bind a fixed base + hash offset and retry upward.
-    int port = 20000 + static_cast<int>(std::hash<std::string>{}(spec.id) % 10000);
+    // Chip accounting mirrors the docker path: concurrent tasks must not
+    // share devices, even though process tasks see them via env only.
+    if (spec.tpu_chips > 0) {
+      auto grant = chips_.acquire(spec.id, spec.tpu_chips);
+      if (!grant) {
+        task.status = "terminated";
+        task.termination_reason = "creating_container_error";
+        task.termination_message =
+            "not enough free TPU chips: want " + std::to_string(spec.tpu_chips) +
+            ", free " + std::to_string(chips_.free_count()) + "/" +
+            std::to_string(chips_.total());
+        return;
+      }
+      task.tpu_chips_held = *grant;
+    }
+
+    // Port allocation: the runner binds :0 and reports the kernel-chosen
+    // port through a file in its workdir — no fixed ranges, no collisions
+    // (the shim waits for the file below).
     std::string workdir = "/tmp/dstack-task-" + spec.id;
     mkdir(workdir.c_str(), 0755);
+    std::string port_file = workdir + "/runner.port";
+    unlink(port_file.c_str());
 
     // Pre-build argv/envp before fork: the shim is multithreaded, and the
     // child must not allocate between fork and exec.
     std::vector<std::string> envv;
     for (char** e = environ; *e; ++e) envv.emplace_back(*e);
     for (const auto& [k, v] : spec.env) envv.push_back(k + "=" + v);
-    if (spec.tpu_chips > 0) envv.push_back("PJRT_DEVICE=TPU");
+    if (spec.tpu_chips > 0) {
+      envv.push_back("PJRT_DEVICE=TPU");
+      if (!task.tpu_chips_held.empty() &&
+          static_cast<int>(task.tpu_chips_held.size()) < chips_.total())
+        envv.push_back("TPU_VISIBLE_DEVICES=" + join_chips(task.tpu_chips_held));
+    }
     std::vector<char*> envp;
     for (auto& e : envv) envp.push_back(const_cast<char*>(e.c_str()));
     envp.push_back(nullptr);
-    std::string port_s = std::to_string(port);
     const char* child_argv[] = {
-        "dstack-tpu-runner", "--host", "127.0.0.1", "--port", port_s.c_str(),
+        "dstack-tpu-runner", "--host", "127.0.0.1", "--port", "0",
+        "--port-file", port_file.c_str(),
         "--working-root", workdir.c_str(), "--idle-shutdown", nullptr};
 
     pid_t pid = fork();
@@ -255,6 +319,7 @@ class ProcessRuntime : public Runtime {
       task.status = "terminated";
       task.termination_reason = "creating_container_error";
       task.termination_message = strerror(errno);
+      release_chips(task);
       return;
     }
     if (pid == 0) {
@@ -263,8 +328,40 @@ class ProcessRuntime : public Runtime {
       _exit(127);
     }
     task.process_pid = pid;
-    task.runner_port = port;
     task.container_name = "process-" + std::to_string(pid);
+
+    // Wait for the runner to report its port (it binds within ms of exec;
+    // the deadline only guards against a crashed child).
+    int64_t deadline = now_ms() + 15'000;
+    int port = -1;
+    while (now_ms() < deadline) {
+      auto contents = read_file(port_file);
+      if (contents && !contents->empty()) {
+        port = atoi(contents->c_str());
+        if (port > 0) break;
+      }
+      int status;
+      if (waitpid(pid, &status, WNOHANG) == pid) {
+        task.status = "terminated";
+        task.termination_reason = "creating_container_error";
+        task.termination_message = "runner exited before binding a port";
+        task.process_pid = -1;
+        release_chips(task);
+        return;
+      }
+      usleep(20'000);
+    }
+    if (port <= 0) {
+      kill(-pid, SIGKILL);
+      waitpid(pid, nullptr, 0);  // reap: refresh() never will (pid cleared)
+      task.status = "terminated";
+      task.termination_reason = "creating_container_error";
+      task.termination_message = "runner did not report its port in time";
+      task.process_pid = -1;
+      release_chips(task);
+      return;
+    }
+    task.runner_port = port;
     task.status = "running";
   }
 
@@ -281,6 +378,7 @@ class ProcessRuntime : public Runtime {
         task.termination_message = "exit code " + std::to_string(code);
       }
       task.process_pid = -1;
+      release_chips(task);
     }
   }
 
@@ -307,12 +405,24 @@ class ProcessRuntime : public Runtime {
       if (task.termination_reason.empty())
         task.termination_reason = "terminated_by_user";
     }
+    release_chips(task);
   }
 
   void remove(TaskState& task) override { terminate(task, 0.5); }
 
  private:
+  void release_chips(TaskState& task) {
+    // See DockerRuntime::release_chips: only free grants this TaskState
+    // carries, so a terminate on the stored pre-launch state cannot free
+    // the in-flight launch copy's chips.
+    if (!task.tpu_chips_held.empty()) {
+      chips_.release(task.spec.id);
+      task.tpu_chips_held.clear();
+    }
+  }
+
   std::string runner_binary_;
+  ChipAllocator chips_;
 };
 
 }  // namespace

@@ -28,6 +28,9 @@ class Runtime {
   virtual void refresh(TaskState& task) = 0;
   virtual void terminate(TaskState& task, double timeout_seconds) = 0;
   virtual void remove(TaskState& task) = 0;
+  // Called for each task rebuilt from container labels after a shim
+  // restart; re-registers held resources (chip grants) with the runtime.
+  virtual void on_restore(TaskState&) {}
 };
 
 std::unique_ptr<Runtime> make_docker_runtime(const std::string& runner_binary);

@@ -37,6 +37,9 @@ Json TaskState::to_json() const {
   j.set("ports", Json::array());
   j.set("container_name", container_name.empty() ? Json() : Json(container_name));
   j.set("runner_port", runner_port);
+  Json chips = Json::array();
+  for (int c : tpu_chips_held) chips.push_back(Json(static_cast<int64_t>(c)));
+  j.set("tpu_chips_held", chips);
   return j;
 }
 

@@ -44,7 +44,8 @@ struct TaskState {
   std::string termination_message;
   std::string container_name;
   int runner_port = 10999;
-  pid_t process_pid = -1;  // process runtime only
+  pid_t process_pid = -1;      // process runtime only
+  std::vector<int> tpu_chips_held;  // /dev/accel* indices granted by ChipAllocator
 
   Json to_json() const;
 };

@@ -444,6 +444,11 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=10999)
+    parser.add_argument(
+        "--port-file", default=None,
+        help="with --port 0, report the kernel-chosen port here (written"
+             " atomically; parity with the C++ runner)",
+    )
     parser.add_argument("--working-root", default=None)
     parser.add_argument("--idle-shutdown", action="store_true")
     args = parser.parse_args()
@@ -452,6 +457,10 @@ def main() -> None:
         app = create_runner_app(args.working_root, idle_shutdown=args.idle_shutdown)
         server = Server(app, args.host, args.port)
         await server.start()
+        if args.port_file:
+            tmp = Path(args.port_file + ".tmp")
+            tmp.write_text(str(server.port))
+            tmp.rename(args.port_file)
         print(f"runner listening on {args.host}:{server.port}", flush=True)
         assert server._server is not None
         async with server._server:

@@ -14,6 +14,8 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
+from pathlib import Path
 from typing import Dict, List, Optional
 
 from dstack_tpu.backends.base.catalog import tpu_offer
@@ -34,7 +36,6 @@ from dstack_tpu.models.volumes import (
     VolumeAttachmentData,
     VolumeProvisioningData,
 )
-from dstack_tpu.utils.ssh import find_free_ports
 
 
 class LocalBackendConfig(CoreModel):
@@ -99,16 +100,19 @@ class LocalCompute(Compute):
         # re-adds what site would have provided.
         pythonpath = os.pathsep.join(p for p in sys.path if p)
         spawned = []
-        # Distinct ports up front (held-socket allocation): with parallel
-        # boot, per-worker find_free_port could hand two workers the same
-        # port before either runner binds.
-        ports = find_free_ports(offer.hosts)
+        # Race-free port allocation: each runner binds :0 and reports the
+        # kernel-chosen port through a file — no pick-then-bind window for
+        # another process to steal the port (the cause of rare parallel-boot
+        # failures with up-front find_free_ports).
+        # Private temp dir so port-file paths are not predictable/pre-creatable
+        # by other local users (mktemp would be).
+        port_dir = tempfile.mkdtemp(prefix="dstack-local-runner-")
         for worker in range(offer.hosts):
-            port = ports[worker]
+            port_file = os.path.join(port_dir, f"w{worker}.port")
             proc = subprocess.Popen(
                 [
                     sys.executable, "-S", "-m", "dstack_tpu.agents.runner",
-                    "--host", "127.0.0.1", "--port", str(port),
+                    "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
                 ],
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
@@ -117,10 +121,21 @@ class LocalCompute(Compute):
             )
             instance_id = f"local-{proc.pid}"
             self._procs[instance_id] = proc
-            spawned.append((worker, port, proc, instance_id))
+            spawned.append((worker, port_file, proc, instance_id))
         # All workers of the slice boot in parallel — the real GCP path
         # provisions one TPU node object whose workers come up together.
-        await asyncio.gather(*(self._wait_port(p) for _, p, _p2, _i in spawned))
+        try:
+            ports = await asyncio.gather(
+                *(self._wait_port_file(f, p) for _, f, p, _i in spawned)
+            )
+        finally:
+            import shutil
+
+            shutil.rmtree(port_dir, ignore_errors=True)
+        spawned = [
+            (worker, port, proc, instance_id)
+            for (worker, _f, proc, instance_id), port in zip(spawned, ports)
+        ]
         for worker, port, proc, instance_id in spawned:
             out.append(
                 JobProvisioningData(
@@ -145,17 +160,33 @@ class LocalCompute(Compute):
         return out
 
     @staticmethod
-    async def _wait_port(port: int, timeout: float = 10.0) -> None:
+    async def _wait_port_file(
+        port_file: str, proc: subprocess.Popen, timeout: float = 30.0
+    ) -> int:
+        """The runner's reported port, once it has bound :0 and is serving."""
         deadline = asyncio.get_event_loop().time() + timeout
+        port = None
         while True:
-            try:
-                _, writer = await asyncio.open_connection("127.0.0.1", port)
-                writer.close()
-                return
-            except OSError:
-                if asyncio.get_event_loop().time() > deadline:
-                    raise TimeoutError(f"local runner on :{port} did not start")
-                await asyncio.sleep(0.05)
+            if port is None:
+                try:
+                    port = int(Path(port_file).read_text())
+                    Path(port_file).unlink(missing_ok=True)
+                except (OSError, ValueError):
+                    port = None
+            if port is not None:
+                try:
+                    _, writer = await asyncio.open_connection("127.0.0.1", port)
+                    writer.close()
+                    return port
+                except OSError:
+                    pass
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"local runner exited with {proc.returncode} before serving"
+                )
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("local runner did not start in time")
+            await asyncio.sleep(0.05)
 
     async def terminate_instance(
         self, instance_id: str, region: str, backend_data: Optional[str] = None
