@@ -123,25 +123,25 @@ class Run:
 
         if follow:
             picked = _picked()
-            if len(picked) == 1 and picked[0].job_submissions:
-                # Single-submission follow rides the server's websocket
-                # stream (no 1s poll latency); gangs interleave via polling.
-                sub_id = picked[0].job_submissions[-1].id
-                clean = False
-                try:
-                    for kind, payload in self._stream_ws(sub_id, cursors.get(sub_id)):
-                        if kind == "data":
-                            yield payload
-                        else:  # cursor checkpoint
-                            cursors[sub_id] = payload or cursors.get(sub_id)
-                    clean = True  # generator exhausted; check how it ended
-                except ConnectionError:
-                    pass
+            subs = [j.job_submissions[-1].id for j in picked if j.job_submissions]
+            if subs:
+                # Every followed job rides the server's websocket stream (no
+                # 1s poll latency); gangs multiplex one stream per job via
+                # reader threads — the flagship multi-host workload gets the
+                # same premium path as single jobs.
+                all_clean = True
+                for kind, sub_id, payload in self._stream_ws_multi(subs, dict(cursors)):
+                    if kind == "data":
+                        yield payload
+                    elif kind == "cursor":
+                        cursors[sub_id] = payload or cursors.get(sub_id)
+                    else:  # "end": payload = stream closed cleanly
+                        all_clean = all_clean and payload
                 self.refresh()
-                if clean and self._ws_clean and self._dto.status.is_finished():
+                if all_clean and self._dto.status.is_finished():
                     return
                 # Disconnect or job retry: resume via the poll loop from the
-                # last checkpoint (no duplication — cursors carry over).
+                # last checkpoints (no duplication — cursors carry over).
 
         while True:
             for job in _picked():
@@ -154,13 +154,65 @@ class Run:
             time.sleep(poll_interval)
             self.refresh()
 
-    _ws_clean = False
+    def _stream_ws_multi(self, sub_ids: List[str], start_cursors: Dict[str, Optional[str]]):
+        """Merge per-job follow websockets into one stream of
+        ("data"|"cursor"|"end", sub_id, payload) tuples. One reader thread
+        per stream feeds a queue; "end" carries True when that stream was
+        closed deliberately by the server (job finished) — a drop carries
+        False so the caller falls back to polling for the tail. Closing the
+        generator (caller breaks out of the follow) closes every websocket
+        so reader threads exit instead of buffering frames forever."""
+        import queue as _queue
+        import threading as _threading
+
+        q: "_queue.Queue" = _queue.Queue()
+        clients: List[Any] = []
+
+        def reader(sub_id: str) -> None:
+            clean = False
+            error = None
+            try:
+                gen = self._stream_ws(
+                    sub_id, start_cursors.get(sub_id), register=clients.append
+                )
+                for kind, payload in gen:
+                    if kind == "clean":
+                        clean = payload
+                    else:
+                        q.put((kind, sub_id, payload))
+            except (ConnectionError, OSError):
+                clean = False  # dropped connection: poll fallback picks up
+            except Exception as e:  # protocol/programming bug: surface it
+                error = e
+            q.put(("end", sub_id, clean) if error is None else ("error", sub_id, error))
+
+        threads = [
+            _threading.Thread(target=reader, args=(s,), daemon=True) for s in sub_ids
+        ]
+        for t in threads:
+            t.start()
+        try:
+            ended = 0
+            while ended < len(sub_ids):
+                kind, sub_id, payload = q.get()
+                if kind == "error":
+                    raise payload
+                if kind == "end":
+                    ended += 1
+                yield kind, sub_id, payload
+        finally:
+            for ws in clients:
+                try:
+                    ws.close()
+                except Exception:
+                    pass
 
     def _stream_ws(self, job_submission_id: str,
-                   start_after: Optional[str] = None):
+                   start_after: Optional[str] = None, register=None):
         """Yield ("data", bytes) log frames and ("cursor", str) checkpoints
-        from the server's follow websocket; sets _ws_clean when the server
-        closed the stream deliberately (job finished) rather than dropping."""
+        from the server's follow websocket, then a final ("clean", bool) —
+        True when the server closed the stream deliberately (job finished)
+        rather than the connection dropping."""
         import json as _json
 
         from dstack_tpu.api.ws import WsClient
@@ -171,8 +223,9 @@ class Run:
         )
         if start_after:
             url += f"?start_after={start_after}"
-        self._ws_clean = False
         ws = WsClient(url, token=self._client.api.token).connect()
+        if register is not None:
+            register(ws)
         try:
             for opcode, payload in ws.typed_frames():
                 if opcode == 0x1:  # text = control (cursor checkpoint)
@@ -182,7 +235,7 @@ class Run:
                         pass
                 else:
                     yield "data", payload
-            self._ws_clean = ws.clean_close
+            yield "clean", ws.clean_close
         finally:
             ws.close()
 

@@ -116,7 +116,11 @@ class LocalCompute(Compute):
                 ],
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
-                env={**os.environ, **(env or {}), "PYTHONPATH": pythonpath},
+                env={**os.environ, **(env or {}), "PYTHONPATH": pythonpath,
+                     # Jobs run as raw host processes here; bootstrap steps
+                     # that would mutate the environment (pip installs) are
+                     # gated on this marker.
+                     "DSTACK_TPU_LOCAL": "1"},
                 start_new_session=True,
             )
             instance_id = f"local-{proc.pid}"

@@ -351,6 +351,12 @@ def attach(run_name: str, project: Optional[str], no_ssh: bool) -> None:
                     console.print(
                         f"SSH: [bold]ssh {info.host_alias}[/] ({info.hostname})"
                     )
+                conf = run.dto.run_spec.configuration
+                if info.hostname and getattr(conf, "type", None) == "dev-environment":
+                    console.print(
+                        "Open in VS Code Desktop: [bold]"
+                        f"vscode://vscode-remote/ssh-remote+{info.host_alias}/workflow[/]"
+                    )
                 for remote, local in info.ports.items():
                     console.print(f"Forwarding localhost:{local} -> :{remote}")
             except DstackTpuError as e:

@@ -77,6 +77,47 @@ def _app_specs(ports: List[PortMapping]) -> List[AppSpec]:
     ]
 
 
+def _dev_env_commands(conf, run_name: str) -> List[str]:
+    """IDE bootstrap for dev environments.
+
+    Parity: reference jobs/configurators/dev.py + extensions/vscode.py —
+    VS Code Desktop connects over the managed SSH config block that
+    `attach` writes (`ssh <run-name>`), so the bootstrap installs the
+    vscode server (pinned build when `version` is set), an ipykernel for
+    notebooks, runs user init, prints the vscode:// URL, then idles.
+    """
+    commands: List[str] = []
+    if conf.version:
+        target = f"~/.vscode-server/bin/{conf.version}"
+        commands += [
+            'if [ "$(uname -m)" = aarch64 ]; then arch=arm64; else arch=x64; fi',
+            f"mkdir -p {target} /tmp",
+            f'curl -fsSL "https://update.code.visualstudio.com/commit:{conf.version}'
+            f'/server-linux-$arch/stable" -o /tmp/vscode-server.tar.gz'
+            f' && tar --no-same-owner -xz --strip-components=1 -C {target}'
+            f" -f /tmp/vscode-server.tar.gz && rm /tmp/vscode-server.tar.gz"
+            f' || echo "vscode server install failed; Remote-SSH will bootstrap itself"',
+        ]
+    # DSTACK_TPU_LOCAL marks process-backend (non-containerized) runs: the
+    # orchestrator must not pip-install into the operator's host Python.
+    commands.append(
+        "python -c 'import ipykernel' 2>/dev/null"
+        ' || [ -n "$DSTACK_TPU_LOCAL" ]'
+        " || (pip install -q --no-cache-dir ipykernel 2>/dev/null)"
+        ' || echo "no pip, ipykernel was not installed"'
+    )
+    commands += list(conf.init)
+    commands += [
+        "echo ''",
+        "echo 'Dev environment ready. To open in VS Code Desktop:'",
+        f"echo '  vscode://vscode-remote/ssh-remote+{run_name}/workflow'",
+        f"echo 'or connect with: ssh {run_name}'",
+        "echo ''",
+        "tail -f /dev/null",
+    ]
+    return commands
+
+
 def get_target_topology(run_spec: RunSpec) -> Optional[TpuTopology]:
     req = Requirements(resources=run_spec.configuration.resources)
     return resolve_target_topology(req)
@@ -136,9 +177,7 @@ def get_job_specs(run_spec: RunSpec, replica_num: int) -> List[JobSpec]:
         return jobs
 
     if isinstance(conf, DevEnvironmentConfiguration):
-        commands = ["echo 'Dev environment started'", "sleep infinity"]
-        if conf.init:
-            commands = list(conf.init) + commands
+        commands = _dev_env_commands(conf, run_name)
         return [
             JobSpec(
                 replica_num=replica_num,
