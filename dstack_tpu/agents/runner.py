@@ -393,6 +393,15 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
     async def metrics(request: Request):
         return MetricsResponse(**executor.metrics().model_dump())
 
+    @router.get("/debug/threads")
+    async def debug_threads(request: Request):
+        # pprof parity: the Go reference runner serves net/http/pprof
+        # (runner/cmd/runner/main.go:7); thread stacks are the Python
+        # equivalent of its goroutine profile.
+        from dstack_tpu.server.tracing import thread_dump
+
+        return {"threads": thread_dump()}
+
     ws_router = Router()
 
     @ws_router.websocket("/logs_ws")

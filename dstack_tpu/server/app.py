@@ -40,6 +40,7 @@ def create_app(
 
     from dstack_tpu.server.routers import (
         backends as backends_router,
+        debug as debug_router,
         fleets as fleets_router,
         instances as instances_router,
         logs as logs_router,
@@ -61,8 +62,13 @@ def create_app(
         instances_router, volumes_router, gateways_router, backends_router,
         repos_router, secrets_router, logs_router, metrics_router,
         server_info_router, services_proxy_router, model_proxy_router,
+        debug_router,
     ):
         app.include_router(mod.router)
+
+    # Self-hosted observability (parity: Sentry tracing + pprof — SURVEY §5):
+    # request/processor spans, fingerprinted errors, live profiler at /debug/*.
+    app.state["tracer"] = ctx.tracer
 
     async def _startup() -> None:
         if db.path != ":memory:":

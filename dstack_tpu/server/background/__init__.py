@@ -66,7 +66,11 @@ async def _loop(
             pass
         signal.clear()
         try:
-            await fn(ctx)
+            # Tracing: every processor tick becomes a span, so /debug/traces
+            # shows FSM latencies and /debug/errors catches processor bugs
+            # (parity: reference Sentry tracing, server/app.py:68-76).
+            with ctx.tracer.span(f"bg {channel}"):
+                await fn(ctx)
         except asyncio.CancelledError:
             raise
         except Exception:

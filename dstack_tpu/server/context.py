@@ -28,6 +28,12 @@ class ServerContext:
         from dstack_tpu.server.services.stats import ServiceStatsCollector
 
         self.service_stats = ServiceStatsCollector()
+        from dstack_tpu.server.tracing import Tracer
+
+        # Per-server tracer (spans, errors, /debug/*): a process-global
+        # singleton would leak spans across the many apps a test process
+        # creates.
+        self.tracer = Tracer()
         self._signals: Dict[str, asyncio.Event] = {}
         self._tasks: List[asyncio.Task] = []
         self.stopping = False

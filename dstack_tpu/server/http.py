@@ -229,12 +229,37 @@ class App:
         return None, {}, path_matched
 
     async def handle(self, request: Request) -> Response:
+        tracer = self.state.get("tracer")
+        if tracer is None:
+            return await self._dispatch(request)
+        # Span name uses the route *pattern* — bounded cardinality: raw
+        # paths would let unauthenticated garbage requests grow the stats
+        # table without limit. One route lookup, shared with _dispatch.
+        import time as _time
+
+        match = self._find_route(request.method, request.path)
+        route = match[0]
+        name = f"http {request.method} {route.pattern if route else '<unmatched>'}"
+        start = _time.monotonic()
+        resp = await self._dispatch(request, match)
+        tracer.record(
+            name,
+            _time.monotonic() - start,
+            error_name=f"http_{resp.status}" if resp.status >= 500 else None,
+            status=resp.status,
+        )
+        return resp
+
+    async def _dispatch(self, request: Request, match=None) -> Response:
         try:
             for mw in self.middleware:
                 resp = await mw(request)
                 if resp is not None:
                     return resp
-            route, params, path_matched = self._find_route(request.method, request.path)
+            route, params, path_matched = (
+                match if match is not None
+                else self._find_route(request.method, request.path)
+            )
             if route is None:
                 if path_matched:
                     return Response({"detail": "Method not allowed"}, status=405)
@@ -257,8 +282,12 @@ class App:
             return Response(
                 {"detail": [{"msg": str(e), "code": "validation_error"}]}, status=400
             )
-        except Exception:
+        except Exception as e:
             logger.exception("Unhandled server error: %s %s", request.method, request.path)
+            tracer = self.state.get("tracer")
+            if tracer is not None:
+                # Sentry-equivalent capture: fingerprinted in /debug/errors.
+                tracer.capture_exception(e, method=request.method, path=request.path)
             return Response(
                 {"detail": [{"msg": "Internal server error", "code": "server_error"}]},
                 status=500,

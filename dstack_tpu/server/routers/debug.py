@@ -1,0 +1,57 @@
+"""/debug/* — self-hosted observability endpoints (admin-only).
+
+Parity: the reference's Sentry tracing/profiling (server/app.py:68-76) and
+the Go runner's net/http/pprof import. Zero-egress equivalent: traces and
+errors are served from the server's Tracer; /debug/profile runs the
+sampling profiler against the live server and returns collapsed stacks.
+"""
+
+from dstack_tpu.errors import BadRequestError, ForbiddenError
+from dstack_tpu.models.users import GlobalRole
+from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.routers.deps import auth_user, get_ctx
+from dstack_tpu.server.tracing import sample_profile, thread_dump
+
+router = Router()
+
+
+async def _auth_admin(request: Request):
+    # UnauthorizedError (no/bad token) propagates as 401 like every other
+    # router; only an authenticated non-admin becomes 403.
+    user = await auth_user(request)
+    if user.global_role != GlobalRole.ADMIN:
+        raise ForbiddenError()
+    return get_ctx(request)
+
+
+@router.get("/debug/traces")
+async def traces(request: Request):
+    ctx = await _auth_admin(request)
+    return ctx.tracer.snapshot()
+
+
+@router.get("/debug/errors")
+async def errors(request: Request):
+    ctx = await _auth_admin(request)
+    return {"errors": ctx.tracer.error_snapshot()}
+
+
+@router.get("/debug/threads")
+async def threads(request: Request):
+    await _auth_admin(request)
+    return {"threads": thread_dump()}
+
+
+@router.get("/debug/profile")
+async def profile(request: Request):
+    await _auth_admin(request)
+    import asyncio
+
+    try:
+        seconds = max(0.1, min(float(request.query_param("seconds", "2")), 30.0))
+        hz = max(1, min(int(request.query_param("hz", "100")), 1000))
+    except ValueError:
+        raise BadRequestError("seconds/hz must be numeric")
+    # Sampling loops in a worker thread; the event loop (and the server)
+    # keeps serving while the profile is taken — that's the point.
+    return await asyncio.to_thread(sample_profile, seconds, hz)

@@ -1,0 +1,201 @@
+"""In-process tracing, error capture, and statistical profiling.
+
+Parity: the reference wires Sentry (tracing + profiling sample rates,
+server/app.py:68-76) and imports net/http/pprof in the Go runner
+(runner/cmd/runner/main.go:7). This environment has zero egress, so the
+equivalent is self-hosted: a span recorder with per-name latency stats, an
+error ring with Sentry-style fingerprint dedupe, and a sampling profiler
+over `sys._current_frames` that emits collapsed stacks (flamegraph
+format). Everything is stdlib and lock-cheap; exposed over /debug/*
+(routers/debug.py) the way pprof exposes /debug/pprof/*.
+"""
+
+import itertools
+import sys
+import threading
+import time
+import traceback
+from collections import Counter, defaultdict, deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_span_ids = itertools.count(1)
+
+
+class SpanStats:
+    __slots__ = ("count", "total_s", "max_s", "errors")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.errors = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "errors": self.errors,
+            "total_s": round(self.total_s, 6),
+            "avg_ms": round(self.total_s / self.count * 1000, 3) if self.count else 0.0,
+            "max_ms": round(self.max_s * 1000, 3),
+        }
+
+
+class Tracer:
+    """Span recorder: recent spans in a ring, aggregates per span name."""
+
+    def __init__(self, max_spans: int = 1000, max_errors: int = 200):
+        self._lock = threading.Lock()
+        self.spans: deque = deque(maxlen=max_spans)
+        self.stats: Dict[str, SpanStats] = defaultdict(SpanStats)
+        # Sentry-style error dedupe: fingerprint -> {first/last seen, count,
+        # one representative traceback}.
+        self.errors: Dict[str, Dict[str, Any]] = {}
+        self._errors_order: deque = deque(maxlen=max_errors)
+
+    def record(
+        self,
+        name: str,
+        duration_s: float,
+        error_name: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        """Record one completed span (for callers that time manually, e.g.
+        the HTTP layer which also wants the response status as an attr)."""
+        with self._lock:
+            st = self.stats[name]
+            st.count += 1
+            st.total_s += duration_s
+            st.max_s = max(st.max_s, duration_s)
+            if error_name is not None:
+                st.errors += 1
+            self.spans.append({
+                "id": next(_span_ids),
+                "name": name,
+                "ts": time.time(),
+                "duration_ms": round(duration_s * 1000, 3),
+                "error": error_name,
+                **attrs,
+            })
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        start = time.monotonic()
+        error: Optional[BaseException] = None
+        try:
+            yield
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            # CancelledError/KeyboardInterrupt are control flow (clean
+            # shutdown cancels every background span) — time them, but do
+            # not count them as errors or pollute /debug/errors.
+            is_failure = isinstance(error, Exception)
+            self.record(
+                name,
+                time.monotonic() - start,
+                error_name=type(error).__name__ if is_failure else None,
+                **attrs,
+            )
+            if is_failure:
+                self.capture_exception(error, span=name, **attrs)
+
+    def capture_exception(self, exc: BaseException, **context: Any) -> str:
+        """Record an exception event; returns its fingerprint. Repeats of the
+        same (type, raise site) bump a counter instead of flooding the ring."""
+        tb = exc.__traceback__
+        site = ""
+        while tb is not None:  # innermost frame = the raise site
+            site = f"{tb.tb_frame.f_code.co_filename}:{tb.tb_lineno}"
+            tb = tb.tb_next
+        fingerprint = f"{type(exc).__name__}@{site}"
+        now = time.time()
+        with self._lock:
+            ev = self.errors.get(fingerprint)
+            if ev is None:
+                if len(self._errors_order) == self._errors_order.maxlen:
+                    oldest = self._errors_order.popleft()
+                    self.errors.pop(oldest, None)
+                self._errors_order.append(fingerprint)
+                self.errors[fingerprint] = {
+                    "fingerprint": fingerprint,
+                    "type": type(exc).__name__,
+                    "message": str(exc)[:500],
+                    "first_seen": now,
+                    "last_seen": now,
+                    "count": 1,
+                    "traceback": "".join(
+                        traceback.format_exception(type(exc), exc, exc.__traceback__)
+                    )[-4000:],
+                    "context": {k: str(v)[:200] for k, v in context.items()},
+                }
+            else:
+                ev["count"] += 1
+                ev["last_seen"] = now
+                ev["message"] = str(exc)[:500]
+        return fingerprint
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "stats": {name: st.to_dict() for name, st in self.stats.items()},
+                "recent_spans": list(self.spans)[-100:],
+            }
+
+    def error_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return sorted(
+                (dict(e) for e in self.errors.values()),
+                key=lambda e: e["last_seen"],
+                reverse=True,
+            )
+
+
+def thread_dump() -> Dict[str, List[str]]:
+    """Stacks of every live thread (pprof `goroutine`-profile equivalent)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        out[label] = [
+            f"{fs.filename}:{fs.lineno} {fs.name}"
+            for fs in traceback.extract_stack(frame)
+        ]
+    return out
+
+
+def sample_profile(seconds: float = 2.0, hz: int = 100) -> Dict[str, Any]:
+    """Statistical profile: sample all thread stacks at `hz` for `seconds`,
+    return collapsed stacks ("frame;frame;frame count" — flamegraph.pl /
+    speedscope input) sorted by weight. The pprof `profile` equivalent,
+    pure stdlib, safe to run against a live server."""
+    interval = 1.0 / hz
+    counts: Counter = Counter()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for frame in sys._current_frames().values():
+            # Raw frame walk — traceback.extract_stack touches linecache
+            # (file IO) and is far too slow to sample at 100 Hz.
+            parts: List[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(
+                    f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})"
+                )
+                f = f.f_back
+            counts[";".join(reversed(parts))] += 1
+        samples += 1
+        time.sleep(interval)
+    return {
+        "seconds": seconds,
+        "hz": hz,
+        "samples": samples,
+        "collapsed": [
+            {"stack": stack, "count": n} for stack, n in counts.most_common(200)
+        ],
+    }
+
+
