@@ -100,21 +100,27 @@ def create_app(
             config_manager.apply_encryption(ctx)
 
         ctx.log_storage = logs_service.default_log_storage(ctx)
-        admin = await users_service.get_or_create_admin(
-            ctx, admin_token or settings.SERVER_ADMIN_TOKEN
-        )
-        app.state["admin_token"] = admin.creds.token
-        from dstack_tpu.models.users import User
-
-        admin_user = User(**{k: v for k, v in admin.model_dump().items() if k != "creds"})
-        try:
-            await projects_service.get_project(ctx, settings.DEFAULT_PROJECT_NAME)
-        except Exception:
-            await projects_service.create_project(
-                ctx, admin_user, settings.DEFAULT_PROJECT_NAME
+        # Boot-time init is wrapped in the advisory-lock equivalent so
+        # several replicas sharing one DB don't race admin/default-project
+        # creation (parity: reference advisory_lock_ctx, app.py:96-122).
+        async with ctx.claims.lock_ctx("server_init", ["boot"]):
+            admin = await users_service.get_or_create_admin(
+                ctx, admin_token or settings.SERVER_ADMIN_TOKEN
             )
-        if config_manager is not None:
-            await config_manager.apply_projects(ctx, admin_user)
+            app.state["admin_token"] = admin.creds.token
+            from dstack_tpu.models.users import User
+
+            admin_user = User(
+                **{k: v for k, v in admin.model_dump().items() if k != "creds"}
+            )
+            try:
+                await projects_service.get_project(ctx, settings.DEFAULT_PROJECT_NAME)
+            except Exception:
+                await projects_service.create_project(
+                    ctx, admin_user, settings.DEFAULT_PROJECT_NAME
+                )
+            if config_manager is not None:
+                await config_manager.apply_projects(ctx, admin_user)
         from dstack_tpu.server.services import backends as backends_service
 
         await backends_service.init_backends(ctx)

@@ -47,9 +47,16 @@ def start_background_tasks(ctx: ServerContext) -> None:
         ("gateways", settings.PROCESS_GATEWAYS_INTERVAL, process_gateways),
         ("metrics", settings.PROCESS_METRICS_INTERVAL, collect_metrics),
         ("metrics_gc", 60.0, delete_expired_metrics),
+        # Multi-replica lease heartbeat: claims held across long operations
+        # (slow cloud calls, image pulls) must not expire mid-section.
+        ("lease_heartbeat", ctx.claims.ttl / 4, _renew_leases),
     ]
     for channel, interval, fn in loops:
         ctx.spawn(_loop(ctx, channel, interval, fn))
+
+
+async def _renew_leases(ctx: ServerContext) -> None:
+    await ctx.claims.renew_held()
 
 
 async def _loop(

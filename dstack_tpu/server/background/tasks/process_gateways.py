@@ -19,14 +19,14 @@ async def process_gateways(ctx: ServerContext) -> None:
         "SELECT * FROM gateways WHERE status IN ('submitted', 'provisioning')"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("gateways", row["id"]):
+        if not await ctx.claims.try_claim("gateways", row["id"]):
             continue
         try:
             await _process_gateway(ctx, row)
         except Exception:
             logger.exception("failed to process gateway %s", row["name"])
         finally:
-            ctx.locker.unlock_nowait("gateways", row["id"])
+            await ctx.claims.release("gateways", row["id"])
     await _poll_gateway_stats(ctx)
 
 

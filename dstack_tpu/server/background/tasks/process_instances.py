@@ -30,14 +30,14 @@ async def process_instances(ctx: ServerContext) -> None:
         " ORDER BY last_processed_at"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("instances", row["id"]):
+        if not await ctx.claims.try_claim("instances", row["id"]):
             continue
         try:
             await _process_instance(ctx, row)
         except Exception:
             logger.exception("failed to process instance %s", row["name"])
         finally:
-            ctx.locker.unlock_nowait("instances", row["id"])
+            await ctx.claims.release("instances", row["id"])
 
 
 async def _process_instance(ctx: ServerContext, row: sqlite3.Row) -> None:

@@ -40,14 +40,14 @@ async def process_running_jobs(ctx: ServerContext) -> None:
         " ORDER BY last_processed_at"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("jobs", row["id"]):
+        if not await ctx.claims.try_claim("jobs", row["id"]):
             continue
         try:
             await _process_job(ctx, row)
         except Exception:
             logger.exception("failed to process running job %s", row["id"])
         finally:
-            ctx.locker.unlock_nowait("jobs", row["id"])
+            await ctx.claims.release("jobs", row["id"])
 
 
 async def process_terminating_jobs(ctx: ServerContext) -> None:
@@ -55,14 +55,14 @@ async def process_terminating_jobs(ctx: ServerContext) -> None:
         "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("jobs", row["id"]):
+        if not await ctx.claims.try_claim("jobs", row["id"]):
             continue
         try:
             await _terminate_job(ctx, row)
         except Exception:
             logger.exception("failed to terminate job %s", row["id"])
         finally:
-            ctx.locker.unlock_nowait("jobs", row["id"])
+            await ctx.claims.release("jobs", row["id"])
 
 
 async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:

@@ -36,14 +36,14 @@ async def process_runs(ctx: ServerContext) -> None:
         " AND deleted = 0 ORDER BY last_processed_at"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("runs", row["id"]):
+        if not await ctx.claims.try_claim("runs", row["id"]):
             continue
         try:
             await _process_run(ctx, row)
         except Exception:
             logger.exception("failed to process run %s", row["run_name"])
         finally:
-            ctx.locker.unlock_nowait("runs", row["id"])
+            await ctx.claims.release("runs", row["id"])
 
 
 async def _process_run(ctx: ServerContext, row: sqlite3.Row) -> None:

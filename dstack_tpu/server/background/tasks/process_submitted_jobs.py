@@ -45,14 +45,14 @@ async def process_submitted_jobs(ctx: ServerContext) -> None:
         "SELECT * FROM jobs WHERE status = 'submitted' ORDER BY last_processed_at"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("jobs", row["id"]):
+        if not await ctx.claims.try_claim("jobs", row["id"]):
             continue
         try:
             await _process_job(ctx, row)
         except Exception:
             logger.exception("failed to process submitted job %s", row["id"])
         finally:
-            ctx.locker.unlock_nowait("jobs", row["id"])
+            await ctx.claims.release("jobs", row["id"])
 
 
 async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:

@@ -17,14 +17,14 @@ async def process_volumes(ctx: ServerContext) -> None:
         "SELECT * FROM volumes WHERE deleted = 0 AND status IN ('submitted', 'provisioning')"
     )
     for row in rows:
-        if not ctx.locker.try_lock_nowait("volumes", row["id"]):
+        if not await ctx.claims.try_claim("volumes", row["id"]):
             continue
         try:
             await _process_volume(ctx, row)
         except Exception:
             logger.exception("failed to process volume %s", row["name"])
         finally:
-            ctx.locker.unlock_nowait("volumes", row["id"])
+            await ctx.claims.release("volumes", row["id"])
 
 
 async def _process_volume(ctx: ServerContext, row) -> None:

@@ -13,15 +13,22 @@ lever vs the reference's fixed 2-4s APScheduler intervals
 import asyncio
 from typing import Any, Callable, Dict, List, Optional
 
+import uuid
+
 from dstack_tpu.server.db import Database
 from dstack_tpu.server.security import Encryption
-from dstack_tpu.server.services.locking import ResourceLocker
+from dstack_tpu.server.services.locking import ClaimLocker, ResourceLocker
 
 
 class ServerContext:
     def __init__(self, db: Database, encryption: Optional[Encryption] = None):
         self.db = db
         self.locker = ResourceLocker()
+        # Cross-replica FSM claims (SKIP LOCKED equivalent): several server
+        # replicas may share one file-backed DB; leases keep their
+        # background processors from double-driving a row.
+        self.replica_id = uuid.uuid4().hex[:12]
+        self.claims = ClaimLocker(db, self.replica_id, self.locker)
         self.encryption = encryption or Encryption()
         self.backends: Dict[str, Any] = {}  # (project_id, type) -> Backend; see services/backends.py
         self.log_storage: Any = None  # set at startup; see services/logs.py

@@ -57,11 +57,23 @@ class Database:
 
     async def migrate(self) -> None:
         def _migrate(conn: sqlite3.Connection) -> None:
-            version = conn.execute("PRAGMA user_version").fetchone()[0]
-            for i, sql in enumerate(MIGRATIONS[version:], start=version + 1):
-                conn.executescript(sql)
-                conn.execute(f"PRAGMA user_version = {i}")
-                conn.commit()
+            # Several server replicas may boot against one file concurrently;
+            # an OS lock on a sidecar file serializes the read-version/apply
+            # sequence (executescript commits as it goes, so a transaction
+            # can't provide this).
+            import contextlib
+            import fcntl
+
+            with contextlib.ExitStack() as stack:
+                if self.path != ":memory:":
+                    lockf = stack.enter_context(open(self.path + ".init.lock", "w"))
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+                    stack.callback(fcntl.flock, lockf, fcntl.LOCK_UN)
+                version = conn.execute("PRAGMA user_version").fetchone()[0]
+                for i, sql in enumerate(MIGRATIONS[version:], start=version + 1):
+                    conn.executescript(sql)
+                    conn.execute(f"PRAGMA user_version = {i}")
+                    conn.commit()
 
         await self.run_sync(_migrate)
 

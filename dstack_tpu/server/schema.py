@@ -276,3 +276,19 @@ ALTER TABLE instances ADD COLUMN idle_since TEXT;
 ALTER TABLE instances ADD COLUMN unreachable_since TEXT;
 """
 )
+
+# Migration 4: multi-replica control plane. Cross-process FSM claims — the
+# moral equivalent of the reference's `SELECT ... FOR UPDATE SKIP LOCKED`
+# (services/locking.py + Postgres) — as expiring lease rows so a crashed
+# replica's claims free themselves. See docs/design/scaling.md.
+migration(
+    """
+CREATE TABLE resource_leases (
+    namespace TEXT NOT NULL,
+    key TEXT NOT NULL,
+    owner TEXT NOT NULL,
+    expires_at REAL NOT NULL,
+    PRIMARY KEY (namespace, key)
+);
+"""
+)

@@ -7,8 +7,9 @@ on sqlite the asyncio locksets are authoritative.
 """
 
 import asyncio
+import time
 from contextlib import asynccontextmanager
-from typing import AsyncIterator, Dict, Iterable, List, Set
+from typing import AsyncIterator, Dict, Iterable, List, Set, Tuple
 
 
 class ResourceLocker:
@@ -54,3 +55,135 @@ class ResourceLocker:
     async def _notify(self) -> None:
         async with self._cond:
             self._cond.notify_all()
+
+
+class ClaimLocker:
+    """Cross-replica FSM claims: in-process lockset + DB lease rows.
+
+    Parity: the reference pairs its in-memory locksets with
+    `SELECT ... FOR UPDATE SKIP LOCKED` on Postgres and advisory locks for
+    cross-replica init (services/locking.py:13-81, db.py). Here the
+    distributed half is an expiring lease row in `resource_leases` keyed by
+    (namespace, key): a replica that crashes mid-claim frees its resources
+    when the lease expires, instead of relying on a DB session dying.
+
+    Held leases must be renewed before they expire — a critical section
+    longer than `ttl` would otherwise let another replica steal the lease
+    mid-section. The background scheduler runs `renew_held()` every ttl/4
+    (see server/background/__init__.py).
+
+    An in-memory database is single-process by construction, so only the
+    local lockset is consulted there — which keeps every hermetic test on
+    the exact pre-multi-replica behavior.
+    """
+
+    def __init__(self, db, replica_id: str, local: ResourceLocker, ttl: float = 120.0):
+        self._db = db
+        self.replica_id = replica_id
+        self._local = local
+        self.ttl = ttl
+        self._held: Set[Tuple[str, str]] = set()
+
+    @property
+    def _distributed(self) -> bool:
+        return self._db.path != ":memory:"
+
+    async def try_claim(self, namespace: str, key: str) -> bool:
+        """Non-blocking claim; the `SKIP LOCKED` equivalent for FSM polls."""
+        if not self._local.try_lock_nowait(namespace, key):
+            return False
+        if not self._distributed:
+            return True
+        ok = False
+        try:
+            ok = await self._try_lease(namespace, key)
+        finally:
+            if ok:
+                self._held.add((namespace, key))
+            else:
+                # DB refusal or DB error: either way the local lock must not
+                # leak, or this replica would never process the row again.
+                self._local.unlock_nowait(namespace, key)
+        return ok
+
+    async def release(self, namespace: str, key: str) -> None:
+        try:
+            if self._distributed:
+                self._held.discard((namespace, key))
+                await self._db.execute(
+                    "DELETE FROM resource_leases WHERE namespace = ? AND key = ?"
+                    " AND owner = ?",
+                    (namespace, key, self.replica_id),
+                )
+        finally:
+            self._local.unlock_nowait(namespace, key)
+
+    async def renew_held(self) -> None:
+        """Extend every held lease's expiry; called periodically by the
+        scheduler so claims held across long operations survive the TTL."""
+        for namespace, key in list(self._held):
+            try:
+                await self._try_lease(namespace, key)  # owner renewal path
+            except Exception:
+                pass  # next heartbeat retries; worst case the lease expires
+
+    @asynccontextmanager
+    async def lock_ctx(
+        self, namespace: str, keys: Iterable[str], poll: float = 0.05
+    ) -> AsyncIterator[None]:
+        """Blocking claim of several keys; the advisory-lock equivalent
+        (run-name generation, startup init)."""
+        keys = sorted(set(keys))
+        async with self._local.lock_ctx(namespace, keys):
+            acquired: List[str] = []
+            try:
+                if self._distributed:
+                    for key in keys:
+                        # Probe with a read before attempting the UPSERT so a
+                        # contended spin does not issue a failed write
+                        # transaction every `poll` seconds.
+                        while True:
+                            if await self._lease_available(namespace, key):
+                                if await self._try_lease(namespace, key):
+                                    break
+                            await asyncio.sleep(poll)
+                        acquired.append(key)
+                        self._held.add((namespace, key))
+                yield
+            finally:
+                for key in acquired:
+                    self._held.discard((namespace, key))
+                    await self._db.execute(
+                        "DELETE FROM resource_leases WHERE namespace = ? AND key = ?"
+                        " AND owner = ?",
+                        (namespace, key, self.replica_id),
+                    )
+
+    async def _lease_available(self, namespace: str, key: str) -> bool:
+        row = await self._db.fetchone(
+            "SELECT owner, expires_at FROM resource_leases"
+            " WHERE namespace = ? AND key = ?",
+            (namespace, key),
+        )
+        return (
+            row is None
+            or row["owner"] == self.replica_id
+            or row["expires_at"] <= time.time()
+        )
+
+    async def _try_lease(self, namespace: str, key: str) -> bool:
+        now = time.time()
+
+        def _claim(conn) -> bool:
+            cur = conn.execute(
+                "INSERT INTO resource_leases (namespace, key, owner, expires_at)"
+                " VALUES (?, ?, ?, ?)"
+                " ON CONFLICT(namespace, key) DO UPDATE SET"
+                "   owner = excluded.owner, expires_at = excluded.expires_at"
+                " WHERE resource_leases.owner = excluded.owner"
+                "    OR resource_leases.expires_at <= ?",
+                (namespace, key, self.replica_id, now + self.ttl, now),
+            )
+            return cur.rowcount == 1
+
+        return await self._db.run_sync(_claim)

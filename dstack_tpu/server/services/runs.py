@@ -189,7 +189,7 @@ def _desired_replica_count(run_spec: RunSpec) -> int:
 async def submit_run(
     ctx: ServerContext, user: User, project_row: sqlite3.Row, run_spec: RunSpec
 ) -> Run:
-    async with ctx.locker.lock_ctx("run_names", [project_row["id"]]):
+    async with ctx.claims.lock_ctx("run_names", [project_row["id"]]):
         if run_spec.run_name is None:
             run_spec = run_spec.model_copy(deep=True)
             while True:
