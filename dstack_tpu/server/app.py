@@ -100,6 +100,9 @@ def create_app(
             config_manager.apply_encryption(ctx)
 
         ctx.log_storage = logs_service.default_log_storage(ctx)
+        from dstack_tpu.server.services import storage as storage_service
+
+        ctx.blob_storage = storage_service.default_blob_storage()
         # Boot-time init is wrapped in the advisory-lock equivalent so
         # several replicas sharing one DB don't race admin/default-project
         # creation (parity: reference advisory_lock_ctx, app.py:96-122).

@@ -283,7 +283,11 @@ async def _submit_to_runner(
                 await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
                             "runner did not become ready in time")
             return
-        code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
+        try:
+            code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
+        except ServerError as e:
+            await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
+            return
         jpd = _jpd(row)
         mounts: List[dict] = []
         if job_spec.volumes and jpd is not None and not jpd.dockerized:
@@ -345,6 +349,22 @@ async def _get_repo_payload(ctx: ServerContext, row: sqlite3.Row):
         (run_row["repo_id"], run_spec.repo_code_hash),
     )
     blob = code_row["blob"] if code_row else None
+    if code_row is not None and blob is None:
+        # Offloaded to object storage at upload time; row holds only the
+        # hash. An unfetchable blob (object gone, storage unconfigured)
+        # must fail the job, not silently run it without its code.
+        from dstack_tpu.server.services.storage import code_blob_key
+
+        if ctx.blob_storage is not None:
+            blob = await ctx.blob_storage.get(
+                code_blob_key(run_row["repo_id"], run_spec.repo_code_hash)
+            )
+        if blob is None:
+            raise ServerError(
+                f"code blob {run_spec.repo_code_hash} was offloaded to object"
+                " storage but cannot be retrieved (object missing or"
+                " DSTACK_TPU_GCS_BLOBS_BUCKET not configured)"
+            )
     repo_data = repo_creds = None
     repo_row = await ctx.db.fetchone(
         "SELECT * FROM repos WHERE id = ?", (run_row["repo_id"],)

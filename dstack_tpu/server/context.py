@@ -32,6 +32,7 @@ class ServerContext:
         self.encryption = encryption or Encryption()
         self.backends: Dict[str, Any] = {}  # (project_id, type) -> Backend; see services/backends.py
         self.log_storage: Any = None  # set at startup; see services/logs.py
+        self.blob_storage: Any = None  # optional object-store offload; see services/storage.py
         from dstack_tpu.server.services.stats import ServiceStatsCollector
 
         self.service_stats = ServiceStatsCollector()

@@ -86,9 +86,18 @@ async def upload_code(request: Request, project_name: str):
         raise ResourceNotExistsError("Repo does not exist; call /repos/init first")
     blob = request.body
     blob_hash = hashlib.sha256(blob).hexdigest()
+    # With object storage configured the DB row carries only the hash and
+    # the bytes go to the bucket (parity: reference S3 offload,
+    # services/storage.py); otherwise the blob lives in the codes table.
+    stored_blob: Optional[bytes] = blob
+    if ctx.blob_storage is not None:
+        from dstack_tpu.server.services.storage import code_blob_key
+
+        await ctx.blob_storage.put(code_blob_key(repo_row["id"], blob_hash), blob)
+        stored_blob = None
     await ctx.db.execute(
         "INSERT INTO codes (id, repo_id, blob_hash, blob) VALUES (?, ?, ?, ?)"
         " ON CONFLICT (repo_id, blob_hash) DO NOTHING",
-        (generate_id(), repo_row["id"], blob_hash, blob),
+        (generate_id(), repo_row["id"], blob_hash, stored_blob),
     )
     return {"blob_hash": blob_hash}

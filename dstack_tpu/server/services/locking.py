@@ -120,12 +120,40 @@ class ClaimLocker:
 
     async def renew_held(self) -> None:
         """Extend every held lease's expiry; called periodically by the
-        scheduler so claims held across long operations survive the TTL."""
+        scheduler so claims held across long operations survive the TTL.
+
+        Renewal is UPDATE-only (never an insert): a release racing this
+        loop must not leave behind a ghost row that blocks other replicas
+        for a full TTL. A renewal that finds no owned row means the lease
+        expired and was stolen — mutual exclusion is already broken for
+        that key, so scream and stop pretending to hold it."""
+        import logging
+
         for namespace, key in list(self._held):
             try:
-                await self._try_lease(namespace, key)  # owner renewal path
+                renewed = await self._renew_lease(namespace, key)
             except Exception:
-                pass  # next heartbeat retries; worst case the lease expires
+                continue  # next heartbeat retries; worst case the lease expires
+            if not renewed and (namespace, key) in self._held:
+                logging.getLogger(__name__).error(
+                    "lease (%s, %s) lost by replica %s (expired and stolen, or"
+                    " released concurrently); dropping from held set",
+                    namespace, key, self.replica_id,
+                )
+                self._held.discard((namespace, key))
+
+    async def _renew_lease(self, namespace: str, key: str) -> bool:
+        expires = time.time() + self.ttl
+
+        def _renew(conn) -> bool:
+            cur = conn.execute(
+                "UPDATE resource_leases SET expires_at = ?"
+                " WHERE namespace = ? AND key = ? AND owner = ?",
+                (expires, namespace, key, self.replica_id),
+            )
+            return cur.rowcount == 1
+
+        return await self._db.run_sync(_renew)
 
     @asynccontextmanager
     async def lock_ctx(

@@ -159,7 +159,144 @@ class FileLogStorage(LogStorage):
         return JobSubmissionLogs(logs=events, next_token=str(consumed) if consumed else "")
 
 
+class GcpLogStorage(LogStorage):
+    """Cloud Logging sink — the TPU-native CloudWatchLogStorage
+    (reference services/logs.py:65-341): selected by env, entries labeled
+    by job submission, poll returns a resumable cursor.
+
+    The client boundary is a thin interface (`write`/`list_after`) so tests
+    inject a fake; the real adapter speaks google.cloud.logging. Cursor =
+    `"{ts_ms}:{seq}"` of the last returned entry — Cloud Logging page
+    tokens expire, so follow-mode re-filters by timestamp instead.
+
+    Client contract: `seq` breaks ties between same-millisecond entries and
+    must be monotonic ACROSS writer processes (claims migrate between
+    replicas; a restart must not reset it) — the real adapter stamps
+    wall-clock nanoseconds, not a counter.
+    """
+
+    def __init__(self, gcp_project: str, client=None):
+        self.gcp_project = gcp_project
+        self.client = client or _GoogleCloudLoggingClient(gcp_project)
+
+    def _log_name(self, project_id: str) -> str:
+        return f"dstack-tpu-{project_id}"
+
+    async def write(
+        self, project_id, run_name, job_submission_id, job_logs, runner_logs
+    ) -> None:
+        entries = []
+        for source, events in (("stdout", job_logs), ("runner", runner_logs)):
+            for e in events:
+                entries.append(
+                    {
+                        "ts_ms": e.timestamp,
+                        "b64": e.message,
+                        "labels": {
+                            "run_name": run_name,
+                            "job_submission_id": job_submission_id,
+                            "source": source,
+                        },
+                    }
+                )
+        if entries:
+            import asyncio
+
+            await asyncio.to_thread(
+                self.client.write, self._log_name(project_id), entries
+            )
+
+    async def poll(
+        self, project_id, run_name, job_submission_id, start_after=None, limit=1000,
+        diagnose=False,
+    ) -> JobSubmissionLogs:
+        source = "runner" if diagnose else "stdout"
+        after = None
+        if start_after:
+            ts_ms, _, seq = start_after.partition(":")
+            after = (int(ts_ms), int(seq or 0))
+        import asyncio
+
+        entries = await asyncio.to_thread(
+            self.client.list_after,
+            self._log_name(project_id),
+            job_submission_id,
+            source,
+            after,
+            limit,
+        )
+        events = [
+            LogEvent(
+                timestamp=_event_ts(e["ts_ms"]),
+                log_source=LogProducer.RUNNER if diagnose else LogProducer.JOB,
+                message=e["b64"],
+            )
+            for e in entries
+        ]
+        if entries:
+            last = entries[-1]
+            next_token = f"{last['ts_ms']}:{last['seq']}"
+        else:
+            next_token = start_after or ""
+        return JobSubmissionLogs(logs=events, next_token=next_token)
+
+
+class _GoogleCloudLoggingClient:  # pragma: no cover - requires network + creds
+    """Real adapter over google.cloud.logging_v2."""
+
+    def __init__(self, gcp_project: str):
+        import google.cloud.logging
+
+        self.project = gcp_project
+        self._client = google.cloud.logging.Client(project=gcp_project)
+
+    def write(self, log_name: str, entries: List[dict]) -> None:
+        import time as _time
+
+        logger = self._client.logger(log_name)
+        for e in entries:
+            # seq = wall-clock ns: survives restarts and claim migration
+            # between replicas (a per-process counter would reset and make
+            # follow cursors silently drop same-millisecond entries).
+            logger.log_struct(
+                {"b64": e["b64"], "ts_ms": e["ts_ms"], "seq": _time.time_ns()},
+                labels=e["labels"],
+                timestamp=_event_ts(e["ts_ms"]),
+            )
+
+    def list_after(self, log_name, job_submission_id, source, after, limit):
+        ts_filter = ""
+        if after is not None:
+            ts_filter = (
+                f' AND timestamp >= "{_event_ts(after[0]).isoformat()}"'
+            )
+        filter_ = (
+            f'logName="projects/{self.project}/logs/{log_name}"'
+            f' AND labels.job_submission_id="{job_submission_id}"'
+            f' AND labels.source="{source}"' + ts_filter
+        )
+        out = []
+        for entry in self._client.list_entries(filter_=filter_, page_size=limit):
+            payload = entry.payload or {}
+            item = {
+                "ts_ms": payload.get("ts_ms", 0),
+                "seq": payload.get("seq", 0),
+                "b64": payload.get("b64", ""),
+            }
+            # The timestamp filter is >= (not >): drop entries at or before
+            # the cursor position.
+            if after is not None and (item["ts_ms"], item["seq"]) <= after:
+                continue
+            out.append(item)
+            if len(out) >= limit:
+                break
+        return out
+
+
 def default_log_storage(ctx: ServerContext) -> LogStorage:
+    gcp_project = os.getenv("DSTACK_TPU_GCP_LOG_PROJECT")
+    if gcp_project:
+        return GcpLogStorage(gcp_project)
     root = os.getenv("DSTACK_TPU_FILE_LOGS_DIR")
     if root:
         return FileLogStorage(Path(root))

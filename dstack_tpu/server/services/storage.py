@@ -1,0 +1,101 @@
+"""Code-blob object storage offload.
+
+Parity: src/dstack/_internal/server/services/storage.py — the reference
+optionally offloads repo code blobs to an S3 bucket (selected by env) so the
+DB doesn't carry multi-MB tars. TPU-native equivalent: a GCS bucket
+(`DSTACK_TPU_GCS_BLOBS_BUCKET`), same cloud the TPU fleet lives in, so blob
+pulls ride Google's network. DB remains the default (single-file deploys).
+
+The GCS adapter speaks the JSON API through an injectable transport — tests
+fake the transport, the real one signs with the same token chain the GCP
+backend uses (`backends/gcp/api.py`).
+"""
+
+import abc
+import os
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+
+class BlobStorage(abc.ABC):
+    @abc.abstractmethod
+    async def put(self, key: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def get(self, key: str) -> Optional[bytes]: ...
+
+
+class GcsBlobStorage(BlobStorage):
+    """GCS JSON/upload API: objects live at gs://<bucket>/<key>."""
+
+    def __init__(self, bucket: str, transport=None):
+        self.bucket = bucket
+        self._transport = transport or _HttpGcsTransport()
+
+    async def put(self, key: str, data: bytes) -> None:
+        import asyncio
+
+        await asyncio.to_thread(self._transport.upload, self.bucket, key, data)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        import asyncio
+
+        return await asyncio.to_thread(self._transport.download, self.bucket, key)
+
+
+class _HttpGcsTransport:  # pragma: no cover - requires network + creds
+    """Minimal GCS JSON-API transport reusing the GCP token chain."""
+
+    def __init__(self):
+        from dstack_tpu.backends.gcp.api import HttpGcpApi
+
+        self._api = HttpGcpApi()
+
+    def _request(
+        self, url: str, data: Optional[bytes] = None, none_on_404: bool = False
+    ) -> Optional[bytes]:
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method="POST" if data is not None else "GET",
+            headers={
+                "Authorization": f"Bearer {self._api._get_token()}",
+                "Content-Type": "application/octet-stream",
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            # 404 means "object absent" only on download; an upload 404
+            # (bad bucket) must fail loudly, or blobs are silently lost.
+            if e.code == 404 and none_on_404:
+                return None
+            raise
+
+    def upload(self, bucket: str, key: str, data: bytes) -> None:
+        name = urllib.parse.quote(key, safe="")
+        self._request(
+            f"https://storage.googleapis.com/upload/storage/v1/b/{bucket}/o"
+            f"?uploadType=media&name={name}",
+            data=data,
+        )
+
+    def download(self, bucket: str, key: str) -> Optional[bytes]:
+        name = urllib.parse.quote(key, safe="")
+        return self._request(
+            f"https://storage.googleapis.com/storage/v1/b/{bucket}/o/{name}?alt=media",
+            none_on_404=True,
+        )
+
+
+def default_blob_storage() -> Optional[BlobStorage]:
+    bucket = os.getenv("DSTACK_TPU_GCS_BLOBS_BUCKET")
+    if bucket:
+        return GcsBlobStorage(bucket)
+    return None
+
+
+def code_blob_key(repo_id: str, blob_hash: str) -> str:
+    return f"codes/{repo_id}/{blob_hash}"
