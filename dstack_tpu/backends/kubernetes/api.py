@@ -1,0 +1,102 @@
+"""Minimal Kubernetes REST client.
+
+The reference backend uses the `kubernetes` PyPI client
+(core/backends/kubernetes/utils.py:get_api_from_config_data); that package
+is not in this environment, so — like the GCP backend (`gcp/api.py`) — the
+API boundary is a tiny protocol (`request`) that tests fake and a real
+HTTP implementation built from kubeconfig data.
+"""
+
+import json
+import ssl
+import tempfile
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Protocol
+
+from dstack_tpu.errors import BackendError
+
+
+class KubernetesApiError(BackendError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"Kubernetes API error {status}: {message}")
+        self.status = status
+
+
+class KubernetesApi(Protocol):
+    async def request(
+        self, method: str, path: str, body: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """JSON request against the cluster API server; path starts /api or
+        /apis. Raises KubernetesApiError on 4xx/5xx."""
+        ...
+
+
+class HttpKubernetesApi:  # pragma: no cover - requires a live cluster
+    """Real transport: bearer-token or client-cert auth from kubeconfig."""
+
+    def __init__(self, kubeconfig: str):
+        import base64
+
+        import yaml
+
+        cfg = yaml.safe_load(kubeconfig)
+        ctx_name = cfg.get("current-context") or cfg["contexts"][0]["name"]
+        context = next(c for c in cfg["contexts"] if c["name"] == ctx_name)["context"]
+        cluster = next(
+            c for c in cfg["clusters"] if c["name"] == context["cluster"]
+        )["cluster"]
+        user = next(u for u in cfg["users"] if u["name"] == context["user"])["user"]
+
+        self.server = cluster["server"].rstrip("/")
+        self._ssl = ssl.create_default_context()
+        ca = cluster.get("certificate-authority-data")
+        if ca:
+            self._ssl = ssl.create_default_context(
+                cadata=base64.b64decode(ca).decode()
+            )
+        if cluster.get("insecure-skip-tls-verify"):
+            self._ssl.check_hostname = False
+            self._ssl.verify_mode = ssl.CERT_NONE
+        self._token = user.get("token")
+        cert_data, key_data = (
+            user.get("client-certificate-data"),
+            user.get("client-key-data"),
+        )
+        if cert_data and key_data:
+            # load_cert_chain only takes paths; stage the pair on disk.
+            self._certfile = tempfile.NamedTemporaryFile(suffix=".pem", delete=False)
+            self._certfile.write(base64.b64decode(cert_data))
+            self._certfile.write(b"\n")
+            self._certfile.write(base64.b64decode(key_data))
+            self._certfile.flush()
+            self._ssl.load_cert_chain(self._certfile.name)
+
+    async def request(
+        self, method: str, path: str, body: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        import asyncio
+
+        return await asyncio.to_thread(self._request_sync, method, path, body)
+
+    def _request_sync(
+        self, method: str, path: str, body: Optional[Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        headers = {"Content-Type": "application/json", "Accept": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        req = urllib.request.Request(
+            self.server + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers=headers,
+        )
+        # An SSLContext is only legal for https URLs (plain-http servers
+        # appear in dev/test kubeconfigs, e.g. kubectl proxy).
+        kwargs = {"context": self._ssl} if self.server.startswith("https") else {}
+        try:
+            with urllib.request.urlopen(req, timeout=60, **kwargs) as resp:
+                data = resp.read()
+                return json.loads(data) if data else {}
+        except urllib.error.HTTPError as e:
+            raise KubernetesApiError(e.code, e.read().decode(errors="replace"))

@@ -1,0 +1,235 @@
+"""Kubernetes object builders for the GKE TPU backend.
+
+Parity: the reference builds pod/service manifests inline in
+core/backends/kubernetes/compute.py (:137-199 run_job pod+service,
+:397-449 jump pod). TPU-first delta: pods target GKE TPU node pools via the
+`cloud.google.com/gke-tpu-accelerator` / `gke-tpu-topology` node selectors
+and request `google.com/tpu` device-plugin resources — the reference only
+knows `nvidia.com/gpu` (:125-133).
+"""
+
+from typing import Dict, List, Optional
+
+from dstack_tpu.models.topology import GENERATIONS, TpuGeneration, TpuTopology
+
+LABEL_MANAGED = "app.dstack-tpu/managed"
+LABEL_INSTANCE = "app.dstack-tpu/instance"
+LABEL_WORKER = "app.dstack-tpu/worker"
+
+# GKE accelerator label values <-> TPU generations.
+GKE_TPU_ACCELERATORS: Dict[str, TpuGeneration] = {
+    "tpu-v4-podslice": TpuGeneration.V4,
+    "tpu-v5-lite-podslice": TpuGeneration.V5E,
+    "tpu-v5p-slice": TpuGeneration.V5P,
+    "tpu-v6e-slice": TpuGeneration.V6E,
+}
+ACCELERATOR_LABELS: Dict[TpuGeneration, str] = {
+    v: k for k, v in GKE_TPU_ACCELERATORS.items()
+}
+
+
+def topology_from_node_labels(labels: Dict[str, str]) -> Optional[TpuTopology]:
+    """GKE TPU node labels -> topology of the slice the node belongs to."""
+    accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+    topo_str = labels.get("cloud.google.com/gke-tpu-topology")
+    gen = GKE_TPU_ACCELERATORS.get(accel or "")
+    if gen is None or not topo_str:
+        return None
+    try:
+        grid = [int(d) for d in topo_str.lower().split("x")]
+    except ValueError:
+        return None
+    chips = 1
+    for d in grid:
+        chips *= d
+    info = GENERATIONS[gen]
+    hosts = (
+        1
+        if chips <= info.max_chips_single_host
+        else chips // info.chips_per_host_multihost
+    )
+    return TpuTopology(generation=gen, chips=chips, grid=grid, hosts=hosts)
+
+
+def runner_bootstrap_commands(
+    authorized_key: str, agent_download_url: str = ""
+) -> List[str]:
+    """In-pod bootstrap: sshd for server tunnels + the runner agent in the
+    foreground (the pod IS the job environment; no shim/docker layer —
+    dockerized=False, same direct-runner contract as SSH-fleet blocks)."""
+    cmds = [
+        "mkdir -p /root/.ssh && chmod 700 /root/.ssh",
+        f'echo "{authorized_key}" >> /root/.ssh/authorized_keys',
+        "chmod 600 /root/.ssh/authorized_keys",
+        "if command -v sshd >/dev/null; then mkdir -p /run/sshd; "
+        "ssh-keygen -A >/dev/null 2>&1 || true; /usr/sbin/sshd || sshd; fi",
+    ]
+    if agent_download_url:
+        cmds += [
+            f"curl -fsSL {agent_download_url}/dstack-tpu-runner"
+            " -o /usr/local/bin/dstack-tpu-runner",
+            "chmod +x /usr/local/bin/dstack-tpu-runner",
+        ]
+    cmds.append("exec /usr/local/bin/dstack-tpu-runner --home /var/lib/dstack-tpu")
+    return cmds
+
+
+def runner_pod_body(
+    name: str,
+    instance_id: str,
+    worker_index: int,
+    image: str,
+    authorized_key: str,
+    cpus: int,
+    memory_mib: int,
+    topo: Optional[TpuTopology] = None,
+    agent_download_url: str = "",
+) -> dict:
+    resources: Dict[str, Dict[str, str]] = {
+        "requests": {"cpu": str(cpus), "memory": f"{memory_mib}Mi"},
+        "limits": {},
+    }
+    node_selector: Dict[str, str] = {}
+    if topo is not None:
+        # TPU chips come from the device plugin and must appear in limits;
+        # GKE schedules one pod per worker host of the slice.
+        resources["limits"]["google.com/tpu"] = str(topo.chips_per_host)
+        resources["requests"]["google.com/tpu"] = str(topo.chips_per_host)
+        node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": ACCELERATOR_LABELS[
+                topo.generation
+            ],
+            "cloud.google.com/gke-tpu-topology": topo.topology_string,
+        }
+    if not resources["limits"]:
+        del resources["limits"]
+    script = "\n".join(runner_bootstrap_commands(authorized_key, agent_download_url))
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "labels": {
+                LABEL_MANAGED: "true",
+                LABEL_INSTANCE: instance_id,
+                LABEL_WORKER: str(worker_index),
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeSelector": node_selector,
+            "containers": [
+                {
+                    "name": "runner",
+                    "image": image,
+                    "command": ["/bin/sh", "-c", script],
+                    "resources": resources,
+                    "ports": [{"containerPort": 22}],
+                }
+            ],
+        },
+    }
+
+
+def jump_pod_body(name: str, authorized_keys: List[str], image: str) -> dict:
+    """SSH ingress pod: the server (and users) reach runner pods through it
+    (parity: reference jump pod, compute.py:397-449)."""
+    keys = "\n".join(authorized_keys)
+    script = "\n".join(
+        [
+            "apk add --no-cache openssh >/dev/null 2>&1 || "
+            "(apt-get update >/dev/null && apt-get install -y openssh-server >/dev/null)",
+            "mkdir -p /run/sshd /root/.ssh && chmod 700 /root/.ssh",
+            f'printf "%s\\n" "{keys}" >> /root/.ssh/authorized_keys',
+            "chmod 600 /root/.ssh/authorized_keys",
+            "ssh-keygen -A",
+            'exec $(command -v sshd || echo /usr/sbin/sshd) -D -e'
+            ' -o "AllowTcpForwarding yes" -o "PermitRootLogin prohibit-password"',
+        ]
+    )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "labels": {LABEL_MANAGED: "true", "app.dstack-tpu/role": "jump"},
+        },
+        "spec": {
+            "restartPolicy": "Always",
+            "containers": [
+                {
+                    "name": "sshd",
+                    "image": image,
+                    "command": ["/bin/sh", "-c", script],
+                    "ports": [{"containerPort": 22}],
+                }
+            ],
+        },
+    }
+
+
+def jump_service_body(name: str, pod_name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "labels": {LABEL_MANAGED: "true"}},
+        "spec": {
+            "type": "NodePort",
+            "selector": {"app.dstack-tpu/role": "jump"},
+            "ports": [{"port": 22, "targetPort": 22, "protocol": "TCP"}],
+        },
+    }
+
+
+def gateway_pod_body(name: str, authorized_key: str, image: str) -> dict:
+    script = "\n".join(
+        [
+            "mkdir -p /root/.ssh && chmod 700 /root/.ssh",
+            f'echo "{authorized_key}" >> /root/.ssh/authorized_keys',
+            "chmod 600 /root/.ssh/authorized_keys",
+            "if command -v sshd >/dev/null; then mkdir -p /run/sshd;"
+            " ssh-keygen -A >/dev/null 2>&1 || true; /usr/sbin/sshd || sshd; fi",
+            "exec sleep infinity",
+        ]
+    )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "labels": {LABEL_MANAGED: "true", "app.dstack-tpu/role": "gateway",
+                       LABEL_INSTANCE: name},
+        },
+        "spec": {
+            "restartPolicy": "Always",
+            "containers": [
+                {
+                    "name": "gateway",
+                    "image": image,
+                    "command": ["/bin/sh", "-c", script],
+                    "ports": [
+                        {"containerPort": 22},
+                        {"containerPort": 80},
+                        {"containerPort": 443},
+                    ],
+                }
+            ],
+        },
+    }
+
+
+def gateway_service_body(name: str, pod_name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "labels": {LABEL_MANAGED: "true"}},
+        "spec": {
+            "type": "LoadBalancer",
+            "selector": {"app.dstack-tpu/instance": pod_name},
+            "ports": [
+                {"name": "ssh", "port": 22, "targetPort": 22},
+                {"name": "http", "port": 80, "targetPort": 80},
+                {"name": "https", "port": 443, "targetPort": 443},
+            ],
+        },
+    }

@@ -12,6 +12,7 @@ from typing import List
 
 class BackendType(str, Enum):
     GCP = "gcp"
+    KUBERNETES = "kubernetes"  # GKE TPU node pools (pods, not VMs)
     SSH = "ssh"  # SSH fleets (on-prem TPU VMs); reference calls this "remote"
     LOCAL = "local"
     DSTACK = "dstack"  # placeholder for marketplace-style pooled capacity
@@ -28,6 +29,7 @@ class BackendType(str, Enum):
 # Backends able to run multi-node (gang-scheduled) tasks.
 BACKENDS_WITH_MULTINODE_SUPPORT: List[BackendType] = [
     BackendType.GCP,
+    BackendType.KUBERNETES,
     BackendType.SSH,
     BackendType.LOCAL,
 ]
@@ -41,6 +43,7 @@ BACKENDS_WITH_CREATE_INSTANCE_SUPPORT: List[BackendType] = [
 # Backends able to provision gateway VMs.
 BACKENDS_WITH_GATEWAY_SUPPORT: List[BackendType] = [
     BackendType.GCP,
+    BackendType.KUBERNETES,
     BackendType.LOCAL,
 ]
 

@@ -285,7 +285,7 @@ async def _submit_to_runner(
             return
         try:
             code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
-        except ServerError as e:
+        except (ServerError, BackendError) as e:
             await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
             return
         jpd = _jpd(row)

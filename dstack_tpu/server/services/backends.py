@@ -28,6 +28,13 @@ def _make_compute(backend_type: BackendType, config: Dict[str, Any]) -> Compute:
         from dstack_tpu.backends.gcp.compute import GCPBackendConfig, GCPCompute
 
         return GCPCompute(GCPBackendConfig.model_validate(config))
+    if backend_type == BackendType.KUBERNETES:
+        from dstack_tpu.backends.kubernetes.compute import (
+            KubernetesBackendConfig,
+            KubernetesCompute,
+        )
+
+        return KubernetesCompute(KubernetesBackendConfig.model_validate(config))
     if backend_type == BackendType.SSH:
         raise BadRequestError("ssh backend instances are created via SSH fleets")
     raise BadRequestError(f"Unsupported backend type: {backend_type}")

@@ -275,14 +275,25 @@ class _GoogleCloudLoggingClient:  # pragma: no cover - requires network + creds
             f' AND labels.job_submission_id="{job_submission_id}"'
             f' AND labels.source="{source}"' + ts_filter
         )
-        out = []
-        for entry in self._client.list_entries(filter_=filter_, page_size=limit):
+        fetched = []
+        # Over-fetch, then order by (ts_ms, seq) ourselves: the API orders by
+        # its own ms-precision timestamp + insertId, which does not agree
+        # with the payload seq for same-millisecond entries — applying the
+        # cursor to unsorted results would drop or duplicate lines.
+        for entry in self._client.list_entries(
+            filter_=filter_, page_size=min(1000, limit * 2)
+        ):
             payload = entry.payload or {}
-            item = {
-                "ts_ms": payload.get("ts_ms", 0),
-                "seq": payload.get("seq", 0),
-                "b64": payload.get("b64", ""),
-            }
+            fetched.append(
+                {
+                    "ts_ms": payload.get("ts_ms", 0),
+                    "seq": payload.get("seq", 0),
+                    "b64": payload.get("b64", ""),
+                }
+            )
+        fetched.sort(key=lambda e: (e["ts_ms"], e["seq"]))
+        out = []
+        for item in fetched:
             # The timestamp filter is >= (not >): drop entries at or before
             # the cursor position.
             if after is not None and (item["ts_ms"], item["seq"]) <= after:

@@ -55,24 +55,36 @@ class _HttpGcsTransport:  # pragma: no cover - requires network + creds
     def _request(
         self, url: str, data: Optional[bytes] = None, none_on_404: bool = False
     ) -> Optional[bytes]:
-        req = urllib.request.Request(
-            url,
-            data=data,
-            method="POST" if data is not None else "GET",
-            headers={
-                "Authorization": f"Bearer {self._api._get_token()}",
-                "Content-Type": "application/octet-stream",
-            },
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            # 404 means "object absent" only on download; an upload 404
-            # (bad bucket) must fail loudly, or blobs are silently lost.
-            if e.code == 404 and none_on_404:
-                return None
-            raise
+        from dstack_tpu.errors import BackendError
+
+        for attempt in (0, 1):
+            req = urllib.request.Request(
+                url,
+                data=data,
+                method="POST" if data is not None else "GET",
+                headers={
+                    "Authorization": f"Bearer {self._api._get_token()}",
+                    "Content-Type": "application/octet-stream",
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as e:
+                # 404 means "object absent" only on download; an upload 404
+                # (bad bucket) must fail loudly, or blobs are silently lost.
+                if e.code == 404 and none_on_404:
+                    return None
+                # Same 401 self-healing as HttpGcpApi.request: a token
+                # revoked before its TTL must re-auth now, not in 45 min.
+                if e.code == 401 and attempt == 0:
+                    self._api._invalidate_token()
+                    continue
+                raise BackendError(
+                    f"GCS request failed with {e.code}: "
+                    f"{e.read().decode(errors='replace')[:300]}"
+                )
+        raise AssertionError("unreachable")
 
     def upload(self, bucket: str, key: str, data: bytes) -> None:
         name = urllib.parse.quote(key, safe="")

@@ -1,0 +1,248 @@
+"""Kubernetes (GKE TPU) backend tests over a faked cluster API.
+
+Parity model: reference core/backends/kubernetes/compute.py; the reference
+leaves its backend untested (SURVEY §4) — here the full offer/provision/
+terminate cycle runs against an in-memory API-server fake, including
+multi-host TPU slice gangs the reference cannot express.
+"""
+
+import json
+
+import pytest
+
+from dstack_tpu.backends.kubernetes.api import KubernetesApiError
+from dstack_tpu.backends.kubernetes.compute import (
+    KubernetesBackendConfig,
+    KubernetesCompute,
+)
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.instances import InstanceAvailability
+from dstack_tpu.models.resources import ResourcesSpec
+from dstack_tpu.models.runs import Requirements
+
+
+class FakeKubernetesApi:
+    """In-memory core/v1 surface: nodes, pods, services."""
+
+    def __init__(self, nodes=None):
+        self.nodes = nodes or []
+        self.pods = {}  # name -> body
+        self.services = {}
+        self.requests = []
+        self.next_node_port = 30022
+
+    async def request(self, method, path, body=None):
+        self.requests.append((method, path, body))
+        if method == "GET" and path == "/api/v1/nodes":
+            return {"items": self.nodes}
+        ns_prefix = "/api/v1/namespaces/"
+        assert path.startswith(ns_prefix), path
+        rest = path[len(ns_prefix):]
+        _, kind_and_name = rest.split("/", 1)
+        if "?" in kind_and_name:
+            kind_and_name, _, query = kind_and_name.partition("?")
+        else:
+            query = ""
+        parts = kind_and_name.split("/")
+        kind, name = parts[0], (parts[1] if len(parts) > 1 else None)
+        store = {"pods": self.pods, "services": self.services}[kind]
+        if method == "POST":
+            pod_name = body["metadata"]["name"]
+            if pod_name in store:
+                raise KubernetesApiError(409, "AlreadyExists")
+            body = json.loads(json.dumps(body))  # deep copy
+            if kind == "services" and body["spec"].get("type") == "NodePort":
+                body["spec"]["ports"][0]["nodePort"] = self.next_node_port
+            if kind == "services" and body["spec"].get("type") == "LoadBalancer":
+                body.setdefault("status", {})["loadBalancer"] = {
+                    "ingress": [{"ip": "203.0.113.99"}]
+                }
+            if kind == "pods":
+                body["status"] = {"phase": "Pending"}
+            store[pod_name] = body
+            return body
+        if method == "GET":
+            if name not in store:
+                raise KubernetesApiError(404, "NotFound")
+            return store[name]
+        if method == "DELETE":
+            if name is not None:
+                if name not in store:
+                    raise KubernetesApiError(404, "NotFound")
+                del store[name]
+                return {}
+            # collection delete by labelSelector
+            assert query.startswith("labelSelector=")
+            sel = query[len("labelSelector="):].replace("%3D", "=")
+            key, _, value = sel.partition("=")
+            doomed = [
+                n for n, p in store.items()
+                if p["metadata"].get("labels", {}).get(key) == value
+            ]
+            for n in doomed:
+                del store[n]
+            return {}
+        raise AssertionError(f"unhandled {method} {path}")
+
+    def set_pod_running(self, name, ip):
+        self.pods[name]["status"] = {"phase": "Running", "podIP": ip}
+
+
+def _node(name, cpu="16", memory="65536Mi", labels=None, addresses=None):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory},
+            "addresses": addresses
+            or [{"type": "InternalIP", "address": "10.0.0.1"}],
+        },
+    }
+
+
+def _tpu_node(name, accel, topology):
+    labels = {
+        "cloud.google.com/gke-tpu-accelerator": accel,
+        "cloud.google.com/gke-tpu-topology": topology,
+        "topology.kubernetes.io/region": "us-central2",
+    }
+    return _node(name, cpu="208", memory="393216Mi", labels=labels)
+
+
+def _compute(api):
+    return KubernetesCompute(
+        KubernetesBackendConfig(kubeconfig="unused: true"), api=api
+    )
+
+
+def _req(tpu=None, cpu="1..", memory="0.5.."):
+    spec = {"cpu": cpu, "memory": memory}
+    if tpu:
+        spec["tpu"] = tpu
+    return Requirements(resources=ResourcesSpec.model_validate(spec))
+
+
+async def test_offers_from_cpu_and_tpu_nodes():
+    api = FakeKubernetesApi(
+        nodes=[
+            _node("cpu-node-1"),
+            _tpu_node("tpu-a", "tpu-v5-lite-podslice", "2x4"),
+        ]
+    )
+    # CPU-only requirements must not burn the TPU slice.
+    cpu_offers = await _compute(api).get_offers(_req())
+    assert {o.instance.name for o in cpu_offers} == {"cpu-node-1"}
+
+    tpu_offers = await _compute(api).get_offers(_req(tpu="v5litepod-8"))
+    assert len(tpu_offers) == 1
+    topo = tpu_offers[0].instance.resources.tpu
+    assert topo.accelerator_type == "v5litepod-8"
+    assert topo.chips == 8 and topo.hosts == 1
+    assert tpu_offers[0].region == "us-central2"
+
+
+async def test_multihost_slice_availability_requires_all_workers():
+    # v5p 4x4x4 = 64 chips = 16 worker hosts; only 2 nodes present -> offer
+    # exists but is NOT_AVAILABLE until the node pool is complete.
+    nodes = [_tpu_node(f"tpu-{i}", "tpu-v5p-slice", "4x4x4") for i in range(2)]
+    api = FakeKubernetesApi(nodes=nodes)
+    offers = await _compute(api).get_offers(_req(tpu="v5p-128"))
+    assert len(offers) == 1
+    offer = offers[0]
+    assert offer.hosts == 16
+    assert offer.availability == InstanceAvailability.NOT_AVAILABLE
+
+    nodes += [_tpu_node(f"tpu-{i}", "tpu-v5p-slice", "4x4x4") for i in range(2, 16)]
+    offers = await _compute(api).get_offers(_req(tpu="v5p-128"))
+    assert offers[0].availability == InstanceAvailability.AVAILABLE
+
+
+async def test_run_job_creates_gang_pods_with_tpu_selectors():
+    nodes = [_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4") for i in range(4)]
+    api = FakeKubernetesApi(nodes=nodes)
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-16"))
+    assert offers and offers[0].hosts == 4
+    jpds = await compute.run_job(
+        "proj", "run1", offers[0], "ssh-rsa KEY", "inst-1"
+    )
+    assert len(jpds) == 4
+    assert {j.tpu_worker_index for j in jpds} == {0, 1, 2, 3}
+    assert all(j.backend == BackendType.KUBERNETES for j in jpds)
+    assert all(not j.dockerized for j in jpds)
+    # All workers reached through the jump pod's NodePort.
+    assert all(j.ssh_proxy is not None for j in jpds)
+    assert jpds[0].ssh_proxy.port == 30022
+    assert jpds[0].ssh_proxy.hostname == "10.0.0.1"
+
+    # Four worker pods + the jump pod; selectors pin the TPU node pool.
+    worker_pods = [p for n, p in api.pods.items() if n.startswith("inst-1")]
+    assert len(worker_pods) == 4
+    spec = worker_pods[0]["spec"]
+    assert spec["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == (
+        "tpu-v5-lite-podslice"
+    )
+    assert spec["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+    limits = spec["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "4"  # chips per worker host
+
+
+async def test_update_provisioning_data_fills_pod_ip():
+    api = FakeKubernetesApi(nodes=[_node("n1")])
+    compute = _compute(api)
+    offers = await compute.get_offers(_req())
+    jpds = await compute.run_job("proj", "run1", offers[0], "ssh-rsa KEY", "inst-2")
+    jpd = jpds[0]
+    jpd = await compute.update_provisioning_data(jpd)
+    assert jpd.hostname is None  # still Pending
+    pod_name = json.loads(jpd.backend_data)["pod"]
+    api.set_pod_running(pod_name, "10.8.0.5")
+    jpd = await compute.update_provisioning_data(jpd)
+    assert jpd.hostname == "10.8.0.5"
+    assert jpd.internal_ip == "10.8.0.5"
+
+
+async def test_failed_pod_raises():
+    from dstack_tpu.errors import ComputeError
+
+    api = FakeKubernetesApi(nodes=[_node("n1")])
+    compute = _compute(api)
+    offers = await compute.get_offers(_req())
+    jpds = await compute.run_job("proj", "run1", offers[0], "ssh-rsa KEY", "inst-3")
+    pod_name = json.loads(jpds[0].backend_data)["pod"]
+    api.pods[pod_name]["status"] = {"phase": "Failed"}
+    with pytest.raises(ComputeError):
+        await compute.update_provisioning_data(jpds[0])
+
+
+async def test_terminate_deletes_all_gang_pods():
+    nodes = [_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4") for i in range(4)]
+    api = FakeKubernetesApi(nodes=nodes)
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-16"))
+    await compute.run_job("proj", "run1", offers[0], "ssh-rsa KEY", "inst-4")
+    assert sum(1 for n in api.pods if n.startswith("inst-4")) == 4
+    await compute.terminate_instance("inst-4", "us-central2")
+    assert not any(n.startswith("inst-4") for n in api.pods)
+    # Idempotent on a second call.
+    await compute.terminate_instance("inst-4", "us-central2")
+
+
+async def test_gateway_pod_and_loadbalancer():
+    from dstack_tpu.models.gateways import GatewayComputeConfiguration
+
+    api = FakeKubernetesApi(nodes=[_node("n1")])
+    compute = _compute(api)
+    gpd = await compute.create_gateway(
+        GatewayComputeConfiguration(
+            project_name="proj",
+            instance_name="gw1",
+            backend=BackendType.KUBERNETES,
+            region="cluster",
+            ssh_key_pub="ssh-rsa KEY",
+        )
+    )
+    assert gpd.ip_address == "203.0.113.99"
+    assert gpd.instance_id in api.pods and gpd.instance_id in api.services
+    await compute.terminate_gateway(gpd.instance_id, "cluster")
+    assert gpd.instance_id not in api.pods
+    assert gpd.instance_id not in api.services
