@@ -64,13 +64,19 @@ class HttpKubernetesApi:  # pragma: no cover - requires a live cluster
             user.get("client-key-data"),
         )
         if cert_data and key_data:
-            # load_cert_chain only takes paths; stage the pair on disk.
-            self._certfile = tempfile.NamedTemporaryFile(suffix=".pem", delete=False)
-            self._certfile.write(base64.b64decode(cert_data))
-            self._certfile.write(b"\n")
-            self._certfile.write(base64.b64decode(key_data))
-            self._certfile.flush()
-            self._ssl.load_cert_chain(self._certfile.name)
+            # load_cert_chain only takes paths; stage the pair on disk just
+            # long enough to load it — key material must not persist.
+            import os
+
+            with tempfile.NamedTemporaryFile(suffix=".pem", delete=False) as f:
+                try:
+                    f.write(base64.b64decode(cert_data))
+                    f.write(b"\n")
+                    f.write(base64.b64decode(key_data))
+                    f.flush()
+                    self._ssl.load_cert_chain(f.name)
+                finally:
+                    os.unlink(f.name)
 
     async def request(
         self, method: str, path: str, body: Optional[Dict[str, Any]] = None

@@ -44,8 +44,10 @@ from dstack_tpu.models.runs import JobProvisioningData, Requirements
 from dstack_tpu.models.topology import TpuTopology
 
 DEFAULT_RUNNER_IMAGE = "python:3.12-slim"
-JUMP_POD_NAME = "dstack-tpu-jump"
-JUMP_SERVICE_NAME = "dstack-tpu-jump"
+# Jump pod/service names carry the SSH key fingerprint: a rotated or
+# per-project key gets its own ingress pod instead of silently reusing one
+# whose authorized_keys doesn't contain it.
+JUMP_POD_PREFIX = "dstack-tpu-jump"
 
 
 class KubernetesBackendConfig(CoreModel):
@@ -84,14 +86,25 @@ class KubernetesCompute(Compute):
             labels = node["metadata"].get("labels", {})
             topo = res.topology_from_node_labels(labels)
             if topo is not None:
+                # Group by node POOL, not just shape: two half-provisioned
+                # same-shape pools must not merge into one "available" slice.
                 key = (
                     labels["cloud.google.com/gke-tpu-accelerator"],
                     labels["cloud.google.com/gke-tpu-topology"],
+                    labels.get("cloud.google.com/gke-nodepool", ""),
                 )
                 slice_nodes.setdefault(key, []).append(node)
-            else:
+            elif _node_ready(node):
                 offers.append(self._cpu_offer(node))
-        for (accel, topo_str), members in slice_nodes.items():
+        best_pools: Dict[Tuple[str, str], List[dict]] = {}
+        for (accel, topo_str, _pool), members in slice_nodes.items():
+            ready = [n for n in members if _node_ready(n)]
+            shape = (accel, topo_str)
+            if len(ready) > len(best_pools.get(shape, [])):
+                best_pools[shape] = ready
+            elif shape not in best_pools:
+                best_pools[shape] = ready
+        for (accel, topo_str), members in best_pools.items():
             topo = res.topology_from_node_labels(
                 {
                     "cloud.google.com/gke-tpu-accelerator": accel,
@@ -129,10 +142,11 @@ class KubernetesCompute(Compute):
     def _tpu_offer(
         self, topo: TpuTopology, members: List[dict]
     ) -> InstanceOfferWithAvailability:
-        alloc = members[0].get("status", {}).get("allocatable", {})
+        alloc = (members[0] if members else {}).get("status", {}).get("allocatable", {})
         cpus = _parse_cpu(alloc.get("cpu", "0")) or 24
         memory_mib = _parse_memory_mib(alloc.get("memory", "0")) or 48 * 1024
-        # A slice is schedulable when every worker host has a ready node.
+        # A slice is schedulable when one node pool has a Ready node for
+        # every worker host (members is the best pool's Ready nodes).
         available = len(members) >= topo.hosts
         return InstanceOfferWithAvailability(
             backend=BackendType.KUBERNETES,
@@ -143,7 +157,7 @@ class KubernetesCompute(Compute):
                     description=f"{topo.display_name} {topo.topology_string} (GKE)",
                 ),
             ),
-            region=self._node_region(members[0]),
+            region=self._node_region(members[0]) if members else "cluster",
             price=self.config.price_per_hour,
             availability=(
                 InstanceAvailability.AVAILABLE
@@ -235,28 +249,34 @@ class KubernetesCompute(Compute):
     # --- SSH ingress -------------------------------------------------------
 
     async def _ensure_jump_pod(self, authorized_key: str) -> SSHConnectionParams:
-        """Create (or reuse) the jump pod + NodePort service; return the SSH
-        proxy params every runner pod is reached through."""
+        """Create (or reuse) the jump pod + NodePort service for this SSH
+        key; return the SSH proxy params runner pods are reached through.
+        The name is keyed by the key's fingerprint, so a 409 reuse is
+        guaranteed to be a pod that already authorizes this exact key."""
+        import hashlib
+
+        fp = hashlib.sha256(authorized_key.encode()).hexdigest()[:10]
+        name = f"{JUMP_POD_PREFIX}-{fp}"
         try:
             await self.api.request(
                 "POST",
                 self._ns("pods"),
-                res.jump_pod_body(JUMP_POD_NAME, [authorized_key], self.config.jump_image),
+                res.jump_pod_body(name, [authorized_key], self.config.jump_image, role=name),
             )
         except KubernetesApiError as e:
-            if e.status != 409:  # already exists
+            if e.status != 409:  # already exists (same key -> same pod)
                 raise
         try:
             await self.api.request(
                 "POST",
                 self._ns("services"),
-                res.jump_service_body(JUMP_SERVICE_NAME, JUMP_POD_NAME),
+                res.jump_service_body(name, name),
             )
         except KubernetesApiError as e:
             if e.status != 409:
                 raise
         svc = await self.api.request(
-            "GET", self._ns("services") + f"/{JUMP_SERVICE_NAME}"
+            "GET", self._ns("services") + f"/{name}"
         )
         node_port = svc["spec"]["ports"][0].get("nodePort")
         host = self.config.ssh_host or await self._any_node_address()
@@ -292,10 +312,25 @@ class KubernetesCompute(Compute):
         await self.api.request(
             "POST", self._ns("services"), res.gateway_service_body(name, name)
         )
-        svc = await self.api.request("GET", self._ns("services") + f"/{name}")
-        ingress = (
-            svc.get("status", {}).get("loadBalancer", {}).get("ingress") or [{}]
-        )[0]
+        # LoadBalancer addresses are assigned asynchronously (~30-120s on
+        # GKE); nothing updates the gateway record later, so wait here
+        # (parity: reference _wait_for_load_balancer_hostname, :495-515).
+        import asyncio
+
+        ingress: Dict[str, Any] = {}
+        deadline = 120.0
+        while True:
+            svc = await self.api.request("GET", self._ns("services") + f"/{name}")
+            entries = svc.get("status", {}).get("loadBalancer", {}).get("ingress")
+            if entries:
+                ingress = entries[0]
+                break
+            if deadline <= 0:
+                raise ComputeError(
+                    f"gateway service {name} got no LoadBalancer address in 120s"
+                )
+            deadline -= 2.0
+            await asyncio.sleep(2.0)
         return GatewayProvisioningData(
             instance_id=name,
             ip_address=ingress.get("ip"),
@@ -315,6 +350,14 @@ class KubernetesCompute(Compute):
             except KubernetesApiError as e:
                 if e.status != 404:
                     raise
+
+
+def _node_ready(node: dict) -> bool:
+    for cond in node.get("status", {}).get("conditions", []):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    # No conditions reported (stripped fake / fresh node): assume ready.
+    return not node.get("status", {}).get("conditions")
 
 
 def _pod_name(instance_name: str, worker: int) -> str:

@@ -43,11 +43,10 @@ def topology_from_node_labels(labels: Dict[str, str]) -> Optional[TpuTopology]:
     for d in grid:
         chips *= d
     info = GENERATIONS[gen]
-    hosts = (
-        1
-        if chips <= info.max_chips_single_host
-        else chips // info.chips_per_host_multihost
-    )
+    try:
+        hosts = TpuTopology._hosts_for(info, chips)
+    except ValueError:
+        return None  # label names a shape the generation table rejects
     return TpuTopology(generation=gen, chips=chips, grid=grid, hosts=hosts)
 
 
@@ -131,9 +130,12 @@ def runner_pod_body(
     }
 
 
-def jump_pod_body(name: str, authorized_keys: List[str], image: str) -> dict:
+def jump_pod_body(
+    name: str, authorized_keys: List[str], image: str, role: str = "jump"
+) -> dict:
     """SSH ingress pod: the server (and users) reach runner pods through it
-    (parity: reference jump pod, compute.py:397-449)."""
+    (parity: reference jump pod, compute.py:397-449). `role` doubles as the
+    service selector value so per-key jump services target their own pod."""
     keys = "\n".join(authorized_keys)
     script = "\n".join(
         [
@@ -152,7 +154,7 @@ def jump_pod_body(name: str, authorized_keys: List[str], image: str) -> dict:
         "kind": "Pod",
         "metadata": {
             "name": name,
-            "labels": {LABEL_MANAGED: "true", "app.dstack-tpu/role": "jump"},
+            "labels": {LABEL_MANAGED: "true", "app.dstack-tpu/role": role},
         },
         "spec": {
             "restartPolicy": "Always",
@@ -168,14 +170,14 @@ def jump_pod_body(name: str, authorized_keys: List[str], image: str) -> dict:
     }
 
 
-def jump_service_body(name: str, pod_name: str) -> dict:
+def jump_service_body(name: str, role: str) -> dict:
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {"name": name, "labels": {LABEL_MANAGED: "true"}},
         "spec": {
             "type": "NodePort",
-            "selector": {"app.dstack-tpu/role": "jump"},
+            "selector": {"app.dstack-tpu/role": role},
             "ports": [{"port": 22, "targetPort": 22, "protocol": "TCP"}],
         },
     }

@@ -50,6 +50,7 @@ def create_app(
         runs as runs_router,
         secrets as secrets_router,
         server_info as server_info_router,
+        ui as ui_router,
         users as users_router,
         volumes as volumes_router,
         gateways as gateways_router,
@@ -62,7 +63,7 @@ def create_app(
         instances_router, volumes_router, gateways_router, backends_router,
         repos_router, secrets_router, logs_router, metrics_router,
         server_info_router, services_proxy_router, model_proxy_router,
-        debug_router,
+        debug_router, ui_router,
     ):
         app.include_router(mod.router)
 

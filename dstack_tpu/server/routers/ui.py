@@ -1,0 +1,50 @@
+"""Web console: serves the static single-page app from dstack_tpu/ui/.
+
+Parity: reference frontend/ (React 18, 15.6k LoC TS, built by node and
+served by the FastAPI app from a wheel-bundled dist). Redesign: a
+dependency-free vanilla-JS SPA shipped inside the Python package — no node
+toolchain, no build step, same dashboards (runs/fleets/instances/volumes/
+gateways/backends + live logs) against the same JSON API.
+"""
+
+from pathlib import Path
+
+from dstack_tpu.server.http import Request, Response, Router
+
+router = Router()
+
+UI_DIR = Path(__file__).resolve().parent.parent.parent / "ui"
+
+# Whitelist instead of path arithmetic: no traversal surface.
+_ASSETS = {
+    "index.html": "text/html; charset=utf-8",
+    "app.js": "application/javascript; charset=utf-8",
+    "style.css": "text/css; charset=utf-8",
+}
+
+
+def _serve(name: str) -> Response:
+    media_type = _ASSETS.get(name)
+    if media_type is None:
+        return Response({"detail": "Not found"}, status=404)
+    path = UI_DIR / name
+    if not path.exists():
+        return Response({"detail": "Not found"}, status=404)
+    return Response(path.read_bytes(), media_type=media_type)
+
+
+@router.get("/")
+async def index(request: Request) -> Response:
+    return Response(
+        None, status=307, headers={"location": "/ui/"}
+    )
+
+
+@router.get("/ui/")
+async def ui_index(request: Request) -> Response:
+    return _serve("index.html")
+
+
+@router.get("/ui/{asset}")
+async def ui_asset(request: Request, asset: str) -> Response:
+    return _serve(asset)

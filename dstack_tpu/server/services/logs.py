@@ -276,12 +276,15 @@ class _GoogleCloudLoggingClient:  # pragma: no cover - requires network + creds
             f' AND labels.source="{source}"' + ts_filter
         )
         fetched = []
-        # Over-fetch, then order by (ts_ms, seq) ourselves: the API orders by
-        # its own ms-precision timestamp + insertId, which does not agree
-        # with the payload seq for same-millisecond entries — applying the
-        # cursor to unsorted results would drop or duplicate lines.
+        # Bounded over-fetch in ascending timestamp order, then re-order by
+        # (ts_ms, seq) ourselves: the API's tie-break (insertId) does not
+        # agree with the payload seq for same-millisecond entries — applying
+        # the cursor to unsorted results would drop or duplicate lines. The
+        # iterator pages through ALL matches if left unbounded, so cap the
+        # window; later entries arrive on the next poll via the cursor.
+        window = limit * 2 + 100
         for entry in self._client.list_entries(
-            filter_=filter_, page_size=min(1000, limit * 2)
+            filter_=filter_, order_by="timestamp asc", page_size=min(1000, window)
         ):
             payload = entry.payload or {}
             fetched.append(
@@ -291,6 +294,8 @@ class _GoogleCloudLoggingClient:  # pragma: no cover - requires network + creds
                     "b64": payload.get("b64", ""),
                 }
             )
+            if len(fetched) >= window:
+                break
         fetched.sort(key=lambda e: (e["ts_ms"], e["seq"]))
         out = []
         for item in fetched:

@@ -1,0 +1,277 @@
+/* dstack-tpu console. Vanilla JS against the server's JSON API (the same
+ * endpoints the CLI/SDK use). State: token in localStorage, current project
+ * + view in the URL hash (#project/view[/run]). */
+"use strict";
+
+const $ = (sel) => document.querySelector(sel);
+const state = { token: localStorage.getItem("dstack_tpu_token") || "", project: "", view: "runs", runName: null, logTimer: null };
+
+async function api(path, body) {
+  const resp = await fetch(path, {
+    method: body === undefined ? "GET" : "POST",
+    headers: { "Authorization": "Bearer " + state.token, "Content-Type": "application/json" },
+    body: body === undefined ? undefined : JSON.stringify(body || {}),
+  });
+  if (resp.status === 401 || resp.status === 403) throw new AuthError();
+  if (!resp.ok) throw new Error((await resp.text()) || resp.statusText);
+  const text = await resp.text();
+  return text ? JSON.parse(text) : null;
+}
+class AuthError extends Error {}
+
+function esc(s) {
+  return String(s ?? "").replace(/[&<>"']/g, (c) => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;" }[c]));
+}
+function fmtDate(iso) {
+  if (!iso) return "—";
+  const d = new Date(iso);
+  return isNaN(d) ? iso : d.toLocaleString();
+}
+function pill(status) {
+  const s = String(status || "unknown");
+  const cls = ["done", "active", "idle", "running"].includes(s) ? "ok"
+    : ["failed", "terminated", "error", "unreachable"].includes(s) ? "bad"
+    : ["pending", "submitted", "provisioning", "pulling", "terminating", "creating"].includes(s) ? "warn" : "run";
+  return `<span class="pill ${cls}">${esc(s)}</span>`;
+}
+function table(headers, rows, rowAttrs) {
+  const head = headers.map((h) => `<th>${esc(h)}</th>`).join("");
+  const body = rows.length
+    ? rows.map((r, i) => `<tr ${rowAttrs ? rowAttrs(i) : ""}>${r.map((c) => `<td>${c}</td>`).join("")}</tr>`).join("")
+    : `<tr><td colspan="${headers.length}" class="muted">Nothing here yet.</td></tr>`;
+  return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
+}
+function stopLogFollow() { if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; } }
+
+/* ---- views ---------------------------------------------------------- */
+
+const views = {
+  async runs() {
+    const runs = await api(`/api/project/${state.project}/runs/list`, {});
+    return { title: "Runs", html: table(
+      ["Name", "Status", "Type", "Resources", "Backend", "Submitted"],
+      (runs || []).map((r) => {
+        const conf = (r.run_spec && r.run_spec.configuration) || {};
+        const res = conf.resources || {};
+        const tpu = res.tpu ? (typeof res.tpu === "string" ? res.tpu : JSON.stringify(res.tpu)) : "cpu";
+        const jpd = latestJpd(r);
+        return [esc(runName(r)), pill(r.status), esc(conf.type || "task"), esc(tpu), esc(jpd ? jpd.backend : "—"), esc(fmtDate(r.submitted_at))];
+      }),
+      (i) => `class="clickable" data-run="${esc(runName(runs[i] || {}))}"`
+    ) };
+  },
+
+  async run_detail() {
+    const run = await api(`/api/project/${state.project}/runs/get`, { run_name: state.runName });
+    const conf = (run.run_spec && run.run_spec.configuration) || {};
+    const jobs = run.jobs || [];
+    const jobRows = [];
+    jobs.forEach((j) => (j.job_submissions || []).slice(-1).forEach((s) => {
+      const jpd = s.job_provisioning_data || {};
+      jobRows.push([
+        esc(j.job_spec ? j.job_spec.job_name : ""), pill(s.status),
+        esc(jpd.instance_type ? jpd.instance_type.name : "—"),
+        esc(jpd.hostname || "—"), esc(`${jpd.tpu_worker_index ?? 0}`),
+        esc(s.termination_reason_message || s.termination_reason || "—"),
+        `<span class="muted">${esc(s.id)}</span>`,
+      ]);
+    }));
+    const html = `
+      <div class="toolbar">
+        <button class="action" id="back-btn">← Runs</button>
+        <div class="spacer"></div>
+        <button class="action danger" id="stop-btn">Stop</button>
+        <button class="action danger" id="delete-btn">Delete</button>
+      </div>
+      <div class="kv">
+        <div>Status</div><div>${pill(run.status)}</div>
+        <div>Type</div><div>${esc(conf.type || "task")}</div>
+        <div>Submitted</div><div>${esc(fmtDate(run.submitted_at))}</div>
+        <div>User</div><div>${esc(run.user || "—")}</div>
+        <div>Resources</div><div><code>${esc(JSON.stringify(conf.resources || {}))}</code></div>
+        <div>Commands</div><div><code>${esc((conf.commands || []).join(" && ") || "—")}</code></div>
+      </div>
+      <div class="section">Jobs</div>
+      ${table(["Job", "Status", "Instance", "Host", "Worker", "Reason", "Submission"], jobRows)}
+      <div class="section">Logs <span class="muted" id="log-state">(following)</span></div>
+      <pre class="logs" id="log-box"></pre>`;
+    return { title: `Run <span class="crumb">/</span> ${esc(state.runName)}`, html, after() {
+      $("#back-btn").onclick = () => navigate(state.project, "runs");
+      $("#stop-btn").onclick = async () => { await api(`/api/project/${state.project}/runs/stop`, { runs_names: [state.runName], abort: false }); render(); };
+      $("#delete-btn").onclick = async () => { await api(`/api/project/${state.project}/runs/delete`, { runs_names: [state.runName] }); navigate(state.project, "runs"); };
+      followLogs(run);
+    } };
+  },
+
+  async fleets() {
+    const fleets = await api(`/api/project/${state.project}/fleets/list`, {});
+    return { title: "Fleets", html: table(
+      ["Name", "Status", "Placement", "Instances"],
+      (fleets || []).map((f) => [
+        esc(f.name), pill(f.status),
+        esc((f.spec && f.spec.configuration && f.spec.configuration.placement) || "any"),
+        esc(String((f.instances || []).length)),
+      ])
+    ) };
+  },
+
+  async instances() {
+    const instances = await api(`/api/project/${state.project}/instances/list`, {});
+    return { title: "Instances", html: table(
+      ["Name", "Status", "Backend", "Type", "Host", "Worker", "Price/hr"],
+      (instances || []).map((i) => [
+        esc(i.name), pill(i.status), esc(i.backend || "—"),
+        esc(i.instance_type ? i.instance_type.name : "—"),
+        esc(i.hostname || "—"), esc(String(i.tpu_worker_index ?? 0)),
+        esc(i.price != null ? `$${Number(i.price).toFixed(2)}` : "—"),
+      ])
+    ) };
+  },
+
+  async volumes() {
+    const volumes = await api(`/api/project/${state.project}/volumes/list`, {});
+    return { title: "Volumes", html: table(
+      ["Name", "Status", "Backend", "Region", "Size", "Attached"],
+      (volumes || []).map((v) => {
+        const conf = (v.configuration || {});
+        return [esc(v.name), pill(v.status), esc(conf.backend || "—"), esc(conf.region || "—"),
+          esc(conf.size != null ? `${conf.size}GB` : "—"),
+          esc((v.attachments || []).length ? "yes" : "no")];
+      })
+    ) };
+  },
+
+  async gateways() {
+    const gateways = await api(`/api/project/${state.project}/gateways/list`, {});
+    return { title: "Gateways", html: table(
+      ["Name", "Status", "Backend", "Region", "Address", "Wildcard domain"],
+      (gateways || []).map((g) => [
+        esc(g.name), pill(g.status), esc(g.backend || "—"), esc(g.region || "—"),
+        esc(g.ip_address || g.hostname || "—"), esc(g.wildcard_domain || "—"),
+      ])
+    ) };
+  },
+
+  async backends() {
+    const backends = await api(`/api/project/${state.project}/backends/list`, {});
+    return { title: "Backends", html: table(
+      ["Type"],
+      (backends || []).map((b) => [esc(typeof b === "string" ? b : b.type || JSON.stringify(b))])
+    ) };
+  },
+
+  async server() {
+    const info = await api("/api/server/get_info", {});
+    const kv = Object.entries(info || {}).map(([k, v]) =>
+      `<div>${esc(k)}</div><div><code>${esc(typeof v === "object" ? JSON.stringify(v) : v)}</code></div>`).join("");
+    return { title: "Server", html: `<div class="kv">${kv}</div>
+      <p class="muted">Live traces, errors and profiles: <code>/debug/traces</code>,
+      <code>/debug/errors</code>, <code>/debug/profile</code> (admin token required).</p>` };
+  },
+};
+
+function runName(r) { return r.run_name || ((r.run_spec || {}).run_name) || ""; }
+
+function latestJpd(run) {
+  for (const j of run.jobs || []) {
+    const subs = j.job_submissions || [];
+    if (subs.length && subs[subs.length - 1].job_provisioning_data) return subs[subs.length - 1].job_provisioning_data;
+  }
+  return null;
+}
+
+function followLogs(run) {
+  stopLogFollow();
+  const jobs = run.jobs || [];
+  if (!jobs.length || !(jobs[0].job_submissions || []).length) { $("#log-state").textContent = "(no submissions yet)"; return; }
+  const submissionId = jobs[0].job_submissions[jobs[0].job_submissions.length - 1].id;
+  let cursor = "";
+  const tick = async () => {
+    try {
+      const out = await api(`/api/project/${state.project}/logs/poll`,
+        { run_name: state.runName, job_submission_id: submissionId, start_after: cursor || null });
+      const box = $("#log-box");
+      if (!box) return; // view changed
+      // atob alone maps bytes to latin1 chars; decode as UTF-8 so non-ASCII
+      // job output doesn't render as mojibake.
+      const dec = new TextDecoder("utf-8");
+      for (const ev of out.logs || []) {
+        box.textContent += dec.decode(Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)));
+      }
+      if ((out.logs || []).length) box.scrollTop = box.scrollHeight;
+      cursor = out.next_token || cursor;
+      state.logTimer = setTimeout(tick, 1500);
+    } catch (e) {
+      if (e instanceof AuthError) return showLogin();
+      const stateEl = $("#log-state");
+      if (stateEl) stateEl.textContent = "(log polling stopped: " + e.message + ")";
+    }
+  };
+  tick();
+}
+
+/* ---- shell ---------------------------------------------------------- */
+
+function navigate(project, view, runName) {
+  location.hash = runName ? `${project}/${view}/${runName}` : `${project}/${view}`;
+}
+
+function parseHash() {
+  const parts = location.hash.replace(/^#/, "").split("/").filter(Boolean);
+  if (parts.length) state.project = decodeURIComponent(parts[0]);
+  state.view = parts[1] || "runs";
+  state.runName = parts[2] ? decodeURIComponent(parts[2]) : null;
+  if (state.view === "runs" && state.runName) state.view = "run_detail";
+}
+
+async function render() {
+  stopLogFollow();
+  parseHash();
+  const content = $("#content");
+  try {
+    if (!state.token) return showLogin();
+    const projects = await api("/api/projects/list", {});
+    const names = (projects || []).map((p) => p.project_name || p.name);
+    if (!names.length) { content.innerHTML = `<p class="muted">No projects.</p>`; return; }
+    if (!names.includes(state.project)) state.project = names[0];
+    const sel = $("#project-select");
+    sel.innerHTML = names.map((n) => `<option ${n === state.project ? "selected" : ""}>${esc(n)}</option>`).join("");
+    document.querySelectorAll("#nav a").forEach((a) => a.classList.toggle(
+      "active", a.dataset.view === (state.view === "run_detail" ? "runs" : state.view)));
+    const view = views[state.view] || views.runs;
+    const { title, html, after } = await view();
+    content.innerHTML = `<h1>${title}</h1>${html}`;
+    content.querySelectorAll("tr[data-run]").forEach((tr) => {
+      tr.onclick = () => navigate(state.project, "runs", tr.dataset.run);
+    });
+    if (after) after();
+    hideLogin();
+  } catch (e) {
+    if (e instanceof AuthError) return showLogin();
+    content.innerHTML = `<p class="error">${esc(e.message)}</p>`;
+  }
+}
+
+function showLogin() { $("#login").classList.remove("hidden"); }
+function hideLogin() { $("#login").classList.add("hidden"); }
+
+$("#login-btn").onclick = async () => {
+  state.token = $("#token-input").value.trim();
+  try {
+    await api("/api/users/get_my_user", {});
+    localStorage.setItem("dstack_tpu_token", state.token);
+    $("#login-error").classList.add("hidden");
+    render();
+  } catch (e) {
+    $("#login-error").textContent = "That token was rejected.";
+    $("#login-error").classList.remove("hidden");
+  }
+};
+$("#token-input").addEventListener("keydown", (e) => { if (e.key === "Enter") $("#login-btn").click(); });
+$("#logout").onclick = () => { localStorage.removeItem("dstack_tpu_token"); state.token = ""; showLogin(); };
+$("#project-select").onchange = (e) => navigate(e.target.value, "runs");
+document.querySelectorAll("#nav a").forEach((a) => {
+  a.onclick = () => navigate(state.project, a.dataset.view);
+});
+window.addEventListener("hashchange", render);
+render();

@@ -99,13 +99,18 @@ def _node(name, cpu="16", memory="65536Mi", labels=None, addresses=None):
     }
 
 
-def _tpu_node(name, accel, topology):
+def _tpu_node(name, accel, topology, pool="pool-a", ready=True):
     labels = {
         "cloud.google.com/gke-tpu-accelerator": accel,
         "cloud.google.com/gke-tpu-topology": topology,
+        "cloud.google.com/gke-nodepool": pool,
         "topology.kubernetes.io/region": "us-central2",
     }
-    return _node(name, cpu="208", memory="393216Mi", labels=labels)
+    node = _node(name, cpu="208", memory="393216Mi", labels=labels)
+    node["status"]["conditions"] = [
+        {"type": "Ready", "status": "True" if ready else "False"}
+    ]
+    return node
 
 
 def _compute(api):
@@ -154,6 +159,54 @@ async def test_multihost_slice_availability_requires_all_workers():
     nodes += [_tpu_node(f"tpu-{i}", "tpu-v5p-slice", "4x4x4") for i in range(2, 16)]
     offers = await _compute(api).get_offers(_req(tpu="v5p-128"))
     assert offers[0].availability == InstanceAvailability.AVAILABLE
+
+
+async def test_not_ready_nodes_do_not_count_toward_availability():
+    # 4-host slice whose nodes are all NotReady: the offer must not be
+    # AVAILABLE (pods would sit Pending forever).
+    nodes = [
+        _tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4", ready=False)
+        for i in range(4)
+    ]
+    api = FakeKubernetesApi(nodes=nodes)
+    offers = await _compute(api).get_offers(_req(tpu="v5litepod-16"))
+    assert offers[0].availability == InstanceAvailability.NOT_AVAILABLE
+
+
+async def test_two_half_pools_do_not_merge_into_one_slice():
+    # Two same-shape pools with half the workers each must NOT present as
+    # one complete slice.
+    nodes = [
+        _tpu_node(f"a-{i}", "tpu-v5-lite-podslice", "4x4", pool="pool-a")
+        for i in range(2)
+    ] + [
+        _tpu_node(f"b-{i}", "tpu-v5-lite-podslice", "4x4", pool="pool-b")
+        for i in range(2)
+    ]
+    api = FakeKubernetesApi(nodes=nodes)
+    offers = await _compute(api).get_offers(_req(tpu="v5litepod-16"))
+    assert len(offers) == 1
+    assert offers[0].availability == InstanceAvailability.NOT_AVAILABLE
+
+
+async def test_jump_pod_is_per_ssh_key():
+    nodes = [_tpu_node("tpu-0", "tpu-v5-lite-podslice", "2x4")]
+    api = FakeKubernetesApi(nodes=nodes)
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-8"))
+    await compute.run_job("proj", "run1", offers[0], "ssh-rsa KEY-A", "i-a")
+    await compute.run_job("proj", "run2", offers[0], "ssh-rsa KEY-B", "i-b")
+    jump_pods = [n for n in api.pods if n.startswith("dstack-tpu-jump-")]
+    # Distinct keys get distinct jump pods; reusing a key reuses the pod.
+    assert len(jump_pods) == 2
+    await compute.run_job("proj", "run3", offers[0], "ssh-rsa KEY-A", "i-c")
+    assert len([n for n in api.pods if n.startswith("dstack-tpu-jump-")]) == 2
+    # Each jump pod authorizes exactly its own key.
+    for pod_name, pod in api.pods.items():
+        if not pod_name.startswith("dstack-tpu-jump-"):
+            continue
+        script = pod["spec"]["containers"][0]["command"][2]
+        assert ("KEY-A" in script) != ("KEY-B" in script)
 
 
 async def test_run_job_creates_gang_pods_with_tpu_selectors():
