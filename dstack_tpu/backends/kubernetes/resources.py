@@ -15,6 +15,7 @@ from dstack_tpu.models.topology import GENERATIONS, TpuGeneration, TpuTopology
 LABEL_MANAGED = "app.dstack-tpu/managed"
 LABEL_INSTANCE = "app.dstack-tpu/instance"
 LABEL_WORKER = "app.dstack-tpu/worker"
+LABEL_JUMP_FP = "app.dstack-tpu/jump-fp"  # which jump pod this pod is reached via
 
 # GKE accelerator label values <-> TPU generations.
 GKE_TPU_ACCELERATORS: Dict[str, TpuGeneration] = {
@@ -83,6 +84,8 @@ def runner_pod_body(
     memory_mib: int,
     topo: Optional[TpuTopology] = None,
     agent_download_url: str = "",
+    node_pool: Optional[str] = None,
+    jump_fp: Optional[str] = None,
 ) -> dict:
     resources: Dict[str, Dict[str, str]] = {
         "requests": {"cpu": str(cpus), "memory": f"{memory_mib}Mi"},
@@ -100,19 +103,27 @@ def runner_pod_body(
             ],
             "cloud.google.com/gke-tpu-topology": topo.topology_string,
         }
+        if node_pool:
+            # Pin the whole gang to the ONE pool whose Ready nodes backed
+            # the offer — shape selectors alone could split a multi-host
+            # gang across two same-shape pools (separate physical slices).
+            node_selector["cloud.google.com/gke-nodepool"] = node_pool
     if not resources["limits"]:
         del resources["limits"]
+    labels = {
+        LABEL_MANAGED: "true",
+        LABEL_INSTANCE: instance_id,
+        LABEL_WORKER: str(worker_index),
+    }
+    if jump_fp:
+        labels[LABEL_JUMP_FP] = jump_fp
     script = "\n".join(runner_bootstrap_commands(authorized_key, agent_download_url))
     return {
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {
             "name": name,
-            "labels": {
-                LABEL_MANAGED: "true",
-                LABEL_INSTANCE: instance_id,
-                LABEL_WORKER: str(worker_index),
-            },
+            "labels": labels,
         },
         "spec": {
             "restartPolicy": "Never",

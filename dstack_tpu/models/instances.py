@@ -70,6 +70,10 @@ class InstanceOffer(CoreModel):
     # Number of worker VMs provisioned together for this offer (pod slice
     # hosts). 1 for plain VMs. The scheduler fans this out into per-host jobs.
     hosts: int = 1
+    # Backend-private placement hint carried from get_offers to run_job
+    # (e.g. the GKE node pool whose Ready nodes made this offer available —
+    # the gang must pin to THAT pool, not just the slice shape).
+    provider_data: Optional[str] = None
 
     @property
     def total_chips(self) -> int:

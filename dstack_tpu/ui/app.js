@@ -4,7 +4,7 @@
 "use strict";
 
 const $ = (sel) => document.querySelector(sel);
-const state = { token: localStorage.getItem("dstack_tpu_token") || "", project: "", view: "runs", runName: null, logTimer: null };
+const state = { token: localStorage.getItem("dstack_tpu_token") || "", project: "", view: "runs", runName: null, logTimer: null, logGen: 0 };
 
 async function api(path, body) {
   const resp = await fetch(path, {
@@ -41,7 +41,7 @@ function table(headers, rows, rowAttrs) {
     : `<tr><td colspan="${headers.length}" class="muted">Nothing here yet.</td></tr>`;
   return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
 }
-function stopLogFollow() { if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; } }
+function stopLogFollow() { state.logGen++; if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; } }
 
 /* ---- views ---------------------------------------------------------- */
 
@@ -182,27 +182,30 @@ function latestJpd(run) {
 
 function followLogs(run) {
   stopLogFollow();
+  const myGen = state.logGen; // stale ticks (in-flight across navigation) bail
   const jobs = run.jobs || [];
   if (!jobs.length || !(jobs[0].job_submissions || []).length) { $("#log-state").textContent = "(no submissions yet)"; return; }
   const submissionId = jobs[0].job_submissions[jobs[0].job_submissions.length - 1].id;
   let cursor = "";
+  // One streaming decoder for the whole follow: per-event decoding would
+  // corrupt multi-byte UTF-8 split across log-chunk boundaries.
+  const dec = new TextDecoder("utf-8");
   const tick = async () => {
     try {
       const out = await api(`/api/project/${state.project}/logs/poll`,
         { run_name: state.runName, job_submission_id: submissionId, start_after: cursor || null });
+      if (myGen !== state.logGen) return; // navigated away mid-request
       const box = $("#log-box");
       if (!box) return; // view changed
-      // atob alone maps bytes to latin1 chars; decode as UTF-8 so non-ASCII
-      // job output doesn't render as mojibake.
-      const dec = new TextDecoder("utf-8");
       for (const ev of out.logs || []) {
-        box.textContent += dec.decode(Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)));
+        box.textContent += dec.decode(Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)), { stream: true });
       }
       if ((out.logs || []).length) box.scrollTop = box.scrollHeight;
       cursor = out.next_token || cursor;
       state.logTimer = setTimeout(tick, 1500);
     } catch (e) {
       if (e instanceof AuthError) return showLogin();
+      if (myGen !== state.logGen) return;
       const stateEl = $("#log-state");
       if (stateEl) stateEl.textContent = "(log polling stopped: " + e.message + ")";
     }

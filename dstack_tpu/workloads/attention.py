@@ -158,12 +158,25 @@ def make_attention_fn(
 ):
     """Pick the attention implementation for a mesh.
 
-    No mesh / no "seq" axis / seq axis of size 1 -> plain fused attention
-    (XLA shards heads/batch itself from the surrounding constraints).
-    Otherwise -> ring attention under shard_map over the seq axis.
+    No mesh / no "seq" axis / seq axis of size 1 -> single-device path: the
+    Pallas flash kernel when shapes qualify (TPU, 128-tiled head_dim,
+    block-divisible seq — workloads/flash_attention.py), else plain fused
+    attention (XLA shards heads/batch itself from the surrounding
+    constraints). Otherwise -> ring attention under shard_map over seq.
     """
     if mesh is None or seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
-        return functools.partial(plain_attention, causal=causal)
+
+        def single_device(q, k, v):
+            from dstack_tpu.workloads.flash_attention import (
+                flash_attention,
+                use_flash,
+            )
+
+            if q.shape[1] == k.shape[1] and use_flash(q.shape[1], q.shape[3]):
+                return flash_attention(q, k, v, causal=causal)
+            return plain_attention(q, k, v, causal=causal)
+
+        return single_device
 
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     heads = heads_axis if heads_axis in mesh.axis_names else None

@@ -1,0 +1,311 @@
+"""Flash attention: fused Pallas TPU kernels for the single-device hot path.
+
+The streaming-softmax math is the same as `attention._block_attend` (and the
+ring path reuses that for cross-device blocks); here the blocking happens
+*inside* one chip's VMEM instead of across devices: the (S, S) probability
+matrix is never materialized in HBM, in forward or backward — q/k/v tiles
+stream HBM→VMEM, logits/probabilities live only in registers/VMEM
+(pallas_guide: Memory Spaces, Tiling Constraints, Patterns: Custom VJP).
+
+This is a capability the reference cannot have: dstack is an orchestrator
+with no compute kernels at all (SURVEY §2.7) — the TPU-native framework
+ships its own. Backward recomputes probabilities blockwise from the saved
+logsumexp (standard flash backward), so residual memory is O(S) per head
+row, not O(S^2).
+
+Dispatch rules (`use_flash`): TPU backend, head_dim a multiple of 128
+(bf16/f32 lane tiling), seq divisible by the block size and small enough
+that one head's K/V fits VMEM comfortably. Everything else falls back to
+`plain_attention`, including CPU tests — which also validate these kernels
+via `interpret=True`.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLK_Q = 256
+BLK_K = 256
+NEG_INF = -1e30
+# One head's K+V must stream through VMEM (~16MB): cap the kernel path.
+MAX_FLASH_SEQ = 8192
+
+
+def use_flash(seq_len: int, head_dim: int, *, interpret: bool = False) -> bool:
+    import os
+
+    if os.getenv("DSTACK_TPU_FLASH_ATTENTION", "1") == "0":
+        return False
+    if not interpret and jax.default_backend() != "tpu":
+        return False
+    return (
+        head_dim % 128 == 0
+        and seq_len % BLK_Q == 0
+        and seq_len % BLK_K == 0
+        and seq_len <= MAX_FLASH_SEQ
+    )
+
+
+# ---- forward ---------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool):
+    blk_q, hd = q_ref.shape[1], q_ref.shape[2]
+    seq = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q_start = iq * blk_q
+    q = q_ref[0].astype(jnp.float32)  # (blk_q, hd)
+    scale = hd ** -0.5
+
+    n_blocks = seq // BLK_K
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing; bound the
+        # loop by the last block any of this tile's queries can see.
+        n_blocks = jnp.minimum(n_blocks, (q_start + blk_q + BLK_K - 1) // BLK_K)
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(j * BLK_K, BLK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * BLK_K, BLK_K), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (blk_q, BLK_K)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, BLK_K), 0)
+            cols = j * BLK_K + jax.lax.broadcasted_iota(jnp.int32, (blk_q, BLK_K), 1)
+            logits = jnp.where(rows >= cols, logits, NEG_INF)
+        blk_m = jnp.max(logits, axis=-1, keepdims=True)  # (blk_q, 1)
+        blk_m = jnp.maximum(blk_m, NEG_INF / 2)
+        p = jnp.exp(logits - blk_m)
+        blk_l = jnp.sum(p, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_m)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(blk_m - m_new)
+        l_new = l * alpha + blk_l * beta
+        o_new = o * alpha + beta * jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((blk_q, hd), jnp.float32)
+    m0 = jnp.full((blk_q, 1), NEG_INF / 2, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd_call(q, k, v, causal: bool, interpret: bool):
+    bh, seq, hd = q.shape
+    grid = (bh, seq // BLK_Q)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLK_Q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, hd), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLK_Q, hd), lambda b, i: (b, i, 0)),
+            # lse rides as (bh, 1, seq): TPU requires the last two block
+            # dims to be (8k, 128k) or full-size — (1, BLK) satisfies it.
+            pl.BlockSpec((1, 1, BLK_Q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---- backward --------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, causal):
+    blk_q, hd = q_ref.shape[1], q_ref.shape[2]
+    seq = k_ref.shape[1]
+    iq = pl.program_id(1)
+    q_start = iq * blk_q
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]  # (blk_q, 1)
+    delta = delta_ref[0, 0][:, None]
+    scale = hd ** -0.5
+
+    n_blocks = seq // BLK_K
+    if causal:
+        n_blocks = jnp.minimum(n_blocks, (q_start + blk_q + BLK_K - 1) // BLK_K)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * BLK_K, BLK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * BLK_K, BLK_K), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, BLK_K), 0)
+            cols = j * BLK_K + jax.lax.broadcasted_iota(jnp.int32, (blk_q, BLK_K), 1)
+            logits = jnp.where(rows >= cols, logits, NEG_INF)
+        p = jnp.exp(logits - lse)  # normalized probabilities
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, n_blocks, body, jnp.zeros((blk_q, hd), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, causal
+):
+    blk_k, hd = k_ref.shape[1], k_ref.shape[2]
+    seq = q_ref.shape[1]
+    jk = pl.program_id(1)
+    k_start = jk * blk_k
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    scale = hd ** -0.5
+
+    n_blocks = seq // BLK_Q
+    start = jnp.array(0, jnp.int32)
+    if causal:
+        # Query blocks strictly before this kv block see none of it.
+        start = k_start // BLK_Q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * BLK_Q, BLK_Q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * BLK_Q, BLK_Q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * BLK_Q, BLK_Q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * BLK_Q, BLK_Q)][:, None]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * BLK_Q + jax.lax.broadcasted_iota(jnp.int32, (BLK_Q, blk_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (BLK_Q, blk_k), 1)
+            logits = jnp.where(rows >= cols, logits, NEG_INF)
+        p = jnp.exp(logits - lse)  # (BLK_Q, blk_k)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((blk_k, hd), jnp.float32)
+    dv0 = jnp.zeros((blk_k, hd), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_call(q, k, v, do, lse, delta, causal: bool, interpret: bool):
+    bh, seq, hd = q.shape
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal),
+        grid=(bh, seq // BLK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLK_Q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, BLK_Q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, BLK_Q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, BLK_Q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, BLK_Q, hd), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal),
+        grid=(bh, seq // BLK_K),
+        in_specs=[
+            pl.BlockSpec((1, seq, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, BLK_K, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, BLK_K, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLK_K, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, BLK_K, hd), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---- custom-vjp wrapper ----------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal: bool, interpret: bool):
+    o, _ = _flash_fwd_call(q, k, v, causal, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    o, lse = _flash_fwd_call(q, k, v, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, interpret, residuals, do):
+    q, k, v, o, lse = residuals
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
+    dq, dk, dv = _flash_bwd_call(q, k, v, do, lse, delta, causal, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in for `plain_attention`: q (B, S, H, hd), k/v (B, S, KV, hd).
+
+    GQA expansion happens OUTSIDE the custom-vjp boundary, so autodiff of
+    the broadcast sums dk/dv over the query-head groups automatically.
+    """
+    b, s, h, hd = q.shape
+    kv = k.shape[2]
+    n_rep = h // kv
+    if n_rep > 1:
+        from dstack_tpu.workloads.attention import _repeat_kv
+
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+
+    def to_bh(x):  # (B, S, H, hd) -> (B*H, S, hd)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, interpret)
+    return o.reshape(b, h, s, hd).transpose(0, 2, 1, 3)

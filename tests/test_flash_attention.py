@@ -1,0 +1,99 @@
+"""Flash-attention Pallas kernels vs the reference jnp implementation.
+
+Runs the kernels in interpret mode (CPU), checking forward outputs and all
+three input gradients, causal + non-causal, MHA + GQA.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dstack_tpu.workloads.attention import plain_attention
+from dstack_tpu.workloads.flash_attention import BLK_K, BLK_Q, flash_attention, use_flash
+
+
+def _inputs(b=1, s=512, h=4, kv=None, hd=128, dtype=jnp.float32, seed=0):
+    kv = kv or h
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, hd), dtype)
+    k = jax.random.normal(ks[1], (b, s, kv, hd), dtype)
+    v = jax.random.normal(ks[2], (b, s, kv, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _inputs()
+    ref = plain_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    assert jnp.allclose(out, ref, atol=2e-3, rtol=2e-3), float(
+        jnp.max(jnp.abs(out - ref))
+    )
+
+
+def test_forward_gqa():
+    q, k, v = _inputs(h=8, kv=2)
+    ref = plain_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    assert jnp.allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    q, k, v = _inputs(s=BLK_Q * 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_fl):
+        err = float(jnp.max(jnp.abs(a - b)))
+        denom = float(jnp.max(jnp.abs(a))) or 1.0
+        assert err / denom < 5e-3, (name, err, denom)
+
+
+def test_gradients_gqa_sum_over_groups():
+    q, k, v = _inputs(h=8, kv=2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=True) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(1, 2))(q, k, v)
+    for name, a, b in zip("kv", g_ref, g_fl):
+        assert a.shape == b.shape  # (B, S, KV, hd) — grouped, not expanded
+        err = float(jnp.max(jnp.abs(a - b)))
+        denom = float(jnp.max(jnp.abs(a))) or 1.0
+        assert err / denom < 5e-3, (name, err, denom)
+
+
+def test_bf16_forward_close():
+    q, k, v = _inputs(dtype=jnp.bfloat16)
+    ref = plain_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    assert jnp.allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_use_flash_dispatch_rules():
+    # CPU backend: only eligible via interpret flag.
+    assert not use_flash(1024, 128)
+    assert use_flash(1024, 128, interpret=True)
+    assert not use_flash(1024, 64, interpret=True)  # head_dim not 128-tiled
+    assert not use_flash(1000, 128, interpret=True)  # seq not block-divisible
+    assert not use_flash(16384, 128, interpret=True)  # K/V too big for VMEM
+    import os
+
+    os.environ["DSTACK_TPU_FLASH_ATTENTION"] = "0"
+    try:
+        assert not use_flash(1024, 128, interpret=True)
+    finally:
+        del os.environ["DSTACK_TPU_FLASH_ATTENTION"]

@@ -62,6 +62,15 @@ class FakeKubernetesApi:
             store[pod_name] = body
             return body
         if method == "GET":
+            if name is None and query.startswith("labelSelector="):
+                sel = query[len("labelSelector="):].replace("%3D", "=")
+                key, _, value = sel.partition("=")
+                return {
+                    "items": [
+                        p for p in store.values()
+                        if p["metadata"].get("labels", {}).get(key) == value
+                    ]
+                }
             if name not in store:
                 raise KubernetesApiError(404, "NotFound")
             return store[name]
@@ -265,6 +274,45 @@ async def test_failed_pod_raises():
     api.pods[pod_name]["status"] = {"phase": "Failed"}
     with pytest.raises(ComputeError):
         await compute.update_provisioning_data(jpds[0])
+
+
+async def test_gang_pods_pinned_to_offer_node_pool():
+    # Shape selectors alone could split a gang across two same-shape pools;
+    # the pods must also pin the pool the offer was computed from.
+    nodes = [
+        _tpu_node(f"a-{i}", "tpu-v5-lite-podslice", "4x4", pool="pool-a")
+        for i in range(4)
+    ] + [
+        _tpu_node(f"b-{i}", "tpu-v5-lite-podslice", "4x4", pool="pool-b")
+        for i in range(4)
+    ]
+    api = FakeKubernetesApi(nodes=nodes)
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-16"))
+    assert offers[0].provider_data in ("pool-a", "pool-b")
+    await compute.run_job("proj", "run1", offers[0], "ssh-rsa KEY", "inst-p")
+    for name, pod in api.pods.items():
+        if name.startswith("inst-p"):
+            sel = pod["spec"]["nodeSelector"]
+            assert sel["cloud.google.com/gke-nodepool"] == offers[0].provider_data
+
+
+async def test_jump_pod_gc_on_last_instance_terminate():
+    nodes = [_tpu_node("tpu-0", "tpu-v5-lite-podslice", "2x4")]
+    api = FakeKubernetesApi(nodes=nodes)
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-8"))
+    await compute.run_job("proj", "r1", offers[0], "ssh-rsa KEY", "i-1")
+    await compute.run_job("proj", "r2", offers[0], "ssh-rsa KEY", "i-2")
+    jump = [n for n in api.pods if n.startswith("dstack-tpu-jump-")]
+    assert len(jump) == 1
+    # First terminate: i-2 still references the jump pod -> kept.
+    await compute.terminate_instance("i-1", "us-central2")
+    assert any(n.startswith("dstack-tpu-jump-") for n in api.pods)
+    # Last reference gone -> jump pod + service GC'd.
+    await compute.terminate_instance("i-2", "us-central2")
+    assert not any(n.startswith("dstack-tpu-jump-") for n in api.pods)
+    assert not any(n.startswith("dstack-tpu-jump-") for n in api.services)
 
 
 async def test_terminate_deletes_all_gang_pods():
