@@ -178,7 +178,12 @@ class KubernetesCompute(Compute):
         env: Optional[Dict[str, str]] = None,
     ) -> List[JobProvisioningData]:
         topo = offer.instance.resources.tpu
-        ssh_proxy, jump_fp = await self._ensure_jump_pod(ssh_public_key)
+        # fp computed up front: runner pods carry the label from birth, and
+        # they are created BEFORE the jump pod so a concurrent GC always
+        # sees them as references.
+        import hashlib
+
+        jump_fp = hashlib.sha256(ssh_public_key.encode()).hexdigest()[:10]
         hosts = offer.hosts
         jpds: List[JobProvisioningData] = []
         for worker in range(hosts):
@@ -197,6 +202,9 @@ class KubernetesCompute(Compute):
                 jump_fp=jump_fp,
             )
             await self.api.request("POST", self._ns("pods"), body)
+        ssh_proxy, _ = await self._ensure_jump_pod(ssh_public_key)
+        for worker in range(hosts):
+            pod_name = _pod_name(instance_name, worker)
             jpds.append(
                 JobProvisioningData(
                     backend=BackendType.KUBERNETES,
@@ -263,17 +271,29 @@ class KubernetesCompute(Compute):
             if e.status != 404:
                 raise
         for fp in fps:
-            await self._gc_jump_pod(fp)
+            await self._gc_jump_pod(fp, terminating_instance=instance_id)
 
-    async def _gc_jump_pod(self, fp: str) -> None:
+    async def _gc_jump_pod(self, fp: str, terminating_instance: str = "") -> None:
         """Delete the jump pod/service for `fp` if no runner pod still
-        references it."""
+        references it. Pods already terminating (deletionTimestamp set) and
+        the terminating instance's own pods do NOT count as references —
+        on a real cluster graceful deletion keeps them listable for ~30s,
+        which would permanently defeat the GC. A narrow create/GC race
+        remains (a concurrent run_job 409-reusing the pod between our list
+        and delete); it self-heals — the new jobs' SSH healthchecks fail
+        and the FSM reprovisions, recreating the jump pod."""
         try:
             remaining = await self.api.request(
                 "GET",
                 self._ns("pods") + f"?labelSelector={res.LABEL_JUMP_FP}%3D{fp}",
             )
-            if remaining.get("items"):
+            live = [
+                pod for pod in remaining.get("items", [])
+                if not pod["metadata"].get("deletionTimestamp")
+                and pod["metadata"].get("labels", {}).get(res.LABEL_INSTANCE)
+                != terminating_instance
+            ]
+            if live:
                 return
             name = f"{JUMP_POD_PREFIX}-{fp}"
             for kind in ("pods", "services"):
@@ -381,8 +401,12 @@ class KubernetesCompute(Compute):
                 # Leave no orphans behind: the FSM retries create_gateway,
                 # and the 409-tolerant creates above make that retry safe —
                 # but a cluster with no LB provisioner should not accrete
-                # pods. Best-effort cleanup, then surface the error.
-                await self.terminate_gateway(name, configuration.region)
+                # pods. Best-effort cleanup (a failing DELETE must not mask
+                # the timeout error), then surface the error.
+                try:
+                    await self.terminate_gateway(name, configuration.region)
+                except KubernetesApiError:
+                    pass
                 raise ComputeError(
                     f"gateway service {name} got no LoadBalancer address in 120s"
                 )

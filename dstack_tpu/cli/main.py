@@ -451,6 +451,53 @@ def fleet_delete(name: str, project: Optional[str], yes: bool) -> None:
 
 
 @cli.group()
+def pool() -> None:
+    """Reference-compat alias: pools are subsumed by fleets here (the
+    reference deprecated pools in its favor too — docs/design/pools.md).
+    `pool ps` lists instances; use `fleet`/`apply -f fleet.yml` to manage
+    capacity."""
+
+
+@pool.command("ps")
+@click.option("--project", default=None)
+def pool_ps(project: Optional[str]) -> None:
+    """List pool (fleet) instances — maps the reference's `dstack pool ps`."""
+    client = _make_client(project)
+    try:
+        from rich.table import Table as RichTable
+
+        table = RichTable(box=None, header_style="bold")
+        for col in ("NAME", "STATUS", "BACKEND", "TYPE", "HOST", "PRICE"):
+            table.add_column(col)
+        for i in client.api.instances.list(client.project):
+            price = i.get("price")
+            table.add_row(
+                i.get("name") or "-",
+                fmt_status(i.get("status", "")),
+                i.get("backend") or "-",
+                (i.get("instance_type") or {}).get("name", "-"),
+                i.get("hostname") or "-",
+                f"${float(price):.2f}" if price is not None else "-",
+            )
+        console.print(table)
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+@pool.command("add")
+@click.option("--project", default=None)
+def pool_add(project: Optional[str]) -> None:
+    """Pools are fleets here: point at the fleet workflow instead."""
+    raise _fail(
+        "pools are subsumed by fleets: create capacity with"
+        " `dstack-tpu apply -f fleet.yml` (cloud) or an ssh_config fleet"
+        " (on-prem). See docs/design/pools.md."
+    )
+
+
+@cli.group()
 def volume() -> None:
     """Manage volumes."""
 

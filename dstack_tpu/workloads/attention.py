@@ -172,7 +172,9 @@ def make_attention_fn(
                 use_flash,
             )
 
-            if q.shape[1] == k.shape[1] and use_flash(q.shape[1], q.shape[3]):
+            if q.shape[1] == k.shape[1] and use_flash(
+                q.shape[1], q.shape[3], dtype_bytes=q.dtype.itemsize
+            ):
                 return flash_attention(q, k, v, causal=causal)
             return plain_attention(q, k, v, causal=causal)
 

@@ -30,22 +30,29 @@ from jax.experimental import pallas as pl
 BLK_Q = 256
 BLK_K = 256
 NEG_INF = -1e30
-# One head's K+V must stream through VMEM (~16MB): cap the kernel path.
-MAX_FLASH_SEQ = 8192
+# One head's full K+V ride in VMEM (~16MB/core): budget them to 8MB so q/o
+# tiles, f32 accumulators and double-buffering fit alongside. The check
+# scales with head_dim and element size — a seq-only cap would admit
+# f32/hd-256 shapes that blow VMEM and crash at compile instead of falling
+# back.
+KV_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 
-def use_flash(seq_len: int, head_dim: int, *, interpret: bool = False) -> bool:
+def use_flash(
+    seq_len: int, head_dim: int, *, dtype_bytes: int = 2, interpret: bool = False
+) -> bool:
     import os
 
     if os.getenv("DSTACK_TPU_FLASH_ATTENTION", "1") == "0":
         return False
     if not interpret and jax.default_backend() != "tpu":
         return False
+    kv_bytes = 2 * seq_len * head_dim * dtype_bytes  # K + V, one head
     return (
         head_dim % 128 == 0
         and seq_len % BLK_Q == 0
         and seq_len % BLK_K == 0
-        and seq_len <= MAX_FLASH_SEQ
+        and kv_bytes <= KV_VMEM_BUDGET_BYTES
     )
 
 

@@ -89,7 +89,10 @@ def test_use_flash_dispatch_rules():
     assert use_flash(1024, 128, interpret=True)
     assert not use_flash(1024, 64, interpret=True)  # head_dim not 128-tiled
     assert not use_flash(1000, 128, interpret=True)  # seq not block-divisible
-    assert not use_flash(16384, 128, interpret=True)  # K/V too big for VMEM
+    assert not use_flash(32768, 128, interpret=True)  # K/V too big for VMEM
+    # The VMEM budget scales with head_dim and element size.
+    assert use_flash(8192, 128, dtype_bytes=2, interpret=True)
+    assert not use_flash(8192, 256, dtype_bytes=4, interpret=True)
     import os
 
     os.environ["DSTACK_TPU_FLASH_ATTENTION"] = "0"

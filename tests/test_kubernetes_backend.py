@@ -315,6 +315,35 @@ async def test_jump_pod_gc_on_last_instance_terminate():
     assert not any(n.startswith("dstack-tpu-jump-") for n in api.services)
 
 
+async def test_jump_pod_gc_ignores_gracefully_terminating_pods():
+    """On a real cluster deleted pods stay listable (~30s grace) with a
+    deletionTimestamp; those must not count as jump-pod references."""
+    nodes = [_tpu_node("tpu-0", "tpu-v5-lite-podslice", "2x4")]
+    api = FakeKubernetesApi(nodes=nodes)
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-8"))
+    await compute.run_job("proj", "r1", offers[0], "ssh-rsa KEY", "i-g")
+    # Simulate graceful deletion: another instance's pod with the same fp
+    # lingers with deletionTimestamp instead of disappearing.
+    fp_label = "app.dstack-tpu/jump-fp"
+    fp = next(
+        p["metadata"]["labels"][fp_label]
+        for p in api.pods.values()
+        if fp_label in p["metadata"].get("labels", {})
+    )
+    api.pods["ghost-w0"] = {
+        "metadata": {
+            "name": "ghost-w0",
+            "deletionTimestamp": "2026-01-01T00:00:00Z",
+            "labels": {fp_label: fp, "app.dstack-tpu/instance": "i-old"},
+        },
+        "spec": {},
+        "status": {"phase": "Running"},
+    }
+    await compute.terminate_instance("i-g", "us-central2")
+    assert not any(n.startswith("dstack-tpu-jump-") for n in api.pods)
+
+
 async def test_terminate_deletes_all_gang_pods():
     nodes = [_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4") for i in range(4)]
     api = FakeKubernetesApi(nodes=nodes)
