@@ -181,9 +181,7 @@ class KubernetesCompute(Compute):
         # fp computed up front: runner pods carry the label from birth, and
         # they are created BEFORE the jump pod so a concurrent GC always
         # sees them as references.
-        import hashlib
-
-        jump_fp = hashlib.sha256(ssh_public_key.encode()).hexdigest()[:10]
+        jump_fp = _key_fp(ssh_public_key)
         hosts = offer.hosts
         jpds: List[JobProvisioningData] = []
         for worker in range(hosts):
@@ -202,7 +200,16 @@ class KubernetesCompute(Compute):
                 jump_fp=jump_fp,
             )
             await self.api.request("POST", self._ns("pods"), body)
-        ssh_proxy, _ = await self._ensure_jump_pod(ssh_public_key)
+        try:
+            ssh_proxy, _ = await self._ensure_jump_pod(ssh_public_key)
+        except Exception:
+            # The gang is already on the cluster; a jump-pod failure must
+            # not leak up to 32 TPU-pool pods (no orphan sweeper exists).
+            try:
+                await self.terminate_instance(instance_name, offer.region)
+            except Exception:
+                pass
+            raise
         for worker in range(hosts):
             pod_name = _pod_name(instance_name, worker)
             jpds.append(
@@ -316,9 +323,7 @@ class KubernetesCompute(Compute):
         terminate_instance can GC unreferenced jump pods). The name is
         keyed by the fingerprint, so a 409 reuse is guaranteed to be a pod
         that already authorizes this exact key."""
-        import hashlib
-
-        fp = hashlib.sha256(authorized_key.encode()).hexdigest()[:10]
+        fp = _key_fp(authorized_key)
         name = f"{JUMP_POD_PREFIX}-{fp}"
         try:
             await self.api.request(
@@ -431,6 +436,14 @@ class KubernetesCompute(Compute):
             except KubernetesApiError as e:
                 if e.status != 404:
                     raise
+
+
+def _key_fp(authorized_key: str) -> str:
+    """SSH-key fingerprint naming the jump pod AND labeling runner pods —
+    one definition, or GC label queries would silently match nothing."""
+    import hashlib
+
+    return hashlib.sha256(authorized_key.encode()).hexdigest()[:10]
 
 
 def _node_ready(node: dict) -> bool:

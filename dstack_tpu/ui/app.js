@@ -160,6 +160,38 @@ const views = {
     ) };
   },
 
+  async models() {
+    const out = await api(`/proxy/models/${state.project}/models`);
+    const models = (out && out.data) || [];
+    // Endpoint shape (routers/model_proxy.py): {id, object, created, owned_by}
+    // where owned_by carries the serving run's name.
+    return { title: "Models", html: table(
+      ["Model", "Run"],
+      models.map((m) => [esc(m.id), esc(m.owned_by || "—")])
+    ) + `<p class="muted">OpenAI-compatible endpoint:
+      <code>/proxy/models/${esc(state.project)}/chat/completions</code></p>` };
+  },
+
+  async admin() {
+    const [users, projects] = await Promise.all([
+      api("/api/users/list", {}),
+      api("/api/projects/list", {}),
+    ]);
+    return { title: "Admin", html: `
+      <div class="section">Users</div>
+      ${table(["Username", "Role", "Email", "Active"],
+        (users || []).map((u) => [
+          esc(u.username), pill(u.global_role), esc(u.email || "—"),
+          esc(u.active === false ? "no" : "yes"),
+        ]))}
+      <div class="section">Projects</div>
+      ${table(["Project", "Members"],
+        (projects || []).map((p) => [
+          esc(p.project_name || p.name),
+          esc(String((p.members || []).length)),
+        ]))}` };
+  },
+
   async server() {
     const info = await api("/api/server/get_info", {});
     const kv = Object.entries(info || {}).map(([k, v]) =>
