@@ -184,27 +184,14 @@ class KubernetesCompute(Compute):
         jump_fp = _key_fp(ssh_public_key)
         hosts = offer.hosts
         jpds: List[JobProvisioningData] = []
-        for worker in range(hosts):
-            pod_name = _pod_name(instance_name, worker)
-            body = res.runner_pod_body(
-                name=pod_name,
-                instance_id=instance_name,
-                worker_index=worker,
-                image=self.config.runner_image,
-                authorized_key=ssh_public_key,
-                cpus=offer.instance.resources.cpus,
-                memory_mib=offer.instance.resources.memory_mib,
-                topo=topo,
-                agent_download_url=self.config.agent_download_url,
-                node_pool=offer.provider_data,
-                jump_fp=jump_fp,
-            )
-            await self.api.request("POST", self._ns("pods"), body)
         try:
+            await self._create_gang_pods(
+                offer, ssh_public_key, instance_name, topo, jump_fp, hosts
+            )
             ssh_proxy, _ = await self._ensure_jump_pod(ssh_public_key)
         except Exception:
-            # The gang is already on the cluster; a jump-pod failure must
-            # not leak up to 32 TPU-pool pods (no orphan sweeper exists).
+            # Partial gangs and jump-pod failures must not leak pods that
+            # hold TPU-pool capacity (no orphan sweeper exists).
             try:
                 await self.terminate_instance(instance_name, offer.region)
             except Exception:
@@ -231,6 +218,26 @@ class KubernetesCompute(Compute):
                 )
             )
         return jpds
+
+    async def _create_gang_pods(
+        self, offer, ssh_public_key, instance_name, topo, jump_fp, hosts
+    ) -> None:
+        for worker in range(hosts):
+            pod_name = _pod_name(instance_name, worker)
+            body = res.runner_pod_body(
+                name=pod_name,
+                instance_id=instance_name,
+                worker_index=worker,
+                image=self.config.runner_image,
+                authorized_key=ssh_public_key,
+                cpus=offer.instance.resources.cpus,
+                memory_mib=offer.instance.resources.memory_mib,
+                topo=topo,
+                agent_download_url=self.config.agent_download_url,
+                node_pool=offer.provider_data,
+                jump_fp=jump_fp,
+            )
+            await self.api.request("POST", self._ns("pods"), body)
 
     async def update_provisioning_data(
         self, jpd: JobProvisioningData

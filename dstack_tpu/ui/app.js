@@ -173,10 +173,8 @@ const views = {
   },
 
   async admin() {
-    const [users, projects] = await Promise.all([
-      api("/api/users/list", {}),
-      api("/api/projects/list", {}),
-    ]);
+    const users = await api("/api/users/list", {});
+    const projects = state.projects || [];  // fetched by render() this pass
     return { title: "Admin", html: `
       <div class="section">Users</div>
       ${table(["Username", "Role", "Email", "Active"],
@@ -266,6 +264,7 @@ async function render() {
   try {
     if (!state.token) return showLogin();
     const projects = await api("/api/projects/list", {});
+    state.projects = projects || [];
     const names = (projects || []).map((p) => p.project_name || p.name);
     if (!names.length) { content.innerHTML = `<p class="muted">No projects.</p>`; return; }
     if (!names.includes(state.project)) state.project = names[0];

@@ -315,6 +315,30 @@ async def test_jump_pod_gc_on_last_instance_terminate():
     assert not any(n.startswith("dstack-tpu-jump-") for n in api.services)
 
 
+async def test_partial_gang_failure_rolls_back_created_pods():
+    """A pod POST failing midway through the gang must not leak the pods
+    already created (they hold TPU-pool capacity; no orphan sweeper)."""
+    nodes = [_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4") for i in range(4)]
+    api = FakeKubernetesApi(nodes=nodes)
+    real_request = api.request
+
+    async def flaky(method, path, body=None):
+        if (
+            method == "POST"
+            and path.endswith("/pods")
+            and body["metadata"]["name"] == "inst-f-w2"
+        ):
+            raise KubernetesApiError(500, "quota blip")
+        return await real_request(method, path, body)
+
+    api.request = flaky
+    compute = _compute(api)
+    offers = await compute.get_offers(_req(tpu="v5litepod-16"))
+    with pytest.raises(KubernetesApiError):
+        await compute.run_job("proj", "run1", offers[0], "ssh-rsa KEY", "inst-f")
+    assert not any(n.startswith("inst-f") for n in api.pods)
+
+
 async def test_jump_pod_gc_ignores_gracefully_terminating_pods():
     """On a real cluster deleted pods stay listable (~30s grace) with a
     deletionTimestamp; those must not count as jump-pod references."""
