@@ -186,7 +186,7 @@ class KubernetesCompute(Compute):
         jpds: List[JobProvisioningData] = []
         try:
             await self._create_gang_pods(
-                offer, ssh_public_key, instance_name, topo, jump_fp, hosts
+                offer, ssh_public_key, instance_name, topo, jump_fp
             )
             ssh_proxy, _ = await self._ensure_jump_pod(ssh_public_key)
         except Exception:
@@ -220,9 +220,14 @@ class KubernetesCompute(Compute):
         return jpds
 
     async def _create_gang_pods(
-        self, offer, ssh_public_key, instance_name, topo, jump_fp, hosts
+        self,
+        offer: InstanceOfferWithAvailability,
+        ssh_public_key: str,
+        instance_name: str,
+        topo: Optional[TpuTopology],
+        jump_fp: str,
     ) -> None:
-        for worker in range(hosts):
+        for worker in range(offer.hosts):
             pod_name = _pod_name(instance_name, worker)
             body = res.runner_pod_body(
                 name=pod_name,

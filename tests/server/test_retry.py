@@ -1,0 +1,156 @@
+"""Retry / failure-recovery FSM e2e tests.
+
+Parity: reference retry policy (`retry.on_events` with duration —
+process_runs.py `_can_retry_single_job` / `retry_run_replica_jobs`,
+services/runs.py:998) plus the TPU-first gang rule: ANY worker death
+terminates and resubmits the whole replica, not just the master. All tests
+run real jobs through the local backend.
+"""
+
+import asyncio
+
+from dstack_tpu.server import settings
+from dstack_tpu.server.http import response_json
+from tests.server.conftest import make_server
+
+
+def _body(commands, run_name, retry=None, resources=None, nodes=1):
+    conf = {
+        "type": "task",
+        "commands": commands,
+        "nodes": nodes,
+        "resources": resources or {"cpu": "1..", "memory": "0.1.."},
+    }
+    if retry is not None:
+        conf["retry"] = retry
+    return {
+        "run_spec": {
+            "run_name": run_name,
+            "configuration": conf,
+            "ssh_key_pub": "ssh-rsa TEST",
+        }
+    }
+
+
+async def _wait_run(fx, run_name, target_statuses, timeout=40.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        resp = await fx.client.post(
+            "/api/project/main/runs/get", json_body={"run_name": run_name}
+        )
+        run = response_json(resp)
+        if run["status"] in target_statuses:
+            return run
+        assert asyncio.get_event_loop().time() < deadline, run["status"]
+        await asyncio.sleep(0.2)
+
+
+async def test_retry_on_error_resubmits_until_success(tmp_path, monkeypatch):
+    monkeypatch.setattr(settings, "RETRY_PENDING_RUN_DELAY", 0)
+    marker = tmp_path / "attempted"
+    fx = await make_server()
+    try:
+        # Fails on the first attempt, succeeds on the second.
+        cmd = (
+            f"if [ -f {marker} ]; then echo recovered; "
+            f"else touch {marker}; exit 1; fi"
+        )
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_body(
+                [cmd], "retry-run",
+                retry={"on_events": ["error"], "duration": 300},
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(fx, "retry-run", {"done", "failed", "terminated"})
+        assert run["status"] == "done", run
+        subs = run["jobs"][0]["job_submissions"]
+        assert len(subs) == 2
+        assert subs[0]["status"] == "failed"
+        assert subs[0]["termination_reason"] == "container_exited_with_error"
+        assert subs[1]["status"] == "done"
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_error_not_covered_by_retry_events_fails(monkeypatch):
+    monkeypatch.setattr(settings, "RETRY_PENDING_RUN_DELAY", 0)
+    fx = await make_server()
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_body(
+                ["exit 7"], "uncovered-run",
+                # Only capacity events are retryable; a job error is not.
+                retry={"on_events": ["no-capacity"], "duration": 300},
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(fx, "uncovered-run", {"done", "failed", "terminated"})
+        assert run["status"] == "failed"
+        assert len(run["jobs"][0]["job_submissions"]) == 1
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_retry_duration_budget_exceeded(monkeypatch):
+    monkeypatch.setattr(settings, "RETRY_PENDING_RUN_DELAY", 0)
+    fx = await make_server()
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_body(
+                ["sleep 1; exit 1"], "budget-run",
+                # Budget smaller than one attempt: the first failure is
+                # already past it.
+                retry={"on_events": ["error"], "duration": 1},
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(fx, "budget-run", {"done", "failed", "terminated"})
+        assert run["status"] in ("failed", "terminated")
+        assert run["termination_reason"] == "retry_limit_exceeded"
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_gang_member_failure_resubmits_whole_replica(tmp_path, monkeypatch):
+    """TPU-first rule: rank 1 dying once terminates all 4 workers (a slice
+    cannot make progress with a dead host) and retry resubmits the WHOLE
+    gang; second attempt succeeds."""
+    monkeypatch.setattr(settings, "RETRY_PENDING_RUN_DELAY", 0)
+    marker = tmp_path / "rank1-died"
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {"tpu_sim": ["v5litepod-16"]}
+    try:
+        cmd = (
+            f'if [ "$JAX_PROCESS_ID" = "1" ] && [ ! -f {marker} ]; then'
+            f" touch {marker}; exit 3; fi; echo rank $JAX_PROCESS_ID ok"
+        )
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_body(
+                [cmd], "gang-retry",
+                retry={"on_events": ["error"], "duration": 300},
+                resources={"tpu": "v5litepod-16"},
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(
+            fx, "gang-retry", {"done", "failed", "terminated"}, timeout=60.0
+        )
+        assert run["status"] == "done", run
+        assert len(run["jobs"]) == 4
+        reasons = set()
+        for job in run["jobs"]:
+            subs = job["job_submissions"]
+            assert len(subs) == 2, job
+            reasons.add(subs[0]["termination_reason"])
+            assert subs[1]["status"] == "done"
+        # Rank 1 failed with the exit error; the other three were killed as
+        # gang members.
+        assert "container_exited_with_error" in reasons
+        assert "gang_member_failed" in reasons
+    finally:
+        await fx.app.shutdown()
