@@ -21,3 +21,49 @@ async def make_server(run_background_tasks: bool = True) -> ServerFixture:
     fx = ServerFixture(app)
     fx.client.token = fx.admin_token
     return fx
+
+
+def task_body(commands, run_name, resources=None, nodes=1, retry=None):
+    """Run-submit request body shared by the e2e suites."""
+    conf = {
+        "type": "task",
+        "commands": commands,
+        "nodes": nodes,
+        "resources": resources or {"cpu": "1..", "memory": "0.1.."},
+    }
+    if retry is not None:
+        conf["retry"] = retry
+    return {
+        "run_spec": {
+            "run_name": run_name,
+            "configuration": conf,
+            "ssh_key_pub": "ssh-rsa TEST",
+        }
+    }
+
+
+async def wait_run(fx, run_name, target_statuses, timeout=30.0, project="main"):
+    """Poll until the run reaches a target status; rich diagnostics on stall."""
+    import asyncio
+
+    from dstack_tpu.server.http import response_json
+
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        resp = await fx.client.post(
+            f"/api/project/{project}/runs/get", json_body={"run_name": run_name}
+        )
+        assert resp.status == 200, resp.body
+        run = response_json(resp)
+        if run["status"] in target_statuses:
+            return run
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"run stuck in {run['status']}; jobs: "
+                + str([
+                    (j["job_submissions"][-1]["status"],
+                     j["job_submissions"][-1]["termination_reason_message"])
+                    for j in run["jobs"]
+                ])
+            )
+        await asyncio.sleep(0.2)

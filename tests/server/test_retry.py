@@ -10,39 +10,11 @@ run real jobs through the local backend.
 import asyncio
 
 from dstack_tpu.server import settings
-from dstack_tpu.server.http import response_json
-from tests.server.conftest import make_server
-
-
-def _body(commands, run_name, retry=None, resources=None, nodes=1):
-    conf = {
-        "type": "task",
-        "commands": commands,
-        "nodes": nodes,
-        "resources": resources or {"cpu": "1..", "memory": "0.1.."},
-    }
-    if retry is not None:
-        conf["retry"] = retry
-    return {
-        "run_spec": {
-            "run_name": run_name,
-            "configuration": conf,
-            "ssh_key_pub": "ssh-rsa TEST",
-        }
-    }
+from tests.server.conftest import make_server, task_body as _body, wait_run
 
 
 async def _wait_run(fx, run_name, target_statuses, timeout=40.0):
-    deadline = asyncio.get_event_loop().time() + timeout
-    while True:
-        resp = await fx.client.post(
-            "/api/project/main/runs/get", json_body={"run_name": run_name}
-        )
-        run = response_json(resp)
-        if run["status"] in target_statuses:
-            return run
-        assert asyncio.get_event_loop().time() < deadline, run["status"]
-        await asyncio.sleep(0.2)
+    return await wait_run(fx, run_name, target_statuses, timeout=timeout)
 
 
 async def test_retry_on_error_resubmits_until_success(tmp_path, monkeypatch):
