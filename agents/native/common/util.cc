@@ -82,11 +82,19 @@ bool write_file(const std::string& path, const std::string& content) {
   return static_cast<bool>(f);
 }
 
-int run_command(const std::vector<std::string>& argv, std::string* output,
-                int timeout_seconds) {
+namespace {
+
+// Shared spawn/capture state machine: fork+exec argv, deliver combined
+// stdout+stderr to on_chunk as it arrives, enforce the timeout. O_CLOEXEC
+// on the pipe keeps children forked concurrently by other threads (the
+// shim launches tasks in detached threads) from inheriting the write end
+// and defeating EOF detection.
+int run_command_impl(const std::vector<std::string>& argv,
+                     const std::function<void(const char*, size_t)>& on_chunk,
+                     int timeout_seconds) {
   if (argv.empty()) return -1;
   int pipefd[2];
-  if (pipe(pipefd) != 0) return -1;
+  if (pipe2(pipefd, O_CLOEXEC) != 0) return -1;
   pid_t pid = fork();
   if (pid < 0) {
     close(pipefd[0]);
@@ -94,10 +102,8 @@ int run_command(const std::vector<std::string>& argv, std::string* output,
     return -1;
   }
   if (pid == 0) {
-    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDOUT_FILENO);  // dup2 clears O_CLOEXEC on the copy
     dup2(pipefd[1], STDERR_FILENO);
-    close(pipefd[0]);
-    close(pipefd[1]);
     std::vector<char*> args;
     for (const auto& a : argv) args.push_back(const_cast<char*>(a.c_str()));
     args.push_back(nullptr);
@@ -105,7 +111,6 @@ int run_command(const std::vector<std::string>& argv, std::string* output,
     _exit(127);
   }
   close(pipefd[1]);
-  std::string out;
   char buf[4096];
   int64_t deadline = timeout_seconds > 0 ? now_ms() + timeout_seconds * 1000 : 0;
   bool timed_out = false;
@@ -120,7 +125,7 @@ int run_command(const std::vector<std::string>& argv, std::string* output,
       if (pr < 0) continue;
     }
     ssize_t n = read(pipefd[0], buf, sizeof(buf));
-    if (n > 0) out.append(buf, n);
+    if (n > 0) on_chunk(buf, static_cast<size_t>(n));
     else if (n == 0) break;
     else if (errno != EINTR) break;
   }
@@ -128,11 +133,43 @@ int run_command(const std::vector<std::string>& argv, std::string* output,
   if (timed_out) kill(pid, SIGKILL);
   int status = 0;
   waitpid(pid, &status, 0);
-  if (output) *output = std::move(out);
   if (timed_out) return -2;
   if (WIFEXITED(status)) return WEXITSTATUS(status);
   if (WIFSIGNALED(status)) return -WTERMSIG(status);
   return -1;
+}
+
+}  // namespace
+
+int run_command(const std::vector<std::string>& argv, std::string* output,
+                int timeout_seconds) {
+  std::string out;
+  int rc = run_command_impl(
+      argv, [&](const char* data, size_t n) { out.append(data, n); },
+      timeout_seconds);
+  if (output) *output = std::move(out);
+  return rc;
+}
+
+int run_command_lines(const std::vector<std::string>& argv,
+                      const std::function<void(const std::string&)>& on_line,
+                      int timeout_seconds) {
+  std::string pending;
+  int rc = run_command_impl(
+      argv,
+      [&](const char* data, size_t n) {
+        pending.append(data, n);
+        size_t pos;
+        while ((pos = pending.find('\n')) != std::string::npos) {
+          std::string line = pending.substr(0, pos);
+          pending.erase(0, pos + 1);
+          if (!line.empty() && line.back() == '\r') line.pop_back();
+          if (on_line) on_line(line);
+        }
+      },
+      timeout_seconds);
+  if (!pending.empty() && on_line) on_line(pending);
+  return rc;
 }
 
 bool mkdir_p(const std::string& path, int mode) {

@@ -2,6 +2,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <optional>
 #include <string>
 #include <vector>
@@ -24,6 +25,12 @@ bool write_file(const std::string& path, const std::string& content);
 // failure). No shell involved.
 int run_command(const std::vector<std::string>& argv, std::string* output,
                 int timeout_seconds = 0);
+
+// Like run_command, but delivers output line by line as it arrives —
+// used to surface progress from long commands (docker pull) while they run.
+int run_command_lines(const std::vector<std::string>& argv,
+                      const std::function<void(const std::string&)>& on_line,
+                      int timeout_seconds = 0);
 
 // mkdir -p: creates every missing component. Returns false if any component
 // cannot be created (exists-as-file, read-only fs, permissions).

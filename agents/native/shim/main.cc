@@ -68,6 +68,17 @@ class TaskStore {
       std::unique_lock<std::mutex> l(mu_);
       TaskState copy = tasks_[id];
       l.unlock();
+      // Live progress: launch works on a copy, so in-flight status and
+      // pull-progress lines are published back into the stored task
+      // (unless the task was terminated underneath the launch).
+      copy.on_progress = [this, id](const TaskState& t) {
+        std::lock_guard<std::mutex> pl(mu_);
+        auto pit = tasks_.find(id);
+        if (pit != tasks_.end() && pit->second.status != "terminated") {
+          pit->second.status = t.status;
+          pit->second.status_message = t.status_message;
+        }
+      };
       runtime_->launch(copy);
       l.lock();
       auto it = tasks_.find(id);

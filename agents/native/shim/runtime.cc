@@ -39,16 +39,29 @@ class DockerRuntime : public Runtime {
 
     if (!spec.image_name.empty()) {
       task.status = "pulling";
-      std::string out;
-      int rc = run_command({"docker", "pull", spec.image_name}, &out,
-                           kPullTimeoutSeconds);
+      task.publish();
+      // Stream pull output so the task API shows live layer progress
+      // instead of a silent multi-minute "pulling".
+      std::string tail;
+      int rc = run_command_lines(
+          {"docker", "pull", spec.image_name},
+          [&](const std::string& line) {
+            if (line.empty()) return;
+            task.status_message = line;
+            tail += line + "\n";
+            if (tail.size() > 4096) tail.erase(0, tail.size() - 4096);
+            task.publish();
+          },
+          kPullTimeoutSeconds);
       if (rc != 0) {
-        fail(task, "creating_container_error", "docker pull failed: " + out);
+        fail(task, "creating_container_error", "docker pull failed: " + tail);
         return;
       }
+      task.status_message.clear();
     }
 
     task.status = "creating";
+    task.publish();
     task.container_name = "dstack-" + spec.id;
     std::vector<std::string> cmd = {
         "docker", "create", "--name", task.container_name,

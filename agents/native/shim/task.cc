@@ -30,6 +30,7 @@ Json TaskState::to_json() const {
   Json j = Json::object();
   j.set("id", spec.id);
   j.set("status", status);
+  j.set("status_message", status_message.empty() ? Json() : Json(status_message));
   j.set("termination_reason",
         termination_reason.empty() ? Json() : Json(termination_reason));
   j.set("termination_message",

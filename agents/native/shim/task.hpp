@@ -3,6 +3,7 @@
 // v2 task API (shim/api/server.go).
 #pragma once
 
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -40,12 +41,23 @@ struct TaskSpec {
 struct TaskState {
   TaskSpec spec;
   std::string status = "pending";
+  // Live progress for long phases (image pull lines), surfaced through the
+  // task API while `launch` is still running (parity: pull progress,
+  // shim/docker.go:648-742).
+  std::string status_message;
   std::string termination_reason;
   std::string termination_message;
   std::string container_name;
   int runner_port = 10999;
   pid_t process_pid = -1;      // process runtime only
   std::vector<int> tpu_chips_held;  // /dev/accel* indices granted by ChipAllocator
+  // Set by the task store: publishes status/status_message of the launch
+  // thread's working copy into the stored task. Not serialized.
+  std::function<void(const TaskState&)> on_progress;
+
+  void publish() const {
+    if (on_progress) on_progress(*this);
+  }
 
   Json to_json() const;
 };

@@ -129,6 +129,8 @@ class TaskSubmitRequest(CoreModel):
 class TaskInfo(CoreModel):
     id: str
     status: TaskStatus
+    # Live progress for long phases (image pull lines) — see shim task API.
+    status_message: Optional[str] = None
     termination_reason: Optional[str] = None
     termination_message: Optional[str] = None
     ports: List[PortMappingOut] = []

@@ -256,6 +256,7 @@ async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
         )
         return
     if task.status != TaskStatus.RUNNING:
+        await _record_pull_progress(ctx, row, task)
         return
     replica = await _replica_rows(ctx, row)
     replica_jpds = [j for j in (_jpd(s) for s in replica) if j is not None]
@@ -264,6 +265,7 @@ async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
     job_spec = JobSpec.model_validate_json(row["job_spec"])
     cluster_info = _build_cluster_info(job_spec, replica_jpds)
     secrets = await _get_secrets(ctx, row["project_id"])
+    ctx.overrides.get("_pull_progress_seen", {}).pop(row["id"], None)
     await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets)
 
 
@@ -454,9 +456,42 @@ async def _handle_disconnect(ctx: ServerContext, row: sqlite3.Row) -> None:
         )
 
 
+async def _record_pull_progress(ctx: ServerContext, row: sqlite3.Row, task) -> None:
+    """Write changed shim pull-progress lines into the diagnose (runner) log
+    stream so `logs --diagnose` shows layer progress instead of a silent
+    multi-minute PULLING (parity: reference pull progress,
+    shim/docker.go:648-742)."""
+    message = getattr(task, "status_message", None)
+    if not message or ctx.log_storage is None:
+        return
+    cache = ctx.overrides.setdefault("_pull_progress_seen", {})
+    if cache.get(row["id"]) == message:
+        return
+    cache[row["id"]] = message
+    import base64
+    import time as _time
+
+    from dstack_tpu.agents.protocol import LogEventOut
+
+    await ctx.log_storage.write(
+        project_id=row["project_id"],
+        run_name=row["run_name"],
+        job_submission_id=row["id"],
+        job_logs=[],
+        runner_logs=[
+            LogEventOut(
+                timestamp=int(_time.time() * 1000),
+                source="runner",
+                message=base64.b64encode((message + "\n").encode()).decode(),
+            )
+        ],
+    )
+
+
 async def _fail(
     ctx: ServerContext, row: sqlite3.Row, reason: JobTerminationReason, message: str
 ) -> None:
+    ctx.overrides.get("_pull_progress_seen", {}).pop(row["id"], None)
     await ctx.db.execute(
         "UPDATE jobs SET status = ?, termination_reason = ?,"
         " termination_reason_message = ?, finished_at = ? WHERE id = ?",

@@ -71,12 +71,13 @@ def main() -> None:
             loss = float(metrics["loss"])
             if jax.process_index() == 0:
                 print(f"step {i}: loss {loss:.4f}")
-        if args.checkpoint_dir and i and i % 100 == 0 and jax.process_index() == 0:
+        ckpt_due = (i + 1) % 100 == 0 or i == args.steps - 1
+        if args.checkpoint_dir and ckpt_due and jax.process_index() == 0:
             # Durable state goes on the mounted volume (see
             # ../v5p-256-volume.yml); orbax/your-own-format both work.
             os.makedirs(args.checkpoint_dir, exist_ok=True)
             with open(os.path.join(args.checkpoint_dir, "LAST_STEP"), "w") as f:
-                f.write(str(i))
+                f.write(str(i + 1))
     print("training complete")
 
 

@@ -15,8 +15,8 @@ import asyncio
 import pytest
 
 from dstack_tpu.server.app import create_app
-from dstack_tpu.server.http import TestClient, response_json
-from tests.server.conftest import ServerFixture
+from dstack_tpu.server.http import TestClient
+from tests.server.conftest import ServerFixture, task_body as _task_body, wait_run as _wait_run
 
 
 async def _make_replica(db_path, run_background_tasks=True) -> ServerFixture:
@@ -29,35 +29,6 @@ async def _make_replica(db_path, run_background_tasks=True) -> ServerFixture:
     fx = ServerFixture(app)
     fx.client.token = fx.admin_token
     return fx
-
-
-def _task_body(commands, run_name):
-    return {
-        "run_spec": {
-            "run_name": run_name,
-            "configuration": {
-                "type": "task",
-                "commands": commands,
-                "resources": {"cpu": "1..", "memory": "0.1.."},
-            },
-            "ssh_key_pub": "ssh-rsa TEST",
-        }
-    }
-
-
-async def _wait_run(fx, run_name, target_statuses, timeout=30.0):
-    deadline = asyncio.get_event_loop().time() + timeout
-    while True:
-        resp = await fx.client.post(
-            "/api/project/main/runs/get", json_body={"run_name": run_name}
-        )
-        assert resp.status == 200, resp.body
-        run = response_json(resp)
-        if run["status"] in target_statuses:
-            return run
-        if asyncio.get_event_loop().time() > deadline:
-            raise AssertionError(f"run stuck in {run['status']}")
-        await asyncio.sleep(0.2)
 
 
 async def test_claims_exclusive_across_replicas(tmp_path):

@@ -7,14 +7,8 @@ terminates and resubmits the whole replica, not just the master. All tests
 run real jobs through the local backend.
 """
 
-import asyncio
-
 from dstack_tpu.server import settings
-from tests.server.conftest import make_server, task_body as _body, wait_run
-
-
-async def _wait_run(fx, run_name, target_statuses, timeout=40.0):
-    return await wait_run(fx, run_name, target_statuses, timeout=timeout)
+from tests.server.conftest import make_server, task_body as _body, wait_run as _wait_run
 
 
 async def test_retry_on_error_resubmits_until_success(tmp_path, monkeypatch):

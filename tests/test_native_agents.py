@@ -658,3 +658,62 @@ class TestLogsWebsocket:
 
         with pytest.raises(WsError):
             WsClient(f"http://127.0.0.1:{runner}/no_such_ws").connect()
+
+
+class TestShimDockerPullProgress:
+    """Docker runtime against a fake `docker` on PATH: live pull progress
+    must surface through the task API's status_message while the pull runs
+    (parity: reference pull progress, shim/docker.go:648-742)."""
+
+    @pytest.fixture
+    def shim_fake_docker(self, binaries, tmp_path):
+        fake = tmp_path / "docker"
+        fake.write_text(
+            "#!/bin/sh\n"
+            'case "$1" in\n'
+            "  ps) exit 0 ;;\n"  # restore_from_docker scan: no containers
+            "  pull)\n"
+            '    echo "layer1: Pulling fs layer"; sleep 0.4\n'
+            '    echo "layer1: Downloading [==>   ] 10MB/50MB"; sleep 0.4\n'
+            '    echo "layer1: Pull complete"; sleep 0.2\n'
+            "    exit 0 ;;\n"
+            "  create) echo cid123; exit 0 ;;\n"
+            "  start) exit 0 ;;\n"
+            '  inspect) echo "true 0"; exit 0 ;;\n'
+            "  kill|stop|rm) exit 0 ;;\n"
+            "esac\n"
+            "exit 0\n"
+        )
+        fake.chmod(0o755)
+        import os
+
+        proc, port = _start(
+            [binaries["shim"], "--host", "127.0.0.1", "--port", 0,
+             "--runtime", "docker", "--runner-binary", binaries["runner"]],
+            env={"PATH": f"{tmp_path}:{os.environ['PATH']}"},
+        )
+        yield port
+        proc.kill()
+        proc.wait()
+
+    def test_pull_progress_surfaces_in_status_message(self, shim_fake_docker):
+        base = f"http://127.0.0.1:{shim_fake_docker}/api"
+        _req("POST", f"{base}/tasks",
+             {"id": "pp-1", "name": "pp", "image_name": "example/image:1"})
+        messages = set()
+        status = None
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            task = _req("GET", f"{base}/tasks/pp-1")
+            status = task["status"]
+            if status == "pulling" and task.get("status_message"):
+                messages.add(task["status_message"])
+            if status in ("running", "terminated"):
+                break
+            time.sleep(0.05)
+        assert status == "running", (status, task)
+        # At least one live progress line was visible mid-pull, and the
+        # message clears once the pull finishes.
+        assert any("layer1" in m for m in messages), messages
+        final = _req("GET", f"{base}/tasks/pp-1")
+        assert not final.get("status_message")
