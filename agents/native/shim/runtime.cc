@@ -225,6 +225,7 @@ class DockerRuntime : public Runtime {
 
   void fail(TaskState& task, const std::string& reason, const std::string& msg) {
     task.status = "terminated";
+    task.status_message.clear();  // a stale mid-pull progress line is not state
     task.termination_reason = reason;
     task.termination_message = msg;
     release_chips(task);  // post-acquire failures must not strand the grant

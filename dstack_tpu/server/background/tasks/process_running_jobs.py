@@ -265,7 +265,7 @@ async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
     job_spec = JobSpec.model_validate_json(row["job_spec"])
     cluster_info = _build_cluster_info(job_spec, replica_jpds)
     secrets = await _get_secrets(ctx, row["project_id"])
-    ctx.overrides.get("_pull_progress_seen", {}).pop(row["id"], None)
+    ctx.pull_progress_seen.pop(row["id"], None)
     await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets)
 
 
@@ -464,9 +464,11 @@ async def _record_pull_progress(ctx: ServerContext, row: sqlite3.Row, task) -> N
     message = getattr(task, "status_message", None)
     if not message or ctx.log_storage is None:
         return
-    cache = ctx.overrides.setdefault("_pull_progress_seen", {})
+    cache = ctx.pull_progress_seen
     if cache.get(row["id"]) == message:
         return
+    while len(cache) > 512:  # bound regardless of job lifecycle path
+        cache.pop(next(iter(cache)))
     cache[row["id"]] = message
     import base64
     import time as _time
@@ -491,7 +493,7 @@ async def _record_pull_progress(ctx: ServerContext, row: sqlite3.Row, task) -> N
 async def _fail(
     ctx: ServerContext, row: sqlite3.Row, reason: JobTerminationReason, message: str
 ) -> None:
-    ctx.overrides.get("_pull_progress_seen", {}).pop(row["id"], None)
+    ctx.pull_progress_seen.pop(row["id"], None)
     await ctx.db.execute(
         "UPDATE jobs SET status = ?, termination_reason = ?,"
         " termination_reason_message = ?, finished_at = ? WHERE id = ?",

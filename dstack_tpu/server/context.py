@@ -47,6 +47,9 @@ class ServerContext:
         self.stopping = False
         # Test hooks: services look up optional fakes here.
         self.overrides: Dict[str, Any] = {}
+        # Last relayed shim pull-progress line per job id, bounded: entries
+        # for jobs that never hit a cleanup path must not accumulate.
+        self.pull_progress_seen: Dict[str, str] = {}
 
     def signal(self, channel: str) -> asyncio.Event:
         if channel not in self._signals:

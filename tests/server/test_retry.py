@@ -29,7 +29,7 @@ async def test_retry_on_error_resubmits_until_success(tmp_path, monkeypatch):
             ),
         )
         assert resp.status == 200, resp.body
-        run = await _wait_run(fx, "retry-run", {"done", "failed", "terminated"})
+        run = await _wait_run(fx, "retry-run", {"done", "failed", "terminated"}, timeout=40.0)
         assert run["status"] == "done", run
         subs = run["jobs"][0]["job_submissions"]
         assert len(subs) == 2
@@ -53,7 +53,7 @@ async def test_error_not_covered_by_retry_events_fails(monkeypatch):
             ),
         )
         assert resp.status == 200, resp.body
-        run = await _wait_run(fx, "uncovered-run", {"done", "failed", "terminated"})
+        run = await _wait_run(fx, "uncovered-run", {"done", "failed", "terminated"}, timeout=40.0)
         assert run["status"] == "failed"
         assert len(run["jobs"][0]["job_submissions"]) == 1
     finally:
@@ -74,7 +74,7 @@ async def test_retry_duration_budget_exceeded(monkeypatch):
             ),
         )
         assert resp.status == 200, resp.body
-        run = await _wait_run(fx, "budget-run", {"done", "failed", "terminated"})
+        run = await _wait_run(fx, "budget-run", {"done", "failed", "terminated"}, timeout=40.0)
         assert run["status"] in ("failed", "terminated")
         assert run["termination_reason"] == "retry_limit_exceeded"
     finally:
