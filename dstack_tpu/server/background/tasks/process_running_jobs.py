@@ -467,6 +467,9 @@ async def _record_pull_progress(ctx: ServerContext, row: sqlite3.Row, task) -> N
     cache = ctx.pull_progress_seen
     if cache.get(row["id"]) == message:
         return
+    # LRU order: re-insert on update so eviction hits genuinely stale
+    # entries, not the longest-running active pull.
+    cache.pop(row["id"], None)
     while len(cache) > 512:  # bound regardless of job lifecycle path
         cache.pop(next(iter(cache)))
     cache[row["id"]] = message

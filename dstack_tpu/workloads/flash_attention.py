@@ -57,7 +57,9 @@ NEG_INF = -1e30
 # tiles, f32 accumulators and double-buffering fit alongside. The check
 # scales with head_dim and element size — a seq-only cap would admit
 # f32/hd-256 shapes that blow VMEM and crash at compile instead of falling
-# back.
+# back. Empirically verified on v5e: every admitted bf16/hd-128 shape up to
+# the budget boundary (seq 16384, KV exactly 8MB) compiles and runs with
+# the 1024-wide block maxima.
 KV_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 
