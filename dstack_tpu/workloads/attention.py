@@ -7,7 +7,9 @@ a ring — each step computes one block of the streaming-softmax accumulation
 while `jax.lax.ppermute` rotates the k/v shard one hop around the ICI ring,
 overlapping compute with neighbor-to-neighbor transfer (the RDMA pattern in
 pallas_guide "Patterns: Ring Collectives", expressed with XLA collectives so
-the compiler schedules the overlap).
+the compiler schedules the overlap). On TPU each ring step's block runs the
+fused Pallas kernel (flash_attention.flash_block_attend) so the
+(shard, shard) logits never land in HBM; CPU/odd shapes keep the jnp path.
 
 All matmuls accumulate in f32 (`preferred_element_type`) regardless of the
 bf16 storage dtype.
@@ -88,6 +90,26 @@ def _block_attend(q, k, v, mask):
     return o, m_safe, l
 
 
+def _ring_block_impl(sq: int, sk: int, hd: int, dtype) -> Optional[bool]:
+    """Whether ring steps use the fused Pallas block kernel: None -> jnp
+    path; otherwise the kernel's `interpret` flag. Forced modes via
+    DSTACK_TPU_FLASH_RING: "0" disables, "interpret" runs the kernel in
+    interpret mode (CPU tests)."""
+    import os
+
+    forced = os.getenv("DSTACK_TPU_FLASH_RING", "auto")
+    if forced == "0":
+        return None
+    if sq != sk:
+        return None
+    from dstack_tpu.workloads.flash_attention import use_flash
+
+    interpret = forced == "interpret"
+    if not use_flash(sk, hd, dtype_bytes=dtype.itemsize, interpret=interpret):
+        return None
+    return interpret
+
+
 def _ring_attention_local(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -102,15 +124,41 @@ def _ring_attention_local(
     n_rep = q.shape[2] // k.shape[2]
     b, sq, h, hd = q.shape
     sk = k.shape[1]
+    flash_impl = _ring_block_impl(sq, sk, hd, q.dtype)
 
     # Block-level causal masks, selected per ring step by traced scalars:
     # kv block strictly after my queries -> fully masked; same block ->
     # lower-triangular; earlier block -> full attend. (Fully-masked rows
-    # come out as l=0/o=0 via the NEG_INF guard in _block_attend.)
-    tril = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-    full = jnp.ones((sq, sk), dtype=bool)
-    empty = jnp.zeros((sq, sk), dtype=bool)
+    # come out as l=0/o=0 via the NEG_INF guard in _block_attend.) Only the
+    # jnp path consumes mask ARRAYS — the flash path selects a static mask
+    # mode per lax.switch branch instead.
+    if flash_impl is None and causal:
+        tril = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        full = jnp.ones((sq, sk), dtype=bool)
+        empty = jnp.zeros((sq, sk), dtype=bool)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _flash_step(q_, k_, v_, kv_idx):
+        """Fused per-step partials: branch on the traced ring position so
+        each branch gets a STATIC mask mode for the kernel (diagonal ->
+        causal tril, earlier shard -> full attend, later -> nothing)."""
+        from dstack_tpu.workloads.flash_attention import flash_block_attend
+
+        def _empty(q_, k_, v_):
+            return (
+                jnp.zeros((b, sq, h, hd), jnp.float32),
+                jnp.full((b, h, sq), NEG_INF / 2, jnp.float32),
+                jnp.zeros((b, h, sq), jnp.float32),
+            )
+
+        def _tril(q_, k_, v_):
+            return flash_block_attend(q_, k_, v_, causal=True, interpret=flash_impl)
+
+        def _full(q_, k_, v_):
+            return flash_block_attend(q_, k_, v_, causal=False, interpret=flash_impl)
+
+        branch = jnp.where(kv_idx > my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2))
+        return lax.switch(branch, [_empty, _tril, _full], q_, k_, v_)
 
     def step(carry, t):
         o, m, l, k_t, v_t = carry
@@ -118,14 +166,23 @@ def _ring_attention_local(
         # compute so each ppermute hop moves 1/n_rep of the bytes.
         k_exp = _repeat_kv(k_t, n_rep)
         v_exp = _repeat_kv(v_t, n_rep)
-        if causal:
-            kv_idx = (my_idx - t) % n  # whose shard we hold at ring step t
-            mask = jnp.where(
-                kv_idx > my_idx, empty, jnp.where(kv_idx == my_idx, tril, full)
+        kv_idx = (my_idx - t) % n  # whose shard we hold at ring step t
+        if flash_impl is not None and causal:
+            blk_o, blk_m, blk_l = _flash_step(q, k_exp, v_exp, kv_idx)
+        elif flash_impl is not None:
+            from dstack_tpu.workloads.flash_attention import flash_block_attend
+
+            blk_o, blk_m, blk_l = flash_block_attend(
+                q, k_exp, v_exp, causal=False, interpret=flash_impl
             )
         else:
-            mask = None
-        blk_o, blk_m, blk_l = _block_attend(q, k_exp, v_exp, mask)
+            if causal:
+                mask = jnp.where(
+                    kv_idx > my_idx, empty, jnp.where(kv_idx == my_idx, tril, full)
+                )
+            else:
+                mask = None
+            blk_o, blk_m, blk_l = _block_attend(q, k_exp, v_exp, mask)
         # Streaming-softmax merge of (o,m,l) with the new block.
         m_new = jnp.maximum(m, blk_m)
         alpha = jnp.exp(m - m_new)  # rescale old accumulation

@@ -1,11 +1,13 @@
 """Flash attention: fused Pallas TPU kernels for the single-device hot path.
 
-The streaming-softmax math is the same as `attention._block_attend` (and the
-ring path reuses that for cross-device blocks); here the blocking happens
-*inside* one chip's VMEM instead of across devices: the (S, S) probability
-matrix is never materialized in HBM, in forward or backward — q/k/v tiles
-stream HBM→VMEM, logits/probabilities live only in registers/VMEM
-(pallas_guide: Memory Spaces, Tiling Constraints, Patterns: Custom VJP).
+The streaming-softmax math is the same as `attention._block_attend`; here
+the blocking happens *inside* one chip's VMEM instead of across devices:
+the (S, S) probability matrix is never materialized in HBM, in forward or
+backward — q/k/v tiles stream HBM→VMEM, logits/probabilities live only in
+registers/VMEM (pallas_guide: Memory Spaces, Tiling Constraints, Patterns:
+Custom VJP). The ring path composes with these kernels too: each ring
+step's per-shard block runs `flash_block_attend` on TPU (see the ring
+section at the bottom).
 
 This is a capability the reference cannot have: dstack is an orchestrator
 with no compute kernels at all (SURVEY §2.7) — the TPU-native framework
@@ -92,7 +94,10 @@ def _pick_block(seq: int, max_blk: int) -> int:
 # ---- forward ---------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool, blk_k: int):
+def _streaming_attend(q_ref, k_ref, v_ref, *, causal: bool, blk_k: int):
+    """Shared streaming-softmax body: returns unnormalized (o, m, l) for
+    this grid tile's queries against the whole K/V in VMEM. Epilogues
+    differ per kernel (normalize+lse vs raw ring partials)."""
     blk_q, hd = q_ref.shape[1], q_ref.shape[2]
     seq = k_ref.shape[1]
     iq = pl.program_id(1)
@@ -135,7 +140,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool, blk_k: int
     o0 = jnp.zeros((blk_q, hd), jnp.float32)
     m0 = jnp.full((blk_q, 1), NEG_INF / 2, jnp.float32)
     l0 = jnp.zeros((blk_q, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
+    return jax.lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool, blk_k: int):
+    o, m, l = _streaming_attend(q_ref, k_ref, v_ref, causal=causal, blk_k=blk_k)
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (o / l).astype(o_ref.dtype)
     lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
@@ -353,3 +362,107 @@ def flash_attention(
 
     o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, interpret)
     return o.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
+
+
+# ---- ring-step block attend ------------------------------------------------
+# The ring path (attention._ring_attention_local) consumes per-step partial
+# results (unnormalized o, running max m, sum l) and merges them across ring
+# hops. This kernel computes one step's partials WITHOUT materializing the
+# (Sq_shard, Sk_shard) logits in HBM — at 32k context over 4 devices that
+# matrix is 256MB f32 per step per head batch, the long-context memory wall.
+# Backward recomputes through the jnp reference (same math, XLA-fused), so
+# gradients stay exact while the forward gets the fused kernel. Known
+# limitation: that recompute re-materializes the per-step logits in the
+# BACKWARD pass, so training at extreme context keeps the old memory
+# profile there (inference/serving gets the full win). A blockwise ring
+# backward needs cotangents w.r.t. the (o, m, l) partials — a different
+# derivation than _bwd_dq/_bwd_dkv's normalized-output form.
+
+
+def _block_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, causal, blk_k):
+    o, m, l = _streaming_attend(q_ref, k_ref, v_ref, causal=causal, blk_k=blk_k)
+    o_ref[0] = o  # unnormalized, relative to m — the ring merge normalizes
+    m_ref[0, 0] = m[:, 0]
+    l_ref[0, 0] = l[:, 0]
+
+
+def _block_ref_bh(q, k, v, causal: bool):
+    """jnp reference of the kernel in (BH, S, hd) layout — the backward
+    path AND the numerics oracle (same math as attention._block_attend)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None], logits, NEG_INF)
+    m = jnp.maximum(jnp.max(logits, axis=-1), NEG_INF / 2)  # (BH, Sq)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_block(q, k, v, causal: bool, interpret: bool):
+    bh, sq, hd = q.shape
+    seq_k = k.shape[1]
+    blk_q = _pick_block(sq, BLK_Q)
+    blk_k = _pick_block(seq_k, BLK_K)
+    o, m, l = pl.pallas_call(
+        functools.partial(_block_fwd_kernel, causal=causal, blk_k=blk_k),
+        grid=(bh, sq // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, hd), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, blk_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, blk_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, hd), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, m[:, 0, :], l[:, 0, :]
+
+
+def _ring_block_fwd(q, k, v, causal, interpret):
+    out = _ring_block(q, k, v, causal, interpret)
+    return out, (q, k, v)
+
+
+def _ring_block_bwd(causal, interpret, residuals, cotangents):
+    q, k, v = residuals
+    # Exact gradients by recompute through the fused-by-XLA reference; the
+    # (m, l) cotangents from the ring merge flow through automatically.
+    _, vjp = jax.vjp(lambda q, k, v: _block_ref_bh(q, k, v, causal), q, k, v)
+    return vjp(cotangents)
+
+
+_ring_block.defvjp(_ring_block_fwd, _ring_block_bwd)
+
+
+def flash_block_attend(q, k, v, *, causal: bool, interpret: bool = False):
+    """One ring step's partials — drop-in for attention._block_attend with a
+    static tril/full mask. q/k/v: (B, S, H, hd) with kv already
+    GQA-expanded; returns (o (B,S,H,hd) f32 unnormalized, m (B,H,S),
+    l (B,H,S))."""
+    b, sq, h, hd = q.shape
+    sk = k.shape[1]
+    # The kernel's causal mask is the absolute row>=col diagonal, which
+    # equals the ring's shifted-tril only for equal shards.
+    assert not causal or sq == sk, (sq, sk)
+
+    def to_bh(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
+
+    o, m, l = _ring_block(to_bh(q, sq), to_bh(k, sk), to_bh(v, sk), causal, interpret)
+    o = o.reshape(b, h, sq, hd).transpose(0, 2, 1, 3)
+    return o, m.reshape(b, h, sq), l.reshape(b, h, sq)
