@@ -16,6 +16,7 @@ import os
 
 import jax
 
+from dstack_tpu.workloads import checkpoint as ckpt
 from dstack_tpu.workloads.config import PRESETS
 from dstack_tpu.workloads.sharding import make_mesh
 from dstack_tpu.workloads.train import (
@@ -56,6 +57,14 @@ def main() -> None:
         jax.devices(), model=args.model_parallel, seq=args.seq_parallel
     )
     state = init_train_state(config, jax.random.PRNGKey(0), mesh=mesh)
+    if args.checkpoint_dir:
+        # Resume from the mounted volume: a retried gang continues at the
+        # last saved step instead of step 0 (dstack_tpu.workloads.checkpoint).
+        restored = ckpt.restore_latest(args.checkpoint_dir, state)
+        if restored is not None:
+            state = restored
+            if jax.process_index() == 0:
+                print(f"resumed from step {int(state.step)}")
     step = make_train_step(config, mesh)
     # The global batch shards over the data+fsdp axes; round up so every
     # device gets at least one row.
@@ -65,19 +74,18 @@ def main() -> None:
         print(f"batch size {args.batch_size} -> {batch_size} (divisible by {dp})")
     batch = synthetic_batch(config, batch_size, args.seq_len, mesh=mesh)
 
-    for i in range(args.steps):
+    start = int(state.step)  # nonzero after a resume
+    for i in range(start, args.steps):
         state, metrics = step(state, batch)
         if i % 10 == 0 or i == args.steps - 1:
             loss = float(metrics["loss"])
             if jax.process_index() == 0:
                 print(f"step {i}: loss {loss:.4f}")
         ckpt_due = (i + 1) % 100 == 0 or i == args.steps - 1
-        if args.checkpoint_dir and ckpt_due and jax.process_index() == 0:
-            # Durable state goes on the mounted volume (see
-            # ../v5p-256-volume.yml); orbax/your-own-format both work.
-            os.makedirs(args.checkpoint_dir, exist_ok=True)
-            with open(os.path.join(args.checkpoint_dir, "LAST_STEP"), "w") as f:
-                f.write(str(i + 1))
+        if args.checkpoint_dir and ckpt_due:
+            # Every process participates (Orbax coordinates global arrays);
+            # block on the final step so the job ends durable.
+            ckpt.save(args.checkpoint_dir, state, wait=i == args.steps - 1)
     print("training complete")
 
 

@@ -49,6 +49,33 @@ def test_tpu_examples_resolve_topologies():
             assert topo.chips >= 1, (path, tpu)
 
 
+def test_train_script_resumes_from_checkpoint(tmp_path):
+    """Kill-and-retry semantics: the second invocation resumes at the saved
+    step instead of restarting (SURVEY §5 checkpoint/resume via volumes)."""
+    import os
+
+    env = {**os.environ, "PYTHONPATH": str(EXAMPLES.parent), "JAX_PLATFORMS": "cpu"}
+    args = [
+        sys.executable,
+        str(EXAMPLES / "fine-tuning" / "jax" / "train.py"),
+        "--preset", "tiny", "--batch-size", "2", "--seq-len", "64",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]
+    first = subprocess.run(
+        args + ["--steps", "2"], capture_output=True, text=True, timeout=300,
+        cwd=str(EXAMPLES.parent), env=env,
+    )
+    assert first.returncode == 0, first.stderr[-2000:]
+    second = subprocess.run(
+        args + ["--steps", "4"], capture_output=True, text=True, timeout=300,
+        cwd=str(EXAMPLES.parent), env=env,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "resumed from step 2" in second.stdout, second.stdout
+    assert "step 3:" in second.stdout  # continued to the final step...
+    assert "step 0:" not in second.stdout  # ...without restarting at 0
+
+
 def test_train_script_runs_tiny_cpu():
     import os
 
