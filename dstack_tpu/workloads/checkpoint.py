@@ -23,7 +23,11 @@ from dstack_tpu.workloads.train import TrainState
 _managers: Dict[str, "object"] = {}
 
 
-def _get_manager(directory: Union[str, Path], max_to_keep: int = 3):
+MAX_TO_KEEP = 3  # retention is fixed per process — the manager is cached,
+# so a per-call knob would silently not apply after first use
+
+
+def _get_manager(directory: Union[str, Path]):
     import orbax.checkpoint as ocp
 
     key = str(Path(directory).absolute())
@@ -32,7 +36,7 @@ def _get_manager(directory: Union[str, Path], max_to_keep: int = 3):
         mngr = ocp.CheckpointManager(
             key,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=MAX_TO_KEEP, create=True
             ),
         )
         _managers[key] = mngr

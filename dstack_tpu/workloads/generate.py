@@ -1,0 +1,143 @@
+"""Autoregressive generation with a KV cache (the serving-side workload).
+
+The training side runs `train.make_train_step`; services (JetStream/vLLM in
+the examples) bring their own engines — this module is the framework-native
+decode path for the same llama-family checkpoints: jitted prefill + a
+`lax.scan` decode loop over a static-shape KV cache, so the whole
+generation compiles to one XLA program (no per-token Python dispatch, no
+dynamic shapes — pallas_guide/XLA semantics).
+
+Consistency contract: prefill+decode must reproduce `transformer.forward`
+logits exactly for the same tokens — pinned by tests/test_generate.py.
+"""
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dstack_tpu.workloads.attention import _repeat_kv
+from dstack_tpu.workloads.config import ModelConfig
+from dstack_tpu.workloads.transformer import mlp_block, project_qkv, rms_norm
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Static-shape per-layer cache: k/v (L, B, max_len, KV, hd)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray  # () int32 — filled positions
+
+
+def init_cache(
+    config: ModelConfig, batch: int, max_len: int, dtype=None
+) -> KVCache:
+    c = config
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    dtype = dtype or c.activation_dtype
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _cached_attention(q, ck, cv, valid_len):
+    """q (B, S, H, hd) against cache k/v (B, max_len, KV, hd); positions at
+    or beyond valid_len (zero padding) are masked out. Causality inside the
+    new tokens is handled by the caller's masking of valid_len per row."""
+    b, s, h, hd = q.shape
+    n_rep = h // ck.shape[2]
+    k = _repeat_kv(ck, n_rep)
+    v = _repeat_kv(cv, n_rep)
+    scale = hd ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    kpos = jnp.arange(ck.shape[1], dtype=jnp.int32)
+    # Row i of this chunk may attend cache positions <= valid_len[i]-1.
+    mask = kpos[None, :] < valid_len[:, None]  # (S, max_len)
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype).reshape(b, s, h * hd)
+
+
+def _forward_cached(
+    config: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run `tokens` (B, S) starting at cache.length; returns logits of the
+    LAST position (B, V) and the extended cache. Used for both prefill
+    (S = prompt len, cache empty) and decode (S = 1)."""
+    c = config
+    b, s = tokens.shape
+    start = cache.length
+    positions = start + jnp.arange(s, dtype=jnp.int32)  # (S,)
+    # Row i sees cache slots [0, start+i] — causal over old + new tokens.
+    valid_len = start + 1 + jnp.arange(s, dtype=jnp.int32)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def body(x, layer):
+        p, ck, cv = layer
+        q, k, v = project_qkv(c, x, p, positions)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, start, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, start, 0, 0))
+        attn = _cached_attention(q, ck, cv, valid_len)
+        x = x + attn @ p["wo"]
+        x = mlp_block(c, x, p)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x[:, -1].astype(jnp.float32)) @ params["lm_head"].astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=start + s)
+
+
+def generate(
+    config: ModelConfig,
+    params: Params,
+    prompt: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Greedy (or temperature-sampled) generation: prompt (B, S) int32 ->
+    (B, max_new_tokens) int32. Jit-compatible: static shapes throughout."""
+    c = config
+    b, s = prompt.shape
+    # The last generated token is never fed back, so the cache only needs
+    # room for s + max_new_tokens - 1 positions (one forward per token, no
+    # wasted trailing forward).
+    max_len = max_len or min(c.max_seq_len, s + max_new_tokens - 1)
+    assert s + max_new_tokens - 1 <= max_len, (s, max_new_tokens, max_len)
+    cache = init_cache(c, b, max_len)
+    logits, cache = _forward_cached(c, params, prompt, cache)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    keys = jax.random.split(rng, max_new_tokens)
+    first = pick(logits, keys[0]).astype(jnp.int32)  # (B,)
+
+    def step(carry, key):
+        token, cache = carry
+        logits, cache = _forward_cached(c, params, token[:, None], cache)
+        nxt = pick(logits, key).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    (_, _), rest = lax.scan(step, (first, cache), keys[1:])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)  # (B, N)

@@ -82,6 +82,26 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
+def project_qkv(c: ModelConfig, x: jnp.ndarray, p: Params, positions: jnp.ndarray):
+    """Pre-norm QKV projection with rope — shared by the training block and
+    the KV-cache decode path (generate.py) so they cannot drift."""
+    b, s, _ = x.shape
+    hd = c.head_dim
+    h = rms_norm(x, p["attn_norm"], c.norm_eps)
+    q = (h @ p["wq"]).reshape(b, s, c.n_heads, hd)
+    k = (h @ p["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = (h @ p["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    return _rope(q, positions, c.rope_theta), _rope(k, positions, c.rope_theta), v
+
+
+def mlp_block(c: ModelConfig, x: jnp.ndarray, p: Params) -> jnp.ndarray:
+    """Pre-norm SwiGLU MLP with residual — shared with generate.py."""
+    h = rms_norm(x, p["mlp_norm"], c.norm_eps)
+    gate = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    up = h @ p["w_up"]
+    return x + (gate * up) @ p["w_down"]
+
+
 def _block(
     c: ModelConfig,
     x: jnp.ndarray,
@@ -89,23 +109,11 @@ def _block(
     positions: jnp.ndarray,
     attention_fn: AttentionFn,
 ) -> jnp.ndarray:
-    b, s, d = x.shape
-    hd = c.head_dim
-
-    h = rms_norm(x, p["attn_norm"], c.norm_eps)
-    q = (h @ p["wq"]).reshape(b, s, c.n_heads, hd)
-    k = (h @ p["wk"]).reshape(b, s, c.n_kv_heads, hd)
-    v = (h @ p["wv"]).reshape(b, s, c.n_kv_heads, hd)
-    q = _rope(q, positions, c.rope_theta)
-    k = _rope(k, positions, c.rope_theta)
-    attn = attention_fn(q, k, v).reshape(b, s, c.n_heads * hd)
+    b, s, _ = x.shape
+    q, k, v = project_qkv(c, x, p, positions)
+    attn = attention_fn(q, k, v).reshape(b, s, c.n_heads * c.head_dim)
     x = x + attn @ p["wo"]
-
-    h = rms_norm(x, p["mlp_norm"], c.norm_eps)
-    gate = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    up = h @ p["w_up"]
-    x = x + (gate * up) @ p["w_down"]
-    return x
+    return mlp_block(c, x, p)
 
 
 def forward(

@@ -86,6 +86,8 @@ def main() -> None:
             # Every process participates (Orbax coordinates global arrays);
             # block on the final step so the job ends durable.
             ckpt.save(args.checkpoint_dir, state, wait=i == args.steps - 1)
+    if args.checkpoint_dir:
+        ckpt.close_all()  # drain async writers before the job exits
     print("training complete")
 
 
