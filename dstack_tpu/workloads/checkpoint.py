@@ -79,6 +79,34 @@ def restore_latest(
     return TrainState(**restored)
 
 
+def export_params(directory: Union[str, Path], state: TrainState) -> None:
+    """Write a params-only serving export (dir/export): restoring the full
+    TrainState for inference would materialize the Adam moments (~2x the
+    parameter bytes) on the serving host for nothing."""
+    import orbax.checkpoint as ocp
+
+    mngr = _get_manager(Path(directory) / "export")
+    mngr.save(int(state.step), args=ocp.args.StandardSave({"params": state.params}))
+    mngr.wait_until_finished()
+
+
+def restore_exported_params(directory: Union[str, Path], params_template):
+    """Restore the newest params-only export, or None if absent."""
+    import orbax.checkpoint as ocp
+
+    path = Path(directory) / "export"
+    if not path.exists():
+        return None
+    mngr = _get_manager(path)
+    step = mngr.latest_step()
+    if step is None:
+        return None
+    restored = mngr.restore(
+        step, args=ocp.args.StandardRestore({"params": params_template})
+    )
+    return restored["params"]
+
+
 def close_all() -> None:
     """Drain and release every cached manager (job end / tests)."""
     for mngr in _managers.values():

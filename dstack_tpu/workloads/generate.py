@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dstack_tpu.workloads.attention import _repeat_kv
+from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.transformer import mlp_block, project_qkv, rms_norm
 
@@ -60,7 +60,7 @@ def _cached_attention(q, ck, cv, valid_len):
     kpos = jnp.arange(ck.shape[1], dtype=jnp.int32)
     # Row i of this chunk may attend cache positions <= valid_len[i]-1.
     mask = kpos[None, :] < valid_len[:, None]  # (S, max_len)
-    logits = jnp.where(mask[None, None], logits, -1e30)
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum(
         "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32

@@ -1,0 +1,161 @@
+"""Framework-native model server: workloads.generate behind an
+OpenAI-compatible HTTP API.
+
+The JetStream/vLLM examples bring external engines; this one serves the
+same llama-family checkpoints with dstack-tpu's own KV-cache decode loop
+(workloads/generate.py) — the whole stack, orchestrator to tokens, is this
+repo. Endpoints: GET /v1/models, POST /v1/chat/completions (non-stream).
+
+The tokenizer here is a toy byte-level one so the example runs without
+downloading a vocab (zero-egress test environments); swap in your
+tokenizer for real checkpoints.
+"""
+
+import argparse
+import itertools
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.generate import generate
+from dstack_tpu.workloads.transformer import init_params
+
+
+class Engine:
+    # Prompt lengths are bucketed so each bucket compiles ONCE — a fresh
+    # XLA compile per novel prompt length would dominate request latency.
+    MIN_BUCKET = 32
+
+    def __init__(self, preset: str, max_new_tokens: int, checkpoint_dir: str = ""):
+        self.config = PRESETS[preset]
+        if max_new_tokens >= self.config.max_seq_len:
+            raise SystemExit(
+                f"--max-new-tokens {max_new_tokens} must be <"
+                f" max_seq_len {self.config.max_seq_len} for {preset}"
+            )
+        self.max_new_tokens = max_new_tokens
+        self._seed = itertools.count(
+            int.from_bytes(__import__("os").urandom(4), "big")
+        )
+        self._seed_lock = threading.Lock()
+        if checkpoint_dir:
+            from dstack_tpu.workloads import checkpoint as ckpt
+            from dstack_tpu.workloads.transformer import init_params as _init
+
+            template = _init(self.config, jax.random.PRNGKey(0))
+            # Prefer the params-only serving export (no optimizer moments
+            # in memory); fall back to a full train-state restore.
+            params = ckpt.restore_exported_params(checkpoint_dir, template)
+            if params is None:
+                from dstack_tpu.workloads.train import init_train_state
+
+                state_tpl = init_train_state(self.config, jax.random.PRNGKey(0))
+                restored = ckpt.restore_latest(checkpoint_dir, state_tpl)
+                params = restored.params if restored is not None else template
+            self.params = params
+        else:
+            self.params = init_params(self.config, jax.random.PRNGKey(0))
+        self._generate = jax.jit(
+            lambda p, t, key: generate(
+                self.config, p, t, max_new_tokens=max_new_tokens,
+                temperature=0.8, rng=key,
+            )
+        )
+
+    def encode(self, text: str) -> jnp.ndarray:
+        ids = [min(b, self.config.vocab_size - 1) for b in text.encode()] or [0]
+        limit = self.config.max_seq_len - self.max_new_tokens
+        ids = ids[-limit:] if limit > 0 else ids[:1]
+        # Bucket to a power of two: pad short prompts left with newline
+        # bytes, truncate the OLDEST bytes down to the bucket otherwise.
+        bucket = self.MIN_BUCKET
+        while bucket * 2 <= len(ids):
+            bucket *= 2
+        bucket = min(bucket, limit if limit > 0 else bucket)
+        if len(ids) < bucket:
+            ids = [10] * (bucket - len(ids)) + ids
+        else:
+            ids = ids[-bucket:]
+        return jnp.asarray([ids], dtype=jnp.int32)
+
+    def decode(self, ids) -> str:
+        return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
+
+    def chat(self, messages) -> str:
+        prompt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
+        )
+        tokens = self.encode(prompt + "\nassistant:")
+        with self._seed_lock:  # unique per request even within one ms
+            seed = next(self._seed) % (2**31)
+        out = self._generate(self.params, tokens, jax.random.PRNGKey(seed))
+        return self.decode(out[0])
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="smol-1b", choices=sorted(PRESETS))
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--model-name", default="dstack-tpu-native")
+    parser.add_argument("--max-new-tokens", type=int, default=64)
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="volume path with an Orbax checkpoint to serve")
+    args = parser.parse_args()
+
+    engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") == "/v1/models":
+                return self._send(200, {
+                    "object": "list",
+                    "data": [{"id": args.model_name, "object": "model",
+                              "created": 0, "owned_by": "dstack-tpu"}],
+                })
+            self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path.rstrip("/") != "/v1/chat/completions":
+                return self._send(404, {"error": "not found"})
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+                text = engine.chat(req.get("messages", []))
+            except Exception as e:  # surface engine errors as API errors
+                return self._send(500, {"error": str(e)})
+            self._send(200, {
+                "id": "chatcmpl-native",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": args.model_name,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "length",
+                }],
+                "usage": {},
+            })
+
+    server = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    print(f"native model server: {args.model_name} on :{args.port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

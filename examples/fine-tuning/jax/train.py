@@ -87,6 +87,9 @@ def main() -> None:
             # block on the final step so the job ends durable.
             ckpt.save(args.checkpoint_dir, state, wait=i == args.steps - 1)
     if args.checkpoint_dir:
+        # Params-only export for serving (deployment/native/server.py reads
+        # this without materializing optimizer moments).
+        ckpt.export_params(args.checkpoint_dir, state)
         ckpt.close_all()  # drain async writers before the job exits
     print("training complete")
 

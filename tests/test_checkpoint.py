@@ -35,6 +35,22 @@ def test_save_restore_round_trip(tmp_path):
     assert float(metrics["loss"]) > 0
 
 
+def test_params_export_round_trip(tmp_path):
+    """Serving export: params restore WITHOUT materializing optimizer state."""
+    config = PRESETS["tiny"]
+    state = init_train_state(config, jax.random.PRNGKey(0))
+    checkpoint.export_params(tmp_path / "ckpt", state)
+    template = init_train_state(config, jax.random.PRNGKey(9)).params
+    params = checkpoint.restore_exported_params(tmp_path / "ckpt", template)
+    assert params is not None
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Absent export -> None (server falls back to full-state restore).
+    assert checkpoint.restore_exported_params(tmp_path / "none", template) is None
+
+
 def test_restore_latest_empty_volume(tmp_path):
     config = PRESETS["tiny"]
     template = init_train_state(config, jax.random.PRNGKey(0))
