@@ -91,17 +91,26 @@ namespace {
 // and defeating EOF detection.
 int run_command_impl(const std::vector<std::string>& argv,
                      const std::function<void(const char*, size_t)>& on_chunk,
-                     int timeout_seconds) {
+                     int timeout_seconds,
+                     const std::string* stdin_data = nullptr) {
   if (argv.empty()) return -1;
   int pipefd[2];
   if (pipe2(pipefd, O_CLOEXEC) != 0) return -1;
-  pid_t pid = fork();
-  if (pid < 0) {
+  int infd[2] = {-1, -1};
+  if (stdin_data && pipe2(infd, O_CLOEXEC) != 0) {
     close(pipefd[0]);
     close(pipefd[1]);
     return -1;
   }
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(pipefd[0]);
+    close(pipefd[1]);
+    if (stdin_data) { close(infd[0]); close(infd[1]); }
+    return -1;
+  }
   if (pid == 0) {
+    if (stdin_data) dup2(infd[0], STDIN_FILENO);
     dup2(pipefd[1], STDOUT_FILENO);  // dup2 clears O_CLOEXEC on the copy
     dup2(pipefd[1], STDERR_FILENO);
     std::vector<char*> args;
@@ -111,6 +120,18 @@ int run_command_impl(const std::vector<std::string>& argv,
     _exit(127);
   }
   close(pipefd[1]);
+  if (stdin_data) {
+    close(infd[0]);
+    // Secrets are small; a blocking write fits the 64K pipe buffer.
+    size_t off = 0;
+    while (off < stdin_data->size()) {
+      ssize_t w = write(infd[1], stdin_data->data() + off,
+                        stdin_data->size() - off);
+      if (w > 0) off += static_cast<size_t>(w);
+      else if (errno != EINTR) break;
+    }
+    close(infd[1]);
+  }
   char buf[4096];
   int64_t deadline = timeout_seconds > 0 ? now_ms() + timeout_seconds * 1000 : 0;
   bool timed_out = false;
@@ -147,6 +168,17 @@ int run_command(const std::vector<std::string>& argv, std::string* output,
   int rc = run_command_impl(
       argv, [&](const char* data, size_t n) { out.append(data, n); },
       timeout_seconds);
+  if (output) *output = std::move(out);
+  return rc;
+}
+
+int run_command_stdin(const std::vector<std::string>& argv,
+                      const std::string& stdin_data, std::string* output,
+                      int timeout_seconds) {
+  std::string out;
+  int rc = run_command_impl(
+      argv, [&](const char* data, size_t n) { out.append(data, n); },
+      timeout_seconds, &stdin_data);
   if (output) *output = std::move(out);
   return rc;
 }

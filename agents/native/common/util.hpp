@@ -32,6 +32,12 @@ int run_command_lines(const std::vector<std::string>& argv,
                       const std::function<void(const std::string&)>& on_line,
                       int timeout_seconds = 0);
 
+// Like run_command, but feeds stdin_data to the child's stdin first — used
+// for material that must not appear in argv (docker login --password-stdin).
+int run_command_stdin(const std::vector<std::string>& argv,
+                      const std::string& stdin_data, std::string* output,
+                      int timeout_seconds = 0);
+
 // mkdir -p: creates every missing component. Returns false if any component
 // cannot be created (exists-as-file, read-only fs, permissions).
 bool mkdir_p(const std::string& path, int mode = 0755);

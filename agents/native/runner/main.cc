@@ -2,6 +2,7 @@
 // Protocol: dstack_tpu/agents/protocol.py (runner HTTP API, :10999).
 // Parity: runner/cmd/runner/main.go + runner/internal/runner/api/server.go.
 #include <getopt.h>
+#include <csignal>
 #include <unistd.h>
 
 #include <atomic>
@@ -21,6 +22,9 @@ constexpr int64_t kIdleShutdownMs = 300'000;
 constexpr int64_t kPostFinishGraceMs = 60'000;
 
 int main(int argc, char** argv) {
+  // A peer (socket or child pipe) closing early must surface as an
+  // error return, not kill the whole agent.
+  signal(SIGPIPE, SIG_IGN);
   std::string host = "127.0.0.1";
   int port = 10999;
   std::string working_root;

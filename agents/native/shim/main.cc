@@ -3,6 +3,7 @@
 // Protocol: dstack_tpu/agents/protocol.py. Parity: runner/cmd/shim/main.go
 // + runner/internal/shim/{api,docker,host}.
 #include <getopt.h>
+#include <csignal>
 #include <sys/stat.h>
 #include <sys/statvfs.h>
 #include <sys/sysinfo.h>
@@ -162,6 +163,9 @@ class TaskStore {
 }  // namespace
 
 int main(int argc, char** argv) {
+  // A peer (socket or child pipe) closing early must surface as an
+  // error return, not kill the whole agent.
+  signal(SIGPIPE, SIG_IGN);
   std::string host = "0.0.0.0";
   int port = 10998;
   std::string runtime_name = "docker";

@@ -40,6 +40,29 @@ class DockerRuntime : public Runtime {
     if (!spec.image_name.empty()) {
       task.status = "pulling";
       task.publish();
+      if (!spec.registry_username.empty() || !spec.registry_password.empty()) {
+        // `docker login` before pull for private registries; the password
+        // goes over stdin so it never appears in /proc/*/cmdline. The
+        // registry host is the first image-ref component when it looks like
+        // a hostname (has a dot or port); otherwise Docker Hub.
+        std::string registry;
+        auto slash = spec.image_name.find('/');
+        if (slash != std::string::npos) {
+          std::string head = spec.image_name.substr(0, slash);
+          if (head.find('.') != std::string::npos ||
+              head.find(':') != std::string::npos || head == "localhost")
+            registry = head;
+        }
+        std::vector<std::string> login = {"docker", "login", "--username",
+                                          spec.registry_username,
+                                          "--password-stdin"};
+        if (!registry.empty()) login.push_back(registry);
+        std::string out;
+        if (run_command_stdin(login, spec.registry_password + "\n", &out, 60) != 0) {
+          fail(task, "creating_container_error", "docker login failed: " + out);
+          return;
+        }
+      }
       // Stream pull output so the task API shows live layer progress
       // instead of a silent multi-minute "pulling".
       std::string tail;

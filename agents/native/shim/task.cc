@@ -8,6 +8,8 @@ TaskSpec TaskSpec::from_json(const Json& j) {
   s.name = j["name"].as_string();
   s.image_name = j["image_name"].as_string();
   if (j["container_user"].is_string()) s.container_user = j["container_user"].as_string();
+  s.registry_username = j["registry_username"].as_string();
+  s.registry_password = j["registry_password"].as_string();
   s.privileged = j["privileged"].as_bool(false);
   s.shm_size_bytes = j["shm_size_bytes"].as_int(0);
   if (j["network_mode"].is_string()) s.network_mode = j["network_mode"].as_string();

@@ -27,6 +27,8 @@ struct TaskSpec {
   std::string name;
   std::string image_name;
   std::optional<std::string> container_user;
+  std::string registry_username;  // private-registry pull auth (server-
+  std::string registry_password;  // interpolated ${{ secrets.* }} values)
   bool privileged = false;
   int64_t shm_size_bytes = 0;
   std::string network_mode = "host";

@@ -30,6 +30,7 @@ from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.services import volumes as volumes_service
 from dstack_tpu.server.services.connections import get_connection_pool
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+from dstack_tpu.utils.interpolator import InterpolatorError, interpolate
 
 logger = logging.getLogger(__name__)
 
@@ -198,6 +199,20 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
             except (ServerError, BackendError) as e:
                 await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
                 return
+            # `${{ secrets.* }}` in registry auth resolves against the
+            # project's secret store (reference process_running_jobs.py:388-394).
+            registry_username = registry_password = None
+            if job_spec.registry_auth is not None:
+                try:
+                    registry_username = interpolate(
+                        job_spec.registry_auth.username or "", {"secrets": secrets}
+                    )
+                    registry_password = interpolate(
+                        job_spec.registry_auth.password or "", {"secrets": secrets}
+                    )
+                except InterpolatorError as e:
+                    await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
+                    return
             await shim.submit_task(
                 TaskSubmitRequest(
                     id=row["id"],
@@ -205,6 +220,8 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
                     image_name=job_spec.image_name,
                     container_user=None,
                     privileged=job_spec.privileged,
+                    registry_username=registry_username,
+                    registry_password=registry_password,
                     shm_size_bytes=int((job_spec.requirements.resources.shm_size or 0) * (1 << 30)),
                     network_mode="host",
                     volumes=resolved_volumes,
@@ -285,6 +302,23 @@ async def _submit_to_runner(
                 await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
                             "runner did not become ready in time")
             return
+        # Resolve `${{ secrets.* }}` / `${{ dstack.* }}` in env values before
+        # the spec leaves the server — secret material is sent only to the
+        # runner of this one job, never stored back into the jobs table.
+        try:
+            ns = {
+                "secrets": secrets,
+                "dstack": {
+                    "job_num": str(job_spec.job_num),
+                    "node_rank": str(job_spec.job_num),
+                    "run_name": row["run_name"],
+                },
+            }
+            env = {k: interpolate(v, ns) for k, v in job_spec.env.items()}
+        except InterpolatorError as e:
+            await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
+            return
+        job_spec = job_spec.model_copy(update={"env": env})
         try:
             code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
         except (ServerError, BackendError) as e:

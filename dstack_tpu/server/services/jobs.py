@@ -28,7 +28,9 @@ from dstack_tpu.models.runs import (
     RunSpec,
 )
 from dstack_tpu.models.topology import TpuTopology
+from dstack_tpu.models.volumes import VolumeMountPoint
 from dstack_tpu.server.services.offers import requirements_from_profile
+from dstack_tpu.utils.interpolator import InterpolatorError, interpolate
 
 DEFAULT_MAX_DURATION_TASK = None  # off by default (parity: profiles "off")
 DEFAULT_IMAGE = "python:3.12-slim"  # base image when only `python` is set
@@ -65,7 +67,6 @@ def _shared_spec_fields(conf, run_spec: RunSpec, profile: Profile) -> dict:
         registry_auth=conf.registry_auth,
         requirements=requirements,
         retry=retry,
-        volumes=conf.volumes,
         working_dir=conf.working_dir or run_spec.working_dir,
     )
 
@@ -118,6 +119,25 @@ def _dev_env_commands(conf, run_name: str) -> List[str]:
     return commands
 
 
+def interpolate_job_volumes(volumes, job_num: int):
+    """Per-job `${{ dstack.job_num }}` / `${{ dstack.node_rank }}` in volume
+    names, so each worker of a gang can mount its own PD (parity: reference
+    jobs/configurators/base.py:234-269). Only the dstack namespace is legal
+    in volume names; anything else fails the submit fast."""
+    ns = {"dstack": {"job_num": str(job_num), "node_rank": str(job_num)}}
+    out = []
+    for mount in volumes:
+        if isinstance(mount, VolumeMountPoint):
+            try:
+                name = interpolate(mount.name, ns)
+            except InterpolatorError as e:
+                raise ServerError(str(e))
+            out.append(VolumeMountPoint(name=name, path=mount.path))
+        else:
+            out.append(mount)
+    return out
+
+
 def get_target_topology(run_spec: RunSpec) -> Optional[TpuTopology]:
     req = Requirements(resources=run_spec.configuration.resources)
     return resolve_target_topology(req)
@@ -153,6 +173,7 @@ def get_job_specs(run_spec: RunSpec, replica_num: int) -> List[JobSpec]:
                     commands=list(conf.commands),
                     tpu_slice=topo,
                     host_rank=job_num % slice_hosts,
+                    volumes=interpolate_job_volumes(conf.volumes, job_num),
                     **shared,
                 )
             )
@@ -171,6 +192,7 @@ def get_job_specs(run_spec: RunSpec, replica_num: int) -> List[JobSpec]:
                     commands=list(conf.commands),
                     tpu_slice=topo,
                     host_rank=job_num,
+                    volumes=interpolate_job_volumes(conf.volumes, job_num),
                     **shared,
                 )
             )
@@ -188,6 +210,7 @@ def get_job_specs(run_spec: RunSpec, replica_num: int) -> List[JobSpec]:
                 commands=commands,
                 tpu_slice=topo,
                 host_rank=0,
+                volumes=interpolate_job_volumes(conf.volumes, 0),
                 **shared,
             )
         ]

@@ -1,0 +1,86 @@
+"""`${{ namespace.key }}` placeholder interpolation for run configurations.
+
+Parity: reference `src/dstack/_internal/utils/interpolator.py`
+(VariablesInterpolator) — used for `${{ dstack.job_num }}` in per-job volume
+names (jobs/configurators/base.py:234-269) and `${{ secrets.* }}` in registry
+auth (process_running_jobs.py:388-394). This implementation is regex-driven
+rather than a hand-rolled scanner; semantics:
+
+- ``${{ ns.key }}``  -> looked up in ``namespaces[ns][key]``
+- ``$${{ ns.key }}`` -> literal ``${{ ns.key }}`` (escape)
+- a namespace listed in *skip* is left untouched (so later stages can
+  resolve it)
+- anything that looks like an opening ``${{`` but is not a valid
+  placeholder raises :class:`InterpolatorError`
+- a valid placeholder whose name is unknown raises (``on_missing="error"``)
+  or is left as-is (``on_missing="keep"``)
+"""
+
+import re
+from typing import Dict, Iterable, List, Mapping, Tuple
+
+__all__ = ["InterpolatorError", "interpolate", "interpolate_or_missing"]
+
+
+class InterpolatorError(ValueError):
+    pass
+
+
+_NAME = r"[A-Za-z_][A-Za-z0-9_]*"
+_PLACEHOLDER = re.compile(
+    r"(?P<dollars>\$+)\{\{\s*(?P<ns>%s)\.(?P<key>%s)\s*\}\}" % (_NAME, _NAME)
+)
+_OPENING = re.compile(r"\$+\{\{")
+
+
+def interpolate_or_missing(
+    s: str,
+    namespaces: Mapping[str, Mapping[str, str]],
+    *,
+    skip: Iterable[str] = (),
+) -> Tuple[str, List[str]]:
+    """Interpolate and return ``(result, missing_names)``."""
+    skip_set = set(skip)
+    missing: List[str] = []
+    spans: Dict[int, int] = {}
+
+    def repl(m: "re.Match[str]") -> str:
+        spans[m.start()] = m.end()
+        n = len(m.group("dollars"))
+        ns, key = m.group("ns"), m.group("key")
+        if ns in skip_set:
+            # Verbatim, escapes included — a later pass owns this namespace
+            # and must see the text exactly as the user wrote it.
+            return m.group(0)
+        # Each leading "$$" escapes one level; an odd count interpolates.
+        if n % 2 == 0:
+            return "$" * (n // 2) + m.group(0)[n:]
+        values = namespaces.get(ns)
+        if values is None or key not in values:
+            missing.append(f"{ns}.{key}")
+            return m.group(0)
+        return "$" * (n // 2) + str(values[key])
+
+    out = _PLACEHOLDER.sub(repl, s)
+    for m in _OPENING.finditer(s):
+        if not any(start <= m.start() < end for start, end in spans.items()):
+            raise InterpolatorError(
+                f"Invalid placeholder syntax at {m.group(0)!r} in {s!r}; "
+                f"expected ${{{{ namespace.key }}}}"
+            )
+    return out, missing
+
+
+def interpolate(
+    s: str,
+    namespaces: Mapping[str, Mapping[str, str]],
+    *,
+    skip: Iterable[str] = (),
+    on_missing: str = "error",
+) -> str:
+    result, missing = interpolate_or_missing(s, namespaces, skip=skip)
+    if missing and on_missing == "error":
+        raise InterpolatorError(
+            f"Unknown variables in {s!r}: {', '.join(sorted(set(missing)))}"
+        )
+    return result

@@ -13,13 +13,15 @@ from dstack_tpu.server.http import response_json
 from tests.server.conftest import make_server
 
 
-def _task_body(commands, run_name, resources=None, nodes=1):
+def _task_body(commands, run_name, resources=None, nodes=1, env=None):
     conf = {
         "type": "task",
         "commands": commands,
         "nodes": nodes,
         "resources": resources or {"cpu": "1..", "memory": "0.1.."},
     }
+    if env is not None:
+        conf["env"] = env
     return {
         "run_spec": {
             "run_name": run_name,
@@ -364,5 +366,63 @@ async def test_multislice_run_gets_megascale_env():
 
         coords = set(_re.findall(r"coord=(\S+)", joined))
         assert len(coords) == 1 and ":" in coords.pop(), joined
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_secrets_interpolated_into_env():
+    """`${{ secrets.X }}` in env resolves against the project's secret store
+    at submit time; the raw value reaches the job process but is never stored
+    in the job spec row."""
+    fx = await make_server()
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/secrets/create_or_update",
+            json_body={"name": "hf_token", "value": "hf_abc123"},
+        )
+        assert resp.status == 200, resp.body
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo token=$HF_TOKEN rank=$RANKED"],
+                "secret-run",
+                env={
+                    "HF_TOKEN": "${{ secrets.hf_token }}",
+                    "RANKED": "job${{ dstack.job_num }}",
+                },
+            ),
+        )
+        run = await _wait_run(fx, "secret-run", {"done", "failed", "terminated"})
+        assert run["status"] == "done", run
+        sub = run["jobs"][0]["job_submissions"][-1]
+        resp = await fx.client.post(
+            "/api/project/main/logs/poll",
+            json_body={"run_name": "secret-run", "job_submission_id": sub["id"]},
+        )
+        logs = response_json(resp)["logs"]
+        text = b"".join(base64.b64decode(e["message"]) for e in logs).decode()
+        assert "token=hf_abc123" in text
+        assert "rank=job0" in text
+        # The stored spec keeps the placeholder, not the secret material.
+        spec = run["jobs"][0]["job_spec"]
+        assert spec["env"]["HF_TOKEN"] == "${{ secrets.hf_token }}"
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_missing_secret_fails_run_with_message():
+    fx = await make_server()
+    try:
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo nope"], "missing-secret",
+                env={"X": "${{ secrets.does_not_exist }}"},
+            ),
+        )
+        run = await _wait_run(fx, "missing-secret", {"done", "failed", "terminated"})
+        assert run["status"] == "failed", run
+        sub = run["jobs"][0]["job_submissions"][-1]
+        assert "does_not_exist" in (sub["termination_reason_message"] or "")
     finally:
         await fx.app.shutdown()

@@ -171,3 +171,38 @@ class TestRunSpecMerge:
         )
         assert spec.merged_profile.spot_policy == SpotPolicy.SPOT
         assert spec.merged_profile.max_price == 2.0
+
+
+class TestJobVolumeInterpolation:
+    def _specs(self, volumes, nodes=2):
+        from dstack_tpu.models.runs import RunSpec
+        from dstack_tpu.server.services.jobs import get_job_specs
+
+        spec = RunSpec(
+            run_name="r",
+            configuration=parse_run_configuration(
+                {"type": "task", "commands": ["x"], "nodes": nodes,
+                 "volumes": volumes}
+            ),
+        )
+        return get_job_specs(spec, replica_num=0)
+
+    def test_per_job_volume_names(self):
+        jobs = self._specs(["ckpt-${{ dstack.job_num }}:/checkpoints"])
+        names = [j.volumes[0].name for j in jobs]
+        assert names == ["ckpt-0", "ckpt-1"]
+        # node_rank is an alias for job_num
+        jobs = self._specs([{"name": "v-${{ dstack.node_rank }}", "path": "/v"}])
+        assert [j.volumes[0].name for j in jobs] == ["v-0", "v-1"]
+
+    def test_instance_mounts_untouched(self):
+        jobs = self._specs(["/host/data:/data"])
+        assert jobs[0].volumes[0] == InstanceMountPoint(
+            instance_path="/host/data", path="/data"
+        )
+
+    def test_bad_placeholder_rejected(self):
+        from dstack_tpu.errors import ServerError
+
+        with pytest.raises(ServerError):
+            self._specs(["ckpt-${{ dstack.unknown }}:/c"])

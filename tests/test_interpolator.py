@@ -1,0 +1,68 @@
+"""Unit tests for `${{ ns.key }}` interpolation (utils/interpolator.py).
+
+Parity: reference src/tests/_internal/utils/test_interpolator.py semantics —
+escape via doubled $, strict syntax inside `${{`, missing-variable handling.
+"""
+
+import pytest
+
+from dstack_tpu.utils.interpolator import (
+    InterpolatorError,
+    interpolate,
+    interpolate_or_missing,
+)
+
+NS = {"secrets": {"token": "s3cret", "user": "bob"}, "dstack": {"job_num": "3"}}
+
+
+def test_basic_substitution():
+    assert interpolate("x=${{ secrets.token }}", NS) == "x=s3cret"
+    assert interpolate("${{secrets.user}}@${{ dstack.job_num }}", NS) == "bob@3"
+
+
+def test_no_placeholder_passthrough():
+    assert interpolate("plain $HOME ${notcurly} text", NS) == "plain $HOME ${notcurly} text"
+    assert interpolate("cost $5 {{ jinja }}", NS) == "cost $5 {{ jinja }}"
+
+
+def test_escaping():
+    assert interpolate("$${{ secrets.token }}", NS) == "${{ secrets.token }}"
+    assert interpolate("$$${{ secrets.token }}", NS) == "$s3cret"
+    assert interpolate("$$$${{ secrets.token }}", NS) == "$${{ secrets.token }}"
+
+
+def test_missing_error_and_keep():
+    with pytest.raises(InterpolatorError, match="secrets.nope"):
+        interpolate("${{ secrets.nope }}", NS)
+    assert (
+        interpolate("${{ secrets.nope }}", NS, on_missing="keep")
+        == "${{ secrets.nope }}"
+    )
+    out, missing = interpolate_or_missing("a ${{ secrets.nope }} b", NS)
+    assert missing == ["secrets.nope"]
+    assert out == "a ${{ secrets.nope }} b"
+
+
+def test_skip_namespace_left_verbatim():
+    out = interpolate(
+        "${{ secrets.token }}/${{ dstack.job_num }}", NS, skip=("secrets",)
+    )
+    assert out == "${{ secrets.token }}/3"
+
+
+def test_invalid_syntax_raises():
+    for bad in ("${{ }}", "${{ noname }}", "${{ 1bad.key }}", "${{ a.b.c }}",
+                "${{ a-b.c }}", "${{ unclosed"):
+        with pytest.raises(InterpolatorError):
+            interpolate(bad, NS)
+
+
+def test_value_not_rescanned():
+    # A secret value containing placeholder syntax must come through verbatim.
+    ns = {"secrets": {"tricky": "${{ secrets.token }}"}}
+    assert interpolate("${{ secrets.tricky }}", ns) == "${{ secrets.token }}"
+
+
+def test_escape_preserves_original_spacing():
+    assert interpolate("$${{secrets.token}}", NS) == "${{secrets.token}}"
+    assert interpolate("$$${{  secrets.token  }}", NS) == "$s3cret"
