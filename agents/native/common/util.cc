@@ -110,6 +110,7 @@ int run_command_impl(const std::vector<std::string>& argv,
     return -1;
   }
   if (pid == 0) {
+    signal(SIGPIPE, SIG_DFL);  // agents ignore it; children must not inherit
     if (stdin_data) dup2(infd[0], STDIN_FILENO);
     dup2(pipefd[1], STDOUT_FILENO);  // dup2 clears O_CLOEXEC on the copy
     dup2(pipefd[1], STDERR_FILENO);

@@ -166,6 +166,10 @@ void Executor::exec_thread() {
     return;
   }
   if (pid == 0) {
+    // The agent ignores SIGPIPE (main.cc) and ignored dispositions survive
+    // exec — restore the default so user pipelines (`cmd | head`) die on a
+    // closed pipe the way they would in a shell.
+    signal(SIGPIPE, SIG_DFL);
     if (chdir(workdir.c_str()) != 0) _exit(126);
     execve("/bin/bash", const_cast<char**>(child_argv), envp.data());
     _exit(127);

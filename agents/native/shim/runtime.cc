@@ -40,11 +40,19 @@ class DockerRuntime : public Runtime {
     if (!spec.image_name.empty()) {
       task.status = "pulling";
       task.publish();
-      if (!spec.registry_username.empty() || !spec.registry_password.empty()) {
-        // `docker login` before pull for private registries; the password
-        // goes over stdin so it never appears in /proc/*/cmdline. The
-        // registry host is the first image-ref component when it looks like
-        // a hostname (has a dot or port); otherwise Docker Hub.
+      // Private-registry auth uses a per-task DOCKER_CONFIG so concurrent
+      // tasks with different credentials never race on the host's
+      // ~/.docker/config.json, and nothing persists after the pull (the
+      // Go reference passes per-pull X-Registry-Auth for the same reason).
+      std::string docker_config;
+      const bool has_auth =
+          !spec.registry_username.empty() || !spec.registry_password.empty();
+      if (has_auth) {
+        docker_config = "/tmp/dstack-docker-cfg-" + spec.id;
+        mkdir_p(docker_config, 0700);
+        // `docker login` with the password over stdin so it never appears
+        // in /proc/*/cmdline. The registry host is the first image-ref
+        // component when it looks like a hostname; otherwise Docker Hub.
         std::string registry;
         auto slash = spec.image_name.find('/');
         if (slash != std::string::npos) {
@@ -53,21 +61,30 @@ class DockerRuntime : public Runtime {
               head.find(':') != std::string::npos || head == "localhost")
             registry = head;
         }
-        std::vector<std::string> login = {"docker", "login", "--username",
-                                          spec.registry_username,
-                                          "--password-stdin"};
+        std::vector<std::string> login = {
+            "env", "DOCKER_CONFIG=" + docker_config, "docker", "login",
+            "--username", spec.registry_username, "--password-stdin"};
         if (!registry.empty()) login.push_back(registry);
         std::string out;
-        if (run_command_stdin(login, spec.registry_password + "\n", &out, 60) != 0) {
+        int login_rc =
+            run_command_stdin(login, spec.registry_password + "\n", &out, 60);
+        if (login_rc != 0) {
+          run_command({"rm", "-rf", docker_config}, nullptr);
           fail(task, "creating_container_error", "docker login failed: " + out);
           return;
         }
       }
+      std::vector<std::string> pull_cmd;
+      if (has_auth)
+        pull_cmd = {"env", "DOCKER_CONFIG=" + docker_config, "docker", "pull",
+                    spec.image_name};
+      else
+        pull_cmd = {"docker", "pull", spec.image_name};
       // Stream pull output so the task API shows live layer progress
       // instead of a silent multi-minute "pulling".
       std::string tail;
       int rc = run_command_lines(
-          {"docker", "pull", spec.image_name},
+          pull_cmd,
           [&](const std::string& line) {
             if (line.empty()) return;
             task.status_message = line;
@@ -76,6 +93,8 @@ class DockerRuntime : public Runtime {
             task.publish();
           },
           kPullTimeoutSeconds);
+      if (!docker_config.empty())
+        run_command({"rm", "-rf", docker_config}, nullptr);
       if (rc != 0) {
         fail(task, "creating_container_error", "docker pull failed: " + tail);
         return;

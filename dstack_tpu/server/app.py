@@ -41,6 +41,7 @@ def create_app(
     from dstack_tpu.server.routers import (
         backends as backends_router,
         debug as debug_router,
+        docs as docs_router,
         fleets as fleets_router,
         instances as instances_router,
         logs as logs_router,
@@ -63,7 +64,7 @@ def create_app(
         instances_router, volumes_router, gateways_router, backends_router,
         repos_router, secrets_router, logs_router, metrics_router,
         server_info_router, services_proxy_router, model_proxy_router,
-        debug_router, ui_router,
+        debug_router, docs_router, ui_router,
     ):
         app.include_router(mod.router)
 

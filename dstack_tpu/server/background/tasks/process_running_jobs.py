@@ -203,6 +203,14 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
             # project's secret store (reference process_running_jobs.py:388-394).
             registry_username = registry_password = None
             if job_spec.registry_auth is not None:
+                if not job_spec.registry_auth.username:
+                    # docker login cannot take a password without a username
+                    # (GHCR/GCR accept a constant like "_token"/"_json_key").
+                    await _fail(
+                        ctx, row, JobTerminationReason.EXECUTOR_ERROR,
+                        "registry_auth.username is required when registry_auth is set",
+                    )
+                    return
                 try:
                     registry_username = interpolate(
                         job_spec.registry_auth.username or "", {"secrets": secrets}

@@ -161,6 +161,10 @@ class Route:
     regex: re.Pattern
     handler: Handler
     websocket: bool = False
+    # OpenAPI metadata (openapi.py); request model may also be inferred
+    # from the handler body's `request.parse(Model)` call.
+    request_model: Optional[type] = None
+    response_model: Optional[type] = None
 
 
 class Router:
@@ -170,18 +174,21 @@ class Router:
         self.prefix = prefix.rstrip("/")
         self.routes: List[Route] = []
 
-    def add(self, method: str, path: str, handler: Handler, websocket: bool = False) -> None:
+    def add(self, method: str, path: str, handler: Handler, websocket: bool = False,
+            request_model: Optional[type] = None,
+            response_model: Optional[type] = None) -> None:
         full = self.prefix + path
-        self.routes.append(Route(method.upper(), full, _compile_path(full), handler, websocket))
+        self.routes.append(Route(method.upper(), full, _compile_path(full), handler,
+                                 websocket, request_model, response_model))
 
-    def post(self, path: str) -> Callable[[Handler], Handler]:
-        return self._decorator("POST", path)
+    def post(self, path: str, **meta) -> Callable[[Handler], Handler]:
+        return self._decorator("POST", path, **meta)
 
-    def get(self, path: str) -> Callable[[Handler], Handler]:
-        return self._decorator("GET", path)
+    def get(self, path: str, **meta) -> Callable[[Handler], Handler]:
+        return self._decorator("GET", path, **meta)
 
-    def delete(self, path: str) -> Callable[[Handler], Handler]:
-        return self._decorator("DELETE", path)
+    def delete(self, path: str, **meta) -> Callable[[Handler], Handler]:
+        return self._decorator("DELETE", path, **meta)
 
     def websocket(self, path: str) -> Callable[[Handler], Handler]:
         def deco(fn: Handler) -> Handler:
@@ -190,9 +197,9 @@ class Router:
 
         return deco
 
-    def _decorator(self, method: str, path: str) -> Callable[[Handler], Handler]:
+    def _decorator(self, method: str, path: str, **meta) -> Callable[[Handler], Handler]:
         def deco(fn: Handler) -> Handler:
-            self.add(method, path, fn)
+            self.add(method, path, fn, **meta)
             return fn
 
         return deco
@@ -229,6 +236,7 @@ class App:
         return None, {}, path_matched
 
     async def handle(self, request: Request) -> Response:
+        request.app = self  # handlers that introspect the route table (docs)
         tracer = self.state.get("tracer")
         if tracer is None:
             return await self._dispatch(request)

@@ -1,0 +1,141 @@
+"""OpenAPI 3.1 document generation for the hand-rolled router stack.
+
+Parity: the reference serves interactive API docs at /api/docs via FastAPI's
+built-in OpenAPI generation (SURVEY §1.2). Our routers don't declare typed
+signatures, so the document is assembled from three sources, best first:
+
+1. explicit ``request_model=`` / ``response_model=`` decorator kwargs,
+2. the ``request.parse(Model)`` call inside the handler body (source scan),
+3. the handler docstring for summary/description.
+
+Pydantic v2 emits the JSON schemas; all model ``$defs`` are merged into
+``components.schemas``.
+"""
+
+import inspect
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from pydantic import BaseModel
+
+_PARSE_RE = re.compile(r"\.parse\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*[,)]")
+_PATH_PARAM_RE = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}")
+
+
+def _infer_request_model(handler) -> Optional[type]:
+    try:
+        source = inspect.getsource(handler)
+    except (OSError, TypeError):
+        return None
+    m = _PARSE_RE.search(source)
+    if m is None:
+        return None
+    module = inspect.getmodule(handler)
+    candidate = getattr(module, m.group(1), None)
+    if isinstance(candidate, type) and issubclass(candidate, BaseModel):
+        return candidate
+    return None
+
+
+def _doc_parts(handler) -> Tuple[str, str]:
+    doc = inspect.getdoc(handler) or ""
+    first, _, rest = doc.partition("\n")
+    return first.strip(), rest.strip()
+
+
+def _tag_for(pattern: str, handler) -> str:
+    module = getattr(handler, "__module__", "")
+    tag = module.rsplit(".", 1)[-1] if module else "api"
+    return tag.replace("_", " ")
+
+
+def build_openapi(app, *, title: str = "dstack-tpu API", version: str = "") -> dict:
+    """Assemble the OpenAPI document from the app's registered routes."""
+    paths: Dict[str, Dict[str, Any]] = {}
+    models: List[type] = []
+
+    def schema_ref(model: type) -> dict:
+        if model not in models:
+            models.append(model)
+        return {"$ref": f"#/components/schemas/{model.__name__}"}
+
+    for router in app.routers:
+        for route in router.routes:
+            summary, description = _doc_parts(route.handler)
+            op: Dict[str, Any] = {
+                "operationId": f"{route.method.lower()}_{route.handler.__name__}",
+                "tags": [_tag_for(route.pattern, route.handler)],
+            }
+            if summary:
+                op["summary"] = summary
+            if description:
+                op["description"] = description
+            if route.websocket:
+                op["description"] = (
+                    (op.get("description", "") + "\n\n").lstrip()
+                    + "WebSocket endpoint (RFC6455 upgrade on GET)."
+                ).strip()
+
+            params = []
+            for name in _PATH_PARAM_RE.findall(route.pattern):
+                params.append({
+                    "name": name,
+                    "in": "path",
+                    "required": True,
+                    "schema": {"type": "string"},
+                })
+            if params:
+                op["parameters"] = params
+
+            request_model = route.request_model or (
+                _infer_request_model(route.handler) if route.method == "POST" else None
+            )
+            if request_model is not None:
+                op["requestBody"] = {
+                    "required": True,
+                    "content": {
+                        "application/json": {"schema": schema_ref(request_model)}
+                    },
+                }
+
+            if route.response_model is not None:
+                content = {"application/json": {"schema": schema_ref(route.response_model)}}
+            else:
+                content = {"application/json": {"schema": {}}}
+            op["responses"] = {
+                "200": {"description": "Successful response", "content": content},
+                "400": {"description": "Client error"},
+                "401": {"description": "Not authenticated"},
+            }
+
+            item = paths.setdefault(route.pattern, {})
+            item[route.method.lower()] = op
+
+    schemas: Dict[str, Any] = {}
+    for model in models:
+        # Per-model generation: one model with a JSON-unrepresentable field
+        # (plain-object types, custom validators) degrades to an untyped
+        # object instead of breaking the whole document.
+        try:
+            schema = model.model_json_schema(
+                ref_template="#/components/schemas/{model}"
+            )
+        except Exception:
+            schemas.setdefault(model.__name__, {"type": "object"})
+            continue
+        for name, sub in schema.pop("$defs", {}).items():
+            schemas.setdefault(name, sub)
+        schemas[model.__name__] = schema
+
+    return {
+        "openapi": "3.1.0",
+        "info": {"title": title, "version": version},
+        "paths": paths,
+        "components": {
+            "schemas": schemas,
+            "securitySchemes": {
+                "token": {"type": "http", "scheme": "bearer"}
+            },
+        },
+        "security": [{"token": []}],
+    }

@@ -1,0 +1,98 @@
+"""/api/openapi.json + /api/docs — interactive API reference.
+
+Parity: reference FastAPI serves Swagger UI at /api/docs (SURVEY §1.2).
+Redesign: the document comes from server/openapi.py over the hand-rolled
+router stack, and the viewer is a small dependency-free HTML page (no
+swagger-ui CDN assets — works in air-gapped deployments).
+"""
+
+import json
+
+from dstack_tpu import version as _version
+from dstack_tpu.server.http import Request, Response, Router
+from dstack_tpu.server.openapi import build_openapi
+
+router = Router()
+
+_DOCS_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>dstack-tpu API</title>
+<style>
+body{font:14px/1.5 system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1c2330}
+header{background:#101828;color:#fff;padding:14px 24px;font-size:17px}
+header .v{opacity:.6;font-size:13px;margin-left:8px}
+main{max-width:960px;margin:0 auto;padding:18px 24px}
+h2{font-size:15px;text-transform:capitalize;border-bottom:1px solid #d9dee7;
+   padding-bottom:4px;margin:26px 0 8px}
+.op{background:#fff;border:1px solid #e2e6ee;border-radius:6px;margin:6px 0}
+.op>summary{display:flex;gap:10px;align-items:center;padding:8px 12px;
+   cursor:pointer;list-style:none}
+.op>summary::-webkit-details-marker{display:none}
+.m{font-weight:700;font-size:11px;border-radius:4px;padding:2px 8px;color:#fff;
+   min-width:44px;text-align:center}
+.m.post{background:#2563eb}.m.get{background:#059669}.m.delete{background:#dc2626}
+.p{font-family:ui-monospace,monospace;font-size:13px}
+.s{color:#667085;font-size:12px;margin-left:auto;text-align:right}
+.body{padding:4px 14px 12px;border-top:1px solid #eef1f6}
+pre{background:#0d1322;color:#d6e2ff;padding:10px;border-radius:6px;
+    overflow:auto;font-size:12px}
+.desc{white-space:pre-wrap;color:#475467;font-size:13px}
+</style></head><body>
+<header>dstack-tpu API<span class="v" id="v"></span></header>
+<main id="root">Loading /api/openapi.json…</main>
+<script>
+(async () => {
+  const spec = await (await fetch('/api/openapi.json')).json();
+  document.getElementById('v').textContent = spec.info.version || '';
+  const groups = {};
+  for (const [path, item] of Object.entries(spec.paths))
+    for (const [method, op] of Object.entries(item))
+      (groups[op.tags?.[0] || 'api'] ??= []).push({path, method, op});
+  const deref = s => {
+    if (s && s.$ref) {
+      const name = s.$ref.split('/').pop();
+      return spec.components.schemas[name] || {};
+    }
+    return s || {};
+  };
+  const root = document.getElementById('root');
+  root.textContent = '';
+  for (const tag of Object.keys(groups).sort()) {
+    const h = document.createElement('h2');
+    h.textContent = tag;
+    root.appendChild(h);
+    for (const {path, method, op} of groups[tag]) {
+      const d = document.createElement('details');
+      d.className = 'op';
+      const reqSchema = op.requestBody?.content?.['application/json']?.schema;
+      d.innerHTML = `<summary><span class="m ${method}">${method.toUpperCase()}</span>
+        <span class="p">${path}</span><span class="s">${op.summary || ''}</span></summary>
+        <div class="body">
+        ${op.description ? `<p class="desc"></p>` : ''}
+        ${reqSchema ? `<p><b>Request body</b></p><pre class="req"></pre>` : ''}
+        </div>`;
+      if (op.description) d.querySelector('.desc').textContent = op.description;
+      if (reqSchema)
+        d.querySelector('.req').textContent =
+          JSON.stringify(deref(reqSchema), null, 2);
+      root.appendChild(d);
+    }
+  }
+})();
+</script></body></html>"""
+
+
+@router.get("/api/openapi.json")
+async def openapi_json(request: Request) -> Response:
+    app = request.app
+    spec = app.state.get("openapi_cache")
+    if spec is None:
+        spec = build_openapi(app, version=_version.__version__)
+        app.state["openapi_cache"] = spec
+    return Response(
+        json.dumps(spec).encode(), media_type="application/json"
+    )
+
+
+@router.get("/api/docs")
+async def docs_page(request: Request) -> Response:
+    return Response(_DOCS_HTML, media_type="text/html; charset=utf-8")
