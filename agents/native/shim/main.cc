@@ -3,6 +3,7 @@
 // Protocol: dstack_tpu/agents/protocol.py. Parity: runner/cmd/shim/main.go
 // + runner/internal/shim/{api,docker,host}.
 #include <getopt.h>
+#include <cctype>
 #include <csignal>
 #include <sys/stat.h>
 #include <sys/statvfs.h>
@@ -59,6 +60,12 @@ class TaskStore {
   HttpResponse submit(const Json& body) {
     TaskSpec spec = TaskSpec::from_json(body);
     if (spec.id.empty()) return HttpResponse::error(400, "task id required");
+    // The id feeds filesystem paths (docker-config dir) and the container
+    // name; anything outside [A-Za-z0-9_-] (e.g. "../") is hostile.
+    for (char ch : spec.id) {
+      if (!isalnum(static_cast<unsigned char>(ch)) && ch != '-' && ch != '_')
+        return HttpResponse::error(400, "task id has invalid characters");
+    }
     std::unique_lock<std::mutex> lock(mu_);
     if (tasks_.count(spec.id)) return HttpResponse::error(409, "task exists");
     TaskState& task = tasks_[spec.id];

@@ -49,7 +49,15 @@ class DockerRuntime : public Runtime {
           !spec.registry_username.empty() || !spec.registry_password.empty();
       if (has_auth) {
         docker_config = "/tmp/dstack-docker-cfg-" + spec.id;
-        mkdir_p(docker_config, 0700);
+        // Plain mkdir, not mkdir_p: the id is charset-checked at the API
+        // (no traversal) and an already-existing dir means another local
+        // user squatted the predictable path — fail rather than write
+        // credentials into it.
+        if (mkdir(docker_config.c_str(), 0700) != 0) {
+          fail(task, "creating_container_error",
+               "docker config dir unavailable: " + docker_config);
+          return;
+        }
         // `docker login` with the password over stdin so it never appears
         // in /proc/*/cmdline. The registry host is the first image-ref
         // component when it looks like a hostname; otherwise Docker Hub.

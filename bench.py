@@ -103,8 +103,8 @@ def main() -> None:
     # compares equal HBM behavior (not a handicapped baseline).
     @functools.partial(jax.jit, donate_argnums=0)
     def bare_step(state, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(config, p, batch)
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: loss_fn(config, p, batch), has_aux=True
         )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -75,6 +75,18 @@ class RegistryAuth(CoreModel):
     username: Optional[str] = None
     password: Optional[str] = None
 
+    @model_validator(mode="after")
+    def _require_username_with_password(self):
+        # docker login cannot take a password alone; registries that don't
+        # care about the username accept a constant ("_token", "_json_key").
+        # Validating here surfaces the mistake at plan/submit time instead
+        # of minutes later on a provisioned instance.
+        if self.password and not self.username:
+            raise ValueError(
+                "registry_auth.username is required when a password is set"
+            )
+        return self
+
 
 class Env(CoreModel):
     """Environment variables as a mapping or a list.

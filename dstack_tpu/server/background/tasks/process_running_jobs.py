@@ -213,7 +213,7 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
                     return
                 try:
                     registry_username = interpolate(
-                        job_spec.registry_auth.username or "", {"secrets": secrets}
+                        job_spec.registry_auth.username, {"secrets": secrets}
                     )
                     registry_password = interpolate(
                         job_spec.registry_auth.password or "", {"secrets": secrets}

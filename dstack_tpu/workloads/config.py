@@ -26,6 +26,13 @@ class ModelConfig:
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
     remat: bool = True  # jax.checkpoint each block: trade FLOPs for HBM
+    # Sparse MoE (0 = dense MLP). With n_experts > 0 every block's MLP is
+    # a routed top-k SwiGLU expert bank (workloads/moe.py) and d_ff is the
+    # per-expert hidden dim.
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -39,11 +46,17 @@ class ModelConfig:
         return replace(self, **kw)
 
     def flops_per_token(self) -> float:
-        """Approximate forward+backward FLOPs per token (3x forward, dense)."""
+        """Approximate forward+backward FLOPs per token (3x forward).
+
+        MoE counts the k active experts per token plus the router matmul,
+        not the full expert bank."""
         d, f, v = self.d_model, self.d_ff, self.vocab_size
         hd = self.head_dim
         attn_proj = 2 * d * (self.n_heads + 2 * self.n_kv_heads) * hd + 2 * self.n_heads * hd * d
-        mlp = 3 * 2 * d * f
+        if self.n_experts > 0:
+            mlp = 3 * 2 * d * f * self.experts_per_token + 2 * d * self.n_experts
+        else:
+            mlp = 3 * 2 * d * f
         per_layer = attn_proj + mlp
         embed = 2 * d * v
         fwd = self.n_layers * per_layer + embed
@@ -65,5 +78,16 @@ PRESETS: Dict[str, ModelConfig] = {
     "llama-8b": ModelConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192,
+    ),
+    # Sparse MoE for tests/dryrun (expert-parallel over the "expert" axis).
+    "tiny-moe": ModelConfig(
+        vocab_size=512, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=256, max_seq_len=256, remat=False, n_experts=4,
+        experts_per_token=2,
+    ),
+    # Mixtral-shaped 8x top-2 at the 1B-active scale.
+    "smol-moe": ModelConfig(
+        vocab_size=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=5632, max_seq_len=2048, n_experts=8, experts_per_token=2,
     ),
 }

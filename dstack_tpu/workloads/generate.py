@@ -9,6 +9,12 @@ dynamic shapes — pallas_guide/XLA semantics).
 
 Consistency contract: prefill+decode must reproduce `transformer.forward`
 logits exactly for the same tokens — pinned by tests/test_generate.py.
+MoE caveat: capacity-based token dropping (workloads/moe.py) is a
+*training-throughput* approximation, not model semantics; decode evaluates
+the un-dropped top-k routing (each step has no cross-token competition), so
+MoE decode matches `forward` exactly only when forward's capacity admits
+every token (tests pin this with a high capacity_factor). When training
+drops tokens, decode is the more faithful computation, not a divergence.
 """
 
 from typing import Any, Dict, NamedTuple, Optional, Tuple
@@ -93,7 +99,12 @@ def _forward_cached(
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, start, 0, 0))
         attn = _cached_attention(q, ck, cv, valid_len)
         x = x + attn @ p["wo"]
-        x = mlp_block(c, x, p)
+        if c.n_experts > 0:
+            from dstack_tpu.workloads.moe import moe_block
+
+            x, _ = moe_block(c, x, p)
+        else:
+            x = mlp_block(c, x, p)
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))

@@ -1,0 +1,136 @@
+"""Sparse mixture-of-experts MLP with expert parallelism, TPU-native.
+
+Mixtral-class MoE done the GShard/Switch way rather than a torch-style
+gather/scatter translation: routing builds dense dispatch/combine tensors
+and the whole layer is einsums — every op is static-shaped, tiles onto the
+MXU, and XLA inserts the token all-to-all from the sharding constraints
+(expert weights and expert inputs live on the "expert" mesh axis; tokens
+live on the batch axes). Capacity overflow drops tokens by construction:
+`one_hot` of an out-of-range slot index is the zero row, so overflowing
+tokens simply fall out of dispatch and keep their residual value.
+
+Parity note: the reference orchestrator ships no model math (SURVEY §2.7
+"absent by design" — users bring torch MoE in containers); this is part of
+the framework-native workload library the orchestrator launches.
+"""
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.workloads.config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+def expert_capacity(c: ModelConfig, seq_len: int) -> int:
+    """Per-expert slot count for one batch row's sequence (static)."""
+    return max(
+        1,
+        int(
+            math.ceil(
+                c.experts_per_token * seq_len * c.capacity_factor / c.n_experts
+            )
+        ),
+    )
+
+
+def route(
+    c: ModelConfig, h: jnp.ndarray, router: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k routing -> (dispatch (B,S,E,C), combine (B,S,E,C), aux scalar).
+
+    Slot assignment is priority-ordered: every token's first choice is
+    placed before any token's second choice (GShard ordering), via one
+    cumsum over the (choice-major) flattened token axis.
+    """
+    B, S, _ = h.shape
+    E, k = c.n_experts, c.experts_per_token
+    C = expert_capacity(c, S)
+
+    logits = jnp.einsum(
+        "bsd,de->bse", h, router, preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E) f32
+    gate_vals, gate_idx = lax.top_k(probs, k)  # (B,S,k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    sel = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # (B,S,k,E)
+    # Choice-major flatten so cumsum hands out slots first-choices-first.
+    sel_flat = sel.transpose(0, 2, 1, 3).reshape(B, k * S, E)
+    pos_flat = jnp.cumsum(sel_flat, axis=1) * sel_flat - 1.0
+    pos = pos_flat.reshape(B, k, S, E).transpose(0, 2, 1, 3)  # (B,S,k,E)
+    slot = jnp.sum(pos * sel, axis=-1).astype(jnp.int32)  # (B,S,k)
+    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32)  # 0-row when >= C
+
+    dispatch = jnp.einsum("bske,bskc->bsec", sel, slot_oh)
+    combine = jnp.einsum("bsk,bske,bskc->bsec", gate_vals, sel, slot_oh)
+
+    # Switch-style load-balance loss: E * sum_e mean_prob_e * top1_share_e.
+    mean_prob = jnp.mean(probs, axis=(0, 1))  # (E,)
+    top1_share = jnp.mean(sel[:, :, 0, :], axis=(0, 1))  # (E,)
+    aux = jnp.float32(E) * jnp.sum(mean_prob * top1_share)
+    return dispatch, combine, aux
+
+
+def moe_mlp(
+    c: ModelConfig,
+    h: jnp.ndarray,
+    p: Params,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The routed SwiGLU experts on a normed input h -> (out, aux_loss).
+
+    p carries: router (D,E) f32, we_gate/we_up (E,D,F), we_down (E,F,D).
+    """
+    dispatch, combine, aux = route(c, h, p["router"])
+
+    def constrain(x, spec):
+        if mesh is not None and "expert" in mesh.axis_names:
+            return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        return x
+
+    # Token all-to-all: tokens (batch-sharded) -> expert slots
+    # (expert-sharded). XLA materializes the collective from the two
+    # constraints on either side of this einsum.
+    expert_in = jnp.einsum(
+        "bsec,bsd->ebcd", dispatch.astype(h.dtype), h
+    )
+    expert_in = constrain(expert_in, P("expert", ("data", "fsdp"), None, None))
+
+    gate = jnp.einsum(
+        "ebcd,edf->ebcf", expert_in, p["we_gate"],
+        preferred_element_type=jnp.float32,
+    )
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, p["we_up"])
+    act = (jax.nn.silu(gate).astype(h.dtype)) * up
+    expert_out = jnp.einsum("ebcf,efd->ebcd", act, p["we_down"])
+    expert_out = constrain(
+        expert_out, P("expert", ("data", "fsdp"), None, None)
+    )
+
+    out = jnp.einsum(
+        "bsec,ebcd->bsd", combine.astype(h.dtype), expert_out
+    )
+    return out, aux
+
+
+def moe_block(
+    c: ModelConfig,
+    x: jnp.ndarray,
+    p: Params,
+    mesh: Optional[Mesh] = None,
+    norm_fn=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pre-norm MoE block with residual: x -> (x + moe(norm(x)), aux)."""
+    from dstack_tpu.workloads.transformer import rms_norm
+
+    h = rms_norm(x, p["mlp_norm"], c.norm_eps)
+    out, aux = moe_mlp(c, h, p, mesh)
+    return x + out, aux

@@ -9,6 +9,8 @@ insert the collectives; the axes follow the standard layout:
   seq   — sequence/context parallelism; activations sharded over sequence,
           attention runs as a ppermute ring (attention.py)
   model — tensor parallelism within a host's ICI-contiguous chips
+  expert — expert parallelism: MoE expert banks sharded over experts, the
+          token dispatch einsum becomes the all-to-all (workloads/moe.py)
 
 Weight matrices are sharded ("fsdp" on the input dim, "model" on the output
 dim) or transposed for the second matmul of each pair, so forward needs only
@@ -22,7 +24,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "fsdp", "seq", "model")
+AXES = ("data", "fsdp", "seq", "model", "expert")
 
 
 def make_mesh(
@@ -32,19 +34,20 @@ def make_mesh(
     fsdp: Optional[int] = None,
     seq: int = 1,
     model: int = 1,
+    expert: int = 1,
 ) -> Mesh:
     """Build a Mesh over the given (default: all) devices.
 
-    `fsdp=None` absorbs whatever factor remains after data*seq*model.
+    `fsdp=None` absorbs whatever factor remains after data*seq*model*expert.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if fsdp is None:
-        denom = data * seq * model
+        denom = data * seq * model * expert
         if n % denom:
             raise ValueError(f"{denom=} does not divide {n} devices")
         fsdp = n // denom
-    shape = (data, fsdp, seq, model)
+    shape = (data, fsdp, seq, model, expert)
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh {dict(zip(AXES, shape))} != {n} devices")
     return Mesh(np.array(devices).reshape(shape), AXES)
@@ -61,6 +64,13 @@ PARAM_SPECS: Dict[str, Any] = {
         "w_gate": P(None, "fsdp", "model"),
         "w_up": P(None, "fsdp", "model"),
         "w_down": P(None, "model", "fsdp"),
+        # MoE variants (present instead of w_gate/w_up/w_down when
+        # n_experts > 0): expert bank over "expert", each expert's matrices
+        # sharded like the dense MLP.
+        "router": P(None, None, None),
+        "we_gate": P(None, "expert", "fsdp", "model"),
+        "we_up": P(None, "expert", "fsdp", "model"),
+        "we_down": P(None, "expert", "model", "fsdp"),
         "attn_norm": P(None, None),
         "mlp_norm": P(None, None),
     },

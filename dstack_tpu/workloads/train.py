@@ -56,22 +56,29 @@ def loss_fn(
     params: Any,
     batch: Dict[str, jnp.ndarray],
     attention_fn=None,
-) -> jnp.ndarray:
-    """Next-token cross-entropy.
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Next-token cross-entropy -> (loss, router_aux).
 
     batch: inputs (B, S) int32, targets (B, S) int32, optional loss_mask
     (B, S). inputs/targets are pre-shifted so both shard evenly over the
-    "seq" mesh axis.
+    "seq" mesh axis. For MoE configs the router load-balance aux term is
+    folded into the loss with `router_aux_coef`.
     """
     inputs, targets = batch["inputs"], batch["targets"]
-    logits = forward(config, params, inputs, attention_fn=attention_fn)
+    logits, aux = forward(
+        config, params, inputs, attention_fn=attention_fn, mesh=mesh,
+        return_aux=True,
+    )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask.astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        ce = jnp.mean(nll)
+    return ce + config.router_aux_coef * aux, aux
 
 
 def make_train_step(
@@ -88,8 +95,9 @@ def make_train_step(
     attention_fn = make_attention_fn(mesh)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(config, p, batch, attention_fn)
+        (loss, aux), grads = jax.value_and_grad(
+            lambda p: loss_fn(config, p, batch, attention_fn, mesh),
+            has_aux=True,
         )(state.params)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
@@ -97,7 +105,7 @@ def make_train_step(
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
         new_state = TrainState(state.step + 1, params, opt_state)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        return new_state, {"loss": loss, "grad_norm": gnorm, "router_aux": aux}
 
     if mesh is None:
         return jax.jit(train_step, donate_argnums=0)
@@ -126,7 +134,8 @@ def make_train_step(
                 in_shardings=(state_sh, batch_sh),
                 out_shardings=(
                     state_sh,
-                    {"loss": replicated, "grad_norm": replicated},
+                    {"loss": replicated, "grad_norm": replicated,
+                     "router_aux": replicated},
                 ),
                 donate_argnums=0,
             )

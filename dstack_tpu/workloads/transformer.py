@@ -44,19 +44,32 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         return (jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5).astype(dt)
 
     L, D, F, V = c.n_layers, c.d_model, c.d_ff, c.vocab_size
+    layers = {
+        "wq": dense(keys[1], (L, D, c.n_heads * hd), D),
+        "wk": dense(keys[2], (L, D, c.n_kv_heads * hd), D),
+        "wv": dense(keys[3], (L, D, c.n_kv_heads * hd), D),
+        "wo": dense(keys[4], (L, c.n_heads * hd, D), c.n_heads * hd),
+        "attn_norm": norm_init((L, D)),
+        "mlp_norm": norm_init((L, D)),
+    }
+    if c.n_experts > 0:
+        E = c.n_experts
+        # Router stays f32: tiny, and routing decisions are precision-
+        # sensitive (a bf16 tie flips top-k membership).
+        layers["router"] = (
+            jax.random.normal(keys[5], (L, D, E), dtype=jnp.float32) * D**-0.5
+        )
+        ek = jax.random.split(keys[6], 3)
+        layers["we_gate"] = dense(ek[0], (L, E, D, F), D)
+        layers["we_up"] = dense(ek[1], (L, E, D, F), D)
+        layers["we_down"] = dense(ek[2], (L, E, F, D), F)
+    else:
+        layers["w_gate"] = dense(keys[5], (L, D, F), D)
+        layers["w_up"] = dense(keys[6], (L, D, F), D)
+        layers["w_down"] = dense(keys[7], (L, F, D), F)
     return {
         "embed": dense(keys[0], (V, D), D),
-        "layers": {
-            "wq": dense(keys[1], (L, D, c.n_heads * hd), D),
-            "wk": dense(keys[2], (L, D, c.n_kv_heads * hd), D),
-            "wv": dense(keys[3], (L, D, c.n_kv_heads * hd), D),
-            "wo": dense(keys[4], (L, c.n_heads * hd, D), c.n_heads * hd),
-            "w_gate": dense(keys[5], (L, D, F), D),
-            "w_up": dense(keys[6], (L, D, F), D),
-            "w_down": dense(keys[7], (L, F, D), F),
-            "attn_norm": norm_init((L, D)),
-            "mlp_norm": norm_init((L, D)),
-        },
+        "layers": layers,
         "final_norm": norm_init((D,)),
         "lm_head": dense(jax.random.fold_in(key, 99), (D, V), D),
     }
@@ -108,12 +121,18 @@ def _block(
     p: Params,
     positions: jnp.ndarray,
     attention_fn: AttentionFn,
-) -> jnp.ndarray:
+    mesh=None,
+):
+    """One decoder block -> (x, router_aux). aux is 0.0 for dense models."""
     b, s, _ = x.shape
     q, k, v = project_qkv(c, x, p, positions)
     attn = attention_fn(q, k, v).reshape(b, s, c.n_heads * c.head_dim)
     x = x + attn @ p["wo"]
-    return mlp_block(c, x, p)
+    if c.n_experts > 0:
+        from dstack_tpu.workloads.moe import moe_block
+
+        return moe_block(c, x, p, mesh)
+    return mlp_block(c, x, p), jnp.float32(0.0)
 
 
 def forward(
@@ -123,8 +142,13 @@ def forward(
     *,
     attention_fn: Optional[AttentionFn] = None,
     positions: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
-    """tokens (B, S) int32 -> logits (B, S, V) in f32."""
+    mesh=None,
+    return_aux: bool = False,
+):
+    """tokens (B, S) int32 -> logits (B, S, V) in f32.
+
+    With return_aux=True returns (logits, aux) where aux is the summed
+    router load-balance loss over layers (0.0 for dense models)."""
     c = config
     attn = attention_fn or plain_attention
     if positions is None:
@@ -132,17 +156,21 @@ def forward(
 
     x = jnp.take(params["embed"], tokens, axis=0)
 
-    def body(x, layer_p):
-        return _block(c, x, layer_p, positions, attn), None
+    def body(carry, layer_p):
+        x, aux = carry
+        x, layer_aux = _block(c, x, layer_p, positions, attn, mesh)
+        return (x, aux + layer_aux), None
 
     if c.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable
         )
-    x, _ = lax.scan(body, x, params["layers"])
+    (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
     )
+    if return_aux:
+        return logits, aux
     return logits

@@ -34,6 +34,7 @@ def main() -> None:
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--model-parallel", type=int, default=1)
     parser.add_argument("--seq-parallel", type=int, default=1)
+    parser.add_argument("--expert-parallel", type=int, default=1)
     parser.add_argument(
         "--checkpoint-dir",
         default=os.environ.get("CHECKPOINT_DIR", ""),
@@ -53,8 +54,11 @@ def main() -> None:
     config = PRESETS[args.preset]
     if args.seq_len > config.max_seq_len:
         raise SystemExit(f"--seq-len > {config.max_seq_len} for {args.preset}")
+    if args.expert_parallel > 1 and config.n_experts % args.expert_parallel:
+        raise SystemExit("--expert-parallel must divide the preset's n_experts")
     mesh = make_mesh(
-        jax.devices(), model=args.model_parallel, seq=args.seq_parallel
+        jax.devices(), model=args.model_parallel, seq=args.seq_parallel,
+        expert=args.expert_parallel,
     )
     state = init_train_state(config, jax.random.PRNGKey(0), mesh=mesh)
     if args.checkpoint_dir:

@@ -1,0 +1,163 @@
+"""Mixture-of-experts workload: routing math, expert parallelism, training.
+
+Runs on the 8-device virtual CPU mesh from tests/conftest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.moe import expert_capacity, moe_mlp, route
+from dstack_tpu.workloads.sharding import make_mesh
+from dstack_tpu.workloads.train import (
+    init_train_state,
+    make_train_step,
+    synthetic_batch,
+)
+from dstack_tpu.workloads.transformer import forward, init_params
+
+CFG = PRESETS["tiny-moe"]
+
+
+def _rand_params(key, c):
+    p = init_params(c, key)["layers"]
+    # Strip the leading layer-stack dim for direct moe_mlp calls.
+    return {k: v[0] for k, v in p.items() if k.startswith(("router", "we_"))}
+
+
+class TestRouting:
+    def test_dispatch_combine_shapes_and_capacity(self):
+        c = CFG
+        h = jax.random.normal(jax.random.PRNGKey(0), (2, 16, c.d_model),
+                              dtype=jnp.bfloat16)
+        router = jax.random.normal(jax.random.PRNGKey(1), (c.d_model, c.n_experts))
+        dispatch, combine, aux = route(c, h, router)
+        C = expert_capacity(c, 16)
+        assert dispatch.shape == (2, 16, c.n_experts, C)
+        assert combine.shape == dispatch.shape
+        # Each slot of each expert holds at most one token.
+        assert float(jnp.max(jnp.sum(dispatch, axis=1))) <= 1.0 + 1e-6
+        # A token occupies at most k slots and combine weights sum to <= 1.
+        per_token = jnp.sum(combine, axis=(2, 3))
+        assert float(jnp.max(per_token)) <= 1.0 + 1e-5
+        assert float(aux) > 0.0
+
+    def test_moe_matches_dense_reference(self):
+        """With capacity high enough that nothing drops, the einsum-dispatch
+        layer must equal the straightforward per-token top-k computation."""
+        c = CFG.with_(capacity_factor=8.0)  # no drops
+        key = jax.random.PRNGKey(2)
+        p = _rand_params(key, c)
+        h = jax.random.normal(
+            jax.random.fold_in(key, 1), (2, 8, c.d_model), dtype=jnp.float32
+        ).astype(jnp.bfloat16)
+
+        out, _ = moe_mlp(c, h, p)
+
+        # Reference: loop over tokens in numpy-esque jax.
+        probs = jax.nn.softmax(
+            jnp.einsum("bsd,de->bse", h, p["router"],
+                       preferred_element_type=jnp.float32), axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, c.experts_per_token)
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+        def expert_ffn(e, x):
+            g = jax.nn.silu(
+                (x @ p["we_gate"][e]).astype(jnp.float32)
+            ).astype(x.dtype)
+            u = x @ p["we_up"][e]
+            return (g * u) @ p["we_down"][e]
+
+        ref = jnp.zeros_like(h)
+        for b in range(h.shape[0]):
+            for s in range(h.shape[1]):
+                acc = jnp.zeros((c.d_model,), dtype=jnp.float32)
+                for j in range(c.experts_per_token):
+                    e = int(gate_idx[b, s, j])
+                    y = expert_ffn(e, h[b, s][None, None, :])[0, 0]
+                    acc = acc + float(gate_vals[b, s, j]) * y.astype(jnp.float32)
+                ref = ref.at[b, s].set(acc.astype(ref.dtype))
+
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32),
+            np.asarray(ref, dtype=np.float32),
+            rtol=0.1, atol=0.05,
+        )
+
+    def test_capacity_overflow_drops_not_crashes(self):
+        c = CFG.with_(capacity_factor=0.25)
+        p = _rand_params(jax.random.PRNGKey(3), c)
+        h = jax.random.normal(jax.random.PRNGKey(4), (1, 32, c.d_model),
+                              dtype=jnp.bfloat16)
+        out, aux = moe_mlp(c, h, p)
+        assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+        # Some tokens must have been dropped at this capacity.
+        dispatch, _, _ = route(c, h, p["router"])
+        placed = float(jnp.sum(dispatch))
+        wanted = h.shape[0] * h.shape[1] * c.experts_per_token
+        assert placed < wanted
+
+
+class TestMoETraining:
+    def test_forward_returns_aux(self):
+        params = init_params(CFG, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+        logits, aux = forward(CFG, params, tokens, return_aux=True)
+        assert logits.shape == (2, 16, CFG.vocab_size)
+        assert float(aux) > 0.0
+
+    def test_train_step_single_device(self):
+        state = init_train_state(CFG, jax.random.PRNGKey(0))
+        step = make_train_step(CFG)
+        batch = synthetic_batch(CFG, batch_size=2, seq_len=32)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert float(metrics["router_aux"]) > 0.0
+        assert int(state.step) == 1
+
+    def test_train_step_expert_parallel_mesh(self):
+        """ep x tp x fsdp: expert axis 2, model 2, fsdp absorbs 2."""
+        mesh = make_mesh(jax.devices()[:8], expert=2, model=2)
+        assert dict(mesh.shape)["expert"] == 2
+        state = init_train_state(CFG, jax.random.PRNGKey(0), mesh=mesh)
+        step = make_train_step(CFG, mesh)
+        batch = synthetic_batch(CFG, batch_size=4, seq_len=32, mesh=mesh)
+        state, metrics = step(state, batch)
+        loss_ep = float(metrics["loss"])
+        assert np.isfinite(loss_ep)
+
+        # Same math without the mesh: losses must agree (routing + experts
+        # are deterministic; only the layout differs).
+        state1 = init_train_state(CFG, jax.random.PRNGKey(0))
+        step1 = make_train_step(CFG)
+        batch1 = synthetic_batch(CFG, batch_size=4, seq_len=32)
+        _, metrics1 = step1(state1, batch1)
+        assert abs(loss_ep - float(metrics1["loss"])) < 0.05
+
+    def test_expert_weights_sharded_over_expert_axis(self):
+        mesh = make_mesh(jax.devices()[:8], expert=2, model=2)
+        state = init_train_state(CFG, jax.random.PRNGKey(0), mesh=mesh)
+        sh = state.params["layers"]["we_gate"].sharding
+        assert "expert" in sh.spec
+
+
+class TestMoEGenerate:
+    def test_decode_matches_forward(self):
+        from dstack_tpu.workloads.generate import generate
+
+        c = CFG.with_(capacity_factor=8.0)
+        params = init_params(c, jax.random.PRNGKey(0))
+        prompt = jnp.array([[5, 7, 11, 13]], dtype=jnp.int32)
+        new = generate(c, params, prompt, max_new_tokens=4, temperature=0.0)
+        assert new.shape == (1, 4)
+
+        # Greedy decode must agree with argmax over the plain forward at
+        # every step (KV-cache path == training forward, MoE included).
+        seq = prompt
+        for t in range(4):
+            logits = forward(c, params, seq)
+            greedy = int(jnp.argmax(logits[0, -1]))
+            assert int(new[0, t]) == greedy, f"step {t}"
+            seq = jnp.concatenate([seq, new[:, t : t + 1]], axis=1)
