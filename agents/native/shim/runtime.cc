@@ -50,13 +50,24 @@ class DockerRuntime : public Runtime {
       if (has_auth) {
         docker_config = "/tmp/dstack-docker-cfg-" + spec.id;
         // Plain mkdir, not mkdir_p: the id is charset-checked at the API
-        // (no traversal) and an already-existing dir means another local
-        // user squatted the predictable path — fail rather than write
-        // credentials into it.
+        // (no traversal). EEXIST from our own leftover (crash between
+        // mkdir and the post-pull rm) is recycled; anything else at the
+        // predictable path (symlink, foreign owner) is squatting — fail
+        // rather than write credentials into it.
         if (mkdir(docker_config.c_str(), 0700) != 0) {
-          fail(task, "creating_container_error",
-               "docker config dir unavailable: " + docker_config);
-          return;
+          struct stat st;
+          bool ours = errno == EEXIST &&
+                      lstat(docker_config.c_str(), &st) == 0 &&
+                      S_ISDIR(st.st_mode) && st.st_uid == getuid();
+          if (ours) {
+            run_command({"rm", "-rf", docker_config}, nullptr);
+            ours = mkdir(docker_config.c_str(), 0700) == 0;
+          }
+          if (!ours) {
+            fail(task, "creating_container_error",
+                 "docker config dir unavailable: " + docker_config);
+            return;
+          }
         }
         // `docker login` with the password over stdin so it never appears
         // in /proc/*/cmdline. The registry host is the first image-ref

@@ -126,7 +126,6 @@ def moe_block(
     x: jnp.ndarray,
     p: Params,
     mesh: Optional[Mesh] = None,
-    norm_fn=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pre-norm MoE block with residual: x -> (x + moe(norm(x)), aux)."""
     from dstack_tpu.workloads.transformer import rms_norm

@@ -1,0 +1,57 @@
+"""Pipeline-parallel fine-tune entrypoint (dp x pp).
+
+The orchestrator injects the JAX coordinator env for multi-host slices;
+workloads.pipeline cuts the layer stack into --stages and streams
+--microbatches through the ppermute ring schedule.
+"""
+
+import argparse
+import os
+
+import jax
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.pipeline import (
+    init_pipeline_state,
+    make_pipeline_mesh,
+    make_pipeline_train_step,
+    pipeline_batch,
+)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="smol-1b", choices=sorted(PRESETS))
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=2048)
+    parser.add_argument("--stages", type=int, default=4)
+    parser.add_argument("--microbatches", type=int, default=8)
+    args = parser.parse_args()
+
+    if int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+        jax.distributed.initialize()
+
+    config = PRESETS[args.preset]
+    n = jax.device_count()
+    if n % args.stages:
+        raise SystemExit(f"--stages {args.stages} must divide {n} devices")
+    mesh = make_pipeline_mesh(jax.devices(), data=n // args.stages, pipe=args.stages)
+    state = init_pipeline_state(config, jax.random.PRNGKey(0), mesh=mesh)
+    step = make_pipeline_train_step(config, mesh, n_microbatches=args.microbatches)
+
+    dp = mesh.shape["data"]
+    per = args.microbatches * dp
+    batch_size = ((args.batch_size + per - 1) // per) * per
+    batch = pipeline_batch(config, batch_size, args.seq_len, mesh=mesh)
+
+    for i in range(args.steps):
+        state, metrics = step(state, batch)
+        if i % 10 == 0 or i == args.steps - 1:
+            if jax.process_index() == 0:
+                print(f"step {i}: loss {float(metrics['loss']):.4f}")
+    print("training complete")
+
+
+if __name__ == "__main__":
+    main()
