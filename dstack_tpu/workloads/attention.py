@@ -235,6 +235,14 @@ def make_attention_fn(
                 return flash_attention(q, k, v, causal=causal)
             return plain_attention(q, k, v, causal=causal)
 
+        def _quadratic(seq_len: int, head_dim: int, dtype_bytes: int = 2) -> bool:
+            # The remat estimator asks whether this path saves O(S^2) score
+            # tensors for backward: only when the flash kernel won't engage.
+            from dstack_tpu.workloads.flash_attention import use_flash
+
+            return not use_flash(seq_len, head_dim, dtype_bytes=dtype_bytes)
+
+        single_device.memory_is_quadratic = _quadratic
         return single_device
 
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
@@ -243,10 +251,17 @@ def make_attention_fn(
     body = functools.partial(
         _ring_attention_local, axis_name=seq_axis, causal=causal
     )
-    return shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_rep=False,
     )
+
+    def ring(q, k, v):
+        return mapped(q, k, v)
+
+    # Ring attention is blockwise per ring step — O(S_local) memory.
+    ring.memory_is_quadratic = lambda *a, **k: False
+    return ring

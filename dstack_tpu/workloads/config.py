@@ -5,8 +5,9 @@ static args. Dimensions are kept multiples of 128 so every matmul tiles
 cleanly onto the 128x128 MXU (pallas_guide: Tiling Constraints).
 """
 
+import os
 from dataclasses import dataclass, replace
-from typing import Dict
+from typing import Dict, Optional, Union
 
 import jax.numpy as jnp
 
@@ -25,7 +26,11 @@ class ModelConfig:
     norm_eps: float = 1e-5
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
-    remat: bool = True  # jax.checkpoint each block: trade FLOPs for HBM
+    # Rematerialization ladder: "none" (save all activations — fastest when
+    # they fit), "dots" (save only batch-free dots), "full" (save nothing),
+    # or "auto" (estimate activation HBM vs what the train state leaves
+    # free and pick — resolve_remat). True/False mean full/none.
+    remat: Union[bool, str] = "auto"
     # Sparse MoE (0 = dense MLP). With n_experts > 0 every block's MLP is
     # a routed top-k SwiGLU expert bank (workloads/moe.py) and d_ff is the
     # per-expert hidden dim.
@@ -44,6 +49,74 @@ class ModelConfig:
 
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
+
+    def param_count(self) -> int:
+        """Approximate parameter count (embedding + head untied)."""
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd = self.head_dim
+        attn = d * (self.n_heads + 2 * self.n_kv_heads) * hd + self.n_heads * hd * d
+        if self.n_experts > 0:
+            mlp = 3 * d * f * self.n_experts + d * self.n_experts
+        else:
+            mlp = 3 * d * f
+        return self.n_layers * (attn + mlp) + 2 * d * v
+
+    def resolve_remat(
+        self,
+        batch_tokens: int,
+        shards: Optional[Dict[str, int]] = None,
+        *,
+        seq_len: Optional[int] = None,
+        attn_scores: bool = False,
+    ) -> str:
+        """Pick the remat policy for a training step of `batch_tokens`
+        (global) on a mesh of `shards` (axis -> size).
+
+        "auto" compares the per-device saved-activation estimate of the
+        no-remat forward against the HBM a device has left after the train
+        state (bf16 params+grads, f32 Adam moments = 12 B/param, divided
+        over the weight-sharding axes). Budget knob: DSTACK_TPU_HBM_GB
+        (default 16, a v5e/v6e chip).
+        """
+        r = self.remat
+        if r is True or r == "full":
+            return "full"
+        if r is False or r == "none":
+            return "none"
+        if r == "dots":
+            return "dots"
+        if r != "auto":
+            raise ValueError(
+                f"remat={r!r}: expected 'auto', 'none', 'dots', 'full' or a bool"
+            )
+        shards = shards or {}
+        hbm = float(os.environ.get("DSTACK_TPU_HBM_GB", "16")) * 2**30
+        weight_shard = (
+            shards.get("fsdp", 1) * shards.get("model", 1)
+            * shards.get("pipe", 1) * shards.get("expert", 1)
+        )
+        act_shard = (
+            shards.get("data", 1) * shards.get("fsdp", 1) * shards.get("seq", 1)
+        )
+        state_bytes = 12 * self.param_count() / weight_shard
+        budget = max(hbm - state_bytes, 0.15 * hbm)
+        d, f = self.d_model, self.d_ff
+        kv = self.n_kv_heads * self.head_dim
+        # MoE: each token funds k routed experts' activations plus the
+        # capacity-factor slack in the dispatch buffers.
+        mlp_width = f * (
+            self.experts_per_token * self.capacity_factor
+            if self.n_experts > 0 else 1
+        )
+        per_token = int((6 * d + 2 * kv + 3 * mlp_width) * 2)  # bf16
+        if attn_scores and seq_len:
+            # Plain (non-flash) attention keeps the f32 score and prob
+            # matrices for backward: O(S) per token per head. The Pallas
+            # flash kernels recompute these in their own backward, which is
+            # exactly what lets long-context no-remat fit.
+            per_token += 2 * seq_len * self.n_heads * 4
+        act_bytes = batch_tokens / max(act_shard, 1) * per_token * self.n_layers
+        return "none" if act_bytes < 0.6 * budget else "dots"
 
     def flops_per_token(self) -> float:
         """Approximate forward+backward FLOPs per token (3x forward).

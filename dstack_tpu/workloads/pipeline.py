@@ -37,7 +37,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dstack_tpu.workloads.attention import make_attention_fn
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.train import TrainState, make_optimizer
-from dstack_tpu.workloads.transformer import _block, init_params, rms_norm
+from dstack_tpu.workloads.transformer import (
+    _block,
+    apply_remat,
+    init_params,
+    rms_norm,
+)
 
 PIPE_AXES = ("data", "pipe")
 
@@ -90,10 +95,18 @@ def _run_stage(config: ModelConfig, x, layers, positions):
         x, _aux = _block(config, x, layer_p, positions, attention)
         return x, None
 
-    if config.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable
-        )
+    # x here is one microbatch on one stage — already per-device. The
+    # estimate must see the stage's slice of the model, not the whole
+    # stack: n_layers/stage for activations, pipe-sharded weights for the
+    # state bytes, and the actual attention path's score memory.
+    n_local = jax.tree_util.tree_leaves(layers)[0].shape[1]
+    stage_cfg = config.with_(n_layers=max(n_local, 1))
+    quadratic = getattr(attention, "memory_is_quadratic", None)
+    body = apply_remat(
+        body, stage_cfg, x.shape[0] * x.shape[1],
+        seq_len=x.shape[1],
+        attn_scores=bool(quadratic and quadratic(x.shape[1], config.head_dim, 2)),
+    )
     x, _ = lax.scan(body, x, jax.tree_util.tree_map(lambda a: a[0], layers))
     return x
 

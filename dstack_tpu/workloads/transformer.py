@@ -115,6 +115,29 @@ def mlp_block(c: ModelConfig, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     return x + (gate * up) @ p["w_down"]
 
 
+def apply_remat(
+    body, c: ModelConfig, n_tokens: int, mesh=None,
+    seq_len: Optional[int] = None, attn_scores: bool = False,
+):
+    """Wrap a scanned block body per the resolved remat policy.
+
+    Shapes inside jit are global, so the per-device estimate divides by
+    the mesh's activation/weight sharding factors (config.resolve_remat).
+    attn_scores marks the plain O(S^2)-memory attention path; the flash
+    kernels recompute scores in backward and don't pay it."""
+    shards = dict(mesh.shape) if mesh is not None else None
+    policy = c.resolve_remat(
+        n_tokens, shards, seq_len=seq_len, attn_scores=attn_scores
+    )
+    if policy == "none":
+        return body
+    policies = {
+        "full": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    return jax.checkpoint(body, policy=policies[policy])
+
+
 def _block(
     c: ModelConfig,
     x: jnp.ndarray,
@@ -161,10 +184,15 @@ def forward(
         x, layer_aux = _block(c, x, layer_p, positions, attn, mesh)
         return (x, aux + layer_aux), None
 
-    if c.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable
-        )
+    quadratic = getattr(attn, "memory_is_quadratic", None)
+    if quadratic is not None:
+        attn_scores = quadratic(tokens.shape[1], c.head_dim, 2)
+    else:
+        attn_scores = attn is plain_attention
+    body = apply_remat(
+        body, c, tokens.shape[0] * tokens.shape[1], mesh,
+        seq_len=tokens.shape[1], attn_scores=attn_scores,
+    )
     (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)

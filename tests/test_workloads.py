@@ -117,3 +117,43 @@ def test_unsharded_train_step_matches_sharded():
     step1 = make_train_step(CFG, mesh)
     _, m1 = step1(s1, synthetic_batch(CFG, batch_size=2, seq_len=32, mesh=mesh))
     assert abs(float(m0["loss"]) - float(m1["loss"])) < 5e-3
+
+
+class TestRematPolicy:
+    def test_explicit_values_respected(self):
+        from dstack_tpu.workloads.config import PRESETS
+
+        c = PRESETS["tiny"]
+        assert c.with_(remat=True).resolve_remat(10**9) == "full"
+        assert c.with_(remat=False).resolve_remat(10**9) == "none"
+        assert c.with_(remat="dots").resolve_remat(1) == "dots"
+        import pytest
+
+        with pytest.raises(ValueError, match="remat"):
+            c.with_(remat="ful").resolve_remat(1)
+
+    def test_auto_scales_with_memory_pressure(self, monkeypatch):
+        from dstack_tpu.workloads.config import PRESETS
+
+        monkeypatch.delenv("DSTACK_TPU_HBM_GB", raising=False)
+
+        small = PRESETS["smol-1b"].with_(n_layers=8, remat="auto")
+        # Bench shape: 4k tokens easily fit -> fastest policy.
+        assert small.resolve_remat(2 * 2048) == "none"
+        # A fat batch on one chip cannot keep every activation.
+        assert small.resolve_remat(256 * 8192) == "dots"
+        # The same fat batch sharded over a big mesh fits again.
+        shards = {"data": 4, "fsdp": 8, "seq": 4}
+        assert small.resolve_remat(256 * 8192, shards) == "none"
+
+    def test_auto_accounts_for_state_bytes(self, monkeypatch):
+        from dstack_tpu.workloads.config import PRESETS
+
+        monkeypatch.delenv("DSTACK_TPU_HBM_GB", raising=False)
+
+        big = PRESETS["llama-8b"].with_(remat="auto")
+        # 8B params of unsharded state alone overflow a 16GB chip: the
+        # budget floors at 15% HBM and even a small batch needs remat.
+        assert big.resolve_remat(8 * 8192) == "dots"
+        # fsdp across 64 chips frees the budget.
+        assert big.resolve_remat(8 * 8192, {"fsdp": 64}) == "none"
