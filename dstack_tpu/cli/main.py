@@ -240,6 +240,19 @@ def _apply_gateway(client, data, name: Optional[str], yes: bool) -> None:
 # --- ps / logs / stop / delete / attach -------------------------------------
 
 
+
+def _run_alias(**kwargs) -> None:
+    """Deprecated alias for `apply` (reference-compat: cli/main.py:60-75)."""
+    click.echo("`run` is deprecated; use `apply`.", err=True)
+    apply.callback(**kwargs)
+
+
+# Shares apply's params so the alias can never drift from the real command.
+cli.add_command(click.Command(
+    name="run", params=list(apply.params), callback=_run_alias, hidden=True,
+    help=_run_alias.__doc__,
+))
+
 @cli.command()
 @click.option("-a", "--all", "show_all", is_flag=True, help="include finished runs")
 @click.option("-v", "--verbose", is_flag=True)

@@ -1,0 +1,67 @@
+"""CLI reference generator: docs/reference/cli.md from the click registry.
+
+`python -m dstack_tpu.cli.reference` rewrites the page;
+tests/test_docs.py fails if the committed page drifts from the code.
+"""
+
+from pathlib import Path
+
+import click
+
+from dstack_tpu.cli.main import cli
+
+HEADER = """# CLI reference
+
+Generated from the command registry — regenerate with
+`python -m dstack_tpu.cli.reference`.
+"""
+
+
+def _command_section(path: str, cmd: click.Command) -> str:
+    ctx = click.Context(cmd, info_name=path)
+    usage = cmd.get_usage(ctx).removeprefix("Usage: ").strip()
+    lines = [f"## `{path}`", "", cmd.help or cmd.short_help or "", ""]
+    lines += ["```", usage, "```", ""]
+    opts = [
+        p for p in cmd.params
+        if isinstance(p, click.Option) and not p.hidden
+    ]
+    if opts:
+        lines.append("| Option | Description |")
+        lines.append("|---|---|")
+        for o in opts:
+            names = ", ".join(f"`{n}`" for n in o.opts + o.secondary_opts)
+            lines.append(f"| {names} | {o.help or ''} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def generate_reference() -> str:
+    sections = [HEADER]
+
+    def walk(path: str, cmd: click.Command) -> None:
+        if getattr(cmd, "hidden", False):
+            return
+        if isinstance(cmd, click.Group):
+            if path != "dstack-tpu":
+                sections.append(
+                    f"## `{path}`\n\n{cmd.help or ''}\n"
+                )
+            for name in sorted(cmd.commands):
+                walk(f"{path} {name}", cmd.commands[name])
+        else:
+            sections.append(_command_section(path, cmd))
+
+    walk("dstack-tpu", cli)
+    return "\n".join(sections).rstrip() + "\n"
+
+
+def main() -> None:
+    out = Path(__file__).resolve().parents[2] / "docs" / "reference" / "cli.md"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(generate_reference())
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

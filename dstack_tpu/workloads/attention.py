@@ -262,6 +262,23 @@ def make_attention_fn(
     def ring(q, k, v):
         return mapped(q, k, v)
 
-    # Ring attention is blockwise per ring step — O(S_local) memory.
-    ring.memory_is_quadratic = lambda *a, **k: False
+    n_seq_shards = mesh.shape[seq_axis]
+
+    def _ring_quadratic(seq_len: int, head_dim: int, dtype_bytes: int = 2) -> bool:
+        # Mirror _ring_block_impl's gate on the LOCAL block: with the fused
+        # kernel, memory is O(S_local); the jnp fallback saves f32
+        # (B,H,Sq,Sk) residuals per ring step across the scan.
+        import os
+
+        s_local = max(seq_len // n_seq_shards, 1)
+        if os.getenv("DSTACK_TPU_FLASH_RING", "auto") == "0":
+            return True
+        from dstack_tpu.workloads.flash_attention import use_flash
+
+        interpret = os.getenv("DSTACK_TPU_FLASH_RING") == "interpret"
+        return not use_flash(
+            s_local, head_dim, dtype_bytes=dtype_bytes, interpret=interpret
+        )
+
+    ring.memory_is_quadratic = _ring_quadratic
     return ring

@@ -47,6 +47,10 @@ class ModelConfig:
     def activation_dtype(self):
         return _DTYPE[self.dtype]
 
+    @property
+    def dtype_bytes(self) -> int:
+        return jnp.dtype(_DTYPE[self.dtype]).itemsize
+
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
 
@@ -108,7 +112,7 @@ class ModelConfig:
             self.experts_per_token * self.capacity_factor
             if self.n_experts > 0 else 1
         )
-        per_token = int((6 * d + 2 * kv + 3 * mlp_width) * 2)  # bf16
+        per_token = int((6 * d + 2 * kv + 3 * mlp_width) * self.dtype_bytes)
         if attn_scores and seq_len:
             # Plain (non-flash) attention keeps the f32 score and prob
             # matrices for backward: O(S) per token per head. The Pallas

@@ -83,9 +83,13 @@ def _param_specs(params_like: Dict) -> Dict:
     return jax.tree_util.tree_map_with_path(spec_for, params_like)
 
 
-def _run_stage(config: ModelConfig, x, layers, positions):
+def _run_stage(config: ModelConfig, x, layers, positions, n_ticks: int = 1):
     """Apply this device's L/P layers (leading local dim is 1 after
-    shard_map slicing; the scan runs over the per-stage layer stack)."""
+    shard_map slicing; the scan runs over the per-stage layer stack).
+
+    n_ticks: how many invocations the surrounding tick scan makes — its
+    backward holds every tick's stage residuals simultaneously, so the
+    remat estimate must charge all of them, not one microbatch."""
     # make_attention_fn(None) is the single-device path: the Pallas flash
     # kernel when shapes qualify, plain fused attention otherwise — same
     # choice the dense trainer makes within one shard.
@@ -103,9 +107,12 @@ def _run_stage(config: ModelConfig, x, layers, positions):
     stage_cfg = config.with_(n_layers=max(n_local, 1))
     quadratic = getattr(attention, "memory_is_quadratic", None)
     body = apply_remat(
-        body, stage_cfg, x.shape[0] * x.shape[1],
+        body, stage_cfg, x.shape[0] * x.shape[1] * n_ticks,
         seq_len=x.shape[1],
-        attn_scores=bool(quadratic and quadratic(x.shape[1], config.head_dim, 2)),
+        attn_scores=bool(
+            quadratic
+            and quadratic(x.shape[1], config.head_dim, config.dtype_bytes)
+        ),
     )
     x, _ = lax.scan(body, x, jax.tree_util.tree_map(lambda a: a[0], layers))
     return x
@@ -142,7 +149,10 @@ def _pipeline_loss(
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
         )
         cur = jnp.where(p_idx == 0, inject, state)
-        cur = _run_stage(config, cur, params["layers"], positions)
+        cur = _run_stage(
+            config, cur, params["layers"], positions,
+            n_ticks=n_micro + n_stages - 1,
+        )
         out_idx = t - (n_stages - 1)
         collect = (p_idx == n_stages - 1) & (out_idx >= 0)
         slot = jnp.clip(out_idx, 0, n_micro - 1)

@@ -186,7 +186,7 @@ def forward(
 
     quadratic = getattr(attn, "memory_is_quadratic", None)
     if quadratic is not None:
-        attn_scores = quadratic(tokens.shape[1], c.head_dim, 2)
+        attn_scores = quadratic(tokens.shape[1], c.head_dim, c.dtype_bytes)
     else:
         attn_scores = attn is plain_attention
     body = apply_remat(

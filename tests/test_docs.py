@@ -1,0 +1,46 @@
+"""Docs stay truthful: generated CLI reference in sync, links resolve."""
+
+import re
+from pathlib import Path
+
+DOCS = Path(__file__).resolve().parent.parent / "docs"
+
+
+def test_cli_reference_in_sync():
+    from dstack_tpu.cli.reference import generate_reference
+
+    committed = (DOCS / "reference" / "cli.md").read_text()
+    assert committed == generate_reference(), (
+        "docs/reference/cli.md is stale — run `python -m dstack_tpu.cli.reference`"
+    )
+
+
+def test_internal_links_resolve():
+    link_re = re.compile(r"\]\((?!https?://|#)([^)#]+)")
+    broken = []
+    for page in DOCS.rglob("*.md"):
+        for target in link_re.findall(page.read_text()):
+            if not (page.parent / target).exists():
+                broken.append(f"{page.relative_to(DOCS)} -> {target}")
+    assert not broken, broken
+
+
+def test_sdk_snippet_names_exist():
+    from dstack_tpu.api.client import Client, Run, RunCollection
+
+    assert hasattr(Client, "from_config")
+    for name in ("get_plan", "exec_plan", "submit"):
+        assert hasattr(RunCollection, name)
+    for name in ("logs", "attach", "stop"):
+        assert hasattr(Run, name)
+
+
+def test_index_table_covers_pages():
+    index = (DOCS / "index.md").read_text()
+    for page in ("quickstart.md", "concepts/runs.md", "concepts/fleets.md",
+                 "concepts/volumes.md", "concepts/services.md",
+                 "guides/multihost.md", "guides/server.md",
+                 "guides/workloads.md", "reference/cli.md",
+                 "reference/api.md"):
+        assert page in index, f"index.md missing link to {page}"
+        assert (DOCS / page).exists()
