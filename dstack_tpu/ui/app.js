@@ -41,7 +41,7 @@ function table(headers, rows, rowAttrs) {
     : `<tr><td colspan="${headers.length}" class="muted">Nothing here yet.</td></tr>`;
   return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
 }
-function stopLogFollow() { state.logGen++; if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; } }
+function stopLogFollow() { state.logGen++; state.metricsGen = (state.metricsGen || 0) + 1; if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; } }
 
 /* ---- views ---------------------------------------------------------- */
 
@@ -93,13 +93,18 @@ const views = {
       </div>
       <div class="section">Jobs</div>
       ${table(["Job", "Status", "Instance", "Host", "Worker", "Reason", "Submission"], jobRows)}
+      <div class="section">Host metrics <span class="muted">(10s samples)</span></div>
+      <div id="metrics-box"><span class="muted">Loading…</span></div>
       <div class="section">Logs <span class="muted" id="log-state">(following)</span></div>
       <pre class="logs" id="log-box"></pre>`;
     return { title: `Run <span class="crumb">/</span> ${esc(state.runName)}`, html, after() {
       $("#back-btn").onclick = () => navigate(state.project, "runs");
       $("#stop-btn").onclick = async () => { await api(`/api/project/${state.project}/runs/stop`, { runs_names: [state.runName], abort: false }); render(); };
       $("#delete-btn").onclick = async () => { await api(`/api/project/${state.project}/runs/delete`, { runs_names: [state.runName] }); navigate(state.project, "runs"); };
+      // Order matters: followLogs -> stopLogFollow bumps BOTH generations,
+      // so the metrics poller must start after it.
       followLogs(run);
+      followMetrics();
     } };
   },
 
@@ -208,6 +213,52 @@ function latestJpd(run) {
     if (subs.length && subs[subs.length - 1].job_provisioning_data) return subs[subs.length - 1].job_provisioning_data;
   }
   return null;
+}
+
+function fmtBytes(n) {
+  if (n == null) return "—";
+  const units = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let u = 0;
+  while (n >= 1024 && u < units.length - 1) { n /= 1024; u++; }
+  return `${n.toFixed(u ? 1 : 0)} ${units[u]}`;
+}
+
+function followMetrics() {
+  // Own generation: each (re)render bails the previous poller; navigating
+  // away removes #metrics-box, which also ends the loop.
+  state.metricsGen = (state.metricsGen || 0) + 1;
+  const myGen = state.metricsGen;
+  let rendered = false;
+  const tick = async () => {
+    if (myGen !== state.metricsGen) return;
+    const box = $("#metrics-box");
+    if (!box) return;
+    try {
+      const out = await api(`/api/project/${state.project}/metrics/run/${encodeURIComponent(state.runName)}`);
+      if (myGen !== state.metricsGen || !$("#metrics-box")) return;
+      const rows = (out.hosts || []).map((h) => [
+        esc(`${h.replica_num}/${h.job_num}`),
+        esc(h.cpu_percent != null ? h.cpu_percent.toFixed(0) + "%" : "—"),
+        esc(fmtBytes(h.memory_usage_bytes)),
+        esc(String(h.tpu_chips ?? 0)),
+        esc(h.tpu_duty_cycle_percent != null ? h.tpu_duty_cycle_percent.toFixed(0) + "%" : "—"),
+        esc(h.tpu_hbm_usage_bytes != null
+          ? `${fmtBytes(h.tpu_hbm_usage_bytes)}${h.tpu_hbm_total_bytes ? " / " + fmtBytes(h.tpu_hbm_total_bytes) : ""}`
+          : "—"),
+      ]);
+      $("#metrics-box").innerHTML = table(
+        ["Replica/Job", "CPU", "Memory", "Chips", "TPU util", "HBM"], rows);
+      rendered = true;
+    } catch (e) {
+      if (e instanceof AuthError) return showLogin();
+      // Keep the last good table through transient poll errors; only an
+      // empty view gets the placeholder.
+      const b = $("#metrics-box");
+      if (b && !rendered) b.innerHTML = `<span class="muted">No metrics yet.</span>`;
+    }
+    if (myGen === state.metricsGen && $("#metrics-box")) setTimeout(tick, 5000);
+  };
+  tick();
 }
 
 function followLogs(run) {
