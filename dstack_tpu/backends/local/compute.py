@@ -45,6 +45,11 @@ class LocalBackendConfig(CoreModel):
     tpu_sim: List[str] = []
     cpu_offers: bool = True
     price_per_hour: float = 0.0
+    # Path to the C++ runner binary (agents/native/build/dstack-tpu-runner)
+    # to spawn instead of the Python twin — the same --host/--port/--port-file
+    # contract, so the whole control plane can be e2e'd against the native
+    # agent stack.
+    runner_binary: Optional[str] = None
 
 
 class LocalCompute(Compute):
@@ -109,11 +114,18 @@ class LocalCompute(Compute):
         port_dir = tempfile.mkdtemp(prefix="dstack-local-runner-")
         for worker in range(offer.hosts):
             port_file = os.path.join(port_dir, f"w{worker}.port")
-            proc = subprocess.Popen(
-                [
+            if self.config.runner_binary:
+                argv = [
+                    self.config.runner_binary,
+                    "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
+                ]
+            else:
+                argv = [
                     sys.executable, "-S", "-m", "dstack_tpu.agents.runner",
                     "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
-                ],
+                ]
+            proc = subprocess.Popen(
+                argv,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
                 env={**os.environ, **(env or {}), "PYTHONPATH": pythonpath,

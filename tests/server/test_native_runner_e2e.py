@@ -1,0 +1,119 @@
+"""Full control-plane e2e against the C++ runner binary.
+
+The local backend spawns `agents/native/build/dstack-tpu-runner` (same
+--host/--port/--port-file contract as the Python twin), so the whole
+submit -> provision -> code upload -> run -> logs -> done pipeline is
+exercised against the native agent — including a simulated multi-host TPU
+gang with the JAX env injected by the C++ executor.
+"""
+
+import base64
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from dstack_tpu.server.http import response_json
+from tests.server.conftest import make_server
+from tests.server.test_runs_e2e import _task_body, _wait_run
+
+ROOT = Path(__file__).resolve().parent.parent.parent
+NATIVE = ROOT / "agents" / "native"
+RUNNER = NATIVE / "build" / "dstack-tpu-runner"
+
+
+@pytest.fixture(scope="session")
+def native_runner():
+    if not shutil.which("cmake"):
+        pytest.skip("cmake not available")
+    subprocess.run(
+        ["cmake", "-B", "build", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        cwd=NATIVE, check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", "build"], cwd=NATIVE, check=True, capture_output=True
+    )
+    return str(RUNNER)
+
+
+async def _poll_text(fx, run_name, sub_id):
+    resp = await fx.client.post(
+        "/api/project/main/logs/poll",
+        json_body={"run_name": run_name, "job_submission_id": sub_id},
+    )
+    logs = response_json(resp)["logs"]
+    return b"".join(base64.b64decode(e["message"]) for e in logs).decode()
+
+
+async def test_single_job_on_native_runner(native_runner):
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {"runner_binary": native_runner}
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo native-$DSTACK_RUN_NAME", "echo rc=$?"], "native-run"
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(fx, "native-run", {"done", "failed", "terminated"})
+        assert run["status"] == "done", run
+        sub = run["jobs"][0]["job_submissions"][-1]
+        text = await _poll_text(fx, "native-run", sub["id"])
+        assert "native-native-run" in text
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_tpu_gang_on_native_runner(native_runner):
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {
+        "runner_binary": native_runner, "tpu_sim": ["v5litepod-16"],
+    }
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo rank=$JAX_PROCESS_ID/$JAX_NUM_PROCESSES coord=$JAX_COORDINATOR_ADDRESS"],
+                "native-gang",
+                resources={"tpu": "v5litepod-16"},
+            ),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(
+            fx, "native-gang", {"done", "failed", "terminated"}, timeout=60
+        )
+        assert run["status"] == "done", run
+        texts = []
+        for job in run["jobs"]:
+            sub = job["job_submissions"][-1]
+            texts.append(await _poll_text(fx, "native-gang", sub["id"]))
+        joined = "\n".join(texts)
+        for rank in range(4):
+            assert f"rank={rank}/4" in joined, joined
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_secrets_reach_native_runner(native_runner):
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {"runner_binary": native_runner}
+    try:
+        await fx.client.post(
+            "/api/project/main/secrets/create_or_update",
+            json_body={"name": "tok", "value": "n4tive"},
+        )
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo got=$T"], "native-secret",
+                env={"T": "${{ secrets.tok }}"},
+            ),
+        )
+        run = await _wait_run(fx, "native-secret", {"done", "failed", "terminated"})
+        assert run["status"] == "done", run
+        sub = run["jobs"][0]["job_submissions"][-1]
+        assert "got=n4tive" in await _poll_text(fx, "native-secret", sub["id"])
+    finally:
+        await fx.app.shutdown()
