@@ -1,0 +1,331 @@
+"""Continuous-batching decode engine (JetStream-style), TPU-native.
+
+`generate.py` decodes one request at a time; this module keeps a fixed
+batch of B *slots* stepping together so new requests join mid-flight and
+finished ones free their slot immediately — the standard way to keep the
+MXU busy while serving many streams. Everything is static-shaped and
+compiles three kinds of program:
+
+- prefill (one per prompt-length bucket): runs the prompt through the
+  cached forward, returns the slot's KV rows + first-token logits;
+- insert: writes a prefilled slot into the shared decode state (donated);
+- decode_step: one token for ALL active slots — per-slot positions, a
+  per-row validity mask instead of generate.py's shared scalar length.
+
+The host loop (`ServingEngine`) owns request queues and streams tokens
+out as they land, which is what SSE serving wants. Greedy decoding keeps
+slot results bit-identical to `generate(temperature=0)` — pinned by
+tests/test_serving.py.
+
+Prefill/insert compile once per distinct prompt LENGTH — callers should
+bucket prompts (pad at the content level like the example server does,
+or truncate) so the compile cache stays small; decode_step compiles once
+regardless.
+"""
+
+import functools
+import queue
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
+from dstack_tpu.workloads.config import ModelConfig
+from dstack_tpu.workloads.generate import KVCache, _forward_cached
+from dstack_tpu.workloads.transformer import (
+    mlp_block,
+    project_qkv,
+    rms_norm,
+)
+
+Params = Dict[str, Any]
+
+
+class DecodeState(NamedTuple):
+    """Shared slot state: k/v (L, B, max_len, KV, hd), per-slot scalars."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray      # (B,) filled cache positions
+    last_token: jnp.ndarray   # (B,) next token to feed
+    active: jnp.ndarray       # (B,) bool
+    remaining: jnp.ndarray    # (B,) new tokens still budgeted
+
+
+def init_decode_state(config: ModelConfig, batch: int, max_len: int) -> DecodeState:
+    c = config
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    return DecodeState(
+        k=jnp.zeros(shape, c.activation_dtype),
+        v=jnp.zeros(shape, c.activation_dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+        last_token=jnp.zeros((batch,), jnp.int32),
+        active=jnp.zeros((batch,), bool),
+        remaining=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def _decode_attention(q, ck, cv, valid_len):
+    """q (B, 1, H, hd) vs cache (B, max_len, KV, hd); per-ROW validity
+    (generate._cached_attention masks per-position instead — decode slots
+    are at different lengths)."""
+    b, s, h, hd = q.shape
+    k = _repeat_kv(ck, h // ck.shape[2])
+    v = _repeat_kv(cv, h // ck.shape[2])
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    kpos = jnp.arange(ck.shape[1], dtype=jnp.int32)
+    mask = kpos[None, :] < valid_len[:, None]          # (B, max_len)
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype).reshape(b, s, h * hd)
+
+
+def make_prefill(config: ModelConfig):
+    """prefill(params, tokens (1, S)) -> (k (L,1,S,KV,hd), v, logits (V,)).
+    Jit once per prompt bucket S."""
+    c = config
+
+    @jax.jit
+    def prefill(params, tokens):
+        cache = KVCache(
+            k=jnp.zeros(
+                (c.n_layers, 1, tokens.shape[1], c.n_kv_heads, c.head_dim),
+                c.activation_dtype,
+            ),
+            v=jnp.zeros(
+                (c.n_layers, 1, tokens.shape[1], c.n_kv_heads, c.head_dim),
+                c.activation_dtype,
+            ),
+            length=jnp.zeros((), jnp.int32),
+        )
+        logits, cache = _forward_cached(c, params, tokens, cache)
+        return cache.k, cache.v, logits[0]
+
+    return prefill
+
+
+def make_insert():
+    """insert(state, slot, k_rows, v_rows, seq_len, token, budget) — write a
+    prefilled request into a free slot. One compile per prefill bucket
+    (k_rows' S differs); slot/lengths are traced."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def insert(state: DecodeState, slot, k_rows, v_rows, seq_len, token, budget):
+        return DecodeState(
+            k=lax.dynamic_update_slice(state.k, k_rows, (0, slot, 0, 0, 0)),
+            v=lax.dynamic_update_slice(state.v, v_rows, (0, slot, 0, 0, 0)),
+            lengths=state.lengths.at[slot].set(seq_len),
+            last_token=state.last_token.at[slot].set(token),
+            active=state.active.at[slot].set(True),
+            remaining=state.remaining.at[slot].set(budget),
+        )
+
+    return insert
+
+
+def make_decode_step(config: ModelConfig, temperature: float = 0.0):
+    """decode_step(params, state, rng) -> (state, tokens (B,), active (B,)).
+    One token for every active slot per call; greedy at temperature 0,
+    categorical sampling otherwise (rng consumed per step)."""
+    c = config
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def decode_step(params, state: DecodeState, rng):
+        B = state.lengths.shape[0]
+        tokens = state.last_token[:, None]                 # (B, 1)
+        positions = state.lengths[:, None]                 # (B, 1) per-slot
+        x = jnp.take(params["embed"], tokens, axis=0)
+
+        rows = jnp.arange(B)
+
+        def body(x, layer):
+            p, ck, cv = layer
+            q, k, v = project_qkv(c, x, p, positions)
+            ck = ck.at[rows, state.lengths].set(k[:, 0].astype(ck.dtype))
+            cv = cv.at[rows, state.lengths].set(v[:, 0].astype(cv.dtype))
+            attn = _decode_attention(q, ck, cv, state.lengths + 1)
+            x = x + attn @ p["wo"]
+            if c.n_experts > 0:
+                from dstack_tpu.workloads.moe import moe_block
+
+                x, _ = moe_block(c, x, p)
+            else:
+                x = mlp_block(c, x, p)
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
+        h = rms_norm(x, params["final_norm"], c.norm_eps)
+        logits = (h[:, -1].astype(jnp.float32)) @ params["lm_head"].astype(jnp.float32)
+        if temperature > 0:
+            next_token = jax.random.categorical(
+                rng, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+        else:
+            next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        act = state.active
+        remaining = state.remaining - act.astype(jnp.int32)
+        # A slot also retires when its cache is full (the NEXT write would
+        # land at row lengths+1, which must stay < max_len).
+        new_active = act & (remaining > 0) & (state.lengths + 2 <= state.k.shape[2])
+        new_state = DecodeState(
+            k=new_k,
+            v=new_v,
+            lengths=state.lengths + act.astype(jnp.int32),
+            last_token=jnp.where(act, next_token, state.last_token),
+            active=new_active,
+            remaining=remaining,
+        )
+        return new_state, jnp.where(act, next_token, -1), new_active
+
+    return decode_step
+
+
+class _Request(NamedTuple):
+    tokens: List[int]
+    max_new_tokens: int
+    out: "queue.Queue[Optional[int]]"   # tokens; None = done
+
+
+class ServingEngine:
+    """Continuous-batching host loop around the jitted trio.
+
+    submit() returns a queue yielding generated token ids as they decode
+    (None terminates) — callers stream them straight out (SSE) or collect.
+    """
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        params: Params,
+        *,
+        slots: int = 8,
+        max_len: Optional[int] = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ):
+        self.config = config
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len or config.max_seq_len
+        self._prefill = make_prefill(config)
+        self._insert = make_insert()
+        self._step = make_decode_step(config, temperature)
+        self._temperature = temperature
+        self._rng = jax.random.PRNGKey(seed)
+        self.state = init_decode_state(config, slots, self.max_len)
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._live: List[Optional[_Request]] = [None] * slots
+        self._wake = threading.Event()
+        self._stop = False
+        self._failed: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(
+        self, tokens: List[int], max_new_tokens: int
+    ) -> "queue.Queue[Optional[int]]":
+        if self._failed is not None:
+            raise RuntimeError(f"serving engine failed: {self._failed}")
+        if not tokens:
+            raise ValueError("empty prompt")
+        # The last decode write lands at cache row len + max_new - 2, so
+        # len + max_new == max_len exactly fills the cache.
+        if len(tokens) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_new_tokens {max_new_tokens}"
+                f" must not exceed max_len {self.max_len}"
+            )
+        out: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._pending.put(_Request(list(tokens), max_new_tokens, out))
+        self._wake.set()
+        return out
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+        self._flush_all()
+
+    def _flush_all(self) -> None:
+        """Terminate every consumer: no out.get() may hang forever."""
+        for slot, req in enumerate(self._live):
+            if req is not None:
+                req.out.put(None)
+                self._live[slot] = None
+        while True:
+            try:
+                self._pending.get_nowait().out.put(None)
+            except queue.Empty:
+                return
+
+    # -- loop ----------------------------------------------------------------
+
+    def _admit(self) -> None:
+        for slot in range(self.slots):
+            if self._live[slot] is not None:
+                continue
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            toks = jnp.asarray([req.tokens], dtype=jnp.int32)
+            k_rows, v_rows, logits = self._prefill(self.params, toks)
+            if self._temperature > 0:
+                self._rng, sub = jax.random.split(self._rng)
+                first = int(jax.random.categorical(sub, logits / self._temperature))
+            else:
+                first = int(jnp.argmax(logits))
+            req.out.put(first)
+            self.state = self._insert(
+                self.state, slot, k_rows, v_rows, len(req.tokens), first,
+                req.max_new_tokens - 1,
+            )
+            if req.max_new_tokens <= 1:
+                req.out.put(None)
+                self.state = self._retire(slot)
+            else:
+                self._live[slot] = req
+
+    def _retire(self, slot: int) -> DecodeState:
+        s = self.state
+        return s._replace(
+            active=s.active.at[slot].set(False),
+            remaining=s.remaining.at[slot].set(0),
+        )
+
+    def _loop(self) -> None:
+        while not self._stop:
+            try:
+                self._admit()
+                if not any(r is not None for r in self._live):
+                    self._wake.wait(timeout=0.2)
+                    self._wake.clear()
+                    continue
+                self._rng, sub = jax.random.split(self._rng)
+                self.state, tokens, active = self._step(
+                    self.params, self.state, sub
+                )
+                toks = jax.device_get(tokens)
+                still = jax.device_get(active)
+                for slot, req in enumerate(self._live):
+                    if req is None:
+                        continue
+                    if toks[slot] >= 0:
+                        req.out.put(int(toks[slot]))
+                    if not still[slot]:
+                        req.out.put(None)
+                        self._live[slot] = None
+            except Exception as e:  # device/compile error: fail loudly, not
+                # by wedging every consumer on a dead queue.
+                self._failed = e
+                self._flush_all()
+                raise

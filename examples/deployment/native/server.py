@@ -1,10 +1,12 @@
-"""Framework-native model server: workloads.generate behind an
+"""Framework-native model server: the continuous-batching engine behind an
 OpenAI-compatible HTTP API.
 
 The JetStream/vLLM examples bring external engines; this one serves the
 same llama-family checkpoints with dstack-tpu's own KV-cache decode loop
 (workloads/generate.py) — the whole stack, orchestrator to tokens, is this
-repo. Endpoints: GET /v1/models, POST /v1/chat/completions (non-stream).
+repo. Endpoints: GET /v1/models, POST /v1/chat/completions
+(stream and non-stream), served by the continuous-batching engine
+(workloads/serving.py).
 
 The tokenizer here is a toy byte-level one so the example runs without
 downloading a vocab (zero-egress test environments); swap in your
@@ -14,7 +16,6 @@ tokenizer for real checkpoints.
 import argparse
 import itertools
 import json
-import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -22,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from dstack_tpu.workloads.config import PRESETS
-from dstack_tpu.workloads.generate import generate
+from dstack_tpu.workloads.serving import ServingEngine
 from dstack_tpu.workloads.transformer import init_params
 
 
@@ -39,10 +40,6 @@ class Engine:
                 f" max_seq_len {self.config.max_seq_len} for {preset}"
             )
         self.max_new_tokens = max_new_tokens
-        self._seed = itertools.count(
-            int.from_bytes(__import__("os").urandom(4), "big")
-        )
-        self._seed_lock = threading.Lock()
         if checkpoint_dir:
             from dstack_tpu.workloads import checkpoint as ckpt
             from dstack_tpu.workloads.transformer import init_params as _init
@@ -60,11 +57,10 @@ class Engine:
             self.params = params
         else:
             self.params = init_params(self.config, jax.random.PRNGKey(0))
-        self._generate = jax.jit(
-            lambda p, t, key: generate(
-                self.config, p, t, max_new_tokens=max_new_tokens,
-                temperature=0.8, rng=key,
-            )
+        # Continuous batching: concurrent requests share one decode batch
+        # (workloads/serving.py) instead of queueing behind each other.
+        self.serving = ServingEngine(
+            self.config, self.params, slots=8, temperature=0.8,
         )
 
     def encode(self, text: str) -> jnp.ndarray:
@@ -86,15 +82,23 @@ class Engine:
     def decode(self, ids) -> str:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
-    def chat(self, messages) -> str:
+    def chat_stream(self, messages):
+        """Yield decoded text fragments as tokens land (continuous batch)."""
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
         )
         tokens = self.encode(prompt + "\nassistant:")
-        with self._seed_lock:  # unique per request even within one ms
-            seed = next(self._seed) % (2**31)
-        out = self._generate(self.params, tokens, jax.random.PRNGKey(seed))
-        return self.decode(out[0])
+        out = self.serving.submit(
+            [int(t) for t in tokens[0]], max_new_tokens=self.max_new_tokens
+        )
+        while True:
+            tok = out.get()
+            if tok is None:
+                return
+            yield self.decode([tok])
+
+    def chat(self, messages) -> str:
+        return "".join(self.chat_stream(messages))
 
 
 def main() -> None:
@@ -121,6 +125,39 @@ def main() -> None:
             self.end_headers()
             self.wfile.write(body)
 
+        def _stream(self, req) -> None:
+            """OpenAI-style SSE: one delta chunk per generated token."""
+            # Pull the first piece BEFORE committing the 200/SSE headers, so
+            # submit-time errors surface as a clean JSON 500 instead of a
+            # second status line spliced into the event stream.
+            pieces = engine.chat_stream(req.get("messages", []))
+            try:
+                first = next(pieces)
+            except StopIteration:
+                first = ""
+            except Exception as e:
+                return self._send(500, {"error": str(e)})
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            for i, piece in enumerate(itertools.chain([first], pieces)):
+                chunk = {
+                    "id": "chatcmpl-native",
+                    "object": "chat.completion.chunk",
+                    "created": int(time.time()),
+                    "model": args.model_name,
+                    "choices": [{
+                        "index": 0,
+                        "delta": {"content": piece} if i else
+                                 {"role": "assistant", "content": piece},
+                        "finish_reason": None,
+                    }],
+                }
+                self.wfile.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                self.wfile.flush()
+            self.wfile.write(b"data: [DONE]\n\n")
+
         def do_GET(self):
             if self.path.rstrip("/") == "/v1/models":
                 return self._send(200, {
@@ -136,6 +173,8 @@ def main() -> None:
             length = int(self.headers.get("Content-Length", 0))
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
+                if req.get("stream"):
+                    return self._stream(req)
                 text = engine.chat(req.get("messages", []))
             except Exception as e:  # surface engine errors as API errors
                 return self._send(500, {"error": str(e)})

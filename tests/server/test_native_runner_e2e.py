@@ -25,8 +25,8 @@ RUNNER = NATIVE / "build" / "dstack-tpu-runner"
 
 @pytest.fixture(scope="session")
 def native_runner():
-    if not shutil.which("cmake"):
-        pytest.skip("cmake not available")
+    if not shutil.which("cmake") or not shutil.which("ninja"):
+        pytest.skip("cmake+ninja not available")
     subprocess.run(
         ["cmake", "-B", "build", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
         cwd=NATIVE, check=True, capture_output=True,

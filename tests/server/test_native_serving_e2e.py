@@ -96,6 +96,33 @@ async def test_native_model_serving_end_to_end():
         assert isinstance(content, str) and len(content) >= 1
         assert body["model"] == "tiny-native"
 
+        # Streaming (SSE) through the proxy: one delta chunk per token from
+        # the continuous-batching engine, [DONE] terminated.
+        resp = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            json_body={
+                "model": "tiny-native", "stream": True,
+                "messages": [{"role": "user", "content": "stream me"}],
+            },
+        )
+        assert resp.status == 200, resp.body
+        raw = resp.body
+        if resp.stream is not None:  # streamed responses arrive as chunks
+            async for chunk in resp.stream:
+                raw += chunk
+        events = [
+            line for line in raw.decode().split("\n\n")
+            if line.startswith("data: ")
+        ]
+        assert events[-1] == "data: [DONE]"
+        chunks = [json.loads(e[len("data: "):]) for e in events[:-1]]
+        assert len(chunks) >= 2  # multiple tokens streamed
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        streamed = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        assert len(streamed) >= 1
+
         # Stop the service; the run terminates cleanly.
         await fx.client.post(
             "/api/project/main/runs/stop", json_body={"runs_names": ["native-svc"]}

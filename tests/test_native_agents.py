@@ -28,8 +28,8 @@ BUILD = NATIVE / "build"
 
 @pytest.fixture(scope="session")
 def binaries():
-    if not shutil.which("cmake"):
-        pytest.skip("cmake not available")
+    if not shutil.which("cmake") or not shutil.which("ninja"):
+        pytest.skip("cmake+ninja not available")
     subprocess.run(
         ["cmake", "-B", "build", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
         cwd=NATIVE, check=True, capture_output=True,
