@@ -131,14 +131,20 @@ def make_insert():
     return insert
 
 
-def make_decode_step(config: ModelConfig, temperature: float = 0.0):
-    """decode_step(params, state, rng) -> (state, tokens (B,), active (B,)).
-    One token for every active slot per call; greedy at temperature 0,
-    categorical sampling otherwise (rng consumed per step)."""
+def make_decode_step(
+    config: ModelConfig, temperature: float = 0.0, steps: int = 1
+):
+    """decode_step(params, state, rng) -> (state, tokens (B, steps), active).
+
+    `steps` tokens for every active slot per call — the inner scan stays on
+    device, so one host sync delivers a chunk of tokens per slot. Larger
+    chunks amortize dispatch/readback latency (critical over tunneled
+    transports, still a win locally) at the cost of up-to-`steps`-step
+    admission latency for new requests. Greedy at temperature 0,
+    categorical sampling otherwise (rng consumed per call)."""
     c = config
 
-    @functools.partial(jax.jit, donate_argnums=1)
-    def decode_step(params, state: DecodeState, rng):
+    def one_step(params, state: DecodeState, rng):
         B = state.lengths.shape[0]
         tokens = state.last_token[:, None]                 # (B, 1)
         positions = state.lengths[:, None]                 # (B, 1) per-slot
@@ -186,13 +192,29 @@ def make_decode_step(config: ModelConfig, temperature: float = 0.0):
         )
         return new_state, jnp.where(act, next_token, -1), new_active
 
-    return decode_step
+    @functools.partial(jax.jit, donate_argnums=1)
+    def decode_steps(params, state: DecodeState, rng):
+        def body(carry, step_rng):
+            st, _ = carry
+            st, toks, active = one_step(params, st, step_rng)
+            return (st, active), toks
+
+        (state, active), toks = lax.scan(
+            body,
+            (state, state.active),
+            jax.random.split(rng, steps),
+        )
+        return state, toks.T, active  # (B, steps)
+
+    return decode_steps
 
 
 class _Request(NamedTuple):
     tokens: List[int]
     max_new_tokens: int
-    out: "queue.Queue[Optional[int]]"   # tokens; None = done
+    # Yields int tokens; None = clean end; an Exception = engine failure
+    # (consumers must re-raise, not treat partial output as complete).
+    out: "queue.Queue[object]"
 
 
 class ServingEngine:
@@ -211,6 +233,7 @@ class ServingEngine:
         max_len: Optional[int] = None,
         temperature: float = 0.0,
         seed: int = 0,
+        steps_per_sync: int = 4,
     ):
         self.config = config
         self.params = params
@@ -218,7 +241,7 @@ class ServingEngine:
         self.max_len = max_len or config.max_seq_len
         self._prefill = make_prefill(config)
         self._insert = make_insert()
-        self._step = make_decode_step(config, temperature)
+        self._step = make_decode_step(config, temperature, steps_per_sync)
         self._temperature = temperature
         self._rng = jax.random.PRNGKey(seed)
         self.state = init_decode_state(config, slots, self.max_len)
@@ -227,16 +250,22 @@ class ServingEngine:
         self._wake = threading.Event()
         self._stop = False
         self._failed: Optional[BaseException] = None
+        # Guards the submit-vs-close/failure window: a request must never
+        # land on _pending after _flush_all drained it (its consumer would
+        # block forever).
+        self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def submit(
         self, tokens: List[int], max_new_tokens: int
-    ) -> "queue.Queue[Optional[int]]":
-        if self._failed is not None:
-            raise RuntimeError(f"serving engine failed: {self._failed}")
+    ) -> "queue.Queue[object]":
+        """Enqueue a request; returns its output queue (see _Request.out
+        for the token/None/Exception protocol)."""
         if not tokens:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         # The last decode write lands at cache row len + max_new - 2, so
         # len + max_new == max_len exactly fills the cache.
         if len(tokens) + max_new_tokens > self.max_len:
@@ -244,28 +273,38 @@ class ServingEngine:
                 f"prompt {len(tokens)} + max_new_tokens {max_new_tokens}"
                 f" must not exceed max_len {self.max_len}"
             )
-        out: "queue.Queue[Optional[int]]" = queue.Queue()
-        self._pending.put(_Request(list(tokens), max_new_tokens, out))
+        out: "queue.Queue[object]" = queue.Queue()
+        with self._lock:
+            if self._failed is not None:
+                raise RuntimeError(f"serving engine failed: {self._failed}")
+            if self._stop:
+                raise RuntimeError("serving engine is closed")
+            self._pending.put(_Request(list(tokens), max_new_tokens, out))
         self._wake.set()
         return out
 
     def close(self) -> None:
-        self._stop = True
+        with self._lock:
+            self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
-        self._flush_all()
+        self._flush_all(None)
 
-    def _flush_all(self) -> None:
-        """Terminate every consumer: no out.get() may hang forever."""
-        for slot, req in enumerate(self._live):
-            if req is not None:
-                req.out.put(None)
-                self._live[slot] = None
-        while True:
-            try:
-                self._pending.get_nowait().out.put(None)
-            except queue.Empty:
-                return
+    def _flush_all(self, error: Optional[BaseException]) -> None:
+        """Terminate every consumer: no out.get() may hang forever. A
+        failure is delivered as the exception itself, NOT the clean-end
+        None — partial output must not read as success."""
+        sentinel: object = error if error is not None else None
+        with self._lock:
+            for slot, req in enumerate(self._live):
+                if req is not None:
+                    req.out.put(sentinel)
+                    self._live[slot] = None
+            while True:
+                try:
+                    self._pending.get_nowait().out.put(sentinel)
+                except queue.Empty:
+                    return
 
     # -- loop ----------------------------------------------------------------
 
@@ -314,18 +353,20 @@ class ServingEngine:
                 self.state, tokens, active = self._step(
                     self.params, self.state, sub
                 )
-                toks = jax.device_get(tokens)
+                toks = jax.device_get(tokens)  # (B, steps_per_sync)
                 still = jax.device_get(active)
                 for slot, req in enumerate(self._live):
                     if req is None:
                         continue
-                    if toks[slot] >= 0:
-                        req.out.put(int(toks[slot]))
+                    for tok in toks[slot]:
+                        if tok >= 0:
+                            req.out.put(int(tok))
                     if not still[slot]:
                         req.out.put(None)
                         self._live[slot] = None
             except Exception as e:  # device/compile error: fail loudly, not
                 # by wedging every consumer on a dead queue.
-                self._failed = e
-                self._flush_all()
+                with self._lock:
+                    self._failed = e
+                self._flush_all(e)
                 raise

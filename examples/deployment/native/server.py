@@ -14,6 +14,7 @@ tokenizer for real checkpoints.
 """
 
 import argparse
+import codecs
 import itertools
 import json
 import time
@@ -83,7 +84,10 @@ class Engine:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
     def chat_stream(self, messages):
-        """Yield decoded text fragments as tokens land (continuous batch)."""
+        """Yield decoded text fragments as tokens land (continuous batch).
+
+        UTF-8 is decoded incrementally so multi-byte characters split
+        across tokens reassemble instead of degrading to U+FFFD."""
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
         )
@@ -91,11 +95,19 @@ class Engine:
         out = self.serving.submit(
             [int(t) for t in tokens[0]], max_new_tokens=self.max_new_tokens
         )
+        dec = codecs.getincrementaldecoder("utf-8")("replace")
         while True:
             tok = out.get()
+            if isinstance(tok, BaseException):
+                raise RuntimeError(f"generation failed: {tok}")
             if tok is None:
+                tail = dec.decode(b"", True)
+                if tail:
+                    yield tail
                 return
-            yield self.decode([tok])
+            piece = dec.decode(bytes([int(tok) % 256]))
+            if piece:
+                yield piece
 
     def chat(self, messages) -> str:
         return "".join(self.chat_stream(messages))
@@ -141,6 +153,15 @@ def main() -> None:
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.end_headers()
+            try:
+                self._stream_body(first, pieces)
+            except Exception:
+                # Headers are committed: a 500 here would splice a second
+                # status line into the event stream. Truncating WITHOUT the
+                # [DONE] terminator is the SSE convention for "broken".
+                return
+
+        def _stream_body(self, first, pieces) -> None:
             for i, piece in enumerate(itertools.chain([first], pieces)):
                 chunk = {
                     "id": "chatcmpl-native",

@@ -77,14 +77,56 @@ def test_midflight_join(params):
 
 
 def test_cache_full_retires_slot(params):
+    """The cache-full guard in decode_step is unreachable through submit()
+    (validation caps budget first) — exercise it directly with a
+    hand-built over-budget state."""
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads.serving import (
+        init_decode_state,
+        make_decode_step,
+        make_insert,
+        make_prefill,
+    )
+
+    max_len = 12
+    state = init_decode_state(CFG, 1, max_len)
+    prefill = make_prefill(CFG)
+    k_rows, v_rows, logits = prefill(params, jnp.asarray([[1, 2, 3]], jnp.int32))
+    state = make_insert()(
+        state, 0, k_rows, v_rows, 3, int(jnp.argmax(logits)), 100
+    )  # budget far beyond the cache
+    step = make_decode_step(CFG)
+    rng = jax.random.PRNGKey(0)
+    emitted = 0
+    for _ in range(max_len + 5):
+        state, toks, active = step(params, state, rng)
+        emitted += int(toks[0, 0] >= 0)
+        if not bool(active[0]):
+            break
+    assert not bool(active[0]), "slot must retire when the cache fills"
+    # Writes never ran past the cache: the last write landed at row
+    # lengths-1 <= max_len-1.
+    assert int(state.lengths[0]) <= max_len
+    assert emitted >= 1
+
+
+def test_submit_validates_budget(params):
     engine = ServingEngine(CFG, params, slots=1, max_len=16)
     try:
-        q = engine.submit([1, 2, 3], max_new_tokens=12)  # 3 + 12 = 15 < 16
-        out = _drain(q)
-        # Budget fits under max_len-1 writes; everything decodes.
-        assert 1 <= len(out) <= 12
+        with pytest.raises(ValueError):
+            engine.submit([1, 2, 3], max_new_tokens=0)
+        with pytest.raises(ValueError):
+            engine.submit([1, 2, 3], max_new_tokens=-2)
     finally:
         engine.close()
+
+
+def test_submit_after_close_raises(params):
+    engine = ServingEngine(CFG, params, slots=1, max_len=16)
+    engine.close()
+    with pytest.raises(RuntimeError):
+        engine.submit([1, 2, 3], max_new_tokens=2)
 
 
 def test_validation(params):
