@@ -161,11 +161,27 @@ class TaskStore {
     }
   }
 
+  // Graceful shutdown: stop every task (kills containers/runner
+  // processes) so nothing outlives the shim.
+  void terminate_all() {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& [id, task] : tasks_) {
+      if (task.status != "terminated") {
+        task.termination_reason = "shim_shutdown";
+        runtime_->terminate(task, 2.0);
+        runtime_->remove(task);
+      }
+    }
+  }
+
  private:
   Runtime* runtime_;
   std::mutex mu_;
   std::map<std::string, TaskState> tasks_;
 };
+
+volatile sig_atomic_t g_stop = 0;
+void handle_stop(int) { g_stop = 1; }
 
 }  // namespace
 
@@ -173,11 +189,16 @@ int main(int argc, char** argv) {
   // A peer (socket or child pipe) closing early must surface as an
   // error return, not kill the whole agent.
   signal(SIGPIPE, SIG_IGN);
+  // SIGTERM tears tasks down from the main loop (not the handler — only a
+  // flag is set here), so a terminated shim never leaks runner processes.
+  signal(SIGTERM, handle_stop);
+  signal(SIGINT, handle_stop);
   std::string host = "0.0.0.0";
   int port = 10998;
   std::string runtime_name = "docker";
   std::string runner_binary = "/usr/local/bin/dstack-tpu-runner";
   std::string host_info_path;
+  std::string port_file;
 
   static option longopts[] = {
       {"host", required_argument, nullptr, 'h'},
@@ -185,20 +206,22 @@ int main(int argc, char** argv) {
       {"runtime", required_argument, nullptr, 'r'},
       {"runner-binary", required_argument, nullptr, 'b'},
       {"host-info", required_argument, nullptr, 'o'},
+      {"port-file", required_argument, nullptr, 'f'},
       {nullptr, 0, nullptr, 0},
   };
   int c;
-  while ((c = getopt_long(argc, argv, "h:p:r:b:o:", longopts, nullptr)) != -1) {
+  while ((c = getopt_long(argc, argv, "h:p:r:b:o:f:", longopts, nullptr)) != -1) {
     switch (c) {
       case 'h': host = optarg; break;
       case 'p': port = atoi(optarg); break;
       case 'r': runtime_name = optarg; break;
       case 'b': runner_binary = optarg; break;
       case 'o': host_info_path = optarg; break;
+      case 'f': port_file = optarg; break;
       default:
         fprintf(stderr,
                 "usage: %s [--host H] [--port P] [--runtime docker|process] "
-                "[--runner-binary PATH] [--host-info PATH]\n",
+                "[--runner-binary PATH] [--host-info PATH] [--port-file PATH]\n",
                 argv[0]);
         return 2;
     }
@@ -241,9 +264,16 @@ int main(int argc, char** argv) {
     fprintf(stderr, "failed to bind %s:%d\n", host.c_str(), port);
     return 1;
   }
+  if (!port_file.empty()) {
+    // Same atomic-rename contract as the runner's --port-file.
+    std::string tmp = port_file + ".tmp";
+    write_file(tmp, std::to_string(bound));
+    rename(tmp.c_str(), port_file.c_str());
+  }
   printf("shim listening on %s:%d (runtime=%s)\n", host.c_str(), bound,
          runtime_name.c_str());
   fflush(stdout);
-  while (true) pause();
+  while (!g_stop) pause();
+  store.terminate_all();
   return 0;
 }

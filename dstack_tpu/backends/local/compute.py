@@ -23,6 +23,7 @@ from dstack_tpu.backends.base.compute import Compute
 from dstack_tpu.backends.base.offers import filter_offers
 from dstack_tpu.models.backends import BackendType
 from dstack_tpu.models.common import CoreModel
+from pydantic import model_validator
 from dstack_tpu.models.instances import (
     InstanceAvailability,
     InstanceOfferWithAvailability,
@@ -50,6 +51,17 @@ class LocalBackendConfig(CoreModel):
     # contract, so the whole control plane can be e2e'd against the native
     # agent stack.
     runner_binary: Optional[str] = None
+    # Path to the C++ shim binary. When set, each worker "host" is a shim
+    # in `--runtime process` mode (dockerized path): the server submits a
+    # task to the shim, the shim spawns the runner — the exact chain real
+    # hosts use, minus docker.
+    shim_binary: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _shim_needs_runner(self):
+        if self.shim_binary and not self.runner_binary:
+            raise ValueError("shim_binary requires runner_binary (the shim execs it)")
+        return self
 
 
 class LocalCompute(Compute):
@@ -114,7 +126,14 @@ class LocalCompute(Compute):
         port_dir = tempfile.mkdtemp(prefix="dstack-local-runner-")
         for worker in range(offer.hosts):
             port_file = os.path.join(port_dir, f"w{worker}.port")
-            if self.config.runner_binary:
+            if self.config.shim_binary:
+                argv = [
+                    self.config.shim_binary,
+                    "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
+                    "--runtime", "process",
+                    "--runner-binary", self.config.runner_binary or "",
+                ]
+            elif self.config.runner_binary:
                 argv = [
                     self.config.runner_binary,
                     "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
@@ -152,6 +171,10 @@ class LocalCompute(Compute):
             (worker, port, proc, instance_id)
             for (worker, _f, proc, instance_id), port in zip(spawned, ports)
         ]
+        # The FSM issues ONE terminate per slice (worker 0 — the real TPU
+        # API deletes the whole node object); locally that must fan out to
+        # every worker's process, so each jpd carries the gang's pids.
+        slice_pids = [proc.pid for _w, _p, proc, _i in spawned]
         for worker, port, proc, instance_id in spawned:
             out.append(
                 JobProvisioningData(
@@ -167,8 +190,15 @@ class LocalCompute(Compute):
                     price=offer.price / offer.hosts,
                     username="root",
                     ssh_port=None,
-                    dockerized=False,  # server talks to the runner directly
-                    backend_data=json.dumps({"port": port, "pid": proc.pid}),
+                    # shim mode follows the real host chain (shim creates the
+                    # task, reports the runner port); otherwise the server
+                    # talks to the runner directly.
+                    dockerized=bool(self.config.shim_binary),
+                    backend_data=json.dumps(
+                        {"shim_port": port, "pid": proc.pid, "slice_pids": slice_pids}
+                        if self.config.shim_binary
+                        else {"port": port, "pid": proc.pid, "slice_pids": slice_pids}
+                    ),
                     tpu_node_id=instance_name if offer.hosts > 1 else None,
                     tpu_worker_index=worker,
                 )
@@ -208,13 +238,36 @@ class LocalCompute(Compute):
         self, instance_id: str, region: str, backend_data: Optional[str] = None
     ) -> None:
         proc = self._procs.pop(instance_id, None)
-        pid: Optional[int] = proc.pid if proc else None
-        if pid is None and backend_data:
-            pid = json.loads(backend_data).get("pid")
-        if pid is not None:
+        data = json.loads(backend_data) if backend_data else {}
+        pids = data.get("slice_pids") or []
+        if proc is not None and proc.pid not in pids:
+            pids.append(proc.pid)
+        if not pids and data.get("pid"):
+            pids = [data["pid"]]
+        # TERM first: a shim tears its tasks down on SIGTERM (runner
+        # children setsid out of its process group, so killpg alone would
+        # leak them); KILL after a grace window.
+        for sig in (signal.SIGTERM, signal.SIGKILL):
+            for pid in pids:
+                try:
+                    os.killpg(os.getpgid(pid), sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            if sig == signal.SIGTERM:
+                await asyncio.sleep(0.5)
+        # Reap every slice member (not just this instance's Popen) so no
+        # zombies or dict entries accumulate across slices.
+        for iid in [f"local-{p}" for p in pids]:
+            sibling = self._procs.pop(iid, None)
+            if sibling is not None:
+                try:
+                    sibling.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        if proc is not None:
             try:
-                os.killpg(os.getpgid(pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
                 pass
 
     # Volumes: directory-backed fakes so the volume FSM is testable.

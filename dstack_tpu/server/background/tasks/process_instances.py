@@ -74,7 +74,24 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
                 ctx, row["project_id"], jpd.get_base_backend()
             )
             # TPU slices: only worker 0 issues the cloud delete (one node
-            # object covers all workers); siblings just finalize.
+            # object covers all workers); siblings just finalize. The
+            # delete is DEFERRED while any sibling worker still runs a job
+            # — tearing the node down under them would kill the whole gang
+            # (json-substring match on the shared tpu_node_id; jpd rows are
+            # compact pydantic dumps).
+            if jpd.tpu_node_id is not None and jpd.tpu_worker_index == 0:
+                busy = await ctx.db.fetchone(
+                    "SELECT COUNT(*) AS n FROM instances"
+                    " WHERE id != ? AND status IN ('pending', 'busy')"
+                    " AND job_provisioning_data LIKE ?",
+                    (row["id"], f'%"tpu_node_id":"{jpd.tpu_node_id}"%'),
+                )
+                if busy and busy["n"]:
+                    logger.debug(
+                        "instance %s: deferring slice delete (%d busy workers)",
+                        row["name"], busy["n"],
+                    )
+                    return
             if jpd.tpu_node_id is None or jpd.tpu_worker_index == 0:
                 await compute.terminate_instance(
                     jpd.instance_id, jpd.region, jpd.backend_data

@@ -33,7 +33,11 @@ async def collect_metrics(ctx: ServerContext) -> None:
                 ctx, row["instance_id"], jpd,
                 ssh_private_key=project_row["ssh_private_key"],
             )
-            runner = conn.runner_client()
+            from dstack_tpu.server.background.tasks.process_running_jobs import (
+                _runner_port_override,
+            )
+
+            runner = conn.runner_client(port=_runner_port_override(row))
             try:
                 point = await runner.metrics()
             finally:

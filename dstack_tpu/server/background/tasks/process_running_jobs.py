@@ -17,9 +17,11 @@ from dstack_tpu.agents.protocol import TaskStatus, TaskSubmitRequest
 from dstack_tpu.models.backends import BackendType
 from dstack_tpu.models.instances import InstanceStatus
 from dstack_tpu.models.logs import LogProducer
+from dstack_tpu.agents.protocol import RUNNER_PORT
 from dstack_tpu.models.runs import (
     ClusterInfo,
     JobProvisioningData,
+    JobRuntimeData,
     JobSpec,
     JobStatus,
     JobTerminationReason,
@@ -138,6 +140,18 @@ def _build_cluster_info(
         slice_count=slice_count,
         slice_id=job_spec.job_num // slice_hosts,
     )
+
+
+def _runner_port_override(row: sqlite3.Row) -> "Optional[int]":
+    """Dynamic runner port recorded at pulling time (shim process runtime)."""
+    try:
+        jrd = row["job_runtime_data"]
+    except (KeyError, IndexError):
+        return None
+    if not jrd:
+        return None
+    ports = JobRuntimeData.model_validate_json(jrd).ports or {}
+    return ports.get(RUNNER_PORT)
 
 
 async def _get_secrets(ctx: ServerContext, project_id: str) -> dict:
@@ -291,7 +305,20 @@ async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
     cluster_info = _build_cluster_info(job_spec, replica_jpds)
     secrets = await _get_secrets(ctx, row["project_id"])
     ctx.pull_progress_seen.pop(row["id"], None)
-    await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets)
+    # Persist a NON-default shim-reported runner port so the RUNNING-phase
+    # poller can reach a dynamically-bound runner (process runtime binds
+    # :0); docker runtime keeps the standard port and needs no record.
+    dynamic_port = task.runner_port if task.runner_port != RUNNER_PORT else None
+    if dynamic_port is not None:
+        jrd = JobRuntimeData(ports={RUNNER_PORT: dynamic_port})
+        await ctx.db.execute(
+            "UPDATE jobs SET job_runtime_data = ? WHERE id = ?",
+            (jrd.model_dump_json(), row["id"]),
+        )
+    await _submit_to_runner(
+        ctx, row, conn, job_spec, cluster_info, secrets,
+        runner_port=dynamic_port,
+    )
 
 
 async def _submit_to_runner(
@@ -301,8 +328,9 @@ async def _submit_to_runner(
     job_spec: JobSpec,
     cluster_info: ClusterInfo,
     secrets: dict,
+    runner_port: "Optional[int]" = None,
 ) -> None:
-    runner = conn.runner_client()
+    runner = conn.runner_client(port=runner_port)
     try:
         health = await runner.healthcheck()
         if health is None:
@@ -442,7 +470,7 @@ async def _pull_runner(ctx: ServerContext, row: sqlite3.Row) -> None:
         ctx, row["instance_id"] or jpd.instance_id, jpd,
         ssh_private_key=project_row["ssh_private_key"],
     )
-    runner = conn.runner_client()
+    runner = conn.runner_client(port=_runner_port_override(row))
     try:
         resp = await runner.pull(row["runner_timestamp"])
     except Exception:

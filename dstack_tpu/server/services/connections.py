@@ -27,7 +27,14 @@ class AgentConnection:
         self.shim_url = shim_url
         self.tunnel = tunnel
 
-    def runner_client(self) -> RunnerClient:
+    def runner_client(self, port: Optional[int] = None) -> RunnerClient:
+        if port is not None and self.tunnel is None:
+            # Direct (tunnel-less) hosts can address the task's actual
+            # runner port (shim process-runtime binds :0 and reports it).
+            # Tunneled hosts keep the fixed forward: their docker runtime
+            # serves the runner on the standard port over host networking.
+            base, _, _ = self.runner_url.rpartition(":")
+            return RunnerClient(f"{base}:{port}")
         return RunnerClient(self.runner_url)
 
     def shim_client(self) -> ShimClient:

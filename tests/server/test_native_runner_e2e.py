@@ -117,3 +117,79 @@ async def test_secrets_reach_native_runner(native_runner):
         assert "got=n4tive" in await _poll_text(fx, "native-secret", sub["id"])
     finally:
         await fx.app.shutdown()
+
+
+@pytest.fixture(scope="session")
+def native_shim(native_runner):
+    return str(NATIVE / "build" / "dstack-tpu-shim")
+
+
+async def test_single_job_via_native_shim(native_shim, native_runner):
+    """The complete native chain: server -> C++ shim (process runtime) ->
+    C++ runner. The server takes the dockerized path (shim task submit,
+    pull poll, dynamic runner port from the shim's TaskInfo)."""
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {
+        "shim_binary": native_shim, "runner_binary": native_runner,
+    }
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(["echo via-shim-$DSTACK_RUN_NAME"], "shim-run"),
+        )
+        assert resp.status == 200, resp.body
+        run = await _wait_run(fx, "shim-run", {"done", "failed", "terminated"})
+        assert run["status"] == "done", run
+        sub = run["jobs"][0]["job_submissions"][-1]
+        assert "via-shim-shim-run" in await _poll_text(fx, "shim-run", sub["id"])
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_gang_via_native_shim(native_shim, native_runner):
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {
+        "shim_binary": native_shim, "runner_binary": native_runner,
+        "tpu_sim": ["v5litepod-16"],
+    }
+    try:
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(
+                ["echo rank=$JAX_PROCESS_ID/$JAX_NUM_PROCESSES"],
+                "shim-gang",
+                resources={"tpu": "v5litepod-16"},
+            ),
+        )
+        run = await _wait_run(
+            fx, "shim-gang", {"done", "failed", "terminated"}, timeout=90
+        )
+        assert run["status"] == "done", run
+        joined = "\n".join([
+            await _poll_text(fx, "shim-gang", j["job_submissions"][-1]["id"])
+            for j in run["jobs"]
+        ])
+        for rank in range(4):
+            assert f"rank={rank}/4" in joined, joined
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_stop_run_via_native_shim(native_shim, native_runner):
+    fx = await make_server()
+    fx.ctx.overrides["local_backend_config"] = {
+        "shim_binary": native_shim, "runner_binary": native_runner,
+    }
+    try:
+        await fx.client.post(
+            "/api/project/main/runs/submit",
+            json_body=_task_body(["sleep 120"], "shim-stop"),
+        )
+        await _wait_run(fx, "shim-stop", {"running"})
+        await fx.client.post(
+            "/api/project/main/runs/stop", json_body={"runs_names": ["shim-stop"]}
+        )
+        run = await _wait_run(fx, "shim-stop", {"terminated", "failed", "done"})
+        assert run["status"] == "terminated", run
+    finally:
+        await fx.app.shutdown()
