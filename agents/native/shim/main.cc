@@ -170,6 +170,11 @@ class TaskStore {
         task.termination_reason = "shim_shutdown";
         runtime_->terminate(task, 2.0);
         runtime_->remove(task);
+        // Mark terminated so a launch thread still in flight (its runner
+        // pid lives only in the thread's working copy until launch
+        // returns) takes the cancelled-teardown path and kills what it
+        // started instead of writing the task back.
+        task.status = "terminated";
       }
     }
   }
@@ -273,7 +278,12 @@ int main(int argc, char** argv) {
   printf("shim listening on %s:%d (runtime=%s)\n", host.c_str(), bound,
          runtime_name.c_str());
   fflush(stdout);
-  while (!g_stop) pause();
+  // Polling sidesteps the classic check-then-pause() lost-wakeup race
+  // (SIGTERM landing between the flag check and pause would block forever).
+  while (!g_stop) usleep(100'000);
   store.terminate_all();
+  // Give in-flight launch threads a moment to observe the terminated
+  // state and run their cancelled-teardown (they hold the runner pid).
+  usleep(2'000'000);
   return 0;
 }

@@ -246,15 +246,24 @@ class LocalCompute(Compute):
             pids = [data["pid"]]
         # TERM first: a shim tears its tasks down on SIGTERM (runner
         # children setsid out of its process group, so killpg alone would
-        # leak them); KILL after a grace window.
-        for sig in (signal.SIGTERM, signal.SIGKILL):
+        # leak them). The grace window polls up to 6s — the shim's own
+        # teardown allows 2s per task — before escalating to KILL.
+        def _kill(sig) -> int:
+            alive = 0
             for pid in pids:
                 try:
                     os.killpg(os.getpgid(pid), sig)
+                    alive += 1
                 except (ProcessLookupError, PermissionError):
                     pass
-            if sig == signal.SIGTERM:
-                await asyncio.sleep(0.5)
+            return alive
+
+        if _kill(signal.SIGTERM):
+            for _ in range(24):
+                await asyncio.sleep(0.25)
+                if not _kill(0):
+                    break
+        _kill(signal.SIGKILL)
         # Reap every slice member (not just this instance's Popen) so no
         # zombies or dict entries accumulate across slices.
         for iid in [f"local-{p}" for p in pids]:

@@ -80,11 +80,16 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
             # (json-substring match on the shared tpu_node_id; jpd rows are
             # compact pydantic dumps).
             if jpd.tpu_node_id is not None and jpd.tpu_worker_index == 0:
+                node = (
+                    jpd.tpu_node_id.replace("\\", "\\\\")
+                    .replace("%", "\\%").replace("_", "\\_")
+                )
                 busy = await ctx.db.fetchone(
                     "SELECT COUNT(*) AS n FROM instances"
-                    " WHERE id != ? AND status IN ('pending', 'busy')"
-                    " AND job_provisioning_data LIKE ?",
-                    (row["id"], f'%"tpu_node_id":"{jpd.tpu_node_id}"%'),
+                    " WHERE id != ? AND deleted = 0"
+                    " AND status IN ('pending', 'busy')"
+                    " AND job_provisioning_data LIKE ? ESCAPE '\\'",
+                    (row["id"], f'%"tpu_node_id":"{node}"%'),
                 )
                 if busy and busy["n"]:
                     logger.debug(

@@ -25,7 +25,13 @@ from jax import lax
 
 from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
 from dstack_tpu.workloads.config import ModelConfig
-from dstack_tpu.workloads.transformer import mlp_block, project_qkv, rms_norm
+from dstack_tpu.workloads.transformer import (
+    linear,
+    logits_linear,
+    mlp_block,
+    project_qkv,
+    rms_norm,
+)
 
 Params = Dict[str, Any]
 
@@ -98,7 +104,7 @@ def _forward_cached(
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, start, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, start, 0, 0))
         attn = _cached_attention(q, ck, cv, valid_len)
-        x = x + attn @ p["wo"]
+        x = x + linear(attn, p["wo"])
         if c.n_experts > 0:
             from dstack_tpu.workloads.moe import moe_block
 
@@ -109,7 +115,7 @@ def _forward_cached(
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], c.norm_eps)
-    logits = (x[:, -1].astype(jnp.float32)) @ params["lm_head"].astype(jnp.float32)
+    logits = logits_linear(x[:, -1], params["lm_head"])
     return logits, KVCache(k=new_k, v=new_v, length=start + s)
 
 

@@ -104,13 +104,20 @@ def moe_mlp(
     )
     expert_in = constrain(expert_in, P("expert", ("data", "fsdp"), None, None))
 
+    def bank(w):
+        # Serving may hand us int8 expert banks; the convert+scale fuses
+        # into the einsum read (workloads/quant.py).
+        from dstack_tpu.workloads.quant import QTensor, dequantize_tensor
+
+        return dequantize_tensor(w, h.dtype) if isinstance(w, QTensor) else w
+
     gate = jnp.einsum(
-        "ebcd,edf->ebcf", expert_in, p["we_gate"],
+        "ebcd,edf->ebcf", expert_in, bank(p["we_gate"]),
         preferred_element_type=jnp.float32,
     )
-    up = jnp.einsum("ebcd,edf->ebcf", expert_in, p["we_up"])
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, bank(p["we_up"]))
     act = (jax.nn.silu(gate).astype(h.dtype)) * up
-    expert_out = jnp.einsum("ebcf,efd->ebcd", act, p["we_down"])
+    expert_out = jnp.einsum("ebcf,efd->ebcd", act, bank(p["we_down"]))
     expert_out = constrain(
         expert_out, P("expert", ("data", "fsdp"), None, None)
     )

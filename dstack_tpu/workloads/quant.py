@@ -1,0 +1,73 @@
+"""Weight-only int8 quantization for the decode/serving path.
+
+Autoregressive decode is HBM-bandwidth-bound: every step streams the full
+weight set for a handful of tokens. Storing the big matrices as int8 with
+per-output-channel f32 scales halves that traffic vs bf16 — the standard
+serving quantization — while matmuls still run in bf16 on the MXU (XLA
+fuses the int8->bf16 convert into the matmul read; only the HBM side
+shrinks).
+
+`quantize_params` rewrites a params pytree in place of the dense weights;
+`linear`/`logits_linear` in transformer.py dispatch on the QTensor leaf type, so
+forward/generate/serving run unchanged on quantized or full-precision
+params. Training is unaffected (quantize only for serving).
+
+Symmetric per-channel scheme: scale_c = max|W[:, c]| / 127,
+q = round(W / scale), W ≈ q * scale. Embedding stays bf16 (it is a gather,
+not a matmul); norms stay f32.
+"""
+
+from typing import Any, Dict, NamedTuple
+
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+class QTensor(NamedTuple):
+    """int8 weights + f32 per-output-channel scales.
+
+    q: (..., in, out) int8; scale: (..., 1, out) f32 — leading dims carry
+    the layer (and expert) stacks so scanned/stacked weights quantize as
+    one leaf."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def quantize_tensor(w: jnp.ndarray) -> QTensor:
+    """Symmetric per-channel int8 over the last (output) axis."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # (..., 1, out)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize_tensor(t: QTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (t.q.astype(jnp.float32) * t.scale).astype(dtype)
+
+
+# Weight leaves worth quantizing: every big matmul operand. Embedding is a
+# gather; norms are tiny and precision-sensitive; the router drives top-k
+# decisions.
+_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+     "we_gate", "we_up", "we_down", "lm_head"}
+)
+
+
+def quantize_params(params: Params) -> Params:
+    """Return a copy of the params tree with the matmul weights as QTensors."""
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {
+                k: quantize_tensor(v)
+                if k in _QUANT_KEYS and not isinstance(v, QTensor)
+                else walk(v)
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(params)

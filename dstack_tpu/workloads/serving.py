@@ -36,6 +36,8 @@ from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.generate import KVCache, _forward_cached
 from dstack_tpu.workloads.transformer import (
+    linear,
+    logits_linear,
     mlp_block,
     project_qkv,
     rms_norm,
@@ -158,7 +160,7 @@ def make_decode_step(
             ck = ck.at[rows, state.lengths].set(k[:, 0].astype(ck.dtype))
             cv = cv.at[rows, state.lengths].set(v[:, 0].astype(cv.dtype))
             attn = _decode_attention(q, ck, cv, state.lengths + 1)
-            x = x + attn @ p["wo"]
+            x = x + linear(attn, p["wo"])
             if c.n_experts > 0:
                 from dstack_tpu.workloads.moe import moe_block
 
@@ -169,7 +171,7 @@ def make_decode_step(
 
         x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
         h = rms_norm(x, params["final_norm"], c.norm_eps)
-        logits = (h[:, -1].astype(jnp.float32)) @ params["lm_head"].astype(jnp.float32)
+        logits = logits_linear(h[:, -1], params["lm_head"])
         if temperature > 0:
             next_token = jax.random.categorical(
                 rng, logits / temperature, axis=-1

@@ -75,6 +75,37 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
     }
 
 
+def linear(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for a raw array or an int8 QTensor (workloads/quant.py).
+
+    The QTensor path reads int8 from HBM (the point: decode is
+    weight-bandwidth-bound), upcasts into the matmul, applies the
+    per-channel scale, and returns x.dtype. The raw path is exactly the
+    plain matmul the training step always ran."""
+    from dstack_tpu.workloads.quant import QTensor
+
+    if isinstance(w, QTensor):
+        y = jnp.matmul(
+            x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return (y * w.scale).astype(x.dtype)
+    return x @ w
+
+
+def logits_linear(x: jnp.ndarray, w) -> jnp.ndarray:
+    """The lm-head matmul: f32 logits from bf16/quantized weights."""
+    from dstack_tpu.workloads.quant import QTensor
+
+    if isinstance(w, QTensor):
+        y = jnp.matmul(
+            x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return y * w.scale
+    return jnp.matmul(
+        x, w, preferred_element_type=jnp.float32
+    ).astype(jnp.float32)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
@@ -101,18 +132,18 @@ def project_qkv(c: ModelConfig, x: jnp.ndarray, p: Params, positions: jnp.ndarra
     b, s, _ = x.shape
     hd = c.head_dim
     h = rms_norm(x, p["attn_norm"], c.norm_eps)
-    q = (h @ p["wq"]).reshape(b, s, c.n_heads, hd)
-    k = (h @ p["wk"]).reshape(b, s, c.n_kv_heads, hd)
-    v = (h @ p["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = linear(h, p["wq"]).reshape(b, s, c.n_heads, hd)
+    k = linear(h, p["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = linear(h, p["wv"]).reshape(b, s, c.n_kv_heads, hd)
     return _rope(q, positions, c.rope_theta), _rope(k, positions, c.rope_theta), v
 
 
 def mlp_block(c: ModelConfig, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """Pre-norm SwiGLU MLP with residual — shared with generate.py."""
     h = rms_norm(x, p["mlp_norm"], c.norm_eps)
-    gate = jax.nn.silu((h @ p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    up = h @ p["w_up"]
-    return x + (gate * up) @ p["w_down"]
+    gate = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    up = linear(h, p["w_up"])
+    return x + linear(gate * up, p["w_down"])
 
 
 def apply_remat(
@@ -150,7 +181,7 @@ def _block(
     b, s, _ = x.shape
     q, k, v = project_qkv(c, x, p, positions)
     attn = attention_fn(q, k, v).reshape(b, s, c.n_heads * c.head_dim)
-    x = x + attn @ p["wo"]
+    x = x + linear(attn, p["wo"])
     if c.n_experts > 0:
         from dstack_tpu.workloads.moe import moe_block
 
@@ -196,9 +227,7 @@ def forward(
     (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"], preferred_element_type=jnp.float32
-    )
+    logits = logits_linear(x, params["lm_head"])
     if return_aux:
         return logits, aux
     return logits

@@ -33,7 +33,8 @@ class Engine:
     # XLA compile per novel prompt length would dominate request latency.
     MIN_BUCKET = 32
 
-    def __init__(self, preset: str, max_new_tokens: int, checkpoint_dir: str = ""):
+    def __init__(self, preset: str, max_new_tokens: int, checkpoint_dir: str = "",
+                 quantize: str = "none"):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -58,6 +59,13 @@ class Engine:
             self.params = params
         else:
             self.params = init_params(self.config, jax.random.PRNGKey(0))
+        if quantize == "int8":
+            # Weight-only int8: decode is weight-bandwidth-bound, so the
+            # smaller HBM reads buy ~1.25x decode throughput (measured on
+            # v5e) at ~half the weight memory (workloads/quant.py).
+            from dstack_tpu.workloads.quant import quantize_params
+
+            self.params = quantize_params(self.params)
         # Continuous batching: concurrent requests share one decode batch
         # (workloads/serving.py) instead of queueing behind each other.
         self.serving = ServingEngine(
@@ -121,9 +129,12 @@ def main() -> None:
     parser.add_argument("--max-new-tokens", type=int, default=64)
     parser.add_argument("--checkpoint-dir", default="",
                         help="volume path with an Orbax checkpoint to serve")
+    parser.add_argument("--quantize", default="none", choices=["none", "int8"],
+                        help="weight-only int8 for ~1.25x decode throughput")
     args = parser.parse_args()
 
-    engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir)
+    engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir,
+                    quantize=args.quantize)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):

@@ -1,0 +1,100 @@
+"""Weight-only int8 quantization for the decode path (workloads/quant.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.generate import generate
+from dstack_tpu.workloads.quant import (
+    QTensor,
+    dequantize_tensor,
+    quantize_params,
+    quantize_tensor,
+)
+from dstack_tpu.workloads.transformer import forward, init_params
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+
+def test_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32) * 0.02
+    t = quantize_tensor(w)
+    assert t.q.dtype == jnp.int8
+    assert t.scale.shape == (1, 128)
+    back = dequantize_tensor(t, jnp.float32)
+    # Per-channel symmetric int8: max error is half a quantization step.
+    step = np.asarray(t.scale)[0]
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= step * 0.51 + 1e-8).all()
+
+
+def test_quantize_params_structure():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    assert isinstance(qp["layers"]["wq"], QTensor)
+    assert isinstance(qp["lm_head"], QTensor)
+    # Non-matmul leaves untouched.
+    assert not isinstance(qp["embed"], QTensor)
+    assert not isinstance(qp["layers"]["attn_norm"], QTensor)
+    # Layer stacking preserved on both halves of the QTensor.
+    assert qp["layers"]["wq"].q.shape == params["layers"]["wq"].shape
+    assert qp["layers"]["wq"].scale.shape[0] == CFG.n_layers
+
+
+def test_forward_runs_quantized_and_stays_close():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qp = quantize_params(params)
+    tokens = jnp.asarray([[5, 7, 11, 13, 17, 19, 23, 29]], jnp.int32)
+    full = forward(CFG, params, tokens)
+    quant = forward(CFG, qp, tokens)
+    assert quant.shape == full.shape
+    # int8 logits track bf16 logits closely in distribution: the top-1
+    # token agrees on the overwhelming majority of positions.
+    agree = jnp.mean(
+        (jnp.argmax(full, -1) == jnp.argmax(quant, -1)).astype(jnp.float32)
+    )
+    assert float(agree) >= 0.7, float(agree)
+    # And the logit values themselves are numerically close.
+    np.testing.assert_allclose(
+        np.asarray(quant), np.asarray(full), atol=0.35, rtol=0.1
+    )
+
+
+def test_generate_runs_on_quantized_params():
+    params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)))
+    out = generate(
+        CFG, params, jnp.asarray([[5, 7, 11]], jnp.int32),
+        max_new_tokens=5, temperature=0.0,
+    )
+    assert out.shape == (1, 5)
+    assert bool(jnp.all((out >= 0) & (out < CFG.vocab_size)))
+
+
+def test_moe_forward_runs_quantized():
+    cfg = PRESETS["tiny-moe"].with_(remat=False)
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    assert isinstance(params["layers"]["we_gate"], QTensor)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    logits = forward(cfg, params, tokens)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_serving_engine_on_quantized_params():
+    from dstack_tpu.workloads.serving import ServingEngine
+
+    params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)))
+    engine = ServingEngine(CFG, params, slots=2, max_len=32)
+    try:
+        q = engine.submit([3, 5, 7], max_new_tokens=4)
+        out = []
+        while True:
+            tok = q.get(timeout=60)
+            if tok is None:
+                break
+            assert not isinstance(tok, BaseException), tok
+            out.append(tok)
+        assert len(out) == 4
+    finally:
+        engine.close()
