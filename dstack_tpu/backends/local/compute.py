@@ -244,10 +244,6 @@ class LocalCompute(Compute):
             pids.append(proc.pid)
         if not pids and data.get("pid"):
             pids = [data["pid"]]
-        # TERM first: a shim tears its tasks down on SIGTERM (runner
-        # children setsid out of its process group, so killpg alone would
-        # leak them). The grace window polls up to 6s — the shim's own
-        # teardown allows 2s per task — before escalating to KILL.
         def _kill(sig) -> int:
             alive = 0
             for pid in pids:
@@ -258,11 +254,17 @@ class LocalCompute(Compute):
                     pass
             return alive
 
-        if _kill(signal.SIGTERM):
-            for _ in range(24):
-                await asyncio.sleep(0.25)
-                if not _kill(0):
-                    break
+        if self.config.shim_binary:
+            # Shim mode: TERM first so the shim tears its tasks down (its
+            # runner children setsid out of the process group — killpg
+            # alone would leak them). Poll up to 6s (the shim's own
+            # teardown allows 2s per task) before escalating.
+            if _kill(signal.SIGTERM):
+                for _ in range(24):
+                    await asyncio.sleep(0.25)
+                    if not _kill(0):
+                        break
+        # Direct runners sit in the group killpg reaches; KILL is exact.
         _kill(signal.SIGKILL)
         # Reap every slice member (not just this instance's Popen) so no
         # zombies or dict entries accumulate across slices.
