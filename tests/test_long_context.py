@@ -1,0 +1,38 @@
+"""Long-context training proof: 4096-token sequences over the ring.
+
+One full train step with the sequence axis sharded 4-way (ring attention
+over ppermute) plus tensor parallelism — the "long context is first-class"
+configuration at a length no single CPU test device would want to
+materialize O(S^2) scores for. Compile-heavy (~1 min on the virtual CPU
+mesh), so exactly one test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.sharding import make_mesh
+from dstack_tpu.workloads.train import (
+    init_train_state,
+    make_train_step,
+    synthetic_batch,
+)
+
+
+def test_4k_context_ring_train_step():
+    cfg = PRESETS["tiny"].with_(max_seq_len=4096, remat=False)
+    mesh = make_mesh(jax.devices()[:8], seq=4, model=2)
+    state = init_train_state(cfg, jax.random.PRNGKey(0), mesh=mesh)
+    step = make_train_step(cfg, mesh)
+    batch = synthetic_batch(cfg, batch_size=2, seq_len=4096, mesh=mesh)
+
+    state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss) and loss > 0
+    assert int(state.step) == 1
+    # Batch rows really are sharded over the seq axis (4-way ring).
+    spec = batch["inputs"].sharding.spec
+    assert spec == jax.sharding.PartitionSpec(("data", "fsdp"), "seq")
+    # Uniform random tokens: loss starts near ln(V).
+    assert abs(loss - np.log(cfg.vocab_size)) < 1.0
