@@ -156,6 +156,11 @@ PRESETS: Dict[str, ModelConfig] = {
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
         d_ff=14336, max_seq_len=8192,
     ),
+    # llama-70b-shaped: the fsdp x tp x sp regime on v5p-512 and up.
+    "llama-70b": ModelConfig(
+        vocab_size=128256, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        d_ff=28672, max_seq_len=8192,
+    ),
     # Sparse MoE for tests/dryrun (expert-parallel over the "expert" axis).
     "tiny-moe": ModelConfig(
         vocab_size=512, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
