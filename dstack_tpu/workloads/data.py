@@ -1,0 +1,208 @@
+"""Training data pipeline: memmap token datasets with per-host sharding.
+
+The orchestrator gang-schedules one process per worker VM; each process
+must read a DISJOINT shard of the corpus and keep the TPU fed. This
+module is the host-side loader for that:
+
+- `TokenDataset` — a flat int32 token file (numpy .npy, memmapped: no
+  HBM, no RAM blowup; the OS page cache does the work) cut into
+  fixed-length rows. Deterministic shuffling by permuting row indices
+  with a seeded RNG per epoch, so every host computes the same global
+  order and takes every (process_count)-th batch — disjoint by
+  construction, no coordination traffic.
+- `BatchLoader` — a background prefetch thread that stages the next
+  batches onto device (`jax.device_put` with the training sharding)
+  while the current step runs, overlapping host I/O + H2D with compute.
+- `write_token_file` / `encode_bytes` — build the .npy from raw text
+  (byte-level, matching the example tokenizer) so the examples run
+  without external corpora.
+
+Batches match train.synthetic_batch's contract: pre-shifted inputs/
+targets of shape (B, S), ready for `make_train_step`.
+"""
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from dstack_tpu.workloads.sharding import BATCH_SPEC
+
+
+def encode_bytes(text: str, vocab_size: int) -> np.ndarray:
+    """Byte-level token ids (the example tokenizer), clipped to the vocab."""
+    b = np.frombuffer(text.encode(), dtype=np.uint8).astype(np.int32)
+    return np.minimum(b, vocab_size - 1)
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Flat int32 .npy the loader memmaps."""
+    np.save(path, np.asarray(tokens, dtype=np.int32))
+
+
+class TokenDataset:
+    """Fixed-length rows over a flat memmapped token array.
+
+    Rows are `seq_len + 1` tokens (pre-shift source); `n_rows` is floor
+    division — a trailing partial row is dropped.
+    """
+
+    def __init__(self, path: str, seq_len: int):
+        self.tokens = np.load(path, mmap_mode="r")
+        if self.tokens.ndim != 1:
+            raise ValueError(f"{path}: expected a flat token array")
+        self.seq_len = seq_len
+        self.row = seq_len + 1
+        self.n_rows = len(self.tokens) // self.row
+        if self.n_rows == 0:
+            raise ValueError(
+                f"{path}: {len(self.tokens)} tokens < one row of {self.row}"
+            )
+
+    def epoch_order(self, epoch: int, seed: int = 0) -> np.ndarray:
+        """Global row permutation for an epoch — identical on every host."""
+        rng = np.random.default_rng(seed * 1_000_003 + epoch)
+        return rng.permutation(self.n_rows)
+
+    def rows(self, idx: np.ndarray) -> np.ndarray:
+        """Gather rows (len(idx), seq_len+1) from the memmap."""
+        out = np.empty((len(idx), self.row), dtype=np.int32)
+        for i, r in enumerate(idx):
+            start = int(r) * self.row
+            out[i] = self.tokens[start : start + self.row]
+        return out
+
+
+def _host_batches(
+    ds: TokenDataset,
+    batch_size: int,
+    process_id: int,
+    process_count: int,
+    seed: int,
+    start_step: int,
+) -> Iterator[np.ndarray]:
+    """Infinite stream of this host's batches, deterministic in step.
+
+    The global epoch order is cut into consecutive global batches; host p
+    takes batch p, p+count, p+2*count, ... — disjoint across hosts, and a
+    resume at `start_step` re-derives position with no state file.
+    """
+    per_epoch = ds.n_rows // batch_size  # global batches per epoch
+    if per_epoch < process_count:
+        raise ValueError(
+            f"dataset has {per_epoch} batches/epoch < {process_count} hosts"
+        )
+    step = start_step
+    cached = (-1, None)  # (epoch, order): one permutation per epoch, not per batch
+    while True:
+        gbatch = step * process_count + process_id
+        epoch, within = divmod(gbatch, per_epoch)
+        if cached[0] != epoch:
+            cached = (epoch, ds.epoch_order(epoch, seed))
+        order = cached[1]
+        idx = order[within * batch_size : (within + 1) * batch_size]
+        yield ds.rows(idx)
+        step += 1
+
+
+class BatchLoader:
+    """Background-prefetched, device-placed batches for the train loop.
+
+    `batch_size` is PER HOST (the local share of the global batch). With a
+    mesh, arrays are placed with the training batch sharding so the step
+    consumes them without a transfer on the critical path.
+    """
+
+    def __init__(
+        self,
+        dataset: TokenDataset,
+        batch_size: int,
+        *,
+        mesh: Optional[Mesh] = None,
+        process_id: Optional[int] = None,
+        process_count: Optional[int] = None,
+        seed: int = 0,
+        start_step: int = 0,
+        prefetch: int = 2,
+        vocab_size: Optional[int] = None,
+    ):
+        self.dataset = dataset
+        pid = jax.process_index() if process_id is None else process_id
+        pcount = jax.process_count() if process_count is None else process_count
+        # Fail fast (the generator body would only run on the prefetch
+        # thread): undersized corpora are a config error, not a hang.
+        if dataset.n_rows // batch_size < pcount:
+            raise ValueError(
+                f"dataset has {dataset.n_rows // batch_size} batches/epoch"
+                f" < {pcount} hosts"
+            )
+        self._source = _host_batches(
+            dataset, batch_size, pid, pcount, seed, start_step
+        )
+        self._sharding = (
+            NamedSharding(mesh, BATCH_SPEC) if mesh is not None else None
+        )
+        self._vocab_size = vocab_size
+        self._q: "queue.Queue[object]" = queue.Queue(maxsize=prefetch)
+        self._stop = False
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _place(self, rows: np.ndarray) -> Dict[str, jax.Array]:
+        if self._vocab_size is not None and rows.max(initial=0) >= self._vocab_size:
+            raise ValueError(
+                f"corpus token id {int(rows.max())} >= vocab_size"
+                f" {self._vocab_size} — wrong tokenizer for this model"
+                " (TPU gathers clamp silently; failing loud instead)"
+            )
+        batch = {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
+        if self._sharding is not None:
+            if jax.process_count() > 1:
+                # Each host holds only ITS shard of the global batch; the
+                # global array is assembled from the per-process pieces
+                # (device_put with a global sharding would treat the local
+                # shard as the whole batch).
+                return {
+                    k: jax.make_array_from_process_local_data(self._sharding, v)
+                    for k, v in batch.items()
+                }
+            return {k: jax.device_put(v, self._sharding) for k, v in batch.items()}
+        return {k: jax.device_put(v) for k, v in batch.items()}
+
+    def _fill(self) -> None:
+        try:
+            for rows in self._source:
+                if self._stop:
+                    return
+                placed = self._place(rows)
+                while not self._stop:
+                    try:
+                        self._q.put(placed, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop:
+                    return
+        except Exception as e:  # surface on the consumer, never hang it
+            self._q.put(e)
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        return self
+
+    def __next__(self) -> Dict[str, jax.Array]:
+        item = self._q.get()
+        if isinstance(item, BaseException):
+            raise RuntimeError(f"data loader failed: {item}") from item
+        return item
+
+    def close(self) -> None:
+        self._stop = True
+        # Unblock a producer waiting on a full queue.
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

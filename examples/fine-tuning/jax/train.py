@@ -36,6 +36,10 @@ def main() -> None:
     parser.add_argument("--seq-parallel", type=int, default=1)
     parser.add_argument("--expert-parallel", type=int, default=1)
     parser.add_argument(
+        "--data", default="",
+        help="flat int32 token .npy (workloads/data.py); synthetic if unset",
+    )
+    parser.add_argument(
         "--checkpoint-dir",
         default=os.environ.get("CHECKPOINT_DIR", ""),
         help="directory on a mounted volume for periodic checkpoints",
@@ -76,10 +80,31 @@ def main() -> None:
     batch_size = ((args.batch_size + dp - 1) // dp) * dp
     if batch_size != args.batch_size and jax.process_index() == 0:
         print(f"batch size {args.batch_size} -> {batch_size} (divisible by {dp})")
-    batch = synthetic_batch(config, batch_size, args.seq_len, mesh=mesh)
+    loader = None
+    if args.data:
+        from dstack_tpu.workloads.data import BatchLoader, TokenDataset
+
+        # Per-host share of the global batch: round the global batch up to
+        # a host multiple too (and say so), never silently change it.
+        hosts = jax.process_count()
+        per = ((batch_size + hosts - 1) // hosts)
+        if per * hosts != batch_size and jax.process_index() == 0:
+            print(f"batch size {batch_size} -> {per * hosts} (divisible by {hosts} hosts)")
+        batch_size = per * hosts
+        loader = BatchLoader(
+            TokenDataset(args.data, args.seq_len),
+            per,
+            mesh=mesh,
+            start_step=int(state.step),
+            vocab_size=config.vocab_size,
+        )
+    else:
+        batch = synthetic_batch(config, batch_size, args.seq_len, mesh=mesh)
 
     start = int(state.step)  # nonzero after a resume
     for i in range(start, args.steps):
+        if loader is not None:
+            batch = next(loader)
         state, metrics = step(state, batch)
         if i % 10 == 0 or i == args.steps - 1:
             loss = float(metrics["loss"])
@@ -95,6 +120,8 @@ def main() -> None:
         # this without materializing optimizer moments).
         ckpt.export_params(args.checkpoint_dir, state)
         ckpt.close_all()  # drain async writers before the job exits
+    if loader is not None:
+        loader.close()
     print("training complete")
 
 

@@ -1,0 +1,150 @@
+"""Data pipeline: memmap datasets, host sharding, prefetch (workloads/data.py)."""
+
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads.data import (
+    BatchLoader,
+    TokenDataset,
+    encode_bytes,
+    write_token_file,
+)
+
+
+@pytest.fixture()
+def token_file(tmp_path):
+    path = tmp_path / "corpus.npy"
+    write_token_file(str(path), np.arange(10_000, dtype=np.int32) % 500)
+    return str(path)
+
+
+def test_dataset_rows_and_bounds(token_file):
+    ds = TokenDataset(token_file, seq_len=99)
+    assert ds.n_rows == 100
+    rows = ds.rows(np.array([0, 1]))
+    assert rows.shape == (2, 100)
+    np.testing.assert_array_equal(rows[0], np.arange(100) % 500)
+    with pytest.raises(ValueError):
+        TokenDataset(token_file, seq_len=20_000)
+
+
+def test_epoch_order_deterministic_and_epoch_varying(token_file):
+    ds = TokenDataset(token_file, seq_len=99)
+    a = ds.epoch_order(0, seed=7)
+    b = ds.epoch_order(0, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, ds.epoch_order(1, seed=7))
+    assert sorted(a.tolist()) == list(range(ds.n_rows))
+
+
+def test_hosts_get_disjoint_batches(token_file):
+    ds = TokenDataset(token_file, seq_len=99)
+    loaders = [
+        BatchLoader(
+            ds, batch_size=4, process_id=p, process_count=4, seed=3, prefetch=1
+        )
+        for p in range(4)
+    ]
+    try:
+        seen = set()
+        for loader in loaders:
+            for _ in range(3):
+                batch = next(loader)
+                key = tuple(np.asarray(batch["inputs"])[:, :3].ravel().tolist())
+                assert key not in seen, "hosts produced an identical batch"
+                seen.add(key)
+    finally:
+        for loader in loaders:
+            loader.close()
+
+
+def test_inputs_targets_shifted(token_file):
+    ds = TokenDataset(token_file, seq_len=16)
+    loader = BatchLoader(ds, batch_size=2, process_id=0, process_count=1)
+    try:
+        batch = next(loader)
+        inp = np.asarray(batch["inputs"])
+        tgt = np.asarray(batch["targets"])
+        assert inp.shape == tgt.shape == (2, 16)
+        np.testing.assert_array_equal(inp[:, 1:], tgt[:, :-1])
+    finally:
+        loader.close()
+
+
+def test_resume_at_step_reproduces_stream(token_file):
+    ds = TokenDataset(token_file, seq_len=99)
+    a = BatchLoader(ds, batch_size=4, process_id=1, process_count=2, seed=5)
+    try:
+        skipped = [np.asarray(next(a)["inputs"]) for _ in range(5)]
+    finally:
+        a.close()
+    b = BatchLoader(
+        ds, batch_size=4, process_id=1, process_count=2, seed=5, start_step=3
+    )
+    try:
+        resumed = np.asarray(next(b)["inputs"])
+        np.testing.assert_array_equal(resumed, skipped[3])
+    finally:
+        b.close()
+
+
+def test_epoch_wraparound(token_file):
+    ds = TokenDataset(token_file, seq_len=99)
+    # 25 global batches/epoch at batch 4; step past an epoch boundary.
+    loader = BatchLoader(ds, batch_size=4, process_id=0, process_count=1,
+                         start_step=24)
+    try:
+        last_of_epoch = next(loader)
+        first_of_next = next(loader)
+        assert np.asarray(last_of_epoch["inputs"]).shape == (4, 99)
+        assert np.asarray(first_of_next["inputs"]).shape == (4, 99)
+    finally:
+        loader.close()
+
+
+def test_train_step_consumes_loader(token_file):
+    import jax
+
+    from dstack_tpu.workloads.config import PRESETS
+    from dstack_tpu.workloads.sharding import make_mesh
+    from dstack_tpu.workloads.train import init_train_state, make_train_step
+
+    cfg = PRESETS["tiny"]
+    ds = TokenDataset(token_file, seq_len=32)
+    mesh = make_mesh(jax.devices()[:8], model=2, seq=2)
+    loader = BatchLoader(ds, batch_size=4, mesh=mesh, process_id=0,
+                         process_count=1)
+    try:
+        state = init_train_state(cfg, jax.random.PRNGKey(0), mesh=mesh)
+        step = make_train_step(cfg, mesh)
+        for _ in range(2):
+            state, metrics = step(state, next(loader))
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state.step) == 2
+    finally:
+        loader.close()
+
+
+def test_encode_bytes_clips():
+    ids = encode_bytes("hé", vocab_size=128)
+    assert ids.dtype == np.int32
+    assert (ids < 128).all()
+
+
+def test_loader_error_surfaces_not_hangs(token_file):
+    ds = TokenDataset(token_file, seq_len=99)
+    # Vocab violation detected on the prefetch thread must raise on the
+    # consumer (not leave next() blocked forever).
+    loader = BatchLoader(ds, batch_size=2, process_id=0, process_count=1,
+                         vocab_size=10)
+    try:
+        with pytest.raises(RuntimeError, match="vocab_size"):
+            next(loader)
+    finally:
+        loader.close()
+
+
+def test_undersized_corpus_fails_at_construction(token_file):
+    ds = TokenDataset(token_file, seq_len=99)  # 100 rows
+    with pytest.raises(ValueError, match="hosts"):
+        BatchLoader(ds, batch_size=50, process_id=0, process_count=4)
