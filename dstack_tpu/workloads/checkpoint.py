@@ -76,7 +76,8 @@ def restore_latest(
     restored = mngr.restore(
         step, args=ocp.args.StandardRestore(template._asdict())
     )
-    return TrainState(**restored)
+    # Works for any NamedTuple state (TrainState, LoraState, ...).
+    return type(template)(**restored)
 
 
 def export_params(directory: Union[str, Path], state: TrainState) -> None:

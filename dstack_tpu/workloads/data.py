@@ -7,12 +7,14 @@ module is the host-side loader for that:
 - `TokenDataset` — a flat int32 token file (numpy .npy, memmapped: no
   HBM, no RAM blowup; the OS page cache does the work) cut into
   fixed-length rows. Deterministic shuffling by permuting row indices
-  with a seeded RNG per epoch, so every host computes the same global
-  order and takes every (process_count)-th batch — disjoint by
-  construction, no coordination traffic.
-- `BatchLoader` — a background prefetch thread that stages the next
-  batches onto device (`jax.device_put` with the training sharding)
-  while the current step runs, overlapping host I/O + H2D with compute.
+  with a seeded RNG per epoch, so every host derives the SAME global
+  batch order with no coordination traffic.
+- `BatchLoader` — yields the GLOBAL batch each step, assembled with
+  `jax.make_array_from_callback`: the callback materializes exactly the
+  (batch-rows x sequence-window) shards this host's devices own, under
+  ANY mesh layout (dp/fsdp/seq split across hosts however they like), so
+  each host reads only its slice of the corpus. A background prefetch
+  thread overlaps that I/O + H2D with the running step.
 - `write_token_file` / `encode_bytes` — build the .npy from raw text
   (byte-level, matching the example tokenizer) so the examples run
   without external corpora.
@@ -76,44 +78,31 @@ class TokenDataset:
         return out
 
 
-def _host_batches(
+def _global_batches(
     ds: TokenDataset,
     batch_size: int,
-    process_id: int,
-    process_count: int,
     seed: int,
     start_step: int,
 ) -> Iterator[np.ndarray]:
-    """Infinite stream of this host's batches, deterministic in step.
-
-    The global epoch order is cut into consecutive global batches; host p
-    takes batch p, p+count, p+2*count, ... — disjoint across hosts, and a
-    resume at `start_step` re-derives position with no state file.
-    """
-    per_epoch = ds.n_rows // batch_size  # global batches per epoch
-    if per_epoch < process_count:
-        raise ValueError(
-            f"dataset has {per_epoch} batches/epoch < {process_count} hosts"
-        )
+    """Infinite stream of GLOBAL batch row-indices, deterministic in step —
+    identical on every host, and a resume at `start_step` re-derives
+    position with no state file."""
+    per_epoch = ds.n_rows // batch_size  # batches per epoch
     step = start_step
     cached = (-1, None)  # (epoch, order): one permutation per epoch, not per batch
     while True:
-        gbatch = step * process_count + process_id
-        epoch, within = divmod(gbatch, per_epoch)
+        epoch, within = divmod(step, per_epoch)
         if cached[0] != epoch:
             cached = (epoch, ds.epoch_order(epoch, seed))
-        order = cached[1]
-        idx = order[within * batch_size : (within + 1) * batch_size]
-        yield ds.rows(idx)
+        yield cached[1][within * batch_size : (within + 1) * batch_size]
         step += 1
 
 
 class BatchLoader:
-    """Background-prefetched, device-placed batches for the train loop.
-
-    `batch_size` is PER HOST (the local share of the global batch). With a
-    mesh, arrays are placed with the training batch sharding so the step
-    consumes them without a transfer on the critical path.
+    """Background-prefetched, device-placed GLOBAL batches for the train
+    loop. `batch_size` is the global batch; with a mesh, arrays are
+    assembled shard-by-shard via `make_array_from_callback`, so this host
+    only ever reads the corpus windows its devices own.
     """
 
     def __init__(
@@ -122,26 +111,20 @@ class BatchLoader:
         batch_size: int,
         *,
         mesh: Optional[Mesh] = None,
-        process_id: Optional[int] = None,
-        process_count: Optional[int] = None,
         seed: int = 0,
         start_step: int = 0,
         prefetch: int = 2,
         vocab_size: Optional[int] = None,
     ):
         self.dataset = dataset
-        pid = jax.process_index() if process_id is None else process_id
-        pcount = jax.process_count() if process_count is None else process_count
         # Fail fast (the generator body would only run on the prefetch
-        # thread): undersized corpora are a config error, not a hang.
-        if dataset.n_rows // batch_size < pcount:
+        # thread): an undersized corpus is a config error, not a hang.
+        if dataset.n_rows < batch_size:
             raise ValueError(
-                f"dataset has {dataset.n_rows // batch_size} batches/epoch"
-                f" < {pcount} hosts"
+                f"dataset has {dataset.n_rows} rows < batch_size {batch_size}"
             )
-        self._source = _host_batches(
-            dataset, batch_size, pid, pcount, seed, start_step
-        )
+        self.batch_size = batch_size
+        self._source = _global_batches(dataset, batch_size, seed, start_step)
         self._sharding = (
             NamedSharding(mesh, BATCH_SPEC) if mesh is not None else None
         )
@@ -151,33 +134,57 @@ class BatchLoader:
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
-    def _place(self, rows: np.ndarray) -> Dict[str, jax.Array]:
-        if self._vocab_size is not None and rows.max(initial=0) >= self._vocab_size:
+    def _materialize(self, idx: np.ndarray, offset: int):
+        """Shard callback factory: element [i, j] of the global array is
+        tokens[idx[i] * row + offset + j] (offset 0 = inputs, 1 = targets).
+        `make_array_from_callback` invokes it once per addressable shard
+        with slices into the global (B, S) shape."""
+        ds = self.dataset
+
+        def cb(index) -> np.ndarray:
+            rows = idx[index[0]]
+            c0, c1, _ = index[1].indices(ds.seq_len)
+            out = np.empty((len(rows), c1 - c0), dtype=np.int32)
+            for i, r in enumerate(rows):
+                start = int(r) * ds.row + offset + c0
+                out[i] = ds.tokens[start : start + (c1 - c0)]
+            self._check_vocab(out)
+            return out
+
+        return cb
+
+    def _check_vocab(self, arr: np.ndarray) -> None:
+        if self._vocab_size is not None and arr.max(initial=0) >= self._vocab_size:
             raise ValueError(
-                f"corpus token id {int(rows.max())} >= vocab_size"
+                f"corpus token id {int(arr.max())} >= vocab_size"
                 f" {self._vocab_size} — wrong tokenizer for this model"
                 " (TPU gathers clamp silently; failing loud instead)"
             )
-        batch = {"inputs": rows[:, :-1], "targets": rows[:, 1:]}
+
+    def _place(self, idx: np.ndarray) -> Dict[str, jax.Array]:
         if self._sharding is not None:
-            if jax.process_count() > 1:
-                # Each host holds only ITS shard of the global batch; the
-                # global array is assembled from the per-process pieces
-                # (device_put with a global sharding would treat the local
-                # shard as the whole batch).
-                return {
-                    k: jax.make_array_from_process_local_data(self._sharding, v)
-                    for k, v in batch.items()
-                }
-            return {k: jax.device_put(v, self._sharding) for k, v in batch.items()}
-        return {k: jax.device_put(v) for k, v in batch.items()}
+            shape = (len(idx), self.dataset.seq_len)
+            return {
+                "inputs": jax.make_array_from_callback(
+                    shape, self._sharding, self._materialize(idx, 0)
+                ),
+                "targets": jax.make_array_from_callback(
+                    shape, self._sharding, self._materialize(idx, 1)
+                ),
+            }
+        rows = self.dataset.rows(idx)
+        self._check_vocab(rows)
+        return {
+            "inputs": jax.device_put(rows[:, :-1]),
+            "targets": jax.device_put(rows[:, 1:]),
+        }
 
     def _fill(self) -> None:
         try:
-            for rows in self._source:
+            for idx in self._source:
                 if self._stop:
                     return
-                placed = self._place(rows)
+                placed = self._place(idx)
                 while not self._stop:
                     try:
                         self._q.put(placed, timeout=0.2)

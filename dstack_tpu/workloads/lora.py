@@ -1,0 +1,160 @@
+"""LoRA fine-tuning: low-rank adapters over the frozen base model.
+
+Parity: the reference's fine-tuning examples run TRL/PEFT LoRA inside
+torch containers (reference examples/fine-tuning/trl/); this is the
+framework-native equivalent. Design: adapters are a separate tiny pytree
+and the train step MERGES them into the frozen base (W + (alpha/r)·A@B)
+at the top of the step — `transformer.forward` runs completely unchanged,
+gradients flow to A/B through the merge, and the optimizer (with its f32
+moments) covers only the adapter tree, which is what makes LoRA cheap:
+optimizer state for a 70B base drops from ~560 GB to the adapters' few
+hundred MB.
+
+A is Gaussian, B is zero — step 0 is exactly the base model. Checkpoints
+save adapters only; `merge_lora` produces plain params for serving (and
+composes with int8 quantization: quantize the merged tree).
+"""
+
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.workloads.attention import make_attention_fn
+from dstack_tpu.workloads.config import ModelConfig
+from dstack_tpu.workloads.train import loss_fn, make_optimizer
+
+Params = Dict[str, Any]
+
+DEFAULT_TARGETS = ("wq", "wv")  # the classic LoRA attention targets
+
+
+class LoraState(NamedTuple):
+    step: jnp.ndarray
+    lora: Params       # {"layers": {f"{t}_a": (L, in, r), f"{t}_b": (L, r, out)}}
+    opt_state: Any
+
+
+def lora_init(
+    config: ModelConfig,
+    base: Params,
+    key: jax.Array,
+    *,
+    rank: int = 8,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+) -> Params:
+    layers: Params = {}
+    for i, t in enumerate(targets):
+        w = base["layers"][t]
+        if not hasattr(w, "shape"):
+            raise ValueError(f"target {t!r} is not a plain weight (quantized base?)")
+        L, d_in, d_out = w.shape
+        k = jax.random.fold_in(key, i)
+        layers[f"{t}_a"] = (
+            jax.random.normal(k, (L, d_in, rank), jnp.float32) * d_in**-0.5
+        ).astype(w.dtype)
+        # B starts at zero: the merged model IS the base model at step 0.
+        layers[f"{t}_b"] = jnp.zeros((L, rank, d_out), w.dtype)
+    return {"layers": layers}
+
+
+def merge_lora(
+    base: Params,
+    lora: Params,
+    *,
+    rank: int,
+    alpha: float = 16.0,
+) -> Params:
+    """base with W_t := W_t + (alpha/rank) * A_t @ B_t for each target."""
+    scale = alpha / rank
+    layers = dict(base["layers"])
+    for name, a in lora["layers"].items():
+        if not name.endswith("_a"):
+            continue
+        t = name[:-2]
+        b = lora["layers"][t + "_b"]
+        delta = jnp.einsum(
+            "lir,lro->lio", a, b, preferred_element_type=jnp.float32
+        ) * scale
+        layers[t] = (layers[t].astype(jnp.float32) + delta).astype(layers[t].dtype)
+    return {**base, "layers": layers}
+
+
+def lora_param_count(lora: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(lora))
+
+
+def _lora_specs(lora_like: Params) -> Params:
+    """A shards its input dim like the base weight ('fsdp'); B its output
+    dim ('model'); the tiny rank dim replicates."""
+
+    def spec_for(path, leaf):
+        name = str(getattr(path[-1], "key", ""))
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 3:
+            return P(None, "fsdp", None) if name.endswith("_a") else P(None, None, "model")
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, lora_like)
+
+
+def init_lora_state(
+    config: ModelConfig,
+    base: Params,
+    key: jax.Array,
+    *,
+    rank: int = 8,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    mesh: Optional[Mesh] = None,
+    learning_rate: float = 1e-4,
+) -> LoraState:
+    lora = lora_init(config, base, key, rank=rank, targets=targets)
+    opt_state = make_optimizer(learning_rate).init(lora)
+    state = LoraState(jnp.zeros((), jnp.int32), lora, opt_state)
+    if mesh is not None:
+        def to_named(tree):
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), _lora_specs(tree),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        state = jax.device_put(
+            state,
+            LoraState(NamedSharding(mesh, P()), to_named(state.lora),
+                      to_named(state.opt_state)),
+        )
+    return state
+
+
+def make_lora_train_step(
+    config: ModelConfig,
+    mesh: Optional[Mesh] = None,
+    *,
+    rank: int = 8,
+    alpha: float = 16.0,
+    learning_rate: float = 1e-4,
+):
+    """step(state, base, batch) -> (state, metrics). base is frozen (no
+    grads, no donation); only the adapter tree updates."""
+    optimizer = make_optimizer(learning_rate)
+    attention_fn = make_attention_fn(mesh)
+
+    def step(state: LoraState, base: Params, batch) -> Tuple[LoraState, Dict]:
+        def lora_loss(lora):
+            merged = merge_lora(base, lora, rank=rank, alpha=alpha)
+            loss, aux = loss_fn(config, merged, batch, attention_fn, mesh)
+            return loss, aux
+
+        (loss, _aux), grads = jax.value_and_grad(lora_loss, has_aux=True)(
+            state.lora
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.lora)
+        lora = optax.apply_updates(state.lora, updates)
+        return (
+            LoraState(state.step + 1, lora, opt_state),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+        )
+
+    return jax.jit(step, donate_argnums=0)

@@ -36,6 +36,10 @@ def main() -> None:
     parser.add_argument("--seq-parallel", type=int, default=1)
     parser.add_argument("--expert-parallel", type=int, default=1)
     parser.add_argument(
+        "--lora-rank", type=int, default=0,
+        help="train low-rank adapters over the frozen base (0 = full fine-tune)",
+    )
+    parser.add_argument(
         "--data", default="",
         help="flat int32 token .npy (workloads/data.py); synthetic if unset",
     )
@@ -64,7 +68,42 @@ def main() -> None:
         jax.devices(), model=args.model_parallel, seq=args.seq_parallel,
         expert=args.expert_parallel,
     )
-    state = init_train_state(config, jax.random.PRNGKey(0), mesh=mesh)
+    # One state + one step either way; LoRA swaps in the tiny adapter
+    # state and a step closed over the frozen base — data, checkpoints,
+    # and the loop below are shared.
+    if args.lora_rank > 0:
+        from dstack_tpu.workloads.lora import (
+            init_lora_state,
+            make_lora_train_step,
+            merge_lora,
+        )
+        from dstack_tpu.workloads.sharding import shard_tree
+        from dstack_tpu.workloads.train import TrainState
+        from dstack_tpu.workloads.transformer import init_params
+
+        base = shard_tree(mesh, init_params(config, jax.random.PRNGKey(0)))
+        state = init_lora_state(
+            config, base, jax.random.PRNGKey(1), rank=args.lora_rank, mesh=mesh
+        )
+        _lora_step = make_lora_train_step(config, mesh, rank=args.lora_rank)
+
+        def step(s, b):
+            return _lora_step(s, base, b)
+
+        def export(final_state):
+            # Serve the merged model; checkpoints stored the adapters only.
+            merged = merge_lora(base, final_state.lora, rank=args.lora_rank)
+            ckpt.export_params(
+                args.checkpoint_dir,
+                TrainState(final_state.step, merged, None),
+            )
+    else:
+        state = init_train_state(config, jax.random.PRNGKey(0), mesh=mesh)
+        step = make_train_step(config, mesh)
+
+        def export(final_state):
+            ckpt.export_params(args.checkpoint_dir, final_state)
+
     if args.checkpoint_dir:
         # Resume from the mounted volume: a retried gang continues at the
         # last saved step instead of step 0 (dstack_tpu.workloads.checkpoint).
@@ -73,7 +112,7 @@ def main() -> None:
             state = restored
             if jax.process_index() == 0:
                 print(f"resumed from step {int(state.step)}")
-    step = make_train_step(config, mesh)
+
     # The global batch shards over the data+fsdp axes; round up so every
     # device gets at least one row.
     dp = mesh.shape["data"] * mesh.shape["fsdp"]
@@ -84,16 +123,11 @@ def main() -> None:
     if args.data:
         from dstack_tpu.workloads.data import BatchLoader, TokenDataset
 
-        # Per-host share of the global batch: round the global batch up to
-        # a host multiple too (and say so), never silently change it.
-        hosts = jax.process_count()
-        per = ((batch_size + hosts - 1) // hosts)
-        if per * hosts != batch_size and jax.process_index() == 0:
-            print(f"batch size {batch_size} -> {per * hosts} (divisible by {hosts} hosts)")
-        batch_size = per * hosts
+        # The loader yields the GLOBAL batch; every host derives the same
+        # order and materializes only its devices' shards (workloads/data.py).
         loader = BatchLoader(
             TokenDataset(args.data, args.seq_len),
-            per,
+            batch_size,
             mesh=mesh,
             start_step=int(state.step),
             vocab_size=config.vocab_size,
@@ -118,7 +152,7 @@ def main() -> None:
     if args.checkpoint_dir:
         # Params-only export for serving (deployment/native/server.py reads
         # this without materializing optimizer moments).
-        ckpt.export_params(args.checkpoint_dir, state)
+        export(state)
         ckpt.close_all()  # drain async writers before the job exits
     if loader is not None:
         loader.close()

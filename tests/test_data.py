@@ -37,30 +37,50 @@ def test_epoch_order_deterministic_and_epoch_varying(token_file):
     assert sorted(a.tolist()) == list(range(ds.n_rows))
 
 
-def test_hosts_get_disjoint_batches(token_file):
+def test_hosts_derive_identical_global_batches(token_file):
+    """Every host computes the same global batch per step (assembly then
+    takes only the shards a host's devices own)."""
     ds = TokenDataset(token_file, seq_len=99)
-    loaders = [
-        BatchLoader(
-            ds, batch_size=4, process_id=p, process_count=4, seed=3, prefetch=1
-        )
-        for p in range(4)
-    ]
+    a = BatchLoader(ds, batch_size=4, seed=3, prefetch=1)
+    b = BatchLoader(ds, batch_size=4, seed=3, prefetch=1)
     try:
-        seen = set()
-        for loader in loaders:
-            for _ in range(3):
-                batch = next(loader)
-                key = tuple(np.asarray(batch["inputs"])[:, :3].ravel().tolist())
-                assert key not in seen, "hosts produced an identical batch"
-                seen.add(key)
+        for _ in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(next(a)["inputs"]), np.asarray(next(b)["inputs"])
+            )
     finally:
-        for loader in loaders:
-            loader.close()
+        a.close()
+        b.close()
+
+
+def test_sharded_assembly_matches_reference(token_file):
+    """The callback-assembled global array equals the host-side rows under
+    a mesh that splits BOTH the batch and sequence dims."""
+    import jax
+
+    from dstack_tpu.workloads.sharding import make_mesh
+
+    ds = TokenDataset(token_file, seq_len=96)
+    mesh = make_mesh(jax.devices()[:8], seq=2, model=2)  # fsdp=2 x seq=2
+    loader = BatchLoader(ds, batch_size=4, mesh=mesh, seed=11)
+    ref = BatchLoader(ds, batch_size=4, seed=11)
+    try:
+        got = next(loader)
+        want = next(ref)
+        np.testing.assert_array_equal(
+            np.asarray(got["inputs"]), np.asarray(want["inputs"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got["targets"]), np.asarray(want["targets"])
+        )
+    finally:
+        loader.close()
+        ref.close()
 
 
 def test_inputs_targets_shifted(token_file):
     ds = TokenDataset(token_file, seq_len=16)
-    loader = BatchLoader(ds, batch_size=2, process_id=0, process_count=1)
+    loader = BatchLoader(ds, batch_size=2)
     try:
         batch = next(loader)
         inp = np.asarray(batch["inputs"])
@@ -73,14 +93,12 @@ def test_inputs_targets_shifted(token_file):
 
 def test_resume_at_step_reproduces_stream(token_file):
     ds = TokenDataset(token_file, seq_len=99)
-    a = BatchLoader(ds, batch_size=4, process_id=1, process_count=2, seed=5)
+    a = BatchLoader(ds, batch_size=4, seed=5)
     try:
         skipped = [np.asarray(next(a)["inputs"]) for _ in range(5)]
     finally:
         a.close()
-    b = BatchLoader(
-        ds, batch_size=4, process_id=1, process_count=2, seed=5, start_step=3
-    )
+    b = BatchLoader(ds, batch_size=4, seed=5, start_step=3)
     try:
         resumed = np.asarray(next(b)["inputs"])
         np.testing.assert_array_equal(resumed, skipped[3])
@@ -91,8 +109,7 @@ def test_resume_at_step_reproduces_stream(token_file):
 def test_epoch_wraparound(token_file):
     ds = TokenDataset(token_file, seq_len=99)
     # 25 global batches/epoch at batch 4; step past an epoch boundary.
-    loader = BatchLoader(ds, batch_size=4, process_id=0, process_count=1,
-                         start_step=24)
+    loader = BatchLoader(ds, batch_size=4, start_step=24)
     try:
         last_of_epoch = next(loader)
         first_of_next = next(loader)
@@ -112,8 +129,7 @@ def test_train_step_consumes_loader(token_file):
     cfg = PRESETS["tiny"]
     ds = TokenDataset(token_file, seq_len=32)
     mesh = make_mesh(jax.devices()[:8], model=2, seq=2)
-    loader = BatchLoader(ds, batch_size=4, mesh=mesh, process_id=0,
-                         process_count=1)
+    loader = BatchLoader(ds, batch_size=4, mesh=mesh)
     try:
         state = init_train_state(cfg, jax.random.PRNGKey(0), mesh=mesh)
         step = make_train_step(cfg, mesh)
@@ -135,8 +151,7 @@ def test_loader_error_surfaces_not_hangs(token_file):
     ds = TokenDataset(token_file, seq_len=99)
     # Vocab violation detected on the prefetch thread must raise on the
     # consumer (not leave next() blocked forever).
-    loader = BatchLoader(ds, batch_size=2, process_id=0, process_count=1,
-                         vocab_size=10)
+    loader = BatchLoader(ds, batch_size=2, vocab_size=10)
     try:
         with pytest.raises(RuntimeError, match="vocab_size"):
             next(loader)
@@ -146,5 +161,5 @@ def test_loader_error_surfaces_not_hangs(token_file):
 
 def test_undersized_corpus_fails_at_construction(token_file):
     ds = TokenDataset(token_file, seq_len=99)  # 100 rows
-    with pytest.raises(ValueError, match="hosts"):
-        BatchLoader(ds, batch_size=50, process_id=0, process_count=4)
+    with pytest.raises(ValueError, match="batch_size"):
+        BatchLoader(ds, batch_size=500)
