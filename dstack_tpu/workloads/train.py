@@ -12,6 +12,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.workloads.attention import make_attention_fn
@@ -30,9 +31,27 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
-def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1):
+def make_optimizer(
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    *,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+):
+    """AdamW with f32 moments; optional linear-warmup + cosine decay (the
+    standard LLM schedule) when warmup_steps/decay_steps are set."""
+    if warmup_steps or decay_steps:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(decay_steps, warmup_steps + 1),
+            end_value=learning_rate * 0.1,
+        )
+    else:
+        lr = learning_rate
     return optax.adamw(
-        learning_rate, b1=0.9, b2=0.95, weight_decay=weight_decay,
+        lr, b1=0.9, b2=0.95, weight_decay=weight_decay,
         mu_dtype=jnp.float32,
     )
 
@@ -42,9 +61,16 @@ def init_train_state(
     key: jax.Array,
     mesh: Optional[Mesh] = None,
     learning_rate: float = 3e-4,
+    *,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
 ) -> TrainState:
+    # Schedule args must match make_train_step's: a scheduled optimizer has
+    # a different opt-state structure than a constant-lr one.
     params = init_params(config, key)
-    opt_state = make_optimizer(learning_rate).init(params)
+    opt_state = make_optimizer(
+        learning_rate, warmup_steps=warmup_steps, decay_steps=decay_steps
+    ).init(params)
     state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
     if mesh is not None:
         state = shard_tree(mesh, state)
@@ -85,20 +111,65 @@ def make_train_step(
     config: ModelConfig,
     mesh: Optional[Mesh] = None,
     learning_rate: float = 3e-4,
+    *,
+    accum_steps: int = 1,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
 ):
     """Returns `train_step(state, batch) -> (state, metrics)`, jitted.
 
     With a mesh the returned fn is committed to NamedShardings (in/out) and
     the state buffer is donated; without one it is a plain single-device jit.
+    accum_steps > 1 cuts the batch into that many microbatches and
+    accumulates grads in a lax.scan before ONE optimizer update — the
+    standard way to run a bigger effective batch than activations allow
+    (activation memory is one microbatch; grads/params unchanged).
     """
-    optimizer = make_optimizer(learning_rate)
+    optimizer = make_optimizer(
+        learning_rate, warmup_steps=warmup_steps, decay_steps=decay_steps
+    )
     attention_fn = make_attention_fn(mesh)
 
-    def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        (loss, aux), grads = jax.value_and_grad(
+    def grads_of(params, batch):
+        return jax.value_and_grad(
             lambda p: loss_fn(config, p, batch, attention_fn, mesh),
             has_aux=True,
-        )(state.params)
+        )(params)
+
+    def accumulated_grads(params, batch):
+        # (B, ...) -> (accum, B/accum, ...): scan keeps one microbatch of
+        # activations live; grads average across microbatches.
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps,
+                                *x.shape[1:]),
+            batch,
+        )
+
+        def body(carry, mb):
+            (loss, aux), grads = grads_of(params, mb)
+            loss_sum, aux_sum, grads_sum = carry
+            grads_sum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grads_sum, grads
+            )
+            return (loss_sum + loss, aux_sum + aux, grads_sum), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss, aux, grads), _ = lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0), zeros), micro
+        )
+        n = jnp.float32(accum_steps)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / n).astype(p.dtype), grads, params
+        )
+        return (loss / n, aux / n), grads
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        if accum_steps > 1:
+            (loss, aux), grads = accumulated_grads(state.params, batch)
+        else:
+            (loss, aux), grads = grads_of(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

@@ -157,3 +157,61 @@ class TestRematPolicy:
         assert big.resolve_remat(8 * 8192) == "dots"
         # fsdp across 64 chips frees the budget.
         assert big.resolve_remat(8 * 8192, {"fsdp": 64}) == "none"
+
+
+class TestAccumulationAndSchedule:
+    def test_accumulated_grads_match_full_batch(self):
+        import jax
+        import numpy as np
+
+        from dstack_tpu.workloads.config import PRESETS
+        from dstack_tpu.workloads.train import (
+            init_train_state,
+            make_train_step,
+            synthetic_batch,
+        )
+
+        cfg = PRESETS["tiny"].with_(remat=False)
+        batch = synthetic_batch(cfg, batch_size=4, seq_len=32)
+
+        s1 = init_train_state(cfg, jax.random.PRNGKey(0))
+        m1 = make_train_step(cfg)(s1, batch)[1]
+        s2 = init_train_state(cfg, jax.random.PRNGKey(0))
+        m2 = make_train_step(cfg, accum_steps=4)(s2, batch)[1]
+        # Same data, same update: mean-of-microbatch grads == full-batch
+        # grads for a mean loss.
+        assert abs(float(m1["loss"]) - float(m2["loss"])) < 1e-3
+        assert abs(float(m1["grad_norm"]) - float(m2["grad_norm"])) < 1e-2
+
+    def test_warmup_schedule_starts_small(self):
+        import jax
+        import numpy as np
+
+        from dstack_tpu.workloads.config import PRESETS
+        from dstack_tpu.workloads.train import (
+            init_train_state,
+            make_train_step,
+            synthetic_batch,
+        )
+
+        cfg = PRESETS["tiny"].with_(remat=False)
+        batch = synthetic_batch(cfg, batch_size=2, seq_len=32)
+        state = init_train_state(
+            cfg, jax.random.PRNGKey(0), warmup_steps=100, decay_steps=1000
+        )
+        step = make_train_step(cfg, warmup_steps=100, decay_steps=1000)
+        p0 = np.asarray(state.params["layers"]["wq"], dtype=np.float32)
+        state, metrics = step(state, batch)
+        # Step 0 of warmup has lr exactly 0 (init_value=0): no movement,
+        # but the schedule-bearing optimizer state round-trips fine.
+        d1 = np.abs(
+            np.asarray(state.params["layers"]["wq"], dtype=np.float32) - p0
+        ).max()
+        assert d1 == 0
+        state, metrics = step(state, batch)
+        # Step 1: lr ~ peak/100 — tiny but nonzero movement.
+        d2 = np.abs(
+            np.asarray(state.params["layers"]["wq"], dtype=np.float32) - p0
+        ).max()
+        assert 0 < d2 < 1e-3
+        assert np.isfinite(float(metrics["loss"]))
