@@ -8,6 +8,7 @@ the topology catalog. Offers for multi-host slices advertise `hosts > 1`
 and are gang-provisioned.
 """
 
+import re
 from typing import Dict, List, Optional, Tuple
 
 from dstack_tpu.models.backends import BackendType
@@ -38,7 +39,7 @@ GENERATION_REGIONS: Dict[TpuGeneration, List[Tuple[str, str]]] = {
     TpuGeneration.V4: [("us-central2", "us-central2-b")],
     TpuGeneration.V5E: [
         ("us-central1", "us-central1-a"),
-        ("us-west4", "us-west4-1"),
+        ("us-west4", "us-west4-a"),
         ("europe-west4", "europe-west4-b"),
     ],
     TpuGeneration.V5P: [("us-east5", "us-east5-a"), ("us-central1", "us-central1-a")],
@@ -60,6 +61,29 @@ HOST_RESOURCES: Dict[TpuGeneration, Tuple[int, int]] = {
 }
 
 
+# GCP naming: region `us-central1`, zone `us-central1-a`. A malformed zone
+# string in an offer is only caught by the real TPU API at node create —
+# the worst possible moment — so offers validate eagerly.
+REGION_RE = re.compile(r"^[a-z]+-[a-z]+\d+$")
+ZONE_RE = re.compile(r"^[a-z]+-[a-z]+\d+-[a-z]$")
+
+
+def validate_zone(zone: str) -> str:
+    if not ZONE_RE.match(zone):
+        raise ValueError(
+            f"malformed GCP zone {zone!r} (expected e.g. 'us-central1-a')"
+        )
+    return zone
+
+
+def validate_region(region: str) -> str:
+    if not REGION_RE.match(region):
+        raise ValueError(
+            f"malformed GCP region {region!r} (expected e.g. 'us-central1')"
+        )
+    return region
+
+
 def tpu_offer(
     topo: TpuTopology,
     region: str,
@@ -67,6 +91,9 @@ def tpu_offer(
     spot: bool,
     backend: BackendType = BackendType.GCP,
 ) -> InstanceOfferWithAvailability:
+    if backend == BackendType.GCP:  # local/k8s use synthetic zone names
+        validate_region(region)
+        validate_zone(zone)
     cpus, mem_gb = HOST_RESOURCES[topo.generation]
     price = CHIP_HOUR_PRICES[topo.generation] * topo.chips
     if spot:

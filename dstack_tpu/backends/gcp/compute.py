@@ -16,6 +16,8 @@ import json
 import re
 from typing import Any, Dict, List, Optional
 
+from pydantic import field_validator
+
 from dstack_tpu.backends.base.catalog import get_tpu_catalog
 from dstack_tpu.backends.base.compute import Compute
 from dstack_tpu.backends.base.offers import filter_offers
@@ -34,7 +36,10 @@ from dstack_tpu.models.gateways import (
     GatewayComputeConfiguration,
     GatewayProvisioningData,
 )
-from dstack_tpu.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.models.instances import (
+    InstanceAvailability,
+    InstanceOfferWithAvailability,
+)
 from dstack_tpu.models.runs import JobProvisioningData, Requirements
 from dstack_tpu.models.topology import TpuGeneration, TpuTopology
 from dstack_tpu.models.volumes import (
@@ -47,6 +52,9 @@ from dstack_tpu.models.volumes import (
 class GCPBackendConfig(CoreModel):
     type: str = "gcp"
     project_id: str
+    # Region strings are validated at config-apply (pydantic validator
+    # below): a typo'd region would otherwise surface as an empty offer
+    # list or a node-create 400 at provisioning time.
     regions: List[str] = []
     generations: List[str] = []  # e.g. ["v5e", "v5p"]; empty = all
     network: str = "default"
@@ -55,6 +63,15 @@ class GCPBackendConfig(CoreModel):
     queued_provisioning: bool = False  # route all creates via queuedResources
     reservation: Optional[str] = None
     access_token: Optional[str] = None  # mainly for tests/short-lived auth
+
+    @field_validator("regions")
+    @classmethod
+    def _validate_regions(cls, v: List[str]) -> List[str]:
+        from dstack_tpu.backends.base.catalog import validate_region
+
+        for region in v:
+            validate_region(region)
+        return v
 
 
 def _sanitize_node_id(name: str) -> str:
@@ -74,6 +91,10 @@ class GCPCompute(Compute):
 
     # --- offers -------------------------------------------------------------
 
+    # Live-discovery cache TTL: accelerator availability and quota move on
+    # human timescales; the offers path runs on every plan/submit.
+    _DISCOVERY_TTL = 600.0
+
     async def get_offers(
         self, requirements: Requirements
     ) -> List[InstanceOfferWithAvailability]:
@@ -81,7 +102,120 @@ class GCPCompute(Compute):
         offers = get_tpu_catalog(generations, backend=BackendType.GCP)
         if self.config.regions:
             offers = [o for o in offers if o.region in self.config.regions]
+        offers = await self._annotate_live(offers)
         return filter_offers(offers, requirements)
+
+    async def _annotate_live(
+        self, offers: List[InstanceOfferWithAvailability]
+    ) -> List[InstanceOfferWithAvailability]:
+        """Correct the static catalog against the real project: drop offers
+        whose accelerator type the zone does not actually serve
+        (`locations/{zone}/acceleratorTypes`), and mark NO_QUOTA where the
+        region's TPU quota cannot fit the slice.
+
+        Parity: the reference augments its catalog with a region quota
+        pass (gcp/compute.py:92-114 `_get_regions_to_quotas`). Discovery
+        failures degrade to the static table (availability UNKNOWN) — a
+        flaky quota API must never blank out the catalog.
+        """
+        import asyncio as _asyncio
+
+        # Warm the per-zone/per-region caches concurrently: the lookups
+        # are independent HTTPS round-trips, and doing them serially would
+        # add seconds to every cold offers call.
+        await _asyncio.gather(
+            *(self._zone_accelerator_types(z) for z in {o.zone for o in offers}),
+            *(self._region_tpu_quota(r) for r in {o.region for o in offers}),
+        )
+        out: List[InstanceOfferWithAvailability] = []
+        for offer in offers:
+            types = await self._zone_accelerator_types(offer.zone)
+            if types is not None and offer.instance.name not in types:
+                continue  # the zone genuinely does not serve this slice
+            if types is not None:
+                offer = offer.model_copy(
+                    update={"availability": InstanceAvailability.AVAILABLE}
+                )
+                quota = await self._region_tpu_quota(offer.region)
+                chips = offer.instance.resources.tpu.chips if offer.instance.resources.tpu else 0
+                spot = offer.instance.resources.spot
+                metric = "preemptible" if spot else "on_demand"
+                limit = quota.get(metric)
+                if limit is not None and limit < chips:
+                    offer = offer.model_copy(
+                        update={"availability": InstanceAvailability.NO_QUOTA}
+                    )
+            out.append(offer)
+        return out
+
+    async def _zone_accelerator_types(self, zone: str) -> Optional[set]:
+        """Accelerator-type names a zone serves, or None when discovery is
+        unavailable (no credentials / API error) — cached per zone."""
+        import time
+
+        cache = getattr(self, "_type_cache", None)
+        if cache is None:
+            cache = self._type_cache = {}
+        hit = cache.get(zone)
+        if hit is not None and time.monotonic() - hit[0] < self._DISCOVERY_TTL:
+            return hit[1]
+        try:
+            names: set = set()
+            url = (
+                f"{TPU_API}/projects/{self.config.project_id}"
+                f"/locations/{zone}/acceleratorTypes"
+            )
+            page: Optional[str] = None
+            while True:
+                resp = await self.api.request(
+                    "GET", url + (f"?pageToken={page}" if page else "")
+                )
+                for t in resp.get("acceleratorTypes", []):
+                    names.add(t["name"].rsplit("/", 1)[-1])
+                page = resp.get("nextPageToken")
+                if not page:
+                    break
+            result: Optional[set] = names
+        except Exception:
+            # Not just BackendError: a socket timeout mid-read or a proxy
+            # handing back HTML both escape GcpApi's wrapping — any
+            # discovery failure must degrade to the static catalog, never
+            # fail the offers call.
+            result = None
+        cache[zone] = (time.monotonic(), result)
+        return result
+
+    async def _region_tpu_quota(self, region: str) -> Dict[str, float]:
+        """{'on_demand': chips, 'preemptible': chips} headroom from the
+        region's compute quotas (metrics containing 'TPU'); empty when the
+        quota API is unreachable or exposes no TPU metrics."""
+        import time
+
+        cache = getattr(self, "_quota_cache", None)
+        if cache is None:
+            cache = self._quota_cache = {}
+        hit = cache.get(region)
+        if hit is not None and time.monotonic() - hit[0] < self._DISCOVERY_TTL:
+            return hit[1]
+        quotas: Dict[str, float] = {}
+        try:
+            resp = await self.api.request(
+                "GET",
+                f"{COMPUTE_API}/projects/{self.config.project_id}/regions/{region}",
+            )
+            for q in resp.get("quotas", []):
+                metric = q.get("metric", "")
+                if "TPU" not in metric:
+                    continue
+                headroom = float(q.get("limit", 0)) - float(q.get("usage", 0))
+                key = "preemptible" if "PREEMPTIBLE" in metric else "on_demand"
+                # Several TPU metrics can coexist; keep the most generous
+                # (generation-specific metrics vary by project vintage).
+                quotas[key] = max(quotas.get(key, 0.0), headroom)
+        except Exception:
+            pass  # same degradation rule as _zone_accelerator_types
+        cache[region] = (time.monotonic(), quotas)
+        return quotas
 
     # --- provisioning -------------------------------------------------------
 

@@ -17,6 +17,7 @@ import time
 from pathlib import Path
 from typing import Dict, List, Optional
 
+from dstack_tpu.gateway.certs import AcmeSettings, CertError, CertManager, local_run
 from dstack_tpu.gateway.connections import ReplicaInfo, ServiceConnectionPool
 from dstack_tpu.gateway.nginx import NginxManager, SiteConfig, Upstream
 from dstack_tpu.server.http import App, Request, Response, Router, Server
@@ -32,8 +33,13 @@ class Registry:
         nginx: Optional[NginxManager] = None,
         tunnel_factory=None,
         state_path: Optional[Path] = None,
+        cert_manager: Optional["CertManager"] = None,
     ):
         self.nginx = nginx or NginxManager()
+        # ACME issuance for https services; None = certs are provisioned
+        # out-of-band (site renders https only once cert files exist).
+        self.certs = cert_manager
+        self._cert_tasks: Dict[str, "asyncio.Task"] = {}
         self.services: Dict[str, dict] = {}  # "{project}/{run}" -> info
         # Tunnels to replicas that are only reachable over SSH; nginx
         # upstreams point at the tunnel's unix socket.
@@ -81,10 +87,16 @@ class Registry:
         self._restoring = True
         try:
             for svc in state.get("services", []):
-                self.register_service(
+                # Issuance (if a cert is missing) happens in background —
+                # a down ACME directory cannot stall or lose the restore.
+                await self.register_service(
                     svc["project_name"], svc["run_name"], svc["domain"],
                     https=svc.get("https", False), auth=svc.get("auth", False),
                     auth_tokens=svc.get("auth_tokens"), options=svc.get("options"),
+                    # Persisted cert paths must survive the restart —
+                    # especially with ACME disabled, where nothing
+                    # could re-derive them.
+                    cert_path=svc.get("cert_path"), key_path=svc.get("key_path"),
                 )
                 for replica_id, rdef in (svc.get("replicas") or {}).items():
                     try:
@@ -100,7 +112,7 @@ class Registry:
             self._restoring = False
         self._save_state()
 
-    def register_service(
+    async def register_service(
         self,
         project_name: str,
         run_name: str,
@@ -109,12 +121,14 @@ class Registry:
         auth: bool = False,
         auth_tokens: Optional[List[str]] = None,
         options: Optional[dict] = None,
+        cert_path: Optional[str] = None,
+        key_path: Optional[str] = None,
     ) -> None:
         key = f"{project_name}/{run_name}"
         # Registration is idempotent and runs once per replica transition:
         # existing replicas must survive a re-register.
         existing = self.services.get(key)
-        self.services[key] = {
+        info = {
             "project_name": project_name,
             "run_name": run_name,
             "domain": domain,
@@ -127,8 +141,88 @@ class Registry:
             "replicas": existing["replicas"] if existing else {},
             "replica_defs": existing.get("replica_defs", {}) if existing else {},
         }
+        if cert_path and key_path:
+            # Explicit paths: restore() round-trips persisted ones, and
+            # operators can push out-of-band certs through the API.
+            info["cert_path"], info["key_path"] = cert_path, key_path
+        elif existing and existing.get("cert_path"):
+            # Re-registration must not drop an already-issued cert.
+            info["cert_path"] = existing["cert_path"]
+            info["key_path"] = existing["key_path"]
+        elif https and self.certs is None:
+            # ACME disabled (--no-certs): certs are provisioned out-of-band
+            # at the conventional letsencrypt paths. Use them when present;
+            # otherwise the site would silently serve plain http, so warn.
+            from dstack_tpu.gateway.certs import LIVE_DIR
+
+            cert = f"{LIVE_DIR}/{domain}/fullchain.pem"
+            keyf = f"{LIVE_DIR}/{domain}/privkey.pem"
+            import os as _os
+
+            if _os.path.exists(cert) and _os.path.exists(keyf):
+                info["cert_path"], info["key_path"] = cert, keyf
+            else:
+                logger.warning(
+                    "https service %s has no certificate at %s and ACME is"
+                    " disabled; serving plain http until one appears",
+                    key, cert,
+                )
+        self.services[key] = info
         self._apply(key)
         self._save_state()
+        if https and self.certs is not None and not info.get("cert_path"):
+            # Issuance must NOT block registration: the control plane
+            # registers a service inside a short-timeout HTTP call on the
+            # replica's RUNNING transition, while an ACME exchange can
+            # take tens of seconds. Two phases, decoupled: the http-only
+            # site just written serves the webroot challenge immediately;
+            # a background task obtains the cert and flips the site to
+            # 443 when it lands (failures keep http + are retried by the
+            # renew timer via retry_pending_certs).
+            self._spawn_cert_task(key, domain)
+
+    def _spawn_cert_task(self, key: str, domain: str) -> None:
+        existing = self._cert_tasks.get(key)
+        if existing is not None and not existing.done():
+            return
+        self._cert_tasks[key] = asyncio.get_event_loop().create_task(
+            self._issue_and_flip(key, domain)
+        )
+
+    async def _issue_and_flip(self, key: str, domain: str) -> None:
+        try:
+            cert, key_path = await self.certs.ensure(domain)
+        except CertError as e:
+            info = self.services.get(key)
+            if info is not None:
+                info["cert_error"] = str(e)
+            logger.warning("certificate for %s not issued: %s", domain, e)
+            return
+        info = self.services.get(key)
+        if info is None or info["domain"] != domain:
+            return  # unregistered/re-pointed while issuing
+        info["cert_path"], info["key_path"] = cert, key_path
+        info.pop("cert_error", None)
+        self._apply(key)
+        self._save_state()
+        logger.info("service %s flipped to https", key)
+
+    async def wait_cert_tasks(self) -> None:
+        """Drain in-flight issuance tasks (tests; graceful shutdown)."""
+        tasks = [t for t in self._cert_tasks.values() if not t.done()]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def retry_pending_certs(self) -> None:
+        """Re-attempt issuance for https services still serving http —
+        called by the renew timer, so a DNS record that appears a day
+        after the service does still converges to https."""
+        if self.certs is None:
+            return
+        for key, info in list(self.services.items()):
+            if info.get("https") and not info.get("cert_path"):
+                self._spawn_cert_task(key, info["domain"])
+        await self.wait_cert_tasks()
 
     def authorize(self, host: str, token: Optional[str]) -> bool:
         """auth_request decision for a request to `host` with bearer `token`."""
@@ -203,6 +297,8 @@ class Registry:
             project_name=info["project_name"],
             run_name=info["run_name"],
             https=info["https"],
+            cert_path=info.get("cert_path"),
+            key_path=info.get("key_path"),
             auth=info["auth"],
             upstreams=[Upstream(a) for a in info["replicas"].values()],
         )
@@ -242,11 +338,16 @@ def create_gateway_app(registry: Optional[Registry] = None) -> App:
     @router.post("/registry/services/register")
     async def register_service(request: Request):
         b = request.json()
-        reg.register_service(
+        # Returns immediately: ACME issuance (potentially tens of
+        # seconds) runs in background and flips the site to 443 when the
+        # cert lands — the control plane's short-timeout call must not
+        # block on it.
+        await reg.register_service(
             b["project_name"], b["run_name"], b["domain"],
             https=b.get("https", False), auth=b.get("auth", False),
             auth_tokens=b.get("auth_tokens"),
             options=b.get("options"),
+            cert_path=b.get("cert_path"), key_path=b.get("key_path"),
         )
         return {}
 
@@ -321,11 +422,32 @@ def main() -> None:
         "--conf-dir", default=None,
         help="nginx sites dir (default: /etc/nginx/sites-enabled)",
     )
+    parser.add_argument(
+        "--no-certs", action="store_true",
+        help="disable ACME issuance (certs provisioned out-of-band)",
+    )
+    parser.add_argument("--acme-server", default=None,
+                        help="custom ACME directory URL (default: Let's Encrypt)")
+    parser.add_argument("--acme-eab-kid", default=None)
+    parser.add_argument("--acme-eab-hmac-key", default=None)
     args = parser.parse_args()
 
     async def _serve() -> None:
-        nginx = NginxManager(conf_dir=Path(args.conf_dir)) if args.conf_dir else None
-        registry = Registry(nginx=nginx, state_path=Path(args.state_file))
+        nginx = NginxManager(conf_dir=Path(args.conf_dir)) if args.conf_dir else NginxManager()
+        certs = None
+        if not args.no_certs:
+            certs = CertManager(
+                local_run,
+                AcmeSettings(
+                    server=args.acme_server,
+                    eab_kid=args.acme_eab_kid,
+                    eab_hmac_key=args.acme_eab_hmac_key,
+                ),
+                reload_cb=nginx.reload,
+            )
+        registry = Registry(
+            nginx=nginx, state_path=Path(args.state_file), cert_manager=certs
+        )
         try:
             await registry.restore()
         except Exception:
@@ -334,9 +456,27 @@ def main() -> None:
         server = Server(app, args.host, args.port)
         await server.start()
         print(f"gateway listening on {args.host}:{server.port}", flush=True)
+        async def _renew_loop() -> None:
+            from dstack_tpu.gateway.certs import RENEW_INTERVAL
+
+            while True:
+                await asyncio.sleep(RENEW_INTERVAL)
+                try:
+                    await certs.renew()
+                    # Issuances that failed at registration (DNS not yet
+                    # propagated) converge here.
+                    await registry.retry_pending_certs()
+                except Exception:
+                    logger.exception("renewal tick failed")
+
+        renew_task = asyncio.create_task(_renew_loop()) if certs else None
         assert server._server is not None
-        async with server._server:
-            await server._server.serve_forever()
+        try:
+            async with server._server:
+                await server._server.serve_forever()
+        finally:
+            if renew_task:
+                renew_task.cancel()
 
     asyncio.run(_serve())
 

@@ -1,0 +1,176 @@
+"""ACME certificate lifecycle for the gateway VM.
+
+Parity: src/dstack/_internal/proxy/gateway/services/nginx.py:56-152 in the
+reference (run_certbot / certificate_exists / ACMESettings): certbot
+obtains per-domain certificates before the https site config is written,
+an existing certificate short-circuits issuance (`--keep`), a custom ACME
+directory + EAB credentials are supported, and a timeout failure surfaces
+a "configure your DNS" error. Two deliberate departures:
+
+- issuance uses `--webroot` against the ACME-challenge location every
+  rendered site already serves (nginx.render_site), not `--nginx` — the
+  webroot authenticator never rewrites nginx configs behind our renderer's
+  back;
+- renewal is owned here too (`renew_forever`), instead of relying on the
+  distro's certbot systemd timer, so a renewed cert is followed by an
+  nginx reload and the whole lifecycle is testable through one seam.
+
+Everything shells out through the same injectable async `run(cmd) -> str`
+contract that gateway/deploy.py uses (production: local subprocess on the
+gateway VM), so tests drive issue/renew/failure paths with a fake runner.
+"""
+
+import asyncio
+import logging
+import shlex
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional, Tuple
+
+from dstack_tpu.gateway.nginx import ACME_ROOT
+
+logger = logging.getLogger(__name__)
+
+RunFn = Callable[[str], Awaitable[str]]
+
+# Reference: CERTBOT_TIMEOUT / CERTBOT_2ND_TIMEOUT (nginx.py:17-18).
+CERTBOT_TIMEOUT = 40
+RENEW_TIMEOUT = 300  # a renew pass covers every managed lineage
+CERTBOT_KILL_AFTER = 5
+RENEW_INTERVAL = 12 * 3600  # certbot renews only certs within 30d of expiry
+LIVE_DIR = "/etc/letsencrypt/live"
+
+
+class CertError(Exception):
+    """Certificate issuance failed; the service stays on its previous
+    (http-only or previously-certified) config."""
+
+
+@dataclass(frozen=True)
+class AcmeSettings:
+    """Custom ACME directory + External Account Binding (e.g. ZeroSSL);
+    all-None means Let's Encrypt defaults."""
+
+    server: Optional[str] = None
+    eab_kid: Optional[str] = None
+    eab_hmac_key: Optional[str] = None
+
+
+async def local_run(command: str) -> str:
+    """Default `run` on the gateway VM: local shell, merged output,
+    raises RuntimeError on nonzero exit (same contract utils/ssh gives
+    the deployer for remote VMs)."""
+    proc = await asyncio.create_subprocess_shell(
+        command,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+    )
+    out_b, _ = await proc.communicate()
+    out = out_b.decode(errors="replace")
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"command failed (exit {proc.returncode}): {command}\n{out[-2000:]}"
+        )
+    return out
+
+
+class CertManager:
+    def __init__(
+        self,
+        run: RunFn,
+        acme: Optional[AcmeSettings] = None,
+        *,
+        webroot: str = str(ACME_ROOT),
+        live_dir: str = LIVE_DIR,
+        reload_cb: Optional[Callable[[], None]] = None,
+    ):
+        self.run = run
+        self.acme = acme or AcmeSettings()
+        self.webroot = webroot
+        self.live_dir = live_dir
+        self.reload_cb = reload_cb  # nginx reload after a renewal lands
+        # certbot holds its own locks badly under concurrency; serialize.
+        self._lock = asyncio.Lock()
+
+    def paths(self, domain: str) -> Tuple[str, str]:
+        return (
+            f"{self.live_dir}/{domain}/fullchain.pem",
+            f"{self.live_dir}/{domain}/privkey.pem",
+        )
+
+    async def exists(self, domain: str) -> bool:
+        cert, _ = self.paths(domain)
+        out = await self.run(f"test -e {shlex.quote(cert)} && echo present || true")
+        return "present" in out
+
+    async def ensure(self, domain: str) -> Tuple[str, str]:
+        """Certificate paths for `domain`, issuing one if none exists."""
+        async with self._lock:
+            if not await self.exists(domain):
+                await self._issue(domain)
+        return self.paths(domain)
+
+    async def _issue(self, domain: str) -> None:
+        cmd = (
+            f"timeout --kill-after {CERTBOT_KILL_AFTER} {CERTBOT_TIMEOUT} "
+            "certbot certonly --non-interactive --agree-tos"
+            " --register-unsafely-without-email --keep"
+            f" --webroot -w {shlex.quote(self.webroot)}"
+            f" --domain {shlex.quote(domain)}"
+        )
+        if self.acme.server:
+            cmd += f" --server {shlex.quote(self.acme.server)}"
+        if self.acme.eab_kid and self.acme.eab_hmac_key:
+            cmd += (
+                f" --eab-kid {shlex.quote(self.acme.eab_kid)}"
+                f" --eab-hmac-key {shlex.quote(self.acme.eab_hmac_key)}"
+            )
+        try:
+            await self.run(cmd)
+        except Exception as e:
+            raise CertError(
+                f"could not obtain a TLS certificate for {domain} within"
+                f" {CERTBOT_TIMEOUT}s. Make sure the domain's DNS A record"
+                f" points at this gateway's public IP: {e}"
+            ) from e
+        logger.info("issued TLS certificate for %s", domain)
+
+    async def renew(self) -> bool:
+        """One renewal pass over every managed cert. Returns True if any
+        cert was renewed (nginx then needs a reload to pick up the new
+        files — same paths, new contents). A failure keeps the old certs:
+        certbot leaves the live/ symlinks untouched unless renewal of a
+        lineage fully succeeds."""
+        async with self._lock:
+            try:
+                # The kill-after guard matters doubly here: renew holds
+                # self._lock, so a certbot hung on a dead ACME directory
+                # would otherwise wedge every future https registration.
+                out = await self.run(
+                    f"timeout --kill-after {CERTBOT_KILL_AFTER} {RENEW_TIMEOUT} "
+                    "certbot renew --non-interactive"
+                    f" --webroot -w {shlex.quote(self.webroot)}"
+                )
+            except Exception as e:
+                logger.warning("certificate renewal pass failed: %s", e)
+                return False
+        # certbot prints "Congratulations, all renewals succeeded" iff at
+        # least one lineage rotated — even when OTHER certs print "not yet
+        # due" in the same pass, so due-ness must not veto the reload (a
+        # rotated cert nginx never reloads is served stale until expiry).
+        renewed = "Congratulations" in out or "renewed" in out.lower()
+        if renewed:
+            logger.info("renewed TLS certificates; reloading nginx")
+            if self.reload_cb is not None:
+                self.reload_cb()
+            return True
+        return False
+
+    async def renew_forever(self, interval: float = RENEW_INTERVAL) -> None:
+        """Renewal timer for the gateway app's lifespan (certbot itself
+        no-ops until a cert is within 30 days of expiry)."""
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.renew()
+            except Exception:  # never let the timer die
+                logger.exception("renewal tick failed")

@@ -56,6 +56,21 @@ def render_site(site: SiteConfig) -> str:
         addr = up.address if "/" not in up.address else f"unix:{up.address.removeprefix('unix:')}"
         lines.append(f"    server {addr} weight={up.weight};")
     lines.append("}")
+    if site.https and site.cert_path:
+        # A port-80 server MUST survive the https flip: certbot renewals
+        # answer the ACME http-01 challenge on port 80 — a 443-only domain
+        # would renew-fail every pass and expire at day 90. Everything
+        # else redirects to https.
+        lines.append("server {")
+        lines.append("    listen 80;")
+        lines.append(f"    server_name {site.domain};")
+        lines.append("    location /.well-known/acme-challenge/ {")
+        lines.append(f"        root {ACME_ROOT};")
+        lines.append("    }")
+        lines.append("    location / {")
+        lines.append("        return 301 https://$host$request_uri;")
+        lines.append("    }")
+        lines.append("}")
     lines.append("server {")
     if site.https and site.cert_path:
         lines.append("    listen 443 ssl;")
@@ -65,7 +80,7 @@ def render_site(site: SiteConfig) -> str:
         lines.append("    listen 80;")
     lines.append(f"    server_name {site.domain};")
     lines.append(f"    client_max_body_size {site.client_max_body_size};")
-    # ACME challenge always served over http for issuance/renewal.
+    # ACME challenge also served here (http-only sites answer issuance).
     lines.append("    location /.well-known/acme-challenge/ {")
     lines.append(f"        root {ACME_ROOT};")
     lines.append("    }")

@@ -28,7 +28,7 @@ def create_app(
     server_config_path: Optional[str] = None,
 ) -> App:
     app = App()
-    db = Database(db_path or ":memory:")
+    db = Database.from_url(db_path or ":memory:")
     ctx = ServerContext(db, Encryption(settings.ENCRYPTION_KEY))
     app.state["ctx"] = ctx
 

@@ -87,7 +87,10 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
                 busy = await ctx.db.fetchone(
                     "SELECT COUNT(*) AS n FROM instances"
                     " WHERE id != ? AND deleted = 0"
-                    " AND status IN ('pending', 'busy')"
+                    # Any not-yet-terminating sibling counts: a worker in
+                    # 'provisioning' (or still 'idle' between jobs) would
+                    # lose the shared node out from under it just the same.
+                    " AND status IN ('pending', 'provisioning', 'idle', 'busy')"
                     " AND job_provisioning_data LIKE ? ESCAPE '\\'",
                     (row["id"], f'%"tpu_node_id":"{node}"%'),
                 )

@@ -519,10 +519,11 @@ async def _handle_disconnect(ctx: ServerContext, row: sqlite3.Row) -> None:
         )
         return
     disconnected = parse_dt(row["disconnected_at"])
-    if (utcnow() - disconnected).total_seconds() > 120:
+    grace = settings.RUNNER_DISCONNECT_GRACE
+    if (utcnow() - disconnected).total_seconds() > grace:
         await _fail(
             ctx, row, JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
-            "runner unreachable for 120s",
+            f"runner unreachable for {grace:g}s",
         )
 
 

@@ -33,8 +33,17 @@ class AgentConnection:
             # runner port (shim process-runtime binds :0 and reports it).
             # Tunneled hosts keep the fixed forward: their docker runtime
             # serves the runner on the standard port over host networking.
-            base, _, _ = self.runner_url.rpartition(":")
-            return RunnerClient(f"{base}:{port}")
+            from urllib.parse import urlsplit, urlunsplit
+
+            parts = urlsplit(self.runner_url)
+            # hostname strips any existing :port; rpartition would mangle
+            # a port-less URL ("http://host" -> "http:PORT").
+            host = parts.hostname or ""
+            if ":" in host:  # bare IPv6 needs its brackets back
+                host = f"[{host}]"
+            return RunnerClient(
+                urlunsplit(parts._replace(netloc=f"{host}:{port}"))
+            )
         return RunnerClient(self.runner_url)
 
     def shim_client(self) -> ShimClient:

@@ -38,6 +38,9 @@ METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL_SECONDS", "3600"))
 
 # Provisioning deadlines, seconds.
 RUNNER_READY_TIMEOUT = int(os.getenv("DSTACK_TPU_RUNNER_READY_TIMEOUT", "600"))
+# How long a RUNNING job may lose contact with its runner before it is
+# failed as interrupted (flaky links tune it up; fail-fast tests down).
+RUNNER_DISCONNECT_GRACE = float(os.getenv("DSTACK_TPU_RUNNER_DISCONNECT_GRACE", "120"))
 INSTANCE_PROVISIONING_TIMEOUT = int(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))
 INSTANCE_UNREACHABLE_DEADLINE = int(os.getenv("DSTACK_TPU_UNREACHABLE_DEADLINE", "1200"))
 RETRY_PENDING_RUN_DELAY = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY", "15"))
@@ -46,4 +49,10 @@ ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); ide
 
 
 def get_db_path() -> str:
+    """DB location: `DSTACK_TPU_DB_URL` (postgres://... for multi-host
+    control planes, sqlite://path) wins over the sqlite-path `DSTACK_TPU_DB`;
+    default is the per-user sqlite file. Consumed via Database.from_url."""
+    url = os.getenv("DSTACK_TPU_DB_URL")
+    if url:
+        return url
     return os.getenv("DSTACK_TPU_DB", str(SERVER_DIR_PATH / "data" / "sqlite.db"))

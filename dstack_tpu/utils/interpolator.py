@@ -8,6 +8,11 @@ rather than a hand-rolled scanner; semantics:
 
 - ``${{ ns.key }}``  -> looked up in ``namespaces[ns][key]``
 - ``$${{ ns.key }}`` -> literal ``${{ ns.key }}`` (escape)
+- ``$$`` NOT followed by ``{{`` is preserved verbatim — a deliberate
+  divergence from the reference, which collapses every ``$$`` to ``$``
+  even outside placeholders (``get_or_error``'s scanner). Env values like
+  ``$$PATH`` or Makefile fragments pass through unchanged here; only
+  dollars that prefix an actual placeholder participate in escaping.
 - a namespace listed in *skip* is left untouched (so later stages can
   resolve it)
 - anything that looks like an opening ``${{`` but is not a valid

@@ -105,6 +105,7 @@ class ModelConfig:
         state_bytes = 12 * self.param_count() / weight_shard
         budget = max(hbm - state_bytes, 0.15 * hbm)
         d, f = self.d_model, self.d_ff
+        db = self.dtype_bytes
         kv = self.n_kv_heads * self.head_dim
         # MoE: each token funds k routed experts' activations plus the
         # capacity-factor slack in the dispatch buffers.
@@ -112,21 +113,41 @@ class ModelConfig:
             self.experts_per_token * self.capacity_factor
             if self.n_experts > 0 else 1
         )
-        per_token = int((6 * d + 2 * kv + 3 * mlp_width) * self.dtype_bytes)
+        # Per-layer residuals the no-remat backward keeps. The SwiGLU gate
+        # rides through transformer._silu (custom VJP) precisely so the
+        # saved intermediates stay in activation dtype — without it,
+        # autodiff keeps two f32 (L, B, S, d_ff) buffers per layer
+        # (measured on v5e: the dominant no-remat allocation). Four
+        # d_ff-wide residuals survive: gate preact, silu out, up, product.
+        per_token = int(
+            (6 * d + 2 * kv) * db          # norms, q/kv post-rope, attn out
+            + mlp_width * 4 * db
+        )
         if attn_scores and seq_len:
             # Plain (non-flash) attention keeps the f32 score and prob
             # matrices for backward: O(S) per token per head. The Pallas
             # flash kernels recompute these in their own backward, which is
             # exactly what lets long-context no-remat fit.
             per_token += 2 * seq_len * self.n_heads * 4
-        act_bytes = batch_tokens / max(act_shard, 1) * per_token * self.n_layers
+        # The lm-head/loss residuals sit outside the scanned layers but
+        # compete for the same budget: f32 logits saved for the CE
+        # backward plus the normalized log-prob intermediate.
+        head_per_token = self.vocab_size * (4 + db)
+        act_bytes = (
+            batch_tokens / max(act_shard, 1)
+            * (per_token * self.n_layers + head_per_token)
+        )
         return "none" if act_bytes < 0.6 * budget else "dots"
 
-    def flops_per_token(self) -> float:
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
         """Approximate forward+backward FLOPs per token (3x forward).
 
-        MoE counts the k active experts per token plus the router matmul,
-        not the full expert bank."""
+        With `seq_len`, includes the causal attention-score FLOPs
+        (QK^T + AV: 2 * 2 * S * d per token per layer, halved by the
+        causal mask) — the standard model-FLOPs accounting MFU uses
+        (PaLM appendix B). Without it, only parameter matmuls count
+        (a conservative lower bound). MoE counts the k active experts
+        per token plus the router matmul, not the full expert bank."""
         d, f, v = self.d_model, self.d_ff, self.vocab_size
         hd = self.head_dim
         attn_proj = 2 * d * (self.n_heads + 2 * self.n_kv_heads) * hd + 2 * self.n_heads * hd * d
@@ -135,6 +156,8 @@ class ModelConfig:
         else:
             mlp = 3 * 2 * d * f
         per_layer = attn_proj + mlp
+        if seq_len:
+            per_layer += 2 * seq_len * self.n_heads * hd  # causal QK^T + AV
         embed = 2 * d * v
         fwd = self.n_layers * per_layer + embed
         return 3.0 * fwd

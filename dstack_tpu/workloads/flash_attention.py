@@ -38,16 +38,22 @@ import os as _os
 MIN_BLK = 128
 
 
+MAX_BLK = 1024  # 2048-wide blocks put a >16MB f32 logits tile on the
+# kernel stack and exceed the scoped-VMEM limit (measured on v5e); 1024
+# keeps the (blk_q, blk_k) f32 block at 4MB with room for accumulators
+# and double-buffering.
+
+
 def _env_block(name: str, default: int) -> int:
-    """Env perf knob, normalized to a power of two >= MIN_BLK — anything
-    else would let _pick_block return a non-divisor of seq and silently
-    drop query tiles."""
+    """Env perf knob, normalized to a power of two in [MIN_BLK, MAX_BLK] —
+    anything else would let _pick_block return a non-divisor of seq (and
+    silently drop query tiles) or blow the kernel's scoped VMEM."""
     try:
         raw = int(_os.getenv(name, str(default)))
     except ValueError:
         return default
     blk = MIN_BLK
-    while blk * 2 <= raw:
+    while blk * 2 <= min(raw, MAX_BLK):
         blk *= 2
     return blk
 

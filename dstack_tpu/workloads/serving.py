@@ -290,7 +290,10 @@ class ServingEngine:
             self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
-        self._flush_all(None)
+        # Requests still in flight get an exception, not the clean-end
+        # None: a consumer must not mistake a truncated generation for a
+        # complete one (same principle _flush_all states for failures).
+        self._flush_all(RuntimeError("serving engine closed mid-generation"))
 
     def _flush_all(self, error: Optional[BaseException]) -> None:
         """Terminate every consumer: no out.get() may hang forever. A

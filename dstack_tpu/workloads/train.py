@@ -139,6 +139,13 @@ def make_train_step(
     def accumulated_grads(params, batch):
         # (B, ...) -> (accum, B/accum, ...): scan keeps one microbatch of
         # activations live; grads average across microbatches.
+        b = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"batch size {b} is not divisible by accum_steps"
+                f" {accum_steps}; gradient accumulation needs equal"
+                " microbatches"
+            )
         micro = jax.tree_util.tree_map(
             lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps,
                                 *x.shape[1:]),

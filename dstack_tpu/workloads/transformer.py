@@ -138,10 +138,38 @@ def project_qkv(c: ModelConfig, x: jnp.ndarray, p: Params, positions: jnp.ndarra
     return _rope(q, positions, c.rope_theta), _rope(k, positions, c.rope_theta), v
 
 
+@jax.custom_vjp
+def _silu(x: jnp.ndarray) -> jnp.ndarray:
+    """silu computed in f32, residual saved in x.dtype.
+
+    Without this, autodiff keeps BOTH f32 (B, S, d_ff) intermediates of
+    `silu(x.astype(f32)).astype(bf16)` for backward — on v5e they are the
+    single largest no-remat allocation (see config.resolve_remat). The
+    custom VJP saves only the bf16 pre-activation and recomputes the f32
+    sigmoid in backward: same forward numerics, ~2x less MLP activation
+    HBM, which is what lets the flagship fine-tune run remat-free at
+    batch sizes that previously forced a remat rung."""
+    return jax.nn.silu(x.astype(jnp.float32)).astype(x.dtype)
+
+
+def _silu_fwd(x):
+    return _silu(x), x
+
+
+def _silu_bwd(x, g):
+    xf = x.astype(jnp.float32)
+    s = jax.nn.sigmoid(xf)
+    grad = s * (1.0 + xf * (1.0 - s))
+    return ((g.astype(jnp.float32) * grad).astype(x.dtype),)
+
+
+_silu.defvjp(_silu_fwd, _silu_bwd)
+
+
 def mlp_block(c: ModelConfig, x: jnp.ndarray, p: Params) -> jnp.ndarray:
     """Pre-norm SwiGLU MLP with residual — shared with generate.py."""
     h = rms_norm(x, p["mlp_norm"], c.norm_eps)
-    gate = jax.nn.silu(linear(h, p["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    gate = _silu(linear(h, p["w_gate"]))
     up = linear(h, p["w_up"])
     return x + linear(gate * up, p["w_down"])
 

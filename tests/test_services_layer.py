@@ -717,8 +717,8 @@ async def test_gateway_registry_survives_restart(tmp_path):
     state = tmp_path / "state.json"
     r1 = Registry(nginx=NginxManager(conf_dir=tmp_path / "n1"),
                   tunnel_factory=tunnel_factory, state_path=state)
-    r1.register_service("main", "svc", "svc.example.com",
-                        auth=True, auth_tokens=["tok-1"])
+    await r1.register_service("main", "svc", "svc.example.com",
+                              auth=True, auth_tokens=["tok-1"])
     await r1.register_replica("main", "svc", "r0", ssh={
         "host": "10.77.0.3", "app_port": 8000, "private_key": "k",
     })

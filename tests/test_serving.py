@@ -122,6 +122,27 @@ def test_submit_validates_budget(params):
         engine.close()
 
 
+def test_close_mid_generation_is_an_error_not_clean_end(params):
+    """close() must not hand unfinished consumers the clean-end None —
+    a truncated generation reading as complete is silent data loss."""
+    engine = ServingEngine(CFG, params, slots=1, max_len=512)
+    q = engine.submit([1, 2, 3], max_new_tokens=400)
+    engine.close()
+    tokens, sentinel = [], None
+    while True:
+        item = q.get(timeout=60)
+        if item is None or isinstance(item, BaseException):
+            sentinel = item
+            break
+        tokens.append(item)
+    if len(tokens) < 400:  # truncated (the overwhelmingly likely case)
+        assert isinstance(sentinel, BaseException), (
+            "truncated generation was delivered as a clean end"
+        )
+    else:  # engine outran close(): complete output, clean end is correct
+        assert sentinel is None
+
+
 def test_submit_after_close_raises(params):
     engine = ServingEngine(CFG, params, slots=1, max_len=16)
     engine.close()
