@@ -5,6 +5,7 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <cstdlib>
 #include <cstring>
 
 #include "../common/util.hpp"
@@ -15,7 +16,17 @@ namespace dstack {
 
 namespace {
 
-constexpr int kPullTimeoutSeconds = 20 * 60;  // parity: shim/docker.go:42
+// Parity: shim/docker.go:42 (20-min cap). Env-tunable so operators can
+// stretch it for multi-GB TPU images on slow links and tests can shrink
+// it to drive the timeout path against the real binary.
+int pull_timeout_seconds() {
+  const char* v = getenv("DSTACK_TPU_SHIM_PULL_TIMEOUT");
+  if (v && *v) {
+    int n = atoi(v);
+    if (n > 0) return n;
+  }
+  return 20 * 60;
+}
 
 std::string join_chips(const std::vector<int>& chips) {
   std::string s;
@@ -111,7 +122,7 @@ class DockerRuntime : public Runtime {
             if (tail.size() > 4096) tail.erase(0, tail.size() - 4096);
             task.publish();
           },
-          kPullTimeoutSeconds);
+          pull_timeout_seconds());
       if (!docker_config.empty())
         run_command({"rm", "-rf", docker_config}, nullptr);
       if (rc != 0) {

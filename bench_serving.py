@@ -1,0 +1,143 @@
+"""Serving-engine throughput benchmark on the real chip.
+
+The continuous-batching engine (workloads/serving.py) exists to multiplex
+many decode streams over one chip; its batch-1 numbers (519 tok/s int8 /
+416 bf16, round 3) only proved correctness overhead. This measures the
+reason it exists: aggregate tokens/s and tail latency at 1/8/16/32
+concurrent streams, bf16 vs int8 weight-only quantization.
+
+Metrics per scenario:
+- agg_tok_s    — total generated tokens / wall time (the capacity number)
+- ttft_p50/p95 — submit -> first token, ms (includes prefill + queueing;
+  on a tunneled dev chip this carries the tunnel RTT)
+- tpt_p50/p95  — inter-token latency per stream, ms (decode cadence; the
+  engine syncs to host every `steps_per_sync` steps, so the observed
+  cadence is bursty — latencies are normalized per token)
+
+Writes BENCH_serving_r04.json and prints one JSON line per scenario.
+Regression guard: tests/test_serving.py pins engine==one-shot decode
+numerics; this file pins the performance claim (continuous batching must
+show a multi-x aggregate over batch-1).
+"""
+
+import json
+import queue
+import statistics
+import threading
+import time
+from typing import Dict, List
+
+import jax
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.serving import ServingEngine
+from dstack_tpu.workloads.transformer import init_params
+
+PROMPT_LEN = 64
+NEW_TOKENS = 128
+MAX_LEN = 512
+SLOTS = 16  # engine batch width; streams beyond this queue
+
+
+def _drain_timed(q: "queue.Queue[object]", t0: float) -> Dict:
+    ts: List[float] = []
+    while True:
+        item = q.get(timeout=600)
+        if item is None:
+            break
+        if isinstance(item, BaseException):
+            raise item
+        ts.append(time.perf_counter())
+    assert len(ts) == NEW_TOKENS, len(ts)
+    deltas = [(b - a) * 1e3 for a, b in zip(ts, ts[1:])]
+    return {"ttft": (ts[0] - t0) * 1e3, "deltas": deltas, "n": len(ts)}
+
+
+def run_scenario(engine: ServingEngine, streams: int) -> Dict:
+    prompts = [
+        [((i * 37 + j * 13) % 30000) + 1 for j in range(PROMPT_LEN)]
+        for i in range(streams)
+    ]
+    results: List[Dict] = [None] * streams  # type: ignore
+    t0 = time.perf_counter()
+
+    def worker(i: int) -> None:
+        q = engine.submit(prompts[i], max_new_tokens=NEW_TOKENS)
+        results[i] = _drain_timed(q, t0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    ttfts = sorted(r["ttft"] for r in results)
+    deltas = sorted(d for r in results for d in r["deltas"])
+    total = sum(r["n"] for r in results)
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    return {
+        "streams": streams,
+        "agg_tok_s": round(total / wall, 1),
+        "ttft_p50_ms": round(pct(ttfts, 0.50), 1),
+        "ttft_p95_ms": round(pct(ttfts, 0.95), 1),
+        "tpt_p50_ms": round(pct(deltas, 0.50), 2),
+        "tpt_p95_ms": round(pct(deltas, 0.95), 2),
+        "wall_s": round(wall, 2),
+    }
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform != "cpu"
+    config = PRESETS["smol-1b"].with_(n_layers=8) if on_tpu else PRESETS["tiny"]
+    stream_counts = (1, 8, 16, 32) if on_tpu else (1, 4)
+
+    params = init_params(config, jax.random.PRNGKey(0))
+    from dstack_tpu.workloads.quant import quantize_params
+
+    out = {
+        "model": "smol-1b/8L" if on_tpu else "tiny",
+        "prompt_len": PROMPT_LEN,
+        "new_tokens": NEW_TOKENS,
+        "slots": SLOTS,
+        "device": jax.devices()[0].device_kind,
+        # Context for reading the numbers: this dev chip sits behind a
+        # tunnel with ~hundreds-of-ms RTT, and the engine pays one host
+        # sync per `steps_per_sync` decode steps — so single-stream
+        # throughput here is an RTT floor, not a chip limit. The two
+        # things this bench pins are exactly the engine's value props:
+        # (1) aggregate scales multi-x with streams at fixed sync cost,
+        # (2) raising steps_per_sync trades TTFT for throughput.
+        "scenarios": [],
+    }
+    variants = [("bf16", params, 4), ("bf16", params, 32),
+                ("int8", quantize_params(params), 32)]
+    for dtype, p, sps in variants:
+        engine = ServingEngine(
+            config, p, slots=SLOTS, max_len=MAX_LEN, steps_per_sync=sps
+        )
+        try:
+            run_scenario(engine, 1)  # warmup: compile prefill/insert/decode
+            for n in stream_counts:
+                s = {"dtype": dtype, "steps_per_sync": sps,
+                     **run_scenario(engine, n)}
+                out["scenarios"].append(s)
+                print(json.dumps(s), flush=True)
+        finally:
+            engine.close()
+
+    agg = {s["streams"]: s["agg_tok_s"] for s in out["scenarios"]
+           if s["dtype"] == "bf16" and s["steps_per_sync"] == 4}
+    if len(agg) > 1:
+        out["batching_speedup"] = round(max(agg.values()) / agg[1], 2)
+        print(f"# continuous batching: {out['batching_speedup']}x aggregate"
+              f" over batch-1 ({max(agg.values()):.0f} vs {agg[1]:.0f} tok/s)",
+              flush=True)
+    with open("BENCH_serving_r04.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Single-replica capacity probe: 200 concurrent runs through the FSM.
+
+The reference documents its per-replica capacity as "150 active jobs /
+runs / instances at <= 2 min processing latency" (reference
+background/__init__.py:40-46). This probe submits 200 concurrent runs on
+the local backend over a real socket — every run provisions a (local)
+instance, handshakes a real runner process, executes, and terminates —
+and records the submit->done latency distribution, i.e. pure control-
+plane processing latency under 1.33x the reference's rated load.
+
+Emits ONE JSON document (CAPACITY_r04.json via --out).
+
+Run: python capacity_probe.py [--runs 200] [--out CAPACITY_r04.json]
+"""
+
+import argparse
+import json
+import statistics
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from latency_probe import ProbeServer
+
+
+def _req(url, token, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Authorization": f"Bearer {token}",
+                 "Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--runs", type=int, default=200)
+    parser.add_argument("--out", default="CAPACITY_r04.json")
+    parser.add_argument("--timeout", type=float, default=600.0)
+    args = parser.parse_args()
+
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    # File-backed DB: the deployment shape (sqlite WAL + reader pool);
+    # :memory: cannot use pooled readers (each connection is its own DB).
+    db_file = tempfile.NamedTemporaryFile(suffix=".db", delete=False)
+    # Agents are the NATIVE C++ runner: a capacity probe measures the
+    # control plane driving N agents, and python-runner processes would
+    # bill ~1 s of interpreter startup CPU per run to the orchestrator
+    # (decisive on small probe machines — this box exposes 1 core).
+    native = Path(__file__).parent / "agents" / "native"
+    subprocess.run(["cmake", "-B", "build", "-G", "Ninja",
+                    "-DCMAKE_BUILD_TYPE=Release"], cwd=native, check=True,
+                   capture_output=True)
+    subprocess.run(["cmake", "--build", "build"], cwd=native, check=True,
+                   capture_output=True)
+    runner_bin = str(native / "build" / "dstack-tpu-runner")
+    srv = ProbeServer(
+        polling=False, db_path=db_file.name,
+        backend_config={"runner_binary": runner_bin},
+    ).start()
+    try:
+        base = f"{srv.url}/api/project/main/runs"
+        t0 = time.perf_counter()
+        submitted_at = {}
+
+        def submit(i: int) -> None:
+            name = f"cap-{i:03d}"
+            _req(f"{base}/submit", srv.token, {"run_spec": {
+                "run_name": name,
+                "configuration": {
+                    "type": "task", "commands": ["true"],
+                    "resources": {"cpu": "1..", "memory": "0.1.."},
+                },
+                "ssh_key_pub": "ssh-rsa PROBE",
+            }})
+            submitted_at[name] = time.perf_counter() - t0
+
+        with ThreadPoolExecutor(max_workers=32) as pool:
+            list(pool.map(submit, range(args.runs)))
+        submit_window = time.perf_counter() - t0
+
+        done_at = {}
+        deadline = t0 + args.timeout
+        last_report = 0.0
+        while time.perf_counter() < deadline and len(done_at) < args.runs:
+            now = time.perf_counter() - t0
+            counts = {}
+            for r in _req(f"{base}/list", srv.token, {"limit": args.runs + 10}):
+                name = (r.get("run_spec") or {}).get("run_name")
+                if name not in submitted_at:
+                    continue
+                counts[r["status"]] = counts.get(r["status"], 0) + 1
+                if name not in done_at and r["status"] in ("done", "failed", "terminated"):
+                    done_at[name] = (now, r["status"])
+            if now - last_report > 10:
+                print(f"# t={now:.0f}s {counts}", file=__import__('sys').stderr, flush=True)
+                last_report = now
+            time.sleep(0.5)
+
+        finished = {n: v for n, v in done_at.items()}
+        assert len(finished) == args.runs, (
+            f"only {len(finished)}/{args.runs} finished in {args.timeout}s"
+        )
+        failures = [n for n, (_, s) in finished.items() if s != "done"]
+        lat = sorted(finished[n][0] - submitted_at[n] for n in finished)
+
+        def pct(p):
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 1)
+
+        buckets = {}
+        for v in lat:
+            key = f"{int(v // 15) * 15}-{int(v // 15) * 15 + 15}s"
+            buckets[key] = buckets.get(key, 0) + 1
+        out = {
+            "runs": args.runs,
+            "failed": len(failures),
+            "submit_window_s": round(submit_window, 1),
+            "all_done_s": round(max(v[0] for v in finished.values()), 1),
+            "throughput_runs_per_min": round(
+                args.runs / max(v[0] for v in finished.values()) * 60, 1
+            ),
+            "done_latency_s": {
+                "p50": pct(0.50), "p90": pct(0.90), "p95": pct(0.95),
+                "max": round(lat[-1], 1), "mean": round(statistics.mean(lat), 1),
+            },
+            "histogram": dict(sorted(
+                buckets.items(), key=lambda kv: int(kv[0].split("-")[0])
+            )),
+            "reference_capacity": "150 active jobs/runs/instances per replica"
+                                  " @ <=2min processing latency"
+                                  " (ref background/__init__.py:40-46)",
+        }
+        print(json.dumps(out, indent=1))
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

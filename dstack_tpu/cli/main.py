@@ -268,6 +268,19 @@ def ps(show_all: bool, verbose: bool, project: Optional[str]) -> None:
             # is active, so the table is never empty right after a run.
             runs = active or runs[:1]
         console.print(runs_table([r.dto for r in runs], verbose=verbose))
+        # Running dev environments get their clickable IDE link right in
+        # `ps` (parity: reference run configurator prints one on attach;
+        # the ssh host alias is the run name, so the URL is deterministic).
+        for r in runs:
+            conf = r.dto.run_spec.configuration
+            if (getattr(conf, "type", None) == "dev-environment"
+                    and r.dto.status.value == "running"):
+                name = r.dto.run_spec.run_name
+                console.print(
+                    f"  [bold]{name}[/]: open "
+                    f"[bold]vscode://vscode-remote/ssh-remote+{name}/workflow[/]"
+                    f" (run `dstack-tpu attach {name}` first)"
+                )
     except DstackTpuError as e:
         raise _fail(str(e))
     finally:

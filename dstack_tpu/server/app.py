@@ -76,6 +76,31 @@ def create_app(
         if db.path != ":memory:":
             Path(db.path).parent.mkdir(parents=True, exist_ok=True)
         await db.connect()
+        if not settings.MULTI_REPLICA and db.path != ":memory:":
+            # Cross-replica lease writes are opt-in (they cost two DB
+            # writes per FSM row-step). Detect the unsafe combination —
+            # another replica actively heartbeating leases on this DB
+            # while this one runs without them — and scream: silent loss
+            # of mutual exclusion double-provisions real capacity.
+            import time as _time
+
+            try:
+                foreign = await db.fetchone(
+                    "SELECT COUNT(*) AS n FROM resource_leases"
+                    " WHERE expires_at > ? AND owner != ?",
+                    (_time.time(), ctx.replica_id),
+                )
+                if foreign and foreign["n"]:
+                    logger.error(
+                        "another server replica holds %d active leases on"
+                        " this database, but DSTACK_TPU_MULTI_REPLICA is"
+                        " not set — cross-replica mutual exclusion is OFF"
+                        " and jobs can be double-processed. Set"
+                        " DSTACK_TPU_MULTI_REPLICA=1 on every replica.",
+                        foreign["n"],
+                    )
+            except Exception:
+                pass  # pre-migration boot: the table appears right after
         from dstack_tpu.server.services import config as config_service
         from dstack_tpu.server.services import logs as logs_service
         from dstack_tpu.server.services import projects as projects_service

@@ -1,0 +1,51 @@
+"""Bounded-concurrency row processing for the background FSM.
+
+Parity: the reference bounds processor parallelism with APScheduler
+`max_instances` + batch sizes and documents the resulting capacity (150
+active jobs/runs/instances per replica at <=2 min latency, reference
+background/__init__.py:40-46). Here each processor's tick walks every due
+row; doing that SERIALLY caps throughput at ~1 slow row per second and
+makes tick time grow with row count — measured on the 200-run capacity
+probe as a nonlinear latency blowup. Per-row claims (services/locking.py)
+already make concurrent processing safe — that is their entire purpose —
+so ticks fan out row steps under a semaphore sized by the settings knobs
+(MAX_CONCURRENT_JOB_STEPS / MAX_CONCURRENT_PROVISIONS).
+"""
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Sequence
+
+from dstack_tpu.server.context import ServerContext
+
+logger = logging.getLogger(__name__)
+
+
+async def for_each_claimed(
+    ctx: ServerContext,
+    namespace: str,
+    rows: Sequence,
+    fn: Callable[[ServerContext, object], Awaitable[None]],
+    *,
+    limit: int,
+    what: str,
+) -> None:
+    """Run `fn(ctx, row)` for every claimable row, at most `limit` at a
+    time. A row whose claim is held elsewhere (another replica, an
+    overlapping tick) is skipped — the claim holder owns the step."""
+    if not rows:
+        return
+    sem = asyncio.Semaphore(max(limit, 1))
+
+    async def one(row) -> None:
+        async with sem:
+            if not await ctx.claims.try_claim(namespace, row["id"]):
+                return
+            try:
+                await fn(ctx, row)
+            except Exception:
+                logger.exception("failed to process %s %s", what, row["id"])
+            finally:
+                await ctx.claims.release(namespace, row["id"])
+
+    await asyncio.gather(*(one(r) for r in rows))

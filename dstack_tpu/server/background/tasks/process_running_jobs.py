@@ -38,34 +38,28 @@ logger = logging.getLogger(__name__)
 
 
 async def process_running_jobs(ctx: ServerContext) -> None:
+    from dstack_tpu.server.background.concurrency import for_each_claimed
+
     rows = await ctx.db.fetchall(
         "SELECT * FROM jobs WHERE status IN ('provisioning', 'pulling', 'running')"
         " ORDER BY last_processed_at"
     )
-    for row in rows:
-        if not await ctx.claims.try_claim("jobs", row["id"]):
-            continue
-        try:
-            await _process_job(ctx, row)
-        except Exception:
-            logger.exception("failed to process running job %s", row["id"])
-        finally:
-            await ctx.claims.release("jobs", row["id"])
+    await for_each_claimed(
+        ctx, "jobs", rows, _process_job,
+        limit=settings.MAX_CONCURRENT_JOB_STEPS, what="running job",
+    )
 
 
 async def process_terminating_jobs(ctx: ServerContext) -> None:
+    from dstack_tpu.server.background.concurrency import for_each_claimed
+
     rows = await ctx.db.fetchall(
         "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at"
     )
-    for row in rows:
-        if not await ctx.claims.try_claim("jobs", row["id"]):
-            continue
-        try:
-            await _terminate_job(ctx, row)
-        except Exception:
-            logger.exception("failed to terminate job %s", row["id"])
-        finally:
-            await ctx.claims.release("jobs", row["id"])
+    await for_each_claimed(
+        ctx, "jobs", rows, _terminate_job,
+        limit=settings.MAX_CONCURRENT_JOB_STEPS, what="terminating job",
+    )
 
 
 async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:

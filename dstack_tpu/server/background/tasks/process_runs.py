@@ -31,19 +31,17 @@ logger = logging.getLogger(__name__)
 
 
 async def process_runs(ctx: ServerContext) -> None:
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.background.concurrency import for_each_claimed
+
     rows = await ctx.db.fetchall(
         "SELECT * FROM runs WHERE status NOT IN ('terminated','failed','done')"
         " AND deleted = 0 ORDER BY last_processed_at"
     )
-    for row in rows:
-        if not await ctx.claims.try_claim("runs", row["id"]):
-            continue
-        try:
-            await _process_run(ctx, row)
-        except Exception:
-            logger.exception("failed to process run %s", row["run_name"])
-        finally:
-            await ctx.claims.release("runs", row["id"])
+    await for_each_claimed(
+        ctx, "runs", rows, _process_run,
+        limit=settings.MAX_CONCURRENT_JOB_STEPS, what="run",
+    )
 
 
 async def _process_run(ctx: ServerContext, row: sqlite3.Row) -> None:

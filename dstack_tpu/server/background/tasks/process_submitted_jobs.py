@@ -41,18 +41,16 @@ MASTER_WAIT_TIMEOUT = 600.0
 
 
 async def process_submitted_jobs(ctx: ServerContext) -> None:
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.background.concurrency import for_each_claimed
+
     rows = await ctx.db.fetchall(
         "SELECT * FROM jobs WHERE status = 'submitted' ORDER BY last_processed_at"
     )
-    for row in rows:
-        if not await ctx.claims.try_claim("jobs", row["id"]):
-            continue
-        try:
-            await _process_job(ctx, row)
-        except Exception:
-            logger.exception("failed to process submitted job %s", row["id"])
-        finally:
-            await ctx.claims.release("jobs", row["id"])
+    await for_each_claimed(
+        ctx, "jobs", rows, _process_job,
+        limit=settings.MAX_CONCURRENT_PROVISIONS, what="submitted job",
+    )
 
 
 async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:

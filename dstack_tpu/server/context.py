@@ -11,7 +11,7 @@ lever vs the reference's fixed 2-4s APScheduler intervals
 """
 
 import asyncio
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 import uuid
 
@@ -43,7 +43,10 @@ class ServerContext:
         # creates.
         self.tracer = Tracer()
         self._signals: Dict[str, asyncio.Event] = {}
-        self._tasks: List[asyncio.Task] = []
+        # A set: done-callbacks race stop_tasks' clear(), and a
+        # list.remove of an already-removed task raised in the event
+        # loop's callback path (noisy on every shutdown).
+        self._tasks: Set[asyncio.Task] = set()
         self.stopping = False
         # Test hooks: services look up optional fakes here.
         self.overrides: Dict[str, Any] = {}
@@ -62,8 +65,8 @@ class ServerContext:
 
     def spawn(self, coro) -> asyncio.Task:
         task = asyncio.get_event_loop().create_task(coro)
-        self._tasks.append(task)
-        task.add_done_callback(self._tasks.remove)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
         return task
 
     async def stop_tasks(self) -> None:

@@ -36,17 +36,33 @@ T = TypeVar("T")
 
 # Ordered migrations; index+1 == resulting user_version.
 MIGRATIONS: List[str] = []
+# Per-version reverse scripts (None = irreversible). Parity: alembic's
+# downgrade() per revision; used by `Database.downgrade` for operator
+# rollback after a bad upgrade.
+DOWNGRADES: List[Optional[str]] = []
 
 
-def migration(sql: str) -> None:
+def migration(sql: str, down: Optional[str] = None) -> None:
     MIGRATIONS.append(sql)
+    DOWNGRADES.append(down)
 
 
 class Database:
+    # Read connections for file-backed DBs: WAL allows many concurrent
+    # readers alongside the single writer, but a lone shared connection
+    # serializes EVERYTHING behind one asyncio lock — measured on the
+    # 200-run capacity probe as a lock convoy that pushed API submit
+    # latency past 60 s while FSM ticks queued thousands of reads.
+    READ_POOL = 4
+
     def __init__(self, path: Union[str, Path] = ":memory:"):
         self.path = str(path)
         self._conn: Optional[sqlite3.Connection] = None
         self._lock = asyncio.Lock()
+        self._readers: List[sqlite3.Connection] = []
+        self._reader_sem: Optional[asyncio.Semaphore] = None
+        self._readers_lock = asyncio.Lock()
+        self._closed = False
 
     @staticmethod
     def from_url(url: Union[str, Path]) -> "Database":
@@ -64,6 +80,13 @@ class Database:
             conn = sqlite3.connect(self.path, check_same_thread=False)
             conn.row_factory = sqlite3.Row
             conn.execute("PRAGMA journal_mode=WAL")
+            # WAL + synchronous=FULL fsyncs every commit; with the FSM's
+            # many small writes that serialized the control plane behind
+            # the disk (measured: ~20 s lockstep stalls on the capacity
+            # probe). NORMAL in WAL keeps the DB corruption-safe across
+            # crashes; at most the final commits before an OS-level power
+            # loss are rolled back — an orchestrator FSM re-derives those.
+            conn.execute("PRAGMA synchronous=NORMAL")
             conn.execute("PRAGMA foreign_keys=ON")
             conn.execute("PRAGMA busy_timeout=10000")
             return conn
@@ -76,11 +99,64 @@ class Database:
             conn = self._conn
             self._conn = None
             await asyncio.to_thread(conn.close)
+        # Mark closed FIRST: an in-flight _read returning its connection
+        # after this point must close it rather than re-pool it (a cleared
+        # pool would silently leak the open connection).
+        self._closed = True
+        async with self._readers_lock:
+            for r in self._readers:
+                try:
+                    r.close()
+                except sqlite3.Error:
+                    pass
+            self._readers.clear()
 
     @property
     def conn(self) -> sqlite3.Connection:
         assert self._conn is not None, "Database is not connected"
         return self._conn
+
+    @property
+    def _pooled_reads(self) -> bool:
+        # In-memory DBs are per-connection: a second connection would see
+        # a DIFFERENT (empty) database, so reads stay on the write conn.
+        return self.path != ":memory:" and not self.path.startswith("file::memory:")
+
+    async def _read(self, fn: Callable[[sqlite3.Connection], T]) -> T:
+        """Run a read on a pooled reader connection (file DBs), bypassing
+        the writer lock — WAL readers never block the writer or each
+        other. Readers see the last committed state, which is exactly what
+        every fetch outside run_sync already assumed (any other coroutine
+        could commit between two calls)."""
+        if not self._pooled_reads:
+            async with self._lock:
+                return await asyncio.to_thread(fn, self.conn)
+        if self._reader_sem is None:
+            self._reader_sem = asyncio.Semaphore(self.READ_POOL)
+        async with self._reader_sem:
+            async with self._readers_lock:
+                if self._readers:
+                    conn = self._readers.pop()
+                else:
+                    conn = await asyncio.to_thread(self._open_reader)
+            try:
+                return await asyncio.to_thread(fn, conn)
+            finally:
+                async with self._readers_lock:
+                    if getattr(self, "_closed", False):
+                        try:
+                            conn.close()
+                        except sqlite3.Error:
+                            pass
+                    else:
+                        self._readers.append(conn)
+
+    def _open_reader(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.path, check_same_thread=False)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA busy_timeout=10000")
+        conn.execute("PRAGMA query_only=ON")  # a reader must never write
+        return conn
 
     async def migrate(self) -> None:
         def _migrate(conn: sqlite3.Connection) -> None:
@@ -103,6 +179,41 @@ class Database:
                     conn.commit()
 
         await self.run_sync(_migrate)
+
+    async def downgrade(self, target_version: int) -> None:
+        """Walk DOWNGRADES from the current version down to `target_version`
+        (alembic `downgrade` parity). Raises if any step in the range has
+        no reverse script — a half-applied rollback is worse than none."""
+        def _downgrade(conn: sqlite3.Connection) -> None:
+            import contextlib
+            import fcntl
+
+            with contextlib.ExitStack() as stack:
+                if self.path != ":memory:":
+                    lockf = stack.enter_context(open(self.path + ".init.lock", "w"))
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+                    stack.callback(fcntl.flock, lockf, fcntl.LOCK_UN)
+                version = conn.execute("PRAGMA user_version").fetchone()[0]
+                if target_version >= version:
+                    return
+                steps = range(version, target_version, -1)  # v, v-1, ..., t+1
+                # Versions beyond this binary's migration list (DB written
+                # by newer code — the classic rollback situation) are
+                # unknown, hence irreversible here.
+                missing = [v for v in steps
+                           if v > len(DOWNGRADES) or DOWNGRADES[v - 1] is None]
+                if missing:
+                    raise RuntimeError(
+                        f"migrations {missing} are irreversible or unknown to"
+                        f" this binary; cannot downgrade from {version} to"
+                        f" {target_version}"
+                    )
+                for v in steps:
+                    conn.executescript(DOWNGRADES[v - 1])
+                    conn.execute(f"PRAGMA user_version = {v - 1}")
+                    conn.commit()
+
+        await self.run_sync(_downgrade)
 
     async def run_sync(self, fn: Callable[[sqlite3.Connection], T]) -> T:
         """Run `fn(conn)` in the worker thread under the write lock; commits
@@ -138,13 +249,13 @@ class Database:
         def _fetch(conn: sqlite3.Connection) -> Optional[sqlite3.Row]:
             return conn.execute(sql, params).fetchone()
 
-        return await self.run_sync(_fetch)
+        return await self._read(_fetch)
 
     async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> List[sqlite3.Row]:
         def _fetch(conn: sqlite3.Connection) -> List[sqlite3.Row]:
             return conn.execute(sql, params).fetchall()
 
-        return await self.run_sync(_fetch)
+        return await self._read(_fetch)
 
 
 # Mechanical DDL translations for the shared migration scripts. Ordered:
@@ -233,6 +344,43 @@ class PostgresDatabase:
 
         async with self._lock:
             await asyncio.to_thread(_migrate, self.conn)
+
+    async def downgrade(self, target_version: int) -> None:
+        """Sqlite-engine `downgrade` parity over schema_migrations."""
+        def _downgrade(conn) -> None:
+            conn.execute("SELECT pg_advisory_lock(?)", (_PG_MIGRATE_LOCK,))
+            try:
+                row = conn.execute(
+                    "SELECT COALESCE(MAX(version), 0) AS v FROM schema_migrations"
+                ).fetchone()
+                version = row["v"]
+                if target_version >= version:
+                    return
+                steps = range(version, target_version, -1)
+                missing = [v for v in steps
+                           if v > len(DOWNGRADES) or DOWNGRADES[v - 1] is None]
+                if missing:
+                    raise RuntimeError(
+                        f"migrations {missing} are irreversible or unknown to"
+                        f" this binary; cannot downgrade from {version} to"
+                        f" {target_version}"
+                    )
+                for v in steps:
+                    conn.begin()
+                    try:
+                        conn.executescript(translate_ddl(DOWNGRADES[v - 1]))
+                        conn.execute(
+                            "DELETE FROM schema_migrations WHERE version = ?", (v,)
+                        )
+                        conn.commit()
+                    except BaseException:
+                        conn.rollback()
+                        raise
+            finally:
+                conn.execute("SELECT pg_advisory_unlock(?)", (_PG_MIGRATE_LOCK,))
+
+        async with self._lock:
+            await asyncio.to_thread(_downgrade, self.conn)
 
     async def run_sync(self, fn: Callable[[Any], T]) -> T:
         """Multi-statement callbacks get an explicit transaction."""

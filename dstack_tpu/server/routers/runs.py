@@ -86,7 +86,11 @@ async def get_run(request: Request, project_name: str):
 @router.post("/api/project/{project_name}/runs/list")
 async def list_runs(request: Request, project_name: str):
     _, project_row = await auth_project_member(request, project_name)
-    runs = await runs_service.list_runs(get_ctx(request), project_id=project_row["id"])
+    body = request.parse(ListRunsRequest)
+    runs = await runs_service.list_runs(
+        get_ctx(request), project_id=project_row["id"],
+        only_active=body.only_active, limit=body.limit,
+    )
     return [r.model_dump() for r in runs]
 
 

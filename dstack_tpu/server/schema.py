@@ -263,7 +263,10 @@ CREATE INDEX ix_logs_submission ON logs(job_submission_id, id);
 migration(
     """
 ALTER TABLE runs ADD COLUMN last_scaled_at TEXT;
-"""
+""",
+    down="""
+ALTER TABLE runs DROP COLUMN last_scaled_at;
+""",
 )
 
 # Migration 3: instance lifecycle — idleness measured from a dedicated
@@ -274,7 +277,11 @@ migration(
     """
 ALTER TABLE instances ADD COLUMN idle_since TEXT;
 ALTER TABLE instances ADD COLUMN unreachable_since TEXT;
-"""
+""",
+    down="""
+ALTER TABLE instances DROP COLUMN idle_since;
+ALTER TABLE instances DROP COLUMN unreachable_since;
+""",
 )
 
 # Migration 4: multi-replica control plane. Cross-process FSM claims — the
@@ -290,5 +297,8 @@ CREATE TABLE resource_leases (
     expires_at REAL NOT NULL,
     PRIMARY KEY (namespace, key)
 );
-"""
+""",
+    down="""
+DROP TABLE resource_leases;
+""",
 )

@@ -86,7 +86,12 @@ class ClaimLocker:
 
     @property
     def _distributed(self) -> bool:
-        return self._db.path != ":memory:"
+        # Lease rows only matter when another replica can contend; a
+        # single-replica control plane (the default) keeps claims purely
+        # in-process. Read dynamically so tests/deployments flip it.
+        from dstack_tpu.server import settings
+
+        return settings.MULTI_REPLICA and self._db.path != ":memory:"
 
     async def try_claim(self, namespace: str, key: str) -> bool:
         """Non-blocking claim; the `SKIP LOCKED` equivalent for FSM polls."""

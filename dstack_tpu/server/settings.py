@@ -18,6 +18,12 @@ DEFAULT_PROJECT_NAME = "main"
 
 SERVER_ADMIN_TOKEN = os.getenv("DSTACK_TPU_SERVER_ADMIN_TOKEN")
 
+# Multiple server replicas sharing one database: enables the cross-process
+# lease rows (services/locking.py). Off by default — a single replica pays
+# two DB writes per FSM row-step for protection against replicas that do
+# not exist (measured: the largest write-lock load on the capacity probe).
+MULTI_REPLICA = os.getenv("DSTACK_TPU_MULTI_REPLICA", "").lower() in ("1", "true", "yes")
+
 # Background processing capacity (reference: background/__init__.py:40-46
 # documents 150 active jobs/runs/instances per replica at 2-4s ticks; the
 # event-driven scheduler here has no per-tick batch caps, these bound

@@ -76,10 +76,12 @@ const views = {
         `<span class="muted">${esc(s.id)}</span>`,
       ]);
     }));
+    const terminal = ["done", "failed", "terminated"].includes(run.status);
     const html = `
       <div class="toolbar">
         <button class="action" id="back-btn">← Runs</button>
         <div class="spacer"></div>
+        ${terminal ? `<button class="action" id="retry-btn">Retry</button>` : ""}
         <button class="action danger" id="stop-btn">Stop</button>
         <button class="action danger" id="delete-btn">Delete</button>
       </div>
@@ -90,10 +92,15 @@ const views = {
         <div>User</div><div>${esc(run.user || "—")}</div>
         <div>Resources</div><div><code>${esc(JSON.stringify(conf.resources || {}))}</code></div>
         <div>Commands</div><div><code>${esc((conf.commands || []).join(" && ") || "—")}</code></div>
+        ${conf.type === "dev-environment" && run.status === "running" ? `
+        <div>IDE</div><div><a href="vscode://vscode-remote/ssh-remote+${esc(state.runName)}/workflow">Open in VS Code</a>
+          <span class="muted">(after \`dstack-tpu attach ${esc(state.runName)}\`)</span></div>` : ""}
       </div>
+      <div class="section">Submission timeline</div>
+      ${table(["#", "Job", "Status", "Submitted", "Finished", "Reason"], timelineRows(jobs))}
       <div class="section">Jobs</div>
       ${table(["Job", "Status", "Instance", "Host", "Worker", "Reason", "Submission"], jobRows)}
-      <div class="section">Host metrics <span class="muted">(10s samples)</span></div>
+      <div class="section">Host metrics <span class="muted">(10s samples; sparklines: last ~7 min)</span></div>
       <div id="metrics-box"><span class="muted">Loading…</span></div>
       <div class="section">Logs <span class="muted" id="log-state">(following)</span></div>
       <pre class="logs" id="log-box"></pre>`;
@@ -101,6 +108,13 @@ const views = {
       $("#back-btn").onclick = () => navigate(state.project, "runs");
       $("#stop-btn").onclick = async () => { await api(`/api/project/${state.project}/runs/stop`, { runs_names: [state.runName], abort: false }); render(); };
       $("#delete-btn").onclick = async () => { await api(`/api/project/${state.project}/runs/delete`, { runs_names: [state.runName] }); navigate(state.project, "runs"); };
+      const retry = $("#retry-btn");
+      if (retry) retry.onclick = async () => {
+        // Resubmit under the same name/spec — the server rejects it only
+        // while the previous incarnation is still active.
+        await api(`/api/project/${state.project}/runs/submit`, { run_spec: run.run_spec });
+        render();
+      };
       // Order matters: followLogs -> stopLogFollow bumps BOTH generations,
       // so the metrics poller must start after it.
       followLogs(run);
@@ -215,6 +229,37 @@ function latestJpd(run) {
   return null;
 }
 
+function timelineRows(jobs) {
+  /* Every submission of every job, newest first — the run's life story:
+   * retries, gang kills and reprovisioning become visible as rows. */
+  const rows = [];
+  jobs.forEach((j) => (j.job_submissions || []).forEach((s, n) => {
+    rows.push([
+      esc(String(n)),
+      esc(j.job_spec ? j.job_spec.job_name : ""),
+      pill(s.status),
+      esc(fmtDate(s.submitted_at)),
+      esc(fmtDate(s.finished_at)),
+      esc(s.termination_reason_message || s.termination_reason || "—"),
+      Date.parse(s.submitted_at) || 0,
+    ]);
+  }));
+  rows.sort((a, b) => b[6] - a[6]);
+  return rows.map((r) => r.slice(0, 6));
+}
+
+function sparkline(values, max) {
+  /* Inline SVG, no dependencies. `values` oldest-first; y scaled to max. */
+  const vals = values.filter((v) => v != null);
+  if (vals.length < 2) return `<span class="muted">—</span>`;
+  const w = 120, h = 22, top = Math.max(max || 0, ...vals, 1e-9);
+  const pts = vals.map((v, i) =>
+    `${(i / (vals.length - 1) * w).toFixed(1)},${(h - v / top * (h - 2)).toFixed(1)}`
+  ).join(" ");
+  return `<svg class="spark" width="${w}" height="${h}" viewBox="0 0 ${w} ${h}">` +
+    `<polyline fill="none" stroke="currentColor" stroke-width="1.5" points="${pts}"/></svg>`;
+}
+
 function fmtBytes(n) {
   if (n == null) return "—";
   const units = ["B", "KiB", "MiB", "GiB", "TiB"];
@@ -236,18 +281,41 @@ function followMetrics() {
     try {
       const out = await api(`/api/project/${state.project}/metrics/run/${encodeURIComponent(state.runName)}`);
       if (myGen !== state.metricsGen || !$("#metrics-box")) return;
-      const rows = (out.hosts || []).map((h) => [
-        esc(`${h.replica_num}/${h.job_num}`),
-        esc(h.cpu_percent != null ? h.cpu_percent.toFixed(0) + "%" : "—"),
-        esc(fmtBytes(h.memory_usage_bytes)),
-        esc(String(h.tpu_chips ?? 0)),
-        esc(h.tpu_duty_cycle_percent != null ? h.tpu_duty_cycle_percent.toFixed(0) + "%" : "—"),
-        esc(h.tpu_hbm_usage_bytes != null
-          ? `${fmtBytes(h.tpu_hbm_usage_bytes)}${h.tpu_hbm_total_bytes ? " / " + fmtBytes(h.tpu_hbm_total_bytes) : ""}`
-          : "—"),
-      ]);
+      // Per-host windows for the sparklines (same API `stats` reads);
+      // fetched in parallel, tolerated individually — a host with no
+      // points yet just shows a dash.
+      const hosts = out.hosts || [];
+      const histories = await Promise.all(hosts.map((h) =>
+        api(`/api/project/${state.project}/metrics/job/${encodeURIComponent(state.runName)}?replica_num=${h.replica_num}&job_num=${h.job_num}&limit=40`)
+          .then((m) => (m.points || []).reverse())  // oldest first
+          .catch(() => [])
+      ));
+      if (myGen !== state.metricsGen || !$("#metrics-box")) return;
+      const rows = hosts.map((h, i) => {
+        const pts = histories[i];
+        const duty = pts.map((p) => {
+          const ds = (p.tpu_chips || []).map((c) => c.duty_cycle_pct).filter((d) => d != null);
+          return ds.length ? ds.reduce((a, b) => a + b, 0) / ds.length : null;
+        });
+        const hbm = pts.map((p) => {
+          const us = (p.tpu_chips || []).map((c) => c.hbm_used_bytes).filter((u) => u != null);
+          return us.length ? us.reduce((a, b) => a + b, 0) : null;
+        });
+        return [
+          esc(`${h.replica_num}/${h.job_num}`),
+          esc(h.cpu_percent != null ? h.cpu_percent.toFixed(0) + "%" : "—"),
+          esc(fmtBytes(h.memory_usage_bytes)),
+          esc(String(h.tpu_chips ?? 0)),
+          esc(h.tpu_duty_cycle_percent != null ? h.tpu_duty_cycle_percent.toFixed(0) + "%" : "—"),
+          sparkline(duty, 100),
+          esc(h.tpu_hbm_usage_bytes != null
+            ? `${fmtBytes(h.tpu_hbm_usage_bytes)}${h.tpu_hbm_total_bytes ? " / " + fmtBytes(h.tpu_hbm_total_bytes) : ""}`
+            : "—"),
+          sparkline(hbm, h.tpu_hbm_total_bytes || 0),
+        ];
+      });
       $("#metrics-box").innerHTML = table(
-        ["Replica/Job", "CPU", "Memory", "Chips", "TPU util", "HBM"], rows);
+        ["Replica/Job", "CPU", "Memory", "Chips", "TPU util", "Util history", "HBM", "HBM history"], rows);
       rendered = true;
     } catch (e) {
       if (e instanceof AuthError) return showLogin();

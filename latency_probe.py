@@ -29,8 +29,11 @@ import time
 class ProbeServer:
     """In-process server on a real socket, optionally polling-mode."""
 
-    def __init__(self, polling: bool):
+    def __init__(self, polling: bool, db_path: str = ":memory:",
+                 backend_config: dict = None):
         self.polling = polling
+        self.db_path = db_path
+        self.backend_config = backend_config or {"tpu_sim": ["v5litepod-16"]}
         self.url = None
         self.token = None
         self._loop = None
@@ -59,12 +62,12 @@ class ProbeServer:
                 from dstack_tpu.server.app import create_app
                 from dstack_tpu.server.http import Server
 
-                app = create_app(db_path=":memory:")
+                app = create_app(db_path=self.db_path)
                 server = Server(app, "127.0.0.1", 0)
                 await server.start()
                 ctx = app.state["ctx"]
-                # Let the local backend advertise multi-host TPU slices.
-                ctx.overrides["local_backend_config"] = {"tpu_sim": ["v5litepod-16"]}
+                # Default: advertise multi-host TPU slices (gang latency).
+                ctx.overrides["local_backend_config"] = self.backend_config
                 if self.polling:
                     ctx.kick = lambda channel: None  # reference has no kicks
                 self.url = f"http://127.0.0.1:{server.port}"

@@ -86,3 +86,67 @@ async def test_concurrent_migration_of_one_file(tmp_path):
     finally:
         for db in dbs:
             await db.close()
+
+
+async def test_downgrade_reverses_migrations(tmp_path):
+    """Operator rollback: head -> version 1 drops the added columns and
+    the lease table; a re-migrate brings the schema back to head — the
+    alembic upgrade/downgrade/upgrade cycle."""
+    from dstack_tpu.server.db import Database
+
+    db = Database(str(tmp_path / "d.db"))
+    await db.connect()
+    try:
+        async def cols(table):
+            rows = await db.fetchall(f"PRAGMA table_info({table})")
+            return {r["name"] for r in rows}
+
+        assert "last_scaled_at" in await cols("runs")
+        assert "idle_since" in await cols("instances")
+
+        await db.downgrade(1)
+        assert (await db.fetchone("PRAGMA user_version"))[0] == 1
+        assert "last_scaled_at" not in await cols("runs")
+        assert "idle_since" not in await cols("instances")
+        row = await db.fetchone(
+            "SELECT name FROM sqlite_master WHERE name = 'resource_leases'"
+        )
+        assert row is None
+
+        await db.migrate()  # back to head
+        assert "last_scaled_at" in await cols("runs")
+        assert await db.fetchone("SELECT COUNT(*) AS n FROM resource_leases")
+    finally:
+        await db.close()
+
+
+async def test_downgrade_refuses_irreversible_range(tmp_path):
+    """Migration 1 (the base schema) has no down script: downgrading to 0
+    must refuse loudly instead of half-unwinding."""
+    import pytest
+
+    from dstack_tpu.server.db import Database
+
+    db = Database(str(tmp_path / "d.db"))
+    await db.connect()
+    try:
+        with pytest.raises(RuntimeError, match="irreversible"):
+            await db.downgrade(0)
+        # Nothing was unwound.
+        assert (await db.fetchone("PRAGMA user_version"))[0] >= 4
+    finally:
+        await db.close()
+
+
+async def test_downgrade_noop_at_or_below_target(tmp_path):
+    from dstack_tpu.server.db import Database
+
+    db = Database(str(tmp_path / "d.db"))
+    await db.connect()
+    try:
+        head = (await db.fetchone("PRAGMA user_version"))[0]
+        await db.downgrade(head)      # same version: no-op
+        await db.downgrade(head + 5)  # above head: no-op
+        assert (await db.fetchone("PRAGMA user_version"))[0] == head
+    finally:
+        await db.close()

@@ -90,9 +90,13 @@ async def test_gang_member_failure_resubmits_whole_replica(tmp_path, monkeypatch
     fx = await make_server()
     fx.ctx.overrides["local_backend_config"] = {"tpu_sim": ["v5litepod-16"]}
     try:
+        # Siblings sleep so they are still RUNNING when rank 1 dies — the
+        # gang rule being tested is killing live members, not re-running
+        # already-finished ones (concurrent FSM ticks finish instant jobs
+        # before the kill propagates).
         cmd = (
             f'if [ "$JAX_PROCESS_ID" = "1" ] && [ ! -f {marker} ]; then'
-            f" touch {marker}; exit 3; fi; echo rank $JAX_PROCESS_ID ok"
+            f" touch {marker}; exit 3; fi; sleep 3; echo rank $JAX_PROCESS_ID ok"
         )
         resp = await fx.client.post(
             "/api/project/main/runs/submit",

@@ -36,3 +36,66 @@ def test_4k_context_ring_train_step():
     assert spec == jax.sharding.PartitionSpec(("data", "fsdp"), "seq")
     # Uniform random tokens: loss starts near ln(V).
     assert abs(loss - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_ring_x_remat_x_pipeline_rungs():
+    """Ring attention composed with each remat rung: the long-context
+    design must hold when activations DON'T all fit (the very situation
+    long context creates). Same step, same data, every rung — losses must
+    agree (remat changes memory, never math)."""
+    cfg = PRESETS["tiny"].with_(max_seq_len=1024)
+    mesh = make_mesh(jax.devices()[:8], seq=4, model=2)
+    losses = {}
+    for rung in ("none", "dots", "full"):
+        c = cfg.with_(remat=rung)
+        state = init_train_state(c, jax.random.PRNGKey(0), mesh=mesh)
+        step = make_train_step(c, mesh)
+        batch = synthetic_batch(c, batch_size=2, seq_len=1024, mesh=mesh)
+        state, metrics = step(state, batch)
+        losses[rung] = float(metrics["loss"])
+        assert np.isfinite(losses[rung]), rung
+    assert abs(losses["none"] - losses["full"]) < 1e-3, losses
+    assert abs(losses["none"] - losses["dots"]) < 1e-3, losses
+
+
+def test_block_picker_and_fallback_across_seq_lengths():
+    """The adaptive block picker must never drop query tiles: for every
+    admitted seq length the chosen block divides it exactly, and lengths
+    the kernel cannot tile (non-multiples of 128, VMEM-overflowing K/V)
+    fall back to plain attention instead of dispatching a broken grid."""
+    from dstack_tpu.workloads.flash_attention import (
+        BLK_K,
+        BLK_Q,
+        MIN_BLK,
+        _pick_block,
+        use_flash,
+    )
+
+    for seq in (128, 256, 384, 640, 1024, 1536, 2048, 2048 + 128, 4096):
+        assert use_flash(seq, 128, interpret=True), seq
+        for max_blk in (BLK_Q, BLK_K, 256):
+            blk = _pick_block(seq, max_blk)
+            assert seq % blk == 0, (seq, max_blk, blk)
+            assert MIN_BLK <= blk <= max_blk
+    # Non-multiples of 128 and VMEM-busting shapes are rejected.
+    for seq in (100, 200, 1000, 2049):
+        assert not use_flash(seq, 128, interpret=True), seq
+    assert not use_flash(1 << 16, 128, interpret=True)  # K/V > VMEM budget
+
+
+def test_non_multiple_seq_matches_plain_attention():
+    """A 384-token sequence (divisible by 128, not by the 1024 block
+    maxima) runs the flash kernel with a smaller block and must match the
+    plain-attention forward bit-for-bit in f32 tolerance."""
+    from dstack_tpu.workloads.attention import plain_attention
+    from dstack_tpu.workloads.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(7)
+    q = jax.random.normal(key, (2, 384, 4, 128), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 384, 2, 128), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 384, 2, 128), jnp.float32)
+    out_flash = flash_attention(q, k, v, causal=True, interpret=True)
+    out_plain = plain_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_plain), rtol=2e-3, atol=2e-3
+    )

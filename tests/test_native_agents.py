@@ -717,3 +717,186 @@ class TestShimDockerPullProgress:
         assert any("layer1" in m for m in messages), messages
         final = _req("GET", f"{base}/tasks/pp-1")
         assert not final.get("status_message")
+
+
+class TestShimFailurePaths:
+    """Failure paths driven against the REAL C++ shim binary (round-4
+    VERDICT #9): mkfs/mount failures, docker-login failure, pull timeout,
+    pull error, and the volume-already-mounted restart path. Reasons use
+    the shared protocol vocabulary (volume_error /
+    creating_container_error) the server FSM maps — the same strings the
+    Python runner twin reports for its volume failures."""
+
+    def _fs_shim(self, binaries, tmp_path, helper_body):
+        helper = tmp_path / "fs_helper.sh"
+        helper.write_text("#!/bin/bash\nverb=$1; shift\n" + helper_body)
+        helper.chmod(0o755)
+        import os
+
+        return _start(
+            [binaries["shim"], "--host", "127.0.0.1", "--port", 0,
+             "--runtime", "process", "--runner-binary", binaries["runner"]],
+            env=dict(os.environ, DSTACK_SHIM_FS_HELPER=str(helper)),
+        )
+
+    def _docker_shim(self, binaries, tmp_path, docker_body, extra_env=None):
+        fake = tmp_path / "docker"
+        fake.write_text("#!/bin/sh\n" + docker_body)
+        fake.chmod(0o755)
+        import os
+
+        env = dict(os.environ, PATH=f"{tmp_path}:{os.environ['PATH']}")
+        env.update(extra_env or {})
+        return _start(
+            [binaries["shim"], "--host", "127.0.0.1", "--port", 0,
+             "--runtime", "docker", "--runner-binary", binaries["runner"]],
+            env=env,
+        )
+
+    def _submit_and_wait(self, port, body, timeout=20.0):
+        base = f"http://127.0.0.1:{port}/api"
+        _req("POST", f"{base}/tasks", body)
+        deadline = time.time() + timeout
+        task = None
+        while time.time() < deadline:
+            task = _req("GET", f"{base}/tasks/{body['id']}")
+            if task["status"] in ("running", "terminated"):
+                return task
+            time.sleep(0.1)
+        raise AssertionError(f"task stuck: {task}")
+
+    def test_mkfs_failure_fails_task_with_volume_error(self, binaries, tmp_path):
+        proc, port = self._fs_shim(
+            binaries, tmp_path,
+            "case $verb in\n"
+            "  mounted) exit 1 ;;\n"
+            "  fstype) exit 2 ;;\n"  # blank device
+            '  mkfs) echo "mke2fs: Device size reported zero"; exit 1 ;;\n'
+            "esac\nexit 3\n",
+        )
+        try:
+            task = self._submit_and_wait(port, {
+                "id": "t-mkfs", "name": "v",
+                "volumes": [{"name": "ckpt", "path": str(tmp_path / "m"),
+                             "device_name": "/dev/fake0"}],
+            })
+            assert task["status"] == "terminated"
+            assert task["termination_reason"] == "volume_error"
+            assert "mkfs.ext4 /dev/fake0 failed" in task["termination_message"]
+            assert "Device size reported zero" in task["termination_message"]
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_mount_failure_fails_task_with_volume_error(self, binaries, tmp_path):
+        proc, port = self._fs_shim(
+            binaries, tmp_path,
+            "case $verb in\n"
+            "  mounted) exit 1 ;;\n"
+            "  fstype) echo ext4; exit 0 ;;\n"
+            '  mount) echo "mount: wrong fs type"; exit 32 ;;\n'
+            "esac\nexit 3\n",
+        )
+        try:
+            task = self._submit_and_wait(port, {
+                "id": "t-mnt", "name": "v",
+                "volumes": [{"name": "data", "path": str(tmp_path / "m"),
+                             "device_name": "/dev/fake1"}],
+            })
+            assert task["status"] == "terminated"
+            assert task["termination_reason"] == "volume_error"
+            assert "mount /dev/fake1" in task["termination_message"]
+            assert "wrong fs type" in task["termination_message"]
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_already_mounted_volume_skips_format_and_mount(self, binaries, tmp_path):
+        """Shim restart with the device still mounted (label-restore path):
+        the not-reformat guarantee extends to not re-running mkfs/mount at
+        all — only the 'mounted' probe fires."""
+        log = tmp_path / "calls.log"
+        proc, port = self._fs_shim(
+            binaries, tmp_path,
+            f'echo "$verb $@" >> {log}\n'
+            "case $verb in\n"
+            "  mounted) exit 0 ;;\n"  # already mounted from before restart
+            "esac\nexit 3\n",  # any other verb would fail loudly
+        )
+        try:
+            task = self._submit_and_wait(port, {
+                "id": "t-rem", "name": "v",
+                "volumes": [{"name": "ckpt", "path": str(tmp_path / "m"),
+                             "device_name": "/dev/fake0"}],
+            })
+            assert task["status"] == "running", task
+            calls = [l.split()[0] for l in log.read_text().splitlines()]
+            assert calls == ["mounted"]
+            _req("POST", f"http://127.0.0.1:{port}/api/tasks/t-rem/terminate",
+                 {"timeout": 1})
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_docker_login_failure(self, binaries, tmp_path):
+        proc, port = self._docker_shim(
+            binaries, tmp_path,
+            'case "$1" in\n'
+            "  ps) exit 0 ;;\n"
+            '  login) echo "Error response from daemon: unauthorized"; exit 1 ;;\n'
+            "esac\nexit 0\n",
+        )
+        try:
+            task = self._submit_and_wait(port, {
+                "id": "t-login", "name": "p",
+                "image_name": "reg.example.com/app:1",
+                "registry_username": "bot", "registry_password": "nope",
+            })
+            assert task["status"] == "terminated"
+            assert task["termination_reason"] == "creating_container_error"
+            assert "docker login failed" in task["termination_message"]
+            assert "unauthorized" in task["termination_message"]
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_pull_timeout_fails_task(self, binaries, tmp_path):
+        """A pull that exceeds the (env-shrunk) cap is killed and the task
+        fails instead of sitting in 'pulling' forever."""
+        proc, port = self._docker_shim(
+            binaries, tmp_path,
+            'case "$1" in\n'
+            "  ps) exit 0 ;;\n"
+            '  pull) echo "layer1: Downloading"; sleep 30 ;;\n'
+            "esac\nexit 0\n",
+            extra_env={"DSTACK_TPU_SHIM_PULL_TIMEOUT": "2"},
+        )
+        try:
+            task = self._submit_and_wait(port, {
+                "id": "t-slow", "name": "p", "image_name": "example/huge:1",
+            }, timeout=30.0)
+            assert task["status"] == "terminated"
+            assert task["termination_reason"] == "creating_container_error"
+            assert "docker pull failed" in task["termination_message"]
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_pull_error_surfaces_docker_output(self, binaries, tmp_path):
+        proc, port = self._docker_shim(
+            binaries, tmp_path,
+            'case "$1" in\n'
+            "  ps) exit 0 ;;\n"
+            '  pull) echo "manifest for example/app:9 not found"; exit 1 ;;\n'
+            "esac\nexit 0\n",
+        )
+        try:
+            task = self._submit_and_wait(port, {
+                "id": "t-404", "name": "p", "image_name": "example/app:9",
+            })
+            assert task["status"] == "terminated"
+            assert task["termination_reason"] == "creating_container_error"
+            assert "manifest for example/app:9 not found" in task["termination_message"]
+        finally:
+            proc.kill()
+            proc.wait()

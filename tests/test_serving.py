@@ -159,3 +159,22 @@ def test_validation(params):
             engine.submit([1] * 10, max_new_tokens=10)
     finally:
         engine.close()
+
+
+def test_bench_serving_harness_smoke(params, monkeypatch):
+    """bench_serving's measurement harness (timed drain, percentile math)
+    stays runnable — the TPU numbers in BENCH_serving_r04.json are
+    produced by exactly this code path."""
+    import bench_serving as bs
+
+    monkeypatch.setattr(bs, "PROMPT_LEN", 4)
+    monkeypatch.setattr(bs, "NEW_TOKENS", 6)
+    monkeypatch.setattr(bs, "MAX_LEN", 32)
+    engine = ServingEngine(CFG, params, slots=2, max_len=32)
+    try:
+        out = bs.run_scenario(engine, 3)
+    finally:
+        engine.close()
+    assert out["streams"] == 3
+    assert out["agg_tok_s"] > 0
+    assert out["ttft_p95_ms"] >= out["ttft_p50_ms"] >= 0
