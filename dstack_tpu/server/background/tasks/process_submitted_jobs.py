@@ -204,8 +204,10 @@ async def _try_assign_pool_instances(
     if slice_hosts == 1:
         if not candidates:
             return False
-        await _assign_jobs_to_instances(ctx, [row], [candidates[0]])
-        return True
+        for candidate in candidates:
+            if await _assign_jobs_to_instances(ctx, [row], [candidate]):
+                return True
+        return False
     # Multi-host: need all H workers of one TPU node idle.
     by_node = {}
     for irow in candidates:
@@ -225,8 +227,8 @@ async def _try_assign_pool_instances(
                     r["job_provisioning_data"]
                 ).tpu_worker_index
             )
-            await _assign_jobs_to_instances(ctx, group_rows, members)
-            return True
+            if await _assign_jobs_to_instances(ctx, group_rows, members):
+                return True  # else: raced on this slice; try the next node
     return False
 
 
@@ -252,21 +254,42 @@ async def _slice_group_jobs(
 
 async def _assign_jobs_to_instances(
     ctx: ServerContext, job_rows: List[sqlite3.Row], instance_rows: List[sqlite3.Row]
-) -> None:
+) -> bool:
+    """Atomically flip each candidate idle instance to busy and bind its
+    job. The status='idle' precondition is what makes concurrent
+    submitted-job steps (background/concurrency.py) safe here: two jobs
+    that both SELECTed the same idle instance race on this UPDATE and
+    exactly one wins; the loser rolls its partial grabs back and retries
+    next tick."""
     now = utcnow_iso()
-    for job_row, irow in zip(job_rows, instance_rows):
-        jpd = irow["job_provisioning_data"]
-        await ctx.db.execute(
+    taken: List[sqlite3.Row] = []
+    for irow in instance_rows:
+        n = await ctx.db.execute(
             "UPDATE instances SET status = 'busy', busy_blocks = total_blocks,"
-            " idle_since = NULL, last_processed_at = ? WHERE id = ?",
+            " idle_since = NULL, last_processed_at = ?"
+            " WHERE id = ? AND status = 'idle' AND deleted = 0",
             (now, irow["id"]),
         )
+        if n != 1:
+            # Another job won this instance between our SELECT and now:
+            # release what we grabbed and let the caller fall through.
+            for t in taken:
+                await ctx.db.execute(
+                    "UPDATE instances SET status = 'idle', busy_blocks = 0,"
+                    " idle_since = ? WHERE id = ? AND status = 'busy'",
+                    (now, t["id"]),
+                )
+            return False
+        taken.append(irow)
+    for job_row, irow in zip(job_rows, instance_rows):
+        jpd = irow["job_provisioning_data"]
         await ctx.db.execute(
             "UPDATE jobs SET instance_id = ?, instance_assigned = 1, status = ?,"
             " job_provisioning_data = ?, last_processed_at = ? WHERE id = ?",
             (irow["id"], JobStatus.PROVISIONING.value, jpd, now, job_row["id"]),
         )
         logger.info("job %s assigned to idle instance %s", job_row["id"][:8], irow["name"])
+    return True
 
 
 async def _commit_provisioned_slice(

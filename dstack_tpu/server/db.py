@@ -209,9 +209,21 @@ class Database:
                         f" {target_version}"
                     )
                 for v in steps:
-                    conn.executescript(DOWNGRADES[v - 1])
-                    conn.execute(f"PRAGMA user_version = {v - 1}")
-                    conn.commit()
+                    # Statement-by-statement inside ONE transaction per
+                    # step: executescript autocommits as it goes, so a
+                    # failure mid-script would leave the schema half
+                    # unwound at the old version — exactly the
+                    # half-applied rollback this method promises not to
+                    # produce. (sqlite DDL is transactional.)
+                    try:
+                        for stmt in DOWNGRADES[v - 1].split(";"):
+                            if stmt.strip():
+                                conn.execute(stmt)
+                        conn.execute(f"PRAGMA user_version = {v - 1}")
+                        conn.commit()
+                    except BaseException:
+                        conn.rollback()
+                        raise
 
         await self.run_sync(_downgrade)
 

@@ -180,9 +180,13 @@ async def get_plan(
 
 
 def _is_unique_violation(e: BaseException) -> bool:
-    """Engine-agnostic unique-index violation test (sqlite + pgwire)."""
+    """Engine-agnostic unique-index violation test (sqlite + pgwire).
+
+    Specifically UNIQUE — an FK or NOT NULL IntegrityError (e.g. the
+    project deleted mid-submit) must surface as its own error, not as
+    "run already exists" or a futile name regeneration."""
     if isinstance(e, sqlite3.IntegrityError):
-        return True
+        return "UNIQUE constraint failed" in str(e)
     from dstack_tpu.server.pgwire import PgError
 
     return isinstance(e, PgError) and e.code == "23505"

@@ -194,19 +194,88 @@ const views = {
   async admin() {
     const users = await api("/api/users/list", {});
     const projects = state.projects || [];  // fetched by render() this pass
-    return { title: "Admin", html: `
+    const usernames = (users || []).map((u) => u.username);
+    const html = `
       <div class="section">Users</div>
-      ${table(["Username", "Role", "Email", "Active"],
+      ${table(["Username", "Role", "Email", "Active", ""],
         (users || []).map((u) => [
           esc(u.username), pill(u.global_role), esc(u.email || "—"),
           esc(u.active === false ? "no" : "yes"),
+          `<button class="action danger" data-del-user="${esc(u.username)}">remove</button>`,
         ]))}
-      <div class="section">Projects</div>
-      ${table(["Project", "Members"],
-        (projects || []).map((p) => [
-          esc(p.project_name || p.name),
-          esc(String((p.members || []).length)),
-        ]))}` };
+      <div class="toolbar">
+        <input id="new-user" placeholder="username">
+        <select id="new-user-role"><option>user</option><option>admin</option></select>
+        <button class="action" id="create-user-btn">Create user</button>
+      </div>
+      <div class="section">Projects &amp; members</div>
+      ${(projects || []).map((p) => {
+        const name = p.project_name || p.name;
+        return `
+        <div class="kv"><div>${esc(name)}</div><div>
+          ${table(["Member", "Role", ""], (p.members || []).map((m) => [
+            esc(m.user.username), pill(m.project_role),
+            `<button class="action danger" data-drop-member-project="${esc(name)}" data-drop-member-user="${esc(m.user.username)}">remove</button>`,
+          ]))}
+          <div class="toolbar">
+            <select data-add-member-user="${esc(name)}">${usernames.map((u) => `<option>${esc(u)}</option>`).join("")}</select>
+            <select data-add-member-role="${esc(name)}"><option>user</option><option>manager</option><option>admin</option></select>
+            <button class="action" data-add-member="${esc(name)}">Add member</button>
+          </div>
+        </div></div>`;
+      }).join("")}
+      <div class="toolbar">
+        <input id="new-project" placeholder="project name">
+        <button class="action" id="create-project-btn">Create project</button>
+      </div>`;
+    return { title: "Admin", html, after() {
+      $("#create-user-btn").onclick = async () => {
+        const username = $("#new-user").value.trim();
+        if (!username) return;
+        await api("/api/users/create", { username, global_role: $("#new-user-role").value });
+        render();
+      };
+      $("#create-project-btn").onclick = async () => {
+        const name = $("#new-project").value.trim();
+        if (!name) return;
+        await api("/api/projects/create", { project_name: name });
+        render();
+      };
+      document.querySelectorAll("[data-del-user]").forEach((b) => {
+        b.onclick = async () => {
+          await api("/api/users/delete", { users: [b.dataset.delUser] });
+          render();
+        };
+      });
+      const membersOf = (name) => {
+        const p = (projects || []).find((q) => (q.project_name || q.name) === name);
+        return (p && p.members || []).map((m) => ({
+          username: m.user.username, project_role: m.project_role,
+        }));
+      };
+      document.querySelectorAll("[data-add-member]").forEach((b) => {
+        b.onclick = async () => {
+          const name = b.dataset.addMember;
+          const user = document.querySelector(`[data-add-member-user="${CSS.escape(name)}"]`).value;
+          const role = document.querySelector(`[data-add-member-role="${CSS.escape(name)}"]`).value;
+          const members = membersOf(name).filter((m) => m.username !== user);
+          members.push({ username: user, project_role: role });
+          await api(`/api/projects/${name}/set_members`, { members });
+          render();
+        };
+      });
+      document.querySelectorAll("[data-drop-member-project]").forEach((b) => {
+        b.onclick = async () => {
+          // Separate data attributes: usernames are unvalidated free text
+          // and may themselves contain the would-be separator.
+          const name = b.dataset.dropMemberProject;
+          const user = b.dataset.dropMemberUser;
+          const members = membersOf(name).filter((m) => m.username !== user);
+          await api(`/api/projects/${name}/set_members`, { members });
+          render();
+        };
+      });
+    } };
   },
 
   async server() {
@@ -283,13 +352,20 @@ function followMetrics() {
       if (myGen !== state.metricsGen || !$("#metrics-box")) return;
       // Per-host windows for the sparklines (same API `stats` reads);
       // fetched in parallel, tolerated individually — a host with no
-      // points yet just shows a dash.
+      // points yet just shows a dash. Histories refresh every OTHER
+      // 5s tick: the server samples every 10s, so fetching N x 40-point
+      // windows per tick would re-download identical data half the time.
       const hosts = out.hosts || [];
-      const histories = await Promise.all(hosts.map((h) =>
-        api(`/api/project/${state.project}/metrics/job/${encodeURIComponent(state.runName)}?replica_num=${h.replica_num}&job_num=${h.job_num}&limit=40`)
-          .then((m) => (m.points || []).reverse())  // oldest first
-          .catch(() => [])
-      ));
+      state.sparkTick = (state.sparkTick || 0) + 1;
+      let histories = state.sparkCache;
+      if (!histories || state.sparkTick % 2 === 1) {
+        histories = await Promise.all(hosts.map((h) =>
+          api(`/api/project/${state.project}/metrics/job/${encodeURIComponent(state.runName)}?replica_num=${h.replica_num}&job_num=${h.job_num}&limit=40`)
+            .then((m) => (m.points || []).reverse())  // oldest first
+            .catch(() => [])
+        ));
+        state.sparkCache = histories;
+      }
       if (myGen !== state.metricsGen || !$("#metrics-box")) return;
       const rows = hosts.map((h, i) => {
         const pts = histories[i];
