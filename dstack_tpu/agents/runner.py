@@ -460,7 +460,30 @@ def main() -> None:
     )
     parser.add_argument("--working-root", default=None)
     parser.add_argument("--idle-shutdown", action="store_true")
+    parser.add_argument(
+        "--parent-pid", type=int, default=None,
+        help="exit when this (spawning) process dies — local backend: a"
+             " runner must not outlive its server; orphaned agents"
+             " accumulated for hours otherwise. Passed explicitly by the"
+             " spawner: capturing getppid() here would race a parent that"
+             " died during interpreter startup (ppid already 1).",
+    )
     args = parser.parse_args()
+
+    if args.parent_pid is not None:
+        parent = args.parent_pid
+
+        def _parent_watch() -> None:
+            import time as _time
+
+            while True:
+                if os.getppid() != parent:  # reparented: spawner is gone
+                    os._exit(0)
+                _time.sleep(5)
+
+        import threading
+
+        threading.Thread(target=_parent_watch, daemon=True).start()
 
     async def _serve() -> None:
         app = create_runner_app(args.working_root, idle_shutdown=args.idle_shutdown)

@@ -64,6 +64,31 @@ class LocalBackendConfig(CoreModel):
         return self
 
 
+# Loaded at import, NOT inside the preexec hook: dlopen between fork and
+# exec in a threaded parent can deadlock on loader/malloc locks.
+try:
+    import ctypes as _ctypes
+
+    _LIBC = _ctypes.CDLL("libc.so.6", use_errno=True)
+except OSError:  # non-glibc platform
+    _LIBC = None
+
+_PR_SET_PDEATHSIG = 1
+
+
+def _exit_with_parent_preexec() -> None:
+    """In the child, pre-exec: deliver SIGTERM when the parent dies
+    (Linux PR_SET_PDEATHSIG). There is a window where the parent died
+    between fork and prctl — detect it and exit immediately."""
+    if _LIBC is None:
+        return  # the --parent-pid watchdog still covers python runners
+    import signal as _signal
+
+    _LIBC.prctl(_PR_SET_PDEATHSIG, _signal.SIGTERM)
+    if os.getppid() == 1:
+        os._exit(0)
+
+
 class LocalCompute(Compute):
     BACKEND_TYPE = "local"
 
@@ -142,6 +167,10 @@ class LocalCompute(Compute):
                 argv = [
                     sys.executable, "-S", "-m", "dstack_tpu.agents.runner",
                     "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
+                    # Belt-and-braces with PDEATHSIG below: the explicit
+                    # pid makes the watchdog race-free even if the parent
+                    # dies during interpreter startup.
+                    "--parent-pid", str(os.getpid()),
                 ]
             proc = subprocess.Popen(
                 argv,
@@ -153,6 +182,12 @@ class LocalCompute(Compute):
                      # gated on this marker.
                      "DSTACK_TPU_LOCAL": "1"},
                 start_new_session=True,
+                # Local "hosts" are children of the server process and must
+                # die with it — abruptly-killed servers (tests, probes)
+                # otherwise leave agent processes around forever (observed:
+                # hundreds, hours old). PDEATHSIG covers every spawn branch
+                # (python, C++ runner, shim) and survives exec.
+                preexec_fn=_exit_with_parent_preexec,
             )
             instance_id = f"local-{proc.pid}"
             self._procs[instance_id] = proc

@@ -209,18 +209,19 @@ class Database:
                         f" {target_version}"
                     )
                 for v in steps:
-                    # Statement-by-statement inside ONE transaction per
-                    # step: executescript autocommits as it goes, so a
-                    # failure mid-script would leave the schema half
-                    # unwound at the old version — exactly the
-                    # half-applied rollback this method promises not to
-                    # produce. (sqlite DDL is transactional.)
+                    # One transaction per step: a failure mid-script must
+                    # not leave the schema half unwound at the old version
+                    # (sqlite DDL is transactional). BEGIN/COMMIT inside
+                    # the script — NOT a naive split(";"), which would
+                    # chop trigger bodies or ';' string literals.
                     try:
-                        for stmt in DOWNGRADES[v - 1].split(";"):
-                            if stmt.strip():
-                                conn.execute(stmt)
-                        conn.execute(f"PRAGMA user_version = {v - 1}")
-                        conn.commit()
+                        # user_version writes are transactional too: the
+                        # version marker moves in the same commit as the
+                        # schema it describes.
+                        conn.executescript(
+                            "BEGIN;\n" + DOWNGRADES[v - 1]
+                            + f"\n;PRAGMA user_version = {v - 1};\nCOMMIT;"
+                        )
                     except BaseException:
                         conn.rollback()
                         raise

@@ -228,24 +228,35 @@ const views = {
         <input id="new-project" placeholder="project name">
         <button class="action" id="create-project-btn">Create project</button>
       </div>`;
+    // Admin mutations share one error path: AuthError -> login prompt
+    // (like every other caller), anything else -> inline error banner —
+    // a silent unhandled rejection would make a 403 look like a dead
+    // button.
+    const act = (fn) => async () => {
+      try {
+        await fn();
+        render();
+      } catch (e) {
+        if (e instanceof AuthError) return showLogin();
+        const c = $("#content");
+        if (c) c.insertAdjacentHTML("afterbegin", `<p class="error">${esc(e.message)}</p>`);
+      }
+    };
     return { title: "Admin", html, after() {
-      $("#create-user-btn").onclick = async () => {
+      $("#create-user-btn").onclick = act(async () => {
         const username = $("#new-user").value.trim();
         if (!username) return;
         await api("/api/users/create", { username, global_role: $("#new-user-role").value });
-        render();
-      };
-      $("#create-project-btn").onclick = async () => {
+      });
+      $("#create-project-btn").onclick = act(async () => {
         const name = $("#new-project").value.trim();
         if (!name) return;
         await api("/api/projects/create", { project_name: name });
-        render();
-      };
+      });
       document.querySelectorAll("[data-del-user]").forEach((b) => {
-        b.onclick = async () => {
+        b.onclick = act(async () => {
           await api("/api/users/delete", { users: [b.dataset.delUser] });
-          render();
-        };
+        });
       });
       const membersOf = (name) => {
         const p = (projects || []).find((q) => (q.project_name || q.name) === name);
@@ -254,26 +265,24 @@ const views = {
         }));
       };
       document.querySelectorAll("[data-add-member]").forEach((b) => {
-        b.onclick = async () => {
+        b.onclick = act(async () => {
           const name = b.dataset.addMember;
           const user = document.querySelector(`[data-add-member-user="${CSS.escape(name)}"]`).value;
           const role = document.querySelector(`[data-add-member-role="${CSS.escape(name)}"]`).value;
           const members = membersOf(name).filter((m) => m.username !== user);
           members.push({ username: user, project_role: role });
           await api(`/api/projects/${name}/set_members`, { members });
-          render();
-        };
+        });
       });
       document.querySelectorAll("[data-drop-member-project]").forEach((b) => {
-        b.onclick = async () => {
+        b.onclick = act(async () => {
           // Separate data attributes: usernames are unvalidated free text
           // and may themselves contain the would-be separator.
           const name = b.dataset.dropMemberProject;
           const user = b.dataset.dropMemberUser;
           const members = membersOf(name).filter((m) => m.username !== user);
           await api(`/api/projects/${name}/set_members`, { members });
-          render();
-        };
+        });
       });
     } };
   },
@@ -341,6 +350,11 @@ function followMetrics() {
   // Own generation: each (re)render bails the previous poller; navigating
   // away removes #metrics-box, which also ends the loop.
   state.metricsGen = (state.metricsGen || 0) + 1;
+  // Fresh view, fresh sparkline cache: serving run A's cached histories
+  // against run B's hosts would mislabel data (and crash on a length
+  // mismatch).
+  state.sparkCache = null;
+  state.sparkTick = 0;
   const myGen = state.metricsGen;
   let rendered = false;
   const tick = async () => {

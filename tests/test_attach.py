@@ -123,3 +123,44 @@ def test_attach_target_with_proxy():
     assert target is not None and target.proxy is not None
     assert target.proxy.hostname == "10.0.0.9"
     assert target.proxy.port == 2222
+
+
+def test_runner_exits_when_parent_dies(tmp_path):
+    """--parent-pid watchdog: a local-backend runner must not outlive the
+    server that spawned it (observed: hundreds of orphaned agents, hours
+    old, after abruptly-killed test servers). The intermediate shell — the
+    "server" — waits for the runner to finish booting (port file written,
+    so the watchdog is genuinely running) and only then dies."""
+    import os
+    import subprocess
+    import sys
+    import time
+    from pathlib import Path
+
+    port_file = tmp_path / "w.port"
+    script = (
+        f"{sys.executable} -m dstack_tpu.agents.runner --host 127.0.0.1"
+        f" --port 0 --port-file {port_file} --parent-pid $$"
+        " >/dev/null 2>&1 & pid=$!;"
+        f" n=0; while [ ! -s {port_file} ] && [ $n -lt 200 ];"
+        " do sleep 0.1; n=$((n+1)); done;"
+        " echo $pid"
+    )
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env = {**os.environ,
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    out = subprocess.run(["/bin/sh", "-c", script], capture_output=True,
+                         env=env, timeout=40)
+    pid = int(out.stdout.strip())
+    assert port_file.read_text().strip(), "runner never booted — vacuous test"
+    # The shell (the runner's parent) has now exited; the watchdog must
+    # notice within its 5 s poll.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return  # exited with its parent, as required
+        time.sleep(0.5)
+    os.kill(pid, 9)  # cleanup before failing
+    raise AssertionError("orphaned runner kept running after parent death")
