@@ -15,6 +15,7 @@ Run: python capacity_probe.py [--runs 200] [--out CAPACITY_r04.json]
 
 import argparse
 import json
+import os
 import statistics
 import time
 import urllib.request
@@ -46,6 +47,9 @@ def main() -> None:
 
     # File-backed DB: the deployment shape (sqlite WAL + reader pool);
     # :memory: cannot use pooled readers (each connection is its own DB).
+    # With DSTACK_TPU_TEST_PG_DSN set, the probe instead measures the
+    # Postgres engine (pgwire pool) end to end.
+    pg_dsn = os.getenv("DSTACK_TPU_TEST_PG_DSN")
     db_file = tempfile.NamedTemporaryFile(suffix=".db", delete=False)
     # Agents are the NATIVE C++ runner: a capacity probe measures the
     # control plane driving N agents, and python-runner processes would
@@ -59,7 +63,7 @@ def main() -> None:
                    capture_output=True)
     runner_bin = str(native / "build" / "dstack-tpu-runner")
     srv = ProbeServer(
-        polling=False, db_path=db_file.name,
+        polling=False, db_path=pg_dsn or db_file.name,
         backend_config={"runner_binary": runner_bin},
     ).start()
     try:
@@ -117,6 +121,7 @@ def main() -> None:
             buckets[key] = buckets.get(key, 0) + 1
         out = {
             "runs": args.runs,
+            "engine": "postgres" if pg_dsn else "sqlite",
             "failed": len(failures),
             "submit_window_s": round(submit_window, 1),
             "all_done_s": round(max(v[0] for v in finished.values()), 1),

@@ -73,7 +73,9 @@ def create_app(
     app.state["tracer"] = ctx.tracer
 
     async def _startup() -> None:
-        if db.path != ":memory:":
+        # sqlite-file-only: with a postgres:// URL this would create a
+        # junk directory whose name embeds the DB password.
+        if isinstance(db, Database) and db.path != ":memory:":
             Path(db.path).parent.mkdir(parents=True, exist_ok=True)
         await db.connect()
         if not settings.MULTI_REPLICA and db.path != ":memory:":

@@ -28,6 +28,7 @@ reference's async-session-with-commit blocks.
 """
 
 import asyncio
+import re
 import sqlite3
 from pathlib import Path
 from typing import Any, Callable, Iterable, List, Optional, Sequence, TypeVar, Union
@@ -273,63 +274,173 @@ class Database:
 
 # Mechanical DDL translations for the shared migration scripts. Ordered:
 # the AUTOINCREMENT rewrite must run before any bare-INTEGER handling.
+# Word-boundary regexes: a future `realm` column or 'BLOB' string literal
+# must not be corrupted (the literal case is additionally protected by
+# the code/literal split in translate_ddl).
 _SQLITE_TO_PG = [
     # sqlite rowid-alias autoincrement -> identity column.
-    ("INTEGER PRIMARY KEY AUTOINCREMENT", "BIGSERIAL PRIMARY KEY"),
-    ("BLOB", "BYTEA"),
+    (re.compile(r"\bINTEGER PRIMARY KEY AUTOINCREMENT\b"), "BIGSERIAL PRIMARY KEY"),
+    (re.compile(r"\bBLOB\b"), "BYTEA"),
     # sqlite REAL is 8-byte; Postgres REAL is 4-byte and would truncate
     # epoch-seconds lease timestamps — promote to double precision.
-    ("REAL", "DOUBLE PRECISION"),
+    (re.compile(r"\bREAL\b"), "DOUBLE PRECISION"),
 ]
+
+# Split DDL into translatable code vs verbatim segments: single-quoted
+# literals (with '' escapes) and `--` line comments pass through untouched.
+_DDL_SEGMENTS = re.compile(r"('(?:[^']|'')*')|(--[^\n]*)", re.DOTALL)
 
 
 def translate_ddl(sql: str) -> str:
-    for a, b in _SQLITE_TO_PG:
-        sql = sql.replace(a, b)
-    return sql
+    def _code(segment: str) -> str:
+        for pat, repl in _SQLITE_TO_PG:
+            segment = pat.sub(repl, segment)
+        return segment
+
+    out: List[str] = []
+    pos = 0
+    for m in _DDL_SEGMENTS.finditer(sql):
+        out.append(_code(sql[pos:m.start()]))
+        out.append(m.group(0))
+        pos = m.end()
+    out.append(_code(sql[pos:]))
+    return "".join(out)
 
 
 # Advisory-lock key for migration serialization (any stable 64-bit int).
 _PG_MIGRATE_LOCK = 0x6473746B_74707531  # "dstk" "tpu1"
 
 
+def _is_conn_failure(exc: BaseException) -> bool:
+    """Connection-level failures: OS/socket errors (incl. operation
+    timeouts) and SQLSTATE class 08. The connection is discarded on any
+    of these."""
+    from dstack_tpu.server.pgwire import PgError
+
+    if isinstance(exc, PgError):
+        return exc.code.startswith("08")
+    return isinstance(exc, OSError)
+
+
+
+
+class _PgPool:
+    """Lazy fixed-cap pool of PgConnection.
+
+    Connections are created only when all existing ones are busy, so a
+    lightly-loaded replica holds one; under FSM fan-out the pool grows to
+    `size` genuinely concurrent wire connections (the reference gets the
+    same from asyncpg's pool). `release(broken=True)` discards instead of
+    re-pooling — the next acquire dials fresh, which is the reconnect
+    path after a dropped/partitioned server."""
+
+    def __init__(self, connect_kwargs: dict, size: int):
+        self._kwargs = connect_kwargs
+        self.size = size
+        self._idle: List[Any] = []
+        self._sem = asyncio.Semaphore(size)
+        self._mu = asyncio.Lock()
+        self._closed = False
+
+    async def acquire(self):
+        from dstack_tpu.server.pgwire import PgConnection
+
+        await self._sem.acquire()
+        try:
+            async with self._mu:
+                if self._idle:
+                    return self._idle.pop()
+            return await asyncio.to_thread(PgConnection, **self._kwargs)
+        except BaseException:
+            self._sem.release()
+            raise
+
+    async def release(self, conn, broken: bool = False) -> None:
+        try:
+            if broken or self._closed:
+                await asyncio.to_thread(conn.close)
+            else:
+                async with self._mu:
+                    if self._closed:
+                        await asyncio.to_thread(conn.close)
+                    else:
+                        self._idle.append(conn)
+        finally:
+            self._sem.release()
+
+    async def close(self) -> None:
+        async with self._mu:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            await asyncio.to_thread(conn.close)
+
+
 class PostgresDatabase:
     """The sqlite `Database` surface over pgwire, for multi-host control
-    planes. One connection guarded by the same asyncio-lock +
-    worker-thread pattern; replicas scale horizontally (each server
-    process holds one connection), and row-level claim safety comes from
-    the lease UPSERTs (services/locking.py), which Postgres executes
+    planes. A lazy connection pool (sized to the FSM concurrency knobs)
+    feeds the same worker-thread pattern; single statements retry once
+    through a fresh connection on connection-level failures, so a bounced
+    Postgres heals without a server restart. Row-level claim safety comes
+    from the lease UPSERTs (services/locking.py), which Postgres executes
     atomically under genuine concurrent writers."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, pool_size: Optional[int] = None):
+        from dstack_tpu.server import settings
         from dstack_tpu.server.pgwire import parse_dsn
 
         self.path = url  # keep the attribute name the server logs use
         self._dsn = parse_dsn(url)
-        self._conn = None
-        self._lock = asyncio.Lock()
-
-    @property
-    def conn(self):
-        assert self._conn is not None, "Database is not connected"
-        return self._conn
+        self._pool = _PgPool(
+            self._dsn, pool_size or settings.PG_POOL_SIZE
+        )
 
     async def connect(self) -> None:
-        from dstack_tpu.server.pgwire import PgConnection
-
-        self._conn = await asyncio.to_thread(PgConnection, **self._dsn)
+        # Dial one connection eagerly so a bad DSN fails at boot, then
+        # run migrations on it.
+        conn = await self._pool.acquire()
+        await self._pool.release(conn)
         await self.migrate()
 
     async def close(self) -> None:
-        if self._conn is not None:
-            conn = self._conn
-            self._conn = None
-            await asyncio.to_thread(conn.close)
+        await self._pool.close()
+
+    async def _with_conn(self, fn: Callable[[Any], T], retry: bool = False) -> T:
+        """`retry=True` is reserved for READS: once a write statement has
+        been sent, a timeout, reset, or EOF cannot distinguish
+        executed-then-died from never-executed, and replaying it could
+        double a non-idempotent write. A failed write therefore surfaces
+        (the FSM re-derives state on its next tick) — but the broken
+        connection is still discarded, so the pool heals and the NEXT
+        statement dials fresh (ADVICE r4: a dropped connection must not
+        permanently poison the adapter)."""
+        conn = await self._pool.acquire()
+        try:
+            result = await asyncio.to_thread(fn, conn)
+        except BaseException as e:
+            # Non-Exception BaseExceptions (task cancellation, interpreter
+            # shutdown) leave the worker thread still mid-statement on
+            # this connection — it must NEVER be re-pooled, another user
+            # would interleave wire frames with the orphaned thread.
+            broken = _is_conn_failure(e) or not isinstance(e, Exception)
+            await self._pool.release(conn, broken=broken)
+            if retry and isinstance(e, Exception) and broken:
+                # Reads are idempotent: one transparent retry on a fresh
+                # connection covers a restarted/failed-over Postgres.
+                return await self._with_conn(fn, retry=False)
+            raise
+        await self._pool.release(conn)
+        return result
 
     async def migrate(self) -> None:
         def _migrate(conn) -> None:
             # Serialize concurrent replica boots with an advisory lock —
             # the role the sidecar flock plays for the sqlite engine.
+            # The lock (and long DDL behind it) legitimately blocks
+            # server-side while another replica migrates: no operation
+            # timeout here, or rolling deploys crash-loop on any
+            # migration slower than it.
+            conn.settimeout(None)
             conn.execute("SELECT pg_advisory_lock(?)", (_PG_MIGRATE_LOCK,))
             try:
                 conn.executescript(
@@ -354,13 +465,14 @@ class PostgresDatabase:
                         raise
             finally:
                 conn.execute("SELECT pg_advisory_unlock(?)", (_PG_MIGRATE_LOCK,))
+                conn.settimeout(conn.operation_timeout)
 
-        async with self._lock:
-            await asyncio.to_thread(_migrate, self.conn)
+        await self._with_conn(_migrate, retry=False)
 
     async def downgrade(self, target_version: int) -> None:
         """Sqlite-engine `downgrade` parity over schema_migrations."""
         def _downgrade(conn) -> None:
+            conn.settimeout(None)  # see migrate(): lock waits are unbounded
             conn.execute("SELECT pg_advisory_lock(?)", (_PG_MIGRATE_LOCK,))
             try:
                 row = conn.execute(
@@ -391,34 +503,34 @@ class PostgresDatabase:
                         raise
             finally:
                 conn.execute("SELECT pg_advisory_unlock(?)", (_PG_MIGRATE_LOCK,))
+                conn.settimeout(conn.operation_timeout)
 
-        async with self._lock:
-            await asyncio.to_thread(_downgrade, self.conn)
+        await self._with_conn(_downgrade, retry=False)
 
     async def run_sync(self, fn: Callable[[Any], T]) -> T:
-        """Multi-statement callbacks get an explicit transaction."""
-        async with self._lock:
-            def _call() -> T:
-                self.conn.begin()
+        """Multi-statement callbacks get an explicit transaction. No
+        transparent retry: the callback may have non-idempotent Python
+        side effects, and a dropped connection already rolled the
+        transaction back server-side — the caller decides whether to
+        re-run."""
+        def _call(conn) -> T:
+            conn.begin()
+            try:
+                result = fn(conn)
+                conn.commit()
+                return result
+            except BaseException:
                 try:
-                    result = fn(self.conn)
-                    self.conn.commit()
-                    return result
-                except BaseException:
-                    self.conn.rollback()
-                    raise
+                    conn.rollback()
+                except Exception:
+                    pass  # connection-level failure: transaction is gone anyway
+                raise
 
-            return await asyncio.to_thread(_call)
-
-    async def _auto(self, fn: Callable[[Any], T]) -> T:
-        """Single statements ride Postgres autocommit: each is already
-        atomic, and BEGIN/COMMIT framing would triple the network round
-        trips on the FSM's hot path."""
-        async with self._lock:
-            return await asyncio.to_thread(fn, self.conn)
+        return await self._with_conn(_call, retry=False)
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
-        return await self._auto(lambda c: c.execute(sql, params).rowcount)
+        # Autocommit, no transparent retry: see _with_conn on write replay.
+        return await self._with_conn(lambda c: c.execute(sql, params).rowcount)
 
     async def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
         rows = list(rows)
@@ -427,7 +539,11 @@ class PostgresDatabase:
         await self.run_sync(lambda c: c.executemany(sql, rows))
 
     async def fetchone(self, sql: str, params: Sequence[Any] = ()):
-        return await self._auto(lambda c: c.execute(sql, params).fetchone())
+        return await self._with_conn(
+            lambda c: c.execute(sql, params).fetchone(), retry=True
+        )
 
     async def fetchall(self, sql: str, params: Sequence[Any] = ()):
-        return await self._auto(lambda c: c.execute(sql, params).fetchall())
+        return await self._with_conn(
+            lambda c: c.execute(sql, params).fetchall(), retry=True
+        )

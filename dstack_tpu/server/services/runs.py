@@ -367,7 +367,9 @@ async def list_runs(
         qs = ",".join(f"'{s.value}'" for s in RunStatus.finished_statuses())
         sql += f" AND status NOT IN ({qs})"
     sql += " ORDER BY submitted_at DESC LIMIT ?"
-    params.append(limit)
+    # Client-supplied: negative means unlimited on sqlite and errors on
+    # Postgres — clamp to a sane window either way.
+    params.append(max(1, min(int(limit), 1000)))
     rows = await ctx.db.fetchall(sql, params)
     return [await run_row_to_run(ctx, r) for r in rows]
 

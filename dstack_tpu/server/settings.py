@@ -31,6 +31,13 @@ MULTI_REPLICA = os.getenv("DSTACK_TPU_MULTI_REPLICA", "").lower() in ("1", "true
 MAX_CONCURRENT_JOB_STEPS = int(os.getenv("DSTACK_TPU_MAX_CONCURRENT_JOB_STEPS", "64"))
 MAX_CONCURRENT_PROVISIONS = int(os.getenv("DSTACK_TPU_MAX_CONCURRENT_PROVISIONS", "32"))
 
+# Postgres wire-connection pool per replica. Sized so FSM fan-out
+# (bounded by the knobs above) does not serialize into one connection,
+# without holding 64 server slots per replica; explicit override wins.
+PG_POOL_SIZE = int(os.getenv("DSTACK_TPU_PG_POOL_SIZE", "0")) or min(
+    16, max(4, MAX_CONCURRENT_JOB_STEPS // 4)
+)
+
 # FSM tick intervals, seconds (reference: 2-4s with jitter).
 PROCESS_RUNS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_RUNS_INTERVAL", "1.0"))
 PROCESS_JOBS_INTERVAL = float(os.getenv("DSTACK_TPU_PROCESS_JOBS_INTERVAL", "1.0"))
