@@ -153,8 +153,9 @@ async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
         "SELECT name FROM projects WHERE id = ?", (row["project_id"],)
     )
     rps = ctx.service_stats.get_rps(project["name"], row["run_name"])
+    rejected = ctx.service_stats.get_rejection_rps(project["name"], row["run_name"])
     last_scaled = parse_dt(row["last_scaled_at"]) if row["last_scaled_at"] else None
-    decision = scaler.scale(current, rps, utcnow(), last_scaled)
+    decision = scaler.scale(current, rps, utcnow(), last_scaled, rejected_rps=rejected)
     if decision.desired == current:
         return
     logger.info(

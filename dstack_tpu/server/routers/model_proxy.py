@@ -81,14 +81,38 @@ async def chat_completions(request: Request, project_name: str):
     match = next((m for m in models if m["name"] == model_name), None)
     if match is None:
         raise ResourceNotExistsError(f"Model {model_name} not found")
-    ctx.service_stats.record(project_name, match["run_name"])
     from dstack_tpu.server.routers.services_proxy import pick_replica
 
-    jpd, port = await pick_replica(ctx, project_name, match["run_name"])
+    try:
+        jpd, port = await pick_replica(ctx, project_name, match["run_name"])
+    except Exception:
+        # Demand against a service with no live replica still counts as
+        # RPS — it is exactly the scale-from-zero wake signal.
+        ctx.service_stats.record(project_name, match["run_name"])
+        raise
     base = f"http://{jpd.hostname}:{port}"
     if match["format"] == "tgi":
-        return await _tgi_chat(base, body)
-    return await _openai_passthrough(base + match["prefix"], body)
+        resp = await _tgi_chat(base, body)
+    else:
+        resp = await _openai_passthrough(base + match["prefix"], body)
+    if resp.status in (429, 503):
+        # Replica shed the request (serving-engine admission control).
+        # Count it ONLY as a rejection — the autoscaler folds shed
+        # demand back into RPS itself; counting it in both streams
+        # would double the scale-up pressure.
+        ctx.service_stats.record_rejection(project_name, match["run_name"])
+    else:
+        ctx.service_stats.record(project_name, match["run_name"])
+    return resp
+
+
+def _proxy_headers(upstream) -> Dict[str, str]:
+    """Headers an upstream error/response must keep through the proxy:
+    content-type, and the Retry-After backpressure hint on sheds."""
+    headers = {"content-type": upstream.headers.get("content-type", "application/json")}
+    if "retry-after" in upstream.headers:
+        headers["retry-after"] = upstream.headers["retry-after"]
+    return headers
 
 
 async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
@@ -102,7 +126,7 @@ async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
     return Response(
         upstream.content,
         status=upstream.status_code,
-        headers={"content-type": upstream.headers.get("content-type", "application/json")},
+        headers=_proxy_headers(upstream),
     )
 
 
@@ -127,7 +151,7 @@ async def _openai_stream(base: str, body: Dict[str, Any]) -> Response:
         return Response(
             content,
             status=upstream.status_code,
-            headers={"content-type": upstream.headers.get("content-type", "application/json")},
+            headers=_proxy_headers(upstream),
         )
 
     async def _gen():
@@ -178,7 +202,10 @@ async def _tgi_chat(base: str, body: Dict[str, Any]) -> Response:
     except httpx.HTTPError as e:
         return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
     if upstream.status_code != 200:
-        return Response(upstream.content, status=upstream.status_code)
+        return Response(
+            upstream.content, status=upstream.status_code,
+            headers=_proxy_headers(upstream),
+        )
     generated = upstream.json().get("generated_text", "")
     return Response(
         {

@@ -31,6 +31,7 @@ class ManualScaler:
         avg_rps: float,
         now: datetime,
         last_scaled_at: Optional[datetime],
+        rejected_rps: float = 0.0,
     ) -> ScalingDecision:
         desired = min(max(current, self.min_replicas), self.max_replicas)
         return ScalingDecision(desired=desired)
@@ -63,8 +64,13 @@ class RPSAutoscaler:
         avg_rps: float,
         now: datetime,
         last_scaled_at: Optional[datetime],
+        rejected_rps: float = 0.0,
     ) -> ScalingDecision:
-        desired = math.ceil(avg_rps / self.target) if self.target > 0 else current
+        # Shed requests (replica 429s under admission control) are demand
+        # the served-RPS counter never saw; fold them back in so overload
+        # creates scale-up pressure instead of being invisible.
+        demand = avg_rps + rejected_rps
+        desired = math.ceil(demand / self.target) if self.target > 0 else current
         desired = min(max(desired, self.min_replicas), self.max_replicas)
         if desired == current:
             return ScalingDecision(desired=current)

@@ -17,11 +17,26 @@ class ServiceStatsCollector:
     def __init__(self, window: float = WINDOW_SECONDS):
         self.window = window
         self._events: Dict[Tuple[str, str], Deque[Tuple[float, int]]] = defaultdict(deque)
+        # Overload sheds (replica answered 429/503): demand the RPS
+        # counter never saw because it was rejected — the autoscaler adds
+        # it back in so shed load still creates scale-up pressure.
+        self._rejections: Dict[Tuple[str, str], Deque[Tuple[float, int]]] = defaultdict(deque)
 
     def record(self, project_name: str, run_name: str, count: int = 1) -> None:
         key = (project_name, run_name)
         self._events[key].append((time.monotonic(), count))
         self._trim(key)
+
+    def record_rejection(self, project_name: str, run_name: str, count: int = 1) -> None:
+        key = (project_name, run_name)
+        self._rejections[key].append((time.monotonic(), count))
+        self._trim_q(self._rejections, key)
+
+    def get_rejection_rps(self, project_name: str, run_name: str) -> float:
+        key = (project_name, run_name)
+        self._trim_q(self._rejections, key)
+        total = sum(c for _, c in self._rejections.get(key, ()))
+        return total / self.window
 
     def ingest(
         self, project_name: str, run_name: str, requests: int, window: float = 0.0
@@ -45,8 +60,14 @@ class ServiceStatsCollector:
         return total / self.window
 
     def _trim(self, key: Tuple[str, str]) -> None:
+        self._trim_q(self._events, key)
+
+    def _trim_q(
+        self, store: Dict[Tuple[str, str], Deque[Tuple[float, int]]],
+        key: Tuple[str, str],
+    ) -> None:
         horizon = time.monotonic() - self.window
-        q = self._events.get(key)
+        q = store.get(key)
         if q is None:
             return
         while q and q[0][0] < horizon:

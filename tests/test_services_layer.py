@@ -64,6 +64,25 @@ def test_manual_scaler_noop():
     assert s.scale(3, 1000.0, utcnow(), None).desired == 3
 
 
+def test_rps_autoscaler_counts_shed_load():
+    """429s from replica admission control are demand the RPS counter
+    never saw — they must still create scale-up pressure."""
+    s = RPSAutoscaler(1, 10, target=5.0, scale_up_delay=0, scale_down_delay=0)
+    # Served RPS alone says 1 replica is fine; shed load says otherwise.
+    assert s.scale(1, 4.0, utcnow(), None).desired == 1
+    assert s.scale(1, 4.0, utcnow(), None, rejected_rps=12.0).desired == 4
+
+
+def test_stats_collector_rejections():
+    c = ServiceStatsCollector(window=60)
+    for _ in range(30):
+        c.record_rejection("p", "r")
+    assert c.get_rejection_rps("p", "r") == pytest.approx(0.5)
+    assert c.get_rejection_rps("p", "other") == 0.0
+    # rejections do not leak into served RPS
+    assert c.get_rps("p", "r") == 0.0
+
+
 def test_get_service_scaler_picks_impl():
     conf = ServiceConfiguration(
         name="svc", port=8000, commands=["serve"], replicas="1..4",
@@ -483,6 +502,7 @@ class _LoopbackTunnel:
         self.socket_path = socket_path
         self.target_port = target_port
         self._server = None
+        self._loop = None
 
     async def open(self, timeout=10.0):
         async def pipe(src, dst):
@@ -503,11 +523,28 @@ class _LoopbackTunnel:
             writer.close()
 
         self._server = await asyncio.start_unix_server(handle, path=self.socket_path)
+        self._loop = asyncio.get_running_loop()
 
     def close(self):
-        if self._server is not None:
-            self._server.close()
-            self._server = None
+        # The gateway calls tunnel.close() on a daemon thread (a real ssh
+        # tunnel's close blocks); an asyncio server object is not
+        # thread-safe and its loop may already be torn down by then —
+        # close the listening sockets directly instead.
+        srv, self._server = self._server, None
+        if srv is None:
+            return
+        # asyncio objects are not thread-safe: hop onto the owning loop.
+        # A closed loop means the test is over and its fds die with the
+        # process — closing them here from this thread would race fd
+        # reuse by a NEWER tunnel (observed: restart test's restored
+        # tunnel lost its listener).
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            loop.call_soon_threadsafe(srv.close)
+        except RuntimeError:
+            pass  # loop closed between the check and the call
 
 
 async def test_gateway_replica_tunnel_data_path(tmp_path):

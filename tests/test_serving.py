@@ -178,3 +178,48 @@ def test_bench_serving_harness_smoke(params, monkeypatch):
     assert out["streams"] == 3
     assert out["agg_tok_s"] > 0
     assert out["ttft_p95_ms"] >= out["ttft_p50_ms"] >= 0
+
+
+def test_admission_control_sheds_overflow(params):
+    """With max_pending bounded, submit() raises EngineOverloadedError
+    (with a Retry-After estimate) instead of queueing unboundedly; stats()
+    exposes the shed counter and queue depth for /metrics."""
+    from dstack_tpu.workloads.serving import EngineOverloadedError
+
+    engine = ServingEngine(CFG, params, slots=1, max_len=64, max_pending=1)
+    try:
+        qa = engine.submit([5, 7, 11], max_new_tokens=30)
+        # Wait until A is admitted to the lone slot (first token arrives),
+        # so B deterministically parks in pending.
+        first = qa.get(timeout=60)
+        assert isinstance(first, int)
+        qb = engine.submit([13, 17], max_new_tokens=30)
+        deadline = time.monotonic() + 60
+        # B may be briefly admitted if A finished... it can't: A has 30
+        # tokens to go at tiny-model speed; but allow a short settle for
+        # the pending queue to register.
+        while engine.stats()["pending"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(EngineOverloadedError) as e:
+            engine.submit([2, 3], max_new_tokens=30)
+        assert e.value.retry_after >= 1.0
+        s = engine.stats()
+        assert s["rejected_total"] == 1
+        assert s["max_pending"] == 1
+        # the accepted requests still complete correctly
+        rest_a = [first] + _drain(qa)
+        assert rest_a == _reference(params, [5, 7, 11], 30)
+        assert _drain(qb) == _reference(params, [13, 17], 30)
+    finally:
+        engine.close()
+
+
+def test_unbounded_engine_never_sheds(params):
+    engine = ServingEngine(CFG, params, slots=1, max_len=64)  # max_pending=None
+    try:
+        queues = [engine.submit([i + 2, i + 3], max_new_tokens=3) for i in range(6)]
+        for i, q in enumerate(queues):
+            assert _drain(q) == _reference(params, [i + 2, i + 3], 3)
+        assert engine.stats()["rejected_total"] == 0
+    finally:
+        engine.close()
