@@ -310,7 +310,15 @@ class ServingEngine:
             if self._stop:
                 raise RuntimeError("serving engine is closed")
             depth = self._pending.qsize()
-            if self.max_pending is not None and depth >= self.max_pending:
+            # Shed on the WAITING backlog, not raw queue depth: a request
+            # that will land in a currently-free slot is not overload
+            # (and max_pending=0 then means "serve, never queue" instead
+            # of bricking an idle engine). _live is mutated by the loop
+            # thread without this lock; a slightly stale free count only
+            # shifts the shed boundary by one request.
+            free = sum(r is None for r in self._live)
+            backlog = depth - free
+            if self.max_pending is not None and backlog >= self.max_pending:
                 self.rejected += 1
                 raise EngineOverloadedError(depth, self._retry_after(depth))
             self._pending.put(_Request(list(tokens), max_new_tokens, out))

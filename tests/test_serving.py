@@ -180,6 +180,19 @@ def test_bench_serving_harness_smoke(params, monkeypatch):
     assert out["ttft_p95_ms"] >= out["ttft_p50_ms"] >= 0
 
 
+def _slow_decode(engine, delay):
+    """Throttle the engine's decode chunks so slot occupancy is stable
+    while a test asserts on admission behavior (real decode on the tiny
+    model retires slots in milliseconds)."""
+    orig = engine._step
+
+    def slow(params, state, rng):
+        time.sleep(delay)
+        return orig(params, state, rng)
+
+    engine._step = slow
+
+
 def test_admission_control_sheds_overflow(params):
     """With max_pending bounded, submit() raises EngineOverloadedError
     (with a Retry-After estimate) instead of queueing unboundedly; stats()
@@ -187,17 +200,19 @@ def test_admission_control_sheds_overflow(params):
     from dstack_tpu.workloads.serving import EngineOverloadedError
 
     engine = ServingEngine(CFG, params, slots=1, max_len=64, max_pending=1)
+    _slow_decode(engine, 0.25)  # hold slot occupancy across the asserts
     try:
         qa = engine.submit([5, 7, 11], max_new_tokens=30)
-        # Wait until A is admitted to the lone slot (first token arrives),
-        # so B deterministically parks in pending.
+        # Wait until A OCCUPIES the lone slot (first token arrives before
+        # the jitted insert finishes compiling, so poll stats), ensuring B
+        # deterministically parks in pending.
         first = qa.get(timeout=60)
         assert isinstance(first, int)
+        deadline = time.monotonic() + 60
+        while engine.stats()["active"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
         qb = engine.submit([13, 17], max_new_tokens=30)
         deadline = time.monotonic() + 60
-        # B may be briefly admitted if A finished... it can't: A has 30
-        # tokens to go at tiny-model speed; but allow a short settle for
-        # the pending queue to register.
         while engine.stats()["pending"] < 1 and time.monotonic() < deadline:
             time.sleep(0.01)
         with pytest.raises(EngineOverloadedError) as e:
@@ -221,5 +236,35 @@ def test_unbounded_engine_never_sheds(params):
         for i, q in enumerate(queues):
             assert _drain(q) == _reference(params, [i + 2, i + 3], 3)
         assert engine.stats()["rejected_total"] == 0
+    finally:
+        engine.close()
+
+
+def test_max_pending_zero_serves_but_never_queues(params):
+    """Admission counts FREE SLOTS: max_pending=0 means 'no waiting', not
+    'reject everything' — an idle engine must still serve up to `slots`
+    concurrent requests."""
+    from dstack_tpu.workloads.serving import EngineOverloadedError
+
+    engine = ServingEngine(CFG, params, slots=2, max_len=64, max_pending=0)
+    _slow_decode(engine, 0.25)  # hold both slots live across the asserts
+    try:
+        qa = engine.submit([5, 7, 11], max_new_tokens=20)
+        qb = engine.submit([13, 17], max_new_tokens=20)
+        # both admitted (2 free slots); once both are live, a third must shed
+        assert isinstance(qa.get(timeout=60), int)
+        assert isinstance(qb.get(timeout=60), int)
+        deadline = time.monotonic() + 60
+        while engine.stats()["active"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(EngineOverloadedError):
+            engine.submit([2, 3], max_new_tokens=20)
+        # after both retire, capacity is free again
+        _drain(qa), _drain(qb)
+        deadline = time.monotonic() + 60
+        while engine.stats()["active"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        qc = engine.submit([2, 3], max_new_tokens=3)
+        assert _drain(qc) == _reference(params, [2, 3], 3)
     finally:
         engine.close()
