@@ -56,6 +56,12 @@ class LocalBackendConfig(CoreModel):
     # task to the shim, the shim spawns the runner — the exact chain real
     # hosts use, minus docker.
     shim_binary: Optional[str] = None
+    # Production semantics for restart drills: real hosts are remote
+    # machines whose agents SURVIVE a server crash/restart. When true,
+    # skip the PDEATHSIG/--parent-pid death-link so local agents model
+    # that (the restart-reconciliation test depends on it). Default off:
+    # abruptly-killed dev servers must not leak agent processes.
+    detach_agents: bool = False
 
     @model_validator(mode="after")
     def _shim_needs_runner(self):
@@ -167,11 +173,12 @@ class LocalCompute(Compute):
                 argv = [
                     sys.executable, "-S", "-m", "dstack_tpu.agents.runner",
                     "--host", "127.0.0.1", "--port", "0", "--port-file", port_file,
+                ]
+                if not self.config.detach_agents:
                     # Belt-and-braces with PDEATHSIG below: the explicit
                     # pid makes the watchdog race-free even if the parent
                     # dies during interpreter startup.
-                    "--parent-pid", str(os.getpid()),
-                ]
+                    argv += ["--parent-pid", str(os.getpid())]
             proc = subprocess.Popen(
                 argv,
                 stdout=subprocess.DEVNULL,
@@ -186,8 +193,11 @@ class LocalCompute(Compute):
                 # die with it — abruptly-killed servers (tests, probes)
                 # otherwise leave agent processes around forever (observed:
                 # hundreds, hours old). PDEATHSIG covers every spawn branch
-                # (python, C++ runner, shim) and survives exec.
-                preexec_fn=_exit_with_parent_preexec,
+                # (python, C++ runner, shim) and survives exec — unless
+                # detach_agents models production hosts that outlive the
+                # server (restart-reconciliation drill).
+                preexec_fn=(None if self.config.detach_agents
+                            else _exit_with_parent_preexec),
             )
             instance_id = f"local-{proc.pid}"
             self._procs[instance_id] = proc

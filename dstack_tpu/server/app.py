@@ -77,6 +77,11 @@ def create_app(
         # junk directory whose name embeds the DB password.
         if isinstance(db, Database) and db.path != ":memory:":
             Path(db.path).parent.mkdir(parents=True, exist_ok=True)
+        # Malformed env-provided backend config must fail the boot with a
+        # clear message, not 500 every later request.
+        from dstack_tpu.server.services.backends import env_local_backend_config
+
+        env_local_backend_config()
         await db.connect()
         if not settings.MULTI_REPLICA and db.path != ":memory:":
             # Cross-replica lease writes are opt-in (they cost two DB

@@ -21,6 +21,40 @@ def local_backend_enabled() -> bool:
     return os.getenv("DSTACK_TPU_LOCAL_BACKEND", "1") != "0"
 
 
+_env_local_conf: Optional[Dict[str, Any]] = None
+
+
+def env_local_backend_config() -> Dict[str, Any]:
+    """DSTACK_TPU_LOCAL_BACKEND_CONFIG (JSON), parsed and validated once.
+
+    The knob exists for subprocess servers (restart drills, probes) that
+    cannot reach ctx.overrides. Called at app startup so a malformed
+    value fails the BOOT with a clear message, not every later request;
+    applying it is logged because an ambient export changes agent
+    lifetime semantics (detach_agents)."""
+    global _env_local_conf
+    if _env_local_conf is None:
+        raw = os.getenv("DSTACK_TPU_LOCAL_BACKEND_CONFIG", "")
+        if not raw:
+            _env_local_conf = {}
+        else:
+            try:
+                conf = json.loads(raw)
+                LocalBackendConfig.model_validate(conf)
+            except Exception as e:
+                raise ValueError(
+                    f"invalid DSTACK_TPU_LOCAL_BACKEND_CONFIG {raw!r}: {e}"
+                ) from e
+            import logging
+
+            logging.getLogger(__name__).info(
+                "local backend configured from DSTACK_TPU_LOCAL_BACKEND_CONFIG: %s",
+                raw,
+            )
+            _env_local_conf = conf
+    return _env_local_conf
+
+
 def _make_compute(backend_type: BackendType, config: Dict[str, Any]) -> Compute:
     if backend_type == BackendType.LOCAL:
         return LocalCompute(LocalBackendConfig.model_validate(config))
@@ -95,9 +129,10 @@ async def list_project_backends(
     if local_backend_enabled():
         key = (project_id, BackendType.LOCAL.value)
         if key not in ctx.backends:
-            ctx.backends[key] = _make_compute(
-                BackendType.LOCAL, ctx.overrides.get("local_backend_config", {})
-            )
+            conf = ctx.overrides.get("local_backend_config")
+            if conf is None:
+                conf = env_local_backend_config()
+            ctx.backends[key] = _make_compute(BackendType.LOCAL, conf)
         if all(t != BackendType.LOCAL for t, _ in out):
             out.append((BackendType.LOCAL, ctx.backends[key]))
     return out

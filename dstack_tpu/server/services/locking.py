@@ -9,7 +9,7 @@ on sqlite the asyncio locksets are authoritative.
 import asyncio
 import time
 from contextlib import asynccontextmanager
-from typing import AsyncIterator, Dict, Iterable, List, Set, Tuple
+from typing import AsyncIterator, Dict, Iterable, List, Optional, Set, Tuple
 
 
 class ResourceLocker:
@@ -77,11 +77,19 @@ class ClaimLocker:
     the exact pre-multi-replica behavior.
     """
 
-    def __init__(self, db, replica_id: str, local: ResourceLocker, ttl: float = 120.0):
+    def __init__(self, db, replica_id: str, local: ResourceLocker,
+                 ttl: Optional[float] = None):
+        import os
+
         self._db = db
         self.replica_id = replica_id
         self._local = local
-        self.ttl = ttl
+        # TTL bounds how long a SIGKILLed replica's claims block the
+        # survivors; env-tunable so restart drills (and latency-sensitive
+        # deployments) can trade takeover speed against renewal traffic.
+        self.ttl = ttl if ttl is not None else float(
+            os.getenv("DSTACK_TPU_LEASE_TTL", "120")
+        )
         self._held: Set[Tuple[str, str]] = set()
 
     @property
