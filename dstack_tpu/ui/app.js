@@ -41,7 +41,12 @@ function table(headers, rows, rowAttrs) {
     : `<tr><td colspan="${headers.length}" class="muted">Nothing here yet.</td></tr>`;
   return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>`;
 }
-function stopLogFollow() { state.logGen++; state.metricsGen = (state.metricsGen || 0) + 1; if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; } }
+function stopLogFollow() {
+  state.logGen++;
+  state.metricsGen = (state.metricsGen || 0) + 1;
+  if (state.logTimer) { clearTimeout(state.logTimer); state.logTimer = null; }
+  if (state.logWs) { try { state.logWs.close(); } catch (e) {} state.logWs = null; }
+}
 
 /* ---- views ---------------------------------------------------------- */
 
@@ -96,11 +101,13 @@ const views = {
         <div>IDE</div><div><a href="vscode://vscode-remote/ssh-remote+${esc(state.runName)}/workflow">Open in VS Code</a>
           <span class="muted">(after \`dstack-tpu attach ${esc(state.runName)}\`)</span></div>` : ""}
       </div>
+      <details class="section-details"><summary class="section">Run spec (as submitted + merged profile)</summary>
+        <pre class="spec">${esc(toYaml(run.run_spec || {}))}</pre></details>
       <div class="section">Submission timeline</div>
       ${table(["#", "Job", "Status", "Submitted", "Finished", "Reason"], timelineRows(jobs))}
       <div class="section">Jobs</div>
       ${table(["Job", "Status", "Instance", "Host", "Worker", "Reason", "Submission"], jobRows)}
-      <div class="section">Host metrics <span class="muted">(10s samples; sparklines: last ~7 min)</span></div>
+      <div class="section">Host metrics <span class="muted">(10s samples; charts: full retained window, up to 1h)</span></div>
       <div id="metrics-box"><span class="muted">Loading…</span></div>
       <div class="section">Logs <span class="muted" id="log-state">(following)</span></div>
       <pre class="logs" id="log-box"></pre>`;
@@ -184,11 +191,78 @@ const views = {
     const models = (out && out.data) || [];
     // Endpoint shape (routers/model_proxy.py): {id, object, created, owned_by}
     // where owned_by carries the serving run's name.
-    return { title: "Models", html: table(
+    const html = table(
       ["Model", "Run"],
       models.map((m) => [esc(m.id), esc(m.owned_by || "—")])
     ) + `<p class="muted">OpenAI-compatible endpoint:
-      <code>/proxy/models/${esc(state.project)}/chat/completions</code></p>` };
+      <code>/proxy/models/${esc(state.project)}/chat/completions</code></p>` +
+    (models.length ? `
+      <div class="section">Playground</div>
+      <div class="playground">
+        <div class="toolbar">
+          <select id="pg-model">${models.map((m) => `<option>${esc(m.id)}</option>`).join("")}</select>
+          <input id="pg-max-tokens" type="number" value="128" min="1" title="max_tokens">
+          <button class="action" id="pg-send">Send</button>
+        </div>
+        <textarea id="pg-prompt" rows="3" placeholder="Say something to the model…"></textarea>
+        <pre class="logs" id="pg-out"></pre>
+      </div>` : "");
+    return { title: "Models", html, after() {
+      const send = $("#pg-send");
+      if (!send) return;
+      send.onclick = async () => {
+        const out = $("#pg-out");
+        out.textContent = "";
+        send.disabled = true;
+        try {
+          // Streamed chat completion through the model proxy's SSE relay
+          // (the exact endpoint external OpenAI SDKs hit).
+          const resp = await fetch(`/proxy/models/${state.project}/chat/completions`, {
+            method: "POST",
+            headers: { "Authorization": "Bearer " + state.token, "Content-Type": "application/json" },
+            body: JSON.stringify({
+              model: $("#pg-model").value,
+              max_tokens: Number($("#pg-max-tokens").value) || 128,
+              stream: true,
+              messages: [{ role: "user", content: $("#pg-prompt").value }],
+            }),
+          });
+          if (resp.status === 429) {
+            const ra = resp.headers.get("retry-after");
+            out.textContent = `model overloaded — retry in ${ra || "a few"} s`;
+            return;
+          }
+          if (!resp.ok) { out.textContent = `error ${resp.status}: ${await resp.text()}`; return; }
+          const reader = resp.body.getReader();
+          const dec = new TextDecoder();
+          let buf = "";
+          for (;;) {
+            const { value, done } = await reader.read();
+            if (done) break;
+            buf += dec.decode(value, { stream: true });
+            // SSE framing: events separated by a blank line, each line
+            // prefixed `data: `; [DONE] terminates.
+            let idx;
+            while ((idx = buf.indexOf("\n\n")) >= 0) {
+              const event = buf.slice(0, idx); buf = buf.slice(idx + 2);
+              for (const line of event.split("\n")) {
+                if (!line.startsWith("data:")) continue;
+                const data = line.slice(5).trim();
+                if (data === "[DONE]") continue;
+                try {
+                  const delta = JSON.parse(data).choices?.[0]?.delta?.content;
+                  if (delta) { out.textContent += delta; out.scrollTop = out.scrollHeight; }
+                } catch (e) { /* partial frame: wait for more bytes */ }
+              }
+            }
+          }
+        } catch (e) {
+          out.textContent += `\n[stream error: ${e.message}]`;
+        } finally {
+          send.disabled = false;
+        }
+      };
+    } };
   },
 
   async admin() {
@@ -197,10 +271,12 @@ const views = {
     const usernames = (users || []).map((u) => u.username);
     const html = `
       <div class="section">Users</div>
-      ${table(["Username", "Role", "Email", "Active", ""],
+      <div id="token-banner"></div>
+      ${table(["Username", "Role", "Email", "Active", "Token", ""],
         (users || []).map((u) => [
           esc(u.username), pill(u.global_role), esc(u.email || "—"),
           esc(u.active === false ? "no" : "yes"),
+          `<button class="action" data-rotate-token="${esc(u.username)}">rotate</button>`,
           `<button class="action danger" data-del-user="${esc(u.username)}">remove</button>`,
         ]))}
       <div class="toolbar">
@@ -257,6 +333,22 @@ const views = {
         b.onclick = act(async () => {
           await api("/api/users/delete", { users: [b.dataset.delUser] });
         });
+      });
+      document.querySelectorAll("[data-rotate-token]").forEach((b) => {
+        // NOT wrapped in act(): the new token must be shown (once), not
+        // wiped by an immediate re-render.
+        b.onclick = async () => {
+          try {
+            const u = await api("/api/users/refresh_token", { username: b.dataset.rotateToken });
+            const tok = u && u.creds && u.creds.token;
+            $("#token-banner").innerHTML = `<p class="ok-banner">New token for
+              <b>${esc(b.dataset.rotateToken)}</b>: <code>${esc(tok || "?")}</code>
+              — copy it now; it is not shown again.</p>`;
+          } catch (e) {
+            if (e instanceof AuthError) return showLogin();
+            $("#token-banner").innerHTML = `<p class="error">${esc(e.message)}</p>`;
+          }
+        };
       });
       const membersOf = (name) => {
         const p = (projects || []).find((q) => (q.project_name || q.name) === name);
@@ -326,16 +418,78 @@ function timelineRows(jobs) {
   return rows.map((r) => r.slice(0, 6));
 }
 
-function sparkline(values, max) {
-  /* Inline SVG, no dependencies. `values` oldest-first; y scaled to max. */
-  const vals = values.filter((v) => v != null);
-  if (vals.length < 2) return `<span class="muted">—</span>`;
-  const w = 120, h = 22, top = Math.max(max || 0, ...vals, 1e-9);
-  const pts = vals.map((v, i) =>
-    `${(i / (vals.length - 1) * w).toFixed(1)},${(h - v / top * (h - 2)).toFixed(1)}`
-  ).join(" ");
-  return `<svg class="spark" width="${w}" height="${h}" viewBox="0 0 ${w} ${h}">` +
-    `<polyline fill="none" stroke="currentColor" stroke-width="1.5" points="${pts}"/></svg>`;
+function chart(points, opts) {
+  /* Real time-axis chart (inline SVG, no dependencies): gridlines, y-axis
+   * labels, HH:MM ticks over the full metrics window. `points` is
+   * [{t: epoch_ms, v: number|null}] oldest-first; gaps (null v) break the
+   * line instead of interpolating across missing samples. */
+  const o = Object.assign({ w: 300, h: 84, max: 0, fmt: (v) => v.toFixed(0) }, opts || {});
+  const pts = points.filter((p) => p.v != null && p.t != null);
+  if (pts.length < 2) return `<span class="muted">not enough samples yet</span>`;
+  const padL = 34, padB = 14, padT = 4, padR = 4;
+  const iw = o.w - padL - padR, ih = o.h - padT - padB;
+  const t0 = pts[0].t, t1 = pts[pts.length - 1].t || t0 + 1;
+  const top = Math.max(o.max || 0, ...pts.map((p) => p.v), 1e-9);
+  const X = (t) => padL + (t - t0) / Math.max(t1 - t0, 1) * iw;
+  const Y = (v) => padT + (1 - v / top) * ih;
+  // polyline segments: break where the source series had a null
+  const segs = [];
+  let cur = [];
+  for (const p of points) {
+    if (p.v == null) { if (cur.length > 1) segs.push(cur); cur = []; continue; }
+    cur.push(`${X(p.t).toFixed(1)},${Y(p.v).toFixed(1)}`);
+  }
+  if (cur.length > 1) segs.push(cur);
+  const lines = segs.map((s) =>
+    `<polyline fill="none" stroke="currentColor" stroke-width="1.5" points="${s.join(" ")}"/>`).join("");
+  // x ticks: ~4 time labels; y: 0 / mid / top gridlines
+  const ticks = [];
+  for (let i = 0; i <= 3; i++) {
+    const t = t0 + (t1 - t0) * i / 3;
+    const d = new Date(t);
+    const lbl = `${String(d.getHours()).padStart(2, "0")}:${String(d.getMinutes()).padStart(2, "0")}`;
+    ticks.push(`<text x="${X(t).toFixed(1)}" y="${o.h - 2}" class="tick" text-anchor="middle">${lbl}</text>`);
+  }
+  const grid = [0.5, 1].map((f) =>
+    `<line x1="${padL}" y1="${Y(top * f).toFixed(1)}" x2="${o.w - padR}" y2="${Y(top * f).toFixed(1)}" class="grid"/>` +
+    `<text x="${padL - 3}" y="${(Y(top * f) + 3).toFixed(1)}" class="tick" text-anchor="end">${esc(o.fmt(top * f))}</text>`
+  ).join("");
+  const base = `<line x1="${padL}" y1="${Y(0)}" x2="${o.w - padR}" y2="${Y(0)}" class="axis"/>`;
+  return `<svg class="chart" width="${o.w}" height="${o.h}" viewBox="0 0 ${o.w} ${o.h}">` +
+    grid + base + lines + ticks.join("") + `</svg>`;
+}
+
+function toYaml(obj, indent) {
+  /* Minimal JSON -> YAML for the run-spec view (strings that could read as
+   * other YAML types get quoted; nothing fancier than the spec needs). */
+  const pad = "  ".repeat(indent || 0);
+  const scalar = (v) => {
+    if (v === null || v === undefined) return "null";
+    if (typeof v === "number" || typeof v === "boolean") return String(v);
+    const s = String(v);
+    return /^[A-Za-z0-9_][A-Za-z0-9_\-./ ]*$/.test(s) &&
+      !/^(true|false|null|yes|no|on|off|~|[0-9.+-].*)$/i.test(s)
+      ? s : JSON.stringify(s);
+  };
+  if (Array.isArray(obj)) {
+    if (!obj.length) return pad + "[]";
+    return obj.map((v) =>
+      typeof v === "object" && v !== null
+        ? pad + "-\n" + toYaml(v, (indent || 0) + 1)
+        : pad + "- " + scalar(v)
+    ).join("\n");
+  }
+  if (typeof obj === "object" && obj !== null) {
+    const keys = Object.keys(obj).filter((k) => obj[k] !== null && obj[k] !== undefined);
+    if (!keys.length) return pad + "{}";
+    return keys.map((k) => {
+      const v = obj[k];
+      if (typeof v === "object" && v !== null && Object.keys(v).length)
+        return pad + k + ":\n" + toYaml(v, (indent || 0) + 1);
+      return pad + k + ": " + (typeof v === "object" ? (Array.isArray(v) ? "[]" : "{}") : scalar(v));
+    }).join("\n");
+  }
+  return pad + scalar(obj);
 }
 
 function fmtBytes(n) {
@@ -373,39 +527,57 @@ function followMetrics() {
       state.sparkTick = (state.sparkTick || 0) + 1;
       let histories = state.sparkCache;
       if (!histories || state.sparkTick % 2 === 1) {
+        // Full metrics window (server TTL is 1h of 10s samples = 360
+        // points), not a 40-point keyhole: the charts below carry a real
+        // time axis, so the whole history is the point.
         histories = await Promise.all(hosts.map((h) =>
-          api(`/api/project/${state.project}/metrics/job/${encodeURIComponent(state.runName)}?replica_num=${h.replica_num}&job_num=${h.job_num}&limit=40`)
+          api(`/api/project/${state.project}/metrics/job/${encodeURIComponent(state.runName)}?replica_num=${h.replica_num}&job_num=${h.job_num}&limit=360`)
             .then((m) => (m.points || []).reverse())  // oldest first
             .catch(() => [])
         ));
         state.sparkCache = histories;
       }
       if (myGen !== state.metricsGen || !$("#metrics-box")) return;
-      const rows = hosts.map((h, i) => {
-        const pts = histories[i];
-        const duty = pts.map((p) => {
-          const ds = (p.tpu_chips || []).map((c) => c.duty_cycle_pct).filter((d) => d != null);
-          return ds.length ? ds.reduce((a, b) => a + b, 0) / ds.length : null;
-        });
-        const hbm = pts.map((p) => {
-          const us = (p.tpu_chips || []).map((c) => c.hbm_used_bytes).filter((u) => u != null);
-          return us.length ? us.reduce((a, b) => a + b, 0) : null;
-        });
-        return [
-          esc(`${h.replica_num}/${h.job_num}`),
-          esc(h.cpu_percent != null ? h.cpu_percent.toFixed(0) + "%" : "—"),
-          esc(fmtBytes(h.memory_usage_bytes)),
-          esc(String(h.tpu_chips ?? 0)),
-          esc(h.tpu_duty_cycle_percent != null ? h.tpu_duty_cycle_percent.toFixed(0) + "%" : "—"),
-          sparkline(duty, 100),
-          esc(h.tpu_hbm_usage_bytes != null
-            ? `${fmtBytes(h.tpu_hbm_usage_bytes)}${h.tpu_hbm_total_bytes ? " / " + fmtBytes(h.tpu_hbm_total_bytes) : ""}`
-            : "—"),
-          sparkline(hbm, h.tpu_hbm_total_bytes || 0),
-        ];
+      const series = (pts, f) => pts.map((p) => ({ t: Date.parse(p.timestamp), v: f(p) }));
+      // cpu_usage_micro is cumulative CPU time: chart its derivative
+      // (µs of CPU per µs of wall = fraction of one core, as percent).
+      const cpuSeries = (pts) => pts.map((p, i) => {
+        if (!i) return { t: Date.parse(p.timestamp), v: null };
+        const dt = Date.parse(p.timestamp) - Date.parse(pts[i - 1].timestamp);
+        const du = (p.cpu_usage_micro || 0) - (pts[i - 1].cpu_usage_micro || 0);
+        return { t: Date.parse(p.timestamp), v: dt > 0 && du >= 0 ? du / (dt * 1000) * 100 : null };
       });
+      const dutyOf = (p) => {
+        const ds = (p.tpu_chips || []).map((c) => c.duty_cycle_pct).filter((d) => d != null);
+        return ds.length ? ds.reduce((a, b) => a + b, 0) / ds.length : null;
+      };
+      const hbmOf = (p) => {
+        const us = (p.tpu_chips || []).map((c) => c.hbm_used_bytes).filter((u) => u != null);
+        return us.length ? us.reduce((a, b) => a + b, 0) : null;
+      };
+      const rows = hosts.map((h, i) => [
+        esc(`${h.replica_num}/${h.job_num}`),
+        esc(h.cpu_percent != null ? h.cpu_percent.toFixed(0) + "%" : "—"),
+        esc(fmtBytes(h.memory_usage_bytes)),
+        esc(String(h.tpu_chips ?? 0)),
+        esc(h.tpu_duty_cycle_percent != null ? h.tpu_duty_cycle_percent.toFixed(0) + "%" : "—"),
+        esc(h.tpu_hbm_usage_bytes != null
+          ? `${fmtBytes(h.tpu_hbm_usage_bytes)}${h.tpu_hbm_total_bytes ? " / " + fmtBytes(h.tpu_hbm_total_bytes) : ""}`
+          : "—"),
+      ]);
+      const charts = hosts.map((h, i) => {
+        const pts = histories[i];
+        return `<div class="chartrow"><div class="chartlabel">${esc(`${h.replica_num}/${h.job_num}`)}</div>
+          <figure><figcaption>TPU duty cycle</figcaption>
+            ${chart(series(pts, dutyOf), { max: 100, fmt: (v) => v.toFixed(0) + "%" })}</figure>
+          <figure><figcaption>HBM used</figcaption>
+            ${chart(series(pts, hbmOf), { max: h.tpu_hbm_total_bytes || 0, fmt: fmtBytes })}</figure>
+          <figure><figcaption>Host CPU</figcaption>
+            ${chart(cpuSeries(pts), { max: 100, fmt: (v) => v.toFixed(0) + "%" })}</figure>
+        </div>`;
+      }).join("");
       $("#metrics-box").innerHTML = table(
-        ["Replica/Job", "CPU", "Memory", "Chips", "TPU util", "Util history", "HBM", "HBM history"], rows);
+        ["Replica/Job", "CPU", "Memory", "Chips", "TPU util", "HBM"], rows) + charts;
       rendered = true;
     } catch (e) {
       if (e instanceof AuthError) return showLogin();
@@ -429,7 +601,56 @@ function followLogs(run) {
   // One streaming decoder for the whole follow: per-event decoding would
   // corrupt multi-byte UTF-8 split across log-chunk boundaries.
   const dec = new TextDecoder("utf-8");
-  const tick = async () => {
+
+  const append = (bytes) => {
+    const box = $("#log-box");
+    if (!box) return false;
+    box.textContent += dec.decode(bytes, { stream: true });
+    box.scrollTop = box.scrollHeight;
+    return true;
+  };
+
+  // Primary transport: the server's websocket follow (push, no poll
+  // latency floor). Binary frames are raw log bytes; text frames are
+  // cursor checkpoints so a fallback/resume never duplicates output.
+  const wsProto = location.protocol === "https:" ? "wss:" : "ws:";
+  const wsUrl = `${wsProto}//${location.host}/api/project/${state.project}` +
+    `/logs/ws/${encodeURIComponent(state.runName)}/${encodeURIComponent(submissionId)}` +
+    `?token=${encodeURIComponent(state.token)}` +
+    (cursor ? `&start_after=${encodeURIComponent(cursor)}` : "");
+  let ws;
+  try { ws = new WebSocket(wsUrl); } catch (e) { ws = null; }
+  if (ws) {
+    ws.binaryType = "arraybuffer";
+    state.logWs = ws;
+    let gotData = false;
+    ws.onmessage = (ev) => {
+      if (myGen !== state.logGen) { ws.close(); return; }
+      if (typeof ev.data === "string") {
+        // checkpoint frame: {"next_token": cursor} — lets poll resume
+        // after a transport drop without duplicating output
+        try { cursor = JSON.parse(ev.data).next_token || cursor; } catch (e) {}
+        return;
+      }
+      gotData = true;
+      if (!append(new Uint8Array(ev.data))) ws.close();
+    };
+    ws.onclose = () => {
+      if (myGen !== state.logGen) return;
+      if (!$("#log-box")) return;
+      // A close can mean "job finished, tail drained" OR a proxy
+      // idle-timeout / network blip mid-run — the socket cannot tell us
+      // which. Continue on the poll transport from the checkpoint: a
+      // finished job just yields empty polls, a live one keeps flowing.
+      const stateEl = $("#log-state");
+      if (stateEl) stateEl.textContent = gotData ? "(following via poll)" : "(following via poll — ws unavailable)";
+      pollTick();
+    };
+    if ($("#log-state")) $("#log-state").textContent = "(following, live)";
+    return;
+  }
+
+  const pollTick = async () => {
     try {
       const out = await api(`/api/project/${state.project}/logs/poll`,
         { run_name: state.runName, job_submission_id: submissionId, start_after: cursor || null });
@@ -437,11 +658,10 @@ function followLogs(run) {
       const box = $("#log-box");
       if (!box) return; // view changed
       for (const ev of out.logs || []) {
-        box.textContent += dec.decode(Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)), { stream: true });
+        append(Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)));
       }
-      if ((out.logs || []).length) box.scrollTop = box.scrollHeight;
       cursor = out.next_token || cursor;
-      state.logTimer = setTimeout(tick, 1500);
+      state.logTimer = setTimeout(pollTick, 1500);
     } catch (e) {
       if (e instanceof AuthError) return showLogin();
       if (myGen !== state.logGen) return;
@@ -449,7 +669,7 @@ function followLogs(run) {
       if (stateEl) stateEl.textContent = "(log polling stopped: " + e.message + ")";
     }
   };
-  tick();
+  pollTick();
 }
 
 /* ---- shell ---------------------------------------------------------- */

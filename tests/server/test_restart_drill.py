@@ -235,7 +235,22 @@ def test_kill9_restart_readopts_running_gang(tmp_path):
             if proc is not None and proc.poll() is None:
                 proc.kill()
         # detach_agents means runners do NOT die with the server; reap any
-        # stragglers so the test leaks nothing.
+        # stragglers so the test leaks nothing. Harvest pids from the DB
+        # too — a failure before the happy-path read above would otherwise
+        # leak every agent already provisioned.
+        if not agent_pids and db_path.exists():
+            try:
+                with _db(db_path) as conn:
+                    agent_pids = [
+                        int(json.loads(r["job_provisioning_data"])["instance_id"]
+                            .rsplit("-", 1)[1])
+                        for r in conn.execute(
+                            "SELECT job_provisioning_data FROM instances"
+                        )
+                        if r["job_provisioning_data"]
+                    ]
+            except Exception:
+                pass
         for pid in agent_pids:
             try:
                 os.kill(pid, signal.SIGKILL)
