@@ -105,12 +105,22 @@ def get_shim_commands(
     authorized_key: str,
     agent_download_url: str = "",
     tpu: bool = True,
+    prepull_images: Optional[List[str]] = None,
 ) -> List[str]:
     """Instance bootstrap: install + launch the shim host agent.
 
     Parity: base/compute.py:220-309 (`get_shim_commands`/`get_user_data`);
     the reference threads `--pjrt-device=TPU` here (:303-309), we default
     TPU-on.
+
+    `prepull_images` starts `docker pull` for each image in the
+    BACKGROUND, concurrent with the shim download and with the server's
+    create->IP->ssh-up polling: by the time the first job submission
+    reaches the shim, the common base image's layers are warm (or the
+    pull is already partway), cutting the submit->running stage of the
+    cold-start budget (docs/guides/multihost.md). Failures are
+    best-effort by design — the shim's own pull at task-submit time is
+    the authoritative one.
     """
     cmds = [
         "mkdir -p /root/.ssh && chmod 700 /root/.ssh",
@@ -118,6 +128,12 @@ def get_shim_commands(
         "chmod 600 /root/.ssh/authorized_keys",
         "mkdir -p /usr/local/bin /var/lib/dstack-tpu",
     ]
+    for image in prepull_images or []:
+        # append (>>): concurrent pulls share the log; O_TRUNC would
+        # clobber each other's output at debug time
+        cmds.append(
+            f"nohup docker pull {image} >>/var/log/dstack-prepull.log 2>&1 &"
+        )
     if agent_download_url:
         cmds += [
             f"curl -fsSL {agent_download_url}/dstack-tpu-shim -o /usr/local/bin/dstack-tpu-shim",

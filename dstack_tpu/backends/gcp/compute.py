@@ -32,6 +32,7 @@ from dstack_tpu.backends.gcp.api import (
 from dstack_tpu.errors import BackendError, ComputeError
 from dstack_tpu.models.backends import BackendType
 from dstack_tpu.models.common import CoreModel
+from dstack_tpu.models.configurations import DEFAULT_IMAGE
 from dstack_tpu.models.gateways import (
     GatewayComputeConfiguration,
     GatewayProvisioningData,
@@ -63,6 +64,11 @@ class GCPBackendConfig(CoreModel):
     queued_provisioning: bool = False  # route all creates via queuedResources
     reservation: Optional[str] = None
     access_token: Optional[str] = None  # mainly for tests/short-lived auth
+    # Images `docker pull`ed in the startup script CONCURRENT with shim
+    # install and the server's boot->ssh polling, so the common base
+    # image's layers are warm before the first submission arrives (see the
+    # cold-start budget, docs/guides/multihost.md).
+    prepull_images: List[str] = [DEFAULT_IMAGE]
 
     @field_validator("regions")
     @classmethod
@@ -244,6 +250,7 @@ class GCPCompute(Compute):
             subnetwork=self.config.subnetwork,
             agent_download_url=self.config.agent_download_url,
             reservation=self.config.reservation,
+            prepull_images=self.config.prepull_images,
         )
         parent = res.tpu_parent(self.config.project_id, zone)
         queued = self.config.queued_provisioning

@@ -22,13 +22,22 @@ def tpu_parent(project_id: str, zone: str) -> str:
     return f"projects/{project_id}/locations/{zone}"
 
 
-def startup_script(authorized_key: str, agent_download_url: str = "") -> str:
-    """TPU-VM startup script: bootstrap the shim host agent.
+def startup_script(
+    authorized_key: str,
+    agent_download_url: str = "",
+    prepull_images: Optional[List[str]] = None,
+) -> str:
+    """TPU-VM startup script: bootstrap the shim host agent, with base
+    images pre-pulled in the background (cold-start budget stage 3 —
+    docs/guides/multihost.md).
 
     Parity: gcp/compute.py:773-779 (TPU startup script = shim commands with
     `--pjrt-device=TPU` threaded via base/compute.py:303-309).
     """
-    commands = "\n".join(get_shim_commands(authorized_key, agent_download_url, tpu=True))
+    commands = "\n".join(get_shim_commands(
+        authorized_key, agent_download_url, tpu=True,
+        prepull_images=prepull_images,
+    ))
     return f"#!/bin/bash\n{commands}\n"
 
 
@@ -46,6 +55,7 @@ def tpu_node_body(
     data_disks: Optional[List[str]] = None,
     reservation: Optional[str] = None,
     env: Optional[Dict[str, str]] = None,
+    prepull_images: Optional[List[str]] = None,
 ) -> Dict[str, Any]:
     """Body for tpu.projects.locations.nodes.create.
 
@@ -61,7 +71,9 @@ def tpu_node_body(
             "enableExternalIps": True,
         },
         "metadata": {
-            "startup-script": startup_script(authorized_key, agent_download_url),
+            "startup-script": startup_script(
+                authorized_key, agent_download_url, prepull_images
+            ),
         },
         "labels": {
             f"{LABEL_PREFIX}-project": project_name,

@@ -26,6 +26,9 @@ from dstack_tpu.models.services import AnyModel, BaseChatModel, parse_model
 from dstack_tpu.models.volumes import MountPoint, VolumeConfiguration, parse_mount_points
 
 SERVICE_HTTPS_DEFAULT = True
+# Base image when a run sets only `python` (or nothing): the single source
+# jobs configurators AND backend prepull defaults share.
+DEFAULT_IMAGE = "python:3.12-slim"
 STRIP_PREFIX_DEFAULT = True
 
 

@@ -27,13 +27,13 @@ from dstack_tpu.models.runs import (
     Retry,
     RunSpec,
 )
+from dstack_tpu.models.configurations import DEFAULT_IMAGE
 from dstack_tpu.models.topology import TpuTopology
 from dstack_tpu.models.volumes import VolumeMountPoint
 from dstack_tpu.server.services.offers import requirements_from_profile
 from dstack_tpu.utils.interpolator import InterpolatorError, interpolate
 
 DEFAULT_MAX_DURATION_TASK = None  # off by default (parity: profiles "off")
-DEFAULT_IMAGE = "python:3.12-slim"  # base image when only `python` is set
 
 
 def get_default_image(python_version: Optional[str]) -> str:

@@ -602,6 +602,11 @@ function followLogs(run) {
   // corrupt multi-byte UTF-8 split across log-chunk boundaries.
   const dec = new TextDecoder("utf-8");
 
+  // Bytes rendered SINCE the last checkpoint frame: the server only
+  // checkpoints per drain batch, so a poll resume from `cursor` resends
+  // exactly this many already-rendered bytes — skip them.
+  let sinceCheckpoint = 0;
+
   const append = (bytes) => {
     const box = $("#log-box");
     if (!box) return false;
@@ -609,6 +614,36 @@ function followLogs(run) {
     box.scrollTop = box.scrollHeight;
     return true;
   };
+
+  // Poll transport: the fallback (and the only transport when ws cannot
+  // even construct). Hoisted function declaration — ws.onclose fires
+  // after the early return below and must still reach it.
+  async function pollTick() {
+    try {
+      const out = await api(`/api/project/${state.project}/logs/poll`,
+        { run_name: state.runName, job_submission_id: submissionId, start_after: cursor || null });
+      if (myGen !== state.logGen) return; // navigated away mid-request
+      const box = $("#log-box");
+      if (!box) return; // view changed
+      for (const ev of out.logs || []) {
+        let bytes = Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0));
+        if (sinceCheckpoint > 0) {  // drop the ws-rendered overlap
+          const skip = Math.min(sinceCheckpoint, bytes.length);
+          sinceCheckpoint -= skip;
+          bytes = bytes.subarray(skip);
+          if (!bytes.length) continue;
+        }
+        append(bytes);
+      }
+      cursor = out.next_token || cursor;
+      state.logTimer = setTimeout(pollTick, 1500);
+    } catch (e) {
+      if (e instanceof AuthError) return showLogin();
+      if (myGen !== state.logGen) return;
+      const stateEl = $("#log-state");
+      if (stateEl) stateEl.textContent = "(log polling stopped: " + e.message + ")";
+    }
+  }
 
   // Primary transport: the server's websocket follow (push, no poll
   // latency floor). Binary frames are raw log bytes; text frames are
@@ -629,10 +664,12 @@ function followLogs(run) {
       if (typeof ev.data === "string") {
         // checkpoint frame: {"next_token": cursor} — lets poll resume
         // after a transport drop without duplicating output
-        try { cursor = JSON.parse(ev.data).next_token || cursor; } catch (e) {}
+        try { cursor = JSON.parse(ev.data).next_token || cursor; } catch (e) { return; }
+        sinceCheckpoint = 0;
         return;
       }
       gotData = true;
+      sinceCheckpoint += ev.data.byteLength;
       if (!append(new Uint8Array(ev.data))) ws.close();
     };
     ws.onclose = () => {
@@ -650,25 +687,6 @@ function followLogs(run) {
     return;
   }
 
-  const pollTick = async () => {
-    try {
-      const out = await api(`/api/project/${state.project}/logs/poll`,
-        { run_name: state.runName, job_submission_id: submissionId, start_after: cursor || null });
-      if (myGen !== state.logGen) return; // navigated away mid-request
-      const box = $("#log-box");
-      if (!box) return; // view changed
-      for (const ev of out.logs || []) {
-        append(Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)));
-      }
-      cursor = out.next_token || cursor;
-      state.logTimer = setTimeout(pollTick, 1500);
-    } catch (e) {
-      if (e instanceof AuthError) return showLogin();
-      if (myGen !== state.logGen) return;
-      const stateEl = $("#log-state");
-      if (stateEl) stateEl.textContent = "(log polling stopped: " + e.message + ")";
-    }
-  };
   pollTick();
 }
 

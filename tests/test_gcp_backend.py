@@ -385,3 +385,41 @@ def test_tpu_offer_rejects_malformed_zone():
 def test_backend_config_rejects_malformed_region():
     with pytest.raises(ValueError, match="malformed GCP region"):
         GCPBackendConfig(project_id="p", regions=["us-central1-a"])  # a zone
+
+
+def test_startup_script_prepulls_images_in_background():
+    """Cold-start budget stage 3: the startup script must start pulling
+    the configured base images BEFORE (and concurrent with) the shim
+    install, in the background, so a failed registry never blocks boot."""
+    from dstack_tpu.backends.gcp import resources as res
+
+    script = res.startup_script(
+        "ssh-rsa KEY", "https://dl.example.com",
+        prepull_images=["python:3.12-slim", "my/base:tpu"],
+    )
+    lines = script.splitlines()
+    pulls = [i for i, l in enumerate(lines) if "docker pull" in l]
+    shim = next(i for i, l in enumerate(lines) if "dstack-tpu-shim -o" in l)
+    launch = next(i for i, l in enumerate(lines) if "nohup /usr/local/bin/dstack-tpu-shim" in l)
+    assert len(pulls) == 2
+    assert all(i < shim < launch for i in pulls), lines
+    assert all(lines[i].startswith("nohup ") and lines[i].endswith("&") for i in pulls)
+    # default config carries the default job image
+    from dstack_tpu.backends.gcp.compute import GCPBackendConfig
+    from dstack_tpu.server.services.jobs import DEFAULT_IMAGE
+
+    assert GCPBackendConfig(project_id="p").prepull_images == [DEFAULT_IMAGE]
+
+
+async def test_run_job_body_carries_prepull():
+    api = FakeGcpApi()
+    compute = GCPCompute(
+        GCPBackendConfig(project_id="p", regions=["us-west4"],
+                         prepull_images=["base:tpu"]),
+        api=api,
+    )
+    offers = await compute.get_offers(tpu_req())
+    offer = next(o for o in offers if o.instance.resources.tpu)
+    await compute.run_job("proj", "run", offer, "ssh-rsa K", "inst-1")
+    create = next(b for m, u, b in api.requests if m == "POST" and b and "metadata" in b)
+    assert "docker pull base:tpu" in create["metadata"]["startup-script"]
