@@ -28,6 +28,16 @@ TRAIN_SCRIPT = """
 import os, sys, time
 vol = sys.argv[1]
 import jax
+# The drill exercises orchestration (preempt -> gang resubmit -> volume
+# -> Orbax resume), not the accelerator: pin the tiny model to CPU so a
+# busy/unreachable dev chip cannot wedge the run (sitecustomize pins the
+# platform before this script runs, hence config.update + clear).
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend as _jb
+    _jb.clear_backends()
+except Exception:
+    pass
 from dstack_tpu.workloads.config import PRESETS
 from dstack_tpu.workloads.train import (
     init_train_state, make_train_step, synthetic_batch,
