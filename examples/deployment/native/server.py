@@ -247,6 +247,10 @@ def main() -> None:
                 "usage": {},
             })
 
+    # Accept backlog deeper than BaseServer's 5: bursts must reach
+    # admission control and get a 429 + Retry-After, not a kernel-level
+    # connection refusal that clients cannot distinguish from an outage.
+    ThreadingHTTPServer.request_queue_size = 64
     server = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"native model server: {args.model_name} on :{args.port}", flush=True)
     server.serve_forever()

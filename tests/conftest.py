@@ -25,3 +25,13 @@ def pytest_pyfunc_call(pyfuncitem):
         asyncio.run(func(**kwargs))
         return True
     return None
+
+
+def free_port() -> int:
+    """Kernel-assigned free TCP port (shared by the subprocess-server
+    tests; bind-to-0 keeps the pick race as narrow as it can be)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -23,7 +23,6 @@ on boot; here the agent keeps its own state and the server re-polls.
 import json
 import os
 import signal
-import socket
 import sqlite3
 import subprocess
 import sys
@@ -38,10 +37,7 @@ REPO = Path(__file__).resolve().parents[2]
 TOKEN = "drill-admin-token"
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests.conftest import free_port as _free_port
 
 
 def _api(port, path, body=None, timeout=5):
