@@ -307,22 +307,48 @@ class Registry:
         self.nginx.apply(self._site(self.services[key]))
 
 
-def parse_access_log_window(
+def parse_access_log(
     lines: List[str], domains_to_service: Dict[str, str]
-) -> Dict[str, int]:
-    """Count requests per service from access-log lines.
+) -> "tuple[Dict[str, int], Dict[str, int]]":
+    """One pass over access-log lines -> (requests, rejections) per
+    service — the same window by construction.
 
     Lines are in the `dstack` log_format emitted by nginx.render_site
-    (`$host $remote_addr [$time_local] "$request" $status $body_bytes_sent`),
-    so the first space-separated field is the service domain.
+    (`$host $remote_addr [$time_local] "$request" $status $body_bytes_sent`):
+    the first field is the service domain; the `$status` field is the
+    first token after the quoted `$request` (a request path can carry
+    quotes only %XX-encoded, so rpartition on the LAST quote is exact).
+    Rejections (429/503) are replica admission-control sheds riding
+    through nginx — the server feeds them to the autoscaler as demand
+    pressure, distinct from served RPS.
     """
     counts: Dict[str, int] = {}
+    rejections: Dict[str, int] = {}
     for line in lines:
         host, _, _ = line.partition(" ")
         service = domains_to_service.get(host)
-        if service is not None:
-            counts[service] = counts.get(service, 0) + 1
-    return counts
+        if service is None:
+            continue
+        counts[service] = counts.get(service, 0) + 1
+        _, _, tail = line.rpartition('"')
+        fields = tail.split()
+        if fields and fields[0] in ("429", "503"):
+            rejections[service] = rejections.get(service, 0) + 1
+    return counts, rejections
+
+
+def parse_access_log_window(
+    lines: List[str], domains_to_service: Dict[str, str]
+) -> Dict[str, int]:
+    """Requests-only view (kept for callers that don't need sheds)."""
+    return parse_access_log(lines, domains_to_service)[0]
+
+
+def parse_access_log_rejections(
+    lines: List[str], domains_to_service: Dict[str, str]
+) -> Dict[str, int]:
+    """Rejections-only view of parse_access_log."""
+    return parse_access_log(lines, domains_to_service)[1]
 
 
 def create_gateway_app(registry: Optional[Registry] = None) -> App:
@@ -394,7 +420,14 @@ def create_gateway_app(registry: Optional[Registry] = None) -> App:
         domains = {
             info["domain"]: key for key, info in reg.services.items()
         }
-        return {"window_requests": parse_access_log_window(lines, domains), "ts": time.time()}
+        requests, rejections = parse_access_log(lines, domains)
+        return {
+            "window_requests": requests,
+            # sheds are reported separately: the server counts them as
+            # rejection pressure for the autoscaler, NOT as served RPS
+            "window_rejections": rejections,
+            "ts": time.time(),
+        }
 
     @router.get("/auth")
     async def auth(request: Request):

@@ -54,9 +54,19 @@ async def _poll_gateway_stats(ctx: ServerContext) -> None:
         except Exception as e:
             logger.debug("gateway %s stats poll failed: %s", host, e)
             continue
+        rejections = stats.get("window_rejections") or {}
         for service_key, count in (stats.get("window_requests") or {}).items():
             project_name, _, run_name = service_key.partition("/")
-            ctx.service_stats.ingest(project_name, run_name, int(count), window=0.0)
+            # Sheds (429/503 through nginx) are rejection PRESSURE, not
+            # served RPS — the autoscaler folds them back into demand
+            # itself; counting them in both streams would double the
+            # scale-up signal (same split the in-server proxy makes).
+            shed = int(rejections.get(service_key, 0))
+            served = max(int(count) - shed, 0)
+            if served:
+                ctx.service_stats.ingest(project_name, run_name, served, window=0.0)
+            if shed:
+                ctx.service_stats.record_rejection(project_name, run_name, shed)
 
 
 async def _http_gateway_stats(gateway: dict) -> dict:

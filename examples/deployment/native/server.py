@@ -247,11 +247,14 @@ def main() -> None:
                 "usage": {},
             })
 
-    # Accept backlog deeper than BaseServer's 5: bursts must reach
-    # admission control and get a 429 + Retry-After, not a kernel-level
-    # connection refusal that clients cannot distinguish from an outage.
-    ThreadingHTTPServer.request_queue_size = 64
-    server = ThreadingHTTPServer(("0.0.0.0", args.port), Handler)
+    class ModelHTTPServer(ThreadingHTTPServer):
+        # Accept backlog deeper than BaseServer's 5: bursts must reach
+        # admission control and get a 429 + Retry-After, not a
+        # kernel-level connection refusal indistinguishable from an
+        # outage. Subclassed so the stdlib class is not mutated.
+        request_queue_size = 64
+
+    server = ModelHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"native model server: {args.model_name} on :{args.port}", flush=True)
     server.serve_forever()
 

@@ -428,14 +428,19 @@ async def test_gateway_stats_feed_autoscaler():
 
         async def fake_stats(host):
             polled_hosts.append(host)
-            return {"window_requests": {"main/llama-svc": 42}}
+            # 42 log lines of which 12 were admission-control sheds
+            return {"window_requests": {"main/llama-svc": 42},
+                    "window_rejections": {"main/llama-svc": 12}}
 
         ctx.overrides["gateway_stats_client"] = fake_stats
         from dstack_tpu.server.background.tasks.process_gateways import process_gateways
 
         await process_gateways(ctx)
         assert polled_hosts == ["10.9.9.9"]
-        assert ctx.service_stats.get_rps("main", "llama-svc") > 0
+        # served = total - shed; shed feeds the rejection stream (the
+        # autoscaler folds it back into demand — not double-counted)
+        assert ctx.service_stats.get_rps("main", "llama-svc") == pytest.approx(30 / 60)
+        assert ctx.service_stats.get_rejection_rps("main", "llama-svc") == pytest.approx(12 / 60)
     finally:
         await fx.app.shutdown()
 
@@ -465,6 +470,18 @@ def test_nginx_log_format_matches_stats_parser(tmp_path):
     ]
     counts = parse_access_log_window(lines, {"svc.example.com": "main/svc"})
     assert counts == {"main/svc": 2}
+
+    # Shed detection reads $status — the token after the LAST quote, so a
+    # %XX-encoded request path cannot confuse it.
+    from dstack_tpu.gateway.app import parse_access_log_rejections
+
+    shed_lines = lines + [
+        'svc.example.com 203.0.113.9 [12/Jul/2026:10:01:05 +0000] "POST /v1/chat/completions HTTP/1.1" 429 84\n',
+        'svc.example.com 203.0.113.9 [12/Jul/2026:10:01:06 +0000] "GET /%22quoted%22 HTTP/1.1" 503 0\n',
+        'other.example.com 198.51.100.4 [12/Jul/2026:10:01:07 +0000] "GET / HTTP/1.1" 429 0\n',
+    ]
+    rejects = parse_access_log_rejections(shed_lines, {"svc.example.com": "main/svc"})
+    assert rejects == {"main/svc": 2}
 
 
 async def test_gateway_stats_offset_resets_on_rotation(tmp_path, monkeypatch):
