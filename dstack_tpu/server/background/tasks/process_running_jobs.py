@@ -348,6 +348,24 @@ async def _submit_to_runner(
         except InterpolatorError as e:
             await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
             return
+        # Persistent XLA compilation cache on the first NETWORK volume:
+        # repeat runs skip the first-compile wall (cold-start budget
+        # stage 5, docs/guides/multihost.md) because the cache outlives
+        # the container AND the instance — an instance mount would die
+        # with the VM, silently re-paying the compile on re-provision.
+        # User-set value always wins; without a volume there is nowhere
+        # durable to put it.
+        if "JAX_COMPILATION_CACHE_DIR" not in env:
+            from dstack_tpu.models.volumes import VolumeMountPoint
+
+            durable = next(
+                (m for m in job_spec.volumes
+                 if isinstance(m, VolumeMountPoint)), None,
+            )
+            if durable is not None:
+                env["JAX_COMPILATION_CACHE_DIR"] = (
+                    durable.path.rstrip("/") + "/.jax-compile-cache"
+                )
         job_spec = job_spec.model_copy(update={"env": env})
         try:
             code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)

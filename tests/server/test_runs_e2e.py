@@ -426,3 +426,50 @@ async def test_missing_secret_fails_run_with_message():
         assert "does_not_exist" in (sub["termination_reason_message"] or "")
     finally:
         await fx.app.shutdown()
+
+
+async def test_volume_run_gets_compile_cache_env(tmp_path):
+    """A run with a mounted volume is handed a persistent XLA compile
+    cache on it (cold-start budget stage 5); a user-set value wins."""
+    fx = await make_server()
+    try:
+        resp = await fx.client.post(
+            "/api/project/main/volumes/create",
+            json_body={"configuration": {
+                "type": "volume", "name": "cache-vol", "backend": "local",
+                "region": "local", "size": "1GB",
+            }},
+        )
+        assert resp.status == 200, resp.body
+
+        mnt = None  # set below; expect values are the FULL env value
+        for run_name, env, expect in (
+            ("cc-default", None, None),  # -> <mnt>/.jax-compile-cache
+            ("cc-custom", {"JAX_COMPILATION_CACHE_DIR": "/custom/cache"},
+             "/custom/cache"),
+        ):
+            body = _task_body(
+                ["echo cache=$JAX_COMPILATION_CACHE_DIR"], run_name, env=env
+            )
+            mnt = tmp_path / "mnt"
+            body["run_spec"]["configuration"]["volumes"] = [
+                {"name": "cache-vol", "path": str(mnt)}
+            ]
+            resp = await fx.client.post(
+                "/api/project/main/runs/submit", json_body=body
+            )
+            assert resp.status == 200, resp.body
+            run = await _wait_run(fx, run_name, {"done", "failed"}, timeout=60)
+            assert run["status"] == "done", run
+            sub = run["jobs"][0]["job_submissions"][-1]
+            resp = await fx.client.post(
+                "/api/project/main/logs/poll",
+                json_body={"run_name": run_name, "job_submission_id": sub["id"]},
+            )
+            text = b"".join(
+                base64.b64decode(e["message"])
+                for e in response_json(resp)["logs"]
+            ).decode()
+            assert f"cache={expect or f'{mnt}/.jax-compile-cache'}" in text, text
+    finally:
+        await fx.app.shutdown()
