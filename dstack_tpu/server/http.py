@@ -570,6 +570,8 @@ class Server:
 class TestClient:
     """In-process client: drives `App.handle` directly (no sockets needed)."""
 
+    __test__ = False  # not a pytest collection target despite the name
+
     def __init__(self, app: App, token: Optional[str] = None):
         self.app = app
         self.token = token

@@ -22,7 +22,6 @@ async def _boot(config_path):
     return app, client
 
 
-@pytest.mark.asyncio
 async def test_config_file_creates_projects_and_backends(tmp_path):
     config = {
         "projects": [
@@ -57,7 +56,6 @@ async def test_config_file_creates_projects_and_backends(tmp_path):
         await app.shutdown()
 
 
-@pytest.mark.asyncio
 async def test_config_encryption_key_applied(tmp_path):
     key = Encryption.generate_key_b64()
     path = tmp_path / "config.yml"
@@ -74,7 +72,6 @@ async def test_config_encryption_key_applied(tmp_path):
         await app.shutdown()
 
 
-@pytest.mark.asyncio
 async def test_missing_config_is_fine(tmp_path):
     app, client = await _boot(tmp_path / "does-not-exist.yml")
     try:
@@ -84,7 +81,6 @@ async def test_missing_config_is_fine(tmp_path):
         await app.shutdown()
 
 
-@pytest.mark.asyncio
 async def test_broken_backend_does_not_block_boot(tmp_path):
     path = tmp_path / "config.yml"
     path.write_text(yaml.safe_dump({
@@ -105,7 +101,6 @@ async def test_broken_backend_does_not_block_boot(tmp_path):
         await app.shutdown()
 
 
-@pytest.mark.asyncio
 async def test_sync_writes_template(tmp_path):
     """Persistent boots regenerate the file; hand-written entries survive."""
     path = tmp_path / "config.yml"
