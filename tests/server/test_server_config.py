@@ -3,7 +3,6 @@ applied at startup, encryption key installed before first write, file
 regenerated as a template on persistent boots.
 """
 
-import pytest
 import yaml
 
 from dstack_tpu.server.app import create_app
