@@ -800,3 +800,40 @@ async def test_gateway_registry_survives_restart(tmp_path):
     assert "replica.sock" not in state.read_text()
     r2.connections.close_all()
     replica_srv.close()
+
+
+async def test_service_run_scales_up_on_shed_pressure():
+    """A saturated service whose SERVED rps sits below target must still
+    scale up when replicas are shedding 429s — the r5 overload signal
+    flowing end to end through _maybe_autoscale."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_service_run(fx, "shed-svc", None, 8000)
+        row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        spec = json.loads(row["run_spec"])
+        spec["configuration"]["replicas"] = "1..4"
+        spec["configuration"]["scaling"] = {"metric": "rps", "target": 1,
+                                            "scale_up_delay": "0s",
+                                            "scale_down_delay": "0s"}
+        from dstack_tpu.models.runs import RunSpec
+
+        await ctx.db.execute(
+            "UPDATE runs SET run_spec = ? WHERE id = ?",
+            (RunSpec.model_validate(spec).model_dump_json(), run_id),
+        )
+        # Served traffic alone would NOT scale: 0.5 rps < target 1.
+        for _ in range(30):
+            ctx.service_stats.record("main", "shed-svc")
+        # But the replica is shedding hard: 1.5 rps rejected.
+        for _ in range(90):
+            ctx.service_stats.record_rejection("main", "shed-svc")
+
+        from dstack_tpu.server.background.tasks.process_runs import process_runs
+
+        await process_runs(ctx)
+        run = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        # demand = 0.5 served + 1.5 shed = 2 rps -> 2 replicas
+        assert run["desired_replica_count"] == 2
+    finally:
+        await fx.app.shutdown()
