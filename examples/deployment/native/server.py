@@ -95,17 +95,26 @@ class Engine:
     def decode(self, ids) -> str:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
-    def chat_stream(self, messages):
+    def chat_stream(self, messages, max_tokens=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
-        UTF-8 is decoded incrementally so multi-byte characters split
-        across tokens reassemble instead of degrading to U+FFFD."""
+        `max_tokens` is the per-request OpenAI field, clamped to the
+        server's --max-new-tokens cap (the cap also bounds the KV rows a
+        request can occupy). UTF-8 is decoded incrementally so
+        multi-byte characters split across tokens reassemble instead of
+        degrading to U+FFFD."""
+        budget = self.max_new_tokens
+        if max_tokens is not None:
+            try:
+                budget = max(1, min(int(max_tokens), self.max_new_tokens))
+            except (TypeError, ValueError):
+                pass  # malformed client value: serve with the server cap
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
         )
         tokens = self.encode(prompt + "\nassistant:")
         out = self.serving.submit(
-            [int(t) for t in tokens[0]], max_new_tokens=self.max_new_tokens
+            [int(t) for t in tokens[0]], max_new_tokens=budget
         )
         dec = codecs.getincrementaldecoder("utf-8")("replace")
         while True:
@@ -121,8 +130,8 @@ class Engine:
             if piece:
                 yield piece
 
-    def chat(self, messages) -> str:
-        return "".join(self.chat_stream(messages))
+    def chat(self, messages, max_tokens=None) -> str:
+        return "".join(self.chat_stream(messages, max_tokens))
 
 
 def main() -> None:
@@ -170,7 +179,9 @@ def main() -> None:
             # submit-time errors surface as a clean JSON 500 instead of a
             # second status line spliced into the event stream.
             try:
-                pieces = engine.chat_stream(req.get("messages", []))
+                pieces = engine.chat_stream(
+                    req.get("messages", []), req.get("max_tokens")
+                )
                 first = next(pieces)
             except StopIteration:
                 first = ""
@@ -229,7 +240,7 @@ def main() -> None:
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if req.get("stream"):
                     return self._stream(req)
-                text = engine.chat(req.get("messages", []))
+                text = engine.chat(req.get("messages", []), req.get("max_tokens"))
             except EngineOverloadedError as e:
                 return self._send_overloaded(e)
             except Exception as e:  # surface engine errors as API errors

@@ -34,12 +34,15 @@ def _post(port, body, timeout=120):
     return urllib.request.urlopen(req, timeout=timeout)
 
 
-def test_native_server_sheds_with_retry_after(tmp_path):
+def _boot_server(tmp_path, *flags):
+    """Start the example model server (CPU-pinned) and wait for /v1/models.
+    Returns (proc, log_handle, port); raises with the log tail if the
+    process dies or never binds."""
     port = free_port()
     env = {
         **os.environ,
         # CPU-pinned regardless of what accelerator plumbing the host
-        # has: this test is about the HTTP/admission surface. Stripping
+        # has: these tests are about the HTTP surface. Stripping
         # PYTHONPATH drops any sitecustomize that would pin a platform
         # before the env var can take effect.
         "PYTHONPATH": str(REPO),
@@ -48,27 +51,34 @@ def test_native_server_sheds_with_retry_after(tmp_path):
     log = open(tmp_path / "server.log", "ab")
     proc = subprocess.Popen(
         [sys.executable, str(SERVER), "--preset", "tiny", "--port", str(port),
-         "--max-new-tokens", "16", "--max-pending", "0"],
+         *flags],
         stdout=log, stderr=subprocess.STDOUT, env=env,
     )
-    try:
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    "server died: "
-                    + (tmp_path / "server.log").read_bytes().decode()[-2000:]
-                )
-            try:
-                urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/v1/models", timeout=2
-                )
-                break
-            except (urllib.error.URLError, ConnectionError, OSError):
-                time.sleep(0.5)
-        else:
-            raise AssertionError("server never came up")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "server died: "
+                + (tmp_path / "server.log").read_bytes().decode()[-2000:]
+            )
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models", timeout=2
+            )
+            return proc, log, port
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.5)
+    raise AssertionError(
+        "server never came up: "
+        + (tmp_path / "server.log").read_bytes().decode()[-2000:]
+    )
 
+
+def test_native_server_sheds_with_retry_after(tmp_path):
+    proc, log, port = _boot_server(
+        tmp_path, "--max-new-tokens", "16", "--max-pending", "0"
+    )
+    try:
         body = {"messages": [{"role": "user", "content": "hello there"}]}
         # idle engine with max_pending=0 must SERVE (free slots count)
         resp = _post(port, body)
@@ -114,6 +124,30 @@ def test_native_server_sheds_with_retry_after(tmp_path):
         assert m["rejected_total"] == counts[429]
         assert m["max_pending"] == 0 and m["slots"] == 8
         assert m["slot_turn_seconds_ewma"] > 0
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
+
+
+def test_native_server_honors_max_tokens(tmp_path):
+    """The OpenAI `max_tokens` field bounds the generation per request,
+    clamped to the server's --max-new-tokens cap."""
+    proc, log, port = _boot_server(tmp_path, "--max-new-tokens", "32")
+    try:
+        def chat(extra):
+            r = _post(port, {"messages": [{"role": "user", "content": "hi"}],
+                             **extra})
+            return json.load(r)["choices"][0]["message"]["content"]
+
+        # The toy tokenizer is byte-level: generated bytes ~= tokens, so
+        # a 3-token budget must come back far shorter than the 32 cap.
+        short = chat({"max_tokens": 3})
+        capped = chat({"max_tokens": 10_000})  # clamped to server cap
+        default = chat({})
+        assert len(short.encode()) <= 3 * 4  # <=3 tokens (utf-8 replacement slack)
+        assert len(capped.encode()) <= 32 * 4
+        assert len(default.encode()) > len(short.encode())
     finally:
         proc.kill()
         proc.wait(timeout=10)
