@@ -56,6 +56,7 @@ class DecodeState(NamedTuple):
     last_token: jnp.ndarray   # (B,) next token to feed
     active: jnp.ndarray       # (B,) bool
     remaining: jnp.ndarray    # (B,) new tokens still budgeted
+    temperature: jnp.ndarray  # (B,) f32 per-REQUEST sampling temp; 0 = greedy
 
 
 def init_decode_state(config: ModelConfig, batch: int, max_len: int) -> DecodeState:
@@ -68,6 +69,7 @@ def init_decode_state(config: ModelConfig, batch: int, max_len: int) -> DecodeSt
         last_token=jnp.zeros((batch,), jnp.int32),
         active=jnp.zeros((batch,), bool),
         remaining=jnp.zeros((batch,), jnp.int32),
+        temperature=jnp.zeros((batch,), jnp.float32),
     )
 
 
@@ -116,12 +118,13 @@ def make_prefill(config: ModelConfig):
 
 
 def make_insert():
-    """insert(state, slot, k_rows, v_rows, seq_len, token, budget) — write a
-    prefilled request into a free slot. One compile per prefill bucket
-    (k_rows' S differs); slot/lengths are traced."""
+    """insert(state, slot, k_rows, v_rows, seq_len, token, budget, temp) —
+    write a prefilled request into a free slot. One compile per prefill
+    bucket (k_rows' S differs); slot/lengths/temp are traced."""
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def insert(state: DecodeState, slot, k_rows, v_rows, seq_len, token, budget):
+    def insert(state: DecodeState, slot, k_rows, v_rows, seq_len, token,
+               budget, temp):
         return DecodeState(
             k=lax.dynamic_update_slice(state.k, k_rows, (0, slot, 0, 0, 0)),
             v=lax.dynamic_update_slice(state.v, v_rows, (0, slot, 0, 0, 0)),
@@ -129,22 +132,24 @@ def make_insert():
             last_token=state.last_token.at[slot].set(token),
             active=state.active.at[slot].set(True),
             remaining=state.remaining.at[slot].set(budget),
+            temperature=state.temperature.at[slot].set(temp),
         )
 
     return insert
 
 
-def make_decode_step(
-    config: ModelConfig, temperature: float = 0.0, steps: int = 1
-):
+def make_decode_step(config: ModelConfig, steps: int = 1):
     """decode_step(params, state, rng) -> (state, tokens (B, steps), active).
 
     `steps` tokens for every active slot per call — the inner scan stays on
     device, so one host sync delivers a chunk of tokens per slot. Larger
     chunks amortize dispatch/readback latency (critical over tunneled
     transports, still a win locally) at the cost of up-to-`steps`-step
-    admission latency for new requests. Greedy at temperature 0,
-    categorical sampling otherwise (rng consumed per call)."""
+    admission latency for new requests. Sampling is per SLOT from
+    `state.temperature` (0 = greedy argmax, else categorical at that
+    temperature — requests with different temperatures share one decode
+    batch; the engine assigns its default to requests that don't
+    specify one)."""
     c = config
 
     def one_step(params, state: DecodeState, rng):
@@ -173,12 +178,14 @@ def make_decode_step(
         x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
         h = rms_norm(x, params["final_norm"], c.norm_eps)
         logits = logits_linear(h[:, -1], params["lm_head"])
-        if temperature > 0:
-            next_token = jax.random.categorical(
-                rng, logits / temperature, axis=-1
-            ).astype(jnp.int32)
-        else:
-            next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Per-slot sampling: scale by each slot's temperature (guarded so
+        # greedy slots don't divide by 0 — their sampled value is unused),
+        # then select greedy vs sampled per slot.
+        temps = state.temperature
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        next_token = jnp.where(temps > 0, sampled, greedy)
 
         act = state.active
         remaining = state.remaining - act.astype(jnp.int32)
@@ -192,6 +199,7 @@ def make_decode_step(
             last_token=jnp.where(act, next_token, state.last_token),
             active=new_active,
             remaining=remaining,
+            temperature=state.temperature,
         )
         return new_state, jnp.where(act, next_token, -1), new_active
 
@@ -236,6 +244,7 @@ class _Request(NamedTuple):
     # Yields int tokens; None = clean end; an Exception = engine failure
     # (consumers must re-raise, not treat partial output as complete).
     out: "queue.Queue[object]"
+    temperature: float  # per-request; 0 = greedy
 
 
 class ServingEngine:
@@ -263,7 +272,7 @@ class ServingEngine:
         self.max_len = max_len or config.max_seq_len
         self._prefill = make_prefill(config)
         self._insert = make_insert()
-        self._step = make_decode_step(config, temperature, steps_per_sync)
+        self._step = make_decode_step(config, steps=steps_per_sync)
         self._temperature = temperature
         self._rng = jax.random.PRNGKey(seed)
         self.state = init_decode_state(config, slots, self.max_len)
@@ -288,14 +297,29 @@ class ServingEngine:
         self._thread.start()
 
     def submit(
-        self, tokens: List[int], max_new_tokens: int
+        self,
+        tokens: List[int],
+        max_new_tokens: int,
+        temperature: Optional[float] = None,
     ) -> "queue.Queue[object]":
         """Enqueue a request; returns its output queue (see _Request.out
-        for the token/None/Exception protocol)."""
+        for the token/None/Exception protocol). `temperature` overrides
+        the engine default for THIS request (0 = greedy) — requests at
+        different temperatures share one decode batch."""
         if not tokens:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature is None:
+            temperature = self._temperature
+        import math
+
+        # `not (>= 0)` also rejects NaN (which would silently decode
+        # greedy); inf would flatten logits to uniform-vocab garbage.
+        if not (temperature >= 0) or math.isinf(temperature):
+            raise ValueError(
+                f"temperature must be a finite number >= 0, got {temperature}"
+            )
         # The last decode write lands at cache row len + max_new - 2, so
         # len + max_new == max_len exactly fills the cache.
         if len(tokens) + max_new_tokens > self.max_len:
@@ -321,7 +345,9 @@ class ServingEngine:
             if self.max_pending is not None and backlog >= self.max_pending:
                 self.rejected += 1
                 raise EngineOverloadedError(depth, self._retry_after(depth))
-            self._pending.put(_Request(list(tokens), max_new_tokens, out))
+            self._pending.put(
+                _Request(list(tokens), max_new_tokens, out, float(temperature))
+            )
         self._wake.set()
         return out
 
@@ -384,15 +410,15 @@ class ServingEngine:
             self._slot_t0[slot] = time.monotonic()
             toks = jnp.asarray([req.tokens], dtype=jnp.int32)
             k_rows, v_rows, logits = self._prefill(self.params, toks)
-            if self._temperature > 0:
+            if req.temperature > 0:
                 self._rng, sub = jax.random.split(self._rng)
-                first = int(jax.random.categorical(sub, logits / self._temperature))
+                first = int(jax.random.categorical(sub, logits / req.temperature))
             else:
                 first = int(jnp.argmax(logits))
             req.out.put(first)
             self.state = self._insert(
                 self.state, slot, k_rows, v_rows, len(req.tokens), first,
-                req.max_new_tokens - 1,
+                req.max_new_tokens - 1, req.temperature,
             )
             if req.max_new_tokens <= 1:
                 req.out.put(None)

@@ -95,26 +95,34 @@ class Engine:
     def decode(self, ids) -> str:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
-    def chat_stream(self, messages, max_tokens=None):
+    def chat_stream(self, messages, max_tokens=None, temperature=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
-        `max_tokens` is the per-request OpenAI field, clamped to the
-        server's --max-new-tokens cap (the cap also bounds the KV rows a
-        request can occupy). UTF-8 is decoded incrementally so
-        multi-byte characters split across tokens reassemble instead of
-        degrading to U+FFFD."""
+        `max_tokens` and `temperature` are the per-request OpenAI fields:
+        the budget is clamped to the server's --max-new-tokens cap (which
+        also bounds the KV rows a request can occupy); temperature rides
+        per-SLOT through the decode batch (0 = greedy). UTF-8 is decoded
+        incrementally so multi-byte characters split across tokens
+        reassemble instead of degrading to U+FFFD."""
         budget = self.max_new_tokens
         if max_tokens is not None:
             try:
                 budget = max(1, min(int(max_tokens), self.max_new_tokens))
             except (TypeError, ValueError):
                 pass  # malformed client value: serve with the server cap
+        temp = None
+        if temperature is not None:
+            try:
+                temp = max(0.0, float(temperature))
+            except (TypeError, ValueError):
+                pass  # malformed: engine default
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
         )
         tokens = self.encode(prompt + "\nassistant:")
         out = self.serving.submit(
-            [int(t) for t in tokens[0]], max_new_tokens=budget
+            [int(t) for t in tokens[0]], max_new_tokens=budget,
+            temperature=temp,
         )
         dec = codecs.getincrementaldecoder("utf-8")("replace")
         while True:
@@ -130,8 +138,8 @@ class Engine:
             if piece:
                 yield piece
 
-    def chat(self, messages, max_tokens=None) -> str:
-        return "".join(self.chat_stream(messages, max_tokens))
+    def chat(self, messages, max_tokens=None, temperature=None) -> str:
+        return "".join(self.chat_stream(messages, max_tokens, temperature))
 
 
 def main() -> None:
@@ -180,13 +188,16 @@ def main() -> None:
             # second status line spliced into the event stream.
             try:
                 pieces = engine.chat_stream(
-                    req.get("messages", []), req.get("max_tokens")
+                    req.get("messages", []), req.get("max_tokens"),
+                    req.get("temperature"),
                 )
                 first = next(pieces)
             except StopIteration:
                 first = ""
             except EngineOverloadedError as e:
                 return self._send_overloaded(e)
+            except ValueError as e:  # bad request field (e.g. temperature)
+                return self._send(400, {"error": str(e)})
             except Exception as e:
                 return self._send(500, {"error": str(e)})
             self.send_response(200)
@@ -240,9 +251,12 @@ def main() -> None:
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if req.get("stream"):
                     return self._stream(req)
-                text = engine.chat(req.get("messages", []), req.get("max_tokens"))
+                text = engine.chat(req.get("messages", []),
+                                   req.get("max_tokens"), req.get("temperature"))
             except EngineOverloadedError as e:
                 return self._send_overloaded(e)
+            except ValueError as e:  # bad request field (e.g. temperature)
+                return self._send(400, {"error": str(e)})
             except Exception as e:  # surface engine errors as API errors
                 return self._send(500, {"error": str(e)})
             self._send(200, {

@@ -94,7 +94,7 @@ def test_cache_full_retires_slot(params):
     prefill = make_prefill(CFG)
     k_rows, v_rows, logits = prefill(params, jnp.asarray([[1, 2, 3]], jnp.int32))
     state = make_insert()(
-        state, 0, k_rows, v_rows, 3, int(jnp.argmax(logits)), 100
+        state, 0, k_rows, v_rows, 3, int(jnp.argmax(logits)), 100, 0.0
     )  # budget far beyond the cache
     step = make_decode_step(CFG)
     rng = jax.random.PRNGKey(0)
@@ -266,5 +266,32 @@ def test_max_pending_zero_serves_but_never_queues(params):
             time.sleep(0.01)
         qc = engine.submit([2, 3], max_new_tokens=3)
         assert _drain(qc) == _reference(params, [2, 3], 3)
+    finally:
+        engine.close()
+
+
+def test_per_request_temperature_in_one_batch(params):
+    """A temperature=0 request must stay bit-identical to greedy decode
+    even while sharing the batch with sampling requests (per-slot
+    temperature, not an engine-wide mode)."""
+    engine = ServingEngine(CFG, params, slots=4, max_len=64, temperature=0.8)
+    try:
+        # engine default (0.8): sampled
+        q_hot = engine.submit([5, 7, 11], max_new_tokens=8)
+        # explicit greedy override rides the same decode batch
+        q_cold = engine.submit([5, 7, 11], max_new_tokens=8, temperature=0)
+        hot = _drain(q_hot)
+        cold = _drain(q_cold)
+        assert cold == _reference(params, [5, 7, 11], 8)
+        assert len(hot) == 8  # sampled stream still completes its budget
+    finally:
+        engine.close()
+
+
+def test_submit_rejects_negative_temperature(params):
+    engine = ServingEngine(CFG, params, slots=1, max_len=64)
+    try:
+        with pytest.raises(ValueError):
+            engine.submit([1, 2], max_new_tokens=2, temperature=-0.5)
     finally:
         engine.close()
