@@ -202,6 +202,7 @@ const views = {
         <div class="toolbar">
           <select id="pg-model">${models.map((m) => `<option>${esc(m.id)}</option>`).join("")}</select>
           <input id="pg-max-tokens" type="number" value="128" min="1" title="max_tokens">
+          <input id="pg-temperature" type="number" value="0.8" min="0" step="0.1" title="temperature (0 = greedy)">
           <button class="action" id="pg-send">Send</button>
         </div>
         <textarea id="pg-prompt" rows="3" placeholder="Say something to the model…"></textarea>
@@ -223,6 +224,7 @@ const views = {
             body: JSON.stringify({
               model: $("#pg-model").value,
               max_tokens: Number($("#pg-max-tokens").value) || 128,
+              temperature: Number($("#pg-temperature").value) || 0,
               stream: true,
               messages: [{ role: "user", content: $("#pg-prompt").value }],
             }),
