@@ -224,7 +224,8 @@ const views = {
             body: JSON.stringify({
               model: $("#pg-model").value,
               max_tokens: Number($("#pg-max-tokens").value) || 128,
-              temperature: Number($("#pg-temperature").value) || 0,
+              temperature: Number.isFinite(Number($("#pg-temperature").value)) && $("#pg-temperature").value !== ""
+                ? Number($("#pg-temperature").value) : 0.8,
               stream: true,
               messages: [{ role: "user", content: $("#pg-prompt").value }],
             }),

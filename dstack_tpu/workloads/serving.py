@@ -57,6 +57,7 @@ class DecodeState(NamedTuple):
     active: jnp.ndarray       # (B,) bool
     remaining: jnp.ndarray    # (B,) new tokens still budgeted
     temperature: jnp.ndarray  # (B,) f32 per-REQUEST sampling temp; 0 = greedy
+    top_p: jnp.ndarray        # (B,) f32 nucleus cutoff; 1 = no filtering
 
 
 def init_decode_state(config: ModelConfig, batch: int, max_len: int) -> DecodeState:
@@ -70,6 +71,7 @@ def init_decode_state(config: ModelConfig, batch: int, max_len: int) -> DecodeSt
         active=jnp.zeros((batch,), bool),
         remaining=jnp.zeros((batch,), jnp.int32),
         temperature=jnp.zeros((batch,), jnp.float32),
+        top_p=jnp.ones((batch,), jnp.float32),
     )
 
 
@@ -118,13 +120,13 @@ def make_prefill(config: ModelConfig):
 
 
 def make_insert():
-    """insert(state, slot, k_rows, v_rows, seq_len, token, budget, temp) —
-    write a prefilled request into a free slot. One compile per prefill
-    bucket (k_rows' S differs); slot/lengths/temp are traced."""
+    """insert(state, slot, k_rows, v_rows, seq_len, token, budget, temp,
+    top_p) — write a prefilled request into a free slot. One compile per
+    prefill bucket (k_rows' S differs); slot/lengths/temp are traced."""
 
     @functools.partial(jax.jit, donate_argnums=0)
     def insert(state: DecodeState, slot, k_rows, v_rows, seq_len, token,
-               budget, temp):
+               budget, temp, top_p):
         return DecodeState(
             k=lax.dynamic_update_slice(state.k, k_rows, (0, slot, 0, 0, 0)),
             v=lax.dynamic_update_slice(state.v, v_rows, (0, slot, 0, 0, 0)),
@@ -133,6 +135,7 @@ def make_insert():
             active=state.active.at[slot].set(True),
             remaining=state.remaining.at[slot].set(budget),
             temperature=state.temperature.at[slot].set(temp),
+            top_p=state.top_p.at[slot].set(top_p),
         )
 
     return insert
@@ -180,10 +183,22 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
         logits = logits_linear(h[:, -1], params["lm_head"])
         # Per-slot sampling: scale by each slot's temperature (guarded so
         # greedy slots don't divide by 0 — their sampled value is unused),
-        # then select greedy vs sampled per slot.
+        # nucleus-filter by each slot's top_p, then select greedy vs
+        # sampled per slot. top_p == 1 masks nothing (the strict `<`
+        # keeps every token whose PRECEDING cumulative mass is < p, so
+        # the top token always survives and p=1 keeps all).
         temps = state.temperature
         scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        # Skip the sort/cumsum entirely on the DEFAULT path (every live
+        # slot at top_p=1): lax.cond executes one branch at runtime, so
+        # unfiltered serving pays only the predicate.
+        filtered = lax.cond(
+            jnp.any(state.top_p < 1.0),
+            lambda x: jax.vmap(_nucleus_filter)(x, state.top_p),
+            lambda x: x,
+            scaled,
+        )
+        sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         next_token = jnp.where(temps > 0, sampled, greedy)
 
@@ -200,6 +215,7 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
             active=new_active,
             remaining=remaining,
             temperature=state.temperature,
+            top_p=state.top_p,
         )
         return new_state, jnp.where(act, next_token, -1), new_active
 
@@ -218,6 +234,19 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
         return state, toks.T, active  # (B, steps)
 
     return decode_steps
+
+
+def _nucleus_filter(logits: jnp.ndarray, top_p) -> jnp.ndarray:
+    """Nucleus (top-p) filter over one row of logits: strict `<` on the
+    PRECEDING cumulative mass, so the top token always survives and
+    top_p=1 keeps everything. The single source of truth — the jitted
+    decode step vmaps this, and the prefill's first token calls it
+    directly, so the boundary rule cannot drift between them."""
+    order = jnp.argsort(-logits)
+    probs = jax.nn.softmax(logits[order])
+    before = jnp.cumsum(probs) - probs
+    keep = jnp.zeros(logits.shape[0], bool).at[order].set(before < top_p)
+    return jnp.where(keep, logits, -jnp.inf)
 
 
 class EngineOverloadedError(RuntimeError):
@@ -245,6 +274,7 @@ class _Request(NamedTuple):
     # (consumers must re-raise, not treat partial output as complete).
     out: "queue.Queue[object]"
     temperature: float  # per-request; 0 = greedy
+    top_p: float        # per-request nucleus cutoff; 1 = no filtering
 
 
 class ServingEngine:
@@ -301,11 +331,13 @@ class ServingEngine:
         tokens: List[int],
         max_new_tokens: int,
         temperature: Optional[float] = None,
+        top_p: float = 1.0,
     ) -> "queue.Queue[object]":
         """Enqueue a request; returns its output queue (see _Request.out
-        for the token/None/Exception protocol). `temperature` overrides
-        the engine default for THIS request (0 = greedy) — requests at
-        different temperatures share one decode batch."""
+        for the token/None/Exception protocol). `temperature` (0 =
+        greedy) and `top_p` (nucleus cutoff, 1 = no filtering) override
+        the engine defaults for THIS request — requests with different
+        sampling params share one decode batch."""
         if not tokens:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -320,6 +352,8 @@ class ServingEngine:
             raise ValueError(
                 f"temperature must be a finite number >= 0, got {temperature}"
             )
+        if not (0 < top_p <= 1):  # also rejects NaN
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         # The last decode write lands at cache row len + max_new - 2, so
         # len + max_new == max_len exactly fills the cache.
         if len(tokens) + max_new_tokens > self.max_len:
@@ -346,7 +380,8 @@ class ServingEngine:
                 self.rejected += 1
                 raise EngineOverloadedError(depth, self._retry_after(depth))
             self._pending.put(
-                _Request(list(tokens), max_new_tokens, out, float(temperature))
+                _Request(list(tokens), max_new_tokens, out,
+                         float(temperature), float(top_p))
             )
         self._wake.set()
         return out
@@ -412,13 +447,16 @@ class ServingEngine:
             k_rows, v_rows, logits = self._prefill(self.params, toks)
             if req.temperature > 0:
                 self._rng, sub = jax.random.split(self._rng)
-                first = int(jax.random.categorical(sub, logits / req.temperature))
+                scaled = logits / req.temperature
+                if req.top_p < 1:
+                    scaled = _nucleus_filter(scaled, req.top_p)
+                first = int(jax.random.categorical(sub, scaled))
             else:
                 first = int(jnp.argmax(logits))
             req.out.put(first)
             self.state = self._insert(
                 self.state, slot, k_rows, v_rows, len(req.tokens), first,
-                req.max_new_tokens - 1, req.temperature,
+                req.max_new_tokens - 1, req.temperature, req.top_p,
             )
             if req.max_new_tokens <= 1:
                 req.out.put(None)

@@ -95,7 +95,8 @@ class Engine:
     def decode(self, ids) -> str:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
-    def chat_stream(self, messages, max_tokens=None, temperature=None):
+    def chat_stream(self, messages, max_tokens=None, temperature=None,
+                    top_p=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
         `max_tokens` and `temperature` are the per-request OpenAI fields:
@@ -116,13 +117,23 @@ class Engine:
                 temp = max(0.0, float(temperature))
             except (TypeError, ValueError):
                 pass  # malformed: engine default
+        nucleus = 1.0
+        if top_p is not None:
+            try:
+                v = float(top_p)
+                # NaN slips through min/max (max(nan, x) is nan): treat it
+                # like any other malformed value — no filtering.
+                if v == v:
+                    nucleus = min(max(v, 1e-6), 1.0)
+            except (TypeError, ValueError):
+                pass  # malformed: no filtering
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
         )
         tokens = self.encode(prompt + "\nassistant:")
         out = self.serving.submit(
             [int(t) for t in tokens[0]], max_new_tokens=budget,
-            temperature=temp,
+            temperature=temp, top_p=nucleus,
         )
         dec = codecs.getincrementaldecoder("utf-8")("replace")
         while True:
@@ -138,8 +149,8 @@ class Engine:
             if piece:
                 yield piece
 
-    def chat(self, messages, max_tokens=None, temperature=None) -> str:
-        return "".join(self.chat_stream(messages, max_tokens, temperature))
+    def chat(self, messages, max_tokens=None, temperature=None, top_p=None) -> str:
+        return "".join(self.chat_stream(messages, max_tokens, temperature, top_p))
 
 
 def main() -> None:
@@ -189,7 +200,7 @@ def main() -> None:
             try:
                 pieces = engine.chat_stream(
                     req.get("messages", []), req.get("max_tokens"),
-                    req.get("temperature"),
+                    req.get("temperature"), req.get("top_p"),
                 )
                 first = next(pieces)
             except StopIteration:
@@ -252,7 +263,8 @@ def main() -> None:
                 if req.get("stream"):
                     return self._stream(req)
                 text = engine.chat(req.get("messages", []),
-                                   req.get("max_tokens"), req.get("temperature"))
+                                   req.get("max_tokens"), req.get("temperature"),
+                                   req.get("top_p"))
             except EngineOverloadedError as e:
                 return self._send_overloaded(e)
             except ValueError as e:  # bad request field (e.g. temperature)

@@ -94,7 +94,7 @@ def test_cache_full_retires_slot(params):
     prefill = make_prefill(CFG)
     k_rows, v_rows, logits = prefill(params, jnp.asarray([[1, 2, 3]], jnp.int32))
     state = make_insert()(
-        state, 0, k_rows, v_rows, 3, int(jnp.argmax(logits)), 100, 0.0
+        state, 0, k_rows, v_rows, 3, int(jnp.argmax(logits)), 100, 0.0, 1.0
     )  # budget far beyond the cache
     step = make_decode_step(CFG)
     rng = jax.random.PRNGKey(0)
@@ -293,5 +293,27 @@ def test_submit_rejects_negative_temperature(params):
     try:
         with pytest.raises(ValueError):
             engine.submit([1, 2], max_new_tokens=2, temperature=-0.5)
+    finally:
+        engine.close()
+
+
+def test_top_p_near_zero_equals_greedy(params):
+    """Nucleus sampling with top_p -> 0 keeps only the top token: even at
+    a hot temperature the stream must equal greedy decode — a closed-form
+    pin on the whole filter (sort, cumsum, scatter-back, strict <)."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=64, temperature=1.0)
+    try:
+        q = engine.submit([5, 7, 11], max_new_tokens=8, top_p=1e-6)
+        assert _drain(q) == _reference(params, [5, 7, 11], 8)
+    finally:
+        engine.close()
+
+
+def test_submit_rejects_bad_top_p(params):
+    engine = ServingEngine(CFG, params, slots=1, max_len=64)
+    try:
+        for bad in (0.0, -0.1, 1.5, float("nan")):
+            with pytest.raises(ValueError):
+                engine.submit([1, 2], max_new_tokens=2, top_p=bad)
     finally:
         engine.close()
