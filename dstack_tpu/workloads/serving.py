@@ -316,6 +316,15 @@ class ServingEngine:
         self._slot_t0: List[float] = [0.0] * slots
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._live: List[Optional[_Request]] = [None] * slots
+        # Output queues whose consumer is gone (client disconnect, stop
+        # sequence hit): the loop retires their slots at the next chunk
+        # boundary instead of decoding the rest of the budget into a
+        # queue nobody reads. _inflight tracks queues with an unfinished
+        # request so cancel() of an already-completed stream is a no-op
+        # (NOT a set leak — consumers routinely cancel in a finally).
+        # Both guarded by _lock.
+        self._cancelled: set = set()
+        self._inflight: set = set()
         self._wake = threading.Event()
         self._stop = False
         self._failed: Optional[BaseException] = None
@@ -383,6 +392,7 @@ class ServingEngine:
                 _Request(list(tokens), max_new_tokens, out,
                          float(temperature), float(top_p))
             )
+            self._inflight.add(out)
         self._wake.set()
         return out
 
@@ -392,6 +402,16 @@ class ServingEngine:
         slot-turn (admit -> retire, EWMA over completed requests)."""
         turns_ahead = (depth + 1) / max(1, self.slots)
         return max(1.0, round(turns_ahead * self._turn_s, 1))
+
+    def cancel(self, out: "queue.Queue[object]") -> None:
+        """Abandon the request whose submit() returned `out` — the slot
+        (or pending entry) is freed at the next chunk boundary. Safe from
+        any thread; idempotent; unknown queues are ignored. The consumer
+        receives the clean-end None once the loop processes it."""
+        with self._lock:
+            if out in self._inflight:
+                self._cancelled.add(out)
+        self._wake.set()
 
     def stats(self) -> Dict[str, Any]:
         """Live load snapshot (feeds /metrics and autoscaler signals)."""
@@ -422,6 +442,8 @@ class ServingEngine:
         None — partial output must not read as success."""
         sentinel: object = error if error is not None else None
         with self._lock:
+            self._cancelled.clear()
+            self._inflight.clear()
             for slot, req in enumerate(self._live):
                 if req is not None:
                     req.out.put(sentinel)
@@ -442,6 +464,13 @@ class ServingEngine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 return
+            with self._lock:
+                if req.out in self._cancelled:
+                    # abandoned while queued: never occupy a slot
+                    self._cancelled.discard(req.out)
+                    self._inflight.discard(req.out)
+                    req.out.put(None)
+                    continue
             self._slot_t0[slot] = time.monotonic()
             toks = jnp.asarray([req.tokens], dtype=jnp.int32)
             k_rows, v_rows, logits = self._prefill(self.params, toks)
@@ -459,6 +488,8 @@ class ServingEngine:
                 req.max_new_tokens - 1, req.temperature, req.top_p,
             )
             if req.max_new_tokens <= 1:
+                with self._lock:
+                    self._inflight.discard(req.out)
                 req.out.put(None)
                 self.state = self._retire(slot)
             else:
@@ -490,8 +521,20 @@ class ServingEngine:
                 toks = jax.device_get(tokens)  # (B, steps_per_sync)
                 still = jax.device_get(active)
                 self._chunk_s = self._ewma(self._chunk_s, time.monotonic() - t0)
+                with self._lock:
+                    cancelled = set(self._cancelled)
                 for slot, req in enumerate(self._live):
                     if req is None:
+                        continue
+                    if req.out in cancelled:
+                        # consumer is gone: free the slot now, skip the
+                        # chunk's tokens (nobody reads them)
+                        with self._lock:
+                            self._cancelled.discard(req.out)
+                            self._inflight.discard(req.out)
+                        self.state = self._retire(slot)
+                        req.out.put(None)
+                        self._live[slot] = None
                         continue
                     for tok in toks[slot]:
                         if tok >= 0:
@@ -499,6 +542,11 @@ class ServingEngine:
                     if not still[slot]:
                         req.out.put(None)
                         self._live[slot] = None
+                        with self._lock:
+                            # cancel() racing normal completion must not
+                            # leave a stale entry behind
+                            self._cancelled.discard(req.out)
+                            self._inflight.discard(req.out)
                         self._turn_s = self._ewma(
                             self._turn_s,
                             time.monotonic() - self._slot_t0[slot],

@@ -96,7 +96,7 @@ class Engine:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
     def chat_stream(self, messages, max_tokens=None, temperature=None,
-                    top_p=None):
+                    top_p=None, stop=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
         `max_tokens` and `temperature` are the per-request OpenAI fields:
@@ -130,27 +130,75 @@ class Engine:
         prompt = "\n".join(
             f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
         )
+        if isinstance(stop, str):
+            stops = [stop]
+        elif isinstance(stop, (list, tuple)):
+            stops = [x for x in stop if isinstance(x, str) and x]
+        else:
+            stops = []  # malformed: no stop filtering (lenient like temp)
         tokens = self.encode(prompt + "\nassistant:")
         out = self.serving.submit(
             [int(t) for t in tokens[0]], max_new_tokens=budget,
             temperature=temp, top_p=nucleus,
         )
         dec = codecs.getincrementaldecoder("utf-8")("replace")
-        while True:
-            tok = out.get()
-            if isinstance(tok, BaseException):
-                raise RuntimeError(f"generation failed: {tok}")
-            if tok is None:
-                tail = dec.decode(b"", True)
-                if tail:
-                    yield tail
-                return
-            piece = dec.decode(bytes([int(tok) % 256]))
-            if piece:
-                yield piece
+        # Streaming stop matching: text already sent cannot be unsent, so
+        # hold back any suffix that is a PREFIX of a stop sequence until
+        # it either completes the stop (truncate + free the slot) or
+        # diverges (flush). The buffer never exceeds max stop length + one
+        # piece, so scans are O(stop length) per token, and OpenAI
+        # semantics hold: the stop string itself is never emitted.
+        max_hold = max((len(x) for x in stops), default=1) - 1
 
-    def chat(self, messages, max_tokens=None, temperature=None, top_p=None) -> str:
-        return "".join(self.chat_stream(messages, max_tokens, temperature, top_p))
+        def holdback(b):
+            for k in range(min(max_hold, len(b)), 0, -1):
+                tail = b[-k:]
+                if any(x.startswith(tail) for x in stops):
+                    return k
+            return 0
+
+        buf = ""
+        try:
+            while True:
+                tok = out.get()
+                if isinstance(tok, BaseException):
+                    raise RuntimeError(f"generation failed: {tok}")
+                if tok is None:
+                    buf += dec.decode(b"", True)
+                    if buf:
+                        yield buf  # incomplete stop prefix at end: emit
+                    return
+                piece = dec.decode(bytes([int(tok) % 256]))
+                if not piece:
+                    continue
+                if not stops:
+                    yield piece
+                    continue
+                buf += piece
+                hit = -1
+                for x in stops:
+                    i = buf.find(x)
+                    if i >= 0 and (hit < 0 or i < hit):
+                        hit = i
+                if hit >= 0:
+                    if buf[:hit]:
+                        yield buf[:hit]
+                    self.serving.cancel(out)  # free the slot early
+                    return
+                keep = holdback(buf)
+                if len(buf) > keep:
+                    yield buf[:len(buf) - keep]
+                    buf = buf[len(buf) - keep:] if keep else ""
+        finally:
+            # Consumer gone mid-stream (client disconnect closes this
+            # generator) or stop hit: the engine must not keep decoding
+            # into a queue nobody reads. Idempotent after clean end.
+            self.serving.cancel(out)
+
+    def chat(self, messages, max_tokens=None, temperature=None, top_p=None,
+             stop=None) -> str:
+        return "".join(self.chat_stream(messages, max_tokens, temperature,
+                                        top_p, stop))
 
 
 def main() -> None:
@@ -200,7 +248,7 @@ def main() -> None:
             try:
                 pieces = engine.chat_stream(
                     req.get("messages", []), req.get("max_tokens"),
-                    req.get("temperature"), req.get("top_p"),
+                    req.get("temperature"), req.get("top_p"), req.get("stop"),
                 )
                 first = next(pieces)
             except StopIteration:
@@ -264,7 +312,7 @@ def main() -> None:
                     return self._stream(req)
                 text = engine.chat(req.get("messages", []),
                                    req.get("max_tokens"), req.get("temperature"),
-                                   req.get("top_p"))
+                                   req.get("top_p"), req.get("stop"))
             except EngineOverloadedError as e:
                 return self._send_overloaded(e)
             except ValueError as e:  # bad request field (e.g. temperature)

@@ -317,3 +317,41 @@ def test_submit_rejects_bad_top_p(params):
                 engine.submit([1, 2], max_new_tokens=2, top_p=bad)
     finally:
         engine.close()
+
+
+def test_cancel_frees_the_slot(params):
+    """cancel() retires an abandoned request at the next chunk boundary
+    (client disconnects must not burn slot capacity for the rest of the
+    budget): with ONE slot, a second request completes promptly after the
+    first is cancelled mid-stream."""
+    engine = ServingEngine(CFG, params, slots=1, max_len=64)
+    _slow_decode(engine, 0.2)  # hold the slot so cancel is observable
+    try:
+        qa = engine.submit([5, 7, 11], max_new_tokens=40)
+        assert isinstance(qa.get(timeout=60), int)  # A occupies the slot
+        qb = engine.submit([13, 17], max_new_tokens=3)  # parks pending
+        engine.cancel(qa)
+        # A's consumer sees the clean end; B gets the slot and finishes.
+        drained = _drain(qa)
+        assert len(drained) < 39  # cancelled well before its budget
+        assert _drain(qb) == _reference(params, [13, 17], 3)
+        assert engine.stats()["active"] == 0
+    finally:
+        engine.close()
+
+
+def test_cancel_pending_request(params):
+    """Cancelling a request that never reached a slot ends its stream
+    without occupying capacity."""
+    engine = ServingEngine(CFG, params, slots=1, max_len=64)
+    _slow_decode(engine, 0.2)
+    try:
+        qa = engine.submit([5, 7, 11], max_new_tokens=30)
+        assert isinstance(qa.get(timeout=60), int)
+        qb = engine.submit([13, 17], max_new_tokens=30)  # pending
+        engine.cancel(qb)
+        assert _drain(qb) == []  # ended with no tokens (first token never sampled)
+        engine.cancel(qa)
+        _drain(qa)
+    finally:
+        engine.close()

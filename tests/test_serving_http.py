@@ -152,3 +152,30 @@ def test_native_server_honors_max_tokens(tmp_path):
         proc.kill()
         proc.wait(timeout=10)
         log.close()
+
+
+def test_native_server_stop_sequences(tmp_path):
+    """The OpenAI `stop` field truncates the output before the stop
+    string; greedy decode makes the check deterministic."""
+    proc, log, port = _boot_server(tmp_path, "--max-new-tokens", "24")
+    try:
+        def chat(extra):
+            r = _post(port, {"messages": [{"role": "user", "content": "go"}],
+                             "temperature": 0, **extra})
+            return json.load(r)["choices"][0]["message"]["content"]
+
+        full = chat({})
+        assert len(full) > 6
+        # Stop on substrings the greedy output certainly contains — a
+        # single char and a MULTI-char one (the hold-back case: partial
+        # matches must not leak into the emitted text).
+        for stop in (full[2], full[2:5]):
+            stopped = chat({"stop": [stop]})
+            assert stop not in stopped, (full, stop, stopped)
+            assert stopped == full[:full.index(stop)], (full, stop, stopped)
+        # malformed stop: lenient, full output
+        assert chat({"stop": 5}) == full
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
