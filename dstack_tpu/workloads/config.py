@@ -38,6 +38,16 @@ class ModelConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # Chunked cross-entropy: compute the lm-head + softmax-xent over
+    # sequence chunks of this many tokens inside a rematerialized
+    # lax.scan, so the full (B, S, V) f32 logits tensor is never
+    # materialized (train.loss_fn). 0 = off (dense logits). The math is
+    # identical (per-token logsumexp; f32 accumulation) — only the
+    # association order of the token-sum changes. Costs one extra
+    # lm-head matmul in backward; frees vocab_size*(4+dtype_bytes)
+    # bytes/token of saved residuals, which is what lets the flagship
+    # bench shape run the remat-free rung (docs/design/perf.md).
+    ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -131,8 +141,16 @@ class ModelConfig:
             per_token += 2 * seq_len * self.n_heads * 4
         # The lm-head/loss residuals sit outside the scanned layers but
         # compete for the same budget: f32 logits saved for the CE
-        # backward plus the normalized log-prob intermediate.
-        head_per_token = self.vocab_size * (4 + db)
+        # backward plus the normalized log-prob intermediate. Chunked CE
+        # recomputes the chunk logits in backward, keeping only the
+        # final-norm hidden states plus one transient (chunk, V) buffer —
+        # but loss_fn falls back to dense logits when the sequence does
+        # not divide into ce_chunk slices (and when seq_len is unknown
+        # here, assume dense: over-counting picks a safer rung).
+        if self.ce_chunk > 0 and seq_len and seq_len % self.ce_chunk == 0:
+            head_per_token = d * db
+        else:
+            head_per_token = self.vocab_size * (4 + db)
         act_bytes = (
             batch_tokens / max(act_shard, 1)
             * (per_token * self.n_layers + head_per_token)

@@ -22,7 +22,7 @@ from dstack_tpu.workloads.sharding import (
     param_shardings,
     shard_tree,
 )
-from dstack_tpu.workloads.transformer import forward, init_params
+from dstack_tpu.workloads.transformer import forward, init_params, logits_linear
 
 
 class TrainState(NamedTuple):
@@ -77,6 +77,51 @@ def init_train_state(
     return state
 
 
+def _chunked_ce(
+    hidden: jnp.ndarray,
+    lm_head,
+    targets: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    chunk: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Softmax cross-entropy over sequence chunks -> (nll_sum, denom).
+
+    hidden (B, S, D) are the final-norm states; the lm-head matmul and
+    the per-token logsumexp run inside a rematerialized lax.scan over
+    S/chunk slices, so only one (B, chunk, V) f32 logits buffer is ever
+    live and nothing vocab-sized is saved for backward (jax.checkpoint
+    recomputes the chunk in the grad pass — one extra head matmul, paid
+    to keep vocab_size*(4+dtype_bytes) bytes/token out of the remat
+    budget; see config.resolve_remat and docs/design/perf.md). The math
+    is the dense path's exactly, f32-accumulated; only the token-sum
+    association differs.
+
+    Sharding note: the scan axis comes from the sequence dimension, so
+    under sequence parallelism (sp > 1) GSPMD must gather each chunk off
+    the seq shards before its head matmul — the dense head keeps that
+    axis parallel. Another reason this is an opt-in memory lever: use it
+    when logits memory binds, not on sp meshes for speed."""
+    b, s, d = hidden.shape
+    n = s // chunk
+    xs = jnp.moveaxis(hidden.reshape(b, n, chunk, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(b, n, chunk), 1, 0)
+    if mask is None:
+        ms = jnp.ones((n, b, chunk), jnp.float32)
+    else:
+        ms = jnp.moveaxis(mask.reshape(b, n, chunk), 1, 0).astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        xi, ti, mi = inp
+        logits = logits_linear(xi, lm_head)  # (B, chunk, V) f32, transient
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, ti[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum((lse - tgt) * mi), None
+
+    total, _ = lax.scan(body, jnp.float32(0.0), (xs, ts, ms))
+    return total, jnp.sum(ms)
+
+
 def loss_fn(
     config: ModelConfig,
     params: Any,
@@ -92,13 +137,23 @@ def loss_fn(
     folded into the loss with `router_aux_coef`.
     """
     inputs, targets = batch["inputs"], batch["targets"]
+    mask = batch.get("loss_mask")
+    if config.ce_chunk > 0 and inputs.shape[1] % config.ce_chunk == 0:
+        hidden, aux = forward(
+            config, params, inputs, attention_fn=attention_fn, mesh=mesh,
+            return_aux=True, return_hidden=True,
+        )
+        total, denom = _chunked_ce(
+            hidden, params["lm_head"], targets, mask, config.ce_chunk
+        )
+        ce = total / jnp.maximum(denom, 1.0)
+        return ce + config.router_aux_coef * aux, aux
     logits, aux = forward(
         config, params, inputs, attention_fn=attention_fn, mesh=mesh,
         return_aux=True,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask.astype(jnp.float32)
         ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

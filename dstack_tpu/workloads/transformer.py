@@ -226,11 +226,16 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
     mesh=None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ):
     """tokens (B, S) int32 -> logits (B, S, V) in f32.
 
     With return_aux=True returns (logits, aux) where aux is the summed
-    router load-balance loss over layers (0.0 for dense models)."""
+    router load-balance loss over layers (0.0 for dense models).
+    With return_hidden=True the lm-head matmul is skipped and the
+    final-norm hidden states (B, S, D) come back in place of logits —
+    the chunked-CE loss (train.loss_fn) applies the head itself per
+    sequence chunk so the full logits tensor is never materialized."""
     c = config
     attn = attention_fn or plain_attention
     if positions is None:
@@ -255,6 +260,8 @@ def forward(
     (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return (x, aux) if return_aux else x
     logits = logits_linear(x, params["lm_head"])
     if return_aux:
         return logits, aux

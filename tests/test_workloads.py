@@ -216,3 +216,73 @@ class TestAccumulationAndSchedule:
         ).max()
         assert 0 < d2 < 1e-3
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestChunkedCE:
+    """config.ce_chunk: sequence-chunked cross-entropy (train._chunked_ce).
+
+    The chunked path must be a pure memory optimization — same loss, same
+    gradients — in every configuration that dispatches it, and must fall
+    back to the dense path when the sequence does not divide evenly."""
+
+    def _loss_and_grads(self, cfg, batch):
+        from dstack_tpu.workloads.train import init_train_state, loss_fn
+
+        state = init_train_state(cfg, jax.random.PRNGKey(0))
+        return jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, batch, plain_attention)[0]
+        )(state.params)
+
+    def test_matches_dense_loss_and_grads(self):
+        batch = synthetic_batch(CFG, batch_size=2, seq_len=64)
+        dense_loss, dense_grads = self._loss_and_grads(CFG, batch)
+        ck_loss, ck_grads = self._loss_and_grads(CFG.with_(ce_chunk=16), batch)
+        np.testing.assert_allclose(
+            float(dense_loss), float(ck_loss), rtol=1e-5
+        )
+        flat_d = jax.tree_util.tree_leaves(dense_grads)
+        flat_c = jax.tree_util.tree_leaves(ck_grads)
+        for gd, gc in zip(flat_d, flat_c):
+            np.testing.assert_allclose(
+                np.asarray(gd, np.float32), np.asarray(gc, np.float32),
+                rtol=5e-2, atol=5e-4,  # bf16 param grads
+            )
+
+    def test_respects_loss_mask(self):
+        batch = synthetic_batch(CFG, batch_size=2, seq_len=64)
+        mask = np.zeros((2, 64), np.float32)
+        mask[:, :17] = 1.0  # straddles a chunk boundary
+        batch = dict(batch, loss_mask=jnp.asarray(mask))
+        dense_loss, _ = self._loss_and_grads(CFG, batch)
+        ck_loss, _ = self._loss_and_grads(CFG.with_(ce_chunk=16), batch)
+        np.testing.assert_allclose(float(dense_loss), float(ck_loss), rtol=1e-5)
+
+    def test_indivisible_seq_falls_back(self):
+        # 64 % 48 != 0: the dense path must serve the loss unchanged.
+        batch = synthetic_batch(CFG, batch_size=2, seq_len=64)
+        dense_loss, _ = self._loss_and_grads(CFG, batch)
+        fb_loss, _ = self._loss_and_grads(CFG.with_(ce_chunk=48), batch)
+        np.testing.assert_allclose(float(dense_loss), float(fb_loss), rtol=1e-6)
+
+    def test_sharded_step_matches_dense(self):
+        """Full train step on the 8-device mesh with ce_chunk on: the
+        scan-over-seq-chunks must compile under dp/fsdp/sp/tp shardings
+        and produce the dense step's loss."""
+        mesh = make_mesh(data=1, fsdp=2, seq=2, model=2)
+        cfg = CFG.with_(ce_chunk=16)
+        batch = synthetic_batch(cfg, batch_size=2, seq_len=64, mesh=mesh)
+        s0 = init_train_state(CFG, jax.random.PRNGKey(0), mesh=mesh)
+        _, m0 = make_train_step(CFG, mesh)(s0, batch)
+        s1 = init_train_state(cfg, jax.random.PRNGKey(0), mesh=mesh)
+        _, m1 = make_train_step(cfg, mesh)(s1, batch)
+        assert abs(float(m0["loss"]) - float(m1["loss"])) < 5e-3
+
+    def test_remat_estimate_drops_head_residuals(self, monkeypatch):
+        """The auto policy knows chunked CE keeps no vocab-sized residual:
+        at the flagship shape there is a batch size where dense logits
+        force the "dots" rung but ce_chunk runs remat-free."""
+        monkeypatch.delenv("DSTACK_TPU_HBM_GB", raising=False)
+        cfg = PRESETS["smol-1b"].with_(n_layers=8, remat="auto")
+        dense = cfg.resolve_remat(5 * 2048, seq_len=2048)
+        chunked = cfg.with_(ce_chunk=256).resolve_remat(5 * 2048, seq_len=2048)
+        assert (dense, chunked) == ("dots", "none")
