@@ -66,8 +66,10 @@ NEG_INF = -1e30
 # scales with head_dim and element size — a seq-only cap would admit
 # f32/hd-256 shapes that blow VMEM and crash at compile instead of falling
 # back. Empirically verified on v5e: every admitted bf16/hd-128 shape up to
-# the budget boundary (seq 16384, KV exactly 8MB) compiles and runs with
-# the 1024-wide block maxima.
+# the budget boundary (seq 16384, KV exactly 8MB) compiles and runs — as a
+# STANDALONE kernel. Inside a multi-layer model, 1024-wide tiles at
+# seq 8192+ crash the AOT compile helper, which is why _pick_block caps
+# long-sequence tiles at 512 (see its docstring before raising the cap).
 KV_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 
@@ -89,7 +91,17 @@ def use_flash(
 
 
 def _pick_block(seq: int, max_blk: int) -> int:
-    """Largest power-of-two block <= max_blk that divides seq."""
+    """Largest power-of-two block <= max_blk that divides seq.
+
+    Long sequences cap at 512 (overriding even the env knob): measured
+    on v5e, 1024x1024 tiles inside a multi-layer scanned model at
+    S=8192 crash the TPU compiler (host-side AOT helper exits 1; the
+    kernel ALONE compiles fine — the blowup needs several in-module
+    instantiations), while 512 compiles everywhere and is within
+    run-to-run noise at every measured shape (docs/design/perf.md).
+    """
+    if seq > 4096:
+        max_blk = min(max_blk, 512)
     blk = max_blk
     while blk > MIN_BLK and seq % blk != 0:
         blk //= 2

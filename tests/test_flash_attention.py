@@ -235,6 +235,20 @@ def test_pick_block_divides_odd_seqs(seq, hd):
     assert seq % blk == 0 and blk >= 128
 
 
+def test_pick_block_caps_long_sequences():
+    """S > 4096 must cap tiles at 512 even when the knob says 1024:
+    measured on v5e, 1024-wide tiles at S=8192 inside a multi-layer
+    model crash the TPU AOT compile helper (flash_attention._pick_block
+    docstring); 512 compiles and is within noise everywhere measured."""
+    from dstack_tpu.workloads.flash_attention import _pick_block
+
+    assert _pick_block(2048, 1024) == 1024
+    assert _pick_block(4096, 1024) == 1024
+    assert _pick_block(8192, 1024) == 512
+    assert _pick_block(16384, 1024) == 512
+    assert _pick_block(8192, 256) == 256  # smaller knob still wins
+
+
 def test_single_device_dispatcher_falls_back(monkeypatch):
     """make_attention's single-device path: ineligible shapes (seq not
     128-divisible) must route to plain_attention, not crash in the
