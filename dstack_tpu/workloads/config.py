@@ -140,8 +140,9 @@ class ModelConfig:
             # exactly what lets long-context no-remat fit.
             per_token += 2 * seq_len * self.n_heads * 4
         # The lm-head/loss residuals sit outside the scanned layers but
-        # compete for the same budget: f32 logits saved for the CE
-        # backward plus the normalized log-prob intermediate. Chunked CE
+        # compete for the same budget: the lse-form CE (train.ce_from_logits)
+        # saves the f32 logits for backward and nothing else vocab-wide.
+        # Chunked CE
         # recomputes the chunk logits in backward, keeping only the
         # final-norm hidden states plus one transient (chunk, V) buffer —
         # but loss_fn falls back to dense logits when the sequence does
@@ -150,7 +151,8 @@ class ModelConfig:
         if self.ce_chunk > 0 and seq_len and seq_len % self.ce_chunk == 0:
             head_per_token = d * db
         else:
-            head_per_token = self.vocab_size * (4 + db)
+            # lse-form CE saves the f32 logits only (no log-prob tensor).
+            head_per_token = self.vocab_size * 4
         act_bytes = (
             batch_tokens / max(act_shard, 1)
             * (per_token * self.n_layers + head_per_token)

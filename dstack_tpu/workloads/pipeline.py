@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.workloads.attention import make_attention_fn
 from dstack_tpu.workloads.config import ModelConfig
-from dstack_tpu.workloads.train import TrainState, make_optimizer
+from dstack_tpu.workloads.train import TrainState, ce_from_logits, make_optimizer
 from dstack_tpu.workloads.transformer import (
     _block,
     apply_remat,
@@ -179,15 +179,7 @@ def _pipeline_loss(
         "bsd,dv->bsv", h, params["lm_head"],
         preferred_element_type=jnp.float32,
     )
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        # Same contract as train.loss_fn: padding/prompt tokens excluded.
-        mask = mask.astype(jnp.float32)
-        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    else:
-        loss = jnp.mean(nll)
+    loss = ce_from_logits(logits, targets, batch.get("loss_mask"))
     return loss * is_last.astype(jnp.float32)
 
 
