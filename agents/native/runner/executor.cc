@@ -251,6 +251,41 @@ void Executor::kill_group(int sig) {
   if (pid > 0) kill(-pid, sig);
 }
 
+namespace {
+std::atomic<Executor*> g_orphan_guard{nullptr};
+
+void orphan_guard_handler(int) {
+  Executor* e = g_orphan_guard.load();
+  if (e) e->reap_group_signal_safe();
+  _exit(143);
+}
+}  // namespace
+
+void Executor::reap_group_signal_safe() {
+  pid_t pid = child_pid_.load();
+  if (pid <= 0) return;
+  kill(-pid, SIGTERM);
+  timespec ts{0, 100'000'000};  // 100ms
+  for (int i = 0; i < 50; ++i) {  // ~5s grace, then escalate
+    // Reap here (async-signal-safe): the worker thread that normally
+    // waitpids may be the very thread this handler preempted, and an
+    // unreaped zombie keeps the group "alive" for the kill(0) probe —
+    // without this, an instantly-dying job still burns the full grace.
+    waitpid(pid, nullptr, WNOHANG);
+    if (kill(-pid, 0) != 0) return;  // group fully gone
+    nanosleep(&ts, nullptr);
+  }
+  kill(-pid, SIGKILL);
+}
+
+void Executor::install_orphan_guard() {
+  g_orphan_guard.store(this);
+  struct sigaction sa {};
+  sa.sa_handler = orphan_guard_handler;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+}
+
 void Executor::stop(double grace_seconds) {
   stopping_ = true;
   if (child_pid_ <= 0) {

@@ -42,6 +42,17 @@ class Executor {
   Json pull(int64_t since_ms);
   Json metrics();
 
+  // Install SIGTERM/SIGINT handlers that TERM->KILL the job's process
+  // group before the runner exits. The graceful paths (stop API,
+  // max_duration) already kill_group; this covers the runner's OWN
+  // death — parent-death link, operator kill — where the job would
+  // otherwise outlive its agent holding the chip and its port (found
+  // by the chip e2e drill against the Python twin). Container runtime
+  // gets this from the shim's teardown; the process runtime has only us.
+  void install_orphan_guard();
+  // Async-signal-safe group reap used by the guard (kill/nanosleep only).
+  void reap_group_signal_safe();
+
   // Copy job log events from `index` on; returns the new index. Feeds the
   // /logs_ws stream (parity: runner/api/ws.go:28-62 jobLogsHistory replay).
   size_t job_logs_since(size_t index, std::vector<LogEvent>* out) const;

@@ -52,6 +52,7 @@ int main(int argc, char** argv) {
   }
 
   Executor executor(working_root);
+  executor.install_orphan_guard();
   HttpServer server(host, port);
 
   server.route("GET", "/api/healthcheck", [](const HttpRequest&) {

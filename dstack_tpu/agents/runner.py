@@ -470,23 +470,69 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    if args.parent_pid is not None:
-        parent = args.parent_pid
+    def _reap_job_group(executor: Executor, grace: float = 5.0) -> None:
+        """Synchronously TERM->KILL the job's process group.
 
-        def _parent_watch() -> None:
-            import time as _time
-
-            while True:
-                if os.getppid() != parent:  # reparented: spawner is gone
-                    os._exit(0)
-                _time.sleep(5)
-
-        import threading
-
-        threading.Thread(target=_parent_watch, daemon=True).start()
+        The runner must NEVER die leaving the job alive: a served model or
+        training loop that outlives its runner keeps the TPU busy and its
+        port bound with no orchestrator able to reach it (found by the
+        chip e2e drill — a stopped service's process answered the next
+        drill's requests). The graceful paths (stop API, max_duration)
+        already killpg; this covers the runner's OWN death: SIGTERM from
+        the parent-death link or operator, and the --parent-pid watchdog.
+        In the container runtime the shim's teardown provides this; the
+        process runtime has only us."""
+        proc = executor.proc
+        if proc is None or proc.returncode is not None:
+            return
+        try:
+            pgid = os.getpgid(proc.pid)
+        except ProcessLookupError:
+            return
+        try:
+            os.killpg(pgid, signal.SIGTERM)
+            deadline = time.monotonic() + grace
+            while time.monotonic() < deadline:
+                try:
+                    os.killpg(pgid, 0)
+                except ProcessLookupError:
+                    return
+                time.sleep(0.1)
+            os.killpg(pgid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
 
     async def _serve() -> None:
         app = create_runner_app(args.working_root, idle_shutdown=args.idle_shutdown)
+        executor: Executor = app.state["executor"]
+
+        if args.parent_pid is not None:
+            parent = args.parent_pid
+
+            def _parent_watch() -> None:
+                import time as _time
+
+                while True:
+                    if os.getppid() != parent:  # reparented: spawner is gone
+                        _reap_job_group(executor)
+                        os._exit(0)
+                    _time.sleep(5)
+
+            import threading
+
+            threading.Thread(target=_parent_watch, daemon=True).start()
+
+        loop = asyncio.get_event_loop()
+
+        def _terminate() -> None:
+            # Runs on the loop thread: safe to touch the executor. Reap
+            # synchronously (the loop is about to die with us anyway).
+            _reap_job_group(executor)
+            os._exit(143)
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, _terminate)
+
         server = Server(app, args.host, args.port)
         await server.start()
         if args.port_file:

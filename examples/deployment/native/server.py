@@ -96,7 +96,7 @@ class Engine:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
     def chat_stream(self, messages, max_tokens=None, temperature=None,
-                    top_p=None, stop=None):
+                    top_p=None, stop=None, usage_out=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
         `max_tokens` and `temperature` are the per-request OpenAI fields:
@@ -137,6 +137,11 @@ class Engine:
         else:
             stops = []  # malformed: no stop filtering (lenient like temp)
         tokens = self.encode(prompt + "\nassistant:")
+        if usage_out is not None:
+            # OpenAI usage accounting: real engine token counts, not a
+            # re-tokenization guess (byte vocab: one token per byte).
+            usage_out["prompt_tokens"] = int(tokens.shape[1])
+            usage_out["completion_tokens"] = 0
         out = self.serving.submit(
             [int(t) for t in tokens[0]], max_new_tokens=budget,
             temperature=temp, top_p=nucleus,
@@ -168,6 +173,8 @@ class Engine:
                     if buf:
                         yield buf  # incomplete stop prefix at end: emit
                     return
+                if usage_out is not None:
+                    usage_out["completion_tokens"] += 1
                 piece = dec.decode(bytes([int(tok) % 256]))
                 if not piece:
                     continue
@@ -183,6 +190,11 @@ class Engine:
                 if hit >= 0:
                     if buf[:hit]:
                         yield buf[:hit]
+                    if usage_out is not None:
+                        # OpenAI semantics: clients branch on this —
+                        # "length" makes them retry/continue a completion
+                        # that actually ended cleanly on a stop sequence.
+                        usage_out["finish_reason"] = "stop"
                     self.serving.cancel(out)  # free the slot early
                     return
                 keep = holdback(buf)
@@ -196,9 +208,9 @@ class Engine:
             self.serving.cancel(out)
 
     def chat(self, messages, max_tokens=None, temperature=None, top_p=None,
-             stop=None) -> str:
+             stop=None, usage_out=None) -> str:
         return "".join(self.chat_stream(messages, max_tokens, temperature,
-                                        top_p, stop))
+                                        top_p, stop, usage_out=usage_out))
 
 
 def main() -> None:
@@ -310,15 +322,18 @@ def main() -> None:
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if req.get("stream"):
                     return self._stream(req)
+                usage = {}
                 text = engine.chat(req.get("messages", []),
                                    req.get("max_tokens"), req.get("temperature"),
-                                   req.get("top_p"), req.get("stop"))
+                                   req.get("top_p"), req.get("stop"),
+                                   usage_out=usage)
             except EngineOverloadedError as e:
                 return self._send_overloaded(e)
             except ValueError as e:  # bad request field (e.g. temperature)
                 return self._send(400, {"error": str(e)})
             except Exception as e:  # surface engine errors as API errors
                 return self._send(500, {"error": str(e)})
+            finish = usage.pop("finish_reason", "length")
             self._send(200, {
                 "id": "chatcmpl-native",
                 "object": "chat.completion",
@@ -327,9 +342,10 @@ def main() -> None:
                 "choices": [{
                     "index": 0,
                     "message": {"role": "assistant", "content": text},
-                    "finish_reason": "length",
+                    "finish_reason": finish,
                 }],
-                "usage": {},
+                "usage": {**usage,
+                          "total_tokens": sum(usage.values())} if usage else {},
             })
 
     class ModelHTTPServer(ThreadingHTTPServer):

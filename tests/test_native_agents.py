@@ -7,6 +7,7 @@ server's RunnerClient/ShimClient speak (dstack_tpu/agents/protocol.py).
 
 import base64
 import json
+import os
 import re
 import shutil
 import subprocess
@@ -900,3 +901,65 @@ class TestShimFailurePaths:
         finally:
             proc.kill()
             proc.wait()
+
+
+class TestOrphanGuard:
+    """SIGTERM to the runner process must reap the JOB's process group.
+
+    The graceful paths (stop API, max_duration) already kill the group;
+    these pin the runner's OWN death — the parent-death link or an
+    operator kill — for both agents. Found by the chip e2e drill: a
+    stopped service's orphaned process kept the port bound and answered
+    the next drill's requests with stale code.
+    """
+
+    def _start_sleeper(self, start_cmd, tmp_path):
+        import signal as _signal
+
+        proc, port = _start(start_cmd)
+        marker = tmp_path / "job-pid"
+        base = f"http://127.0.0.1:{port}/api"
+        _req("POST", f"{base}/submit", {
+            "run_name": "orphan",
+            # resources present: the Python twin pydantic-validates the
+            # spec (the C++ agent is lenient about missing sub-objects).
+            "job_spec": _job_spec(
+                [f"echo $$ > {marker}", "sleep 300"],
+                requirements={"resources": {}},
+            ),
+        })
+        _req("POST", f"{base}/run", {})
+        deadline = time.time() + 10
+        while not marker.exists() or not marker.read_text().strip():
+            assert time.time() < deadline, "job never started"
+            time.sleep(0.05)
+        job_pid = int(marker.read_text())
+        os.kill(job_pid, 0)  # sanity: the job shell is alive
+        proc.send_signal(_signal.SIGTERM)
+        proc.wait(timeout=10)
+        # The whole job process group must be gone within the 5s grace.
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            try:
+                os.kill(job_pid, 0)
+            except ProcessLookupError:
+                return
+            time.sleep(0.1)
+        os.killpg(job_pid, 9)  # cleanup the whole group before failing loudly
+        raise AssertionError(f"job {job_pid} survived its runner's SIGTERM")
+
+    def test_cpp_runner_reaps_job_on_sigterm(self, binaries, tmp_path):
+        self._start_sleeper(
+            [binaries["runner"], "--port", 0,
+             "--working-root", tmp_path / "work"],
+            tmp_path,
+        )
+
+    def test_python_runner_reaps_job_on_sigterm(self, tmp_path):
+        import sys
+
+        self._start_sleeper(
+            [sys.executable, "-m", "dstack_tpu.agents.runner", "--port", "0",
+             "--working-root", tmp_path / "work"],
+            tmp_path,
+        )
