@@ -161,3 +161,79 @@ class TestMoEGenerate:
             greedy = int(jnp.argmax(logits[0, -1]))
             assert int(new[0, t]) == greedy, f"step {t}"
             seq = jnp.concatenate([seq, new[:, t : t + 1]], axis=1)
+
+
+class TestGatherDispatch:
+    """config.moe_impl="gather": the take/scatter formulation must equal
+    the einsum path exactly — same slot permutation, same drops, same
+    gate weighting (tests pin both clean and overflow regimes)."""
+
+    def _pair(self, c, key, shape):
+        p = _rand_params(key, c)
+        h = jax.random.normal(
+            jax.random.fold_in(key, 7), shape, dtype=jnp.float32
+        ).astype(jnp.bfloat16)
+        out_e, aux_e = moe_mlp(c, h, p)
+        out_g, aux_g = moe_mlp(c.with_(moe_impl="gather"), h, p)
+        return out_e, aux_e, out_g, aux_g
+
+    def test_matches_einsum_no_drops(self):
+        c = CFG.with_(capacity_factor=8.0)
+        out_e, aux_e, out_g, aux_g = self._pair(
+            c, jax.random.PRNGKey(11), (2, 16, c.d_model))
+        np.testing.assert_allclose(
+            np.asarray(out_e, np.float32), np.asarray(out_g, np.float32),
+            rtol=2e-2, atol=2e-3,  # einsum path rounds the gate to bf16
+        )
+        assert float(aux_e) == float(aux_g)
+
+    def test_matches_einsum_with_overflow_drops(self):
+        c = CFG.with_(capacity_factor=0.25)
+        out_e, _, out_g, _ = self._pair(
+            c, jax.random.PRNGKey(12), (1, 32, c.d_model))
+        np.testing.assert_allclose(
+            np.asarray(out_e, np.float32), np.asarray(out_g, np.float32),
+            rtol=2e-2, atol=2e-3,
+        )
+
+    def test_gradients_match_einsum(self):
+        c = CFG.with_(capacity_factor=1.0)
+        p = _rand_params(jax.random.PRNGKey(13), c)
+        h = jax.random.normal(
+            jax.random.PRNGKey(14), (2, 16, c.d_model), jnp.float32
+        ).astype(jnp.bfloat16)
+
+        def loss(params, cfg):
+            out, aux = moe_mlp(cfg, h, params)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + aux
+
+        g_e = jax.grad(loss)(p, c)
+        g_g = jax.grad(loss)(p, c.with_(moe_impl="gather"))
+        # The einsum path rounds the gate to bf16 inside combine (the
+        # gather path keeps it f32), so the two formulations are slightly
+        # different FUNCTIONS at bf16 — gradients agree to bf16 rounding
+        # accumulated over the token sum, tightest for the expert banks
+        # and loosest for the router (whose grad flows entirely through
+        # the gate). Elementwise for the banks; relative L2 for router.
+        for k in ("we_gate", "we_up", "we_down"):
+            np.testing.assert_allclose(
+                np.asarray(g_e[k], np.float32), np.asarray(g_g[k], np.float32),
+                rtol=1e-1, atol=1e-1,
+            )
+        re_ = np.asarray(g_e["router"], np.float32)
+        rg = np.asarray(g_g["router"], np.float32)
+        rel_l2 = np.linalg.norm(re_ - rg) / max(np.linalg.norm(re_), 1e-9)
+        assert rel_l2 < 0.05, rel_l2
+
+    def test_trains_on_mesh_with_expert_parallelism(self):
+        c = PRESETS["tiny-moe"].with_(moe_impl="gather")
+        mesh = make_mesh(data=2, fsdp=1, seq=1, model=2, expert=2)
+        state = init_train_state(c, jax.random.PRNGKey(0), mesh=mesh,
+                                 learning_rate=1e-2)
+        step = make_train_step(c, mesh, learning_rate=1e-2)
+        batch = synthetic_batch(c, batch_size=4, seq_len=32, mesh=mesh)
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
