@@ -141,6 +141,17 @@ def make_insert():
     return insert
 
 
+def _any_active_nucleus(state: DecodeState) -> jnp.ndarray:
+    """True when any LIVE slot wants nucleus filtering.
+
+    Gates the per-step sort/cumsum branch in make_decode_step. Must look
+    only at active slots: retire keeps the old top_p in the freed row,
+    and a stale < 1 value must not tax default traffic forever (pinned
+    by tests/test_serving.py::test_nucleus_gate_ignores_retired_slots).
+    """
+    return jnp.any(state.active & (state.top_p < 1.0))
+
+
 def make_decode_step(config: ModelConfig, steps: int = 1):
     """decode_step(params, state, rng) -> (state, tokens (B, steps), active).
 
@@ -193,7 +204,7 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
         # slot at top_p=1): lax.cond executes one branch at runtime, so
         # unfiltered serving pays only the predicate.
         filtered = lax.cond(
-            jnp.any(state.top_p < 1.0),
+            _any_active_nucleus(state),
             lambda x: jax.vmap(_nucleus_filter)(x, state.top_p),
             lambda x: x,
             scaled,
@@ -490,6 +501,11 @@ class ServingEngine:
             if req.max_new_tokens <= 1:
                 with self._lock:
                     self._inflight.discard(req.out)
+                    # cancel() racing this completion may have moved the
+                    # queue to _cancelled already; every completion path
+                    # must clear both sets or the entry leaks for the
+                    # engine's lifetime.
+                    self._cancelled.discard(req.out)
                 req.out.put(None)
                 self.state = self._retire(slot)
             else:

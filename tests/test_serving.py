@@ -355,3 +355,71 @@ def test_cancel_pending_request(params):
         _drain(qa)
     finally:
         engine.close()
+
+
+def test_nucleus_gate_ignores_retired_slots(params):
+    """A completed top_p request must not leave the per-step nucleus
+    filter armed for default traffic: retire keeps the old top_p in the
+    DecodeState row, so the gate (serving._any_active_nucleus) may look
+    only at ACTIVE slots."""
+    from dstack_tpu.workloads.serving import _any_active_nucleus
+
+    engine = ServingEngine(CFG, params, slots=2, max_len=64)
+    try:
+        out = engine.submit([1, 2, 3], max_new_tokens=4,
+                            temperature=0.8, top_p=0.5)
+        _drain(out)
+        state = engine.state
+        # The regression state: no slot live, the stale 0.5 still in row 0.
+        assert not bool(jnp.any(state.active))
+        assert bool(jnp.any(state.top_p < 1.0))
+        assert not bool(_any_active_nucleus(state)), (
+            "stale top_p in a retired slot armed the nucleus branch"
+        )
+        # And a live nucleus slot must still arm it.
+        armed = state._replace(
+            active=state.active.at[0].set(True),
+        )
+        assert bool(_any_active_nucleus(armed))
+        # Default traffic after the stale slot still matches greedy.
+        out2 = engine.submit([1, 2, 3], max_new_tokens=4)
+        assert _drain(out2) == _reference(params, [1, 2, 3], 4)[:4]
+    finally:
+        engine.close()
+
+
+def test_one_token_completion_clears_cancel_race(params):
+    """Every completion path must clear BOTH _inflight and _cancelled.
+
+    Deterministic interleaving: _admit checks _cancelled BEFORE the
+    prefill, so blocking the prefill and cancelling while blocked lands
+    the cancel exactly in the window the leak needs — past the queued-
+    cancel branch, before the one-token completion's discards."""
+    import threading
+
+    engine = ServingEngine(CFG, params, slots=1, max_len=16)
+    try:
+        started, release = threading.Event(), threading.Event()
+        real_prefill = engine._prefill
+
+        def blocking_prefill(p, toks):
+            started.set()
+            assert release.wait(30)
+            return real_prefill(p, toks)
+
+        engine._prefill = blocking_prefill
+        out = engine.submit([1, 2], max_new_tokens=1)
+        assert started.wait(30), "engine never admitted the request"
+        engine.cancel(out)  # lands mid-admission: in _inflight, past the check
+        release.set()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with engine._lock:
+                if not engine._cancelled and not engine._inflight:
+                    break
+            time.sleep(0.02)
+        with engine._lock:
+            assert not engine._cancelled, "cancel-race leaked a queue entry"
+            assert not engine._inflight
+    finally:
+        engine.close()
