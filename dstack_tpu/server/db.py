@@ -287,8 +287,12 @@ _SQLITE_TO_PG = [
 ]
 
 # Split DDL into translatable code vs verbatim segments: single-quoted
-# literals (with '' escapes) and `--` line comments pass through untouched.
-_DDL_SEGMENTS = re.compile(r"('(?:[^']|'')*')|(--[^\n]*)", re.DOTALL)
+# literals (with '' escapes), double-quoted IDENTIFIERS (a column named
+# "real" or "blob" must not be rewritten to a type), and `--` line
+# comments pass through untouched.
+_DDL_SEGMENTS = re.compile(
+    r"('(?:[^']|'')*')|(\"(?:[^\"]|\"\")*\")|(--[^\n]*)", re.DOTALL
+)
 
 
 def translate_ddl(sql: str) -> str:

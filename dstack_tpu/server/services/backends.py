@@ -21,22 +21,30 @@ def local_backend_enabled() -> bool:
     return os.getenv("DSTACK_TPU_LOCAL_BACKEND", "1") != "0"
 
 
-_env_local_conf: Optional[Dict[str, Any]] = None
+_env_local_conf: Optional[Tuple[str, Dict[str, Any]]] = None  # (raw, parsed)
 
 
 def env_local_backend_config() -> Dict[str, Any]:
-    """DSTACK_TPU_LOCAL_BACKEND_CONFIG (JSON), parsed and validated once.
+    """DSTACK_TPU_LOCAL_BACKEND_CONFIG (JSON), parsed and validated,
+    cached per raw value.
 
     The knob exists for subprocess servers (restart drills, probes) that
     cannot reach ctx.overrides. Called at app startup so a malformed
-    value fails the BOOT with a clear message, not every later request;
-    applying it is logged because an ambient export changes agent
+    value fails that boot with a clear message; the cache re-keys on the
+    raw env value so a second app booted in the same process sees the
+    current export (a value changed to garbage MID-process therefore
+    surfaces on the next read instead of being masked by the old parse).
+    Applying it is logged because an ambient export changes agent
     lifetime semantics (detach_agents)."""
     global _env_local_conf
-    if _env_local_conf is None:
-        raw = os.getenv("DSTACK_TPU_LOCAL_BACKEND_CONFIG", "")
+    raw = os.getenv("DSTACK_TPU_LOCAL_BACKEND_CONFIG", "")
+    # Cache keyed by the raw value, not first-call-wins: a second app
+    # booted in the same process after the env var changed (tests,
+    # probes, embedded servers) must see the current value, and a cached
+    # empty {} must not mask a later export.
+    if _env_local_conf is None or _env_local_conf[0] != raw:
         if not raw:
-            _env_local_conf = {}
+            _env_local_conf = (raw, {})
         else:
             try:
                 conf = json.loads(raw)
@@ -51,8 +59,8 @@ def env_local_backend_config() -> Dict[str, Any]:
                 "local backend configured from DSTACK_TPU_LOCAL_BACKEND_CONFIG: %s",
                 raw,
             )
-            _env_local_conf = conf
-    return _env_local_conf
+            _env_local_conf = (raw, conf)
+    return _env_local_conf[1]
 
 
 def _make_compute(backend_type: BackendType, config: Dict[str, Any]) -> Compute:

@@ -427,7 +427,9 @@ function chart(points, opts) {
    * [{t: epoch_ms, v: number|null}] oldest-first; gaps (null v) break the
    * line instead of interpolating across missing samples. */
   const o = Object.assign({ w: 300, h: 84, max: 0, fmt: (v) => v.toFixed(0) }, opts || {});
-  const pts = points.filter((p) => p.v != null && p.t != null);
+  // isFinite (not != null): a NaN timestamp from a bad Date.parse would
+  // make t0/t1 NaN and blank the entire chart.
+  const pts = points.filter((p) => Number.isFinite(p.v) && Number.isFinite(p.t));
   if (pts.length < 2) return `<span class="muted">not enough samples yet</span>`;
   const padL = 34, padB = 14, padT = 4, padR = 4;
   const iw = o.w - padL - padR, ih = o.h - padT - padB;
@@ -437,9 +439,16 @@ function chart(points, opts) {
   const Y = (v) => padT + (1 - v / top) * ih;
   // polyline segments: break where the source series had a null
   const segs = [];
+  // Iterate the RAW series so null-v gaps still break the line, but only
+  // plot points that survived the finite filter (a point with v set and
+  // t missing must not emit NaN coordinates and drop its polyline).
   let cur = [];
   for (const p of points) {
-    if (p.v == null) { if (cur.length > 1) segs.push(cur); cur = []; continue; }
+    if (!Number.isFinite(p.v) || !Number.isFinite(p.t)) {
+      if (cur.length > 1) segs.push(cur);
+      cur = [];
+      continue;
+    }
     cur.push(`${X(p.t).toFixed(1)},${Y(p.v).toFixed(1)}`);
   }
   if (cur.length > 1) segs.push(cur);

@@ -148,8 +148,23 @@ def _any_active_nucleus(state: DecodeState) -> jnp.ndarray:
     only at active slots: retire keeps the old top_p in the freed row,
     and a stale < 1 value must not tax default traffic forever (pinned
     by tests/test_serving.py::test_nucleus_gate_ignores_retired_slots).
+    Greedy slots (temperature 0) discard their sampled value entirely,
+    so their top_p must not arm the branch either — the OpenAI-SDK
+    combo {"temperature": 0, "top_p": 0.9} is routine.
     """
-    return jnp.any(state.active & (state.top_p < 1.0))
+    return jnp.any(
+        state.active & (state.top_p < 1.0) & (state.temperature > 0.0)
+    )
+
+
+def _any_active_sampling(state: DecodeState) -> jnp.ndarray:
+    """True when any LIVE slot samples (temperature > 0).
+
+    Gates the categorical branch: an all-greedy batch (the default
+    engine) compiles back to the argmax-only step instead of paying
+    gumbel RNG + a second vocab-wide argmax per decode step whose
+    result every slot discards."""
+    return jnp.any(state.active & (state.temperature > 0.0))
 
 
 def make_decode_step(config: ModelConfig, steps: int = 1):
@@ -199,17 +214,28 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
         # keeps every token whose PRECEDING cumulative mass is < p, so
         # the top token always survives and p=1 keeps all).
         temps = state.temperature
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        # Skip the sort/cumsum entirely on the DEFAULT path (every live
-        # slot at top_p=1): lax.cond executes one branch at runtime, so
-        # unfiltered serving pays only the predicate.
-        filtered = lax.cond(
-            _any_active_nucleus(state),
-            lambda x: jax.vmap(_nucleus_filter)(x, state.top_p),
-            lambda x: x,
-            scaled,
+        # Two nested runtime branches keep the DEFAULT paths free:
+        # an all-greedy batch (every live temp 0) never scales, filters,
+        # or draws gumbels — it compiles back to the argmax-only step;
+        # a sampling batch with every live top_p=1 skips the vocab-wide
+        # sort/cumsum. lax.cond executes one branch at runtime, so each
+        # skipped stage costs only its predicate.
+        def _sample(x):
+            scaled = x / jnp.maximum(temps, 1e-6)[:, None]
+            filtered = lax.cond(
+                _any_active_nucleus(state),
+                lambda s: jax.vmap(_nucleus_filter)(s, state.top_p),
+                lambda s: s,
+                scaled,
+            )
+            return jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
+
+        sampled = lax.cond(
+            _any_active_sampling(state),
+            _sample,
+            lambda x: jnp.zeros((x.shape[0],), jnp.int32),  # value unused
+            logits,
         )
-        sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         next_token = jnp.where(temps > 0, sampled, greedy)
 
@@ -418,10 +444,36 @@ class ServingEngine:
         """Abandon the request whose submit() returned `out` — the slot
         (or pending entry) is freed at the next chunk boundary. Safe from
         any thread; idempotent; unknown queues are ignored. The consumer
-        receives the clean-end None once the loop processes it."""
+        receives the clean-end None once the loop processes it (a
+        still-queued request is purged and answered immediately)."""
         with self._lock:
-            if out in self._inflight:
-                self._cancelled.add(out)
+            if out not in self._inflight:
+                return
+            # Purge a still-QUEUED request right here rather than leaving
+            # a tombstone for _admit: dead entries would keep counting in
+            # the admission backlog and stats()["pending"], shedding new
+            # traffic below the real max_pending bound under cancel-heavy
+            # load (disconnecting clients cancel from a finally:).
+            # queue.Queue is internally locked, so draining interleaves
+            # safely with the loop thread's get_nowait; order of the
+            # survivors is preserved.
+            drained, found = [], False
+            while True:
+                try:
+                    r = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if r.out is out:
+                    found = True
+                else:
+                    drained.append(r)
+            for r in drained:
+                self._pending.put(r)
+            if found:
+                self._inflight.discard(out)
+                out.put(None)
+                return
+            self._cancelled.add(out)
         self._wake.set()
 
     def stats(self) -> Dict[str, Any]:

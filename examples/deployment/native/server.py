@@ -114,7 +114,12 @@ class Engine:
         temp = None
         if temperature is not None:
             try:
-                temp = max(0.0, float(temperature))
+                v = float(temperature)
+                # max(0.0, nan) is 0.0 — NaN would silently mean GREEDY
+                # instead of "malformed: engine default" (the engine
+                # itself rejects NaN with 400; match the top_p branch).
+                if v == v and v != float("inf"):
+                    temp = max(0.0, v)
             except (TypeError, ValueError):
                 pass  # malformed: engine default
         nucleus = 1.0

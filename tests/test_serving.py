@@ -423,3 +423,56 @@ def test_one_token_completion_clears_cancel_race(params):
             assert not engine._inflight
     finally:
         engine.close()
+
+
+def test_greedy_top_p_does_not_arm_nucleus_branch(params):
+    """{"temperature": 0, "top_p": 0.9} (a routine OpenAI-SDK combo) must
+    not arm the per-step sort/cumsum: a greedy slot discards its sampled
+    value, so only sampling slots may gate the filter."""
+    from dstack_tpu.workloads.serving import (
+        _any_active_nucleus,
+        _any_active_sampling,
+    )
+
+    engine = ServingEngine(CFG, params, slots=2, max_len=64)
+    try:
+        out = engine.submit([1, 2, 3], max_new_tokens=4,
+                            temperature=0.0, top_p=0.9)
+        toks = _drain(out)
+        # Greedy output unchanged by the (unarmed) filter.
+        assert toks == _reference(params, [1, 2, 3], 4)[:4]
+        state = engine.state
+        armed = state._replace(active=state.active.at[0].set(True))
+        assert not bool(_any_active_nucleus(armed))
+        assert not bool(_any_active_sampling(armed))
+    finally:
+        engine.close()
+
+
+def test_cancelled_queued_requests_leave_the_backlog(params):
+    """cancel() must purge a still-queued request immediately: dead
+    entries counted in the admission backlog would shed new traffic
+    below the real max_pending bound under cancel-heavy load."""
+    engine = ServingEngine(CFG, params, slots=1, max_len=64, max_pending=2)
+    try:
+        hog = engine.submit([1, 2, 3], max_new_tokens=40)  # occupies the slot
+        # Wait until the hog is IN the slot (not queued).
+        deadline = time.monotonic() + 30
+        while engine.stats()["active"] == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        q1 = engine.submit([4, 5], max_new_tokens=4)
+        q2 = engine.submit([6, 7], max_new_tokens=4)
+        with pytest.raises(Exception):  # backlog full at max_pending=2
+            engine.submit([8, 9], max_new_tokens=4)
+        engine.cancel(q1)
+        engine.cancel(q2)
+        assert q1.get(timeout=5) is None  # purged = answered immediately
+        assert q2.get(timeout=5) is None
+        assert engine.stats()["pending"] == 0
+        # The freed backlog admits new work right away.
+        q3 = engine.submit([8, 9], max_new_tokens=4)
+        engine.cancel(hog)
+        assert len(_drain(q3)) == 4
+    finally:
+        engine.close()
