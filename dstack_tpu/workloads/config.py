@@ -198,6 +198,15 @@ PRESETS: Dict[str, ModelConfig] = {
         vocab_size=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
         d_ff=5632, max_seq_len=2048,
     ),
+    # smol-1b at 8k context (long-rope), the longctx-v5e.yml example:
+    # 14.6k tok/s measured on one v5e at full 16-layer depth (auto remat
+    # picks "dots"; the half-depth bench shape runs remat-free at 29.5k).
+    # Unlocked by the O(S) flash backward + the 512 tile cap —
+    # docs/design/perf.md "Long context on one chip".
+    "smol-1b-8k": ModelConfig(
+        vocab_size=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=5632, max_seq_len=8192, rope_theta=1e6,
+    ),
     # llama-8b-shaped, for v5p-8 and up.
     "llama-8b": ModelConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
