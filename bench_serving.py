@@ -17,15 +17,27 @@ Metrics per scenario:
   published tpt_p50=0.0); the per-stream cadence is the number a client
   actually experiences.
 
-The final scenario exercises admission control: slots oversubscribed 2x
-with `max_pending` bounded — overflow is rejected with a Retry-After
-hint and the client retries; TTFT of ACCEPTED requests stays bounded
-instead of the 10.8 s p50 measured unbounded in r4.
+Each scenario also records the engine's own view of the run: the TTFT
+breakdown (queue wait -> prefill -> first chunk, from the scheduler's
+EWMA gauges) and the decode/prefill/idle utilization split — the numbers
+that show whether prefill is stealing decode time (the r05 failure mode:
+agg tok/s flat 675.8 -> 669.2 going 16 -> 32 streams while TTFT p95 hit
+4.6 s, classic prefill head-of-line blocking, fixed by the overlapped
+scheduler).
 
-Writes BENCH_serving_r05.json and prints one JSON line per scenario.
+The admission-control scenario exercises shedding: slots oversubscribed
+2x with `max_pending` bounded — overflow is rejected with a Retry-After
+hint and the client retries; TTFT of ACCEPTED requests stays bounded
+instead of the 10.8 s p50 measured unbounded in r4. The prefill-heavy
+scenario (long prompts, short generations) isolates prefill/decode
+overlap: sequential admission serializes the long prefills in front of
+every decode chunk, overlap hides them behind it.
+
+Writes BENCH_serving_r06.json and prints one JSON line per scenario.
 Regression guard: tests/test_serving.py pins engine==one-shot decode
 numerics; this file pins the performance claim (continuous batching must
-show a multi-x aggregate over batch-1).
+show a multi-x aggregate over batch-1, and TTFT p95 at 32 streams must
+stay bounded while agg tok/s holds the 16-stream plateau).
 """
 
 import json
@@ -47,7 +59,7 @@ MAX_LEN = 512
 SLOTS = 16  # engine batch width; streams beyond this queue
 
 
-def _drain_timed(q: "queue.Queue[object]", t0: float) -> Dict:
+def _drain_timed(q: "queue.Queue[object]", t0: float, n_expected: int) -> Dict:
     ts: List[float] = []
     while True:
         item = q.get(timeout=600)
@@ -56,7 +68,7 @@ def _drain_timed(q: "queue.Queue[object]", t0: float) -> Dict:
         if isinstance(item, BaseException):
             raise item
         ts.append(time.perf_counter())
-    assert len(ts) == NEW_TOKENS, len(ts)
+    assert len(ts) == n_expected, len(ts)
     # Effective per-token cadence for THIS stream: tokens land in
     # steps_per_sync bursts, so per-delta percentiles are ~0/meaningless;
     # span/(n-1) is the cadence a client sees.
@@ -68,15 +80,19 @@ def _pct(xs, p):
     return xs[min(len(xs) - 1, int(p * len(xs)))]
 
 
-def run_scenario(engine: ServingEngine, streams: int, retry: bool = False) -> Dict:
+def run_scenario(engine: ServingEngine, streams: int, retry: bool = False,
+                 prompt_len: int = None, new_tokens: int = None) -> Dict:
     from dstack_tpu.workloads.serving import EngineOverloadedError
 
+    prompt_len = PROMPT_LEN if prompt_len is None else prompt_len
+    new_tokens = NEW_TOKENS if new_tokens is None else new_tokens
     prompts = [
-        [((i * 37 + j * 13) % 30000) + 1 for j in range(PROMPT_LEN)]
+        [((i * 37 + j * 13) % 30000) + 1 for j in range(prompt_len)]
         for i in range(streams)
     ]
     results: List[Dict] = [None] * streams  # type: ignore
     retries = [0] * streams
+    stats0 = engine.stats()  # counter snapshot: per-scenario util diffs
     t0 = time.perf_counter()
 
     def worker(i: int) -> None:
@@ -87,14 +103,14 @@ def run_scenario(engine: ServingEngine, streams: int, retry: bool = False) -> Di
             # in-engine latency SLO.
             t_submit = time.perf_counter()
             try:
-                q = engine.submit(prompts[i], max_new_tokens=NEW_TOKENS)
+                q = engine.submit(prompts[i], max_new_tokens=new_tokens)
             except EngineOverloadedError as e:
                 if not retry:
                     raise
                 retries[i] += 1
                 time.sleep(e.retry_after)
                 continue
-            results[i] = _drain_timed(q, t_submit)
+            results[i] = _drain_timed(q, t_submit, new_tokens)
             return
 
     threads = [threading.Thread(target=worker, args=(i,)) for i in range(streams)]
@@ -107,14 +123,47 @@ def run_scenario(engine: ServingEngine, streams: int, retry: bool = False) -> Di
     cadences = sorted(r["cadence"] for r in results)
     total = sum(r["n"] for r in results)
 
+    # The engine's own breakdown of the TTFT it just served, from the
+    # summary counters diffed across the scenario (exact per-scenario
+    # means — the EWMA gauges carry compile-spike history from warmup):
+    # queue wait (submit -> admission), prefill (admission -> first
+    # token, which under the overlapped scheduler includes the decode
+    # chunk it hid behind), and the residual of the measured client-side
+    # p50. Plus the decode/prefill/idle wall-time split — the gauges
+    # that pin "prefill never stalls decode" on hardware-free CI where
+    # absolute tok/s means nothing.
+    stats = engine.stats()
+    n_adm = max(1, stats["admitted_total"] - stats0["admitted_total"])
+    queue_ms = (
+        stats["queue_wait_seconds_sum"] - stats0["queue_wait_seconds_sum"]
+    ) / n_adm * 1e3
+    prefill_ms = (
+        stats["prefill_seconds_sum"] - stats0["prefill_seconds_sum"]
+    ) / n_adm * 1e3
+    ttft_p50 = _pct(ttfts, 0.50)
+    spans = {
+        k: stats[f"{k}_seconds_total"] - stats0[f"{k}_seconds_total"]
+        for k in ("decode", "prefill", "idle")
+    }
+    span_total = sum(spans.values()) or 1.0
     out = {
         "streams": streams,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
         "agg_tok_s": round(total / wall, 1),
-        "ttft_p50_ms": round(_pct(ttfts, 0.50), 1),
+        "ttft_p50_ms": round(ttft_p50, 1),
         "ttft_p95_ms": round(_pct(ttfts, 0.95), 1),
         "tpt_p50_ms": round(_pct(cadences, 0.50), 2),
         "tpt_p95_ms": round(_pct(cadences, 0.95), 2),
         "wall_s": round(wall, 2),
+        "ttft_breakdown_ms": {
+            "queue_wait": round(queue_ms, 1),
+            "prefill": round(prefill_ms, 1),
+            "first_chunk_residual": round(
+                max(0.0, ttft_p50 - queue_ms - prefill_ms), 1
+            ),
+        },
+        "util": {k: round(v / span_total, 4) for k, v in spans.items()},
     }
     if retry:
         out["sheds"] = sum(retries)
@@ -135,6 +184,7 @@ def main() -> None:
         "prompt_len": PROMPT_LEN,
         "new_tokens": NEW_TOKENS,
         "slots": SLOTS,
+        "max_prefills_per_chunk": 4,  # engine default; the fairness knob
         "device": jax.devices()[0].device_kind,
         # Context for reading the numbers: this dev chip sits behind a
         # tunnel with ~hundreds-of-ms RTT, and the engine pays one host
@@ -182,6 +232,27 @@ def main() -> None:
     finally:
         engine.close()
 
+    # Prefill-heavy: long prompts, short generations — the shape that
+    # made the r05 sequential admission serialize ~16 prefills in front
+    # of every decode chunk. With overlap, prefill host work hides
+    # behind the decode chunk; the scenario's util split shows how much
+    # decode time admission still costs.
+    pf_prompt = min(256, MAX_LEN - 32) if on_tpu else 16
+    pf_new = 16 if on_tpu else 4
+    pf_streams = SLOTS * 2 if on_tpu else 4
+    engine = ServingEngine(
+        config, params, slots=SLOTS, max_len=MAX_LEN, steps_per_sync=32,
+    )
+    try:
+        run_scenario(engine, 1, prompt_len=pf_prompt, new_tokens=pf_new)
+        s = {"dtype": "bf16", "steps_per_sync": 32, "shape": "prefill_heavy",
+             **run_scenario(engine, pf_streams, prompt_len=pf_prompt,
+                            new_tokens=pf_new)}
+        out["scenarios"].append(s)
+        print(json.dumps(s), flush=True)
+    finally:
+        engine.close()
+
     agg = {s["streams"]: s["agg_tok_s"] for s in out["scenarios"]
            if s["dtype"] == "bf16" and s["steps_per_sync"] == 4}
     if len(agg) > 1:
@@ -189,7 +260,7 @@ def main() -> None:
         print(f"# continuous batching: {out['batching_speedup']}x aggregate"
               f" over batch-1 ({max(agg.values()):.0f} vs {agg[1]:.0f} tok/s)",
               flush=True)
-    with open("BENCH_serving_r05.json", "w") as f:
+    with open("BENCH_serving_r06.json", "w") as f:
         json.dump(out, f, indent=1)
 
 

@@ -7,6 +7,8 @@ same replica must resolve to the exact target topology fixed at plan time so
 the gang size is stable.
 """
 
+import asyncio
+import logging
 from typing import List, Optional, Tuple
 
 from dstack_tpu.backends.base.compute import Compute
@@ -24,6 +26,36 @@ from dstack_tpu.models.runs import (
 )
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.services import backends as backends_service
+
+
+# Per-backend budget for one get_offers call. Offers are advisory (the
+# scheduler re-validates at provision time), so a slow cloud API is worth
+# less than the latency it adds to every plan/submit for all backends.
+OFFER_FETCH_TIMEOUT_S = 30.0
+
+
+async def _fetch_backend_offers(
+    backend_type: BackendType,
+    compute: Compute,
+    requirements: Requirements,
+) -> List[InstanceOfferWithAvailability]:
+    """One backend's offers, bounded by OFFER_FETCH_TIMEOUT_S; errors and
+    timeouts log (per backend, as the sequential loop did) and yield []."""
+    try:
+        return await asyncio.wait_for(
+            compute.get_offers(requirements), OFFER_FETCH_TIMEOUT_S
+        )
+    except asyncio.TimeoutError:
+        logging.getLogger(__name__).warning(
+            "get_offers for %s timed out after %.0fs",
+            backend_type, OFFER_FETCH_TIMEOUT_S,
+        )
+        return []
+    except Exception:
+        logging.getLogger(__name__).exception(
+            "get_offers failed for %s", backend_type
+        )
+        return []
 
 
 def requirements_from_profile(resources, profile: Profile) -> Requirements:
@@ -55,14 +87,18 @@ async def get_offers_by_requirements(
 
     target_topo = resolve_target_topology(requirements)
     out: List[Tuple[Compute, InstanceOfferWithAvailability]] = []
-    for backend_type, compute in backends:
-        try:
-            offers = await compute.get_offers(requirements)
-        except Exception:
-            import logging
-
-            logging.getLogger(__name__).exception("get_offers failed for %s", backend_type)
-            continue
+    # Fan out across backends concurrently: provisioning latency is the
+    # SLOWEST cloud API, not the sum of all of them, and a hung backend
+    # is cut off at OFFER_FETCH_TIMEOUT_S instead of serializing every
+    # other backend behind it. Failures (including timeout) degrade to
+    # "no offers from that backend", logged per backend as before.
+    results = await asyncio.gather(
+        *(
+            _fetch_backend_offers(backend_type, compute, requirements)
+            for backend_type, compute in backends
+        )
+    )
+    for (backend_type, compute), offers in zip(backends, results):
         for offer in offers:
             if target_topo is not None:
                 tpu = offer.instance.resources.tpu

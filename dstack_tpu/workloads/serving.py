@@ -7,15 +7,23 @@ MXU busy while serving many streams. Everything is static-shaped and
 compiles three kinds of program:
 
 - prefill (one per prompt-length bucket): runs the prompt through the
-  cached forward, returns the slot's KV rows + first-token logits;
-- insert: writes a prefilled slot into the shared decode state (donated);
+  cached forward, returns the slot's KV rows + the FIRST TOKEN, sampled
+  on device — admission needs no host round-trip;
+- insert: writes a BATCH of prefilled requests (same prompt bucket) into
+  the shared decode state in one donated call;
 - decode_step: one token for ALL active slots — per-slot positions, a
   per-row validity mask instead of generate.py's shared scalar length.
 
 The host loop (`ServingEngine`) owns request queues and streams tokens
-out as they land, which is what SSE serving wants. Greedy decoding keeps
-slot results bit-identical to `generate(temperature=0)` — pinned by
-tests/test_serving.py.
+out as they land, which is what SSE serving wants. Prefill never stalls
+decode: each iteration dispatches the decode chunk first (JAX async
+dispatch returns immediately), then does admission host work — popping
+pending requests and dispatching their prefills — WHILE the chunk
+executes on device, and only then syncs on the chunk's tokens. Up to
+`max_prefills_per_chunk` requests are admitted per chunk boundary so
+decode cadence stays bounded under admission bursts. Greedy decoding
+keeps slot results bit-identical to `generate(temperature=0)` — pinned
+by tests/test_serving.py.
 
 Prefill/insert compile once per distinct prompt LENGTH — callers should
 bucket prompts (pad at the content level like the example server does,
@@ -96,12 +104,19 @@ def _decode_attention(q, ck, cv, valid_len):
 
 
 def make_prefill(config: ModelConfig):
-    """prefill(params, tokens (1, S)) -> (k (L,1,S,KV,hd), v, logits (V,)).
-    Jit once per prompt bucket S."""
+    """prefill(params, tokens (1, S), temp, top_p, rng) ->
+    (k (L,1,S,KV,hd), v, first_token ()).
+
+    First-token sampling is folded into the jitted program (greedy argmax
+    when temp == 0, else temperature-scaled categorical with the shared
+    `_nucleus_filter`), so admission never blocks the host on a device
+    readback — the loop can dispatch prefills while a decode chunk runs
+    and fetch the token later. `temp`/`top_p`/`rng` are traced, so the
+    compile cache stays one entry per prompt bucket S."""
     c = config
 
     @jax.jit
-    def prefill(params, tokens):
+    def prefill(params, tokens, temp, top_p, rng):
         cache = KVCache(
             k=jnp.zeros(
                 (c.n_layers, 1, tokens.shape[1], c.n_kv_heads, c.head_dim),
@@ -114,28 +129,51 @@ def make_prefill(config: ModelConfig):
             length=jnp.zeros((), jnp.int32),
         )
         logits, cache = _forward_cached(c, params, tokens, cache)
-        return cache.k, cache.v, logits[0]
+        row = logits[0]
+
+        def _sample(x):
+            scaled = x / jnp.maximum(temp, 1e-6)
+            filtered = lax.cond(
+                top_p < 1.0,
+                lambda s: _nucleus_filter(s, top_p),
+                lambda s: s,
+                scaled,
+            )
+            return jax.random.categorical(rng, filtered).astype(jnp.int32)
+
+        first = lax.cond(
+            temp > 0.0,
+            _sample,
+            lambda x: jnp.argmax(x).astype(jnp.int32),
+            row,
+        )
+        return cache.k, cache.v, first
 
     return prefill
 
 
 def make_insert():
-    """insert(state, slot, k_rows, v_rows, seq_len, token, budget, temp,
-    top_p) — write a prefilled request into a free slot. One compile per
-    prefill bucket (k_rows' S differs); slot/lengths/temp are traced."""
+    """insert(state, slots (N,), k_rows (L,N,S,KV,hd), v_rows, seq_lens
+    (N,), tokens (N,), budgets (N,), temps (N,), top_ps (N,)) — write N
+    prefilled requests of the SAME prompt bucket S into their slots in
+    one donated call (one scatter per state leaf instead of one device
+    call per request). One compile per (N, S) pair; N is bounded by
+    `max_prefills_per_chunk`, S by the caller's prompt bucketing, so the
+    cache stays small."""
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def insert(state: DecodeState, slot, k_rows, v_rows, seq_len, token,
-               budget, temp, top_p):
+    def insert(state: DecodeState, slots, k_rows, v_rows, seq_lens,
+               tokens, budgets, temps, top_ps):
+        s_len = k_rows.shape[2]
         return DecodeState(
-            k=lax.dynamic_update_slice(state.k, k_rows, (0, slot, 0, 0, 0)),
-            v=lax.dynamic_update_slice(state.v, v_rows, (0, slot, 0, 0, 0)),
-            lengths=state.lengths.at[slot].set(seq_len),
-            last_token=state.last_token.at[slot].set(token),
-            active=state.active.at[slot].set(True),
-            remaining=state.remaining.at[slot].set(budget),
-            temperature=state.temperature.at[slot].set(temp),
-            top_p=state.top_p.at[slot].set(top_p),
+            k=state.k.at[:, slots, :s_len].set(k_rows),
+            v=state.v.at[:, slots, :s_len].set(v_rows),
+            lengths=state.lengths.at[slots].set(seq_lens),
+            last_token=state.last_token.at[slots].set(tokens),
+            active=state.active.at[slots].set(True),
+            remaining=state.remaining.at[slots].set(budgets),
+            temperature=state.temperature.at[slots].set(temps),
+            top_p=state.top_p.at[slots].set(top_ps),
         )
 
     return insert
@@ -312,6 +350,21 @@ class _Request(NamedTuple):
     out: "queue.Queue[object]"
     temperature: float  # per-request; 0 = greedy
     top_p: float        # per-request nucleus cutoff; 1 = no filtering
+    t_submit: float     # monotonic submit time (TTFT / queue-wait gauges)
+
+
+class _Admission(NamedTuple):
+    """A request whose prefill has been DISPATCHED but whose first token
+    has not been delivered yet — the overlap window. `first` is a device
+    scalar future; the loop reads it only after the decode chunk's own
+    sync, so the readback waits on the prefill alone."""
+
+    req: _Request
+    slot: int
+    k_rows: jnp.ndarray
+    v_rows: jnp.ndarray
+    first: jnp.ndarray
+    t_pop: float
 
 
 class ServingEngine:
@@ -332,6 +385,7 @@ class ServingEngine:
         seed: int = 0,
         steps_per_sync: int = 4,
         max_pending: Optional[int] = None,
+        max_prefills_per_chunk: int = 4,
     ):
         self.config = config
         self.params = params
@@ -348,11 +402,44 @@ class ServingEngine:
         self.max_pending = max_pending
         self.rejected = 0  # total sheds, monotonic (for /metrics)
         self._steps_per_sync = steps_per_sync
+        # Fairness knob: at most this many prefills are dispatched per
+        # chunk boundary, so an admission burst cannot starve the decode
+        # cadence of already-live streams (it also bounds the batched
+        # insert's compile cache — one entry per (N<=cap, bucket)).
+        if max_prefills_per_chunk < 1:
+            raise ValueError(
+                f"max_prefills_per_chunk must be >= 1, got {max_prefills_per_chunk}"
+            )
+        self.max_prefills_per_chunk = max_prefills_per_chunk
         self._chunk_s = 0.05  # EWMA wall time per decode chunk (seeded)
         self._turn_s = 1.0    # EWMA slot occupancy admit->retire (seeded)
+        # Scheduler gauges (seeded on first sample): TTFT submit->first
+        # token, queue wait submit->admission, prefill admission->first
+        # token — the autoscaler/gateway read these from stats().
+        self._ttft_s = 0.0
+        self._queue_wait_s = 0.0
+        self._prefill_s = 0.0
+        # Monotonic sum/count behind the EWMAs (Prometheus summary
+        # style): scrapers and the bench diff these per window for exact
+        # per-window means, immune to EWMA warm-up/compile spikes.
+        self._n_admitted = 0
+        self._sum_ttft = 0.0
+        self._sum_queue_wait = 0.0
+        self._sum_prefill = 0.0
+        # Wall-time accounting for the utilization gauges: cumulative
+        # seconds the loop spent blocked on decode chunks, doing
+        # prefill/admission host work, and idle-waiting.
+        self._t_decode = 0.0
+        self._t_prefill = 0.0
+        self._t_idle = 0.0
         self._slot_t0: List[float] = [0.0] * slots
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._live: List[Optional[_Request]] = [None] * slots
+        # Requests popped for prefill but not yet live (the overlap
+        # window): admission accounting must see them as occupying
+        # capacity, and _flush_all must terminate their consumers too.
+        # Guarded by _lock.
+        self._admitting: List[_Request] = []
         # Output queues whose consumer is gone (client disconnect, stop
         # sequence hit): the loop retires their slots at the next chunk
         # boundary instead of decoding the rest of the budget into a
@@ -417,17 +504,21 @@ class ServingEngine:
             # Shed on the WAITING backlog, not raw queue depth: a request
             # that will land in a currently-free slot is not overload
             # (and max_pending=0 then means "serve, never queue" instead
-            # of bricking an idle engine). _live is mutated by the loop
-            # thread without this lock; a slightly stale free count only
-            # shifts the shed boundary by one request.
-            free = sum(r is None for r in self._live)
+            # of bricking an idle engine). The snapshot is consistent:
+            # the loop thread mutates _live and _admitting under this
+            # same lock, and clears a retiring slot BEFORE signalling its
+            # consumer — so a client that saw its stream end and
+            # immediately resubmits cannot be shed by a stale free count.
+            # Requests in the prefill-overlap window (_admitting) are in
+            # neither _pending nor _live but do occupy capacity.
+            free = sum(r is None for r in self._live) - len(self._admitting)
             backlog = depth - free
             if self.max_pending is not None and backlog >= self.max_pending:
                 self.rejected += 1
                 raise EngineOverloadedError(depth, self._retry_after(depth))
             self._pending.put(
                 _Request(list(tokens), max_new_tokens, out,
-                         float(temperature), float(top_p))
+                         float(temperature), float(top_p), time.monotonic())
             )
             self._inflight.add(out)
         self._wake.set()
@@ -477,7 +568,19 @@ class ServingEngine:
         self._wake.set()
 
     def stats(self) -> Dict[str, Any]:
-        """Live load snapshot (feeds /metrics and autoscaler signals)."""
+        """Live load snapshot (feeds /metrics and autoscaler signals).
+
+        Beyond queue/shed counters, the scheduler gauges: `ttft_seconds_
+        ewma` (submit -> first token, with its `queue_wait_seconds_ewma`
+        / `prefill_seconds_ewma` breakdown) and the utilization split —
+        `util_decode` / `util_prefill` / `util_idle`, the fraction of the
+        loop's wall time spent blocked on decode chunks, doing admission
+        (prefill dispatch + first-token delivery) host work, and idle.
+        A healthy overlapped engine under load shows util_decode near 1;
+        util_prefill climbing toward it means admission work is eating
+        the decode cadence (lower `max_prefills_per_chunk` or bucket
+        prompts coarser)."""
+        busy = self._t_decode + self._t_prefill + self._t_idle
         return {
             "slots": self.slots,
             "active": sum(r is not None for r in self._live),
@@ -487,6 +590,25 @@ class ServingEngine:
             "chunk_seconds_ewma": round(self._chunk_s, 4),
             "slot_turn_seconds_ewma": round(self._turn_s, 3),
             "steps_per_sync": self._steps_per_sync,
+            "max_prefills_per_chunk": self.max_prefills_per_chunk,
+            "ttft_seconds_ewma": round(self._ttft_s, 4),
+            "queue_wait_seconds_ewma": round(self._queue_wait_s, 4),
+            "prefill_seconds_ewma": round(self._prefill_s, 4),
+            "util_decode": round(self._t_decode / busy, 4) if busy else 0.0,
+            "util_prefill": round(self._t_prefill / busy, 4) if busy else 0.0,
+            "util_idle": round(self._t_idle / busy, 4) if busy else 0.0,
+            # Raw monotonic counters behind the fractions (Prometheus
+            # counter style) so scrapers/benches can diff per window.
+            "decode_seconds_total": round(self._t_decode, 4),
+            "prefill_seconds_total": round(self._t_prefill, 4),
+            "idle_seconds_total": round(self._t_idle, 4),
+            # Summary-style sum/count behind the latency EWMAs: diff two
+            # snapshots for an exact per-window mean (the EWMAs carry
+            # compile-spike history across windows; these don't).
+            "admitted_total": self._n_admitted,
+            "ttft_seconds_sum": round(self._sum_ttft, 4),
+            "queue_wait_seconds_sum": round(self._sum_queue_wait, 4),
+            "prefill_seconds_sum": round(self._sum_prefill, 4),
         }
 
     def close(self) -> None:
@@ -511,6 +633,12 @@ class ServingEngine:
                 if req is not None:
                     req.out.put(sentinel)
                     self._live[slot] = None
+            # Requests caught in the prefill-overlap window (popped from
+            # _pending, not yet live) must get the sentinel too, or their
+            # consumers hang forever on a dead engine.
+            for req in self._admitting:
+                req.out.put(sentinel)
+            self._admitting.clear()
             while True:
                 try:
                     self._pending.get_nowait().out.put(sentinel)
@@ -519,14 +647,20 @@ class ServingEngine:
 
     # -- loop ----------------------------------------------------------------
 
-    def _admit(self) -> None:
-        for slot in range(self.slots):
-            if self._live[slot] is not None:
-                continue
+    def _start_prefills(self) -> List[_Admission]:
+        """Pop up to `max_prefills_per_chunk` pending requests into free
+        slots and DISPATCH their prefills. No host sync happens here —
+        the jitted prefill samples the first token on device — so when
+        the caller has just dispatched a decode chunk, all of this host
+        work runs while the chunk executes on device and the prefill
+        programs queue up behind it."""
+        admissions: List[_Admission] = []
+        free = [s for s in range(self.slots) if self._live[s] is None]
+        while free and len(admissions) < self.max_prefills_per_chunk:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
-                return
+                break
             with self._lock:
                 if req.out in self._cancelled:
                     # abandoned while queued: never occupy a slot
@@ -534,34 +668,88 @@ class ServingEngine:
                     self._inflight.discard(req.out)
                     req.out.put(None)
                     continue
-            self._slot_t0[slot] = time.monotonic()
-            toks = jnp.asarray([req.tokens], dtype=jnp.int32)
-            k_rows, v_rows, logits = self._prefill(self.params, toks)
-            if req.temperature > 0:
-                self._rng, sub = jax.random.split(self._rng)
-                scaled = logits / req.temperature
-                if req.top_p < 1:
-                    scaled = _nucleus_filter(scaled, req.top_p)
-                first = int(jax.random.categorical(sub, scaled))
-            else:
-                first = int(jnp.argmax(logits))
-            req.out.put(first)
-            self.state = self._insert(
-                self.state, slot, k_rows, v_rows, len(req.tokens), first,
-                req.max_new_tokens - 1, req.temperature, req.top_p,
+                self._admitting.append(req)
+            slot = free.pop(0)
+            t_pop = time.monotonic()
+            self._slot_t0[slot] = t_pop
+            self._queue_wait_s = self._ewma_seed(
+                self._queue_wait_s, t_pop - req.t_submit
             )
-            if req.max_new_tokens <= 1:
+            self._sum_queue_wait += t_pop - req.t_submit
+            self._rng, sub = jax.random.split(self._rng)
+            toks = jnp.asarray([req.tokens], dtype=jnp.int32)
+            k_rows, v_rows, first = self._prefill(
+                self.params, toks,
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_p, jnp.float32),
+                sub,
+            )
+            admissions.append(_Admission(req, slot, k_rows, v_rows, first, t_pop))
+        return admissions
+
+    def _finish_admissions(self, admissions: List[_Admission]) -> None:
+        """Insert prefilled requests into the decode state — batched, one
+        `insert` call per prompt bucket instead of one per request — and
+        deliver their first tokens. Runs after the decode chunk's sync,
+        so the `int(first)` readbacks wait only on the prefills."""
+        if not admissions:
+            return
+        live_adm: List[_Admission] = []
+        with self._lock:
+            for a in admissions:
+                self._admitting.remove(a.req)
+                if a.req.out in self._cancelled:
+                    # cancel() landed during the prefill overlap: the
+                    # request must not occupy a slot, and both sets must
+                    # be cleared or the entry leaks for the engine's
+                    # lifetime.
+                    self._cancelled.discard(a.req.out)
+                    self._inflight.discard(a.req.out)
+                    a.req.out.put(None)
+                else:
+                    live_adm.append(a)
+        # One batched insert per prompt bucket (dispatch-only — the
+        # device consumes the prefill outputs without a host round-trip).
+        # One-token requests never occupy a slot: their budget is spent
+        # by the first token, so inserting would emit a phantom token.
+        groups: Dict[int, List[_Admission]] = {}
+        for a in live_adm:
+            if a.req.max_new_tokens > 1:
+                groups.setdefault(a.k_rows.shape[2], []).append(a)
+        for group in groups.values():
+            self.state = self._insert(
+                self.state,
+                jnp.asarray([a.slot for a in group], jnp.int32),
+                jnp.concatenate([a.k_rows for a in group], axis=1),
+                jnp.concatenate([a.v_rows for a in group], axis=1),
+                jnp.asarray([len(a.req.tokens) for a in group], jnp.int32),
+                jnp.stack([a.first for a in group]),
+                jnp.asarray(
+                    [a.req.max_new_tokens - 1 for a in group], jnp.int32
+                ),
+                jnp.asarray([a.req.temperature for a in group], jnp.float32),
+                jnp.asarray([a.req.top_p for a in group], jnp.float32),
+            )
+        for a in live_adm:
+            first = int(a.first)  # the admission's only host sync
+            a.req.out.put(first)
+            now = time.monotonic()
+            self._ttft_s = self._ewma_seed(self._ttft_s, now - a.req.t_submit)
+            self._prefill_s = self._ewma_seed(self._prefill_s, now - a.t_pop)
+            self._n_admitted += 1
+            self._sum_ttft += now - a.req.t_submit
+            self._sum_prefill += now - a.t_pop
+            if a.req.max_new_tokens <= 1:
                 with self._lock:
-                    self._inflight.discard(req.out)
+                    self._inflight.discard(a.req.out)
                     # cancel() racing this completion may have moved the
                     # queue to _cancelled already; every completion path
-                    # must clear both sets or the entry leaks for the
-                    # engine's lifetime.
-                    self._cancelled.discard(req.out)
-                req.out.put(None)
-                self.state = self._retire(slot)
+                    # must clear both sets.
+                    self._cancelled.discard(a.req.out)
+                a.req.out.put(None)
             else:
-                self._live[slot] = req
+                with self._lock:
+                    self._live[a.slot] = a.req
 
     def _retire(self, slot: int) -> DecodeState:
         s = self.state
@@ -573,22 +761,48 @@ class ServingEngine:
     def _ewma(self, prev: float, sample: float, alpha: float = 0.2) -> float:
         return prev + alpha * (sample - prev)
 
+    def _ewma_seed(self, prev: float, sample: float, alpha: float = 0.2) -> float:
+        """EWMA whose zero value means "unseeded": the first sample sets
+        the gauge directly instead of averaging against the 0 seed."""
+        return sample if prev == 0.0 else prev + alpha * (sample - prev)
+
     def _loop(self) -> None:
         while not self._stop:
             try:
-                self._admit()
                 if not any(r is not None for r in self._live):
-                    self._wake.wait(timeout=0.2)
-                    self._wake.clear()
+                    if self._pending.empty():
+                        t_w = time.monotonic()
+                        self._wake.wait(timeout=0.2)
+                        self._wake.clear()
+                        self._t_idle += time.monotonic() - t_w
+                        continue
+                    # Nothing decoding: admission runs alone (no chunk to
+                    # overlap with); the next iteration dispatches the
+                    # first decode chunk for the freshly inserted slots.
+                    t_p = time.monotonic()
+                    self._finish_admissions(self._start_prefills())
+                    self._t_prefill += time.monotonic() - t_p
                     continue
+                # 1) Dispatch the decode chunk — JAX async dispatch
+                #    returns immediately; the device starts decoding now.
                 t0 = time.monotonic()
                 self._rng, sub = jax.random.split(self._rng)
                 self.state, tokens, active = self._step(
                     self.params, self.state, sub
                 )
+                t_disp = time.monotonic()
+                # 2) Overlap: admission host work + prefill dispatch run
+                #    WHILE the chunk executes on device (the prefill
+                #    programs queue behind it on the device stream).
+                admissions = self._start_prefills()
+                t_pf = time.monotonic()
+                # 3) Sync on the chunk.
                 toks = jax.device_get(tokens)  # (B, steps_per_sync)
                 still = jax.device_get(active)
-                self._chunk_s = self._ewma(self._chunk_s, time.monotonic() - t0)
+                t_sync = time.monotonic()
+                self._chunk_s = self._ewma(self._chunk_s, t_sync - t0)
+                self._t_decode += (t_disp - t0) + (t_sync - t_pf)
+                self._t_prefill += t_pf - t_disp
                 with self._lock:
                     cancelled = set(self._cancelled)
                 for slot, req in enumerate(self._live):
@@ -600,25 +814,39 @@ class ServingEngine:
                         with self._lock:
                             self._cancelled.discard(req.out)
                             self._inflight.discard(req.out)
+                            self._live[slot] = None
                         self.state = self._retire(slot)
                         req.out.put(None)
-                        self._live[slot] = None
                         continue
-                    for tok in toks[slot]:
-                        if tok >= 0:
-                            req.out.put(int(tok))
                     if not still[slot]:
-                        req.out.put(None)
-                        self._live[slot] = None
+                        # Free the slot (under the submit lock) BEFORE
+                        # delivering the final tokens + clean end: a
+                        # client that sees its stream finish and
+                        # immediately resubmits must find the capacity
+                        # it just released (max_pending=0 semantics).
                         with self._lock:
+                            self._live[slot] = None
                             # cancel() racing normal completion must not
                             # leave a stale entry behind
                             self._cancelled.discard(req.out)
                             self._inflight.discard(req.out)
+                        for tok in toks[slot]:
+                            if tok >= 0:
+                                req.out.put(int(tok))
+                        req.out.put(None)
                         self._turn_s = self._ewma(
                             self._turn_s,
                             time.monotonic() - self._slot_t0[slot],
                         )
+                        continue
+                    for tok in toks[slot]:
+                        if tok >= 0:
+                            req.out.put(int(tok))
+                # 4) Insert the overlapped prefills (batched per bucket)
+                #    and deliver their first tokens.
+                t_fin = time.monotonic()
+                self._finish_admissions(admissions)
+                self._t_prefill += time.monotonic() - t_fin
             except Exception as e:  # device/compile error: fail loudly, not
                 # by wedging every consumer on a dead queue.
                 if self._stop:

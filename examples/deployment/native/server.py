@@ -34,7 +34,9 @@ class Engine:
     MIN_BUCKET = 32
 
     def __init__(self, preset: str, max_new_tokens: int, checkpoint_dir: str = "",
-                 quantize: str = "none", max_pending: int = 16):
+                 quantize: str = "none", max_pending: int = 16,
+                 slots: int = 8, steps_per_sync: int = 4,
+                 max_prefills_per_chunk: int = 4):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -71,9 +73,15 @@ class Engine:
         # Bounded admission: beyond max_pending queued requests the API
         # answers 429 + Retry-After rather than letting TTFT blow up
         # (measured: 10.8 s TTFT p50 at 2x oversubscription unbounded).
+        # Scheduler knobs ride through from the CLI: `slots` (decode
+        # batch width), `steps_per_sync` (device steps per host
+        # readback), and `max_prefills_per_chunk` (admissions per chunk
+        # boundary — the overlapped scheduler's fairness knob). See
+        # docs/guides/serving-tuning.md for the measured trade-offs.
         self.serving = ServingEngine(
-            self.config, self.params, slots=8, temperature=0.8,
-            max_pending=max_pending,
+            self.config, self.params, slots=slots, temperature=0.8,
+            max_pending=max_pending, steps_per_sync=steps_per_sync,
+            max_prefills_per_chunk=max_prefills_per_chunk,
         )
 
     def encode(self, text: str) -> jnp.ndarray:
@@ -230,10 +238,19 @@ def main() -> None:
                         help="weight-only int8 for ~1.25x decode throughput")
     parser.add_argument("--max-pending", type=int, default=16,
                         help="queued-request bound; overflow answers 429")
+    parser.add_argument("--slots", type=int, default=8,
+                        help="decode batch width (concurrent streams)")
+    parser.add_argument("--steps-per-sync", type=int, default=4,
+                        help="device decode steps per host readback")
+    parser.add_argument("--max-prefills-per-chunk", type=int, default=4,
+                        help="admissions per decode chunk boundary (the"
+                             " overlapped scheduler's fairness knob)")
     args = parser.parse_args()
 
     engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir,
-                    quantize=args.quantize, max_pending=args.max_pending)
+                    quantize=args.quantize, max_pending=args.max_pending,
+                    slots=args.slots, steps_per_sync=args.steps_per_sync,
+                    max_prefills_per_chunk=args.max_prefills_per_chunk)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):

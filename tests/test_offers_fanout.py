@@ -1,0 +1,118 @@
+"""Parallel offer fan-out: one slow/failed cloud API must not serialize
+or sink the others (server/services/offers.py).
+
+Skips when the server extra (cryptography) is absent — the offers service
+pulls ServerContext, same dependency wall as tests/server/.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+offers_service = pytest.importorskip("dstack_tpu.server.services.offers")
+
+from dstack_tpu.models.backends import BackendType  # noqa: E402
+from dstack_tpu.models.instances import (  # noqa: E402
+    InstanceAvailability,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+)
+from dstack_tpu.models.profiles import Profile  # noqa: E402
+from dstack_tpu.models.resources import ResourcesSpec  # noqa: E402
+from dstack_tpu.models.runs import Requirements  # noqa: E402
+
+
+def _offer(backend: BackendType, price: float, region: str = "r1"):
+    return InstanceOfferWithAvailability(
+        backend=backend,
+        instance=InstanceType(
+            name=f"{backend.value}-inst",
+            resources=Resources(cpus=4, memory_mib=8192),
+        ),
+        region=region,
+        price=price,
+        availability=InstanceAvailability.AVAILABLE,
+    )
+
+
+class _FakeCompute:
+    def __init__(self, backend, offers, delay=0.0, fail=False):
+        self.backend = backend
+        self.offers = offers
+        self.delay = delay
+        self.fail = fail
+
+    async def get_offers(self, requirements):
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        if self.fail:
+            raise RuntimeError("cloud API down")
+        return self.offers
+
+
+def _wire(monkeypatch, pairs):
+    async def fake_list(ctx, project_id):
+        return pairs
+
+    monkeypatch.setattr(
+        offers_service.backends_service, "list_project_backends", fake_list
+    )
+
+
+async def test_backend_fanout_is_concurrent(monkeypatch):
+    """Three backends at 0.3 s each must resolve in ~one delay, not three
+    (the r05 behavior: a sequential await per backend), with the merged
+    result still price-sorted across backends."""
+    pairs = [
+        (BackendType.GCP, _FakeCompute(
+            BackendType.GCP, [_offer(BackendType.GCP, 3.0)], delay=0.3)),
+        (BackendType.SSH, _FakeCompute(
+            BackendType.SSH, [_offer(BackendType.SSH, 1.0)], delay=0.3)),
+        (BackendType.LOCAL, _FakeCompute(
+            BackendType.LOCAL, [_offer(BackendType.LOCAL, 2.0)], delay=0.3)),
+    ]
+    _wire(monkeypatch, pairs)
+    t0 = time.perf_counter()
+    got = await offers_service.get_offers_by_requirements(
+        None, "proj", Requirements(resources=ResourcesSpec()), Profile(name="p")
+    )
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.75, f"fan-out serialized: {elapsed:.2f}s for 3x0.3s"
+    assert [o.price for _, o in got] == [1.0, 2.0, 3.0]
+
+
+async def test_failing_backend_degrades_to_empty(monkeypatch):
+    """A raising backend contributes nothing; the healthy backends'
+    offers still come back (per-backend exception isolation, logged)."""
+    pairs = [
+        (BackendType.GCP, _FakeCompute(BackendType.GCP, [], fail=True)),
+        (BackendType.LOCAL, _FakeCompute(
+            BackendType.LOCAL, [_offer(BackendType.LOCAL, 2.0)])),
+    ]
+    _wire(monkeypatch, pairs)
+    got = await offers_service.get_offers_by_requirements(
+        None, "proj", Requirements(resources=ResourcesSpec()), Profile(name="p")
+    )
+    assert [o.backend for _, o in got] == [BackendType.LOCAL]
+
+
+async def test_hung_backend_is_cut_off_at_timeout(monkeypatch):
+    """A backend that never answers is abandoned at OFFER_FETCH_TIMEOUT_S
+    instead of stalling provisioning for every backend."""
+    monkeypatch.setattr(offers_service, "OFFER_FETCH_TIMEOUT_S", 0.2)
+    pairs = [
+        (BackendType.GCP, _FakeCompute(
+            BackendType.GCP, [_offer(BackendType.GCP, 9.0)], delay=30.0)),
+        (BackendType.LOCAL, _FakeCompute(
+            BackendType.LOCAL, [_offer(BackendType.LOCAL, 2.0)])),
+    ]
+    _wire(monkeypatch, pairs)
+    t0 = time.perf_counter()
+    got = await offers_service.get_offers_by_requirements(
+        None, "proj", Requirements(resources=ResourcesSpec()), Profile(name="p")
+    )
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"hung backend stalled the fan-out: {elapsed:.2f}s"
+    assert [o.backend for _, o in got] == [BackendType.LOCAL]

@@ -92,10 +92,17 @@ def test_cache_full_retires_slot(params):
     max_len = 12
     state = init_decode_state(CFG, 1, max_len)
     prefill = make_prefill(CFG)
-    k_rows, v_rows, logits = prefill(params, jnp.asarray([[1, 2, 3]], jnp.int32))
+    k_rows, v_rows, first = prefill(
+        params, jnp.asarray([[1, 2, 3]], jnp.int32),
+        jnp.asarray(0.0, jnp.float32), jnp.asarray(1.0, jnp.float32),
+        jax.random.PRNGKey(0),
+    )
     state = make_insert()(
-        state, 0, k_rows, v_rows, 3, int(jnp.argmax(logits)), 100, 0.0, 1.0
-    )  # budget far beyond the cache
+        state, jnp.asarray([0], jnp.int32), k_rows, v_rows,
+        jnp.asarray([3], jnp.int32), first[None],
+        jnp.asarray([100], jnp.int32),  # budget far beyond the cache
+        jnp.asarray([0.0], jnp.float32), jnp.asarray([1.0], jnp.float32),
+    )
     step = make_decode_step(CFG)
     rng = jax.random.PRNGKey(0)
     emitted = 0
@@ -391,10 +398,10 @@ def test_nucleus_gate_ignores_retired_slots(params):
 def test_one_token_completion_clears_cancel_race(params):
     """Every completion path must clear BOTH _inflight and _cancelled.
 
-    Deterministic interleaving: _admit checks _cancelled BEFORE the
-    prefill, so blocking the prefill and cancelling while blocked lands
-    the cancel exactly in the window the leak needs — past the queued-
-    cancel branch, before the one-token completion's discards."""
+    Deterministic interleaving: _start_prefills checks _cancelled BEFORE
+    the prefill, so blocking the prefill and cancelling while blocked
+    lands the cancel exactly in the overlap window the leak needs — past
+    the queued-cancel branch, before _finish_admissions' discards."""
     import threading
 
     engine = ServingEngine(CFG, params, slots=1, max_len=16)
@@ -402,10 +409,10 @@ def test_one_token_completion_clears_cancel_race(params):
         started, release = threading.Event(), threading.Event()
         real_prefill = engine._prefill
 
-        def blocking_prefill(p, toks):
+        def blocking_prefill(p, toks, temp, top_p, rng):
             started.set()
             assert release.wait(30)
-            return real_prefill(p, toks)
+            return real_prefill(p, toks, temp, top_p, rng)
 
         engine._prefill = blocking_prefill
         out = engine.submit([1, 2], max_new_tokens=1)

@@ -1,0 +1,204 @@
+"""Overlapped prefill/decode scheduler: exactness, fairness, gauges.
+
+tests/test_serving.py pins the engine's numerics and queue protocol; this
+file pins the SCHEDULER introduced for PR 1 — first-token sampling folded
+into the jitted prefill, admission overlapped with the in-flight decode
+chunk, batched inserts capped by `max_prefills_per_chunk`, and the
+TTFT/utilization gauges the gateway and autoscaler read. Everything here
+runs on the tiny CPU preset under `-m 'not slow'` so tier-1 catches
+scheduler regressions without TPU hardware.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.generate import generate
+from dstack_tpu.workloads.serving import ServingEngine
+from dstack_tpu.workloads.transformer import init_params
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _drain(q):
+    out = []
+    while True:
+        tok = q.get(timeout=60)
+        if isinstance(tok, BaseException):
+            raise tok
+        if tok is None:
+            return out
+        out.append(tok)
+
+
+def _reference(params, prompt, n):
+    toks = generate(
+        CFG, params, jnp.asarray([prompt], dtype=jnp.int32),
+        max_new_tokens=n, temperature=0.0,
+    )
+    return [int(t) for t in toks[0]]
+
+
+def test_admission_burst_token_exact_and_prefill_cap(params):
+    """A 32-request greedy burst through the overlapped scheduler yields
+    outputs bit-identical to the sequential reference, while every
+    batched insert stays within `max_prefills_per_chunk` (the fairness
+    knob: an admission burst must not starve decode cadence) and at
+    least one insert actually batched multiple requests (the point of
+    the one-call-per-bucket insert)."""
+    engine = ServingEngine(CFG, params, slots=8, max_len=64,
+                           max_prefills_per_chunk=3)
+    batch_sizes = []
+    orig_insert = engine._insert
+
+    def spy(state, slots, *rest):
+        batch_sizes.append(int(slots.shape[0]))
+        return orig_insert(state, slots, *rest)
+
+    engine._insert = spy
+    try:
+        base_prompts = [[5, 7, 11], [13, 17], [2, 3, 5, 7], [19, 23, 29]]
+        refs = {tuple(p): _reference(params, p, 4) for p in base_prompts}
+        prompts = [base_prompts[i % len(base_prompts)] for i in range(32)]
+        queues = [engine.submit(p, max_new_tokens=4) for p in prompts]
+        for p, q in zip(prompts, queues):
+            assert _drain(q) == refs[tuple(p)], p
+        assert batch_sizes, "no insert ever ran"
+        assert max(batch_sizes) <= 3, (
+            f"insert batch {max(batch_sizes)} exceeded max_prefills_per_chunk"
+        )
+        assert max(batch_sizes) > 1, (
+            "a 32-request burst never batched an insert"
+        )
+        s = engine.stats()
+        assert s["ttft_seconds_ewma"] > 0
+        assert s["queue_wait_seconds_ewma"] > 0
+    finally:
+        engine.close()
+
+
+def test_batched_insert_groups_by_prompt_bucket(params):
+    """Mixed prompt lengths in one burst: the batched insert groups by
+    bucket (same-S requests share a call, different-S requests don't),
+    and outputs stay exact across the grouping."""
+    engine = ServingEngine(CFG, params, slots=4, max_len=64,
+                           max_prefills_per_chunk=4)
+    seen = []  # (n_requests, bucket_len) per insert call
+    orig_insert = engine._insert
+
+    def spy(state, slots, k_rows, *rest):
+        seen.append((int(slots.shape[0]), int(k_rows.shape[2])))
+        return orig_insert(state, slots, k_rows, *rest)
+
+    engine._insert = spy
+    try:
+        short = [5, 7, 11]
+        long = [13, 17, 19, 23, 29, 31]
+        queues = [engine.submit(p, max_new_tokens=4)
+                  for p in (short, long, short, long)]
+        outs = [_drain(q) for q in queues]
+        assert outs[0] == outs[2] == _reference(params, short, 4)
+        assert outs[1] == outs[3] == _reference(params, long, 4)
+        for n, s in seen:
+            assert s in (len(short), len(long))
+    finally:
+        engine.close()
+
+
+def test_stats_exposes_scheduler_gauges(params):
+    """CI smoke (no TPU needed): the gauges the gateway /metrics and
+    autoscaler consume exist and are coherent after one request — TTFT
+    EWMA with its queue-wait/prefill breakdown, the decode/prefill/idle
+    utilization split summing to ~1, and the fairness knob echoed."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=32,
+                           max_prefills_per_chunk=2)
+    try:
+        q = engine.submit([5, 7, 11], max_new_tokens=4)
+        assert len(_drain(q)) == 4
+        s = engine.stats()
+        for key in ("ttft_seconds_ewma", "queue_wait_seconds_ewma",
+                    "prefill_seconds_ewma", "util_decode", "util_prefill",
+                    "util_idle", "decode_seconds_total",
+                    "prefill_seconds_total", "idle_seconds_total",
+                    "admitted_total", "ttft_seconds_sum",
+                    "queue_wait_seconds_sum", "prefill_seconds_sum"):
+            assert key in s, key
+        assert s["max_prefills_per_chunk"] == 2
+        assert s["admitted_total"] == 1
+        assert s["ttft_seconds_sum"] >= s["prefill_seconds_sum"] > 0
+        assert s["ttft_seconds_ewma"] > 0
+        assert s["prefill_seconds_ewma"] > 0
+        util = s["util_decode"] + s["util_prefill"] + s["util_idle"]
+        assert util == pytest.approx(1.0, abs=2e-3)
+        assert s["util_decode"] > 0  # at least one chunk ran
+    finally:
+        engine.close()
+
+
+def test_cancel_during_prefill_overlap_leaves_no_leak(params):
+    """cancel() landing while a request's prefill is in flight (the
+    overlap window: popped from pending, not yet live) must end the
+    stream cleanly, never insert the request, and leave no entry behind
+    in _inflight/_cancelled — the slot stays usable."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=64)
+    try:
+        started, release = threading.Event(), threading.Event()
+        real_prefill = engine._prefill
+
+        def blocking_prefill(p, toks, temp, top_p, rng):
+            started.set()
+            assert release.wait(30)
+            return real_prefill(p, toks, temp, top_p, rng)
+
+        engine._prefill = blocking_prefill
+        out = engine.submit([1, 2, 3], max_new_tokens=5)
+        assert started.wait(30), "engine never started the prefill"
+        engine.cancel(out)  # lands mid-overlap: in _inflight, past the pop
+        release.set()
+        assert out.get(timeout=30) is None  # ended with zero tokens
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with engine._lock:
+                if not engine._cancelled and not engine._inflight:
+                    break
+            time.sleep(0.02)
+        with engine._lock:
+            assert not engine._cancelled, "overlap cancel leaked an entry"
+            assert not engine._inflight
+            assert not engine._admitting
+        assert engine.stats()["active"] == 0
+        # The slot the cancelled request reserved is free for new work.
+        q = engine.submit([5, 7, 11], max_new_tokens=3)
+        assert _drain(q) == _reference(params, [5, 7, 11], 3)
+    finally:
+        engine.close()
+
+
+def test_idle_resubmit_after_completion_is_not_shed(params):
+    """Satellite regression (the stale-`free` race): with max_pending=0
+    ("serve, never queue"), a client that sees its stream complete and
+    immediately resubmits must be admitted — the loop frees the slot
+    under the submit lock BEFORE delivering the clean end, so the
+    admission snapshot can never show a phantom-occupied idle engine."""
+    engine = ServingEngine(CFG, params, slots=1, max_len=32, max_pending=0)
+    try:
+        for i in range(5):  # each iteration: complete, then resubmit at once
+            q = engine.submit([i + 2, i + 3], max_new_tokens=2)
+            assert len(_drain(q)) == 2  # None received -> slot already free
+    finally:
+        engine.close()
+
+
+def test_max_prefills_per_chunk_validation(params):
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, slots=1, max_len=32,
+                      max_prefills_per_chunk=0)
