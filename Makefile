@@ -1,0 +1,22 @@
+# Convenience targets. `make chaos` is the headless resilience drill:
+# it exits nonzero if any scenario's run fails to recover.
+
+PYTHON ?= python
+PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
+
+.PHONY: test test-all chaos chaos-fast lint
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
+
+test-all:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -p no:cacheprovider
+
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --all
+
+chaos-fast:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario runner-flap
+
+lint:
+	$(PYTHON) -m compileall -q dstack_tpu

@@ -34,6 +34,14 @@ from dstack_tpu.models.runs import ClusterInfo, JobSpec, JobStatus, JobTerminati
 RUNNER_PORT = 10999
 SHIM_PORT = 10998
 
+# Exit code a workload's drain handler uses to say "preemption notice
+# received, checkpoint saved, exiting cleanly". The runner reports any
+# drained job as preempted_by_provider; this code additionally marks the
+# drain as clean (checkpoint durable), which the server counts separately
+# (resilience clean_drains). Jobs should `exec` their trainer so the code
+# reaches the runner unwrapped by the shell.
+DRAIN_EXIT_CODE = 113
+
 
 class HealthcheckResponse(CoreModel):
     service: str

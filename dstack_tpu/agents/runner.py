@@ -26,6 +26,7 @@ from dstack_tpu.agents.repo import RepoError, setup_remote_repo
 from dstack_tpu.agents.tpu_telemetry import collect_tpu_metrics
 
 from dstack_tpu.agents.protocol import (
+    DRAIN_EXIT_CODE,
     HealthcheckResponse,
     JobStateEvent,
     LogEventOut,
@@ -42,6 +43,70 @@ from dstack_tpu.server.http import App, Request, Response, Router, Server
 from dstack_tpu.utils.common import utcnow
 
 IDLE_SHUTDOWN_SECONDS = 300.0  # parity: runner self-terminates if no job (server.go:56)
+
+# GCE/TPU-VM maintenance-event metadata endpoint ("NONE" until the host is
+# scheduled for maintenance/preemption; GCP gives spot VMs ~30s notice,
+# on-demand hosts longer). Prod preemption source for the watcher below.
+GCE_MAINTENANCE_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/maintenance-event"
+)
+
+
+def _preemption_source() -> tuple:
+    """(kind, target) of the configured preemption source, or (None, None).
+
+    - DSTACK_TPU_PREEMPTION_FILE: a path whose appearance signals a
+      maintenance event — written by the chaos engine in tests/scenarios
+      (the local backend passes one per worker).
+    - DSTACK_TPU_PREEMPTION_METADATA=1: poll the GCE metadata endpoint —
+      opt-in so non-GCP hosts don't hammer a dead DNS name.
+    """
+    path = os.getenv("DSTACK_TPU_PREEMPTION_FILE")
+    if path:
+        return "file", path
+    if os.getenv("DSTACK_TPU_PREEMPTION_METADATA", "").lower() in ("1", "true", "yes"):
+        return "metadata", os.getenv("DSTACK_TPU_PREEMPTION_METADATA_URL", GCE_MAINTENANCE_URL)
+    return None, None
+
+
+async def _maintenance_pending(kind: str, target: str) -> bool:
+    if kind == "file":
+        return os.path.exists(target)
+
+    def _poll_metadata() -> bool:
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(target, headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                value = resp.read().decode().strip()
+            return bool(value) and value != "NONE"
+        except Exception:
+            return False  # unreachable metadata is not a preemption signal
+
+    return await asyncio.get_event_loop().run_in_executor(None, _poll_metadata)
+
+
+async def watch_preemption(
+    executor: "Executor", kind: str, target: str, poll: Optional[float] = None
+) -> None:
+    """Poll the preemption source; on a maintenance event, drain the job.
+
+    Keeps watching while no job is submitted yet — a notice can precede the
+    job, in which case the job drains (fails as preempted) as soon as it
+    exists, letting the server reschedule the gang off the doomed host."""
+    if poll is None:
+        poll = float(
+            os.getenv("DSTACK_TPU_PREEMPTION_POLL", "0.5" if kind == "file" else "5")
+        )
+    while not executor.finished.is_set():
+        await asyncio.sleep(poll)
+        if await _maintenance_pending(kind, target):
+            if executor.submission is None:
+                continue  # notice stays pending until there is a job to drain
+            grace = float(os.getenv("DSTACK_TPU_DRAIN_GRACE", "30"))
+            await executor.drain(grace)
+            return
 
 
 class MountError(Exception):
@@ -251,7 +316,20 @@ class Executor:
         code = await self.proc.wait()
         # Let the output pump drain before the final state flips.
         await asyncio.sleep(0)
-        if code == 0:
+        if self._preempting:
+            # The host is being reclaimed: whatever the exit code, the job
+            # did not fail on its own merits — report the preemption so the
+            # retry policy classifies it as an interruption. DRAIN_EXIT_CODE
+            # marks a clean drain (the workload confirmed its checkpoint).
+            clean = code == DRAIN_EXIT_CODE
+            self.set_state(
+                JobStatus.FAILED,
+                JobTerminationReason.PREEMPTED_BY_PROVIDER,
+                "preempted by provider"
+                + ("; checkpoint drained" if clean else f"; exit status {code}"),
+                exit_status=code,
+            )
+        elif code == 0:
             self.set_state(JobStatus.DONE, JobTerminationReason.DONE_BY_RUNNER, exit_status=0)
         elif code < 0 and self._stopping:
             self.set_state(
@@ -268,6 +346,35 @@ class Executor:
             )
 
     _stopping = False
+    _preempting = False
+
+    async def drain(self, grace_seconds: float = 30.0) -> None:
+        """Provider preemption: SIGTERM the job group, give it a grace
+        window to checkpoint (workloads install a DrainHandler —
+        workloads/train.py), then SIGKILL. The final state is always
+        FAILED/preempted_by_provider (recorded by _wait_proc) so the
+        server's retry policy sees an `interruption` event."""
+        if self.finished.is_set():
+            return
+        self._preempting = True
+        if self.proc is None or self.proc.returncode is not None:
+            # Notice arrived before the job started (or between submit and
+            # run): nothing to drain, but the host is still going away.
+            self.set_state(
+                JobStatus.FAILED,
+                JobTerminationReason.PREEMPTED_BY_PROVIDER,
+                "host preempted by provider before the job started",
+            )
+            return
+        self.log_runner(
+            f"Preemption notice: draining job (SIGTERM, {grace_seconds:g}s grace)"
+        )
+        self._kill(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(self.proc.wait(), grace_seconds)
+        except asyncio.TimeoutError:
+            self.log_runner("Drain grace expired; killing job group")
+            self._kill(signal.SIGKILL)
 
     async def _enforce_max_duration(self, max_duration: int) -> None:
         await asyncio.sleep(max_duration)
@@ -430,6 +537,15 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
 
     app.include_router(router)
     app.include_router(ws_router)
+
+    kind, target = _preemption_source()
+    if kind:
+        async def _start_preemption_watcher() -> None:
+            asyncio.get_event_loop().create_task(
+                watch_preemption(executor, kind, target)
+            )
+
+        app.on_startup.append(_start_preemption_watcher)
 
     if idle_shutdown:
         async def _idle_watchdog() -> None:

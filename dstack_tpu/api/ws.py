@@ -45,8 +45,9 @@ class WsClient:
         self._sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
         self._sock.sendall(("\r\n".join(headers) + "\r\n\r\n").encode())
         status = self._read_until(b"\r\n\r\n")
-        if b" 101 " not in status.split(b"\r\n", 1)[0]:
-            raise WsError(f"Handshake rejected: {status.split(b'\r\n', 1)[0].decode()}")
+        status_line = status.split(b"\r\n", 1)[0]
+        if b" 101 " not in status_line:
+            raise WsError(f"Handshake rejected: {status_line.decode()}")
         return self
 
     def _read_until(self, delim: bytes) -> bytes:

@@ -105,8 +105,19 @@ class HttpGcpApi:
         method: str,
         url: str,
         body: Optional[Dict[str, Any]] = None,
-    ) -> Dict[str, Any]:  # pragma: no cover - network-gated
-        def _do() -> Dict[str, Any]:
+    ) -> Dict[str, Any]:
+        # Chaos hook: injected faults surface as GcpApiError with a status,
+        # exactly like a real quota/5xx response, so the scheduler's
+        # try-next-offer and the instance FSM see the failure they would in
+        # production. Latency faults sleep before the transport runs.
+        from dstack_tpu import chaos
+
+        try:
+            await chaos.maybe_inject("gcp.api", method=method, url=url)
+        except chaos.ChaosError as e:
+            raise GcpApiError(str(e), status=e.status)
+
+        def _do() -> Dict[str, Any]:  # pragma: no cover - network-gated
             data = json.dumps(body).encode() if body is not None else None
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Authorization", f"Bearer {self._get_token()}")

@@ -101,6 +101,7 @@ class LocalCompute(Compute):
     def __init__(self, config: Optional[LocalBackendConfig] = None):
         self.config = config or LocalBackendConfig()
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._preempt_files: Dict[tuple, str] = {}  # (instance_name, worker)
 
     async def get_offers(
         self, requirements: Requirements
@@ -155,8 +156,15 @@ class LocalCompute(Compute):
         # Private temp dir so port-file paths are not predictable/pre-creatable
         # by other local users (mktemp would be).
         port_dir = tempfile.mkdtemp(prefix="dstack-local-runner-")
+        # Per-worker preemption-notice files: the runner's preemption watcher
+        # polls DSTACK_TPU_PREEMPTION_FILE (the local stand-in for the GCP
+        # maintenance-event metadata endpoint); the chaos engine "preempts" a
+        # worker by writing its file. Outlives port_dir — notices can arrive
+        # any time in the worker's life.
+        preempt_dir = tempfile.mkdtemp(prefix="dstack-local-preempt-")
         for worker in range(offer.hosts):
             port_file = os.path.join(port_dir, f"w{worker}.port")
+            preempt_file = os.path.join(preempt_dir, f"w{worker}.preempt")
             if self.config.shim_binary:
                 argv = [
                     self.config.shim_binary,
@@ -187,7 +195,8 @@ class LocalCompute(Compute):
                      # Jobs run as raw host processes here; bootstrap steps
                      # that would mutate the environment (pip installs) are
                      # gated on this marker.
-                     "DSTACK_TPU_LOCAL": "1"},
+                     "DSTACK_TPU_LOCAL": "1",
+                     "DSTACK_TPU_PREEMPTION_FILE": preempt_file},
                 start_new_session=True,
                 # Local "hosts" are children of the server process and must
                 # die with it — abruptly-killed servers (tests, probes)
@@ -202,6 +211,7 @@ class LocalCompute(Compute):
             instance_id = f"local-{proc.pid}"
             self._procs[instance_id] = proc
             spawned.append((worker, port_file, proc, instance_id))
+            self._preempt_files[(instance_name, worker)] = preempt_file
         # All workers of the slice boot in parallel — the real GCP path
         # provisions one TPU node object whose workers come up together.
         try:
@@ -220,6 +230,19 @@ class LocalCompute(Compute):
         # API deletes the whole node object); locally that must fan out to
         # every worker's process, so each jpd carries the gang's pids.
         slice_pids = [proc.pid for _w, _p, proc, _i in spawned]
+        # Hand the gang to an installed chaos engine so tick-scheduled
+        # preempt/crash events can target it by instance name/worker index.
+        from dstack_tpu import chaos
+
+        engine = chaos.get_engine()
+        if engine is not None:
+            for worker, _port, proc, _iid in spawned:
+                engine.register_worker(
+                    instance_name,
+                    worker,
+                    preemption_file=self._preempt_files[(instance_name, worker)],
+                    pids=[proc.pid],
+                )
         for worker, port, proc, instance_id in spawned:
             out.append(
                 JobProvisioningData(

@@ -1,0 +1,53 @@
+"""Chaos/resilience subsystem: deterministic fault injection for the
+orchestrator's recovery paths.
+
+Spot/preemptible capacity and maintenance events are the dominant failure
+mode for multi-host TPU gangs; this package makes every recovery path
+(preemption drain, gang resubmit, checkpoint resume, disconnect grace,
+backend-API flakes) exercisable deterministically from the CPU test suite
+and from a headless scenario runner (`python -m dstack_tpu.chaos`).
+
+A process-global engine keeps the hook points one-liner cheap: production
+code calls `maybe_inject(...)`, which is a no-op unless a test or scenario
+installed an engine. See `docs/guides/resilience.md`.
+"""
+
+from typing import Optional
+
+from dstack_tpu.chaos.engine import ChaosEngine, ChaosError, ChaosEvent
+
+__all__ = [
+    "ChaosEngine",
+    "ChaosError",
+    "ChaosEvent",
+    "get_engine",
+    "install",
+    "maybe_inject",
+    "uninstall",
+]
+
+_engine: Optional[ChaosEngine] = None
+
+
+def install(engine: ChaosEngine) -> ChaosEngine:
+    """Make `engine` the process-global chaos engine consulted by hooks."""
+    global _engine
+    _engine = engine
+    return engine
+
+
+def uninstall() -> None:
+    global _engine
+    _engine = None
+
+
+def get_engine() -> Optional[ChaosEngine]:
+    return _engine
+
+
+async def maybe_inject(hook: str, **attrs) -> None:
+    """Hook-point entry: no-op without an installed engine; otherwise may
+    sleep (latency fault) or raise ChaosError (error fault)."""
+    engine = _engine
+    if engine is not None:
+        await engine.inject(hook, **attrs)

@@ -1,0 +1,54 @@
+"""Headless chaos runner: `python -m dstack_tpu.chaos --scenario NAME`.
+
+Boots an in-memory server with the local backend, runs the named chaos
+scenario (see `dstack_tpu/chaos/scenarios.py`), prints the report, and
+exits nonzero if any expectation failed — wire it into CI the same way as
+`make chaos`.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+
+from dstack_tpu.chaos.scenarios import list_scenarios, run_scenario
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dstack_tpu.chaos",
+        description="Run deterministic chaos/resilience scenarios headlessly.",
+    )
+    parser.add_argument("--scenario", "-s", help="scenario name (see --list)")
+    parser.add_argument("--seed", type=int, default=0, help="fault-injection seed")
+    parser.add_argument("--all", action="store_true", help="run every scenario")
+    parser.add_argument("--list", action="store_true", help="list scenarios and exit")
+    parser.add_argument("--json", action="store_true", help="emit raw JSON reports")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in list_scenarios():
+            print(name)
+        return 0
+    names = list_scenarios() if args.all else ([args.scenario] if args.scenario else [])
+    if not names:
+        parser.error("pass --scenario NAME, --all, or --list")
+
+    ok = True
+    for name in names:
+        report = asyncio.run(run_scenario(name, seed=args.seed))
+        if args.json:
+            print(json.dumps(report))
+        else:
+            status = "PASS" if report["ok"] else "FAIL"
+            print(f"[{status}] {name} (seed {report['seed']})")
+            for f in report["failures"]:
+                print(f"  - {f}")
+            for k, v in report.get("details", {}).items():
+                print(f"  {k}: {v}")
+        ok = ok and report["ok"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""Seeded deterministic fault-injection engine.
+
+The engine holds a declarative schedule of `ChaosEvent`s and is consulted
+from hook points wired through the serving path:
+
+  - ``runner.http`` / ``shim.http`` — every agent-client request
+    (`server/services/runner/client.py`): drop (error) or delay (latency)
+    heartbeats and any other agent call.
+  - ``gcp.api`` — every `GcpApi.request` (`backends/gcp/api.py`): inject
+    backend-API errors/latency.
+  - ``tick`` — the engine's own logical clock: `preempt` (write the
+    maintenance-event file the agent-side watcher polls) and `crash`
+    (SIGKILL a registered runner process — a reclaimed VM with no notice).
+
+Determinism: call-scheduled events fire on the Nth *matching* call
+(per-event counters, no wall clock); probability-gated events draw from one
+`random.Random(seed)`, so a (schedule, seed) pair replays identically.
+Tick-scheduled events run on a logical tick counter and can be gated on a
+filesystem path (`when_path_exists`) to synchronize with workload progress
+markers — state-based, not time-based, so scenarios stay reproducible on
+loaded CI hosts.
+
+Everything injected is recorded in `engine.injected` for assertions and
+scenario reports.
+"""
+
+import asyncio
+import logging
+import os
+import random
+import signal
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+from dstack_tpu.models.common import CoreModel
+
+logger = logging.getLogger(__name__)
+
+
+class ChaosError(Exception):
+    """An injected fault. Hook sites translate it into the error type their
+    layer already handles (AgentHTTPError, GcpApiError) so downstream FSM
+    code cannot tell chaos from the real failure it simulates."""
+
+    def __init__(self, message: str = "chaos: injected fault", status: int = 503):
+        super().__init__(message)
+        self.status = status
+
+
+class ChaosEvent(CoreModel):
+    """One schedule entry. Call-hook events (`error`/`latency`) fire on
+    matching calls; tick events (`preempt`/`crash`) fire from the engine's
+    tick loop against registered workers."""
+
+    hook: str  # "runner.http" | "shim.http" | "gcp.api" | "tick"
+    action: str = "error"  # error | latency | preempt | crash
+    # Substring filters on the hook call's attrs, e.g. {"path": "/api/pull"}.
+    match: Dict[str, str] = {}
+    # Call scheduling: fire from the Nth matching call (1-based; default 1)
+    # for `calls` consecutive matches (None = unlimited).
+    at_call: Optional[int] = None
+    calls: Optional[int] = 1
+    # Seeded coin per otherwise-due call (composes with at_call/calls).
+    probability: Optional[float] = None
+    # Tick scheduling (preempt/crash): earliest logical tick, and/or a
+    # progress gate — the event waits until this path exists.
+    at_tick: Optional[int] = None
+    when_path_exists: Optional[str] = None
+    once: bool = True
+    # Target selectors for preempt/crash (None = every registered worker).
+    worker: Optional[int] = None
+    instance: Optional[str] = None
+    # Fault parameters.
+    latency_s: float = 0.0
+    status: int = 503
+    message: str = "chaos: injected fault"
+
+
+class ChaosEngine:
+    def __init__(
+        self,
+        schedule: List[Union[ChaosEvent, Dict[str, Any]]],
+        seed: int = 0,
+        tick_interval: float = 0.25,
+        name: str = "chaos",
+    ):
+        self.name = name
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.events = [
+            e if isinstance(e, ChaosEvent) else ChaosEvent.model_validate(e)
+            for e in schedule
+        ]
+        self.tick_interval = tick_interval
+        self.tick = 0
+        self.injected: List[Dict[str, Any]] = []  # audit log of fired faults
+        self._counts = [0] * len(self.events)  # matching calls seen, per event
+        self._fired = [0] * len(self.events)
+        self._workers: List[Dict[str, Any]] = []
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    # -- hook-point API ------------------------------------------------------
+
+    async def inject(self, hook: str, **attrs: Any) -> None:
+        """Consulted at a hook point: sleeps for scheduled latency, raises
+        ChaosError for a scheduled error. No-op when nothing is due."""
+        delay = 0.0
+        err: Optional[ChaosEvent] = None
+        for i, ev in enumerate(self.events):
+            if ev.hook != hook or ev.action not in ("error", "latency"):
+                continue
+            if not self._matches(ev, attrs):
+                continue
+            self._counts[i] += 1
+            if not self._due(i, ev):
+                continue
+            self._fired[i] += 1
+            self._record(ev, hook=hook, **attrs)
+            if ev.action == "latency":
+                delay = max(delay, ev.latency_s)
+            else:
+                err = ev
+        if delay:
+            await asyncio.sleep(delay)
+        if err is not None:
+            raise ChaosError(err.message, err.status)
+
+    def register_worker(
+        self,
+        instance_name: str,
+        worker: int,
+        *,
+        preemption_file: Optional[str] = None,
+        pids: Optional[List[int]] = None,
+    ) -> None:
+        """Called by the local backend when it spawns a worker host, making
+        it a target for tick-scheduled preempt/crash events."""
+        self._workers.append(
+            {
+                "instance": instance_name,
+                "worker": worker,
+                "preemption_file": preemption_file,
+                "pids": pids or [],
+            }
+        )
+
+    # -- tick loop -----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._stopped = False
+            self._task = asyncio.get_event_loop().create_task(self._tick_loop())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _tick_loop(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(self.tick_interval)
+            self.tick += 1
+            for i, ev in enumerate(self.events):
+                if ev.hook != "tick" or ev.action not in ("preempt", "crash"):
+                    continue
+                if ev.once and self._fired[i]:
+                    continue
+                if ev.at_tick is not None and self.tick < ev.at_tick:
+                    continue
+                if ev.when_path_exists and not os.path.exists(ev.when_path_exists):
+                    continue
+                targets = self._targets(ev)
+                if not targets:
+                    continue  # nothing registered yet; retry next tick
+                self._fired[i] += 1
+                for t in targets:
+                    if ev.action == "preempt":
+                        self._fire_preempt(ev, t)
+                    else:
+                        self._fire_crash(ev, t)
+
+    def _targets(self, ev: ChaosEvent) -> List[Dict[str, Any]]:
+        out = []
+        for t in self._workers:
+            if ev.worker is not None and t["worker"] != ev.worker:
+                continue
+            if ev.instance is not None and ev.instance not in t["instance"]:
+                continue
+            out.append(t)
+        return out
+
+    def _fire_preempt(self, ev: ChaosEvent, target: Dict[str, Any]) -> None:
+        path = target.get("preemption_file")
+        if not path:
+            return
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        Path(path).write_text("TERMINATE_ON_HOST_MAINTENANCE")
+        self._record(ev, hook="tick", **{k: target[k] for k in ("instance", "worker")})
+        logger.info(
+            "chaos: preemption notice for %s worker %s", target["instance"], target["worker"]
+        )
+
+    def _fire_crash(self, ev: ChaosEvent, target: Dict[str, Any]) -> None:
+        self._record(ev, hook="tick", **{k: target[k] for k in ("instance", "worker")})
+        for pid in target["pids"]:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGKILL)
+                logger.info("chaos: crashed runner pid %s (worker %s)", pid, target["worker"])
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    # -- internals -----------------------------------------------------------
+
+    def _matches(self, ev: ChaosEvent, attrs: Dict[str, Any]) -> bool:
+        return all(needle in str(attrs.get(key, "")) for key, needle in ev.match.items())
+
+    def _due(self, i: int, ev: ChaosEvent) -> bool:
+        if ev.when_path_exists and not os.path.exists(ev.when_path_exists):
+            return False
+        first = ev.at_call or 1
+        n = self._counts[i]
+        if n < first:
+            return False
+        if ev.calls is not None and n >= first + ev.calls:
+            return False
+        if ev.probability is not None and self.rng.random() >= ev.probability:
+            return False
+        return True
+
+    def _record(self, ev: ChaosEvent, **attrs: Any) -> None:
+        self.injected.append(
+            {
+                "tick": self.tick,
+                "action": ev.action,
+                "message": ev.message,
+                **{k: v for k, v in attrs.items() if isinstance(v, (str, int, float))},
+            }
+        )

@@ -100,6 +100,11 @@ class JobTerminationReason(str, Enum):
     TERMINATED_BY_SERVER = "terminated_by_server"
     GANG_MEMBER_FAILED = "gang_member_failed"  # TPU-first: any-worker death kills the gang
     # Set by the runner/agents
+    # Provider maintenance/preemption notice: the agent drained the job
+    # (SIGTERM + grace) before the host went away. Retryable as an
+    # `interruption` event, like INTERRUPTED_BY_NO_CAPACITY — but unlike a
+    # hard kill, the workload had a window to checkpoint.
+    PREEMPTED_BY_PROVIDER = "preempted_by_provider"
     CONTAINER_EXITED_WITH_ERROR = "container_exited_with_error"
     PORTS_BINDING_FAILED = "ports_binding_failed"
     CREATING_CONTAINER_ERROR = "creating_container_error"
@@ -120,6 +125,7 @@ class JobTerminationReason(str, Enum):
             self.ABORTED_BY_USER: JobStatus.ABORTED,
             self.TERMINATED_BY_SERVER: JobStatus.TERMINATED,
             self.GANG_MEMBER_FAILED: JobStatus.FAILED,
+            self.PREEMPTED_BY_PROVIDER: JobStatus.FAILED,
             self.CONTAINER_EXITED_WITH_ERROR: JobStatus.FAILED,
             self.PORTS_BINDING_FAILED: JobStatus.FAILED,
             self.CREATING_CONTAINER_ERROR: JobStatus.FAILED,

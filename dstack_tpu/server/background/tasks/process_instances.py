@@ -80,8 +80,12 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
             # (json-substring match on the shared tpu_node_id; jpd rows are
             # compact pydantic dumps).
             if jpd.tpu_node_id is not None and jpd.tpu_worker_index == 0:
+                # The LIKE runs over raw JSON text, so the node id must be
+                # JSON-escaped first (a literal backslash is stored as \\),
+                # THEN LIKE-escaped so %/_/\ in the id cannot wildcard-match
+                # other nodes.
                 node = (
-                    jpd.tpu_node_id.replace("\\", "\\\\")
+                    json.dumps(jpd.tpu_node_id)[1:-1].replace("\\", "\\\\")
                     .replace("%", "\\%").replace("_", "\\_")
                 )
                 busy = await ctx.db.fetchone(
@@ -175,17 +179,29 @@ async def _healthcheck(ctx: ServerContext, row: sqlite3.Row) -> bool:
     if healthy:
         await ctx.db.execute(
             "UPDATE instances SET unreachable = 0, unreachable_since = NULL,"
-            " health_status = 'healthy' WHERE id = ?",
+            " health_status = 'healthy', health_fail_streak = 0 WHERE id = ?",
             (row["id"],),
+        )
+        return False
+    # Flap damping: one dropped probe (GC pause, transient tunnel reset) must
+    # not start the unreachable->terminate clock. Only a streak of consecutive
+    # failures marks the instance unreachable; any healthy probe resets it.
+    streak = (row["health_fail_streak"] or 0) + 1
+    if streak < settings.INSTANCE_HEALTH_FLAP_THRESHOLD:
+        await ctx.db.execute(
+            "UPDATE instances SET health_fail_streak = ?, health_status = ?"
+            " WHERE id = ?",
+            (streak, (detail or "unreachable")[:200], row["id"]),
         )
         return False
     unreachable_since = parse_dt(row["unreachable_since"]) or utcnow()
     await ctx.db.execute(
         "UPDATE instances SET unreachable = 1, unreachable_since = ?,"
-        " health_status = ? WHERE id = ?",
+        " health_status = ?, health_fail_streak = ? WHERE id = ?",
         (
             row["unreachable_since"] or now,
             (detail or "unreachable")[:200],
+            streak,
             row["id"],
         ),
     )

@@ -7,11 +7,14 @@ TPU-first: ANY worker job of a replica failing terminates the whole replica
 special-cases the master job.
 """
 
+import json
 import logging
+import random
 from typing import List, Optional
 
 import sqlite3
 
+from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
 from dstack_tpu.models.runs import (
     JobStatus,
     JobTerminationReason,
@@ -204,6 +207,8 @@ async def _maybe_retry(
     if retry is None:
         return False
     now = utcnow()
+    resilience = json.loads(row["resilience"]) if row["resilience"] else {}
+    resubmitted = False
     for replica in failed_replicas:
         replica_jobs = [j for j in jobs if j["replica_num"] == replica]
         # All jobs of the failed replica must be finished before resubmission.
@@ -238,8 +243,15 @@ async def _maybe_retry(
                 covered = False
         if not covered:
             return False
-        # Retry-duration budget: measured from the first submission.
-        first = min(parse_dt(j["submitted_at"]) for j in replica_jobs)
+        # Retry-duration budget: measured from the FIRST submission of the
+        # replica, not the latest resubmission — otherwise each retry resets
+        # the clock and a flapping replica retries forever.
+        first_row = await ctx.db.fetchone(
+            "SELECT MIN(submitted_at) AS first_submitted FROM jobs"
+            " WHERE run_id = ? AND replica_num = ?",
+            (row["id"], replica),
+        )
+        first = parse_dt(first_row["first_submitted"])
         if (now - first).total_seconds() > retry.duration:
             await ctx.db.execute(
                 "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
@@ -254,22 +266,83 @@ async def _maybe_retry(
         await create_replica_jobs(
             ctx, row["project_id"], row["id"], run_spec, replica, submission_num
         )
-        await ctx.db.execute(
-            "UPDATE runs SET status = ? WHERE id = ?", (RunStatus.PENDING.value, row["id"])
-        )
+        _account_resilience(ctx, row, resilience, replica_jobs)
+        resubmitted = True
         logger.info(
             "run %s: resubmitted replica %s (submission %s)",
             row["run_name"], replica, submission_num,
+        )
+    if resubmitted:
+        await ctx.db.execute(
+            "UPDATE runs SET status = ?, resilience = ? WHERE id = ?",
+            (RunStatus.PENDING.value, json.dumps(resilience), row["id"]),
         )
     ctx.kick("submitted_jobs")
     return True
 
 
+_PREEMPTION_REASONS = {
+    JobTerminationReason.PREEMPTED_BY_PROVIDER.value,
+    JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY.value,
+}
+
+
+def _account_resilience(
+    ctx: ServerContext, row: sqlite3.Row, resilience: dict, replica_jobs: List[sqlite3.Row]
+) -> None:
+    """Accumulate per-run resilience counters for one replica resubmission.
+
+    steps_lost stays 0 for clean drains by construction (the checkpoint is
+    saved before the job exits); hard kills lose whatever the workload wrote
+    since its last periodic checkpoint, which the server cannot see — so it
+    is only bumped when no clean drain happened, as "unknown >= 0" floor.
+    """
+    preemptions = sum(
+        1 for j in replica_jobs if j["termination_reason"] in _PREEMPTION_REASONS
+    )
+    clean_drains = sum(
+        1
+        for j in replica_jobs
+        if j["termination_reason"] == JobTerminationReason.PREEMPTED_BY_PROVIDER.value
+        and j["exit_status"] == DRAIN_EXIT_CODE
+    )
+    resilience["preemptions"] = resilience.get("preemptions", 0) + preemptions
+    resilience["clean_drains"] = resilience.get("clean_drains", 0) + clean_drains
+    resilience["restarts"] = resilience.get("restarts", 0) + 1
+    resilience.setdefault("steps_lost", 0)
+    labels = {"run": row["run_name"]}
+    if preemptions:
+        ctx.tracer.inc("run_preemptions", preemptions, **labels)
+    if clean_drains:
+        ctx.tracer.inc("run_clean_drains", clean_drains, **labels)
+    ctx.tracer.inc("run_restarts", 1, **labels)
+
+
+def _pending_run_delay(run_id: str, base: float, attempt: int) -> float:
+    """Exponential backoff with deterministic jitter for resubmitted runs.
+
+    attempt is the highest submission_num across the run's jobs (1 after the
+    first resubmit). The delay doubles per attempt, capped, with ±20% jitter
+    seeded by (run_id, attempt) so repeated ticks compute the same deadline.
+    """
+    if base <= 0:
+        return 0.0
+    delay = min(base * 2 ** max(attempt - 1, 0), settings.RETRY_PENDING_RUN_DELAY_CAP)
+    return delay * random.Random(f"{run_id}:{attempt}").uniform(0.8, 1.2)
+
+
 async def _process_pending_run(ctx: ServerContext, row: sqlite3.Row) -> None:
     # Resubmitted replicas exist already; flip back to SUBMITTED after the
-    # retry delay (reference: RETRY_DELAY=15s, process_runs.py:43).
+    # retry delay (reference: RETRY_DELAY=15s, process_runs.py:43), scaled
+    # exponentially by how many times the gang has already been resubmitted
+    # so a crash-looping run does not hammer the provisioning path.
+    attempt_row = await ctx.db.fetchone(
+        "SELECT MAX(submission_num) AS attempt FROM jobs WHERE run_id = ?", (row["id"],)
+    )
+    attempt = attempt_row["attempt"] or 0
+    delay = _pending_run_delay(row["id"], settings.RETRY_PENDING_RUN_DELAY, attempt)
     last = parse_dt(row["last_processed_at"])
-    if (utcnow() - last).total_seconds() < settings.RETRY_PENDING_RUN_DELAY:
+    if (utcnow() - last).total_seconds() < delay:
         return
     await ctx.db.execute(
         "UPDATE runs SET status = ? WHERE id = ?", (RunStatus.SUBMITTED.value, row["id"])

@@ -48,6 +48,80 @@ def migration(sql: str, down: Optional[str] = None) -> None:
     DOWNGRADES.append(down)
 
 
+_DROP_COLUMN_RE = re.compile(
+    r"ALTER\s+TABLE\s+(\w+)\s+DROP\s+COLUMN\s+(\w+)\s*;", re.IGNORECASE
+)
+
+
+def _emulate_drop_column(conn: sqlite3.Connection, script: str) -> str:
+    """Rewrite `ALTER TABLE t DROP COLUMN c;` statements for sqlite < 3.35
+    (which predates DROP COLUMN) into the documented rebuild procedure:
+    create the narrowed table under a temp name, copy rows, drop the old
+    table, rename, recreate its indexes. The new table is renamed LAST so
+    REFERENCES clauses in *other* tables keep pointing at the original
+    name (a rename first would rewrite them to the temp name)."""
+    if sqlite3.sqlite_version_info >= (3, 35, 0):
+        return script
+    # Per-table running state: consecutive drops against one table in one
+    # script must each see the previous drop applied.
+    create_sql: dict = {}
+    columns: dict = {}
+
+    def _load(table: str) -> None:
+        if table in create_sql:
+            return
+        row = conn.execute(
+            "SELECT sql FROM sqlite_master WHERE type='table' AND name=?", (table,)
+        ).fetchone()
+        if row is None:
+            raise RuntimeError(f"cannot emulate DROP COLUMN: no table {table!r}")
+        create_sql[table] = row[0]
+        columns[table] = [
+            r[1] for r in conn.execute(f'PRAGMA table_info("{table}")')
+        ]
+
+    def _rebuild(m: "re.Match[str]") -> str:
+        table, column = m.group(1), m.group(2)
+        _load(table)
+        # ADD COLUMN appends the definition at the end of the stored CREATE
+        # statement; none of ours contain commas or parens, so trimming
+        # ", col ..." up to the next delimiter is exact.
+        narrowed = re.sub(
+            rf',\s*"?{column}"?\s+[^,)]*', "", create_sql[table], count=1
+        )
+        if narrowed == create_sql[table]:
+            raise RuntimeError(
+                f"cannot emulate DROP COLUMN {table}.{column}: definition"
+                f" not found in stored CREATE TABLE"
+            )
+        create_sql[table] = narrowed
+        columns[table] = [c for c in columns[table] if c != column]
+        tmp = f"_mig_new_{table}"
+        tmp_create = re.sub(
+            rf'(CREATE\s+TABLE\s+)"?{table}"?', rf"\g<1>{tmp}", narrowed, count=1
+        )
+        collist = ", ".join(columns[table])
+        indexes = [
+            r[0]
+            for r in conn.execute(
+                "SELECT sql FROM sqlite_master WHERE type='index'"
+                " AND tbl_name=? AND sql IS NOT NULL",
+                (table,),
+            )
+            if not re.search(rf"\b{column}\b", r[0].split("(", 1)[-1])
+        ]
+        stmts = [
+            tmp_create.rstrip().rstrip(";"),
+            f'INSERT INTO "{tmp}" ({collist}) SELECT {collist} FROM "{table}"',
+            f'DROP TABLE "{table}"',
+            f'ALTER TABLE "{tmp}" RENAME TO "{table}"',
+            *indexes,
+        ]
+        return ";\n".join(stmts) + ";"
+
+    return _DROP_COLUMN_RE.sub(_rebuild, script)
+
+
 class Database:
     # Read connections for file-backed DBs: WAL allows many concurrent
     # readers alongside the single writer, but a lone shared connection
@@ -220,7 +294,8 @@ class Database:
                         # version marker moves in the same commit as the
                         # schema it describes.
                         conn.executescript(
-                            "BEGIN;\n" + DOWNGRADES[v - 1]
+                            "BEGIN;\n"
+                            + _emulate_drop_column(conn, DOWNGRADES[v - 1])
                             + f"\n;PRAGMA user_version = {v - 1};\nCOMMIT;"
                         )
                     except BaseException:

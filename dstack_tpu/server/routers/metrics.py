@@ -6,11 +6,64 @@ from typing import Optional
 
 from dstack_tpu.errors import ResourceNotExistsError
 from dstack_tpu.models.metrics import JobMetrics, MetricsPoint, TpuChipMetrics
-from dstack_tpu.server.http import Request, Router
+from dstack_tpu.server.http import Request, Response, Router
 from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
 from dstack_tpu.utils.common import parse_dt
 
 router = Router()
+
+
+def _prom_escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_line(name: str, labels: dict, value) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {value}"
+    return f"{name} {value}"
+
+
+@router.get("/metrics")
+async def prometheus_metrics(request: Request):
+    """Prometheus text exposition: per-run resilience counters (preemptions,
+    restarts, clean drains, steps lost), tracer counters, and span stats.
+    Unauthenticated, like a typical scrape target."""
+    ctx = get_ctx(request)
+    lines = []
+    rows = await ctx.db.fetchall(
+        "SELECT r.run_name, r.resilience, p.name AS project FROM runs r"
+        " JOIN projects p ON p.id = r.project_id"
+        " WHERE r.deleted = 0 AND r.resilience IS NOT NULL"
+    )
+    gauges = {
+        "preemptions": "dstack_tpu_run_preemptions_total",
+        "restarts": "dstack_tpu_run_restarts_total",
+        "clean_drains": "dstack_tpu_run_clean_drains_total",
+        "steps_lost": "dstack_tpu_run_steps_lost_total",
+    }
+    emitted = set()
+    for r in rows:
+        res = json.loads(r["resilience"])
+        labels = {"project": r["project"], "run": r["run_name"]}
+        for key, metric in gauges.items():
+            if metric not in emitted:
+                lines.append(f"# TYPE {metric} counter")
+                emitted.add(metric)
+            lines.append(_prom_line(metric, labels, res.get(key, 0)))
+    for c in ctx.tracer.counter_snapshot():
+        metric = f"dstack_tpu_{c['name']}_total"
+        if metric not in emitted:
+            lines.append(f"# TYPE {metric} counter")
+            emitted.add(metric)
+        lines.append(_prom_line(metric, c["labels"], c["value"]))
+    lines.append("# TYPE dstack_tpu_span_count_total counter")
+    lines.append("# TYPE dstack_tpu_span_seconds_sum counter")
+    for name, st in ctx.tracer.snapshot()["stats"].items():
+        labels = {"span": name}
+        lines.append(_prom_line("dstack_tpu_span_count_total", labels, st["count"]))
+        lines.append(_prom_line("dstack_tpu_span_seconds_sum", labels, st["total_s"]))
+    return Response("\n".join(lines) + "\n", media_type="text/plain; version=0.0.4")
 
 
 @router.get("/api/project/{project_name}/metrics/job/{run_name}")

@@ -302,3 +302,19 @@ CREATE TABLE resource_leases (
 DROP TABLE resource_leases;
 """,
 )
+
+# Migration 5: resilience. Per-run recovery counters (preemptions survived,
+# gang restarts, clean checkpoint drains — JSON, written by the retry FSM
+# and surfaced via /metrics) and the health-probe failure streak that backs
+# flap damping in process_instances (N consecutive failures before the
+# unreachable deadline starts).
+migration(
+    """
+ALTER TABLE runs ADD COLUMN resilience TEXT;
+ALTER TABLE instances ADD COLUMN health_fail_streak INTEGER NOT NULL DEFAULT 0;
+""",
+    down="""
+ALTER TABLE runs DROP COLUMN resilience;
+ALTER TABLE instances DROP COLUMN health_fail_streak;
+""",
+)

@@ -5,11 +5,64 @@ EncryptionKey: AES / identity) and user token auth.
 """
 
 import base64
+import hashlib
+import hmac
 import os
 import uuid
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:  # gated: the image may lack `cryptography`
+    AESGCM = None
+
+
+class _HmacAead:
+    """Stdlib fallback AEAD when `cryptography` is absent: HMAC-SHA256
+    keystream (CTR construction) with an encrypt-then-MAC tag. Same
+    nonce/ciphertext/tag interface as AESGCM so `Encryption` is oblivious;
+    values written by one implementation fail loudly (bad tag) under the
+    other rather than decrypting to garbage."""
+
+    _TAG_LEN = 16
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    @staticmethod
+    def generate_key(bit_length: int = 256) -> bytes:
+        return os.urandom(bit_length // 8)
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        out = b""
+        counter = 0
+        while len(out) < n:
+            block = hmac.new(
+                self._key, nonce + counter.to_bytes(4, "big"), hashlib.sha256
+            ).digest()
+            out += block
+            counter += 1
+        return out[:n]
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+        ct = bytes(a ^ b for a, b in zip(data, self._keystream(nonce, len(data))))
+        tag = hmac.new(
+            self._key, b"tag" + nonce + aad + ct, hashlib.sha256
+        ).digest()[: self._TAG_LEN]
+        return ct + tag
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+        ct, tag = data[: -self._TAG_LEN], data[-self._TAG_LEN :]
+        want = hmac.new(
+            self._key, b"tag" + nonce + aad + ct, hashlib.sha256
+        ).digest()[: self._TAG_LEN]
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("decryption failed: bad auth tag")
+        return bytes(a ^ b for a, b in zip(ct, self._keystream(nonce, len(ct))))
+
+
+if AESGCM is None:
+    AESGCM = _HmacAead
 
 
 def generate_token() -> str:

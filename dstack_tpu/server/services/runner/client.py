@@ -9,6 +9,7 @@ from typing import Dict, Optional
 
 import httpx
 
+from dstack_tpu import chaos
 from dstack_tpu.agents.protocol import (
     HealthcheckResponse,
     MetricsResponse,
@@ -37,6 +38,15 @@ class RunnerClient:
         await self._client.aclose()
 
     async def _request(self, method: str, path: str, **kwargs) -> httpx.Response:
+        # Chaos hook: scheduled faults surface as the AgentHTTPError a real
+        # flaky agent produces — dropped heartbeats (pull errors) ride the
+        # disconnect-grace path, healthcheck errors the flap damping.
+        try:
+            await chaos.maybe_inject(
+                "runner.http", method=method, path=path, base_url=self.base_url
+            )
+        except chaos.ChaosError as e:
+            raise AgentHTTPError(e.status, str(e))
         resp = await self._client.request(method, self.base_url + path, **kwargs)
         if resp.status_code >= 400:
             raise AgentHTTPError(resp.status_code, resp.text)
@@ -113,6 +123,12 @@ class ShimClient:
         await self._client.aclose()
 
     async def _request(self, method: str, path: str, **kwargs) -> httpx.Response:
+        try:
+            await chaos.maybe_inject(
+                "shim.http", method=method, path=path, base_url=self.base_url
+            )
+        except chaos.ChaosError as e:
+            raise AgentHTTPError(e.status, str(e))
         resp = await self._client.request(method, self.base_url + path, **kwargs)
         if resp.status_code >= 400:
             raise AgentHTTPError(resp.status_code, resp.text)

@@ -43,6 +43,7 @@ from dstack_tpu.utils.common import generate_run_name, utcnow, utcnow_iso
 JOB_TERMINATION_REASONS_RETRYABLE = {
     JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
     JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+    JobTerminationReason.PREEMPTED_BY_PROVIDER,
 }
 
 

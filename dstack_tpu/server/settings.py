@@ -56,7 +56,14 @@ RUNNER_READY_TIMEOUT = int(os.getenv("DSTACK_TPU_RUNNER_READY_TIMEOUT", "600"))
 RUNNER_DISCONNECT_GRACE = float(os.getenv("DSTACK_TPU_RUNNER_DISCONNECT_GRACE", "120"))
 INSTANCE_PROVISIONING_TIMEOUT = int(os.getenv("DSTACK_TPU_PROVISIONING_TIMEOUT", "600"))
 INSTANCE_UNREACHABLE_DEADLINE = int(os.getenv("DSTACK_TPU_UNREACHABLE_DEADLINE", "1200"))
+# Consecutive failed health probes before the unreachable->terminate
+# deadline starts ticking — one dropped heartbeat (chaos, GC pause, link
+# blip) must not start the clock on terminating a busy gang worker.
+INSTANCE_HEALTH_FLAP_THRESHOLD = int(os.getenv("DSTACK_TPU_HEALTH_FLAP_THRESHOLD", "3"))
 RETRY_PENDING_RUN_DELAY = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY", "15"))
+# Exponential-backoff ceiling for run resubmission: the pending-run delay
+# doubles per submission (base * 2^(n-1), jittered) up to this cap.
+RETRY_PENDING_RUN_DELAY_CAP = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY_CAP", "300"))
 
 ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); identity if unset
 

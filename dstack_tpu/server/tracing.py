@@ -48,10 +48,29 @@ class Tracer:
         self._lock = threading.Lock()
         self.spans: deque = deque(maxlen=max_spans)
         self.stats: Dict[str, SpanStats] = defaultdict(SpanStats)
+        # Monotonic labelled counters (resilience events, chaos injections):
+        # name -> {sorted-label-tuple: value}. Exposed on /metrics in
+        # Prometheus text format and in /debug/traces snapshots.
+        self.counters: Dict[str, Dict[tuple, float]] = defaultdict(dict)
         # Sentry-style error dedupe: fingerprint -> {first/last seen, count,
         # one representative traceback}.
         self.errors: Dict[str, Dict[str, Any]] = {}
         self._errors_order: deque = deque(maxlen=max_errors)
+
+    def inc(self, name: str, value: float = 1, **labels: Any) -> None:
+        """Bump a labelled counter (monotonic; create-on-first-use)."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            series = self.counters[name]
+            series[key] = series.get(key, 0) + value
+
+    def counter_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"name": name, "labels": dict(key), "value": value}
+                for name, series in self.counters.items()
+                for key, value in series.items()
+            ]
 
     def record(
         self,
@@ -140,6 +159,11 @@ class Tracer:
         with self._lock:
             return {
                 "stats": {name: st.to_dict() for name, st in self.stats.items()},
+                "counters": [
+                    {"name": name, "labels": dict(key), "value": value}
+                    for name, series in self.counters.items()
+                    for key, value in series.items()
+                ],
                 "recent_spans": list(self.spans)[-100:],
             }
 

@@ -18,6 +18,8 @@ def utcnow_iso() -> str:
 def parse_dt(v: Optional[str]) -> Optional[datetime]:
     if v is None:
         return None
+    if v.endswith("Z"):  # py3.10 fromisoformat rejects the Zulu suffix
+        v = v[:-1] + "+00:00"
     dt = datetime.fromisoformat(v)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)

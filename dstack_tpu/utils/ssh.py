@@ -14,14 +14,19 @@ from contextlib import asynccontextmanager
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+except ModuleNotFoundError:  # gated: the image may lack `cryptography`
+    serialization = rsa = None
 
 from dstack_tpu.errors import SSHError
 
 
 def generate_rsa_keypair() -> Tuple[str, str]:
     """(private_pem, public_openssh)."""
+    if rsa is None:
+        return _generate_rsa_keypair_openssh()
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     private_pem = key.private_bytes(
         encoding=serialization.Encoding.PEM,
@@ -33,6 +38,26 @@ def generate_rsa_keypair() -> Tuple[str, str]:
         format=serialization.PublicFormat.OpenSSH,
     ).decode()
     return private_pem, public_openssh + " dstack-tpu"
+
+
+def _generate_rsa_keypair_openssh() -> Tuple[str, str]:
+    """Fallback via the ssh-keygen binary (the tunnel layer already requires
+    OpenSSH on PATH, so this adds no new dependency)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "id")
+        try:
+            subprocess.run(
+                ["ssh-keygen", "-q", "-t", "rsa", "-b", "2048", "-m", "PEM",
+                 "-N", "", "-C", "dstack-tpu", "-f", path],
+                check=True, capture_output=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise SSHError(f"cannot generate SSH keypair: {e}")
+        with open(path) as f:
+            private_pem = f.read()
+        with open(path + ".pub") as f:
+            public_openssh = f.read().strip()
+        return private_pem, public_openssh
 
 
 _SSH_OPTS = [

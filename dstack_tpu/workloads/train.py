@@ -7,6 +7,8 @@ ppermutes (seq ring attention). Optimizer is AdamW with f32 moments sharded
 exactly like their params, so optimizer memory scales down with fsdp.
 """
 
+import signal as _signal
+import sys as _sys
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -291,6 +293,54 @@ def make_train_step(
         return _cache[key](state, batch)
 
     return jitted
+
+
+class DrainHandler:
+    """Graceful-preemption hook for training loops.
+
+    When the provider announces a maintenance/preemption event, the runner
+    agent SIGTERMs the job group and waits a grace window before killing it
+    (agents/runner.py `Executor.drain`). A training loop that installs this
+    handler turns that window into a durable checkpoint:
+
+        handler = install_drain_handler()
+        for _ in range(start, steps):
+            state, metrics = train_step(state, batch)
+            if handler.draining:
+                handler.checkpoint_and_exit(ckpt_dir, state)
+
+    `checkpoint_and_exit` saves through workloads/checkpoint.py (blocking
+    until durable) and exits with DRAIN_EXIT_CODE so the runner reports a
+    *clean* drain — the resubmitted gang resumes from this step instead of
+    the last periodic checkpoint (or step 0). `exec` the trainer from the
+    job command so the exit code reaches the runner unwrapped by bash.
+    """
+
+    def __init__(self, signals=(_signal.SIGTERM,)):
+        self._draining = False
+        for sig in signals:
+            _signal.signal(sig, self._on_signal)
+
+    def _on_signal(self, signum, frame) -> None:
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def checkpoint_and_exit(self, directory, state: TrainState) -> None:
+        from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
+        from dstack_tpu.workloads import checkpoint as ckpt
+
+        step = ckpt.save(directory, state, wait=True)
+        ckpt.close_all()
+        print(f"drain: checkpoint saved at step {step}; exiting", flush=True)
+        _sys.exit(DRAIN_EXIT_CODE)
+
+
+def install_drain_handler() -> DrainHandler:
+    """Install SIGTERM-drain handling for the calling training process."""
+    return DrainHandler()
 
 
 def synthetic_batch(

@@ -1,0 +1,114 @@
+"""Chaos subsystem: engine determinism, hook-point fault translation, and
+the bundled scenarios end to end (the preempt-resume drill is THE
+acceptance story: drain -> checkpoint -> gang resubmit -> resume > 0 ->
+/metrics counters)."""
+
+import pytest
+
+from dstack_tpu import chaos
+from dstack_tpu.chaos.engine import ChaosEngine, ChaosError
+from dstack_tpu.chaos.scenarios import list_scenarios, run_scenario
+
+
+def teardown_function(_fn):
+    chaos.uninstall()  # never leak an engine into other tests
+
+
+async def test_engine_at_call_window():
+    """An error scheduled at_call=2 for 2 calls fires on exactly the 2nd and
+    3rd matching calls; non-matching calls don't advance the counter."""
+    engine = ChaosEngine(
+        [{"hook": "runner.http", "action": "error",
+          "match": {"path": "/api/pull"}, "at_call": 2, "calls": 2}]
+    )
+    fired = []
+    for path in ["/api/pull", "/api/submit", "/api/pull", "/api/pull", "/api/pull"]:
+        try:
+            await engine.inject("runner.http", method="GET", path=path)
+            fired.append(False)
+        except ChaosError:
+            fired.append(True)
+    assert fired == [False, False, True, True, False]
+    assert len(engine.injected) == 2
+
+
+async def test_engine_probability_is_seed_deterministic():
+    """The same (schedule, seed) replays the same fault pattern; a different
+    seed draws a different coin sequence."""
+    schedule = [{"hook": "gcp.api", "action": "error",
+                 "calls": None, "probability": 0.5}]
+
+    async def pattern(seed):
+        engine = ChaosEngine(schedule, seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                await engine.inject("gcp.api", method="POST", url="/nodes")
+                out.append(0)
+            except ChaosError:
+                out.append(1)
+        return out
+
+    a, b, c = await pattern(7), await pattern(7), await pattern(8)
+    assert a == b
+    assert a != c
+    assert 0 < sum(a) < 64  # the coin actually flips both ways
+
+
+async def test_runner_client_translates_chaos_to_agent_error():
+    """A fault injected at the runner.http hook surfaces as the
+    AgentHTTPError a real flaky agent produces — before any socket I/O."""
+    from dstack_tpu.server.services.runner.client import AgentHTTPError, RunnerClient
+
+    chaos.install(
+        ChaosEngine(
+            [{"hook": "runner.http", "action": "error",
+              "match": {"path": "/api/pull"}, "status": 503,
+              "message": "chaos: dropped heartbeat"}]
+        )
+    )
+    client = RunnerClient("http://127.0.0.1:1")  # nothing listens; hook fires first
+    try:
+        with pytest.raises(AgentHTTPError) as exc:
+            await client._request("GET", "/api/pull")
+        assert exc.value.status == 503
+        assert "dropped heartbeat" in str(exc.value)
+    finally:
+        await client.close()
+        chaos.uninstall()
+
+
+async def test_maybe_inject_is_noop_without_engine():
+    chaos.uninstall()
+    await chaos.maybe_inject("runner.http", path="/api/pull")  # must not raise
+
+
+async def test_scenario_registry():
+    assert {"runner-flap", "hard-preempt", "preempt-resume"} <= set(list_scenarios())
+    with pytest.raises(ValueError, match="unknown scenario"):
+        await run_scenario("no-such-drill")
+
+
+async def test_runner_flap_scenario_absorbed_by_grace():
+    """Fast tier-1 scenario: injected pull failures ride the disconnect
+    grace; the run finishes on its first submission."""
+    report = await run_scenario("runner-flap", seed=0)
+    assert report["ok"], report["failures"]
+    assert report["details"]["submissions"] == 1
+    assert len(report["details"]["injected"]) == 2
+
+
+async def test_preempt_resume_drill_end_to_end():
+    """Acceptance: preempt one worker of a 2-worker gang mid-training ->
+    drain saves a checkpoint -> gang resubmitted exactly once -> training
+    resumes at step > 0 -> /metrics reports 1 preemption + 1 restart."""
+    report = await run_scenario("preempt-resume", seed=0)
+    assert report["ok"], report["failures"]
+    resumed = int(report["details"]["final"].split("resumed_from=")[1].split()[0])
+    assert resumed > 0
+
+
+@pytest.mark.slow
+async def test_hard_preempt_scenario_end_to_end():
+    report = await run_scenario("hard-preempt", seed=0)
+    assert report["ok"], report["failures"]

@@ -114,6 +114,8 @@ async def test_unreachable_instance_gets_deadline_then_terminates(monkeypatch):
     fx = await make_server(run_background_tasks=False)
     try:
         ctx = fx.ctx
+        # Flap damping off: one failed probe starts the unreachable clock.
+        monkeypatch.setattr(settings, "INSTANCE_HEALTH_FLAP_THRESHOLD", 1)
         ctx.overrides["instance_health_client"] = _always_dead
         iid = await _insert_instance(ctx, status="busy")
         await process_instances(ctx)
@@ -173,6 +175,172 @@ async def test_pending_instance_provisioning_deadline(monkeypatch):
         assert row["termination_reason"] == "provisioning timeout"
     finally:
         await fx.app.shutdown()
+
+
+async def test_healthcheck_flap_damping_requires_streak(monkeypatch):
+    """Transient probe failures (GC pause, tunnel reset) must not start the
+    unreachable->terminate clock: only N CONSECUTIVE failures do."""
+    from dstack_tpu.server import settings
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        monkeypatch.setattr(settings, "INSTANCE_HEALTH_FLAP_THRESHOLD", 3)
+        ctx.overrides["instance_health_client"] = _always_dead
+        iid = await _insert_instance(ctx, status="busy")
+        for expected_streak in (1, 2):
+            await process_instances(ctx)
+            row = await ctx.db.fetchone("SELECT * FROM instances WHERE id = ?", (iid,))
+            assert row["unreachable"] == 0, expected_streak
+            assert row["unreachable_since"] is None
+            assert row["health_fail_streak"] == expected_streak
+            assert "refused" in row["health_status"]  # detail still recorded
+        # Third consecutive failure crosses the threshold: clock starts.
+        await process_instances(ctx)
+        row = await ctx.db.fetchone("SELECT * FROM instances WHERE id = ?", (iid,))
+        assert row["unreachable"] == 1
+        assert row["unreachable_since"] is not None
+        assert row["health_fail_streak"] == 3
+        assert row["status"] == "busy"  # deadline not yet passed
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_healthcheck_flap_streak_reset_by_recovery(monkeypatch):
+    """A healthy probe between failures resets the streak, so a flapping
+    link never accumulates to unreachable."""
+    from dstack_tpu.server import settings
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        monkeypatch.setattr(settings, "INSTANCE_HEALTH_FLAP_THRESHOLD", 3)
+        iid = await _insert_instance(ctx, status="busy")
+        for probe in (_always_dead, _always_dead, _always_healthy,
+                      _always_dead, _always_dead):
+            ctx.overrides["instance_health_client"] = probe
+            await process_instances(ctx)
+            row = await ctx.db.fetchone("SELECT * FROM instances WHERE id = ?", (iid,))
+            assert row["unreachable"] == 0
+        assert row["health_fail_streak"] == 2  # the post-recovery streak
+        assert row["status"] == "busy"
+    finally:
+        await fx.app.shutdown()
+
+
+# ---- _terminate: deferred slice delete -------------------------------------
+
+
+async def _insert_slice_worker(ctx, *, node_id, worker, status, name=None):
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    iid = generate_id()
+    jpd = {
+        "backend": "gcp",
+        "instance_type": {"name": "v5litepod-8",
+                          "resources": {"cpus": 24, "memory_mib": 48000}},
+        "instance_id": f"i-{iid[:6]}",
+        "hostname": "10.0.0.5",
+        "region": "us-central1",
+        "dockerized": True,
+        "tpu_node_id": node_id,
+        "tpu_worker_index": worker,
+    }
+    now = utcnow_iso()
+    await ctx.db.execute(
+        "INSERT INTO instances (id, project_id, name, status, created_at,"
+        " started_at, last_processed_at, backend, job_provisioning_data)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (iid, project["id"], name or f"inst-{iid[:6]}", status, now, now, now,
+         # Compact separators: production rows are pydantic model_dump_json,
+         # and the busy-sibling LIKE matches the compact form.
+         "gcp", json.dumps(jpd, separators=(",", ":"))),
+    )
+    return iid
+
+
+class _FakeCompute:
+    def __init__(self):
+        self.terminated = []
+
+    async def terminate_instance(self, instance_id, region, backend_data=None):
+        self.terminated.append(instance_id)
+
+
+def _patch_backend(monkeypatch, compute):
+    import dstack_tpu.server.services.backends as backends_service
+
+    async def fake_get_project_backend(ctx, project_id, backend_type):
+        return compute
+
+    monkeypatch.setattr(
+        backends_service, "get_project_backend", fake_get_project_backend
+    )
+
+
+async def test_terminate_defers_slice_delete_while_sibling_busy(monkeypatch):
+    """Worker 0's cloud delete covers the WHOLE slice, so it must wait for
+    every sibling worker to stop running — then go through."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        compute = _FakeCompute()
+        _patch_backend(monkeypatch, compute)
+        w0 = await _insert_slice_worker(
+            ctx, node_id="slice-a", worker=0, status="terminating"
+        )
+        w1 = await _insert_slice_worker(
+            ctx, node_id="slice-a", worker=1, status="busy"
+        )
+        await process_instances(ctx)
+        assert await _status(ctx, w0) == "terminating"  # deferred
+        assert compute.terminated == []
+
+        # Sibling done -> delete proceeds and both finalize.
+        await ctx.db.execute(
+            "UPDATE instances SET status = 'terminating' WHERE id = ?", (w1,)
+        )
+        await process_instances(ctx)
+        assert await _status(ctx, w0) == "terminated"
+        assert await _status(ctx, w1) == "terminated"
+        assert len(compute.terminated) == 1  # only worker 0 issued the delete
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_terminate_slice_like_escaping(monkeypatch):
+    """`%`, `_`, and `\\` in a tpu_node_id must match literally in the
+    busy-sibling query — a node named `slice_a` must not be deferred by a
+    busy worker of `sliceXa`, and exact-name siblings must still defer."""
+    fx = await make_server(run_background_tasks=False)
+    for node_id, decoy in [
+        ("slice_a", "sliceXa"),
+        ("slice%a", "slice-anything-a"),
+        ("slice\\a", "slicea"),
+    ]:
+        ctx = fx.ctx
+        compute = _FakeCompute()
+        _patch_backend(monkeypatch, compute)
+        # A busy worker of a DIFFERENT node that an unescaped LIKE would
+        # match: must NOT defer worker 0's delete.
+        await _insert_slice_worker(ctx, node_id=decoy, worker=1, status="busy")
+        w0 = await _insert_slice_worker(
+            ctx, node_id=node_id, worker=0, status="terminating"
+        )
+        await process_instances(ctx)
+        assert await _status(ctx, w0) == "terminated", node_id
+        assert len(compute.terminated) == 1, node_id
+
+        # An exact-name busy sibling still defers.
+        w0b = await _insert_slice_worker(
+            ctx, node_id=node_id, worker=0, status="terminating"
+        )
+        await _insert_slice_worker(ctx, node_id=node_id, worker=1, status="busy")
+        await process_instances(ctx)
+        assert await _status(ctx, w0b) == "terminating", node_id
+        assert len(compute.terminated) == 1, node_id
+        # Clean up for the next loop iteration.
+        await ctx.db.execute("UPDATE instances SET status = 'terminated'")
+    await fx.app.shutdown()
 
 
 async def test_released_instance_gets_idle_since_and_busy_clears_it():

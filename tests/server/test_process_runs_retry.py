@@ -1,0 +1,233 @@
+"""_maybe_retry / _process_pending_run unit tests (chaos PR satellites):
+survivor termination, retry budget anchored at the FIRST submission,
+non-covered-reason short-circuit, resilience accounting, and exponential
+backoff with deterministic jitter for resubmitted runs."""
+
+from datetime import timedelta
+
+from dstack_tpu.models.runs import JobStatus, JobTerminationReason, RunStatus
+from dstack_tpu.server import settings
+from dstack_tpu.server.background.tasks import process_runs
+from dstack_tpu.server.testing.factories import (
+    create_run_row,
+    make_task_run_spec,
+)
+from dstack_tpu.server.services.runs import create_replica_jobs
+from dstack_tpu.utils.common import utcnow, utcnow_iso
+from tests.server.conftest import make_server
+
+
+async def _make_run(ctx, *, nodes=1, retry=None, status=RunStatus.RUNNING):
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    conf_extra = {}
+    if retry is not None:
+        conf_extra["retry"] = retry
+    spec = make_task_run_spec(nodes=nodes, tpu="v5litepod-8" if nodes > 1 else None,
+                              **conf_extra)
+    run_id = await create_run_row(ctx, project["id"], user["id"], spec, status=status)
+    await create_replica_jobs(ctx, project["id"], run_id, spec, 0, 0)
+    return run_id
+
+
+async def _set_job(ctx, job_id, *, status, reason=None, exit_status=None,
+                   submitted_at=None):
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ?, termination_reason = ?, exit_status = ?,"
+        " submitted_at = COALESCE(?, submitted_at) WHERE id = ?",
+        (status.value, reason.value if reason else None, exit_status,
+         submitted_at, job_id),
+    )
+
+
+async def _jobs(ctx, run_id):
+    return await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num, submission_num",
+        (run_id,),
+    )
+
+
+async def _tick(ctx, run_id):
+    row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+    await process_runs._process_run(ctx, row)
+    return await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+
+
+async def test_retry_terminates_survivors_before_resubmitting():
+    """A 2-worker gang with one preempted worker: the live sibling is forced
+    to TERMINATING (gang_member_failed) and no new submission is created
+    until the whole replica is down."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_run(
+            ctx, nodes=2, retry={"on_events": ["interruption"], "duration": 600}
+        )
+        jobs = await _jobs(ctx, run_id)
+        assert len(jobs) == 2
+        await _set_job(ctx, jobs[0]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.PREEMPTED_BY_PROVIDER)
+        await _set_job(ctx, jobs[1]["id"], status=JobStatus.RUNNING)
+
+        run = await _tick(ctx, run_id)
+        jobs = await _jobs(ctx, run_id)
+        assert len(jobs) == 2  # no resubmission yet
+        survivor = [j for j in jobs if j["job_num"] == 1][0]
+        assert survivor["status"] == "terminating"
+        assert survivor["termination_reason"] == "gang_member_failed"
+        assert run["status"] == "running"  # run waits for the gang to land
+
+        # Survivor lands: the next tick resubmits the whole replica.
+        await _set_job(ctx, survivor["id"], status=JobStatus.TERMINATED,
+                       reason=JobTerminationReason.GANG_MEMBER_FAILED)
+        run = await _tick(ctx, run_id)
+        jobs = await _jobs(ctx, run_id)
+        assert run["status"] == "pending"
+        assert len(jobs) == 4  # both workers resubmitted
+        assert {j["submission_num"] for j in jobs} == {0, 1}
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_retry_budget_measured_from_first_submission():
+    """Each resubmission must NOT reset the retry-duration clock: the budget
+    is anchored at the replica's first submission, so a run that has been
+    flapping longer than `duration` stops even if the latest incarnation is
+    fresh."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_run(
+            ctx, retry={"on_events": ["interruption"], "duration": 3600}
+        )
+        project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+        run = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        spec_json = run["run_spec"]
+        from dstack_tpu.models.runs import RunSpec
+
+        await create_replica_jobs(
+            ctx, project["id"], run_id, RunSpec.model_validate_json(spec_json), 0, 1
+        )
+        jobs = await _jobs(ctx, run_id)
+        assert [j["submission_num"] for j in jobs] == [0, 1]
+        # First submission failed 2h ago; the latest failed just now.
+        two_h_ago = (utcnow() - timedelta(hours=2)).isoformat()
+        await _set_job(ctx, jobs[0]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+                       submitted_at=two_h_ago)
+        await _set_job(ctx, jobs[1]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY)
+
+        run = await _tick(ctx, run_id)
+        assert run["status"] in ("terminating", "failed")
+        assert run["termination_reason"] == "retry_limit_exceeded"
+        assert len(await _jobs(ctx, run_id)) == 2  # no third submission
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_retry_short_circuits_on_non_covered_reason():
+    """A failure reason the policy does not cover (an error under
+    on_events=[interruption]) must fail the run instead of retrying."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_run(
+            ctx, retry={"on_events": ["interruption"], "duration": 600}
+        )
+        jobs = await _jobs(ctx, run_id)
+        await _set_job(ctx, jobs[0]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.CONTAINER_EXITED_WITH_ERROR)
+        run = await _tick(ctx, run_id)
+        assert run["status"] == "terminating"
+        assert run["termination_reason"] == "job_failed"
+        assert len(await _jobs(ctx, run_id)) == 1  # not resubmitted
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_resubmit_accounts_resilience_counters():
+    """A clean-drained preemption (exit DRAIN_EXIT_CODE) increments
+    preemptions, clean_drains, and restarts on the run row and mirrors them
+    into tracer counters."""
+    import json
+
+    from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_run(
+            ctx, retry={"on_events": ["interruption"], "duration": 600}
+        )
+        jobs = await _jobs(ctx, run_id)
+        await _set_job(ctx, jobs[0]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.PREEMPTED_BY_PROVIDER,
+                       exit_status=DRAIN_EXIT_CODE)
+        run = await _tick(ctx, run_id)
+        assert run["status"] == "pending"
+        res = json.loads(run["resilience"])
+        assert res == {"preemptions": 1, "clean_drains": 1, "restarts": 1,
+                       "steps_lost": 0}
+        counters = {c["name"]: c["value"] for c in ctx.tracer.counter_snapshot()}
+        assert counters["run_preemptions"] == 1
+        assert counters["run_clean_drains"] == 1
+        assert counters["run_restarts"] == 1
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_pending_run_backoff_scales_with_submission_num(monkeypatch):
+    """Resubmission N waits base * 2^(N-1) (capped, jittered ±20% with a
+    per-(run, attempt) deterministic seed) before flipping back to
+    SUBMITTED — time-mocked, no sleeping."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        monkeypatch.setattr(settings, "RETRY_PENDING_RUN_DELAY", 10)
+        run_id = await _make_run(
+            ctx, retry={"on_events": ["interruption"], "duration": 600},
+            status=RunStatus.PENDING,
+        )
+        project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+        run = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        from dstack_tpu.models.runs import RunSpec
+
+        await create_replica_jobs(
+            ctx, project["id"], run_id, RunSpec.model_validate_json(run["run_spec"]), 0, 3
+        )
+        delay = process_runs._pending_run_delay(run_id, 10, 3)
+        assert 10 * 4 * 0.8 <= delay <= 10 * 4 * 1.2  # 2^(3-1) scaling
+        # Deterministic: same (run, attempt) -> same jitter.
+        assert delay == process_runs._pending_run_delay(run_id, 10, 3)
+
+        t0 = utcnow()
+        await ctx.db.execute(
+            "UPDATE runs SET last_processed_at = ? WHERE id = ?",
+            (t0.isoformat(), run_id),
+        )
+        # Just before the deadline: still pending.
+        monkeypatch.setattr(
+            process_runs, "utcnow", lambda: t0 + timedelta(seconds=delay - 1)
+        )
+        run = await _tick(ctx, run_id)
+        assert run["status"] == "pending"
+        # Past the deadline: released.
+        await ctx.db.execute(
+            "UPDATE runs SET last_processed_at = ? WHERE id = ?",
+            (t0.isoformat(), run_id),
+        )
+        monkeypatch.setattr(
+            process_runs, "utcnow", lambda: t0 + timedelta(seconds=delay + 1)
+        )
+        run = await _tick(ctx, run_id)
+        assert run["status"] == "submitted"
+    finally:
+        await fx.app.shutdown()
+
+
+def test_pending_run_delay_cap(monkeypatch):
+    monkeypatch.setattr(settings, "RETRY_PENDING_RUN_DELAY_CAP", 300)
+    d = process_runs._pending_run_delay("some-run", 15, 50)
+    assert d <= 300 * 1.2
+    assert process_runs._pending_run_delay("some-run", 0, 50) == 0.0
