@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast lint
+.PHONY: test test-all chaos chaos-fast lint capacity capacity-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -20,3 +20,13 @@ chaos-fast:
 
 lint:
 	$(PYTHON) -m compileall -q dstack_tpu
+
+# Full control-plane capacity probe (500 concurrent runs, native runner,
+# real socket). Results land in CAPACITY_r06.json; see
+# docs/guides/control-plane-tuning.md for how to read them.
+capacity:
+	JAX_PLATFORMS=cpu $(PYTHON) capacity_probe.py --runs 500 --out CAPACITY_r06.json
+
+# CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
+capacity-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/server/test_capacity_smoke.py -q -m capacity -p no:cacheprovider

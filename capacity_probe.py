@@ -56,12 +56,26 @@ def main() -> None:
     # bill ~1 s of interpreter startup CPU per run to the orchestrator
     # (decisive on small probe machines — this box exposes 1 core).
     native = Path(__file__).parent / "agents" / "native"
-    subprocess.run(["cmake", "-B", "build", "-G", "Ninja",
-                    "-DCMAKE_BUILD_TYPE=Release"], cwd=native, check=True,
-                   capture_output=True)
-    subprocess.run(["cmake", "--build", "build"], cwd=native, check=True,
-                   capture_output=True)
-    runner_bin = str(native / "build" / "dstack-tpu-runner")
+    runner_path = native / "build" / "dstack-tpu-runner"
+    try:
+        subprocess.run(["cmake", "-B", "build", "-G", "Ninja",
+                        "-DCMAKE_BUILD_TYPE=Release"], cwd=native, check=True,
+                       capture_output=True)
+        subprocess.run(["cmake", "--build", "build"], cwd=native, check=True,
+                       capture_output=True)
+    except FileNotFoundError:
+        # No cmake on this box: a stale binary still beats no probe, and a
+        # direct g++ build of the runner target works (plain C++17).
+        if not runner_path.exists():
+            runner_path.parent.mkdir(exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-pthread", "-o", str(runner_path),
+                 "runner/main.cc", "runner/executor.cc", "runner/cluster_env.cc",
+                 "runner/repo.cc", "common/http.cc", "common/util.cc",
+                 "common/tpu_telemetry.cc", "-lutil"],
+                cwd=native, check=True, capture_output=True,
+            )
+    runner_bin = str(runner_path)
     srv = ProbeServer(
         polling=False, db_path=pg_dsn or db_file.name,
         backend_config={"runner_binary": runner_bin},

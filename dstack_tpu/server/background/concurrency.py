@@ -10,11 +10,19 @@ probe as a nonlinear latency blowup. Per-row claims (services/locking.py)
 already make concurrent processing safe — that is their entire purpose —
 so ticks fan out row steps under a semaphore sized by the settings knobs
 (MAX_CONCURRENT_JOB_STEPS / MAX_CONCURRENT_PROVISIONS).
+
+TickBuffer coalesces the per-row bookkeeping writes a tick produces
+(status touches, `last_processed_at`) into ONE write-lock acquisition:
+db.execute takes the writer lock per statement, so a 500-row tick used to
+pay 500 lock round-trips for writes whose only reader is the next tick.
+Correctness-critical writes (the atomic idle->busy claim, terminal status
+transitions observed by waiting clients) stay immediate.
 """
 
 import asyncio
 import logging
-from typing import Awaitable, Callable, Sequence
+from collections import OrderedDict
+from typing import Awaitable, Callable, List, Sequence
 
 from dstack_tpu.server.context import ServerContext
 
@@ -29,18 +37,22 @@ async def for_each_claimed(
     *,
     limit: int,
     what: str,
-) -> None:
+) -> int:
     """Run `fn(ctx, row)` for every claimable row, at most `limit` at a
     time. A row whose claim is held elsewhere (another replica, an
-    overlapping tick) is skipped — the claim holder owns the step."""
+    overlapping tick) is skipped — the claim holder owns the step.
+    Returns the number of rows actually stepped (claims won)."""
     if not rows:
-        return
+        return 0
     sem = asyncio.Semaphore(max(limit, 1))
+    stepped = 0
 
     async def one(row) -> None:
+        nonlocal stepped
         async with sem:
             if not await ctx.claims.try_claim(namespace, row["id"]):
                 return
+            stepped += 1
             try:
                 await fn(ctx, row)
             except Exception:
@@ -49,3 +61,60 @@ async def for_each_claimed(
                 await ctx.claims.release(namespace, row["id"])
 
     await asyncio.gather(*(one(r) for r in rows))
+    return stepped
+
+
+def placeholders(n: int) -> str:
+    """`?,?,...` for an IN (...) list of n values."""
+    return ",".join("?" * n)
+
+
+def id_chunks(ids: Sequence, size: int = 500):
+    """Chunk an id list so IN (...) stays under engine parameter limits."""
+    for i in range(0, len(ids), size):
+        yield list(ids[i : i + size])
+
+
+class TickBuffer:
+    """Write coalescing for one FSM tick.
+
+    Row steps call `write(sql, params)` instead of `ctx.db.execute` for
+    bookkeeping updates, and `kick(channel)` instead of `ctx.kick` when the
+    kicked processor must observe the buffered write; `flush()` applies
+    everything as a single transaction (executemany per distinct statement,
+    chunked by TICK_FLUSH_BATCH) and only then delivers the kicks, so a
+    woken processor never reads state the buffer still holds.
+    """
+
+    def __init__(self, ctx: ServerContext):
+        self.ctx = ctx
+        self._writes: "OrderedDict[str, List[tuple]]" = OrderedDict()
+        self._kicks: List[str] = []
+
+    def write(self, sql: str, params: Sequence) -> None:
+        self._writes.setdefault(sql, []).append(tuple(params))
+
+    def kick(self, channel: str) -> None:
+        if channel not in self._kicks:
+            self._kicks.append(channel)
+
+    @property
+    def pending(self) -> int:
+        return sum(len(rows) for rows in self._writes.values())
+
+    async def flush(self) -> None:
+        writes, self._writes = self._writes, OrderedDict()
+        kicks, self._kicks = self._kicks, []
+        if writes:
+            from dstack_tpu.server import settings
+
+            batch = max(1, settings.TICK_FLUSH_BATCH)
+
+            def _apply(conn) -> None:
+                for sql, rows in writes.items():
+                    for i in range(0, len(rows), batch):
+                        conn.executemany(sql, rows[i : i + batch])
+
+            await self.ctx.db.run_sync(_apply)
+        for channel in kicks:
+            self.ctx.kick(channel)

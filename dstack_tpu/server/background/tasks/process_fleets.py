@@ -17,11 +17,27 @@ async def process_fleets(ctx: ServerContext) -> None:
     rows = await ctx.db.fetchall(
         "SELECT * FROM fleets WHERE deleted = 0 AND status IN ('active', 'terminating')"
     )
+    ctx.tracer.inc("tick_rows_scanned", len(rows), processor="fleets")
+    if not rows:
+        return
+    # Batched read: per-fleet instance status counts in one sweep instead of
+    # a query per fleet (every completed run leaves an autocreated fleet
+    # behind until GC, so this loop runs over hundreds of rows under load).
+    from dstack_tpu.server.background.concurrency import id_chunks, placeholders
+
+    counts: dict = {r["id"]: {} for r in rows}
+    for chunk in id_chunks(list(counts)):
+        for irow in await ctx.db.fetchall(
+            "SELECT fleet_id, status, COUNT(*) AS n FROM instances"
+            f" WHERE fleet_id IN ({placeholders(len(chunk))}) AND deleted = 0"
+            " GROUP BY fleet_id, status",
+            chunk,
+        ):
+            counts[irow["fleet_id"]][irow["status"]] = irow["n"]
     for row in rows:
-        instances = await ctx.db.fetchall(
-            "SELECT status FROM instances WHERE fleet_id = ? AND deleted = 0", (row["id"],)
-        )
-        active = [i for i in instances if i["status"] != "terminated"]
+        by_status = counts[row["id"]]
+        instances = sum(by_status.values())
+        active = instances - by_status.get("terminated", 0)
         if row["status"] == FleetStatus.TERMINATING.value:
             for i in await ctx.db.fetchall(
                 "SELECT id, status FROM instances WHERE fleet_id = ? AND deleted = 0",

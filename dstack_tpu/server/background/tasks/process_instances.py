@@ -18,6 +18,7 @@ from dstack_tpu.models.instances import InstanceStatus
 from dstack_tpu.models.profiles import DEFAULT_FLEET_IDLE_DURATION
 from dstack_tpu.models.runs import JobProvisioningData
 from dstack_tpu.server import settings
+from dstack_tpu.server.background.concurrency import TickBuffer, for_each_claimed
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
 
@@ -29,32 +30,47 @@ async def process_instances(ctx: ServerContext) -> None:
         "SELECT * FROM instances WHERE status != 'terminated' AND deleted = 0"
         " ORDER BY last_processed_at"
     )
-    for row in rows:
-        if not await ctx.claims.try_claim("instances", row["id"]):
-            continue
-        try:
-            await _process_instance(ctx, row)
-        except Exception:
-            logger.exception("failed to process instance %s", row["name"])
-        finally:
-            await ctx.claims.release("instances", row["id"])
+    ctx.tracer.inc("tick_rows_scanned", len(rows), processor="instances")
+    if not rows:
+        return
+    buf = TickBuffer(ctx)
+    stepped = await for_each_claimed(
+        ctx,
+        "instances",
+        rows,
+        lambda c, r: _process_instance(c, r, buf),
+        limit=settings.MAX_CONCURRENT_JOB_STEPS,
+        what="instance",
+    )
+    ctx.tracer.inc("tick_rows_stepped", stepped, processor="instances")
+    await buf.flush()
 
 
-async def _process_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _process_instance(
+    ctx: ServerContext, row: sqlite3.Row, buf: Optional[TickBuffer] = None
+) -> None:
     status = InstanceStatus(row["status"])
     if status == InstanceStatus.TERMINATING:
         await _terminate(ctx, row)
     elif status == InstanceStatus.PENDING:
-        await _check_provisioning_deadline(ctx, row)
-        await _provision_fleet_instance(ctx, row)
+        if not await _check_provisioning_deadline(ctx, row):
+            from dstack_tpu.server.services import fleets as fleets_service
+
+            await fleets_service.provision_pending_instance(ctx, row)
     elif status in (InstanceStatus.IDLE, InstanceStatus.BUSY):
         terminated = await _healthcheck(ctx, row)
         if not terminated and status == InstanceStatus.IDLE:
             await _check_idle_timeout(ctx, row)
-    await ctx.db.execute(
-        "UPDATE instances SET last_processed_at = ? WHERE id = ?",
-        (utcnow_iso(), row["id"]),
-    )
+    if buf is not None:
+        buf.write(
+            "UPDATE instances SET last_processed_at = ? WHERE id = ?",
+            (utcnow_iso(), row["id"]),
+        )
+    else:
+        await ctx.db.execute(
+            "UPDATE instances SET last_processed_at = ? WHERE id = ?",
+            (utcnow_iso(), row["id"]),
+        )
 
 
 async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
@@ -63,9 +79,9 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
     # Release attached volumes before the instance goes away (cloud detach
     # best-effort, attachment rows always removed so volumes stay reusable).
     await volumes_service.detach_instance_volumes(ctx, row)
-    jpd: Optional[JobProvisioningData] = None
-    if row["job_provisioning_data"]:
-        jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+    jpd: Optional[JobProvisioningData] = ctx.spec_cache.parse(
+        JobProvisioningData, "instances", row["id"], row["job_provisioning_data"] or None
+    )
     if jpd is not None and jpd.backend != BackendType.SSH:
         from dstack_tpu.server.services import backends as backends_service
 
@@ -150,11 +166,12 @@ async def _check_idle_timeout(ctx: ServerContext, row: sqlite3.Row) -> None:
         ctx.kick("instances")
 
 
-async def _check_provisioning_deadline(ctx: ServerContext, row: sqlite3.Row) -> None:
-    """PENDING instances that never provision get reaped (ref :103-107)."""
+async def _check_provisioning_deadline(ctx: ServerContext, row: sqlite3.Row) -> bool:
+    """PENDING instances that never provision get reaped (ref :103-107).
+    Returns True when the deadline fired (so the caller skips provisioning)."""
     created = parse_dt(row["created_at"])
     if created is None:
-        return
+        return False
     if (utcnow() - created).total_seconds() > settings.INSTANCE_PROVISIONING_TIMEOUT:
         await ctx.db.execute(
             "UPDATE instances SET status = 'terminating', termination_reason = ?"
@@ -162,6 +179,8 @@ async def _check_provisioning_deadline(ctx: ServerContext, row: sqlite3.Row) -> 
             ("provisioning timeout", row["id"]),
         )
         ctx.kick("instances")
+        return True
+    return False
 
 
 async def _healthcheck(ctx: ServerContext, row: sqlite3.Row) -> bool:
@@ -173,7 +192,9 @@ async def _healthcheck(ctx: ServerContext, row: sqlite3.Row) -> bool:
     """
     if not row["job_provisioning_data"]:
         return False
-    jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+    jpd = ctx.spec_cache.parse(
+        JobProvisioningData, "instances", row["id"], row["job_provisioning_data"]
+    )
     healthy, detail = await _probe(ctx, row, jpd)
     now = utcnow_iso()
     if healthy:

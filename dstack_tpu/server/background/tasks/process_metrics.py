@@ -21,13 +21,27 @@ logger = logging.getLogger(__name__)
 
 async def collect_metrics(ctx: ServerContext) -> None:
     rows = await ctx.db.fetchall("SELECT * FROM jobs WHERE status = 'running'")
+    if not rows:
+        return
+    # Batched read: one project sweep for the tick instead of a query per
+    # running job.
+    from dstack_tpu.server.background.concurrency import id_chunks, placeholders
+
+    project_ids = list({r["project_id"] for r in rows})
+    projects = {}
+    for chunk in id_chunks(project_ids):
+        for prow in await ctx.db.fetchall(
+            f"SELECT * FROM projects WHERE id IN ({placeholders(len(chunk))})",
+            chunk,
+        ):
+            projects[prow["id"]] = prow
     for row in rows:
         if not row["job_provisioning_data"] or not row["instance_id"]:
             continue
-        jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
-        project_row = await ctx.db.fetchone(
-            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
+        jpd = ctx.spec_cache.parse(
+            JobProvisioningData, "jobs", row["id"], row["job_provisioning_data"]
         )
+        project_row = projects[row["project_id"]]
         try:
             conn = await get_connection_pool(ctx).get(
                 ctx, row["instance_id"], jpd,

@@ -9,7 +9,7 @@ topology and the runner injects the JAX coordinator env
 """
 
 import logging
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import sqlite3
 
@@ -37,6 +37,63 @@ from dstack_tpu.utils.interpolator import InterpolatorError, interpolate
 logger = logging.getLogger(__name__)
 
 
+class _Tick:
+    """Per-tick prefetched rows shared by every job step: runs and projects
+    keyed by id, raw secret rows per project (decrypted lazily, memoized),
+    and the coalesced write buffer. Batched here so one tick costs a
+    handful of queries instead of 3-4 fetchones per due job."""
+
+    __slots__ = ("runs", "projects", "_secret_rows", "_secrets", "buffer")
+
+    def __init__(self, runs, projects, secret_rows, buffer):
+        self.runs = runs
+        self.projects = projects
+        self._secret_rows = secret_rows
+        self._secrets: Dict[str, dict] = {}
+        self.buffer = buffer
+
+    def secrets(self, ctx: ServerContext, project_id: str) -> dict:
+        cached = self._secrets.get(project_id)
+        if cached is None:
+            cached = {
+                r["name"]: ctx.encryption.decrypt(r["value"])
+                for r in self._secret_rows.get(project_id, [])
+            }
+            self._secrets[project_id] = cached
+        return cached
+
+
+async def _build_tick(ctx: ServerContext, rows) -> _Tick:
+    from dstack_tpu.server.background.concurrency import (
+        TickBuffer,
+        id_chunks,
+        placeholders,
+    )
+
+    run_ids = list({r["run_id"] for r in rows})
+    project_ids = list({r["project_id"] for r in rows})
+    runs: Dict[str, sqlite3.Row] = {}
+    for chunk in id_chunks(run_ids):
+        for rr in await ctx.db.fetchall(
+            f"SELECT * FROM runs WHERE id IN ({placeholders(len(chunk))})", chunk
+        ):
+            runs[rr["id"]] = rr
+    projects: Dict[str, sqlite3.Row] = {}
+    secret_rows: Dict[str, list] = {}
+    for chunk in id_chunks(project_ids):
+        for pr in await ctx.db.fetchall(
+            f"SELECT * FROM projects WHERE id IN ({placeholders(len(chunk))})", chunk
+        ):
+            projects[pr["id"]] = pr
+        for sr in await ctx.db.fetchall(
+            "SELECT project_id, name, value FROM secrets"
+            f" WHERE project_id IN ({placeholders(len(chunk))})",
+            chunk,
+        ):
+            secret_rows.setdefault(sr["project_id"], []).append(sr)
+    return _Tick(runs, projects, secret_rows, TickBuffer(ctx))
+
+
 async def process_running_jobs(ctx: ServerContext) -> None:
     from dstack_tpu.server.background.concurrency import for_each_claimed
 
@@ -44,10 +101,16 @@ async def process_running_jobs(ctx: ServerContext) -> None:
         "SELECT * FROM jobs WHERE status IN ('provisioning', 'pulling', 'running')"
         " ORDER BY last_processed_at"
     )
-    await for_each_claimed(
-        ctx, "jobs", rows, _process_job,
+    ctx.tracer.inc("tick_rows_scanned", len(rows), processor="running_jobs")
+    if not rows:
+        return
+    tick = await _build_tick(ctx, rows)
+    stepped = await for_each_claimed(
+        ctx, "jobs", rows, lambda c, r: _process_job(c, r, tick),
         limit=settings.MAX_CONCURRENT_JOB_STEPS, what="running job",
     )
+    ctx.tracer.inc("tick_rows_stepped", stepped, processor="running_jobs")
+    await tick.buffer.flush()
 
 
 async def process_terminating_jobs(ctx: ServerContext) -> None:
@@ -56,23 +119,57 @@ async def process_terminating_jobs(ctx: ServerContext) -> None:
     rows = await ctx.db.fetchall(
         "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at"
     )
-    await for_each_claimed(
-        ctx, "jobs", rows, _terminate_job,
+    ctx.tracer.inc("tick_rows_scanned", len(rows), processor="terminating_jobs")
+    if not rows:
+        return
+    tick = await _build_tick(ctx, rows)
+    stepped = await for_each_claimed(
+        ctx, "jobs", rows, lambda c, r: _terminate_job(c, r, tick),
         limit=settings.MAX_CONCURRENT_JOB_STEPS, what="terminating job",
     )
+    ctx.tracer.inc("tick_rows_stepped", stepped, processor="terminating_jobs")
+    await tick.buffer.flush()
 
 
-async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _process_job(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
     status = JobStatus(row["status"])
     if status == JobStatus.PROVISIONING:
-        await _process_provisioning(ctx, row)
+        await _process_provisioning(ctx, row, tick)
     elif status == JobStatus.PULLING:
-        await _process_pulling(ctx, row)
+        await _process_pulling(ctx, row, tick)
     elif status == JobStatus.RUNNING:
-        await _pull_runner(ctx, row)
+        await _pull_runner(ctx, row, tick)
+    if tick is not None:
+        # Pure bookkeeping: one executemany at end of tick instead of one
+        # write-lock acquisition per job.
+        tick.buffer.write(
+            "UPDATE jobs SET last_processed_at = ? WHERE id = ?",
+            (utcnow_iso(), row["id"]),
+        )
+        return
     await ctx.db.execute(
         "UPDATE jobs SET last_processed_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
     )
+
+
+async def _get_project_row(
+    ctx: ServerContext, project_id: str, tick: Optional[_Tick]
+) -> Optional[sqlite3.Row]:
+    if tick is not None and project_id in tick.projects:
+        return tick.projects[project_id]
+    return await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (project_id,)
+    )
+
+
+async def _get_run_row(
+    ctx: ServerContext, run_id: str, tick: Optional[_Tick]
+) -> Optional[sqlite3.Row]:
+    if tick is not None and run_id in tick.runs:
+        return tick.runs[run_id]
+    return await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
 
 
 async def _replica_rows(ctx: ServerContext, row: sqlite3.Row) -> List[sqlite3.Row]:
@@ -83,15 +180,15 @@ async def _replica_rows(ctx: ServerContext, row: sqlite3.Row) -> List[sqlite3.Ro
     )
 
 
-def _jpd(row: sqlite3.Row) -> Optional[JobProvisioningData]:
-    if not row["job_provisioning_data"]:
-        return None
-    return JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+def _jpd(ctx: ServerContext, row: sqlite3.Row) -> Optional[JobProvisioningData]:
+    return ctx.spec_cache.parse(
+        JobProvisioningData, "jobs", row["id"], row["job_provisioning_data"] or None
+    )
 
 
 async def _update_jpd_ip(ctx: ServerContext, row: sqlite3.Row) -> Optional[JobProvisioningData]:
     """Poll the backend for the instance IP if not yet known."""
-    jpd = _jpd(row)
+    jpd = _jpd(ctx, row)
     if jpd is None:
         return None
     if jpd.hostname is not None and jpd.internal_ip is not None:
@@ -148,7 +245,11 @@ def _runner_port_override(row: sqlite3.Row) -> "Optional[int]":
     return ports.get(RUNNER_PORT)
 
 
-async def _get_secrets(ctx: ServerContext, project_id: str) -> dict:
+async def _get_secrets(
+    ctx: ServerContext, project_id: str, tick: Optional[_Tick] = None
+) -> dict:
+    if tick is not None:
+        return tick.secrets(ctx, project_id)
     rows = await ctx.db.fetchall(
         "SELECT name, value FROM secrets WHERE project_id = ?", (project_id,)
     )
@@ -160,7 +261,9 @@ async def _runner_deadline_exceeded(ctx: ServerContext, row: sqlite3.Row) -> boo
     return (utcnow() - submitted).total_seconds() > settings.RUNNER_READY_TIMEOUT
 
 
-async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _process_provisioning(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
     """Wait for the whole gang's IPs, then hand the job to its agent."""
     jpd = await _update_jpd_ip(ctx, row)
     if jpd is None or jpd.hostname is None:
@@ -171,17 +274,15 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
     replica = await _replica_rows(ctx, row)
     replica_jpds = []
     for sibling in replica:
-        sjpd = _jpd(sibling)
+        sjpd = _jpd(ctx, sibling)
         if sjpd is None or sjpd.hostname is None:
             return  # gang not fully provisioned yet (reference :176-187)
         replica_jpds.append(sjpd)
 
-    job_spec = JobSpec.model_validate_json(row["job_spec"])
+    job_spec = ctx.spec_cache.parse(JobSpec, "jobs", row["id"], row["job_spec"])
     cluster_info = _build_cluster_info(job_spec, replica_jpds)
-    secrets = await _get_secrets(ctx, row["project_id"])
-    project_row = await ctx.db.fetchone(
-        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
-    )
+    secrets = await _get_secrets(ctx, row["project_id"], tick)
+    project_row = await _get_project_row(ctx, row["project_id"], tick)
     pool = get_connection_pool(ctx)
     conn = await pool.get(
         ctx, row["instance_id"] or jpd.instance_id, jpd,
@@ -256,17 +357,17 @@ async def _process_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
             await shim.close()
         return
 
-    await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets)
+    await _submit_to_runner(ctx, row, conn, job_spec, cluster_info, secrets, tick=tick)
 
 
-async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _process_pulling(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
     """Poll the shim until the container is up, then submit to the runner."""
-    jpd = _jpd(row)
+    jpd = _jpd(ctx, row)
     if jpd is None:
         return
-    project_row = await ctx.db.fetchone(
-        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
-    )
+    project_row = await _get_project_row(ctx, row["project_id"], tick)
     pool = get_connection_pool(ctx)
     conn = await pool.get(
         ctx, row["instance_id"] or jpd.instance_id, jpd,
@@ -292,12 +393,12 @@ async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
         await _record_pull_progress(ctx, row, task)
         return
     replica = await _replica_rows(ctx, row)
-    replica_jpds = [j for j in (_jpd(s) for s in replica) if j is not None]
+    replica_jpds = [j for j in (_jpd(ctx, s) for s in replica) if j is not None]
     if len(replica_jpds) != len(replica):
         return
-    job_spec = JobSpec.model_validate_json(row["job_spec"])
+    job_spec = ctx.spec_cache.parse(JobSpec, "jobs", row["id"], row["job_spec"])
     cluster_info = _build_cluster_info(job_spec, replica_jpds)
-    secrets = await _get_secrets(ctx, row["project_id"])
+    secrets = await _get_secrets(ctx, row["project_id"], tick)
     ctx.pull_progress_seen.pop(row["id"], None)
     # Persist a NON-default shim-reported runner port so the RUNNING-phase
     # poller can reach a dynamically-bound runner (process runtime binds
@@ -311,7 +412,7 @@ async def _process_pulling(ctx: ServerContext, row: sqlite3.Row) -> None:
         )
     await _submit_to_runner(
         ctx, row, conn, job_spec, cluster_info, secrets,
-        runner_port=dynamic_port,
+        runner_port=dynamic_port, tick=tick,
     )
 
 
@@ -323,6 +424,7 @@ async def _submit_to_runner(
     cluster_info: ClusterInfo,
     secrets: dict,
     runner_port: "Optional[int]" = None,
+    tick: Optional[_Tick] = None,
 ) -> None:
     runner = conn.runner_client(port=runner_port)
     try:
@@ -368,11 +470,11 @@ async def _submit_to_runner(
                 )
         job_spec = job_spec.model_copy(update={"env": env})
         try:
-            code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row)
+            code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row, tick)
         except (ServerError, BackendError) as e:
             await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
             return
-        jpd = _jpd(row)
+        jpd = _jpd(ctx, row)
         mounts: List[dict] = []
         if job_spec.volumes and jpd is not None and not jpd.dockerized:
             # Dockerized hosts mount volumes in the shim; the direct-runner
@@ -402,7 +504,7 @@ async def _submit_to_runner(
         await ctx.db.execute(
             "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
         )
-        await _register_service_replica(ctx, row, jpd, job_spec)
+        await _register_service_replica(ctx, row, jpd, job_spec, tick)
         logger.info(
             "job %s (%s rank %d/%d) running",
             job_spec.job_name, row["run_name"], job_spec.job_num, job_spec.jobs_per_replica,
@@ -412,12 +514,17 @@ async def _submit_to_runner(
         await runner.close()
 
 
-async def _get_repo_payload(ctx: ServerContext, row: sqlite3.Row):
+async def _get_repo_payload(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+):
     """The job's code payload: (code blob, repo data, repo creds). For remote
     repos the blob is the uncommitted diff and repo_data/creds drive the
     runner-side git clone (agents/repo.py); for local repos the blob is the
-    tar and repo_data is None-equivalent for the runner."""
-    run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+    tar and repo_data is None-equivalent for the runner. The code/repo rows
+    stay on-demand fetches: they are read only on the one-time
+    runner-submit transition (O(transitions), not O(rows) per tick), and
+    code blobs are far too large to prefetch."""
+    run_row = await _get_run_row(ctx, row["run_id"], tick)
     if run_row is None:
         return None, None, None
     from pydantic import TypeAdapter
@@ -425,7 +532,7 @@ async def _get_repo_payload(ctx: ServerContext, row: sqlite3.Row):
     from dstack_tpu.models.repos import AnyRunRepoData, RemoteRepoCreds
     from dstack_tpu.models.runs import RunSpec
 
-    run_spec = RunSpec.model_validate_json(run_row["run_spec"])
+    run_spec = ctx.spec_cache.parse(RunSpec, "runs", run_row["id"], run_row["run_spec"])
     if run_spec.repo_code_hash is None or run_row["repo_id"] is None:
         return None, None, None
     code_row = await ctx.db.fetchone(
@@ -470,13 +577,13 @@ async def _get_repo_payload(ctx: ServerContext, row: sqlite3.Row):
     return blob, repo_data, repo_creds
 
 
-async def _pull_runner(ctx: ServerContext, row: sqlite3.Row) -> None:
-    jpd = _jpd(row)
+async def _pull_runner(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
+    jpd = _jpd(ctx, row)
     if jpd is None:
         return
-    project_row = await ctx.db.fetchone(
-        "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
-    )
+    project_row = await _get_project_row(ctx, row["project_id"], tick)
     pool = get_connection_pool(ctx)
     conn = await pool.get(
         ctx, row["instance_id"] or jpd.instance_id, jpd,
@@ -590,18 +697,18 @@ async def _fail(
     logger.info("job %s failed: %s", row["id"][:8], message)
 
 
-async def _terminate_job(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _terminate_job(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
     """TERMINATING → stop the agent, release the instance, finalize."""
-    jpd = _jpd(row)
+    jpd = _jpd(ctx, row)
     reason = (
         JobTerminationReason(row["termination_reason"])
         if row["termination_reason"]
         else JobTerminationReason.TERMINATED_BY_SERVER
     )
     if jpd is not None and row["instance_id"]:
-        project_row = await ctx.db.fetchone(
-            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
-        )
+        project_row = await _get_project_row(ctx, row["project_id"], tick)
         pool = get_connection_pool(ctx)
         try:
             conn = await pool.get(
@@ -630,13 +737,17 @@ async def _terminate_job(ctx: ServerContext, row: sqlite3.Row) -> None:
         "UPDATE jobs SET status = ?, finished_at = ?, last_processed_at = ? WHERE id = ?",
         (reason.to_status().value, utcnow_iso(), utcnow_iso(), row["id"]),
     )
-    await _unregister_service_replica(ctx, row)
+    await _unregister_service_replica(ctx, row, tick)
     await _release_instance(ctx, row)
     ctx.kick("runs")
 
 
 async def _register_service_replica(
-    ctx: ServerContext, row: sqlite3.Row, jpd: JobProvisioningData, job_spec: JobSpec
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    jpd: JobProvisioningData,
+    job_spec: JobSpec,
+    tick: Optional[_Tick] = None,
 ) -> None:
     """Service runs: expose this replica through the project's gateway
     (services/services.py opens the gateway-side tunnel). Best-effort at this
@@ -646,27 +757,25 @@ async def _register_service_replica(
     from dstack_tpu.server.services import services as services_service
 
     try:
-        run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+        run_row = await _get_run_row(ctx, row["run_id"], tick)
         if run_row is None or run_row["service_spec"] is None:
             return
-        project_row = await ctx.db.fetchone(
-            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
-        )
+        project_row = await _get_project_row(ctx, row["project_id"], tick)
         await services_service.register_replica(ctx, project_row, run_row, row, jpd, job_spec)
     except Exception as e:
         logger.warning("gateway replica registration failed for job %s: %s", row["id"][:8], e)
 
 
-async def _unregister_service_replica(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _unregister_service_replica(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
     from dstack_tpu.server.services import services as services_service
 
     try:
-        run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+        run_row = await _get_run_row(ctx, row["run_id"], tick)
         if run_row is None or run_row["service_spec"] is None:
             return
-        project_row = await ctx.db.fetchone(
-            "SELECT * FROM projects WHERE id = ?", (row["project_id"],)
-        )
+        project_row = await _get_project_row(ctx, row["project_id"], tick)
         await services_service.unregister_replica(ctx, project_row, run_row, row)
     except Exception as e:
         logger.debug("gateway replica unregistration failed for job %s: %s", row["id"][:8], e)
@@ -680,10 +789,8 @@ async def _release_instance(ctx: ServerContext, row: sqlite3.Row) -> None:
     if irow is None:
         return
     get_connection_pool(ctx).drop(irow["id"])
-    jpd = (
-        JobProvisioningData.model_validate_json(irow["job_provisioning_data"])
-        if irow["job_provisioning_data"]
-        else None
+    jpd = ctx.spec_cache.parse(
+        JobProvisioningData, "instances", irow["id"], irow["job_provisioning_data"] or None
     )
     fleet_row = None
     if irow["fleet_id"]:

@@ -35,19 +35,25 @@ logger = logging.getLogger(__name__)
 
 async def process_runs(ctx: ServerContext) -> None:
     from dstack_tpu.server import settings
-    from dstack_tpu.server.background.concurrency import for_each_claimed
+    from dstack_tpu.server.background.concurrency import TickBuffer, for_each_claimed
 
     rows = await ctx.db.fetchall(
         "SELECT * FROM runs WHERE status NOT IN ('terminated','failed','done')"
         " AND deleted = 0 ORDER BY last_processed_at"
     )
-    await for_each_claimed(
-        ctx, "runs", rows, _process_run,
+    ctx.tracer.inc("tick_rows_scanned", len(rows), processor="runs")
+    if not rows:
+        return
+    buf = TickBuffer(ctx)
+    stepped = await for_each_claimed(
+        ctx, "runs", rows, lambda c, r: _process_run(c, r, buf),
         limit=settings.MAX_CONCURRENT_JOB_STEPS, what="run",
     )
+    ctx.tracer.inc("tick_rows_stepped", stepped, processor="runs")
+    await buf.flush()
 
 
-async def _process_run(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _process_run(ctx: ServerContext, row: sqlite3.Row, buf=None) -> None:
     status = RunStatus(row["status"])
     if status == RunStatus.TERMINATING:
         await _process_terminating_run(ctx, row)
@@ -55,9 +61,14 @@ async def _process_run(ctx: ServerContext, row: sqlite3.Row) -> None:
         await _process_pending_run(ctx, row)
     else:
         await _process_active_run(ctx, row)
-    await ctx.db.execute(
-        "UPDATE runs SET last_processed_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
-    )
+    if buf is not None:
+        buf.write(
+            "UPDATE runs SET last_processed_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
+        )
+    else:
+        await ctx.db.execute(
+            "UPDATE runs SET last_processed_at = ? WHERE id = ?", (utcnow_iso(), row["id"])
+        )
 
 
 async def _latest_jobs(ctx: ServerContext, run_id: str) -> List[sqlite3.Row]:
@@ -136,7 +147,7 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
 async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
     """Replica autoscaling for RUNNING services (reference:
     _process_pending_run autoscaler hook, process_runs.py:142-153)."""
-    run_spec = RunSpec.model_validate_json(row["run_spec"])
+    run_spec = ctx.spec_cache.parse(RunSpec, "runs", row["id"], row["run_spec"])
     conf = run_spec.configuration
     if conf.type != "service":
         return
@@ -201,7 +212,7 @@ async def _maybe_retry(
     ctx: ServerContext, row: sqlite3.Row, jobs: List[sqlite3.Row], failed_replicas: set
 ) -> bool:
     """Resubmit failed replicas when the retry policy covers the failure."""
-    run_spec = RunSpec.model_validate_json(row["run_spec"])
+    run_spec = ctx.spec_cache.parse(RunSpec, "runs", row["id"], row["run_spec"])
     profile = run_spec.merged_profile
     retry = profile.get_retry() if profile else None
     if retry is None:

@@ -10,18 +10,24 @@ provision via offers; cluster fleet creation :493-520; master-wait
     worker instance. The reference provisions 1 instance per job and cannot
     express pod slices.
   - Pool reuse matches whole slices: H idle workers of the same TPU node.
+
+Hot path: one tick prefetches the run/project rows and the idle-instance
+pool for EVERY due job in a handful of batched queries (`_Tick`), instead
+of the per-job fetchone chains that made a tick O(rows) round-trips — and
+the pool candidates are parsed once per tick (spec_cache), not once per
+(job x instance). Per-row helpers keep a tick=None fallback so unit tests
+can still drive one row directly.
 """
 
 import json
 import logging
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import sqlite3
 
 from dstack_tpu.errors import BackendError, NoCapacityError
-from dstack_tpu.models.backends import BackendType
 from dstack_tpu.models.fleets import FleetStatus
-from dstack_tpu.models.instances import InstanceStatus
+from dstack_tpu.models.instances import InstanceOfferWithAvailability, InstanceStatus
 from dstack_tpu.models.runs import (
     JobProvisioningData,
     JobSpec,
@@ -40,6 +46,83 @@ MAX_OFFERS_TRIED = 15  # parity: offer loop cap (process_submitted_jobs.py:450-4
 MASTER_WAIT_TIMEOUT = 600.0
 
 
+class _Tick:
+    """Rows every job step of one tick shares: prefetched runs/projects,
+    the parsed idle-instance pool per project (shared candidate index),
+    wait-timeout anchors, and the coalesced write buffer."""
+
+    __slots__ = ("runs", "projects", "pool", "anchors", "buffer")
+
+    def __init__(self, runs, projects, pool, anchors, buffer):
+        self.runs = runs
+        self.projects = projects
+        self.pool = pool
+        self.anchors = anchors
+        self.buffer = buffer
+
+
+async def _build_tick(ctx: ServerContext, rows) -> _Tick:
+    from dstack_tpu.server.background.concurrency import (
+        TickBuffer,
+        id_chunks,
+        placeholders,
+    )
+
+    run_ids = list({r["run_id"] for r in rows})
+    project_ids = list({r["project_id"] for r in rows})
+    runs: Dict[str, sqlite3.Row] = {}
+    for chunk in id_chunks(run_ids):
+        for rr in await ctx.db.fetchall(
+            f"SELECT * FROM runs WHERE id IN ({placeholders(len(chunk))})", chunk
+        ):
+            runs[rr["id"]] = rr
+    projects: Dict[str, sqlite3.Row] = {}
+    for chunk in id_chunks(project_ids):
+        for pr in await ctx.db.fetchall(
+            f"SELECT * FROM projects WHERE id IN ({placeholders(len(chunk))})", chunk
+        ):
+            projects[pr["id"]] = pr
+    pool: Dict[str, List[dict]] = {pid: [] for pid in project_ids}
+    for chunk in id_chunks(project_ids):
+        idle_rows = await ctx.db.fetchall(
+            f"SELECT * FROM instances WHERE project_id IN ({placeholders(len(chunk))})"
+            " AND status = 'idle' AND deleted = 0 ORDER BY price",
+            chunk,
+        )
+        for irow in idle_rows:
+            cand = _pool_candidate(ctx, irow)
+            if cand is not None:
+                pool[irow["project_id"]].append(cand)
+    # Wait-timeout anchors: the latest (re)submission time per replica gang.
+    anchors: Dict[Tuple[str, int], str] = {}
+    for chunk in id_chunks(run_ids):
+        for arow in await ctx.db.fetchall(
+            "SELECT run_id, replica_num, MAX(submitted_at) AS anchor FROM jobs"
+            f" WHERE run_id IN ({placeholders(len(chunk))})"
+            " GROUP BY run_id, replica_num",
+            chunk,
+        ):
+            anchors[(arow["run_id"], arow["replica_num"])] = arow["anchor"]
+    return _Tick(runs, projects, pool, anchors, TickBuffer(ctx))
+
+
+def _pool_candidate(ctx: ServerContext, irow: sqlite3.Row) -> Optional[dict]:
+    """Parse one idle row into a reusable-pool candidate (None if not
+    reusable). Parses go through the spec cache: steady-state ticks revisit
+    the same idle rows and pay zero pydantic work."""
+    if not irow["offer"] or not irow["job_provisioning_data"]:
+        return None
+    offer = ctx.spec_cache.parse(
+        InstanceOfferWithAvailability, "instances", irow["id"], irow["offer"]
+    )
+    jpd = ctx.spec_cache.parse(
+        JobProvisioningData, "instances", irow["id"], irow["job_provisioning_data"]
+    )
+    if not jpd.dockerized:
+        return None  # one-shot (runner-direct) instances cannot be reused
+    return {"row": irow, "offer": offer, "jpd": jpd}
+
+
 async def process_submitted_jobs(ctx: ServerContext) -> None:
     from dstack_tpu.server import settings
     from dstack_tpu.server.background.concurrency import for_each_claimed
@@ -47,28 +130,41 @@ async def process_submitted_jobs(ctx: ServerContext) -> None:
     rows = await ctx.db.fetchall(
         "SELECT * FROM jobs WHERE status = 'submitted' ORDER BY last_processed_at"
     )
-    await for_each_claimed(
-        ctx, "jobs", rows, _process_job,
+    ctx.tracer.inc("tick_rows_scanned", len(rows), processor="submitted_jobs")
+    if not rows:
+        return
+    tick = await _build_tick(ctx, rows)
+    stepped = await for_each_claimed(
+        ctx, "jobs", rows, lambda c, r: _process_job(c, r, tick),
         limit=settings.MAX_CONCURRENT_PROVISIONS, what="submitted job",
     )
+    ctx.tracer.inc("tick_rows_stepped", stepped, processor="submitted_jobs")
+    await tick.buffer.flush()
 
 
-async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:
-    job_spec = JobSpec.model_validate_json(row["job_spec"])
-    run_row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (row["run_id"],))
+async def _process_job(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
+    job_spec = ctx.spec_cache.parse(JobSpec, "jobs", row["id"], row["job_spec"])
+    if tick is not None:
+        run_row = tick.runs.get(row["run_id"])
+    else:
+        run_row = await ctx.db.fetchone(
+            "SELECT * FROM runs WHERE id = ?", (row["run_id"],)
+        )
     if run_row is None or run_row["status"] in ("terminating", "terminated", "failed", "done"):
         return
-    run_spec = RunSpec.model_validate_json(run_row["run_spec"])
+    run_spec = ctx.spec_cache.parse(RunSpec, "runs", run_row["id"], run_row["run_spec"])
     slice_hosts = job_spec.tpu_slice.hosts if job_spec.tpu_slice else 1
 
     if row["instance_assigned"]:
-        await _mark_provisioning(ctx, row)
+        await _mark_provisioning(ctx, row, tick)
         return
 
     if job_spec.host_rank != 0:
         # Worker jobs wait for their slice leader to provision the slice and
         # assign instances (parity: master-wait :138-154).
-        await _check_wait_timeout(ctx, row)
+        await _check_wait_timeout(ctx, row, tick)
         return
 
     is_master = job_spec.job_num == 0
@@ -76,11 +172,13 @@ async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:
     if not is_master:
         master_jpd = await _get_master_jpd(ctx, row)
         if master_jpd is None:
-            await _check_wait_timeout(ctx, row)
+            await _check_wait_timeout(ctx, row, tick)
             return
 
     # Phase 1: reuse idle pool/fleet instances (shim-managed only).
-    assigned = await _try_assign_pool_instances(ctx, row, job_spec, run_spec, slice_hosts)
+    assigned = await _try_assign_pool_instances(
+        ctx, row, job_spec, run_spec, slice_hosts, tick
+    )
     if assigned:
         ctx.kick("running_jobs")
         return
@@ -111,9 +209,12 @@ async def _process_job(ctx: ServerContext, row: sqlite3.Row) -> None:
         )
         return
 
-    project_row = await ctx.db.fetchone(
-        "SELECT * FROM projects WHERE id = ?", (run_row["project_id"],)
-    )
+    if tick is not None:
+        project_row = tick.projects.get(run_row["project_id"])
+    else:
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE id = ?", (run_row["project_id"],)
+        )
     last_error = "no capacity"
     for compute, offer in pairs[:MAX_OFFERS_TRIED]:
         try:
@@ -147,16 +248,48 @@ async def _get_master_jpd(
     )
     if master is None or not master["job_provisioning_data"]:
         return None
-    return JobProvisioningData.model_validate_json(master["job_provisioning_data"])
+    return ctx.spec_cache.parse(
+        JobProvisioningData, "jobs", master["id"], master["job_provisioning_data"]
+    )
 
 
-async def _check_wait_timeout(ctx: ServerContext, row: sqlite3.Row) -> None:
-    submitted = parse_dt(row["submitted_at"])
+async def _check_wait_timeout(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
+    # The wait window is anchored at the replica's LATEST (re)submission,
+    # not this row's own submitted_at: after a retry (the resubmission path)
+    # a waiting worker must get a fresh MASTER_WAIT_TIMEOUT budget even if
+    # its row carries an older timestamp than its freshly written siblings.
+    if tick is not None:
+        anchor = tick.anchors.get((row["run_id"], row["replica_num"]))
+    else:
+        arow = await ctx.db.fetchone(
+            "SELECT MAX(submitted_at) AS anchor FROM jobs"
+            " WHERE run_id = ? AND replica_num = ?",
+            (row["run_id"], row["replica_num"]),
+        )
+        anchor = arow["anchor"] if arow is not None else None
+    submitted = parse_dt(anchor or row["submitted_at"])
     if (utcnow() - submitted).total_seconds() > MASTER_WAIT_TIMEOUT:
         await _fail_job(
             ctx, row, JobTerminationReason.WAITING_INSTANCE_LIMIT_EXCEEDED,
             "timed out waiting for the slice leader to provision",
         )
+
+
+async def _load_pool_candidates(ctx: ServerContext, project_id: str) -> List[dict]:
+    """tick=None fallback: one project's candidate index, built on demand."""
+    idle_rows = await ctx.db.fetchall(
+        "SELECT * FROM instances WHERE project_id = ? AND status = 'idle'"
+        " AND deleted = 0 ORDER BY price",
+        (project_id,),
+    )
+    out = []
+    for irow in idle_rows:
+        cand = _pool_candidate(ctx, irow)
+        if cand is not None:
+            out.append(cand)
+    return out
 
 
 async def _try_assign_pool_instances(
@@ -165,22 +298,25 @@ async def _try_assign_pool_instances(
     job_spec: JobSpec,
     run_spec: RunSpec,
     slice_hosts: int,
+    tick: Optional[_Tick] = None,
 ) -> bool:
-    """Find idle shim-managed instances that satisfy the whole slice group."""
-    from dstack_tpu.backends.base.offers import offer_matches_requirements
-    from dstack_tpu.models.instances import InstanceOfferWithAvailability
+    """Find idle shim-managed instances that satisfy the whole slice group.
 
-    idle_rows = await ctx.db.fetchall(
-        "SELECT * FROM instances WHERE project_id = ? AND status = 'idle'"
-        " AND deleted = 0 ORDER BY price",
-        (row["project_id"],),
-    )
+    The candidate index is built once per tick and SHARED by all submitted
+    jobs (per-job work is just the requirements/profile filter); winners
+    remove their instances from it. Sharing is safe because the atomic
+    idle->busy UPDATE in _assign_jobs_to_instances remains the source of
+    truth — a stale candidate merely loses that race and is skipped."""
+    from dstack_tpu.backends.base.offers import offer_matches_requirements
+
+    if tick is not None:
+        shared = tick.pool.setdefault(row["project_id"], [])
+    else:
+        shared = await _load_pool_candidates(ctx, row["project_id"])
     profile = run_spec.merged_profile
-    candidates: List[sqlite3.Row] = []
-    for irow in idle_rows:
-        if not irow["offer"]:
-            continue
-        offer = InstanceOfferWithAvailability.model_validate_json(irow["offer"])
+    candidates: List[dict] = []
+    for cand in list(shared):
+        offer = cand["offer"]
         if not offer_matches_requirements(offer, job_spec.requirements):
             continue
         # Profile placement constraints apply to reuse too (parity:
@@ -193,41 +329,36 @@ async def _try_assign_pool_instances(
                 continue
             if profile.instance_types and offer.instance.name not in profile.instance_types:
                 continue
-        jpd = (
-            JobProvisioningData.model_validate_json(irow["job_provisioning_data"])
-            if irow["job_provisioning_data"]
-            else None
-        )
-        if jpd is None or not jpd.dockerized:
-            continue  # one-shot (runner-direct) instances cannot be reused
-        candidates.append(irow)
+        candidates.append(cand)
+
+    def _take(won: List[dict]) -> None:
+        for c in won:
+            try:
+                shared.remove(c)
+            except ValueError:
+                pass  # a concurrent step already dropped it
+
     if slice_hosts == 1:
-        if not candidates:
-            return False
-        for candidate in candidates:
-            if await _assign_jobs_to_instances(ctx, [row], [candidate]):
+        for cand in candidates:
+            if await _assign_jobs_to_instances(ctx, [row], [cand["row"]]):
+                _take([cand])
                 return True
         return False
     # Multi-host: need all H workers of one TPU node idle.
-    by_node = {}
-    for irow in candidates:
-        node = None
-        if irow["job_provisioning_data"]:
-            node = JobProvisioningData.model_validate_json(
-                irow["job_provisioning_data"]
-            ).tpu_node_id
-        by_node.setdefault(node or irow["id"], []).append(irow)
+    by_node: Dict[str, List[dict]] = {}
+    for cand in candidates:
+        node = cand["jpd"].tpu_node_id
+        by_node.setdefault(node or cand["row"]["id"], []).append(cand)
     group_rows = await _slice_group_jobs(ctx, row, slice_hosts)
     if group_rows is None:
         return False
     for node, members in by_node.items():
         if len(members) == slice_hosts:
-            members.sort(
-                key=lambda r: JobProvisioningData.model_validate_json(
-                    r["job_provisioning_data"]
-                ).tpu_worker_index
-            )
-            if await _assign_jobs_to_instances(ctx, group_rows, members):
+            members.sort(key=lambda c: c["jpd"].tpu_worker_index)
+            if await _assign_jobs_to_instances(
+                ctx, group_rows, [m["row"] for m in members]
+            ):
+                _take(members)
                 return True  # else: raced on this slice; try the next node
     return False
 
@@ -382,7 +513,18 @@ async def _commit_provisioned_slice(
     )
 
 
-async def _mark_provisioning(ctx: ServerContext, row: sqlite3.Row) -> None:
+async def _mark_provisioning(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> None:
+    if tick is not None:
+        # Pure bookkeeping flip: coalesced, with the kick delivered after
+        # the flush so the running-jobs processor sees the new status.
+        tick.buffer.write(
+            "UPDATE jobs SET status = ?, last_processed_at = ? WHERE id = ?",
+            (JobStatus.PROVISIONING.value, utcnow_iso(), row["id"]),
+        )
+        tick.buffer.kick("running_jobs")
+        return
     await ctx.db.execute(
         "UPDATE jobs SET status = ?, last_processed_at = ? WHERE id = ?",
         (JobStatus.PROVISIONING.value, utcnow_iso(), row["id"]),

@@ -42,6 +42,11 @@ class ServerContext:
         # singleton would leak spans across the many apps a test process
         # creates.
         self.tracer = Tracer()
+        from dstack_tpu.server.services.spec_cache import SpecCache
+
+        # Versioned parse cache shared by the FSM processors: memoizes the
+        # pydantic validation of spec JSON columns per (table, row, model).
+        self.spec_cache = SpecCache(tracer=self.tracer)
         self._signals: Dict[str, asyncio.Event] = {}
         # A set: done-callbacks race stop_tasks' clear(), and a
         # list.remove of an already-removed task raised in the event

@@ -57,6 +57,11 @@ async def prometheus_metrics(request: Request):
             lines.append(f"# TYPE {metric} counter")
             emitted.add(metric)
         lines.append(_prom_line(metric, c["labels"], c["value"]))
+    cache = ctx.spec_cache.stats()
+    lines.append("# TYPE dstack_tpu_spec_cache_entries gauge")
+    lines.append(_prom_line("dstack_tpu_spec_cache_entries", {}, cache["size"]))
+    lines.append("# TYPE dstack_tpu_spec_cache_hit_rate gauge")
+    lines.append(_prom_line("dstack_tpu_spec_cache_hit_rate", {}, cache["hit_rate"]))
     lines.append("# TYPE dstack_tpu_span_count_total counter")
     lines.append("# TYPE dstack_tpu_span_seconds_sum counter")
     for name, st in ctx.tracer.snapshot()["stats"].items():

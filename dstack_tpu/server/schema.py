@@ -318,3 +318,23 @@ ALTER TABLE runs DROP COLUMN resilience;
 ALTER TABLE instances DROP COLUMN health_fail_streak;
 """,
 )
+
+# Migration 6: covering indexes for the FSM hot path. Every background tick
+# filters jobs by status and orders by last_processed_at (ix_jobs_status
+# alone still sorted); pool assignment scans idle instances per project
+# (ix_instances_project has no status); log polling filters on
+# (job_submission_id, log_source) and keysets on id — the old
+# ix_logs_submission forced a residual log_source filter over the whole
+# submission history.
+migration(
+    """
+CREATE INDEX ix_jobs_status_lpa ON jobs(status, last_processed_at);
+CREATE INDEX ix_instances_project_status ON instances(project_id, status, deleted);
+CREATE INDEX ix_logs_poll ON logs(job_submission_id, log_source, id);
+""",
+    down="""
+DROP INDEX ix_jobs_status_lpa;
+DROP INDEX ix_instances_project_status;
+DROP INDEX ix_logs_poll;
+""",
+)

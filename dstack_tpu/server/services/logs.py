@@ -75,13 +75,17 @@ class DbLogStorage(LogStorage):
                 rows,
             )
 
-    async def poll(
-        self, project_id, run_name, job_submission_id, start_after=None, limit=1000,
-        diagnose=False,
-    ) -> JobSubmissionLogs:
-        source = "runner" if diagnose else "stdout"
+    @staticmethod
+    def _poll_query(job_submission_id, source, start_after, limit):
+        """Keyset-paginated poll: (job_submission_id, log_source, id) walks
+        the ix_logs_poll covering index, so each poll reads only rows past
+        the cursor instead of re-scanning the submission's whole history.
+        `limit` is clamped server-side — decode work is bounded no matter
+        what the client asks for. Factored out so tests can EXPLAIN it."""
+        limit = max(1, min(int(limit), 1000))
         sql = (
-            "SELECT * FROM logs WHERE job_submission_id = ? AND log_source = ?"
+            "SELECT id, timestamp, message FROM logs"
+            " WHERE job_submission_id = ? AND log_source = ?"
         )
         params: list = [job_submission_id, source]
         if start_after:
@@ -89,6 +93,14 @@ class DbLogStorage(LogStorage):
             params.append(int(start_after))
         sql += " ORDER BY id LIMIT ?"
         params.append(limit)
+        return sql, params
+
+    async def poll(
+        self, project_id, run_name, job_submission_id, start_after=None, limit=1000,
+        diagnose=False,
+    ) -> JobSubmissionLogs:
+        source = "runner" if diagnose else "stdout"
+        sql, params = self._poll_query(job_submission_id, source, start_after, limit)
         rows = await self.ctx.db.fetchall(sql, params)
         events = [
             LogEvent.create(

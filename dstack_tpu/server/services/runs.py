@@ -47,11 +47,17 @@ JOB_TERMINATION_REASONS_RETRYABLE = {
 }
 
 
-def job_row_to_submission(row: sqlite3.Row) -> JobSubmission:
+def job_row_to_submission(row: sqlite3.Row, ctx: Optional[ServerContext] = None) -> JobSubmission:
     from dstack_tpu.utils.common import parse_dt
 
     jpd = row["job_provisioning_data"]
     jrd = row["job_runtime_data"]
+    if ctx is not None:
+        parsed_jpd = ctx.spec_cache.parse(
+            JobProvisioningData, "jobs", row["id"], jpd or None
+        )
+    else:
+        parsed_jpd = JobProvisioningData.model_validate_json(jpd) if jpd else None
     return JobSubmission(
         id=row["id"],
         submission_num=row["submission_num"],
@@ -66,38 +72,51 @@ def job_row_to_submission(row: sqlite3.Row) -> JobSubmission:
         ),
         termination_reason_message=row["termination_reason_message"],
         exit_status=row["exit_status"],
-        job_provisioning_data=(
-            JobProvisioningData.model_validate_json(jpd) if jpd else None
-        ),
+        job_provisioning_data=parsed_jpd,
         job_runtime_data=(JobRuntimeData.model_validate_json(jrd) if jrd else None),
     )
 
 
-def job_rows_to_jobs(job_rows: List[sqlite3.Row]) -> List[Job]:
+def job_rows_to_jobs(
+    job_rows: List[sqlite3.Row], ctx: Optional[ServerContext] = None
+) -> List[Job]:
     """Group submissions of the same job (project, replica_num, job_num)."""
     by_key = {}
     for row in sorted(job_rows, key=lambda r: (r["replica_num"], r["job_num"], r["submission_num"])):
         key = (row["replica_num"], row["job_num"])
-        spec = JobSpec.model_validate_json(row["job_spec"])
+        if ctx is not None:
+            spec = ctx.spec_cache.parse(JobSpec, "jobs", row["id"], row["job_spec"])
+        else:
+            spec = JobSpec.model_validate_json(row["job_spec"])
         if key not in by_key:
             by_key[key] = Job(job_spec=spec, job_submissions=[])
         by_key[key].job_spec = spec
-        by_key[key].job_submissions.append(job_row_to_submission(row))
+        by_key[key].job_submissions.append(job_row_to_submission(row, ctx))
     return [by_key[k] for k in sorted(by_key)]
 
 
-async def run_row_to_run(ctx: ServerContext, row: sqlite3.Row, user_name: Optional[str] = None) -> Run:
+async def run_row_to_run(
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    user_name: Optional[str] = None,
+    *,
+    job_rows: Optional[List[sqlite3.Row]] = None,
+    project_name: Optional[str] = None,
+) -> Run:
     from dstack_tpu.utils.common import parse_dt
 
-    job_rows = await ctx.db.fetchall(
-        "SELECT * FROM jobs WHERE run_id = ? ORDER BY replica_num, job_num, submission_num",
-        (row["id"],),
-    )
+    if job_rows is None:
+        job_rows = await ctx.db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY replica_num, job_num, submission_num",
+            (row["id"],),
+        )
     if user_name is None:
         user_row = await ctx.db.fetchone("SELECT username FROM users WHERE id = ?", (row["user_id"],))
         user_name = user_row["username"] if user_row else "unknown"
-    project_row = await ctx.db.fetchone("SELECT name FROM projects WHERE id = ?", (row["project_id"],))
-    jobs = job_rows_to_jobs(job_rows)
+    if project_name is None:
+        project_row = await ctx.db.fetchone("SELECT name FROM projects WHERE id = ?", (row["project_id"],))
+        project_name = project_row["name"] if project_row else "unknown"
+    jobs = job_rows_to_jobs(job_rows, ctx)
     latest = None
     if jobs and jobs[0].job_submissions:
         latest = jobs[0].job_submissions[-1]
@@ -112,7 +131,7 @@ async def run_row_to_run(ctx: ServerContext, row: sqlite3.Row, user_name: Option
                 cost += sub.job_provisioning_data.price * hours
     return Run(
         id=row["id"],
-        project_name=project_row["name"] if project_row else "unknown",
+        project_name=project_name,
         user=user_name,
         submitted_at=parse_dt(row["submitted_at"]),
         last_processed_at=parse_dt(row["last_processed_at"]),
@@ -120,7 +139,7 @@ async def run_row_to_run(ctx: ServerContext, row: sqlite3.Row, user_name: Option
         termination_reason=(
             RunTerminationReason(row["termination_reason"]) if row["termination_reason"] else None
         ),
-        run_spec=RunSpec.model_validate_json(row["run_spec"]),
+        run_spec=ctx.spec_cache.parse(RunSpec, "runs", row["id"], row["run_spec"]),
         jobs=jobs,
         latest_job_submission=latest,
         cost=round(cost, 4),
@@ -372,7 +391,47 @@ async def list_runs(
     # Postgres — clamp to a sane window either way.
     params.append(max(1, min(int(limit), 1000)))
     rows = await ctx.db.fetchall(sql, params)
-    return [await run_row_to_run(ctx, r) for r in rows]
+    if not rows:
+        return []
+    # Batched reads: jobs, usernames, and project names for the whole page
+    # in three IN(...) sweeps instead of 3 queries per run (polling clients
+    # hit this endpoint every ~0.5 s while watching hundreds of runs).
+    from dstack_tpu.server.background.concurrency import id_chunks, placeholders
+
+    jobs_by_run: dict = {r["id"]: [] for r in rows}
+    for chunk in id_chunks(list(jobs_by_run)):
+        for j in await ctx.db.fetchall(
+            f"SELECT * FROM jobs WHERE run_id IN ({placeholders(len(chunk))})"
+            " ORDER BY replica_num, job_num, submission_num",
+            chunk,
+        ):
+            jobs_by_run[j["run_id"]].append(j)
+    user_ids = list({r["user_id"] for r in rows})
+    users = {}
+    for chunk in id_chunks(user_ids):
+        for u in await ctx.db.fetchall(
+            f"SELECT id, username FROM users WHERE id IN ({placeholders(len(chunk))})",
+            chunk,
+        ):
+            users[u["id"]] = u["username"]
+    project_ids = list({r["project_id"] for r in rows})
+    projects = {}
+    for chunk in id_chunks(project_ids):
+        for p in await ctx.db.fetchall(
+            f"SELECT id, name FROM projects WHERE id IN ({placeholders(len(chunk))})",
+            chunk,
+        ):
+            projects[p["id"]] = p["name"]
+    return [
+        await run_row_to_run(
+            ctx,
+            r,
+            users.get(r["user_id"], "unknown"),
+            job_rows=jobs_by_run[r["id"]],
+            project_name=projects.get(r["project_id"], "unknown"),
+        )
+        for r in rows
+    ]
 
 
 async def get_run(ctx: ServerContext, project_id: str, run_name: str) -> Run:

@@ -1,0 +1,110 @@
+"""Versioned parse cache for JSON spec columns.
+
+The FSM re-reads the same rows every tick, and pydantic
+`model_validate_json` dominates tick CPU once row counts grow — the
+pool-assign path alone re-parsed every idle instance's offer for every
+submitted job in every tick (O(jobs x instances) validations). Rows are
+immutable-ish (spec columns change rarely relative to how often they are
+read), so parses are memoized per (table, row id, model) and verified
+against a content hash of the raw JSON: an updated row changes the digest,
+which misses and transparently replaces the stale entry. The LRU bound
+keeps memory flat regardless of how many rows pass through.
+
+Cached objects are SHARED between callers — treat them as frozen and use
+`model_copy(update=...)` for any mutation (the hot paths already do).
+"""
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple, Type, TypeVar
+
+from dstack_tpu.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.models.runs import JobProvisioningData, JobSpec, RunSpec
+
+# Models the cache is allowed to hold. The property test in
+# tests/server/test_spec_cache.py asserts cached == uncached for each.
+CACHEABLE_MODELS: Tuple[type, ...] = (
+    JobSpec,
+    RunSpec,
+    JobProvisioningData,
+    InstanceOfferWithAvailability,
+)
+
+M = TypeVar("M")
+
+
+class SpecCache:
+    """LRU of parsed pydantic models keyed (table, row id, model), each entry
+    carrying the content digest of the JSON it was parsed from."""
+
+    def __init__(self, max_entries: Optional[int] = None, tracer=None):
+        if max_entries is None:
+            from dstack_tpu.server import settings
+
+            max_entries = settings.SPEC_CACHE_SIZE
+        self.max_entries = max(1, max_entries)
+        self.tracer = tracer
+        # Thread lock, not asyncio: parses happen on the event loop but
+        # /metrics stats reads may race flushes from worker threads.
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Tuple[bytes, Any]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _digest(raw) -> bytes:
+        data = raw if isinstance(raw, bytes) else raw.encode()
+        return hashlib.blake2b(data, digest_size=16).digest()
+
+    def parse(
+        self, model_cls: Type[M], table: str, row_id: str, raw
+    ) -> Optional[M]:
+        """Parse `raw` (the JSON text of `table`.`row_id`) as `model_cls`,
+        reusing the cached object when the content is unchanged."""
+        if raw is None:
+            return None
+        key = (table, row_id, model_cls)
+        digest = self._digest(raw)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry[0] == digest:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                hit = True
+            else:
+                self.misses += 1
+                hit = False
+        if self.tracer is not None:
+            self.tracer.inc(
+                "spec_cache_hits" if hit else "spec_cache_misses",
+                model=model_cls.__name__,
+            )
+        if hit:
+            return entry[1]
+        parsed = model_cls.model_validate_json(raw)
+        with self._lock:
+            self._entries[key] = (digest, parsed)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return parsed
+
+    def invalidate(self, table: str, row_id: str) -> None:
+        """Drop every cached model for one row. Content-hash verification
+        already makes stale reads impossible; this just frees memory early
+        (e.g. on row delete)."""
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == table and k[1] == row_id]:
+                del self._entries[key]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "size": len(self._entries),
+                "max_entries": self.max_entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }

@@ -31,6 +31,14 @@ MULTI_REPLICA = os.getenv("DSTACK_TPU_MULTI_REPLICA", "").lower() in ("1", "true
 MAX_CONCURRENT_JOB_STEPS = int(os.getenv("DSTACK_TPU_MAX_CONCURRENT_JOB_STEPS", "64"))
 MAX_CONCURRENT_PROVISIONS = int(os.getenv("DSTACK_TPU_MAX_CONCURRENT_PROVISIONS", "32"))
 
+# Versioned parse cache (services/spec_cache.py): parsed-spec LRU entries
+# held across all models. Each entry is one pydantic object; 4096 covers
+# ~1k active jobs + their runs/instances/offers with headroom.
+SPEC_CACHE_SIZE = int(os.getenv("DSTACK_TPU_SPEC_CACHE_SIZE", "4096"))
+# Coalesced tick writes (background/concurrency.py TickBuffer): rows per
+# executemany batch inside the single end-of-tick flush transaction.
+TICK_FLUSH_BATCH = int(os.getenv("DSTACK_TPU_TICK_FLUSH_BATCH", "500"))
+
 # Postgres wire-connection pool per replica. Sized so FSM fan-out
 # (bounded by the knobs above) does not serialize into one connection,
 # without holding 64 server slots per replica; explicit override wins.

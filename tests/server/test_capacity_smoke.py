@@ -1,0 +1,59 @@
+"""Fast capacity smoke: 40 concurrent runs through the full FSM.
+
+The real probe (`make capacity` / capacity_probe.py --runs 500, results in
+CAPACITY_r06.json) runs over a socket with the native runner; this is the
+CI-sized variant — 40 runs on the in-process test server, asserting zero
+failures and that the tick telemetry the optimization is judged by is
+actually exported at GET /metrics.
+"""
+
+import asyncio
+
+import pytest
+
+from dstack_tpu.server.http import response_json
+from tests.server.conftest import make_server, task_body, wait_run
+
+
+@pytest.mark.capacity
+async def test_capacity_smoke_40_runs_zero_failed():
+    fx = await make_server(run_background_tasks=True)
+    try:
+        n = 40
+        names = [f"cap-smoke-{i:02d}" for i in range(n)]
+        resps = await asyncio.gather(*(
+            fx.client.post(
+                "/api/project/main/runs/submit",
+                json_body=task_body(["true"], name),
+            )
+            for name in names
+        ))
+        for r in resps:
+            assert r.status == 200, r.body
+
+        results = await asyncio.gather(*(
+            wait_run(fx, name, ("done", "failed", "terminated"), timeout=60.0)
+            for name in names
+        ))
+        failed = [r["run_spec"]["run_name"] for r in results if r["status"] != "done"]
+        assert not failed, f"{len(failed)} failed runs: {failed[:5]}"
+
+        # The optimization's own telemetry must be visible on the scrape
+        # endpoint: per-processor tick counters and spec-cache hit/miss.
+        resp = await fx.client.get("/metrics")
+        assert resp.status == 200
+        text = resp.body.decode()
+        assert 'dstack_tpu_tick_rows_scanned_total{processor="submitted_jobs"}' in text
+        assert 'dstack_tpu_tick_rows_stepped_total{processor="submitted_jobs"}' in text
+        assert 'dstack_tpu_tick_rows_scanned_total{processor="runs"}' in text
+        assert "dstack_tpu_spec_cache_hits_total" in text
+        assert "dstack_tpu_spec_cache_entries" in text
+        assert "dstack_tpu_spec_cache_hit_rate" in text
+        # Tick duration: every background channel is spanned as "bg <name>".
+        assert 'dstack_tpu_span_seconds_sum{span="bg submitted_jobs"}' in text
+
+        # The hot tick actually hit the cache under load.
+        stats = fx.ctx.spec_cache.stats()
+        assert stats["hits"] > 0, stats
+    finally:
+        await fx.app.shutdown()

@@ -102,6 +102,46 @@ async def test_gcp_log_storage_isolates_submissions():
     assert [base64.b64decode(e.message).decode() for e in got.logs] == ["A"]
 
 
+async def test_db_log_poll_uses_keyset_index_not_history_scan():
+    """Regression: poll must walk the (job_submission_id, log_source, id)
+    covering index past the cursor instead of re-scanning the submission's
+    whole log history, and must clamp the row budget server-side."""
+    from dstack_tpu.server.services.logs import DbLogStorage
+    from tests.server.conftest import _test_db_url
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        storage = DbLogStorage(fx.ctx)
+        await storage.write(
+            "proj1", "run1", "subX",
+            job_logs=[_Event(1000 + i, _b64(f"line {i}")) for i in range(50)],
+            runner_logs=[],
+        )
+        # Keyset pagination: the cursor returns only rows past it.
+        first = await storage.poll("proj1", "run1", "subX", limit=10)
+        assert len(first.logs) == 10
+        rest = await storage.poll("proj1", "run1", "subX", start_after=first.next_token)
+        assert [base64.b64decode(e.message).decode() for e in rest.logs][0] == "line 10"
+
+        # The limit is clamped: a hostile/huge limit cannot widen the scan,
+        # a zero limit cannot emit an invalid query.
+        sql, params = DbLogStorage._poll_query("subX", "stdout", None, 10**9)
+        assert params[-1] == 1000
+        _, params0 = DbLogStorage._poll_query("subX", "stdout", None, 0)
+        assert params0[-1] == 1
+
+        if not _test_db_url().startswith(("postgres://", "postgresql://")):
+            # sqlite: EXPLAIN the exact poll SQL — it must use ix_logs_poll,
+            # not a full-table scan of logs.
+            sql, params = DbLogStorage._poll_query("subX", "stdout", "5", 100)
+            plan = await fx.ctx.db.fetchall(f"EXPLAIN QUERY PLAN {sql}", params)
+            detail = " ".join(r["detail"] for r in plan)
+            assert "ix_logs_poll" in detail, detail
+            assert "SCAN logs" not in detail, detail
+    finally:
+        await fx.app.shutdown()
+
+
 class DictBlobStorage(BlobStorage):
     def __init__(self):
         self.data = {}

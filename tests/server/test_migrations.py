@@ -120,6 +120,31 @@ async def test_downgrade_reverses_migrations(tmp_path):
         await db.close()
 
 
+async def test_hot_path_indexes_round_trip(tmp_path):
+    """Migration 6 (FSM hot-path covering indexes): present at head, dropped
+    by downgrade, restored by re-migrate — upgrade/downgrade/upgrade."""
+    from dstack_tpu.server.db import Database
+
+    expected = {"ix_jobs_status_lpa", "ix_instances_project_status", "ix_logs_poll"}
+
+    db = Database(str(tmp_path / "d.db"))
+    await db.connect()
+    try:
+        async def indexes():
+            rows = await db.fetchall(
+                "SELECT name FROM sqlite_master WHERE type = 'index'"
+            )
+            return {r["name"] for r in rows}
+
+        assert expected <= await indexes()
+        await db.downgrade(5)
+        assert not (expected & await indexes())
+        await db.migrate()
+        assert expected <= await indexes()
+    finally:
+        await db.close()
+
+
 async def test_downgrade_refuses_irreversible_range(tmp_path):
     """Migration 1 (the base schema) has no down script: downgrading to 0
     must refuse loudly instead of half-unwinding."""

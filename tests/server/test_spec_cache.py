@@ -1,0 +1,154 @@
+"""SpecCache — the FSM's versioned parse cache (services/spec_cache.py).
+
+The cache is keyed (table, row id, model) and verified against a content
+hash of the raw JSON, so correctness never depends on explicit
+invalidation: an updated row changes the digest and transparently
+re-parses. These tests pin the contract the processors rely on — hit on
+unchanged content, miss-and-replace on changed content, bounded memory,
+and parse-identical results for every cacheable model.
+"""
+
+import json
+
+from dstack_tpu.models.backends import BackendType
+from dstack_tpu.models.instances import (
+    InstanceAvailability,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+)
+from dstack_tpu.models.runs import JobProvisioningData, JobSpec, RunSpec
+from dstack_tpu.server.services.spec_cache import CACHEABLE_MODELS, SpecCache
+from dstack_tpu.server.testing.factories import make_task_run_spec
+from dstack_tpu.server.tracing import Tracer
+
+
+def _jpd_json(instance_id="i-1", price=1.0) -> str:
+    return JobProvisioningData(
+        backend=BackendType.GCP,
+        instance_type=InstanceType(
+            name="v5litepod-16",
+            resources=Resources(cpus=1, memory_mib=1024, description=""),
+        ),
+        instance_id=instance_id,
+        region="us-west4",
+        price=price,
+        username="root",
+        ssh_port=22,
+        dockerized=True,
+    ).model_dump_json()
+
+
+def _sample_json(model_cls) -> str:
+    if model_cls is JobProvisioningData:
+        return _jpd_json()
+    if model_cls is InstanceOfferWithAvailability:
+        return InstanceOfferWithAvailability(
+            backend=BackendType.GCP,
+            instance=InstanceType(
+                name="gcp-inst", resources=Resources(cpus=4, memory_mib=8192)
+            ),
+            region="r1",
+            price=2.5,
+            availability=InstanceAvailability.AVAILABLE,
+        ).model_dump_json()
+    if model_cls is RunSpec:
+        return make_task_run_spec().model_dump_json()
+    if model_cls is JobSpec:
+        from dstack_tpu.models.runs import Requirements
+
+        run_spec = make_task_run_spec()
+        return JobSpec(
+            job_name="test-run-0-0",
+            commands=["echo hello"],
+            requirements=Requirements(resources=run_spec.configuration.resources),
+        ).model_dump_json()
+    raise AssertionError(f"no sample for {model_cls}")
+
+
+def test_hit_on_unchanged_content():
+    cache = SpecCache(max_entries=16)
+    raw = _jpd_json()
+    first = cache.parse(JobProvisioningData, "instances", "i-1", raw)
+    second = cache.parse(JobProvisioningData, "instances", "i-1", raw)
+    assert second is first  # same object, no re-validation
+    assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 1
+
+
+def test_miss_and_replace_on_row_update():
+    """A row UPDATE changes the JSON; the digest check must reject the
+    stale entry and return the new parse — no explicit invalidation."""
+    cache = SpecCache(max_entries=16)
+    old = cache.parse(JobProvisioningData, "instances", "i-1", _jpd_json(price=1.0))
+    new = cache.parse(JobProvisioningData, "instances", "i-1", _jpd_json(price=9.0))
+    assert new is not old and new.price == 9.0
+    assert cache.stats()["hits"] == 0 and cache.stats()["misses"] == 2
+    # The replaced entry now hits.
+    assert cache.parse(
+        JobProvisioningData, "instances", "i-1", _jpd_json(price=9.0)
+    ) is new
+
+
+def test_explicit_invalidate_drops_all_models_for_row():
+    cache = SpecCache(max_entries=16)
+    raw = _jpd_json()
+    cache.parse(JobProvisioningData, "instances", "i-1", raw)
+    cache.parse(JobProvisioningData, "instances", "i-2", raw)
+    cache.invalidate("instances", "i-1")
+    assert cache.stats()["size"] == 1
+    # Re-parsing i-1 misses; i-2 still hits.
+    cache.parse(JobProvisioningData, "instances", "i-1", raw)
+    assert cache.stats()["misses"] == 3
+    cache.parse(JobProvisioningData, "instances", "i-2", raw)
+    assert cache.stats()["hits"] == 1
+
+
+def test_lru_bounds_memory():
+    cache = SpecCache(max_entries=8)
+    for i in range(50):
+        cache.parse(JobProvisioningData, "instances", f"i-{i}", _jpd_json(f"i-{i}"))
+        assert cache.stats()["size"] <= 8
+    # Most recently used survive; the oldest were evicted.
+    assert cache.parse(
+        JobProvisioningData, "instances", "i-49", _jpd_json("i-49")
+    ) is not None
+    assert cache.stats()["hits"] == 1
+    cache.parse(JobProvisioningData, "instances", "i-0", _jpd_json("i-0"))
+    assert cache.stats()["hits"] == 1  # i-0 was evicted -> miss
+
+
+def test_none_raw_returns_none_uncached():
+    cache = SpecCache(max_entries=4)
+    assert cache.parse(JobProvisioningData, "instances", "i-1", None) is None
+    assert cache.stats()["size"] == 0
+
+
+def test_cached_equals_uncached_for_every_registry_model():
+    """Property: for each cacheable model, the cached parse is semantically
+    identical to a fresh model_validate_json of the same content."""
+    cache = SpecCache(max_entries=16)
+    for model_cls in CACHEABLE_MODELS:
+        raw = _sample_json(model_cls)
+        cached = cache.parse(model_cls, "t", "r-1", raw)
+        fresh = model_cls.model_validate_json(raw)
+        assert cached == fresh, model_cls.__name__
+        # And the round-tripped dumps agree byte-for-byte.
+        assert json.loads(cached.model_dump_json()) == json.loads(
+            fresh.model_dump_json()
+        ), model_cls.__name__
+        # Same content under a different key parses to an equal object.
+        assert cache.parse(model_cls, "t", "r-2", raw) == fresh
+
+
+def test_tracer_counters_emitted():
+    tracer = Tracer()
+    cache = SpecCache(max_entries=4, tracer=tracer)
+    raw = _jpd_json()
+    cache.parse(JobProvisioningData, "instances", "i-1", raw)
+    cache.parse(JobProvisioningData, "instances", "i-1", raw)
+    counters = {
+        (c["name"], c["labels"].get("model")): c["value"]
+        for c in tracer.counter_snapshot()
+    }
+    assert counters[("spec_cache_misses", "JobProvisioningData")] == 1
+    assert counters[("spec_cache_hits", "JobProvisioningData")] == 1

@@ -1,0 +1,115 @@
+"""Master-wait timeout anchoring (process_submitted_jobs._check_wait_timeout).
+
+A worker job waits for its slice leader for MASTER_WAIT_TIMEOUT. The wait
+window must be anchored at the replica's LATEST (re)submission, not the
+worker row's own submitted_at: after a retry, a resubmitted gang gets a
+fresh wait budget even when some row carries an old timestamp — and
+conversely a replica whose every submission is stale does time out.
+"""
+
+from datetime import timedelta
+
+from dstack_tpu.models.runs import RunStatus
+from dstack_tpu.server.background.tasks import process_submitted_jobs
+from dstack_tpu.server.services.runs import create_replica_jobs
+from dstack_tpu.server.testing.factories import create_run_row, make_task_run_spec
+from dstack_tpu.utils.common import utcnow
+from tests.server.conftest import make_server
+
+
+async def _make_gang(ctx):
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    spec = make_task_run_spec(nodes=2, tpu="v5litepod-8")
+    run_id = await create_run_row(
+        ctx, project["id"], user["id"], spec, status=RunStatus.SUBMITTED
+    )
+    await create_replica_jobs(ctx, project["id"], run_id, spec, 0, 0)
+    return run_id
+
+
+async def _set_submitted_at(ctx, job_id, dt):
+    await ctx.db.execute(
+        "UPDATE jobs SET submitted_at = ? WHERE id = ?", (dt.isoformat(), job_id)
+    )
+
+
+async def _worker_row(ctx, run_id):
+    return await ctx.db.fetchone(
+        "SELECT * FROM jobs WHERE run_id = ? AND job_num = 1"
+        " ORDER BY submission_num DESC LIMIT 1",
+        (run_id,),
+    )
+
+
+async def test_fresh_resubmission_resets_worker_wait_budget():
+    """Worker row is older than MASTER_WAIT_TIMEOUT but a sibling was just
+    (re)submitted: the worker must keep waiting, not fail."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_gang(ctx)
+        worker = await _worker_row(ctx, run_id)
+        stale = utcnow() - timedelta(
+            seconds=process_submitted_jobs.MASTER_WAIT_TIMEOUT + 60
+        )
+        await _set_submitted_at(ctx, worker["id"], stale)
+        # The leader's fresh submitted_at (written by create_replica_jobs)
+        # is the replica's anchor.
+        worker = await _worker_row(ctx, run_id)
+        await process_submitted_jobs._process_job(ctx, worker)
+        after = await _worker_row(ctx, run_id)
+        assert after["status"] == "submitted", dict(after)
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_stale_replica_times_out():
+    """Every submission of the replica is past the wait deadline: the
+    waiting worker fails with waiting_instance_limit_exceeded."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_gang(ctx)
+        stale = utcnow() - timedelta(
+            seconds=process_submitted_jobs.MASTER_WAIT_TIMEOUT + 60
+        )
+        for j in await ctx.db.fetchall(
+            "SELECT id FROM jobs WHERE run_id = ?", (run_id,)
+        ):
+            await _set_submitted_at(ctx, j["id"], stale)
+        worker = await _worker_row(ctx, run_id)
+        await process_submitted_jobs._process_job(ctx, worker)
+        after = await _worker_row(ctx, run_id)
+        assert after["status"] == "failed"
+        assert after["termination_reason"] == "waiting_instance_limit_exceeded"
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_anchor_prefetched_by_tick_matches_on_demand():
+    """The batched tick path (anchors prefetched in one GROUP BY) must agree
+    with the tick=None on-demand query."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_gang(ctx)
+        worker = await _worker_row(ctx, run_id)
+        stale = utcnow() - timedelta(
+            seconds=process_submitted_jobs.MASTER_WAIT_TIMEOUT + 60
+        )
+        await _set_submitted_at(ctx, worker["id"], stale)
+        worker = await _worker_row(ctx, run_id)
+        tick = await process_submitted_jobs._build_tick(ctx, [worker])
+        anchor = tick.anchors.get((worker["run_id"], worker["replica_num"]))
+        arow = await ctx.db.fetchone(
+            "SELECT MAX(submitted_at) AS anchor FROM jobs"
+            " WHERE run_id = ? AND replica_num = ?",
+            (worker["run_id"], worker["replica_num"]),
+        )
+        assert anchor == arow["anchor"]
+        await process_submitted_jobs._process_job(ctx, worker, tick)
+        after = await _worker_row(ctx, run_id)
+        assert after["status"] == "submitted"  # fresh sibling anchors the wait
+    finally:
+        await fx.app.shutdown()
