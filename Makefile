@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast lint capacity capacity-smoke
+.PHONY: test test-all chaos chaos-fast lint lint-json capacity capacity-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -18,8 +18,16 @@ chaos:
 chaos-fast:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario runner-flap
 
+# Static analysis (docs/guides/static-analysis.md) + bytecode compile.
+# The second analysis invocation is the self-check: the analyzer's own
+# package must be clean with the baseline ignored entirely.
 lint:
+	$(PYTHON) -m dstack_tpu.analysis dstack_tpu/
+	$(PYTHON) -m dstack_tpu.analysis dstack_tpu/analysis --no-baseline
 	$(PYTHON) -m compileall -q dstack_tpu
+
+lint-json:
+	$(PYTHON) -m dstack_tpu.analysis dstack_tpu/ --json
 
 # Full control-plane capacity probe (500 concurrent runs, native runner,
 # real socket). Results land in CAPACITY_r06.json; see

@@ -41,6 +41,7 @@ from dstack_tpu.models.runs import JobStatus, JobTerminationReason
 from dstack_tpu.parallel.env import make_cluster_env
 from dstack_tpu.server.http import App, Request, Response, Router, Server
 from dstack_tpu.utils.common import utcnow
+from dstack_tpu.utils.tasks import spawn_logged
 
 IDLE_SHUTDOWN_SECONDS = 300.0  # parity: runner self-terminates if no job (server.go:56)
 
@@ -279,7 +280,11 @@ class Executor:
         if repo_data is not None and repo_data.repo_type == "remote":
             # Only the remote path needs the blob in memory (it's the diff,
             # small); local tars stream straight from disk in _extract_tar.
-            blob = self.code_path.read_bytes() if has_code else None
+            blob = (
+                await asyncio.to_thread(self.code_path.read_bytes)
+                if has_code
+                else None
+            )
             await asyncio.get_event_loop().run_in_executor(
                 None,
                 functools.partial(
@@ -541,8 +546,8 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
     kind, target = _preemption_source()
     if kind:
         async def _start_preemption_watcher() -> None:
-            asyncio.get_event_loop().create_task(
-                watch_preemption(executor, kind, target)
+            spawn_logged(
+                watch_preemption(executor, kind, target), "preemption watcher"
             )
 
         app.on_startup.append(_start_preemption_watcher)
@@ -559,7 +564,7 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
                     os._exit(0)
 
         async def _start_watchdog() -> None:
-            asyncio.get_event_loop().create_task(_idle_watchdog())
+            spawn_logged(_idle_watchdog(), "idle watchdog")
 
         app.on_startup.append(_start_watchdog)
     return app
@@ -653,7 +658,7 @@ def main() -> None:
         await server.start()
         if args.port_file:
             tmp = Path(args.port_file + ".tmp")
-            tmp.write_text(str(server.port))
+            await asyncio.to_thread(tmp.write_text, str(server.port))
             tmp.rename(args.port_file)
         print(f"runner listening on {args.host}:{server.port}", flush=True)
         assert server._server is not None

@@ -1,0 +1,30 @@
+"""Orchestrator-aware static analysis for the dstack-tpu control plane.
+
+The control plane is a large async FSM; its recurring defect classes are
+concurrency and state-consistency bugs that unit tests reach only after
+the fact (chaos drills, the runtime dialect audit). This package is an
+AST-based static pass over the codebase — stdlib `ast` only, no external
+dependencies — that gates every PR on the hazards this repo has actually
+shipped:
+
+- ASY01  blocking call (sleep / subprocess / requests / sqlite / file IO)
+         inside `async def` — stalls the whole event loop.
+- ASY02  un-awaited module-local coroutine, or an `asyncio.create_task`
+         whose handle is discarded (exceptions vanish at GC time).
+- LCK01  UPDATE/DELETE on an FSM-owned table (runs / jobs / instances)
+         from server/background/ or server/services/ without holding the
+         matching `ResourceLocker`/`ClaimLocker` namespace.
+- LCK02  inconsistent cross-namespace lock acquisition order (deadlock).
+- SQL01  string interpolation into execute()/fetch*(), and sqlite-only
+         dialect in SQL literals (shares the SQLITE_ISMS corpus with the
+         runtime audit in tests/server/test_pg_dialect_audit.py).
+- MET01  Prometheus emissions not declared in the single metrics
+         registry (server/metrics_registry.py), label-set drift, and
+         counter naming.
+- BASE01 stale baseline entry (suppressed finding whose code is gone).
+
+Run: `python -m dstack_tpu.analysis dstack_tpu/ [--json]`
+Docs: docs/guides/static-analysis.md
+"""
+
+from dstack_tpu.analysis.core import Finding, Project, run_analysis  # noqa: F401

@@ -1,0 +1,107 @@
+"""CLI: `python -m dstack_tpu.analysis [paths] [--json] [--baseline FILE]`.
+
+Exit status: 0 = clean (baselined findings do not fail the run),
+1 = actionable findings or unparseable files, 2 = usage error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from dstack_tpu.analysis import baseline as baseline_mod
+from dstack_tpu.analysis.core import run_analysis
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dstack_tpu.analysis",
+        description="Orchestrator-aware static analysis (see"
+        " docs/guides/static-analysis.md)",
+    )
+    p.add_argument("paths", nargs="*", default=["dstack_tpu"], help="files or directories to scan")
+    p.add_argument("--json", action="store_true", dest="as_json", help="machine-readable output")
+    p.add_argument(
+        "--baseline",
+        default=baseline_mod.DEFAULT_PATH,
+        help=f"baseline file (default: {baseline_mod.DEFAULT_PATH})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline file (self-check mode)",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write current findings into the baseline and exit 0",
+    )
+    p.add_argument("--root", default=None, help="path findings are reported relative to (default: cwd)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = args.paths or ["dstack_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    fingerprints = set()
+    if not args.no_baseline:
+        try:
+            fingerprints = baseline_mod.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    report = run_analysis(paths, root=args.root, baseline_fingerprints=fingerprints)
+
+    if args.update_baseline:
+        keep = [f.fingerprint for f in report.findings if f.code != "BASE01"]
+        keep += [f.fingerprint for f in report.baselined]
+        baseline_mod.save(args.baseline, keep)
+        print(f"baseline updated: {args.baseline} ({len(set(keep))} entries)")
+        return 0
+
+    if args.as_json:
+        payload = {
+            "files_scanned": report.files_scanned,
+            "checkers": report.checker_codes,
+            "findings": [
+                {
+                    "code": f.code,
+                    "message": f.message,
+                    "path": f.rel,
+                    "line": f.line,
+                    "col": f.col,
+                    "symbol": f.symbol,
+                    "fingerprint": f.fingerprint,
+                }
+                for f in report.findings
+            ],
+            "baselined": [f.fingerprint for f in report.baselined],
+            "stale_baseline": report.stale_baseline,
+            "errors": report.errors,
+            "exit_code": report.exit_code,
+        }
+        print(json.dumps(payload, indent=2))
+        return report.exit_code
+
+    for err in report.errors:
+        print(f"ERROR {err}", file=sys.stderr)
+    for f in report.findings:
+        print(f.render())
+    summary = (
+        f"{report.files_scanned} files, checkers: {', '.join(report.checker_codes)}"
+        f" — {len(report.findings)} finding(s)"
+    )
+    if report.baselined:
+        summary += f", {len(report.baselined)} baselined"
+    print(("FAIL " if report.exit_code else "OK ") + summary)
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""Small AST helpers shared by the checkers (stdlib `ast` only)."""
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Marker substituted for interpolated segments when flattening an
+# f-string / %-format / .format() into linter-visible text.
+INTERP = "\x00"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for a Name/Attribute chain; None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def attr_name(call: ast.Call) -> Optional[str]:
+    """Bare method name for attribute calls (`x.y.execute(...)` ->
+    "execute"); None for plain-name calls."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def string_text(node: ast.AST) -> Tuple[Optional[str], bool]:
+    """Flatten a string-valued expression to (text, interpolated).
+
+    Interpolated segments (f-string values, %-args, .format args, non-const
+    concat operands) become INTERP markers so regexes still see the constant
+    SQL around them. Returns (None, False) when the expression is not
+    string-like at all.
+    """
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            return node.value, False
+        return None, False
+    if isinstance(node, ast.JoinedStr):
+        out: List[str] = []
+        interpolated = False
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                out.append(part.value)
+            else:
+                out.append(INTERP)
+                interpolated = True
+        return "".join(out), interpolated
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left, li = string_text(node.left)
+        right, ri = string_text(node.right)
+        if left is None and right is None:
+            return None, False
+        return (left or INTERP) + (right or INTERP), (
+            li or ri or left is None or right is None
+        )
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        base, _ = string_text(node.left)
+        if base is None:
+            return None, False
+        return base.replace("%s", INTERP).replace("%d", INTERP), True
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+    ):
+        base, _ = string_text(node.func.value)
+        if base is None:
+            return None, False
+        return base, True
+    return None, False
+
+
+class ImportAliases:
+    """Map local names back to canonical module paths.
+
+    `import time as _time` -> {"_time": "time"};
+    `from time import sleep` -> {"sleep": "time.sleep"}.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def canonical(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        mapped = self.aliases.get(head)
+        if mapped is None:
+            return dotted
+        return f"{mapped}.{rest}" if rest else mapped
+
+
+def outer_functions(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(qualname, node) for every top-level function and class method.
+    Nested defs belong to their outermost function for analysis purposes."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in tree.body:
+        if isinstance(node, FUNC_NODES):
+            out.append((node.name, node))
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, FUNC_NODES):
+                    out.append((f"{node.name}.{item.name}", item))
+    return out
+
+
+def walk_async_bodies(func: ast.AsyncFunctionDef):
+    """Yield nodes executed ON the event loop inside `func`: descends the
+    async body but not into nested sync defs (executor/run_sync callbacks)
+    or lambdas (commonly shipped to threads)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.AsyncFunctionDef):
+            continue  # visited as its own root
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

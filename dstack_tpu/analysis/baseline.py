@@ -1,0 +1,44 @@
+"""Baseline file: grandfathered finding fingerprints.
+
+The committed `analysis_baseline.json` is intended to stay empty — the
+first full run's genuine defects were fixed, not baselined. The file
+exists so a future PR that *must* land with a known finding (e.g. a
+staged refactor) can suppress it explicitly and reviewably, and so the
+tooling round-trip (record → suppress → stale-entry detection) is
+exercised by tests rather than trusted.
+
+Fingerprints are line-number-free (`code::rel::symbol::key`), so edits
+above a finding do not invalidate the baseline; deleting the finding
+does (BASE01 flags the stale entry until it is removed from the file).
+"""
+
+import json
+import os
+from typing import Iterable, List, Set
+
+VERSION = 1
+DEFAULT_PATH = "analysis_baseline.json"
+
+
+def load(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != VERSION:
+        raise ValueError(f"{path}: unsupported baseline format")
+    entries = data.get("entries", [])
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: 'entries' must be a list")
+    return set(str(e) for e in entries)
+
+
+def save(path: str, fingerprints: Iterable[str]) -> None:
+    data = {"version": VERSION, "entries": sorted(set(fingerprints))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+def merge(existing: Set[str], new_fps: Iterable[str]) -> List[str]:
+    return sorted(existing | set(new_fps))

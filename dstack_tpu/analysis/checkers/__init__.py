@@ -1,0 +1,2 @@
+"""Checker implementations. Each module exports one Checker subclass;
+`core.default_checkers()` is the registry."""

@@ -1,0 +1,208 @@
+"""ASY01 / ASY02: event-loop hygiene.
+
+ASY01 — a blocking call (`time.sleep`, subprocess, requests, sync
+sqlite3, `open()` / Path IO) lexically inside an `async def` body stalls
+every coroutine on the loop. Only statements that actually run ON the
+loop are checked: nested sync defs and lambdas (run_sync / executor
+callbacks, thread targets) are skipped, which is also what keeps the
+legitimately-sync CLI/SDK poll loops (`api/client.py`, `cli/main.py`)
+out of scope.
+
+ASY02 — a coroutine called at statement position is never awaited and
+silently does nothing; an `asyncio.create_task(...)` whose handle is
+discarded can be garbage-collected mid-flight and swallows its exception.
+Handles must be retained (assigned, stored, passed, returned) or routed
+through a logging spawner (`dstack_tpu.utils.tasks.spawn_logged`,
+`ctx.spawn`). Discarded-handle detection covers sync functions too — the
+repo's first genuine hit was in a sync `unlock_nowait`.
+"""
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from dstack_tpu.analysis.astutil import (
+    FUNC_NODES,
+    attr_name,
+    call_name,
+    walk_async_bodies,
+)
+from dstack_tpu.analysis.core import Checker, Finding, Module
+
+# Canonical callables that block the thread (after import-alias
+# resolution).
+BLOCKING_CALLS: Set[str] = {
+    "time.sleep",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.Popen",
+    "requests.get",
+    "requests.post",
+    "requests.put",
+    "requests.patch",
+    "requests.delete",
+    "requests.head",
+    "requests.request",
+    "sqlite3.connect",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "open",
+}
+
+# Path / file-handle methods that hit the filesystem synchronously.
+# `.open()` is only flagged when the call is NOT awaited — `await
+# tunnel.open()` is an async method that happens to share the name.
+BLOCKING_METHODS: Set[str] = {
+    "read_text",
+    "write_text",
+    "read_bytes",
+    "write_bytes",
+    "open",
+}
+
+# Spawners that retain the task and log its exception; a bare-expression
+# call through these is fine.
+SAFE_SPAWNERS: Set[str] = {"spawn_logged", "spawn"}
+
+TASK_SPAWNERS: Set[str] = {"create_task", "ensure_future"}
+
+
+def _functions(module: Module) -> List[Tuple[str, ast.AST]]:
+    """Every function (sync and async, any nesting) with a dotted
+    qualname. Each def appears exactly once."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNC_NODES):
+                out.append((f"{prefix}{child.name}", child))
+                visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(module.tree, "")
+    return out
+
+
+def _own_statements(func: ast.AST):
+    """Statements belonging to `func` itself, not to nested defs."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FUNC_NODES) or isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class AsyncHygieneChecker(Checker):
+    codes = ("ASY01", "ASY02")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        coro_names: Set[str] = {
+            n.name for n in ast.walk(module.tree)
+            if isinstance(n, ast.AsyncFunctionDef)
+        }
+        for qualname, func in _functions(module):
+            if isinstance(func, ast.AsyncFunctionDef):
+                body_nodes = list(walk_async_bodies(func))
+                awaited = {
+                    id(n.value)
+                    for n in body_nodes
+                    if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)
+                }
+                for node in body_nodes:
+                    if isinstance(node, ast.Call):
+                        findings.extend(
+                            self._check_blocking(module, qualname, node, awaited)
+                        )
+            for node in _own_statements(func):
+                if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                    findings.extend(
+                        self._check_discarded(module, qualname, node.value, coro_names)
+                    )
+        return findings
+
+    def _check_blocking(
+        self, module: Module, qualname: str, call: ast.Call, awaited: Set[int]
+    ) -> Iterable[Finding]:
+        if id(call) in awaited:
+            return  # `await x.open()` etc. — an async method, not file IO
+        name = call_name(call)
+        canonical = module.aliases.canonical(name) if name else None
+        if canonical in BLOCKING_CALLS:
+            yield Finding(
+                code="ASY01",
+                message=f"blocking call `{canonical}` inside `async def {qualname}`"
+                " — stalls the event loop; use the async equivalent or"
+                " offload to a thread",
+                rel=module.rel,
+                line=call.lineno,
+                col=call.col_offset,
+                symbol=qualname,
+                key=canonical,
+            )
+            return
+        method = attr_name(call)
+        if method in BLOCKING_METHODS:
+            yield Finding(
+                code="ASY01",
+                message=f"synchronous file IO `.{method}()` inside"
+                f" `async def {qualname}` — offload to a thread"
+                " (loop.run_in_executor / asyncio.to_thread)",
+                rel=module.rel,
+                line=call.lineno,
+                col=call.col_offset,
+                symbol=qualname,
+                key=f".{method}",
+            )
+
+    def _check_discarded(
+        self,
+        module: Module,
+        qualname: str,
+        call: ast.Call,
+        coro_names: Set[str],
+    ) -> Iterable[Finding]:
+        method = attr_name(call)
+        if method in TASK_SPAWNERS:
+            yield Finding(
+                code="ASY02",
+                message=f"`{method}(...)` handle discarded in"
+                f" `{qualname}` — the task can be garbage-collected"
+                " mid-flight and its exception is lost; retain the handle"
+                " or use dstack_tpu.utils.tasks.spawn_logged",
+                rel=module.rel,
+                line=call.lineno,
+                col=call.col_offset,
+                symbol=qualname,
+                key=method,
+            )
+            return
+        if method in SAFE_SPAWNERS:
+            return
+        name = call_name(call)
+        if name is None:
+            return
+        bare = name.split(".")[-1]
+        # Only calls we can resolve to a module-local coroutine: plain
+        # names and direct self.<method>. `self._sem.release()` is NOT
+        # `self.release` — matching through intermediate attributes would
+        # false-positive on sync methods of member objects that share a
+        # name with a local coroutine.
+        if bare in coro_names and name in (bare, f"self.{bare}"):
+            yield Finding(
+                code="ASY02",
+                message=f"coroutine `{name}(...)` called but never awaited"
+                f" in `{qualname}` — it will not run",
+                rel=module.rel,
+                line=call.lineno,
+                col=call.col_offset,
+                symbol=qualname,
+                key=name,
+            )

@@ -1,0 +1,340 @@
+"""LCK01 / LCK02: FSM lock discipline.
+
+The control plane serializes row ownership through two primitives in
+`server/services/locking.py`:
+
+- `ResourceLocker.lock_ctx(namespace, keys)` — in-process lockset, used
+  as `async with`;
+- `ClaimLocker.try_claim(namespace, key)` / `.release(...)` — DB lease
+  rows, used directly or through
+  `server/background/concurrency.for_each_claimed(ctx, ns, rows, fn, ...)`
+  which claims each row before invoking `fn`.
+
+LCK01 — an UPDATE/DELETE on an FSM-owned table (`runs` / `jobs` /
+`instances`) issued from `server/background/` or `server/services/`
+while no claim/lock for an allowed namespace is held. "Held" is
+computed lexically (enclosing `lock_ctx` with-blocks, prior `try_claim`
+in the same function) plus a cross-module fixed point: namespaces held
+at a call site propagate to the callee, and `for_each_claimed` grants
+its namespace to the stepper it invokes. INSERTs are exempt (creating a
+row races with nobody), as is `TickBuffer.write` (the post-release
+bookkeeping channel — it is a different method name and is never gated).
+
+The ownership map encodes the FSM's real write hierarchy, not a 1:1
+table↔namespace rule: the run FSM legitimately writes `jobs` rows under
+its "runs" claim, and job processors write `instances` under "jobs".
+
+LCK02 — inconsistent cross-namespace acquisition order. Every
+acquisition made while another namespace is held contributes an edge
+(held → acquired); a cycle in that graph is a deadlock waiting for
+load.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dstack_tpu.analysis.astutil import (
+    FUNC_NODES,
+    attr_name,
+    call_name,
+    const_str,
+    string_text,
+)
+from dstack_tpu.analysis.core import Checker, Finding, Module, Project
+
+# table -> namespaces whose holder may write it.
+TABLE_NAMESPACES: Dict[str, Set[str]] = {
+    "runs": {"runs"},
+    "jobs": {"jobs", "runs"},
+    "instances": {"instances", "jobs"},
+}
+
+_WRITE_RE = re.compile(r"^\s*(UPDATE|DELETE\s+FROM)\s+([A-Za-z_][A-Za-z0-9_]*)", re.I)
+
+_SCOPED = ("server/background/", "server/services/")
+
+
+def _scoped(rel: str) -> bool:
+    return any(part in rel for part in _SCOPED)
+
+
+class _Site:
+    __slots__ = ("line", "held")
+
+    def __init__(self, line: int, held: Set[str]):
+        self.line = line
+        self.held = set(held)
+
+
+class _WriteSite(_Site):
+    __slots__ = ("table", "verb")
+
+    def __init__(self, line: int, held: Set[str], table: str, verb: str):
+        super().__init__(line, held)
+        self.table = table
+        self.verb = verb
+
+
+class _CallSite(_Site):
+    __slots__ = ("callee",)
+
+    def __init__(self, line: int, held: Set[str], callee: str):
+        super().__init__(line, held)
+        self.callee = callee
+
+
+class _AcqSite(_Site):
+    __slots__ = ("namespace",)
+
+    def __init__(self, line: int, held: Set[str], namespace: str):
+        super().__init__(line, held)
+        self.namespace = namespace
+
+
+class _FuncInfo:
+    def __init__(self, module: Module, qualname: str, node: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.writes: List[_WriteSite] = []
+        self.calls: List[_CallSite] = []
+        self.acquisitions: List[_AcqSite] = []
+        self.granted: Set[str] = set()  # namespaces held for the whole body
+
+
+def _top_functions(module: Module) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for node in module.tree.body:
+        if isinstance(node, FUNC_NODES):
+            out.append((node.name, node))
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, FUNC_NODES):
+                    out.append((f"{node.name}.{item.name}", item))
+    return out
+
+
+def _lock_ctx_namespace(item: ast.withitem) -> Optional[str]:
+    call = item.context_expr
+    if isinstance(call, ast.Call) and attr_name(call) == "lock_ctx" and call.args:
+        return const_str(call.args[0])
+    return None
+
+
+def _scan_expr(info: _FuncInfo, node: ast.AST, held: Set[str]) -> None:
+    """Record every call / write / try_claim inside one expression or
+    simple statement. `try_claim` grows `held` in place — claims acquired
+    earlier in a function cover the statements after them (the claim may
+    fail at runtime, but writes are conventionally inside the success
+    branch, so over-approximating avoids false positives without
+    weakening the ordering check)."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        method = attr_name(sub)
+        if method == "try_claim" and sub.args:
+            ns = const_str(sub.args[0])
+            if ns:
+                info.acquisitions.append(_AcqSite(sub.lineno, held, ns))
+                held.add(ns)
+            continue
+        if method in ("execute", "executemany") and sub.args:
+            text, _ = string_text(sub.args[0])
+            if text:
+                m = _WRITE_RE.match(text)
+                if m:
+                    verb = m.group(1).split()[0].upper()
+                    table = m.group(2).lower()
+                    info.writes.append(_WriteSite(sub.lineno, held, table, verb))
+        name = call_name(sub)
+        bare = None
+        if name is not None:
+            bare = name.split(".")[-1]
+        elif method is not None:
+            bare = method
+        if bare:
+            info.calls.append(_CallSite(sub.lineno, held, bare))
+        # for_each_claimed(ctx, ns, rows, fn, ...) claims each row before
+        # invoking fn: grant ns to the stepper. The stepper is usually a
+        # lambda closing over extra args — grant to every call inside it.
+        if bare == "for_each_claimed" and len(sub.args) >= 4:
+            ns = const_str(sub.args[1])
+            fn = sub.args[3]
+            if ns and isinstance(fn, ast.Lambda):
+                for inner in ast.walk(fn.body):
+                    if isinstance(inner, ast.Call):
+                        iname = call_name(inner) or attr_name(inner)
+                        if iname:
+                            info.calls.append(
+                                _CallSite(
+                                    inner.lineno, held | {ns}, iname.split(".")[-1]
+                                )
+                            )
+            elif ns:
+                fn_name = call_name(fn)
+                if fn_name:
+                    info.calls.append(
+                        _CallSite(sub.lineno, held | {ns}, fn_name.split(".")[-1])
+                    )
+
+
+def _scan_body(info: _FuncInfo, body: Sequence[ast.stmt], held: Set[str]) -> None:
+    held = set(held)
+    for stmt in body:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in stmt.items:
+                _scan_expr(info, item.context_expr, held)
+                ns = _lock_ctx_namespace(item)
+                if ns:
+                    info.acquisitions.append(_AcqSite(stmt.lineno, held, ns))
+                    inner.add(ns)
+            _scan_body(info, stmt.body, inner)
+        elif isinstance(stmt, FUNC_NODES):
+            # Nested defs (inline helpers) inherit the lexical context at
+            # their definition point — they are invoked inside it in this
+            # codebase's idiom.
+            _scan_body(info, stmt.body, held)
+        elif isinstance(stmt, ast.ClassDef):
+            _scan_body(info, stmt.body, held)
+        elif isinstance(stmt, ast.If):
+            # Scan the test first: `if await ctx.claims.try_claim(...)`
+            # must grow `held` before its body is scanned.
+            _scan_expr(info, stmt.test, held)
+            _scan_body(info, stmt.body, held)
+            _scan_body(info, stmt.orelse, held)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _scan_expr(info, stmt.iter, held)
+            _scan_body(info, stmt.body, held)
+            _scan_body(info, stmt.orelse, held)
+        elif isinstance(stmt, ast.While):
+            _scan_expr(info, stmt.test, held)
+            _scan_body(info, stmt.body, held)
+            _scan_body(info, stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            _scan_body(info, stmt.body, held)
+            for handler in stmt.handlers:
+                _scan_body(info, handler.body, held)
+            _scan_body(info, stmt.orelse, held)
+            _scan_body(info, stmt.finalbody, held)
+        else:
+            _scan_expr(info, stmt, held)
+
+
+class LockDisciplineChecker(Checker):
+    codes = ("LCK01", "LCK02")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        infos: List[_FuncInfo] = []
+        by_name: Dict[str, List[_FuncInfo]] = {}
+        for module in project.modules:
+            for qualname, node in _top_functions(module):
+                info = _FuncInfo(module, qualname, node)
+                _scan_body(info, node.body, set())
+                infos.append(info)
+                by_name.setdefault(qualname.split(".")[-1], []).append(info)
+
+        def resolve(caller: _FuncInfo, bare: str) -> List[_FuncInfo]:
+            candidates = by_name.get(bare, [])
+            same = [c for c in candidates if c.module is caller.module]
+            return same or candidates
+
+        # Fixed point: namespaces held at a call site flow into the
+        # callee's whole-body grant.
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for info in infos:
+                for site in info.calls:
+                    flowing = site.held | info.granted
+                    if not flowing:
+                        continue
+                    for callee in resolve(info, site.callee):
+                        if callee is info:
+                            continue
+                        if not flowing <= callee.granted:
+                            callee.granted |= flowing
+                            changed = True
+
+        findings: List[Finding] = []
+        edges: Dict[Tuple[str, str], Tuple[Module, int, str]] = {}
+        for info in infos:
+            for acq in info.acquisitions:
+                for held_ns in acq.held | info.granted:
+                    if held_ns != acq.namespace:
+                        edges.setdefault(
+                            (held_ns, acq.namespace),
+                            (info.module, acq.line, info.qualname),
+                        )
+            if not _scoped(info.module.rel):
+                continue
+            for w in info.writes:
+                allowed = TABLE_NAMESPACES.get(w.table)
+                if allowed is None:
+                    continue
+                held = w.held | info.granted
+                if held & allowed:
+                    continue
+                want = " or ".join(f'"{ns}"' for ns in sorted(allowed))
+                held_desc = (
+                    ", ".join(sorted(held)) if held else "none"
+                )
+                findings.append(
+                    Finding(
+                        code="LCK01",
+                        message=f"{w.verb} on FSM-owned table `{w.table}` in"
+                        f" `{info.qualname}` without holding a {want} claim"
+                        f" (held: {held_desc}) — wrap in lock_ctx/try_claim"
+                        " for the owning namespace",
+                        rel=info.module.rel,
+                        line=w.line,
+                        symbol=info.qualname,
+                        key=f"{w.verb.lower()}:{w.table}",
+                    )
+                )
+
+        findings.extend(self._order_cycles(edges))
+        return findings
+
+    def _order_cycles(
+        self, edges: Dict[Tuple[str, str], Tuple[Module, int, str]]
+    ) -> Iterable[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen: Set[str] = set()
+            stack = [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(graph.get(n, ()))
+            return False
+
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), (module, line, symbol) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])
+        ):
+            if (b, a) in reported:
+                continue
+            if reaches(b, a):
+                reported.add((a, b))
+                yield Finding(
+                    code="LCK02",
+                    message=f"lock acquisition order cycle: namespace"
+                    f' "{b}" acquired while holding "{a}", but a path'
+                    f' elsewhere acquires "{a}" while holding "{b}" —'
+                    " pick one global order",
+                    rel=module.rel,
+                    line=line,
+                    symbol=symbol,
+                    key=f"{a}->{b}",
+                )

@@ -1,0 +1,220 @@
+"""MET01: every Prometheus emission must match the declared registry.
+
+The registry is `dstack_tpu/server/metrics_registry.py` (`METRICS`,
+parsed statically from the analyzed tree — the checker never imports
+server code). Three rules:
+
+1. Registry hygiene: counters must end `_total` / `_sum` / `_count`;
+   gauges must not end `_total`.
+2. `tracer.inc("name", value, **labels)` sites: the derived series
+   `dstack_tpu_<name>_total` must be a declared counter, and the label
+   names (keyword args, or a local `labels = {...}` dict-literal passed
+   as `**labels`; `"a" if cond else "b"` names check both branches)
+   must equal the declared label set exactly.
+3. Any string literal containing a `dstack_tpu_*` metric name — the
+   hand-rolled exposition in server/routers/metrics.py, assertions in
+   chaos scenarios — must name a declared series. This is what turns
+   "one registry" from convention into an invariant: you cannot emit or
+   assert on a name the registry does not know.
+
+Fixture tests inject a registry dict directly; in normal runs it is
+discovered from the tree (no registry module found => rules 2/3 are
+skipped, so the checker stays quiet on foreign codebases).
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, attr_name, const_str
+from dstack_tpu.analysis.core import Checker, Finding, Module, Project
+
+REGISTRY_REL_SUFFIX = "server/metrics_registry.py"
+PREFIX = "dstack_tpu_"
+_NAME_RE = re.compile(r"dstack_tpu_[a-z0-9_]+")
+COUNTER_SUFFIXES = ("_total", "_sum", "_count")
+
+Registry = Dict[str, Tuple[str, Tuple[str, ...]]]
+
+
+def parse_registry(module: Module) -> Optional[Registry]:
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == "METRICS":
+                try:
+                    raw = ast.literal_eval(value)
+                except (ValueError, SyntaxError):
+                    return None
+                return {str(k): (str(v[0]), tuple(v[1])) for k, v in raw.items()}
+    return None
+
+
+def _counter_names(arg: ast.AST) -> List[str]:
+    """Constant counter name(s) at an inc() site; IfExp checks both arms."""
+    s = const_str(arg)
+    if s is not None:
+        return [s]
+    if isinstance(arg, ast.IfExp):
+        return _counter_names(arg.body) + _counter_names(arg.orelse)
+    return []
+
+
+def _dict_literal_keys(module: Module, func: ast.AST, name: str) -> Optional[Set[str]]:
+    """Keys of `name = {...}` (const keys) assigned inside `func`."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    keys = [const_str(k) for k in node.value.keys]
+                    if all(k is not None for k in keys):
+                        return set(keys)  # type: ignore[arg-type]
+                    return None
+    return None
+
+
+class MetricsRegistryChecker(Checker):
+    codes = ("MET01",)
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._injected = registry
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        registry = self._injected
+        registry_module: Optional[Module] = None
+        for module in project.modules:
+            if module.rel.endswith(REGISTRY_REL_SUFFIX):
+                registry_module = module
+                if registry is None:
+                    registry = parse_registry(module)
+                break
+
+        if registry_module is not None and registry is not None:
+            findings.extend(self._check_hygiene(registry_module, registry))
+        if registry is None:
+            return findings
+
+        for module in project.modules:
+            if module is registry_module:
+                continue
+            findings.extend(self._check_inc_sites(module, registry))
+            findings.extend(self._check_literals(module, registry))
+        return findings
+
+    def _check_hygiene(self, module: Module, registry: Registry) -> Iterable[Finding]:
+        for name, (mtype, _labels) in registry.items():
+            if mtype == "counter" and not name.endswith(COUNTER_SUFFIXES):
+                yield Finding(
+                    code="MET01",
+                    message=f"counter `{name}` must end in"
+                    " _total/_sum/_count (Prometheus naming)",
+                    rel=module.rel,
+                    line=1,
+                    key=f"suffix:{name}",
+                )
+            elif mtype == "gauge" and name.endswith("_total"):
+                yield Finding(
+                    code="MET01",
+                    message=f"gauge `{name}` must not end in _total"
+                    " (reads as a counter)",
+                    rel=module.rel,
+                    line=1,
+                    key=f"suffix:{name}",
+                )
+
+    def _check_inc_sites(self, module: Module, registry: Registry) -> Iterable[Finding]:
+        funcs = [n for n in ast.walk(module.tree) if isinstance(n, FUNC_NODES)]
+        for func in funcs:
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call) or attr_name(node) != "inc":
+                    continue
+                if not node.args:
+                    continue
+                names = _counter_names(node.args[0])
+                if not names:
+                    continue  # dynamic name; cannot check statically
+                labels = self._site_labels(module, func, node)
+                for cname in names:
+                    series = f"{PREFIX}{cname}_total"
+                    decl = registry.get(series)
+                    if decl is None:
+                        yield Finding(
+                            code="MET01",
+                            message=f"tracer counter `{cname}` emits"
+                            f" undeclared series `{series}` — add it to"
+                            " server/metrics_registry.py or rename",
+                            rel=module.rel,
+                            line=node.lineno,
+                            key=f"undeclared:{series}",
+                        )
+                        continue
+                    mtype, decl_labels = decl
+                    if mtype != "counter":
+                        yield Finding(
+                            code="MET01",
+                            message=f"`{series}` is declared {mtype} but"
+                            " emitted via tracer.inc (a counter)",
+                            rel=module.rel,
+                            line=node.lineno,
+                            key=f"type:{series}",
+                        )
+                    if labels is not None and labels != set(decl_labels):
+                        yield Finding(
+                            code="MET01",
+                            message=f"label drift on `{series}`: emitted"
+                            f" {sorted(labels)} but registry declares"
+                            f" {sorted(decl_labels)}",
+                            rel=module.rel,
+                            line=node.lineno,
+                            key=f"labels:{series}",
+                        )
+
+    def _site_labels(
+        self, module: Module, func: ast.AST, call: ast.Call
+    ) -> Optional[Set[str]]:
+        labels: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg is not None:
+                labels.add(kw.arg)
+            elif isinstance(kw.value, ast.Name):
+                keys = _dict_literal_keys(module, func, kw.value.id)
+                if keys is None:
+                    return None  # unresolvable **expansion
+                labels |= keys
+            elif isinstance(kw.value, ast.Dict):
+                keys = [const_str(k) for k in kw.value.keys]
+                if not all(k is not None for k in keys):
+                    return None
+                labels |= set(keys)  # type: ignore[arg-type]
+            else:
+                return None
+        return labels
+
+    def _check_literals(self, module: Module, registry: Registry) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+                continue
+            for match in _NAME_RE.finditer(node.value):
+                name = match.group(0)
+                # Trim label-suffix junk is unnecessary (regex stops at
+                # `{`); but a literal may legitimately be a prefix of a
+                # registered name only if it IS a registered name.
+                if name not in registry:
+                    yield Finding(
+                        code="MET01",
+                        message=f"string literal references undeclared"
+                        f" metric `{name}` — not in"
+                        " server/metrics_registry.py",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"literal:{name}",
+                    )
+        return

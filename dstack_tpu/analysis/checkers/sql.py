@@ -1,0 +1,130 @@
+"""SQL01: interpolation into SQL sinks + static dialect lint.
+
+Two hazards share the code because they share the sink set
+(`execute` / `executemany` / `executescript` / `fetchone` / `fetchall`):
+
+1. String interpolation (f-string, `%`, `.format`, `+`) into the SQL
+   argument. The only blessed interpolation is placeholder expansion —
+   a `placeholders(n)` call (server/background/concurrency.py) or a
+   local variable assigned from `placeholders(...)` / `",".join(...)`.
+   Everything else is an injection hazard and must become a `?` bind.
+
+2. sqlite-only dialect in the constant SQL text, linted against the
+   same `SQLITE_ISMS` corpus the runtime audit uses
+   (dstack_tpu/analysis/sqlrules.py) — the static pass catches
+   statements the audit's traced workload never executes.
+
+Engine adapters (`server/db.py`, `server/pgwire.py`) are dialect-
+specific by design and carry a file-level allow pragma rather than an
+exemption hard-coded here.
+"""
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from dstack_tpu.analysis.astutil import INTERP, attr_name, call_name, string_text
+from dstack_tpu.analysis.core import Checker, Finding, Module
+from dstack_tpu.analysis.sqlrules import dialect_findings
+
+SQL_SINKS: Set[str] = {
+    "execute",
+    "executemany",
+    "executescript",
+    "fetchone",
+    "fetchall",
+}
+
+
+def _safe_names(module: Module) -> Set[str]:
+    """Local names assigned from placeholder-expansion expressions."""
+    safe: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _safe_value(node.value, safe):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        safe.add(target.id)
+    return safe
+
+
+def _safe_value(node: ast.AST, safe: Set[str]) -> bool:
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name and name.split(".")[-1] == "placeholders":
+            return True
+        if attr_name(node) == "join":
+            return True
+    if isinstance(node, ast.Name):
+        return node.id in safe
+    return False
+
+
+def _unsafe_parts(sql_arg: ast.AST, safe: Set[str]) -> List[str]:
+    """Describe each interpolated segment that is NOT blessed placeholder
+    expansion. Empty list == the interpolation is safe (or absent)."""
+    if isinstance(sql_arg, ast.JoinedStr):
+        out = []
+        for part in sql_arg.values:
+            if isinstance(part, ast.FormattedValue):
+                if not _safe_value(part.value, safe):
+                    desc = ast.unparse(part.value) if hasattr(ast, "unparse") else "?"
+                    out.append(desc)
+        return out
+    if isinstance(sql_arg, ast.BinOp) and isinstance(sql_arg.op, ast.Add):
+        return _unsafe_parts(sql_arg.left, safe) + _unsafe_parts(sql_arg.right, safe)
+    if isinstance(sql_arg, ast.Constant):
+        return []
+    # %-format, .format(), or anything else string_text marked
+    # interpolated: no blessed idiom uses these.
+    _, interpolated = string_text(sql_arg)
+    if interpolated:
+        return ["<dynamic>"]
+    return []
+
+
+class SqlChecker(Checker):
+    codes = ("SQL01",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        safe = _safe_names(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if attr_name(node) not in SQL_SINKS:
+                continue
+            sql_arg = node.args[0]
+            text, interpolated = string_text(sql_arg)
+            if text is None:
+                continue  # dynamic expression; nothing lintable
+            sink = attr_name(node)
+            if interpolated:
+                unsafe = _unsafe_parts(sql_arg, safe)
+                if unsafe:
+                    detail = ", ".join(unsafe[:3])
+                    findings.append(
+                        Finding(
+                            code="SQL01",
+                            message=f"string interpolation into `{sink}()`"
+                            f" ({detail}) — use `?` binds; only"
+                            " placeholders()-style expansion is allowed",
+                            rel=module.rel,
+                            line=sql_arg.lineno,
+                            col=sql_arg.col_offset,
+                            key=f"interp:{sink}",
+                        )
+                    )
+            for ism in dialect_findings(text.replace(INTERP, "")):
+                findings.append(
+                    Finding(
+                        code="SQL01",
+                        message=f"sqlite-only dialect in SQL literal:"
+                        f" {ism} — breaks on the PostgreSQL adapter"
+                        " (shared corpus: dstack_tpu/analysis/sqlrules.py)",
+                        rel=module.rel,
+                        line=sql_arg.lineno,
+                        col=sql_arg.col_offset,
+                        key=f"dialect:{ism}",
+                    )
+                )
+        return findings

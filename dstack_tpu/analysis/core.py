@@ -1,0 +1,213 @@
+"""Framework core: findings, per-module context, checker API, driver.
+
+A checker sees one `Module` at a time via `check()` and the whole
+`Project` once via `finalize()` (for cross-module passes like LCK01's
+call-graph claim propagation). Findings carry a line-number-free
+fingerprint so the baseline survives unrelated edits above a finding.
+
+Suppression pragmas (narrowest wins, all are per-code):
+
+    x = f(...)  # analysis: allow(ASY01)        on the finding line
+    # analysis: allow(ASY01, SQL01)             on the line above
+    # analysis: allow-file(SQL01)               anywhere in the file
+"""
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([A-Z0-9, ]+)\)")
+_ALLOW_FILE_RE = re.compile(r"#\s*analysis:\s*allow-file\(([A-Z0-9, ]+)\)")
+
+
+@dataclass
+class Finding:
+    code: str  # e.g. "ASY01"
+    message: str
+    rel: str  # repo-relative posix path
+    line: int
+    col: int = 0
+    symbol: str = ""  # enclosing function qualname ("" at module level)
+    key: str = ""  # stable detail key (e.g. the offending callee name)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}::{self.rel}::{self.symbol}::{self.key}"
+
+    def render(self) -> str:
+        where = f"{self.rel}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.code}{sym} {self.message}"
+
+
+class Module:
+    """One parsed source file plus the per-line suppression state."""
+
+    def __init__(self, path: str, rel: str, source: str, tree: ast.Module):
+        from dstack_tpu.analysis.astutil import ImportAliases
+
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.aliases = ImportAliases(tree)
+        self.allow_file: Set[str] = set()
+        self.allow_lines: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _ALLOW_FILE_RE.search(text)
+            if m:
+                self.allow_file |= {c.strip() for c in m.group(1).split(",")}
+            m = _ALLOW_RE.search(text)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",")}
+                # Applies to its own line and the one below (comment-above
+                # style).
+                self.allow_lines.setdefault(i, set()).update(codes)
+                self.allow_lines.setdefault(i + 1, set()).update(codes)
+
+    def suppressed(self, code: str, line: int) -> bool:
+        return code in self.allow_file or code in self.allow_lines.get(line, set())
+
+
+class Project:
+    def __init__(self, root: str, modules: List[Module]):
+        self.root = root
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+
+
+class Checker:
+    """Base class. `codes` lists every code the checker can emit (used for
+    stale-baseline detection and --json reporting)."""
+
+    codes: Tuple[str, ...] = ()
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)  # actionable
+    baselined: List[Finding] = field(default_factory=list)  # suppressed by baseline
+    stale_baseline: List[str] = field(default_factory=list)  # fingerprints
+    errors: List[str] = field(default_factory=list)  # unparseable files
+    checker_codes: List[str] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.errors) else 0
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in ("__pycache__", ".git")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def load_project(paths: Sequence[str], root: Optional[str] = None) -> Tuple[Project, List[str]]:
+    root = os.path.abspath(root or os.getcwd())
+    modules: List[Module] = []
+    errors: List[str] = []
+    for path in _iter_py_files(paths):
+        apath = os.path.abspath(path)
+        rel = os.path.relpath(apath, root).replace(os.sep, "/")
+        try:
+            with open(apath, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: unparseable: {e}")
+            continue
+        modules.append(Module(apath, rel, source, tree))
+    return Project(root, modules), errors
+
+
+def default_checkers() -> List[Checker]:
+    from dstack_tpu.analysis.checkers.async_hygiene import AsyncHygieneChecker
+    from dstack_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
+    from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
+    from dstack_tpu.analysis.checkers.sql import SqlChecker
+
+    return [
+        AsyncHygieneChecker(),
+        LockDisciplineChecker(),
+        SqlChecker(),
+        MetricsRegistryChecker(),
+    ]
+
+
+def run_analysis(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    checkers: Optional[List[Checker]] = None,
+    baseline_fingerprints: Optional[Set[str]] = None,
+) -> Report:
+    checkers = checkers if checkers is not None else default_checkers()
+    project, errors = load_project(paths, root)
+    report = Report(errors=errors, files_scanned=len(project.modules))
+    report.checker_codes = sorted({c for ch in checkers for c in ch.codes})
+
+    raw: List[Finding] = []
+    for checker in checkers:
+        for module in project.modules:
+            raw.extend(checker.check(module))
+        raw.extend(checker.finalize(project))
+
+    # Pragma suppression (needs the owning module for line-level pragmas).
+    visible: List[Finding] = []
+    for f in raw:
+        mod = project.by_rel.get(f.rel)
+        if mod is not None and mod.suppressed(f.code, f.line):
+            continue
+        visible.append(f)
+    visible.sort(key=lambda f: (f.rel, f.line, f.code, f.key))
+
+    baseline = baseline_fingerprints or set()
+    seen_fps: Set[str] = set()
+    for f in visible:
+        seen_fps.add(f.fingerprint)
+        if f.fingerprint in baseline:
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+
+    # A baseline entry whose finding no longer fires is stale: the defect
+    # was fixed, so the grandfather clause must be retired with it (BASE01).
+    for fp in sorted(baseline - seen_fps):
+        report.stale_baseline.append(fp)
+        report.findings.append(
+            Finding(
+                code="BASE01",
+                message=f"stale baseline entry (finding no longer fires): {fp}",
+                rel=fp.split("::", 2)[1] if fp.count("::") >= 2 else "<baseline>",
+                line=0,
+                key=fp,
+            )
+        )
+    return report
+
+
+def main_self_check() -> int:  # pragma: no cover - convenience hook
+    report = run_analysis([os.path.dirname(__file__)])
+    for f in report.findings:
+        print(f.render(), file=sys.stderr)
+    return report.exit_code

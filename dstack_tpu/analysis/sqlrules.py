@@ -1,0 +1,62 @@
+"""Shared SQL dialect rules: one corpus for the runtime audit and SQL01.
+
+The runtime dialect audit (tests/server/test_pg_dialect_audit.py) traces
+every statement a live server executes and lints the corpus; the static
+SQL01 checker lints the SQL string literals at execute()/fetch*() call
+sites. Both consume THIS module, so the two passes cannot drift: a
+pattern added here tightens the runtime gate and the static gate in the
+same commit.
+
+Patterns parse on sqlite but error (or silently differ) on PostgreSQL.
+"""
+
+import re
+from typing import Iterable, List, Pattern, Tuple
+
+# Each entry: (name, compiled regex). Matched against SQL with string
+# literals stripped (lint code, not quoted data).
+SQLITE_ISMS: List[Tuple[str, Pattern]] = [
+    ("INSERT OR REPLACE/IGNORE/ABORT", re.compile(r"\bINSERT\s+OR\s+\w+", re.I)),
+    ("REPLACE INTO", re.compile(r"\bREPLACE\s+INTO\b", re.I)),
+    ("AUTOINCREMENT", re.compile(r"\bAUTOINCREMENT\b", re.I)),
+    ("GLOB operator", re.compile(r"\bGLOB\b", re.I)),
+    ("datetime()", re.compile(r"\bdatetime\s*\(", re.I)),
+    ("strftime()", re.compile(r"\bstrftime\s*\(", re.I)),
+    ("julianday()", re.compile(r"\bjulianday\s*\(", re.I)),
+    ("ifnull()", re.compile(r"\bifnull\s*\(", re.I)),
+    ("group_concat()", re.compile(r"\bgroup_concat\s*\(", re.I)),
+    ("hex()", re.compile(r"\bhex\s*\(", re.I)),
+    ("randomblob()", re.compile(r"\brandomblob\s*\(", re.I)),
+    ("last_insert_rowid()", re.compile(r"\blast_insert_rowid\b", re.I)),
+    # Service code must never issue PRAGMAs — those are engine-internal
+    # (and meaningless on Postgres). The engine adapters themselves
+    # (server/db.py, server/pgwire.py) are dialect-specific by design and
+    # carry a file-level `analysis: allow-file(SQL01)` pragma.
+    ("PRAGMA", re.compile(r"\bPRAGMA\b", re.I)),
+]
+
+# Transaction framing the sqlite3 module emits on its own; the Postgres
+# engine provides its own framing (run_sync begin/commit).
+FRAMING = re.compile(r"^\s*(BEGIN|COMMIT|ROLLBACK|SAVEPOINT|RELEASE)\b", re.I)
+
+
+def strip_literals(sql: str) -> str:
+    """Lint code, not quoted data (a log line containing 'PRAGMA' is
+    fine)."""
+    return re.sub(r"'(?:[^']|'')*'", "''", sql)
+
+
+def dialect_findings(sql: str) -> List[str]:
+    """Names of every sqlite-ism present in one statement."""
+    code = strip_literals(sql)
+    return [name for name, pat in SQLITE_ISMS if pat.search(code)]
+
+
+def lint(corpus: Iterable[str]) -> List[Tuple[str, str]]:
+    """(ism-name, truncated statement) for every hit in a statement
+    corpus — the runtime audit's interface."""
+    findings = []
+    for sql in corpus:
+        for name in dialect_findings(sql):
+            findings.append((name, sql.strip()[:120]))
+    return findings

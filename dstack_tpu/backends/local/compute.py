@@ -187,7 +187,8 @@ class LocalCompute(Compute):
                     # pid makes the watchdog race-free even if the parent
                     # dies during interpreter startup.
                     argv += ["--parent-pid", str(os.getpid())]
-            proc = subprocess.Popen(
+            proc = await asyncio.to_thread(
+                subprocess.Popen,
                 argv,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
@@ -283,7 +284,7 @@ class LocalCompute(Compute):
         while True:
             if port is None:
                 try:
-                    port = int(Path(port_file).read_text())
+                    port = int(await asyncio.to_thread(Path(port_file).read_text))
                     Path(port_file).unlink(missing_ok=True)
                 except (OSError, ValueError):
                     port = None

@@ -215,7 +215,7 @@ async def _hard_preempt(report, seed, tmp: Path) -> None:
         _expect(report, resp.status == 200, f"submit failed: {resp.body!r}")
         for _ in range(300):  # release the retried gang once the crash fired
             if engine.injected:
-                crash_done.write_text("crashed")
+                await asyncio.to_thread(crash_done.write_text, "crashed")
                 break
             await asyncio.sleep(0.2)
         _expect(report, engine.injected != [], "crash event never fired")
@@ -293,7 +293,7 @@ async def _preempt_resume(report, seed, tmp: Path) -> None:
 
     settings.RETRY_PENDING_RUN_DELAY = 0
     script = tmp / "train.py"
-    script.write_text(_DRAIN_TRAIN)
+    await asyncio.to_thread(script.write_text, _DRAIN_TRAIN)
     mount = tmp / "mnt" / "ckpt"
     engine = chaos.install(
         ChaosEngine(
@@ -363,7 +363,7 @@ async def _preempt_resume(report, seed, tmp: Path) -> None:
         final_path = mount / "final"
         resumed = -1
         if final_path.exists():
-            final = final_path.read_text()
+            final = await asyncio.to_thread(final_path.read_text)
             resumed = int(final.split("resumed_from=")[1].split()[0])
             report["details"]["final"] = final.strip()
         _expect(

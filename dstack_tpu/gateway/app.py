@@ -21,10 +21,19 @@ from dstack_tpu.gateway.certs import AcmeSettings, CertError, CertManager, local
 from dstack_tpu.gateway.connections import ReplicaInfo, ServiceConnectionPool
 from dstack_tpu.gateway.nginx import NginxManager, SiteConfig, Upstream
 from dstack_tpu.server.http import App, Request, Response, Router, Server
+from dstack_tpu.utils.tasks import spawn_logged
 
 logger = logging.getLogger(__name__)
 
 ACCESS_LOG = Path("/var/log/nginx/dstack.access.log")
+
+
+def _read_access_log(offset: int):
+    """Lines appended since `offset` and the new offset (thread-offloaded:
+    the access log can be large and the stats endpoint runs on the loop)."""
+    with ACCESS_LOG.open() as f:
+        f.seek(offset)
+        return f.readlines(), f.tell()
 
 
 class Registry:
@@ -83,7 +92,7 @@ class Registry:
         """Rebuild services, tunnels and nginx configs from the state file."""
         if self.state_path is None or not self.state_path.exists():
             return
-        state = json.loads(self.state_path.read_text())
+        state = json.loads(await asyncio.to_thread(self.state_path.read_text))
         self._restoring = True
         try:
             for svc in state.get("services", []):
@@ -185,8 +194,11 @@ class Registry:
         existing = self._cert_tasks.get(key)
         if existing is not None and not existing.done():
             return
-        self._cert_tasks[key] = asyncio.get_event_loop().create_task(
-            self._issue_and_flip(key, domain)
+        # spawn_logged retains the handle and logs non-CertError failures
+        # (_issue_and_flip only handles CertError itself; an nginx reload
+        # error must not vanish into an unobserved task).
+        self._cert_tasks[key] = spawn_logged(
+            self._issue_and_flip(key, domain), f"cert issuance {domain}"
         )
 
     async def _issue_and_flip(self, key: str, domain: str) -> None:
@@ -413,10 +425,9 @@ def create_gateway_app(registry: Optional[Registry] = None) -> App:
             # would seek past EOF and zero the stats forever.
             if ACCESS_LOG.stat().st_size < app.state["stats_offset"]:
                 app.state["stats_offset"] = 0
-            with ACCESS_LOG.open() as f:
-                f.seek(app.state["stats_offset"])
-                lines = f.readlines()
-                app.state["stats_offset"] = f.tell()
+            lines, app.state["stats_offset"] = await asyncio.to_thread(
+                _read_access_log, app.state["stats_offset"]
+            )
         domains = {
             info["domain"]: key for key, info in reg.services.items()
         }

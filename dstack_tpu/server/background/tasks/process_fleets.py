@@ -44,10 +44,18 @@ async def process_fleets(ctx: ServerContext) -> None:
                 (row["id"],),
             ):
                 if i["status"] not in ("terminated", "terminating"):
-                    await ctx.db.execute(
-                        "UPDATE instances SET status = 'terminating' WHERE id = ?",
-                        (i["id"],),
-                    )
+                    # The instance FSM owns status transitions; claim the row
+                    # so a concurrent process_instances step can't race this
+                    # write. A failed claim just defers to the next tick.
+                    if not await ctx.claims.try_claim("instances", i["id"]):
+                        continue
+                    try:
+                        await ctx.db.execute(
+                            "UPDATE instances SET status = 'terminating' WHERE id = ?",
+                            (i["id"],),
+                        )
+                    finally:
+                        await ctx.claims.release("instances", i["id"])
                     ctx.kick("instances")
             if not active:
                 await ctx.db.execute(

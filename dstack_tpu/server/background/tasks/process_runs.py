@@ -321,12 +321,15 @@ def _account_resilience(
     resilience["clean_drains"] = resilience.get("clean_drains", 0) + clean_drains
     resilience["restarts"] = resilience.get("restarts", 0) + 1
     resilience.setdefault("steps_lost", 0)
+    # Event-stream counters are labeled only by run — distinct names from
+    # the DB-sourced {project,run} series (dstack_tpu_run_preemptions_total
+    # etc.), which a shared name would corrupt with mixed label sets.
     labels = {"run": row["run_name"]}
     if preemptions:
-        ctx.tracer.inc("run_preemptions", preemptions, **labels)
+        ctx.tracer.inc("run_preemption_events", preemptions, **labels)
     if clean_drains:
-        ctx.tracer.inc("run_clean_drains", clean_drains, **labels)
-    ctx.tracer.inc("run_restarts", 1, **labels)
+        ctx.tracer.inc("run_clean_drain_events", clean_drains, **labels)
+    ctx.tracer.inc("run_restart_events", 1, **labels)
 
 
 def _pending_run_delay(run_id: str, base: float, attempt: int) -> float:

@@ -1,0 +1,59 @@
+"""Single declared registry of every Prometheus series the server emits.
+
+`/metrics` (server/routers/metrics.py) derives its `# TYPE` lines from
+this table, and the MET01 static checker verifies every emission site
+against it: tracer counters (`tracer.inc("name", **labels)` becomes
+`dstack_tpu_<name>_total`), hand-emitted gauges, and literal metric
+names anywhere in the tree must appear here with exactly the declared
+label set. Because it is one dict, a duplicate name with two differing
+label sets — the bug class that motivated MET01: the run resilience
+counters and the tracer event counters once shared
+`dstack_tpu_run_preemptions_total` with different labels — cannot be
+expressed at all.
+
+Keep entries sorted; the checker also enforces counter suffix naming
+(`_total` / `_sum` / `_count`).
+"""
+
+from typing import Dict, Tuple
+
+PREFIX = "dstack_tpu_"
+
+# name -> (type, label names). Label order here is documentation; the
+# exposition sorts labels alphabetically.
+METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    # Per-run resilience totals, sourced from the runs.resilience JSON
+    # column (survive server restarts).
+    "dstack_tpu_run_clean_drains_total": ("counter", ("project", "run")),
+    "dstack_tpu_run_preemptions_total": ("counter", ("project", "run")),
+    "dstack_tpu_run_restarts_total": ("counter", ("project", "run")),
+    "dstack_tpu_run_steps_lost_total": ("counter", ("project", "run")),
+    # In-process tracer event counters (reset on restart). Deliberately
+    # named *_events_total so they can never collide with the DB-sourced
+    # totals above.
+    "dstack_tpu_run_clean_drain_events_total": ("counter", ("run",)),
+    "dstack_tpu_run_preemption_events_total": ("counter", ("run",)),
+    "dstack_tpu_run_restart_events_total": ("counter", ("run",)),
+    # Background FSM tick accounting.
+    "dstack_tpu_tick_rows_scanned_total": ("counter", ("processor",)),
+    "dstack_tpu_tick_rows_stepped_total": ("counter", ("processor",)),
+    # Spec cache (PR 3).
+    "dstack_tpu_spec_cache_entries": ("gauge", ()),
+    "dstack_tpu_spec_cache_hit_rate": ("gauge", ()),
+    "dstack_tpu_spec_cache_hits_total": ("counter", ("model",)),
+    "dstack_tpu_spec_cache_misses_total": ("counter", ("model",)),
+    # Span latency aggregates.
+    "dstack_tpu_span_count_total": ("counter", ("span",)),
+    "dstack_tpu_span_seconds_sum": ("counter", ("span",)),
+}
+
+
+def counter_name(tracer_counter: str) -> str:
+    """Prometheus name a `tracer.inc(name, ...)` counter is exposed as."""
+    return f"{PREFIX}{tracer_counter}_total"
+
+
+def metric_type(name: str) -> str:
+    """Declared exposition type; raises KeyError for undeclared names so
+    emission-time drift fails loudly in tests."""
+    return METRICS[name][0]

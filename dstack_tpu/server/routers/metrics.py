@@ -7,6 +7,7 @@ from typing import Optional
 from dstack_tpu.errors import ResourceNotExistsError
 from dstack_tpu.models.metrics import JobMetrics, MetricsPoint, TpuChipMetrics
 from dstack_tpu.server.http import Request, Response, Router
+from dstack_tpu.server.metrics_registry import counter_name, metric_type
 from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
 from dstack_tpu.utils.common import parse_dt
 
@@ -17,11 +18,26 @@ def _prom_escape(v) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def _prom_line(name: str, labels: dict, value) -> str:
-    if labels:
-        body = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items()))
-        return f"{name}{{{body}}} {value}"
-    return f"{name} {value}"
+class _Exposition:
+    """Accumulates exposition lines; `# TYPE` comes from the declared
+    registry (metrics_registry.METRICS), once per series. An undeclared
+    name raises KeyError — the same contract MET01 enforces statically."""
+
+    def __init__(self):
+        self.lines = []
+        self._typed = set()
+
+    def add(self, name: str, labels: dict, value) -> None:
+        if name not in self._typed:
+            self.lines.append(f"# TYPE {name} {metric_type(name)}")
+            self._typed.add(name)
+        if labels:
+            body = ",".join(
+                f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+            )
+            self.lines.append(f"{name}{{{body}}} {value}")
+        else:
+            self.lines.append(f"{name} {value}")
 
 
 @router.get("/metrics")
@@ -30,45 +46,33 @@ async def prometheus_metrics(request: Request):
     restarts, clean drains, steps lost), tracer counters, and span stats.
     Unauthenticated, like a typical scrape target."""
     ctx = get_ctx(request)
-    lines = []
+    exp = _Exposition()
     rows = await ctx.db.fetchall(
         "SELECT r.run_name, r.resilience, p.name AS project FROM runs r"
         " JOIN projects p ON p.id = r.project_id"
         " WHERE r.deleted = 0 AND r.resilience IS NOT NULL"
     )
-    gauges = {
+    resilience_series = {
         "preemptions": "dstack_tpu_run_preemptions_total",
         "restarts": "dstack_tpu_run_restarts_total",
         "clean_drains": "dstack_tpu_run_clean_drains_total",
         "steps_lost": "dstack_tpu_run_steps_lost_total",
     }
-    emitted = set()
     for r in rows:
         res = json.loads(r["resilience"])
         labels = {"project": r["project"], "run": r["run_name"]}
-        for key, metric in gauges.items():
-            if metric not in emitted:
-                lines.append(f"# TYPE {metric} counter")
-                emitted.add(metric)
-            lines.append(_prom_line(metric, labels, res.get(key, 0)))
+        for key, metric in resilience_series.items():
+            exp.add(metric, labels, res.get(key, 0))
     for c in ctx.tracer.counter_snapshot():
-        metric = f"dstack_tpu_{c['name']}_total"
-        if metric not in emitted:
-            lines.append(f"# TYPE {metric} counter")
-            emitted.add(metric)
-        lines.append(_prom_line(metric, c["labels"], c["value"]))
+        exp.add(counter_name(c["name"]), c["labels"], c["value"])
     cache = ctx.spec_cache.stats()
-    lines.append("# TYPE dstack_tpu_spec_cache_entries gauge")
-    lines.append(_prom_line("dstack_tpu_spec_cache_entries", {}, cache["size"]))
-    lines.append("# TYPE dstack_tpu_spec_cache_hit_rate gauge")
-    lines.append(_prom_line("dstack_tpu_spec_cache_hit_rate", {}, cache["hit_rate"]))
-    lines.append("# TYPE dstack_tpu_span_count_total counter")
-    lines.append("# TYPE dstack_tpu_span_seconds_sum counter")
+    exp.add("dstack_tpu_spec_cache_entries", {}, cache["size"])
+    exp.add("dstack_tpu_spec_cache_hit_rate", {}, cache["hit_rate"])
     for name, st in ctx.tracer.snapshot()["stats"].items():
         labels = {"span": name}
-        lines.append(_prom_line("dstack_tpu_span_count_total", labels, st["count"]))
-        lines.append(_prom_line("dstack_tpu_span_seconds_sum", labels, st["total_s"]))
-    return Response("\n".join(lines) + "\n", media_type="text/plain; version=0.0.4")
+        exp.add("dstack_tpu_span_count_total", labels, st["count"])
+        exp.add("dstack_tpu_span_seconds_sum", labels, st["total_s"])
+    return Response("\n".join(exp.lines) + "\n", media_type="text/plain; version=0.0.4")
 
 
 @router.get("/api/project/{project_name}/metrics/job/{run_name}")

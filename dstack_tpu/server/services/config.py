@@ -19,6 +19,7 @@ Format:
             regions: [us-central2]
 """
 
+import asyncio
 import logging
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -122,7 +123,9 @@ class ServerConfigManager:
         # Atomic replace: this file may hold the only copy of the encryption
         # key — a crash mid-write must never truncate it.
         tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(yaml.safe_dump(self.config, sort_keys=False))
+        await asyncio.to_thread(
+            tmp.write_text, yaml.safe_dump(self.config, sort_keys=False)
+        )
         tmp.rename(self.path)
 
 

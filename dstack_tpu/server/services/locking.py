@@ -11,6 +11,8 @@ import time
 from contextlib import asynccontextmanager
 from typing import AsyncIterator, Dict, Iterable, List, Optional, Set, Tuple
 
+from dstack_tpu.utils.tasks import spawn_logged
+
 
 class ResourceLocker:
     def __init__(self):
@@ -49,8 +51,10 @@ class ResourceLocker:
 
     def unlock_nowait(self, namespace: str, key: str) -> None:
         self._namespaces.get(namespace, set()).discard(key)
-        # Waiters in lock_ctx need a wakeup; schedule it.
-        asyncio.get_event_loop().create_task(self._notify())
+        # Waiters in lock_ctx need a wakeup; schedule it. The handle must
+        # be retained or the wakeup task can be GC'd before it runs and
+        # lock_ctx waiters stall until the next unrelated notify.
+        spawn_logged(self._notify(), "locker notify")
 
     async def _notify(self) -> None:
         async with self._cond:

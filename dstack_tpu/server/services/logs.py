@@ -7,6 +7,7 @@ the reference's on-disk layout.
 """
 
 import abc
+import asyncio
 import base64
 import json
 import os
@@ -128,6 +129,25 @@ class FileLogStorage(LogStorage):
             / f"{job_submission_id}.{source}.jsonl"
         )
 
+    @staticmethod
+    def _append(path: Path, payload: str) -> None:
+        with open(path, "a") as f:
+            f.write(payload)
+
+    @staticmethod
+    def _read_window(path: Path, start_line: int, limit: int):
+        raw: List[dict] = []
+        consumed = start_line
+        with open(path) as f:
+            for i, line in enumerate(f):
+                if i < start_line:
+                    continue
+                if len(raw) >= limit:
+                    break
+                raw.append(json.loads(line))
+                consumed = i + 1
+        return raw, consumed
+
     async def write(
         self, project_id, run_name, job_submission_id, job_logs, runner_logs
     ) -> None:
@@ -136,9 +156,12 @@ class FileLogStorage(LogStorage):
                 continue
             path = self._path(project_id, run_name, job_submission_id, source)
             path.parent.mkdir(parents=True, exist_ok=True)
-            with open(path, "a") as f:
-                for e in events:
-                    f.write(json.dumps({"ts": e.timestamp, "b64": e.message}) + "\n")
+            payload = "".join(
+                json.dumps({"ts": e.timestamp, "b64": e.message}) + "\n"
+                for e in events
+            )
+            # File IO off the loop: log pushes land on the hot request path.
+            await asyncio.to_thread(self._append, path, payload)
 
     async def poll(
         self, project_id, run_name, job_submission_id, start_after=None, limit=1000,
@@ -148,24 +171,18 @@ class FileLogStorage(LogStorage):
         path = self._path(project_id, run_name, job_submission_id, source)
         if not path.exists():
             return JobSubmissionLogs(logs=[])
-        events: List[LogEvent] = []
         start_line = int(start_after) if start_after else 0
-        consumed = start_line
-        with open(path) as f:
-            for i, line in enumerate(f):
-                if i < start_line:
-                    continue
-                if len(events) >= limit:
-                    break
-                data = json.loads(line)
-                events.append(
-                    LogEvent(
-                        timestamp=_event_ts(data["ts"]),
-                        log_source=LogProducer.RUNNER if diagnose else LogProducer.JOB,
-                        message=data["b64"],
-                    )
-                )
-                consumed = i + 1
+        raw, consumed = await asyncio.to_thread(
+            self._read_window, path, start_line, limit
+        )
+        events = [
+            LogEvent(
+                timestamp=_event_ts(data["ts"]),
+                log_source=LogProducer.RUNNER if diagnose else LogProducer.JOB,
+                message=data["b64"],
+            )
+            for data in raw
+        ]
         # Always a resumable cursor (line number) so follow-mode clients can
         # poll for lines appended later.
         return JobSubmissionLogs(logs=events, next_token=str(consumed) if consumed else "")

@@ -254,10 +254,21 @@ async def submit_run(
                     f"Run {run_spec.run_name} already exists and is active"
                 )
             # Finished run with the same name: soft-delete it (reference
-            # allows resubmission under the same name).
-            await ctx.db.execute(
-                "UPDATE runs SET deleted = 1 WHERE id = ?", (existing["id"],)
-            )
+            # allows resubmission under the same name). The run FSM owns
+            # this row — take its lock and re-check the status under it,
+            # or a concurrent retry transition could resurrect the run.
+            async with ctx.locker.lock_ctx("runs", [existing["id"]]):
+                current = await ctx.db.fetchone(
+                    "SELECT status FROM runs WHERE id = ? AND deleted = 0",
+                    (existing["id"],),
+                )
+                if current is not None and not RunStatus(current["status"]).is_finished():
+                    raise ResourceExistsError(
+                        f"Run {run_spec.run_name} already exists and is active"
+                    )
+                await ctx.db.execute(
+                    "UPDATE runs SET deleted = 1 WHERE id = ?", (existing["id"],)
+                )
     run_id = generate_id()
     now = utcnow_iso()
     # Resolve the user-facing repo name to the internal repos.id so the
@@ -457,14 +468,22 @@ async def stop_runs(
         )
         if row is None:
             continue
-        status = RunStatus(row["status"])
-        if status.is_finished():
+        if RunStatus(row["status"]).is_finished():
             continue
-        await ctx.db.execute(
-            "UPDATE runs SET status = ?, termination_reason = ?, last_processed_at = ?"
-            " WHERE id = ?",
-            (RunStatus.TERMINATING.value, reason.value, utcnow_iso(), row["id"]),
-        )
+        # The FSM may be stepping this run right now; serialize with it
+        # and re-read the status so a run that just finished is not
+        # yanked back to terminating.
+        async with ctx.locker.lock_ctx("runs", [row["id"]]):
+            current = await ctx.db.fetchone(
+                "SELECT status FROM runs WHERE id = ? AND deleted = 0", (row["id"],)
+            )
+            if current is None or RunStatus(current["status"]).is_finished():
+                continue
+            await ctx.db.execute(
+                "UPDATE runs SET status = ?, termination_reason = ?, last_processed_at = ?"
+                " WHERE id = ?",
+                (RunStatus.TERMINATING.value, reason.value, utcnow_iso(), row["id"]),
+            )
     ctx.kick("runs")
 
 
@@ -478,4 +497,14 @@ async def delete_runs(ctx: ServerContext, project_id: str, run_names: List[str])
             raise ResourceNotExistsError(f"Run {run_name} does not exist")
         if not RunStatus(row["status"]).is_finished():
             raise ServerError(f"Run {run_name} is not finished")
-        await ctx.db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))
+        async with ctx.locker.lock_ctx("runs", [row["id"]]):
+            current = await ctx.db.fetchone(
+                "SELECT status FROM runs WHERE id = ? AND deleted = 0", (row["id"],)
+            )
+            if current is None:
+                continue  # already deleted concurrently — idempotent
+            if not RunStatus(current["status"]).is_finished():
+                raise ServerError(f"Run {run_name} is not finished")
+            await ctx.db.execute(
+                "UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],)
+            )

@@ -23,6 +23,13 @@ except ModuleNotFoundError:  # gated: the image may lack `cryptography`
 from dstack_tpu.errors import SSHError
 
 
+def _write_key_file(path: str, private_key: str) -> None:
+    """Private key to disk, 0600 (sync — callers on the loop offload it)."""
+    with open(path, "w") as f:
+        f.write(private_key)
+    os.chmod(path, 0o600)
+
+
 def generate_rsa_keypair() -> Tuple[str, str]:
     """(private_pem, public_openssh)."""
     if rsa is None:
@@ -130,9 +137,7 @@ class SSHTunnel:
         if self.target.private_key and not key_file:
             assert self._tmp is not None
             key_file = os.path.join(self._tmp.name, "id")
-            with open(key_file, "w") as f:
-                f.write(self.target.private_key)
-            os.chmod(key_file, 0o600)
+            _write_key_file(key_file, self.target.private_key)
         if key_file:
             cmd += ["-i", key_file]
         if self.target.proxy is not None:
@@ -147,9 +152,10 @@ class SSHTunnel:
 
     async def open(self, timeout: float = 20.0) -> None:
         self._tmp = tempfile.TemporaryDirectory()
-        cmd = self._build_cmd()
-        self._proc = subprocess.Popen(
-            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
+        # _build_cmd may write the private key to disk; keep it off the loop.
+        cmd = await asyncio.to_thread(self._build_cmd)
+        self._proc = await asyncio.to_thread(
+            subprocess.Popen, cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
         )
         # Wait until the first local forward (TCP port or unix socket)
         # accepts connections.
@@ -202,9 +208,7 @@ async def ssh_execute(target: SSHTarget, command: str, timeout: float = 60.0) ->
         key_file = target.identity_file
         if target.private_key and not key_file:
             key_file = os.path.join(tmp, "id")
-            with open(key_file, "w") as f:
-                f.write(target.private_key)
-            os.chmod(key_file, 0o600)
+            await asyncio.to_thread(_write_key_file, key_file, target.private_key)
         if key_file:
             cmd += ["-i", key_file]
         cmd += ["-p", str(target.port), f"{target.username}@{target.hostname}", command]

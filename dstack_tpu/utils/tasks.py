@@ -1,0 +1,41 @@
+"""Task-spawning helpers that never lose exceptions.
+
+A bare `asyncio.create_task(coro)` whose handle is dropped can be
+garbage-collected mid-flight, and any exception it raises is reported
+only at GC time (or never). `spawn_logged` retains the handle in a
+module-level registry until completion and logs failures through the
+standard logger — it is the blessed fire-and-forget primitive the ASY02
+checker accepts (alongside `ServerContext.spawn`, which ties task
+lifetime to server shutdown instead).
+"""
+
+import asyncio
+import logging
+from typing import Coroutine, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+# Strong refs until done — asyncio only keeps weak ones.
+_tasks: Set["asyncio.Task"] = set()
+
+
+def spawn_logged(
+    coro: Coroutine,
+    what: str,
+    log: Optional[logging.Logger] = None,
+) -> "asyncio.Task":
+    """Schedule `coro`, keep the task alive until it finishes, and log a
+    traceback if it fails. Cancellation is clean shutdown, not an error."""
+    task = asyncio.get_event_loop().create_task(coro)
+    _tasks.add(task)
+
+    def _done(t: "asyncio.Task") -> None:
+        _tasks.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            (log or logger).error("background task %r failed", what, exc_info=exc)
+
+    task.add_done_callback(_done)
+    return task

@@ -1,0 +1,21 @@
+"""Known-bad fixture: ASY01 (blocking calls on the loop) and ASY02
+(discarded task handle, un-awaited coroutine). Expected findings are
+asserted by tests/test_static_analysis.py — keep counts in sync."""
+
+import asyncio
+import time
+
+import requests
+
+
+async def notify():
+    await asyncio.sleep(0)
+
+
+async def handler(path):
+    time.sleep(1)  # ASY01: time.sleep
+    requests.get("http://example.com")  # ASY01: requests.get
+    data = path.read_text()  # ASY01: .read_text
+    asyncio.create_task(notify())  # ASY02: discarded handle
+    notify()  # ASY02: never awaited
+    return data

@@ -1,0 +1,27 @@
+"""Known-bad fixture: LCK01 (unguarded FSM-table write) and LCK02
+(opposing cross-namespace acquisition orders)."""
+
+
+async def rogue_update(ctx, run_id):
+    # LCK01: UPDATE runs with no claim held.
+    await ctx.db.execute(
+        "UPDATE runs SET status = 'failed' WHERE id = ?", (run_id,)
+    )
+
+
+async def terminate_run(ctx, run_id, job_id):
+    # Acquires "jobs" while holding "runs"...
+    async with ctx.locker.lock_ctx("runs", [run_id]):
+        if await ctx.claims.try_claim("jobs", job_id):
+            await ctx.db.execute(
+                "UPDATE jobs SET status = ? WHERE id = ?", ("stopped", job_id)
+            )
+
+
+async def reconcile_job(ctx, run_id, job_id):
+    # ...and here "runs" while holding "jobs": LCK02 cycle.
+    async with ctx.locker.lock_ctx("jobs", [job_id]):
+        if await ctx.claims.try_claim("runs", run_id):
+            await ctx.db.execute(
+                "UPDATE runs SET status = ? WHERE id = ?", ("pending", run_id)
+            )

@@ -1,0 +1,8 @@
+"""Known-bad registry fixture: one good series plus two hygiene
+violations (counter without _total suffix, gauge ending _total)."""
+
+METRICS = {
+    "dstack_tpu_widget_spins_total": ("counter", ("widget",)),
+    "dstack_tpu_bad_counter": ("counter", ()),
+    "dstack_tpu_bad_gauge_total": ("gauge", ()),
+}

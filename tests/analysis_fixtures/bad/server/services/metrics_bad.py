@@ -1,0 +1,9 @@
+"""Known-bad fixture: MET01 emission drift — undeclared counter, label
+drift against the declared set, and an undeclared literal name."""
+
+UNDECLARED = "dstack_tpu_never_declared_total"  # MET01: literal
+
+
+def account(tracer):
+    tracer.inc("mystery_widget", 1)  # MET01: undeclared series
+    tracer.inc("widget_spins", 1, run="r1")  # MET01: label drift (wants widget)

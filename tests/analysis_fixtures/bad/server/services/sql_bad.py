@@ -1,0 +1,12 @@
+"""Known-bad fixture: SQL01 interpolation into a sink and sqlite-only
+dialect in a constant statement."""
+
+
+async def lookup(db, name):
+    # SQL01: f-string interpolation of a non-placeholder value.
+    return await db.fetchone(f"SELECT * FROM projects WHERE name = '{name}'")
+
+
+async def upsert(db):
+    # SQL01: INSERT OR IGNORE is sqlite-only dialect.
+    await db.execute("INSERT OR IGNORE INTO settings (k, v) VALUES (?, ?)", ("a", 1))

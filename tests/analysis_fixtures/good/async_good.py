@@ -1,0 +1,28 @@
+"""Known-good fixture: async code using the blessed idioms, plus the
+sync poll-loop shape (CLI/SDK) that must NOT be flagged."""
+
+import asyncio
+import time
+
+from dstack_tpu.utils.tasks import spawn_logged
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+async def handler(path, loop):
+    await asyncio.sleep(0.1)
+    data = await asyncio.to_thread(path.read_text)
+    spawn_logged(work(), "background work")
+    task = asyncio.create_task(work())
+    await task
+    # Executor callbacks run off the loop; blocking inside them is fine.
+    await loop.run_in_executor(None, lambda: time.sleep(0.01))
+    return data
+
+
+def sync_poll(client):
+    # The CLI/SDK poll loop: sync context, time.sleep is correct here.
+    while not client.done():
+        time.sleep(1)

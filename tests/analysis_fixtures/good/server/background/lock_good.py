@@ -1,0 +1,33 @@
+"""Known-good fixture: FSM-table writes under the owning claim — via
+lock_ctx, a guarded try_claim, and a for_each_claimed stepper grant."""
+
+from dstack_tpu.server.background.concurrency import for_each_claimed
+
+
+async def stop_run(ctx, run_id):
+    async with ctx.locker.lock_ctx("runs", [run_id]):
+        await ctx.db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?", ("stopping", run_id)
+        )
+
+
+async def claim_and_write(ctx, inst_id):
+    if await ctx.claims.try_claim("instances", inst_id):
+        try:
+            await ctx.db.execute(
+                "UPDATE instances SET status = ? WHERE id = ?", ("busy", inst_id)
+            )
+        finally:
+            await ctx.claims.release("instances", inst_id)
+
+
+async def _step_run(ctx, row):
+    # Granted "runs" by the for_each_claimed call below; the runs holder
+    # may also write jobs rows (TABLE_NAMESPACES hierarchy).
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ? WHERE run_id = ?", ("done", row["id"])
+    )
+
+
+async def tick(ctx, rows):
+    await for_each_claimed(ctx, "runs", rows, lambda c, r: _step_run(c, r))

@@ -1,0 +1,6 @@
+"""Known-good registry fixture."""
+
+METRICS = {
+    "dstack_tpu_widget_spins_total": ("counter", ("widget",)),
+    "dstack_tpu_widget_backlog": ("gauge", ()),
+}

@@ -1,0 +1,21 @@
+"""Known-good fixture: `?` binds and blessed placeholder expansion."""
+
+from dstack_tpu.server.background.concurrency import placeholders
+
+
+async def lookup(db, name):
+    return await db.fetchone("SELECT * FROM projects WHERE name = ?", (name,))
+
+
+async def bulk_fetch(db, ids):
+    ph = placeholders(len(ids))
+    return await db.fetchall(
+        f"SELECT * FROM projects WHERE id IN ({ph})", ids
+    )
+
+
+def account(tracer):
+    tracer.inc("widget_spins", 1, widget="w1")
+
+
+EXPOSED = "dstack_tpu_widget_spins_total"

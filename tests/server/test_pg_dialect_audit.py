@@ -11,54 +11,17 @@ surface, not a hand-maintained list.
 
 Parity: the reference gets dialect portability from SQLAlchemy Core; the
 equivalent here is this audit plus pgwire's placeholder rewrite.
-"""
 
-import re
+The rule set lives in dstack_tpu.analysis.sqlrules, shared with the
+static SQL01 checker so the runtime and static gates cannot drift.
+"""
 
 import pytest
 
+from dstack_tpu.analysis.sqlrules import FRAMING as _FRAMING
+from dstack_tpu.analysis.sqlrules import lint
 from dstack_tpu.server.http import response_json
 from tests.server.conftest import make_server, task_body, wait_run
-
-# Patterns that parse on sqlite but error (or silently differ) on
-# PostgreSQL. Each entry: (name, compiled regex).
-SQLITE_ISMS = [
-    ("INSERT OR REPLACE/IGNORE/ABORT", re.compile(r"\bINSERT\s+OR\s+\w+", re.I)),
-    ("REPLACE INTO", re.compile(r"\bREPLACE\s+INTO\b", re.I)),
-    ("AUTOINCREMENT", re.compile(r"\bAUTOINCREMENT\b", re.I)),
-    ("GLOB operator", re.compile(r"\bGLOB\b", re.I)),
-    ("datetime()", re.compile(r"\bdatetime\s*\(", re.I)),
-    ("strftime()", re.compile(r"\bstrftime\s*\(", re.I)),
-    ("julianday()", re.compile(r"\bjulianday\s*\(", re.I)),
-    ("ifnull()", re.compile(r"\bifnull\s*\(", re.I)),
-    ("group_concat()", re.compile(r"\bgroup_concat\s*\(", re.I)),
-    ("hex()", re.compile(r"\bhex\s*\(", re.I)),
-    ("randomblob()", re.compile(r"\brandomblob\s*\(", re.I)),
-    ("last_insert_rowid()", re.compile(r"\blast_insert_rowid\b", re.I)),
-    # Service code must never issue PRAGMAs — those are engine-internal
-    # (and meaningless on Postgres).
-    ("PRAGMA", re.compile(r"\bPRAGMA\b", re.I)),
-]
-
-# Transaction framing the sqlite3 module emits on its own; the Postgres
-# engine provides its own framing (run_sync begin/commit).
-_FRAMING = re.compile(r"^\s*(BEGIN|COMMIT|ROLLBACK|SAVEPOINT|RELEASE)\b", re.I)
-
-
-def _strip_literals(sql: str) -> str:
-    """Lint code, not quoted data (a log line containing 'PRAGMA' is
-    fine)."""
-    return re.sub(r"'(?:[^']|'')*'", "''", sql)
-
-
-def lint(corpus):
-    findings = []
-    for sql in corpus:
-        code = _strip_literals(sql)
-        for name, pat in SQLITE_ISMS:
-            if pat.search(code):
-                findings.append((name, sql.strip()[:120]))
-    return findings
 
 
 def test_linter_catches_known_sqlite_isms():

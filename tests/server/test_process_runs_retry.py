@@ -170,9 +170,9 @@ async def test_resubmit_accounts_resilience_counters():
         assert res == {"preemptions": 1, "clean_drains": 1, "restarts": 1,
                        "steps_lost": 0}
         counters = {c["name"]: c["value"] for c in ctx.tracer.counter_snapshot()}
-        assert counters["run_preemptions"] == 1
-        assert counters["run_clean_drains"] == 1
-        assert counters["run_restarts"] == 1
+        assert counters["run_preemption_events"] == 1
+        assert counters["run_clean_drain_events"] == 1
+        assert counters["run_restart_events"] == 1
     finally:
         await fx.app.shutdown()
 

@@ -1,0 +1,225 @@
+"""Tier-1 gate + unit tests for the static analysis framework.
+
+Three layers:
+
+1. Fixture tests — known-bad snippets (tests/analysis_fixtures/bad/)
+   must produce exactly the expected codes; known-good snippets
+   (.../good/) must be clean. The good tree includes the sync CLI/SDK
+   poll-loop shape, which must never be flagged.
+2. Tooling round-trip — suppression pragmas, fingerprint stability,
+   baseline record -> suppress -> stale-entry (BASE01) flow via the CLI
+   entrypoint.
+3. The gate itself — `dstack_tpu/` has zero non-baselined findings with
+   the committed baseline (intended empty), and the analyzer passes its
+   own self-check.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+from dstack_tpu.analysis import baseline as baseline_mod
+from dstack_tpu.analysis.__main__ import main as cli_main
+from dstack_tpu.analysis.core import run_analysis
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+BAD = str(FIXTURES / "bad")
+GOOD = str(FIXTURES / "good")
+
+
+def _codes(report):
+    return sorted({f.code for f in report.findings})
+
+
+def _keys(report, code):
+    return sorted(f.key for f in report.findings if f.code == code)
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def test_bad_fixtures_trip_every_checker():
+    report = run_analysis([BAD], root=BAD)
+    assert report.errors == []
+    assert _codes(report) == ["ASY01", "ASY02", "LCK01", "LCK02", "MET01", "SQL01"]
+    assert _keys(report, "ASY01") == [".read_text", "requests.get", "time.sleep"]
+    assert _keys(report, "ASY02") == ["create_task", "notify"]
+    assert _keys(report, "LCK01") == ["update:runs"]
+    assert _keys(report, "LCK02") in (["jobs->runs"], ["runs->jobs"])
+    assert _keys(report, "SQL01") == [
+        "dialect:INSERT OR REPLACE/IGNORE/ABORT",
+        "interp:fetchone",
+    ]
+    assert _keys(report, "MET01") == [
+        "labels:dstack_tpu_widget_spins_total",
+        "literal:dstack_tpu_never_declared_total",
+        "suffix:dstack_tpu_bad_counter",
+        "suffix:dstack_tpu_bad_gauge_total",
+        "undeclared:dstack_tpu_mystery_widget_total",
+    ]
+    assert report.exit_code == 1
+
+
+def test_good_fixtures_are_clean():
+    report = run_analysis([GOOD], root=GOOD)
+    assert report.errors == []
+    assert report.findings == [], [f.render() for f in report.findings]
+    assert report.exit_code == 0
+
+
+# --------------------------------------------------------- seeded defects
+
+
+def _write(tmp_path: Path, rel: str, body: str) -> None:
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+
+
+def test_seeded_violations_are_caught(tmp_path):
+    """The acceptance contract: a freshly seeded ASY01 / LCK01 / SQL01
+    defect each produces its finding."""
+    _write(
+        tmp_path,
+        "server/background/seeded.py",
+        '''
+        import time
+
+        async def tick(ctx, run_id):
+            time.sleep(5)
+            await ctx.db.execute(
+                "UPDATE runs SET status = 'x' WHERE id = ?", (run_id,)
+            )
+
+        async def probe(db, name):
+            await db.execute(f"DELETE FROM settings WHERE k = '{name}'")
+        ''',
+    )
+    report = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert "ASY01" in _codes(report)
+    assert "LCK01" in _codes(report)
+    assert "SQL01" in _codes(report)
+    assert "update:runs" in _keys(report, "LCK01")
+    assert "interp:execute" in _keys(report, "SQL01")
+
+
+def test_fingerprints_survive_line_shifts(tmp_path):
+    body = '''
+    import time
+
+    async def f():
+        time.sleep(1)
+    '''
+    _write(tmp_path, "mod.py", body)
+    before = run_analysis([str(tmp_path)], root=str(tmp_path))
+    _write(tmp_path, "mod.py", "# a new comment\n# another\n" + textwrap.dedent(body))
+    after = run_analysis([str(tmp_path)], root=str(tmp_path))
+    (f1,), (f2,) = before.findings, after.findings
+    assert f1.line != f2.line
+    assert f1.fingerprint == f2.fingerprint == "ASY01::mod.py::f::time.sleep"
+
+
+def test_suppression_pragmas(tmp_path):
+    _write(
+        tmp_path,
+        "mod.py",
+        '''
+        import time
+
+        async def f():
+            time.sleep(1)  # analysis: allow(ASY01)
+
+        async def g():
+            # analysis: allow(ASY01)
+            time.sleep(1)
+
+        async def h():
+            time.sleep(1)
+        ''',
+    )
+    report = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert [f.symbol for f in report.findings] == ["h"]
+
+    _write(
+        tmp_path,
+        "mod.py",
+        '''
+        # analysis: allow-file(ASY01)
+        import time
+
+        async def h():
+            time.sleep(1)
+        ''',
+    )
+    report = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert report.findings == []
+
+
+# ------------------------------------------------------ baseline round-trip
+
+
+def test_baseline_round_trip(tmp_path, capsys):
+    """Record findings into a baseline, re-run suppressed, then flag the
+    entries as stale once the findings disappear."""
+    baseline = tmp_path / "baseline.json"
+
+    # 1. Record: the bad tree's findings all land in the baseline.
+    rc = cli_main([BAD, "--root", BAD, "--baseline", str(baseline), "--update-baseline"])
+    assert rc == 0
+    entries = baseline_mod.load(str(baseline))
+    assert entries, "update-baseline wrote no entries"
+
+    # 2. Suppress: same tree + baseline now exits clean.
+    rc = cli_main([BAD, "--root", BAD, "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "baselined" in out
+
+    # 3. Stale: against the (clean) good tree every entry is stale and
+    #    surfaces as an actionable BASE01 finding.
+    rc = cli_main([GOOD, "--root", GOOD, "--baseline", str(baseline), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["stale_baseline"] == sorted(entries)
+    assert all(f["code"] == "BASE01" for f in payload["findings"])
+
+
+def test_cli_json_contract(capsys):
+    rc = cli_main([BAD, "--root", BAD, "--no-baseline", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["exit_code"] == 1
+    assert payload["files_scanned"] == 5
+    assert set(payload["checkers"]) >= {"ASY01", "ASY02", "LCK01", "LCK02", "SQL01", "MET01"}
+    sample = payload["findings"][0]
+    assert {"code", "message", "path", "line", "fingerprint"} <= set(sample)
+
+
+# ------------------------------------------------------------- the gate
+
+
+def test_committed_baseline_is_valid_and_empty():
+    entries = baseline_mod.load(str(REPO / "analysis_baseline.json"))
+    assert entries == set(), (
+        "the committed baseline should stay empty — fix findings instead"
+        f" of grandfathering them: {sorted(entries)}"
+    )
+
+
+def test_tree_has_zero_findings():
+    """The tier-1 gate: the committed tree is clean under all checkers
+    (modulo the committed baseline, which is asserted empty above)."""
+    baseline = baseline_mod.load(str(REPO / "analysis_baseline.json"))
+    report = run_analysis(
+        [str(REPO / "dstack_tpu")], root=str(REPO), baseline_fingerprints=baseline
+    )
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.render() for f in report.findings)
+
+
+def test_analyzer_self_check():
+    """The analysis package itself is clean with no baseline at all."""
+    report = run_analysis([str(REPO / "dstack_tpu" / "analysis")], root=str(REPO))
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.render() for f in report.findings)
