@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast lint lint-json capacity capacity-smoke
+.PHONY: test test-all chaos chaos-fast lint lint-json capacity capacity-smoke bench-proxy
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -34,6 +34,12 @@ lint-json:
 # docs/guides/control-plane-tuning.md for how to read them.
 capacity:
 	JAX_PLATFORMS=cpu $(PYTHON) capacity_probe.py --runs 500 --out CAPACITY_r06.json
+
+# Proxy data-plane benchmark: pooled+streamed fast path vs the legacy
+# per-request-client buffered proxy. Results land in BENCH_proxy_r07.json;
+# see docs/guides/proxy-tuning.md for how to read them.
+bench-proxy:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r07.json
 
 # CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
 capacity-smoke:

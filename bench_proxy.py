@@ -1,0 +1,396 @@
+"""Proxy data-plane benchmark: pooled + streamed fast path vs the legacy
+per-request-client buffered proxy, and routing-cache vs per-request DB pick.
+
+Three scenarios, all against a real keep-alive HTTP/1.1 upstream socket:
+
+1. latency/RPS — N concurrent small-payload requests through each arm.
+   The legacy arm reproduces the pre-fast-path handler verbatim (new
+   httpx.AsyncClient per request, fully buffered body, per-request DB
+   replica pick with a global round-robin counter); the fast arm is the
+   shipped /proxy/services/ route (pooled client, streamed relay,
+   routing cache). Both go through the same App dispatch.
+2. TTFB — a trickling upstream (first KB immediately, rest after
+   --gen-delay). Buffered proxying cannot hand the client a byte before
+   the upstream finishes; the streamed relay's TTFB is decoupled from
+   total generation time.
+3. routing — replica lookups/s: 3 SQL queries + 2 pydantic parses per
+   pick (legacy) vs the TTL'd routing cache.
+
+Emits ONE JSON document (BENCH_proxy_r07.json via --out).
+
+Run: JAX_PLATFORMS=cpu python bench_proxy.py [--requests 300] [--out ...]
+"""
+
+import argparse
+import asyncio
+import itertools
+import json
+import re
+import statistics
+import time
+
+import httpx
+
+from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.models.runs import JobProvisioningData, JobSpec
+from dstack_tpu.server.http import Request, Response, Route, Router
+
+# ---------------------------------------------------------------- upstream
+
+
+class Upstream:
+    """Keep-alive HTTP/1.1 stub replica. `/trickle` responds with the
+    first KB immediately and the remaining body after `gen_delay` —
+    a stand-in for token-by-token model generation."""
+
+    def __init__(self, payload_size=512, trickle_size=16384, gen_delay=0.25):
+        self.payload = b"x" * payload_size
+        self.trickle = b"y" * trickle_size
+        self.gen_delay = gen_delay
+        self.connections = 0
+        self.requests = 0
+        self.server = None
+
+    async def start(self) -> int:
+        self.server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    def stop(self):
+        self.server.close()
+
+    async def _handle(self, reader, writer):
+        self.connections += 1
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                target = line.decode().split(" ", 2)[1]
+                clen = 0
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    if k.strip().lower() == "content-length":
+                        clen = int(v)
+                if clen:
+                    await reader.readexactly(clen)
+                self.requests += 1
+                if target.startswith("/trickle"):
+                    body = self.trickle
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\n"
+                        b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+                        + body[:1024]
+                    )
+                    await writer.drain()
+                    await asyncio.sleep(self.gen_delay)
+                    writer.write(body[1024:])
+                else:
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\n"
+                        b"Content-Length: " + str(len(self.payload)).encode()
+                        + b"\r\n\r\n" + self.payload
+                    )
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+# ------------------------------------------------- legacy arm (pre-fast-path)
+# Reproduced from the proxy as of commit d5a77f0, before the fast path:
+# per-request DB pick + pydantic parse, global round-robin, a fresh
+# httpx.AsyncClient per request, and a fully buffered response body.
+
+_HOP_HEADERS = {
+    "connection", "keep-alive", "transfer-encoding", "upgrade", "host",
+    "content-length", "proxy-authorization", "te", "trailer",
+}
+_legacy_rr = itertools.count()
+
+
+async def _legacy_pick(ctx, project_name, run_name):
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project_row is None:
+        raise ResourceNotExistsError("Project not found")
+    run_row = await ctx.db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise ResourceNotExistsError("Run not found")
+    if run_row["service_spec"] is None:
+        raise BadRequestError("Run is not a service")
+    job_rows = await ctx.db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND status = 'running' ORDER BY replica_num",
+        (run_row["id"],),
+    )
+    job_rows = [j for j in job_rows if j["job_provisioning_data"]]
+    if not job_rows:
+        raise BadRequestError("No running replicas")
+    row = job_rows[next(_legacy_rr) % len(job_rows)]
+    spec = JobSpec.model_validate_json(row["job_spec"])
+    jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
+    port = spec.app_specs[0].port if spec.app_specs else 80
+    return jpd, port
+
+
+async def _legacy_proxy(request, project_name, run_name, rest):
+    ctx = request.state["ctx"]
+    ctx.service_stats.record(project_name, run_name)
+    jpd, port = await _legacy_pick(ctx, project_name, run_name)
+    target = f"http://{jpd.hostname}:{port}/{rest}"
+    headers = {k: v for k, v in request.headers.items() if k not in _HOP_HEADERS}
+    try:
+        async with httpx.AsyncClient(timeout=60.0) as client:
+            upstream = await client.request(
+                request.method, target, content=request.body or None,
+                headers=headers, params=request.query,
+            )
+    except httpx.HTTPError as e:
+        return Response({"detail": f"Service unreachable: {e}"}, status=502)
+    resp_headers = {
+        k: v for k, v in upstream.headers.items() if k.lower() not in _HOP_HEADERS
+    }
+    return Response(upstream.content, status=upstream.status_code, headers=resp_headers)
+
+
+def _mount_legacy(app):
+    router = Router()
+    for method in ("GET", "POST"):
+        router.routes.append(
+            Route(
+                method=method,
+                pattern="/proxy/legacy/{project_name}/{run_name}/{rest}",
+                regex=re.compile(
+                    r"^/proxy/legacy/(?P<project_name>[^/]+)/(?P<run_name>[^/]+)/(?P<rest>.*)$"
+                ),
+                handler=_legacy_proxy,
+            )
+        )
+    app.include_router(router)
+
+
+# ------------------------------------------------------------------ seeding
+
+
+async def _seed_service(ctx, run_name, port):
+    from dstack_tpu.models.runs import RunSpec
+    from dstack_tpu.server.security import generate_id
+    from dstack_tpu.utils.common import utcnow_iso
+
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    run_id, now = generate_id(), utcnow_iso()
+    spec = RunSpec.model_validate(
+        {"run_name": run_name, "repo_id": "local",
+         "configuration": {"type": "service", "name": run_name, "port": port,
+                           "commands": ["serve"]}}
+    )
+    await ctx.db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+        " last_processed_at, status, run_spec, service_spec)"
+        " VALUES (?, ?, ?, ?, ?, ?, 'running', ?, ?)",
+        (run_id, project["id"], user["id"], run_name, now, now,
+         spec.model_dump_json(),
+         json.dumps({"url": f"/proxy/services/main/{run_name}/", "model": None})),
+    )
+    job_spec = JobSpec.model_validate(
+        {"job_name": f"{run_name}-0-0", "commands": ["serve"],
+         "requirements": {"resources": {}},
+         "app_specs": [{"app_name": "app", "port": port}]}
+    )
+    jpd = JobProvisioningData.model_validate(
+        {"backend": "local",
+         "instance_type": {"name": "local",
+                           "resources": {"cpus": 1, "memory_mib": 1024}},
+         "instance_id": "i-0", "hostname": "127.0.0.1", "internal_ip": "127.0.0.1",
+         "region": "local", "price": 0.0, "username": "root", "dockerized": False}
+    )
+    await ctx.db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " submitted_at, last_processed_at, status, job_spec, job_provisioning_data)"
+        " VALUES (?, ?, ?, ?, 0, 0, ?, ?, 'running', ?, ?)",
+        (generate_id(), project["id"], run_id, run_name, now, now,
+         job_spec.model_dump_json(), jpd.model_dump_json()),
+    )
+
+
+# ------------------------------------------------------------------ driving
+
+
+def _req(path):
+    return Request(method="GET", path=path, query={}, headers={}, body=b"")
+
+
+async def _drain(resp):
+    if resp.stream is None:
+        return len(resp.body)
+    n = 0
+    async for chunk in resp.stream:
+        n += len(chunk)
+    return n
+
+
+async def _one(app, path):
+    t0 = time.perf_counter()
+    resp = await app.handle(_req(path))
+    assert resp.status == 200, (path, resp.status, resp.body[:200])
+    await _drain(resp)
+    return time.perf_counter() - t0
+
+
+async def _run_arm(app, path, requests, concurrency):
+    # warmup (connection pools, caches — both arms get one)
+    await _one(app, path)
+    sem = asyncio.Semaphore(concurrency)
+    lat = []
+
+    async def go():
+        async with sem:
+            lat.append(await _one(app, path))
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[go() for _ in range(requests)])
+    wall = time.perf_counter() - t0
+    lat.sort()
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1000, 3)
+
+    return {
+        "requests": requests,
+        "p50_ms": pct(0.50), "p90_ms": pct(0.90), "p99_ms": pct(0.99),
+        "mean_ms": round(statistics.mean(lat) * 1000, 3),
+        "rps": round(requests / wall, 1),
+    }
+
+
+async def _ttfb_arm(app, path, n):
+    ttfbs, totals = [], []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        resp = await app.handle(_req(path))
+        assert resp.status == 200
+        if resp.stream is None:
+            # Buffered: the first client-visible byte IS the last one.
+            ttfbs.append(time.perf_counter() - t0)
+        else:
+            first = None
+            async for _chunk in resp.stream:
+                if first is None:
+                    first = time.perf_counter() - t0
+            ttfbs.append(first)
+        totals.append(time.perf_counter() - t0)
+    return {
+        "requests": n,
+        "ttfb_p50_ms": round(statistics.median(ttfbs) * 1000, 3),
+        "total_p50_ms": round(statistics.median(totals) * 1000, 3),
+    }
+
+
+async def _routing_arm(ctx, lookups, cached):
+    from dstack_tpu.server.routers.services_proxy import pick_replica
+
+    t0 = time.perf_counter()
+    for _ in range(lookups):
+        if cached:
+            await pick_replica(ctx, "main", "bench-svc")
+        else:
+            await _legacy_pick(ctx, "main", "bench-svc")
+    wall = time.perf_counter() - t0
+    return {"lookups": lookups, "lookups_per_s": round(lookups / wall, 1)}
+
+
+async def run_bench(args):
+    from dstack_tpu.server.app import create_app
+
+    upstream = Upstream(payload_size=args.payload, gen_delay=args.gen_delay)
+    port = await upstream.start()
+    app = create_app(db_path=":memory:", run_background_tasks=False)
+    await app.startup()
+    ctx = app.state["ctx"]
+    _mount_legacy(app)
+    try:
+        await _seed_service(ctx, "bench-svc", port)
+
+        legacy = await _run_arm(
+            app, "/proxy/legacy/main/bench-svc/data", args.requests, args.concurrency
+        )
+        legacy["upstream_connections"] = upstream.connections
+        before = upstream.connections
+        fast = await _run_arm(
+            app, "/proxy/services/main/bench-svc/data", args.requests, args.concurrency
+        )
+        fast["upstream_connections"] = upstream.connections - before
+
+        legacy_ttfb = await _ttfb_arm(
+            app, "/proxy/legacy/main/bench-svc/trickle", args.ttfb_requests
+        )
+        fast_ttfb = await _ttfb_arm(
+            app, "/proxy/services/main/bench-svc/trickle", args.ttfb_requests
+        )
+
+        routing_db = await _routing_arm(ctx, args.routing_lookups, cached=False)
+        routing_cached = await _routing_arm(ctx, args.routing_lookups, cached=True)
+
+        return {
+            "config": {
+                "requests": args.requests, "concurrency": args.concurrency,
+                "payload_bytes": args.payload, "gen_delay_s": args.gen_delay,
+                "routing_lookups": args.routing_lookups,
+            },
+            "latency": {"legacy_unpooled_buffered": legacy,
+                        "fastpath_pooled_streamed": fast},
+            "ttfb": {"legacy_buffered": legacy_ttfb,
+                     "fastpath_streamed": fast_ttfb},
+            "routing": {"per_request_db_pick": routing_db,
+                        "routing_cache": routing_cached},
+            "summary": {
+                "p50_speedup_x": round(legacy["p50_ms"] / fast["p50_ms"], 2),
+                "rps_speedup_x": round(fast["rps"] / legacy["rps"], 2),
+                "ttfb_improvement_x": round(
+                    legacy_ttfb["ttfb_p50_ms"] / fast_ttfb["ttfb_p50_ms"], 2
+                ),
+                "routing_speedup_x": round(
+                    routing_cached["lookups_per_s"] / routing_db["lookups_per_s"], 2
+                ),
+                "pooled_streamed_beats_unpooled_buffered": bool(
+                    fast["p50_ms"] < legacy["p50_ms"] and fast["rps"] > legacy["rps"]
+                ),
+                "streamed_ttfb_before_upstream_done": bool(
+                    fast_ttfb["ttfb_p50_ms"] < args.gen_delay * 1000
+                ),
+            },
+        }
+    finally:
+        upstream.stop()
+        await app.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=300)
+    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument("--payload", type=int, default=512)
+    parser.add_argument("--gen-delay", type=float, default=0.25)
+    parser.add_argument("--ttfb-requests", type=int, default=12)
+    parser.add_argument("--routing-lookups", type=int, default=1500)
+    parser.add_argument("--out", default="BENCH_proxy_r07.json")
+    args = parser.parse_args()
+
+    out = asyncio.run(run_bench(args))
+    print(json.dumps(out, indent=1))
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    if not out["summary"]["pooled_streamed_beats_unpooled_buffered"]:
+        raise SystemExit("fast path did not beat the legacy proxy")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""POOL01: per-request HTTP client construction in async server code.
+
+Building `httpx.AsyncClient(...)` inside an `async def` in the server's
+request/services/background layer opens a fresh TCP connection (no
+keep-alive reuse) on every call — the exact overhead the proxy fast
+path removed. Upstream calls must go through the shared pool
+(`ctx.proxy_pool.acquire/release`, services/proxy_pool.py), which owns
+construction (in a sync helper) and shutdown.
+
+Scope is the server data/control plane only (`server/routers/`,
+`server/services/`, `server/background/`): clients built once in sync
+`__init__`s (runner/client.py) or in CLI/SDK code are fine, and
+`walk_async_bodies` already skips nested sync defs — which is also why
+the pool's own sync `_build_client` never trips the checker.
+"""
+
+import ast
+from typing import Iterable, List, Set
+
+from dstack_tpu.analysis.astutil import call_name, walk_async_bodies
+from dstack_tpu.analysis.checkers.async_hygiene import _functions
+from dstack_tpu.analysis.core import Checker, Finding, Module
+
+# Canonical constructors (after import-alias resolution) that open a new
+# connection pool per call site.
+CLIENT_CONSTRUCTORS: Set[str] = {"httpx.AsyncClient"}
+
+SCOPE_MARKERS = ("server/routers/", "server/services/", "server/background/")
+
+
+class PoolChecker(Checker):
+    codes = ("POOL01",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not any(marker in module.rel for marker in SCOPE_MARKERS):
+            return
+        for qualname, func in _functions(module):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for node in walk_async_bodies(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                canonical = module.aliases.canonical(name) if name else None
+                if canonical in CLIENT_CONSTRUCTORS:
+                    yield Finding(
+                        code="POOL01",
+                        message=f"per-request `{canonical}(...)` inside"
+                        f" `async def {qualname}` — opens a fresh TCP"
+                        " connection per call; acquire the shared client"
+                        " from ctx.proxy_pool (services/proxy_pool.py)",
+                        rel=module.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        symbol=qualname,
+                        key=canonical,
+                    )

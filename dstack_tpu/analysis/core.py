@@ -145,6 +145,7 @@ def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.async_hygiene import AsyncHygieneChecker
     from dstack_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
+    from dstack_tpu.analysis.checkers.pool import PoolChecker
     from dstack_tpu.analysis.checkers.sql import SqlChecker
 
     return [
@@ -152,6 +153,7 @@ def default_checkers() -> List[Checker]:
         LockDisciplineChecker(),
         SqlChecker(),
         MetricsRegistryChecker(),
+        PoolChecker(),
     ]
 
 

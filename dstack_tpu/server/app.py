@@ -173,6 +173,7 @@ def create_app(
 
     async def _shutdown() -> None:
         await ctx.stop_tasks()
+        await ctx.proxy_pool.aclose()
         await db.close()
 
     app.on_startup.append(_startup)

@@ -49,7 +49,7 @@ async def _poll_gateway_stats(ctx: ServerContext) -> None:
                 stats = await client(host)
             else:
                 stats = await _http_gateway_stats(
-                    {"host": host, "ssh_private_key": row["ssh_private_key"]}
+                    ctx, {"host": host, "ssh_private_key": row["ssh_private_key"]}
                 )
         except Exception as e:
             logger.debug("gateway %s stats poll failed: %s", host, e)
@@ -69,18 +69,20 @@ async def _poll_gateway_stats(ctx: ServerContext) -> None:
                 ctx.service_stats.record_rejection(project_name, run_name, shed)
 
 
-async def _http_gateway_stats(gateway: dict) -> dict:
+async def _http_gateway_stats(ctx: ServerContext, gateway: dict) -> dict:
     """Stats ride the same server→gateway SSH tunnel as registry calls —
     the gateway API binds 127.0.0.1 on the VM, nothing crosses in plaintext."""
-    import httpx
-
     from dstack_tpu.server.services.services import _gateway_tunnel_port
 
     port = await _gateway_tunnel_port(gateway)
-    async with httpx.AsyncClient(timeout=10.0) as client:
-        resp = await client.get(f"http://127.0.0.1:{port}/api/stats")
+    base = f"http://127.0.0.1:{port}"
+    client = ctx.proxy_pool.acquire(base)
+    try:
+        resp = await client.get(f"{base}/api/stats", timeout=10.0)
         resp.raise_for_status()
         return resp.json()
+    finally:
+        ctx.proxy_pool.release(base)
 
 
 async def _process_gateway(ctx: ServerContext, row) -> None:

@@ -504,6 +504,7 @@ async def _submit_to_runner(
         await ctx.db.execute(
             "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
         )
+        ctx.routing_cache.invalidate_run(row["run_name"])
         await _register_service_replica(ctx, row, jpd, job_spec, tick)
         logger.info(
             "job %s (%s rank %d/%d) running",
@@ -625,6 +626,7 @@ async def _pull_runner(
                     row["id"],
                 ),
             )
+            ctx.routing_cache.invalidate_run(row["run_name"])
             await _release_instance(ctx, row)
             ctx.kick("runs")
             logger.info("job %s finished: %s", row["id"][:8], event.state.value)
@@ -692,6 +694,7 @@ async def _fail(
         " termination_reason_message = ?, finished_at = ? WHERE id = ?",
         (reason.to_status().value, reason.value, message, utcnow_iso(), row["id"]),
     )
+    ctx.routing_cache.invalidate_run(row["run_name"])
     await _release_instance(ctx, row)
     ctx.kick("runs")
     logger.info("job %s failed: %s", row["id"][:8], message)
@@ -737,6 +740,7 @@ async def _terminate_job(
         "UPDATE jobs SET status = ?, finished_at = ?, last_processed_at = ? WHERE id = ?",
         (reason.to_status().value, utcnow_iso(), utcnow_iso(), row["id"]),
     )
+    ctx.routing_cache.invalidate_run(row["run_name"])
     await _unregister_service_replica(ctx, row, tick)
     await _release_instance(ctx, row)
     ctx.kick("runs")

@@ -119,6 +119,7 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
             "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
             (RunStatus.TERMINATING.value, RunTerminationReason.JOB_FAILED.value, row["id"]),
         )
+        ctx.routing_cache.invalidate_run(row["run_name"])
         ctx.kick("terminating_jobs")
         return
 
@@ -201,6 +202,7 @@ async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
                             j["id"],
                         ),
                     )
+        ctx.routing_cache.invalidate_run(row["run_name"])
         ctx.kick("terminating_jobs")
     await ctx.db.execute(
         "UPDATE runs SET desired_replica_count = ?, last_scaled_at = ? WHERE id = ?",
@@ -237,6 +239,7 @@ async def _maybe_retry(
                             j["id"],
                         ),
                     )
+            ctx.routing_cache.invalidate_run(row["run_name"])
             ctx.kick("terminating_jobs")
             return True
         reasons = {
@@ -388,6 +391,7 @@ async def _process_terminating_run(ctx: ServerContext, row: sqlite3.Row) -> None
                     j["id"],
                 ),
             )
+    ctx.routing_cache.invalidate_run(row["run_name"])
     if not all_finished:
         ctx.kick("terminating_jobs")
         return

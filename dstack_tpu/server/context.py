@@ -47,6 +47,14 @@ class ServerContext:
         # Versioned parse cache shared by the FSM processors: memoizes the
         # pydantic validation of spec JSON columns per (table, row, model).
         self.spec_cache = SpecCache(tracer=self.tracer)
+        from dstack_tpu.server.services.proxy_pool import ProxyPool
+        from dstack_tpu.server.services.routing_cache import RoutingCache
+
+        # Proxy data plane: pooled keep-alive upstream clients + the
+        # TTL/FSM-invalidated replica routing table (closed/invalidated
+        # via app shutdown and the background FSM respectively).
+        self.proxy_pool = ProxyPool(tracer=self.tracer)
+        self.routing_cache = RoutingCache(tracer=self.tracer)
         self._signals: Dict[str, asyncio.Event] = {}
         # A set: done-callbacks race stop_tasks' clear(), and a
         # list.remove of an already-removed task raised in the event

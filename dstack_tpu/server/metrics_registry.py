@@ -37,6 +37,17 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # Background FSM tick accounting.
     "dstack_tpu_tick_rows_scanned_total": ("counter", ("processor",)),
     "dstack_tpu_tick_rows_stepped_total": ("counter", ("processor",)),
+    # Proxy data plane (services/proxy_pool.py + routing_cache.py):
+    # request/error counters per traffic kind (service | model), pooled
+    # client gauge, routing-cache hit rate, and the hand-accumulated
+    # TTFB summary (sum/count emitted from the pool's accumulator — a
+    # tracer counter would be suffixed `_total`).
+    "dstack_tpu_proxy_pool_connections": ("gauge", ()),
+    "dstack_tpu_proxy_requests_total": ("counter", ("kind",)),
+    "dstack_tpu_proxy_routing_cache_hit_rate": ("gauge", ()),
+    "dstack_tpu_proxy_ttfb_seconds_count": ("counter", ("kind",)),
+    "dstack_tpu_proxy_ttfb_seconds_sum": ("counter", ("kind",)),
+    "dstack_tpu_proxy_upstream_errors_total": ("counter", ("kind",)),
     # Spec cache (PR 3).
     "dstack_tpu_spec_cache_entries": ("gauge", ()),
     "dstack_tpu_spec_cache_hit_rate": ("gauge", ()),

@@ -68,6 +68,14 @@ async def prometheus_metrics(request: Request):
     cache = ctx.spec_cache.stats()
     exp.add("dstack_tpu_spec_cache_entries", {}, cache["size"])
     exp.add("dstack_tpu_spec_cache_hit_rate", {}, cache["hit_rate"])
+    pool = ctx.proxy_pool.stats()
+    exp.add("dstack_tpu_proxy_pool_connections", {}, pool["clients"])
+    for kind, (ttfb_sum, ttfb_count) in sorted(ctx.proxy_pool.ttfb_stats().items()):
+        labels = {"kind": kind}
+        exp.add("dstack_tpu_proxy_ttfb_seconds_sum", labels, ttfb_sum)
+        exp.add("dstack_tpu_proxy_ttfb_seconds_count", labels, ttfb_count)
+    routing = ctx.routing_cache.stats()
+    exp.add("dstack_tpu_proxy_routing_cache_hit_rate", {}, routing["hit_rate"])
     for name, st in ctx.tracer.snapshot()["stats"].items():
         labels = {"span": name}
         exp.add("dstack_tpu_span_count_total", labels, st["count"])

@@ -6,18 +6,25 @@ requested model, with format adapters:
   - openai: passthrough to the container's own OpenAI-compatible server
     (vLLM-TPU, JetStream+adapter)
   - tgi: translate chat-completions <-> TGI /generate
+
+All upstream traffic rides the shared keep-alive pool (ctx.proxy_pool) and
+the routing cache picks replicas (see services_proxy.py); SSE generations
+stream chunk-by-chunk, non-stream completions buffer (their body is one
+JSON object either way) but still reuse pooled connections.
 """
 
 import json
 import logging
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List
 
 import httpx
 
 from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Router
 from dstack_tpu.server.routers.deps import get_ctx
+from dstack_tpu.server.routers.services_proxy import pick_replica
 
 logger = logging.getLogger(__name__)
 
@@ -25,32 +32,9 @@ router = Router(prefix="/proxy/models")
 
 
 async def _service_models(ctx, project_name: str) -> List[Dict[str, Any]]:
-    """All models served by RUNNING services of a project."""
-    project_row = await ctx.db.fetchone(
-        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
-    )
-    if project_row is None:
-        raise ResourceNotExistsError("Project not found")
-    rows = await ctx.db.fetchall(
-        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0"
-        " AND service_spec IS NOT NULL AND status = 'running'",
-        (project_row["id"],),
-    )
-    models = []
-    for row in rows:
-        spec = json.loads(row["service_spec"])
-        model = spec.get("model")
-        if model:
-            models.append(
-                {
-                    "run_id": row["id"],
-                    "run_name": row["run_name"],
-                    "name": model["name"],
-                    "format": model.get("format", "openai"),
-                    "prefix": model.get("prefix", "/v1"),
-                }
-            )
-    return models
+    """All models served by RUNNING services of a project (cached; the
+    routing cache invalidates on FSM job transitions + TTL)."""
+    return await ctx.routing_cache.get_models(ctx, project_name)
 
 
 @router.get("/{project_name}/models")
@@ -81,20 +65,20 @@ async def chat_completions(request: Request, project_name: str):
     match = next((m for m in models if m["name"] == model_name), None)
     if match is None:
         raise ResourceNotExistsError(f"Model {model_name} not found")
-    from dstack_tpu.server.routers.services_proxy import pick_replica
-
+    ctx.tracer.inc("proxy_requests", kind="model")
     try:
-        jpd, port = await pick_replica(ctx, project_name, match["run_name"])
+        target = await pick_replica(ctx, project_name, match["run_name"])
     except Exception:
         # Demand against a service with no live replica still counts as
         # RPS — it is exactly the scale-from-zero wake signal.
         ctx.service_stats.record(project_name, match["run_name"])
         raise
-    base = f"http://{jpd.hostname}:{port}"
     if match["format"] == "tgi":
-        resp = await _tgi_chat(base, body)
+        resp = await _tgi_chat(ctx, target, target.base_url, body)
     else:
-        resp = await _openai_passthrough(base + match["prefix"], body)
+        resp = await _openai_passthrough(
+            ctx, target, target.base_url + match["prefix"], body
+        )
     if resp.status in (429, 503):
         # Replica shed the request (serving-engine admission control).
         # Count it ONLY as a rejection — the autoscaler folds shed
@@ -115,14 +99,32 @@ def _proxy_headers(upstream) -> Dict[str, str]:
     return headers
 
 
-async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
+def _upstream_error(ctx, target, e: Exception) -> Response:
+    ctx.tracer.inc("proxy_upstream_errors", kind="model")
+    if isinstance(e, (httpx.ConnectError, httpx.ConnectTimeout)):
+        # Trip the breaker so the next pick skips this replica for the
+        # cooldown (POSTs are not replayed — generation is not idempotent).
+        ctx.routing_cache.mark_failure(target.job_id)
+    return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+
+
+async def _openai_passthrough(ctx, target, base: str, body: Dict[str, Any]) -> Response:
     if body.get("stream"):
-        return await _openai_stream(base, body)
+        return await _openai_stream(ctx, target, base, body)
+    client = ctx.proxy_pool.acquire(base)
+    ctx.routing_cache.start(target.job_id)
+    start = time.monotonic()
     try:
-        async with httpx.AsyncClient(timeout=300.0) as client:
-            upstream = await client.post(f"{base}/chat/completions", json=body)
+        upstream = await client.post(
+            f"{base}/chat/completions", json=body, timeout=settings.PROXY_MODEL_TIMEOUT
+        )
     except httpx.HTTPError as e:
-        return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+        return _upstream_error(ctx, target, e)
+    finally:
+        ctx.routing_cache.finish(target.job_id)
+        ctx.proxy_pool.release(base)
+    ctx.proxy_pool.observe_ttfb("model", time.monotonic() - start)
+    ctx.routing_cache.mark_success(target.job_id)
     return Response(
         upstream.content,
         status=upstream.status_code,
@@ -130,24 +132,35 @@ async def _openai_passthrough(base: str, body: Dict[str, Any]) -> Response:
     )
 
 
-async def _openai_stream(base: str, body: Dict[str, Any]) -> Response:
+async def _openai_stream(ctx, target, base: str, body: Dict[str, Any]) -> Response:
     """Token-by-token SSE relay: forward upstream chunks as they arrive
     instead of buffering the full generation (reference model proxy streams).
     Upstream errors keep their status/body rather than masquerading as a
     successful empty stream."""
-    client = httpx.AsyncClient(timeout=300.0)
+    client = ctx.proxy_pool.acquire(base)
+    ctx.routing_cache.start(target.job_id)
+    start = time.monotonic()
     try:
         upstream = await client.send(
-            client.build_request("POST", f"{base}/chat/completions", json=body),
+            client.build_request(
+                "POST",
+                f"{base}/chat/completions",
+                json=body,
+                timeout=settings.PROXY_MODEL_TIMEOUT,
+            ),
             stream=True,
         )
     except httpx.HTTPError as e:
-        await client.aclose()
-        return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+        ctx.routing_cache.finish(target.job_id)
+        ctx.proxy_pool.release(base)
+        return _upstream_error(ctx, target, e)
+    ctx.proxy_pool.observe_ttfb("model", time.monotonic() - start)
+    ctx.routing_cache.mark_success(target.job_id)
     if upstream.status_code != 200:
         content = await upstream.aread()
         await upstream.aclose()
-        await client.aclose()
+        ctx.routing_cache.finish(target.job_id)
+        ctx.proxy_pool.release(base)
         return Response(
             content,
             status=upstream.status_code,
@@ -155,6 +168,9 @@ async def _openai_stream(base: str, body: Dict[str, Any]) -> Response:
         )
 
     async def _gen():
+        # The pooled client stays leased until the last chunk: release
+        # happens here, never in the handler, so pool eviction cannot
+        # close a client under an in-flight generation.
         try:
             async for chunk in upstream.aiter_bytes():
                 yield chunk
@@ -162,7 +178,8 @@ async def _openai_stream(base: str, body: Dict[str, Any]) -> Response:
             pass  # mid-stream disconnect: terminate the chunked response
         finally:
             await upstream.aclose()
-            await client.aclose()
+            ctx.routing_cache.finish(target.job_id)
+            ctx.proxy_pool.release(base)
 
     return Response(
         stream=_gen(),
@@ -181,26 +198,37 @@ def _messages_to_prompt(messages: List[Dict[str, Any]]) -> str:
     return "\n".join(parts)
 
 
-async def _tgi_chat(base: str, body: Dict[str, Any]) -> Response:
+async def _tgi_chat(ctx, target, base: str, body: Dict[str, Any]) -> Response:
     if body.get("stream"):
         # TGI translation is request/response; a buffered body dressed up as
         # a chat.completion would break SSE-iterating SDKs, so be explicit.
         raise BadRequestError("stream=true is not supported for tgi-format models")
     prompt = _messages_to_prompt(body.get("messages", []))
-    tgi_body = {
-        "inputs": prompt,
-        "parameters": {
-            "max_new_tokens": body.get("max_tokens", 512),
-            "temperature": body.get("temperature") or None,
-            "top_p": body.get("top_p") or None,
-            "stop": body.get("stop") or [],
-        },
+    parameters: Dict[str, Any] = {
+        "max_new_tokens": body.get("max_tokens", 512),
+        "stop": body.get("stop") or [],
     }
+    # `is not None`, not truthiness: temperature=0 / top_p=0 are valid
+    # greedy-decoding settings and must pass through.
+    if body.get("temperature") is not None:
+        parameters["temperature"] = body["temperature"]
+    if body.get("top_p") is not None:
+        parameters["top_p"] = body["top_p"]
+    tgi_body = {"inputs": prompt, "parameters": parameters}
+    client = ctx.proxy_pool.acquire(base)
+    ctx.routing_cache.start(target.job_id)
+    start = time.monotonic()
     try:
-        async with httpx.AsyncClient(timeout=300.0) as client:
-            upstream = await client.post(f"{base}/generate", json=tgi_body)
+        upstream = await client.post(
+            f"{base}/generate", json=tgi_body, timeout=settings.PROXY_MODEL_TIMEOUT
+        )
     except httpx.HTTPError as e:
-        return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
+        return _upstream_error(ctx, target, e)
+    finally:
+        ctx.routing_cache.finish(target.job_id)
+        ctx.proxy_pool.release(base)
+    ctx.proxy_pool.observe_ttfb("model", time.monotonic() - start)
+    ctx.routing_cache.mark_success(target.job_id)
     if upstream.status_code != 200:
         return Response(
             upstream.content, status=upstream.status_code,

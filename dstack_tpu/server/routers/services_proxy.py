@@ -2,80 +2,134 @@
 
 Parity: src/dstack/_internal/server/services/proxy/services/service_proxy.py
 (the no-gateway fallback path, app.py:184-185). Requests are forwarded to a
-RUNNING replica's app port; replicas are selected round-robin.
+RUNNING replica's app port.
+
+Data-plane fast path (docs/guides/proxy-tuning.md): upstream clients come
+from the shared keep-alive pool (ctx.proxy_pool), replica lookup from the
+FSM-invalidated routing cache (ctx.routing_cache, least-outstanding
+selection), and response bodies relay chunk-by-chunk through
+`Response(stream=...)` — constant memory, first byte forwarded the moment
+the upstream produces it. A connect-stage failure trips the replica's
+circuit breaker and, for idempotent methods (no bytes reached the app),
+is retried once on the next replica.
 """
 
-import itertools
 import logging
 import re
+import time
 
 import httpx
 
-from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
-from dstack_tpu.models.runs import JobProvisioningData, JobSpec
+from dstack_tpu.errors import BadRequestError
+from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Route, Router
 from dstack_tpu.server.routers.deps import get_ctx
+from dstack_tpu.server.services.routing_cache import ReplicaTarget
 
 logger = logging.getLogger(__name__)
 
 router = Router()
-_rr = itertools.count()
 
 _HOP_HEADERS = {
     "connection", "keep-alive", "transfer-encoding", "upgrade", "host",
     "content-length", "proxy-authorization", "te", "trailer",
 }
 
+# Safe to transparently re-send to another replica after a connect-stage
+# failure: the request never reached an application.
+_IDEMPOTENT_METHODS = {"GET", "HEAD", "OPTIONS"}
 
-async def pick_replica(ctx, project_name: str, run_name: str):
-    project_row = await ctx.db.fetchone(
-        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
-    )
-    if project_row is None:
-        raise ResourceNotExistsError("Project not found")
-    run_row = await ctx.db.fetchone(
-        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
-        (project_row["id"], run_name),
-    )
-    if run_row is None:
-        raise ResourceNotExistsError("Run not found")
-    if run_row["service_spec"] is None:
-        raise BadRequestError("Run is not a service")
-    job_rows = await ctx.db.fetchall(
-        "SELECT * FROM jobs WHERE run_id = ? AND status = 'running' ORDER BY replica_num",
-        (run_row["id"],),
-    )
-    job_rows = [j for j in job_rows if j["job_provisioning_data"]]
-    if not job_rows:
-        raise BadRequestError("No running replicas")
-    row = job_rows[next(_rr) % len(job_rows)]
-    spec = JobSpec.model_validate_json(row["job_spec"])
-    jpd = JobProvisioningData.model_validate_json(row["job_provisioning_data"])
-    port = spec.app_specs[0].port if spec.app_specs else 80
-    return jpd, port
+_CONNECT_ERRORS = (httpx.ConnectError, httpx.ConnectTimeout)
+
+
+async def pick_replica(ctx, project_name: str, run_name: str, exclude=()) -> ReplicaTarget:
+    """A RUNNING replica of the service, via the routing cache
+    (least-outstanding, circuit-breaker aware)."""
+    targets = await ctx.routing_cache.get_replicas(ctx, project_name, run_name)
+    return ctx.routing_cache.select(project_name, run_name, targets, exclude=exclude)
+
+
+def request_headers(request: Request):
+    """Forwardable request headers: hop-by-hop stripped case-insensitively
+    (the framework lowercases parsed headers, but a hand-built Request —
+    tests, internal calls — may not)."""
+    return {
+        k.lower(): v
+        for k, v in request.headers.items()
+        if k.lower() not in _HOP_HEADERS
+    }
+
+
+async def _relay_body(ctx, upstream, base_url: str, job_id: str):
+    """Stream upstream bytes out as they arrive. aiter_raw: the body is
+    forwarded as-is on the wire (content-encoding intact). The pooled
+    client is released only here, after the last chunk — pool eviction
+    never closes a client under an active stream."""
+    try:
+        async for chunk in upstream.aiter_raw():
+            yield chunk
+    except httpx.HTTPError:
+        pass  # mid-stream upstream failure: terminate the chunked relay
+    finally:
+        await upstream.aclose()
+        ctx.routing_cache.finish(job_id)
+        ctx.proxy_pool.release(base_url)
 
 
 async def proxy_service(request: Request, project_name: str, run_name: str, rest: str):
     ctx = get_ctx(request)
     ctx.service_stats.record(project_name, run_name)  # feeds the autoscaler
-    jpd, port = await pick_replica(ctx, project_name, run_name)
-    # Host-network containers expose the app port on the instance address;
-    # local backend runs directly on the server host.
-    target = f"http://{jpd.hostname}:{port}/{rest}"
-    headers = {k: v for k, v in request.headers.items() if k not in _HOP_HEADERS}
-    try:
-        async with httpx.AsyncClient(timeout=60.0) as client:
-            upstream = await client.request(
-                request.method, target, content=request.body or None, headers=headers,
-                params=request.query,
+    ctx.tracer.inc("proxy_requests", kind="service")
+    start = time.monotonic()
+    headers = request_headers(request)
+    method = request.method.upper()
+    attempts = 2 if method in _IDEMPOTENT_METHODS else 1
+    tried = []
+    last_error = None
+    for _ in range(attempts):
+        try:
+            target = await pick_replica(ctx, project_name, run_name, exclude=tried)
+        except BadRequestError:
+            if tried:
+                break  # every replica already failed this request -> 502
+            raise
+        base = target.base_url
+        client = ctx.proxy_pool.acquire(base)
+        ctx.routing_cache.start(target.job_id)
+        try:
+            upstream = await client.send(
+                client.build_request(
+                    method,
+                    f"{base}/{rest}",
+                    content=request.body or None,
+                    headers=headers,
+                    params=request.query,
+                    timeout=settings.PROXY_SERVICE_TIMEOUT,
+                ),
+                stream=True,
             )
-    except httpx.HTTPError as e:
-        return Response({"detail": f"Service unreachable: {e}"}, status=502)
-    resp_headers = {
-        k: v for k, v in upstream.headers.items()
-        if k.lower() not in _HOP_HEADERS
-    }
-    return Response(upstream.content, status=upstream.status_code, headers=resp_headers)
+        except httpx.HTTPError as e:
+            ctx.routing_cache.finish(target.job_id)
+            ctx.proxy_pool.release(base)
+            ctx.tracer.inc("proxy_upstream_errors", kind="service")
+            if isinstance(e, _CONNECT_ERRORS):
+                ctx.routing_cache.mark_failure(target.job_id)
+                tried.append(target.job_id)
+                last_error = e
+                continue
+            return Response({"detail": f"Service unreachable: {e}"}, status=502)
+        ctx.proxy_pool.observe_ttfb("service", time.monotonic() - start)
+        ctx.routing_cache.mark_success(target.job_id)
+        resp_headers = {
+            k: v for k, v in upstream.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        return Response(
+            stream=_relay_body(ctx, upstream, base, target.job_id),
+            status=upstream.status_code,
+            headers=resp_headers,
+        )
+    return Response({"detail": f"Service unreachable: {last_error}"}, status=502)
 
 
 # Catch-all routes (the generic {param} matcher stops at "/", so these are

@@ -1,0 +1,165 @@
+"""Pooled keep-alive HTTP clients for the proxy data plane.
+
+The services proxy and the model proxy used to open a brand-new
+`httpx.AsyncClient` per request — a fresh TCP handshake and zero
+connection reuse on the hottest user-facing path. The pool caches one
+client per upstream *base URL* (scheme://host:port) in a bounded LRU;
+each client keeps its own keep-alive connection pool (`httpx.Limits`),
+so sequential requests to the same replica ride one socket.
+
+Lifecycle rules the call sites must follow:
+
+- `acquire(base_url)` / `release(base_url)` bracket every use. A
+  streaming relay releases from the stream generator's `finally`, i.e.
+  only after the last chunk went out — eviction never closes a client
+  that still has requests in flight.
+- Never call `aclose()` on a pooled client; the pool owns closing
+  (LRU eviction, idle eviction, and `aclose()` on app shutdown).
+
+The POOL01 static checker enforces the complement: no
+`httpx.AsyncClient(...)` construction inside `async def` server code —
+which is why `_build_client` is deliberately a sync method.
+
+The pool also accumulates proxy TTFB (time to upstream response
+headers) per traffic kind; /metrics exposes the running sum/count so a
+scraper can diff two scrapes for an exact per-window mean.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import httpx
+
+from dstack_tpu.utils.tasks import spawn_logged
+
+
+class _Entry:
+    __slots__ = ("client", "last_used", "in_flight")
+
+    def __init__(self, client: "httpx.AsyncClient"):
+        self.client = client
+        self.last_used = time.monotonic()
+        self.in_flight = 0
+
+
+class ProxyPool:
+    """LRU of keep-alive `httpx.AsyncClient`s keyed by upstream base URL."""
+
+    def __init__(
+        self,
+        max_clients: Optional[int] = None,
+        max_connections: Optional[int] = None,
+        max_keepalive: Optional[int] = None,
+        keepalive_expiry: Optional[float] = None,
+        idle_evict: Optional[float] = None,
+        tracer=None,
+    ):
+        from dstack_tpu.server import settings
+
+        self.max_clients = max(1, max_clients or settings.PROXY_POOL_MAX_CLIENTS)
+        self.max_connections = max_connections or settings.PROXY_MAX_CONNECTIONS
+        self.max_keepalive = max_keepalive or settings.PROXY_MAX_KEEPALIVE
+        self.keepalive_expiry = keepalive_expiry or settings.PROXY_KEEPALIVE_EXPIRY
+        self.idle_evict = idle_evict or settings.PROXY_CLIENT_IDLE_EVICT
+        self.tracer = tracer
+        # Thread lock, not asyncio: /metrics stats reads may come from a
+        # different task mid-acquire, and none of the guarded sections
+        # await (same rationale as SpecCache).
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._ttfb: Dict[str, List[float]] = {}  # kind -> [sum_seconds, count]
+        self.hits = 0
+        self.misses = 0
+        self.closed = False
+
+    def _build_client(self) -> "httpx.AsyncClient":
+        # Sync on purpose — POOL01 flags AsyncClient construction in
+        # async defs; per-request deadlines ride build_request(timeout=).
+        return httpx.AsyncClient(
+            limits=httpx.Limits(
+                max_connections=self.max_connections,
+                max_keepalive_connections=self.max_keepalive,
+                keepalive_expiry=self.keepalive_expiry,
+            ),
+        )
+
+    def acquire(self, base_url: str) -> "httpx.AsyncClient":
+        """The shared client for `base_url`; pair with `release()`."""
+        victims: List["httpx.AsyncClient"] = []
+        with self._lock:
+            entry = self._entries.get(base_url)
+            if entry is None:
+                self.misses += 1
+                entry = _Entry(self._build_client())
+                self._entries[base_url] = entry
+            else:
+                self.hits += 1
+            entry.last_used = time.monotonic()
+            entry.in_flight += 1
+            self._entries.move_to_end(base_url)
+            victims = self._evict_locked()
+        for client in victims:
+            spawn_logged(client.aclose(), "proxy pool client close")
+        return entry.client
+
+    def release(self, base_url: str) -> None:
+        with self._lock:
+            entry = self._entries.get(base_url)
+            if entry is not None and entry.in_flight > 0:
+                entry.in_flight -= 1
+
+    def _evict_locked(self) -> List["httpx.AsyncClient"]:
+        """Drop idle-expired clients and LRU overflow; busy clients
+        (in-flight streams) are skipped — the bound is soft while every
+        client is mid-request. Returns clients for the caller to close
+        outside the lock."""
+        now = time.monotonic()
+        victims: List["httpx.AsyncClient"] = []
+        for key in [
+            k
+            for k, e in self._entries.items()
+            if e.in_flight == 0 and now - e.last_used > self.idle_evict
+        ]:
+            victims.append(self._entries.pop(key).client)
+        while len(self._entries) > self.max_clients:
+            lru = next(
+                (k for k, e in self._entries.items() if e.in_flight == 0), None
+            )
+            if lru is None:
+                break
+            victims.append(self._entries.pop(lru).client)
+        return victims
+
+    def observe_ttfb(self, kind: str, seconds: float) -> None:
+        """Record upstream time-to-first-byte (headers received)."""
+        with self._lock:
+            acc = self._ttfb.setdefault(kind, [0.0, 0])
+            acc[0] += seconds
+            acc[1] += 1
+
+    def ttfb_stats(self) -> Dict[str, Tuple[float, int]]:
+        with self._lock:
+            return {k: (v[0], int(v[1])) for k, v in self._ttfb.items()}
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "clients": len(self._entries),
+                "in_flight": sum(e.in_flight for e in self._entries.values()),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }
+
+    async def aclose(self) -> None:
+        """Close every pooled client (app shutdown). In-flight streams are
+        torn down with their clients — shutdown outranks stragglers."""
+        with self._lock:
+            self.closed = True
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for entry in entries:
+            await entry.client.aclose()

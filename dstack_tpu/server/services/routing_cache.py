@@ -1,0 +1,263 @@
+"""TTL + FSM-invalidated replica routing table for the proxy data plane.
+
+`pick_replica` used to issue three DB queries (project, run, jobs) plus
+two pydantic validations per proxied request; `_service_models` re-read
+every service run of the project per /models or chat-completions call.
+Replica topology only changes when the background FSM transitions a job,
+so both lookups are cached here per process:
+
+- replica targets per (project, run), parsed once via the PR 3 spec
+  cache and kept until TTL expiry or `invalidate_run()`;
+- the model list per project, same policy.
+
+`process_runs` / `process_running_jobs` call `invalidate_run(run_name)`
+on every job status transition, so the common case sees new/dead
+replicas on the very next request. The cache is PER PROCESS: with
+several server replicas sharing one DB, the FSM invalidation only
+reaches the process that stepped the job — the short TTL
+(`DSTACK_TPU_PROXY_ROUTING_TTL`) is the cross-replica staleness bound.
+
+Selection upgrades the old module-global round-robin counter to
+per-run least-outstanding-requests (long SSE generations pin a replica;
+new requests flow to the idlest one) with a per-run rotation tie-break
+and a connect-error circuit breaker: a replica that just refused a
+connection is skipped for a cooldown unless every replica tripped.
+"""
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+
+
+@dataclass(frozen=True)
+class ReplicaTarget:
+    job_id: str
+    replica_num: int
+    hostname: str
+    port: int
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.hostname}:{self.port}"
+
+
+class RoutingCache:
+    def __init__(
+        self,
+        ttl: Optional[float] = None,
+        breaker_cooldown: Optional[float] = None,
+        tracer=None,
+    ):
+        from dstack_tpu.server import settings
+
+        self.ttl = settings.PROXY_ROUTING_TTL if ttl is None else ttl
+        self.breaker_cooldown = (
+            settings.PROXY_BREAKER_COOLDOWN
+            if breaker_cooldown is None
+            else breaker_cooldown
+        )
+        self.tracer = tracer
+        # Thread lock for the same reason as SpecCache: /metrics stats
+        # reads race the request path, and no guarded section awaits.
+        self._lock = threading.Lock()
+        # (project, run) -> (expires_at, targets)
+        self._replicas: Dict[Tuple[str, str], Tuple[float, List[ReplicaTarget]]] = {}
+        # project -> (expires_at, model dicts)
+        self._models: Dict[str, Tuple[float, List[Dict[str, Any]]]] = {}
+        self._outstanding: Dict[str, int] = {}  # job_id -> in-flight requests
+        self._breaker: Dict[str, float] = {}  # job_id -> skip until (monotonic)
+        self._rr: Dict[Tuple[str, str], int] = {}  # per-run tie-break rotation
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    # ------------------------------------------------------------- lookups
+
+    async def get_replicas(
+        self, ctx, project_name: str, run_name: str
+    ) -> List[ReplicaTarget]:
+        key = (project_name, run_name)
+        now = time.monotonic()
+        with self._lock:
+            entry = self._replicas.get(key)
+            if entry is not None and entry[0] > now:
+                self.hits += 1
+                return entry[1]
+            self.misses += 1
+        targets = await self._load_replicas(ctx, project_name, run_name)
+        with self._lock:
+            self._replicas[key] = (time.monotonic() + self.ttl, targets)
+        return targets
+
+    async def _load_replicas(
+        self, ctx, project_name: str, run_name: str
+    ) -> List[ReplicaTarget]:
+        from dstack_tpu.models.runs import JobProvisioningData, JobSpec
+
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+        )
+        if project_row is None:
+            raise ResourceNotExistsError("Project not found")
+        run_row = await ctx.db.fetchone(
+            "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+            (project_row["id"], run_name),
+        )
+        if run_row is None:
+            raise ResourceNotExistsError("Run not found")
+        if run_row["service_spec"] is None:
+            raise BadRequestError("Run is not a service")
+        job_rows = await ctx.db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? AND status = 'running'"
+            " ORDER BY replica_num",
+            (run_row["id"],),
+        )
+        targets = []
+        for row in job_rows:
+            if not row["job_provisioning_data"]:
+                continue
+            spec = ctx.spec_cache.parse(JobSpec, "jobs", row["id"], row["job_spec"])
+            jpd = ctx.spec_cache.parse(
+                JobProvisioningData, "jobs", row["id"], row["job_provisioning_data"]
+            )
+            port = spec.app_specs[0].port if spec.app_specs else 80
+            targets.append(
+                ReplicaTarget(
+                    job_id=row["id"],
+                    replica_num=row["replica_num"],
+                    hostname=jpd.hostname,
+                    port=port,
+                )
+            )
+        # "No running replicas" is NOT cached: scale-from-zero wants the
+        # next request to see a replica the moment the FSM brings one up.
+        if not targets:
+            raise BadRequestError("No running replicas")
+        return targets
+
+    async def get_models(self, ctx, project_name: str) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            entry = self._models.get(project_name)
+            if entry is not None and entry[0] > now:
+                self.hits += 1
+                return entry[1]
+            self.misses += 1
+        models = await self._load_models(ctx, project_name)
+        with self._lock:
+            self._models[project_name] = (time.monotonic() + self.ttl, models)
+        return models
+
+    async def _load_models(self, ctx, project_name: str) -> List[Dict[str, Any]]:
+        import json
+
+        project_row = await ctx.db.fetchone(
+            "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+        )
+        if project_row is None:
+            raise ResourceNotExistsError("Project not found")
+        rows = await ctx.db.fetchall(
+            "SELECT * FROM runs WHERE project_id = ? AND deleted = 0"
+            " AND service_spec IS NOT NULL AND status = 'running'",
+            (project_row["id"],),
+        )
+        models = []
+        for row in rows:
+            spec = json.loads(row["service_spec"])
+            model = spec.get("model")
+            if model:
+                models.append(
+                    {
+                        "run_id": row["id"],
+                        "run_name": row["run_name"],
+                        "name": model["name"],
+                        "format": model.get("format", "openai"),
+                        "prefix": model.get("prefix", "/v1"),
+                    }
+                )
+        return models
+
+    # ----------------------------------------------------------- selection
+
+    def select(
+        self,
+        project_name: str,
+        run_name: str,
+        targets: Sequence[ReplicaTarget],
+        exclude: Sequence[str] = (),
+    ) -> ReplicaTarget:
+        """Least-outstanding replica, per-run rotation tie-break.
+
+        `exclude` removes replicas already tried this request (the
+        idempotent-retry path). Circuit-broken replicas are skipped
+        unless that leaves nothing — all-broken means the breaker is
+        wrong or the service is down, and one request finding out is
+        cheaper than failing all of them for the cooldown.
+        """
+        candidates = [t for t in targets if t.job_id not in set(exclude)]
+        if not candidates:
+            raise BadRequestError("No running replicas")
+        with self._lock:
+            now = time.monotonic()
+            for job_id in [j for j, until in self._breaker.items() if until <= now]:
+                del self._breaker[job_id]
+            live = [t for t in candidates if t.job_id not in self._breaker]
+            pool = live or candidates
+            lowest = min(self._outstanding.get(t.job_id, 0) for t in pool)
+            tied = [t for t in pool if self._outstanding.get(t.job_id, 0) == lowest]
+            key = (project_name, run_name)
+            self._rr[key] = self._rr.get(key, -1) + 1
+            return tied[self._rr[key] % len(tied)]
+
+    def start(self, job_id: str) -> None:
+        with self._lock:
+            self._outstanding[job_id] = self._outstanding.get(job_id, 0) + 1
+
+    def finish(self, job_id: str) -> None:
+        with self._lock:
+            n = self._outstanding.get(job_id, 0) - 1
+            if n > 0:
+                self._outstanding[job_id] = n
+            else:
+                self._outstanding.pop(job_id, None)
+
+    def mark_failure(self, job_id: str) -> None:
+        """Connect-stage failure: skip this replica for the cooldown."""
+        with self._lock:
+            self._breaker[job_id] = time.monotonic() + self.breaker_cooldown
+
+    def mark_success(self, job_id: str) -> None:
+        with self._lock:
+            self._breaker.pop(job_id, None)
+
+    # --------------------------------------------------------- maintenance
+
+    def invalidate_run(self, run_name: str) -> None:
+        """FSM hook: a job of `run_name` changed status. Replica entries
+        for that run are dropped; the per-project model lists are dropped
+        wholesale (cheap — they rebuild in one query, and mapping run ->
+        project here would duplicate FSM state)."""
+        with self._lock:
+            stale = [k for k in self._replicas if k[1] == run_name]
+            for key in stale:
+                del self._replicas[key]
+            if stale or self._models:
+                self.invalidations += 1
+            self._models.clear()
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "replica_entries": len(self._replicas),
+                "model_entries": len(self._models),
+                "outstanding": sum(self._outstanding.values()),
+                "broken": len(self._breaker),
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": self.invalidations,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }

@@ -61,12 +61,14 @@ async def _registry_call(ctx: ServerContext, gateway: Dict[str, Any], path: str,
     if client is not None:
         await client(gateway["host"], path, body)
         return
-    import httpx
-
     port = await _gateway_tunnel_port(gateway)
-    async with httpx.AsyncClient(timeout=15.0) as http:
-        resp = await http.post(f"http://127.0.0.1:{port}/api{path}", json=body)
+    base = f"http://127.0.0.1:{port}"
+    http = ctx.proxy_pool.acquire(base)
+    try:
+        resp = await http.post(f"{base}/api{path}", json=body, timeout=15.0)
         resp.raise_for_status()
+    finally:
+        ctx.proxy_pool.release(base)
 
 
 async def get_project_gateway(ctx: ServerContext, project_id: str) -> Optional[Dict[str, Any]]:

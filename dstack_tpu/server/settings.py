@@ -73,6 +73,27 @@ RETRY_PENDING_RUN_DELAY = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY", "1
 # doubles per submission (base * 2^(n-1), jittered) up to this cap.
 RETRY_PENDING_RUN_DELAY_CAP = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY_CAP", "300"))
 
+# Proxy data plane (services/proxy_pool.py, services/routing_cache.py;
+# docs/guides/proxy-tuning.md). One keep-alive client is cached per
+# upstream base URL; limits below are per client.
+PROXY_POOL_MAX_CLIENTS = int(os.getenv("DSTACK_TPU_PROXY_POOL_MAX_CLIENTS", "64"))
+PROXY_MAX_CONNECTIONS = int(os.getenv("DSTACK_TPU_PROXY_MAX_CONNECTIONS", "100"))
+PROXY_MAX_KEEPALIVE = int(os.getenv("DSTACK_TPU_PROXY_MAX_KEEPALIVE", "20"))
+# Keep-alive expiry is what the transport holds an idle TCP connection
+# for; idle-evict is how long an entire *client* (base URL) may go
+# unused before the pool drops it on the next access.
+PROXY_KEEPALIVE_EXPIRY = float(os.getenv("DSTACK_TPU_PROXY_KEEPALIVE_EXPIRY", "30"))
+PROXY_CLIENT_IDLE_EVICT = float(os.getenv("DSTACK_TPU_PROXY_CLIENT_IDLE_EVICT", "300"))
+PROXY_SERVICE_TIMEOUT = float(os.getenv("DSTACK_TPU_PROXY_SERVICE_TIMEOUT", "60"))
+PROXY_MODEL_TIMEOUT = float(os.getenv("DSTACK_TPU_PROXY_MODEL_TIMEOUT", "300"))
+# Replica routing table TTL: per-process, so with several server
+# replicas the FSM invalidation only reaches the local process — the
+# TTL is the cross-replica staleness bound. Keep it short.
+PROXY_ROUTING_TTL = float(os.getenv("DSTACK_TPU_PROXY_ROUTING_TTL", "3.0"))
+# How long a replica that just refused a connection is skipped by
+# selection (circuit breaker; it is retried once all replicas trip).
+PROXY_BREAKER_COOLDOWN = float(os.getenv("DSTACK_TPU_PROXY_BREAKER_COOLDOWN", "5.0"))
+
 ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); identity if unset
 
 

@@ -1,0 +1,10 @@
+"""Known-bad: POOL01 — per-request AsyncClient construction in async
+server code (fresh TCP handshake per call; must use ctx.proxy_pool)."""
+
+import httpx
+
+
+async def relay(body):
+    async with httpx.AsyncClient(timeout=5.0) as client:  # POOL01
+        resp = await client.post("http://upstream:8000/api", json=body)
+        return resp.json()

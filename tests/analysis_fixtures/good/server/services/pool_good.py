@@ -1,0 +1,19 @@
+"""Known-good shapes for POOL01: pooled acquire/release in async code,
+and sync construction (factories, __init__) which stays legal."""
+
+import httpx
+
+
+def build_client() -> "httpx.AsyncClient":
+    # Sync construction is the pool's own job — never flagged.
+    return httpx.AsyncClient(timeout=5.0)
+
+
+async def relay(ctx, body):
+    base = "http://upstream:8000"
+    client = ctx.proxy_pool.acquire(base)
+    try:
+        resp = await client.post(f"{base}/api", json=body)
+        return resp.json()
+    finally:
+        ctx.proxy_pool.release(base)

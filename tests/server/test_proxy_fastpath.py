@@ -1,0 +1,413 @@
+"""Proxy data-plane fast path: pooled upstream clients, streamed relay,
+routing cache + FSM invalidation, circuit breaker, and the adapter
+edge-cases (temperature=0, hop-by-hop header casing, per-run rotation).
+
+Upstreams are real asyncio socket servers speaking just enough keep-alive
+HTTP/1.1 to count connections and trickle chunks on demand.
+"""
+
+import asyncio
+import json
+
+from dstack_tpu.server.http import Request
+from tests.server.conftest import make_server
+
+
+class StubUpstream:
+    """Keep-alive HTTP/1.1 stub replica. Modes:
+    - json (default): Content-Length JSON response, connection stays open
+    - tgi: TGI /generate-shaped JSON response
+    - sse: SSE headers + first chunk, then blocks on `release` before the
+      second chunk (lets tests observe relay-before-upstream-finishes)
+    - truncate: declares Content-Length 100, sends 7 bytes, closes
+    """
+
+    def __init__(self, mode="json"):
+        self.mode = mode
+        self.connections = 0
+        self.requests = []
+        self.release = asyncio.Event()
+        self.sse_done = False
+        self.server = None
+
+    async def start(self) -> int:
+        self.server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    def stop(self):
+        if self.server is not None:
+            self.server.close()
+
+    async def _handle(self, reader, writer):
+        self.connections += 1
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line or request_line in (b"\r\n", b"\n"):
+                    break
+                method, target, _ = request_line.decode().split(" ", 2)
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                self.requests.append(
+                    {"method": method, "target": target, "headers": headers, "body": body}
+                )
+                if self.mode == "sse":
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+                        b"Connection: close\r\n\r\ndata: first\n\n"
+                    )
+                    await writer.drain()
+                    await self.release.wait()
+                    self.sse_done = True
+                    writer.write(b"data: second\n\n")
+                    await writer.drain()
+                    break
+                if self.mode == "truncate":
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\n"
+                        b"Content-Length: 100\r\n\r\npartial"
+                    )
+                    await writer.drain()
+                    break
+                if self.mode == "tgi":
+                    payload = json.dumps({"generated_text": "ok"}).encode()
+                else:
+                    payload = json.dumps(
+                        {"object": "chat.completion",
+                         "choices": [{"message": {"content": "hi"}}]}
+                    ).encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(payload)).encode() + b"\r\n\r\n"
+                    + payload
+                )
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+async def _make_service_run(fx, run_name, ports, model=None, fmt="openai"):
+    """Insert a RUNNING service run with one RUNNING replica job per port."""
+    ctx = fx.ctx
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    from dstack_tpu.models.runs import JobProvisioningData, JobSpec, RunSpec
+    from dstack_tpu.server.security import generate_id
+    from dstack_tpu.utils.common import utcnow_iso
+
+    run_id = generate_id()
+    now = utcnow_iso()
+    spec = RunSpec.model_validate(
+        {
+            "run_name": run_name, "repo_id": "local",
+            "configuration": {"type": "service", "name": run_name,
+                              "port": ports[0], "commands": ["serve"],
+                              "model": model},
+        }
+    )
+    service_spec = {"url": f"/proxy/services/main/{run_name}/", "model": None}
+    if model:
+        service_spec["model"] = {"name": model, "format": fmt, "prefix": "/v1"}
+    await ctx.db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+        " last_processed_at, status, run_spec, service_spec)"
+        " VALUES (?, ?, ?, ?, ?, ?, 'running', ?, ?)",
+        (run_id, project["id"], user["id"], run_name, now, now,
+         spec.model_dump_json(), json.dumps(service_spec)),
+    )
+    job_ids = []
+    for replica_num, port in enumerate(ports):
+        job_spec = JobSpec.model_validate(
+            {
+                "job_name": f"{run_name}-0-{replica_num}", "commands": ["serve"],
+                "requirements": {"resources": {}},
+                "app_specs": [{"app_name": "app", "port": port}],
+            }
+        )
+        jpd = JobProvisioningData.model_validate(
+            {
+                "backend": "local",
+                "instance_type": {"name": "local",
+                                  "resources": {"cpus": 1, "memory_mib": 1024}},
+                "instance_id": f"i-{replica_num}", "hostname": "127.0.0.1",
+                "internal_ip": "127.0.0.1", "region": "local", "price": 0.0,
+                "username": "root", "dockerized": False,
+            }
+        )
+        job_id = generate_id()
+        job_ids.append(job_id)
+        await ctx.db.execute(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num,"
+            " replica_num, submitted_at, last_processed_at, status, job_spec,"
+            " job_provisioning_data) VALUES (?, ?, ?, ?, 0, ?, ?, ?, 'running', ?, ?)",
+            (job_id, project["id"], run_id, run_name, replica_num, now, now,
+             job_spec.model_dump_json(), jpd.model_dump_json()),
+        )
+    return run_id, job_ids
+
+
+async def _drain(resp) -> bytes:
+    """Streamed proxy responses reach the TestClient unconsumed."""
+    if resp.stream is None:
+        return resp.body
+    return b"".join([chunk async for chunk in resp.stream])
+
+
+def _counter(ctx, name, **labels):
+    for c in ctx.tracer.counter_snapshot():
+        if c["name"] == name and all(c["labels"].get(k) == v for k, v in labels.items()):
+            return c["value"]
+    return 0
+
+
+async def test_pooled_client_reused_across_sequential_requests():
+    stub = StubUpstream()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "svc", [port])
+        base = f"http://127.0.0.1:{port}"
+
+        r = await fx.client.get("/proxy/services/main/svc/hello")
+        assert r.status == 200
+        await _drain(r)
+        first_client = fx.ctx.proxy_pool.acquire(base)
+        fx.ctx.proxy_pool.release(base)
+
+        r = await fx.client.get("/proxy/services/main/svc/hello")
+        assert r.status == 200
+        await _drain(r)
+        second_client = fx.ctx.proxy_pool.acquire(base)
+        fx.ctx.proxy_pool.release(base)
+
+        assert first_client is second_client  # same pooled client object
+        assert stub.connections == 1  # keep-alive: one TCP connection total
+        assert fx.ctx.proxy_pool.stats()["in_flight"] == 0
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_sse_relay_delivers_first_chunk_before_upstream_finishes():
+    stub = StubUpstream(mode="sse")
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "sse-svc", [port], model="m1")
+        resp = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "m1", "stream": True,
+             "messages": [{"role": "user", "content": "go"}]},
+        )
+        assert resp.status == 200
+        assert resp.stream is not None
+        agen = resp.stream.__aiter__()
+        first = await asyncio.wait_for(agen.__anext__(), timeout=5)
+        # The relay forwarded bytes while the upstream is still mid-
+        # generation (blocked on `release`) — TTFB decoupled from total.
+        assert b"first" in first
+        assert not stub.sse_done
+        stub.release.set()
+        rest = b"".join([chunk async for chunk in agen])
+        assert b"second" in rest
+        assert fx.ctx.proxy_pool.stats()["in_flight"] == 0
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_upstream_midstream_error_terminates_relay_cleanly():
+    stub = StubUpstream(mode="truncate")
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "trunc-svc", [port])
+        resp = await fx.client.get("/proxy/services/main/trunc-svc/blob")
+        assert resp.status == 200
+        # Upstream dies after 7 of 100 declared bytes: the relay yields
+        # what arrived and ends the chunked stream without raising.
+        body = await _drain(resp)
+        assert body == b"partial"
+        assert fx.ctx.proxy_pool.stats()["in_flight"] == 0
+        assert fx.ctx.routing_cache.stats()["outstanding"] == 0
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_routing_cache_hit_and_fsm_invalidation():
+    stub = StubUpstream()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    ctx = fx.ctx
+    try:
+        await _make_service_run(fx, "cached-svc", [port])
+        # Long TTL: anything observed below is invalidation, not expiry.
+        ctx.routing_cache.ttl = 300.0
+
+        r = await fx.client.get("/proxy/services/main/cached-svc/a")
+        assert r.status == 200 and await _drain(r) is not None
+        misses = ctx.routing_cache.stats()["misses"]
+
+        # Job dies in the DB — the cached route still serves (per-process
+        # cache, no FSM tick yet), and without a single new DB read.
+        await ctx.db.execute(
+            "UPDATE jobs SET status = 'failed' WHERE run_name = 'cached-svc'"
+        )
+        r = await fx.client.get("/proxy/services/main/cached-svc/b")
+        assert r.status == 200 and await _drain(r) is not None
+        assert ctx.routing_cache.stats()["misses"] == misses
+        assert ctx.routing_cache.stats()["hits"] >= 1
+
+        # The FSM observes the failure -> terminating transition ->
+        # invalidate hook. The very next request sees no live replica.
+        from dstack_tpu.server.background.tasks.process_runs import process_runs
+
+        await process_runs(ctx)
+        r = await fx.client.get("/proxy/services/main/cached-svc/c")
+        assert r.status == 400
+        assert "No running replicas" in (await _drain(r)).decode()
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_circuit_breaker_skips_dead_replica():
+    stub = StubUpstream()
+    live_port = await stub.start()
+    # A port with nothing listening: connect refused deterministically.
+    probe = await asyncio.start_server(lambda r, w: None, "127.0.0.1", 0)
+    dead_port = probe.sockets[0].getsockname()[1]
+    probe.close()
+    await probe.wait_closed()
+
+    fx = await make_server(run_background_tasks=False)
+    ctx = fx.ctx
+    try:
+        await _make_service_run(fx, "cb-svc", [dead_port, live_port])
+        ctx.routing_cache.breaker_cooldown = 60.0  # keep the breaker open
+
+        for _ in range(6):
+            r = await fx.client.get("/proxy/services/main/cb-svc/ping")
+            assert r.status == 200  # idempotent retry hides the dead replica
+            await _drain(r)
+        # Only the first request paid the connect error; every later pick
+        # skipped the circuit-broken replica.
+        assert _counter(ctx, "proxy_upstream_errors", kind="service") == 1
+        assert len(stub.requests) == 6
+        assert ctx.routing_cache.stats()["broken"] == 1
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_per_run_rotation_unskewed_by_other_services():
+    stub_a0, stub_a1, stub_b = StubUpstream(), StubUpstream(), StubUpstream()
+    pa0, pa1, pb = await stub_a0.start(), await stub_a1.start(), await stub_b.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "svc-a", [pa0, pa1])
+        await _make_service_run(fx, "svc-b", [pb])
+        # Interleave B's traffic; A must still alternate its own replicas
+        # (the old module-global round-robin counter skewed on this).
+        for _ in range(2):
+            for path in ("/proxy/services/main/svc-a/x",
+                         "/proxy/services/main/svc-b/x",
+                         "/proxy/services/main/svc-a/x"):
+                r = await fx.client.get(path)
+                assert r.status == 200
+                await _drain(r)
+        assert len(stub_a0.requests) == 2
+        assert len(stub_a1.requests) == 2
+        assert len(stub_b.requests) == 2
+    finally:
+        stub_a0.stop(); stub_a1.stop(); stub_b.stop()
+        await fx.app.shutdown()
+
+
+async def test_tgi_temperature_zero_passes_through():
+    stub = StubUpstream(mode="tgi")
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "tgi-svc", [port], model="flan", fmt="tgi")
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "flan", "temperature": 0, "top_p": 0,
+             "messages": [{"role": "user", "content": "greedy"}]},
+        )
+        assert r.status == 200
+        sent = json.loads(stub.requests[0]["body"])
+        # temperature=0 / top_p=0 are valid greedy settings; the old
+        # `body.get(...) or None` silently dropped them.
+        assert sent["parameters"]["temperature"] == 0
+        assert sent["parameters"]["top_p"] == 0
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_hop_headers_stripped_case_insensitively_and_query_forwarded():
+    stub = StubUpstream()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "hdr-svc", [port])
+        # Hand-built Request: the socket server lowercases parsed headers,
+        # but the proxy must not rely on that (the old filter compared raw
+        # keys against a lowercase set).
+        req = Request(
+            method="GET",
+            path="/proxy/services/main/hdr-svc/echo",
+            query={"a": ["1"], "b": ["two"]},
+            headers={"Connection": "keep-alive", "Transfer-Encoding": "chunked",
+                     "X-Custom": "yes"},
+            body=b"",
+        )
+        resp = await fx.app.handle(req)
+        assert resp.status == 200
+        await _drain(resp)
+        seen = stub.requests[0]
+        assert "?a=1" in seen["target"] and "b=two" in seen["target"]
+        assert seen["headers"].get("x-custom") == "yes"
+        assert "transfer-encoding" not in seen["headers"]
+        assert seen["headers"].get("connection", "keep-alive") == "keep-alive"
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_metrics_expose_proxy_series():
+    stub = StubUpstream()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "met-svc", [port], model="m1")
+        r = await fx.client.get("/proxy/services/main/met-svc/x")
+        await _drain(r)
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "m1", "messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert r.status == 200
+        metrics = (await fx.client.get("/metrics")).body.decode()
+        assert 'dstack_tpu_proxy_requests_total{kind="service"} 1' in metrics
+        assert 'dstack_tpu_proxy_requests_total{kind="model"} 1' in metrics
+        assert "dstack_tpu_proxy_pool_connections" in metrics
+        assert 'dstack_tpu_proxy_ttfb_seconds_sum{kind="service"}' in metrics
+        assert 'dstack_tpu_proxy_ttfb_seconds_count{kind="model"} 1' in metrics
+        assert "dstack_tpu_proxy_routing_cache_hit_rate" in metrics
+    finally:
+        stub.stop()
+        await fx.app.shutdown()

@@ -42,7 +42,10 @@ def _keys(report, code):
 def test_bad_fixtures_trip_every_checker():
     report = run_analysis([BAD], root=BAD)
     assert report.errors == []
-    assert _codes(report) == ["ASY01", "ASY02", "LCK01", "LCK02", "MET01", "SQL01"]
+    assert _codes(report) == [
+        "ASY01", "ASY02", "LCK01", "LCK02", "MET01", "POOL01", "SQL01",
+    ]
+    assert _keys(report, "POOL01") == ["httpx.AsyncClient"]
     assert _keys(report, "ASY01") == [".read_text", "requests.get", "time.sleep"]
     assert _keys(report, "ASY02") == ["create_task", "notify"]
     assert _keys(report, "LCK01") == ["update:runs"]
@@ -190,8 +193,10 @@ def test_cli_json_contract(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert payload["exit_code"] == 1
-    assert payload["files_scanned"] == 5
-    assert set(payload["checkers"]) >= {"ASY01", "ASY02", "LCK01", "LCK02", "SQL01", "MET01"}
+    assert payload["files_scanned"] == 6
+    assert set(payload["checkers"]) >= {
+        "ASY01", "ASY02", "LCK01", "LCK02", "SQL01", "MET01", "POOL01",
+    }
     sample = payload["findings"][0]
     assert {"code", "message", "path", "line", "fingerprint"} <= set(sample)
 
