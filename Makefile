@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast lint lint-json capacity capacity-smoke bench-proxy
+.PHONY: test test-all chaos chaos-fast lint lint-json capacity capacity-smoke bench-proxy bench-serving
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -40,6 +40,13 @@ capacity:
 # see docs/guides/proxy-tuning.md for how to read them.
 bench-proxy:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r07.json
+
+# Serving-engine benchmark: chunked prefill + paged KV with prefix
+# sharing (warmed-burst TTFT and shared-prefix accounting scenarios).
+# Results land in BENCH_serving_r08.json; see
+# docs/guides/serving-tuning.md for how to read them.
+bench-serving:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r08.json
 
 # CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
 capacity-smoke:

@@ -48,6 +48,23 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_proxy_ttfb_seconds_count": ("counter", ("kind",)),
     "dstack_tpu_proxy_ttfb_seconds_sum": ("counter", ("kind",)),
     "dstack_tpu_proxy_upstream_errors_total": ("counter", ("kind",)),
+    # Serving engine (workloads/serving.py `prometheus_metrics`, exposed
+    # by the native model server's /metrics): paged-KV pool occupancy,
+    # prefix-cache effectiveness, chunked-prefill accounting, and the
+    # admission counters behind the TTFT summary.
+    "dstack_tpu_serving_admitted_total": ("counter", ()),
+    "dstack_tpu_serving_kv_blocks_cached": ("gauge", ()),
+    "dstack_tpu_serving_kv_blocks_in_use": ("gauge", ()),
+    "dstack_tpu_serving_kv_cow_copies_total": ("counter", ()),
+    "dstack_tpu_serving_pending_requests": ("gauge", ()),
+    "dstack_tpu_serving_prefill_chunks_total": ("counter", ()),
+    "dstack_tpu_serving_prefill_tokens_total": ("counter", ()),
+    "dstack_tpu_serving_prefix_cache_hits_total": ("counter", ()),
+    "dstack_tpu_serving_prefix_cache_misses_total": ("counter", ()),
+    "dstack_tpu_serving_prefix_tokens_reused_total": ("counter", ()),
+    "dstack_tpu_serving_rejected_total": ("counter", ()),
+    "dstack_tpu_serving_slots_active": ("gauge", ()),
+    "dstack_tpu_serving_ttft_seconds_sum": ("counter", ()),
     # Spec cache (PR 3).
     "dstack_tpu_spec_cache_entries": ("gauge", ()),
     "dstack_tpu_spec_cache_hit_rate": ("gauge", ()),

@@ -1,0 +1,465 @@
+"""Paged KV cache: block pool + prefix sharing + chunked prefill.
+
+The dense serving layout (one `(max_len, KV, hd)` strip per slot) wastes
+HBM twice: a short request reserves the whole strip, and N requests that
+share a system prompt hold N copies of its KV. This module replaces the
+strip with a vLLM-style *block pool* — `k`/`v` are
+`(L, num_blocks, block_size, KV, hd)` and each slot owns an int32 *block
+table* row mapping its logical cache positions to pool blocks — plus:
+
+- a host-side `BlockAllocator` with refcounts and a hash-chained prefix
+  cache (full blocks keyed by the sha1 chain of their token contents,
+  partial tails keyed by `(parent_hash, tail_tokens)`), so a request
+  whose prompt prefix was already prefilled retains the existing blocks
+  instead of recomputing them; writers copy-on-write any block they
+  share (`ensure_writable`);
+- `make_chunk_prefill`: prefill one budget-bounded token chunk of one
+  prompt directly into the pool, so a long prompt interleaves with
+  decode chunks instead of monopolizing the device;
+- `make_paged_decode_step`: gathers each slot's dense view from the
+  pool, runs the *same* per-token decode body as the dense path
+  (`serving._decode_body` — numerics cannot drift), and scatters only
+  the newly written rows back.
+
+Correctness leans on two XLA facts (pallas_guide: gather/scatter modes):
+garbage in unwritten or stale pool blocks is harmless because attention
+masks positions `>= valid_len` with a `jnp.where` *before* softmax (all
+pool gathers use `mode="clip"` so padding never introduces NaN — a NaN
+value row would survive masking as `0 * NaN`), and all pool writes use
+`mode="drop"` with an out-of-bounds sentinel index (`num_blocks` for
+blocks, `max_len` for rows) so padded lanes simply vanish instead of
+clobbering block 0. Chunk writes into the gathered dense view use an
+explicit row scatter, never `lax.dynamic_update_slice` — DUS *clamps*
+the start index when `start + C` overruns, silently shifting the write.
+"""
+
+import functools
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dstack_tpu.workloads.config import ModelConfig
+from dstack_tpu.workloads.generate import _cached_attention, sample_logits_row
+from dstack_tpu.workloads.transformer import (
+    linear,
+    logits_linear,
+    mlp_block,
+    project_qkv,
+    rms_norm,
+)
+
+Params = Dict[str, Any]
+
+
+class PagedDecodeState(NamedTuple):
+    """Block-pool decode state. Per-slot scalar fields carry the SAME
+    names as serving.DecodeState so the sampling gates
+    (`_any_active_nucleus` / `_any_active_sampling`) and engine-level
+    tests work on either."""
+
+    k: jnp.ndarray            # (L, num_blocks, block_size, KV, hd)
+    v: jnp.ndarray
+    block_tables: jnp.ndarray  # (B, max_blocks) int32; pad = num_blocks
+    lengths: jnp.ndarray      # (B,) filled cache positions
+    last_token: jnp.ndarray   # (B,) next token to feed
+    active: jnp.ndarray       # (B,) bool
+    remaining: jnp.ndarray    # (B,) new tokens still budgeted
+    temperature: jnp.ndarray  # (B,) f32; 0 = greedy
+    top_p: jnp.ndarray        # (B,) f32; 1 = no filtering
+
+
+def init_paged_state(
+    config: ModelConfig,
+    batch: int,
+    max_len: int,
+    block_size: int,
+    num_blocks: int,
+) -> PagedDecodeState:
+    c = config
+    if max_len % block_size != 0:
+        raise ValueError(
+            f"kv_block_size {block_size} must divide max_len {max_len}"
+        )
+    max_blocks = max_len // block_size
+    shape = (c.n_layers, num_blocks, block_size, c.n_kv_heads, c.head_dim)
+    return PagedDecodeState(
+        k=jnp.zeros(shape, c.activation_dtype),
+        v=jnp.zeros(shape, c.activation_dtype),
+        block_tables=jnp.full((batch, max_blocks), num_blocks, jnp.int32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+        last_token=jnp.zeros((batch,), jnp.int32),
+        active=jnp.zeros((batch,), bool),
+        remaining=jnp.zeros((batch,), jnp.int32),
+        temperature=jnp.zeros((batch,), jnp.float32),
+        top_p=jnp.ones((batch,), jnp.float32),
+    )
+
+
+# -- host-side allocator ------------------------------------------------------
+
+
+def _chain_hash(parent: bytes, block_tokens) -> bytes:
+    """sha1 chain over block contents: a block's key commits to every
+    token before it, so equal hashes mean equal logical prefixes."""
+    return hashlib.sha1(parent + repr(tuple(block_tokens)).encode()).digest()
+
+
+class BlockAllocator:
+    """Refcounted free-list over the pool + LRU prefix cache.
+
+    NOT thread-safe — the engine serializes calls under its own lock.
+    Refcount convention: `_ref[b]` counts holders (one per task/slot
+    table referencing b, plus one if the prefix cache retains it). A
+    block leaves the free list only via `alloc()` and returns only when
+    its refcount hits zero; cached blocks therefore never free until
+    evicted. Cache keys: `("F", h)` for a full block (h = chain hash
+    through that block), `("P", h, tail_tokens)` for a partial tail
+    whose parent chain is h. Evicting a parent leaves children
+    unreachable (the match walk stops at the gap); they age out via LRU.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, cache: bool = True):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.cache_enabled = cache
+        self._free: List[int] = list(range(num_blocks))
+        self._ref = [0] * num_blocks
+        self._cache: "OrderedDict[tuple, int]" = OrderedDict()
+        self._block_key: Dict[int, tuple] = {}
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.cow_copies = 0
+        self.evictions = 0
+
+    @property
+    def in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def cached(self) -> int:
+        return len(self._cache)
+
+    def alloc(self) -> Optional[int]:
+        """Pop a free block (ref=1), evicting the LRU cache entry whose
+        block is solely cache-held if that's what it takes; None when
+        every block is pinned by a live table. Entries for table-held
+        blocks are deliberately NOT dropped — they cost nothing now and
+        can still serve matches (or free later when the table retires)."""
+        if not self._free:
+            victim = next((k for k, b in self._cache.items()
+                           if self._ref[b] == 1), None)
+            if victim is None:
+                return None
+            b = self._cache.pop(victim)
+            del self._block_key[b]
+            self.evictions += 1
+            self._ref[b] -= 1
+            self._free.append(b)
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    def release(self, b: int) -> None:
+        self._ref[b] -= 1
+        assert self._ref[b] >= 0, f"double release of block {b}"
+        if self._ref[b] == 0:
+            self._free.append(b)
+
+    def retain(self, b: int) -> None:
+        self._ref[b] += 1
+
+    def ensure_writable(self, b: int) -> Tuple[Optional[int], bool]:
+        """(block, needs_copy): a privately held block is returned as-is;
+        a shared one is swapped for a fresh allocation the caller must
+        copy-on-write into (our share of the old block is released)."""
+        if self._ref[b] <= 1:
+            return b, False
+        nb = self.alloc()
+        if nb is None:
+            return None, False
+        self._ref[b] -= 1
+        self.cow_copies += 1
+        return nb, True
+
+    def match(self, tokens: List[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `tokens`: full blocks down the hash
+        chain, then the longest partial tail. Matched blocks are
+        RETAINED for the caller (released like any table block). At
+        least one trailing token is always left uncovered — the prefill
+        must compute the last prompt position's logits to sample the
+        first token."""
+        if not self.cache_enabled:
+            return [], 0
+        bs = self.block_size
+        limit = len(tokens) - 1
+        blocks: List[int] = []
+        h = b""
+        matched = 0
+        while (len(blocks) + 1) * bs <= limit:
+            h2 = _chain_hash(h, tokens[matched:matched + bs])
+            b = self._cache.get(("F", h2))
+            if b is None:
+                break
+            self._cache.move_to_end(("F", h2))
+            self._ref[b] += 1
+            blocks.append(b)
+            matched += bs
+            h = h2
+        for f in range(min(limit - matched, bs - 1), 0, -1):
+            key = ("P", h, tuple(tokens[matched:matched + f]))
+            b = self._cache.get(key)
+            if b is not None:
+                self._cache.move_to_end(key)
+                self._ref[b] += 1
+                blocks.append(b)
+                matched += f
+                break
+        if matched:
+            self.hits += 1
+        else:
+            self.misses += 1
+        self.tokens_reused += matched
+        return blocks, matched
+
+    def insert_full(self, tokens: List[int], table: List[int]) -> None:
+        """Publish every complete prompt block of a finalized prefill.
+        Called at finalize DISPATCH time: device program order guarantees
+        the chunk writes complete before any later matcher's gather runs,
+        so publishing early is safe and maximizes burst hit rate."""
+        if not self.cache_enabled:
+            return
+        bs = self.block_size
+        h = b""
+        for i in range(len(tokens) // bs):
+            h = _chain_hash(h, tokens[i * bs:(i + 1) * bs])
+            key = ("F", h)
+            if key in self._cache:
+                self._cache.move_to_end(key)
+                continue
+            if i >= len(table) or table[i] in self._block_key:
+                continue
+            b = table[i]
+            self._cache[key] = b
+            self._block_key[b] = key
+            self._ref[b] += 1
+
+    def insert_tail(self, tokens: List[int], table: List[int]) -> None:
+        """Publish the partial-tail prompt block at RETIRE time (no live
+        writer left). The block also holds this request's decode KV past
+        the tail — harmless: a matcher's valid region ends at the tail,
+        and attention masks everything beyond it."""
+        if not self.cache_enabled:
+            return
+        bs = self.block_size
+        nfull = len(tokens) // bs
+        f = len(tokens) - nfull * bs
+        if f == 0 or nfull >= len(table):
+            return
+        h = b""
+        for i in range(nfull):
+            h = _chain_hash(h, tokens[i * bs:(i + 1) * bs])
+        key = ("P", h, tuple(tokens[nfull * bs:]))
+        if key in self._cache or table[nfull] in self._block_key:
+            return
+        b = table[nfull]
+        self._cache[key] = b
+        self._block_key[b] = key
+        self._ref[b] += 1
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "blocks_total": self.num_blocks,
+            "blocks_in_use": self.in_use,
+            "blocks_cached": self.cached,
+            "hits": self.hits,
+            "misses": self.misses,
+            "tokens_reused": self.tokens_reused,
+            "cow_copies": self.cow_copies,
+            "evictions": self.evictions,
+        }
+
+
+# -- jitted programs ----------------------------------------------------------
+
+
+def make_chunk_prefill(config: ModelConfig, chunk: int):
+    """chunk_prefill(params, state, slot, table_row (MB,), tokens (1, C),
+    n_valid, start, budget, temp, top_p, rng, finalize) ->
+    (state, first_token ()).
+
+    Runs ONE padded chunk (C = `chunk` tokens, first `n_valid` real) of
+    one prompt at cache positions [start, start + n_valid) straight into
+    the slot's pool blocks. Everything but C is traced, so the compile
+    cache holds one entry per pow-2 chunk bucket regardless of prompt
+    length, start offset, or sampling params. `first` is only meaningful
+    when `finalize` is set (last chunk): it samples the last prompt
+    position's logits exactly like the dense `make_prefill`. Finalize
+    also flips the slot live on device (lengths/last_token/active/...)
+    so no separate insert program is needed.
+    """
+    c = config
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def chunk_prefill(params, state: PagedDecodeState, slot, table_row,
+                      tokens, n_valid, start, budget, temp, top_p, rng,
+                      finalize):
+        C = tokens.shape[1]
+        bs = state.k.shape[2]
+        nb = state.k.shape[1]
+        mb = state.block_tables.shape[1]
+        ml = mb * bs
+        offs = jnp.arange(C, dtype=jnp.int32)
+        positions = start + offs                     # (C,)
+        valid = offs < n_valid                       # (C,)
+        # Dense-view row index per chunk lane; padded lanes -> ml (drop).
+        rows_idx = jnp.where(valid, positions, ml)
+        # Pool scatter targets; padded lanes -> block nb (drop).
+        blk = jnp.take(
+            table_row, jnp.clip(positions // bs, 0, mb - 1), mode="clip"
+        )
+        blk = jnp.where(valid, blk, nb)
+        off = positions % bs
+        # Row i of the chunk attends cache positions <= start + i.
+        valid_len = start + 1 + offs
+
+        x = jnp.take(params["embed"], tokens, axis=0)  # (1, C, d)
+
+        def body(x, layer):
+            p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
+            q, k, v = project_qkv(c, x, p, positions)
+            # Gather this slot's dense view (clip: pad entries read
+            # garbage that valid_len masks; never NaN-fill).
+            dk = jnp.take(ck, table_row, axis=0, mode="clip")
+            dv = jnp.take(cv, table_row, axis=0, mode="clip")
+            dk = dk.reshape(ml, *ck.shape[2:])[None]
+            dv = dv.reshape(ml, *cv.shape[2:])[None]
+            dk = dk.at[0, rows_idx].set(k[0].astype(dk.dtype), mode="drop")
+            dv = dv.at[0, rows_idx].set(v[0].astype(dv.dtype), mode="drop")
+            attn = _cached_attention(q, dk, dv, valid_len)
+            x = x + linear(attn, p["wo"])
+            if c.n_experts > 0:
+                from dstack_tpu.workloads.moe import moe_block
+
+                x, _ = moe_block(c, x, p)
+            else:
+                x = mlp_block(c, x, p)
+            ck = ck.at[blk, off].set(k[0].astype(ck.dtype), mode="drop")
+            cv = cv.at[blk, off].set(v[0].astype(cv.dtype), mode="drop")
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
+        h = rms_norm(x, params["final_norm"], c.norm_eps)
+        h_last = jnp.take(
+            h[0], jnp.clip(n_valid - 1, 0, C - 1), axis=0, mode="clip"
+        )
+        logits = logits_linear(h_last[None], params["lm_head"])[0]
+        first = sample_logits_row(logits, temp, top_p, rng)
+
+        B = state.lengths.shape[0]
+        sel = (jnp.arange(B, dtype=jnp.int32) == slot) & finalize
+        prompt_len = start + n_valid
+        new_state = PagedDecodeState(
+            k=new_k,
+            v=new_v,
+            block_tables=state.block_tables.at[slot].set(table_row),
+            lengths=jnp.where(sel, prompt_len, state.lengths),
+            last_token=jnp.where(sel, first, state.last_token),
+            active=jnp.where(sel, budget > 1, state.active),
+            remaining=jnp.where(sel, budget - 1, state.remaining),
+            temperature=jnp.where(sel, temp, state.temperature),
+            top_p=jnp.where(sel, top_p, state.top_p),
+        )
+        return new_state, first
+
+    return chunk_prefill
+
+
+def make_paged_decode_step(config: ModelConfig, steps: int = 1):
+    """decode_step(params, state, rng) -> (state, tokens (B, steps),
+    active) over a PagedDecodeState — the paged twin of
+    serving.make_decode_step.
+
+    One gather materializes every slot's dense view from the pool, the
+    dense decode body (`serving._decode_body` — the SAME traced function
+    the dense path jits, so the two cannot drift numerically) scans
+    `steps` tokens over it, and one scatter writes back only the
+    `steps` newly produced rows per slot. Gather/scatter cost is
+    amortized over the whole chunk. Distinct valid (slot, step) lanes
+    land in distinct (block, offset) cells — slots own disjoint blocks —
+    so the scatter has no collisions; lanes past a slot's final length
+    (inactive or retired mid-chunk) are dropped via the OOB block index.
+    """
+    # Function-level import: serving imports this module at load time,
+    # and engines construct only after both modules exist.
+    from dstack_tpu.workloads import serving as _serving
+
+    one_step = _serving._decode_body(config)
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def decode_steps(params, state: PagedDecodeState, rng):
+        L, nb, bs = state.k.shape[0], state.k.shape[1], state.k.shape[2]
+        B, mb = state.block_tables.shape
+        ml = mb * bs
+        dk = jnp.take(state.k, state.block_tables, axis=1, mode="clip")
+        dv = jnp.take(state.v, state.block_tables, axis=1, mode="clip")
+        dk = dk.reshape(L, B, ml, *state.k.shape[3:])
+        dv = dv.reshape(L, B, ml, *state.v.shape[3:])
+        dstate = _serving.DecodeState(
+            k=dk, v=dv, lengths=state.lengths, last_token=state.last_token,
+            active=state.active, remaining=state.remaining,
+            temperature=state.temperature, top_p=state.top_p,
+        )
+
+        def body(carry, step_rng):
+            st, _ = carry
+            st, toks, active = one_step(params, st, step_rng)
+            return (st, active), toks
+
+        (dstate, active), toks = lax.scan(
+            body, (dstate, state.active), jax.random.split(rng, steps)
+        )
+
+        pos = state.lengths[:, None] + jnp.arange(steps, dtype=jnp.int32)[None, :]
+        written = (pos < dstate.lengths[:, None]) & (pos < ml)  # (B, steps)
+        blk = jnp.take_along_axis(
+            state.block_tables, jnp.clip(pos // bs, 0, mb - 1), axis=1
+        )
+        blk = jnp.where(written, blk, nb)
+        off = pos % bs
+        cp = jnp.clip(pos, 0, ml - 1)[None, :, :, None, None]
+        rows_k = jnp.take_along_axis(dstate.k, cp, axis=2)  # (L, B, steps, KV, hd)
+        rows_v = jnp.take_along_axis(dstate.v, cp, axis=2)
+        new_state = PagedDecodeState(
+            k=state.k.at[:, blk, off].set(rows_k, mode="drop"),
+            v=state.v.at[:, blk, off].set(rows_v, mode="drop"),
+            block_tables=state.block_tables,
+            lengths=dstate.lengths,
+            last_token=dstate.last_token,
+            active=dstate.active,
+            remaining=dstate.remaining,
+            temperature=dstate.temperature,
+            top_p=dstate.top_p,
+        )
+        return new_state, toks.T, dstate.active
+
+    return decode_steps
+
+
+def make_copy_block():
+    """copy_block(state, src, dst): copy one pool block across every
+    layer — the device half of copy-on-write (the allocator's
+    `ensure_writable` picks dst; the engine swaps the table entry)."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def copy_block(state: PagedDecodeState, src, dst):
+        return state._replace(
+            k=state.k.at[:, dst].set(state.k[:, src]),
+            v=state.v.at[:, dst].set(state.v[:, src]),
+        )
+
+    return copy_block

@@ -3,32 +3,41 @@
 `generate.py` decodes one request at a time; this module keeps a fixed
 batch of B *slots* stepping together so new requests join mid-flight and
 finished ones free their slot immediately — the standard way to keep the
-MXU busy while serving many streams. Everything is static-shaped and
-compiles three kinds of program:
+MXU busy while serving many streams.
 
-- prefill (one per prompt-length bucket): runs the prompt through the
-  cached forward, returns the slot's KV rows + the FIRST TOKEN, sampled
-  on device — admission needs no host round-trip;
-- insert: writes a BATCH of prefilled requests (same prompt bucket) into
-  the shared decode state in one donated call;
-- decode_step: one token for ALL active slots — per-slot positions, a
-  per-row validity mask instead of generate.py's shared scalar length.
+The KV cache is PAGED (workloads/kv_blocks.py): slots index a shared
+block pool through per-slot block tables instead of owning dense
+`max_len` strips, so short requests hold only the blocks they filled and
+requests sharing a prompt prefix share its blocks refcounted
+(copy-on-write on divergence). Prompt admission is CHUNKED: each loop
+iteration dispatches at most `prefill_chunk_tokens` prompt tokens —
+split across up to `max_prefills_per_chunk` requests — before the
+decode chunk, so a long prompt never stalls in-flight decodes for more
+than one chunk budget and TTFT under burst stops scaling with
+prompt_len × streams.
 
-The host loop (`ServingEngine`) owns request queues and streams tokens
-out as they land, which is what SSE serving wants. Prefill never stalls
-decode: each iteration dispatches the decode chunk first (JAX async
-dispatch returns immediately), then does admission host work — popping
-pending requests and dispatching their prefills — WHILE the chunk
-executes on device, and only then syncs on the chunk's tokens. Up to
-`max_prefills_per_chunk` requests are admitted per chunk boundary so
-decode cadence stays bounded under admission bursts. Greedy decoding
-keeps slot results bit-identical to `generate(temperature=0)` — pinned
-by tests/test_serving.py.
+Three kinds of jitted program run the engine:
 
-Prefill/insert compile once per distinct prompt LENGTH — callers should
-bucket prompts (pad at the content level like the example server does,
-or truncate) so the compile cache stays small; decode_step compiles once
-regardless.
+- chunk_prefill (one per pow-2 chunk bucket): one prompt chunk straight
+  into the slot's pool blocks; the final chunk samples the first token
+  on device AND flips the slot live — admission needs no insert program
+  and no host round-trip;
+- paged decode_step: `steps_per_sync` tokens for ALL active slots per
+  host sync (gather dense views → the shared dense decode body →
+  scatter new rows back);
+- copy_block: the device half of copy-on-write.
+
+First tokens are delivered by a dedicated reader thread the moment the
+prefill readback lands — because prefill chunks are dispatched BEFORE
+the decode chunk each iteration, that readback completes while the
+decode chunk still runs, so TTFT no longer pays the decode-chunk
+residual (the 191 ms term in BENCH_serving_r06 at steps_per_sync=32).
+
+The dense primitives (DecodeState / make_prefill / make_insert /
+make_decode_step) remain the reference semantics — `_decode_body` is
+the single traced decode-step body both paths share, and
+tests/test_serving_paged.py pins chunked+paged token streams to them
+bit-exactly at temperature 0.
 """
 
 import functools
@@ -41,9 +50,21 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
+from dstack_tpu.workloads.attention import decode_attention
 from dstack_tpu.workloads.config import ModelConfig
-from dstack_tpu.workloads.generate import KVCache, _forward_cached
+from dstack_tpu.workloads.generate import (
+    KVCache,
+    _forward_cached,
+    _nucleus_filter,
+    sample_logits_row,
+)
+from dstack_tpu.workloads.kv_blocks import (
+    BlockAllocator,
+    init_paged_state,
+    make_chunk_prefill,
+    make_copy_block,
+    make_paged_decode_step,
+)
 from dstack_tpu.workloads.transformer import (
     linear,
     logits_linear,
@@ -53,6 +74,10 @@ from dstack_tpu.workloads.transformer import (
 )
 
 Params = Dict[str, Any]
+
+# Moved to attention.py (the paged path shares it); old name kept for
+# the engine-internal call sites and external pins.
+_decode_attention = decode_attention
 
 
 class DecodeState(NamedTuple):
@@ -83,36 +108,17 @@ def init_decode_state(config: ModelConfig, batch: int, max_len: int) -> DecodeSt
     )
 
 
-def _decode_attention(q, ck, cv, valid_len):
-    """q (B, 1, H, hd) vs cache (B, max_len, KV, hd); per-ROW validity
-    (generate._cached_attention masks per-position instead — decode slots
-    are at different lengths)."""
-    b, s, h, hd = q.shape
-    k = _repeat_kv(ck, h // ck.shape[2])
-    v = _repeat_kv(cv, h // ck.shape[2])
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * (hd ** -0.5)
-    kpos = jnp.arange(ck.shape[1], dtype=jnp.int32)
-    mask = kpos[None, :] < valid_len[:, None]          # (B, max_len)
-    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
-    )
-    return out.astype(q.dtype).reshape(b, s, h * hd)
-
-
 def make_prefill(config: ModelConfig):
     """prefill(params, tokens (1, S), temp, top_p, rng) ->
     (k (L,1,S,KV,hd), v, first_token ()).
 
-    First-token sampling is folded into the jitted program (greedy argmax
-    when temp == 0, else temperature-scaled categorical with the shared
-    `_nucleus_filter`), so admission never blocks the host on a device
-    readback — the loop can dispatch prefills while a decode chunk runs
-    and fetch the token later. `temp`/`top_p`/`rng` are traced, so the
-    compile cache stays one entry per prompt bucket S."""
+    First-token sampling is folded into the jitted program (the shared
+    `generate.sample_logits_row`), so admission never blocks the host on
+    a device readback. `temp`/`top_p`/`rng` are traced, so the compile
+    cache stays one entry per prompt bucket S. This is the DENSE
+    reference prefill; the engine itself admits through the chunked
+    paged path (kv_blocks.make_chunk_prefill), which must sample
+    identically."""
     c = config
 
     @jax.jit
@@ -129,24 +135,7 @@ def make_prefill(config: ModelConfig):
             length=jnp.zeros((), jnp.int32),
         )
         logits, cache = _forward_cached(c, params, tokens, cache)
-        row = logits[0]
-
-        def _sample(x):
-            scaled = x / jnp.maximum(temp, 1e-6)
-            filtered = lax.cond(
-                top_p < 1.0,
-                lambda s: _nucleus_filter(s, top_p),
-                lambda s: s,
-                scaled,
-            )
-            return jax.random.categorical(rng, filtered).astype(jnp.int32)
-
-        first = lax.cond(
-            temp > 0.0,
-            _sample,
-            lambda x: jnp.argmax(x).astype(jnp.int32),
-            row,
-        )
+        first = sample_logits_row(logits[0], temp, top_p, rng)
         return cache.k, cache.v, first
 
     return prefill
@@ -156,10 +145,8 @@ def make_insert():
     """insert(state, slots (N,), k_rows (L,N,S,KV,hd), v_rows, seq_lens
     (N,), tokens (N,), budgets (N,), temps (N,), top_ps (N,)) — write N
     prefilled requests of the SAME prompt bucket S into their slots in
-    one donated call (one scatter per state leaf instead of one device
-    call per request). One compile per (N, S) pair; N is bounded by
-    `max_prefills_per_chunk`, S by the caller's prompt bucketing, so the
-    cache stays small."""
+    one donated call. Part of the dense reference path (the paged
+    engine's chunk_prefill finalize replaces it)."""
 
     @functools.partial(jax.jit, donate_argnums=0)
     def insert(state: DecodeState, slots, k_rows, v_rows, seq_lens,
@@ -179,23 +166,24 @@ def make_insert():
     return insert
 
 
-def _any_active_nucleus(state: DecodeState) -> jnp.ndarray:
+def _any_active_nucleus(state) -> jnp.ndarray:
     """True when any LIVE slot wants nucleus filtering.
 
-    Gates the per-step sort/cumsum branch in make_decode_step. Must look
+    Gates the per-step sort/cumsum branch in the decode body. Must look
     only at active slots: retire keeps the old top_p in the freed row,
     and a stale < 1 value must not tax default traffic forever (pinned
     by tests/test_serving.py::test_nucleus_gate_ignores_retired_slots).
     Greedy slots (temperature 0) discard their sampled value entirely,
     so their top_p must not arm the branch either — the OpenAI-SDK
-    combo {"temperature": 0, "top_p": 0.9} is routine.
+    combo {"temperature": 0, "top_p": 0.9} is routine. Works on either
+    DecodeState or PagedDecodeState (same field names).
     """
     return jnp.any(
         state.active & (state.top_p < 1.0) & (state.temperature > 0.0)
     )
 
 
-def _any_active_sampling(state: DecodeState) -> jnp.ndarray:
+def _any_active_sampling(state) -> jnp.ndarray:
     """True when any LIVE slot samples (temperature > 0).
 
     Gates the categorical branch: an all-greedy batch (the default
@@ -205,18 +193,13 @@ def _any_active_sampling(state: DecodeState) -> jnp.ndarray:
     return jnp.any(state.active & (state.temperature > 0.0))
 
 
-def make_decode_step(config: ModelConfig, steps: int = 1):
-    """decode_step(params, state, rng) -> (state, tokens (B, steps), active).
-
-    `steps` tokens for every active slot per call — the inner scan stays on
-    device, so one host sync delivers a chunk of tokens per slot. Larger
-    chunks amortize dispatch/readback latency (critical over tunneled
-    transports, still a win locally) at the cost of up-to-`steps`-step
-    admission latency for new requests. Sampling is per SLOT from
-    `state.temperature` (0 = greedy argmax, else categorical at that
-    temperature — requests with different temperatures share one decode
-    batch; the engine assigns its default to requests that don't
-    specify one)."""
+def _decode_body(config: ModelConfig):
+    """one_step(params, state, rng) -> (state, tokens (B,), active) — the
+    single-token decode body. The ONE traced definition both cache
+    layouts run: make_decode_step scans it over the dense DecodeState,
+    and kv_blocks.make_paged_decode_step scans it over dense views
+    gathered from the block pool — so the paged path cannot drift
+    numerically from the dense reference."""
     c = config
 
     def one_step(params, state: DecodeState, rng):
@@ -294,6 +277,23 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
         )
         return new_state, jnp.where(act, next_token, -1), new_active
 
+    return one_step
+
+
+def make_decode_step(config: ModelConfig, steps: int = 1):
+    """decode_step(params, state, rng) -> (state, tokens (B, steps), active).
+
+    `steps` tokens for every active slot per call — the inner scan stays on
+    device, so one host sync delivers a chunk of tokens per slot. Larger
+    chunks amortize dispatch/readback latency (critical over tunneled
+    transports, still a win locally) at the cost of up-to-`steps`-step
+    admission latency for new requests. Sampling is per SLOT from
+    `state.temperature` (0 = greedy argmax, else categorical at that
+    temperature — requests with different temperatures share one decode
+    batch; the engine assigns its default to requests that don't
+    specify one)."""
+    one_step = _decode_body(config)
+
     @functools.partial(jax.jit, donate_argnums=1)
     def decode_steps(params, state: DecodeState, rng):
         def body(carry, step_rng):
@@ -309,19 +309,6 @@ def make_decode_step(config: ModelConfig, steps: int = 1):
         return state, toks.T, active  # (B, steps)
 
     return decode_steps
-
-
-def _nucleus_filter(logits: jnp.ndarray, top_p) -> jnp.ndarray:
-    """Nucleus (top-p) filter over one row of logits: strict `<` on the
-    PRECEDING cumulative mass, so the top token always survives and
-    top_p=1 keeps everything. The single source of truth — the jitted
-    decode step vmaps this, and the prefill's first token calls it
-    directly, so the boundary rule cannot drift between them."""
-    order = jnp.argsort(-logits)
-    probs = jax.nn.softmax(logits[order])
-    before = jnp.cumsum(probs) - probs
-    keep = jnp.zeros(logits.shape[0], bool).at[order].set(before < top_p)
-    return jnp.where(keep, logits, -jnp.inf)
 
 
 class EngineOverloadedError(RuntimeError):
@@ -353,22 +340,31 @@ class _Request(NamedTuple):
     t_submit: float     # monotonic submit time (TTFT / queue-wait gauges)
 
 
-class _Admission(NamedTuple):
-    """A request whose prefill has been DISPATCHED but whose first token
-    has not been delivered yet — the overlap window. `first` is a device
-    scalar future; the loop reads it only after the decode chunk's own
-    sync, so the readback waits on the prefill alone."""
+class _PrefillTask:
+    """A request mid-chunked-prefill: owns a slot and a growing block
+    table from admission until its final chunk dispatches. `first` is a
+    device scalar future set at finalize; `delivered` flips once the
+    reader thread has pushed the first token to the consumer (the loop
+    waits on it before fanning out decode tokens that could otherwise
+    overtake it)."""
 
-    req: _Request
-    slot: int
-    k_rows: jnp.ndarray
-    v_rows: jnp.ndarray
-    first: jnp.ndarray
-    t_pop: float
+    __slots__ = ("req", "slot", "pos", "table", "first", "t_pop",
+                 "delivered", "finalized")
+
+    def __init__(self, req: _Request, slot: int, pos: int, table: List[int],
+                 t_pop: float):
+        self.req = req
+        self.slot = slot
+        self.pos = pos          # prompt tokens already in cache (prefix hits)
+        self.table = table      # host copy of the slot's block table
+        self.first: Optional[jnp.ndarray] = None
+        self.t_pop = t_pop
+        self.delivered = threading.Event()
+        self.finalized = False
 
 
 class ServingEngine:
-    """Continuous-batching host loop around the jitted trio.
+    """Continuous-batching host loop around the jitted programs.
 
     submit() returns a queue yielding generated token ids as they decode
     (None terminates) — callers stream them straight out (SSE) or collect.
@@ -386,30 +382,75 @@ class ServingEngine:
         steps_per_sync: int = 4,
         max_pending: Optional[int] = None,
         max_prefills_per_chunk: int = 4,
+        prefill_chunk_tokens: int = 128,
+        kv_block_size: int = 16,
+        kv_pool_blocks: Optional[int] = None,
+        prefix_cache: bool = True,
     ):
         self.config = config
         self.params = params
         self.slots = slots
         self.max_len = max_len or config.max_seq_len
-        self._prefill = make_prefill(config)
-        self._insert = make_insert()
-        self._step = make_decode_step(config, steps=steps_per_sync)
+        if max_prefills_per_chunk < 1:
+            raise ValueError(
+                f"max_prefills_per_chunk must be >= 1, got {max_prefills_per_chunk}"
+            )
+        if prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, got {prefill_chunk_tokens}"
+            )
+        if kv_block_size < 1:
+            raise ValueError(
+                f"kv_block_size must be >= 1, got {kv_block_size}"
+            )
+        if self.max_len % kv_block_size != 0:
+            raise ValueError(
+                f"kv_block_size {kv_block_size} must divide"
+                f" max_len {self.max_len}"
+            )
+        self._block_size = kv_block_size
+        self._max_blocks = self.max_len // kv_block_size
+        # Default pool = dense-equivalent (every slot can grow to
+        # max_len even with zero sharing, so allocation cannot fail at
+        # the defaults; prefix sharing then turns the saved blocks into
+        # cache headroom). Smaller pools trade worst-case capacity for
+        # HBM — submit() bounds each request to fit, but concurrent
+        # worst-case slots can still exhaust a small pool mid-decode,
+        # which force-retires the starved slot with an error.
+        self._num_blocks = (
+            kv_pool_blocks if kv_pool_blocks is not None
+            else slots * self._max_blocks
+        )
+        if self._num_blocks < self._max_blocks:
+            raise ValueError(
+                f"kv_pool_blocks {self._num_blocks} must fit one max_len"
+                f" request ({self._max_blocks} blocks)"
+            )
+        self._alloc = BlockAllocator(
+            self._num_blocks, kv_block_size, cache=prefix_cache
+        )
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self._chunk_cache: Dict[int, Any] = {}
+        self._step = make_paged_decode_step(config, steps=steps_per_sync)
+        self._copy_block = make_copy_block()
+        # Per-row table push with fixed shapes ((slots, max_blocks) +
+        # scalar + (max_blocks,)): one compile ever, hit during warmup.
+        # A batched .at[slots].set(rows) would recompile per
+        # number-of-rows-grown — a ~0.5 s XLA stall the first time a
+        # multi-stream scenario grows several tables in one boundary.
+        self._set_table_row = jax.jit(
+            lambda bt, slot, row: bt.at[slot].set(row), donate_argnums=0
+        )
         self._temperature = temperature
         self._rng = jax.random.PRNGKey(seed)
-        self.state = init_decode_state(config, slots, self.max_len)
+        self.state = init_paged_state(
+            config, slots, self.max_len, kv_block_size, self._num_blocks
+        )
         # Admission control: None = unbounded (library embedding decides);
         # servers should bound it — see EngineOverloadedError.
         self.max_pending = max_pending
         self.rejected = 0  # total sheds, monotonic (for /metrics)
         self._steps_per_sync = steps_per_sync
-        # Fairness knob: at most this many prefills are dispatched per
-        # chunk boundary, so an admission burst cannot starve the decode
-        # cadence of already-live streams (it also bounds the batched
-        # insert's compile cache — one entry per (N<=cap, bucket)).
-        if max_prefills_per_chunk < 1:
-            raise ValueError(
-                f"max_prefills_per_chunk must be >= 1, got {max_prefills_per_chunk}"
-            )
         self.max_prefills_per_chunk = max_prefills_per_chunk
         self._chunk_s = 0.05  # EWMA wall time per decode chunk (seeded)
         self._turn_s = 1.0    # EWMA slot occupancy admit->retire (seeded)
@@ -432,14 +473,31 @@ class ServingEngine:
         self._t_decode = 0.0
         self._t_prefill = 0.0
         self._t_idle = 0.0
+        # Chunked-prefill / paging counters (monotonic, for /metrics and
+        # the prefix-reuse acceptance measurement: tokens_computed for a
+        # cache-hit request drops by the reused prefix).
+        self._prefill_chunks = 0
+        self._prefill_tokens_computed = 0
         self._slot_t0: List[float] = [0.0] * slots
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._live: List[Optional[_Request]] = [None] * slots
-        # Requests popped for prefill but not yet live (the overlap
-        # window): admission accounting must see them as occupying
-        # capacity, and _flush_all must terminate their consumers too.
-        # Guarded by _lock.
+        # Host mirrors of per-slot cache length and block table for
+        # decode-growth allocation and retire-time release (loop thread
+        # only; table lists are also read by stats() counters via the
+        # allocator, under _lock).
+        self._lengths_host: List[int] = [0] * slots
+        self._slot_tables: List[Optional[List[int]]] = [None] * slots
+        # Requests popped for prefill but not yet live (the chunked
+        # admission window): admission accounting must see them as
+        # occupying capacity, and _flush_all must terminate their
+        # consumers too. Guarded by _lock.
         self._admitting: List[_Request] = []
+        self._tasks: List[_PrefillTask] = []
+        # Finalized tasks whose first token the reader thread has not
+        # confirmed delivered yet — the loop waits on these after each
+        # decode sync so decode tokens never overtake the first token.
+        self._pending_activation: List[_PrefillTask] = []
+        self._deliver_q: "queue.Queue[Optional[_PrefillTask]]" = queue.Queue()
         # Output queues whose consumer is gone (client disconnect, stop
         # sequence hit): the loop retires their slots at the next chunk
         # boundary instead of decoding the rest of the budget into a
@@ -456,6 +514,10 @@ class ServingEngine:
         # land on _pending after _flush_all drained it (its consumer would
         # block forever).
         self._lock = threading.Lock()
+        self._deliver_thread = threading.Thread(
+            target=self._deliver_loop, daemon=True
+        )
+        self._deliver_thread.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -494,6 +556,14 @@ class ServingEngine:
                 f"prompt {len(tokens)} + max_new_tokens {max_new_tokens}"
                 f" must not exceed max_len {self.max_len}"
             )
+        # Worst-case block demand (no prefix hit) must fit the pool, or
+        # the request could stall admission forever on a small pool.
+        need = (len(tokens) + max_new_tokens - 2) // self._block_size + 1
+        if need > self._num_blocks:
+            raise ValueError(
+                f"request needs up to {need} KV blocks but the pool has"
+                f" {self._num_blocks} (raise kv_pool_blocks)"
+            )
         out: "queue.Queue[object]" = queue.Queue()
         with self._lock:
             if self._failed is not None:
@@ -509,7 +579,7 @@ class ServingEngine:
             # same lock, and clears a retiring slot BEFORE signalling its
             # consumer — so a client that saw its stream end and
             # immediately resubmits cannot be shed by a stale free count.
-            # Requests in the prefill-overlap window (_admitting) are in
+            # Requests in the chunked-prefill window (_admitting) are in
             # neither _pending nor _live but do occupy capacity.
             free = sum(r is None for r in self._live) - len(self._admitting)
             backlog = depth - free
@@ -541,8 +611,8 @@ class ServingEngine:
             if out not in self._inflight:
                 return
             # Purge a still-QUEUED request right here rather than leaving
-            # a tombstone for _admit: dead entries would keep counting in
-            # the admission backlog and stats()["pending"], shedding new
+            # a tombstone: dead entries would keep counting in the
+            # admission backlog and stats()["pending"], shedding new
             # traffic below the real max_pending bound under cancel-heavy
             # load (disconnecting clients cancel from a finally:).
             # queue.Queue is internally locked, so draining interleaves
@@ -570,17 +640,19 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         """Live load snapshot (feeds /metrics and autoscaler signals).
 
-        Beyond queue/shed counters, the scheduler gauges: `ttft_seconds_
-        ewma` (submit -> first token, with its `queue_wait_seconds_ewma`
-        / `prefill_seconds_ewma` breakdown) and the utilization split —
-        `util_decode` / `util_prefill` / `util_idle`, the fraction of the
-        loop's wall time spent blocked on decode chunks, doing admission
-        (prefill dispatch + first-token delivery) host work, and idle.
-        A healthy overlapped engine under load shows util_decode near 1;
-        util_prefill climbing toward it means admission work is eating
-        the decode cadence (lower `max_prefills_per_chunk` or bucket
-        prompts coarser)."""
+        Beyond queue/shed counters and the scheduler gauges (`ttft_
+        seconds_ewma` with its queue-wait/prefill breakdown, the
+        util_decode/util_prefill/util_idle wall-time split), this now
+        reports the paged-KV view: pool occupancy (`kv_blocks_in_use` /
+        `kv_blocks_cached` of `kv_blocks_total`), prefix-cache hit
+        counters with `prefix_tokens_reused_total` (prompt tokens whose
+        prefill was skipped), copy-on-write and eviction counters, and
+        the chunked-prefill counters (`prefill_chunks_total`,
+        `prefill_tokens_computed_total` — diff the latter across a
+        window against submitted prompt tokens to measure the prefill
+        compute saved by sharing)."""
         busy = self._t_decode + self._t_prefill + self._t_idle
+        a = self._alloc
         return {
             "slots": self.slots,
             "active": sum(r is not None for r in self._live),
@@ -591,6 +663,18 @@ class ServingEngine:
             "slot_turn_seconds_ewma": round(self._turn_s, 3),
             "steps_per_sync": self._steps_per_sync,
             "max_prefills_per_chunk": self.max_prefills_per_chunk,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "kv_block_size": self._block_size,
+            "kv_blocks_total": a.num_blocks,
+            "kv_blocks_in_use": a.in_use,
+            "kv_blocks_cached": a.cached,
+            "prefix_cache_hits_total": a.hits,
+            "prefix_cache_misses_total": a.misses,
+            "prefix_tokens_reused_total": a.tokens_reused,
+            "kv_cow_copies_total": a.cow_copies,
+            "kv_block_evictions_total": a.evictions,
+            "prefill_chunks_total": self._prefill_chunks,
+            "prefill_tokens_computed_total": self._prefill_tokens_computed,
             "ttft_seconds_ewma": round(self._ttft_s, 4),
             "queue_wait_seconds_ewma": round(self._queue_wait_s, 4),
             "prefill_seconds_ewma": round(self._prefill_s, 4),
@@ -616,6 +700,8 @@ class ServingEngine:
             self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
+        self._deliver_q.put(None)
+        self._deliver_thread.join(timeout=10)
         # Requests still in flight get an exception, not the clean-end
         # None: a consumer must not mistake a truncated generation for a
         # complete one (same principle _flush_all states for failures).
@@ -633,30 +719,108 @@ class ServingEngine:
                 if req is not None:
                     req.out.put(sentinel)
                     self._live[slot] = None
-            # Requests caught in the prefill-overlap window (popped from
-            # _pending, not yet live) must get the sentinel too, or their
-            # consumers hang forever on a dead engine.
+            # Requests caught mid-chunked-prefill (popped from _pending,
+            # not yet live) must get the sentinel too, or their consumers
+            # hang forever on a dead engine.
             for req in self._admitting:
                 req.out.put(sentinel)
             self._admitting.clear()
+            self._tasks.clear()
+            self._pending_activation.clear()
             while True:
                 try:
                     self._pending.get_nowait().out.put(sentinel)
                 except queue.Empty:
                     return
 
-    # -- loop ----------------------------------------------------------------
+    # -- chunked prefill admission -------------------------------------------
 
-    def _start_prefills(self) -> List[_Admission]:
-        """Pop up to `max_prefills_per_chunk` pending requests into free
-        slots and DISPATCH their prefills. No host sync happens here —
-        the jitted prefill samples the first token on device — so when
-        the caller has just dispatched a decode chunk, all of this host
-        work runs while the chunk executes on device and the prefill
-        programs queue up behind it."""
-        admissions: List[_Admission] = []
-        free = [s for s in range(self.slots) if self._live[s] is None]
-        while free and len(admissions) < self.max_prefills_per_chunk:
+    def _chunk_fn(self, n_padded: int):
+        """The jitted chunk-prefill program for padded chunk length
+        `n_padded` (one compile per pow-2 bucket). Tests monkeypatch this
+        to block or spy on chunk dispatches."""
+        fn = self._chunk_cache.get(n_padded)
+        if fn is None:
+            fn = make_chunk_prefill(self.config, n_padded)
+            self._chunk_cache[n_padded] = fn
+        return fn
+
+    def _pad_chunk(self, n: int) -> int:
+        """Pow-2 bucket (min 8) capped at the chunk budget, so compile
+        entries stay O(log prefill_chunk_tokens)."""
+        c = 8
+        while c < n:
+            c *= 2
+        return max(min(c, self.prefill_chunk_tokens), n)
+
+    def _pad_table(self, table: List[int]) -> List[int]:
+        """Pad a host table to the device row width with the OOB sentinel
+        (num_blocks): padded gathers clip (masked garbage), padded
+        scatters drop — never block 0."""
+        return table + [self._num_blocks] * (self._max_blocks - len(table))
+
+    def _drop_task(self, task: _PrefillTask) -> None:
+        """Abandon a mid-prefill task (cancel): release its blocks,
+        answer the consumer, clear admission accounting."""
+        with self._lock:
+            for b in task.table:
+                self._alloc.release(b)
+            task.table.clear()
+            self._cancelled.discard(task.req.out)
+            self._inflight.discard(task.req.out)
+            if task.req in self._admitting:
+                self._admitting.remove(task.req)
+        self._tasks.remove(task)
+        task.req.out.put(None)
+
+    def _ensure_task_blocks(self, task: _PrefillTask, upto: int) -> bool:
+        """Make blocks [pos//bs, (upto-1)//bs] of the task's table
+        writable: fresh-allocate missing ones, copy-on-write shared ones.
+        False (and no dispatch this boundary) when the pool is exhausted
+        — refs already taken are kept, so the retry resumes where it
+        stalled."""
+        bs = self._block_size
+        first_blk = task.pos // bs
+        last_blk = (upto - 1) // bs
+        with self._lock:
+            for idx in range(first_blk, last_blk + 1):
+                if idx < len(task.table):
+                    b, needs_copy = self._alloc.ensure_writable(task.table[idx])
+                    if b is None:
+                        return False
+                    if needs_copy:
+                        self.state = self._copy_block(
+                            self.state,
+                            jnp.asarray(task.table[idx], jnp.int32),
+                            jnp.asarray(b, jnp.int32),
+                        )
+                        task.table[idx] = b
+                else:
+                    b = self._alloc.alloc()
+                    if b is None:
+                        return False
+                    task.table.append(b)
+        return True
+
+    def _advance_prefills(self) -> bool:
+        """One admission boundary: pull new requests into prefill tasks
+        (up to `max_prefills_per_chunk` concurrent, prefix-cache matched
+        on entry), then dispatch prompt chunks round-robin within a
+        TOTAL budget of `prefill_chunk_tokens` valid tokens — so one
+        long prompt and eight short ones cost a decode stream the same
+        bounded stall. Dispatch-only (no host sync): the jitted final
+        chunk samples the first token and flips the slot live on device;
+        the reader thread picks the token up the moment its readback
+        lands. Returns True if anything moved (admission, dispatch, or
+        cancel processing)."""
+        progressed = False
+        # Admit new requests into the task window.
+        while len(self._tasks) < self.max_prefills_per_chunk:
+            busy = {t.slot for t in self._tasks}
+            free = [s for s in range(self.slots)
+                    if self._live[s] is None and s not in busy]
+            if not free:
+                break
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
@@ -667,91 +831,213 @@ class ServingEngine:
                     self._cancelled.discard(req.out)
                     self._inflight.discard(req.out)
                     req.out.put(None)
+                    progressed = True
                     continue
                 self._admitting.append(req)
-            slot = free.pop(0)
+                blocks, matched = self._alloc.match(req.tokens)
+            slot = free[0]
             t_pop = time.monotonic()
             self._slot_t0[slot] = t_pop
             self._queue_wait_s = self._ewma_seed(
                 self._queue_wait_s, t_pop - req.t_submit
             )
             self._sum_queue_wait += t_pop - req.t_submit
+            self._tasks.append(_PrefillTask(req, slot, matched, blocks, t_pop))
+            progressed = True
+        # Dispatch chunks under the shared token budget.
+        budget = self.prefill_chunk_tokens
+        for task in list(self._tasks):
+            if budget <= 0:
+                break
+            with self._lock:
+                dead = task.req.out in self._cancelled
+            if dead:
+                self._drop_task(task)
+                progressed = True
+                continue
+            n = min(len(task.req.tokens) - task.pos, budget)
+            if not self._ensure_task_blocks(task, task.pos + n):
+                continue  # pool exhausted; retry next boundary
+            final = task.pos + n == len(task.req.tokens)
+            n_padded = self._pad_chunk(n)
+            chunk = task.req.tokens[task.pos:task.pos + n]
             self._rng, sub = jax.random.split(self._rng)
-            toks = jnp.asarray([req.tokens], dtype=jnp.int32)
-            k_rows, v_rows, first = self._prefill(
-                self.params, toks,
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_p, jnp.float32),
-                sub,
-            )
-            admissions.append(_Admission(req, slot, k_rows, v_rows, first, t_pop))
-        return admissions
-
-    def _finish_admissions(self, admissions: List[_Admission]) -> None:
-        """Insert prefilled requests into the decode state — batched, one
-        `insert` call per prompt bucket instead of one per request — and
-        deliver their first tokens. Runs after the decode chunk's sync,
-        so the `int(first)` readbacks wait only on the prefills."""
-        if not admissions:
-            return
-        live_adm: List[_Admission] = []
-        with self._lock:
-            for a in admissions:
-                self._admitting.remove(a.req)
-                if a.req.out in self._cancelled:
-                    # cancel() landed during the prefill overlap: the
-                    # request must not occupy a slot, and both sets must
-                    # be cleared or the entry leaks for the engine's
-                    # lifetime.
-                    self._cancelled.discard(a.req.out)
-                    self._inflight.discard(a.req.out)
-                    a.req.out.put(None)
-                else:
-                    live_adm.append(a)
-        # One batched insert per prompt bucket (dispatch-only — the
-        # device consumes the prefill outputs without a host round-trip).
-        # One-token requests never occupy a slot: their budget is spent
-        # by the first token, so inserting would emit a phantom token.
-        groups: Dict[int, List[_Admission]] = {}
-        for a in live_adm:
-            if a.req.max_new_tokens > 1:
-                groups.setdefault(a.k_rows.shape[2], []).append(a)
-        for group in groups.values():
-            self.state = self._insert(
+            self.state, first = self._chunk_fn(n_padded)(
+                self.params,
                 self.state,
-                jnp.asarray([a.slot for a in group], jnp.int32),
-                jnp.concatenate([a.k_rows for a in group], axis=1),
-                jnp.concatenate([a.v_rows for a in group], axis=1),
-                jnp.asarray([len(a.req.tokens) for a in group], jnp.int32),
-                jnp.stack([a.first for a in group]),
-                jnp.asarray(
-                    [a.req.max_new_tokens - 1 for a in group], jnp.int32
-                ),
-                jnp.asarray([a.req.temperature for a in group], jnp.float32),
-                jnp.asarray([a.req.top_p for a in group], jnp.float32),
+                jnp.asarray(task.slot, jnp.int32),
+                jnp.asarray(self._pad_table(task.table), jnp.int32),
+                jnp.asarray([chunk + [0] * (n_padded - n)], jnp.int32),
+                jnp.asarray(n, jnp.int32),
+                jnp.asarray(task.pos, jnp.int32),
+                jnp.asarray(task.req.max_new_tokens, jnp.int32),
+                jnp.asarray(task.req.temperature, jnp.float32),
+                jnp.asarray(task.req.top_p, jnp.float32),
+                sub,
+                jnp.asarray(final, bool),
             )
-        for a in live_adm:
-            first = int(a.first)  # the admission's only host sync
-            a.req.out.put(first)
-            now = time.monotonic()
-            self._ttft_s = self._ewma_seed(self._ttft_s, now - a.req.t_submit)
-            self._prefill_s = self._ewma_seed(self._prefill_s, now - a.t_pop)
-            self._n_admitted += 1
-            self._sum_ttft += now - a.req.t_submit
-            self._sum_prefill += now - a.t_pop
-            if a.req.max_new_tokens <= 1:
+            task.pos += n
+            budget -= n
+            self._prefill_chunks += 1
+            self._prefill_tokens_computed += n
+            progressed = True
+            if final:
+                task.first = first
+                task.finalized = True
                 with self._lock:
-                    self._inflight.discard(a.req.out)
-                    # cancel() racing this completion may have moved the
-                    # queue to _cancelled already; every completion path
-                    # must clear both sets.
-                    self._cancelled.discard(a.req.out)
-                a.req.out.put(None)
-            else:
-                with self._lock:
-                    self._live[a.slot] = a.req
+                    # Publish the prompt's full blocks NOW (dispatch
+                    # order guarantees the writes precede any later
+                    # matcher's gather), so a burst of shared-prefix
+                    # requests hits from the second admission on.
+                    self._alloc.insert_full(task.req.tokens, task.table)
+                    if task.req.max_new_tokens > 1:
+                        self._live[task.slot] = task.req
+                        self._admitting.remove(task.req)
+                        self._lengths_host[task.slot] = len(task.req.tokens)
+                        self._slot_tables[task.slot] = task.table
+                    # One-token requests never go live: their budget is
+                    # spent by the first token. The reader thread
+                    # completes them (and releases their blocks); they
+                    # stay in _admitting until then so capacity
+                    # accounting and _flush_all keep seeing them.
+                self._tasks.remove(task)
+                self._pending_activation.append(task)
+                self._deliver_q.put(task)
+        return progressed
 
-    def _retire(self, slot: int) -> DecodeState:
+    def _deliver_loop(self) -> None:
+        """Reader thread: blocks on each finalized prefill's first-token
+        readback and delivers it the instant it lands — decoupled from
+        the main loop, which may still be waiting out a decode chunk
+        (the r06 `first_chunk_residual`). Also completes one-token
+        requests end-to-end."""
+        while True:
+            task = self._deliver_q.get()
+            if task is None:
+                return
+            req = task.req
+            try:
+                first = int(task.first)  # blocks until prefill readback
+            except Exception:
+                # Poisoned by an engine failure/close mid-flight: the
+                # loop's own sync fails too and _flush_all answers the
+                # consumer; just unblock any waiter.
+                task.delivered.set()
+                continue
+            now = time.monotonic()
+            with self._lock:
+                dead = req.out in self._cancelled
+                if not dead:
+                    req.out.put(first)
+                self._ttft_s = self._ewma_seed(self._ttft_s, now - req.t_submit)
+                self._prefill_s = self._ewma_seed(self._prefill_s, now - task.t_pop)
+                self._n_admitted += 1
+                self._sum_ttft += now - req.t_submit
+                self._sum_prefill += now - task.t_pop
+                if req.max_new_tokens <= 1:
+                    # Budget spent by the first token: complete here.
+                    self._cancelled.discard(req.out)
+                    self._inflight.discard(req.out)
+                    if req in self._admitting:
+                        self._admitting.remove(req)
+                    for b in task.table:
+                        self._alloc.release(b)
+                    task.table.clear()
+                    req.out.put(None)
+                elif dead:
+                    # Cancelled between finalize and delivery: the loop's
+                    # cancel branch frees the live slot at the next
+                    # boundary; nothing to deliver.
+                    pass
+            task.delivered.set()
+
+    def _wait_activations(self) -> None:
+        """Order barrier: before fanning out a decode chunk's tokens,
+        make sure every first token the chunk's prefills produced has
+        been delivered (the reader thread normally finished long ago —
+        its readback completed before the decode chunk did)."""
+        for task in self._pending_activation:
+            task.delivered.wait(timeout=60)
+        self._pending_activation.clear()
+
+    # -- decode ---------------------------------------------------------------
+
+    def _ensure_decode_blocks(self) -> None:
+        """Grow live slots' tables to cover the next decode chunk's
+        writes. A slot the pool cannot feed (undersized kv_pool_blocks
+        under concurrent worst-case load) is force-retired with an
+        error — silently dropping its KV writes would corrupt the
+        stream."""
+        bs = self._block_size
+        updates: Dict[int, List[int]] = {}
+        for slot in range(self.slots):
+            table = self._slot_tables[slot]
+            if self._live[slot] is None or table is None:
+                continue
+            need = min(
+                (self._lengths_host[slot] + self._steps_per_sync - 1) // bs + 1,
+                self._max_blocks,
+            )
+            grew = False
+            starved = False
+            while len(table) < need:
+                with self._lock:
+                    b = self._alloc.alloc()
+                if b is None:
+                    starved = True
+                    break
+                table.append(b)
+                grew = True
+            if starved:
+                self._force_retire(
+                    slot,
+                    RuntimeError(
+                        "kv block pool exhausted mid-decode"
+                        " (raise kv_pool_blocks)"
+                    ),
+                )
+                continue
+            if grew:
+                updates[slot] = self._pad_table(table)
+        if updates:
+            bt = self.state.block_tables
+            for s in sorted(updates):
+                bt = self._set_table_row(
+                    bt,
+                    jnp.asarray(s, jnp.int32),
+                    jnp.asarray(updates[s], jnp.int32),
+                )
+            self.state = self.state._replace(block_tables=bt)
+
+    def _force_retire(self, slot: int, error: BaseException) -> None:
+        req = self._live[slot]
+        with self._lock:
+            self._live[slot] = None
+            if req is not None:
+                self._cancelled.discard(req.out)
+                self._inflight.discard(req.out)
+            self._release_slot_blocks(slot, cache_tail=False)
+        self.state = self._retire(slot)
+        if req is not None:
+            req.out.put(error)
+
+    def _release_slot_blocks(self, slot: int, cache_tail: bool,
+                             prompt: Optional[List[int]] = None) -> None:
+        """Return a retired slot's blocks to the pool (caller holds
+        _lock). With `cache_tail`, first publish the prompt's partial
+        tail block for future prefix hits — full blocks were already
+        published at finalize."""
+        table = self._slot_tables[slot]
+        if table is None:
+            return
+        if cache_tail and prompt is not None:
+            self._alloc.insert_tail(prompt, table)
+        for b in table:
+            self._alloc.release(b)
+        self._slot_tables[slot] = None
+        self._lengths_host[slot] = 0
+
+    def _retire(self, slot: int):
         s = self.state
         return s._replace(
             active=s.active.at[slot].set(False),
@@ -766,48 +1052,64 @@ class ServingEngine:
         the gauge directly instead of averaging against the 0 seed."""
         return sample if prev == 0.0 else prev + alpha * (sample - prev)
 
+    # -- loop ----------------------------------------------------------------
+
     def _loop(self) -> None:
         while not self._stop:
             try:
-                if not any(r is not None for r in self._live):
+                has_live = any(r is not None for r in self._live)
+                if not has_live and not self._tasks:
                     if self._pending.empty():
                         t_w = time.monotonic()
                         self._wake.wait(timeout=0.2)
                         self._wake.clear()
                         self._t_idle += time.monotonic() - t_w
                         continue
-                    # Nothing decoding: admission runs alone (no chunk to
-                    # overlap with); the next iteration dispatches the
-                    # first decode chunk for the freshly inserted slots.
+                if not has_live:
+                    # Nothing decoding: admission runs alone; the next
+                    # iteration dispatches the first decode chunk for the
+                    # freshly activated slots.
                     t_p = time.monotonic()
-                    self._finish_admissions(self._start_prefills())
+                    progressed = self._advance_prefills()
+                    self._wait_activations()
                     self._t_prefill += time.monotonic() - t_p
+                    if not progressed and self._tasks:
+                        time.sleep(0.001)  # pool starved, nothing live
                     continue
-                # 1) Dispatch the decode chunk — JAX async dispatch
-                #    returns immediately; the device starts decoding now.
+                # 1) Dispatch PREFILL chunks FIRST: their programs run
+                #    on device ahead of the decode chunk, so the reader
+                #    thread's first-token readbacks land while the decode
+                #    chunk still executes — TTFT never pays the
+                #    decode-chunk residual. Block growth runs AFTER
+                #    admissions: a prefill that finalizes above goes
+                #    live in THIS chunk, and its table so far only
+                #    covers the prompt — growing first would let the
+                #    chunk's writes past the last prompt block hit the
+                #    pad sentinel and silently drop.
                 t0 = time.monotonic()
+                self._advance_prefills()
+                self._ensure_decode_blocks()
+                t_pf = time.monotonic()
+                # 2) Dispatch the decode chunk (async) and sync on it.
                 self._rng, sub = jax.random.split(self._rng)
                 self.state, tokens, active = self._step(
                     self.params, self.state, sub
                 )
-                t_disp = time.monotonic()
-                # 2) Overlap: admission host work + prefill dispatch run
-                #    WHILE the chunk executes on device (the prefill
-                #    programs queue behind it on the device stream).
-                admissions = self._start_prefills()
-                t_pf = time.monotonic()
-                # 3) Sync on the chunk.
                 toks = jax.device_get(tokens)  # (B, steps_per_sync)
                 still = jax.device_get(active)
                 t_sync = time.monotonic()
-                self._chunk_s = self._ewma(self._chunk_s, t_sync - t0)
-                self._t_decode += (t_disp - t0) + (t_sync - t_pf)
-                self._t_prefill += t_pf - t_disp
+                self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
+                self._t_prefill += t_pf - t0
+                self._t_decode += t_sync - t_pf
+                # 3) First-token order barrier, then fan out the chunk.
+                self._wait_activations()
                 with self._lock:
                     cancelled = set(self._cancelled)
                 for slot, req in enumerate(self._live):
                     if req is None:
                         continue
+                    n_emitted = int((toks[slot] >= 0).sum())
+                    self._lengths_host[slot] += n_emitted
                     if req.out in cancelled:
                         # consumer is gone: free the slot now, skip the
                         # chunk's tokens (nobody reads them)
@@ -815,6 +1117,9 @@ class ServingEngine:
                             self._cancelled.discard(req.out)
                             self._inflight.discard(req.out)
                             self._live[slot] = None
+                            self._release_slot_blocks(
+                                slot, cache_tail=True, prompt=req.tokens
+                            )
                         self.state = self._retire(slot)
                         req.out.put(None)
                         continue
@@ -830,6 +1135,9 @@ class ServingEngine:
                             # leave a stale entry behind
                             self._cancelled.discard(req.out)
                             self._inflight.discard(req.out)
+                            self._release_slot_blocks(
+                                slot, cache_tail=True, prompt=req.tokens
+                            )
                         for tok in toks[slot]:
                             if tok >= 0:
                                 req.out.put(int(tok))
@@ -842,11 +1150,6 @@ class ServingEngine:
                     for tok in toks[slot]:
                         if tok >= 0:
                             req.out.put(int(tok))
-                # 4) Insert the overlapped prefills (batched per bucket)
-                #    and deliver their first tokens.
-                t_fin = time.monotonic()
-                self._finish_admissions(admissions)
-                self._t_prefill += time.monotonic() - t_fin
             except Exception as e:  # device/compile error: fail loudly, not
                 # by wedging every consumer on a dead queue.
                 if self._stop:
@@ -866,3 +1169,40 @@ class ServingEngine:
                     "serving engine loop failed"
                 )
                 return
+
+
+def prometheus_metrics(stats: Dict[str, Any]) -> str:
+    """Render a stats() snapshot in Prometheus text exposition format.
+    Every series here is declared in server/metrics_registry.py — the
+    MET01 checker verifies these literals against it."""
+    series = [
+        ("dstack_tpu_serving_slots_active", "gauge", stats["active"]),
+        ("dstack_tpu_serving_pending_requests", "gauge", stats["pending"]),
+        ("dstack_tpu_serving_kv_blocks_in_use", "gauge",
+         stats["kv_blocks_in_use"]),
+        ("dstack_tpu_serving_kv_blocks_cached", "gauge",
+         stats["kv_blocks_cached"]),
+        ("dstack_tpu_serving_prefix_cache_hits_total", "counter",
+         stats["prefix_cache_hits_total"]),
+        ("dstack_tpu_serving_prefix_cache_misses_total", "counter",
+         stats["prefix_cache_misses_total"]),
+        ("dstack_tpu_serving_prefix_tokens_reused_total", "counter",
+         stats["prefix_tokens_reused_total"]),
+        ("dstack_tpu_serving_kv_cow_copies_total", "counter",
+         stats["kv_cow_copies_total"]),
+        ("dstack_tpu_serving_prefill_chunks_total", "counter",
+         stats["prefill_chunks_total"]),
+        ("dstack_tpu_serving_prefill_tokens_total", "counter",
+         stats["prefill_tokens_computed_total"]),
+        ("dstack_tpu_serving_admitted_total", "counter",
+         stats["admitted_total"]),
+        ("dstack_tpu_serving_rejected_total", "counter",
+         stats["rejected_total"]),
+        ("dstack_tpu_serving_ttft_seconds_sum", "counter",
+         stats["ttft_seconds_sum"]),
+    ]
+    lines = []
+    for name, mtype, value in series:
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from dstack_tpu.workloads.config import PRESETS
-from dstack_tpu.workloads.serving import EngineOverloadedError, ServingEngine
+from dstack_tpu.workloads.serving import (
+    EngineOverloadedError,
+    ServingEngine,
+    prometheus_metrics,
+)
 from dstack_tpu.workloads.transformer import init_params
 
 
@@ -36,7 +40,8 @@ class Engine:
     def __init__(self, preset: str, max_new_tokens: int, checkpoint_dir: str = "",
                  quantize: str = "none", max_pending: int = 16,
                  slots: int = 8, steps_per_sync: int = 4,
-                 max_prefills_per_chunk: int = 4):
+                 max_prefills_per_chunk: int = 4,
+                 prefill_chunk_tokens: int = 128, kv_block_size: int = 16):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -78,11 +83,21 @@ class Engine:
         # readback), and `max_prefills_per_chunk` (admissions per chunk
         # boundary — the overlapped scheduler's fairness knob). See
         # docs/guides/serving-tuning.md for the measured trade-offs.
-        self.serving = ServingEngine(
-            self.config, self.params, slots=slots, temperature=0.8,
-            max_pending=max_pending, steps_per_sync=steps_per_sync,
-            max_prefills_per_chunk=max_prefills_per_chunk,
-        )
+        # Paged-KV knobs: `prefill_chunk_tokens` bounds the prompt
+        # tokens computed per chunk boundary (decode stall ceiling), and
+        # `kv_block_size` is the pool's block granularity (must divide
+        # the preset's max_seq_len). The engine validates both; surface
+        # its ValueError as a clean CLI error, not a traceback.
+        try:
+            self.serving = ServingEngine(
+                self.config, self.params, slots=slots, temperature=0.8,
+                max_pending=max_pending, steps_per_sync=steps_per_sync,
+                max_prefills_per_chunk=max_prefills_per_chunk,
+                prefill_chunk_tokens=prefill_chunk_tokens,
+                kv_block_size=kv_block_size,
+            )
+        except ValueError as e:
+            raise SystemExit(f"invalid serving configuration: {e}")
 
     def encode(self, text: str) -> jnp.ndarray:
         ids = [min(b, self.config.vocab_size - 1) for b in text.encode()] or [0]
@@ -245,12 +260,35 @@ def main() -> None:
     parser.add_argument("--max-prefills-per-chunk", type=int, default=4,
                         help="admissions per decode chunk boundary (the"
                              " overlapped scheduler's fairness knob)")
+    parser.add_argument("--prefill-chunk-tokens", type=int, default=128,
+                        help="prompt tokens computed per chunk boundary —"
+                             " bounds the decode stall a long prompt causes")
+    parser.add_argument("--kv-block-size", type=int, default=16,
+                        help="paged-KV block granularity in tokens; must"
+                             " divide the preset's max_seq_len")
     args = parser.parse_args()
+    if args.prefill_chunk_tokens <= 0:
+        raise SystemExit(
+            f"--prefill-chunk-tokens must be positive,"
+            f" got {args.prefill_chunk_tokens}"
+        )
+    if args.kv_block_size <= 0:
+        raise SystemExit(
+            f"--kv-block-size must be positive, got {args.kv_block_size}"
+        )
+    max_len = PRESETS[args.preset].max_seq_len
+    if max_len % args.kv_block_size != 0:
+        raise SystemExit(
+            f"--kv-block-size {args.kv_block_size} must divide"
+            f" {args.preset}'s max_seq_len {max_len}"
+        )
 
     engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir,
                     quantize=args.quantize, max_pending=args.max_pending,
                     slots=args.slots, steps_per_sync=args.steps_per_sync,
-                    max_prefills_per_chunk=args.max_prefills_per_chunk)
+                    max_prefills_per_chunk=args.max_prefills_per_chunk,
+                    prefill_chunk_tokens=args.prefill_chunk_tokens,
+                    kv_block_size=args.kv_block_size)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -330,10 +368,26 @@ def main() -> None:
                     "data": [{"id": args.model_name, "object": "model",
                               "created": 0, "owned_by": "dstack-tpu"}],
                 })
-            if self.path.rstrip("/") == "/metrics":
-                # Queue depth + shed counters for scrapers and the
-                # control plane's autoscaler signals.
-                return self._send(200, engine.serving.stats())
+            path, _, query = self.path.partition("?")
+            if path.rstrip("/") == "/metrics":
+                # Queue depth, shed counters, and paged-KV pool gauges
+                # for scrapers and the control plane's autoscaler
+                # signals. JSON by default (existing consumers);
+                # Prometheus text when the scraper asks for it via
+                # Accept or ?format=prometheus.
+                stats = engine.serving.stats()
+                accept = self.headers.get("Accept", "")
+                if "format=prometheus" in query or "text/plain" in accept:
+                    body = prometheus_metrics(stats).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                return self._send(200, stats)
             self._send(404, {"error": "not found"})
 
         def do_POST(self):

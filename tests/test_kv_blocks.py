@@ -1,0 +1,142 @@
+"""BlockAllocator unit tests: refcounts, prefix cache, CoW, eviction.
+
+The allocator is pure host-side Python (the engine serializes it under
+its own lock), so these tests pin its invariants without touching JAX:
+a block leaves the free list only via alloc(), returns only at refcount
+zero, cache retention counts as a reference, and the sha1-chained match
+walk never covers the last prompt token (the prefill must compute the
+last position's logits to sample the first output token).
+"""
+
+import pytest
+
+from dstack_tpu.workloads.kv_blocks import BlockAllocator, init_paged_state
+from dstack_tpu.workloads.config import PRESETS
+
+BS = 4  # block size used throughout; small so chains stay readable
+
+
+def test_alloc_release_refcount_roundtrip():
+    a = BlockAllocator(num_blocks=3, block_size=BS)
+    b1, b2, b3 = a.alloc(), a.alloc(), a.alloc()
+    assert sorted([b1, b2, b3]) == [0, 1, 2]
+    assert a.in_use == 3
+    assert a.alloc() is None  # exhausted, nothing cached to evict
+    a.retain(b1)  # second holder
+    a.release(b1)
+    assert a.in_use == 3  # still held once
+    a.release(b1)
+    assert a.in_use == 2
+    assert a.alloc() == b1  # freed block is reusable
+    a.release(b2)
+    with pytest.raises(AssertionError):  # double release must fail loudly
+        a.release(b2)
+
+
+def test_match_full_chain_and_partial_tail():
+    a = BlockAllocator(num_blocks=8, block_size=BS)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # 2 full blocks + tail [9, 10]
+    table = [a.alloc(), a.alloc(), a.alloc()]
+    a.insert_full(prompt, table)
+    assert a.cached == 2  # only complete blocks at finalize time
+    a.insert_tail(prompt, table)
+    assert a.cached == 3
+
+    # Identical prompt: both full blocks match; the tail [9, 10] does NOT
+    # because match leaves >=1 trailing token uncovered (limit=9 -> only a
+    # 1-token tail [9] is searched, and the cached key is the 2-token tail).
+    blocks, matched = a.match(prompt)
+    assert blocks == table[:2] and matched == 8
+    assert a.hits == 1 and a.tokens_reused == 8
+    for b in blocks:
+        a.release(b)  # matcher's retains
+
+    # A longer prompt sharing the prefix matches full chain + cached tail.
+    blocks, matched = a.match(prompt + [11, 12, 13])
+    assert blocks == table and matched == 10
+    for b in blocks:
+        a.release(b)
+
+    # Diverging first block: no match, miss counted.
+    blocks, matched = a.match([99, 2, 3, 4, 5, 6, 7, 8])
+    assert blocks == [] and matched == 0
+    assert a.misses == 1
+
+
+def test_match_never_covers_last_token():
+    a = BlockAllocator(num_blocks=4, block_size=BS)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]  # exactly 2 full blocks
+    table = [a.alloc(), a.alloc()]
+    a.insert_full(prompt, table)
+    # Same prompt again: limit = 7, so only the FIRST block may match —
+    # the second would cover the final token whose logits prefill needs.
+    blocks, matched = a.match(prompt)
+    assert blocks == table[:1] and matched == 4
+
+
+def test_ensure_writable_cow_semantics():
+    a = BlockAllocator(num_blocks=3, block_size=BS)
+    b = a.alloc()
+    assert a.ensure_writable(b) == (b, False)  # private: write in place
+    a.retain(b)  # now shared (e.g. matched by a second table)
+    nb, needs_copy = a.ensure_writable(b)
+    assert needs_copy and nb != b
+    assert a.cow_copies == 1
+    assert a._ref[b] == 1  # our share of the old block was released
+    # Exhaustion during CoW: pool of 3 with all blocks held.
+    a.retain(b)
+    c = a.alloc()
+    assert c is not None and a.in_use == 3
+    assert a.ensure_writable(b) == (None, False)  # caller retries later
+
+
+def test_lru_eviction_frees_cached_blocks_only_at_ref_zero():
+    a = BlockAllocator(num_blocks=2, block_size=BS)
+    p1, p2 = [1, 2, 3, 4, 9], [5, 6, 7, 8, 9]
+    t1, t2 = [a.alloc()], [a.alloc()]
+    a.insert_full(p1, t1)
+    a.insert_full(p2, t2)
+    assert a.alloc() is None  # cached but still table-held: not evictable
+    for t in (t1, t2):
+        a.release(t[0])  # tables retire; blocks now cache-held only
+    assert a.in_use == 2 and a.cached == 2
+    # p1's block is LRU (inserted first, never touched since): evicted.
+    b = a.alloc()
+    assert b == t1[0]
+    assert a.evictions == 1 and a.cached == 1
+    # p2's entry survived and still matches.
+    blocks, matched = a.match(p2)
+    assert blocks == t2 and matched == 4
+
+
+def test_cache_disabled_is_inert():
+    a = BlockAllocator(num_blocks=4, block_size=BS, cache=False)
+    t = [a.alloc(), a.alloc()]
+    a.insert_full([1, 2, 3, 4, 5, 6, 7, 8], t)
+    a.insert_tail([1, 2, 3, 4, 5, 6], t)
+    assert a.cached == 0
+    assert a.match([1, 2, 3, 4, 5, 6, 7, 8]) == ([], 0)
+    assert a.hits == 0 and a.misses == 0
+
+
+def test_insert_full_dedups_against_existing_entries():
+    a = BlockAllocator(num_blocks=4, block_size=BS)
+    prompt = [1, 2, 3, 4, 5]
+    t1 = [a.alloc(), a.alloc()]
+    a.insert_full(prompt, t1)
+    t2 = [a.alloc(), a.alloc()]
+    a.insert_full(prompt, t2)  # same content: first entry wins
+    assert a.cached == 1
+    blocks, matched = a.match(prompt + [6, 7, 8])
+    assert blocks == t1[:1] and matched == 4
+
+
+def test_init_paged_state_validates_block_size():
+    cfg = PRESETS["tiny"].with_(remat=False)
+    with pytest.raises(ValueError, match="divide"):
+        init_paged_state(cfg, batch=2, max_len=32, block_size=5,
+                         num_blocks=16)
+    st = init_paged_state(cfg, batch=2, max_len=32, block_size=8,
+                          num_blocks=16)
+    assert st.block_tables.shape == (2, 4)
+    assert int(st.block_tables.min()) == 16  # pad sentinel == num_blocks

@@ -398,23 +398,29 @@ def test_nucleus_gate_ignores_retired_slots(params):
 def test_one_token_completion_clears_cancel_race(params):
     """Every completion path must clear BOTH _inflight and _cancelled.
 
-    Deterministic interleaving: _start_prefills checks _cancelled BEFORE
-    the prefill, so blocking the prefill and cancelling while blocked
-    lands the cancel exactly in the overlap window the leak needs — past
-    the queued-cancel branch, before _finish_admissions' discards."""
+    Deterministic interleaving: _advance_prefills checks _cancelled at
+    admission AND before each chunk dispatch, so blocking the chunk
+    program and cancelling while blocked lands the cancel exactly in
+    the window the leak needs — past both checks, before the reader
+    thread's completion discards."""
     import threading
 
     engine = ServingEngine(CFG, params, slots=1, max_len=16)
     try:
         started, release = threading.Event(), threading.Event()
-        real_prefill = engine._prefill
+        real_chunk_fn = engine._chunk_fn
 
-        def blocking_prefill(p, toks, temp, top_p, rng):
-            started.set()
-            assert release.wait(30)
-            return real_prefill(p, toks, temp, top_p, rng)
+        def blocking_chunk_fn(n_padded):
+            fn = real_chunk_fn(n_padded)
 
-        engine._prefill = blocking_prefill
+            def wrapped(*args):
+                started.set()
+                assert release.wait(30)
+                return fn(*args)
+
+            return wrapped
+
+        engine._chunk_fn = blocking_chunk_fn
         out = engine.submit([1, 2], max_new_tokens=1)
         assert started.wait(30), "engine never admitted the request"
         engine.cancel(out)  # lands mid-admission: in _inflight, past the check

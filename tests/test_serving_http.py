@@ -154,6 +154,67 @@ def test_native_server_honors_max_tokens(tmp_path):
         log.close()
 
 
+def test_native_server_paged_kv_flags_and_prometheus(tmp_path):
+    """--prefill-chunk-tokens / --kv-block-size ride through to the
+    engine, /metrics stays JSON for existing consumers, and the same
+    endpoint serves Prometheus text when asked via ?format=prometheus
+    or an Accept header."""
+    proc, log, port = _boot_server(
+        tmp_path, "--max-new-tokens", "16",
+        "--prefill-chunk-tokens", "32", "--kv-block-size", "8",
+    )
+    try:
+        r = _post(port, {"messages": [{"role": "user", "content": "hi"}]})
+        assert r.status == 200
+
+        m = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ))
+        assert m["prefill_chunk_tokens"] == 32
+        assert m["kv_block_size"] == 8
+        assert m["admitted_total"] >= 1
+        assert m["prefill_chunks_total"] >= 1
+        # untouched legacy keys existing dashboards scrape
+        assert m["rejected_total"] == 0 and m["slots"] == 8
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?format=prometheus", timeout=5
+        ).read().decode()
+        assert "# TYPE dstack_tpu_serving_kv_blocks_in_use gauge" in text
+        assert "dstack_tpu_serving_admitted_total 1" in text
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "text/plain"},
+        )
+        via_accept = urllib.request.urlopen(req, timeout=5)
+        assert via_accept.headers["Content-Type"].startswith("text/plain")
+        assert "dstack_tpu_serving_prefix_cache_hits_total" in (
+            via_accept.read().decode()
+        )
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
+
+
+def test_native_server_rejects_bad_paged_kv_flags(tmp_path):
+    """Invalid paged-KV flags fail fast with a clear message, not a
+    late traceback (tiny's max_seq_len is 256: 24 does not divide it)."""
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+    for flags, needle in (
+        (["--kv-block-size", "24"], "must divide"),
+        (["--kv-block-size", "0"], "must be positive"),
+        (["--prefill-chunk-tokens", "-4"], "must be positive"),
+    ):
+        out = subprocess.run(
+            [sys.executable, str(SERVER), "--preset", "tiny",
+             "--port", str(free_port()), *flags],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode != 0, flags
+        assert needle in out.stderr, (flags, out.stderr[-500:])
+
+
 def test_native_server_stop_sequences(tmp_path):
     """The OpenAI `stop` field truncates the output before the stop
     string; greedy decode makes the check deterministic."""

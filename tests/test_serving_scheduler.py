@@ -1,12 +1,13 @@
-"""Overlapped prefill/decode scheduler: exactness, fairness, gauges.
+"""Chunked-prefill scheduler: exactness, fairness, gauges.
 
 tests/test_serving.py pins the engine's numerics and queue protocol; this
-file pins the SCHEDULER introduced for PR 1 — first-token sampling folded
-into the jitted prefill, admission overlapped with the in-flight decode
-chunk, batched inserts capped by `max_prefills_per_chunk`, and the
-TTFT/utilization gauges the gateway and autoscaler read. Everything here
-runs on the tiny CPU preset under `-m 'not slow'` so tier-1 catches
-scheduler regressions without TPU hardware.
+file pins the SCHEDULER — admission through budget-bounded prompt chunks
+(`prefill_chunk_tokens`) dispatched ahead of each decode chunk, the
+concurrent-prefill window capped by `max_prefills_per_chunk`, pow-2
+chunk bucketing of the compile cache, and the TTFT/utilization gauges
+the gateway and autoscaler read. Everything here runs on the tiny CPU
+preset under `-m 'not slow'` so tier-1 catches scheduler regressions
+without TPU hardware.
 """
 
 import threading
@@ -48,23 +49,34 @@ def _reference(params, prompt, n):
     return [int(t) for t in toks[0]]
 
 
-def test_admission_burst_token_exact_and_prefill_cap(params):
-    """A 32-request greedy burst through the overlapped scheduler yields
-    outputs bit-identical to the sequential reference, while every
-    batched insert stays within `max_prefills_per_chunk` (the fairness
-    knob: an admission burst must not starve decode cadence) and at
-    least one insert actually batched multiple requests (the point of
-    the one-call-per-bucket insert)."""
+def _spy_chunks(engine, record):
+    """Wrap engine._chunk_fn so `record(n_padded, engine)` runs at every
+    chunk DISPATCH (the hook tests are told to patch)."""
+    real = engine._chunk_fn
+
+    def spying(n_padded):
+        fn = real(n_padded)
+
+        def wrapped(*args):
+            record(n_padded, engine)
+            return fn(*args)
+
+        return wrapped
+
+    engine._chunk_fn = spying
+
+
+def test_admission_burst_token_exact_and_prefill_window_cap(params):
+    """A 32-request greedy burst through the chunked scheduler yields
+    outputs bit-identical to the sequential reference, while the
+    concurrent-prefill window never exceeds `max_prefills_per_chunk`
+    (the fairness knob: an admission burst must not starve decode
+    cadence) and the window actually filled past one request (the point
+    of admitting several prompts per boundary)."""
     engine = ServingEngine(CFG, params, slots=8, max_len=64,
                            max_prefills_per_chunk=3)
-    batch_sizes = []
-    orig_insert = engine._insert
-
-    def spy(state, slots, *rest):
-        batch_sizes.append(int(slots.shape[0]))
-        return orig_insert(state, slots, *rest)
-
-    engine._insert = spy
+    window_sizes = []
+    _spy_chunks(engine, lambda n, e: window_sizes.append(len(e._tasks)))
     try:
         base_prompts = [[5, 7, 11], [13, 17], [2, 3, 5, 7], [19, 23, 29]]
         refs = {tuple(p): _reference(params, p, 4) for p in base_prompts}
@@ -72,44 +84,40 @@ def test_admission_burst_token_exact_and_prefill_cap(params):
         queues = [engine.submit(p, max_new_tokens=4) for p in prompts]
         for p, q in zip(prompts, queues):
             assert _drain(q) == refs[tuple(p)], p
-        assert batch_sizes, "no insert ever ran"
-        assert max(batch_sizes) <= 3, (
-            f"insert batch {max(batch_sizes)} exceeded max_prefills_per_chunk"
+        assert window_sizes, "no prefill chunk ever dispatched"
+        assert max(window_sizes) <= 3, (
+            f"prefill window {max(window_sizes)} exceeded max_prefills_per_chunk"
         )
-        assert max(batch_sizes) > 1, (
-            "a 32-request burst never batched an insert"
+        assert max(window_sizes) > 1, (
+            "a 32-request burst never filled the prefill window"
         )
         s = engine.stats()
         assert s["ttft_seconds_ewma"] > 0
         assert s["queue_wait_seconds_ewma"] > 0
+        assert s["prefill_chunks_total"] >= 32
     finally:
         engine.close()
 
 
-def test_batched_insert_groups_by_prompt_bucket(params):
-    """Mixed prompt lengths in one burst: the batched insert groups by
-    bucket (same-S requests share a call, different-S requests don't),
-    and outputs stay exact across the grouping."""
-    engine = ServingEngine(CFG, params, slots=4, max_len=64,
-                           max_prefills_per_chunk=4)
-    seen = []  # (n_requests, bucket_len) per insert call
-    orig_insert = engine._insert
-
-    def spy(state, slots, k_rows, *rest):
-        seen.append((int(slots.shape[0]), int(k_rows.shape[2])))
-        return orig_insert(state, slots, k_rows, *rest)
-
-    engine._insert = spy
+def test_chunked_prefill_splits_and_buckets(params):
+    """A prompt longer than `prefill_chunk_tokens` is split across
+    boundaries, each padded chunk drawn from the pow-2 bucket set (one
+    compile per bucket, never per prompt length) — and the split output
+    stays exact."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=64,
+                           prefill_chunk_tokens=16, kv_block_size=8)
+    seen = []
+    _spy_chunks(engine, lambda n, e: seen.append(n))
     try:
         short = [5, 7, 11]
-        long = [13, 17, 19, 23, 29, 31]
-        queues = [engine.submit(p, max_new_tokens=4)
-                  for p in (short, long, short, long)]
-        outs = [_drain(q) for q in queues]
-        assert outs[0] == outs[2] == _reference(params, short, 4)
-        assert outs[1] == outs[3] == _reference(params, long, 4)
-        for n, s in seen:
-            assert s in (len(short), len(long))
+        long = [(i * 29 + 3) % 50 + 1 for i in range(20)]
+        q1 = engine.submit(short, max_new_tokens=4)
+        q2 = engine.submit(long, max_new_tokens=4)
+        assert _drain(q1) == _reference(params, short, 4)
+        assert _drain(q2) == _reference(params, long, 4)
+        assert set(seen) <= {8, 16}, seen  # pow-2 buckets capped at budget
+        assert 16 in seen, "the 20-token prompt never used a full chunk"
+        assert engine.stats()["prefill_chunks_total"] >= 3  # 1 + split-in-2
     finally:
         engine.close()
 
@@ -118,7 +126,8 @@ def test_stats_exposes_scheduler_gauges(params):
     """CI smoke (no TPU needed): the gauges the gateway /metrics and
     autoscaler consume exist and are coherent after one request — TTFT
     EWMA with its queue-wait/prefill breakdown, the decode/prefill/idle
-    utilization split summing to ~1, and the fairness knob echoed."""
+    utilization split summing to ~1, the fairness knobs echoed, and the
+    paged-KV pool counters."""
     engine = ServingEngine(CFG, params, slots=2, max_len=32,
                            max_prefills_per_chunk=2)
     try:
@@ -130,13 +139,20 @@ def test_stats_exposes_scheduler_gauges(params):
                     "util_idle", "decode_seconds_total",
                     "prefill_seconds_total", "idle_seconds_total",
                     "admitted_total", "ttft_seconds_sum",
-                    "queue_wait_seconds_sum", "prefill_seconds_sum"):
+                    "queue_wait_seconds_sum", "prefill_seconds_sum",
+                    "kv_blocks_total", "kv_blocks_in_use",
+                    "kv_blocks_cached", "prefix_cache_hits_total",
+                    "prefix_cache_misses_total", "prefill_chunks_total",
+                    "prefill_tokens_computed_total", "kv_block_size",
+                    "prefill_chunk_tokens"):
             assert key in s, key
         assert s["max_prefills_per_chunk"] == 2
         assert s["admitted_total"] == 1
         assert s["ttft_seconds_sum"] >= s["prefill_seconds_sum"] > 0
         assert s["ttft_seconds_ewma"] > 0
         assert s["prefill_seconds_ewma"] > 0
+        assert s["prefill_tokens_computed_total"] == 3
+        assert s["prefill_chunks_total"] == 1
         util = s["util_decode"] + s["util_prefill"] + s["util_idle"]
         assert util == pytest.approx(1.0, abs=2e-3)
         assert s["util_decode"] > 0  # at least one chunk ran
@@ -145,24 +161,32 @@ def test_stats_exposes_scheduler_gauges(params):
 
 
 def test_cancel_during_prefill_overlap_leaves_no_leak(params):
-    """cancel() landing while a request's prefill is in flight (the
-    overlap window: popped from pending, not yet live) must end the
-    stream cleanly, never insert the request, and leave no entry behind
-    in _inflight/_cancelled — the slot stays usable."""
-    engine = ServingEngine(CFG, params, slots=2, max_len=64)
+    """cancel() landing while a request's prefill chunk is in flight
+    (popped from pending, not yet live) must end the stream cleanly,
+    never activate the slot, return every KV block to the pool, and
+    leave no entry behind in _inflight/_cancelled. prefix_cache=False so
+    "returned" means literally zero blocks in use (with the cache on,
+    the computed prefix is deliberately kept cached, not leaked)."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=64,
+                           prefix_cache=False)
     try:
         started, release = threading.Event(), threading.Event()
-        real_prefill = engine._prefill
+        real_chunk_fn = engine._chunk_fn
 
-        def blocking_prefill(p, toks, temp, top_p, rng):
-            started.set()
-            assert release.wait(30)
-            return real_prefill(p, toks, temp, top_p, rng)
+        def blocking_chunk_fn(n_padded):
+            fn = real_chunk_fn(n_padded)
 
-        engine._prefill = blocking_prefill
+            def wrapped(*args):
+                started.set()
+                assert release.wait(30)
+                return fn(*args)
+
+            return wrapped
+
+        engine._chunk_fn = blocking_chunk_fn
         out = engine.submit([1, 2, 3], max_new_tokens=5)
         assert started.wait(30), "engine never started the prefill"
-        engine.cancel(out)  # lands mid-overlap: in _inflight, past the pop
+        engine.cancel(out)  # lands mid-chunk: in _inflight, past the pop
         release.set()
         assert out.get(timeout=30) is None  # ended with zero tokens
         deadline = time.monotonic() + 30
@@ -176,7 +200,11 @@ def test_cancel_during_prefill_overlap_leaves_no_leak(params):
             assert not engine._inflight
             assert not engine._admitting
         assert engine.stats()["active"] == 0
+        assert engine.stats()["kv_blocks_in_use"] == 0, (
+            "cancelled mid-prefill request leaked pool blocks"
+        )
         # The slot the cancelled request reserved is free for new work.
+        engine._chunk_fn = real_chunk_fn
         q = engine.submit([5, 7, 11], max_new_tokens=3)
         assert _drain(q) == _reference(params, [5, 7, 11], 3)
     finally:
@@ -198,7 +226,17 @@ def test_idle_resubmit_after_completion_is_not_shed(params):
         engine.close()
 
 
-def test_max_prefills_per_chunk_validation(params):
+def test_scheduler_knob_validation(params):
     with pytest.raises(ValueError):
         ServingEngine(CFG, params, slots=1, max_len=32,
                       max_prefills_per_chunk=0)
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, slots=1, max_len=32,
+                      prefill_chunk_tokens=0)
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, slots=1, max_len=32, kv_block_size=0)
+    with pytest.raises(ValueError, match="divide"):
+        ServingEngine(CFG, params, slots=1, max_len=32, kv_block_size=12)
+    with pytest.raises(ValueError, match="kv_pool_blocks"):
+        ServingEngine(CFG, params, slots=1, max_len=32, kv_block_size=8,
+                      kv_pool_blocks=2)
