@@ -93,6 +93,27 @@ class StopBody(CoreModel):
     grace_seconds: float = 5.0
 
 
+class DrainBody(CoreModel):
+    """Server-initiated drain: SIGTERM the workload and give it a grace
+    window to checkpoint and exit DRAIN_EXIT_CODE. `reason` selects the
+    termination reason the runner reports — "preempted_by_scheduler" when a
+    higher-priority run reclaimed the capacity, otherwise the provider
+    preemption default."""
+
+    grace_seconds: float = 30.0
+    reason: Optional[str] = None
+
+
+class ResizeBody(CoreModel):
+    """Elastic width notification: the runner writes this to the job's
+    resize file (DSTACK_TPU_RESIZE_FILE) and the trainer polls it between
+    steps. `width` is the current number of live data-parallel hosts;
+    `total` is the gang's full width."""
+
+    width: int
+    total: int = 0
+
+
 class MetricsResponse(MetricsPoint):
     pass
 

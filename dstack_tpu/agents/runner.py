@@ -14,6 +14,7 @@ import argparse
 import asyncio
 import base64
 import functools
+import json
 import os
 import signal
 import sys
@@ -27,11 +28,13 @@ from dstack_tpu.agents.tpu_telemetry import collect_tpu_metrics
 
 from dstack_tpu.agents.protocol import (
     DRAIN_EXIT_CODE,
+    DrainBody,
     HealthcheckResponse,
     JobStateEvent,
     LogEventOut,
     MetricsResponse,
     PullResponse,
+    ResizeBody,
     StopBody,
     SubmitBody,
 )
@@ -95,19 +98,28 @@ async def watch_preemption(
 
     Keeps watching while no job is submitted yet — a notice can precede the
     job, in which case the job drains (fails as preempted) as soon as it
-    exists, letting the server reschedule the gang off the doomed host."""
+    exists, letting the server reschedule the gang off the doomed host.
+    The watcher outlives individual jobs: an agent can be reused across
+    submissions (elastic in-place resubmission), so after a drain the file
+    notice is consumed and watching continues for the next job."""
     if poll is None:
         poll = float(
             os.getenv("DSTACK_TPU_PREEMPTION_POLL", "0.5" if kind == "file" else "5")
         )
-    while not executor.finished.is_set():
+    while True:
         await asyncio.sleep(poll)
         if await _maintenance_pending(kind, target):
-            if executor.submission is None:
+            if executor.submission is None or executor.finished.is_set():
                 continue  # notice stays pending until there is a job to drain
             grace = float(os.getenv("DSTACK_TPU_DRAIN_GRACE", "30"))
             await executor.drain(grace)
-            return
+            if kind == "file":
+                # One-shot notice: consume it so the next job on this host
+                # (the elastic replacement rank) is not drained on arrival.
+                try:
+                    os.unlink(target)
+                except OSError:
+                    pass
 
 
 class MountError(Exception):
@@ -123,9 +135,18 @@ class Executor:
 
     def __init__(self, working_root: Optional[str] = None):
         self._last_event_ts = 0
+        self.working_root = working_root
+        self.reset()
+
+    def reset(self) -> None:
+        """Back to the pre-submit state so this agent can take another job
+        (elastic in-place resubmission reuses the surviving runner). The
+        event/log buffers are cleared — the new job row pulls from timestamp
+        0 and must not replay the previous submission's finished event — but
+        `_last_event_ts` is kept so timestamps stay strictly increasing
+        across submissions."""
         self.submission: Optional[SubmitBody] = None
         self.code_path: Optional[Path] = None
-        self.working_root = working_root
         self.job_states: List[JobStateEvent] = []
         self.job_logs: List[LogEventOut] = []
         self.runner_logs: List[LogEventOut] = []
@@ -133,6 +154,10 @@ class Executor:
         self.started = False
         self.finished = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+        self._preempting = False
+        self._drain_reason: Optional[JobTerminationReason] = None
+        self.resize_file: Optional[Path] = None
 
     # -- state/log plumbing --------------------------------------------------
 
@@ -194,6 +219,8 @@ class Executor:
         env["DSTACK_RUN_NAME"] = sub.run_name
         env["DSTACK_REPLICA_NUM"] = str(sub.job_spec.replica_num)
         env["DSTACK_JOB_NUM"] = str(sub.job_spec.job_num)
+        if self.resize_file is not None:
+            env["DSTACK_TPU_RESIZE_FILE"] = str(self.resize_file)
         return env
 
     async def run(self) -> None:
@@ -204,6 +231,13 @@ class Executor:
         sub = self.submission
         workdir = Path(self.working_root or tempfile.mkdtemp(prefix="dstack-job-"))
         workdir.mkdir(parents=True, exist_ok=True)
+        # Elastic width notices land here (POST /api/resize); the trainer
+        # polls the file between steps via DSTACK_TPU_RESIZE_FILE.
+        self.resize_file = workdir / ".dstack-resize.json"
+        try:
+            self.resize_file.unlink()
+        except OSError:
+            pass
         try:
             self._setup_mounts()
         except (MountError, OSError) as e:
@@ -327,11 +361,16 @@ class Executor:
             # retry policy classifies it as an interruption. DRAIN_EXIT_CODE
             # marks a clean drain (the workload confirmed its checkpoint).
             clean = code == DRAIN_EXIT_CODE
+            reason = self._drain_reason or JobTerminationReason.PREEMPTED_BY_PROVIDER
+            what = (
+                "preempted by scheduler"
+                if reason == JobTerminationReason.PREEMPTED_BY_SCHEDULER
+                else "preempted by provider"
+            )
             self.set_state(
                 JobStatus.FAILED,
-                JobTerminationReason.PREEMPTED_BY_PROVIDER,
-                "preempted by provider"
-                + ("; checkpoint drained" if clean else f"; exit status {code}"),
+                reason,
+                what + ("; checkpoint drained" if clean else f"; exit status {code}"),
                 exit_status=code,
             )
         elif code == 0:
@@ -352,23 +391,31 @@ class Executor:
 
     _stopping = False
     _preempting = False
+    _drain_reason: Optional[JobTerminationReason] = None
 
-    async def drain(self, grace_seconds: float = 30.0) -> None:
-        """Provider preemption: SIGTERM the job group, give it a grace
-        window to checkpoint (workloads install a DrainHandler —
+    async def drain(
+        self,
+        grace_seconds: float = 30.0,
+        reason: Optional[JobTerminationReason] = None,
+    ) -> None:
+        """Preemption drain: SIGTERM the job group, give it a grace window
+        to checkpoint (workloads install a DrainHandler —
         workloads/train.py), then SIGKILL. The final state is always
-        FAILED/preempted_by_provider (recorded by _wait_proc) so the
-        server's retry policy sees an `interruption` event."""
+        FAILED with a preemption reason (recorded by _wait_proc) so the
+        server's retry policy sees an `interruption` event; `reason`
+        overrides the provider-preemption default when the SERVER initiated
+        the drain (priority preemption: preempted_by_scheduler)."""
         if self.finished.is_set():
             return
         self._preempting = True
+        self._drain_reason = reason
         if self.proc is None or self.proc.returncode is not None:
             # Notice arrived before the job started (or between submit and
             # run): nothing to drain, but the host is still going away.
             self.set_state(
                 JobStatus.FAILED,
-                JobTerminationReason.PREEMPTED_BY_PROVIDER,
-                "host preempted by provider before the job started",
+                reason or JobTerminationReason.PREEMPTED_BY_PROVIDER,
+                "host preempted before the job started",
             )
             return
         self.log_runner(
@@ -412,6 +459,16 @@ class Executor:
             await asyncio.wait_for(self.proc.wait(), grace_seconds)
         except asyncio.TimeoutError:
             self._kill(signal.SIGKILL)
+
+    def write_resize(self, width: int, total: int = 0) -> None:
+        """Drop an elastic width notice for the running job (tmp+rename so
+        the trainer never reads a torn write)."""
+        if self.resize_file is None:
+            raise ApiError("No job running")
+        tmp = self.resize_file.with_name(self.resize_file.name + ".tmp")
+        tmp.write_text(json.dumps({"width": width, "total": total}))
+        tmp.replace(self.resize_file)
+        self.log_runner(f"Elastic resize notice: width={width} total={total}")
 
     def pull(self, since_ms: int) -> PullResponse:
         done = bool(self.job_states) and self.job_states[-1].state.is_finished()
@@ -467,7 +524,11 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
     @router.post("/submit")
     async def submit(request: Request):
         if executor.submission is not None:
-            raise ApiError("Job already submitted")
+            if not executor.finished.is_set():
+                raise ApiError("Job already submitted")
+            # The previous job is finished: the server is reusing this agent
+            # (elastic in-place resubmission). Start a fresh lifecycle.
+            executor.reset()
         executor.submission = request.parse(SubmitBody)
         state["deadline"] = None
         executor.log_runner(f"Job {executor.submission.job_spec.job_name} submitted")
@@ -499,6 +560,28 @@ def create_runner_app(working_root: Optional[str] = None, idle_shutdown: bool = 
     async def stop(request: Request):
         body = request.parse(StopBody) if request.body else StopBody()
         await executor.stop(body.grace_seconds)
+        return {}
+
+    @router.post("/drain")
+    async def drain(request: Request):
+        body = request.parse(DrainBody) if request.body else DrainBody()
+        reason = None
+        if body.reason:
+            try:
+                reason = JobTerminationReason(body.reason)
+            except ValueError:
+                raise ApiError(f"Unknown drain reason: {body.reason}")
+        # Respond before the grace window elapses: the drain runs in the
+        # background, and the server observes the outcome through /api/pull.
+        spawn_logged(
+            executor.drain(body.grace_seconds, reason=reason), "server drain"
+        )
+        return {}
+
+    @router.post("/resize")
+    async def resize(request: Request):
+        body = request.parse(ResizeBody)
+        executor.write_resize(body.width, body.total)
         return {}
 
     @router.get("/metrics")

@@ -54,9 +54,22 @@ _WRITE_RE = re.compile(r"^\s*(UPDATE|DELETE\s+FROM)\s+([A-Za-z_][A-Za-z0-9_]*)",
 
 _SCOPED = ("server/background/", "server/services/")
 
+# Modules whose FSM writes must sit under a claim THEY lexically take.
+# The cross-module fixed point exists so steppers invoked by
+# `for_each_claimed` don't re-lock rows the loop already claimed — but a
+# module like the preemption policy mutates OTHER runs' rows (not the row
+# its caller holds), so an inherited grant proves nothing there: the
+# caller's claim is on the requester's job, the write lands on the
+# victim's run. For these modules `held` is the lexical set only.
+_EXPLICIT_CLAIM = ("server/services/preemption",)
+
 
 def _scoped(rel: str) -> bool:
     return any(part in rel for part in _SCOPED)
+
+
+def _explicit_claim(rel: str) -> bool:
+    return any(part in rel for part in _EXPLICIT_CLAIM)
 
 
 class _Site:
@@ -271,11 +284,12 @@ class LockDisciplineChecker(Checker):
                         )
             if not _scoped(info.module.rel):
                 continue
+            explicit = _explicit_claim(info.module.rel)
             for w in info.writes:
                 allowed = TABLE_NAMESPACES.get(w.table)
                 if allowed is None:
                     continue
-                held = w.held | info.granted
+                held = w.held if explicit else (w.held | info.granted)
                 if held & allowed:
                     continue
                 want = " or ".join(f'"{ns}"' for ns in sorted(allowed))

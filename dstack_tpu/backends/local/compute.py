@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 from dstack_tpu.backends.base.catalog import tpu_offer
 from dstack_tpu.backends.base.compute import Compute
 from dstack_tpu.backends.base.offers import filter_offers
+from dstack_tpu.errors import NoCapacityError
 from dstack_tpu.models.backends import BackendType
 from dstack_tpu.models.common import CoreModel
 from pydantic import model_validator
@@ -62,6 +63,12 @@ class LocalBackendConfig(CoreModel):
     # that (the restart-reconciliation test depends on it). Default off:
     # abruptly-killed dev servers must not leak agent processes.
     detach_agents: bool = False
+    # Finite fleet: at most this many TPU slices may be live at once;
+    # further slice provisions raise NoCapacityError exactly like a real
+    # region with no free nodes. None = unlimited (the historical default).
+    # The priority-preemption chaos drill uses max_slices=1 to force the
+    # scheduler to reclaim capacity instead of provisioning fresh.
+    max_slices: Optional[int] = None
 
     @model_validator(mode="after")
     def _shim_needs_runner(self):
@@ -102,6 +109,24 @@ class LocalCompute(Compute):
         self.config = config or LocalBackendConfig()
         self._procs: Dict[str, subprocess.Popen] = {}
         self._preempt_files: Dict[tuple, str] = {}  # (instance_name, worker)
+        self._slices: Dict[str, List[int]] = {}  # instance_name -> worker pids
+
+    def _live_slices(self) -> int:
+        """Active TPU slices, pruning entries whose workers all exited —
+        a drained/crashed slice frees its capacity slot without waiting
+        for the FSM's terminate to round-trip."""
+        for name in list(self._slices):
+            alive = False
+            for pid in self._slices[name]:
+                try:
+                    os.kill(pid, 0)  # PermissionError would still mean alive
+                    alive = True
+                    break
+                except ProcessLookupError:
+                    continue
+            if not alive:
+                del self._slices[name]
+        return len(self._slices)
 
     async def get_offers(
         self, requirements: Requirements
@@ -142,6 +167,15 @@ class LocalCompute(Compute):
         instance_name: str,
         env: Optional[Dict[str, str]] = None,
     ) -> List[JobProvisioningData]:
+        is_tpu = offer.instance.resources.tpu is not None
+        if (
+            is_tpu
+            and self.config.max_slices is not None
+            and self._live_slices() >= self.config.max_slices
+        ):
+            raise NoCapacityError(
+                f"local fleet full: {self.config.max_slices} TPU slice(s) live"
+            )
         out: List[JobProvisioningData] = []
         # -S skips site init: this environment's sitecustomize imports jax
         # at interpreter start (~3s); the runner agent doesn't need it, and
@@ -231,6 +265,8 @@ class LocalCompute(Compute):
         # API deletes the whole node object); locally that must fan out to
         # every worker's process, so each jpd carries the gang's pids.
         slice_pids = [proc.pid for _w, _p, proc, _i in spawned]
+        if is_tpu:
+            self._slices[instance_name] = list(slice_pids)
         # Hand the gang to an installed chaos engine so tick-scheduled
         # preempt/crash events can target it by instance name/worker index.
         from dstack_tpu import chaos
@@ -309,6 +345,11 @@ class LocalCompute(Compute):
         proc = self._procs.pop(instance_id, None)
         data = json.loads(backend_data) if backend_data else {}
         pids = data.get("slice_pids") or []
+        # Free the capacity slot as soon as the slice is torn down (not on
+        # the next provision's liveness prune — reaped zombies still ping).
+        for name, spids in list(self._slices.items()):
+            if set(spids) & set(pids) or (proc is not None and proc.pid in spids):
+                del self._slices[name]
         if proc is not None and proc.pid not in pids:
             pids.append(proc.pid)
         if not pids and data.get("pid"):

@@ -62,15 +62,20 @@ def _expect(report: Dict[str, Any], cond: bool, what: str) -> None:
         report["failures"].append(what)
 
 
-async def _make_server(tpu_sim: Optional[List[str]] = None):
+async def _make_server(
+    tpu_sim: Optional[List[str]] = None, **backend_overrides
+):
     from dstack_tpu.server.app import create_app
     from dstack_tpu.server.http import TestClient
 
     app = create_app(db_path=":memory:", run_background_tasks=True)
     await app.startup()
     ctx = app.state["ctx"]
-    if tpu_sim:
-        ctx.overrides["local_backend_config"] = {"tpu_sim": tpu_sim}
+    if tpu_sim or backend_overrides:
+        conf = dict(backend_overrides)
+        if tpu_sim:
+            conf["tpu_sim"] = tpu_sim
+        ctx.overrides["local_backend_config"] = conf
     client = TestClient(app, token=app.state["admin_token"])
     return app, ctx, client
 
@@ -91,12 +96,13 @@ async def _wait_run(client, run_name: str, targets, timeout: float):
         await asyncio.sleep(0.2)
 
 
-def _task_body(commands, run_name, resources=None, retry=None, nodes=1):
+def _task_body(commands, run_name, resources=None, retry=None, nodes=1, **conf_extra):
     conf: Dict[str, Any] = {
         "type": "task",
         "commands": commands,
         "nodes": nodes,
         "resources": resources or {"cpu": "1..", "memory": "0.1.."},
+        **conf_extra,
     }
     if retry is not None:
         conf["retry"] = retry
@@ -247,6 +253,11 @@ import os, sys, time
 vol = sys.argv[1]
 import jax
 jax.config.update("jax_platforms", "cpu")
+# Synchronous dispatch: these sim trainers churn buffers (resize /
+# drain-restore) while the host is oversubscribed by the whole drill
+# fleet; CPU async dispatch can still touch freed buffers from its
+# dispatch thread (observed SIGSEGV / malloc corruption under load).
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 try:
     import jax.extend.backend as _jb
     _jb.clear_backends()
@@ -391,6 +402,377 @@ async def _preempt_resume(report, seed, tmp: Path) -> None:
             _expect(report, val == want, f"/metrics {metric} = {val}, want {want}")
         report["details"]["injected"] = engine.injected
         report["details"]["first_reasons"] = sorted(r for r in reasons if r)
+    finally:
+        await engine.stop()
+        await app.shutdown()
+
+
+_VICTIM_TRAIN = """
+import os, sys, time
+vol = sys.argv[1]
+import jax
+jax.config.update("jax_platforms", "cpu")
+# Synchronous dispatch: these sim trainers churn buffers (resize /
+# drain-restore) while the host is oversubscribed by the whole drill
+# fleet; CPU async dispatch can still touch freed buffers from its
+# dispatch thread (observed SIGSEGV / malloc corruption under load).
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+try:
+    import jax.extend.backend as _jb
+    _jb.clear_backends()
+except Exception:
+    pass
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.train import (
+    init_train_state, make_train_step, synthetic_batch, install_drain_handler,
+)
+from dstack_tpu.workloads import checkpoint as ckpt
+
+drain = install_drain_handler()
+cfg = PRESETS["tiny"]
+state = init_train_state(cfg, jax.random.PRNGKey(0))
+restored = ckpt.restore_latest(vol + "/ckpts", state)
+start = 0
+if restored is not None:
+    state = restored
+    start = int(state.step)
+step = make_train_step(cfg)
+batch = synthetic_batch(cfg, 2, 32)
+for _ in range(start, 400):
+    state, m = step(state, batch)
+    with open(vol + "/progress", "w") as f:
+        f.write(str(int(state.step)))
+    if drain.draining:
+        drain.checkpoint_and_exit(vol + "/ckpts", state, grace_seconds=30.0)
+    if os.path.exists(vol + "/stop"):
+        break
+    time.sleep(0.3)
+    if drain.draining:
+        drain.checkpoint_and_exit(vol + "/ckpts", state, grace_seconds=30.0)
+with open(vol + "/final", "w") as f:
+    f.write(f"resumed_from={start} final={int(state.step)}")
+"""
+
+
+@scenario("priority-preempt")
+async def _priority_preempt(report, seed, tmp: Path) -> None:
+    """Cluster-level priority preemption: the local fleet holds exactly ONE
+    TPU slice (max_slices=1) and a priority-0 training run occupies it. A
+    priority-50 run arrives, cannot place, and the scheduler reclaims
+    capacity: the victim is cleanly drained (checkpoint + DRAIN_EXIT_CODE,
+    reason preempted_by_scheduler), the high-priority run places on the
+    freed slice and finishes, and the victim resumes from its drain
+    checkpoint once capacity frees again. No chaos engine — the only
+    "fault" is the scheduler doing its job."""
+    from dstack_tpu.server import settings
+
+    settings.RETRY_PENDING_RUN_DELAY = 0
+    script = tmp / "train.py"
+    await asyncio.to_thread(script.write_text, _VICTIM_TRAIN)
+    mount = tmp / "mnt" / "ckpt"
+    app, ctx, client = await _make_server(tpu_sim=["v5litepod-4"], max_slices=1)
+    try:
+        resp = await client.post(
+            "/api/project/main/volumes/create",
+            json_body={"configuration": {
+                "type": "volume", "name": "chaos-ckpt", "backend": "local",
+                "region": "local", "size": "1GB",
+            }},
+        )
+        _expect(report, resp.status == 200, f"volume create failed: {resp.body!r}")
+        body = _task_body(
+            [f"PYTHONPATH={REPO_ROOT}:$PYTHONPATH exec python {script} {mount}"],
+            "chaos-victim",
+            resources={"tpu": "v5litepod-4"},
+            retry={"on_events": ["interruption"], "duration": 600},
+        )
+        body["run_spec"]["configuration"]["volumes"] = [
+            {"name": "chaos-ckpt", "path": str(mount)}
+        ]
+        resp = await client.post("/api/project/main/runs/submit", json_body=body)
+        _expect(report, resp.status == 200, f"victim submit failed: {resp.body!r}")
+        # The victim must be mid-training (checkpointable) before the
+        # high-priority run shows up.
+        progress = mount / "progress"
+        for _ in range(600):
+            if progress.exists():
+                break
+            await asyncio.sleep(0.2)
+        _expect(report, progress.exists(), "victim never made training progress")
+
+        body = _task_body(
+            ["echo high-priority work done"],
+            "chaos-highpri",
+            resources={"tpu": "v5litepod-4"},
+            priority=50,
+        )
+        resp = await client.post("/api/project/main/runs/submit", json_body=body)
+        _expect(report, resp.status == 200, f"high-pri submit failed: {resp.body!r}")
+        run = await _wait_run(
+            client, "chaos-highpri", {"done", "failed", "terminated"}, 120
+        )
+        _expect(
+            report, run["status"] == "done",
+            f"high-pri run ended {run['status']}, want done (preemption placed it)",
+        )
+
+        # Let the resumed victim finish.
+        await asyncio.to_thread((mount / "stop").write_text, "done")
+        victim = await _wait_run(
+            client, "chaos-victim", {"done", "failed", "terminated"}, 120
+        )
+        _expect(
+            report, victim["status"] == "done",
+            f"victim ended {victim['status']}, want done (resumed after preemption)",
+        )
+        subs = victim["jobs"][0]["job_submissions"]
+        _expect(
+            report, len(subs) == 2,
+            f"victim has {len(subs)} submissions, want 2 (drained exactly once)",
+        )
+        _expect(
+            report,
+            subs[0]["termination_reason"] == "preempted_by_scheduler",
+            f"victim first incarnation ended {subs[0]['termination_reason']},"
+            " want preempted_by_scheduler",
+        )
+        final_path = mount / "final"
+        resumed = -1
+        if final_path.exists():
+            final = await asyncio.to_thread(final_path.read_text)
+            resumed = int(final.split("resumed_from=")[1].split()[0])
+            report["details"]["final"] = final.strip()
+        _expect(
+            report, resumed > 0,
+            f"victim resumed at step {resumed}, want > 0 (from the drain checkpoint)",
+        )
+
+        resp = await client.get("/metrics", token="")
+        text = resp.body.decode()
+        for metric, want in [
+            ("dstack_tpu_run_scheduler_preemptions_total", 1),
+            ("dstack_tpu_run_clean_drains_total", 1),
+            ("dstack_tpu_run_restarts_total", 1),
+            ("dstack_tpu_run_steps_lost_total", 0),
+        ]:
+            line = next(
+                (
+                    ln
+                    for ln in text.splitlines()
+                    if ln.startswith(metric + "{") and 'run="chaos-victim"' in ln
+                ),
+                None,
+            )
+            val = float(line.rsplit(" ", 1)[1]) if line else None
+            _expect(report, val == want, f"/metrics {metric} = {val}, want {want}")
+    finally:
+        await app.shutdown()
+
+
+_ELASTIC_TRAIN = """
+import json, os, sys, time
+vol = sys.argv[1]
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=4 " + os.environ.get("XLA_FLAGS", "")
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+# Synchronous dispatch: these sim trainers churn buffers (resize /
+# drain-restore) while the host is oversubscribed by the whole drill
+# fleet; CPU async dispatch can still touch freed buffers from its
+# dispatch thread (observed SIGSEGV / malloc corruption under load).
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+try:
+    import jax.extend.backend as _jb
+    _jb.clear_backends()
+except Exception:
+    pass
+from dstack_tpu.parallel.mesh import rescale_accum_steps
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.sharding import make_mesh
+from dstack_tpu.workloads.train import (
+    init_train_state, make_train_step, read_resize_notice, synthetic_batch,
+)
+from dstack_tpu.workloads import checkpoint as ckpt
+
+GLOBAL_BATCH = 12
+cfg = PRESETS["tiny"]
+devices = jax.devices()
+
+
+_built = {}
+
+
+def build(width, accum):
+    # Cache per-width artifacts: re-expanding to a width already seen reuses
+    # the mesh and compiled step (no recompile on rejoin).
+    if (width, accum) not in _built:
+        mesh = make_mesh(devices[:width], data=width)
+        step = make_train_step(cfg, mesh, accum_steps=accum)
+        batch = synthetic_batch(cfg, GLOBAL_BATCH, 32, mesh=mesh)
+        _built[(width, accum)] = (mesh, step, batch)
+    return _built[(width, accum)]
+
+
+width, accum = 4, 3
+mesh, step_fn, batch = build(width, accum)
+state = init_train_state(cfg, jax.random.PRNGKey(0), mesh)
+widths = [width]
+steps_since_full = 0
+for _ in range(200):
+    notice = read_resize_notice()
+    if notice and notice["width"] != width:
+        # Shrink or re-expand: checkpoint, re-form the mesh at the new dp
+        # width, reshard the state back in, rescale grad accumulation so
+        # accum * width (the global batch) is invariant.
+        ckpt.save(vol + "/ckpts", state, wait=True)
+        ckpt.close_all()
+        accum = rescale_accum_steps(accum, width, notice["width"])
+        width = notice["width"]
+        widths.append(width)
+        mesh, step_fn, batch = build(width, accum)
+        template = init_train_state(cfg, jax.random.PRNGKey(0), mesh)
+        state = ckpt.restore_latest(vol + "/ckpts", template)
+        steps_since_full = 0
+    state, m = step_fn(state, batch)
+    with open(vol + "/progress", "w") as f:
+        f.write(str(int(state.step)))
+    if width == 4 and len(widths) >= 3:
+        steps_since_full += 1
+        if steps_since_full >= 2:
+            break
+    time.sleep(0.3)
+with open(vol + "/final", "w") as f:
+    f.write(json.dumps({"widths": widths, "final_step": int(state.step)}))
+"""
+
+
+@scenario("elastic-resize")
+async def _elastic_resize(report, seed, tmp: Path) -> None:
+    """Elastic data-parallel recovery: a 4-host v5p-32 gang trains with
+    elastic: true; chaos preempts worker 1 mid-run. Instead of restarting
+    the gang, the server keeps the drained host's instance, notifies the
+    survivors to re-form at width 3 (the rank-0 trainer reshards from its
+    drain checkpoint and rescales grad accumulation to preserve the global
+    batch), resubmits the lost rank in place, and re-expands to width 4
+    when it rejoins. Rank 0 never restarts; no steps are lost."""
+    from dstack_tpu.server import settings
+
+    settings.RETRY_PENDING_RUN_DELAY = 0
+    script = tmp / "train.py"
+    await asyncio.to_thread(script.write_text, _ELASTIC_TRAIN)
+    mount = tmp / "mnt" / "ckpt"
+    engine = chaos.install(
+        ChaosEngine(
+            [
+                {
+                    "hook": "tick",
+                    "action": "preempt",
+                    "worker": 1,
+                    "when_path_exists": str(mount / "progress"),
+                    "message": "chaos: host maintenance",
+                }
+            ],
+            seed=seed,
+            name="elastic-resize",
+        )
+    )
+    app, ctx, client = await _make_server(tpu_sim=["v5p-32"])
+    try:
+        await engine.start()
+        resp = await client.post(
+            "/api/project/main/volumes/create",
+            json_body={"configuration": {
+                "type": "volume", "name": "chaos-ckpt", "backend": "local",
+                "region": "local", "size": "1GB",
+            }},
+        )
+        _expect(report, resp.status == 200, f"volume create failed: {resp.body!r}")
+        # Rank 0 execs the elastic trainer; other ranks model checkpointing
+        # workers: exit DRAIN_EXIT_CODE on SIGTERM (a clean drain), park
+        # until the trainer finishes otherwise.
+        rank0 = f"PYTHONPATH={REPO_ROOT}:$PYTHONPATH exec python {script} {mount}"
+        workers = (
+            f"trap 'exit 113' TERM;"
+            f" while [ ! -f {mount}/final ]; do sleep 0.2; done; echo rank done"
+        )
+        cmd = f'if [ "$JAX_PROCESS_ID" = "0" ]; then {rank0}; else {workers}; fi'
+        body = _task_body(
+            [cmd],
+            "chaos-elastic",
+            resources={"tpu": "v5p-32"},
+            retry={"on_events": ["interruption"], "duration": 600},
+            elastic=True,
+        )
+        body["run_spec"]["configuration"]["volumes"] = [
+            {"name": "chaos-ckpt", "path": str(mount)}
+        ]
+        resp = await client.post("/api/project/main/runs/submit", json_body=body)
+        _expect(report, resp.status == 200, f"submit failed: {resp.body!r}")
+        run = await _wait_run(
+            client, "chaos-elastic", {"done", "failed", "terminated"}, 240
+        )
+        _expect(report, run["status"] == "done", f"run ended {run['status']}, want done")
+        _expect(report, engine.injected != [], "preempt event never fired")
+
+        report["details"]["submissions"] = [
+            {
+                "job_num": job["job_spec"]["job_num"],
+                "subs": [
+                    {
+                        "status": s["status"],
+                        "reason": s.get("termination_reason"),
+                        "exit": s.get("exit_status"),
+                        "msg": s.get("termination_reason_message"),
+                    }
+                    for s in job["job_submissions"]
+                ],
+            }
+            for job in run["jobs"]
+        ]
+        # Rank 0 must have survived on its FIRST submission — the whole
+        # point of elastic mode is no full-gang restart.
+        for job in run["jobs"]:
+            subs = job["job_submissions"]
+            num = job["job_spec"]["job_num"]
+            want = 2 if num == 1 else 1
+            _expect(
+                report, len(subs) == want,
+                f"job {num}: {len(subs)} submissions, want {want}",
+            )
+
+        final_path = mount / "final"
+        widths = []
+        if final_path.exists():
+            import json as _json
+
+            final = _json.loads(await asyncio.to_thread(final_path.read_text))
+            widths = final["widths"]
+            report["details"]["final"] = final
+        _expect(
+            report, widths == [4, 3, 4],
+            f"trainer width history {widths}, want [4, 3, 4]"
+            " (shrink on preemption, re-expand on rejoin)",
+        )
+
+        resp = await client.get("/metrics", token="")
+        text = resp.body.decode()
+        for metric, want in [
+            ("dstack_tpu_run_elastic_resizes_total", 1),
+            ("dstack_tpu_run_steps_lost_total", 0),
+            ("dstack_tpu_run_restarts_total", 0),
+        ]:
+            line = next(
+                (
+                    ln
+                    for ln in text.splitlines()
+                    if ln.startswith(metric + "{") and 'run="chaos-elastic"' in ln
+                ),
+                None,
+            )
+            val = float(line.rsplit(" ", 1)[1]) if line else None
+            _expect(report, val == want, f"/metrics {metric} = {val}, want {want}")
+        report["details"]["injected"] = engine.injected
     finally:
         await engine.stop()
         await app.shutdown()

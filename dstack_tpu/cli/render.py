@@ -50,15 +50,50 @@ def fmt_status(status: str) -> str:
     return f"[{_status_style(status)}]{status}[/]"
 
 
+def resilience_summary(res: dict) -> str:
+    """Compact human form of a run's resilience counters, e.g.
+    "1 preemption (1 sched), 1 clean drain, 1 restart, 1 resize"."""
+    if not res:
+        return ""
+    parts = []
+    n = res.get("preemptions", 0)
+    if n:
+        sched = res.get("preempted_by_scheduler", 0)
+        parts.append(
+            f"{n} preemption{'s' if n != 1 else ''}"
+            + (f" ({sched} sched)" if sched else "")
+        )
+    n = res.get("clean_drains", 0)
+    if n:
+        parts.append(f"{n} clean drain{'s' if n != 1 else ''}")
+    n = res.get("restarts", 0)
+    if n:
+        parts.append(f"{n} restart{'s' if n != 1 else ''}")
+    n = res.get("elastic_resizes", 0)
+    if n:
+        parts.append(f"{n} resize{'s' if n != 1 else ''}")
+    n = res.get("steps_lost", 0)
+    if n:
+        parts.append(f"[red]{n} step{'s' if n != 1 else ''} lost[/]")
+    return ", ".join(parts)
+
+
 def runs_table(runs: List[Run], verbose: bool = False) -> Table:
     table = Table(box=None, header_style="bold")
     table.add_column("NAME")
     table.add_column("BACKEND")
     table.add_column("RESOURCES")
     table.add_column("PRICE")
+    # Scheduler priority (0-100): higher places first and may preempt
+    # lower. Shown only when some run actually sets it, so the default
+    # table stays unchanged for priority-free projects.
+    show_priority = any(r.priority for r in runs)
+    if show_priority:
+        table.add_column("PRIO", justify="right")
     table.add_column("STATUS")
     table.add_column("SUBMITTED")
     if verbose:
+        table.add_column("RESILIENCE")
         table.add_column("ERROR")
     for run in runs:
         sub = run.latest_job_submission
@@ -74,10 +109,15 @@ def runs_table(runs: List[Run], verbose: bool = False) -> Table:
             backend,
             resources,
             f"${jpd.price:g}" if jpd and jpd.price else "",
+        ]
+        if show_priority:
+            row.append(str(run.priority))
+        row += [
             fmt_status(run.status.value),
             _age(run.submitted_at),
         ]
         if verbose:
+            row.append(resilience_summary(run.resilience))
             row.append(run.error)
         table.add_row(*row)
     return table

@@ -163,6 +163,12 @@ class TaskConfiguration(BaseRunConfiguration, PortsMixin, CommandsMixin):
 
     type: Literal["task"] = "task"
     nodes: int = Field(default=1, ge=1)
+    # Elastic data-parallel recovery: when a gang host is cleanly drained
+    # (preemption), the run shrinks to the surviving hosts instead of a
+    # full-gang restart — the trainer re-forms its mesh at reduced dp width
+    # from the drain checkpoint and re-expands when the host returns
+    # (docs/guides/resilience.md "Elastic training").
+    elastic: bool = False
 
 
 class DevEnvironmentConfiguration(BaseRunConfiguration, PortsMixin):

@@ -77,6 +77,9 @@ def _parse_idle_duration(v: Any) -> Any:
     return Duration.parse(v)
 
 
+MAX_RUN_PRIORITY = 100
+
+
 class ProfileParams(CoreModel):
     """Provisioning knobs shared by run configurations, fleets and profiles."""
 
@@ -94,6 +97,11 @@ class ProfileParams(CoreModel):
     idle_duration: Optional[Union[str, int]] = None
     pool_name: Optional[str] = None
     instance_name: Optional[str] = None
+    # Cluster-level scheduling priority (0..100, default 0). Higher-priority
+    # runs place first, and when they cannot place the scheduler may cleanly
+    # drain lower-priority runs whose retry policy covers interruptions
+    # (server/services/preemption.py).
+    priority: Optional[int] = None
 
     @field_validator("backends", mode="before")
     @classmethod
@@ -124,6 +132,13 @@ class ProfileParams(CoreModel):
     def _v_price(cls, v: Optional[float]) -> Optional[float]:
         if v is not None and v <= 0:
             raise ValueError("max_price must be positive")
+        return v
+
+    @field_validator("priority")
+    @classmethod
+    def _v_priority(cls, v: Optional[int]) -> Optional[int]:
+        if v is not None and not (0 <= v <= MAX_RUN_PRIORITY):
+            raise ValueError(f"priority must be in 0..{MAX_RUN_PRIORITY}")
         return v
 
     def get_retry(self) -> Optional[ProfileRetry]:

@@ -99,6 +99,11 @@ class JobTerminationReason(str, Enum):
     ABORTED_BY_USER = "aborted_by_user"
     TERMINATED_BY_SERVER = "terminated_by_server"
     GANG_MEMBER_FAILED = "gang_member_failed"  # TPU-first: any-worker death kills the gang
+    # The scheduler itself reclaimed the capacity for a higher-priority run:
+    # the server asked the agent to drain (SIGTERM + grace) exactly like a
+    # provider preemption, so a checkpointing workload exits cleanly and the
+    # run auto-resumes when capacity frees. Retryable as `interruption`.
+    PREEMPTED_BY_SCHEDULER = "preempted_by_scheduler"
     # Set by the runner/agents
     # Provider maintenance/preemption notice: the agent drained the job
     # (SIGTERM + grace) before the host went away. Retryable as an
@@ -125,6 +130,7 @@ class JobTerminationReason(str, Enum):
             self.ABORTED_BY_USER: JobStatus.ABORTED,
             self.TERMINATED_BY_SERVER: JobStatus.TERMINATED,
             self.GANG_MEMBER_FAILED: JobStatus.FAILED,
+            self.PREEMPTED_BY_SCHEDULER: JobStatus.FAILED,
             self.PREEMPTED_BY_PROVIDER: JobStatus.FAILED,
             self.CONTAINER_EXITED_WITH_ERROR: JobStatus.FAILED,
             self.PORTS_BINDING_FAILED: JobStatus.FAILED,
@@ -355,6 +361,12 @@ class Run(CoreModel):
     cost: float = 0
     service: Optional[ServiceSpec] = None
     deleted: bool = False
+    # Scheduling priority (runs.priority column; 0 unless the profile set one).
+    priority: int = 0
+    # Recovery history (runs.resilience JSON column): preemptions,
+    # clean_drains, restarts, steps_lost, preempted_by_scheduler,
+    # elastic_resizes — the same counters /metrics exports.
+    resilience: Dict[str, Any] = {}
 
     @property
     def error(self) -> str:

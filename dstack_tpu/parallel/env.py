@@ -67,6 +67,35 @@ def make_cluster_env(
     return env
 
 
+def make_elastic_env(
+    cluster: ClusterInfo,
+    node_rank: int,
+    active_ranks: List[int],
+) -> Dict[str, str]:
+    """Coordinator env for a SURVIVOR of an elastic resize.
+
+    When a worker host is preempted out of an elastic data-parallel gang,
+    the remaining hosts re-form the JAX process group at reduced width:
+    process ids must stay dense (0..n-1) and the hostname list must shrink
+    to the live hosts, or `jax.distributed.initialize` hangs waiting for
+    the dead rank. This derives that env from the original ClusterInfo plus
+    the set of surviving node ranks — the server pushes it through the
+    runner's resize channel, the trainer re-initializes from its last
+    checkpoint (see docs/guides/resilience.md, "Elastic training").
+
+    The coordinator host must survive (rank 0 is never elastically removed
+    — the FSM only resizes around non-coordinator ranks).
+    """
+    ranks = sorted(active_ranks)
+    if node_rank not in ranks:
+        raise ValueError(f"node_rank {node_rank} is not among survivors {ranks}")
+    if 0 not in ranks:
+        raise ValueError("elastic resize cannot remove the coordinator (rank 0)")
+    ips = [cluster.job_ips[r] for r in ranks]
+    shrunk = cluster.model_copy(update={"job_ips": ips})
+    return make_cluster_env(shrunk, ranks.index(node_rank))
+
+
 def make_megascale_env(cluster: ClusterInfo) -> Dict[str, str]:
     """Multi-slice (DCN) env: XLA's megascale runtime coordinates slices.
 

@@ -47,3 +47,24 @@ def mesh_shape_for_devices(
     if n_devices % tensor_parallel != 0:
         raise ValueError(f"{tensor_parallel=} does not divide {n_devices=}")
     return (n_devices // tensor_parallel, tensor_parallel), ("data", "model")
+
+
+def rescale_accum_steps(accum_steps: int, old_width: int, new_width: int) -> int:
+    """Gradient-accumulation steps after an elastic data-parallel resize,
+    preserving the global batch: accum_steps x dp_width is invariant, so the
+    loss trajectory (and LR schedule) is bit-compatible with the full-width
+    run. Raises when the global step count does not divide evenly at the new
+    width — the caller must then choose a different microbatch split rather
+    than silently training at a different batch size.
+    """
+    if old_width <= 0 or new_width <= 0:
+        raise ValueError(f"mesh widths must be positive, got {old_width}->{new_width}")
+    total = accum_steps * old_width
+    if total % new_width != 0:
+        raise ValueError(
+            f"global batch of {total} microbatches does not divide evenly"
+            f" across dp width {new_width}; pick accum_steps so that"
+            f" accum_steps * width is divisible by every width you may"
+            f" shrink to"
+        )
+    return total // new_width

@@ -13,7 +13,7 @@ from typing import Dict, List, Optional
 
 import sqlite3
 
-from dstack_tpu.agents.protocol import TaskStatus, TaskSubmitRequest
+from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE, TaskStatus, TaskSubmitRequest
 from dstack_tpu.models.backends import BackendType
 from dstack_tpu.models.instances import InstanceStatus
 from dstack_tpu.models.logs import LogProducer
@@ -173,10 +173,17 @@ async def _get_run_row(
 
 
 async def _replica_rows(ctx: ServerContext, row: sqlite3.Row) -> List[sqlite3.Row]:
+    # Latest submission per sibling job, NOT this row's own submission_num:
+    # after an elastic in-place resubmission one rank of the gang runs at a
+    # higher submission_num than its siblings, and filtering on the caller's
+    # number would make the gang look forever incomplete to both of them.
     return await ctx.db.fetchall(
-        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND submission_num = ?"
-        " ORDER BY job_num",
-        (row["run_id"], row["replica_num"], row["submission_num"]),
+        "SELECT j.* FROM jobs j JOIN ("
+        "  SELECT job_num, MAX(submission_num) AS sn FROM jobs"
+        "  WHERE run_id = ? AND replica_num = ? GROUP BY job_num"
+        ") latest ON j.job_num = latest.job_num AND j.submission_num = latest.sn"
+        " WHERE j.run_id = ? AND j.replica_num = ? ORDER BY j.job_num",
+        (row["run_id"], row["replica_num"], row["run_id"], row["replica_num"]),
     )
 
 
@@ -627,10 +634,50 @@ async def _pull_runner(
                 ),
             )
             ctx.routing_cache.invalidate_run(row["run_name"])
-            await _release_instance(ctx, row)
+            if await _elastic_keeps_instance(
+                ctx, row, reason, event.exit_status, tick
+            ):
+                logger.info(
+                    "job %s drained cleanly; instance kept for elastic"
+                    " in-place resubmission", row["id"][:8],
+                )
+            else:
+                await _release_instance(ctx, row)
             ctx.kick("runs")
             logger.info("job %s finished: %s", row["id"][:8], event.state.value)
             return
+
+
+_ELASTIC_DRAIN_REASONS = {
+    JobTerminationReason.PREEMPTED_BY_PROVIDER,
+    JobTerminationReason.PREEMPTED_BY_SCHEDULER,
+}
+
+
+async def _elastic_keeps_instance(
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    reason: JobTerminationReason,
+    exit_status: Optional[int],
+    tick: Optional[_Tick] = None,
+) -> bool:
+    """Whether a finished job's instance must survive it: an elastic task's
+    clean preemption drain keeps the host, because the run FSM is about to
+    resubmit the lost rank in place onto the same runner — and terminating
+    the instance would tear down the slice (the local backend kills the
+    whole slice's worker processes), taking the survivors with it."""
+    if reason not in _ELASTIC_DRAIN_REASONS or exit_status != DRAIN_EXIT_CODE:
+        return False
+    if row["job_num"] == 0:
+        return False  # coordinator loss always goes through the full retry
+    run_row = await _get_run_row(ctx, row["run_id"], tick)
+    if run_row is None:
+        return False
+    from dstack_tpu.models.runs import RunSpec
+
+    run_spec = ctx.spec_cache.parse(RunSpec, "runs", run_row["id"], run_row["run_spec"])
+    conf = run_spec.configuration
+    return conf.type == "task" and bool(getattr(conf, "elastic", False))
 
 
 async def _handle_disconnect(ctx: ServerContext, row: sqlite3.Row) -> None:

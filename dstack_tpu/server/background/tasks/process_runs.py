@@ -24,6 +24,7 @@ from dstack_tpu.models.runs import (
 )
 from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.security import generate_id
 from dstack_tpu.server.services.runs import (
     JOB_TERMINATION_REASONS_RETRYABLE,
     create_replica_jobs,
@@ -100,6 +101,8 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
         ):
             failed_replicas.add(j["replica_num"])
     if failed_replicas:
+        if await _maybe_elastic_resize(ctx, row, jobs, failed_replicas):
+            return
         retryable = await _maybe_retry(ctx, row, jobs, failed_replicas)
         if retryable:
             return
@@ -140,6 +143,9 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
         await ctx.db.execute(
             "UPDATE runs SET status = ? WHERE id = ?", (new_status.value, row["id"])
         )
+
+    if all(s == JobStatus.RUNNING for s in statuses):
+        await _maybe_elastic_reexpand(ctx, row, jobs)
 
     if (new_status or RunStatus(row["status"])) == RunStatus.RUNNING:
         await _maybe_autoscale(ctx, row, jobs)
@@ -213,40 +219,57 @@ async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
 async def _maybe_retry(
     ctx: ServerContext, row: sqlite3.Row, jobs: List[sqlite3.Row], failed_replicas: set
 ) -> bool:
-    """Resubmit failed replicas when the retry policy covers the failure."""
+    """Resubmit failed replicas when the retry policy covers the failure.
+
+    Decide-then-mutate: coverage and budget are computed for EVERY failed
+    replica before any row is written. The earlier shape returned False from
+    the middle of the per-replica loop when a later replica was not covered,
+    after earlier replicas had already been resubmitted — the run then fell
+    through to the gang-failure teardown with fresh SUBMITTED jobs orphaned
+    under a TERMINATING run.
+    """
     run_spec = ctx.spec_cache.parse(RunSpec, "runs", row["id"], row["run_spec"])
     profile = run_spec.merged_profile
     retry = profile.get_retry() if profile else None
     if retry is None:
         return False
     now = utcnow()
-    resilience = json.loads(row["resilience"]) if row["resilience"] else {}
-    resubmitted = False
-    for replica in failed_replicas:
+
+    # All jobs of every failed replica must be finished before any decision:
+    # terminate the survivors first and retry on a later tick.
+    unfinished = [
+        j
+        for j in jobs
+        if j["replica_num"] in failed_replicas
+        and not JobStatus(j["status"]).is_finished()
+    ]
+    if unfinished:
+        for j in unfinished:
+            if j["status"] != "terminating":
+                await ctx.db.execute(
+                    "UPDATE jobs SET status = ?, termination_reason = ?,"
+                    " last_processed_at = ? WHERE id = ?",
+                    (
+                        JobStatus.TERMINATING.value,
+                        JobTerminationReason.GANG_MEMBER_FAILED.value,
+                        utcnow_iso(),
+                        j["id"],
+                    ),
+                )
+        ctx.routing_cache.invalidate_run(row["run_name"])
+        ctx.kick("terminating_jobs")
+        return True
+
+    # Phase 1 — decide (no writes). Any uncovered replica vetoes the whole
+    # retry; any over-budget replica fails the run with RETRY_LIMIT_EXCEEDED.
+    retry_events = {e.value for e in retry.on_events}
+    plans = []
+    budget_exceeded = False
+    for replica in sorted(failed_replicas):
         replica_jobs = [j for j in jobs if j["replica_num"] == replica]
-        # All jobs of the failed replica must be finished before resubmission.
-        if not all(JobStatus(j["status"]).is_finished() for j in replica_jobs):
-            # Terminate the survivors first; retry on a later tick.
-            for j in replica_jobs:
-                if not JobStatus(j["status"]).is_finished() and j["status"] != "terminating":
-                    await ctx.db.execute(
-                        "UPDATE jobs SET status = ?, termination_reason = ?,"
-                        " last_processed_at = ? WHERE id = ?",
-                        (
-                            JobStatus.TERMINATING.value,
-                            JobTerminationReason.GANG_MEMBER_FAILED.value,
-                            utcnow_iso(),
-                            j["id"],
-                        ),
-                    )
-            ctx.routing_cache.invalidate_run(row["run_name"])
-            ctx.kick("terminating_jobs")
-            return True
         reasons = {
             j["termination_reason"] for j in replica_jobs if j["termination_reason"]
         } - {JobTerminationReason.GANG_MEMBER_FAILED.value}
-        retry_events = {e.value for e in retry.on_events}
-        covered = True
         for reason in reasons:
             r = JobTerminationReason(reason)
             if r in JOB_TERMINATION_REASONS_RETRYABLE:
@@ -254,9 +277,7 @@ async def _maybe_retry(
             else:
                 needed = {"error"}
             if not (needed & retry_events):
-                covered = False
-        if not covered:
-            return False
+                return False
         # Retry-duration budget: measured from the FIRST submission of the
         # replica, not the latest resubmission — otherwise each retry resets
         # the clock and a flapping replica retries forever.
@@ -267,30 +288,35 @@ async def _maybe_retry(
         )
         first = parse_dt(first_row["first_submitted"])
         if (now - first).total_seconds() > retry.duration:
-            await ctx.db.execute(
-                "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
-                (
-                    RunStatus.TERMINATING.value,
-                    RunTerminationReason.RETRY_LIMIT_EXCEEDED.value,
-                    row["id"],
-                ),
-            )
-            return True
+            budget_exceeded = True
+        plans.append((replica, replica_jobs))
+    if budget_exceeded:
+        await ctx.db.execute(
+            "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
+            (
+                RunStatus.TERMINATING.value,
+                RunTerminationReason.RETRY_LIMIT_EXCEEDED.value,
+                row["id"],
+            ),
+        )
+        return True
+
+    # Phase 2 — mutate. Every failed replica is covered and within budget.
+    resilience = json.loads(row["resilience"]) if row["resilience"] else {}
+    for replica, replica_jobs in plans:
         submission_num = max(j["submission_num"] for j in replica_jobs) + 1
         await create_replica_jobs(
             ctx, row["project_id"], row["id"], run_spec, replica, submission_num
         )
         _account_resilience(ctx, row, resilience, replica_jobs)
-        resubmitted = True
         logger.info(
             "run %s: resubmitted replica %s (submission %s)",
             row["run_name"], replica, submission_num,
         )
-    if resubmitted:
-        await ctx.db.execute(
-            "UPDATE runs SET status = ?, resilience = ? WHERE id = ?",
-            (RunStatus.PENDING.value, json.dumps(resilience), row["id"]),
-        )
+    await ctx.db.execute(
+        "UPDATE runs SET status = ?, resilience = ? WHERE id = ?",
+        (RunStatus.PENDING.value, json.dumps(resilience), row["id"]),
+    )
     ctx.kick("submitted_jobs")
     return True
 
@@ -298,6 +324,14 @@ async def _maybe_retry(
 _PREEMPTION_REASONS = {
     JobTerminationReason.PREEMPTED_BY_PROVIDER.value,
     JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY.value,
+    JobTerminationReason.PREEMPTED_BY_SCHEDULER.value,
+}
+
+# Reasons that come with a drain window: the agent SIGTERMed the workload and
+# a checkpointing job exits DRAIN_EXIT_CODE with its state durable.
+_CLEAN_DRAIN_REASONS = {
+    JobTerminationReason.PREEMPTED_BY_PROVIDER.value,
+    JobTerminationReason.PREEMPTED_BY_SCHEDULER.value,
 }
 
 
@@ -307,9 +341,10 @@ def _account_resilience(
     """Accumulate per-run resilience counters for one replica resubmission.
 
     steps_lost stays 0 for clean drains by construction (the checkpoint is
-    saved before the job exits); hard kills lose whatever the workload wrote
-    since its last periodic checkpoint, which the server cannot see — so it
-    is only bumped when no clean drain happened, as "unknown >= 0" floor.
+    saved before the job exits); a hard kill loses whatever the workload
+    wrote since its last periodic checkpoint, which the server cannot see —
+    so each hard-killed preemption bumps steps_lost by 1, a ">= 1 step lost"
+    floor rather than an exact count.
     """
     preemptions = sum(
         1 for j in replica_jobs if j["termination_reason"] in _PREEMPTION_REASONS
@@ -317,13 +352,29 @@ def _account_resilience(
     clean_drains = sum(
         1
         for j in replica_jobs
-        if j["termination_reason"] == JobTerminationReason.PREEMPTED_BY_PROVIDER.value
+        if j["termination_reason"] in _CLEAN_DRAIN_REASONS
         and j["exit_status"] == DRAIN_EXIT_CODE
     )
+    scheduler_preemptions = sum(
+        1
+        for j in replica_jobs
+        if j["termination_reason"] == JobTerminationReason.PREEMPTED_BY_SCHEDULER.value
+    )
+    hard_kills = preemptions - clean_drains
     resilience["preemptions"] = resilience.get("preemptions", 0) + preemptions
     resilience["clean_drains"] = resilience.get("clean_drains", 0) + clean_drains
     resilience["restarts"] = resilience.get("restarts", 0) + 1
+    if scheduler_preemptions:
+        resilience["preempted_by_scheduler"] = (
+            resilience.get("preempted_by_scheduler", 0) + scheduler_preemptions
+        )
+    if hard_kills > 0:
+        resilience["steps_lost"] = resilience.get("steps_lost", 0) + hard_kills
     resilience.setdefault("steps_lost", 0)
+    # A full-gang restart supersedes any in-flight scheduler drain or
+    # elastic shrink: the markers are consumed here.
+    resilience.pop("scheduler_drain", None)
+    resilience.pop("elastic_width", None)
     # Event-stream counters are labeled only by run — distinct names from
     # the DB-sourced {project,run} series (dstack_tpu_run_preemptions_total
     # etc.), which a shared name would corrupt with mixed label sets.
@@ -332,7 +383,166 @@ def _account_resilience(
         ctx.tracer.inc("run_preemption_events", preemptions, **labels)
     if clean_drains:
         ctx.tracer.inc("run_clean_drain_events", clean_drains, **labels)
+    if scheduler_preemptions:
+        ctx.tracer.inc("run_scheduler_preemption_events", scheduler_preemptions, **labels)
     ctx.tracer.inc("run_restart_events", 1, **labels)
+
+
+async def _maybe_elastic_resize(
+    ctx: ServerContext, row: sqlite3.Row, jobs: List[sqlite3.Row], failed_replicas: set
+) -> bool:
+    """Shrink an elastic gang instead of restarting it.
+
+    When a non-coordinator host of an `elastic: true` task drains cleanly
+    (preemption, exit DRAIN_EXIT_CODE), the survivors keep stepping at
+    reduced data-parallel width: the lost rank is resubmitted onto its kept
+    instance, and each surviving runner is told the new width through its
+    resize file so the trainer re-forms its mesh from the drain checkpoint.
+    Once the replacement is RUNNING again, _maybe_elastic_reexpand restores
+    the full width. No job of the surviving set is ever restarted.
+    """
+    run_spec = ctx.spec_cache.parse(RunSpec, "runs", row["id"], row["run_spec"])
+    conf = run_spec.configuration
+    if conf.type != "task" or not getattr(conf, "elastic", False):
+        return False
+    if len(failed_replicas) != 1:
+        return False
+    replica = next(iter(failed_replicas))
+    replica_jobs = [j for j in jobs if j["replica_num"] == replica]
+    if len(replica_jobs) < 2:
+        return False
+
+    def _failed(j: sqlite3.Row) -> bool:
+        s = JobStatus(j["status"])
+        return s in (JobStatus.FAILED, JobStatus.ABORTED) or (
+            s == JobStatus.TERMINATED
+            and j["termination_reason"] != JobTerminationReason.SCALED_DOWN.value
+        )
+
+    lost = [j for j in replica_jobs if _failed(j)]
+    survivors = [j for j in replica_jobs if not _failed(j)]
+    # Losing the coordinator host (job 0) tears down the JAX coordinator
+    # itself; that cannot shrink — fall through to the normal retry path.
+    if any(j["job_num"] == 0 for j in lost):
+        return False
+    if not survivors or len(lost) >= len(replica_jobs):
+        return False
+    # Only clean preemption drains are shrinkable: the checkpoint is durable
+    # and the instance was kept (process_running_jobs skips the release for
+    # elastic clean drains), so the replacement lands on the same host.
+    for j in lost:
+        if (
+            j["termination_reason"] not in _CLEAN_DRAIN_REASONS
+            or j["exit_status"] != DRAIN_EXIT_CODE
+            or not j["instance_id"]
+        ):
+            return False
+    if any(j["status"] != JobStatus.RUNNING.value for j in survivors):
+        return False
+
+    now = utcnow_iso()
+    resilience = json.loads(row["resilience"]) if row["resilience"] else {}
+    resilience["elastic_resizes"] = resilience.get("elastic_resizes", 0) + 1
+    resilience.setdefault("steps_lost", 0)
+    resilience["elastic_width"] = len(survivors)
+    resilience["elastic_resized_at"] = now
+    ctx.tracer.inc("run_elastic_resize_events", len(lost), run=row["run_name"])
+    for j in lost:
+        # Resubmit the lost rank pinned to its kept instance: the submitted-
+        # jobs processor sees instance_assigned and goes straight to
+        # provisioning on the same runner agent.
+        await ctx.db.execute(
+            "INSERT INTO jobs (id, project_id, run_id, run_name, job_num,"
+            " replica_num, submission_num, submitted_at, last_processed_at,"
+            " status, job_spec, instance_id, instance_assigned,"
+            " job_provisioning_data)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, 1, ?)",
+            (
+                generate_id(),
+                j["project_id"],
+                j["run_id"],
+                j["run_name"],
+                j["job_num"],
+                j["replica_num"],
+                j["submission_num"] + 1,
+                now,
+                now,
+                JobStatus.SUBMITTED.value,
+                j["job_spec"],
+                j["instance_id"],
+                j["job_provisioning_data"],
+            ),
+        )
+    await ctx.db.execute(
+        "UPDATE runs SET resilience = ? WHERE id = ?",
+        (json.dumps(resilience), row["id"]),
+    )
+    await _notify_resize(ctx, survivors, len(survivors), len(replica_jobs))
+    ctx.kick("submitted_jobs")
+    logger.info(
+        "run %s: elastic shrink to %d/%d hosts; lost rank(s) resubmitted in place",
+        row["run_name"], len(survivors), len(replica_jobs),
+    )
+    return True
+
+
+async def _maybe_elastic_reexpand(
+    ctx: ServerContext, row: sqlite3.Row, jobs: List[sqlite3.Row]
+) -> None:
+    """Restore the full data-parallel width once every host is RUNNING again."""
+    resilience = json.loads(row["resilience"]) if row["resilience"] else {}
+    if "elastic_width" not in resilience:
+        return
+    # Debounce: survivors must actually train at the reduced width for a
+    # while before the width bounces back — a replacement that rejoins
+    # within one trainer poll would otherwise overwrite the shrink notice
+    # before any survivor observed it, wasting the drain checkpoint.
+    resized_at = resilience.get("elastic_resized_at")
+    if resized_at is not None:
+        held = (utcnow() - parse_dt(resized_at)).total_seconds()
+        if held < settings.ELASTIC_REEXPAND_HYSTERESIS:
+            return
+    resilience.pop("elastic_width", None)
+    resilience.pop("elastic_resized_at", None)
+    await ctx.db.execute(
+        "UPDATE runs SET resilience = ? WHERE id = ?",
+        (json.dumps(resilience), row["id"]),
+    )
+    by_replica = {}
+    for j in jobs:
+        by_replica.setdefault(j["replica_num"], []).append(j)
+    for replica_jobs in by_replica.values():
+        await _notify_resize(ctx, replica_jobs, len(replica_jobs), len(replica_jobs))
+    logger.info("run %s: elastic re-expand to full width", row["run_name"])
+
+
+async def _notify_resize(
+    ctx: ServerContext, job_rows: List[sqlite3.Row], width: int, total: int
+) -> None:
+    """Best-effort: tell each runner the current data-parallel width. The
+    agent writes it to the job's resize file; the trainer polls that file
+    between steps (workloads/train.py)."""
+    from dstack_tpu.models.runs import JobProvisioningData
+    from dstack_tpu.server.background.tasks.process_running_jobs import (
+        _runner_port_override,
+    )
+    from dstack_tpu.server.services.connections import get_connection_pool
+
+    for j in job_rows:
+        if not j["job_provisioning_data"] or not j["instance_id"]:
+            continue
+        try:
+            jpd = ctx.spec_cache.parse(
+                JobProvisioningData, "jobs", j["id"], j["job_provisioning_data"]
+            )
+            conn = await get_connection_pool(ctx).get(ctx, j["instance_id"], jpd)
+            client = conn.runner_client(port=_runner_port_override(j))
+            await client.resize(width=width, total=total)
+        except Exception as e:
+            logger.warning(
+                "run %s: resize notify failed for job %s: %s",
+                j["run_name"], j["id"][:8], e,
+            )
 
 
 def _pending_run_delay(run_id: str, base: float, attempt: int) -> float:

@@ -127,8 +127,13 @@ async def process_submitted_jobs(ctx: ServerContext) -> None:
     from dstack_tpu.server import settings
     from dstack_tpu.server.background.concurrency import for_each_claimed
 
+    # Priority-then-anchor order: higher-priority runs' jobs place first, so
+    # capacity freed by a preemption drain (services/preemption.py) is
+    # claimed by the run that asked for it, not whichever job polled first.
     rows = await ctx.db.fetchall(
-        "SELECT * FROM jobs WHERE status = 'submitted' ORDER BY last_processed_at"
+        "SELECT j.* FROM jobs j JOIN runs r ON j.run_id = r.id"
+        " WHERE j.status = 'submitted'"
+        " ORDER BY r.priority DESC, j.last_processed_at"
     )
     ctx.tracer.inc("tick_rows_scanned", len(rows), processor="submitted_jobs")
     if not rows:
@@ -203,6 +208,8 @@ async def _process_job(
         master_jpd=master_jpd,
     )
     if not pairs:
+        if await _maybe_preempt(ctx, row, run_row, run_spec, job_spec):
+            return  # stays SUBMITTED; the freed capacity arrives within a tick
         await _fail_job(
             ctx, row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
             "no matching offers",
@@ -233,9 +240,18 @@ async def _process_job(
         await _commit_provisioned_slice(ctx, row, run_row, run_spec, offer, jpds)
         ctx.kick("running_jobs")
         return
+    if await _maybe_preempt(ctx, row, run_row, run_spec, job_spec):
+        return  # stays SUBMITTED; the freed capacity arrives within a tick
     await _fail_job(
         ctx, row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY, last_error
     )
+
+
+async def _maybe_preempt(ctx, row, run_row, run_spec, job_spec) -> bool:
+    """Priority preemption hook for the two no-capacity fail sites."""
+    from dstack_tpu.server.services import preemption
+
+    return await preemption.maybe_preempt(ctx, row, run_row, run_spec, job_spec)
 
 
 async def _get_master_jpd(

@@ -25,15 +25,19 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # Per-run resilience totals, sourced from the runs.resilience JSON
     # column (survive server restarts).
     "dstack_tpu_run_clean_drains_total": ("counter", ("project", "run")),
+    "dstack_tpu_run_elastic_resizes_total": ("counter", ("project", "run")),
     "dstack_tpu_run_preemptions_total": ("counter", ("project", "run")),
     "dstack_tpu_run_restarts_total": ("counter", ("project", "run")),
+    "dstack_tpu_run_scheduler_preemptions_total": ("counter", ("project", "run")),
     "dstack_tpu_run_steps_lost_total": ("counter", ("project", "run")),
     # In-process tracer event counters (reset on restart). Deliberately
     # named *_events_total so they can never collide with the DB-sourced
     # totals above.
     "dstack_tpu_run_clean_drain_events_total": ("counter", ("run",)),
+    "dstack_tpu_run_elastic_resize_events_total": ("counter", ("run",)),
     "dstack_tpu_run_preemption_events_total": ("counter", ("run",)),
     "dstack_tpu_run_restart_events_total": ("counter", ("run",)),
+    "dstack_tpu_run_scheduler_preemption_events_total": ("counter", ("run",)),
     # Background FSM tick accounting.
     "dstack_tpu_tick_rows_scanned_total": ("counter", ("processor",)),
     "dstack_tpu_tick_rows_stepped_total": ("counter", ("processor",)),

@@ -57,6 +57,8 @@ async def prometheus_metrics(request: Request):
         "restarts": "dstack_tpu_run_restarts_total",
         "clean_drains": "dstack_tpu_run_clean_drains_total",
         "steps_lost": "dstack_tpu_run_steps_lost_total",
+        "preempted_by_scheduler": "dstack_tpu_run_scheduler_preemptions_total",
+        "elastic_resizes": "dstack_tpu_run_elastic_resizes_total",
     }
     for r in rows:
         res = json.loads(r["resilience"])

@@ -338,3 +338,17 @@ DROP INDEX ix_instances_project_status;
 DROP INDEX ix_logs_poll;
 """,
 )
+
+# Migration 7: cluster-level scheduling priority. Backfilled 0 (the
+# pre-priority default) so ordering by priority is total across old rows;
+# process_submitted_jobs places in priority-then-anchor order and the
+# preemption policy (services/preemption.py) only ever drains strictly
+# lower-priority runs.
+migration(
+    """
+ALTER TABLE runs ADD COLUMN priority INTEGER NOT NULL DEFAULT 0;
+""",
+    down="""
+ALTER TABLE runs DROP COLUMN priority;
+""",
+)

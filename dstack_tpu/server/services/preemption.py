@@ -1,0 +1,217 @@
+"""Cluster-level priority preemption policy.
+
+When a higher-priority run's job cannot place (no idle pool match, no
+capacity from the backends), the scheduler may reclaim capacity from
+lower-priority runs instead of failing the job: it picks the cheapest
+RUNNING victim whose retry policy covers interruptions and whose instances
+satisfy the request, and cleanly drains it through the runner's drain API —
+the exact mechanism a provider preemption uses, so a checkpointing workload
+exits DRAIN_EXIT_CODE with its state durable. The victim's jobs finish as
+`preempted_by_scheduler`, the run FSM resubmits them under its retry policy
+(they back off while the fleet is full and resume from the drain checkpoint
+when capacity frees), and the requester's job stays SUBMITTED to claim the
+freed capacity on the next scheduler tick — priority ordering in
+process_submitted_jobs guarantees it gets there first.
+
+Lock discipline: the cross-run `UPDATE runs` below mutates a run this
+processor holds NO FSM claim on (the claim is on the requester's job row),
+so it takes an explicit lexical `lock_ctx("runs")` — and the static
+analyzer's LCK01 checker enforces exactly that for this module
+(analysis/checkers/lock_discipline.py, explicit-claim scope).
+"""
+
+import json
+import logging
+from typing import List, Optional
+
+import sqlite3
+
+from dstack_tpu.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.models.profiles import RetryEvent
+from dstack_tpu.models.runs import (
+    JobProvisioningData,
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+    RunSpec,
+    RunStatus,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.context import ServerContext
+from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
+
+logger = logging.getLogger(__name__)
+
+
+async def maybe_preempt(
+    ctx: ServerContext,
+    job_row: sqlite3.Row,
+    run_row: sqlite3.Row,
+    run_spec: RunSpec,
+    job_spec: JobSpec,
+) -> bool:
+    """Try to free capacity for a job that could not place.
+
+    Returns True when the job should stay SUBMITTED (a drain was issued now,
+    or one is already in flight for this project) and False when priority
+    preemption does not apply — the caller then fails the job with the
+    normal no-capacity path.
+    """
+    priority = run_row["priority"] if "priority" in run_row.keys() else 0
+    if not priority or priority <= 0:
+        return False
+
+    active_rows = await ctx.db.fetchall(
+        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0 AND id != ?"
+        " AND status NOT IN ('terminated', 'failed', 'done')",
+        (job_row["project_id"], job_row["run_id"]),
+    )
+    now = utcnow()
+    for r in active_rows:
+        res = json.loads(r["resilience"]) if r["resilience"] else {}
+        ts = res.get("scheduler_drain")
+        if ts and (now - parse_dt(ts)).total_seconds() < settings.SCHEDULER_PREEMPTION_TTL:
+            # A drain is already landing; reclaiming more before it settles
+            # would evict a second victim for the same request.
+            return True
+
+    victim = await _pick_victim(ctx, active_rows, priority, job_spec)
+    if victim is None:
+        return False
+    await _drain_run(ctx, victim)
+    logger.info(
+        "run %s (priority %d): preempting run %s (priority %d) to free capacity",
+        run_row["run_name"], priority,
+        victim["row"]["run_name"], victim["priority"],
+    )
+    return True
+
+
+async def _pick_victim(
+    ctx: ServerContext,
+    active_rows: List[sqlite3.Row],
+    priority: int,
+    job_spec: JobSpec,
+) -> Optional[dict]:
+    """The cheapest strictly-lower-priority RUNNING run whose instances
+    satisfy the request. A victim must be fully drainable: every live job
+    RUNNING with a reachable runner, and its retry policy covering
+    `interruption` — draining a run that cannot resume would turn a
+    scheduling decision into data loss."""
+    needed_hosts = job_spec.tpu_slice.hosts if job_spec.tpu_slice else 1
+    candidates = []
+    for r in active_rows:
+        v_priority = r["priority"] if "priority" in r.keys() else 0
+        if v_priority >= priority:
+            continue
+        if RunStatus(r["status"]) != RunStatus.RUNNING:
+            continue
+        v_spec = ctx.spec_cache.parse(RunSpec, "runs", r["id"], r["run_spec"])
+        v_profile = v_spec.merged_profile
+        v_retry = v_profile.get_retry() if v_profile else None
+        if v_retry is None or RetryEvent.INTERRUPTION not in v_retry.on_events:
+            continue
+        jobs = await _live_jobs(ctx, r["id"])
+        if not jobs or any(j["status"] != JobStatus.RUNNING.value for j in jobs):
+            continue
+        if any(not j["instance_id"] or not j["job_provisioning_data"] for j in jobs):
+            continue
+        matching, price = await _instance_match(ctx, jobs, job_spec)
+        if matching < needed_hosts:
+            continue
+        candidates.append(
+            {"row": r, "jobs": jobs, "price": price, "priority": v_priority}
+        )
+    if not candidates:
+        return None
+    candidates.sort(key=lambda v: (v["price"], v["row"]["id"]))
+    return candidates[0]
+
+
+async def _live_jobs(ctx: ServerContext, run_id: str) -> List[sqlite3.Row]:
+    """Latest submission of each (replica, job) of the victim run."""
+    return await ctx.db.fetchall(
+        "SELECT j.* FROM jobs j JOIN ("
+        "  SELECT replica_num, job_num, MAX(submission_num) AS sn FROM jobs"
+        "  WHERE run_id = ? GROUP BY replica_num, job_num"
+        ") latest ON j.replica_num = latest.replica_num AND j.job_num = latest.job_num"
+        "  AND j.submission_num = latest.sn WHERE j.run_id = ?"
+        " ORDER BY j.replica_num, j.job_num",
+        (run_id, run_id),
+    )
+
+
+async def _instance_match(
+    ctx: ServerContext, jobs: List[sqlite3.Row], job_spec: JobSpec
+):
+    """(matching instance count, total price/h) of a victim's instances,
+    using the same offer-vs-requirements filter the pool-reuse path applies
+    — freed capacity only counts if this requester could actually use it."""
+    from dstack_tpu.backends.base.offers import offer_matches_requirements
+
+    matching = 0
+    price = 0.0
+    for j in jobs:
+        irow = await ctx.db.fetchone(
+            "SELECT * FROM instances WHERE id = ?", (j["instance_id"],)
+        )
+        if irow is None or not irow["offer"]:
+            continue
+        offer = ctx.spec_cache.parse(
+            InstanceOfferWithAvailability, "instances", irow["id"], irow["offer"]
+        )
+        price += offer.price or 0.0
+        if offer_matches_requirements(offer, job_spec.requirements):
+            matching += 1
+    return matching, price
+
+
+async def _drain_run(ctx: ServerContext, victim: dict) -> None:
+    """Mark the victim and cleanly drain every one of its running jobs."""
+    from dstack_tpu.server.background.tasks.process_running_jobs import (
+        _runner_port_override,
+    )
+    from dstack_tpu.server.services.connections import get_connection_pool
+
+    vrow = victim["row"]
+    # This processor's FSM claim is on the REQUESTER's job row; the victim
+    # run belongs to the run FSM, so its row is mutated only under an
+    # explicit runs lock (LCK01 explicit-claim scope for this module).
+    async with ctx.locker.lock_ctx("runs", [vrow["id"]]):
+        fresh = await ctx.db.fetchone(
+            "SELECT resilience FROM runs WHERE id = ?", (vrow["id"],)
+        )
+        res = json.loads(fresh["resilience"]) if fresh and fresh["resilience"] else {}
+        res["scheduler_drain"] = utcnow_iso()
+        await ctx.db.execute(
+            "UPDATE runs SET resilience = ? WHERE id = ?",
+            (json.dumps(res), vrow["id"]),
+        )
+
+    project_row = await ctx.db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (vrow["project_id"],)
+    )
+    pool = get_connection_pool(ctx)
+    for j in victim["jobs"]:
+        try:
+            jpd = ctx.spec_cache.parse(
+                JobProvisioningData, "jobs", j["id"], j["job_provisioning_data"]
+            )
+            conn = await pool.get(
+                ctx, j["instance_id"], jpd,
+                ssh_private_key=project_row["ssh_private_key"] if project_row else None,
+            )
+            client = conn.runner_client(port=_runner_port_override(j))
+            await client.drain(
+                grace_seconds=settings.SCHEDULER_PREEMPTION_GRACE,
+                reason=JobTerminationReason.PREEMPTED_BY_SCHEDULER.value,
+            )
+        except Exception as e:
+            # Best-effort per job: an unreachable runner's job is picked up
+            # by the disconnect path; the others still drain cleanly.
+            logger.warning(
+                "preemption drain failed for job %s of run %s: %s",
+                j["id"][:8], vrow["run_name"], e,
+            )
+    ctx.kick("running_jobs")
+    ctx.kick("runs")

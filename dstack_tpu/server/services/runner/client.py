@@ -104,6 +104,22 @@ class RunnerClient:
             headers={"content-type": "application/json"},
         )
 
+    async def drain(
+        self, grace_seconds: float = 30.0, reason: Optional[str] = None
+    ) -> None:
+        await self._request(
+            "POST", "/api/drain",
+            content=json.dumps({"grace_seconds": grace_seconds, "reason": reason}),
+            headers={"content-type": "application/json"},
+        )
+
+    async def resize(self, width: int, total: int = 0) -> None:
+        await self._request(
+            "POST", "/api/resize",
+            content=json.dumps({"width": width, "total": total}),
+            headers={"content-type": "application/json"},
+        )
+
     async def metrics(self) -> Optional[MetricsResponse]:
         try:
             resp = await self._request("GET", "/api/metrics")

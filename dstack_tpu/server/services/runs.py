@@ -44,6 +44,7 @@ JOB_TERMINATION_REASONS_RETRYABLE = {
     JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
     JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
     JobTerminationReason.PREEMPTED_BY_PROVIDER,
+    JobTerminationReason.PREEMPTED_BY_SCHEDULER,
 }
 
 
@@ -145,6 +146,8 @@ async def run_row_to_run(
         cost=round(cost, 4),
         service=(ServiceSpec.model_validate_json(row["service_spec"]) if row["service_spec"] else None),
         deleted=bool(row["deleted"]),
+        priority=row["priority"] if "priority" in row.keys() else 0,
+        resilience=json.loads(row["resilience"]) if row["resilience"] else {},
     )
 
 
@@ -217,6 +220,13 @@ def _desired_replica_count(run_spec: RunSpec) -> int:
     if isinstance(conf, ServiceConfiguration):
         return int(conf.replicas.min or 0) or 1
     return 1
+
+
+def _run_priority(run_spec: RunSpec) -> int:
+    profile = run_spec.merged_profile
+    if profile is not None and profile.priority is not None:
+        return profile.priority
+    return 0
 
 
 async def submit_run(
@@ -310,8 +320,8 @@ async def submit_run(
             await ctx.db.execute(
                 "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
                 " last_processed_at, status, run_spec, service_spec, desired_replica_count,"
-                " repo_id)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                " repo_id, priority)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                 (
                     run_id,
                     project_row["id"],
@@ -324,6 +334,7 @@ async def submit_run(
                     service_spec.model_dump_json() if service_spec else None,
                     _desired_replica_count(run_spec),
                     repo_row_id,
+                    _run_priority(run_spec),
                 ),
             )
             break

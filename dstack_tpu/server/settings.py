@@ -69,6 +69,19 @@ INSTANCE_UNREACHABLE_DEADLINE = int(os.getenv("DSTACK_TPU_UNREACHABLE_DEADLINE",
 # blip) must not start the clock on terminating a busy gang worker.
 INSTANCE_HEALTH_FLAP_THRESHOLD = int(os.getenv("DSTACK_TPU_HEALTH_FLAP_THRESHOLD", "3"))
 RETRY_PENDING_RUN_DELAY = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY", "15"))
+# Priority preemption (services/preemption.py): the drain grace a victim's
+# workload gets to checkpoint before SIGKILL, and how long an issued drain
+# suppresses further preemptions in the project — so one stuck high-priority
+# job drains exactly one victim set, not one per scheduler tick.
+SCHEDULER_PREEMPTION_GRACE = float(os.getenv("DSTACK_TPU_SCHEDULER_PREEMPTION_GRACE", "30"))
+SCHEDULER_PREEMPTION_TTL = float(os.getenv("DSTACK_TPU_SCHEDULER_PREEMPTION_TTL", "120"))
+# Elastic resize debounce: after a shrink, hold the reduced width at least
+# this long before notifying the re-expand. Every resize costs the trainer a
+# checkpoint + mesh re-form + recompile, so a replacement that rejoins
+# instantly must not bounce the gang 4 -> 3 -> 4 within one poll interval.
+ELASTIC_REEXPAND_HYSTERESIS = float(
+    os.getenv("DSTACK_TPU_ELASTIC_REEXPAND_HYSTERESIS", "10")
+)
 # Exponential-backoff ceiling for run resubmission: the pending-run delay
 # doubles per submission (base * 2^(n-1), jittered) up to this cap.
 RETRY_PENDING_RUN_DELAY_CAP = int(os.getenv("DSTACK_TPU_RETRY_PENDING_RUN_DELAY_CAP", "300"))

@@ -318,22 +318,65 @@ class DrainHandler:
 
     def __init__(self, signals=(_signal.SIGTERM,)):
         self._draining = False
+        self._prior = {}
         for sig in signals:
-            _signal.signal(sig, self._on_signal)
+            try:
+                self._prior[sig] = _signal.signal(sig, self._on_signal)
+            except ValueError as e:
+                # signal.signal only works on the main thread; failing half
+                # installed would leave the loop believing it has drain
+                # coverage it does not. Surface the contract loudly.
+                raise RuntimeError(
+                    "DrainHandler must be installed from the main thread"
+                    " (signal handlers are process-global); install it"
+                    " before spawning data-loader/metric threads"
+                ) from e
 
     def _on_signal(self, signum, frame) -> None:
         self._draining = True
+        # Chain whatever was installed before us (a framework's own SIGTERM
+        # hook, a prior DrainHandler): replacing it silently would disable
+        # someone else's cleanup.
+        prior = self._prior.get(signum)
+        if callable(prior):
+            prior(signum, frame)
 
     @property
     def draining(self) -> bool:
         return self._draining
 
-    def checkpoint_and_exit(self, directory, state: TrainState) -> None:
+    def checkpoint_and_exit(
+        self,
+        directory,
+        state: TrainState,
+        grace_seconds: Optional[float] = None,
+    ) -> None:
+        """Save a durable checkpoint and exit DRAIN_EXIT_CODE.
+
+        `grace_seconds` is the drain window the runner allows (the server's
+        SCHEDULER_PREEMPTION_GRACE for scheduler preemptions, the provider
+        notice for maintenance events). When the blocking save overruns it,
+        a loud warning is printed: the checkpoint WAS durable by the time we
+        got here, but the runner may already have SIGKILLed siblings — size
+        the grace to your checkpoint time, not the other way round.
+        """
+        import time as _time
+
         from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
         from dstack_tpu.workloads import checkpoint as ckpt
 
+        t0 = _time.monotonic()
         step = ckpt.save(directory, state, wait=True)
         ckpt.close_all()
+        elapsed = _time.monotonic() - t0
+        if grace_seconds is not None and elapsed > grace_seconds:
+            print(
+                f"WARNING: drain checkpoint took {elapsed:.1f}s, over the"
+                f" {grace_seconds:.0f}s grace window — the runner may have"
+                " hard-killed this job before the save completed; raise the"
+                " drain grace or shrink the checkpoint",
+                file=_sys.stderr, flush=True,
+            )
         print(f"drain: checkpoint saved at step {step}; exiting", flush=True)
         _sys.exit(DRAIN_EXIT_CODE)
 
@@ -341,6 +384,31 @@ class DrainHandler:
 def install_drain_handler() -> DrainHandler:
     """Install SIGTERM-drain handling for the calling training process."""
     return DrainHandler()
+
+
+def read_resize_notice(path: Optional[str] = None) -> Optional[Dict[str, int]]:
+    """The pending elastic-resize notice from the runner, or None.
+
+    The runner agent writes `{"width": W, "total": N}` atomically to
+    DSTACK_TPU_RESIZE_FILE when the server resizes an elastic gang
+    (agents/runner.py `write_resize`). An elastic training loop polls this
+    once per step; on a change it checkpoints, re-forms its mesh at the new
+    data-parallel width (rescaling accum_steps via
+    parallel.mesh.rescale_accum_steps to keep the global batch), and keeps
+    stepping. Malformed/partial content reads as None — the write is atomic
+    (tmp + rename), so that only means "no notice yet".
+    """
+    import json as _json
+    import os as _os
+
+    p = path or _os.environ.get("DSTACK_TPU_RESIZE_FILE")
+    if not p:
+        return None
+    try:
+        data = _json.loads(open(p).read())
+        return {"width": int(data["width"]), "total": int(data.get("total", 0))}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
 
 
 def synthetic_batch(

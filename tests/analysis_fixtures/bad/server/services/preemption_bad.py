@@ -1,0 +1,21 @@
+"""Known-bad fixture: LCK01 under the explicit-claim rule.
+
+Modules matching `server/services/preemption` mutate OTHER runs' rows
+(the victim's, not the row their caller holds a claim on), so the
+cross-module grant propagation that normally absolves a callee proves
+nothing here: `drain_victim`'s caller holds "runs" — for the requester's
+run — but the UPDATE below lands on the victim's. The checker must flag
+it even though the fixed point grants "runs" to this function.
+"""
+
+
+async def schedule(ctx, run_id, victim_id):
+    async with ctx.locker.lock_ctx("runs", [run_id]):
+        await drain_victim(ctx, victim_id)
+
+
+async def drain_victim(ctx, victim_id):
+    # LCK01 (explicit-claim scope): inherited grant only, no lexical lock.
+    await ctx.db.execute(
+        "UPDATE runs SET resilience = '{}' WHERE id = ?", (victim_id,)
+    )

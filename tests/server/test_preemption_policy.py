@@ -1,0 +1,231 @@
+"""Priority-preemption policy unit tests (services/preemption.py): the
+priority gate, the per-project drain TTL guard, and victim selection —
+cheapest strictly-lower-priority RUNNING run whose retry policy covers
+interruptions and whose instances match the request. The end-to-end story
+(drain -> preempted_by_scheduler -> resume) runs in the priority-preempt
+chaos drill; these tests pin the policy decisions without processes."""
+
+import json
+
+from dstack_tpu.models.instances import (
+    InstanceAvailability,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+)
+from dstack_tpu.models.resources import ResourcesSpec
+from dstack_tpu.models.runs import JobSpec, Requirements, RunStatus
+from dstack_tpu.server import settings
+from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services import preemption
+from dstack_tpu.server.services.runs import create_replica_jobs
+from dstack_tpu.server.testing.factories import create_run_row, make_task_run_spec
+from dstack_tpu.utils.common import utcnow, utcnow_iso
+from tests.server.conftest import make_server
+
+
+def _requester_job_spec() -> JobSpec:
+    return JobSpec(
+        job_name="requester-0-0",
+        requirements=Requirements(
+            resources=ResourcesSpec.model_validate({"cpu": "1..", "memory": "0.1.."})
+        ),
+    )
+
+
+def _offer_json(price: float) -> str:
+    return InstanceOfferWithAvailability(
+        backend="local",
+        instance=InstanceType(
+            name="sim-host", resources=Resources(cpus=8, memory_mib=16384)
+        ),
+        region="local",
+        price=price,
+        availability=InstanceAvailability.AVAILABLE,
+    ).model_dump_json()
+
+
+async def _mk_victim(
+    ctx,
+    name,
+    *,
+    priority=0,
+    price=1.0,
+    retry=True,
+    status=RunStatus.RUNNING,
+    job_status="running",
+    with_instance=True,
+    resilience=None,
+):
+    """A candidate victim: a run with one job, optionally provisioned onto
+    an instance whose offer carries the given price."""
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    extra = {}
+    if retry:
+        extra["retry"] = {"on_events": ["interruption"], "duration": 600}
+    spec = make_task_run_spec(run_name=name, **extra)
+    run_id = await create_run_row(ctx, project["id"], user["id"], spec, status=status)
+    await ctx.db.execute(
+        "UPDATE runs SET priority = ?, resilience = ? WHERE id = ?",
+        (priority, json.dumps(resilience) if resilience else None, run_id),
+    )
+    await create_replica_jobs(ctx, project["id"], run_id, spec, 0, 0)
+    if with_instance:
+        iid = generate_id()
+        jpd = {
+            "backend": "local",
+            "instance_type": {
+                "name": "sim-host",
+                "resources": {"cpus": 8, "memory_mib": 16384},
+            },
+            "instance_id": f"i-{iid[:6]}",
+            "hostname": "127.0.0.1",
+            "region": "local",
+            "dockerized": False,
+        }
+        await ctx.db.execute(
+            "INSERT INTO instances (id, project_id, name, status, created_at,"
+            " last_processed_at, backend, offer, job_provisioning_data)"
+            " VALUES (?, ?, ?, 'busy', ?, ?, 'local', ?, ?)",
+            (iid, project["id"], f"inst-{iid[:6]}", utcnow_iso(), utcnow_iso(),
+             _offer_json(price), json.dumps(jpd)),
+        )
+        await ctx.db.execute(
+            "UPDATE jobs SET status = ?, instance_id = ?,"
+            " job_provisioning_data = ? WHERE run_id = ?",
+            (job_status, iid, json.dumps(jpd), run_id),
+        )
+    else:
+        await ctx.db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?", (job_status, run_id)
+        )
+    return run_id
+
+
+async def _active_rows(ctx):
+    return await ctx.db.fetchall(
+        "SELECT * FROM runs WHERE deleted = 0"
+        " AND status NOT IN ('terminated', 'failed', 'done')"
+    )
+
+
+async def test_zero_priority_never_preempts():
+    """The gate: only a positive-priority requester may reclaim capacity."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        for prio in (0, None, -1):
+            assert not await preemption.maybe_preempt(
+                fx.ctx,
+                {"project_id": "p", "run_id": "r"},
+                {"priority": prio, "run_name": "req"},
+                None,
+                _requester_job_spec(),
+            )
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_pick_victim_cheapest_lower_priority():
+    """Among eligible victims the cheapest wins; runs at or above the
+    requester's priority are never candidates."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        await _mk_victim(ctx, "victim-pricey", priority=0, price=5.0)
+        cheap = await _mk_victim(ctx, "victim-cheap", priority=0, price=2.0)
+        # Cheaper still, but same priority as the requester: protected.
+        await _mk_victim(ctx, "peer", priority=3, price=0.5)
+        victim = await preemption._pick_victim(
+            ctx, await _active_rows(ctx), 3, _requester_job_spec()
+        )
+        assert victim is not None
+        assert victim["row"]["id"] == cheap
+        assert victim["price"] == 2.0
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_pick_victim_requires_interruption_retry():
+    """Draining a run that cannot resume is data loss, not scheduling: a
+    victim without retry-on-interruption is never picked."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        await _mk_victim(ctx, "no-retry", priority=0, retry=False)
+        assert (
+            await preemption._pick_victim(
+                ctx, await _active_rows(ctx), 3, _requester_job_spec()
+            )
+            is None
+        )
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_pick_victim_requires_fully_running_gang():
+    """A victim mid-provisioning (or with any non-RUNNING job) has nothing
+    to drain; the policy skips it rather than racing its own placement."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        await _mk_victim(ctx, "provisioning", priority=0, job_status="provisioning")
+        await _mk_victim(
+            ctx, "no-instance", priority=0, with_instance=False, job_status="running"
+        )
+        assert (
+            await preemption._pick_victim(
+                ctx, await _active_rows(ctx), 3, _requester_job_spec()
+            )
+            is None
+        )
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_drain_ttl_suppresses_second_victim(monkeypatch):
+    """While an issued drain is still landing (scheduler_drain fresher than
+    the TTL), maybe_preempt keeps the requester SUBMITTED without evicting
+    anyone else; once the marker ages past the TTL the policy re-evaluates."""
+    from datetime import timedelta
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        monkeypatch.setattr(settings, "SCHEDULER_PREEMPTION_TTL", 120)
+        draining = await _mk_victim(
+            ctx, "draining", priority=0,
+            resilience={"scheduler_drain": utcnow_iso()},
+        )
+        spare = await _mk_victim(ctx, "spare", priority=0, price=9.0)
+        job_row = {"project_id": (await ctx.db.fetchone(
+            "SELECT project_id FROM runs WHERE id = ?", (draining,)
+        ))["project_id"], "run_id": "requester-run"}
+        run_row = {"priority": 3, "run_name": "requester"}
+
+        assert await preemption.maybe_preempt(
+            ctx, job_row, run_row, None, _requester_job_spec()
+        )
+        spare_row = await ctx.db.fetchone(
+            "SELECT resilience FROM runs WHERE id = ?", (spare,)
+        )
+        assert not spare_row["resilience"]  # no second victim drained
+
+        # The marker expires: the policy picks (and marks) a fresh victim.
+        stale = (utcnow() - timedelta(seconds=121)).isoformat()
+        await ctx.db.execute(
+            "UPDATE runs SET resilience = ? WHERE id = ?",
+            (json.dumps({"scheduler_drain": stale}), draining),
+        )
+        assert await preemption.maybe_preempt(
+            ctx, job_row, run_row, None, _requester_job_spec()
+        )
+        marked = [
+            r for r in await _active_rows(ctx)
+            if r["resilience"]
+            and "scheduler_drain" in json.loads(r["resilience"])
+            and json.loads(r["resilience"])["scheduler_drain"] != stale
+        ]
+        assert len(marked) == 1  # exactly one new drain issued
+    finally:
+        await fx.app.shutdown()

@@ -126,6 +126,116 @@ async def test_retry_budget_measured_from_first_submission():
         await fx.app.shutdown()
 
 
+async def test_retry_budget_boundary_frozen_clock(monkeypatch):
+    """The budget check is strict (> duration): exactly AT the budget the
+    replica still retries; one second past it the run fails with
+    retry_limit_exceeded. Clock frozen via process_runs.utcnow so the
+    boundary is exact, not a race against wall time."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        t0 = utcnow()
+        monkeypatch.setattr(process_runs, "utcnow", lambda: t0)
+        run_id = await _make_run(
+            ctx, retry={"on_events": ["interruption"], "duration": 600}
+        )
+        jobs = await _jobs(ctx, run_id)
+        await _set_job(ctx, jobs[0]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+                       submitted_at=(t0 - timedelta(seconds=600)).isoformat())
+
+        run = await _tick(ctx, run_id)  # exactly at the budget: still covered
+        assert run["status"] == "pending"
+        assert len(await _jobs(ctx, run_id)) == 2
+
+        # The resubmission fails too; the clock is now 1s past the budget
+        # anchored at the FIRST submission.
+        jobs = await _jobs(ctx, run_id)
+        await _set_job(ctx, jobs[1]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY)
+        monkeypatch.setattr(
+            process_runs, "utcnow", lambda: t0 + timedelta(seconds=1)
+        )
+        await ctx.db.execute(
+            "UPDATE runs SET status = 'running' WHERE id = ?", (run_id,)
+        )
+        run = await _tick(ctx, run_id)
+        assert run["termination_reason"] == "retry_limit_exceeded"
+        assert len(await _jobs(ctx, run_id)) == 2  # no third submission
+    finally:
+        await fx.app.shutdown()
+
+
+def _resilience_rows(reasons_exits):
+    return [
+        {"termination_reason": r, "exit_status": e} for r, e in reasons_exits
+    ]
+
+
+class _Tracer:
+    def __init__(self):
+        self.counts = {}
+
+    def inc(self, name, value=1, **labels):
+        self.counts[name] = self.counts.get(name, 0) + value
+
+
+class _Ctx:
+    def __init__(self):
+        self.tracer = _Tracer()
+
+
+def test_account_resilience_hard_kill_bumps_steps_lost():
+    """A preemption WITHOUT the drain exit code is a hard kill: the server
+    cannot know how much work died since the last periodic checkpoint, so
+    steps_lost gets a >=1 floor per hard-killed job."""
+    ctx, res = _Ctx(), {}
+    process_runs._account_resilience(
+        ctx, {"run_name": "r"}, res,
+        _resilience_rows([("preempted_by_provider", None)]),
+    )
+    assert res == {"preemptions": 1, "clean_drains": 0, "restarts": 1,
+                   "steps_lost": 1}
+    assert ctx.tracer.counts["run_preemption_events"] == 1
+    assert "run_clean_drain_events" not in ctx.tracer.counts
+
+
+def test_account_resilience_clean_drain_keeps_steps_lost_zero():
+    """A drain-exit preemption saved its checkpoint before dying: zero lost
+    steps by construction, and the explicit zero is still recorded so
+    dashboards can tell 'clean' from 'not yet preempted'."""
+    from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
+
+    ctx, res = _Ctx(), {}
+    process_runs._account_resilience(
+        ctx, {"run_name": "r"}, res,
+        _resilience_rows([("preempted_by_provider", DRAIN_EXIT_CODE)]),
+    )
+    assert res == {"preemptions": 1, "clean_drains": 1, "restarts": 1,
+                   "steps_lost": 0}
+
+
+def test_account_resilience_scheduler_preemption_and_marker_consume():
+    """preempted_by_scheduler counts as a (clean-drained) preemption AND as
+    its own counter; a full-gang restart consumes any in-flight
+    scheduler_drain / elastic_width markers so a later tick cannot act on a
+    superseded drain or shrink."""
+    from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
+
+    ctx = _Ctx()
+    res = {"scheduler_drain": "2026-01-01T00:00:00+00:00", "elastic_width": 3}
+    process_runs._account_resilience(
+        ctx, {"run_name": "r"}, res,
+        _resilience_rows([
+            ("preempted_by_scheduler", DRAIN_EXIT_CODE),
+            ("gang_member_failed", None),  # sibling: not a preemption
+        ]),
+    )
+    assert res == {"preemptions": 1, "clean_drains": 1, "restarts": 1,
+                   "preempted_by_scheduler": 1, "steps_lost": 0}
+    assert ctx.tracer.counts["run_scheduler_preemption_events"] == 1
+
+
 async def test_retry_short_circuits_on_non_covered_reason():
     """A failure reason the policy does not cover (an error under
     on_events=[interruption]) must fail the run instead of retrying."""
@@ -142,6 +252,30 @@ async def test_retry_short_circuits_on_non_covered_reason():
         assert run["status"] == "terminating"
         assert run["termination_reason"] == "job_failed"
         assert len(await _jobs(ctx, run_id)) == 1  # not resubmitted
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_retry_mixed_reasons_veto_whole_gang():
+    """Decide-then-mutate: when one gang member died for a covered reason
+    (preemption) and another for an uncovered one (error), NO job may be
+    resubmitted — the earlier shape retried the covered member first and
+    left its fresh submission orphaned under a terminating run."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_run(
+            ctx, nodes=2, retry={"on_events": ["interruption"], "duration": 600}
+        )
+        jobs = await _jobs(ctx, run_id)
+        await _set_job(ctx, jobs[0]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.PREEMPTED_BY_PROVIDER)
+        await _set_job(ctx, jobs[1]["id"], status=JobStatus.FAILED,
+                       reason=JobTerminationReason.CONTAINER_EXITED_WITH_ERROR)
+        run = await _tick(ctx, run_id)
+        assert run["status"] == "terminating"
+        assert run["termination_reason"] == "job_failed"
+        assert len(await _jobs(ctx, run_id)) == 2  # nothing resubmitted
     finally:
         await fx.app.shutdown()
 

@@ -1,7 +1,17 @@
+import pytest
+
 from dstack_tpu.models.runs import ClusterInfo
 from dstack_tpu.models.topology import TpuTopology
-from dstack_tpu.parallel.env import jax_initialize_kwargs, make_cluster_env
-from dstack_tpu.parallel.mesh import mesh_shape_for_devices, plan_mesh
+from dstack_tpu.parallel.env import (
+    jax_initialize_kwargs,
+    make_cluster_env,
+    make_elastic_env,
+)
+from dstack_tpu.parallel.mesh import (
+    mesh_shape_for_devices,
+    plan_mesh,
+    rescale_accum_steps,
+)
 
 
 def _cluster(hosts=4):
@@ -50,6 +60,44 @@ class TestClusterEnv:
         kw = jax_initialize_kwargs(env)
         assert kw["process_id"] == 3
         assert kw["num_processes"] == 4
+
+
+class TestElasticEnv:
+    def test_survivors_get_dense_ranks(self):
+        """Losing rank 2 of 4: survivors re-form as a 3-process group with
+        dense ids and a shrunk hostname list — anything sparse hangs
+        jax.distributed.initialize waiting for the dead rank."""
+        env = make_elastic_env(_cluster(), node_rank=3, active_ranks=[0, 1, 3])
+        assert env["JAX_NUM_PROCESSES"] == "3"
+        assert env["JAX_PROCESS_ID"] == "2"  # rank 3 is dense index 2 of survivors
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.0:8476"
+        assert env["TPU_WORKER_HOSTNAMES"] == "10.0.0.0,10.0.0.1,10.0.0.3"
+
+    def test_coordinator_must_survive(self):
+        with pytest.raises(ValueError, match="coordinator"):
+            make_elastic_env(_cluster(), node_rank=1, active_ranks=[1, 2, 3])
+
+    def test_node_must_be_a_survivor(self):
+        with pytest.raises(ValueError, match="not among survivors"):
+            make_elastic_env(_cluster(), node_rank=2, active_ranks=[0, 1, 3])
+
+
+class TestRescaleAccum:
+    def test_global_batch_invariant(self):
+        # 4 hosts x 3 accum = 12 microbatches; any width dividing 12 keeps
+        # the global batch (and hence the loss trajectory) unchanged.
+        assert rescale_accum_steps(3, 4, 3) == 4
+        assert rescale_accum_steps(4, 3, 4) == 3
+        assert rescale_accum_steps(3, 4, 2) == 6
+        assert rescale_accum_steps(3, 4, 4) == 3
+
+    def test_indivisible_width_raises(self):
+        with pytest.raises(ValueError, match="divide"):
+            rescale_accum_steps(3, 4, 5)
+
+    def test_nonpositive_width_raises(self):
+        with pytest.raises(ValueError, match="positive"):
+            rescale_accum_steps(3, 0, 2)
 
 
 class TestMeshPlan:

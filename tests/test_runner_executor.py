@@ -1,0 +1,113 @@
+"""Executor unit tests (agents/runner.py): the elastic resize notice
+channel, reset-for-resubmission semantics, and drain-reason plumbing —
+the runner-side halves of priority preemption and elastic recovery that
+the chaos drills exercise only end-to-end."""
+
+import asyncio
+import json
+
+import pytest
+
+from dstack_tpu.agents.protocol import DRAIN_EXIT_CODE
+from dstack_tpu.agents.runner import Executor, SubmitBody
+from dstack_tpu.errors import ApiError
+from dstack_tpu.models.resources import ResourcesSpec
+from dstack_tpu.models.runs import (
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+    Requirements,
+)
+
+
+def _submission(commands):
+    return SubmitBody(
+        run_name="test-run",
+        job_spec=JobSpec(
+            job_name="test-run-0-0",
+            commands=commands,
+            requirements=Requirements(
+                resources=ResourcesSpec.model_validate({"cpu": "1..", "memory": "0.1.."})
+            ),
+        ),
+    )
+
+
+async def _run_job(tmp_path, commands):
+    ex = Executor(working_root=str(tmp_path / "work"))
+    ex.submission = _submission(commands)
+    await ex.run()
+    return ex
+
+
+def test_write_resize_is_atomic(tmp_path):
+    """The notice lands via tmp+rename: after write_resize there is valid
+    JSON at the final path and no .tmp residue a trainer could mis-read."""
+    ex = Executor()
+    ex.resize_file = tmp_path / ".dstack-resize.json"
+    ex.write_resize(3, total=4)
+    assert json.loads(ex.resize_file.read_text()) == {"width": 3, "total": 4}
+    assert not list(tmp_path.glob("*.tmp"))
+    # Overwrites in place: a re-expand replaces the shrink notice.
+    ex.write_resize(4, total=4)
+    assert json.loads(ex.resize_file.read_text()) == {"width": 4, "total": 4}
+
+
+def test_write_resize_without_job_is_an_api_error():
+    with pytest.raises(ApiError):
+        Executor().write_resize(3)
+
+
+def test_reset_clears_buffers_but_keeps_timestamps_increasing():
+    """Elastic in-place resubmission reuses the surviving runner: reset()
+    must drop the previous submission's events (the new job row pulls from
+    timestamp 0) while keeping event timestamps strictly increasing so no
+    pull window can straddle two submissions."""
+    ex = Executor()
+    ex.set_state(JobStatus.RUNNING)
+    ex.set_state(JobStatus.DONE, JobTerminationReason.DONE_BY_RUNNER, exit_status=0)
+    assert ex.finished.is_set()
+    last_ts = ex.job_states[-1].timestamp
+
+    ex.reset()
+    assert ex.job_states == [] and ex.job_logs == [] and ex.runner_logs == []
+    assert not ex.finished.is_set()
+    assert ex.submission is None and not ex.started
+    assert ex.resize_file is None
+
+    ex.set_state(JobStatus.RUNNING)
+    assert ex.job_states[0].timestamp > last_ts
+
+
+async def test_drain_records_scheduler_reason(tmp_path):
+    """A server-initiated drain (priority preemption) must surface as
+    preempted_by_scheduler with the clean-drain exit code — that exact pair
+    is what _account_resilience counts as a zero-loss scheduler preemption."""
+    ex = await _run_job(
+        tmp_path, [f"trap 'exit {DRAIN_EXIT_CODE}' TERM; sleep 30"]
+    )
+    for _ in range(100):  # wait for the trap to be installed
+        if ex.job_states and ex.job_states[-1].state == JobStatus.RUNNING:
+            break
+        await asyncio.sleep(0.05)
+    await asyncio.sleep(0.3)
+    await ex.drain(
+        grace_seconds=10, reason=JobTerminationReason.PREEMPTED_BY_SCHEDULER
+    )
+    await asyncio.wait_for(ex.finished.wait(), 10)
+    final = ex.job_states[-1]
+    assert final.state == JobStatus.FAILED
+    assert final.termination_reason == JobTerminationReason.PREEMPTED_BY_SCHEDULER
+    assert final.exit_status == DRAIN_EXIT_CODE
+    assert "checkpoint drained" in final.termination_message
+
+
+async def test_drain_before_start_fails_with_preemption(tmp_path):
+    """A preemption notice racing the submit (no process yet) still reports
+    an interruption-shaped failure so the retry policy covers it."""
+    ex = Executor(working_root=str(tmp_path / "work"))
+    ex.submission = _submission(["sleep 1"])
+    await ex.drain(grace_seconds=1)
+    final = ex.job_states[-1]
+    assert final.state == JobStatus.FAILED
+    assert final.termination_reason == JobTerminationReason.PREEMPTED_BY_PROVIDER
