@@ -65,6 +65,10 @@ class SubmitBody(CoreModel):
     # place. Dockerized hosts mount volumes in the shim instead.
     mounts: List[Dict[str, Optional[str]]] = []
     working_dir_root: str = "/workflow"
+    # W3C trace context of the run (runs.trace_context). The runner injects
+    # it into the workload as DSTACK_TPU_TRACEPARENT so agent and
+    # trainer/serving spans share the run's trace_id.
+    traceparent: Optional[str] = None
 
 
 class JobStateEvent(CoreModel):
@@ -81,10 +85,21 @@ class LogEventOut(CoreModel):
     message: str  # base64
 
 
+class RunStageEvent(CoreModel):
+    """One lifecycle stage observed on the host: emitted by the runner
+    itself (drain) or parsed from workload stage markers (tpu_init,
+    compile_start/end, first_step, first_token — see workloads/stages.py).
+    Rides the pull channel; the server persists it into run_events."""
+
+    stage: str
+    timestamp: int  # same strictly-increasing ms clock as the log events
+
+
 class PullResponse(CoreModel):
     job_states: List[JobStateEvent] = []
     job_logs: List[LogEventOut] = []
     runner_logs: List[LogEventOut] = []
+    stage_events: List[RunStageEvent] = []
     last_updated: int = 0
     has_more: bool = True
 

@@ -35,6 +35,7 @@ from dstack_tpu.agents.protocol import (
     MetricsResponse,
     PullResponse,
     ResizeBody,
+    RunStageEvent,
     StopBody,
     SubmitBody,
 )
@@ -45,6 +46,10 @@ from dstack_tpu.parallel.env import make_cluster_env
 from dstack_tpu.server.http import App, Request, Response, Router, Server
 from dstack_tpu.utils.common import utcnow
 from dstack_tpu.utils.tasks import spawn_logged
+from dstack_tpu.utils.stagemarkers import STAGE_MARKER_PREFIX, parse_stage_marker
+from dstack_tpu.utils.tracecontext import TRACEPARENT_ENV
+
+_MARKER_BYTES = STAGE_MARKER_PREFIX.encode()
 
 IDLE_SHUTDOWN_SECONDS = 300.0  # parity: runner self-terminates if no job (server.go:56)
 
@@ -112,6 +117,9 @@ async def watch_preemption(
             if executor.submission is None or executor.finished.is_set():
                 continue  # notice stays pending until there is a job to drain
             grace = float(os.getenv("DSTACK_TPU_DRAIN_GRACE", "30"))
+            # Timeline: the provider notice precedes the drain — the
+            # preempt -> drain gap is how fast the agent reacted.
+            executor.record_stage("preempt")
             await executor.drain(grace)
             if kind == "file":
                 # One-shot notice: consume it so the next job on this host
@@ -150,6 +158,7 @@ class Executor:
         self.job_states: List[JobStateEvent] = []
         self.job_logs: List[LogEventOut] = []
         self.runner_logs: List[LogEventOut] = []
+        self.stage_events: List[RunStageEvent] = []
         self.proc: Optional[asyncio.subprocess.Process] = None
         self.started = False
         self.finished = asyncio.Event()
@@ -206,6 +215,14 @@ class Executor:
             )
         )
 
+    def record_stage(self, stage: str) -> None:
+        """One lifecycle stage observed on this host (workload marker or the
+        runner's own drain); rides the pull channel on the same strictly
+        increasing clock as logs/states so `> since` never drops one."""
+        self.stage_events.append(
+            RunStageEvent(stage=stage, timestamp=self._next_ts())
+        )
+
     # -- execution -----------------------------------------------------------
 
     def build_env(self) -> Dict[str, str]:
@@ -219,6 +236,10 @@ class Executor:
         env["DSTACK_RUN_NAME"] = sub.run_name
         env["DSTACK_REPLICA_NUM"] = str(sub.job_spec.replica_num)
         env["DSTACK_JOB_NUM"] = str(sub.job_spec.job_num)
+        if sub.traceparent:
+            # The run's W3C trace context: workload spans (tpu_init, compile,
+            # steps) join the same trace_id as the submit/provision spans.
+            env[TRACEPARENT_ENV] = sub.traceparent
         if self.resize_file is not None:
             env["DSTACK_TPU_RESIZE_FILE"] = str(self.resize_file)
         return env
@@ -343,12 +364,51 @@ class Executor:
             raise RepoError(f"failed to extract code archive: {e}")
 
     async def _pump_output(self) -> None:
+        """Relay workload output into the log buffer, intercepting stage
+        marker lines (workloads/stages.py): a `::dstack-tpu-stage::<name>`
+        line becomes a RunStageEvent instead of a log line. Only complete
+        lines can be classified, so an unterminated tail is held back — but
+        flushed immediately once it can no longer be a marker, so prompts
+        and progress output without a trailing newline still stream."""
         assert self.proc is not None and self.proc.stdout is not None
+        pending = b""
         while True:
             chunk = await self.proc.stdout.read(65536)
             if not chunk:
                 break
-            self.log_job(chunk)
+            lines = (pending + chunk).split(b"\n")
+            pending = lines.pop()
+            out = bytearray()
+            for line in lines:
+                stage = self._match_stage(line)
+                if stage is not None:
+                    self.record_stage(stage)
+                else:
+                    out += line + b"\n"
+            if out:
+                self.log_job(bytes(out))
+            probe = pending.lstrip()
+            if probe and (
+                len(pending) > 4096
+                or not _MARKER_BYTES.startswith(probe[: len(_MARKER_BYTES)])
+            ):
+                self.log_job(pending)
+                pending = b""
+        if pending:
+            stage = self._match_stage(pending)
+            if stage is not None:
+                self.record_stage(stage)
+            else:
+                self.log_job(pending)
+
+    @staticmethod
+    def _match_stage(line: bytes) -> Optional[str]:
+        if _MARKER_BYTES not in line:
+            return None
+        try:
+            return parse_stage_marker(line.decode())
+        except UnicodeDecodeError:
+            return None
 
     async def _wait_proc(self) -> None:
         assert self.proc is not None
@@ -409,6 +469,9 @@ class Executor:
             return
         self._preempting = True
         self._drain_reason = reason
+        # Timeline: the drain window starts here (the gap to the server's
+        # resume event is the recovery latency the waterfall shows).
+        self.record_stage("drain")
         if self.proc is None or self.proc.returncode is not None:
             # Notice arrived before the job started (or between submit and
             # run): nothing to drain, but the host is still going away.
@@ -475,6 +538,7 @@ class Executor:
         states = [s for s in self.job_states if s.timestamp > since_ms]
         job_logs = [e for e in self.job_logs if e.timestamp > since_ms]
         runner_logs = [e for e in self.runner_logs if e.timestamp > since_ms]
+        stages = [e for e in self.stage_events if e.timestamp > since_ms]
         # last_updated is the max timestamp returned, NOT "now": an event
         # recorded in the same millisecond as a wall-clock last_updated would
         # be filtered by `> since` on the next poll and lost forever.
@@ -482,10 +546,12 @@ class Executor:
             (e.timestamp for e in states + job_logs + runner_logs),
             default=since_ms,
         )
+        last = max([last] + [e.timestamp for e in stages])
         return PullResponse(
             job_states=states,
             job_logs=job_logs,
             runner_logs=runner_logs,
+            stage_events=stages,
             last_updated=last,
             has_more=not done,
         )

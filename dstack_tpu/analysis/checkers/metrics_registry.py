@@ -5,17 +5,23 @@ parsed statically from the analyzed tree — the checker never imports
 server code). Three rules:
 
 1. Registry hygiene: counters must end `_total` / `_sum` / `_count`;
-   gauges must not end `_total`.
+   gauges must not end `_total`; histograms are declared under their
+   BASE name, so a histogram ending `_total`/`_bucket`/`_sum`/`_count`
+   is a hand-declared derived series; the reserved `le` label must
+   never appear in any declared label set (exposition owns it).
 2. `tracer.inc("name", value, **labels)` sites: the derived series
    `dstack_tpu_<name>_total` must be a declared counter, and the label
    names (keyword args, or a local `labels = {...}` dict-literal passed
    as `**labels`; `"a" if cond else "b"` names check both branches)
-   must equal the declared label set exactly.
+   must equal the declared label set exactly. `tracer.observe(...)`
+   sites mirror this against declared histograms (series
+   `dstack_tpu_<name>`, no suffix).
 3. Any string literal containing a `dstack_tpu_*` metric name — the
    hand-rolled exposition in server/routers/metrics.py, assertions in
-   chaos scenarios — must name a declared series. This is what turns
-   "one registry" from convention into an invariant: you cannot emit or
-   assert on a name the registry does not know.
+   chaos scenarios — must name a declared series, or a
+   `_bucket`/`_sum`/`_count` derivation of a declared histogram. This
+   is what turns "one registry" from convention into an invariant: you
+   cannot emit or assert on a name the registry does not know.
 
 Fixture tests inject a registry dict directly; in normal runs it is
 discovered from the tree (no registry module found => rules 2/3 are
@@ -33,8 +39,25 @@ REGISTRY_REL_SUFFIX = "server/metrics_registry.py"
 PREFIX = "dstack_tpu_"
 _NAME_RE = re.compile(r"dstack_tpu_[a-z0-9_]+")
 COUNTER_SUFFIXES = ("_total", "_sum", "_count")
+# A histogram's _bucket/_sum/_count series are derived at exposition; a
+# declared base carrying one of these suffixes is a hand-rolled derived
+# series (and _total reads as a counter).
+HISTOGRAM_BAD_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
+HISTOGRAM_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
 
 Registry = Dict[str, Tuple[str, Tuple[str, ...]]]
+
+
+def histogram_base(name: str, registry: Registry) -> Optional[str]:
+    """Declared histogram behind a derived `_bucket`/`_sum`/`_count`
+    name, or None (static mirror of metrics_registry.histogram_base —
+    the checker never imports server code)."""
+    for suffix in HISTOGRAM_DERIVED_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if registry.get(base, ("",))[0] == "histogram":
+                return base
+    return None
 
 
 def parse_registry(module: Module) -> Optional[Registry]:
@@ -106,6 +129,7 @@ class MetricsRegistryChecker(Checker):
             if module is registry_module:
                 continue
             findings.extend(self._check_inc_sites(module, registry))
+            findings.extend(self._check_observe_sites(module, registry))
             findings.extend(self._check_literals(module, registry))
         return findings
 
@@ -128,6 +152,25 @@ class MetricsRegistryChecker(Checker):
                     rel=module.rel,
                     line=1,
                     key=f"suffix:{name}",
+                )
+            elif mtype == "histogram" and name.endswith(HISTOGRAM_BAD_SUFFIXES):
+                yield Finding(
+                    code="MET01",
+                    message=f"histogram `{name}` must be declared under"
+                    " its base name — _bucket/_sum/_count are derived"
+                    " at exposition (and _total reads as a counter)",
+                    rel=module.rel,
+                    line=1,
+                    key=f"suffix:{name}",
+                )
+            if "le" in _labels:
+                yield Finding(
+                    code="MET01",
+                    message=f"`{name}` declares the reserved label `le`"
+                    " — histogram exposition owns it",
+                    rel=module.rel,
+                    line=1,
+                    key=f"le:{name}",
                 )
 
     def _check_inc_sites(self, module: Module, registry: Registry) -> Iterable[Finding]:
@@ -177,6 +220,59 @@ class MetricsRegistryChecker(Checker):
                             key=f"labels:{series}",
                         )
 
+    def _check_observe_sites(
+        self, module: Module, registry: Registry
+    ) -> Iterable[Finding]:
+        """`tracer.observe("name", value, **labels)` emits histogram
+        series under `dstack_tpu_<name>` (no suffix — _bucket/_sum/
+        _count derive at exposition). HistogramData.observe(value) sites
+        pass a number first, so the constant-string filter skips them."""
+        funcs = [n for n in ast.walk(module.tree) if isinstance(n, FUNC_NODES)]
+        for func in funcs:
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call) or attr_name(node) != "observe":
+                    continue
+                if not node.args:
+                    continue
+                names = _counter_names(node.args[0])
+                if not names:
+                    continue  # dynamic (or non-tracer) observe site
+                labels = self._site_labels(module, func, node)
+                for hname in names:
+                    series = f"{PREFIX}{hname}"
+                    decl = registry.get(series)
+                    if decl is None:
+                        yield Finding(
+                            code="MET01",
+                            message=f"tracer histogram `{hname}` emits"
+                            f" undeclared series `{series}` — add it to"
+                            " server/metrics_registry.py or rename",
+                            rel=module.rel,
+                            line=node.lineno,
+                            key=f"undeclared:{series}",
+                        )
+                        continue
+                    mtype, decl_labels = decl
+                    if mtype != "histogram":
+                        yield Finding(
+                            code="MET01",
+                            message=f"`{series}` is declared {mtype} but"
+                            " emitted via tracer.observe (a histogram)",
+                            rel=module.rel,
+                            line=node.lineno,
+                            key=f"type:{series}",
+                        )
+                    if labels is not None and labels != set(decl_labels):
+                        yield Finding(
+                            code="MET01",
+                            message=f"label drift on `{series}`: emitted"
+                            f" {sorted(labels)} but registry declares"
+                            f" {sorted(decl_labels)}",
+                            rel=module.rel,
+                            line=node.lineno,
+                            key=f"labels:{series}",
+                        )
+
     def _site_labels(
         self, module: Module, func: ast.AST, call: ast.Call
     ) -> Optional[Set[str]]:
@@ -206,8 +302,9 @@ class MetricsRegistryChecker(Checker):
                 name = match.group(0)
                 # Trim label-suffix junk is unnecessary (regex stops at
                 # `{`); but a literal may legitimately be a prefix of a
-                # registered name only if it IS a registered name.
-                if name not in registry:
+                # registered name only if it IS a registered name — or a
+                # _bucket/_sum/_count derivation of a declared histogram.
+                if name not in registry and histogram_base(name, registry) is None:
                     yield Finding(
                         code="MET01",
                         message=f"string literal references undeclared"

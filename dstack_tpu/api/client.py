@@ -27,6 +27,7 @@ from dstack_tpu.models.volumes import Volume, VolumeConfiguration
 from dstack_tpu.api.repos import detect_remote_repo, pack_local_repo, repo_id_for_dir
 from dstack_tpu.api.rest import APIClient, NotFoundError
 from dstack_tpu.utils.ssh import SSHTunnel
+from dstack_tpu.utils.tracecontext import generate_traceparent
 
 DEFAULT_SERVER_URL = "http://127.0.0.1:3000"
 
@@ -80,6 +81,10 @@ class Run:
 
     def delete(self) -> None:
         self._client.api.runs.delete(self._client.project, [self.name])
+
+    def timeline(self) -> Dict[str, Any]:
+        """Stage-stamped lifecycle events (submit -> first step/token)."""
+        return self._client.api.runs.timeline(self._client.project, self.name)
 
     # -- logs ----------------------------------------------------------------
 
@@ -323,11 +328,14 @@ class RunCollection:
         return self._client.api.runs.get_plan(self._client.project, run_spec)
 
     def exec_plan(self, plan: RunPlan, repo_dir: Optional[str] = None) -> Run:
-        """Apply a plan: upload code for the repo (if any), then submit."""
+        """Apply a plan: upload code for the repo (if any), then submit.
+        Submission mints the run's trace context — every server/runner/
+        workload span downstream shares its trace_id."""
         self._upload_code(plan.run_spec, repo_dir)
         dto = self._client.api.runs.apply_plan(
             self._client.project,
             ApplyRunPlanInput(run_spec=plan.run_spec, current_resource=plan.current_resource),
+            traceparent=generate_traceparent(),
         )
         return Run(self._client, dto)
 
@@ -340,7 +348,9 @@ class RunCollection:
     ) -> Run:
         run_spec = self._make_run_spec(configuration, run_name, repo_dir, **kwargs)
         self._upload_code(run_spec, repo_dir)
-        dto = self._client.api.runs.submit(self._client.project, run_spec)
+        dto = self._client.api.runs.submit(
+            self._client.project, run_spec, traceparent=generate_traceparent()
+        )
         return Run(self._client, dto)
 
     def get(self, run_name: str) -> Run:

@@ -18,6 +18,13 @@ from dstack_tpu.models.gateways import Gateway
 from dstack_tpu.models.runs import ApplyRunPlanInput, Run, RunPlan, RunSpec
 from dstack_tpu.models.users import Project, User, UserWithCreds
 from dstack_tpu.models.volumes import Volume, VolumeConfiguration
+from dstack_tpu.utils.tracecontext import TRACEPARENT_HEADER
+
+
+def _trace_headers(traceparent: Optional[str]) -> Optional[Dict[str, str]]:
+    if traceparent is None:
+        return None
+    return {TRACEPARENT_HEADER: traceparent}
 
 
 class ApiClientError(ClientError):
@@ -70,14 +77,17 @@ class APIClient:
 
     # -- plumbing ------------------------------------------------------------
 
-    def post(self, path: str, body: Any = None, raw: Optional[bytes] = None) -> Any:
+    def post(self, path: str, body: Any = None, raw: Optional[bytes] = None,
+             headers: Optional[Dict[str, str]] = None) -> Any:
         try:
             if raw is not None:
                 resp = self._http.post(
                     path, content=raw, headers={"content-type": "application/octet-stream"}
                 )
             else:
-                resp = self._http.post(path, json=body if body is not None else {})
+                resp = self._http.post(
+                    path, json=body if body is not None else {}, headers=headers
+                )
         except httpx.HTTPError as e:
             raise ClientError(f"Cannot reach the server at {self.base_url}: {e}") from e
         return self._handle(resp)
@@ -127,16 +137,20 @@ class _Runs(_Resource):
         )
         return RunPlan.model_validate(data)
 
-    def apply_plan(self, project: str, plan: ApplyRunPlanInput) -> Run:
+    def apply_plan(self, project: str, plan: ApplyRunPlanInput,
+                   traceparent: Optional[str] = None) -> Run:
         data = self._api.post(
-            f"/api/project/{project}/runs/apply", json.loads(plan.model_dump_json())
+            f"/api/project/{project}/runs/apply", json.loads(plan.model_dump_json()),
+            headers=_trace_headers(traceparent),
         )
         return Run.model_validate(data)
 
-    def submit(self, project: str, run_spec: RunSpec) -> Run:
+    def submit(self, project: str, run_spec: RunSpec,
+               traceparent: Optional[str] = None) -> Run:
         data = self._api.post(
             f"/api/project/{project}/runs/submit",
             {"run_spec": json.loads(run_spec.model_dump_json())},
+            headers=_trace_headers(traceparent),
         )
         return Run.model_validate(data)
 
@@ -162,6 +176,10 @@ class _Runs(_Resource):
 
     def delete(self, project: str, runs_names: List[str]) -> None:
         self._api.post(f"/api/project/{project}/runs/delete", {"runs_names": runs_names})
+
+    def timeline(self, project: str, run_name: str) -> Dict[str, Any]:
+        """Stage-stamped lifecycle events: trace context, per-lane waterfall."""
+        return self._api.get(f"/api/project/{project}/runs/{run_name}/timeline")
 
 
 class _Fleets(_Resource):

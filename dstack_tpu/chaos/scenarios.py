@@ -400,6 +400,38 @@ async def _preempt_resume(report, seed, tmp: Path) -> None:
             )
             val = float(line.rsplit(" ", 1)[1]) if line else None
             _expect(report, val == want, f"/metrics {metric} = {val}, want {want}")
+        stage_buckets = [
+            ln for ln in text.splitlines()
+            if ln.startswith("dstack_tpu_run_stage_seconds_bucket{") and 'stage="' in ln
+        ]
+        _expect(
+            report,
+            bool(stage_buckets),
+            "/metrics lacks dstack_tpu_run_stage_seconds_bucket series",
+        )
+
+        # The victim's persisted timeline must tell the preemption story in
+        # order: notice (runner), graceful drain (runner), resubmit (FSM).
+        from dstack_tpu.server.http import response_json
+
+        resp = await client.get("/api/project/main/runs/chaos-drill/timeline")
+        _expect(report, resp.status == 200, f"timeline fetch failed: {resp.body!r}")
+        timeline = response_json(resp) or {"events": []}
+        stages = [e["stage"] for e in timeline["events"]]
+        report["details"]["timeline_stages"] = stages
+        order = [stages.index(s) if s in stages else -1
+                 for s in ("preempt", "drain", "resume")]
+        _expect(
+            report,
+            -1 not in order and order[0] < order[1] < order[2],
+            f"timeline stages {stages} lack ordered preempt -> drain -> resume",
+        )
+        _expect(
+            report,
+            timeline.get("trace_context") is None
+            or timeline["trace_context"].startswith("00-"),
+            f"timeline trace_context malformed: {timeline.get('trace_context')!r}",
+        )
         report["details"]["injected"] = engine.injected
         report["details"]["first_reasons"] = sorted(r for r in reasons if r)
     finally:

@@ -241,17 +241,97 @@ def _apply_gateway(client, data, name: Optional[str], yes: bool) -> None:
 
 
 
-def _run_alias(**kwargs) -> None:
-    """Deprecated alias for `apply` (reference-compat: cli/main.py:60-75)."""
+def _run_alias(ctx: click.Context, **kwargs) -> None:
+    """Deprecated alias for `apply` (reference-compat: cli/main.py:60-75);
+    also hosts run-scoped subcommands like `run timeline`."""
+    if ctx.invoked_subcommand is not None:
+        return
+    if not kwargs.get("config_file"):
+        raise _fail("`run` needs -f FILE (or a subcommand: `run timeline NAME`)")
     click.echo("`run` is deprecated; use `apply`.", err=True)
     apply.callback(**kwargs)
 
 
+def _run_alias_params() -> list:
+    """apply's params with `-f` made optional, so `run timeline ...` can
+    parse without tripping the alias's required option."""
+    import copy
+
+    params = []
+    for p in apply.params:
+        p = copy.copy(p)
+        p.required = False
+        params.append(p)
+    return params
+
+
 # Shares apply's params so the alias can never drift from the real command.
-cli.add_command(click.Command(
-    name="run", params=list(apply.params), callback=_run_alias, hidden=True,
-    help=_run_alias.__doc__,
-))
+run_group = click.Group(
+    name="run", params=_run_alias_params(),
+    callback=click.pass_context(_run_alias),
+    invoke_without_command=True, hidden=True, help=_run_alias.__doc__,
+)
+cli.add_command(run_group)
+
+
+@run_group.command("timeline")
+@click.argument("run_name")
+@click.option("--project", default=None)
+@click.option("--width", default=40, show_default=True, type=int,
+              help="bar column width in characters")
+def run_timeline(run_name: str, project: Optional[str], width: int) -> None:
+    """Lifecycle waterfall: per-host stage entries and durations."""
+    client = _make_client(project)
+    try:
+        data = client.api.runs.timeline(client.project, run_name)
+        _render_timeline(data, width)
+    except DstackTpuError as e:
+        raise _fail(str(e))
+    finally:
+        client.api.close()
+
+
+def _render_timeline(data: dict, width: int) -> None:
+    """ASCII waterfall: one lane per host (plus the run lane), each stage a
+    bar offset by its entry time and sized by its duration. Durations
+    telescope server-side, so per-lane bars tile the lane's total span."""
+    from rich.table import Table
+
+    total = data.get("total_s") or 0.0
+    events = data.get("events") or []
+    if not events:
+        console.print(f"Run [bold]{data.get('run_name')}[/]: no events recorded")
+        return
+    t0 = min(e["ts"] for e in events)
+    scale = (width / total) if total > 0 else 0.0
+    header = f"Run [bold]{data.get('run_name')}[/] — {total:.2f}s total"
+    if data.get("trace_context"):
+        header += f"  [dim]trace {data['trace_context']}[/]"
+    console.print(header)
+    table = Table(box=None, header_style="bold")
+    for col in ("LANE", "STAGE", "T+", "DURATION", "", "SRC"):
+        table.add_column(col)
+    for lane in data.get("lanes", []):
+        if lane["replica_num"] < 0:
+            lane_name = "run"
+        else:
+            lane_name = f"{lane['replica_num']}/{lane['job_num']}"
+        for stage in lane["stages"]:
+            offset = int((stage["ts"] - t0) * scale)
+            bar_len = max(1, int(stage["duration_s"] * scale)) \
+                if stage["duration_s"] > 0 else 0
+            bar = " " * min(offset, width) + "█" * bar_len
+            table.add_row(
+                lane_name,
+                stage["stage"],
+                f"{stage['ts'] - t0:.2f}s",
+                f"{stage['duration_s']:.2f}s",
+                f"[cyan]{bar}[/]",
+                stage["source"],
+            )
+            lane_name = ""
+        table.add_row("", "", "", "", "", "")
+    console.print(table)
 
 @cli.command()
 @click.option("-a", "--all", "show_all", is_flag=True, help="include finished runs")

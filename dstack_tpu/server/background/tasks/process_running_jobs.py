@@ -29,6 +29,7 @@ from dstack_tpu.models.runs import (
 from dstack_tpu.errors import BackendError, ServerError
 from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
+from dstack_tpu.server.services import run_events
 from dstack_tpu.server.services import volumes as volumes_service
 from dstack_tpu.server.services.connections import get_connection_pool
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
@@ -172,6 +173,33 @@ async def _get_run_row(
     return await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
 
 
+async def _run_traceparent(
+    ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
+) -> Optional[str]:
+    """The run's W3C trace context (runs.trace_context), if recorded."""
+    run_row = await _get_run_row(ctx, row["run_id"], tick)
+    if run_row is not None and "trace_context" in run_row.keys():
+        return run_row["trace_context"]
+    return None
+
+
+async def _stage(
+    ctx: ServerContext,
+    row: sqlite3.Row,
+    stage: str,
+    *,
+    source: str = "server",
+    ts: Optional[float] = None,
+    dedupe: bool = False,
+) -> None:
+    """Record a timeline event on this job's host lane."""
+    await run_events.record_event(
+        ctx, row["run_id"], row["project_id"], stage,
+        replica_num=row["replica_num"], job_num=row["job_num"],
+        source=source, ts=ts, dedupe=dedupe,
+    )
+
+
 async def _replica_rows(ctx: ServerContext, row: sqlite3.Row) -> List[sqlite3.Row]:
     # Latest submission per sibling job, NOT this row's own submission_num:
     # after an elastic in-place resubmission one rank of the gang runs at a
@@ -285,6 +313,9 @@ async def _process_provisioning(
         if sjpd is None or sjpd.hostname is None:
             return  # gang not fully provisioned yet (reference :176-187)
         replica_jpds.append(sjpd)
+    # Gang complete: every sibling has an IP. Re-entered until the agent
+    # handshake succeeds, hence dedupe.
+    await _stage(ctx, row, "instance_ready", dedupe=True)
 
     job_spec = ctx.spec_cache.parse(JobSpec, "jobs", row["id"], row["job_spec"])
     cluster_info = _build_cluster_info(job_spec, replica_jpds)
@@ -359,6 +390,7 @@ async def _process_provisioning(
                 "UPDATE jobs SET shim_task_submitted = 1, status = ? WHERE id = ?",
                 (JobStatus.PULLING.value, row["id"]),
             )
+            await _stage(ctx, row, "pulling")
             ctx.kick("running_jobs")
         finally:
             await shim.close()
@@ -434,6 +466,10 @@ async def _submit_to_runner(
     tick: Optional[_Tick] = None,
 ) -> None:
     runner = conn.runner_client(port=runner_port)
+    # Thread the run's trace context to the agent: child traceparents on
+    # every HTTP call, and the run context itself in the submit body (the
+    # runner injects it into the workload as DSTACK_TPU_TRACEPARENT).
+    runner.traceparent = await _run_traceparent(ctx, row, tick)
     try:
         health = await runner.healthcheck()
         if health is None:
@@ -511,6 +547,7 @@ async def _submit_to_runner(
         await ctx.db.execute(
             "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
         )
+        await _stage(ctx, row, "env_ready")
         ctx.routing_cache.invalidate_run(row["run_name"])
         await _register_service_replica(ctx, row, jpd, job_spec, tick)
         logger.info(
@@ -598,6 +635,7 @@ async def _pull_runner(
         ssh_private_key=project_row["ssh_private_key"],
     )
     runner = conn.runner_client(port=_runner_port_override(row))
+    runner.traceparent = await _run_traceparent(ctx, row, tick)
     try:
         resp = await runner.pull(row["runner_timestamp"])
     except Exception:
@@ -609,6 +647,13 @@ async def _pull_runner(
         "UPDATE jobs SET runner_timestamp = ?, disconnected_at = NULL WHERE id = ?",
         (resp.last_updated, row["id"]),
     )
+    for stage_event in resp.stage_events:
+        # Host-observed stages (workload markers, runner drain): the runner
+        # stamps them on its own ms clock; record_event clamps skew.
+        await _stage(
+            ctx, row, stage_event.stage,
+            source="workload", ts=stage_event.timestamp / 1000.0,
+        )
     if ctx.log_storage is not None and (resp.job_logs or resp.runner_logs):
         await ctx.log_storage.write(
             project_id=row["project_id"],

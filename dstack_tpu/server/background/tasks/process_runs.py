@@ -25,6 +25,7 @@ from dstack_tpu.models.runs import (
 from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services import run_events
 from dstack_tpu.server.services.runs import (
     JOB_TERMINATION_REASONS_RETRYABLE,
     create_replica_jobs,
@@ -143,6 +144,12 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
         await ctx.db.execute(
             "UPDATE runs SET status = ? WHERE id = ?", (new_status.value, row["id"])
         )
+        if new_status == RunStatus.PROVISIONING:
+            # Dedupe: a retried run flips back through PROVISIONING, but the
+            # resume event already marks that boundary.
+            await run_events.record_event(
+                ctx, row["id"], row["project_id"], "provisioning", dedupe=True
+            )
 
     if all(s == JobStatus.RUNNING for s in statuses):
         await _maybe_elastic_reexpand(ctx, row, jobs)
@@ -303,6 +310,11 @@ async def _maybe_retry(
 
     # Phase 2 — mutate. Every failed replica is covered and within budget.
     resilience = json.loads(row["resilience"]) if row["resilience"] else {}
+    preempted = any(
+        j["termination_reason"] in _PREEMPTION_REASONS
+        for _, replica_jobs in plans
+        for j in replica_jobs
+    )
     for replica, replica_jobs in plans:
         submission_num = max(j["submission_num"] for j in replica_jobs) + 1
         await create_replica_jobs(
@@ -317,6 +329,13 @@ async def _maybe_retry(
         "UPDATE runs SET status = ?, resilience = ? WHERE id = ?",
         (RunStatus.PENDING.value, json.dumps(resilience), row["id"]),
     )
+    if preempted:
+        # Timeline: recovery boundary. The gap since the host's drain event
+        # is the preemption-to-resubmit latency the waterfall surfaces.
+        await run_events.record_event(
+            ctx, row["id"], row["project_id"], "resume",
+            details={"replicas": sorted(r for r, _ in plans)},
+        )
     ctx.kick("submitted_jobs")
     return True
 
@@ -477,6 +496,10 @@ async def _maybe_elastic_resize(
         "UPDATE runs SET resilience = ? WHERE id = ?",
         (json.dumps(resilience), row["id"]),
     )
+    await run_events.record_event(
+        ctx, row["id"], row["project_id"], "resize",
+        details={"width": len(survivors), "total": len(replica_jobs)},
+    )
     await _notify_resize(ctx, survivors, len(survivors), len(replica_jobs))
     ctx.kick("submitted_jobs")
     logger.info(
@@ -511,6 +534,11 @@ async def _maybe_elastic_reexpand(
     by_replica = {}
     for j in jobs:
         by_replica.setdefault(j["replica_num"], []).append(j)
+    width = max((len(js) for js in by_replica.values()), default=0)
+    await run_events.record_event(
+        ctx, row["id"], row["project_id"], "resize",
+        details={"width": width, "total": width},
+    )
     for replica_jobs in by_replica.values():
         await _notify_resize(ctx, replica_jobs, len(replica_jobs), len(replica_jobs))
     logger.info("run %s: elastic re-expand to full width", row["run_name"])

@@ -158,6 +158,11 @@ class Database:
         def _open() -> sqlite3.Connection:
             conn = sqlite3.connect(self.path, check_same_thread=False)
             conn.row_factory = sqlite3.Row
+            # busy_timeout BEFORE the WAL switch: on a fresh file, two
+            # connections opening concurrently race the journal-mode
+            # conversion (it takes an exclusive lock), and the loser gets
+            # an instant SQLITE_BUSY under the default zero timeout.
+            conn.execute("PRAGMA busy_timeout=10000")
             conn.execute("PRAGMA journal_mode=WAL")
             # WAL + synchronous=FULL fsyncs every commit; with the FSM's
             # many small writes that serialized the control plane behind
@@ -167,7 +172,6 @@ class Database:
             # loss are rolled back — an orchestrator FSM re-derives those.
             conn.execute("PRAGMA synchronous=NORMAL")
             conn.execute("PRAGMA foreign_keys=ON")
-            conn.execute("PRAGMA busy_timeout=10000")
             return conn
 
         self._conn = await asyncio.to_thread(_open)

@@ -13,9 +13,15 @@ expressed at all.
 
 Keep entries sorted; the checker also enforces counter suffix naming
 (`_total` / `_sum` / `_count`).
+
+Histograms are declared once under their BASE name with type
+`"histogram"`; the `_bucket` / `_sum` / `_count` series (and the
+reserved `le` label) are derived at exposition time — declaring them by
+hand, or declaring `le`, is a MET01 violation. `histogram_base()`
+resolves a derived name back to its declaration.
 """
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 PREFIX = "dstack_tpu_"
 
@@ -41,21 +47,24 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # Background FSM tick accounting.
     "dstack_tpu_tick_rows_scanned_total": ("counter", ("processor",)),
     "dstack_tpu_tick_rows_stepped_total": ("counter", ("processor",)),
+    # Per-run lifecycle stage durations (services/run_events.py): the
+    # time each stage of the submit -> first-step/first-token path took,
+    # observed when the NEXT stage event lands. Quantiles come from the
+    # bucket ladder instead of EWMAs.
+    "dstack_tpu_run_stage_seconds": ("histogram", ("stage",)),
     # Proxy data plane (services/proxy_pool.py + routing_cache.py):
     # request/error counters per traffic kind (service | model), pooled
-    # client gauge, routing-cache hit rate, and the hand-accumulated
-    # TTFB summary (sum/count emitted from the pool's accumulator — a
-    # tracer counter would be suffixed `_total`).
+    # client gauge, routing-cache hit rate, and the TTFB histogram
+    # accumulated in the pool (bucket/sum/count derived at exposition).
     "dstack_tpu_proxy_pool_connections": ("gauge", ()),
     "dstack_tpu_proxy_requests_total": ("counter", ("kind",)),
     "dstack_tpu_proxy_routing_cache_hit_rate": ("gauge", ()),
-    "dstack_tpu_proxy_ttfb_seconds_count": ("counter", ("kind",)),
-    "dstack_tpu_proxy_ttfb_seconds_sum": ("counter", ("kind",)),
+    "dstack_tpu_proxy_ttfb_seconds": ("histogram", ("kind",)),
     "dstack_tpu_proxy_upstream_errors_total": ("counter", ("kind",)),
     # Serving engine (workloads/serving.py `prometheus_metrics`, exposed
     # by the native model server's /metrics): paged-KV pool occupancy,
     # prefix-cache effectiveness, chunked-prefill accounting, and the
-    # admission counters behind the TTFT summary.
+    # admission counters behind the TTFT histogram.
     "dstack_tpu_serving_admitted_total": ("counter", ()),
     "dstack_tpu_serving_kv_blocks_cached": ("gauge", ()),
     "dstack_tpu_serving_kv_blocks_in_use": ("gauge", ()),
@@ -68,7 +77,9 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_prefix_tokens_reused_total": ("counter", ()),
     "dstack_tpu_serving_rejected_total": ("counter", ()),
     "dstack_tpu_serving_slots_active": ("gauge", ()),
-    "dstack_tpu_serving_ttft_seconds_sum": ("counter", ()),
+    # Was a lone `_sum` counter with no `_count` partner (unscrapeable as
+    # a summary); now a first-class histogram.
+    "dstack_tpu_serving_ttft_seconds": ("histogram", ()),
     # Spec cache (PR 3).
     "dstack_tpu_spec_cache_entries": ("gauge", ()),
     "dstack_tpu_spec_cache_hit_rate": ("gauge", ()),
@@ -80,12 +91,38 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
 }
 
 
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
 def counter_name(tracer_counter: str) -> str:
     """Prometheus name a `tracer.inc(name, ...)` counter is exposed as."""
     return f"{PREFIX}{tracer_counter}_total"
 
 
+def histogram_name(tracer_histogram: str) -> str:
+    """Prometheus base name a `tracer.observe(name, ...)` histogram is
+    exposed under (`_bucket`/`_sum`/`_count` are derived from it)."""
+    return f"{PREFIX}{tracer_histogram}"
+
+
+def histogram_base(name: str) -> Optional[str]:
+    """Base declaration behind a derived histogram series name, or None
+    if `name` is not `<declared histogram>_bucket/_sum/_count`."""
+    for suffix in _HISTOGRAM_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if METRICS.get(base, ("",))[0] == "histogram":
+                return base
+    return None
+
+
 def metric_type(name: str) -> str:
     """Declared exposition type; raises KeyError for undeclared names so
-    emission-time drift fails loudly in tests."""
-    return METRICS[name][0]
+    emission-time drift fails loudly in tests. Derived histogram series
+    resolve through their base declaration."""
+    decl = METRICS.get(name)
+    if decl is not None:
+        return decl[0]
+    if histogram_base(name) is not None:
+        return "histogram"
+    raise KeyError(name)

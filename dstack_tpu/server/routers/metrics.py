@@ -7,7 +7,7 @@ from typing import Optional
 from dstack_tpu.errors import ResourceNotExistsError
 from dstack_tpu.models.metrics import JobMetrics, MetricsPoint, TpuChipMetrics
 from dstack_tpu.server.http import Request, Response, Router
-from dstack_tpu.server.metrics_registry import counter_name, metric_type
+from dstack_tpu.server.metrics_registry import counter_name, histogram_name, metric_type
 from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
 from dstack_tpu.utils.common import parse_dt
 
@@ -16,6 +16,12 @@ router = Router()
 
 def _prom_escape(v) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_le(le) -> str:
+    """Bucket bound label value; str() round-trips the log ladder exactly
+    (one inexact factor), and +Inf is handled by the caller."""
+    return str(le)
 
 
 class _Exposition:
@@ -31,6 +37,25 @@ class _Exposition:
         if name not in self._typed:
             self.lines.append(f"# TYPE {name} {metric_type(name)}")
             self._typed.add(name)
+        self._line(name, labels, value)
+
+    def add_histogram(self, base: str, labels: dict, buckets, total, count) -> None:
+        """Histogram exposition: one `# TYPE <base> histogram` line, then
+        cumulative `_bucket{le=...}` (with the mandatory +Inf), `_sum`,
+        `_count`. `buckets` is [(le_seconds, cumulative_count), ...] as
+        produced by tracing.HistogramData.to_dict()."""
+        if base not in self._typed:
+            self.lines.append(f"# TYPE {base} {metric_type(base)}")
+            self._typed.add(base)
+        # `le` joins the caller's labels at render time — it is reserved
+        # and never part of a declaration (MET01 enforces this).
+        for le, cumulative in buckets:
+            self._line(f"{base}_bucket", {**labels, "le": _format_le(le)}, cumulative)
+        self._line(f"{base}_bucket", {**labels, "le": "+Inf"}, count)
+        self._line(f"{base}_sum", labels, total)
+        self._line(f"{base}_count", labels, count)
+
+    def _line(self, name: str, labels: dict, value) -> None:
         if labels:
             body = ",".join(
                 f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
@@ -72,13 +97,22 @@ async def prometheus_metrics(request: Request):
     exp.add("dstack_tpu_spec_cache_hit_rate", {}, cache["hit_rate"])
     pool = ctx.proxy_pool.stats()
     exp.add("dstack_tpu_proxy_pool_connections", {}, pool["clients"])
-    for kind, (ttfb_sum, ttfb_count) in sorted(ctx.proxy_pool.ttfb_stats().items()):
-        labels = {"kind": kind}
-        exp.add("dstack_tpu_proxy_ttfb_seconds_sum", labels, ttfb_sum)
-        exp.add("dstack_tpu_proxy_ttfb_seconds_count", labels, ttfb_count)
+    for kind, hist in sorted(ctx.proxy_pool.ttfb_histogram().items()):
+        exp.add_histogram(
+            "dstack_tpu_proxy_ttfb_seconds", {"kind": kind},
+            hist["buckets"], hist["sum"], hist["count"],
+        )
     routing = ctx.routing_cache.stats()
     exp.add("dstack_tpu_proxy_routing_cache_hit_rate", {}, routing["hit_rate"])
-    for name, st in ctx.tracer.snapshot()["stats"].items():
+    # Lifecycle stage latencies (and any other tracer histograms) — the
+    # quantile source the SLO autoscaler reads instead of EWMAs.
+    for h in ctx.tracer.histogram_snapshot():
+        exp.add_histogram(
+            histogram_name(h["name"]), h["labels"], h["buckets"], h["sum"], h["count"]
+        )
+    # Aggregates only: snapshot() also copies the full span ring, which is
+    # pure overhead at scrape frequency.
+    for name, st in ctx.tracer.stats_snapshot().items():
         labels = {"span": name}
         exp.add("dstack_tpu_span_count_total", labels, st["count"])
         exp.add("dstack_tpu_span_seconds_sum", labels, st["total_s"])

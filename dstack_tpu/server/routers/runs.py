@@ -4,10 +4,13 @@ from typing import List, Optional
 
 from pydantic import BaseModel
 
+from dstack_tpu.errors import ResourceNotExistsError
 from dstack_tpu.models.runs import ApplyRunPlanInput, RunSpec
 from dstack_tpu.server.http import Request, Router
 from dstack_tpu.server.routers.deps import auth_project_member, auth_user, get_ctx
+from dstack_tpu.server.services import run_events
 from dstack_tpu.server.services import runs as runs_service
+from dstack_tpu.utils.tracecontext import TRACEPARENT_HEADER
 
 router = Router()
 
@@ -66,14 +69,20 @@ async def get_plan(request: Request, project_name: str):
 async def apply_plan(request: Request, project_name: str):
     user, project_row = await auth_project_member(request, project_name)
     body = request.parse(ApplyRunPlanInput)
-    return await runs_service.submit_run(get_ctx(request), user, project_row, body.run_spec)
+    return await runs_service.submit_run(
+        get_ctx(request), user, project_row, body.run_spec,
+        trace_context=request.headers.get(TRACEPARENT_HEADER),
+    )
 
 
 @router.post("/api/project/{project_name}/runs/submit")
 async def submit_run(request: Request, project_name: str):
     user, project_row = await auth_project_member(request, project_name)
     body = request.parse(SubmitRequest)
-    return await runs_service.submit_run(get_ctx(request), user, project_row, body.run_spec)
+    return await runs_service.submit_run(
+        get_ctx(request), user, project_row, body.run_spec,
+        trace_context=request.headers.get(TRACEPARENT_HEADER),
+    )
 
 
 @router.post("/api/project/{project_name}/runs/get")
@@ -92,6 +101,23 @@ async def list_runs(request: Request, project_name: str):
         only_active=body.only_active, limit=body.limit,
     )
     return [r.model_dump() for r in runs]
+
+
+@router.get("/api/project/{project_name}/runs/{run_name}/timeline")
+async def get_run_timeline(request: Request, project_name: str, run_name: str):
+    """Per-host stage waterfall of a run's persisted lifecycle events
+    (run_events) — the data behind `dstack-tpu run timeline`."""
+    _, project_row = await auth_project_member(request, project_name)
+    ctx = get_ctx(request)
+    run_row = await ctx.db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+    if run_row is None:
+        raise ResourceNotExistsError("Run not found")
+    timeline = await run_events.get_timeline(ctx, run_row)
+    timeline["project"] = project_name
+    return timeline
 
 
 @router.post("/api/project/{project_name}/runs/stop")

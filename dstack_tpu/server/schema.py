@@ -352,3 +352,35 @@ ALTER TABLE runs ADD COLUMN priority INTEGER NOT NULL DEFAULT 0;
 ALTER TABLE runs DROP COLUMN priority;
 """,
 )
+
+# Migration 8: run lifecycle tracing. `trace_context` carries the W3C
+# traceparent generated at submit (one run = one trace_id, threaded
+# through FSM -> runner -> workload); `run_events` is the persisted stage
+# timeline (submitted, provisioning, instance_ready, pulling, env_ready,
+# tpu_init, compile_start/end, first_step, first_token, drain, preempt,
+# resume, resize) behind GET .../runs/{run}/timeline and the
+# dstack_tpu_run_stage_seconds histogram. `ts` is epoch seconds (REAL —
+# sub-second stage gaps matter); (replica_num, job_num) is the waterfall
+# lane; `source` records which layer observed the event (server, runner,
+# workload).
+migration(
+    """
+ALTER TABLE runs ADD COLUMN trace_context TEXT;
+CREATE TABLE run_events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_id TEXT NOT NULL REFERENCES runs(id),
+    project_id TEXT NOT NULL,
+    replica_num INTEGER NOT NULL DEFAULT 0,
+    job_num INTEGER NOT NULL DEFAULT 0,
+    stage TEXT NOT NULL,
+    ts REAL NOT NULL,
+    source TEXT NOT NULL DEFAULT 'server',
+    details TEXT
+);
+CREATE INDEX ix_run_events_run ON run_events(run_id, ts, id);
+""",
+    down="""
+DROP TABLE run_events;
+ALTER TABLE runs DROP COLUMN trace_context;
+""",
+)

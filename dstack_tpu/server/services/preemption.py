@@ -173,7 +173,15 @@ async def _drain_run(ctx: ServerContext, victim: dict) -> None:
     )
     from dstack_tpu.server.services.connections import get_connection_pool
 
+    from dstack_tpu.server.services import run_events
+
     vrow = victim["row"]
+    # Timeline: the victim's preemption starts HERE, before the drain calls
+    # land — the preempt -> drain gap is the notice-to-SIGTERM latency.
+    await run_events.record_event(
+        ctx, vrow["id"], vrow["project_id"], "preempt",
+        details={"by": "scheduler"},
+    )
     # This processor's FSM claim is on the REQUESTER's job row; the victim
     # run belongs to the run FSM, so its row is mutated only under an
     # explicit runs lock (LCK01 explicit-claim scope for this module).

@@ -21,8 +21,9 @@ The POOL01 static checker enforces the complement: no
 which is why `_build_client` is deliberately a sync method.
 
 The pool also accumulates proxy TTFB (time to upstream response
-headers) per traffic kind; /metrics exposes the running sum/count so a
-scraper can diff two scrapes for an exact per-window mean.
+headers) per traffic kind — a log-bucket histogram plus running
+sum/count, exposed on /metrics as dstack_tpu_proxy_ttfb_seconds so a
+scraper gets quantiles, not just the per-window mean.
 """
 
 import threading
@@ -32,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import httpx
 
+from dstack_tpu.server.tracing import HistogramData
 from dstack_tpu.utils.tasks import spawn_logged
 
 
@@ -70,6 +72,7 @@ class ProxyPool:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self._ttfb: Dict[str, List[float]] = {}  # kind -> [sum_seconds, count]
+        self._ttfb_hist: Dict[str, HistogramData] = {}  # kind -> buckets
         self.hits = 0
         self.misses = 0
         self.closed = False
@@ -138,10 +141,21 @@ class ProxyPool:
             acc = self._ttfb.setdefault(kind, [0.0, 0])
             acc[0] += seconds
             acc[1] += 1
+            hist = self._ttfb_hist.get(kind)
+            if hist is None:
+                hist = self._ttfb_hist[kind] = HistogramData()
+            hist.observe(seconds)
 
     def ttfb_stats(self) -> Dict[str, Tuple[float, int]]:
         with self._lock:
             return {k: (v[0], int(v[1])) for k, v in self._ttfb.items()}
+
+    def ttfb_histogram(self) -> Dict[str, Dict]:
+        """Per-kind TTFB histogram snapshots (buckets/sum/count) for the
+        dstack_tpu_proxy_ttfb_seconds exposition — quantiles instead of
+        the old sum/count-only summary."""
+        with self._lock:
+            return {k: h.to_dict() for k, h in self._ttfb_hist.items()}
 
     def stats(self) -> Dict[str, float]:
         with self._lock:

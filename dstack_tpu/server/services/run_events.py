@@ -1,0 +1,166 @@
+"""Persisted run lifecycle stage timeline (run_events, migration 8).
+
+Every layer that observes a run changing stage appends one event here:
+the submit router (`submitted`), the run FSM (`provisioning`, `preempt`,
+`resume`, `resize`), the running-jobs processor (`instance_ready`,
+`pulling`, `env_ready`), the runner agent (`drain`), and the workload
+itself (`tpu_init`, `compile_start`, `compile_end`, `first_step`,
+`first_token` — via stage markers relayed through the runner report
+channel). `GET /api/project/{p}/runs/{run}/timeline` turns the table
+into a per-host waterfall, and every recorded transition feeds the
+`dstack_tpu_run_stage_seconds` histogram, so the cold-start breakdown
+(arXiv:2312.07220's dominant serverless overhead) is measurable per
+stage, per host, per run.
+
+Event rows mark stage ENTRY; a stage's duration is the gap to the next
+event in its lane. Run-scoped events (no specific host) use lane
+(-1, -1) and are folded into every host lane when building the
+waterfall, so each host's stage sum telescopes to exactly its
+submit -> last-event total.
+"""
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import sqlite3
+
+from dstack_tpu.server.context import ServerContext
+
+# Documentation order of the known stages (free-form strings are allowed;
+# the CLI renders unknown stages too).
+STAGES = (
+    "submitted",
+    "provisioning",
+    "instance_ready",
+    "pulling",
+    "env_ready",
+    "tpu_init",
+    "compile_start",
+    "compile_end",
+    "first_step",
+    "first_token",
+    "drain",
+    "preempt",
+    "resume",
+    "resize",
+)
+
+# Lane id for events that apply to the whole run rather than one host.
+RUN_LANE = -1
+
+
+async def record_event(
+    ctx: ServerContext,
+    run_id: str,
+    project_id: str,
+    stage: str,
+    *,
+    ts: Optional[float] = None,
+    replica_num: int = RUN_LANE,
+    job_num: int = RUN_LANE,
+    source: str = "server",
+    details: Optional[dict] = None,
+    dedupe: bool = False,
+) -> None:
+    """Append one stage event and feed the stage-duration histogram.
+
+    The duration observed is for the stage that just ENDED in this lane
+    (the previous event's stage); run-scoped events count as the previous
+    stage for every lane. Timestamps are clamped monotonic within the
+    lane so cross-process clock jitter can't produce a negative bar.
+    `dedupe=True` drops the event when the lane's latest event is already
+    this stage — for FSM sites that re-run until a transition sticks."""
+    if ts is None:
+        ts = time.time()
+    prev = await ctx.db.fetchone(
+        "SELECT stage, ts FROM run_events WHERE run_id = ?"
+        " AND ((replica_num = ? AND job_num = ?) OR replica_num = ?)"
+        " ORDER BY ts DESC, id DESC LIMIT 1",
+        (run_id, replica_num, job_num, RUN_LANE),
+    )
+    if dedupe and prev is not None and prev["stage"] == stage:
+        return
+    if prev is not None and ts < prev["ts"]:
+        ts = prev["ts"]
+    await ctx.db.execute(
+        "INSERT INTO run_events (run_id, project_id, replica_num, job_num,"
+        " stage, ts, source, details) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        (
+            run_id,
+            project_id,
+            replica_num,
+            job_num,
+            stage,
+            ts,
+            source,
+            json.dumps(details) if details else None,
+        ),
+    )
+    if prev is not None:
+        ctx.tracer.observe(
+            "run_stage_seconds", max(0.0, ts - prev["ts"]), stage=prev["stage"]
+        )
+
+
+def _event_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    return {
+        "replica_num": row["replica_num"],
+        "job_num": row["job_num"],
+        "stage": row["stage"],
+        "ts": row["ts"],
+        "source": row["source"],
+        "details": json.loads(row["details"]) if row["details"] else None,
+    }
+
+
+async def get_timeline(ctx: ServerContext, run_row: sqlite3.Row) -> Dict[str, Any]:
+    """Waterfall view of a run's events: one lane per host, run-scoped
+    events folded into every lane, durations telescoping to the lane
+    total (so stage sum == submit -> last-event span exactly)."""
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM run_events WHERE run_id = ? ORDER BY ts, id",
+        (run_row["id"],),
+    )
+    events = [_event_dict(r) for r in rows]
+    run_scoped = [e for e in events if e["replica_num"] == RUN_LANE]
+    host_keys = sorted(
+        {(e["replica_num"], e["job_num"]) for e in events if e["replica_num"] != RUN_LANE}
+    )
+    lanes: List[Dict[str, Any]] = []
+    for replica_num, job_num in host_keys or [(RUN_LANE, RUN_LANE)]:
+        chain = sorted(
+            (
+                e
+                for e in events
+                if e["replica_num"] == RUN_LANE
+                or (e["replica_num"], e["job_num"]) == (replica_num, job_num)
+            ),
+            key=lambda e: e["ts"],
+        ) if host_keys else list(run_scoped)
+        stages = []
+        for i, e in enumerate(chain):
+            nxt = chain[i + 1]["ts"] if i + 1 < len(chain) else e["ts"]
+            stages.append({
+                "stage": e["stage"],
+                "ts": e["ts"],
+                "duration_s": max(0.0, nxt - e["ts"]),
+                "source": e["source"],
+            })
+        lanes.append({
+            "replica_num": replica_num,
+            "job_num": job_num,
+            "stages": stages,
+        })
+    total_s = (events[-1]["ts"] - events[0]["ts"]) if len(events) > 1 else 0.0
+    trace_context = (
+        run_row["trace_context"] if "trace_context" in run_row.keys() else None
+    )
+    return {
+        "run_name": run_row["run_name"],
+        "status": run_row["status"],
+        "trace_context": trace_context,
+        "total_s": total_s,
+        "events": events,
+        "lanes": lanes,
+    }

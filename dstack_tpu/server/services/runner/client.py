@@ -21,6 +21,7 @@ from dstack_tpu.agents.protocol import (
 )
 from dstack_tpu.errors import ServerError
 from dstack_tpu.models.runs import ClusterInfo, JobSpec
+from dstack_tpu.utils.tracecontext import TRACEPARENT_HEADER, child_traceparent
 
 
 class AgentHTTPError(ServerError):
@@ -30,8 +31,14 @@ class AgentHTTPError(ServerError):
 
 
 class RunnerClient:
-    def __init__(self, base_url: str, timeout: float = 20.0):
+    def __init__(
+        self, base_url: str, timeout: float = 20.0, traceparent: Optional[str] = None
+    ):
         self.base_url = base_url.rstrip("/")
+        # The run's trace context: every call to this agent carries a child
+        # traceparent (same trace_id, fresh span_id) so agent-side spans
+        # join the run's trace.
+        self.traceparent = traceparent
         self._client = httpx.AsyncClient(timeout=timeout)
 
     async def close(self) -> None:
@@ -47,6 +54,10 @@ class RunnerClient:
             )
         except chaos.ChaosError as e:
             raise AgentHTTPError(e.status, str(e))
+        if self.traceparent:
+            headers = dict(kwargs.pop("headers", None) or {})
+            headers.setdefault(TRACEPARENT_HEADER, child_traceparent(self.traceparent))
+            kwargs["headers"] = headers
         resp = await self._client.request(method, self.base_url + path, **kwargs)
         if resp.status_code >= 400:
             raise AgentHTTPError(resp.status_code, resp.text)
@@ -70,6 +81,7 @@ class RunnerClient:
         repo_data=None,
         repo_creds=None,
         mounts=None,
+        traceparent: Optional[str] = None,
     ) -> None:
         body = SubmitBody(
             run_name=run_name,
@@ -81,6 +93,7 @@ class RunnerClient:
             repo_data=repo_data,
             repo_creds=repo_creds,
             mounts=mounts or [],
+            traceparent=traceparent or self.traceparent,
         )
         await self._request(
             "POST", "/api/submit", content=body.model_dump_json(),

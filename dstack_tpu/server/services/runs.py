@@ -38,7 +38,9 @@ from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
 from dstack_tpu.server.services import jobs as jobs_service
 from dstack_tpu.server.services import offers as offers_service
+from dstack_tpu.server.services import run_events
 from dstack_tpu.utils.common import generate_run_name, utcnow, utcnow_iso
+from dstack_tpu.utils import tracecontext
 
 JOB_TERMINATION_REASONS_RETRYABLE = {
     JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
@@ -230,7 +232,11 @@ def _run_priority(run_spec: RunSpec) -> int:
 
 
 async def submit_run(
-    ctx: ServerContext, user: User, project_row: sqlite3.Row, run_spec: RunSpec
+    ctx: ServerContext,
+    user: User,
+    project_row: sqlite3.Row,
+    run_spec: RunSpec,
+    trace_context: Optional[str] = None,
 ) -> Run:
     # Name uniqueness is enforced by the partial unique index
     # ix_runs_project_name_active (one ACTIVE run per name) — the INSERT
@@ -281,6 +287,11 @@ async def submit_run(
                 )
     run_id = generate_id()
     now = utcnow_iso()
+    # One run = one trace. The SDK/CLI sends its traceparent header; a
+    # missing/malformed one restarts the trace server-side (W3C rule), so
+    # every run row carries a valid context for the FSM and runner hops.
+    if tracecontext.parse_traceparent(trace_context) is None:
+        trace_context = tracecontext.generate_traceparent()
     # Resolve the user-facing repo name to the internal repos.id so the
     # running-jobs processor can fetch the uploaded code blob
     # (process_running_jobs._get_code_blob joins codes on repos.id).
@@ -320,8 +331,8 @@ async def submit_run(
             await ctx.db.execute(
                 "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
                 " last_processed_at, status, run_spec, service_spec, desired_replica_count,"
-                " repo_id, priority)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                " repo_id, priority, trace_context)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                 (
                     run_id,
                     project_row["id"],
@@ -335,6 +346,7 @@ async def submit_run(
                     _desired_replica_count(run_spec),
                     repo_row_id,
                     _run_priority(run_spec),
+                    trace_context,
                 ),
             )
             break
@@ -353,6 +365,7 @@ async def submit_run(
             run_spec.run_name = generate_run_name()
     else:
         raise ServerError("could not generate a unique run name")
+    await run_events.record_event(ctx, run_id, project_row["id"], "submitted")
     for replica_num in range(_desired_replica_count(run_spec)):
         await create_replica_jobs(ctx, project_row["id"], run_id, run_spec, replica_num)
     ctx.kick("submitted_jobs")

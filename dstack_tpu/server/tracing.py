@@ -10,6 +10,7 @@ format). Everything is stdlib and lock-cheap; exposed over /debug/*
 (routers/debug.py) the way pprof exposes /debug/pprof/*.
 """
 
+import bisect
 import itertools
 import sys
 import threading
@@ -20,6 +21,46 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 _span_ids = itertools.count(1)
+
+# Fixed log-spaced histogram buckets (seconds): 1 ms .. ~69 min doubling,
+# 23 finite buckets + implicit +Inf. One shared ladder for every duration
+# histogram (stage latencies, TTFT/TTFB) keeps exposition size bounded and
+# lets quantile queries aggregate across series.
+LOG_BUCKETS: tuple = tuple(0.001 * (2 ** i) for i in range(23))
+
+
+class HistogramData:
+    """One labelled histogram series: per-bucket counts + sum + count.
+
+    `counts[i]` is the NON-cumulative count of observations in bucket i
+    (<= LOG_BUCKETS[i]); the last slot is the +Inf overflow. Snapshots
+    compute the cumulative `le` form Prometheus expects."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: tuple = LOG_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        self.counts[idx] += 1
+        self.sum += value
+        self.count += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        cumulative = []
+        running = 0
+        for le, n in zip(self.buckets, self.counts):
+            running += n
+            cumulative.append((le, running))
+        return {
+            "buckets": cumulative,  # [(le_seconds, cumulative_count), ...]
+            "sum": self.sum,
+            "count": self.count,
+        }
 
 
 class SpanStats:
@@ -52,6 +93,10 @@ class Tracer:
         # name -> {sorted-label-tuple: value}. Exposed on /metrics in
         # Prometheus text format and in /debug/traces snapshots.
         self.counters: Dict[str, Dict[tuple, float]] = defaultdict(dict)
+        # Labelled histograms (stage latencies, TTFT): name ->
+        # {sorted-label-tuple: HistogramData}. Same keying as counters;
+        # exposed on /metrics as _bucket/_sum/_count.
+        self.histograms: Dict[str, Dict[tuple, HistogramData]] = defaultdict(dict)
         # Sentry-style error dedupe: fingerprint -> {first/last seen, count,
         # one representative traceback}.
         self.errors: Dict[str, Dict[str, Any]] = {}
@@ -70,6 +115,25 @@ class Tracer:
                 {"name": name, "labels": dict(key), "value": value}
                 for name, series in self.counters.items()
                 for key, value in series.items()
+            ]
+
+    def observe(self, name: str, value: float, **labels: Any) -> None:
+        """Record one observation into a labelled histogram (log-spaced
+        buckets, create-on-first-use)."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            series = self.histograms[name]
+            hist = series.get(key)
+            if hist is None:
+                hist = series[key] = HistogramData()
+            hist.observe(value)
+
+    def histogram_snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"name": name, "labels": dict(key), **hist.to_dict()}
+                for name, series in self.histograms.items()
+                for key, hist in series.items()
             ]
 
     def record(
@@ -167,6 +231,13 @@ class Tracer:
                 "recent_spans": list(self.spans)[-100:],
             }
 
+    def stats_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-span aggregates only. The /metrics scrape path wants just
+        these; `snapshot()` also copies the full span ring (up to 1000
+        dicts) per call, which is pure waste at scrape frequency."""
+        with self._lock:
+            return {name: st.to_dict() for name, st in self.stats.items()}
+
     def error_snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
             return sorted(
@@ -197,8 +268,17 @@ def sample_profile(seconds: float = 2.0, hz: int = 100) -> Dict[str, Any]:
     interval = 1.0 / hz
     counts: Counter = Counter()
     samples = 0
-    deadline = time.monotonic() + seconds
-    while time.monotonic() < deadline:
+    start = time.monotonic()
+    deadline = start + seconds
+    # Next-deadline pacing: sleeping a flat `interval` after each walk adds
+    # the walk cost (which grows with thread count and stack depth) to every
+    # period, so the effective rate drifts well below `hz` exactly on the
+    # busy servers worth profiling. Anchoring each wakeup to start+k/hz
+    # absorbs walk cost into the sleep; a walk slower than one period skips
+    # ahead instead of building a backlog of zero-sleep samples.
+    next_at = start
+    now = start
+    while now < deadline:
         for frame in sys._current_frames().values():
             # Raw frame walk — traceback.extract_stack touches linecache
             # (file IO) and is far too slow to sample at 100 Hz.
@@ -212,11 +292,21 @@ def sample_profile(seconds: float = 2.0, hz: int = 100) -> Dict[str, Any]:
                 f = f.f_back
             counts[";".join(reversed(parts))] += 1
         samples += 1
-        time.sleep(interval)
+        now = time.monotonic()
+        next_at += interval
+        if next_at < now:  # walk overran the period: realign, don't burst
+            next_at = now
+        elif next_at < deadline:
+            time.sleep(next_at - now)
+            now = time.monotonic()
+        else:
+            break
+    elapsed = max(time.monotonic() - start, 1e-9)
     return {
         "seconds": seconds,
         "hz": hz,
         "samples": samples,
+        "effective_hz": round(samples / elapsed, 3),
         "collapsed": [
             {"stack": stack, "count": n} for stack, n in counts.most_common(200)
         ],

@@ -1,0 +1,64 @@
+"""Lifecycle stage markers: the workload -> runner wire format.
+
+A workload process cannot reach the server, but its stdout already flows
+through the runner's log pump — so stage transitions ride that channel as
+single marker lines. `emit_stage("tpu_init")` prints
+
+    ::dstack-tpu-stage::tpu_init
+
+and the runner (agents/runner.py) recognizes the line, converts it to a
+RunStageEvent on its report clock, and keeps it out of the job's log
+stream. The server persists the event into run_events, where it lands in
+the run's timeline next to the FSM-observed stages — the in-workload half
+of the submit -> first-step/first-token waterfall.
+
+Markers are deliberately dumb text: they survive `exec`, shells, and
+containers, and need no socket back to the agent. The canonical stages a
+trainer emits are tpu_init, compile_start, compile_end, first_step; a
+serving engine emits first_token. `DSTACK_TPU_TRACEPARENT` (injected by
+the runner) carries the run's trace context for workloads that also keep
+their own spans.
+
+Lives in utils (not workloads) so the runner agent and the server can
+import the parser without dragging the JAX-heavy workloads package;
+workloads import it as `dstack_tpu.workloads.stages`.
+"""
+
+import os
+import sys
+from typing import Optional
+
+STAGE_MARKER_PREFIX = "::dstack-tpu-stage::"
+
+
+def emit_stage(stage: str, stream=None) -> None:
+    """Print one stage marker line; flushes so the runner's pump sees it
+    immediately (a buffered marker arriving after first_step would skew
+    every stage duration behind it)."""
+    out = stream if stream is not None else sys.stdout
+    out.write(f"{STAGE_MARKER_PREFIX}{stage}\n")
+    out.flush()
+
+
+def auto_stage(stage: str) -> None:
+    """`emit_stage`, but only inside an orchestrated run — detected by the
+    DSTACK_RUN_NAME env var the runner injects. Library code (train step
+    factories, serving engines) calls this unconditionally; direct use in
+    tests or benchmarks stays silent instead of polluting stdout."""
+    if os.environ.get("DSTACK_RUN_NAME"):
+        emit_stage(stage)
+
+
+def parse_stage_marker(line: str) -> Optional[str]:
+    """Stage name if `line` is a marker (surrounding whitespace ignored),
+    else None."""
+    text = line.strip()
+    if not text.startswith(STAGE_MARKER_PREFIX):
+        return None
+    stage = text[len(STAGE_MARKER_PREFIX):].strip()
+    return stage or None
+
+
+def traceparent() -> Optional[str]:
+    """The run's trace context as injected by the runner, if any."""
+    return os.environ.get("DSTACK_TPU_TRACEPARENT")

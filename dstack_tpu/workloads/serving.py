@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dstack_tpu.server.tracing import HistogramData
+from dstack_tpu.utils.stagemarkers import auto_stage
 from dstack_tpu.workloads.attention import decode_attention
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.generate import (
@@ -467,6 +469,13 @@ class ServingEngine:
         self._sum_ttft = 0.0
         self._sum_queue_wait = 0.0
         self._sum_prefill = 0.0
+        # Log-bucket TTFT histogram behind the sum/count pair: /metrics
+        # exposes dstack_tpu_serving_ttft_seconds as a real histogram so
+        # scrapers get quantiles, not just per-window means.
+        self._ttft_hist = HistogramData()
+        # One first_token timeline marker per engine lifetime (stage
+        # markers ride stdout; see utils/stagemarkers.py).
+        self._first_token_emitted = False
         # Wall-time accounting for the utilization gauges: cumulative
         # seconds the loop spent blocked on decode chunks, doing
         # prefill/admission host work, and idle-waiting.
@@ -693,6 +702,9 @@ class ServingEngine:
             "ttft_seconds_sum": round(self._sum_ttft, 4),
             "queue_wait_seconds_sum": round(self._sum_queue_wait, 4),
             "prefill_seconds_sum": round(self._sum_prefill, 4),
+            # Bucketed TTFT ({"buckets": [(le, cumulative)...], "sum",
+            # "count"}) — prometheus_metrics renders the histogram series.
+            "ttft_hist": self._ttft_hist.to_dict(),
         }
 
     def close(self) -> None:
@@ -934,6 +946,12 @@ class ServingEngine:
                 self._n_admitted += 1
                 self._sum_ttft += now - req.t_submit
                 self._sum_prefill += now - task.t_pop
+                self._ttft_hist.observe(now - req.t_submit)
+                if not self._first_token_emitted:
+                    self._first_token_emitted = True
+                    # Serving cold-start boundary: submit -> first_token is
+                    # the serving analogue of the trainer's first_step.
+                    auto_stage("first_token")
                 if req.max_new_tokens <= 1:
                     # Budget spent by the first token: complete here.
                     self._cancelled.discard(req.out)
@@ -1198,11 +1216,24 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
          stats["admitted_total"]),
         ("dstack_tpu_serving_rejected_total", "counter",
          stats["rejected_total"]),
-        ("dstack_tpu_serving_ttft_seconds_sum", "counter",
-         stats["ttft_seconds_sum"]),
     ]
     lines = []
     for name, mtype, value in series:
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name} {value}")
+    # TTFT as a real histogram (declared base dstack_tpu_serving_ttft_seconds;
+    # the _bucket/_sum/_count series derive from it). Older stats snapshots
+    # without ttft_hist degrade to the sum/count pair.
+    hist = stats.get("ttft_hist") or {
+        "buckets": [],
+        "sum": stats["ttft_seconds_sum"],
+        "count": stats["admitted_total"],
+    }
+    base = "dstack_tpu_serving_ttft_seconds"
+    lines.append(f"# TYPE {base} histogram")
+    for le, cumulative in hist["buckets"]:
+        lines.append(f'{base}_bucket{{le="{le}"}} {cumulative}')
+    lines.append(f'{base}_bucket{{le="+Inf"}} {hist["count"]}')
+    lines.append(f"{base}_sum {hist['sum']}")
+    lines.append(f"{base}_count {hist['count']}")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,23 @@
+"""Workload-facing alias of the stage-marker protocol.
+
+The implementation lives in `dstack_tpu.utils.stagemarkers` so the runner
+agent and the server can parse markers without importing the JAX-heavy
+workloads package; workloads use this module for the natural spelling
+(`from dstack_tpu.workloads.stages import emit_stage`).
+"""
+
+from dstack_tpu.utils.stagemarkers import (  # noqa: F401
+    STAGE_MARKER_PREFIX,
+    auto_stage,
+    emit_stage,
+    parse_stage_marker,
+    traceparent,
+)
+
+__all__ = [
+    "STAGE_MARKER_PREFIX",
+    "auto_stage",
+    "emit_stage",
+    "parse_stage_marker",
+    "traceparent",
+]

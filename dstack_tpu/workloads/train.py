@@ -17,6 +17,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dstack_tpu.utils.stagemarkers import auto_stage, emit_stage  # noqa: F401
 from dstack_tpu.workloads.attention import make_attention_fn
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.sharding import (
@@ -69,6 +70,9 @@ def init_train_state(
 ) -> TrainState:
     # Schedule args must match make_train_step's: a scheduled optimizer has
     # a different opt-state structure than a constant-lr one.
+    # First touch of the accelerator in a typical trainer: the timeline's
+    # env_ready -> tpu_init gap is import + device-discovery cost.
+    auto_stage("tpu_init")
     params = init_params(config, key)
     opt_state = make_optimizer(
         learning_rate, warmup_steps=warmup_steps, decay_steps=decay_steps
@@ -259,7 +263,7 @@ def make_train_step(
         return new_state, {"loss": loss, "grad_norm": gnorm, "router_aux": aux}
 
     if mesh is None:
-        return jax.jit(train_step, donate_argnums=0)
+        return _staged_step(jax.jit(train_step, donate_argnums=0))
 
     def shardings_of(tree):
         return param_shardings(mesh, tree)
@@ -292,7 +296,29 @@ def make_train_step(
             )
         return _cache[key](state, batch)
 
-    return jitted
+    return _staged_step(jitted)
+
+
+def _staged_step(step_fn):
+    """Bracket the FIRST invocation with compile_start/compile_end and
+    first_step timeline markers (no-ops outside an orchestrated run). The
+    first call is synced with block_until_ready so compile_end measures the
+    actual compile+first-execute wall, not async dispatch; later calls go
+    through untouched."""
+    holder = {"first": True}
+
+    def stepped(state, batch):
+        if not holder["first"]:
+            return step_fn(state, batch)
+        holder["first"] = False
+        auto_stage("compile_start")
+        out = step_fn(state, batch)
+        jax.block_until_ready(out)
+        auto_stage("compile_end")
+        auto_stage("first_step")
+        return out
+
+    return stepped
 
 
 class DrainHandler:

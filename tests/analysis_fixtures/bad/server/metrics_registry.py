@@ -1,8 +1,13 @@
-"""Known-bad registry fixture: one good series plus two hygiene
-violations (counter without _total suffix, gauge ending _total)."""
+"""Known-bad registry fixture: one good counter and one good histogram,
+plus hygiene violations (counter without _total suffix, gauge ending
+_total, histogram declared under a derived _bucket name, reserved `le`
+label declared by hand)."""
 
 METRICS = {
     "dstack_tpu_widget_spins_total": ("counter", ("widget",)),
+    "dstack_tpu_widget_latency_seconds": ("histogram", ("widget",)),
     "dstack_tpu_bad_counter": ("counter", ()),
     "dstack_tpu_bad_gauge_total": ("gauge", ()),
+    "dstack_tpu_bad_hist_bucket": ("histogram", ()),
+    "dstack_tpu_le_gauge": ("gauge", ("le",)),
 }

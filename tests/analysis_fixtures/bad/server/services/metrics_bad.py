@@ -1,9 +1,17 @@
-"""Known-bad fixture: MET01 emission drift — undeclared counter, label
-drift against the declared set, and an undeclared literal name."""
+"""Known-bad fixture: MET01 emission drift — undeclared counter and
+histogram, label drift against the declared sets, an undeclared literal
+name, and a derived _bucket literal whose base is not a histogram."""
 
 UNDECLARED = "dstack_tpu_never_declared_total"  # MET01: literal
+PHANTOM_BUCKET = "dstack_tpu_phantom_seconds_bucket"  # MET01: no histogram base
+OK_BUCKET = "dstack_tpu_widget_latency_seconds_bucket"  # derived from declared
 
 
 def account(tracer):
     tracer.inc("mystery_widget", 1)  # MET01: undeclared series
     tracer.inc("widget_spins", 1, run="r1")  # MET01: label drift (wants widget)
+
+
+def observe(tracer):
+    tracer.observe("mystery_latency", 0.5)  # MET01: undeclared histogram
+    tracer.observe("widget_latency_seconds", 0.5, run="r1")  # MET01: label drift

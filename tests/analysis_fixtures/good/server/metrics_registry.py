@@ -1,6 +1,8 @@
-"""Known-good registry fixture."""
+"""Known-good registry fixture: a counter, a gauge, and a histogram
+declared under its base name with no reserved labels."""
 
 METRICS = {
     "dstack_tpu_widget_spins_total": ("counter", ("widget",)),
     "dstack_tpu_widget_backlog": ("gauge", ()),
+    "dstack_tpu_widget_latency_seconds": ("histogram", ("widget",)),
 }

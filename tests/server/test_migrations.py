@@ -175,3 +175,33 @@ async def test_downgrade_noop_at_or_below_target(tmp_path):
         assert (await db.fetchone("PRAGMA user_version"))[0] == head
     finally:
         await db.close()
+
+
+async def test_run_events_migration_round_trip(tmp_path):
+    """Migration 8 (run lifecycle tracing): run_events + runs.trace_context
+    present at head, dropped by downgrade, restored by re-migrate."""
+    from dstack_tpu.server.db import Database
+
+    db = Database(str(tmp_path / "d.db"))
+    await db.connect()
+    try:
+        async def has_events_table():
+            row = await db.fetchone(
+                "SELECT name FROM sqlite_master WHERE name = 'run_events'"
+            )
+            return row is not None
+
+        async def run_cols():
+            rows = await db.fetchall("PRAGMA table_info(runs)")
+            return {r["name"] for r in rows}
+
+        assert await has_events_table()
+        assert "trace_context" in await run_cols()
+        await db.downgrade(7)
+        assert not await has_events_table()
+        assert "trace_context" not in await run_cols()
+        await db.migrate()
+        assert await has_events_table()
+        assert "trace_context" in await run_cols()
+    finally:
+        await db.close()

@@ -135,3 +135,57 @@ async def test_debug_endpoints_admin_only_and_live():
         fx.client.token = old
     finally:
         await fx.app.shutdown()
+
+
+# ----------------------------------------------------------- histograms
+
+
+def test_histogram_bucket_math():
+    from dstack_tpu.server.tracing import LOG_BUCKETS, HistogramData
+
+    h = HistogramData()
+    h.observe(0.0005)   # below the first bucket edge (1ms)
+    h.observe(0.003)    # lands in the 4ms bucket
+    h.observe(10_000.0)  # beyond the ladder -> overflow (+Inf only)
+    assert h.count == 3
+    assert abs(h.sum - 10_000.0035) < 1e-6
+    d = h.to_dict()
+    cumulative = dict(d["buckets"])
+    assert list(cumulative) == list(LOG_BUCKETS)
+    assert cumulative[0.001] == 1
+    assert cumulative[0.004] == 2
+    # Cumulative counts are monotone and the ladder misses the overflow.
+    counts = [c for _, c in d["buckets"]]
+    assert counts == sorted(counts)
+    assert counts[-1] == 2  # +Inf (derived from count) catches the third
+
+
+def test_tracer_observe_labelled_series():
+    t = Tracer()
+    t.observe("run_stage_seconds", 1.5, stage="pulling")
+    t.observe("run_stage_seconds", 2.5, stage="pulling")
+    t.observe("run_stage_seconds", 0.5, stage="env_ready")
+    snap = t.histogram_snapshot()
+    by_labels = {tuple(sorted(e["labels"].items())): e for e in snap}
+    pulling = by_labels[(("stage", "pulling"),)]
+    assert pulling["count"] == 2 and abs(pulling["sum"] - 4.0) < 1e-9
+    assert by_labels[(("stage", "env_ready"),)]["count"] == 1
+
+
+def test_stats_snapshot_is_aggregates_only():
+    t = Tracer()
+    with t.span("work"):
+        pass
+    stats = t.stats_snapshot()
+    assert stats["work"]["count"] == 1
+    # The scrape path must not pay for the span ring; snapshot() does.
+    assert "spans" not in stats["work"]
+    assert t.snapshot()["recent_spans"]
+
+
+def test_sample_profile_reports_effective_hz():
+    prof = sample_profile(seconds=0.2, hz=100)
+    assert prof["samples"] >= 1
+    # Next-deadline pacing: the achieved rate is reported and can't
+    # exceed the requested one by more than scheduling noise.
+    assert 0 < prof["effective_hz"] <= 110

@@ -111,3 +111,68 @@ async def test_drain_before_start_fails_with_preemption(tmp_path):
     final = ex.job_states[-1]
     assert final.state == JobStatus.FAILED
     assert final.termination_reason == JobTerminationReason.PREEMPTED_BY_PROVIDER
+
+
+def test_build_env_injects_traceparent(tmp_path):
+    from dstack_tpu.utils.tracecontext import TRACEPARENT_ENV, generate_traceparent
+
+    ex = Executor(working_root=str(tmp_path / "work"))
+    ex.submission = _submission(["true"])
+    assert TRACEPARENT_ENV not in ex.build_env()
+
+    tp = generate_traceparent()
+    ex.submission = _submission(["true"])
+    ex.submission.traceparent = tp
+    env = ex.build_env()
+    assert env[TRACEPARENT_ENV] == tp
+    assert env["DSTACK_RUN_NAME"] == "test-run"
+
+
+async def test_stage_markers_diverted_from_job_logs(tmp_path):
+    """Marker lines become RunStageEvents on the report clock and never
+    reach the log stream; surrounding output is untouched."""
+    import base64
+
+    from dstack_tpu.utils.stagemarkers import STAGE_MARKER_PREFIX
+
+    ex = await _run_job(
+        tmp_path,
+        [
+            "echo before",
+            f"echo '{STAGE_MARKER_PREFIX}tpu_init'",
+            "echo between",
+            f"echo '{STAGE_MARKER_PREFIX}first_step'",
+            # Unterminated marker at EOF must still classify.
+            f"printf '{STAGE_MARKER_PREFIX}drain'",
+        ],
+    )
+    await asyncio.wait_for(ex.finished.wait(), 10)
+    assert [e.stage for e in ex.stage_events] == ["tpu_init", "first_step", "drain"]
+    ts = [e.timestamp for e in ex.stage_events]
+    assert ts == sorted(ts) and len(set(ts)) == len(ts)
+    text = b"".join(
+        base64.b64decode(log.message) for log in ex.job_logs
+    ).decode()
+    assert STAGE_MARKER_PREFIX not in text
+    assert "before" in text and "between" in text
+
+    # Stage events ride the pull channel behind the same `> since` filter.
+    resp = ex.pull(since_ms=0)
+    assert [e.stage for e in resp.stage_events] == ["tpu_init", "first_step", "drain"]
+    later = ex.pull(since_ms=ex.stage_events[0].timestamp)
+    assert [e.stage for e in later.stage_events] == ["first_step", "drain"]
+
+
+async def test_unterminated_non_marker_output_still_streams(tmp_path):
+    """The pending-tail hold applies only while the tail could still be a
+    marker prefix: ordinary unterminated output (progress bars, prompts)
+    must flush, not sit in the buffer."""
+    import base64
+
+    ex = await _run_job(tmp_path, ["printf 'progress: 42%%'", "sleep 0.5"])
+    await asyncio.wait_for(ex.finished.wait(), 10)
+    text = b"".join(
+        base64.b64decode(log.message) for log in ex.job_logs
+    ).decode()
+    assert "progress: 42%" in text
+    assert ex.stage_events == []

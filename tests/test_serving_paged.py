@@ -214,7 +214,10 @@ def test_prometheus_metrics_matches_registry(params):
         text = prometheus_metrics(engine.stats())
     finally:
         engine.close()
+    from dstack_tpu.server.metrics_registry import histogram_base
+
     seen = set()
+    sampled = set()
     for line in text.strip().splitlines():
         if line.startswith("# TYPE "):
             _, _, name, mtype = line.split()
@@ -224,12 +227,37 @@ def test_prometheus_metrics_matches_registry(params):
             seen.add(name)
         else:
             name, _, value = line.partition(" ")
-            assert name in seen, f"sample before TYPE: {name}"
+            base = name.partition("{")[0]
+            assert base in seen or histogram_base(base) in seen, \
+                f"sample before TYPE: {name}"
+            sampled.add(base)
             float(value)
     for expected in ("dstack_tpu_serving_kv_blocks_in_use",
                      "dstack_tpu_serving_prefix_cache_hits_total",
                      "dstack_tpu_serving_prefix_cache_misses_total",
                      "dstack_tpu_serving_prefill_chunks_total",
-                     "dstack_tpu_serving_admitted_total",
-                     "dstack_tpu_serving_ttft_seconds_sum"):
+                     "dstack_tpu_serving_admitted_total"):
         assert expected in seen, expected
+    # TTFT is a real histogram now: derived series, declared base.
+    assert "dstack_tpu_serving_ttft_seconds" in seen
+    for derived in ("dstack_tpu_serving_ttft_seconds_bucket",
+                    "dstack_tpu_serving_ttft_seconds_sum",
+                    "dstack_tpu_serving_ttft_seconds_count"):
+        assert derived in sampled, derived
+
+
+def test_ttft_histogram_tracks_deliveries(params):
+    """Each admitted request's first token lands one TTFT observation;
+    the stats snapshot carries the cumulative-bucket dict the exposition
+    renders."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=32)
+    try:
+        _drain(engine.submit([5, 7, 11], max_new_tokens=3))
+        _drain(engine.submit([5, 7, 13], max_new_tokens=3))
+        hist = engine.stats()["ttft_hist"]
+    finally:
+        engine.close()
+    assert hist["count"] == 2
+    assert hist["sum"] > 0
+    counts = [c for _, c in hist["buckets"]]
+    assert counts == sorted(counts) and counts[-1] <= 2

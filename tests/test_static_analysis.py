@@ -58,10 +58,15 @@ def test_bad_fixtures_trip_every_checker():
         "interp:fetchone",
     ]
     assert _keys(report, "MET01") == [
+        "labels:dstack_tpu_widget_latency_seconds",
         "labels:dstack_tpu_widget_spins_total",
+        "le:dstack_tpu_le_gauge",
         "literal:dstack_tpu_never_declared_total",
+        "literal:dstack_tpu_phantom_seconds_bucket",
         "suffix:dstack_tpu_bad_counter",
         "suffix:dstack_tpu_bad_gauge_total",
+        "suffix:dstack_tpu_bad_hist_bucket",
+        "undeclared:dstack_tpu_mystery_latency",
         "undeclared:dstack_tpu_mystery_widget_total",
     ]
     assert report.exit_code == 1
