@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast lint lint-json capacity capacity-smoke bench-proxy bench-serving
+.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage dataplane lint lint-json capacity capacity-smoke bench-proxy bench-serving
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -17,6 +17,22 @@ chaos:
 
 chaos-fast:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario runner-flap
+
+# Failure-isolation drills (docs/guides/multi-replica.md): control-plane
+# replica SIGKILL with lease takeover, data-plane worker SIGKILL mid-SSE,
+# and a full control-plane outage with degraded serving + epoch re-sync.
+chaos-replica-kill:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario replica-kill-takeover
+
+chaos-worker-kill:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario dataplane-worker-kill
+
+chaos-outage:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario dataplane-outage
+
+# Standalone data-plane worker(s) against the local server DB.
+dataplane:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.dataplane --workers $(or $(WORKERS),1)
 
 # Static analysis (docs/guides/static-analysis.md) + bytecode compile.
 # The second analysis invocation is the self-check: the analyzer's own
@@ -36,10 +52,12 @@ capacity:
 	JAX_PLATFORMS=cpu $(PYTHON) capacity_probe.py --runs 500 --out CAPACITY_r06.json
 
 # Proxy data-plane benchmark: pooled+streamed fast path vs the legacy
-# per-request-client buffered proxy. Results land in BENCH_proxy_r07.json;
-# see docs/guides/proxy-tuning.md for how to read them.
+# per-request-client buffered proxy, plus the multi-worker scaling and
+# route-staleness arms (real dataplane subprocesses). Results land in
+# BENCH_proxy_r09.json; see docs/guides/proxy-tuning.md and
+# docs/guides/multi-replica.md for how to read them.
 bench-proxy:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r07.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r09.json
 
 # Serving-engine benchmark: chunked prefill + paged KV with prefix
 # sharing (warmed-burst TTFT and shared-prefix accounting scenarios).

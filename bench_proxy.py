@@ -15,8 +15,14 @@ Three scenarios, all against a real keep-alive HTTP/1.1 upstream socket:
    total generation time.
 3. routing — replica lookups/s: 3 SQL queries + 2 pydantic parses per
    pick (legacy) vs the TTL'd routing cache.
+4. multiworker — real `python -m dstack_tpu.dataplane` subprocesses
+   (1, 2, 4) sharing one file DB, each given the same per-worker
+   connection budget against a fixed-service-time upstream: aggregate
+   RPS scaling measures cross-worker interference, and a post-transition
+   probe measures route staleness after a routing_epoch bump (must stay
+   within ~one poll interval).
 
-Emits ONE JSON document (BENCH_proxy_r07.json via --out).
+Emits ONE JSON document (BENCH_proxy_r09.json via --out).
 
 Run: JAX_PLATFORMS=cpu python bench_proxy.py [--requests 300] [--out ...]
 """
@@ -43,10 +49,14 @@ class Upstream:
     first KB immediately and the remaining body after `gen_delay` —
     a stand-in for token-by-token model generation."""
 
-    def __init__(self, payload_size=512, trickle_size=16384, gen_delay=0.25):
-        self.payload = b"x" * payload_size
+    def __init__(
+        self, payload_size=512, trickle_size=16384, gen_delay=0.25,
+        fill=b"x", service_time=0.0,
+    ):
+        self.payload = fill * payload_size
         self.trickle = b"y" * trickle_size
         self.gen_delay = gen_delay
+        self.service_time = service_time
         self.connections = 0
         self.requests = 0
         self.server = None
@@ -88,6 +98,8 @@ class Upstream:
                     await asyncio.sleep(self.gen_delay)
                     writer.write(body[1024:])
                 else:
+                    if self.service_time:
+                        await asyncio.sleep(self.service_time)
                     writer.write(
                         b"HTTP/1.1 200 OK\r\nContent-Type: application/octet-stream\r\n"
                         b"Content-Length: " + str(len(self.payload)).encode()
@@ -219,6 +231,225 @@ async def _seed_service(ctx, run_name, port):
         (generate_id(), project["id"], run_id, run_name, now, now,
          job_spec.model_dump_json(), jpd.model_dump_json()),
     )
+
+
+# ------------------------------------------- multi-worker scaling (PR 9)
+# Real `python -m dstack_tpu.dataplane` subprocesses against a shared
+# file DB: each worker gets the same per-worker connection budget and the
+# upstream has a fixed service time, so aggregate RPS measures whether
+# workers interfere with one another (shared DB, shared upstream) — not
+# raw single-core Python throughput. Near-linear scaling = no
+# cross-worker contention on the shared paths.
+
+_MW_REQ = (
+    b"GET /proxy/services/main/bench-svc/data HTTP/1.1\r\n"
+    b"host: bench\r\n\r\n"
+)
+
+
+async def _mw_read_response(reader):
+    """Parse one keep-alive HTTP/1.1 response (content-length or chunked
+    — the streamed relay emits chunked) and return (status_line, body)."""
+    status = await reader.readline()
+    clen, chunked = None, False
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        k = k.strip().lower()
+        if k == "content-length":
+            clen = int(v)
+        elif k == "transfer-encoding" and "chunked" in v.lower():
+            chunked = True
+    body = b""
+    if chunked:
+        while True:
+            size = int((await reader.readline()).strip() or b"0", 16)
+            chunk = await reader.readexactly(size + 2)
+            if size == 0:
+                break
+            body += chunk[:-2]
+    elif clen:
+        body = await reader.readexactly(clen)
+    return status, body
+
+
+async def _mw_conn(port, end_time, counter):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        while time.perf_counter() < end_time:
+            writer.write(_MW_REQ)
+            await writer.drain()
+            status, _body = await _mw_read_response(reader)
+            assert b" 200 " in status, status
+            counter[0] += 1
+    finally:
+        writer.close()
+
+
+async def _mw_spawn_workers(db_path, n, poll_interval):
+    import os
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs, ports = [], []
+    for _ in range(n):
+        procs.append(
+            await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "dstack_tpu.dataplane",
+                "--db", str(db_path), "--port", "0",
+                "--poll-interval", str(poll_interval),
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+                env=env,
+            )
+        )
+    for proc in procs:
+        line = await asyncio.wait_for(proc.stdout.readline(), 30)
+        ports.append(int(line.decode().rsplit(":", 1)[1]))
+    async with httpx.AsyncClient(timeout=5.0) as hc:
+        for port in ports:
+            deadline = time.perf_counter() + 20
+            while True:
+                try:
+                    r = await hc.get(f"http://127.0.0.1:{port}/readyz")
+                    if r.status_code == 200:
+                        break
+                except httpx.HTTPError:
+                    pass
+                if time.perf_counter() > deadline:
+                    raise RuntimeError(f"worker on :{port} never became ready")
+                await asyncio.sleep(0.1)
+    return procs, ports
+
+
+async def _mw_kill(procs):
+    for p in procs:
+        if p.returncode is None:
+            p.kill()
+    for p in procs:
+        try:
+            await asyncio.wait_for(p.wait(), 10)
+        except asyncio.TimeoutError:
+            pass
+
+
+async def run_multiworker_bench(args, tmpdir):
+    import json as _json
+    import sqlite3
+    from pathlib import Path
+
+    from dstack_tpu.server.app import create_app
+
+    db = Path(tmpdir) / "bench.db"
+    up_a = Upstream(fill=b"a", service_time=args.mw_service_time)
+    up_b = Upstream(fill=b"b", service_time=args.mw_service_time)
+    port_a, port_b = await up_a.start(), await up_b.start()
+
+    # Control plane only migrates + seeds, then exits — workers must run
+    # without any live server process.
+    app = create_app(
+        db_path=str(db), admin_token="bench", run_background_tasks=False,
+        server_config_path=str(Path(tmpdir) / "config.yml"),
+    )
+    await app.startup()
+    await _seed_service(app.state["ctx"], "bench-svc", port_a)
+    await app.shutdown()
+
+    try:
+        scaling = {}
+        for n in (1, 2, 4):
+            procs, ports = await _mw_spawn_workers(db, n, poll_interval=1.0)
+            try:
+                counter = [0]
+                end = time.perf_counter() + args.mw_duration
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *[
+                        _mw_conn(port, end, counter)
+                        for port in ports
+                        for _ in range(args.mw_conns)
+                    ]
+                )
+                wall = time.perf_counter() - t0
+                scaling[str(n)] = {
+                    "workers": n,
+                    "connections": n * args.mw_conns,
+                    "requests": counter[0],
+                    "rps": round(counter[0] / wall, 1),
+                }
+            finally:
+                await _mw_kill(procs)
+
+        # Route-staleness after an FSM transition: flip the service's
+        # replica port + bump routing_epoch straight in the DB (what
+        # bump_routing_epoch does on run/job transitions), then measure
+        # how long a worker keeps routing to the old replica.
+        procs, ports = await _mw_spawn_workers(db, 1, poll_interval=args.mw_poll)
+        try:
+            async with httpx.AsyncClient(timeout=10.0) as hc:
+                url = f"http://127.0.0.1:{ports[0]}/proxy/services/main/bench-svc/data"
+                r = await hc.get(url)
+                assert r.status_code == 200 and r.content[:1] == b"a", (
+                    r.status_code, r.content[:20],
+                )
+                conn = sqlite3.connect(db)
+                row = conn.execute(
+                    "SELECT id, job_spec FROM jobs WHERE run_name='bench-svc'"
+                ).fetchone()
+                spec = _json.loads(row[1])
+                spec["app_specs"][0]["port"] = port_b
+                conn.execute(
+                    "UPDATE jobs SET job_spec=? WHERE id=?",
+                    (_json.dumps(spec), row[0]),
+                )
+                conn.execute(
+                    "UPDATE runs SET routing_epoch = routing_epoch + 1"
+                    " WHERE run_name='bench-svc'"
+                )
+                conn.commit()
+                conn.close()
+                t0 = time.perf_counter()
+                while True:
+                    r = await hc.get(url)
+                    if r.status_code == 200 and r.content[:1] == b"b":
+                        staleness = time.perf_counter() - t0
+                        break
+                    if time.perf_counter() - t0 > args.mw_poll * 4 + 5:
+                        raise RuntimeError("worker never picked up the epoch bump")
+                    await asyncio.sleep(0.02)
+        finally:
+            await _mw_kill(procs)
+
+        scaling_x = round(scaling["4"]["rps"] / scaling["1"]["rps"], 2)
+        return {
+            "config": {
+                "duration_s": args.mw_duration,
+                "connections_per_worker": args.mw_conns,
+                "upstream_service_time_s": args.mw_service_time,
+                "epoch_poll_interval_s": args.mw_poll,
+                "note": "fixed per-worker connection budget against a"
+                        " fixed-service-time upstream: scaling measures"
+                        " cross-worker interference on the shared DB and"
+                        " upstream, holding per-worker offered load constant",
+            },
+            "scaling": scaling,
+            "staleness": {
+                "post_transition_staleness_s": round(staleness, 3),
+                "bound_s": round(args.mw_poll + 0.3, 3),
+            },
+            "summary": {
+                "rps_scaling_4w_x": scaling_x,
+                "near_linear_to_4_workers": bool(scaling_x >= 3.0),
+                "staleness_bounded_by_poll": bool(
+                    staleness <= args.mw_poll + 0.3
+                ),
+            },
+        }
+    finally:
+        up_a.stop()
+        up_b.stop()
 
 
 # ------------------------------------------------------------------ driving
@@ -373,6 +604,16 @@ async def run_bench(args):
         await app.shutdown()
 
 
+async def _run_all(args):
+    import tempfile
+
+    out = await run_bench(args)
+    if not args.skip_multiworker:
+        with tempfile.TemporaryDirectory(prefix="dstack-bench-mw-") as tmp:
+            out["multiworker"] = await run_multiworker_bench(args, tmp)
+    return out
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--requests", type=int, default=300)
@@ -381,15 +622,37 @@ def main() -> None:
     parser.add_argument("--gen-delay", type=float, default=0.25)
     parser.add_argument("--ttfb-requests", type=int, default=12)
     parser.add_argument("--routing-lookups", type=int, default=1500)
-    parser.add_argument("--out", default="BENCH_proxy_r07.json")
+    parser.add_argument("--mw-duration", type=float, default=4.0,
+                        help="seconds of load per multi-worker arm")
+    parser.add_argument("--mw-conns", type=int, default=2,
+                        help="load connections per worker")
+    parser.add_argument("--mw-service-time", type=float, default=0.05,
+                        help="upstream service time for the scaling arms")
+    parser.add_argument("--mw-poll", type=float, default=0.25,
+                        help="epoch poll interval for the staleness probe")
+    parser.add_argument("--skip-multiworker", action="store_true")
+    parser.add_argument("--out", default="BENCH_proxy_r09.json")
     args = parser.parse_args()
 
-    out = asyncio.run(run_bench(args))
+    out = asyncio.run(_run_all(args))
     print(json.dumps(out, indent=1))
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     if not out["summary"]["pooled_streamed_beats_unpooled_buffered"]:
         raise SystemExit("fast path did not beat the legacy proxy")
+    mw = out.get("multiworker")
+    if mw is not None:
+        if not mw["summary"]["near_linear_to_4_workers"]:
+            raise SystemExit(
+                f"multi-worker RPS scaling {mw['summary']['rps_scaling_4w_x']}x"
+                " at 4 workers, want >= 3x"
+            )
+        if not mw["summary"]["staleness_bounded_by_poll"]:
+            raise SystemExit(
+                "post-transition route staleness "
+                f"{mw['staleness']['post_transition_staleness_s']}s exceeds "
+                f"{mw['staleness']['bound_s']}s bound"
+            )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,166 @@
+"""LCK03: in-process locks guarding multi-replica state.
+
+`ResourceLocker.lock_ctx` serializes within ONE server process. The
+control plane can run N replicas (`DSTACK_TPU_REPLICA_ID` /
+`DSTACK_TPU_MULTI_REPLICA`), so an UPDATE/DELETE on an FSM-owned table
+(`runs` / `jobs` / `instances`) whose only guard is the in-process
+lockset is invisible to sibling replicas: two replicas each pass their
+local lock and double-write the same row. Such writes must go through
+`ctx.claims.lock_ctx` / `ctx.claims.try_claim` — the DB-lease-backed
+claim that degrades to the plain in-process lockset in single-replica
+deployments, so promoting a site costs nothing when only one server
+runs.
+
+Flagged: a write to an FSM-owned table lexically inside `async with
+<x>.locker.lock_ctx(ns, ...)` for an owning namespace, with no
+claims-backed lease for an owning namespace held at the write. Writes
+already covered by LCK01 (no lock at all) are not LCK03's concern, and
+writes under a lease are correct regardless of extra in-process locks.
+Scope matches LCK01: `server/background/` and `server/services/`.
+"""
+
+import ast
+from typing import Iterable, List, Sequence, Set
+
+from dstack_tpu.analysis.astutil import (
+    FUNC_NODES,
+    attr_name,
+    const_str,
+    string_text,
+)
+from dstack_tpu.analysis.checkers.lock_discipline import (
+    TABLE_NAMESPACES,
+    _WRITE_RE,
+    _scoped,
+    _top_functions,
+)
+from dstack_tpu.analysis.core import Checker, Finding, Module
+
+
+def _receiver_attr(call: ast.Call) -> str:
+    """For `a.b.lock_ctx(...)`, the receiver attribute `b` ("locker",
+    "claims", ...); "" when the callee is not shaped that way."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Attribute):
+        return fn.value.attr
+    return ""
+
+
+class MultiReplicaLockChecker(Checker):
+    codes = ("LCK03",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not _scoped(module.rel):
+            return []
+        findings: List[Finding] = []
+        for qualname, node in _top_functions(module):
+            self._scan(module, qualname, node.body, set(), set(), findings)
+        return findings
+
+    def _scan(
+        self,
+        module: Module,
+        qualname: str,
+        body: Sequence[ast.stmt],
+        inproc: Set[str],
+        lease: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        inproc, lease = set(inproc), set(lease)
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner_inproc, inner_lease = set(inproc), set(lease)
+                for item in stmt.items:
+                    self._scan_expr(
+                        module, qualname, item.context_expr,
+                        inproc, lease, findings,
+                    )
+                    call = item.context_expr
+                    if (
+                        isinstance(call, ast.Call)
+                        and attr_name(call) == "lock_ctx"
+                        and call.args
+                    ):
+                        ns = const_str(call.args[0])
+                        recv = _receiver_attr(call)
+                        if ns and recv == "locker":
+                            inner_inproc.add(ns)
+                        elif ns and recv == "claims":
+                            inner_lease.add(ns)
+                self._scan(
+                    module, qualname, stmt.body,
+                    inner_inproc, inner_lease, findings,
+                )
+            elif isinstance(stmt, (FUNC_NODES, ast.ClassDef)):
+                self._scan(module, qualname, stmt.body, inproc, lease, findings)
+            elif isinstance(stmt, ast.If):
+                # `if await ctx.claims.try_claim(...)` grows the lease set
+                # before the body is scanned (same over-approximation as
+                # LCK01: writes conventionally live in the success branch).
+                self._scan_expr(module, qualname, stmt.test, inproc, lease, findings)
+                self._scan(module, qualname, stmt.body, inproc, lease, findings)
+                self._scan(module, qualname, stmt.orelse, inproc, lease, findings)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(module, qualname, stmt.iter, inproc, lease, findings)
+                self._scan(module, qualname, stmt.body, inproc, lease, findings)
+                self._scan(module, qualname, stmt.orelse, inproc, lease, findings)
+            elif isinstance(stmt, ast.While):
+                self._scan_expr(module, qualname, stmt.test, inproc, lease, findings)
+                self._scan(module, qualname, stmt.body, inproc, lease, findings)
+                self._scan(module, qualname, stmt.orelse, inproc, lease, findings)
+            elif isinstance(stmt, ast.Try):
+                self._scan(module, qualname, stmt.body, inproc, lease, findings)
+                for handler in stmt.handlers:
+                    self._scan(module, qualname, handler.body, inproc, lease, findings)
+                self._scan(module, qualname, stmt.orelse, inproc, lease, findings)
+                self._scan(module, qualname, stmt.finalbody, inproc, lease, findings)
+            else:
+                self._scan_expr(module, qualname, stmt, inproc, lease, findings)
+
+    def _scan_expr(
+        self,
+        module: Module,
+        qualname: str,
+        node: ast.AST,
+        inproc: Set[str],
+        lease: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            method = attr_name(sub)
+            if method == "try_claim" and sub.args:
+                ns = const_str(sub.args[0])
+                if ns:
+                    lease.add(ns)
+                continue
+            if method in ("execute", "executemany") and sub.args:
+                text, _ = string_text(sub.args[0])
+                if not text:
+                    continue
+                m = _WRITE_RE.match(text)
+                if not m:
+                    continue
+                verb = m.group(1).split()[0].upper()
+                table = m.group(2).lower()
+                allowed = TABLE_NAMESPACES.get(table)
+                if allowed is None:
+                    continue
+                if not (inproc & allowed) or (lease & allowed):
+                    continue
+                locks = ", ".join(sorted(inproc & allowed))
+                findings.append(
+                    Finding(
+                        code="LCK03",
+                        message=f"{verb} on `{table}` in `{qualname}` is"
+                        f" guarded only by the in-process lock ({locks}) —"
+                        " invisible to sibling server replicas; use"
+                        " ctx.claims.lock_ctx / try_claim so the guard is"
+                        " a DB lease under DSTACK_TPU_MULTI_REPLICA",
+                        rel=module.rel,
+                        line=sub.lineno,
+                        symbol=qualname,
+                        key=f"inproc:{table}",
+                    )
+                )

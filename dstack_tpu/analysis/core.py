@@ -145,12 +145,14 @@ def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.async_hygiene import AsyncHygieneChecker
     from dstack_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
+    from dstack_tpu.analysis.checkers.multi_replica import MultiReplicaLockChecker
     from dstack_tpu.analysis.checkers.pool import PoolChecker
     from dstack_tpu.analysis.checkers.sql import SqlChecker
 
     return [
         AsyncHygieneChecker(),
         LockDisciplineChecker(),
+        MultiReplicaLockChecker(),
         SqlChecker(),
         MetricsRegistryChecker(),
         PoolChecker(),

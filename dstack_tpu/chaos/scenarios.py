@@ -808,3 +808,579 @@ async def _elastic_resize(report, seed, tmp: Path) -> None:
     finally:
         await engine.stop()
         await app.shutdown()
+
+
+# ---- PR 9: failure-isolated serving tier drills ----------------------------
+#
+# Three drills proving the multi-replica control plane and the standalone
+# data-plane workers fail independently: (a) kill -9 a server replica and
+# watch the survivor take over its expired leases with zero double-claims;
+# (b) kill -9 a data-plane worker mid-SSE and verify the other worker's
+# streams are byte-intact while the killed streams end promptly; (c) cut
+# the data plane off from the control-plane DB and verify it serves
+# last-known routes flagged stale, then re-syncs epochs within one poll
+# interval of recovery.
+
+
+async def _seed_service_rows(ctx, run_name: str, port: int) -> str:
+    """Insert a RUNNING service run + replica job pointing at
+    127.0.0.1:port (same row shapes bench_proxy.py seeds). Returns run_id."""
+    import json
+
+    from dstack_tpu.models.runs import JobProvisioningData, JobSpec, RunSpec
+    from dstack_tpu.server.security import generate_id
+    from dstack_tpu.utils.common import utcnow_iso
+
+    project = await ctx.db.fetchone("SELECT * FROM projects WHERE name='main'")
+    user = await ctx.db.fetchone("SELECT * FROM users LIMIT 1")
+    run_id, now = generate_id(), utcnow_iso()
+    spec = RunSpec.model_validate(
+        {"run_name": run_name, "repo_id": "local",
+         "configuration": {"type": "service", "name": run_name, "port": port,
+                           "commands": ["serve"]}}
+    )
+    await ctx.db.execute(
+        "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
+        " last_processed_at, status, run_spec, service_spec)"
+        " VALUES (?, ?, ?, ?, ?, ?, 'running', ?, ?)",
+        (run_id, project["id"], user["id"], run_name, now, now,
+         spec.model_dump_json(),
+         json.dumps({"url": f"/proxy/services/main/{run_name}/", "model": None})),
+    )
+    await ctx.db.execute(
+        "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
+        " submitted_at, last_processed_at, status, job_spec, job_provisioning_data)"
+        " VALUES (?, ?, ?, ?, 0, 0, ?, ?, 'running', ?, ?)",
+        (generate_id(), project["id"], run_id, run_name, now, now,
+         _service_job_spec(run_name, port), _service_jpd()),
+    )
+    return run_id
+
+
+def _service_job_spec(run_name: str, port: int) -> str:
+    from dstack_tpu.models.runs import JobSpec
+
+    return JobSpec.model_validate(
+        {"job_name": f"{run_name}-0-0", "commands": ["serve"],
+         "requirements": {"resources": {}},
+         "app_specs": [{"app_name": "app", "port": port}]}
+    ).model_dump_json()
+
+
+def _service_jpd() -> str:
+    from dstack_tpu.models.runs import JobProvisioningData
+
+    return JobProvisioningData.model_validate(
+        {"backend": "local",
+         "instance_type": {"name": "local",
+                           "resources": {"cpus": 1, "memory_mib": 1024}},
+         "instance_id": "i-0", "hostname": "127.0.0.1", "internal_ip": "127.0.0.1",
+         "region": "local", "price": 0.0, "username": "root", "dockerized": False}
+    ).model_dump_json()
+
+
+_REPLICA_WORKER = """
+import asyncio, json, sys, time
+
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.http import Server
+
+
+async def main():
+    db_path, mode, keys_csv = sys.argv[1:4]
+    keys = keys_csv.split(",")
+    app = create_app(db_path=db_path, admin_token="chaos-admin",
+                     run_background_tasks=True)
+    server = Server(app, "127.0.0.1", 0)
+    await server.start()
+    ctx = app.state["ctx"]
+    print(json.dumps({"event": "up", "port": server.port,
+                      "replica": ctx.replica_id}), flush=True)
+    if mode == "holder":
+        held = []
+        for k in keys:
+            if await ctx.claims.try_claim("jobs", k):
+                held.append(k)
+                await ctx.db.execute(
+                    "INSERT INTO chaos_claims (key, owner, acquired_at)"
+                    " VALUES (?, ?, ?)", (k, ctx.replica_id, time.time()),
+                )
+        print(json.dumps({"event": "held", "keys": held}), flush=True)
+        await asyncio.sleep(300)  # killed from outside; heartbeat renews
+    else:  # contender: spin until every key is stolen from the corpse
+        acquired = []
+        while len(acquired) < len(keys):
+            for k in keys:
+                if k not in acquired and await ctx.claims.try_claim("jobs", k):
+                    acquired.append(k)
+                    await ctx.db.execute(
+                        "INSERT INTO chaos_claims (key, owner, acquired_at)"
+                        " VALUES (?, ?, ?)", (k, ctx.replica_id, time.time()),
+                    )
+            await asyncio.sleep(0.1)
+        print(json.dumps({"event": "acquired", "keys": sorted(acquired)}),
+              flush=True)
+        await asyncio.sleep(300)  # parent scrapes /metrics, then kills us
+
+
+asyncio.run(main())
+"""
+
+
+async def _read_event(proc, want: str, timeout: float = 60.0):
+    """Next {"event": want} JSON line from a worker's stdout."""
+    import json
+
+    while True:
+        line = await asyncio.wait_for(proc.stdout.readline(), timeout)
+        if not line:
+            raise RuntimeError(f"worker exited before event {want!r}")
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            continue  # log noise on stdout
+        if msg.get("event") == want:
+            return msg
+
+
+def _drill_env(tmp: Path, **extra: str) -> Dict[str, str]:
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO_ROOT,
+        # Keep subprocess servers away from the operator's real config.
+        "DSTACK_TPU_SERVER_CONFIG": str(tmp / "config.yml"),
+    }
+    env.update(extra)
+    return env
+
+
+@scenario("replica-kill-takeover")
+async def _replica_kill_takeover(report, seed, tmp: Path) -> None:
+    """kill -9 one of two server replicas mid-claim: the survivor must
+    take over the corpse's leases within TTL, with zero double-claims
+    (no acquisition before the dead replica's lease expiry), and the
+    takeover must be visible on the survivor's /metrics."""
+    import json as _json
+    import sys
+    import time
+
+    import httpx
+
+    ttl = 2.0
+    keys = [f"drill-job-{i}" for i in range(4)]
+    db = tmp / "replicas.db"
+
+    # Parent-side control app: migrates the DB, creates the audit table,
+    # and is our read handle on resource_leases / chaos_claims.
+    from dstack_tpu.server.app import create_app
+
+    app = create_app(db_path=str(db), admin_token="chaos-admin",
+                     run_background_tasks=False)
+    await app.startup()
+    ctx = app.state["ctx"]
+    await ctx.db.execute(
+        "CREATE TABLE IF NOT EXISTS chaos_claims ("
+        " key TEXT NOT NULL, owner TEXT NOT NULL, acquired_at REAL NOT NULL)"
+    )
+
+    script = tmp / "replica_worker.py"
+    await asyncio.to_thread(script.write_text, _REPLICA_WORKER)
+
+    def _spawn(replica_id: str, mode: str):
+        # stderr to a file, not a pipe: nobody drains it, and a chatty FSM
+        # filling the pipe buffer would deadlock the worker.
+        errlog = open(tmp / f"{replica_id}.stderr", "wb")
+        return asyncio.create_subprocess_exec(
+            sys.executable, str(script), str(db), mode, ",".join(keys),
+            stdout=asyncio.subprocess.PIPE, stderr=errlog,
+            env=_drill_env(
+                tmp,
+                DSTACK_TPU_MULTI_REPLICA="1",
+                DSTACK_TPU_REPLICA_ID=replica_id,
+                DSTACK_TPU_LEASE_TTL=str(ttl),
+            ),
+        )
+
+    proc_a = await _spawn("replica-a", "holder")
+    proc_b = None
+    try:
+        held = await _read_event(proc_a, "held")
+        _expect(report, sorted(held["keys"]) == sorted(keys),
+                f"holder claimed {held['keys']}, want all of {keys}")
+
+        proc_b = await _spawn("replica-b", "contender")
+        up_b = await _read_event(proc_b, "up")
+        b_port = up_b["port"]
+
+        # Readiness gate: the contender's HTTP plane answers.
+        async with httpx.AsyncClient(timeout=5) as hc:
+            deadline = time.monotonic() + 15
+            while True:
+                try:
+                    r = await hc.get(f"http://127.0.0.1:{b_port}/metrics")
+                    if r.status_code == 200:
+                        break
+                except httpx.HTTPError:
+                    pass
+                _expect(report, time.monotonic() < deadline,
+                        "contender /metrics never came up")
+                if time.monotonic() >= deadline:
+                    return
+                await asyncio.sleep(0.1)
+
+        # Let the contender demonstrably contend (and fail) while the
+        # holder is alive, then snapshot the holder's lease expiries and
+        # kill it without ceremony.
+        await asyncio.sleep(2 * ttl / 4)
+        pre_kill = await ctx.db.fetchall(
+            "SELECT key, expires_at FROM resource_leases"
+            " WHERE owner = 'replica-a' AND namespace = 'jobs'"
+        )
+        _expect(report, len(pre_kill) == len(keys),
+                f"holder had {len(pre_kill)} leases at kill time, want {len(keys)}")
+        expiry = {r["key"]: r["expires_at"] for r in pre_kill}
+        stolen_early = await ctx.db.fetchall(
+            "SELECT * FROM chaos_claims WHERE owner = 'replica-b'"
+        )
+        _expect(report, not stolen_early,
+                "contender acquired keys while the holder was alive")
+        t_kill = time.time()
+        proc_a.kill()
+
+        acquired = await _read_event(proc_b, "acquired",
+                                     timeout=ttl + 20)
+        _expect(report, acquired["keys"] == sorted(keys),
+                f"contender acquired {acquired['keys']}, want {sorted(keys)}")
+        rows = await ctx.db.fetchall(
+            "SELECT key, acquired_at FROM chaos_claims WHERE owner = 'replica-b'"
+        )
+        takeover_at = {r["key"]: r["acquired_at"] for r in rows}
+        double_claims = [
+            k for k in keys
+            if takeover_at.get(k, float("inf")) < expiry.get(k, 0) - 0.05
+        ]
+        _expect(report, not double_claims,
+                f"double-claimed before lease expiry: {double_claims}")
+        worst = max(takeover_at.values()) - t_kill if takeover_at else None
+        _expect(report, worst is not None and worst <= ttl + 3,
+                f"takeover took {worst}s after kill -9, want <= ttl+3")
+        report["details"]["takeover_after_kill_s"] = round(worst, 3) if worst else None
+
+        # The steal is observable: lease_takeovers ticked on the survivor.
+        takeovers = 0.0
+        async with httpx.AsyncClient(timeout=5) as hc:
+            r = await hc.get(f"http://127.0.0.1:{b_port}/metrics")
+            for ln in r.text.splitlines():
+                if ln.startswith("dstack_tpu_lease_takeovers_total") and \
+                        'namespace="jobs"' in ln:
+                    takeovers = float(ln.rsplit(" ", 1)[1])
+        _expect(report, takeovers >= 1,
+                f"survivor /metrics lease_takeovers_total = {takeovers}, want >= 1")
+        report["details"]["lease_takeovers_total"] = takeovers
+    finally:
+        for p in (proc_a, proc_b):
+            if p is not None and p.returncode is None:
+                p.kill()
+                try:
+                    await asyncio.wait_for(p.wait(), 10)
+                except asyncio.TimeoutError:
+                    pass
+        await app.shutdown()
+
+
+@scenario("dataplane-worker-kill")
+async def _dataplane_worker_kill(report, seed, tmp: Path) -> None:
+    """kill -9 one of two data-plane workers mid-SSE: the surviving
+    worker's stream must arrive byte-intact, the killed worker's streams
+    must end promptly (not hang), and the survivor stays ready."""
+    import sys
+    import time
+
+    import httpx
+
+    from dstack_tpu.server.app import create_app
+
+    db = tmp / "dataplane.db"
+    events = [f"event {i:03d}\n".encode() for i in range(30)]
+    expected = b"".join(events)
+
+    # Slow SSE-ish upstream: headers immediately, then one event every
+    # 120 ms — long enough for a mid-stream kill, short enough for CI.
+    async def _handle(reader, writer):
+        try:
+            await reader.readuntil(b"\r\n\r\n")
+            writer.write(
+                b"HTTP/1.1 200 OK\r\ncontent-type: text/event-stream\r\n"
+                + b"content-length: %d\r\n\r\n" % len(expected)
+            )
+            await writer.drain()
+            for e in events:
+                writer.write(e)
+                await writer.drain()
+                await asyncio.sleep(0.12)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    upstream = await asyncio.start_server(_handle, "127.0.0.1", 0)
+    uport = upstream.sockets[0].getsockname()[1]
+
+    # Control plane: migrate + seed the service, then get out of the way
+    # (the whole point is that workers need no live server process).
+    app = create_app(db_path=str(db), admin_token="chaos-admin",
+                     run_background_tasks=False)
+    await app.startup()
+    await _seed_service_rows(app.state["ctx"], "chaos-sse", uport)
+    await app.shutdown()
+
+    async def _spawn_worker(idx: int):
+        errlog = await asyncio.to_thread(open, tmp / f"worker-{idx}.stderr", "wb")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "dstack_tpu.dataplane",
+            "--db", str(db), "--port", "0", "--poll-interval", "0.2",
+            stdout=asyncio.subprocess.PIPE, stderr=errlog,
+            env=_drill_env(tmp),
+        )
+        line = await asyncio.wait_for(proc.stdout.readline(), 30)
+        port = int(line.decode().rsplit(":", 1)[1])
+        return proc, port
+
+    async def _wait_ready(hc, port, deadline=15.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            try:
+                r = await hc.get(f"http://127.0.0.1:{port}/readyz")
+                if r.status_code == 200:
+                    return True
+            except httpx.HTTPError:
+                pass
+            await asyncio.sleep(0.1)
+        return False
+
+    proc1 = proc2 = None
+    hc = httpx.AsyncClient(timeout=httpx.Timeout(30, connect=5))
+    try:
+        (proc1, port1), (proc2, port2) = await asyncio.gather(
+            _spawn_worker(1), _spawn_worker(2)
+        )
+        ready = await asyncio.gather(
+            _wait_ready(hc, port1), _wait_ready(hc, port2)
+        )
+        _expect(report, all(ready), f"workers ready: {ready}, want both")
+        if not all(ready):
+            return
+
+        progress = {1: 0, 2: 0}
+        body: Dict[int, bytes] = {}
+        errors: Dict[int, str] = {}
+
+        async def _consume(idx: int, port: int) -> None:
+            buf = b""
+            try:
+                async with hc.stream(
+                    "GET",
+                    f"http://127.0.0.1:{port}/proxy/services/main/chaos-sse/stream",
+                ) as r:
+                    async for chunk in r.aiter_raw():
+                        buf += chunk
+                        progress[idx] = len(buf)
+            except Exception as e:  # the killed stream ends however it ends
+                errors[idx] = repr(e)
+            body[idx] = buf
+
+        t1 = asyncio.create_task(_consume(1, port1))
+        t2 = asyncio.create_task(_consume(2, port2))
+        # Both streams demonstrably mid-flight (>= 5 events each), then
+        # SIGKILL worker 1 — no shutdown hooks, no connection draining.
+        five = 5 * len(events[0])
+        deadline = time.monotonic() + 15
+        while min(progress.values()) < five:
+            _expect(report, time.monotonic() < deadline,
+                    f"streams never reached mid-flight: {progress}")
+            if time.monotonic() >= deadline:
+                return
+            await asyncio.sleep(0.05)
+        t_kill = time.monotonic()
+        proc1.kill()
+        try:
+            await asyncio.wait_for(t1, 10)
+            killed_end = time.monotonic() - t_kill
+        except asyncio.TimeoutError:
+            t1.cancel()
+            killed_end = None
+        _expect(report, killed_end is not None,
+                "killed worker's stream hung instead of ending")
+        try:
+            await asyncio.wait_for(t2, 30)
+        except asyncio.TimeoutError:
+            t2.cancel()
+        _expect(report, body.get(2) == expected,
+                f"surviving stream not byte-intact: got {len(body.get(2) or b'')}"
+                f" bytes, want {len(expected)}")
+        _expect(report, body.get(1) != expected,
+                "killed stream implausibly completed after SIGKILL")
+        r = await hc.get(f"http://127.0.0.1:{port2}/readyz")
+        _expect(report, r.status_code == 200,
+                f"survivor /readyz = {r.status_code} after the kill, want 200")
+        report["details"]["killed_stream_ended_after_s"] = (
+            round(killed_end, 3) if killed_end is not None else None
+        )
+        report["details"]["killed_stream_bytes"] = len(body.get(1) or b"")
+        report["details"]["surviving_stream_bytes"] = len(body.get(2) or b"")
+    finally:
+        await hc.aclose()
+        for p in (proc1, proc2):
+            if p is not None and p.returncode is None:
+                p.kill()
+                try:
+                    await asyncio.wait_for(p.wait(), 10)
+                except asyncio.TimeoutError:
+                    pass
+        upstream.close()
+        await upstream.wait_closed()
+
+
+@scenario("dataplane-outage")
+async def _dataplane_outage(report, seed, tmp: Path) -> None:
+    """Control-plane outage: the data plane must keep serving last-known
+    routes (flagged `x-dstack-route-stale`), stay ready, and re-sync
+    epochs within ~one poll interval of the control plane returning —
+    including a topology change that happened during the outage."""
+    import time
+
+    from dstack_tpu.dataplane.app import (
+        create_dataplane_app, route_staleness_seconds,
+    )
+    from dstack_tpu.server.app import create_app
+    from dstack_tpu.server.http import TestClient
+
+    db = tmp / "outage.db"
+    poll = 0.25
+
+    async def _make_upstream(payload: bytes):
+        async def _handle(reader, writer):
+            try:
+                while True:
+                    await reader.readuntil(b"\r\n\r\n")
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\ncontent-length: %d\r\n\r\n"
+                        % len(payload) + payload
+                    )
+                    await writer.drain()
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                writer.close()
+
+        srv = await asyncio.start_server(_handle, "127.0.0.1", 0)
+        return srv, srv.sockets[0].getsockname()[1]
+
+    up_a, port_a = await _make_upstream(b"alpha")
+    up_b, port_b = await _make_upstream(b"bravo")
+
+    app = create_app(db_path=str(db), admin_token="chaos-admin",
+                     run_background_tasks=False)
+    await app.startup()
+    ctx = app.state["ctx"]
+    run_id = await _seed_service_rows(ctx, "outage-svc", port_a)
+
+    dp = create_dataplane_app(str(db), poll_interval=poll, routing_ttl=0.4)
+    await dp.startup()
+    dpc = dp.state["ctx"]
+    client = TestClient(dp)
+
+    async def _get(path):
+        resp = await client.get(path)
+        if resp.stream is not None:
+            chunks = []
+            async for c in resp.stream:
+                chunks.append(c)
+            resp.body = b"".join(chunks)
+        return resp
+
+    class _DeadDB:
+        """Every query raises — the worker's view of a down control
+        plane. Real db object kept so non-query attributes still work."""
+
+        def __init__(self, real):
+            self._real = real
+
+        def __getattr__(self, name):
+            if name in ("fetchone", "fetchall", "execute", "executemany",
+                        "run_sync"):
+                async def _fail(*a, **k):
+                    raise RuntimeError("control plane unreachable (chaos)")
+                return _fail
+            return getattr(self._real, name)
+
+    try:
+        deadline = time.monotonic() + 15
+        while not dpc.synced_once and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        _expect(report, dpc.synced_once, "worker never achieved epoch sync")
+        r = await _get("/proxy/services/main/outage-svc/data")
+        _expect(report, r.status == 200 and r.body == b"alpha",
+                f"pre-outage request: {r.status} {r.body[:40]!r}")
+        _expect(report, r.headers.get("x-dstack-route-stale") is None,
+                "fresh route wrongly flagged stale")
+
+        # --- outage: cut the worker off from the DB entirely.
+        real_db = dpc.db
+        dpc.db = _DeadDB(real_db)
+        await asyncio.sleep(0.6)  # routing TTL expires; epoch polls fail
+        r = await _get("/proxy/services/main/outage-svc/data")
+        _expect(report, r.status == 200 and r.body == b"alpha",
+                f"during outage: {r.status} {r.body[:40]!r}, want cached 200")
+        _expect(report, r.headers.get("x-dstack-route-stale") == "1",
+                "degraded-mode response missing x-dstack-route-stale: 1")
+        ready = await _get("/readyz")
+        _expect(report, ready.status == 200,
+                f"/readyz {ready.status} during outage, want 200 (stays ready)")
+        await asyncio.sleep(poll)
+        staleness = route_staleness_seconds(dpc)
+        _expect(report, staleness > 0,
+                f"staleness gauge {staleness} during outage, want > 0")
+        report["details"]["outage_staleness_s"] = round(staleness, 3)
+        report["details"]["stale_serves"] = dpc.routing_cache.stats()["stale_serves"]
+
+        # While the worker is cut off, the FSM moves the service to a new
+        # replica (port flip + epoch bump) — exactly what the worker must
+        # pick up on recovery.
+        await ctx.db.execute(
+            "UPDATE jobs SET job_spec = ? WHERE run_id = ?",
+            (_service_job_spec("outage-svc", port_b), run_id),
+        )
+        await ctx.db.execute(
+            "UPDATE runs SET routing_epoch = routing_epoch + 1 WHERE id = ?",
+            (run_id,),
+        )
+
+        # --- recovery: reconnect and measure epoch re-sync latency.
+        dpc.db = real_db
+        t0 = time.monotonic()
+        resynced = None
+        while time.monotonic() - t0 < poll * 4 + 2:
+            r = await _get("/proxy/services/main/outage-svc/data")
+            if r.status == 200 and r.body == b"bravo":
+                resynced = time.monotonic() - t0
+                _expect(report, r.headers.get("x-dstack-route-stale") is None,
+                        "post-recovery response still flagged stale")
+                break
+            await asyncio.sleep(0.05)
+        _expect(report, resynced is not None,
+                "worker never picked up the epoch bump after recovery")
+        _expect(report, resynced is None or resynced <= poll + 1.0,
+                f"epoch re-sync took {resynced}s, want <= poll + 1.0")
+        if resynced is not None:
+            report["details"]["resync_after_recovery_s"] = round(resynced, 3)
+        await asyncio.sleep(poll + 0.1)
+        _expect(report, route_staleness_seconds(dpc) < poll + 1.0,
+                "staleness gauge did not recover after reconnection")
+    finally:
+        await dp.shutdown()
+        await app.shutdown()
+        for srv in (up_a, up_b):
+            srv.close()
+            await srv.wait_closed()

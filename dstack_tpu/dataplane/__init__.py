@@ -1,0 +1,29 @@
+"""Standalone data-plane workers — the failure-isolated serving tier.
+
+The in-server proxy (PR 5) put the data plane in the same process as the
+FSM: a control-plane crash, stall, or long DB write killed every
+in-flight user stream. This package serves the exact same routes
+(`/proxy/services/...`, `/proxy/models/...`) from dedicated worker
+processes that share nothing with the control plane except the database:
+
+- route invalidation arrives through the `routing_epoch` column
+  (migration 9; services/routing_events.py) polled once per
+  `DSTACK_TPU_DATAPLANE_EPOCH_POLL` seconds — never more than one poll
+  interval stale, regardless of which control-plane replica stepped a
+  job;
+- a control-plane outage degrades instead of failing: last-known routes
+  keep being served (responses flagged `x-dstack-route-stale: 1`),
+  the epoch poller retries with jittered backoff, and in-flight SSE
+  streams are never dropped (relay holds its pooled client until the
+  last byte);
+- `/healthz` is liveness, `/readyz` is "first epoch sync achieved",
+  `/metrics` exposes `dstack_tpu_dataplane_route_staleness_seconds`
+  alongside the proxy pool / routing cache series.
+
+Run: `python -m dstack_tpu.dataplane --workers N` (N processes on
+consecutive ports; front with any TCP load balancer).
+"""
+
+from dstack_tpu.dataplane.app import DataPlaneContext, create_dataplane_app
+
+__all__ = ["DataPlaneContext", "create_dataplane_app"]

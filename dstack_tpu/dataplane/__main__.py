@@ -1,0 +1,116 @@
+"""Data-plane worker launcher.
+
+`--workers 1` (the default) serves in-process. `--workers N` supervises
+N single-worker child processes on consecutive ports (port .. port+N-1)
+— the hand-rolled Server has no SO_REUSEPORT, and per-worker ports are
+what the kill drills and the multi-worker bench address anyway; front
+the ports with any TCP load balancer in production. The parent forwards
+SIGTERM/SIGINT and exits with the first non-zero child status.
+
+Run: python -m dstack_tpu.dataplane --db ~/.dstack-tpu/server/data/sqlite.db --workers 4
+"""
+
+import argparse
+import asyncio
+import logging
+import signal
+import subprocess
+import sys
+
+from dstack_tpu.server import settings
+
+logger = logging.getLogger(__name__)
+
+
+def _parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(prog="python -m dstack_tpu.dataplane")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8100,
+                        help="first worker port; worker i listens on port+i")
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--db", default=None,
+                        help="control-plane database (default: server's)")
+    parser.add_argument("--poll-interval", type=float, default=None,
+                        help="routing_epoch poll interval seconds"
+                             " (default: DSTACK_TPU_DATAPLANE_EPOCH_POLL)")
+    parser.add_argument("--routing-ttl", type=float, default=None,
+                        help="routing cache TTL seconds"
+                             " (default: DSTACK_TPU_DATAPLANE_ROUTING_TTL)")
+    parser.add_argument("--worker-id", default=None, help=argparse.SUPPRESS)
+    return parser.parse_args(argv)
+
+
+async def _serve(args: argparse.Namespace) -> None:
+    from dstack_tpu.dataplane.app import create_dataplane_app
+    from dstack_tpu.server.http import Server
+
+    app = create_dataplane_app(
+        args.db or settings.get_db_path(),
+        poll_interval=args.poll_interval,
+        routing_ttl=args.routing_ttl,
+        worker_id=args.worker_id,
+    )
+    server = Server(app, args.host, args.port)
+    await server.start()
+    print(f"dataplane worker listening on {args.host}:{server.port}", flush=True)
+    assert server._server is not None
+    try:
+        async with server._server:
+            await server._server.serve_forever()
+    finally:
+        await app.shutdown()
+
+
+def _supervise(args: argparse.Namespace) -> int:
+    procs = []
+    base_cmd = [sys.executable, "-m", "dstack_tpu.dataplane", "--workers", "1",
+                "--host", args.host]
+    if args.db:
+        base_cmd += ["--db", args.db]
+    if args.poll_interval is not None:
+        base_cmd += ["--poll-interval", str(args.poll_interval)]
+    if args.routing_ttl is not None:
+        base_cmd += ["--routing-ttl", str(args.routing_ttl)]
+    for i in range(args.workers):
+        cmd = base_cmd + ["--port", str(args.port + i), "--worker-id", f"worker-{i}"]
+        procs.append(subprocess.Popen(cmd))
+
+    forwarded: set = set()
+
+    def _forward(signum, _frame):
+        forwarded.add(signum)
+        for p in procs:
+            try:
+                p.send_signal(signum)
+            except OSError:
+                pass
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+    rc = 0
+    for p in procs:
+        try:
+            p.wait()
+        except KeyboardInterrupt:
+            pass
+        code = p.returncode or 0
+        if code < 0 and -code in forwarded:
+            code = 0  # child died to the signal we forwarded: clean shutdown
+        rc = rc or code
+    return rc
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = _parse_args(argv)
+    if args.workers > 1:
+        return _supervise(args)
+    try:
+        asyncio.run(_serve(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""Data-plane worker app: proxy routes + epoch sync + health endpoints.
+
+The worker reuses the server's proxy routers verbatim — they only touch
+the context attributes a `DataPlaneContext` provides (db, spec_cache,
+proxy_pool, routing_cache, tracer, service_stats) — so the request path
+is byte-identical to the in-server fast path. What differs is
+invalidation: no FSM runs here, so the worker polls the `routing_epoch`
+column like the PR 3 spec cache polls content digests, and drops cached
+routes for any service run whose epoch moved (or which disappeared).
+"""
+
+import asyncio
+import logging
+import random
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+import dstack_tpu.server.schema  # noqa: F401  (registers migrations)
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.http import App, Request, Response, Router
+from dstack_tpu.server.metrics_registry import counter_name, histogram_name
+from dstack_tpu.server.routers.metrics import _Exposition
+
+logger = logging.getLogger(__name__)
+
+
+class DataPlaneContext:
+    """The slice of ServerContext the proxy routers actually touch, plus
+    the worker's epoch-sync state. Deliberately NOT a ServerContext: no
+    locker, no claims, no backends — a worker that cannot reach the FSM's
+    machinery cannot accidentally drive it."""
+
+    def __init__(
+        self,
+        db: Database,
+        poll_interval: Optional[float] = None,
+        sync_deadline: Optional[float] = None,
+        routing_ttl: Optional[float] = None,
+        worker_id: Optional[str] = None,
+    ):
+        from dstack_tpu.server.services.proxy_pool import ProxyPool
+        from dstack_tpu.server.services.routing_cache import RoutingCache
+        from dstack_tpu.server.services.spec_cache import SpecCache
+        from dstack_tpu.server.services.stats import ServiceStatsCollector
+        from dstack_tpu.server.tracing import Tracer
+
+        self.db = db
+        self.worker_id = worker_id or uuid.uuid4().hex[:12]
+        self.tracer = Tracer()
+        self.spec_cache = SpecCache(tracer=self.tracer)
+        self.proxy_pool = ProxyPool(tracer=self.tracer)
+        # Long TTL: epoch polling — not expiry — is the invalidation path
+        # here, so entries survive until the FSM actually changes topology.
+        self.routing_cache = RoutingCache(
+            ttl=(
+                settings.DATAPLANE_ROUTING_TTL if routing_ttl is None else routing_ttl
+            ),
+            tracer=self.tracer,
+        )
+        self.service_stats = ServiceStatsCollector()
+        self.poll_interval = (
+            settings.DATAPLANE_EPOCH_POLL if poll_interval is None else poll_interval
+        )
+        self.sync_deadline = (
+            settings.DATAPLANE_SYNC_DEADLINE if sync_deadline is None else sync_deadline
+        )
+        # run_id -> (epoch, run_name, project_id); the poller's last view.
+        self.epochs: Dict[str, Tuple[int, str, str]] = {}
+        self.synced_once = False
+        self.last_sync: Optional[float] = None  # monotonic
+        self.sync_failures = 0
+        self.epoch_invalidations = 0
+
+
+async def sync_epochs(ctx: DataPlaneContext) -> int:
+    """One epoch poll: read every live service run's routing_epoch and
+    invalidate routes whose epoch moved or whose run disappeared.
+    Returns the number of invalidations. Raises on DB failure — retry
+    policy lives in the caller."""
+    rows = await ctx.db.fetchall(
+        "SELECT r.id AS run_id, r.run_name, r.routing_epoch, r.project_id,"
+        " p.name AS project_name"
+        " FROM runs r JOIN projects p ON p.id = r.project_id"
+        " WHERE r.deleted = 0 AND r.service_spec IS NOT NULL"
+    )
+    changed = 0
+    seen: Dict[str, Tuple[int, str, str]] = {}
+    for row in rows:
+        seen[row["run_id"]] = (
+            row["routing_epoch"], row["run_name"], row["project_id"],
+        )
+        prev = ctx.epochs.get(row["run_id"])
+        if prev is not None and prev[0] != row["routing_epoch"]:
+            ctx.routing_cache.invalidate_run(
+                row["run_name"], project_id=row["project_id"]
+            )
+            changed += 1
+    for run_id, (_epoch, run_name, project_id) in ctx.epochs.items():
+        if run_id not in seen:
+            ctx.routing_cache.invalidate_run(run_name, project_id=project_id)
+            changed += 1
+    ctx.epochs = seen
+    ctx.last_sync = time.monotonic()
+    ctx.synced_once = True
+    if changed:
+        ctx.epoch_invalidations += changed
+    return changed
+
+
+async def sync_with_retries(ctx: DataPlaneContext) -> bool:
+    """Epoch sync with jittered exponential backoff under a deadline: a
+    control-plane hiccup is retried within this poll cycle; a sustained
+    outage gives up until the next cycle (the worker keeps serving
+    last-known routes flagged stale either way)."""
+    deadline = time.monotonic() + ctx.sync_deadline
+    delay = 0.05
+    while True:
+        try:
+            await sync_epochs(ctx)
+            return True
+        except Exception:
+            ctx.sync_failures += 1
+            if time.monotonic() + delay >= deadline:
+                logger.warning(
+                    "epoch sync failed for %.1fs; serving last-known routes",
+                    ctx.sync_deadline,
+                    exc_info=True,
+                )
+                return False
+            # Full jitter keeps N workers from hammering a recovering
+            # control plane in lockstep.
+            await asyncio.sleep(delay * (0.5 + random.random()))
+            delay = min(delay * 2, 1.0)
+
+
+async def _poll_loop(ctx: DataPlaneContext) -> None:
+    while True:
+        await sync_with_retries(ctx)
+        await asyncio.sleep(ctx.poll_interval)
+
+
+def route_staleness_seconds(ctx: DataPlaneContext) -> float:
+    """Seconds of route staleness beyond the expected poll cadence: 0
+    while epoch syncs land on schedule, growing from the moment the
+    control plane stops answering."""
+    if ctx.last_sync is None:
+        return 0.0
+    return max(0.0, time.monotonic() - ctx.last_sync - ctx.poll_interval)
+
+
+def create_dataplane_app(
+    db_path: str,
+    poll_interval: Optional[float] = None,
+    sync_deadline: Optional[float] = None,
+    routing_ttl: Optional[float] = None,
+    worker_id: Optional[str] = None,
+) -> App:
+    app = App()
+    db = Database.from_url(db_path)
+    ctx = DataPlaneContext(
+        db,
+        poll_interval=poll_interval,
+        sync_deadline=sync_deadline,
+        routing_ttl=routing_ttl,
+        worker_id=worker_id,
+    )
+    app.state["ctx"] = ctx
+    app.state["tracer"] = ctx.tracer
+
+    async def _inject_ctx(request: Request) -> Optional[Response]:
+        request.state["ctx"] = ctx
+        return None
+
+    app.add_middleware(_inject_ctx)
+
+    from dstack_tpu.server.routers import model_proxy, services_proxy
+
+    router = Router()
+
+    @router.get("/healthz")
+    async def healthz(request: Request):
+        return {
+            "status": "ok",
+            "worker_id": ctx.worker_id,
+            "sync_failures": ctx.sync_failures,
+        }
+
+    @router.get("/readyz")
+    async def readyz(request: Request):
+        # Ready = at least one successful epoch sync: before that the
+        # worker has no baseline and could serve a route whose run the
+        # FSM already tore down. Chaos drills and load balancers gate on
+        # this instead of sleeping.
+        if ctx.synced_once:
+            return {
+                "status": "ready",
+                "worker_id": ctx.worker_id,
+                "tracked_runs": len(ctx.epochs),
+            }
+        return Response(
+            {"status": "waiting for first epoch sync"}, status=503
+        )
+
+    @router.get("/metrics")
+    async def metrics(request: Request):
+        exp = _Exposition()
+        exp.add(
+            "dstack_tpu_dataplane_route_staleness_seconds",
+            {},
+            route_staleness_seconds(ctx),
+        )
+        for c in ctx.tracer.counter_snapshot():
+            exp.add(counter_name(c["name"]), c["labels"], c["value"])
+        pool = ctx.proxy_pool.stats()
+        exp.add("dstack_tpu_proxy_pool_connections", {}, pool["clients"])
+        for kind, hist in sorted(ctx.proxy_pool.ttfb_histogram().items()):
+            exp.add_histogram(
+                "dstack_tpu_proxy_ttfb_seconds", {"kind": kind},
+                hist["buckets"], hist["sum"], hist["count"],
+            )
+        routing = ctx.routing_cache.stats()
+        exp.add("dstack_tpu_proxy_routing_cache_hit_rate", {}, routing["hit_rate"])
+        for h in ctx.tracer.histogram_snapshot():
+            exp.add_histogram(
+                histogram_name(h["name"]), h["labels"],
+                h["buckets"], h["sum"], h["count"],
+            )
+        return Response(
+            "\n".join(exp.lines) + "\n", media_type="text/plain; version=0.0.4"
+        )
+
+    app.include_router(router)
+    app.include_router(services_proxy.router)
+    app.include_router(model_proxy.router)
+
+    async def _startup() -> None:
+        await db.connect()
+        app.state["poll_task"] = asyncio.get_event_loop().create_task(
+            _poll_loop(ctx)
+        )
+
+    async def _shutdown() -> None:
+        task = app.state.pop("poll_task", None)
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await ctx.proxy_pool.aclose()
+        await db.close()
+
+    app.on_startup.append(_startup)
+    app.on_shutdown.append(_shutdown)
+    return app

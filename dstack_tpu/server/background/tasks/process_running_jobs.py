@@ -30,6 +30,7 @@ from dstack_tpu.errors import BackendError, ServerError
 from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.services import run_events
+from dstack_tpu.server.services.routing_events import bump_routing_epoch
 from dstack_tpu.server.services import volumes as volumes_service
 from dstack_tpu.server.services.connections import get_connection_pool
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
@@ -548,7 +549,7 @@ async def _submit_to_runner(
             "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
         )
         await _stage(ctx, row, "env_ready")
-        ctx.routing_cache.invalidate_run(row["run_name"])
+        await bump_routing_epoch(ctx, row["run_id"], row["run_name"], row["project_id"])
         await _register_service_replica(ctx, row, jpd, job_spec, tick)
         logger.info(
             "job %s (%s rank %d/%d) running",
@@ -678,7 +679,7 @@ async def _pull_runner(
                     row["id"],
                 ),
             )
-            ctx.routing_cache.invalidate_run(row["run_name"])
+            await bump_routing_epoch(ctx, row["run_id"], row["run_name"], row["project_id"])
             if await _elastic_keeps_instance(
                 ctx, row, reason, event.exit_status, tick
             ):
@@ -786,7 +787,7 @@ async def _fail(
         " termination_reason_message = ?, finished_at = ? WHERE id = ?",
         (reason.to_status().value, reason.value, message, utcnow_iso(), row["id"]),
     )
-    ctx.routing_cache.invalidate_run(row["run_name"])
+    await bump_routing_epoch(ctx, row["run_id"], row["run_name"], row["project_id"])
     await _release_instance(ctx, row)
     ctx.kick("runs")
     logger.info("job %s failed: %s", row["id"][:8], message)
@@ -832,7 +833,7 @@ async def _terminate_job(
         "UPDATE jobs SET status = ?, finished_at = ?, last_processed_at = ? WHERE id = ?",
         (reason.to_status().value, utcnow_iso(), utcnow_iso(), row["id"]),
     )
-    ctx.routing_cache.invalidate_run(row["run_name"])
+    await bump_routing_epoch(ctx, row["run_id"], row["run_name"], row["project_id"])
     await _unregister_service_replica(ctx, row, tick)
     await _release_instance(ctx, row)
     ctx.kick("runs")

@@ -26,6 +26,7 @@ from dstack_tpu.server import settings
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
 from dstack_tpu.server.services import run_events
+from dstack_tpu.server.services.routing_events import bump_routing_epoch
 from dstack_tpu.server.services.runs import (
     JOB_TERMINATION_REASONS_RETRYABLE,
     create_replica_jobs,
@@ -123,7 +124,7 @@ async def _process_active_run(ctx: ServerContext, row: sqlite3.Row) -> None:
             "UPDATE runs SET status = ?, termination_reason = ? WHERE id = ?",
             (RunStatus.TERMINATING.value, RunTerminationReason.JOB_FAILED.value, row["id"]),
         )
-        ctx.routing_cache.invalidate_run(row["run_name"])
+        await bump_routing_epoch(ctx, row["id"], row["run_name"], row["project_id"])
         ctx.kick("terminating_jobs")
         return
 
@@ -215,7 +216,7 @@ async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
                             j["id"],
                         ),
                     )
-        ctx.routing_cache.invalidate_run(row["run_name"])
+        await bump_routing_epoch(ctx, row["id"], row["run_name"], row["project_id"])
         ctx.kick("terminating_jobs")
     await ctx.db.execute(
         "UPDATE runs SET desired_replica_count = ?, last_scaled_at = ? WHERE id = ?",
@@ -263,7 +264,7 @@ async def _maybe_retry(
                         j["id"],
                     ),
                 )
-        ctx.routing_cache.invalidate_run(row["run_name"])
+        await bump_routing_epoch(ctx, row["id"], row["run_name"], row["project_id"])
         ctx.kick("terminating_jobs")
         return True
 
@@ -629,7 +630,7 @@ async def _process_terminating_run(ctx: ServerContext, row: sqlite3.Row) -> None
                     j["id"],
                 ),
             )
-    ctx.routing_cache.invalidate_run(row["run_name"])
+    await bump_routing_epoch(ctx, row["id"], row["run_name"], row["project_id"])
     if not all_finished:
         ctx.kick("terminating_jobs")
         return

@@ -482,7 +482,7 @@ async def _commit_provisioned_slice(
         )
         # The runs row is the run FSM's property; this processor only holds
         # the jobs claim, so take the run lock for the fleet_id backfill.
-        async with ctx.locker.lock_ctx("runs", [run_row["id"]]):
+        async with ctx.claims.lock_ctx("runs", [run_row["id"]]):
             await ctx.db.execute(
                 "UPDATE runs SET fleet_id = ? WHERE id = ?", (fleet_id, run_row["id"])
             )

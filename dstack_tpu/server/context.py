@@ -22,13 +22,23 @@ from dstack_tpu.server.services.locking import ClaimLocker, ResourceLocker
 
 class ServerContext:
     def __init__(self, db: Database, encryption: Optional[Encryption] = None):
+        from dstack_tpu.server import settings
+        from dstack_tpu.server.tracing import Tracer
+
         self.db = db
         self.locker = ResourceLocker()
+        # Per-server tracer (spans, errors, /debug/*): a process-global
+        # singleton would leak spans across the many apps a test process
+        # creates.
+        self.tracer = Tracer()
         # Cross-replica FSM claims (SKIP LOCKED equivalent): several server
         # replicas may share one file-backed DB; leases keep their
-        # background processors from double-driving a row.
-        self.replica_id = uuid.uuid4().hex[:12]
-        self.claims = ClaimLocker(db, self.replica_id, self.locker)
+        # background processors from double-driving a row. An operator-set
+        # DSTACK_TPU_REPLICA_ID pins the lease owner across restarts so a
+        # rebooted replica reclaims its own leases instead of waiting out
+        # its previous incarnation's TTL.
+        self.replica_id = settings.REPLICA_ID or uuid.uuid4().hex[:12]
+        self.claims = ClaimLocker(db, self.replica_id, self.locker, tracer=self.tracer)
         self.encryption = encryption or Encryption()
         self.backends: Dict[str, Any] = {}  # (project_id, type) -> Backend; see services/backends.py
         self.log_storage: Any = None  # set at startup; see services/logs.py
@@ -36,12 +46,6 @@ class ServerContext:
         from dstack_tpu.server.services.stats import ServiceStatsCollector
 
         self.service_stats = ServiceStatsCollector()
-        from dstack_tpu.server.tracing import Tracer
-
-        # Per-server tracer (spans, errors, /debug/*): a process-global
-        # singleton would leak spans across the many apps a test process
-        # creates.
-        self.tracer = Tracer()
         from dstack_tpu.server.services.spec_cache import SpecCache
 
         # Versioned parse cache shared by the FSM processors: memoizes the

@@ -45,8 +45,23 @@ _CONNECT_ERRORS = (httpx.ConnectError, httpx.ConnectTimeout)
 async def pick_replica(ctx, project_name: str, run_name: str, exclude=()) -> ReplicaTarget:
     """A RUNNING replica of the service, via the routing cache
     (least-outstanding, circuit-breaker aware)."""
-    targets = await ctx.routing_cache.get_replicas(ctx, project_name, run_name)
-    return ctx.routing_cache.select(project_name, run_name, targets, exclude=exclude)
+    target, _stale = await pick_replica_ex(ctx, project_name, run_name, exclude=exclude)
+    return target
+
+
+async def pick_replica_ex(
+    ctx, project_name: str, run_name: str, exclude=()
+) -> "tuple[ReplicaTarget, bool]":
+    """pick_replica plus the routing-cache staleness flag: True means the
+    control plane was unreachable and the target comes from the last-known
+    routes (surfaced to clients as `x-dstack-route-stale: 1`)."""
+    targets, stale = await ctx.routing_cache.get_replicas_ex(
+        ctx, project_name, run_name
+    )
+    return (
+        ctx.routing_cache.select(project_name, run_name, targets, exclude=exclude),
+        stale,
+    )
 
 
 def request_headers(request: Request):
@@ -88,7 +103,9 @@ async def proxy_service(request: Request, project_name: str, run_name: str, rest
     last_error = None
     for _ in range(attempts):
         try:
-            target = await pick_replica(ctx, project_name, run_name, exclude=tried)
+            target, stale = await pick_replica_ex(
+                ctx, project_name, run_name, exclude=tried
+            )
         except BadRequestError:
             if tried:
                 break  # every replica already failed this request -> 502
@@ -124,6 +141,11 @@ async def proxy_service(request: Request, project_name: str, run_name: str, rest
             k: v for k, v in upstream.headers.items()
             if k.lower() not in _HOP_HEADERS
         }
+        if stale:
+            # Route came from the last-known snapshot because the control
+            # plane was unreachable; clients that care (canaries, SLO
+            # probes) can tell a degraded-mode answer from a fresh one.
+            resp_headers["x-dstack-route-stale"] = "1"
         return Response(
             stream=_relay_body(ctx, upstream, base, target.job_id),
             status=upstream.status_code,

@@ -384,3 +384,19 @@ DROP TABLE run_events;
 ALTER TABLE runs DROP COLUMN trace_context;
 """,
 )
+
+# Migration 9: crash-safe cross-replica route invalidation. The FSM bumps
+# `routing_epoch` in the same transaction that changes a run's replica
+# topology (services/routing_events.py); data-plane workers poll the
+# epoch column (one indexed scan per poll interval, like the PR 3 spec
+# cache's version check) and drop their cached routes for any run whose
+# epoch moved — so a route is never served more than one poll interval
+# stale regardless of which replica mutated the run.
+migration(
+    """
+ALTER TABLE runs ADD COLUMN routing_epoch INTEGER NOT NULL DEFAULT 0;
+""",
+    down="""
+ALTER TABLE runs DROP COLUMN routing_epoch;
+""",
+)

@@ -82,12 +82,13 @@ class ClaimLocker:
     """
 
     def __init__(self, db, replica_id: str, local: ResourceLocker,
-                 ttl: Optional[float] = None):
+                 ttl: Optional[float] = None, tracer=None):
         import os
 
         self._db = db
         self.replica_id = replica_id
         self._local = local
+        self.tracer = tracer
         # TTL bounds how long a SIGKILLed replica's claims block the
         # survivors; env-tunable so restart drills (and latency-sensitive
         # deployments) can trade takeover speed against renewal traffic.
@@ -219,7 +220,17 @@ class ClaimLocker:
     async def _try_lease(self, namespace: str, key: str) -> bool:
         now = time.time()
 
-        def _claim(conn) -> bool:
+        def _claim(conn) -> Tuple[bool, bool]:
+            # Read the incumbent first so a successful steal of an expired
+            # foreign lease is distinguishable from a plain (re)acquire —
+            # that distinction is the takeover signal the replica-kill
+            # chaos drill asserts on via /metrics.
+            cur = conn.execute(
+                "SELECT owner, expires_at FROM resource_leases"
+                " WHERE namespace = ? AND key = ?",
+                (namespace, key),
+            )
+            prev = cur.fetchone()
             cur = conn.execute(
                 "INSERT INTO resource_leases (namespace, key, owner, expires_at)"
                 " VALUES (?, ?, ?, ?)"
@@ -229,6 +240,16 @@ class ClaimLocker:
                 "    OR resource_leases.expires_at <= ?",
                 (namespace, key, self.replica_id, now + self.ttl, now),
             )
-            return cur.rowcount == 1
+            won = cur.rowcount == 1
+            stolen = (
+                won
+                and prev is not None
+                and prev["owner"] != self.replica_id
+                and prev["expires_at"] <= now
+            )
+            return won, stolen
 
-        return await self._db.run_sync(_claim)
+        won, stolen = await self._db.run_sync(_claim)
+        if stolen and self.tracer is not None:
+            self.tracer.inc("lease_takeovers", namespace=namespace)
+        return won

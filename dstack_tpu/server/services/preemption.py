@@ -185,7 +185,7 @@ async def _drain_run(ctx: ServerContext, victim: dict) -> None:
     # This processor's FSM claim is on the REQUESTER's job row; the victim
     # run belongs to the run FSM, so its row is mutated only under an
     # explicit runs lock (LCK01 explicit-claim scope for this module).
-    async with ctx.locker.lock_ctx("runs", [vrow["id"]]):
+    async with ctx.claims.lock_ctx("runs", [vrow["id"]]):
         fresh = await ctx.db.fetchone(
             "SELECT resilience FROM runs WHERE id = ?", (vrow["id"],)
         )

@@ -10,12 +10,16 @@ so both lookups are cached here per process:
   cache and kept until TTL expiry or `invalidate_run()`;
 - the model list per project, same policy.
 
-`process_runs` / `process_running_jobs` call `invalidate_run(run_name)`
-on every job status transition, so the common case sees new/dead
-replicas on the very next request. The cache is PER PROCESS: with
-several server replicas sharing one DB, the FSM invalidation only
-reaches the process that stepped the job — the short TTL
-(`DSTACK_TPU_PROXY_ROUTING_TTL`) is the cross-replica staleness bound.
+`process_runs` / `process_running_jobs` call
+`services/routing_events.bump_routing_epoch` on every job status
+transition, which both invalidates this process's cache (keyed
+`(project, run)`) and bumps the run's `routing_epoch` column in the same
+transaction as the FSM write. The cache is PER PROCESS: other replicas
+and standalone data-plane workers observe the epoch bump through the
+poll loop in `dstack_tpu/dataplane`, so their staleness bound is one
+epoch-poll interval; the short in-server TTL
+(`DSTACK_TPU_PROXY_ROUTING_TTL`) remains the backstop for anything that
+does not poll.
 
 Selection upgrades the old module-global round-robin counter to
 per-run least-outstanding-requests (long SSE generations pin a replica;
@@ -63,38 +67,69 @@ class RoutingCache:
         # Thread lock for the same reason as SpecCache: /metrics stats
         # reads race the request path, and no guarded section awaits.
         self._lock = threading.Lock()
-        # (project, run) -> (expires_at, targets)
-        self._replicas: Dict[Tuple[str, str], Tuple[float, List[ReplicaTarget]]] = {}
-        # project -> (expires_at, model dicts)
-        self._models: Dict[str, Tuple[float, List[Dict[str, Any]]]] = {}
+        # (project, run) -> (expires_at, targets, project_id)
+        self._replicas: Dict[
+            Tuple[str, str], Tuple[float, List[ReplicaTarget], str]
+        ] = {}
+        # project -> (expires_at, model dicts, project_id)
+        self._models: Dict[str, Tuple[float, List[Dict[str, Any]], str]] = {}
+        # (project, run) -> last successfully loaded targets, never expired:
+        # served (flagged stale) when the control-plane DB is unreachable so
+        # a data-plane worker keeps routing live traffic through an outage.
+        self._fallback: Dict[Tuple[str, str], List[ReplicaTarget]] = {}
         self._outstanding: Dict[str, int] = {}  # job_id -> in-flight requests
         self._breaker: Dict[str, float] = {}  # job_id -> skip until (monotonic)
         self._rr: Dict[Tuple[str, str], int] = {}  # per-run tie-break rotation
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
+        self.stale_serves = 0
 
     # ------------------------------------------------------------- lookups
 
     async def get_replicas(
         self, ctx, project_name: str, run_name: str
     ) -> List[ReplicaTarget]:
+        targets, _stale = await self.get_replicas_ex(ctx, project_name, run_name)
+        return targets
+
+    async def get_replicas_ex(
+        self, ctx, project_name: str, run_name: str
+    ) -> Tuple[List[ReplicaTarget], bool]:
+        """Targets plus a staleness flag: True means the control plane was
+        unreachable and these are the last-known routes (the data-plane
+        worker surfaces that as an `x-dstack-route-stale` header)."""
         key = (project_name, run_name)
         now = time.monotonic()
         with self._lock:
             entry = self._replicas.get(key)
             if entry is not None and entry[0] > now:
                 self.hits += 1
-                return entry[1]
+                return entry[1], False
             self.misses += 1
-        targets = await self._load_replicas(ctx, project_name, run_name)
+        try:
+            targets, project_id = await self._load_replicas(
+                ctx, project_name, run_name
+            )
+        except (BadRequestError, ResourceNotExistsError):
+            # Authoritative control-plane answers (no such run, no running
+            # replicas) propagate — only infrastructure failures fall back.
+            raise
+        except Exception:
+            with self._lock:
+                fallback = self._fallback.get(key)
+                if fallback is not None:
+                    self.stale_serves += 1
+                    return fallback, True
+            raise
         with self._lock:
-            self._replicas[key] = (time.monotonic() + self.ttl, targets)
-        return targets
+            self._replicas[key] = (time.monotonic() + self.ttl, targets, project_id)
+            self._fallback[key] = targets
+        return targets, False
 
     async def _load_replicas(
         self, ctx, project_name: str, run_name: str
-    ) -> List[ReplicaTarget]:
+    ) -> Tuple[List[ReplicaTarget], str]:
         from dstack_tpu.models.runs import JobProvisioningData, JobSpec
 
         project_row = await ctx.db.fetchone(
@@ -136,7 +171,7 @@ class RoutingCache:
         # next request to see a replica the moment the FSM brings one up.
         if not targets:
             raise BadRequestError("No running replicas")
-        return targets
+        return targets, project_row["id"]
 
     async def get_models(self, ctx, project_name: str) -> List[Dict[str, Any]]:
         now = time.monotonic()
@@ -146,12 +181,18 @@ class RoutingCache:
                 self.hits += 1
                 return entry[1]
             self.misses += 1
-        models = await self._load_models(ctx, project_name)
+        models, project_id = await self._load_models(ctx, project_name)
         with self._lock:
-            self._models[project_name] = (time.monotonic() + self.ttl, models)
+            self._models[project_name] = (
+                time.monotonic() + self.ttl,
+                models,
+                project_id,
+            )
         return models
 
-    async def _load_models(self, ctx, project_name: str) -> List[Dict[str, Any]]:
+    async def _load_models(
+        self, ctx, project_name: str
+    ) -> Tuple[List[Dict[str, Any]], str]:
         import json
 
         project_row = await ctx.db.fetchone(
@@ -178,7 +219,7 @@ class RoutingCache:
                         "prefix": model.get("prefix", "/v1"),
                     }
                 )
-        return models
+        return models, project_row["id"]
 
     # ----------------------------------------------------------- selection
 
@@ -235,18 +276,40 @@ class RoutingCache:
 
     # --------------------------------------------------------- maintenance
 
-    def invalidate_run(self, run_name: str) -> None:
-        """FSM hook: a job of `run_name` changed status. Replica entries
-        for that run are dropped; the per-project model lists are dropped
-        wholesale (cheap — they rebuild in one query, and mapping run ->
-        project here would duplicate FSM state)."""
+    def invalidate_run(
+        self, run_name: str, project_id: Optional[str] = None
+    ) -> None:
+        """FSM/epoch hook: a job of `run_name` changed status. Replica
+        entries for that run are dropped, and the model list of the run's
+        project with it (it may list this run).
+
+        `project_id` scopes the drop: without it a same-named run in
+        another project would lose its (perfectly valid) routes and every
+        project's model list would rebuild. Callers that do not know the
+        project (legacy) still get the old clear-everything behavior."""
         with self._lock:
-            stale = [k for k in self._replicas if k[1] == run_name]
+            stale = [
+                k
+                for k, entry in self._replicas.items()
+                if k[1] == run_name
+                and (project_id is None or entry[2] == project_id)
+            ]
             for key in stale:
                 del self._replicas[key]
-            if stale or self._models:
+            if project_id is None:
+                dropped_models = bool(self._models)
+                self._models.clear()
+            else:
+                model_keys = [
+                    name
+                    for name, entry in self._models.items()
+                    if entry[2] == project_id
+                ]
+                for name in model_keys:
+                    del self._models[name]
+                dropped_models = bool(model_keys)
+            if stale or dropped_models:
                 self.invalidations += 1
-            self._models.clear()
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
@@ -259,5 +322,6 @@ class RoutingCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,
+                "stale_serves": self.stale_serves,
                 "hit_rate": (self.hits / total) if total else 0.0,
             }

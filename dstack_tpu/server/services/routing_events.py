@@ -1,0 +1,45 @@
+"""Cross-replica route invalidation: the `routing_epoch` protocol.
+
+Before PR 9, `process_runs` / `process_running_jobs` invalidated the
+routing cache purely in process — correct with one server, silently
+stale with several replicas or a standalone data-plane worker, because
+the replica that stepped a job is not the process serving its traffic.
+
+`bump_routing_epoch` is the single FSM hook now: it increments the run's
+`routing_epoch` column (migration 9) so every *other* process's epoch
+poller (`dstack_tpu/dataplane`) observes the change within one poll
+interval, and drops the local cache entry so *this* process routes
+correctly on the very next request. The column write is a monotonic
+counter — concurrent bumps from two replicas both land (`SET
+routing_epoch = routing_epoch + 1` under the row's claim), and a poller
+that misses an intermediate value still sees a changed epoch.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+async def bump_routing_epoch(
+    ctx, run_id: str, run_name: str, project_id: str
+) -> None:
+    """FSM transition hook: replica topology of `run_id` (may have)
+    changed. Safe to call for non-service runs — the epoch column is
+    maintained for every run, pollers only watch service runs."""
+    try:
+        await ctx.db.execute(
+            "UPDATE runs SET routing_epoch = routing_epoch + 1 WHERE id = ?",
+            (run_id,),
+        )
+    except Exception:
+        # The local invalidation below must still happen: serving a stale
+        # route locally because the epoch write failed would turn a DB
+        # hiccup into a routing error. Remote pollers fall back to their
+        # routing TTL for this transition.
+        logger.warning(
+            "routing_epoch bump failed for run %s; remote workers fall"
+            " back to TTL expiry for this transition",
+            run_id[:8],
+            exc_info=True,
+        )
+    ctx.routing_cache.invalidate_run(run_name, project_id=project_id)

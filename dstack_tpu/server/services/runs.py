@@ -273,7 +273,7 @@ async def submit_run(
             # allows resubmission under the same name). The run FSM owns
             # this row — take its lock and re-check the status under it,
             # or a concurrent retry transition could resurrect the run.
-            async with ctx.locker.lock_ctx("runs", [existing["id"]]):
+            async with ctx.claims.lock_ctx("runs", [existing["id"]]):
                 current = await ctx.db.fetchone(
                     "SELECT status FROM runs WHERE id = ? AND deleted = 0",
                     (existing["id"],),
@@ -497,7 +497,7 @@ async def stop_runs(
         # The FSM may be stepping this run right now; serialize with it
         # and re-read the status so a run that just finished is not
         # yanked back to terminating.
-        async with ctx.locker.lock_ctx("runs", [row["id"]]):
+        async with ctx.claims.lock_ctx("runs", [row["id"]]):
             current = await ctx.db.fetchone(
                 "SELECT status FROM runs WHERE id = ? AND deleted = 0", (row["id"],)
             )
@@ -521,7 +521,7 @@ async def delete_runs(ctx: ServerContext, project_id: str, run_names: List[str])
             raise ResourceNotExistsError(f"Run {run_name} does not exist")
         if not RunStatus(row["status"]).is_finished():
             raise ServerError(f"Run {run_name} is not finished")
-        async with ctx.locker.lock_ctx("runs", [row["id"]]):
+        async with ctx.claims.lock_ctx("runs", [row["id"]]):
             current = await ctx.db.fetchone(
                 "SELECT status FROM runs WHERE id = ? AND deleted = 0", (row["id"],)
             )

@@ -18,11 +18,20 @@ DEFAULT_PROJECT_NAME = "main"
 
 SERVER_ADMIN_TOKEN = os.getenv("DSTACK_TPU_SERVER_ADMIN_TOKEN")
 
+# Stable replica identity for a multi-replica control plane. When set, the
+# server pins its lease owner id to it (instead of a random per-boot id) and
+# MULTI_REPLICA is implied: naming a replica only makes sense in a topology
+# where a second one can exist.
+REPLICA_ID = os.getenv("DSTACK_TPU_REPLICA_ID") or None
+
 # Multiple server replicas sharing one database: enables the cross-process
 # lease rows (services/locking.py). Off by default — a single replica pays
 # two DB writes per FSM row-step for protection against replicas that do
 # not exist (measured: the largest write-lock load on the capacity probe).
-MULTI_REPLICA = os.getenv("DSTACK_TPU_MULTI_REPLICA", "").lower() in ("1", "true", "yes")
+MULTI_REPLICA = (
+    os.getenv("DSTACK_TPU_MULTI_REPLICA", "").lower() in ("1", "true", "yes")
+    or REPLICA_ID is not None
+)
 
 # Background processing capacity (reference: background/__init__.py:40-46
 # documents 150 active jobs/runs/instances per replica at 2-4s ticks; the
@@ -106,6 +115,16 @@ PROXY_ROUTING_TTL = float(os.getenv("DSTACK_TPU_PROXY_ROUTING_TTL", "3.0"))
 # How long a replica that just refused a connection is skipped by
 # selection (circuit breaker; it is retried once all replicas trip).
 PROXY_BREAKER_COOLDOWN = float(os.getenv("DSTACK_TPU_PROXY_BREAKER_COOLDOWN", "5.0"))
+
+# Standalone data-plane workers (dstack_tpu/dataplane). The epoch poll
+# interval is the route-staleness bound after an FSM transition on any
+# replica; the sync deadline caps how long one poll cycle retries the
+# control-plane DB (jittered backoff) before giving up until the next
+# tick. Routing TTL on a worker can be much longer than the in-server
+# default because epoch polling — not expiry — is the invalidation path.
+DATAPLANE_EPOCH_POLL = float(os.getenv("DSTACK_TPU_DATAPLANE_EPOCH_POLL", "1.0"))
+DATAPLANE_SYNC_DEADLINE = float(os.getenv("DSTACK_TPU_DATAPLANE_SYNC_DEADLINE", "5.0"))
+DATAPLANE_ROUTING_TTL = float(os.getenv("DSTACK_TPU_DATAPLANE_ROUTING_TTL", "30.0"))
 
 ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); identity if unset
 

@@ -1,5 +1,7 @@
-"""Known-bad fixture: LCK01 (unguarded FSM-table write) and LCK02
-(opposing cross-namespace acquisition orders)."""
+"""Known-bad fixture: LCK01 (unguarded FSM-table write), LCK02
+(opposing cross-namespace acquisition orders), and LCK03 (FSM-table
+write guarded only by the in-process lockset — invisible to sibling
+server replicas)."""
 
 
 async def rogue_update(ctx, run_id):
@@ -25,3 +27,13 @@ async def reconcile_job(ctx, run_id, job_id):
             await ctx.db.execute(
                 "UPDATE runs SET status = ? WHERE id = ?", ("pending", run_id)
             )
+
+
+async def resize_gang(ctx, run_id):
+    # LCK03: the in-process lock satisfies LCK01 but serializes nothing
+    # across replicas — a second server replica passes ITS local lock and
+    # double-writes the row. Must be ctx.claims.lock_ctx.
+    async with ctx.locker.lock_ctx("runs", [run_id]):
+        await ctx.db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?", ("resizing", run_id)
+        )

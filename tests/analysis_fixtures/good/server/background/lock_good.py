@@ -5,7 +5,9 @@ from dstack_tpu.server.background.concurrency import for_each_claimed
 
 
 async def stop_run(ctx, run_id):
-    async with ctx.locker.lock_ctx("runs", [run_id]):
+    # claims.lock_ctx: DB lease under MULTI_REPLICA, plain in-process
+    # lockset otherwise — the guard sibling replicas can see (LCK03).
+    async with ctx.claims.lock_ctx("runs", [run_id]):
         await ctx.db.execute(
             "UPDATE runs SET status = ? WHERE id = ?", ("stopping", run_id)
         )

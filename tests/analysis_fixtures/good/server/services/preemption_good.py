@@ -1,10 +1,11 @@
 """Known-good fixture: the explicit-claim rule is satisfied by a lexical
-`lock_ctx("runs")` around the cross-run write — the shape
-`server/services/preemption.py` itself uses."""
+`claims.lock_ctx("runs")` around the cross-run write — the shape
+`server/services/preemption.py` itself uses (DB lease under
+MULTI_REPLICA, so the guard is visible to sibling replicas)."""
 
 
 async def drain_victim(ctx, victim_id):
-    async with ctx.locker.lock_ctx("runs", [victim_id]):
+    async with ctx.claims.lock_ctx("runs", [victim_id]):
         await ctx.db.execute(
             "UPDATE runs SET resilience = '{}' WHERE id = ?", (victim_id,)
         )

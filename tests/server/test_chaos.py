@@ -84,7 +84,10 @@ async def test_maybe_inject_is_noop_without_engine():
 
 
 async def test_scenario_registry():
-    assert {"runner-flap", "hard-preempt", "preempt-resume"} <= set(list_scenarios())
+    assert {
+        "runner-flap", "hard-preempt", "preempt-resume",
+        "replica-kill-takeover", "dataplane-worker-kill", "dataplane-outage",
+    } <= set(list_scenarios())
     with pytest.raises(ValueError, match="unknown scenario"):
         await run_scenario("no-such-drill")
 

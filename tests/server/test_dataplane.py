@@ -1,0 +1,191 @@
+"""Data-plane worker: epoch sync, readiness gating, degraded serving.
+
+The chaos drills (`make chaos-worker-kill`, `make chaos-outage`) prove
+the failure stories with real processes; these are the fast tier-1
+versions: `sync_epochs` invalidation semantics driven directly, the
+`/healthz`-vs-`/readyz` split, and the stale-route header on a
+control-plane outage.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from dstack_tpu.dataplane.app import (
+    DataPlaneContext,
+    create_dataplane_app,
+    route_staleness_seconds,
+    sync_epochs,
+    sync_with_retries,
+)
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.http import Request, TestClient, response_json
+
+
+async def _seed(tmp_path, run_name="dp-svc", port=18080):
+    """Migrate a file DB and seed one RUNNING service, via a throwaway
+    control app (the data plane never writes the schema itself)."""
+    from dstack_tpu.chaos.scenarios import _seed_service_rows
+
+    db_path = tmp_path / "dataplane.db"
+    app = create_app(
+        db_path=str(db_path), admin_token="dp-admin", run_background_tasks=False,
+        server_config_path=str(tmp_path / "config.yml"),
+    )
+    await app.startup()
+    run_id = await _seed_service_rows(app.state["ctx"], run_name, port)
+    await app.shutdown()
+    return db_path, run_id
+
+
+class _DeadDB:
+    """Control-plane-down stand-in: every query raises."""
+
+    def __init__(self, real):
+        self._real = real
+
+    def __getattr__(self, name):
+        if name in ("fetchone", "fetchall", "execute", "executemany", "run_sync"):
+            async def _fail(*a, **k):
+                raise RuntimeError("control plane unreachable (test)")
+            return _fail
+        return getattr(self._real, name)
+
+
+async def test_sync_epochs_invalidates_on_bump_and_disappearance(tmp_path):
+    db_path, run_id = await _seed(tmp_path)
+    db = Database.from_url(str(db_path))
+    await db.connect()
+    try:
+        ctx = DataPlaneContext(db, poll_interval=0.05)
+        assert not ctx.synced_once
+        assert await sync_epochs(ctx) == 0  # baseline: nothing to invalidate
+        assert ctx.synced_once
+        assert list(ctx.epochs) == [run_id]
+        assert ctx.epochs[run_id][0] == 0
+
+        # Prime the routing cache, then move the epoch like
+        # bump_routing_epoch does on an FSM transition.
+        targets = await ctx.routing_cache.get_replicas(ctx, "main", "dp-svc")
+        assert len(targets) == 1
+        await db.execute(
+            "UPDATE runs SET routing_epoch = routing_epoch + 1 WHERE id = ?",
+            (run_id,),
+        )
+        assert await sync_epochs(ctx) == 1
+        assert ctx.epochs[run_id][0] == 1
+        assert ctx.routing_cache.stats()["replica_entries"] == 0
+
+        # A run the FSM tore down disappears from the poll entirely —
+        # that too must drop its routes.
+        await ctx.routing_cache.get_replicas(ctx, "main", "dp-svc")
+        await db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (run_id,))
+        assert await sync_epochs(ctx) == 1
+        assert ctx.epochs == {}
+        assert ctx.routing_cache.stats()["replica_entries"] == 0
+    finally:
+        await db.close()
+
+
+async def test_sync_with_retries_concedes_under_deadline(tmp_path):
+    db_path, _ = await _seed(tmp_path)
+    db = Database.from_url(str(db_path))
+    await db.connect()
+    try:
+        ctx = DataPlaneContext(db, poll_interval=0.05, sync_deadline=0.2)
+        ctx.db = _DeadDB(db)
+        assert not await sync_with_retries(ctx)
+        assert ctx.sync_failures > 0
+        assert not ctx.synced_once
+        # Recovery: the same call path succeeds once the DB answers.
+        ctx.db = db
+        assert await sync_with_retries(ctx)
+        assert ctx.synced_once
+    finally:
+        await db.close()
+
+
+async def test_staleness_gauge_tracks_missed_polls(tmp_path):
+    db_path, _ = await _seed(tmp_path)
+    db = Database.from_url(str(db_path))
+    await db.connect()
+    try:
+        ctx = DataPlaneContext(db, poll_interval=0.05)
+        assert route_staleness_seconds(ctx) == 0.0  # never synced: no claim
+        await sync_epochs(ctx)
+        assert route_staleness_seconds(ctx) == 0.0
+        await asyncio.sleep(0.12)  # two missed polls
+        assert route_staleness_seconds(ctx) > 0.0
+    finally:
+        await db.close()
+
+
+async def test_worker_app_readiness_and_degraded_serving(tmp_path):
+    # Real upstream so the proxied request has somewhere to land.
+    payload = b"dp-payload"
+
+    async def _handle(reader, writer):
+        try:
+            while True:
+                await reader.readuntil(b"\r\n\r\n")
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-length: %d\r\n\r\n" % len(payload)
+                    + payload
+                )
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    upstream = await asyncio.start_server(_handle, "127.0.0.1", 0)
+    uport = upstream.sockets[0].getsockname()[1]
+    db_path, _ = await _seed(tmp_path, port=uport)
+
+    app = create_dataplane_app(str(db_path), poll_interval=0.05, routing_ttl=0.1)
+    await app.startup()
+    ctx = app.state["ctx"]
+    client = TestClient(app)
+    try:
+        # Liveness is unconditional; readiness waits for the first sync.
+        resp = await client.get("/healthz")
+        assert resp.status == 200
+        deadline = asyncio.get_event_loop().time() + 10
+        while not ctx.synced_once:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        resp = await client.get("/readyz")
+        assert resp.status == 200
+        assert response_json(resp)["tracked_runs"] == 1
+
+        async def _get_data():
+            resp = await client.get("/proxy/services/main/dp-svc/data")
+            if resp.stream is not None:
+                chunks = []
+                async for c in resp.stream:
+                    chunks.append(c)
+                resp.body = b"".join(chunks)
+            return resp
+
+        resp = await _get_data()
+        assert resp.status == 200 and resp.body == payload
+        assert resp.headers.get("x-dstack-route-stale") is None
+
+        # Outage: routes expired + control plane unreachable -> serve the
+        # fallback snapshot, flagged, and stay ready.
+        ctx.db = _DeadDB(ctx.db)
+        await asyncio.sleep(0.15)  # past routing_ttl
+        resp = await _get_data()
+        assert resp.status == 200 and resp.body == payload
+        assert resp.headers.get("x-dstack-route-stale") == "1"
+        assert (await client.get("/readyz")).status == 200
+
+        resp = await client.get("/metrics")
+        text = resp.body.decode()
+        assert "dstack_tpu_dataplane_route_staleness_seconds" in text
+    finally:
+        await app.shutdown()
+        upstream.close()
+        await upstream.wait_closed()

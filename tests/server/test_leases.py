@@ -1,0 +1,172 @@
+"""DB lease lifecycle under a frozen clock.
+
+`ClaimLocker`'s distributed half is an expiring lease row per
+(namespace, key). The chaos drills prove the takeover story end to end
+with real processes and real time; these tests pin the exact boundary
+semantics with a controllable clock patched into the locking module:
+
+- heartbeat renewal (`renew_held`) pushes expiry forward, so a claim
+  held across a long operation survives many TTLs;
+- a foreign lease is stealable at exactly `t == expires_at` (expiry is
+  non-strict) and NOT one tick before;
+- two survivors racing for the same expired lease: exactly one wins
+  (the loser's UPSERT matches zero rows);
+- releasing a lease makes it immediately re-acquirable by anyone.
+"""
+
+import pytest
+
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services import locking as locking_mod
+from dstack_tpu.server.services.locking import ClaimLocker, ResourceLocker
+
+
+class _FrozenTime:
+    """Stand-in for the `time` module inside services/locking.py: the
+    clock only moves when a test advances it."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def time(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+@pytest.fixture(autouse=True)
+def _multi_replica_mode():
+    from dstack_tpu.server import settings
+
+    old = settings.MULTI_REPLICA
+    settings.MULTI_REPLICA = True
+    yield
+    settings.MULTI_REPLICA = old
+
+
+@pytest.fixture
+def clock(monkeypatch) -> _FrozenTime:
+    frozen = _FrozenTime()
+    monkeypatch.setattr(locking_mod, "time", frozen)
+    return frozen
+
+
+class _LeaseDb:
+    """Async fixtures aren't supported by the minimal test harness
+    (tests/conftest.py), so each test opens/closes the DB itself."""
+
+    def __init__(self, tmp_path):
+        self._path = str(tmp_path / "leases.db")
+        self.db = None
+
+    async def __aenter__(self) -> Database:
+        self.db = Database.from_url(self._path)
+        await self.db.connect()
+        return self.db
+
+    async def __aexit__(self, *exc) -> None:
+        await self.db.close()
+
+
+def _locker(db, replica_id: str, ttl: float = 10.0) -> ClaimLocker:
+    return ClaimLocker(db, replica_id, ResourceLocker(), ttl=ttl)
+
+
+async def _expiry(db, namespace: str, key: str) -> float:
+    row = await db.fetchone(
+        "SELECT owner, expires_at FROM resource_leases"
+        " WHERE namespace = ? AND key = ?",
+        (namespace, key),
+    )
+    assert row is not None
+    return row["expires_at"]
+
+
+async def test_heartbeat_renewal_extends_expiry(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _locker(db, "replica-a", ttl=10.0)
+        assert await a.try_claim("jobs", "j1")
+        assert await _expiry(db, "jobs", "j1") == clock.now + 10.0
+
+        # Hold the claim across 5 TTLs' worth of frozen time, renewing like
+        # the scheduler does. The lease must track the clock, never lapse.
+        for _ in range(10):
+            clock.advance(5.0)
+            await a.renew_held()
+            assert await _expiry(db, "jobs", "j1") == clock.now + 10.0
+            assert ("jobs", "j1") in a._held
+
+        # Another replica never gets a look-in while renewals land.
+        b = _locker(db, "replica-b", ttl=10.0)
+        assert not await b.try_claim("jobs", "j1")
+
+
+async def test_expiry_boundary_is_non_strict(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _locker(db, "replica-a", ttl=10.0)
+        b = _locker(db, "replica-b", ttl=10.0)
+        assert await a.try_claim("runs", "r1")
+        expires_at = await _expiry(db, "runs", "r1")
+
+        # One tick before expiry the lease is still owned: no steal.
+        clock.now = expires_at - 0.001
+        assert not await b.try_claim("runs", "r1")
+
+        # At exactly expires_at the lease is gone (expiry is `<=`): the
+        # takeover path must not stall one poll interval past a dead
+        # replica's TTL.
+        clock.now = expires_at
+        assert await b.try_claim("runs", "r1")
+        row = await db.fetchone(
+            "SELECT owner FROM resource_leases WHERE namespace = 'runs' AND key = 'r1'"
+        )
+        assert row["owner"] == "replica-b"
+
+        # The late incumbent's renewal finds its row gone and drops the key
+        # from the held set instead of pretending.
+        await a.renew_held()
+        assert ("runs", "r1") not in a._held
+
+
+async def test_takeover_race_single_winner(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        dead = _locker(db, "replica-dead", ttl=5.0)
+        assert await dead.try_claim("jobs", "j9")
+        clock.advance(5.0)  # lease now exactly expired
+
+        # Two survivors race the UPSERT for the same expired lease. sqlite
+        # serializes the writes; the second one's WHERE clause sees a live
+        # foreign lease and matches zero rows.
+        b = _locker(db, "replica-b", ttl=5.0)
+        c = _locker(db, "replica-c", ttl=5.0)
+        won_b = await b._try_lease("jobs", "j9")
+        won_c = await c._try_lease("jobs", "j9")
+        assert (won_b, won_c) == (True, False)
+        row = await db.fetchone(
+            "SELECT owner FROM resource_leases WHERE namespace = 'jobs' AND key = 'j9'"
+        )
+        assert row["owner"] == "replica-b"
+
+
+async def test_released_lease_immediately_reacquirable(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _locker(db, "replica-a", ttl=10.0)
+        b = _locker(db, "replica-b", ttl=10.0)
+        assert await a.try_claim("instances", "i1")
+        assert not await b.try_claim("instances", "i1")
+
+        await a.release("instances", "i1")
+        # No clock movement: release deletes the row, it does not wait out
+        # the TTL.
+        assert await b.try_claim("instances", "i1")
+        assert await _expiry(db, "instances", "i1") == clock.now + 10.0
+
+        # And release is owner-checked: a's stale release must not free b's
+        # fresh lease.
+        await a.release("instances", "i1")
+        row = await db.fetchone(
+            "SELECT owner FROM resource_leases"
+            " WHERE namespace = 'instances' AND key = 'i1'"
+        )
+        assert row is not None and row["owner"] == "replica-b"

@@ -43,7 +43,7 @@ def test_bad_fixtures_trip_every_checker():
     report = run_analysis([BAD], root=BAD)
     assert report.errors == []
     assert _codes(report) == [
-        "ASY01", "ASY02", "LCK01", "LCK02", "MET01", "POOL01", "SQL01",
+        "ASY01", "ASY02", "LCK01", "LCK02", "LCK03", "MET01", "POOL01", "SQL01",
     ]
     assert _keys(report, "POOL01") == ["httpx.AsyncClient"]
     assert _keys(report, "ASY01") == [".read_text", "requests.get", "time.sleep"]
@@ -53,6 +53,8 @@ def test_bad_fixtures_trip_every_checker():
     # scope ignores the fixed-point grant).
     assert _keys(report, "LCK01") == ["update:runs", "update:runs"]
     assert _keys(report, "LCK02") in (["jobs->runs"], ["runs->jobs"])
+    # The in-process-lock-only write in lock_bad.py::resize_gang.
+    assert _keys(report, "LCK03") == ["inproc:runs"]
     assert _keys(report, "SQL01") == [
         "dialect:INSERT OR REPLACE/IGNORE/ABORT",
         "interp:fetchone",
@@ -203,7 +205,7 @@ def test_cli_json_contract(capsys):
     assert payload["exit_code"] == 1
     assert payload["files_scanned"] == 7
     assert set(payload["checkers"]) >= {
-        "ASY01", "ASY02", "LCK01", "LCK02", "SQL01", "MET01", "POOL01",
+        "ASY01", "ASY02", "LCK01", "LCK02", "LCK03", "SQL01", "MET01", "POOL01",
     }
     sample = payload["findings"][0]
     assert {"code", "message", "path", "line", "fingerprint"} <= set(sample)
