@@ -64,7 +64,7 @@ bench-proxy:
 # Results land in BENCH_serving_r08.json; see
 # docs/guides/serving-tuning.md for how to read them.
 bench-serving:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r08.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r10.json
 
 # CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
 capacity-smoke:

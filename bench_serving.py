@@ -43,7 +43,20 @@ equivalent — the "more live slots in the same KV budget" claim). Every
 scenario now also reports the engine's prefix-cache hit rate and block
 pool occupancy.
 
-Writes BENCH_serving_r08.json (override with --out) and prints one JSON
+Round 10 adds draft-model speculative decoding: a high-acceptance arm
+(drafter = int8 of the target) and an adversarial arm (random-init
+drafter of the same shape) each run against a non-speculative baseline
+at the same steps_per_sync=1 sync cadence, reporting acceptance rate,
+accepted-tokens-per-target-step (every target forward — verify or plain
+step — emits exactly one non-draft token, so the metric is
+tokens / (tokens - accepted)), and the wall-clock tok/s ratio vs the
+baseline arm. r10 also carries the fix for r08's noted batch-1
+steps_per_sync=4 regression: the decode step now caches the gathered
+dense pool view across chunks and only re-gathers after a boundary that
+moved tables or wrote the pool outside the step (see
+r08_comparison_note and the 1-stream bf16/4 cell).
+
+Writes BENCH_serving_r10.json (override with --out) and prints one JSON
 line per scenario. Regression guard: tests/test_serving.py pins
 engine==one-shot decode numerics; this file pins the performance claim
 (continuous batching must show a multi-x aggregate over batch-1, TTFT
@@ -70,6 +83,12 @@ PROMPT_LEN = 64
 NEW_TOKENS = 128
 MAX_LEN = 512
 SLOTS = 16  # engine batch width; streams beyond this queue
+# Prompt tokens stay strictly inside the model's vocab (set in main()
+# from the chosen preset). Out-of-vocab ids silently clamp in the embed
+# take, collapsing every stream onto one embedding — timing-identical,
+# but it makes generated content degenerate, which fakes the spec arms'
+# acceptance (any drafter agrees on a fixed point).
+TOKEN_MOD = 30000
 
 
 def _drain_timed(q: "queue.Queue[object]", t0: float, n_expected: int) -> Dict:
@@ -100,7 +119,7 @@ def run_scenario(engine: ServingEngine, streams: int, retry: bool = False,
     prompt_len = PROMPT_LEN if prompt_len is None else prompt_len
     new_tokens = NEW_TOKENS if new_tokens is None else new_tokens
     prompts = [
-        [((i * 37 + j * 13) % 30000) + 1 for j in range(prompt_len)]
+        [((i * 37 + j * 13) % TOKEN_MOD) + 1 for j in range(prompt_len)]
         for i in range(streams)
     ]
     results: List[Dict] = [None] * streams  # type: ignore
@@ -205,10 +224,57 @@ def run_scenario(engine: ServingEngine, streams: int, retry: bool = False,
     return out
 
 
+def run_spec_scenario(engine: ServingEngine, streams: int,
+                      new_tokens: int = None) -> Dict:
+    """run_scenario plus the speculation columns diffed over the run.
+
+    `accepted_tokens_per_target_step` uses the identity that every
+    target forward pass — a (k+1)-wide verify or a plain decode step —
+    emits exactly ONE token that did not come from an accepted draft
+    (the bonus/correction token, or the plain step's sample): target
+    steps = emitted - accepted, so the metric is
+    emitted / (emitted - accepted). 1.0 = plain decode; the r10
+    acceptance bar is >= 1.5 on the high-acceptance arm."""
+    s0 = engine.stats()
+    out = run_scenario(engine, streams, new_tokens=new_tokens)
+    s1 = engine.stats()
+    proposed = (s1["spec_tokens_proposed_total"]
+                - s0["spec_tokens_proposed_total"])
+    accepted = (s1["spec_tokens_accepted_total"]
+                - s0["spec_tokens_accepted_total"])
+    # First token of each stream comes from prefill finalize, not a
+    # decode/verify step.
+    emitted = streams * (out["new_tokens"] - 1)
+    out.update({
+        "spec": {
+            "rounds": s1["spec_rounds_total"] - s0["spec_rounds_total"],
+            "fallback_rounds": (s1["spec_fallback_rounds_total"]
+                                - s0["spec_fallback_rounds_total"]),
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": round(accepted / proposed, 3)
+            if proposed else 0.0,
+            "accepted_tokens_per_target_step": round(
+                emitted / max(1, emitted - accepted), 2
+            ),
+            "draft_len_mean": s1["spec_draft_len_mean"],
+            "draft_seconds": round(
+                s1["spec_draft_seconds_total"]
+                - s0["spec_draft_seconds_total"], 3
+            ),
+            "verify_seconds": round(
+                s1["spec_verify_seconds_total"]
+                - s0["spec_verify_seconds_total"], 3
+            ),
+        },
+    })
+    return out
+
+
 def _shared_prefix_prompts(streams, prefix_len, suffix_len):
-    prefix = [((j * 31) % 30000) + 1 for j in range(prefix_len)]
+    prefix = [((j * 31) % TOKEN_MOD) + 1 for j in range(prefix_len)]
     return [
-        prefix + [((i * 7 + j * 3) % 30000) + 1 for j in range(suffix_len)]
+        prefix + [((i * 7 + j * 3) % TOKEN_MOD) + 1 for j in range(suffix_len)]
         for i in range(streams)
     ]
 
@@ -396,11 +462,13 @@ def run_warmed_burst_scenario(engine: ServingEngine, streams: int,
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="BENCH_serving_r08.json")
+    ap.add_argument("--out", default="BENCH_serving_r10.json")
     cli = ap.parse_args()
     on_tpu = jax.devices()[0].platform != "cpu"
     config = PRESETS["smol-1b"].with_(n_layers=8) if on_tpu else PRESETS["tiny"]
     stream_counts = (1, 8, 16, 32) if on_tpu else (1, 4)
+    global TOKEN_MOD
+    TOKEN_MOD = min(TOKEN_MOD, config.vocab_size - 2)
 
     params = init_params(config, jax.random.PRNGKey(0))
     from dstack_tpu.workloads.quant import quantize_params
@@ -541,6 +609,72 @@ def main() -> None:
     finally:
         engine.close()
 
+    # Speculative decoding (r10): each drafter arm runs against a plain
+    # baseline engine at the SAME steps_per_sync=1 cadence, so the tok/s
+    # ratio isolates speculation (draft scan + wide verify vs one step
+    # per token) from sync-batching effects. The int8 drafter is the
+    # deployment default (quantized copy of the target: high acceptance,
+    # ~half the weight reads); the random-init drafter is the worst
+    # case the adaptive draft length + whole-batch fallback must bound.
+    # These arms use a latency-oriented engine shape — slots sized to
+    # the stream counts, window sized to the request — not the big
+    # throughput engine above: speculation's win is per-token overhead
+    # (dispatch, per-step sync) amortized k+1 times per target forward,
+    # and padding every step out to 16 idle slots x 512-token views
+    # buries exactly that effect under dead-slot compute.
+    spec_streams = (1, 8) if on_tpu else (1, 4)
+    spec_slots = max(spec_streams)
+    spec_max_len = 224  # prompt 64 + 128 new + slack, block-aligned
+    baseline = {}
+    engine = ServingEngine(
+        config, params, slots=spec_slots, max_len=spec_max_len,
+        steps_per_sync=1,
+    )
+    try:
+        run_scenario(engine, 1)
+        run_scenario(engine, 1)
+        for n in spec_streams:
+            reps = 3 if n == 1 else 1
+            runs = sorted((run_scenario(engine, n) for _ in range(reps)),
+                          key=lambda r: r["agg_tok_s"])
+            s = {"dtype": "bf16", "steps_per_sync": 1, "arm": "no_spec",
+                 "slots": spec_slots, "max_len": spec_max_len,
+                 **runs[len(runs) // 2]}
+            baseline[n] = s["agg_tok_s"]
+            out["scenarios"].append(s)
+            print(json.dumps(s), flush=True)
+    finally:
+        engine.close()
+    drafters = [
+        ("spec_int8_drafter", quantize_params(params)),
+        ("spec_adversarial_drafter", init_params(config, jax.random.PRNGKey(9))),
+    ]
+    for arm, drafter in drafters:
+        engine = ServingEngine(
+            config, params, slots=spec_slots, max_len=spec_max_len,
+            steps_per_sync=1, spec_enable=True, spec_max_draft=4,
+            spec_draft_params=drafter, spec_draft_config=config,
+        )
+        try:
+            run_scenario(engine, 1)
+            run_scenario(engine, 1)
+            for n in spec_streams:
+                reps = 3 if n == 1 else 1
+                runs = sorted(
+                    (run_spec_scenario(engine, n) for _ in range(reps)),
+                    key=lambda r: r["agg_tok_s"],
+                )
+                s = {"dtype": "bf16", "steps_per_sync": 1, "arm": arm,
+                     "slots": spec_slots, "max_len": spec_max_len,
+                     **runs[len(runs) // 2]}
+                s["tok_s_vs_no_spec"] = round(
+                    s["agg_tok_s"] / baseline[n], 3
+                )
+                out["scenarios"].append(s)
+                print(json.dumps(s), flush=True)
+        finally:
+            engine.close()
+
     agg = {s["streams"]: s["agg_tok_s"] for s in out["scenarios"]
            if s.get("dtype") == "bf16" and s.get("steps_per_sync") == 4
            and "shape" not in s}
@@ -549,6 +683,42 @@ def main() -> None:
         print(f"# continuous batching: {out['batching_speedup']}x aggregate"
               f" over batch-1 ({max(agg.values()):.0f} vs {agg[1]:.0f} tok/s)",
               flush=True)
+    # r08 noted a ~-9% batch-1 cell at steps_per_sync=4 from re-gathering
+    # the dense pool view every chunk; the decode step now carries the
+    # view across chunks (re-gathering only after boundaries that moved
+    # tables or wrote the pool), so that cell should recover toward the
+    # steps_per_sync=32 number. Absolute tok/s is not comparable across
+    # sessions on a shared-CPU container (host load shifts every cell),
+    # so quantify with the WITHIN-RUN sps4/sps32 ratio: sps4 runs 8x
+    # more chunk boundaries per token, so the per-boundary gather cost
+    # is exactly what separates the two cells on the same run.
+    note = ("batch-1 steps_per_sync=4 paid a per-chunk dense-view gather"
+            " in r08; r10 caches the gathered view across chunks and"
+            " invalidates it only at boundaries that changed tables or"
+            " wrote the pool outside the step")
+
+    def _cell(art, sps):
+        return next(
+            s["agg_tok_s"] for s in art["scenarios"]
+            if s.get("dtype") == "bf16" and s.get("steps_per_sync") == sps
+            and s.get("streams") == 1 and "shape" not in s
+            and "arm" not in s
+        )
+    try:
+        with open("BENCH_serving_r08.json") as f:
+            r08 = json.load(f)
+        r08_ratio = _cell(r08, 4) / _cell(r08, 32)
+        r10_ratio = _cell(out, 4) / _cell(out, 32)
+        note += (f"; 1-stream bf16 sps4/sps32 ratio (machine-speed"
+                 f" invariant): r10 {r10_ratio:.3f} vs r08 {r08_ratio:.3f}"
+                 f" — the per-boundary cost gap"
+                 f" {'closed' if r10_ratio > r08_ratio else 'did not close'}"
+                 f" (absolute cells: r10 {_cell(out, 4)} tok/s vs r08"
+                 f" {_cell(r08, 4)}, but cross-session absolutes on a"
+                 " shared-CPU container track host load, not the code)")
+    except (OSError, StopIteration, KeyError, json.JSONDecodeError):
+        pass
+    out["r08_comparison_note"] = note
     with open(cli.out, "w") as f:
         json.dump(out, f, indent=1)
 

@@ -84,6 +84,17 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_prefix_tokens_reused_total": ("counter", ()),
     "dstack_tpu_serving_rejected_total": ("counter", ()),
     "dstack_tpu_serving_slots_active": ("gauge", ()),
+    # Speculative decoding (PR 10): draft/verify wall time, token fate
+    # counters, and the acceptance signals behind adaptive draft length.
+    "dstack_tpu_serving_spec_accept_rate_ewma": ("gauge", ()),
+    "dstack_tpu_serving_spec_draft_len_mean": ("gauge", ()),
+    "dstack_tpu_serving_spec_draft_seconds_total": ("counter", ()),
+    "dstack_tpu_serving_spec_fallback_rounds_total": ("counter", ()),
+    "dstack_tpu_serving_spec_rounds_total": ("counter", ()),
+    "dstack_tpu_serving_spec_tokens_accepted_total": ("counter", ()),
+    "dstack_tpu_serving_spec_tokens_proposed_total": ("counter", ()),
+    "dstack_tpu_serving_spec_tokens_rejected_total": ("counter", ()),
+    "dstack_tpu_serving_spec_verify_seconds_total": ("counter", ()),
     # Was a lone `_sum` counter with no `_count` partner (unscrapeable as
     # a summary); now a first-class histogram.
     "dstack_tpu_serving_ttft_seconds": ("histogram", ()),

@@ -123,8 +123,12 @@ def _nucleus_filter(logits: jnp.ndarray, top_p) -> jnp.ndarray:
     """Nucleus (top-p) filter over one row of logits: strict `<` on the
     PRECEDING cumulative mass, so the top token always survives and
     top_p=1 keeps everything. The single source of truth — the jitted
-    decode step vmaps this, and prefill first-token sampling calls it
-    directly, so the boundary rule cannot drift between them."""
+    decode step vmaps this, prefill first-token sampling calls it
+    directly, and speculative decoding's rejection sampling builds both
+    its target (p) and drafter (q) distributions through it
+    (kv_blocks._sampling_probs), so the boundary rule cannot drift
+    between any of them: distribution-exact speculation requires p and
+    q to share the exact filter semantics."""
     order = jnp.argsort(-logits)
     probs = jax.nn.softmax(logits[order])
     before = jnp.cumsum(probs) - probs

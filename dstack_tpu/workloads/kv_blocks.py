@@ -43,7 +43,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from dstack_tpu.workloads.config import ModelConfig
-from dstack_tpu.workloads.generate import _cached_attention, sample_logits_row
+from dstack_tpu.workloads.generate import (
+    _cached_attention,
+    _nucleus_filter,
+    sample_logits_row,
+)
 from dstack_tpu.workloads.transformer import (
     linear,
     logits_linear,
@@ -380,9 +384,9 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
 
 
 def make_paged_decode_step(config: ModelConfig, steps: int = 1):
-    """decode_step(params, state, rng) -> (state, tokens (B, steps),
-    active) over a PagedDecodeState — the paged twin of
-    serving.make_decode_step.
+    """decode_step(params, state, view_k, view_v, fresh, rng) ->
+    (state, view_k, view_v, tokens (B, steps), active) over a
+    PagedDecodeState — the paged twin of serving.make_decode_step.
 
     One gather materializes every slot's dense view from the pool, the
     dense decode body (`serving._decode_body` — the SAME traced function
@@ -393,6 +397,19 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1):
     land in distinct (block, offset) cells — slots own disjoint blocks —
     so the scatter has no collisions; lanes past a slot's final length
     (inactive or retired mid-chunk) are dropped via the OOB block index.
+
+    The dense view is additionally CARRIED across chunks: the caller
+    keeps the returned `view_k`/`view_v` (which include the chunk's new
+    rows — the scan wrote them) and passes them back with `fresh=False`
+    while no block table moved, so steady-state decode skips the
+    per-chunk whole-pool gather entirely (the bf16 steps_per_sync=4
+    single-stream regression in BENCH_serving_r08). Any event that
+    changes a table or writes the pool outside this program (prefill
+    chunk, CoW copy, table growth, spec round) must set `fresh=True` so
+    the next chunk re-gathers; `lax.cond` executes only the taken
+    branch, so a stale=False chunk never pays the gather. Peak memory is
+    unchanged — the non-cached variant materialized the same dense view
+    every chunk; it is merely kept alive between chunks now.
     """
     # Function-level import: serving imports this module at load time,
     # and engines construct only after both modules exist.
@@ -400,15 +417,21 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1):
 
     one_step = _serving._decode_body(config)
 
-    @functools.partial(jax.jit, donate_argnums=1)
-    def decode_steps(params, state: PagedDecodeState, rng):
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def decode_steps(params, state: PagedDecodeState, view_k, view_v,
+                     fresh, rng):
         L, nb, bs = state.k.shape[0], state.k.shape[1], state.k.shape[2]
         B, mb = state.block_tables.shape
         ml = mb * bs
-        dk = jnp.take(state.k, state.block_tables, axis=1, mode="clip")
-        dv = jnp.take(state.v, state.block_tables, axis=1, mode="clip")
-        dk = dk.reshape(L, B, ml, *state.k.shape[3:])
-        dv = dv.reshape(L, B, ml, *state.v.shape[3:])
+
+        def gather(_):
+            gk = jnp.take(state.k, state.block_tables, axis=1, mode="clip")
+            gv = jnp.take(state.v, state.block_tables, axis=1, mode="clip")
+            return (gk.reshape(L, B, ml, *state.k.shape[3:]),
+                    gv.reshape(L, B, ml, *state.v.shape[3:]))
+
+        dk, dv = lax.cond(fresh, gather, lambda _: (view_k, view_v),
+                          operand=None)
         dstate = _serving.DecodeState(
             k=dk, v=dv, lengths=state.lengths, last_token=state.last_token,
             active=state.active, remaining=state.remaining,
@@ -445,9 +468,323 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1):
             temperature=dstate.temperature,
             top_p=dstate.top_p,
         )
-        return new_state, toks.T, dstate.active
+        return new_state, dstate.k, dstate.v, toks.T, dstate.active
 
     return decode_steps
+
+
+# -- speculative decoding (draft k cheap tokens, verify in one forward) -------
+
+
+def _spec_attention(q, ck, cv, valid_len):
+    """`generate._cached_attention` with a PER-SLOT valid length: q
+    (B, S, H, hd) against dense views ck/cv (B, ml, KV, hd), where row i
+    of slot b may attend cache positions < valid_len[b, i]. The verify
+    forward needs this because every slot sits at a different length —
+    the (S,)-shaped mask of the chunk-prefill path assumes one slot."""
+    from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
+
+    b, s, h, hd = q.shape
+    n_rep = h // ck.shape[2]
+    k = _repeat_kv(ck, n_rep)
+    v = _repeat_kv(cv, n_rep)
+    scale = hd ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    kpos = jnp.arange(ck.shape[1], dtype=jnp.int32)
+    mask = kpos[None, None, :] < valid_len[:, :, None]      # (B, S, ml)
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype).reshape(b, s, h * hd)
+
+
+def _sampling_probs(logits, temps, top_ps):
+    """Per-slot sampling distributions under the ENGINE's semantics —
+    temperature scale guarded like `_decode_body._sample`, nucleus
+    filter via the shared `generate._nucleus_filter` (gated so all-
+    top_p=1 traffic never pays the vocab sort). logits (B, S, V), temps
+    / top_ps (B,) -> probs (B, S, V). Rejection sampling is exact only
+    if drafter q and target p both come from THIS function."""
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    filtered = lax.cond(
+        jnp.any((temps > 0.0) & (top_ps < 1.0)),
+        lambda s: jax.vmap(
+            lambda rows, tp: jax.vmap(
+                lambda r: _nucleus_filter(r, tp)
+            )(rows)
+        )(s, top_ps),
+        lambda s: s,
+        scaled,
+    )
+    return jax.nn.softmax(filtered, axis=-1)
+
+
+def make_spec_draft(config: ModelConfig, k: int):
+    """spec_draft(params, draft_k, draft_v, block_tables, lengths,
+    last_token, active, temps, top_ps, rng) ->
+    (draft_k', draft_v', drafts (B, k), qlogits (B, k, V)).
+
+    The drafter's half of a speculation round: gather each slot's dense
+    view from the DRAFTER pool (same block tables as the target — the
+    two pools are indexed by one allocator, so prefix sharing and CoW
+    decisions apply to both), run k+1 single-token drafter steps, and
+    scatter the k+1 new rows back. Step i feeds the previous token at
+    position lengths+i and proposes the next, so steps 0..k-1 yield
+    drafts d_1..d_k; step k's sampled token is discarded but its KV
+    write (row lengths+k, the KV of d_k) is what lets a fully accepted
+    round continue without a catch-up pass — the drafter's valid rows
+    always cover the target's new length, for ANY acceptance count.
+
+    `qlogits` are the drafter's logits behind each draft: the verifier
+    recomputes q(:) from them with the same `_sampling_probs` so the
+    accept test u < p/q and the residual distribution max(p-q, 0) are
+    exact (arXiv:2211.17192). Rows for inactive slots are never
+    scattered (their device table rows may be stale — the blocks could
+    have been freed to the cache or another slot at retire)."""
+    c = config
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def spec_draft(params, draft_k, draft_v, block_tables, lengths,
+                   last_token, active, temps, top_ps, rng):
+        L, nb, bs = draft_k.shape[0], draft_k.shape[1], draft_k.shape[2]
+        B, mb = block_tables.shape
+        ml = mb * bs
+        dk = jnp.take(draft_k, block_tables, axis=1, mode="clip")
+        dv = jnp.take(draft_v, block_tables, axis=1, mode="clip")
+        dk = dk.reshape(L, B, ml, *draft_k.shape[3:])
+        dv = dv.reshape(L, B, ml, *draft_v.shape[3:])
+        rows = jnp.arange(B)
+
+        def one(carry, step_rng):
+            dk, dv, pos, token = carry          # pos (B,), token (B,)
+            x = jnp.take(params["embed"], token[:, None], axis=0)
+            write_rows = jnp.where(active & (pos < ml), pos, ml)
+
+            def body(x, layer):
+                p, ck, cv = layer               # ck (B, ml, KV, hd)
+                q, kk, vv = project_qkv(c, x, p, pos[:, None])
+                ck = ck.at[rows, write_rows].set(
+                    kk[:, 0].astype(ck.dtype), mode="drop"
+                )
+                cv = cv.at[rows, write_rows].set(
+                    vv[:, 0].astype(cv.dtype), mode="drop"
+                )
+                attn = _spec_attention(q, ck, cv, pos[:, None] + 1)
+                x = x + linear(attn, p["wo"])
+                if c.n_experts > 0:
+                    from dstack_tpu.workloads.moe import moe_block
+
+                    x, _ = moe_block(c, x, p)
+                else:
+                    x = mlp_block(c, x, p)
+                return x, (ck, cv)
+
+            x, (dk, dv) = lax.scan(body, x, (params["layers"], dk, dv))
+            h = rms_norm(x, params["final_norm"], c.norm_eps)
+            logits = logits_linear(h[:, -1], params["lm_head"])  # (B, V)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            probs = _sampling_probs(logits[:, None], temps, top_ps)[:, 0]
+            sampled = jax.random.categorical(
+                step_rng, jnp.log(jnp.maximum(probs, 1e-38)), axis=-1
+            ).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            return (dk, dv, pos + 1, nxt), (nxt, logits)
+
+        (dk, dv, _, _), (toks, qlogits) = lax.scan(
+            one, (dk, dv, lengths, last_token), jax.random.split(rng, k + 1)
+        )
+        drafts = toks[:k].T                         # (B, k): d_1..d_k
+        qlogits = jnp.moveaxis(qlogits[:k], 0, 1)   # (B, k, V)
+
+        # Scatter the k+1 new rows back to the drafter pool (active
+        # slots only — see docstring).
+        pos = lengths[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        ok = active[:, None] & (pos < ml)
+        blk = jnp.take_along_axis(
+            block_tables, jnp.clip(pos // bs, 0, mb - 1), axis=1
+        )
+        blk = jnp.where(ok, blk, nb)
+        off = pos % bs
+        cp = jnp.clip(pos, 0, ml - 1)[None, :, :, None, None]
+        rows_k = jnp.take_along_axis(dk, cp, axis=2)
+        rows_v = jnp.take_along_axis(dv, cp, axis=2)
+        new_k = draft_k.at[:, blk, off].set(rows_k, mode="drop")
+        new_v = draft_v.at[:, blk, off].set(rows_v, mode="drop")
+        return new_k, new_v, drafts, qlogits
+
+    return spec_draft
+
+
+def make_spec_verify(config: ModelConfig, k: int):
+    """spec_verify(params, state, drafts (B, k), qlogits (B, k, V), rng)
+    -> (state', emitted (B, k+1), accepted (B,), active (B,)).
+
+    The target's half of a speculation round, shaped like a chunked
+    prefill over every slot at once: feed [last_token, d_1..d_k] at
+    positions lengths..lengths+k, write the k+1 rows into each slot's
+    gathered dense view, attend with per-slot valid lengths, and score
+    all k+1 positions in ONE forward — logits[:, j] conditions on the
+    drafts up to d_j exactly as the sequential decode body would.
+
+    Acceptance per slot: greedy slots (temp 0) accept the leading run
+    of drafts matching the target argmax — bit-exact with non-
+    speculative decode by construction; sampling slots run rejection
+    sampling (accept d_j iff u_j < p_j(d_j) / q_j(d_j), correction
+    token from the residual norm(max(p-q, 0)), bonus token from p_k
+    when everything accepts), which preserves the target distribution
+    exactly. Emission caps (`remaining` budget, cache capacity) and the
+    retire conditions replicate `_decode_body`'s, so a speculative slot
+    stops on exactly the token the plain path would have stopped on.
+
+    ROLLBACK IS BY CONSTRUCTION: only rows < the new length (the
+    accepted prefix + correction) are scattered to the pool — rejected
+    positions never reach it, so refcounted / cache-published blocks
+    cannot be corrupted by a failed speculation and lengths never
+    over-advance. `accepted` is the UNCAPPED accepted-draft count m
+    (for the engine's acceptance EWMAs); `emitted` rows use the decode
+    path's -1 padding convention so the engine's fan-out is shared."""
+    c = config
+    S = k + 1
+
+    @functools.partial(jax.jit, donate_argnums=1)
+    def spec_verify(params, state: PagedDecodeState, drafts, qlogits, rng):
+        L, nb, bs = state.k.shape[0], state.k.shape[1], state.k.shape[2]
+        B, mb = state.block_tables.shape
+        ml = mb * bs
+        lens = state.lengths
+        act0 = state.active
+        offs = jnp.arange(S, dtype=jnp.int32)
+        tokens = jnp.concatenate([state.last_token[:, None], drafts], axis=1)
+        positions = lens[:, None] + offs[None, :]            # (B, S)
+        write_rows = jnp.where(positions < ml, positions, ml)
+        batch_rows = jnp.arange(B)[:, None]
+
+        dk = jnp.take(state.k, state.block_tables, axis=1, mode="clip")
+        dv = jnp.take(state.v, state.block_tables, axis=1, mode="clip")
+        dk = dk.reshape(L, B, ml, *state.k.shape[3:])
+        dv = dv.reshape(L, B, ml, *state.v.shape[3:])
+
+        x = jnp.take(params["embed"], tokens, axis=0)        # (B, S, d)
+
+        def body(x, layer):
+            p, ck, cv = layer                                # ck (B, ml, ...)
+            q, kk, vv = project_qkv(c, x, p, positions)
+            ck = ck.at[batch_rows, write_rows].set(
+                kk.astype(ck.dtype), mode="drop"
+            )
+            cv = cv.at[batch_rows, write_rows].set(
+                vv.astype(cv.dtype), mode="drop"
+            )
+            attn = _spec_attention(q, ck, cv, positions + 1)
+            x = x + linear(attn, p["wo"])
+            if c.n_experts > 0:
+                from dstack_tpu.workloads.moe import moe_block
+
+                x, _ = moe_block(c, x, p)
+            else:
+                x = mlp_block(c, x, p)
+            # Keep the chunk's new rows as scan outputs: the pool
+            # scatter happens AFTER acceptance is known, so rejected
+            # rows are simply never written.
+            new_rows_k = jnp.take_along_axis(
+                ck, jnp.clip(positions, 0, ml - 1)[:, :, None, None], axis=1
+            )
+            new_rows_v = jnp.take_along_axis(
+                cv, jnp.clip(positions, 0, ml - 1)[:, :, None, None], axis=1
+            )
+            return x, (new_rows_k, new_rows_v)
+
+        x, (rows_k, rows_v) = lax.scan(body, x, (params["layers"], dk, dv))
+        h = rms_norm(x, params["final_norm"], c.norm_eps)
+        logits = logits_linear(h, params["lm_head"])         # (B, S, V)
+
+        temps = state.temperature
+        samp = temps > 0
+        greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, S)
+        greedy_ok = greedy_tok[:, :k] == drafts                      # (B, k)
+
+        r_u, r_bonus = jax.random.split(rng)
+        p_probs = _sampling_probs(logits, temps, state.top_p)        # (B, S, V)
+        q_probs = _sampling_probs(qlogits, temps, state.top_p)       # (B, k, V)
+        p_at = jnp.take_along_axis(
+            p_probs[:, :k], drafts[:, :, None], axis=2
+        )[:, :, 0]
+        q_at = jnp.take_along_axis(q_probs, drafts[:, :, None], axis=2)[:, :, 0]
+        u = jax.random.uniform(r_u, (B, k))
+        samp_ok = u * q_at < p_at                # u < p/q without the divide
+        ok = jnp.where(samp[:, None], samp_ok, greedy_ok)
+        m = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)  # (B,)
+
+        # Correction / bonus token at index m: argmax for greedy slots;
+        # for sampling slots the residual max(p_m - q_m, 0) normalized
+        # (q padded with a zero row at index k, so a fully accepted run
+        # falls back to sampling the bonus straight from p_k).
+        p_m = jnp.take_along_axis(p_probs, m[:, None, None], axis=1)[:, 0]
+        q_pad = jnp.concatenate(
+            [q_probs, jnp.zeros_like(q_probs[:, :1])], axis=1
+        )
+        q_m = jnp.take_along_axis(q_pad, m[:, None, None], axis=1)[:, 0]
+        resid = jnp.maximum(p_m - q_m, 0.0)
+        r_sum = jnp.sum(resid, axis=-1, keepdims=True)
+        resid = jnp.where(r_sum > 0, resid / jnp.maximum(r_sum, 1e-38), p_m)
+        bonus_samp = jax.random.categorical(
+            r_bonus, jnp.log(jnp.maximum(resid, 1e-38)), axis=-1
+        ).astype(jnp.int32)
+        bonus_greedy = jnp.take_along_axis(
+            greedy_tok, m[:, None], axis=1
+        )[:, 0]
+        bonus = jnp.where(samp, bonus_samp, bonus_greedy)
+
+        # Emission mirrors _decode_body's stop rules: at most `remaining`
+        # tokens, and never past cache row ml-2 (the next round's write
+        # must still fit).
+        cap = jnp.maximum(ml - 1 - lens, 0)
+        n_emit = jnp.where(
+            act0,
+            jnp.minimum(jnp.minimum(m + 1, state.remaining), cap),
+            0,
+        )
+        seq = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+        )                                            # (B, S): d_1..d_k, _
+        seq = jnp.where(offs[None, :] == m[:, None], bonus[:, None], seq)
+        emitted = jnp.where(offs[None, :] < n_emit[:, None], seq, -1)
+
+        new_len = lens + n_emit
+        new_rem = state.remaining - n_emit
+        new_act = act0 & (new_rem > 0) & (new_len + 2 <= ml)
+        last_emitted = jnp.take_along_axis(
+            emitted, jnp.clip(n_emit - 1, 0, k)[:, None], axis=1
+        )[:, 0]
+        new_last = jnp.where(n_emit > 0, last_emitted, state.last_token)
+
+        # Pool scatter of ONLY the accepted region (rows lens..new_len-1
+        # hold the KV of last_token, d_1..d_{n_emit-1}).
+        ok_write = (offs[None, :] < n_emit[:, None]) & (positions < ml)
+        blk = jnp.take_along_axis(
+            state.block_tables, jnp.clip(positions // bs, 0, mb - 1), axis=1
+        )
+        blk = jnp.where(ok_write, blk, nb)
+        off = positions % bs
+        new_state = PagedDecodeState(
+            k=state.k.at[:, blk, off].set(rows_k, mode="drop"),
+            v=state.v.at[:, blk, off].set(rows_v, mode="drop"),
+            block_tables=state.block_tables,
+            lengths=new_len,
+            last_token=new_last,
+            active=new_act,
+            remaining=new_rem,
+            temperature=state.temperature,
+            top_p=state.top_p,
+        )
+        accepted = jnp.where(act0, m, 0)
+        return new_state, emitted, accepted, new_act
+
+    return spec_verify
 
 
 def make_copy_block():

@@ -66,7 +66,10 @@ from dstack_tpu.workloads.kv_blocks import (
     make_chunk_prefill,
     make_copy_block,
     make_paged_decode_step,
+    make_spec_draft,
+    make_spec_verify,
 )
+from dstack_tpu.workloads.quant import quantize_params
 from dstack_tpu.workloads.transformer import (
     linear,
     logits_linear,
@@ -388,6 +391,12 @@ class ServingEngine:
         kv_block_size: int = 16,
         kv_pool_blocks: Optional[int] = None,
         prefix_cache: bool = True,
+        spec_enable: bool = False,
+        spec_max_draft: int = 4,
+        spec_draft_params: Optional[Params] = None,
+        spec_draft_config: Optional[ModelConfig] = None,
+        spec_min_accept: float = 0.3,
+        kv_budget_bytes: Optional[int] = None,
     ):
         self.config = config
         self.params = params
@@ -435,6 +444,100 @@ class ServingEngine:
         self._chunk_cache: Dict[int, Any] = {}
         self._step = make_paged_decode_step(config, steps=steps_per_sync)
         self._copy_block = make_copy_block()
+        # -- speculative decoding (drafter proposes k, target verifies
+        # k+1 in one forward; see kv_blocks.make_spec_draft/_verify).
+        self._spec = bool(spec_enable)
+        if spec_max_draft < 1:
+            raise ValueError(
+                f"spec_max_draft must be >= 1, got {spec_max_draft}"
+            )
+        self._spec_max_draft = spec_max_draft
+        self._spec_min_accept = spec_min_accept
+
+        def _pool_bytes(cfg: ModelConfig) -> int:
+            row = 2 * cfg.n_kv_heads * cfg.head_dim  # k + v
+            return (cfg.n_layers * self._num_blocks * kv_block_size * row
+                    * jnp.dtype(cfg.activation_dtype).itemsize)
+
+        self._draft_config = spec_draft_config or config
+        # Exposed so deployment surfaces (and tests) can size
+        # kv_budget_bytes against the actual pool footprint.
+        self._pool_bytes_target = _pool_bytes(config)
+        if self._spec:
+            if self._draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    "drafter vocab_size"
+                    f" {self._draft_config.vocab_size} must match the"
+                    f" target's {config.vocab_size} (one tokenizer)"
+                )
+            # The drafter must cover as much of the engine window as
+            # the target does (the target may itself run a max_len
+            # beyond its preset's max_seq_len — RoPE extrapolation —
+            # and then the drafter only has to match that coverage).
+            target_cover = min(self.max_len, config.max_seq_len)
+            if self._draft_config.max_seq_len < target_cover:
+                raise ValueError(
+                    f"drafter max_seq_len {self._draft_config.max_seq_len}"
+                    f" must cover the engine window {target_cover}"
+                    f" (min of engine max_len {self.max_len} and target"
+                    f" max_seq_len {config.max_seq_len})"
+                )
+        if kv_budget_bytes is not None:
+            need_bytes = _pool_bytes(config)
+            if self._spec:
+                need_bytes += _pool_bytes(self._draft_config)
+            if need_bytes > kv_budget_bytes:
+                what = ("a drafter KV pool alongside the target pool"
+                        if self._spec else "the KV pool")
+                raise ValueError(
+                    f"cannot fit {what}: {need_bytes} bytes needed but"
+                    f" kv_budget_bytes is {kv_budget_bytes}"
+                    + (" (disable speculation or shrink the pool)"
+                       if self._spec else "")
+                )
+        if self._spec:
+            # Default drafter: weight-only int8 of the target — same
+            # tree shape (QTensor leaves dispatch in transformer.linear)
+            # so every jitted program runs unchanged.
+            self._draft_params = (
+                spec_draft_params if spec_draft_params is not None
+                else quantize_params(params)
+            )
+            # The drafter pool mirrors the target pool's GEOMETRY
+            # (num_blocks x block_size) and is indexed through the SAME
+            # block tables: one allocator drives both, so prefix
+            # sharing, CoW and eviction decisions stay coherent across
+            # the two models. Its own table/scalar fields are unused.
+            self._draft_state = init_paged_state(
+                self._draft_config, slots, self.max_len, kv_block_size,
+                self._num_blocks,
+            )
+            self._copy_draft_block = make_copy_block()
+            self._draft_chunk_cache: Dict[int, Any] = {}
+            self._spec_draft_fns: Dict[int, Any] = {}
+            self._spec_verify_fns: Dict[int, Any] = {}
+        # Per-slot adaptive draft length: starts mid, grows toward
+        # spec_max_draft while the slot's acceptance EWMA stays high,
+        # shrinks toward 1 when it drops. None EWMA = unseeded.
+        self._spec_init_k = min(2, spec_max_draft)
+        self._slot_k: List[int] = [self._spec_init_k] * slots
+        self._accept_ewma: List[Optional[float]] = [None] * slots
+        self._spec_accept_ewma = 0.0      # batch mean (stats gauge)
+        self._spec_tokens_round_ewma = 0.0  # emitted tokens per round
+        self._spec_rounds = 0
+        self._spec_fallback_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rejected = 0
+        self._t_spec_draft = 0.0
+        self._t_spec_verify = 0.0
+        # Whole-batch fallback: after `_spec_low_streak` consecutive
+        # rounds with batch-mean acceptance below spec_min_accept, run
+        # plain decode chunks for `_SPEC_COOLDOWN` boundaries, then
+        # re-probe at k=1 — bounding the adversarial-drafter loss to
+        # the probe rounds' overhead.
+        self._spec_low_streak = 0
+        self._spec_cooldown = 0
         # Per-row table push with fixed shapes ((slots, max_blocks) +
         # scalar + (max_blocks,)): one compile ever, hit during warmup.
         # A batched .at[slots].set(rows) would recompile per
@@ -445,9 +548,23 @@ class ServingEngine:
         )
         self._temperature = temperature
         self._rng = jax.random.PRNGKey(seed)
+        # Separate drafter stream: at temperature 0 both paths are
+        # greedy (rng unused), so keeping the target's stream untouched
+        # is what makes spec-on output bit-identical to spec-off.
+        self._rng_draft = jax.random.PRNGKey(seed + 0x5bec)
         self.state = init_paged_state(
             config, slots, self.max_len, kv_block_size, self._num_blocks
         )
+        # Carried dense view for the decode step (kv_blocks.
+        # make_paged_decode_step): while no block table moves and no
+        # program outside the decode step writes the pool, chunks skip
+        # the whole-pool re-gather (the r08 bf16 steps_per_sync=4
+        # single-stream regression). Any such event sets _view_fresh.
+        c = config
+        vshape = (c.n_layers, slots, self.max_len, c.n_kv_heads, c.head_dim)
+        self._view_k = jnp.zeros(vshape, c.activation_dtype)
+        self._view_v = jnp.zeros(vshape, c.activation_dtype)
+        self._view_fresh = True
         # Admission control: None = unbounded (library embedding decides);
         # servers should bound it — see EngineOverloadedError.
         self.max_pending = max_pending
@@ -705,6 +822,27 @@ class ServingEngine:
             # Bucketed TTFT ({"buckets": [(le, cumulative)...], "sum",
             # "count"}) — prometheus_metrics renders the histogram series.
             "ttft_hist": self._ttft_hist.to_dict(),
+            # Speculative decoding: per-round draft/verify wall time,
+            # token fate counters (proposed = accepted + rejected; the
+            # bonus/correction token the target emits each round is NOT
+            # counted as proposed), and the acceptance EWMAs that drive
+            # per-slot draft-length adaptation and whole-batch fallback.
+            "spec_enabled": self._spec,
+            "spec_max_draft": self._spec_max_draft,
+            "spec_rounds_total": self._spec_rounds,
+            "spec_fallback_rounds_total": self._spec_fallback_rounds,
+            "spec_tokens_proposed_total": self._spec_proposed,
+            "spec_tokens_accepted_total": self._spec_accepted,
+            "spec_tokens_rejected_total": self._spec_rejected,
+            "spec_accept_rate_ewma": round(self._spec_accept_ewma, 4),
+            "spec_tokens_per_round_ewma": round(
+                self._spec_tokens_round_ewma, 4
+            ),
+            "spec_draft_len_mean": round(
+                sum(self._slot_k) / len(self._slot_k), 4
+            ) if self._slot_k else 0.0,
+            "spec_draft_seconds_total": round(self._t_spec_draft, 4),
+            "spec_verify_seconds_total": round(self._t_spec_verify, 4),
         }
 
     def close(self) -> None:
@@ -757,6 +895,29 @@ class ServingEngine:
             self._chunk_cache[n_padded] = fn
         return fn
 
+    def _draft_chunk_fn(self, n_padded: int):
+        """Drafter twin of _chunk_fn (the drafter config compiles its
+        own bucket entries)."""
+        fn = self._draft_chunk_cache.get(n_padded)
+        if fn is None:
+            fn = make_chunk_prefill(self._draft_config, n_padded)
+            self._draft_chunk_cache[n_padded] = fn
+        return fn
+
+    def _spec_draft_fn(self, k: int):
+        fn = self._spec_draft_fns.get(k)
+        if fn is None:
+            fn = make_spec_draft(self._draft_config, k)
+            self._spec_draft_fns[k] = fn
+        return fn
+
+    def _spec_verify_fn(self, k: int):
+        fn = self._spec_verify_fns.get(k)
+        if fn is None:
+            fn = make_spec_verify(self.config, k)
+            self._spec_verify_fns[k] = fn
+        return fn
+
     def _pad_chunk(self, n: int) -> int:
         """Pow-2 bucket (min 8) capped at the chunk budget, so compile
         entries stay O(log prefill_chunk_tokens)."""
@@ -801,11 +962,16 @@ class ServingEngine:
                     if b is None:
                         return False
                     if needs_copy:
-                        self.state = self._copy_block(
-                            self.state,
-                            jnp.asarray(task.table[idx], jnp.int32),
-                            jnp.asarray(b, jnp.int32),
-                        )
+                        src = jnp.asarray(task.table[idx], jnp.int32)
+                        dst = jnp.asarray(b, jnp.int32)
+                        self.state = self._copy_block(self.state, src, dst)
+                        if self._spec:
+                            # One allocator, two pools: the drafter's
+                            # copy of the shared block moves with it.
+                            self._draft_state = self._copy_draft_block(
+                                self._draft_state, src, dst
+                            )
+                        self._view_fresh = True
                         task.table[idx] = b
                 else:
                     b = self._alloc.alloc()
@@ -874,9 +1040,7 @@ class ServingEngine:
             n_padded = self._pad_chunk(n)
             chunk = task.req.tokens[task.pos:task.pos + n]
             self._rng, sub = jax.random.split(self._rng)
-            self.state, first = self._chunk_fn(n_padded)(
-                self.params,
-                self.state,
+            chunk_args = (
                 jnp.asarray(task.slot, jnp.int32),
                 jnp.asarray(self._pad_table(task.table), jnp.int32),
                 jnp.asarray([chunk + [0] * (n_padded - n)], jnp.int32),
@@ -885,9 +1049,21 @@ class ServingEngine:
                 jnp.asarray(task.req.max_new_tokens, jnp.int32),
                 jnp.asarray(task.req.temperature, jnp.float32),
                 jnp.asarray(task.req.top_p, jnp.float32),
-                sub,
+            )
+            self.state, first = self._chunk_fn(n_padded)(
+                self.params, self.state, *chunk_args, sub,
                 jnp.asarray(final, bool),
             )
+            self._view_fresh = True
+            if self._spec:
+                # The drafter prefills the same chunk into ITS pool
+                # through the same table — prefix-cache hits skip both
+                # models' prefill identically (same task.pos start).
+                self._rng_draft, dsub = jax.random.split(self._rng_draft)
+                self._draft_state, _ = self._draft_chunk_fn(n_padded)(
+                    self._draft_params, self._draft_state, *chunk_args,
+                    dsub, jnp.asarray(final, bool),
+                )
             task.pos += n
             budget -= n
             self._prefill_chunks += 1
@@ -907,6 +1083,10 @@ class ServingEngine:
                         self._admitting.remove(task.req)
                         self._lengths_host[task.slot] = len(task.req.tokens)
                         self._slot_tables[task.slot] = task.table
+                        # Fresh request: restart its draft-length
+                        # adaptation from the cautious midpoint.
+                        self._slot_k[task.slot] = self._spec_init_k
+                        self._accept_ewma[task.slot] = None
                     # One-token requests never go live: their budget is
                     # spent by the first token. The reader thread
                     # completes them (and releases their blocks); they
@@ -980,12 +1160,16 @@ class ServingEngine:
 
     # -- decode ---------------------------------------------------------------
 
-    def _ensure_decode_blocks(self) -> None:
-        """Grow live slots' tables to cover the next decode chunk's
-        writes. A slot the pool cannot feed (undersized kv_pool_blocks
-        under concurrent worst-case load) is force-retired with an
-        error — silently dropping its KV writes would corrupt the
-        stream."""
+    def _ensure_decode_blocks(self, lookahead: Optional[int] = None) -> None:
+        """Grow live slots' tables to cover the next chunk's writes —
+        `lookahead` rows past each slot's length (default: the decode
+        chunk's steps_per_sync; a speculation round passes k+1, its
+        draft/verify write window). A slot the pool cannot feed
+        (undersized kv_pool_blocks under concurrent worst-case load) is
+        force-retired with an error — silently dropping its KV writes
+        would corrupt the stream."""
+        if lookahead is None:
+            lookahead = self._steps_per_sync
         bs = self._block_size
         updates: Dict[int, List[int]] = {}
         for slot in range(self.slots):
@@ -993,7 +1177,7 @@ class ServingEngine:
             if self._live[slot] is None or table is None:
                 continue
             need = min(
-                (self._lengths_host[slot] + self._steps_per_sync - 1) // bs + 1,
+                (self._lengths_host[slot] + lookahead - 1) // bs + 1,
                 self._max_blocks,
             )
             grew = False
@@ -1026,6 +1210,60 @@ class ServingEngine:
                     jnp.asarray(updates[s], jnp.int32),
                 )
             self.state = self.state._replace(block_tables=bt)
+            self._view_fresh = True
+
+    def _ensure_spec_writable(self, k: int) -> None:
+        """Copy-on-write pass over each live slot's speculation write
+        window (rows length..length+k): the draft and verify programs
+        write those rows directly into pool blocks, so a block still
+        shared with the prefix cache or a sharer (a published tail the
+        slot decodes into) must be privatized FIRST — rejected-draft
+        writes into a refcounted block would corrupt every other
+        holder. Under the engine's invariants the window is virtually
+        always private already (prefill CoWs the matched tail before
+        any write; growth allocates fresh blocks), so this pass is a
+        cheap refcount check per window block."""
+        bs = self._block_size
+        updates: Dict[int, List[int]] = {}
+        for slot in range(self.slots):
+            table = self._slot_tables[slot]
+            if self._live[slot] is None or table is None:
+                continue
+            first_blk = self._lengths_host[slot] // bs
+            last_blk = min(
+                (self._lengths_host[slot] + k) // bs, len(table) - 1
+            )
+            for idx in range(first_blk, last_blk + 1):
+                with self._lock:
+                    b, needs_copy = self._alloc.ensure_writable(table[idx])
+                if b is None:
+                    self._force_retire(
+                        slot,
+                        RuntimeError(
+                            "kv block pool exhausted during speculative"
+                            " copy-on-write (raise kv_pool_blocks)"
+                        ),
+                    )
+                    break
+                if needs_copy:
+                    src = jnp.asarray(table[idx], jnp.int32)
+                    dst = jnp.asarray(b, jnp.int32)
+                    self.state = self._copy_block(self.state, src, dst)
+                    self._draft_state = self._copy_draft_block(
+                        self._draft_state, src, dst
+                    )
+                    table[idx] = b
+                    updates[slot] = self._pad_table(table)
+        if updates:
+            bt = self.state.block_tables
+            for s in sorted(updates):
+                bt = self._set_table_row(
+                    bt,
+                    jnp.asarray(s, jnp.int32),
+                    jnp.asarray(updates[s], jnp.int32),
+                )
+            self.state = self.state._replace(block_tables=bt)
+            self._view_fresh = True
 
     def _force_retire(self, slot: int, error: BaseException) -> None:
         req = self._live[slot]
@@ -1106,68 +1344,41 @@ class ServingEngine:
                 #    pad sentinel and silently drop.
                 t0 = time.monotonic()
                 self._advance_prefills()
-                self._ensure_decode_blocks()
-                t_pf = time.monotonic()
-                # 2) Dispatch the decode chunk (async) and sync on it.
-                self._rng, sub = jax.random.split(self._rng)
-                self.state, tokens, active = self._step(
-                    self.params, self.state, sub
-                )
-                toks = jax.device_get(tokens)  # (B, steps_per_sync)
-                still = jax.device_get(active)
-                t_sync = time.monotonic()
-                self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
+                spec_now = self._spec and self._spec_cooldown == 0
+                if spec_now:
+                    toks, still, t_pf = self._spec_round(t0)
+                    if toks is None:
+                        continue  # every slot force-retired mid-round
+                else:
+                    self._ensure_decode_blocks()
+                    t_pf = time.monotonic()
+                    # 2) Dispatch the decode chunk (async), sync on it.
+                    self._rng, sub = jax.random.split(self._rng)
+                    (self.state, self._view_k, self._view_v, tokens,
+                     active) = self._step(
+                        self.params, self.state, self._view_k,
+                        self._view_v, jnp.asarray(self._view_fresh, bool),
+                        sub,
+                    )
+                    self._view_fresh = False
+                    toks = jax.device_get(tokens)  # (B, steps_per_sync)
+                    still = jax.device_get(active)
+                    t_sync = time.monotonic()
+                    self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
+                    self._t_decode += t_sync - t_pf
+                    if self._spec and self._spec_cooldown > 0:
+                        self._spec_fallback_rounds += 1
+                        self._spec_cooldown -= 1
+                        if self._spec_cooldown == 0:
+                            # Re-probe cautiously: shortest drafts,
+                            # fresh acceptance estimates.
+                            self._slot_k = [1] * self.slots
+                            self._accept_ewma = [None] * self.slots
+                            self._spec_low_streak = 0
                 self._t_prefill += t_pf - t0
-                self._t_decode += t_sync - t_pf
                 # 3) First-token order barrier, then fan out the chunk.
                 self._wait_activations()
-                with self._lock:
-                    cancelled = set(self._cancelled)
-                for slot, req in enumerate(self._live):
-                    if req is None:
-                        continue
-                    n_emitted = int((toks[slot] >= 0).sum())
-                    self._lengths_host[slot] += n_emitted
-                    if req.out in cancelled:
-                        # consumer is gone: free the slot now, skip the
-                        # chunk's tokens (nobody reads them)
-                        with self._lock:
-                            self._cancelled.discard(req.out)
-                            self._inflight.discard(req.out)
-                            self._live[slot] = None
-                            self._release_slot_blocks(
-                                slot, cache_tail=True, prompt=req.tokens
-                            )
-                        self.state = self._retire(slot)
-                        req.out.put(None)
-                        continue
-                    if not still[slot]:
-                        # Free the slot (under the submit lock) BEFORE
-                        # delivering the final tokens + clean end: a
-                        # client that sees its stream finish and
-                        # immediately resubmits must find the capacity
-                        # it just released (max_pending=0 semantics).
-                        with self._lock:
-                            self._live[slot] = None
-                            # cancel() racing normal completion must not
-                            # leave a stale entry behind
-                            self._cancelled.discard(req.out)
-                            self._inflight.discard(req.out)
-                            self._release_slot_blocks(
-                                slot, cache_tail=True, prompt=req.tokens
-                            )
-                        for tok in toks[slot]:
-                            if tok >= 0:
-                                req.out.put(int(tok))
-                        req.out.put(None)
-                        self._turn_s = self._ewma(
-                            self._turn_s,
-                            time.monotonic() - self._slot_t0[slot],
-                        )
-                        continue
-                    for tok in toks[slot]:
-                        if tok >= 0:
-                            req.out.put(int(tok))
+                self._fan_out(toks, still)
             except Exception as e:  # device/compile error: fail loudly, not
                 # by wedging every consumer on a dead queue.
                 if self._stop:
@@ -1187,6 +1398,140 @@ class ServingEngine:
                     "serving engine loop failed"
                 )
                 return
+
+    def _spec_round(self, t0: float):
+        """One speculation boundary: drafter proposes k tokens per
+        slot, the target verifies all k+1 positions in one forward, and
+        the host adapts per-slot draft lengths from what survived.
+        Returns (toks, still, t_pf) shaped exactly like a decode chunk
+        (toks (B, k+1) with -1 padding) so the fan-out is shared, or
+        (None, None, t) when no slot survived block provisioning."""
+        k_cur = max(
+            (self._slot_k[s] for s in range(self.slots)
+             if self._live[s] is not None),
+            default=self._spec_init_k,
+        )
+        self._ensure_decode_blocks(k_cur + 1)
+        self._ensure_spec_writable(k_cur)
+        if not any(r is not None for r in self._live):
+            return None, None, time.monotonic()
+        t_pf = time.monotonic()
+        self._rng_draft, dsub = jax.random.split(self._rng_draft)
+        self._rng, vsub = jax.random.split(self._rng)
+        dk, dv, drafts, qlogits = self._spec_draft_fn(k_cur)(
+            self._draft_params, self._draft_state.k, self._draft_state.v,
+            self.state.block_tables, self.state.lengths,
+            self.state.last_token, self.state.active,
+            self.state.temperature, self.state.top_p, dsub,
+        )
+        self._draft_state = self._draft_state._replace(k=dk, v=dv)
+        drafts.block_until_ready()  # draft/verify timing split
+        t_draft = time.monotonic()
+        self.state, emitted, accepted, active = self._spec_verify_fn(k_cur)(
+            self.params, self.state, drafts, qlogits, vsub,
+        )
+        toks = jax.device_get(emitted)     # (B, k_cur + 1), -1 padded
+        still = jax.device_get(active)
+        acc = jax.device_get(accepted)
+        t_sync = time.monotonic()
+        self._view_fresh = True  # verify wrote the pool behind the view
+        self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
+        self._t_decode += t_sync - t_pf
+        self._t_spec_draft += t_draft - t_pf
+        self._t_spec_verify += t_sync - t_draft
+        # Acceptance bookkeeping + per-slot draft-length adaptation.
+        self._spec_rounds += 1
+        live_rates = []
+        n_round_tokens = 0
+        for slot in range(self.slots):
+            if self._live[slot] is None:
+                continue
+            a = int(acc[slot])
+            self._spec_proposed += k_cur
+            self._spec_accepted += a
+            self._spec_rejected += k_cur - a
+            n_round_tokens += int((toks[slot] >= 0).sum())
+            rate = a / k_cur
+            prev = self._accept_ewma[slot]
+            ewma = rate if prev is None else prev + 0.3 * (rate - prev)
+            self._accept_ewma[slot] = ewma
+            live_rates.append(ewma)
+            if ewma > 0.8 and self._slot_k[slot] < self._spec_max_draft:
+                self._slot_k[slot] += 1
+            elif ewma < 0.4 and self._slot_k[slot] > 1:
+                self._slot_k[slot] -= 1
+        if live_rates:
+            mean_rate = sum(live_rates) / len(live_rates)
+            self._spec_accept_ewma = self._ewma_seed(
+                self._spec_accept_ewma, mean_rate
+            )
+            self._spec_tokens_round_ewma = self._ewma_seed(
+                self._spec_tokens_round_ewma,
+                n_round_tokens / len(live_rates),
+            )
+            # Whole-batch fallback: speculation that keeps missing is a
+            # strict loss (k drafter steps + a (k+1)-wide verify for ~1
+            # token); after a few consecutive low-acceptance rounds,
+            # drop to plain decode chunks for a cooldown window.
+            if mean_rate < self._spec_min_accept:
+                self._spec_low_streak += 1
+                if self._spec_low_streak >= 3:
+                    self._spec_cooldown = 50
+            else:
+                self._spec_low_streak = 0
+        return toks, still, t_pf
+
+    def _fan_out(self, toks, still) -> None:
+        """Deliver one chunk's tokens (decode or speculation round —
+        rows are -1-padded past each slot's emissions) and retire slots
+        that finished or were cancelled."""
+        with self._lock:
+            cancelled = set(self._cancelled)
+        for slot, req in enumerate(self._live):
+            if req is None:
+                continue
+            n_emitted = int((toks[slot] >= 0).sum())
+            self._lengths_host[slot] += n_emitted
+            if req.out in cancelled:
+                # consumer is gone: free the slot now, skip the
+                # chunk's tokens (nobody reads them)
+                with self._lock:
+                    self._cancelled.discard(req.out)
+                    self._inflight.discard(req.out)
+                    self._live[slot] = None
+                    self._release_slot_blocks(
+                        slot, cache_tail=True, prompt=req.tokens
+                    )
+                self.state = self._retire(slot)
+                req.out.put(None)
+                continue
+            if not still[slot]:
+                # Free the slot (under the submit lock) BEFORE
+                # delivering the final tokens + clean end: a
+                # client that sees its stream finish and
+                # immediately resubmits must find the capacity
+                # it just released (max_pending=0 semantics).
+                with self._lock:
+                    self._live[slot] = None
+                    # cancel() racing normal completion must not
+                    # leave a stale entry behind
+                    self._cancelled.discard(req.out)
+                    self._inflight.discard(req.out)
+                    self._release_slot_blocks(
+                        slot, cache_tail=True, prompt=req.tokens
+                    )
+                for tok in toks[slot]:
+                    if tok >= 0:
+                        req.out.put(int(tok))
+                req.out.put(None)
+                self._turn_s = self._ewma(
+                    self._turn_s,
+                    time.monotonic() - self._slot_t0[slot],
+                )
+                continue
+            for tok in toks[slot]:
+                if tok >= 0:
+                    req.out.put(int(tok))
 
 
 def prometheus_metrics(stats: Dict[str, Any]) -> str:
@@ -1216,6 +1561,26 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
          stats["admitted_total"]),
         ("dstack_tpu_serving_rejected_total", "counter",
          stats["rejected_total"]),
+        # Speculative decoding (all zero when --spec-enable is off;
+        # .get defaults keep pre-speculation snapshots renderable).
+        ("dstack_tpu_serving_spec_rounds_total", "counter",
+         stats.get("spec_rounds_total", 0)),
+        ("dstack_tpu_serving_spec_fallback_rounds_total", "counter",
+         stats.get("spec_fallback_rounds_total", 0)),
+        ("dstack_tpu_serving_spec_tokens_proposed_total", "counter",
+         stats.get("spec_tokens_proposed_total", 0)),
+        ("dstack_tpu_serving_spec_tokens_accepted_total", "counter",
+         stats.get("spec_tokens_accepted_total", 0)),
+        ("dstack_tpu_serving_spec_tokens_rejected_total", "counter",
+         stats.get("spec_tokens_rejected_total", 0)),
+        ("dstack_tpu_serving_spec_draft_seconds_total", "counter",
+         stats.get("spec_draft_seconds_total", 0.0)),
+        ("dstack_tpu_serving_spec_verify_seconds_total", "counter",
+         stats.get("spec_verify_seconds_total", 0.0)),
+        ("dstack_tpu_serving_spec_accept_rate_ewma", "gauge",
+         stats.get("spec_accept_rate_ewma", 0.0)),
+        ("dstack_tpu_serving_spec_draft_len_mean", "gauge",
+         stats.get("spec_draft_len_mean", 0.0)),
     ]
     lines = []
     for name, mtype, value in series:

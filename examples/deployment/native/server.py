@@ -41,7 +41,9 @@ class Engine:
                  quantize: str = "none", max_pending: int = 16,
                  slots: int = 8, steps_per_sync: int = 4,
                  max_prefills_per_chunk: int = 4,
-                 prefill_chunk_tokens: int = 128, kv_block_size: int = 16):
+                 prefill_chunk_tokens: int = 128, kv_block_size: int = 16,
+                 spec_enable: bool = False, spec_max_draft: int = 4,
+                 spec_draft_preset: str = "int8", kv_budget_mb: int = 0):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -88,6 +90,15 @@ class Engine:
         # `kv_block_size` is the pool's block granularity (must divide
         # the preset's max_seq_len). The engine validates both; surface
         # its ValueError as a clean CLI error, not a traceback.
+        # Speculative decoding: the drafter is either an int8-quantized
+        # copy of the target (default — same architecture, cheaper math,
+        # high acceptance) or a smaller preset drafting for a bigger
+        # target. The engine builds the int8 drafter itself when no
+        # drafter params are passed.
+        draft_params = draft_config = None
+        if spec_enable and spec_draft_preset != "int8":
+            draft_config = PRESETS[spec_draft_preset]
+            draft_params = init_params(draft_config, jax.random.PRNGKey(1))
         try:
             self.serving = ServingEngine(
                 self.config, self.params, slots=slots, temperature=0.8,
@@ -95,6 +106,10 @@ class Engine:
                 max_prefills_per_chunk=max_prefills_per_chunk,
                 prefill_chunk_tokens=prefill_chunk_tokens,
                 kv_block_size=kv_block_size,
+                spec_enable=spec_enable, spec_max_draft=spec_max_draft,
+                spec_draft_params=draft_params,
+                spec_draft_config=draft_config,
+                kv_budget_bytes=kv_budget_mb * (1 << 20) or None,
             )
         except ValueError as e:
             raise SystemExit(f"invalid serving configuration: {e}")
@@ -266,7 +281,29 @@ def main() -> None:
     parser.add_argument("--kv-block-size", type=int, default=16,
                         help="paged-KV block granularity in tokens; must"
                              " divide the preset's max_seq_len")
+    parser.add_argument("--spec-enable", action="store_true",
+                        help="draft-model speculative decoding: a cheap"
+                             " drafter proposes tokens, the target verifies"
+                             " them in one forward (distribution-exact)")
+    parser.add_argument("--spec-max-draft", type=int, default=4,
+                        help="ceiling for the adaptive per-slot draft length")
+    parser.add_argument("--spec-draft-preset", default="int8",
+                        help="drafter model: 'int8' (quantized copy of the"
+                             " target) or a smaller preset name")
+    parser.add_argument("--kv-budget-mb", type=int, default=0,
+                        help="KV pool memory budget in MiB (0 = unlimited);"
+                             " with --spec-enable the target AND drafter"
+                             " pools must both fit")
     args = parser.parse_args()
+    if args.spec_max_draft <= 0:
+        raise SystemExit(
+            f"--spec-max-draft must be positive, got {args.spec_max_draft}"
+        )
+    if args.spec_draft_preset != "int8" and args.spec_draft_preset not in PRESETS:
+        raise SystemExit(
+            f"--spec-draft-preset {args.spec_draft_preset!r} is not a known"
+            f" preset (choose 'int8' or one of: {', '.join(sorted(PRESETS))})"
+        )
     if args.prefill_chunk_tokens <= 0:
         raise SystemExit(
             f"--prefill-chunk-tokens must be positive,"
@@ -288,7 +325,11 @@ def main() -> None:
                     slots=args.slots, steps_per_sync=args.steps_per_sync,
                     max_prefills_per_chunk=args.max_prefills_per_chunk,
                     prefill_chunk_tokens=args.prefill_chunk_tokens,
-                    kv_block_size=args.kv_block_size)
+                    kv_block_size=args.kv_block_size,
+                    spec_enable=args.spec_enable,
+                    spec_max_draft=args.spec_max_draft,
+                    spec_draft_preset=args.spec_draft_preset,
+                    kv_budget_mb=args.kv_budget_mb)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):

@@ -19,6 +19,8 @@ import urllib.request
 from collections import Counter
 from pathlib import Path
 
+import pytest
+
 from tests.conftest import free_port
 
 REPO = Path(__file__).resolve().parents[1]
@@ -213,6 +215,77 @@ def test_native_server_rejects_bad_paged_kv_flags(tmp_path):
         )
         assert out.returncode != 0, flags
         assert needle in out.stderr, (flags, out.stderr[-500:])
+
+
+def test_native_server_rejects_bad_spec_flags(tmp_path):
+    """The speculation flags fail fast with clear messages: a
+    non-positive draft ceiling, an unknown drafter preset, and a KV
+    budget that fits the target pool but cannot also fit the drafter
+    pool (tiny at server defaults needs exactly 1 MiB per pool, so
+    --kv-budget-mb 1 admits plain serving but rejects speculation)."""
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+    for flags, needle in (
+        (["--spec-enable", "--spec-max-draft", "0"], "must be positive"),
+        (["--spec-enable", "--spec-draft-preset", "nope"],
+         "not a known preset"),
+        (["--spec-enable", "--kv-budget-mb", "1"], "drafter KV pool"),
+    ):
+        out = subprocess.run(
+            [sys.executable, str(SERVER), "--preset", "tiny",
+             "--port", str(free_port()), *flags],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode != 0, flags
+        assert needle in out.stderr, (flags, out.stderr[-500:])
+
+
+@pytest.mark.slow
+def test_native_server_spec_flags_and_prometheus(tmp_path):
+    """--spec-enable rides through to the engine (the same 1 MiB-per-pool
+    budget that rejects speculation at 1 MiB admits it at 2), the JSON
+    /metrics surface reports the speculation counters, and every
+    dstack_tpu_serving_spec_* Prometheus series is declared in the
+    registry with matching type."""
+    from dstack_tpu.server.metrics_registry import METRICS
+
+    proc, log, port = _boot_server(
+        tmp_path, "--max-new-tokens", "16", "--spec-enable",
+        "--spec-max-draft", "2", "--kv-budget-mb", "2",
+    )
+    try:
+        r = _post(port, {"messages": [{"role": "user", "content": "hi"}],
+                         "temperature": 0})
+        assert r.status == 200
+
+        m = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ))
+        assert m["spec_enabled"] is True
+        assert m["spec_max_draft"] == 2
+        assert m["spec_rounds_total"] >= 1
+        assert m["spec_tokens_proposed_total"] == (
+            m["spec_tokens_accepted_total"] + m["spec_tokens_rejected_total"]
+        )
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?format=prometheus", timeout=5
+        ).read().decode()
+        spec_series = set()
+        for line in text.splitlines():
+            if line.startswith("# TYPE dstack_tpu_serving_spec_"):
+                _, _, name, mtype = line.split()
+                spec_series.add(name)
+                assert name in METRICS, name
+                assert METRICS[name][0] == mtype, (name, mtype)
+                assert METRICS[name][1] == (), name
+        declared = {n for n in METRICS if n.startswith(
+            "dstack_tpu_serving_spec_")}
+        assert spec_series == declared, declared - spec_series
+        assert "dstack_tpu_serving_spec_rounds_total" in spec_series
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
 
 
 def test_native_server_stop_sequences(tmp_path):

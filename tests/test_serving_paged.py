@@ -244,6 +244,29 @@ def test_prometheus_metrics_matches_registry(params):
                     "dstack_tpu_serving_ttft_seconds_sum",
                     "dstack_tpu_serving_ttft_seconds_count"):
         assert derived in sampled, derived
+    # Speculation series render (at zero) even with speculation off, so
+    # dashboards and the registry checker see one stable series set.
+    assert "dstack_tpu_serving_spec_rounds_total" in seen
+    assert "dstack_tpu_serving_spec_accept_rate_ewma" in seen
+
+
+def test_spec_disabled_surface_is_inert(params):
+    """A spec-off engine reports the speculation keys as zeros/False —
+    scrapers get a stable schema — and rejects a KV budget smaller than
+    the target pool with an actionable error (no drafter involved)."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=32)
+    try:
+        st = engine.stats()
+        assert st["spec_enabled"] is False
+        assert st["spec_rounds_total"] == 0
+        assert st["spec_tokens_proposed_total"] == 0
+        assert st["spec_accept_rate_ewma"] == 0.0
+        pool = engine._pool_bytes_target
+    finally:
+        engine.close()
+    with pytest.raises(ValueError, match="cannot fit the KV pool"):
+        ServingEngine(CFG, params, slots=2, max_len=32,
+                      kv_budget_bytes=pool - 1)
 
 
 def test_ttft_histogram_tracks_deliveries(params):

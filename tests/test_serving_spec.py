@@ -1,0 +1,233 @@
+"""Speculative decoding in the serving engine (PR 10).
+
+Covers the exactness contract (temp-0 speculative output is bit-identical
+to plain decode, whatever the drafter proposes), paged-KV rollback safety
+(rejected draft rows never corrupt shared prefix blocks), block-leak
+freedom under cancellation mid-round, and the adaptive draft-length /
+whole-batch-fallback control loop.
+
+The two drafters used here bracket the acceptance spectrum:
+- the TARGET's own params as drafter -> every greedy draft matches, so
+  acceptance is 1.0 (the "scripted" high-acceptance drafter);
+- a freshly initialised net with a different seed -> its argmax almost
+  never matches the target's, so acceptance is ~0 (the adversarial one).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.generate import generate
+from dstack_tpu.workloads.serving import ServingEngine
+from dstack_tpu.workloads.transformer import init_params
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def bad_drafter_params():
+    # Same architecture, different weights: greedy drafts disagree with
+    # the target almost everywhere.
+    return init_params(CFG, jax.random.PRNGKey(7))
+
+
+def _drain(q):
+    out = []
+    while True:
+        tok = q.get(timeout=60)
+        if isinstance(tok, BaseException):
+            raise tok
+        if tok is None:
+            return out
+        out.append(tok)
+
+
+def _reference(params, prompt, n):
+    toks = generate(
+        CFG, params, jnp.asarray([prompt], dtype=jnp.int32),
+        max_new_tokens=n, temperature=0.0,
+    )
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n):
+    return [(i * 37 + seed * 13 + 5) % 100 + 1 for i in range(n)]
+
+
+def _spec_engine(params, drafter, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("prefill_chunk_tokens", 16)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("spec_max_draft", 3)
+    return ServingEngine(
+        CFG, params, spec_enable=True, spec_draft_params=drafter,
+        spec_draft_config=CFG, **kw,
+    )
+
+
+def test_spec_temp0_bit_exact_at_awkward_lengths(params):
+    """Speculative temp-0 output must equal the dense reference for
+    prompt lengths that are not multiples of the chunk or block size
+    (5 and 33 with chunk=16, block=8 — 33 crosses a block boundary
+    mid-chunk), with a high-acceptance drafter driving multi-token
+    rounds."""
+    engine = _spec_engine(params, params)
+    try:
+        for seed, n in ((1, 5), (3, 33)):
+            p = _prompt(seed, n)
+            q = engine.submit(p, max_new_tokens=8)
+            assert _drain(q) == _reference(params, p, 8), f"len={n}"
+        st = engine.stats()
+        assert st["spec_rounds_total"] > 0
+        assert st["spec_tokens_accepted_total"] > 0
+    finally:
+        engine.close()
+
+
+def test_spec_temp0_bit_exact_under_adversarial_drafter(params,
+                                                        bad_drafter_params):
+    """Rejection sampling is what makes speculation safe: even a drafter
+    that is wrong almost every round must leave temp-0 output
+    bit-identical to plain decode (the verify pass emits the target's
+    own token wherever the draft diverges)."""
+    engine = _spec_engine(params, bad_drafter_params)
+    try:
+        p = _prompt(5, 21)
+        q = engine.submit(p, max_new_tokens=10)
+        assert _drain(q) == _reference(params, p, 10)
+        st = engine.stats()
+        assert st["spec_rounds_total"] > 0
+        assert st["spec_tokens_rejected_total"] > 0
+    finally:
+        engine.close()
+
+
+@pytest.mark.slow
+def test_spec_rollback_keeps_shared_prefix_blocks_intact(params,
+                                                         bad_drafter_params):
+    """Rejected draft rows roll back without touching published blocks:
+    after a rejection-heavy run whose decode tail extends into the
+    prompt's cached (shared) last block, re-running the same prompt must
+    still prefix-hit AND still match the dense reference — any scrubbed
+    byte in a shared block would surface as divergence here."""
+    engine = _spec_engine(params, bad_drafter_params)
+    try:
+        p = _prompt(6, 20)  # 2.5 blocks: rows 20.. land in the shared tail
+        ref = _reference(params, p, 10)
+        assert _drain(engine.submit(p, max_new_tokens=10)) == ref
+        st0 = engine.stats()
+        assert st0["spec_tokens_rejected_total"] > 0
+        assert _drain(engine.submit(p, max_new_tokens=10)) == ref
+        st1 = engine.stats()
+        assert st1["prefix_cache_hits_total"] > st0["prefix_cache_hits_total"]
+        assert (st1["prefix_tokens_reused_total"]
+                > st0["prefix_tokens_reused_total"])
+    finally:
+        engine.close()
+
+
+@pytest.mark.slow
+def test_spec_cancel_mid_round_leaks_zero_blocks(params):
+    """Cancel landing while a speculation round is in flight: the stream
+    ends cleanly and every block returns to the pool."""
+    engine = _spec_engine(params, params, prefix_cache=False)
+    try:
+        round_started = threading.Event()
+        release = threading.Event()
+        real_verify_fn = engine._spec_verify_fn
+
+        def gated_verify_fn(k):
+            fn = real_verify_fn(k)
+
+            def wrapped(*args):
+                round_started.set()
+                assert release.wait(30)
+                return fn(*args)
+
+            return wrapped
+
+        engine._spec_verify_fn = gated_verify_fn
+        p0 = _prompt(8, 11)
+        q = engine.submit(p0, max_new_tokens=24)
+        assert round_started.wait(60)
+        engine.cancel(q)  # lands while the verify forward is gated
+        release.set()
+        # Clean end; anything delivered before the cancel (the prefill's
+        # first token beats the gated round) must be an exact prefix.
+        got = _drain(q)
+        assert len(got) < 24
+        assert got == _reference(params, p0, 24)[:len(got)]
+        engine._spec_verify_fn = real_verify_fn
+        assert engine.stats()["kv_blocks_in_use"] == 0
+        # Engine still serves exactly after the cancelled round.
+        p = _prompt(9, 9)
+        assert _drain(engine.submit(p, max_new_tokens=6)) == _reference(
+            params, p, 6
+        )
+        assert engine.stats()["kv_blocks_in_use"] == 0
+    finally:
+        engine.close()
+
+
+@pytest.mark.slow
+def test_spec_draft_length_adapts_up_on_high_acceptance(params):
+    """With the target drafting for itself every draft is accepted, so
+    the per-slot draft length must climb from its starting value to
+    --spec-max-draft."""
+    engine = _spec_engine(params, params, slots=1)
+    try:
+        _drain(engine.submit(_prompt(10, 9), max_new_tokens=24))
+        st = engine.stats()
+        assert st["spec_accept_rate_ewma"] > 0.9
+        assert st["spec_draft_len_mean"] == engine._spec_max_draft
+        assert st["spec_fallback_rounds_total"] == 0
+    finally:
+        engine.close()
+
+
+@pytest.mark.slow
+def test_spec_adapts_down_and_falls_back_on_low_acceptance(
+        params, bad_drafter_params):
+    """An adversarial drafter must drive the draft length to its floor
+    and then trip the whole-batch fallback (plain decode chunks) after a
+    few consecutive low-acceptance rounds — bounding the loss."""
+    engine = _spec_engine(params, bad_drafter_params, slots=1)
+    try:
+        _drain(engine.submit(_prompt(11, 9), max_new_tokens=24))
+        st = engine.stats()
+        assert st["spec_accept_rate_ewma"] < 0.3
+        assert st["spec_draft_len_mean"] == 1.0
+        assert st["spec_fallback_rounds_total"] > 0
+    finally:
+        engine.close()
+
+
+def test_spec_ctor_validation(params):
+    with pytest.raises(ValueError, match="spec_max_draft"):
+        ServingEngine(CFG, params, spec_enable=True, spec_max_draft=0)
+    # A KV budget that fits one pool but not two rejects speculation
+    # with an actionable message.
+    probe = ServingEngine(CFG, params, slots=2, max_len=96,
+                          kv_block_size=8)
+    try:
+        one_pool = probe._pool_bytes_target
+    finally:
+        probe.close()
+    with pytest.raises(ValueError, match="drafter KV pool"):
+        ServingEngine(CFG, params, slots=2, max_len=96, kv_block_size=8,
+                      spec_enable=True, spec_draft_params=params,
+                      spec_draft_config=CFG,
+                      kv_budget_bytes=int(one_pool * 1.5))
+    # The same budget is fine without speculation.
+    ok = ServingEngine(CFG, params, slots=2, max_len=96, kv_block_size=8,
+                       kv_budget_bytes=int(one_pool * 1.5))
+    ok.close()
