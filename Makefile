@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage dataplane lint lint-json capacity capacity-smoke bench-proxy bench-serving
+.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-serving
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -30,6 +30,12 @@ chaos-worker-kill:
 chaos-outage:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario dataplane-outage
 
+# Sharded-FSM drill: SIGKILL one of four replicas mid-probe; survivors
+# must absorb its shards within one lease TTL of expiry with zero
+# pre-expiry steals, and every in-flight run still completes.
+chaos-shard-kill:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.chaos --scenario shard-kill
+
 # Standalone data-plane worker(s) against the local server DB.
 dataplane:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.dataplane --workers $(or $(WORKERS),1)
@@ -50,6 +56,12 @@ lint-json:
 # docs/guides/control-plane-tuning.md for how to read them.
 capacity:
 	JAX_PLATFORMS=cpu $(PYTHON) capacity_probe.py --runs 500 --out CAPACITY_r06.json
+
+# Multi-replica scaling sweep: 1/2/4 replicas (1 in-process + N-1 real
+# subprocesses) sharing one file-backed DB with hash-sharded FSM
+# ownership. Per-arm aggregate runs/min lands in CAPACITY_r11.json.
+capacity-multi:
+	JAX_PLATFORMS=cpu $(PYTHON) capacity_probe.py --runs 500 --replicas 1,2,4 --out CAPACITY_r11.json
 
 # Proxy data-plane benchmark: pooled+streamed fast path vs the legacy
 # per-request-client buffered proxy, plus the multi-worker scaling and

@@ -1,0 +1,83 @@
+"""SHD01: whole-table FSM scans in background code must be shard-aware.
+
+The background FSM is hash-partitioned across replicas (PR 11,
+services/shard_map.py): every tick scan over runs / jobs / instances /
+volumes / gateways must go through `concurrency.shard_scan`, whose SQL
+carries the `{shard}` token that expands to this replica's owned-bucket
+predicate. A processor that calls `ctx.db.fetchall(...)` with a bare
+`SELECT ... FROM <fsm table>` silently regresses to scanning — and
+contending on — every other replica's rows, which is exactly the
+throughput collapse sharding exists to prevent.
+
+Flagged: inside `server/background/`, a `*.fetchall(...)` /
+`*.fetchone(...)` call whose statically-extractable first argument
+selects FROM an FSM table, unless the WHERE clause is keyed to specific
+rows (an `<...>id = ?` / `<...>id IN (...)` equality — point lookups and
+batch hydration by id are not scans) or the SQL already carries the
+`{shard}` token. `fleets` is exempt: it has no shard column by design
+(see shard_map.FSM_TABLES). Dynamic SQL (a variable argument, e.g.
+inside shard_scan itself) is out of static reach and not flagged.
+"""
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from dstack_tpu.analysis.astutil import attr_name, string_text
+from dstack_tpu.analysis.core import Checker, Finding, Module
+
+SCOPE_MARKER = "server/background/"
+
+SHARDED_TABLES = ("runs", "jobs", "instances", "volumes", "gateways")
+
+_FROM_RE = re.compile(
+    r"\bFROM\s+(" + "|".join(SHARDED_TABLES) + r")\b", re.IGNORECASE
+)
+# A WHERE clause keyed on an id-ish column reads specific rows, not the
+# table; applied to the text after WHERE so join ON conditions
+# (`j.run_id = r.id`) can't masquerade as keys.
+_KEYED_RE = re.compile(r"\b[\w.]*id\b\s*(?:=|IN\s*\()", re.IGNORECASE)
+
+
+def _scan_table(sql: str) -> Optional[str]:
+    """FSM table an un-keyed, un-sharded scan reads; None if compliant."""
+    match = _FROM_RE.search(sql)
+    if match is None:
+        return None
+    if "{shard}" in sql:
+        return None
+    _, _, where = sql.partition("WHERE")
+    if where and _KEYED_RE.search(where):
+        return None
+    return match.group(1).lower()
+
+
+class ShardScanChecker(Checker):
+    codes = ("SHD01",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if SCOPE_MARKER not in module.rel:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if attr_name(node) not in ("fetchall", "fetchone"):
+                continue
+            sql, _ = string_text(node.args[0])
+            if sql is None:
+                continue
+            table = _scan_table(sql)
+            if table is None:
+                continue
+            yield Finding(
+                code="SHD01",
+                message=f"whole-table scan over FSM table `{table}` bypasses"
+                " the shard predicate — in a multi-replica deployment every"
+                " replica re-scans and contends on all rows; use"
+                " concurrency.shard_scan with a `{shard}` token in the SQL",
+                rel=module.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                symbol="",
+                key=table,
+            )

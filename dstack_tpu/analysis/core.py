@@ -147,6 +147,7 @@ def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
     from dstack_tpu.analysis.checkers.multi_replica import MultiReplicaLockChecker
     from dstack_tpu.analysis.checkers.pool import PoolChecker
+    from dstack_tpu.analysis.checkers.shard import ShardScanChecker
     from dstack_tpu.analysis.checkers.sql import SqlChecker
 
     return [
@@ -156,6 +157,7 @@ def default_checkers() -> List[Checker]:
         SqlChecker(),
         MetricsRegistryChecker(),
         PoolChecker(),
+        ShardScanChecker(),
     ]
 
 

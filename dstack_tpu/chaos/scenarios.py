@@ -1384,3 +1384,238 @@ async def _dataplane_outage(report, seed, tmp: Path) -> None:
         for srv in (up_a, up_b):
             srv.close()
             await srv.wait_closed()
+
+
+_SHARD_WORKER = """
+import asyncio, json, sys, time
+
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.http import Server
+
+
+async def main():
+    db_path = sys.argv[1]
+    app = create_app(db_path=db_path, admin_token="chaos-admin",
+                     run_background_tasks=True)
+    server = Server(app, "127.0.0.1", 0)
+    await server.start()
+    ctx = app.state["ctx"]
+    print(json.dumps({"event": "up", "port": server.port,
+                      "replica": ctx.replica_id}), flush=True)
+    # Audit trail: every shard acquisition gets a wall-clock row. The
+    # parent compares these against the victim's snapshotted lease
+    # expiries to prove no survivor stole a shard early. Polling lags
+    # the lease write by <= 50ms, which only makes the recorded time
+    # LATER -- it can never fake a pre-expiry steal.
+    owned = frozenset()
+    while True:
+        now_owned = ctx.shard_map.owned()
+        for n in sorted(now_owned - owned):
+            await ctx.db.execute(
+                "INSERT INTO chaos_shards (shard, owner, acquired_at)"
+                " VALUES (?, ?, ?)", (n, ctx.replica_id, time.time()),
+            )
+        owned = now_owned
+        await asyncio.sleep(0.05)
+
+
+asyncio.run(main())
+"""
+
+
+@scenario("shard-kill")
+async def _shard_kill(report, seed, tmp: Path) -> None:
+    """kill -9 one of four sharded replicas mid-probe: the survivors
+    must absorb the corpse's FSM shards within one lease TTL of expiry,
+    with zero pre-expiry steals (the lease boundary is the only handoff
+    authority), and every in-flight run still reaches `done` -- the
+    blast radius of a replica death is one TTL of latency on its
+    shards, never a stuck run."""
+    import json as _json
+    import signal
+    import sys
+    import time
+
+    import httpx
+
+    from dstack_tpu.server.services.shard_map import NS_SHARD
+
+    ttl = 2.0
+    n_replicas = 4
+    n_shards = 16
+    n_runs = 12
+    db = tmp / "shards.db"
+
+    # Parent-side control app (not multi-replica, no background tasks):
+    # migrates the DB, owns the audit table, reads leases and run rows.
+    from dstack_tpu.server.app import create_app
+
+    app = create_app(db_path=str(db), admin_token="chaos-admin",
+                     run_background_tasks=False)
+    await app.startup()
+    ctx = app.state["ctx"]
+    await ctx.db.execute(
+        "CREATE TABLE IF NOT EXISTS chaos_shards ("
+        " shard INTEGER NOT NULL, owner TEXT NOT NULL,"
+        " acquired_at REAL NOT NULL)"
+    )
+
+    script = tmp / "shard_worker.py"
+    await asyncio.to_thread(script.write_text, _SHARD_WORKER)
+
+    def _spawn(replica_id: str):
+        errlog = open(tmp / f"{replica_id}.stderr", "wb")
+        return asyncio.create_subprocess_exec(
+            sys.executable, str(script), str(db),
+            stdout=asyncio.subprocess.PIPE, stderr=errlog,
+            env=_drill_env(
+                tmp,
+                DSTACK_TPU_MULTI_REPLICA="1",
+                DSTACK_TPU_REPLICA_ID=replica_id,
+                DSTACK_TPU_LEASE_TTL=str(ttl),
+                DSTACK_TPU_FSM_SHARDS=str(n_shards),
+            ),
+        )
+
+    names = [f"replica-{i}" for i in range(n_replicas)]
+    procs = {}
+    try:
+        for name in names:
+            procs[name] = await _spawn(name)
+        ports = {}
+        for name in names:
+            up = await _read_event(procs[name], "up")
+            ports[name] = up["port"]
+
+        async def _lease_map():
+            now = time.time()
+            rows = await ctx.db.fetchall(
+                "SELECT key, owner, expires_at FROM resource_leases"
+                " WHERE namespace = ? AND expires_at > ?", (NS_SHARD, now),
+            )
+            return {int(r["key"]): (r["owner"], r["expires_at"]) for r in rows}
+
+        # Convergence gate: all shards leased, perfectly fair (4 each).
+        deadline = time.monotonic() + 30
+        while True:
+            leases = await _lease_map()
+            per_owner = {}
+            for owner, _ in leases.values():
+                per_owner[owner] = per_owner.get(owner, 0) + 1
+            if len(leases) == n_shards and \
+                    sorted(per_owner.values()) == [4] * n_replicas:
+                break
+            _expect(report, time.monotonic() < deadline,
+                    f"shards never balanced: {per_owner}")
+            if time.monotonic() >= deadline:
+                return
+            await asyncio.sleep(0.1)
+        report["details"]["balanced_assignment"] = {
+            o: n for o, n in sorted(per_owner.items())
+        }
+
+        # Mid-probe load: real runs through the sharded FSM, submitted
+        # to replica-0's API (which stays alive).
+        api = f"http://127.0.0.1:{ports['replica-0']}"
+        hdrs = {"Authorization": "Bearer chaos-admin"}
+        run_names = [f"shardkill-{i:02d}" for i in range(n_runs)]
+        async with httpx.AsyncClient(timeout=30) as hc:
+            for rn in run_names:
+                r = await hc.post(f"{api}/api/project/main/runs/submit",
+                                  headers=hdrs, json=_task_body(["true"], rn))
+                _expect(report, r.status_code == 200,
+                        f"submit {rn} -> {r.status_code}: {r.text[:200]}")
+
+        # Snapshot the victim's lease expiries, then kill it mid-flight.
+        victim = "replica-3"
+        leases = await _lease_map()
+        victim_shards = {n: exp for n, (o, exp) in leases.items() if o == victim}
+        _expect(report, len(victim_shards) == 4,
+                f"victim held {len(victim_shards)} shards at kill, want 4")
+        t_kill = time.time()
+        procs[victim].kill()
+
+        # Survivors must own ALL shards again within one TTL of the
+        # victim's last lease expiry (tick cadence is ttl/4; generous
+        # slack for a 1-core box mid run-churn).
+        reassigned_at = None
+        deadline = time.monotonic() + 3 * ttl + 30
+        while time.monotonic() < deadline:
+            leases = await _lease_map()
+            owners = {o for o, _ in leases.values()}
+            if len(leases) == n_shards and victim not in owners:
+                reassigned_at = time.time()
+                break
+            await asyncio.sleep(0.1)
+        _expect(report, reassigned_at is not None,
+                "survivors never absorbed the victim's shards")
+        if reassigned_at is not None:
+            report["details"]["reassigned_after_kill_s"] = round(
+                reassigned_at - t_kill, 3)
+
+        # Zero pre-expiry steals: every takeover row for a victim shard
+        # is stamped at or after that shard's snapshotted lease expiry.
+        rows = await ctx.db.fetchall(
+            "SELECT shard, owner, acquired_at FROM chaos_shards"
+            " WHERE acquired_at > ? AND owner != ?", (t_kill, victim),
+        )
+        early = [
+            (r["shard"], r["owner"])
+            for r in rows
+            if r["shard"] in victim_shards
+            and r["acquired_at"] < victim_shards[r["shard"]] - 0.05
+        ]
+        _expect(report, not early,
+                f"shards stolen before the victim's lease expired: {early}")
+
+        # The kill must not strand a single run: shards moved, rows kept
+        # flowing (per-row claims stay the correctness backstop).
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            rows = await ctx.db.fetchall(
+                "SELECT run_name, status FROM runs WHERE deleted = 0")
+            status = {r["run_name"]: r["status"] for r in rows
+                      if r["run_name"] in set(run_names)}
+            if len(status) == n_runs and \
+                    all(s in ("done", "failed", "terminated")
+                        for s in status.values()):
+                break
+            await asyncio.sleep(0.5)
+        not_done = {n: s for n, s in status.items() if s != "done"}
+        missing = [n for n in run_names if n not in status]
+        _expect(report, not not_done and not missing,
+                f"runs not done after takeover: {not_done or missing}")
+        report["details"]["runs_done"] = sum(
+            1 for s in status.values() if s == "done")
+
+        # Observability: the rebalance is visible on survivor /metrics --
+        # the owned-shards gauges sum to the full shard space and at
+        # least one survivor counted an `acquired` rebalance post-kill.
+        owned_total, acquired_total = 0.0, 0.0
+        async with httpx.AsyncClient(timeout=10) as hc:
+            for name in names:
+                if name == victim:
+                    continue
+                r = await hc.get(f"http://127.0.0.1:{ports[name]}/metrics")
+                for ln in r.text.splitlines():
+                    if ln.startswith("dstack_tpu_fsm_shards_owned"):
+                        owned_total += float(ln.rsplit(" ", 1)[1])
+                    if ln.startswith("dstack_tpu_fsm_shard_rebalances_total") \
+                            and 'action="acquired"' in ln:
+                        acquired_total += float(ln.rsplit(" ", 1)[1])
+        _expect(report, owned_total == n_shards,
+                f"survivor shards_owned gauges sum to {owned_total},"
+                f" want {n_shards}")
+        _expect(report, acquired_total >= n_shards,
+                f"rebalance counters show {acquired_total} acquisitions,"
+                f" want >= {n_shards}")
+        report["details"]["survivor_shards_owned_sum"] = owned_total
+    finally:
+        for p in procs.values():
+            if p is not None and p.returncode is None:
+                p.kill()
+                try:
+                    await asyncio.wait_for(p.wait(), 10)
+                except asyncio.TimeoutError:
+                    pass
+        await app.shutdown()

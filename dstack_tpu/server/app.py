@@ -165,6 +165,10 @@ def create_app(
             # Real servers keep the file in sync so first boot leaves a
             # template; in-memory (test) servers never touch the home dir.
             await config_manager.sync_from_db(ctx)
+        # Rows inserted before migration 10 (or by out-of-band writers)
+        # carry shard = -1; assign real buckets before the processors
+        # start filtering on them.
+        await ctx.shard_map.backfill()
         if run_background_tasks:
             from dstack_tpu.server.background import start_background_tasks
 
@@ -173,6 +177,9 @@ def create_app(
 
     async def _shutdown() -> None:
         await ctx.stop_tasks()
+        # Hand shards back voluntarily: a clean restart rebalances at the
+        # survivors' next tick instead of waiting out this replica's TTL.
+        await ctx.shard_map.close()
         await ctx.proxy_pool.aclose()
         await db.close()
 

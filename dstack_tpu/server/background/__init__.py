@@ -50,13 +50,26 @@ def start_background_tasks(ctx: ServerContext) -> None:
         # Multi-replica lease heartbeat: claims held across long operations
         # (slow cloud calls, image pulls) must not expire mid-section.
         ("lease_heartbeat", ctx.claims.ttl / 4, _renew_leases),
+        # Shard ownership rebalance (services/shard_map.py). Same cadence
+        # as the heartbeat: a membership change is observable one renewal
+        # boundary after it happens, so re-deriving the fair share any
+        # faster buys nothing.
+        ("shard_map", ctx.claims.ttl / 4, _shard_tick),
     ]
     for channel, interval, fn in loops:
         ctx.spawn(_loop(ctx, channel, interval, fn))
+    # _loop waits out its interval before the first call; an ownerless
+    # boot window of ttl/4 would leave every shard unprocessed on a
+    # multi-replica cold start, so tick the shard map immediately.
+    ctx.kick("shard_map")
 
 
 async def _renew_leases(ctx: ServerContext) -> None:
     await ctx.claims.renew_held()
+
+
+async def _shard_tick(ctx: ServerContext) -> None:
+    await ctx.shard_map.tick()
 
 
 async def _loop(

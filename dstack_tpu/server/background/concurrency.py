@@ -56,12 +56,33 @@ async def for_each_claimed(
             try:
                 await fn(ctx, row)
             except Exception:
+                # A crash-looping processor must be visible on /metrics,
+                # not just greppable in logs.
+                ctx.tracer.inc("fsm_step_errors", namespace=namespace)
                 logger.exception("failed to process %s %s", what, row["id"])
             finally:
                 await ctx.claims.release(namespace, row["id"])
 
     await asyncio.gather(*(one(r) for r in rows))
     return stepped
+
+
+async def shard_scan(
+    ctx: ServerContext, sql: str, params: Sequence = (), *, column: str = "shard"
+):
+    """Tick-scan an FSM table restricted to the shards this replica owns.
+
+    `sql` carries a literal `{shard}` token immediately after its WHERE
+    conditions; it expands to the owned-bucket predicate (or to nothing
+    when sharding is inactive, so single-replica scans are byte-identical
+    to the pre-shard queries). `column` qualifies the shard column when
+    the scan joins (`j.shard`, `g.shard`). The token is mandatory — the
+    SHD01 checker flags background scans that bypass this helper.
+    """
+    clause, extra = ctx.shard_map.bucket_predicate(column)
+    return await ctx.db.fetchall(
+        sql.replace("{shard}", clause), tuple(params) + tuple(extra)
+    )
 
 
 def placeholders(n: int) -> str:

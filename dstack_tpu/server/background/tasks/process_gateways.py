@@ -15,8 +15,11 @@ logger = logging.getLogger(__name__)
 
 
 async def process_gateways(ctx: ServerContext) -> None:
-    rows = await ctx.db.fetchall(
-        "SELECT * FROM gateways WHERE status IN ('submitted', 'provisioning')"
+    from dstack_tpu.server.background.concurrency import shard_scan
+
+    rows = await shard_scan(
+        ctx,
+        "SELECT * FROM gateways WHERE status IN ('submitted', 'provisioning'){shard}",
     )
     for row in rows:
         if not await ctx.claims.try_claim("gateways", row["id"]):
@@ -24,6 +27,7 @@ async def process_gateways(ctx: ServerContext) -> None:
         try:
             await _process_gateway(ctx, row)
         except Exception:
+            ctx.tracer.inc("fsm_step_errors", namespace="gateways")
             logger.exception("failed to process gateway %s", row["name"])
         finally:
             await ctx.claims.release("gateways", row["id"])
@@ -34,10 +38,14 @@ async def _poll_gateway_stats(ctx: ServerContext) -> None:
     """Pull per-service request counters from RUNNING gateways into the
     autoscaler's stats collector (reference: gateway nginx access-log stats
     feeding process_runs' autoscaler hook)."""
-    rows = await ctx.db.fetchall(
+    from dstack_tpu.server.background.concurrency import shard_scan
+
+    rows = await shard_scan(
+        ctx,
         "SELECT g.id, gc.hostname, gc.ip_address, gc.ssh_private_key FROM gateways g"
         " JOIN gateway_computes gc ON g.gateway_compute_id = gc.id"
-        " WHERE g.status = 'running'"
+        " WHERE g.status = 'running'{shard}",
+        column="g.shard",
     )
     client = ctx.overrides.get("gateway_stats_client")
     for row in rows:

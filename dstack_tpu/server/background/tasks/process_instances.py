@@ -18,7 +18,11 @@ from dstack_tpu.models.instances import InstanceStatus
 from dstack_tpu.models.profiles import DEFAULT_FLEET_IDLE_DURATION
 from dstack_tpu.models.runs import JobProvisioningData
 from dstack_tpu.server import settings
-from dstack_tpu.server.background.concurrency import TickBuffer, for_each_claimed
+from dstack_tpu.server.background.concurrency import (
+    TickBuffer,
+    for_each_claimed,
+    shard_scan,
+)
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
 
@@ -26,9 +30,10 @@ logger = logging.getLogger(__name__)
 
 
 async def process_instances(ctx: ServerContext) -> None:
-    rows = await ctx.db.fetchall(
+    rows = await shard_scan(
+        ctx,
         "SELECT * FROM instances WHERE status != 'terminated' AND deleted = 0"
-        " ORDER BY last_processed_at"
+        "{shard} ORDER BY last_processed_at",
     )
     ctx.tracer.inc("tick_rows_scanned", len(rows), processor="instances")
     if not rows:
@@ -104,6 +109,11 @@ async def _terminate(ctx: ServerContext, row: sqlite3.Row) -> None:
                     json.dumps(jpd.tpu_node_id)[1:-1].replace("\\", "\\\\")
                     .replace("%", "\\%").replace("_", "\\_")
                 )
+                # Deliberately cross-shard: a slice's sibling workers can
+                # hash anywhere, and missing one would tear the shared TPU
+                # node down under a live gang. Point-ish read (one node's
+                # workers), not a tick scan.
+                # analysis: allow(SHD01)
                 busy = await ctx.db.fetchone(
                     "SELECT COUNT(*) AS n FROM instances"
                     " WHERE id != ? AND deleted = 0"

@@ -20,7 +20,11 @@ logger = logging.getLogger(__name__)
 
 
 async def collect_metrics(ctx: ServerContext) -> None:
-    rows = await ctx.db.fetchall("SELECT * FROM jobs WHERE status = 'running'")
+    from dstack_tpu.server.background.concurrency import shard_scan
+
+    rows = await shard_scan(
+        ctx, "SELECT * FROM jobs WHERE status = 'running'{shard}"
+    )
     if not rows:
         return
     # Batched read: one project sweep for the tick instead of a query per

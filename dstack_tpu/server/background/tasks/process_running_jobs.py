@@ -38,6 +38,45 @@ from dstack_tpu.utils.interpolator import InterpolatorError, interpolate
 
 logger = logging.getLogger(__name__)
 
+# Last handshake attempt per provisioning job (monotonic seconds). Kicks
+# make the running-jobs channel tick on every state change, so during a
+# submit burst each provisioning job would otherwise re-run the whole
+# gang-check + secrets + connection + healthcheck prelude dozens of
+# times per second while its agent is still booting — O(jobs * kicks)
+# of pure waste. Entries are dropped when the handshake succeeds; stale
+# ones (failed/terminated jobs) are pruned by size, not by lifecycle.
+_last_handshake: Dict[str, float] = {}
+
+# Same idea for RUNNING jobs: /api/pull is how completion is detected,
+# but polling an agent more than once per debounce window buys nothing
+# except HTTP churn (each kick-driven tick would otherwise re-pull every
+# running job).
+_last_pull: Dict[str, float] = {}
+
+
+def _debounced(cache: Dict[str, float], job_id: str, interval: float) -> bool:
+    """True when this job hit the guarded path too recently. The first
+    attempt is never debounced, so the happy path pays zero latency."""
+    import time
+
+    now = time.monotonic()
+    if len(cache) > 4096:
+        cutoff = now - 60.0
+        for k, v in list(cache.items()):
+            if v < cutoff:
+                del cache[k]
+    last = cache.get(job_id)
+    if last is not None and now - last < interval:
+        return True
+    cache[job_id] = now
+    return False
+
+
+def _handshake_debounced(job_id: str) -> bool:
+    return _debounced(
+        _last_handshake, job_id, settings.RUNNER_HANDSHAKE_DEBOUNCE
+    )
+
 
 class _Tick:
     """Per-tick prefetched rows shared by every job step: runs and projects
@@ -97,11 +136,12 @@ async def _build_tick(ctx: ServerContext, rows) -> _Tick:
 
 
 async def process_running_jobs(ctx: ServerContext) -> None:
-    from dstack_tpu.server.background.concurrency import for_each_claimed
+    from dstack_tpu.server.background.concurrency import for_each_claimed, shard_scan
 
-    rows = await ctx.db.fetchall(
+    rows = await shard_scan(
+        ctx,
         "SELECT * FROM jobs WHERE status IN ('provisioning', 'pulling', 'running')"
-        " ORDER BY last_processed_at"
+        "{shard} ORDER BY last_processed_at",
     )
     ctx.tracer.inc("tick_rows_scanned", len(rows), processor="running_jobs")
     if not rows:
@@ -116,10 +156,12 @@ async def process_running_jobs(ctx: ServerContext) -> None:
 
 
 async def process_terminating_jobs(ctx: ServerContext) -> None:
-    from dstack_tpu.server.background.concurrency import for_each_claimed
+    from dstack_tpu.server.background.concurrency import for_each_claimed, shard_scan
 
-    rows = await ctx.db.fetchall(
-        "SELECT * FROM jobs WHERE status = 'terminating' ORDER BY last_processed_at"
+    rows = await shard_scan(
+        ctx,
+        "SELECT * FROM jobs WHERE status = 'terminating'{shard}"
+        " ORDER BY last_processed_at",
     )
     ctx.tracer.inc("tick_rows_scanned", len(rows), processor="terminating_jobs")
     if not rows:
@@ -301,6 +343,8 @@ async def _process_provisioning(
     ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
 ) -> None:
     """Wait for the whole gang's IPs, then hand the job to its agent."""
+    if _handshake_debounced(row["id"]):
+        return
     jpd = await _update_jpd_ip(ctx, row)
     if jpd is None or jpd.hostname is None:
         if await _runner_deadline_exceeded(ctx, row):
@@ -387,6 +431,7 @@ async def _process_provisioning(
                     env={},
                 )
             )
+            _last_handshake.pop(row["id"], None)
             await ctx.db.execute(
                 "UPDATE jobs SET shim_task_submitted = 1, status = ? WHERE id = ?",
                 (JobStatus.PULLING.value, row["id"]),
@@ -466,98 +511,96 @@ async def _submit_to_runner(
     runner_port: "Optional[int]" = None,
     tick: Optional[_Tick] = None,
 ) -> None:
-    runner = conn.runner_client(port=runner_port)
+    runner = conn.pooled_runner_client(port=runner_port)
     # Thread the run's trace context to the agent: child traceparents on
     # every HTTP call, and the run context itself in the submit body (the
     # runner injects it into the workload as DSTACK_TPU_TRACEPARENT).
     runner.traceparent = await _run_traceparent(ctx, row, tick)
+    health = await runner.healthcheck()
+    if health is None:
+        if await _runner_deadline_exceeded(ctx, row):
+            await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
+                        "runner did not become ready in time")
+        return
+    # Resolve `${{ secrets.* }}` / `${{ dstack.* }}` in env values before
+    # the spec leaves the server — secret material is sent only to the
+    # runner of this one job, never stored back into the jobs table.
     try:
-        health = await runner.healthcheck()
-        if health is None:
-            if await _runner_deadline_exceeded(ctx, row):
-                await _fail(ctx, row, JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED,
-                            "runner did not become ready in time")
-            return
-        # Resolve `${{ secrets.* }}` / `${{ dstack.* }}` in env values before
-        # the spec leaves the server — secret material is sent only to the
-        # runner of this one job, never stored back into the jobs table.
-        try:
-            ns = {
-                "secrets": secrets,
-                "dstack": {
-                    "job_num": str(job_spec.job_num),
-                    "node_rank": str(job_spec.job_num),
-                    "run_name": row["run_name"],
-                },
-            }
-            env = {k: interpolate(v, ns) for k, v in job_spec.env.items()}
-        except InterpolatorError as e:
-            await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
-            return
-        # Persistent XLA compilation cache on the first NETWORK volume:
-        # repeat runs skip the first-compile wall (cold-start budget
-        # stage 5, docs/guides/multihost.md) because the cache outlives
-        # the container AND the instance — an instance mount would die
-        # with the VM, silently re-paying the compile on re-provision.
-        # User-set value always wins; without a volume there is nowhere
-        # durable to put it.
-        if "JAX_COMPILATION_CACHE_DIR" not in env:
-            from dstack_tpu.models.volumes import VolumeMountPoint
+        ns = {
+            "secrets": secrets,
+            "dstack": {
+                "job_num": str(job_spec.job_num),
+                "node_rank": str(job_spec.job_num),
+                "run_name": row["run_name"],
+            },
+        }
+        env = {k: interpolate(v, ns) for k, v in job_spec.env.items()}
+    except InterpolatorError as e:
+        await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
+        return
+    # Persistent XLA compilation cache on the first NETWORK volume:
+    # repeat runs skip the first-compile wall (cold-start budget
+    # stage 5, docs/guides/multihost.md) because the cache outlives
+    # the container AND the instance — an instance mount would die
+    # with the VM, silently re-paying the compile on re-provision.
+    # User-set value always wins; without a volume there is nowhere
+    # durable to put it.
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        from dstack_tpu.models.volumes import VolumeMountPoint
 
-            durable = next(
-                (m for m in job_spec.volumes
-                 if isinstance(m, VolumeMountPoint)), None,
+        durable = next(
+            (m for m in job_spec.volumes
+             if isinstance(m, VolumeMountPoint)), None,
+        )
+        if durable is not None:
+            env["JAX_COMPILATION_CACHE_DIR"] = (
+                durable.path.rstrip("/") + "/.jax-compile-cache"
             )
-            if durable is not None:
-                env["JAX_COMPILATION_CACHE_DIR"] = (
-                    durable.path.rstrip("/") + "/.jax-compile-cache"
-                )
-        job_spec = job_spec.model_copy(update={"env": env})
+    job_spec = job_spec.model_copy(update={"env": env})
+    try:
+        code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row, tick)
+    except (ServerError, BackendError) as e:
+        await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
+        return
+    jpd = _jpd(ctx, row)
+    mounts: List[dict] = []
+    if job_spec.volumes and jpd is not None and not jpd.dockerized:
+        # Dockerized hosts mount volumes in the shim; the direct-runner
+        # (local backend) path resolves them here instead.
         try:
-            code_blob, repo_data, repo_creds = await _get_repo_payload(ctx, row, tick)
+            mounts = await volumes_service.attach_job_volumes(
+                ctx, row["project_id"], row["instance_id"] or jpd.instance_id,
+                jpd, job_spec.volumes,
+            )
         except (ServerError, BackendError) as e:
-            await _fail(ctx, row, JobTerminationReason.EXECUTOR_ERROR, str(e))
+            await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
             return
-        jpd = _jpd(ctx, row)
-        mounts: List[dict] = []
-        if job_spec.volumes and jpd is not None and not jpd.dockerized:
-            # Dockerized hosts mount volumes in the shim; the direct-runner
-            # (local backend) path resolves them here instead.
-            try:
-                mounts = await volumes_service.attach_job_volumes(
-                    ctx, row["project_id"], row["instance_id"] or jpd.instance_id,
-                    jpd, job_spec.volumes,
-                )
-            except (ServerError, BackendError) as e:
-                await _fail(ctx, row, JobTerminationReason.VOLUME_ERROR, str(e))
-                return
-        await runner.submit_job(
-            run_name=row["run_name"],
-            job_spec=job_spec,
-            cluster_info=cluster_info,
-            node_rank=job_spec.job_num,
-            secrets=secrets,
-            has_code=code_blob is not None,
-            repo_data=repo_data,
-            repo_creds=repo_creds,
-            mounts=mounts,
-        )
-        if code_blob is not None:
-            await runner.upload_code(code_blob)
-        await runner.run_job()
-        await ctx.db.execute(
-            "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
-        )
-        await _stage(ctx, row, "env_ready")
-        await bump_routing_epoch(ctx, row["run_id"], row["run_name"], row["project_id"])
-        await _register_service_replica(ctx, row, jpd, job_spec, tick)
-        logger.info(
-            "job %s (%s rank %d/%d) running",
-            job_spec.job_name, row["run_name"], job_spec.job_num, job_spec.jobs_per_replica,
-        )
-        ctx.kick("runs")
-    finally:
-        await runner.close()
+    await runner.submit_job(
+        run_name=row["run_name"],
+        job_spec=job_spec,
+        cluster_info=cluster_info,
+        node_rank=job_spec.job_num,
+        secrets=secrets,
+        has_code=code_blob is not None,
+        repo_data=repo_data,
+        repo_creds=repo_creds,
+        mounts=mounts,
+    )
+    if code_blob is not None:
+        await runner.upload_code(code_blob)
+    await runner.run_job()
+    _last_handshake.pop(row["id"], None)
+    await ctx.db.execute(
+        "UPDATE jobs SET status = ? WHERE id = ?", (JobStatus.RUNNING.value, row["id"])
+    )
+    await _stage(ctx, row, "env_ready")
+    await bump_routing_epoch(ctx, row["run_id"], row["run_name"], row["project_id"])
+    await _register_service_replica(ctx, row, jpd, job_spec, tick)
+    logger.info(
+        "job %s (%s rank %d/%d) running",
+        job_spec.job_name, row["run_name"], job_spec.job_num, job_spec.jobs_per_replica,
+    )
+    ctx.kick("runs")
 
 
 async def _get_repo_payload(
@@ -626,6 +669,8 @@ async def _get_repo_payload(
 async def _pull_runner(
     ctx: ServerContext, row: sqlite3.Row, tick: Optional[_Tick] = None
 ) -> None:
+    if _debounced(_last_pull, row["id"], settings.RUNNER_PULL_DEBOUNCE):
+        return
     jpd = _jpd(ctx, row)
     if jpd is None:
         return
@@ -635,15 +680,13 @@ async def _pull_runner(
         ctx, row["instance_id"] or jpd.instance_id, jpd,
         ssh_private_key=project_row["ssh_private_key"],
     )
-    runner = conn.runner_client(port=_runner_port_override(row))
+    runner = conn.pooled_runner_client(port=_runner_port_override(row))
     runner.traceparent = await _run_traceparent(ctx, row, tick)
     try:
         resp = await runner.pull(row["runner_timestamp"])
     except Exception:
         await _handle_disconnect(ctx, row)
         return
-    finally:
-        await runner.close()
     await ctx.db.execute(
         "UPDATE jobs SET runner_timestamp = ?, disconnected_at = NULL WHERE id = ?",
         (resp.last_updated, row["id"]),
@@ -820,13 +863,11 @@ async def _terminate_job(
                 finally:
                     await shim.close()
             else:
-                runner = conn.runner_client()
+                runner = conn.pooled_runner_client()
                 try:
                     await runner.stop()
                 except Exception:
                     pass
-                finally:
-                    await runner.close()
         except Exception:
             logger.debug("could not reach agent while terminating job %s", row["id"][:8])
     await ctx.db.execute(

@@ -38,11 +38,16 @@ logger = logging.getLogger(__name__)
 
 async def process_runs(ctx: ServerContext) -> None:
     from dstack_tpu.server import settings
-    from dstack_tpu.server.background.concurrency import TickBuffer, for_each_claimed
+    from dstack_tpu.server.background.concurrency import (
+        TickBuffer,
+        for_each_claimed,
+        shard_scan,
+    )
 
-    rows = await ctx.db.fetchall(
+    rows = await shard_scan(
+        ctx,
         "SELECT * FROM runs WHERE status NOT IN ('terminated','failed','done')"
-        " AND deleted = 0 ORDER BY last_processed_at"
+        " AND deleted = 0{shard} ORDER BY last_processed_at",
     )
     ctx.tracer.inc("tick_rows_scanned", len(rows), processor="runs")
     if not rows:
@@ -471,14 +476,15 @@ async def _maybe_elastic_resize(
         # Resubmit the lost rank pinned to its kept instance: the submitted-
         # jobs processor sees instance_assigned and goes straight to
         # provisioning on the same runner agent.
+        job_id = generate_id()
         await ctx.db.execute(
             "INSERT INTO jobs (id, project_id, run_id, run_name, job_num,"
             " replica_num, submission_num, submitted_at, last_processed_at,"
             " status, job_spec, instance_id, instance_assigned,"
-            " job_provisioning_data)"
-            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, 1, ?)",
+            " job_provisioning_data, shard)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, 1, ?, ?)",
             (
-                generate_id(),
+                job_id,
                 j["project_id"],
                 j["run_id"],
                 j["run_name"],
@@ -491,6 +497,7 @@ async def _maybe_elastic_resize(
                 j["job_spec"],
                 j["instance_id"],
                 j["job_provisioning_data"],
+                shard_of(job_id),
             ),
         )
     await ctx.db.execute(

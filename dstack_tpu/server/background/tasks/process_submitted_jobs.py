@@ -38,6 +38,7 @@ from dstack_tpu.models.runs import (
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
 from dstack_tpu.server.services import offers as offers_service
+from dstack_tpu.server.services.shard_map import shard_of
 from dstack_tpu.utils.common import parse_dt, utcnow, utcnow_iso
 
 logger = logging.getLogger(__name__)
@@ -125,15 +126,17 @@ def _pool_candidate(ctx: ServerContext, irow: sqlite3.Row) -> Optional[dict]:
 
 async def process_submitted_jobs(ctx: ServerContext) -> None:
     from dstack_tpu.server import settings
-    from dstack_tpu.server.background.concurrency import for_each_claimed
+    from dstack_tpu.server.background.concurrency import for_each_claimed, shard_scan
 
     # Priority-then-anchor order: higher-priority runs' jobs place first, so
     # capacity freed by a preemption drain (services/preemption.py) is
     # claimed by the run that asked for it, not whichever job polled first.
-    rows = await ctx.db.fetchall(
+    rows = await shard_scan(
+        ctx,
         "SELECT j.* FROM jobs j JOIN runs r ON j.run_id = r.id"
-        " WHERE j.status = 'submitted'"
-        " ORDER BY r.priority DESC, j.last_processed_at"
+        " WHERE j.status = 'submitted'{shard}"
+        " ORDER BY r.priority DESC, j.last_processed_at",
+        column="j.shard",
     )
     ctx.tracer.inc("tick_rows_scanned", len(rows), processor="submitted_jobs")
     if not rows:
@@ -493,8 +496,8 @@ async def _commit_provisioned_slice(
             "INSERT INTO instances (id, project_id, fleet_id, name, instance_num,"
             " status, created_at, started_at, last_processed_at, backend, region,"
             " availability_zone, price, offer, job_provisioning_data, tpu_node,"
-            " tpu_worker_index, busy_blocks)"
-            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, 1)",
+            " tpu_worker_index, busy_blocks, shard)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, 1, ?)",
             (
                 instance_id,
                 run_row["project_id"],
@@ -513,6 +516,7 @@ async def _commit_provisioned_slice(
                 jpd.model_dump_json(),
                 jpd.tpu_node_id,
                 jpd.tpu_worker_index,
+                shard_of(instance_id),
             ),
         )
         await ctx.db.execute(

@@ -13,8 +13,12 @@ logger = logging.getLogger(__name__)
 
 
 async def process_volumes(ctx: ServerContext) -> None:
-    rows = await ctx.db.fetchall(
-        "SELECT * FROM volumes WHERE deleted = 0 AND status IN ('submitted', 'provisioning')"
+    from dstack_tpu.server.background.concurrency import shard_scan
+
+    rows = await shard_scan(
+        ctx,
+        "SELECT * FROM volumes WHERE deleted = 0"
+        " AND status IN ('submitted', 'provisioning'){shard}",
     )
     for row in rows:
         if not await ctx.claims.try_claim("volumes", row["id"]):
@@ -22,6 +26,7 @@ async def process_volumes(ctx: ServerContext) -> None:
         try:
             await _process_volume(ctx, row)
         except Exception:
+            ctx.tracer.inc("fsm_step_errors", namespace="volumes")
             logger.exception("failed to process volume %s", row["name"])
         finally:
             await ctx.claims.release("volumes", row["id"])

@@ -39,6 +39,12 @@ class ServerContext:
         # its previous incarnation's TTL.
         self.replica_id = settings.REPLICA_ID or uuid.uuid4().hex[:12]
         self.claims = ClaimLocker(db, self.replica_id, self.locker, tracer=self.tracer)
+        from dstack_tpu.server.services.shard_map import ShardMap
+
+        # Hash-partitioned FSM ownership: which slice of the run/job/
+        # instance tables this replica's background processors scan.
+        # Inert (scan everything) outside multi-replica deployments.
+        self.shard_map = ShardMap(db, self.claims, tracer=self.tracer)
         self.encryption = encryption or Encryption()
         self.backends: Dict[str, Any] = {}  # (project_id, type) -> Backend; see services/backends.py
         self.log_storage: Any = None  # set at startup; see services/logs.py

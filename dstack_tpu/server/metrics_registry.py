@@ -47,12 +47,20 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # Background FSM tick accounting.
     "dstack_tpu_tick_rows_scanned_total": ("counter", ("processor",)),
     "dstack_tpu_tick_rows_stepped_total": ("counter", ("processor",)),
+    # Sharded FSM (PR 11, services/shard_map.py): per-replica lease-shard
+    # ownership, rebalance churn (acquired/released/lost), and processor
+    # step failures — a crash-looping processor shows up here, not just
+    # in logs.
+    "dstack_tpu_fsm_shard_rebalances_total": ("counter", ("action",)),
+    "dstack_tpu_fsm_shards_owned": ("gauge", ()),
+    "dstack_tpu_fsm_step_errors_total": ("counter", ("namespace",)),
     # Failure-isolated serving tier (PR 9). Route staleness is seconds
     # since the data-plane worker's last successful epoch sync (0 when the
     # control plane is reachable); lease takeovers count expired foreign
     # leases stolen by this replica's ClaimLocker — the replica-kill chaos
     # drill asserts it goes positive on the survivor.
     "dstack_tpu_dataplane_route_staleness_seconds": ("gauge", ()),
+    "dstack_tpu_lease_renewal_failures_total": ("counter", ("namespace",)),
     "dstack_tpu_lease_takeovers_total": ("counter", ("namespace",)),
     # Per-run lifecycle stage durations (services/run_events.py): the
     # time each stage of the submit -> first-step/first-token path took,

@@ -9,6 +9,7 @@ from dstack_tpu.models.gateways import Gateway, GatewayConfiguration, GatewaySta
 from dstack_tpu.server.http import Request, Router
 from dstack_tpu.server.routers.deps import auth_project_member, get_ctx
 from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services.shard_map import shard_of
 from dstack_tpu.utils.common import parse_dt, utcnow_iso
 
 router = Router()
@@ -64,13 +65,15 @@ async def create_gateway(request: Request, project_name: str):
     if existing is not None:
         raise ResourceExistsError(f"Gateway {name} already exists")
     now = utcnow_iso()
+    gateway_id = generate_id()
     await ctx.db.execute(
         "INSERT INTO gateways (id, project_id, name, status, configuration,"
-        " created_at, last_processed_at, is_default) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        " created_at, last_processed_at, is_default, shard)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
         (
-            generate_id(), project_row["id"], name, GatewayStatus.SUBMITTED.value,
+            gateway_id, project_row["id"], name, GatewayStatus.SUBMITTED.value,
             body.configuration.model_dump_json(), now, now,
-            1 if body.configuration.default else 0,
+            1 if body.configuration.default else 0, shard_of(gateway_id),
         ),
     )
     ctx.kick("gateways")

@@ -104,6 +104,10 @@ async def prometheus_metrics(request: Request):
         )
     routing = ctx.routing_cache.stats()
     exp.add("dstack_tpu_proxy_routing_cache_hit_rate", {}, routing["hit_rate"])
+    # Sharded FSM: how many lease shards this replica's processors scan.
+    # 0 on an inactive (single-replica) shard map; the chaos shard-kill
+    # drill asserts the survivors' sum returns to FSM_SHARDS.
+    exp.add("dstack_tpu_fsm_shards_owned", {}, len(ctx.shard_map.owned()))
     # Lifecycle stage latencies (and any other tracer histograms) — the
     # quantile source the SLO autoscaler reads instead of EWMAs.
     for h in ctx.tracer.histogram_snapshot():

@@ -400,3 +400,33 @@ ALTER TABLE runs ADD COLUMN routing_epoch INTEGER NOT NULL DEFAULT 0;
 ALTER TABLE runs DROP COLUMN routing_epoch;
 """,
 )
+
+# Migration 10: hash-partitioned background FSM (services/shard_map.py).
+# `shard` persists the 256-bucket hash of the row id (last two hex chars
+# — see `shard_of` / `bucket_sql_expr`, which this backfill uses so the
+# SQL and Python hashes agree on every historical row). -1 is the
+# "unsharded" sentinel for rows inserted by code that predates the
+# column; every replica's scan predicate admits it and the shard-map
+# sweep promotes it to a real bucket. The indexes make shard-filtered
+# tick scans cheap, which is the entire point of the column. The
+# expression is substr/length/CASE only, so the same script runs on the
+# Postgres arm (translate_ddl rewrites types, never functions).
+from dstack_tpu.server.services.shard_map import FSM_TABLES, bucket_sql_expr
+
+migration(
+    "".join(
+        f"""
+ALTER TABLE {table} ADD COLUMN shard INTEGER NOT NULL DEFAULT -1;
+UPDATE {table} SET shard = {bucket_sql_expr("id")};
+CREATE INDEX ix_{table}_shard ON {table}(shard);
+"""
+        for table in FSM_TABLES
+    ),
+    down="".join(
+        f"""
+DROP INDEX ix_{table}_shard;
+ALTER TABLE {table} DROP COLUMN shard;
+"""
+        for table in FSM_TABLES
+    ),
+)

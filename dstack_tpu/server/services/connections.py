@@ -26,6 +26,7 @@ class AgentConnection:
         self.runner_url = runner_url
         self.shim_url = shim_url
         self.tunnel = tunnel
+        self._pooled_runners: Dict[Optional[int], RunnerClient] = {}
 
     def runner_client(self, port: Optional[int] = None) -> RunnerClient:
         if port is not None and self.tunnel is None:
@@ -46,6 +47,21 @@ class AgentConnection:
             )
         return RunnerClient(self.runner_url)
 
+    def pooled_runner_client(self, port: Optional[int] = None) -> RunnerClient:
+        """Keep-alive RunnerClient cached per target port for the life of
+        this connection. The FSM polls every running job's agent each tick;
+        a throwaway client per poll pays an httpx client build plus a TCP
+        connect per call, while this one rides a single keep-alive socket.
+        Callers must NOT close the returned client (close() here owns it);
+        `traceparent` is caller-set per step, so on a multi-job instance
+        interleaved steps may cross-attribute agent spans — cosmetic only.
+        """
+        client = self._pooled_runners.get(port)
+        if client is None:
+            client = self.runner_client(port)
+            self._pooled_runners[port] = client
+        return client
+
     def shim_client(self) -> ShimClient:
         assert self.shim_url is not None, "instance has no shim"
         return ShimClient(self.shim_url)
@@ -53,6 +69,21 @@ class AgentConnection:
     def close(self) -> None:
         if self.tunnel is not None:
             self.tunnel.close()
+        # Best-effort async close of the pooled HTTP clients: drop() is
+        # sync, so schedule the aclose when a loop is running and let GC
+        # reap the sockets otherwise (process teardown).
+        pooled, self._pooled_runners = self._pooled_runners, {}
+        if pooled:
+            import asyncio
+
+            from dstack_tpu.utils.tasks import spawn_logged
+
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            for client in pooled.values():
+                spawn_logged(client.close(), "close pooled runner client")
 
 
 class ConnectionPool:

@@ -35,6 +35,7 @@ from dstack_tpu.models.profiles import Profile
 from dstack_tpu.models.runs import JobProvisioningData, Requirements
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services.shard_map import shard_of
 from dstack_tpu.utils.common import parse_dt, utcnow_iso
 
 logger = logging.getLogger(__name__)
@@ -138,14 +139,15 @@ async def _create_ssh_instances(
             "ssh_private_key": host.ssh_key or conf.ssh_config.ssh_key,
             "internal_ip": host.internal_ip,
         }
+        instance_id = generate_id()
         await ctx.db.execute(
             "INSERT INTO instances (id, project_id, fleet_id, name, instance_num,"
             " status, created_at, last_processed_at, backend, region,"
-            " remote_connection_info) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            " remote_connection_info, shard) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
             (
-                generate_id(), project_id, fleet_id, f"{fleet_name}-{num}", num,
+                instance_id, project_id, fleet_id, f"{fleet_name}-{num}", num,
                 InstanceStatus.PENDING.value, now, now, BackendType.SSH.value,
-                "remote", json.dumps(rci),
+                "remote", json.dumps(rci), shard_of(instance_id),
             ),
         )
 
@@ -162,14 +164,16 @@ async def _create_pending_cloud_instance(
         ) if getattr(conf, k, None) is not None
     })
     requirements = Requirements(resources=conf.resources or None)
+    instance_id = generate_id()
     await ctx.db.execute(
         "INSERT INTO instances (id, project_id, fleet_id, name, instance_num, status,"
-        " created_at, last_processed_at, requirements, profile)"
-        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        " created_at, last_processed_at, requirements, profile, shard)"
+        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
         (
-            generate_id(), project_id, fleet_id, f"{fleet_name}-{num}", num,
+            instance_id, project_id, fleet_id, f"{fleet_name}-{num}", num,
             InstanceStatus.PENDING.value, now, now,
             requirements.model_dump_json(), profile.model_dump_json(),
+            shard_of(instance_id),
         ),
     )
 
@@ -225,20 +229,22 @@ async def provision_pending_instance(ctx: ServerContext, row: sqlite3.Row) -> No
                     ),
                 )
             else:
+                worker_id = generate_id()
                 await ctx.db.execute(
                     "INSERT INTO instances (id, project_id, fleet_id, name,"
                     " instance_num, status, created_at, started_at, idle_since,"
                     " last_processed_at, backend, region, availability_zone, price,"
-                    " offer, job_provisioning_data, tpu_node, tpu_worker_index)"
-                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    " offer, job_provisioning_data, tpu_node, tpu_worker_index, shard)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (
-                        generate_id(), row["project_id"], row["fleet_id"],
+                        worker_id, row["project_id"], row["fleet_id"],
                         f"{row['name']}-w{worker}", row["instance_num"] * 1000 + worker,
                         InstanceStatus.IDLE.value, now, now, now, now,
                         jpd.backend.value,
                         jpd.region, jpd.availability_zone, jpd.price,
                         offer.model_dump_json(), jpd.model_dump_json(),
                         jpd.tpu_node_id, jpd.tpu_worker_index,
+                        shard_of(worker_id),
                     ),
                 )
         logger.info("fleet instance %s provisioned (%d workers)", row["name"], len(jpds))

@@ -7,11 +7,14 @@ on sqlite the asyncio locksets are authoritative.
 """
 
 import asyncio
+import logging
 import time
 from contextlib import asynccontextmanager
 from typing import AsyncIterator, Dict, Iterable, List, Optional, Set, Tuple
 
 from dstack_tpu.utils.tasks import spawn_logged
+
+logger = logging.getLogger(__name__)
 
 
 class ResourceLocker:
@@ -98,13 +101,22 @@ class ClaimLocker:
         self._held: Set[Tuple[str, str]] = set()
 
     @property
-    def _distributed(self) -> bool:
+    def distributed(self) -> bool:
         # Lease rows only matter when another replica can contend; a
         # single-replica control plane (the default) keeps claims purely
         # in-process. Read dynamically so tests/deployments flip it.
         from dstack_tpu.server import settings
 
         return settings.MULTI_REPLICA and self._db.path != ":memory:"
+
+    # Historical spelling, still used in a few call sites/tests.
+    _distributed = distributed
+
+    def holds(self, namespace: str, key: str) -> bool:
+        """Whether this replica believes it holds the lease — i.e. it
+        acquired it and the renewal heartbeat has not reported it lost.
+        Only meaningful when `distributed`."""
+        return (namespace, key) in self._held
 
     async def try_claim(self, namespace: str, key: str) -> bool:
         """Non-blocking claim; the `SKIP LOCKED` equivalent for FSM polls."""
@@ -145,15 +157,24 @@ class ClaimLocker:
         for a full TTL. A renewal that finds no owned row means the lease
         expired and was stolen — mutual exclusion is already broken for
         that key, so scream and stop pretending to hold it."""
-        import logging
-
         for namespace, key in list(self._held):
             try:
                 renewed = await self._renew_lease(namespace, key)
             except Exception:
-                continue  # next heartbeat retries; worst case the lease expires
+                # Next heartbeat retries; worst case the lease expires.
+                # That worst case is exactly why a silent skip is wrong:
+                # a dying DB connection here lets EVERY lease lapse at
+                # once, so make each failure loud and countable.
+                logger.warning(
+                    "lease (%s, %s) renewal failed on replica %s; lease"
+                    " expires in <= ttl unless a later heartbeat succeeds",
+                    namespace, key, self.replica_id, exc_info=True,
+                )
+                if self.tracer is not None:
+                    self.tracer.inc("lease_renewal_failures", namespace=namespace)
+                continue
             if not renewed and (namespace, key) in self._held:
-                logging.getLogger(__name__).error(
+                logger.error(
                     "lease (%s, %s) lost by replica %s (expired and stolen, or"
                     " released concurrently); dropping from held set",
                     namespace, key, self.replica_id,

@@ -21,13 +21,36 @@ from dstack_tpu.agents.protocol import (
 )
 from dstack_tpu.errors import ServerError
 from dstack_tpu.models.runs import ClusterInfo, JobSpec
+from dstack_tpu.utils.imports import fail_fast_missing_optional
 from dstack_tpu.utils.tracecontext import TRACEPARENT_HEADER, child_traceparent
+
+# httpcore retries `import sniffio` on EVERY request (failed imports are
+# not cached by Python) — on boxes without it that is a full sys.path
+# scan per agent HTTP call. Probe once, fail fast forever after.
+fail_fast_missing_optional("sniffio")
 
 
 class AgentHTTPError(ServerError):
     def __init__(self, status: int, body: str):
         super().__init__(f"agent returned {status}: {body[:200]}")
         self.status = status
+
+
+_ssl_context = None
+
+
+def _shared_ssl_context():
+    """One SSL context for every agent client. httpx builds a fresh
+    context per AsyncClient by default, and `load_verify_locations`
+    costs ~7ms of pure CPU — decisive when the FSM constructs a client
+    per handshake attempt across hundreds of concurrent jobs (and agent
+    URLs are plain http anyway, so the context is never even used)."""
+    global _ssl_context
+    if _ssl_context is None:
+        import ssl
+
+        _ssl_context = ssl.create_default_context()
+    return _ssl_context
 
 
 class RunnerClient:
@@ -39,7 +62,7 @@ class RunnerClient:
         # traceparent (same trace_id, fresh span_id) so agent-side spans
         # join the run's trace.
         self.traceparent = traceparent
-        self._client = httpx.AsyncClient(timeout=timeout)
+        self._client = httpx.AsyncClient(timeout=timeout, verify=_shared_ssl_context())
 
     async def close(self) -> None:
         await self._client.aclose()
@@ -146,7 +169,7 @@ class ShimClient:
 
     def __init__(self, base_url: str, timeout: float = 20.0):
         self.base_url = base_url.rstrip("/")
-        self._client = httpx.AsyncClient(timeout=timeout)
+        self._client = httpx.AsyncClient(timeout=timeout, verify=_shared_ssl_context())
 
     async def close(self) -> None:
         await self._client.aclose()

@@ -36,6 +36,7 @@ from dstack_tpu.models.runs import (
 from dstack_tpu.models.users import User
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services.shard_map import shard_of
 from dstack_tpu.server.services import jobs as jobs_service
 from dstack_tpu.server.services import offers as offers_service
 from dstack_tpu.server.services import run_events
@@ -331,8 +332,8 @@ async def submit_run(
             await ctx.db.execute(
                 "INSERT INTO runs (id, project_id, user_id, run_name, submitted_at,"
                 " last_processed_at, status, run_spec, service_spec, desired_replica_count,"
-                " repo_id, priority, trace_context)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                " repo_id, priority, trace_context, shard)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                 (
                     run_id,
                     project_row["id"],
@@ -347,6 +348,7 @@ async def submit_run(
                     repo_row_id,
                     _run_priority(run_spec),
                     trace_context,
+                    shard_of(run_id),
                 ),
             )
             break
@@ -384,12 +386,13 @@ async def create_replica_jobs(
 ) -> None:
     now = utcnow_iso()
     for job_spec in jobs_service.get_job_specs(run_spec, replica_num):
+        job_id = generate_id()
         await ctx.db.execute(
             "INSERT INTO jobs (id, project_id, run_id, run_name, job_num, replica_num,"
-            " submission_num, submitted_at, last_processed_at, status, job_spec)"
-            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            " submission_num, submitted_at, last_processed_at, status, job_spec, shard)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
             (
-                generate_id(),
+                job_id,
                 project_id,
                 run_id,
                 run_spec.run_name,
@@ -400,6 +403,7 @@ async def create_replica_jobs(
                 now,
                 JobStatus.SUBMITTED.value,
                 job_spec.model_dump_json(),
+                shard_of(job_id),
             ),
         )
 

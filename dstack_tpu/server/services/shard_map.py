@@ -1,0 +1,284 @@
+"""Hash-partitioned background FSM: lease-backed shard ownership.
+
+PR 9 made N replicas *safe* (per-row leases in `resource_leases`), but
+every replica still scanned the whole runs/jobs/instances table and
+contended row-by-row, so aggregate FSM throughput stayed pinned at one
+replica's. This module partitions the work instead of just fencing it:
+
+- Every FSM row hashes into a fixed 256-bucket space, persisted in the
+  indexed `shard` column (migration 10). The bucket is a pure function
+  of the row id (`shard_of`, mirrored exactly by `bucket_sql_expr` for
+  in-database backfill), so it never needs recomputation.
+- `settings.FSM_SHARDS` lease shards divide the bucket space: lease
+  shard n owns every bucket b with b % FSM_SHARDS == n. Because the
+  persisted value is the 256-bucket hash, the shard-count knob can
+  change between boots without touching a single row.
+- Each live replica holds one `fsm-shard/<n>` lease per owned shard
+  (plus an `fsm-replica/<id>` presence lease for membership), all
+  renewed by the existing `renew_held` heartbeat. Replicas converge on
+  a fair share: an over-share incumbent voluntarily releases its
+  highest shards at its next tick (the joiner's steal happens at that
+  renewal boundary), and a SIGKILLed replica's shards become stealable
+  when its leases expire — blast radius is bounded by one lease TTL.
+- Tick queries filter on the owned buckets (`bucket_predicate` /
+  `background.concurrency.shard_scan`), so a replica's scan touches
+  only rows it owns. Per-row claims remain as the correctness backstop
+  during handoff windows: a shard moving between replicas can never
+  produce a double-step, only a short overlap of *attempts*.
+
+Sharding is entirely inert when the deployment is not multi-replica
+(`ClaimLocker.distributed` is False): `owned_buckets()` returns None
+and every scan stays whole-table, byte-for-byte the pre-shard behavior.
+"""
+
+import logging
+import time
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from dstack_tpu.server import settings
+
+logger = logging.getLogger(__name__)
+
+# Fixed hash space persisted in the `shard` column; never resized.
+SHARD_BUCKETS = 256
+
+# Tables carrying the persisted bucket (migration 10). `fleets` is
+# deliberately absent: fleet rows are few and fleet maintenance already
+# rides the instances it claims.
+FSM_TABLES = ("runs", "jobs", "instances", "volumes", "gateways")
+
+NS_SHARD = "fsm-shard"
+NS_REPLICA = "fsm-replica"
+
+# Rows inserted without an explicit bucket carry the sentinel; every
+# replica's scan predicate includes them (`shard < 0`) so nothing is
+# ever orphaned, and the backfill sweep assigns them a real bucket.
+UNSHARDED = -1
+
+_HEX = "0123456789abcdef"
+
+
+def shard_of(row_id: str) -> int:
+    """256-space bucket of a row id: the last two hex characters.
+
+    Row ids are `uuid4` strings, so the tail is uniformly distributed
+    hex. Non-hex characters (hand-written test ids) map to 15 per
+    nibble — the same ELSE arm `bucket_sql_expr` uses, so the Python
+    and SQL hashes can never disagree on any input.
+    """
+    hi = _HEX.find(row_id[-2]) if len(row_id) >= 2 else -1
+    lo = _HEX.find(row_id[-1]) if len(row_id) >= 1 else -1
+    return (hi if hi >= 0 else 15) * 16 + (lo if lo >= 0 else 15)
+
+
+def _hex_case(char_expr: str) -> str:
+    whens = " ".join(f"WHEN '{c}' THEN {i}" for i, c in enumerate(_HEX))
+    return f"CASE {char_expr} {whens} ELSE 15 END"
+
+
+def bucket_sql_expr(id_column: str = "id") -> str:
+    """Portable SQL expression equal to `shard_of(id_column)`.
+
+    Pure substr/length/CASE so it runs unmodified on both sqlite and
+    Postgres (`translate_ddl` only rewrites types, not functions) —
+    this is what lets migration 10 backfill in-database on both arms.
+    """
+    hi = _hex_case(f"substr({id_column}, length({id_column}) - 1, 1)")
+    lo = _hex_case(f"substr({id_column}, length({id_column}), 1)")
+    return f"(({hi}) * 16 + ({lo}))"
+
+
+# Per-table sweep for rows inserted with the UNSHARDED sentinel. Built
+# once here (static strings at the execute site would pin the checker's
+# attention on an idempotent pure-function-of-id write).
+_BACKFILL_SQL: Dict[str, str] = {
+    table: (
+        f"UPDATE {table} SET shard = {bucket_sql_expr('id')} WHERE shard < 0"
+    )
+    for table in FSM_TABLES
+}
+
+
+class ShardMap:
+    """Assigns FSM shards to live replicas through `resource_leases`.
+
+    One instance per server process, ticked every ttl/4 by the
+    background scheduler (channel "shard_map"). The tick is
+    crash-convergent: all state lives in lease rows, so any replica can
+    die or join at any point and the survivors re-derive a fair
+    assignment within one TTL.
+    """
+
+    def __init__(self, db, claims, shards: Optional[int] = None, tracer=None):
+        self._db = db
+        self._claims = claims
+        self.tracer = tracer
+        wanted = settings.FSM_SHARDS if shards is None else shards
+        self.shards = max(1, min(SHARD_BUCKETS, wanted))
+        self._owned: Set[int] = set()
+        # No successful tick yet: scan unfiltered so a replica is never
+        # idle during the boot/convergence window (claims dedupe).
+        self._ready = False
+
+    @property
+    def replica_id(self) -> str:
+        return self._claims.replica_id
+
+    @property
+    def active(self) -> bool:
+        """Sharding only matters when lease rows do."""
+        return self._claims.distributed
+
+    def owned(self) -> FrozenSet[int]:
+        """Lease shards this replica currently holds."""
+        return frozenset(self._owned)
+
+    def owned_buckets(self) -> Optional[FrozenSet[int]]:
+        """256-space buckets this replica should scan; None means scan
+        everything (inactive, not yet converged, or sole owner)."""
+        if not self.active or not self._ready:
+            return None
+        if len(self._owned) >= self.shards:
+            return None
+        return frozenset(
+            b for b in range(SHARD_BUCKETS) if b % self.shards in self._owned
+        )
+
+    def bucket_predicate(self, column: str = "shard") -> Tuple[str, Tuple[int, ...]]:
+        """SQL fragment (appended after a WHERE condition) restricting a
+        scan to owned buckets, plus its bind params. Empty fragment when
+        no filtering applies. Unassigned rows (`shard < 0`) always pass:
+        a forgotten INSERT site degrades to pre-shard contention on that
+        row, never to a stuck row."""
+        buckets = self.owned_buckets()
+        if buckets is None:
+            return "", ()
+        if not buckets:
+            return f" AND {column} < 0", ()
+        marks = ", ".join("?" for _ in buckets)
+        return f" AND ({column} IN ({marks}) OR {column} < 0)", tuple(sorted(buckets))
+
+    async def backfill(self) -> int:
+        """Assign real buckets to rows carrying the UNSHARDED sentinel.
+
+        Idempotent and claim-free by design: the written value is a pure
+        function of the immutable row id, so concurrent sweeps from two
+        replicas write identical bytes. Called at startup and from the
+        shard-0 owner's tick (exactly one sweeper once converged)."""
+        total = 0
+        for table in FSM_TABLES:
+            sql = _BACKFILL_SQL[table]
+
+            def _sweep(conn, _sql=sql) -> int:
+                return conn.execute(_sql).rowcount
+
+            total += await self._db.run_sync(_sweep)
+        if total:
+            logger.info("shard backfill assigned %d unsharded rows", total)
+        return total
+
+    async def tick(self) -> None:
+        """One rebalance round; never raises (the loop must outlive DB
+        hiccups — ownership degrades to lease expiry, not to a crash)."""
+        if not self.active:
+            if self._owned or self._ready:
+                self._owned.clear()
+                self._ready = False
+            return
+        try:
+            await self._tick()
+        except Exception:
+            logger.exception(
+                "shard map tick failed on replica %s", self.replica_id
+            )
+
+    async def _tick(self) -> None:
+        claims = self._claims
+
+        # Drop shards whose lease the heartbeat reported lost. release()
+        # also clears the stale in-process lock so the shard can be
+        # re-acquired later (the owner-checked DELETE is a no-op on a
+        # row someone else now owns).
+        for n in sorted(self._owned):
+            if not claims.holds(NS_SHARD, str(n)):
+                await claims.release(NS_SHARD, str(n))
+                self._owned.discard(n)
+                self._count("lost")
+
+        # Presence lease: how other replicas learn this one is alive.
+        if not claims.holds(NS_REPLICA, self.replica_id):
+            await claims.release(NS_REPLICA, self.replica_id)
+            await claims.try_claim(NS_REPLICA, self.replica_id)
+
+        now = time.time()
+        rows = await self._db.fetchall(
+            "SELECT namespace, key, owner, expires_at FROM resource_leases"
+            " WHERE namespace IN (?, ?)",
+            (NS_SHARD, NS_REPLICA),
+        )
+        live: Set[str] = {self.replica_id}
+        incumbents: Dict[int, Tuple[str, float]] = {}
+        for row in rows:
+            if row["namespace"] == NS_REPLICA:
+                if row["expires_at"] > now:
+                    live.add(row["owner"])
+                continue
+            try:
+                n = int(row["key"])
+            except ValueError:
+                continue
+            if 0 <= n < self.shards:
+                incumbents[n] = (row["owner"], row["expires_at"])
+
+        fair = -(-self.shards // len(live))  # ceil division
+
+        # Over fair share (a replica joined): release highest shards
+        # first — the joiner acquires them on its next tick. This IS the
+        # steal-at-renewal-boundary: rebalance latency is one heartbeat,
+        # not one TTL.
+        for n in sorted(self._owned, reverse=True):
+            if len(self._owned) <= fair:
+                break
+            await claims.release(NS_SHARD, str(n))
+            self._owned.discard(n)
+            self._count("released")
+
+        # Under fair share: acquire unowned or expired shards. The read
+        # gate skips live foreign leases without issuing a doomed write;
+        # the UPSERT in try_claim is still the only authority, so two
+        # racing acquirers resolve there, not here.
+        for n in range(self.shards):
+            if len(self._owned) >= fair:
+                break
+            if n in self._owned:
+                continue
+            incumbent = incumbents.get(n)
+            if (
+                incumbent is not None
+                and incumbent[0] != self.replica_id
+                and incumbent[1] > now
+            ):
+                continue
+            if await claims.try_claim(NS_SHARD, str(n)):
+                self._owned.add(n)
+                self._count("acquired")
+
+        self._ready = True
+
+        # Exactly one converged replica sweeps the unsharded sentinel
+        # (greedy acquisition from 0 means shard 0 always has an owner).
+        if 0 in self._owned:
+            await self.backfill()
+
+    async def close(self) -> None:
+        """Voluntarily hand back every shard + the presence lease so a
+        clean restart rebalances immediately instead of after one TTL."""
+        for n in sorted(self._owned):
+            await self._claims.release(NS_SHARD, str(n))
+        self._owned.clear()
+        await self._claims.release(NS_REPLICA, self.replica_id)
+        self._ready = False
+
+    def _count(self, action: str) -> None:
+        if self.tracer is not None:
+            self.tracer.inc("fsm_shard_rebalances", action=action)

@@ -14,6 +14,7 @@ from dstack_tpu.models.volumes import (
 )
 from dstack_tpu.server.context import ServerContext
 from dstack_tpu.server.security import generate_id
+from dstack_tpu.server.services.shard_map import shard_of
 from dstack_tpu.utils.common import parse_dt, utcnow_iso
 
 
@@ -62,7 +63,7 @@ async def create_volume(
     now = utcnow_iso()
     await ctx.db.execute(
         "INSERT INTO volumes (id, project_id, name, status, configuration, external,"
-        " created_at, last_processed_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        " created_at, last_processed_at, shard) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
         (
             volume_id,
             project_id,
@@ -72,6 +73,7 @@ async def create_volume(
             1 if configuration.volume_id else 0,
             now,
             now,
+            shard_of(volume_id),
         ),
     )
     ctx.kick("volumes")

@@ -33,6 +33,15 @@ MULTI_REPLICA = (
     or REPLICA_ID is not None
 )
 
+# Hash-partitioned background FSM (services/shard_map.py): number of
+# `fsm-shard/<n>` leases the live replicas divide between themselves.
+# Row ids hash into a fixed 256-bucket space persisted in the `shard`
+# column, so this knob can change between boots without a re-backfill —
+# lease shard n owns every bucket b with b % FSM_SHARDS == n. Sizing:
+# keep it a few × the largest replica count you plan to run so a joiner
+# can always steal a meaningful slice (16 is fine up to ~8 replicas).
+FSM_SHARDS = max(1, min(256, int(os.getenv("DSTACK_TPU_FSM_SHARDS", "16"))))
+
 # Background processing capacity (reference: background/__init__.py:40-46
 # documents 150 active jobs/runs/instances per replica at 2-4s ticks; the
 # event-driven scheduler here has no per-tick batch caps, these bound
@@ -68,6 +77,17 @@ METRICS_TTL_SECONDS = int(os.getenv("DSTACK_TPU_METRICS_TTL_SECONDS", "3600"))
 
 # Provisioning deadlines, seconds.
 RUNNER_READY_TIMEOUT = int(os.getenv("DSTACK_TPU_RUNNER_READY_TIMEOUT", "600"))
+# Minimum seconds between agent-handshake attempts for one provisioning
+# job. Kicks re-tick the running-jobs channel on every state change, so
+# without a floor a submit burst re-runs each booting job's full
+# handshake prelude per kick.
+RUNNER_HANDSHAKE_DEBOUNCE = float(
+    os.getenv("DSTACK_TPU_RUNNER_HANDSHAKE_DEBOUNCE", "0.4")
+)
+# Minimum seconds between /api/pull polls for one RUNNING job, for the
+# same reason: completion detection gains nothing from sub-second
+# re-polls, and each poll is a full HTTP round trip per job per kick.
+RUNNER_PULL_DEBOUNCE = float(os.getenv("DSTACK_TPU_RUNNER_PULL_DEBOUNCE", "0.4"))
 # How long a RUNNING job may lose contact with its runner before it is
 # failed as interrupted (flaky links tune it up; fail-fast tests down).
 RUNNER_DISCONNECT_GRACE = float(os.getenv("DSTACK_TPU_RUNNER_DISCONNECT_GRACE", "120"))

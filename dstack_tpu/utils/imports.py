@@ -1,0 +1,54 @@
+"""Negative import cache for known-missing optional dependencies.
+
+Python caches successful imports in sys.modules but retries failed ones
+from scratch: every `import sniffio` inside httpcore's per-request
+`current_async_library()` re-scans all of sys.path (and re-fills
+FileFinder caches whenever a path directory's mtime moved). On the
+capacity probe that was ~0.5ms of importlib work per agent HTTP call —
+several seconds per hundred runs, all spent failing the same import.
+
+`fail_fast_missing_optional(*names)` probes each module once; the ones
+that genuinely cannot be imported get a meta_path finder that raises
+ModuleNotFoundError immediately, preserving the ImportError semantics
+the caller's `except ImportError` fallback expects at ~zero cost.
+"""
+
+import importlib
+import sys
+
+_REGISTERED: set = set()
+
+
+class _FailFastFinder:
+    """sys.meta_path entry that short-circuits known-absent modules."""
+
+    def __init__(self):
+        self.names = set()
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname in self.names:
+            raise ModuleNotFoundError(
+                f"No module named {fullname!r}", name=fullname
+            )
+        return None
+
+
+_finder = _FailFastFinder()
+
+
+def fail_fast_missing_optional(*names: str) -> None:
+    """Make future imports of each genuinely-missing module fail fast.
+
+    Modules that DO import are left untouched (and stay in sys.modules),
+    so this is safe to call with optimistic lists.
+    """
+    for name in names:
+        if name in _REGISTERED:
+            continue
+        _REGISTERED.add(name)
+        try:
+            importlib.import_module(name)
+        except ImportError:
+            if _finder not in sys.meta_path:
+                sys.meta_path.insert(0, _finder)
+            _finder.names.add(name)

@@ -1,0 +1,17 @@
+"""Known-bad fixture: SHD01 — a background tick scan over an FSM table
+that bypasses the shard predicate (whole-table SELECT, no `{shard}`
+token, no id key), regressing a multi-replica deployment to every
+replica scanning and contending on all rows."""
+
+
+async def process_widgets(ctx):
+    rows = await ctx.db.fetchall(
+        "SELECT * FROM runs WHERE status = 'submitted' ORDER BY last_processed_at"
+    )
+    for row in rows:
+        await _step(ctx, row)
+
+
+async def _step(ctx, row):
+    if await ctx.claims.try_claim("runs", row["id"]):
+        await ctx.claims.release("runs", row["id"])

@@ -1,0 +1,28 @@
+"""Known-good fixture: SHD01-compliant background reads — the tick scan
+goes through shard_scan with a `{shard}` token, keyed lookups hydrate
+specific rows, and non-FSM tables are out of scope."""
+
+from dstack_tpu.server.background.concurrency import shard_scan
+
+
+async def process_widgets(ctx):
+    # Shard-aware tick scan: the token expands to the owned-bucket
+    # predicate on multi-replica servers, to nothing otherwise.
+    rows = await shard_scan(
+        ctx,
+        "SELECT * FROM runs WHERE status = 'submitted'{shard}"
+        " ORDER BY last_processed_at",
+    )
+    for row in rows:
+        run = await ctx.db.fetchone(
+            "SELECT * FROM runs WHERE id = ?", (row["id"],)
+        )
+        siblings = await ctx.db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (row["id"],)
+        )
+        del run, siblings
+
+
+async def sweep_bookkeeping(ctx):
+    # Not an FSM table: no shard column, no predicate required.
+    return await ctx.db.fetchall("SELECT * FROM run_events ORDER BY ts")

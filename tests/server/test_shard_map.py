@@ -1,0 +1,238 @@
+"""Shard-map rebalancing semantics under a frozen clock.
+
+The chaos drill (`make chaos-shard-kill`) proves takeover with real
+processes and real time; these tests pin the exact convergence rules of
+`services/shard_map.py` with a controllable clock patched into both the
+locking and shard_map modules:
+
+- replicas converge on a fair share (ceil(shards/replicas)) at 1, 2 and
+  4 replicas, with every shard owned by exactly one replica;
+- a dead replica's shards become stealable exactly when its leases
+  expire, and a survivor absorbs all of them on its next tick;
+- a joiner steals at the incumbent's renewal boundary: the incumbent
+  voluntarily releases its highest shards on the tick after it sees the
+  joiner's presence lease, no TTL wait involved;
+- the union of every replica's `bucket_predicate` covers each row
+  exactly once (no orphans, no double-scans), with unsharded sentinel
+  rows visible to everyone.
+"""
+
+import pytest
+
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services import locking as locking_mod
+from dstack_tpu.server.services import shard_map as shard_map_mod
+from dstack_tpu.server.services.locking import ClaimLocker, ResourceLocker
+from dstack_tpu.server.services.shard_map import (
+    NS_REPLICA,
+    NS_SHARD,
+    SHARD_BUCKETS,
+    ShardMap,
+    shard_of,
+)
+
+
+class _FrozenTime:
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def time(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+@pytest.fixture(autouse=True)
+def _multi_replica_mode():
+    from dstack_tpu.server import settings
+
+    old = settings.MULTI_REPLICA
+    settings.MULTI_REPLICA = True
+    yield
+    settings.MULTI_REPLICA = old
+
+
+@pytest.fixture
+def clock(monkeypatch) -> _FrozenTime:
+    frozen = _FrozenTime()
+    monkeypatch.setattr(locking_mod, "time", frozen)
+    monkeypatch.setattr(shard_map_mod, "time", frozen)
+    return frozen
+
+
+class _LeaseDb:
+    """Async fixtures aren't supported by the minimal test harness
+    (tests/conftest.py), so each test opens/closes the DB itself."""
+
+    def __init__(self, tmp_path):
+        self._path = str(tmp_path / "shards.db")
+        self.db = None
+
+    async def __aenter__(self) -> Database:
+        self.db = Database.from_url(self._path)
+        await self.db.connect()
+        return self.db
+
+    async def __aexit__(self, *exc) -> None:
+        await self.db.close()
+
+
+def _replica(db, replica_id: str, ttl: float = 10.0, shards: int = 16) -> ShardMap:
+    claims = ClaimLocker(db, replica_id, ResourceLocker(), ttl=ttl)
+    return ShardMap(db, claims, shards=shards)
+
+
+async def _converge(*maps: ShardMap, rounds: int = 6) -> None:
+    """Round-robin ticks until a full round changes nothing. The first
+    round never counts as stable: joiners announce presence during it,
+    which is precisely what destabilizes the incumbents' next round."""
+    stable_from = 1
+    for i in range(rounds):
+        before = [m.owned() for m in maps]
+        for m in maps:
+            await m.tick()
+        if i >= stable_from and [m.owned() for m in maps] == before:
+            return
+
+
+def _assert_partition(maps) -> None:
+    """Every shard owned by exactly one replica."""
+    all_owned = [n for m in maps for n in m.owned()]
+    assert sorted(all_owned) == sorted(set(all_owned)), all_owned
+    assert set(all_owned) == set(range(maps[0].shards)), all_owned
+
+
+async def test_single_replica_owns_everything(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _replica(db, "replica-a")
+        await a.tick()
+        assert a.owned() == frozenset(range(16))
+        # Sole owner scans unfiltered — the predicate is a no-op, so the
+        # single-replica fast path is byte-identical to pre-shard SQL.
+        assert a.owned_buckets() is None
+        assert a.bucket_predicate() == ("", ())
+
+
+async def test_fair_share_two_and_four_replicas(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _replica(db, "replica-a")
+        b = _replica(db, "replica-b")
+        await _converge(a, b)
+        assert sorted(len(m.owned()) for m in (a, b)) == [8, 8]
+        _assert_partition([a, b])
+
+        c = _replica(db, "replica-c")
+        d = _replica(db, "replica-d")
+        await _converge(a, b, c, d)
+        assert sorted(len(m.owned()) for m in (a, b, c, d)) == [4, 4, 4, 4]
+        _assert_partition([a, b, c, d])
+
+
+async def test_dead_replica_shards_stealable_at_expiry(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _replica(db, "replica-a", ttl=10.0)
+        b = _replica(db, "replica-b", ttl=10.0)
+        await _converge(a, b)
+        lost = sorted(b.owned())
+        assert len(lost) == 8
+
+        # b dies (no more renewals). One tick before expiry its leases
+        # are still live: a must not poach.
+        clock.advance(9.999)
+        await a._claims.renew_held()
+        await a.tick()
+        assert len(a.owned()) == 8
+
+        # At the expiry boundary the presence lease is gone, so live
+        # membership = {a}, fair = 16, and every expired shard lease is
+        # stealable in the same tick.
+        clock.advance(0.001)
+        await a._claims.renew_held()
+        await a.tick()
+        assert a.owned() == frozenset(range(16))
+        assert a.owned_buckets() is None
+
+
+async def test_joiner_steals_at_renewal_boundary(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _replica(db, "replica-a", ttl=10.0)
+        await a.tick()
+        assert len(a.owned()) == 16
+
+        b = _replica(db, "replica-b", ttl=10.0)
+        # Joiner's first tick: announces presence, but every shard lease
+        # is live and foreign — it acquires nothing, no TTL-long stall,
+        # no doomed writes.
+        await b.tick()
+        assert b.owned() == frozenset()
+
+        # Incumbent's next tick sees the joiner's presence lease and
+        # voluntarily releases its highest shards down to fair share —
+        # the clock has NOT advanced: rebalance latency is one heartbeat,
+        # not one TTL.
+        await a.tick()
+        assert a.owned() == frozenset(range(8))
+
+        await b.tick()
+        assert b.owned() == frozenset(range(8, 16))
+        _assert_partition([a, b])
+
+
+async def test_bucket_predicates_cover_every_row_exactly_once(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _replica(db, "replica-a")
+        b = _replica(db, "replica-b")
+        c = _replica(db, "replica-c")
+        await _converge(a, b, c)
+        _assert_partition([a, b, c])
+
+        # A scratch table keeps the test about the predicate, not the
+        # runs schema's foreign keys. Ids exercise every bucket plus the
+        # non-hex ELSE arm and the unsharded sentinel.
+        await db.execute("CREATE TABLE scratch (id TEXT, shard INTEGER)")
+        ids = [f"row-{i:02x}" for i in range(SHARD_BUCKETS)] + ["row-Z!"]
+        for row_id in ids:
+            await db.execute(
+                "INSERT INTO scratch (id, shard) VALUES (?, ?)",
+                (row_id, shard_of(row_id)),
+            )
+        await db.execute(
+            "INSERT INTO scratch (id, shard) VALUES ('row-unsharded', -1)"
+        )
+
+        seen = []
+        for m in (a, b, c):
+            clause, params = m.bucket_predicate()
+            rows = await db.fetchall(
+                f"SELECT id FROM scratch WHERE 1 = 1{clause}", params
+            )
+            seen.extend(r["id"] for r in rows)
+
+        sharded = [i for i in seen if i != "row-unsharded"]
+        # Every sharded row matched by exactly one replica's predicate.
+        assert sorted(sharded) == sorted(ids)
+        # The unsharded sentinel passes every replica's predicate, so a
+        # forgotten INSERT site degrades to contention, never to a
+        # stuck row.
+        assert seen.count("row-unsharded") == 3
+
+
+async def test_clean_close_releases_everything(tmp_path, clock):
+    async with _LeaseDb(tmp_path) as db:
+        a = _replica(db, "replica-a")
+        b = _replica(db, "replica-b")
+        await _converge(a, b)
+
+        await b.close()
+        rows = await db.fetchall(
+            "SELECT namespace, key FROM resource_leases"
+            " WHERE owner = 'replica-b' AND namespace IN (?, ?)",
+            (NS_SHARD, NS_REPLICA),
+        )
+        assert rows == []
+
+        # No clock movement needed: the survivor absorbs the released
+        # shards on its very next tick.
+        await a.tick()
+        assert a.owned() == frozenset(range(16))
