@@ -72,11 +72,12 @@ bench-proxy:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r09.json
 
 # Serving-engine benchmark: chunked prefill + paged KV with prefix
-# sharing (warmed-burst TTFT and shared-prefix accounting scenarios).
-# Results land in BENCH_serving_r08.json; see
+# sharing, speculative-decoding arms, and the r12 ragged-paged-attention
+# cells (no dense-view gather; see r10_comparison_note in the output).
+# Results land in BENCH_serving_r12.json; see
 # docs/guides/serving-tuning.md for how to read them.
 bench-serving:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r10.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r12.json
 
 # CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
 capacity-smoke:

@@ -146,6 +146,7 @@ def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
     from dstack_tpu.analysis.checkers.multi_replica import MultiReplicaLockChecker
+    from dstack_tpu.analysis.checkers.paged_gather import PagedGatherChecker
     from dstack_tpu.analysis.checkers.pool import PoolChecker
     from dstack_tpu.analysis.checkers.shard import ShardScanChecker
     from dstack_tpu.analysis.checkers.sql import SqlChecker
@@ -156,6 +157,7 @@ def default_checkers() -> List[Checker]:
         MultiReplicaLockChecker(),
         SqlChecker(),
         MetricsRegistryChecker(),
+        PagedGatherChecker(),
         PoolChecker(),
         ShardScanChecker(),
     ]

@@ -81,6 +81,9 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # prefix-cache effectiveness, chunked-prefill accounting, and the
     # admission counters behind the TTFT histogram.
     "dstack_tpu_serving_admitted_total": ("counter", ()),
+    # Ragged paged attention: jitted-program dispatches per
+    # implementation (path = "pallas" | "lax_ragged").
+    "dstack_tpu_serving_attn_dispatch_total": ("counter", ("path",)),
     "dstack_tpu_serving_kv_blocks_cached": ("gauge", ()),
     "dstack_tpu_serving_kv_blocks_in_use": ("gauge", ()),
     "dstack_tpu_serving_kv_cow_copies_total": ("counter", ()),

@@ -74,14 +74,34 @@ KV_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 
 def use_flash(
-    seq_len: int, head_dim: int, *, dtype_bytes: int = 2, interpret: bool = False
+    seq_len: int,
+    head_dim: int,
+    *,
+    dtype_bytes: int = 2,
+    interpret: bool = False,
+    kv_block_size: int = None,
 ) -> bool:
+    """Whether the fused Pallas path handles this shape on this backend.
+
+    With `kv_block_size` set, the caller attends over paged KV blocks
+    (paged_attention.ragged_attention): the kernel streams one
+    `kv_block_size`-row tile at a time, so the dense `seq % MIN_BLK`
+    rule would wrongly reject block-granular windows — the paged rules
+    are block-aligned seq and a single K+V tile within the VMEM budget.
+    """
     import os
 
     if os.getenv("DSTACK_TPU_FLASH_ATTENTION", "1") == "0":
         return False
     if not interpret and jax.default_backend() != "tpu":
         return False
+    if kv_block_size is not None:
+        tile_bytes = 2 * kv_block_size * head_dim * dtype_bytes  # K + V tile
+        return (
+            head_dim % 128 == 0
+            and seq_len % kv_block_size == 0
+            and tile_bytes <= KV_VMEM_BUDGET_BYTES
+        )
     kv_bytes = 2 * seq_len * head_dim * dtype_bytes  # K + V, one head
     return (
         head_dim % 128 == 0

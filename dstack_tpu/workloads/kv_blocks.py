@@ -16,21 +16,31 @@ table* row mapping its logical cache positions to pool blocks — plus:
 - `make_chunk_prefill`: prefill one budget-bounded token chunk of one
   prompt directly into the pool, so a long prompt interleaves with
   decode chunks instead of monopolizing the device;
-- `make_paged_decode_step`: gathers each slot's dense view from the
-  pool, runs the *same* per-token decode body as the dense path
-  (`serving._decode_body` — numerics cannot drift), and scatters only
-  the newly written rows back.
+- `make_paged_decode_step`: the per-token decode body against the pool,
+  sharing `serving._select_next_token` with the dense path so sampling
+  semantics cannot drift.
+
+Since r12, every attention in this module goes through
+`paged_attention.ragged_attention`: it attends STRAIGHT against the
+`(num_blocks, block_size, KV, hd)` pool indexed by the block tables,
+with a streaming softmax that walks one table column (one block) at a
+time. No program here materializes a dense `(max_len, ...)` per-slot
+view any more — the whole-pool `jnp.take(pool, block_tables, ...)`
+gather, the matching full-view scatter, and the engine's cross-chunk
+view cache that existed to amortize them are all gone (the static
+analyzer's KVB01 check keeps them gone). Each program's writes shrink
+to the handful of rows it actually produced, scattered by
+`(block, offset)` before the layer's attention so in-flight rows see
+themselves and their predecessors exactly as the dense body would.
 
 Correctness leans on two XLA facts (pallas_guide: gather/scatter modes):
 garbage in unwritten or stale pool blocks is harmless because attention
-masks positions `>= valid_len` with a `jnp.where` *before* softmax (all
-pool gathers use `mode="clip"` so padding never introduces NaN — a NaN
-value row would survive masking as `0 * NaN`), and all pool writes use
-`mode="drop"` with an out-of-bounds sentinel index (`num_blocks` for
-blocks, `max_len` for rows) so padded lanes simply vanish instead of
-clobbering block 0. Chunk writes into the gathered dense view use an
-explicit row scatter, never `lax.dynamic_update_slice` — DUS *clamps*
-the start index when `start + C` overruns, silently shifting the write.
+masks positions `>= valid_len` (and pad-sentinel table entries) *before*
+softmax (all pool gathers use `mode="clip"` so padding never introduces
+NaN — a NaN value row would survive masking as `0 * NaN`), and all pool
+writes use `mode="drop"` with an out-of-bounds sentinel index
+(`num_blocks` for blocks, `max_len` for rows) so padded or inactive
+lanes simply vanish instead of clobbering block 0.
 """
 
 import functools
@@ -44,10 +54,10 @@ from jax import lax
 
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.generate import (
-    _cached_attention,
     _nucleus_filter,
     sample_logits_row,
 )
+from dstack_tpu.workloads.paged_attention import ragged_attention
 from dstack_tpu.workloads.transformer import (
     linear,
     logits_linear,
@@ -316,12 +326,9 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
         bs = state.k.shape[2]
         nb = state.k.shape[1]
         mb = state.block_tables.shape[1]
-        ml = mb * bs
         offs = jnp.arange(C, dtype=jnp.int32)
         positions = start + offs                     # (C,)
         valid = offs < n_valid                       # (C,)
-        # Dense-view row index per chunk lane; padded lanes -> ml (drop).
-        rows_idx = jnp.where(valid, positions, ml)
         # Pool scatter targets; padded lanes -> block nb (drop).
         blk = jnp.take(
             table_row, jnp.clip(positions // bs, 0, mb - 1), mode="clip"
@@ -336,15 +343,14 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
         def body(x, layer):
             p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
             q, k, v = project_qkv(c, x, p, positions)
-            # Gather this slot's dense view (clip: pad entries read
-            # garbage that valid_len masks; never NaN-fill).
-            dk = jnp.take(ck, table_row, axis=0, mode="clip")
-            dv = jnp.take(cv, table_row, axis=0, mode="clip")
-            dk = dk.reshape(ml, *ck.shape[2:])[None]
-            dv = dv.reshape(ml, *cv.shape[2:])[None]
-            dk = dk.at[0, rows_idx].set(k[0].astype(dk.dtype), mode="drop")
-            dv = dv.at[0, rows_idx].set(v[0].astype(dv.dtype), mode="drop")
-            attn = _cached_attention(q, dk, dv, valid_len)
+            # Write the chunk's rows into the pool FIRST, then attend
+            # raggedly over the slot's blocks: row i sees cache
+            # positions <= start + i, including the rows just written.
+            # Padded lanes hit the sentinel block and drop; valid_len
+            # masks whatever garbage their attention rows read.
+            ck = ck.at[blk, off].set(k[0].astype(ck.dtype), mode="drop")
+            cv = cv.at[blk, off].set(v[0].astype(cv.dtype), mode="drop")
+            attn = ragged_attention(q, ck, cv, table_row[None], valid_len[None])
             x = x + linear(attn, p["wo"])
             if c.n_experts > 0:
                 from dstack_tpu.workloads.moe import moe_block
@@ -352,8 +358,6 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
                 x, _ = moe_block(c, x, p)
             else:
                 x = mlp_block(c, x, p)
-            ck = ck.at[blk, off].set(k[0].astype(ck.dtype), mode="drop")
-            cv = cv.at[blk, off].set(v[0].astype(cv.dtype), mode="drop")
             return x, (ck, cv)
 
         x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
@@ -384,122 +388,100 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
 
 
 def make_paged_decode_step(config: ModelConfig, steps: int = 1):
-    """decode_step(params, state, view_k, view_v, fresh, rng) ->
-    (state, view_k, view_v, tokens (B, steps), active) over a
-    PagedDecodeState — the paged twin of serving.make_decode_step.
+    """decode_steps(params, state, rng) -> (state, tokens (B, steps),
+    active) over a PagedDecodeState — the paged twin of
+    serving.make_decode_step.
 
-    One gather materializes every slot's dense view from the pool, the
-    dense decode body (`serving._decode_body` — the SAME traced function
-    the dense path jits, so the two cannot drift numerically) scans
-    `steps` tokens over it, and one scatter writes back only the
-    `steps` newly produced rows per slot. Gather/scatter cost is
-    amortized over the whole chunk. Distinct valid (slot, step) lanes
-    land in distinct (block, offset) cells — slots own disjoint blocks —
-    so the scatter has no collisions; lanes past a slot's final length
-    (inactive or retired mid-chunk) are dropped via the OOB block index.
+    Each of the `steps` per-token iterations writes the new row's K/V
+    straight into the slot's current block — one O(B)-row scatter — and
+    attends raggedly over the block tables
+    (`paged_attention.ragged_attention`). The whole-pool gather, the
+    full-view write-back, and the carried cross-chunk view cache of
+    r08-r10 are gone: steady-state decode touches only the blocks each
+    slot actually owns, and there is no cached view for boundary events
+    (prefill chunks, CoW copies, table growth, spec rounds) to
+    invalidate.
 
-    The dense view is additionally CARRIED across chunks: the caller
-    keeps the returned `view_k`/`view_v` (which include the chunk's new
-    rows — the scan wrote them) and passes them back with `fresh=False`
-    while no block table moved, so steady-state decode skips the
-    per-chunk whole-pool gather entirely (the bf16 steps_per_sync=4
-    single-stream regression in BENCH_serving_r08). Any event that
-    changes a table or writes the pool outside this program (prefill
-    chunk, CoW copy, table growth, spec round) must set `fresh=True` so
-    the next chunk re-gathers; `lax.cond` executes only the taken
-    branch, so a stale=False chunk never pays the gather. Peak memory is
-    unchanged — the non-cached variant materialized the same dense view
-    every chunk; it is merely kept alive between chunks now.
+    Sampling and retirement share `serving._select_next_token` — the
+    SAME traced tail as the dense `_decode_body` — so the two paths
+    cannot drift: temp-0 output is bit-exact vs the dense engine.
+    Inactive slots never write: their table rows may be stale (blocks
+    freed to the cache or another slot at retire), so their write lane
+    is pointed at the OOB sentinel block and dropped.
     """
     # Function-level import: serving imports this module at load time,
     # and engines construct only after both modules exist.
     from dstack_tpu.workloads import serving as _serving
 
-    one_step = _serving._decode_body(config)
+    c = config
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
-    def decode_steps(params, state: PagedDecodeState, view_k, view_v,
-                     fresh, rng):
-        L, nb, bs = state.k.shape[0], state.k.shape[1], state.k.shape[2]
+    def one_step(params, state: PagedDecodeState, rng):
+        nb, bs = state.k.shape[1], state.k.shape[2]
         B, mb = state.block_tables.shape
         ml = mb * bs
+        positions = state.lengths[:, None]           # (B, 1)
+        x = jnp.take(params["embed"], state.last_token[:, None], axis=0)
+        write_ok = state.active & (state.lengths < ml)
+        blk = jnp.take_along_axis(
+            state.block_tables,
+            jnp.clip(state.lengths[:, None] // bs, 0, mb - 1), axis=1,
+        )[:, 0]
+        blk = jnp.where(write_ok, blk, nb)
+        off = state.lengths % bs
+        valid_len = (state.lengths + 1)[:, None]     # (B, 1)
 
-        def gather(_):
-            gk = jnp.take(state.k, state.block_tables, axis=1, mode="clip")
-            gv = jnp.take(state.v, state.block_tables, axis=1, mode="clip")
-            return (gk.reshape(L, B, ml, *state.k.shape[3:]),
-                    gv.reshape(L, B, ml, *state.v.shape[3:]))
+        def body(x, layer):
+            p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
+            q, k, v = project_qkv(c, x, p, positions)
+            ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype), mode="drop")
+            cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype), mode="drop")
+            attn = ragged_attention(q, ck, cv, state.block_tables, valid_len)
+            x = x + linear(attn, p["wo"])
+            if c.n_experts > 0:
+                from dstack_tpu.workloads.moe import moe_block
 
-        dk, dv = lax.cond(fresh, gather, lambda _: (view_k, view_v),
-                          operand=None)
-        dstate = _serving.DecodeState(
-            k=dk, v=dv, lengths=state.lengths, last_token=state.last_token,
-            active=state.active, remaining=state.remaining,
-            temperature=state.temperature, top_p=state.top_p,
+                x, _ = moe_block(c, x, p)
+            else:
+                x = mlp_block(c, x, p)
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
+        h = rms_norm(x, params["final_norm"], c.norm_eps)
+        logits = logits_linear(h[:, -1], params["lm_head"])
+        next_token = _serving._select_next_token(state, logits, rng)
+
+        act = state.active
+        remaining = state.remaining - act.astype(jnp.int32)
+        new_active = act & (remaining > 0) & (state.lengths + 2 <= ml)
+        new_state = PagedDecodeState(
+            k=new_k,
+            v=new_v,
+            block_tables=state.block_tables,
+            lengths=state.lengths + act.astype(jnp.int32),
+            last_token=jnp.where(act, next_token, state.last_token),
+            active=new_active,
+            remaining=remaining,
+            temperature=state.temperature,
+            top_p=state.top_p,
         )
+        return new_state, jnp.where(act, next_token, -1), new_active
 
+    @functools.partial(jax.jit, donate_argnums=1)
+    def decode_steps(params, state: PagedDecodeState, rng):
         def body(carry, step_rng):
             st, _ = carry
             st, toks, active = one_step(params, st, step_rng)
             return (st, active), toks
 
-        (dstate, active), toks = lax.scan(
-            body, (dstate, state.active), jax.random.split(rng, steps)
+        (state, active), toks = lax.scan(
+            body, (state, state.active), jax.random.split(rng, steps)
         )
-
-        pos = state.lengths[:, None] + jnp.arange(steps, dtype=jnp.int32)[None, :]
-        written = (pos < dstate.lengths[:, None]) & (pos < ml)  # (B, steps)
-        blk = jnp.take_along_axis(
-            state.block_tables, jnp.clip(pos // bs, 0, mb - 1), axis=1
-        )
-        blk = jnp.where(written, blk, nb)
-        off = pos % bs
-        cp = jnp.clip(pos, 0, ml - 1)[None, :, :, None, None]
-        rows_k = jnp.take_along_axis(dstate.k, cp, axis=2)  # (L, B, steps, KV, hd)
-        rows_v = jnp.take_along_axis(dstate.v, cp, axis=2)
-        new_state = PagedDecodeState(
-            k=state.k.at[:, blk, off].set(rows_k, mode="drop"),
-            v=state.v.at[:, blk, off].set(rows_v, mode="drop"),
-            block_tables=state.block_tables,
-            lengths=dstate.lengths,
-            last_token=dstate.last_token,
-            active=dstate.active,
-            remaining=dstate.remaining,
-            temperature=dstate.temperature,
-            top_p=dstate.top_p,
-        )
-        return new_state, dstate.k, dstate.v, toks.T, dstate.active
+        return state, toks.T, active
 
     return decode_steps
 
 
 # -- speculative decoding (draft k cheap tokens, verify in one forward) -------
-
-
-def _spec_attention(q, ck, cv, valid_len):
-    """`generate._cached_attention` with a PER-SLOT valid length: q
-    (B, S, H, hd) against dense views ck/cv (B, ml, KV, hd), where row i
-    of slot b may attend cache positions < valid_len[b, i]. The verify
-    forward needs this because every slot sits at a different length —
-    the (S,)-shaped mask of the chunk-prefill path assumes one slot."""
-    from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
-
-    b, s, h, hd = q.shape
-    n_rep = h // ck.shape[2]
-    k = _repeat_kv(ck, n_rep)
-    v = _repeat_kv(cv, n_rep)
-    scale = hd ** -0.5
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    kpos = jnp.arange(ck.shape[1], dtype=jnp.int32)
-    mask = kpos[None, None, :] < valid_len[:, :, None]      # (B, S, ml)
-    logits = jnp.where(mask[:, None], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
-    )
-    return out.astype(q.dtype).reshape(b, s, h * hd)
 
 
 def _sampling_probs(logits, temps, top_ps):
@@ -528,52 +510,52 @@ def make_spec_draft(config: ModelConfig, k: int):
     last_token, active, temps, top_ps, rng) ->
     (draft_k', draft_v', drafts (B, k), qlogits (B, k, V)).
 
-    The drafter's half of a speculation round: gather each slot's dense
-    view from the DRAFTER pool (same block tables as the target — the
-    two pools are indexed by one allocator, so prefix sharing and CoW
-    decisions apply to both), run k+1 single-token drafter steps, and
-    scatter the k+1 new rows back. Step i feeds the previous token at
-    position lengths+i and proposes the next, so steps 0..k-1 yield
-    drafts d_1..d_k; step k's sampled token is discarded but its KV
-    write (row lengths+k, the KV of d_k) is what lets a fully accepted
-    round continue without a catch-up pass — the drafter's valid rows
-    always cover the target's new length, for ANY acceptance count.
+    The drafter's half of a speculation round: run k+1 single-token
+    drafter steps against the DRAFTER pool (same block tables as the
+    target — the two pools are indexed by one allocator, so prefix
+    sharing and CoW decisions apply to both), each step writing its row
+    straight into the pool (the window rows lengths..lengths+k were
+    privatized by the engine's `_ensure_spec_writable` before dispatch)
+    and attending raggedly over the tables. Step i feeds the previous
+    token at position lengths+i and proposes the next, so steps 0..k-1
+    yield drafts d_1..d_k; step k's sampled token is discarded but its
+    KV write (row lengths+k, the KV of d_k) is what lets a fully
+    accepted round continue without a catch-up pass — the drafter's
+    valid rows always cover the target's new length, for ANY acceptance
+    count.
 
     `qlogits` are the drafter's logits behind each draft: the verifier
     recomputes q(:) from them with the same `_sampling_probs` so the
     accept test u < p/q and the residual distribution max(p-q, 0) are
     exact (arXiv:2211.17192). Rows for inactive slots are never
-    scattered (their device table rows may be stale — the blocks could
-    have been freed to the cache or another slot at retire)."""
+    written (their device table rows may be stale — the blocks could
+    have been freed to the cache or another slot at retire): their
+    write lane is pointed at the OOB sentinel block and dropped."""
     c = config
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def spec_draft(params, draft_k, draft_v, block_tables, lengths,
                    last_token, active, temps, top_ps, rng):
-        L, nb, bs = draft_k.shape[0], draft_k.shape[1], draft_k.shape[2]
+        nb, bs = draft_k.shape[1], draft_k.shape[2]
         B, mb = block_tables.shape
         ml = mb * bs
-        dk = jnp.take(draft_k, block_tables, axis=1, mode="clip")
-        dv = jnp.take(draft_v, block_tables, axis=1, mode="clip")
-        dk = dk.reshape(L, B, ml, *draft_k.shape[3:])
-        dv = dv.reshape(L, B, ml, *draft_v.shape[3:])
-        rows = jnp.arange(B)
 
         def one(carry, step_rng):
-            dk, dv, pos, token = carry          # pos (B,), token (B,)
+            dk, dv, pos, token = carry          # dk/dv: the POOL
             x = jnp.take(params["embed"], token[:, None], axis=0)
-            write_rows = jnp.where(active & (pos < ml), pos, ml)
+            write_ok = active & (pos < ml)
+            blk = jnp.take_along_axis(
+                block_tables, jnp.clip(pos[:, None] // bs, 0, mb - 1), axis=1
+            )[:, 0]
+            blk = jnp.where(write_ok, blk, nb)
+            off = pos % bs
 
             def body(x, layer):
-                p, ck, cv = layer               # ck (B, ml, KV, hd)
+                p, ck, cv = layer           # ck (num_blocks, bs, KV, hd)
                 q, kk, vv = project_qkv(c, x, p, pos[:, None])
-                ck = ck.at[rows, write_rows].set(
-                    kk[:, 0].astype(ck.dtype), mode="drop"
-                )
-                cv = cv.at[rows, write_rows].set(
-                    vv[:, 0].astype(cv.dtype), mode="drop"
-                )
-                attn = _spec_attention(q, ck, cv, pos[:, None] + 1)
+                ck = ck.at[blk, off].set(kk[:, 0].astype(ck.dtype), mode="drop")
+                cv = cv.at[blk, off].set(vv[:, 0].astype(cv.dtype), mode="drop")
+                attn = ragged_attention(q, ck, cv, block_tables, pos[:, None] + 1)
                 x = x + linear(attn, p["wo"])
                 if c.n_experts > 0:
                     from dstack_tpu.workloads.moe import moe_block
@@ -594,26 +576,12 @@ def make_spec_draft(config: ModelConfig, k: int):
             nxt = jnp.where(temps > 0, sampled, greedy)
             return (dk, dv, pos + 1, nxt), (nxt, logits)
 
-        (dk, dv, _, _), (toks, qlogits) = lax.scan(
-            one, (dk, dv, lengths, last_token), jax.random.split(rng, k + 1)
+        (new_k, new_v, _, _), (toks, qlogits) = lax.scan(
+            one, (draft_k, draft_v, lengths, last_token),
+            jax.random.split(rng, k + 1)
         )
         drafts = toks[:k].T                         # (B, k): d_1..d_k
         qlogits = jnp.moveaxis(qlogits[:k], 0, 1)   # (B, k, V)
-
-        # Scatter the k+1 new rows back to the drafter pool (active
-        # slots only — see docstring).
-        pos = lengths[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
-        ok = active[:, None] & (pos < ml)
-        blk = jnp.take_along_axis(
-            block_tables, jnp.clip(pos // bs, 0, mb - 1), axis=1
-        )
-        blk = jnp.where(ok, blk, nb)
-        off = pos % bs
-        cp = jnp.clip(pos, 0, ml - 1)[None, :, :, None, None]
-        rows_k = jnp.take_along_axis(dk, cp, axis=2)
-        rows_v = jnp.take_along_axis(dv, cp, axis=2)
-        new_k = draft_k.at[:, blk, off].set(rows_k, mode="drop")
-        new_v = draft_v.at[:, blk, off].set(rows_v, mode="drop")
         return new_k, new_v, drafts, qlogits
 
     return spec_draft
@@ -625,10 +593,11 @@ def make_spec_verify(config: ModelConfig, k: int):
 
     The target's half of a speculation round, shaped like a chunked
     prefill over every slot at once: feed [last_token, d_1..d_k] at
-    positions lengths..lengths+k, write the k+1 rows into each slot's
-    gathered dense view, attend with per-slot valid lengths, and score
-    all k+1 positions in ONE forward — logits[:, j] conditions on the
-    drafts up to d_j exactly as the sequential decode body would.
+    positions lengths..lengths+k, write the k+1 rows straight into each
+    slot's pool blocks, attend raggedly with per-slot valid lengths,
+    and score all k+1 positions in ONE forward — logits[:, j]
+    conditions on the drafts up to d_j exactly as the sequential decode
+    body would.
 
     Acceptance per slot: greedy slots (temp 0) accept the leading run
     of drafts matching the target argmax — bit-exact with non-
@@ -640,19 +609,24 @@ def make_spec_verify(config: ModelConfig, k: int):
     retire conditions replicate `_decode_body`'s, so a speculative slot
     stops on exactly the token the plain path would have stopped on.
 
-    ROLLBACK IS BY CONSTRUCTION: only rows < the new length (the
-    accepted prefix + correction) are scattered to the pool — rejected
-    positions never reach it, so refcounted / cache-published blocks
-    cannot be corrupted by a failed speculation and lengths never
-    over-advance. `accepted` is the UNCAPPED accepted-draft count m
-    (for the engine's acceptance EWMAs); `emitted` rows use the decode
-    path's -1 padding convention so the engine's fan-out is shared."""
+    ROLLBACK IS LENGTH GATING OVER A PRIVATIZED WINDOW: all k+1 rows
+    are written to the pool (in-flight rows must be visible to later
+    positions' attention), but the engine's `_ensure_spec_writable`
+    copy-on-writes every block the window rows lengths..lengths+k
+    touch BEFORE each round, so rejected-draft KV lands only in blocks
+    this slot holds privately — refcounted / cache-published blocks
+    cannot be corrupted by a failed speculation. Lengths advance only
+    by the emitted count, so rejected rows sit past valid_len (masked
+    by every later attention) until the next round overwrites them.
+    `accepted` is the UNCAPPED accepted-draft count m (for the
+    engine's acceptance EWMAs); `emitted` rows use the decode path's
+    -1 padding convention so the engine's fan-out is shared."""
     c = config
     S = k + 1
 
     @functools.partial(jax.jit, donate_argnums=1)
     def spec_verify(params, state: PagedDecodeState, drafts, qlogits, rng):
-        L, nb, bs = state.k.shape[0], state.k.shape[1], state.k.shape[2]
+        nb, bs = state.k.shape[1], state.k.shape[2]
         B, mb = state.block_tables.shape
         ml = mb * bs
         lens = state.lengths
@@ -660,26 +634,25 @@ def make_spec_verify(config: ModelConfig, k: int):
         offs = jnp.arange(S, dtype=jnp.int32)
         tokens = jnp.concatenate([state.last_token[:, None], drafts], axis=1)
         positions = lens[:, None] + offs[None, :]            # (B, S)
-        write_rows = jnp.where(positions < ml, positions, ml)
-        batch_rows = jnp.arange(B)[:, None]
-
-        dk = jnp.take(state.k, state.block_tables, axis=1, mode="clip")
-        dv = jnp.take(state.v, state.block_tables, axis=1, mode="clip")
-        dk = dk.reshape(L, B, ml, *state.k.shape[3:])
-        dv = dv.reshape(L, B, ml, *state.v.shape[3:])
+        # Pool targets for the k+1 in-flight rows; inactive slots (their
+        # tables may be stale) and rows past the cache -> sentinel, drop.
+        ok_w = act0[:, None] & (positions < ml)
+        blk = jnp.take_along_axis(
+            state.block_tables, jnp.clip(positions // bs, 0, mb - 1), axis=1
+        )
+        blk = jnp.where(ok_w, blk, nb)
+        off = positions % bs
 
         x = jnp.take(params["embed"], tokens, axis=0)        # (B, S, d)
 
         def body(x, layer):
-            p, ck, cv = layer                                # ck (B, ml, ...)
+            p, ck, cv = layer                    # ck (num_blocks, bs, KV, hd)
             q, kk, vv = project_qkv(c, x, p, positions)
-            ck = ck.at[batch_rows, write_rows].set(
-                kk.astype(ck.dtype), mode="drop"
+            ck = ck.at[blk, off].set(kk.astype(ck.dtype), mode="drop")
+            cv = cv.at[blk, off].set(vv.astype(cv.dtype), mode="drop")
+            attn = ragged_attention(
+                q, ck, cv, state.block_tables, positions + 1
             )
-            cv = cv.at[batch_rows, write_rows].set(
-                vv.astype(cv.dtype), mode="drop"
-            )
-            attn = _spec_attention(q, ck, cv, positions + 1)
             x = x + linear(attn, p["wo"])
             if c.n_experts > 0:
                 from dstack_tpu.workloads.moe import moe_block
@@ -687,18 +660,9 @@ def make_spec_verify(config: ModelConfig, k: int):
                 x, _ = moe_block(c, x, p)
             else:
                 x = mlp_block(c, x, p)
-            # Keep the chunk's new rows as scan outputs: the pool
-            # scatter happens AFTER acceptance is known, so rejected
-            # rows are simply never written.
-            new_rows_k = jnp.take_along_axis(
-                ck, jnp.clip(positions, 0, ml - 1)[:, :, None, None], axis=1
-            )
-            new_rows_v = jnp.take_along_axis(
-                cv, jnp.clip(positions, 0, ml - 1)[:, :, None, None], axis=1
-            )
-            return x, (new_rows_k, new_rows_v)
+            return x, (ck, cv)
 
-        x, (rows_k, rows_v) = lax.scan(body, x, (params["layers"], dk, dv))
+        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
         h = rms_norm(x, params["final_norm"], c.norm_eps)
         logits = logits_linear(h, params["lm_head"])         # (B, S, V)
 
@@ -762,17 +726,9 @@ def make_spec_verify(config: ModelConfig, k: int):
         )[:, 0]
         new_last = jnp.where(n_emit > 0, last_emitted, state.last_token)
 
-        # Pool scatter of ONLY the accepted region (rows lens..new_len-1
-        # hold the KV of last_token, d_1..d_{n_emit-1}).
-        ok_write = (offs[None, :] < n_emit[:, None]) & (positions < ml)
-        blk = jnp.take_along_axis(
-            state.block_tables, jnp.clip(positions // bs, 0, mb - 1), axis=1
-        )
-        blk = jnp.where(ok_write, blk, nb)
-        off = positions % bs
         new_state = PagedDecodeState(
-            k=state.k.at[:, blk, off].set(rows_k, mode="drop"),
-            v=state.v.at[:, blk, off].set(rows_v, mode="drop"),
+            k=new_k,
+            v=new_v,
             block_tables=state.block_tables,
             lengths=new_len,
             last_token=new_last,

@@ -1,0 +1,318 @@
+"""Ragged paged attention: attend straight over the block-table pool.
+
+The paged-KV engine (workloads/kv_blocks.py) stores every slot's KV cache
+as scattered `(block_size, KV, hd)` blocks inside one shared
+`(L, num_blocks, block_size, KV, hd)` pool, indexed by per-slot block
+tables. Until r12 every attention consumer first *gathered* a slot's
+blocks into a dense `(max_len, KV, hd)` scratch view and ran dense
+attention over it — a whole-pool data movement per dispatch that
+BENCH_serving_r10 measured at −63.6% single-stream throughput vs the
+dense engine, despite a cross-chunk view cache built solely to amortize
+it. This module deletes that trade entirely: attention runs directly
+against the pool, vLLM-PagedAttention-style, one block at a time with a
+streaming softmax, and the dense view is never materialized.
+
+Two implementations behind one dispatch seam (`ragged_attention`):
+
+- `_ragged_attention_pallas`: a Pallas TPU kernel. Block tables ride in
+  as scalar-prefetch operands (pallas_guide: PrefetchScalarGridSpec) so
+  each grid step's BlockSpec index_map resolves `tables[b, j]` into the
+  pool's block axis and the DMA engine streams exactly that
+  `(block_size, hd)` K/V tile HBM→VMEM — the gather IS the index_map.
+  Softmax state (running max m, denominator l, unnormalized output o)
+  accumulates in VMEM scratch across the innermost grid axis, the
+  standard flash accumulation (same math as `attention._block_attend`).
+  Pad-sentinel table entries (== num_blocks) clamp to a real block in
+  the index_map and are masked out of the logits, as are rows at or
+  beyond each query's `valid_len`. Validated on CPU via interpret=True.
+
+- `_ragged_attention_lax`: pure-lax fallback for CPU tests and
+  bench_serving. Two `lax.fori_loop` passes walk the table columns —
+  softmax stats first (running max + rescaled denominator), then the PV
+  accumulation with probabilities normalized at the final stats and
+  quantized to q.dtype, reproducing the flat softmax's rounding profile
+  (see the function docstring: temperature-0 bit-exactness against the
+  dense engine depends on it). Each step gathers only the current
+  `(B, block_size)` block column — O(B·block_size) transient memory,
+  never a dense `(max_len)` view. Both loops are capped at the number
+  of columns any live row actually needs, so short contexts don't pay
+  for the table tail.
+
+Both paths mask, scale, and accumulate identically, so the
+interpret-mode parity test (tests/test_paged_attention.py) pins them
+together to f32 rounding (the kernel folds its softmax into one pass;
+on the test's f32 inputs the quantization casts are no-ops).
+
+Semantics: query row (b, i) attends cache positions `p < valid_len[b, i]`
+in slot b's context; position p lives at block `tables[b, p // bs]`, row
+`p % bs` of the pool. Garbage in masked rows (unwritten blocks, pad
+sentinels, stale reuse) never reaches the softmax.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dstack_tpu.workloads.attention import NEG_INF, _repeat_kv
+
+__all__ = ["ragged_attention", "dispatch_path"]
+
+
+def dispatch_path(
+    max_len: int,
+    head_dim: int,
+    kv_block_size: int,
+    *,
+    dtype_bytes: int = 2,
+    interpret: bool = False,
+) -> str:
+    """Which implementation `ragged_attention` will run for this geometry.
+
+    Static (shape + backend) decision, resolved at trace time — the
+    serving engine calls it once at construction to label the
+    `dstack_tpu_serving_attn_dispatch_total{path=...}` counter without a
+    device sync. Delegates to `flash_attention.use_flash` with the paged
+    block geometry so the dense-prefill seq-divisibility rule doesn't
+    apply (the kernel streams block_size-granular tiles; max_len only
+    needs to be block-aligned, which the pool guarantees).
+    """
+    from dstack_tpu.workloads.flash_attention import use_flash
+
+    ok = use_flash(
+        max_len,
+        head_dim,
+        dtype_bytes=dtype_bytes,
+        interpret=interpret,
+        kv_block_size=kv_block_size,
+    )
+    return "pallas" if ok else "lax_ragged"
+
+
+def ragged_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,
+    valid_len: jnp.ndarray,
+    *,
+    impl: Optional[str] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged paged attention over one layer's block pool.
+
+    q:        (B, S, H, hd)      queries (S=1 decode, S=k+1 verify, S=C chunk)
+    k_pool:   (NB, bs, KV, hd)   one layer of the shared block pool
+    v_pool:   (NB, bs, KV, hd)
+    tables:   (B, MB) int32      per-slot block tables, pad sentinel == NB
+    valid_len:(B, S) int32       row (b, i) attends positions < valid_len[b, i]
+
+    Returns (B, S, H*hd) in q.dtype, matching the dense consumers' shape.
+    """
+    if impl is None:
+        impl = dispatch_path(
+            tables.shape[1] * k_pool.shape[1],
+            q.shape[-1],
+            k_pool.shape[1],
+            dtype_bytes=k_pool.dtype.itemsize,
+            interpret=interpret,
+        )
+    if impl == "pallas":
+        return _ragged_attention_pallas(
+            q, k_pool, v_pool, tables, valid_len, interpret=interpret
+        )
+    return _ragged_attention_lax(q, k_pool, v_pool, tables, valid_len)
+
+
+# ------------------------------------------------------------- lax fallback
+
+
+def _ragged_attention_lax(q, k_pool, v_pool, tables, valid_len):
+    """Gather-free fallback: two fori_loop passes over table columns.
+
+    Per step the only gather is `jnp.take(pool, tables[:, j])` — one
+    (B, bs, KV, hd) block column, clip-guarded against the pad sentinel
+    and masked before the softmax. Pass 1 streams the softmax stats
+    (running max, rescaled denominator); pass 2 accumulates the PV
+    product with the probabilities normalized at the FINAL (m, l) and
+    quantized to q.dtype first. That quantization is deliberate: the
+    dense consumers this path replaced (generate._cached_attention,
+    attention.decode_attention) all run
+    `softmax(logits).astype(q.dtype)` before PV, and the serving tests
+    pin the engine bit-exact against them at temperature 0 — near-tied
+    logits (observed gaps under 1e-2) flip the argmax if the paged path
+    keeps f32 probabilities the flat path rounded away. Recomputing the
+    QK logits in pass 2 costs one extra (B, S, bs) einsum per column and
+    buys exactness without any (max_len)-sized scratch.
+    """
+    b, s, h, hd = q.shape
+    nb, bs, kv, _ = k_pool.shape
+    mb = tables.shape[1]
+    n_rep = h // kv
+    scale = hd ** -0.5
+
+    # Columns any live row needs: garbage-masked steps past this are pure
+    # no-ops, so skip them (short contexts in a MB-wide table).
+    n_cols = jnp.minimum((jnp.max(valid_len) + bs - 1) // bs, mb)
+
+    def _block(j):
+        """Masked logits for table column j plus the clamped block ids.
+
+        Same dtype/scale placement as the flat reference: the einsum
+        takes q/k in storage dtype with an f32 accumulator, scale lands
+        on the f32 logits.
+        """
+        col = lax.dynamic_index_in_dim(tables, j, axis=1, keepdims=False)
+        safe = jnp.clip(col, 0, nb - 1)
+        kb = _repeat_kv(jnp.take(k_pool, safe, axis=0), n_rep)
+        logits = jnp.einsum(
+            "bshd,bthd->bhst", q, kb, preferred_element_type=jnp.float32
+        ) * scale  # (B, H, S, bs)
+        pos = j * bs + jnp.arange(bs, dtype=jnp.int32)
+        ok = (pos[None, None, :] < valid_len[:, :, None]) & (
+            col < nb
+        )[:, None, None]  # (B, S, bs)
+        return jnp.where(ok[:, None], logits, NEG_INF), safe
+
+    def stats(j, carry):
+        m, l = carry  # (B, H, S, 1) f32
+        logits, _ = _block(j)
+        blk_m = jnp.maximum(
+            jnp.max(logits, axis=-1, keepdims=True), NEG_INF / 2
+        )
+        m_new = jnp.maximum(m, blk_m)
+        blk_l = jnp.sum(jnp.exp(logits - m_new), axis=-1, keepdims=True)
+        return m_new, l * jnp.exp(m - m_new) + blk_l
+
+    m0 = jnp.full((b, h, s, 1), NEG_INF / 2, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    m, l = lax.fori_loop(0, n_cols, stats, (m0, l0))
+    l = jnp.maximum(l, 1e-30)
+
+    def accum(j, o):
+        logits, safe = _block(j)
+        vb = _repeat_kv(jnp.take(v_pool, safe, axis=0), n_rep)
+        p = (jnp.exp(logits - m) / l).astype(q.dtype)
+        return o + jnp.einsum(
+            "bhst,bthd->bhsd", p, vb, preferred_element_type=jnp.float32
+        )
+
+    o = lax.fori_loop(0, n_cols, accum, jnp.zeros((b, h, s, hd), jnp.float32))
+    return o.astype(q.dtype).transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+
+
+# ------------------------------------------------------------ pallas kernel
+
+
+def _paged_kernel(
+    t_ref,  # scalar prefetch: (B, MB) block tables in SMEM
+    q_ref,  # (1, S, 1, hd)
+    vlen_ref,  # (1, 1, S)
+    k_ref,  # (1, bs, 1, hd) — the block the index_map resolved for step j
+    v_ref,  # (1, bs, 1, hd)
+    o_ref,  # (1, S, 1, hd), revisited across the innermost grid axis
+    acc_ref,  # VMEM scratch (S, hd) f32
+    m_ref,  # VMEM scratch (S, 1) f32
+    l_ref,  # VMEM scratch (S, 1) f32
+    *,
+    n_cols: int,
+    block_size: int,
+    num_pool_blocks: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF / 2)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Storage-dtype operands with f32 accumulation, scale applied to the
+    # f32 logits — the same placement as attention._block_attend.
+    q = q_ref[0, :, 0, :]  # (S, hd)
+    k = k_ref[0, :, 0, :]  # (bs, hd)
+    v = v_ref[0, :, 0, :]
+    logits = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (S, bs)
+    # 2D iota (TPU requires >= 2D): key positions per logits column.
+    pos = j * block_size + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    ok = pos < vlen_ref[0, 0, :][:, None]
+    # Pad-sentinel columns clamp to block NB-1 in the index_map; mask
+    # everything they contributed.
+    ok &= t_ref[b, j] < num_pool_blocks
+    logits = jnp.where(ok, logits, NEG_INF)
+
+    blk_m = jnp.maximum(jnp.max(logits, axis=-1, keepdims=True), NEG_INF / 2)
+    p = jnp.exp(logits - blk_m)
+    blk_l = jnp.sum(p, axis=-1, keepdims=True)
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, blk_m)
+    alpha = jnp.exp(m_prev - m_new)
+    beta = jnp.exp(blk_m - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_ref[...] * alpha + blk_l * beta
+    pv = lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (S, hd)
+    acc_ref[...] = acc_ref[...] * alpha + beta * pv
+
+    @pl.when(j == n_cols - 1)
+    def _emit():
+        o_ref[0, :, 0, :] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ragged_attention_pallas(q, k_pool, v_pool, tables, valid_len, *, interpret=False):
+    b, s, h, hd = q.shape
+    nb, bs, kv, _ = k_pool.shape
+    mb = tables.shape[1]
+    n_rep = h // kv
+    grid = (b, h, mb)
+
+    def _table_block(bi, hi, ji, t):
+        # The gather IS the index_map: scalar-prefetched tables steer the
+        # DMA straight at the slot's j-th block (sentinel clamps in-range;
+        # the kernel masks its rows).
+        return (jnp.minimum(t[bi, ji], nb - 1), 0, hi // n_rep, 0)
+
+    kernel = functools.partial(
+        _paged_kernel,
+        n_cols=mb,
+        block_size=bs,
+        num_pool_blocks=nb,
+        scale=hd ** -0.5,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, s, 1, hd), lambda bi, hi, ji, t: (bi, 0, hi, 0)),
+                pl.BlockSpec((1, 1, s), lambda bi, hi, ji, t: (bi, 0, 0)),
+                pl.BlockSpec((1, bs, 1, hd), _table_block),
+                pl.BlockSpec((1, bs, 1, hd), _table_block),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, s, 1, hd), lambda bi, hi, ji, t: (bi, 0, hi, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((s, hd), jnp.float32),
+                pltpu.VMEM((s, 1), jnp.float32),
+                pltpu.VMEM((s, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, s, h, hd), q.dtype),
+        interpret=interpret,
+    )(tables, q, valid_len[:, None, :].astype(jnp.int32), k_pool, v_pool)
+    return out.reshape(b, s, h * hd)

@@ -23,8 +23,9 @@ Three kinds of jitted program run the engine:
   on device AND flips the slot live — admission needs no insert program
   and no host round-trip;
 - paged decode_step: `steps_per_sync` tokens for ALL active slots per
-  host sync (gather dense views → the shared dense decode body →
-  scatter new rows back);
+  host sync, attending raggedly over the block tables
+  (paged_attention.ragged_attention — no dense view is ever gathered)
+  and writing only each step's new row;
 - copy_block: the device half of copy-on-write.
 
 First tokens are delivered by a dedicated reader thread the moment the
@@ -34,10 +35,10 @@ decode chunk still runs, so TTFT no longer pays the decode-chunk
 residual (the 191 ms term in BENCH_serving_r06 at steps_per_sync=32).
 
 The dense primitives (DecodeState / make_prefill / make_insert /
-make_decode_step) remain the reference semantics — `_decode_body` is
-the single traced decode-step body both paths share, and
-tests/test_serving_paged.py pins chunked+paged token streams to them
-bit-exactly at temperature 0.
+make_decode_step) remain the reference semantics — the paged decode
+body shares `_select_next_token` with the dense `_decode_body`, and
+tests/test_serving_paged.py pins chunked+paged token streams to the
+dense reference bit-exactly at temperature 0.
 """
 
 import functools
@@ -68,6 +69,9 @@ from dstack_tpu.workloads.kv_blocks import (
     make_paged_decode_step,
     make_spec_draft,
     make_spec_verify,
+)
+from dstack_tpu.workloads.paged_attention import (
+    dispatch_path as attn_dispatch_path,
 )
 from dstack_tpu.workloads.quant import quantize_params
 from dstack_tpu.workloads.transformer import (
@@ -198,13 +202,55 @@ def _any_active_sampling(state) -> jnp.ndarray:
     return jnp.any(state.active & (state.temperature > 0.0))
 
 
+def _select_next_token(state, logits, rng):
+    """Per-slot next-token selection: scale by each slot's temperature
+    (guarded so greedy slots don't divide by 0 — their sampled value is
+    unused), nucleus-filter by each slot's top_p, then select greedy vs
+    sampled per slot. top_p == 1 masks nothing (the strict `<` keeps
+    every token whose PRECEDING cumulative mass is < p, so the top token
+    always survives and p=1 keeps all).
+
+    The ONE traced sampling tail both cache layouts run: the dense
+    `_decode_body` and the paged ragged decode body
+    (kv_blocks.make_paged_decode_step) call it on their respective
+    states (DecodeState / PagedDecodeState — same scalar field names),
+    so the two paths cannot drift in sampling semantics.
+
+    Two nested runtime branches keep the DEFAULT paths free: an
+    all-greedy batch (every live temp 0) never scales, filters, or
+    draws gumbels — it compiles back to the argmax-only step; a
+    sampling batch with every live top_p=1 skips the vocab-wide
+    sort/cumsum. lax.cond executes one branch at runtime, so each
+    skipped stage costs only its predicate."""
+    temps = state.temperature
+
+    def _sample(x):
+        scaled = x / jnp.maximum(temps, 1e-6)[:, None]
+        filtered = lax.cond(
+            _any_active_nucleus(state),
+            lambda s: jax.vmap(_nucleus_filter)(s, state.top_p),
+            lambda s: s,
+            scaled,
+        )
+        return jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
+
+    sampled = lax.cond(
+        _any_active_sampling(state),
+        _sample,
+        lambda x: jnp.zeros((x.shape[0],), jnp.int32),  # value unused
+        logits,
+    )
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
 def _decode_body(config: ModelConfig):
     """one_step(params, state, rng) -> (state, tokens (B,), active) — the
-    single-token decode body. The ONE traced definition both cache
-    layouts run: make_decode_step scans it over the dense DecodeState,
-    and kv_blocks.make_paged_decode_step scans it over dense views
-    gathered from the block pool — so the paged path cannot drift
-    numerically from the dense reference."""
+    single-token dense decode body scanned by make_decode_step. The
+    paged engine runs its own ragged body against the block pool
+    (kv_blocks.make_paged_decode_step) but shares `_select_next_token`,
+    so the paged path cannot drift from the dense reference in
+    sampling or retirement semantics."""
     c = config
 
     def one_step(params, state: DecodeState, rng):
@@ -233,37 +279,7 @@ def _decode_body(config: ModelConfig):
         x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
         h = rms_norm(x, params["final_norm"], c.norm_eps)
         logits = logits_linear(h[:, -1], params["lm_head"])
-        # Per-slot sampling: scale by each slot's temperature (guarded so
-        # greedy slots don't divide by 0 — their sampled value is unused),
-        # nucleus-filter by each slot's top_p, then select greedy vs
-        # sampled per slot. top_p == 1 masks nothing (the strict `<`
-        # keeps every token whose PRECEDING cumulative mass is < p, so
-        # the top token always survives and p=1 keeps all).
-        temps = state.temperature
-        # Two nested runtime branches keep the DEFAULT paths free:
-        # an all-greedy batch (every live temp 0) never scales, filters,
-        # or draws gumbels — it compiles back to the argmax-only step;
-        # a sampling batch with every live top_p=1 skips the vocab-wide
-        # sort/cumsum. lax.cond executes one branch at runtime, so each
-        # skipped stage costs only its predicate.
-        def _sample(x):
-            scaled = x / jnp.maximum(temps, 1e-6)[:, None]
-            filtered = lax.cond(
-                _any_active_nucleus(state),
-                lambda s: jax.vmap(_nucleus_filter)(s, state.top_p),
-                lambda s: s,
-                scaled,
-            )
-            return jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
-
-        sampled = lax.cond(
-            _any_active_sampling(state),
-            _sample,
-            lambda x: jnp.zeros((x.shape[0],), jnp.int32),  # value unused
-            logits,
-        )
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        next_token = jnp.where(temps > 0, sampled, greedy)
+        next_token = _select_next_token(state, logits, rng)
 
         act = state.active
         remaining = state.remaining - act.astype(jnp.int32)
@@ -444,6 +460,15 @@ class ServingEngine:
         self._chunk_cache: Dict[int, Any] = {}
         self._step = make_paged_decode_step(config, steps=steps_per_sync)
         self._copy_block = make_copy_block()
+        # Which ragged-attention implementation this engine's geometry
+        # dispatches (static per engine: shape + backend decide), and
+        # how many jitted-program dispatches ran it — exposed as
+        # dstack_tpu_serving_attn_dispatch_total{path=...}.
+        self._attn_path = attn_dispatch_path(
+            self.max_len, config.head_dim, kv_block_size,
+            dtype_bytes=jnp.dtype(config.activation_dtype).itemsize,
+        )
+        self._attn_dispatch = {"pallas": 0, "lax_ragged": 0}
         # -- speculative decoding (drafter proposes k, target verifies
         # k+1 in one forward; see kv_blocks.make_spec_draft/_verify).
         self._spec = bool(spec_enable)
@@ -555,16 +580,6 @@ class ServingEngine:
         self.state = init_paged_state(
             config, slots, self.max_len, kv_block_size, self._num_blocks
         )
-        # Carried dense view for the decode step (kv_blocks.
-        # make_paged_decode_step): while no block table moves and no
-        # program outside the decode step writes the pool, chunks skip
-        # the whole-pool re-gather (the r08 bf16 steps_per_sync=4
-        # single-stream regression). Any such event sets _view_fresh.
-        c = config
-        vshape = (c.n_layers, slots, self.max_len, c.n_kv_heads, c.head_dim)
-        self._view_k = jnp.zeros(vshape, c.activation_dtype)
-        self._view_v = jnp.zeros(vshape, c.activation_dtype)
-        self._view_fresh = True
         # Admission control: None = unbounded (library embedding decides);
         # servers should bound it — see EngineOverloadedError.
         self.max_pending = max_pending
@@ -843,6 +858,14 @@ class ServingEngine:
             ) if self._slot_k else 0.0,
             "spec_draft_seconds_total": round(self._t_spec_draft, 4),
             "spec_verify_seconds_total": round(self._t_spec_verify, 4),
+            # Ragged-attention dispatch: which implementation this
+            # engine's geometry selects (static) and how many jitted
+            # programs ran it (chunk prefills, decode chunks, spec
+            # draft/verify forwards).
+            "attn_path": self._attn_path,
+            "attn_dispatch_pallas_total": self._attn_dispatch["pallas"],
+            "attn_dispatch_lax_ragged_total":
+                self._attn_dispatch["lax_ragged"],
         }
 
     def close(self) -> None:
@@ -971,7 +994,6 @@ class ServingEngine:
                             self._draft_state = self._copy_draft_block(
                                 self._draft_state, src, dst
                             )
-                        self._view_fresh = True
                         task.table[idx] = b
                 else:
                     b = self._alloc.alloc()
@@ -1054,7 +1076,7 @@ class ServingEngine:
                 self.params, self.state, *chunk_args, sub,
                 jnp.asarray(final, bool),
             )
-            self._view_fresh = True
+            self._attn_dispatch[self._attn_path] += 1
             if self._spec:
                 # The drafter prefills the same chunk into ITS pool
                 # through the same table — prefix-cache hits skip both
@@ -1064,6 +1086,7 @@ class ServingEngine:
                     self._draft_params, self._draft_state, *chunk_args,
                     dsub, jnp.asarray(final, bool),
                 )
+                self._attn_dispatch[self._attn_path] += 1
             task.pos += n
             budget -= n
             self._prefill_chunks += 1
@@ -1210,7 +1233,6 @@ class ServingEngine:
                     jnp.asarray(updates[s], jnp.int32),
                 )
             self.state = self.state._replace(block_tables=bt)
-            self._view_fresh = True
 
     def _ensure_spec_writable(self, k: int) -> None:
         """Copy-on-write pass over each live slot's speculation write
@@ -1263,7 +1285,6 @@ class ServingEngine:
                     jnp.asarray(updates[s], jnp.int32),
                 )
             self.state = self.state._replace(block_tables=bt)
-            self._view_fresh = True
 
     def _force_retire(self, slot: int, error: BaseException) -> None:
         req = self._live[slot]
@@ -1354,13 +1375,10 @@ class ServingEngine:
                     t_pf = time.monotonic()
                     # 2) Dispatch the decode chunk (async), sync on it.
                     self._rng, sub = jax.random.split(self._rng)
-                    (self.state, self._view_k, self._view_v, tokens,
-                     active) = self._step(
-                        self.params, self.state, self._view_k,
-                        self._view_v, jnp.asarray(self._view_fresh, bool),
-                        sub,
+                    self.state, tokens, active = self._step(
+                        self.params, self.state, sub
                     )
-                    self._view_fresh = False
+                    self._attn_dispatch[self._attn_path] += 1
                     toks = jax.device_get(tokens)  # (B, steps_per_sync)
                     still = jax.device_get(active)
                     t_sync = time.monotonic()
@@ -1434,7 +1452,7 @@ class ServingEngine:
         still = jax.device_get(active)
         acc = jax.device_get(accepted)
         t_sync = time.monotonic()
-        self._view_fresh = True  # verify wrote the pool behind the view
+        self._attn_dispatch[self._attn_path] += 2  # draft + verify programs
         self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
         self._t_decode += t_sync - t_pf
         self._t_spec_draft += t_draft - t_pf
@@ -1586,6 +1604,15 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
     for name, mtype, value in series:
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name} {value}")
+    # Ragged-attention dispatch counter, labeled by implementation path
+    # (the registry declares the ("path",) label set).
+    attn = "dstack_tpu_serving_attn_dispatch_total"
+    lines.append(f"# TYPE {attn} counter")
+    for path in ("pallas", "lax_ragged"):
+        lines.append(
+            f'{attn}{{path="{path}"}}'
+            f' {stats.get(f"attn_dispatch_{path}_total", 0)}'
+        )
     # TTFT as a real histogram (declared base dstack_tpu_serving_ttft_seconds;
     # the _bucket/_sum/_count series derive from it). Older stats snapshots
     # without ttft_hist degrade to the sum/count pair.

@@ -1,0 +1,13 @@
+"""KVB01 fixture: the pre-r12 dense-view gather the ragged path deleted.
+
+Gathering the pool by a WHOLE block table materializes the dense
+(B, max_len, KV, hd) scratch view that paged_attention.ragged_attention
+exists to avoid.
+"""
+
+import jax.numpy as jnp
+
+
+def make_dense_view(k_pool, block_tables):
+    dk = jnp.take(k_pool, block_tables, axis=1, mode="clip")
+    return dk.reshape(dk.shape[0], -1, dk.shape[3], dk.shape[4])

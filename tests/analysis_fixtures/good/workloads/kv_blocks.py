@@ -1,0 +1,20 @@
+"""KVB01-clean: the ragged idioms kv_blocks.py is allowed to use.
+
+Indexing a single table column, or gathering through a COMPUTED index
+expression (clip of positions, one dynamic column), never materializes
+the dense view — only bare whole-table indices are banned.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def ragged_column_step(k_pool, tables, j, nb):
+    col = lax.dynamic_index_in_dim(tables, j, axis=1, keepdims=False)
+    safe = jnp.clip(col, 0, nb - 1)
+    return jnp.take(k_pool, safe, axis=0)
+
+
+def rows_to_blocks(table_row, positions, bs, mb):
+    blk = jnp.take(table_row, jnp.clip(positions // bs, 0, mb - 1), mode="clip")
+    return blk, positions % bs

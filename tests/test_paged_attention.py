@@ -1,0 +1,244 @@
+"""Ragged paged attention: kernel/fallback parity, dispatch, exactness.
+
+The r12 contract (docs/guides/serving-tuning.md, "Ragged paged
+attention"): attention over the block pool never materializes a dense
+`(max_len)` view, the Pallas kernel (interpret=True on CPU) and the
+pure-lax fallback implement the SAME streaming-softmax update, and the
+engine's temp-0 output stays bit-exact vs the dense `generate()`
+reference at lengths that are multiples of neither chunk nor block size
+— through decode, chunked prefill, and full speculation rounds.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dstack_tpu.workloads.attention import _repeat_kv
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.generate import generate
+from dstack_tpu.workloads.paged_attention import (
+    _ragged_attention_lax,
+    _ragged_attention_pallas,
+    dispatch_path,
+    ragged_attention,
+)
+from dstack_tpu.workloads.serving import ServingEngine, prometheus_metrics
+from dstack_tpu.workloads.transformer import init_params
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _ragged_inputs(seed, B, S, H, KV, hd, NB, bs, MB):
+    """Random pool + ragged tables with pad sentinels and per-row
+    valid lengths that straddle block boundaries."""
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((B, S, H, hd)).astype(np.float32)
+    kp = rng.standard_normal((NB, bs, KV, hd)).astype(np.float32)
+    vp = rng.standard_normal((NB, bs, KV, hd)).astype(np.float32)
+    tables = np.full((B, MB), NB, np.int32)
+    nblk = rng.integers(1, MB + 1, B)
+    blocks = rng.permutation(NB)[: int(nblk.sum())]
+    c = 0
+    for b in range(B):
+        tables[b, : nblk[b]] = blocks[c : c + nblk[b]]
+        c += nblk[b]
+    vlen = np.stack(
+        [rng.integers(1, nblk[b] * bs + 1, S) for b in range(B)]
+    ).astype(np.int32)
+    return (
+        jnp.asarray(q),
+        jnp.asarray(kp),
+        jnp.asarray(vp),
+        jnp.asarray(tables),
+        jnp.asarray(vlen),
+    )
+
+
+def _flat_softmax_reference(q, k_pool, v_pool, tables, valid_len):
+    """Dense flat-softmax oracle: densify the view (test-only!) and mask
+    per row — the pre-r12 `_spec_attention` semantics."""
+    B, S, H, hd = q.shape
+    NB, bs, KV, _ = k_pool.shape
+    MB = tables.shape[1]
+    safe = jnp.clip(tables, 0, NB - 1)
+    dk = jnp.take(k_pool, safe, axis=0).reshape(B, MB * bs, KV, hd)
+    dv = jnp.take(v_pool, safe, axis=0).reshape(B, MB * bs, KV, hd)
+    k = _repeat_kv(dk, H // KV).astype(jnp.float32)
+    v = _repeat_kv(dv, H // KV).astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k) * (
+        hd ** -0.5
+    )
+    kpos = jnp.arange(MB * bs)
+    real = jnp.repeat(tables < NB, bs, axis=1)  # sentinel blocks masked
+    mask = (kpos[None, None, :] < valid_len[:, :, None]) & real[:, None, :]
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.astype(q.dtype).reshape(B, S, H * hd)
+
+
+SHAPES = (
+    # (B, S, H, KV, hd, NB, bs, MB): decode-, verify-, and chunk-shaped.
+    (3, 1, 4, 2, 32, 16, 8, 6),
+    (2, 5, 4, 4, 32, 12, 8, 5),
+    (1, 16, 8, 2, 128, 20, 16, 4),
+)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_pallas_interpret_matches_lax_fallback(shape):
+    """Both implementations share one streaming-softmax update rule —
+    interpret-mode kernel output must match the fallback bit-tightly on
+    identical inputs (sentinel-padded tables, ragged valid lengths)."""
+    q, kp, vp, tables, vlen = _ragged_inputs(7, *shape)
+    got_lax = _ragged_attention_lax(q, kp, vp, tables, vlen)
+    got_pal = _ragged_attention_pallas(q, kp, vp, tables, vlen, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got_pal), np.asarray(got_lax), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_ragged_matches_flat_softmax_reference(shape):
+    """The streaming accumulation equals a flat masked softmax over the
+    densified view (the pre-r12 semantics) to f32 accuracy."""
+    q, kp, vp, tables, vlen = _ragged_inputs(11, *shape)
+    ref = _flat_softmax_reference(q, kp, vp, tables, vlen)
+    got = ragged_attention(q, kp, vp, tables, vlen)  # lax path on CPU
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ragged_rows_never_see_masked_garbage():
+    """NaN planted in unwritten pool blocks and past valid_len must not
+    leak: masking happens before the softmax, not after."""
+    q, kp, vp, tables, vlen = _ragged_inputs(3, 2, 2, 4, 2, 32, 10, 8, 4)
+    tables_np = np.asarray(tables)
+    poison = np.array(kp)
+    unused = sorted(set(range(10)) - set(tables_np[tables_np < 10].tolist()))
+    poison[unused] = np.nan
+    # Poison rows past each row's valid length inside used blocks too.
+    vlen_np = np.asarray(vlen)
+    out = ragged_attention(
+        q, jnp.asarray(poison), vp, tables, jnp.minimum(vlen, 9)
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_paged_dispatch_rules():
+    """use_flash with paged-block geometry: the dense seq % 128 rule must
+    not reject block-granular windows; the CPU backend without interpret
+    still falls back; undersized head_dim still falls back."""
+    from dstack_tpu.workloads.flash_attention import use_flash
+
+    # 72 is not a multiple of the dense MIN_BLK=128: rejected dense,
+    # admitted paged (block size 8 divides it).
+    assert not use_flash(72, 128, interpret=True)
+    assert use_flash(72, 128, interpret=True, kv_block_size=8)
+    # Paged admission still needs block-aligned windows and lane-tiled
+    # head_dim.
+    assert not use_flash(70, 128, interpret=True, kv_block_size=8)
+    assert not use_flash(72, 64, interpret=True, kv_block_size=8)
+    # Off-TPU without interpret: always the lax fallback.
+    assert not use_flash(72, 128, kv_block_size=8)
+    assert dispatch_path(72, 128, 8) == "lax_ragged"
+    assert dispatch_path(72, 128, 8, interpret=True) == "pallas"
+    # The tiny test preset (head_dim 32) runs the fallback everywhere.
+    assert dispatch_path(96, CFG.head_dim, 8, interpret=True) == "lax_ragged"
+
+
+def test_env_kill_switch_forces_fallback(monkeypatch):
+    monkeypatch.setenv("DSTACK_TPU_FLASH_ATTENTION", "0")
+    assert dispatch_path(72, 128, 8, interpret=True) == "lax_ragged"
+
+
+# ------------------------------------------------- engine-level exactness
+
+
+def _drain(q):
+    out = []
+    while True:
+        tok = q.get(timeout=60)
+        if isinstance(tok, BaseException):
+            raise tok
+        if tok is None:
+            return out
+        out.append(tok)
+
+
+def _reference(params, prompt, n):
+    toks = generate(
+        CFG, params, jnp.asarray([prompt], dtype=jnp.int32),
+        max_new_tokens=n, temperature=0.0,
+    )
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n):
+    return [(i * 37 + seed * 13 + 5) % 100 + 1 for i in range(n)]
+
+
+def test_engine_temp0_exact_decode_and_chunk_prefill_awkward(params):
+    """Decode + chunked prefill through the ragged path at lengths that
+    are multiples of neither chunk (16) nor block (8), crossing block
+    boundaries mid-decode — bit-exact vs the dense reference."""
+    engine = ServingEngine(CFG, params, slots=4, max_len=96,
+                           prefill_chunk_tokens=16, kv_block_size=8)
+    try:
+        for seed, n, new in ((1, 5, 9), (2, 27, 8), (3, 33, 11)):
+            p = _prompt(seed, n)
+            assert _drain(engine.submit(p, max_new_tokens=new)) == \
+                _reference(params, p, new), f"len={n}"
+    finally:
+        engine.close()
+
+
+def test_engine_temp0_exact_spec_round_adversarial_drafter(params):
+    """A full speculation round through the ragged draft + verify paths,
+    against a random-init drafter (worst case: most drafts rejected, the
+    rollback path exercised every round) at an awkward prompt length —
+    still bit-exact vs the dense reference."""
+    drafter = init_params(CFG, jax.random.PRNGKey(7))
+    engine = ServingEngine(
+        CFG, params, slots=2, max_len=96, prefill_chunk_tokens=16,
+        kv_block_size=8, spec_enable=True, spec_max_draft=3,
+        spec_draft_params=drafter, spec_min_accept=0.0,
+    )
+    try:
+        p = _prompt(5, 27)
+        assert _drain(engine.submit(p, max_new_tokens=10)) == \
+            _reference(params, p, 10)
+        assert engine.stats()["spec_rounds_total"] > 0
+    finally:
+        engine.close()
+
+
+def test_attn_dispatch_counter_exposed(params):
+    """The engine reports which attention path it dispatches and how
+    often: stats() carries the per-path counters, the Prometheus
+    exposition renders the labeled series, and on CPU every dispatch is
+    the lax fallback."""
+    from dstack_tpu.server.metrics_registry import METRICS
+
+    engine = ServingEngine(CFG, params, slots=2, max_len=32)
+    try:
+        _drain(engine.submit([5, 7, 11], max_new_tokens=3))
+        st = engine.stats()
+        text = prometheus_metrics(st)
+    finally:
+        engine.close()
+    assert st["attn_path"] == "lax_ragged"
+    assert st["attn_dispatch_lax_ragged_total"] > 0
+    assert st["attn_dispatch_pallas_total"] == 0
+    assert METRICS["dstack_tpu_serving_attn_dispatch_total"] == (
+        "counter", ("path",)
+    )
+    assert 'dstack_tpu_serving_attn_dispatch_total{path="lax_ragged"}' in text
+    assert 'dstack_tpu_serving_attn_dispatch_total{path="pallas"} 0' in text

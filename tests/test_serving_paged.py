@@ -223,13 +223,21 @@ def test_prometheus_metrics_matches_registry(params):
             _, _, name, mtype = line.split()
             assert name in METRICS, f"undeclared series {name}"
             assert METRICS[name][0] == mtype, name
-            assert METRICS[name][1] == (), name  # serving series: no labels
+            # Serving series carry no labels, except the r12 attention
+            # dispatch counter (path=pallas|lax_ragged) — its samples are
+            # checked against the declared label set below.
+            if name != "dstack_tpu_serving_attn_dispatch_total":
+                assert METRICS[name][1] == (), name
             seen.add(name)
         else:
             name, _, value = line.partition(" ")
             base = name.partition("{")[0]
             assert base in seen or histogram_base(base) in seen, \
                 f"sample before TYPE: {name}"
+            if base == "dstack_tpu_serving_attn_dispatch_total":
+                assert name in (
+                    base + '{path="pallas"}', base + '{path="lax_ragged"}'
+                ), name
             sampled.add(base)
             float(value)
     for expected in ("dstack_tpu_serving_kv_blocks_in_use",
