@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-serving
+.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-serving drill-disagg
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -72,12 +72,20 @@ bench-proxy:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r09.json
 
 # Serving-engine benchmark: chunked prefill + paged KV with prefix
-# sharing, speculative-decoding arms, and the r12 ragged-paged-attention
-# cells (no dense-view gather; see r10_comparison_note in the output).
-# Results land in BENCH_serving_r12.json; see
-# docs/guides/serving-tuning.md for how to read them.
+# sharing, speculative-decoding arms, the r12 ragged-paged-attention
+# cells, and the r13 sharded (tensor-parallel bit-exactness/overhead)
+# and disaggregation (prefill-flood decode-isolation) arms. Results
+# land in BENCH_serving_r13.json; see docs/guides/serving-tuning.md
+# for how to read them.
 bench-serving:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r12.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r13.json
+
+# Prefill/decode disaggregation drill: two real worker processes over a
+# 2-way model mesh each, KV handoffs over a socket. Asserts token
+# bit-exactness vs a unified engine, clean cancel mid-handoff,
+# stale-epoch reject + client refresh, and zero KV-block residue.
+drill-disagg:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.workloads.serving_disagg
 
 # CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
 capacity-smoke:

@@ -87,6 +87,17 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_kv_blocks_cached": ("gauge", ()),
     "dstack_tpu_serving_kv_blocks_in_use": ("gauge", ()),
     "dstack_tpu_serving_kv_cow_copies_total": ("counter", ()),
+    # Prefill/decode disaggregation (workloads/kv_transfer.py): handoff
+    # outcome counters on both sides of the seam, payload bytes moved,
+    # per-handoff transfer latency, and the depth of the handoff queue
+    # (prefill: finalized tasks awaiting send; decode: received payloads
+    # awaiting a slot + blocks).
+    "dstack_tpu_serving_kv_handoffs_received_total": ("counter", ()),
+    "dstack_tpu_serving_kv_handoffs_sent_total": ("counter", ()),
+    "dstack_tpu_serving_kv_handoffs_stale_rejected_total": ("counter", ()),
+    "dstack_tpu_serving_kv_transfer_bytes_total": ("counter", ()),
+    "dstack_tpu_serving_kv_transfer_queue_depth": ("gauge", ()),
+    "dstack_tpu_serving_kv_transfer_seconds": ("histogram", ("role",)),
     "dstack_tpu_serving_pending_requests": ("gauge", ()),
     "dstack_tpu_serving_prefill_chunks_total": ("counter", ()),
     "dstack_tpu_serving_prefill_tokens_total": ("counter", ()),
@@ -106,9 +117,16 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_spec_tokens_proposed_total": ("counter", ()),
     "dstack_tpu_serving_spec_tokens_rejected_total": ("counter", ()),
     "dstack_tpu_serving_spec_verify_seconds_total": ("counter", ()),
+    # Decode time per emitted token, one sample per decode chunk / spec
+    # round (chunk wall time over tokens emitted) — the series the
+    # disaggregation bench's decode-isolation check reads.
+    "dstack_tpu_serving_tpt_seconds": ("histogram", ("role",)),
     # Was a lone `_sum` counter with no `_count` partner (unscrapeable as
-    # a summary); now a first-class histogram.
-    "dstack_tpu_serving_ttft_seconds": ("histogram", ()),
+    # a summary); now a first-class histogram. The role label separates a
+    # split request's prefill leg (submit -> handoff acked), decode leg
+    # (receipt -> first delivery) and a unified engine's full TTFT —
+    # different quantities that must not aggregate into one distribution.
+    "dstack_tpu_serving_ttft_seconds": ("histogram", ("role",)),
     # Spec cache (PR 3).
     "dstack_tpu_spec_cache_entries": ("gauge", ()),
     "dstack_tpu_spec_cache_hit_rate": ("gauge", ()),

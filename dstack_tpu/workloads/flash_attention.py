@@ -80,6 +80,9 @@ def use_flash(
     dtype_bytes: int = 2,
     interpret: bool = False,
     kv_block_size: int = None,
+    num_heads: int = None,
+    num_kv_heads: int = None,
+    model_shards: int = 1,
 ) -> bool:
     """Whether the fused Pallas path handles this shape on this backend.
 
@@ -88,6 +91,17 @@ def use_flash(
     `kv_block_size`-row tile at a time, so the dense `seq % MIN_BLK`
     rule would wrongly reject block-granular windows — the paged rules
     are block-aligned seq and a single K+V tile within the VMEM budget.
+
+    Under a "model"-sharded mesh each shard's program sees
+    `num_heads / model_shards` query heads and `num_kv_heads /
+    model_shards` KV heads — the rule must judge THAT geometry, not the
+    global one, or the Pallas-vs-lax choice flips incorrectly (e.g. a
+    global n_rep of 2 can be per-shard n_rep 1, or fractional). Pass the
+    GLOBAL counts plus `model_shards`; the per-shard division happens
+    here. `model_shards > 1` currently always answers False: pallas_call
+    carries no SPMD partitioning rule, so inside a GSPMD-partitioned
+    program the kernel would force a full gather of the sharded pools —
+    the lax fallback is what partitions cleanly.
     """
     import os
 
@@ -95,6 +109,27 @@ def use_flash(
         return False
     if not interpret and jax.default_backend() != "tpu":
         return False
+    if model_shards < 1:
+        raise ValueError(f"model_shards must be >= 1, got {model_shards}")
+    if num_heads is not None or num_kv_heads is not None:
+        if num_heads is None or num_kv_heads is None:
+            raise ValueError(
+                "num_heads and num_kv_heads must be passed together"
+            )
+        if num_heads % model_shards or num_kv_heads % model_shards:
+            raise ValueError(
+                f"heads ({num_heads} q / {num_kv_heads} kv) must divide"
+                f" model_shards={model_shards} — the engine validates"
+                " this at construction"
+            )
+        per_q = num_heads // model_shards
+        per_kv = num_kv_heads // model_shards
+        # The kernels replicate KV across the GQA group via an integral
+        # n_rep; a per-shard geometry that breaks it must fall back.
+        if per_kv < 1 or per_q % per_kv:
+            return False
+    if model_shards > 1:
+        return False  # no pallas SPMD partitioning rule (see docstring)
     if kv_block_size is not None:
         tile_bytes = 2 * kv_block_size * head_dim * dtype_bytes  # K + V tile
         return (

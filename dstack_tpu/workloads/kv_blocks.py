@@ -299,9 +299,22 @@ class BlockAllocator:
 
 
 # -- jitted programs ----------------------------------------------------------
+#
+# Every factory takes an optional `shardings` (a
+# `sharding.ServingShardings`): when set, the program is jitted with
+# explicit in/out shardings — params column-parallel over "model", KV
+# pools sharded on the KV-head dim, control state replicated — and GSPMD
+# partitions the SAME traced logic; there are no sharded/unsharded code
+# forks. When None (the default), jit behaves exactly as before.
 
 
-def make_chunk_prefill(config: ModelConfig, chunk: int):
+def _jit_shardings(in_shardings, out_shardings):
+    if in_shardings is None:
+        return {}
+    return {"in_shardings": in_shardings, "out_shardings": out_shardings}
+
+
+def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None):
     """chunk_prefill(params, state, slot, table_row (MB,), tokens (1, C),
     n_valid, start, budget, temp, top_p, rng, finalize) ->
     (state, first_token ()).
@@ -317,8 +330,13 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
     so no separate insert program is needed.
     """
     c = config
+    sh = shardings
+    kw = _jit_shardings(
+        None if sh is None else (sh.params, sh.state) + (sh.replicated,) * 10,
+        None if sh is None else (sh.state, sh.replicated),
+    )
 
-    @functools.partial(jax.jit, donate_argnums=1)
+    @functools.partial(jax.jit, donate_argnums=1, **kw)
     def chunk_prefill(params, state: PagedDecodeState, slot, table_row,
                       tokens, n_valid, start, budget, temp, top_p, rng,
                       finalize):
@@ -387,7 +405,7 @@ def make_chunk_prefill(config: ModelConfig, chunk: int):
     return chunk_prefill
 
 
-def make_paged_decode_step(config: ModelConfig, steps: int = 1):
+def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None):
     """decode_steps(params, state, rng) -> (state, tokens (B, steps),
     active) over a PagedDecodeState — the paged twin of
     serving.make_decode_step.
@@ -466,7 +484,13 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1):
         )
         return new_state, jnp.where(act, next_token, -1), new_active
 
-    @functools.partial(jax.jit, donate_argnums=1)
+    sh = shardings
+    kw = _jit_shardings(
+        None if sh is None else (sh.params, sh.state, sh.replicated),
+        None if sh is None else (sh.state, sh.replicated, sh.replicated),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=1, **kw)
     def decode_steps(params, state: PagedDecodeState, rng):
         def body(carry, step_rng):
             st, _ = carry
@@ -505,7 +529,7 @@ def _sampling_probs(logits, temps, top_ps):
     return jax.nn.softmax(filtered, axis=-1)
 
 
-def make_spec_draft(config: ModelConfig, k: int):
+def make_spec_draft(config: ModelConfig, k: int, shardings=None):
     """spec_draft(params, draft_k, draft_v, block_tables, lengths,
     last_token, active, temps, top_ps, rng) ->
     (draft_k', draft_v', drafts (B, k), qlogits (B, k, V)).
@@ -532,8 +556,15 @@ def make_spec_draft(config: ModelConfig, k: int):
     have been freed to the cache or another slot at retire): their
     write lane is pointed at the OOB sentinel block and dropped."""
     c = config
+    sh = shardings
+    kw = _jit_shardings(
+        None if sh is None
+        else (sh.params, sh.pool, sh.pool) + (sh.replicated,) * 7,
+        None if sh is None
+        else (sh.pool, sh.pool, sh.replicated, sh.replicated),
+    )
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    @functools.partial(jax.jit, donate_argnums=(1, 2), **kw)
     def spec_draft(params, draft_k, draft_v, block_tables, lengths,
                    last_token, active, temps, top_ps, rng):
         nb, bs = draft_k.shape[1], draft_k.shape[2]
@@ -587,7 +618,7 @@ def make_spec_draft(config: ModelConfig, k: int):
     return spec_draft
 
 
-def make_spec_verify(config: ModelConfig, k: int):
+def make_spec_verify(config: ModelConfig, k: int, shardings=None):
     """spec_verify(params, state, drafts (B, k), qlogits (B, k, V), rng)
     -> (state', emitted (B, k+1), accepted (B,), active (B,)).
 
@@ -623,8 +654,14 @@ def make_spec_verify(config: ModelConfig, k: int):
     -1 padding convention so the engine's fan-out is shared."""
     c = config
     S = k + 1
+    sh = shardings
+    kw = _jit_shardings(
+        None if sh is None
+        else (sh.params, sh.state) + (sh.replicated,) * 3,
+        None if sh is None else (sh.state,) + (sh.replicated,) * 3,
+    )
 
-    @functools.partial(jax.jit, donate_argnums=1)
+    @functools.partial(jax.jit, donate_argnums=1, **kw)
     def spec_verify(params, state: PagedDecodeState, drafts, qlogits, rng):
         nb, bs = state.k.shape[1], state.k.shape[2]
         B, mb = state.block_tables.shape
@@ -743,12 +780,17 @@ def make_spec_verify(config: ModelConfig, k: int):
     return spec_verify
 
 
-def make_copy_block():
+def make_copy_block(shardings=None):
     """copy_block(state, src, dst): copy one pool block across every
     layer — the device half of copy-on-write (the allocator's
     `ensure_writable` picks dst; the engine swaps the table entry)."""
+    sh = shardings
+    kw = _jit_shardings(
+        None if sh is None else (sh.state, sh.replicated, sh.replicated),
+        None if sh is None else sh.state,
+    )
 
-    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(jax.jit, donate_argnums=0, **kw)
     def copy_block(state: PagedDecodeState, src, dst):
         return state._replace(
             k=state.k.at[:, dst].set(state.k[:, src]),

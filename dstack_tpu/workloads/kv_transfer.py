@@ -1,0 +1,365 @@
+"""KV-block handoff seam between a prefill worker and a decode engine.
+
+Prefill/decode disaggregation (ROADMAP: the r06 TTFT pathology at
+cross-host scale): a prefill-role `ServingEngine` runs chunked prefill
+on its own devices, then ships each finished request's KV blocks — the
+pool rows its block table points at, gathered per block, NEVER as a
+dense `(max_len, KV, hd)` view — plus the allocator-side metadata
+(prompt, first sampled token, sampling params, budget) to the decode
+engine, which allocates fresh blocks from ITS pool, scatters the
+payload in, and goes straight to decode. Block ids are local to each
+pool; the logical prefix is what transfers, so the two allocators stay
+independently refcount-coherent.
+
+Epoch fencing: the DECODE side owns a monotonically increasing handoff
+epoch, announced in the `hello` it sends on every new connection and
+bumped whenever its pool state is reset (engine restart, flush). Every
+handoff is stamped with the epoch the prefill side last saw; the decode
+side rejects stale stamps (`reject` with the current epoch, counted in
+`stale_rejected`) instead of admitting KV that was computed against a
+dead pool generation — the prefill side re-handshakes and the caller
+decides whether to re-prefill. This is the same fencing idea as the
+dataplane's route epochs (PR 9), applied to KV payloads.
+
+Wire format (one TCP stream, strictly request/response from the
+prefill side): every message is an 8-byte big-endian length + a JSON
+header; a `handoff` header carries an `arrays` manifest (name / shape /
+dtype) and the raw array bytes follow the header in manifest order.
+numpy buffers move as raw bytes — no pickling, so the stream is safe to
+cross trust boundaries and versions.
+"""
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+_LEN = struct.Struct(">Q")
+# A single handoff is bounded by pool-geometry arrays (L, n_blocks, bs,
+# KV, hd); 1 GiB headroom rejects garbage/hostile lengths before any
+# allocation.
+MAX_MSG_BYTES = 1 << 30
+
+
+class KVHandoff(NamedTuple):
+    """One finished prefill, ready for decode-side admission."""
+
+    request_id: int
+    epoch: int
+    prompt: List[int]
+    first_token: int          # sampled by the prefill finalize chunk
+    max_new_tokens: int
+    temperature: float
+    top_p: float
+    k: np.ndarray             # (L, n_blocks, block_size, KV, hd)
+    v: np.ndarray
+    draft_k: Optional[np.ndarray] = None   # drafter pool rows (spec only)
+    draft_v: Optional[np.ndarray] = None
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.k.shape[1])
+
+    @property
+    def payload_bytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.draft_k is not None:
+            n += self.draft_k.nbytes + self.draft_v.nbytes
+        return n
+
+
+class StaleEpochError(RuntimeError):
+    """Handoff stamped with an epoch the decode side no longer serves."""
+
+    def __init__(self, got: int, current: int):
+        super().__init__(
+            f"stale handoff epoch {got} (decode side is at {current})"
+        )
+        self.got = got
+        self.current = current
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("kv_transfer peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, header: Dict[str, Any],
+             payloads: Tuple[np.ndarray, ...] = ()) -> int:
+    """Write one framed message; returns bytes put on the wire."""
+    raw = json.dumps(header, separators=(",", ":")).encode()
+    parts = [_LEN.pack(len(raw)), raw]
+    for a in payloads:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    blob = b"".join(parts)
+    sock.sendall(blob)
+    return len(blob)
+
+
+def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+    """Read one framed header; array payloads (if any) are attached
+    under `_arrays` as numpy views in manifest order."""
+    (n,) = _LEN.unpack(_read_exact(sock, _LEN.size))
+    if n > MAX_MSG_BYTES:
+        raise ConnectionError(f"kv_transfer header length {n} over limit")
+    header = json.loads(_read_exact(sock, n).decode())
+    arrays = []
+    for spec in header.get("arrays", ()):
+        shape = tuple(int(d) for d in spec["shape"])
+        dtype = np.dtype(spec["dtype"])
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if nbytes > MAX_MSG_BYTES:
+            raise ConnectionError(
+                f"kv_transfer array {spec.get('name')} length over limit"
+            )
+        arrays.append(
+            np.frombuffer(_read_exact(sock, nbytes), dtype).reshape(shape)
+        )
+    header["_arrays"] = arrays
+    return header
+
+
+def _manifest(named: List[Tuple[str, np.ndarray]]) -> List[Dict[str, Any]]:
+    return [
+        {"name": name, "shape": list(a.shape), "dtype": str(a.dtype)}
+        for name, a in named
+    ]
+
+
+def pack_handoff(h: KVHandoff) -> Tuple[Dict[str, Any], Tuple[np.ndarray, ...]]:
+    named: List[Tuple[str, np.ndarray]] = [("k", h.k), ("v", h.v)]
+    if h.draft_k is not None:
+        named += [("draft_k", h.draft_k), ("draft_v", h.draft_v)]
+    header = {
+        "kind": "handoff",
+        "request_id": h.request_id,
+        "epoch": h.epoch,
+        "prompt": list(h.prompt),
+        "first_token": int(h.first_token),
+        "max_new_tokens": int(h.max_new_tokens),
+        "temperature": float(h.temperature),
+        "top_p": float(h.top_p),
+        "arrays": _manifest(named),
+    }
+    return header, tuple(a for _, a in named)
+
+
+def unpack_handoff(header: Dict[str, Any]) -> KVHandoff:
+    by_name = {
+        spec["name"]: arr
+        for spec, arr in zip(header.get("arrays", ()), header["_arrays"])
+    }
+    return KVHandoff(
+        request_id=int(header["request_id"]),
+        epoch=int(header["epoch"]),
+        prompt=[int(t) for t in header["prompt"]],
+        first_token=int(header["first_token"]),
+        max_new_tokens=int(header["max_new_tokens"]),
+        temperature=float(header["temperature"]),
+        top_p=float(header["top_p"]),
+        k=by_name["k"],
+        v=by_name["v"],
+        draft_k=by_name.get("draft_k"),
+        draft_v=by_name.get("draft_v"),
+    )
+
+
+# -- decode side --------------------------------------------------------------
+
+
+class TransferServer:
+    """Decode-side listener: one thread per prefill connection, each
+    handoff validated against the CURRENT epoch before `on_handoff`
+    (typically `ServingEngine.submit_prefilled`) runs; the ack only goes
+    out after the callback returns, so a prefill worker that sees the
+    ack knows the decode side owns the request (and its own block refs
+    are safe to drop)."""
+
+    def __init__(self, host: str, port: int,
+                 on_handoff: Callable[[KVHandoff], None],
+                 *, epoch: int = 1):
+        self._on_handoff = on_handoff
+        self._epoch = epoch
+        self._lock = threading.Lock()
+        self._stop = False
+        self.stale_rejected = 0        # monotonic, feeds /metrics
+        self.handoffs_accepted = 0
+        self.bytes_received = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def bump_epoch(self) -> int:
+        """Invalidate every in-flight handoff (pool generation changed).
+        Already-connected prefill workers learn the new epoch from the
+        next reject; new connections learn it from the hello."""
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                send_msg(conn, {"kind": "hello", "epoch": self.epoch})
+                while not self._stop:
+                    header = recv_msg(conn)
+                    if header.get("kind") != "handoff":
+                        send_msg(conn, {"kind": "error",
+                                        "reason": "unexpected message"})
+                        continue
+                    h = unpack_handoff(header)
+                    current = self.epoch
+                    if h.epoch != current:
+                        with self._lock:
+                            self.stale_rejected += 1
+                        send_msg(conn, {
+                            "kind": "reject", "reason": "stale_epoch",
+                            "request_id": h.request_id, "epoch": current,
+                        })
+                        continue
+                    try:
+                        self._on_handoff(h)
+                    except StaleEpochError as e:
+                        # Raced a bump between our check and admission.
+                        with self._lock:
+                            self.stale_rejected += 1
+                        send_msg(conn, {
+                            "kind": "reject", "reason": "stale_epoch",
+                            "request_id": h.request_id, "epoch": e.current,
+                        })
+                        continue
+                    with self._lock:
+                        self.handoffs_accepted += 1
+                        self.bytes_received += h.payload_bytes
+                    send_msg(conn, {"kind": "ack",
+                                    "request_id": h.request_id})
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            return  # peer went away; the accept loop keeps serving
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- prefill side -------------------------------------------------------------
+
+
+class TransferClient:
+    """Prefill-side sender. `send()` stamps the handoff with the epoch
+    learned from the decode side's hello, blocks for the ack, and
+    retries ONCE on a stale-epoch reject with the refreshed epoch — a
+    second reject means the decode side is churning and the caller
+    should fail the request rather than loop. Thread-safe (the engine's
+    handoff thread is the only caller in practice)."""
+
+    def __init__(self, host: str, port: int, *, timeout: float = 60.0,
+                 retry_stale: bool = True):
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._retry_stale = retry_stale
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self.epoch = 0
+        self.bytes_sent = 0            # monotonic, feeds /metrics
+        self.handoffs_sent = 0
+        self.stale_rejects_seen = 0
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        sock.settimeout(self._timeout)
+        hello = recv_msg(sock)
+        if hello.get("kind") != "hello":
+            sock.close()
+            raise ConnectionError(
+                f"expected hello from decode side, got {hello.get('kind')!r}"
+            )
+        self._sock = sock
+        self.epoch = int(hello["epoch"])
+
+    def _send_once(self, h: KVHandoff) -> Dict[str, Any]:
+        if self._sock is None:
+            self._connect()
+        header, payloads = pack_handoff(h._replace(epoch=self.epoch))
+        try:
+            self.bytes_sent += send_msg(self._sock, header, payloads)
+            return recv_msg(self._sock)
+        except (ConnectionError, OSError):
+            # One reconnect per attempt: a decode-side restart closed the
+            # stream; the fresh hello carries the new epoch.
+            self._close_sock()
+            self._connect()
+            header, payloads = pack_handoff(h._replace(epoch=self.epoch))
+            self.bytes_sent += send_msg(self._sock, header, payloads)
+            return recv_msg(self._sock)
+
+    def send(self, h: KVHandoff) -> None:
+        """Deliver one handoff; raises StaleEpochError after a reject on
+        the refreshed epoch, ConnectionError when the decode side is
+        unreachable."""
+        with self._lock:
+            for attempt in range(2):
+                reply = self._send_once(h)
+                kind = reply.get("kind")
+                if kind == "ack":
+                    self.handoffs_sent += 1
+                    return
+                if kind == "reject" and reply.get("reason") == "stale_epoch":
+                    self.stale_rejects_seen += 1
+                    stamped = self.epoch
+                    self.epoch = int(reply["epoch"])
+                    if attempt == 0 and self._retry_stale:
+                        continue
+                    raise StaleEpochError(stamped, self.epoch)
+                raise ConnectionError(
+                    f"unexpected kv_transfer reply: {reply!r}"
+                )
+
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_sock()

@@ -70,6 +70,9 @@ def dispatch_path(
     *,
     dtype_bytes: int = 2,
     interpret: bool = False,
+    num_heads: Optional[int] = None,
+    num_kv_heads: Optional[int] = None,
+    model_shards: int = 1,
 ) -> str:
     """Which implementation `ragged_attention` will run for this geometry.
 
@@ -79,7 +82,12 @@ def dispatch_path(
     device sync. Delegates to `flash_attention.use_flash` with the paged
     block geometry so the dense-prefill seq-divisibility rule doesn't
     apply (the kernel streams block_size-granular tiles; max_len only
-    needs to be block-aligned, which the pool guarantees).
+    needs to be block-aligned, which the pool guarantees). Sharded
+    engines pass their GLOBAL head counts plus the mesh's "model" extent:
+    the rule judges the per-shard geometry each partitioned program
+    actually sees (and answers "lax_ragged" whenever model_shards > 1 —
+    pallas_call has no SPMD partitioning rule; the lax fallback is the
+    path GSPMD partitions).
     """
     from dstack_tpu.workloads.flash_attention import use_flash
 
@@ -89,6 +97,9 @@ def dispatch_path(
         dtype_bytes=dtype_bytes,
         interpret=interpret,
         kv_block_size=kv_block_size,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        model_shards=model_shards,
     )
     return "pallas" if ok else "lax_ragged"
 

@@ -49,6 +49,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dstack_tpu.server.tracing import HistogramData
@@ -70,10 +71,15 @@ from dstack_tpu.workloads.kv_blocks import (
     make_spec_draft,
     make_spec_verify,
 )
+from dstack_tpu.workloads.kv_transfer import KVHandoff, StaleEpochError
 from dstack_tpu.workloads.paged_attention import (
     dispatch_path as attn_dispatch_path,
 )
 from dstack_tpu.workloads.quant import quantize_params
+from dstack_tpu.workloads.sharding import (
+    make_serving_shardings,
+    serving_param_shardings,
+)
 from dstack_tpu.workloads.transformer import (
     linear,
     logits_linear,
@@ -359,6 +365,10 @@ class _Request(NamedTuple):
     temperature: float  # per-request; 0 = greedy
     top_p: float        # per-request nucleus cutoff; 1 = no filtering
     t_submit: float     # monotonic submit time (TTFT / queue-wait gauges)
+    # Caller-supplied correlation id, carried on the KV handoff so a
+    # disaggregated front-end can match decode-side streams back to the
+    # prompts it submitted to the prefill worker. None = engine-assigned.
+    request_id: Optional[int] = None
 
 
 class _PrefillTask:
@@ -370,7 +380,7 @@ class _PrefillTask:
     overtake it)."""
 
     __slots__ = ("req", "slot", "pos", "table", "first", "t_pop",
-                 "delivered", "finalized")
+                 "delivered", "finalized", "kv_payload")
 
     def __init__(self, req: _Request, slot: int, pos: int, table: List[int],
                  t_pop: float):
@@ -382,6 +392,11 @@ class _PrefillTask:
         self.t_pop = t_pop
         self.delivered = threading.Event()
         self.finalized = False
+        # Prefill role only: device gathers of the finished blocks (and
+        # drafter blocks), dispatched at finalize on the loop thread —
+        # the sender thread reads these back, never self.state (whose
+        # buffers later chunk dispatches donate).
+        self.kv_payload: Optional[Dict[str, Any]] = None
 
 
 class ServingEngine:
@@ -413,11 +428,19 @@ class ServingEngine:
         spec_draft_config: Optional[ModelConfig] = None,
         spec_min_accept: float = 0.3,
         kv_budget_bytes: Optional[int] = None,
+        mesh: Optional[Any] = None,
+        role: str = "unified",
+        kv_transfer: Optional[Any] = None,
     ):
         self.config = config
         self.params = params
         self.slots = slots
         self.max_len = max_len or config.max_seq_len
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be unified/prefill/decode, got {role!r}"
+            )
+        self.role = role
         if max_prefills_per_chunk < 1:
             raise ValueError(
                 f"max_prefills_per_chunk must be >= 1, got {max_prefills_per_chunk}"
@@ -458,8 +481,46 @@ class ServingEngine:
         )
         self.prefill_chunk_tokens = prefill_chunk_tokens
         self._chunk_cache: Dict[int, Any] = {}
-        self._step = make_paged_decode_step(config, steps=steps_per_sync)
-        self._copy_block = make_copy_block()
+        # -- tensor-parallel serving (mesh != None) -----------------------
+        # Column-parallel layout ("model" only on output dims; see
+        # sharding.SERVING_PARAM_SPECS): params and KV pools are
+        # device_put with explicit NamedShardings and every jitted
+        # program below is built with matching in/out shardings — the
+        # SAME traced programs serve partitioned state, and because no
+        # contraction axis is ever split, sharded temp-0 output stays
+        # bit-exact vs a single-device engine.
+        self.mesh = mesh
+        self._model_shards = 1
+        self._shardings = None
+        if mesh is not None:
+            if "model" not in getattr(mesh, "shape", {}):
+                raise ValueError("serving mesh must carry a 'model' axis")
+            ms = int(mesh.shape["model"])
+            self._model_shards = ms
+            for what, mc in (
+                ("target", config),
+                ("drafter", spec_draft_config or config),
+            )[: 2 if spec_enable else 1]:
+                if mc.n_heads % ms or mc.n_kv_heads % ms:
+                    raise ValueError(
+                        f"{what} heads ({mc.n_heads} q / {mc.n_kv_heads} kv)"
+                        f" must divide the mesh's model axis ({ms})"
+                    )
+        self.state = init_paged_state(
+            config, slots, self.max_len, kv_block_size, self._num_blocks
+        )
+        if mesh is not None:
+            self.params = jax.device_put(
+                params, serving_param_shardings(mesh, params)
+            )
+            self._shardings = make_serving_shardings(
+                mesh, self.params, self.state
+            )
+            self.state = jax.device_put(self.state, self._shardings.state)
+        self._step = make_paged_decode_step(
+            config, steps=steps_per_sync, shardings=self._shardings
+        )
+        self._copy_block = make_copy_block(shardings=self._shardings)
         # Which ragged-attention implementation this engine's geometry
         # dispatches (static per engine: shape + backend decide), and
         # how many jitted-program dispatches ran it — exposed as
@@ -467,6 +528,8 @@ class ServingEngine:
         self._attn_path = attn_dispatch_path(
             self.max_len, config.head_dim, kv_block_size,
             dtype_bytes=jnp.dtype(config.activation_dtype).itemsize,
+            num_heads=config.n_heads, num_kv_heads=config.n_kv_heads,
+            model_shards=self._model_shards,
         )
         self._attn_dispatch = {"pallas": 0, "lax_ragged": 0}
         # -- speculative decoding (drafter proposes k, target verifies
@@ -537,7 +600,24 @@ class ServingEngine:
                 self._draft_config, slots, self.max_len, kv_block_size,
                 self._num_blocks,
             )
-            self._copy_draft_block = make_copy_block()
+            self._draft_shardings = None
+            if mesh is not None:
+                # QTensor leaves: q mirrors the float parent's column-
+                # parallel spec, per-channel scales replicate (see
+                # sharding._broadcast_specs).
+                self._draft_params = jax.device_put(
+                    self._draft_params,
+                    serving_param_shardings(mesh, self._draft_params),
+                )
+                self._draft_shardings = make_serving_shardings(
+                    mesh, self._draft_params, self._draft_state
+                )
+                self._draft_state = jax.device_put(
+                    self._draft_state, self._draft_shardings.state
+                )
+            self._copy_draft_block = make_copy_block(
+                shardings=self._draft_shardings
+            )
             self._draft_chunk_cache: Dict[int, Any] = {}
             self._spec_draft_fns: Dict[int, Any] = {}
             self._spec_verify_fns: Dict[int, Any] = {}
@@ -577,9 +657,6 @@ class ServingEngine:
         # greedy (rng unused), so keeping the target's stream untouched
         # is what makes spec-on output bit-identical to spec-off.
         self._rng_draft = jax.random.PRNGKey(seed + 0x5bec)
-        self.state = init_paged_state(
-            config, slots, self.max_len, kv_block_size, self._num_blocks
-        )
         # Admission control: None = unbounded (library embedding decides);
         # servers should bound it — see EngineOverloadedError.
         self.max_pending = max_pending
@@ -655,10 +732,52 @@ class ServingEngine:
         # land on _pending after _flush_all drained it (its consumer would
         # block forever).
         self._lock = threading.Lock()
+        # -- prefill/decode disaggregation (role != "unified") -------------
+        # A prefill engine never activates decode slots: finalized tasks
+        # divert to _handoff_q, where a sender thread ships the gathered
+        # KV blocks + metadata through `kv_transfer` (a
+        # kv_transfer.TransferClient or anything with .send(KVHandoff)).
+        # A decode engine accepts handoffs via submit_prefilled(): queued
+        # under _prefilled_pending, admitted by the loop thread into
+        # fresh blocks from ITS allocator. Epoch fencing: the decode
+        # side's handoff_epoch must match every payload's stamp, so a
+        # pool-generation change (bump_handoff_epoch) rejects in-flight
+        # KV instead of absorbing bytes computed against dead state.
+        self._kv_transfer = kv_transfer
+        if role == "prefill" and kv_transfer is None:
+            raise ValueError(
+                "role='prefill' requires a kv_transfer client to ship"
+                " finished prefills to (see workloads/kv_transfer.py)"
+            )
+        self.handoff_epoch = 1
+        self._handoff_seq = 0
+        self._handoff_q: "queue.Queue[Optional[_PrefillTask]]" = queue.Queue()
+        # (handoff, out queue, receipt time) triples awaiting a slot +
+        # blocks on the decode side; guarded by _lock.
+        self._prefilled_pending: List[Tuple[KVHandoff, Any, float]] = []
+        self._handoffs_sent = 0
+        self._handoffs_received = 0
+        self._handoff_stale_rejected = 0
+        self._kv_transfer_bytes = 0
+        self._kv_transfer_hist = HistogramData()
+        # Decode time per emitted token, sampled once per chunk/spec
+        # round (chunk wall time / tokens it emitted) — the TPT series
+        # behind the disaggregation bench's decode-isolation check.
+        self._tpt_hist = HistogramData()
+        self._last_chunk_s = 0.0
+        self._gather_fns: Dict[int, Any] = {}
+        self._inject_fns: Dict[Tuple[int, bool], Any] = {}
+        self._activate_prefilled_fn: Optional[Any] = None
         self._deliver_thread = threading.Thread(
             target=self._deliver_loop, daemon=True
         )
         self._deliver_thread.start()
+        self._handoff_thread: Optional[threading.Thread] = None
+        if role == "prefill":
+            self._handoff_thread = threading.Thread(
+                target=self._handoff_loop, daemon=True
+            )
+            self._handoff_thread.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -668,6 +787,7 @@ class ServingEngine:
         max_new_tokens: int,
         temperature: Optional[float] = None,
         top_p: float = 1.0,
+        request_id: Optional[int] = None,
     ) -> "queue.Queue[object]":
         """Enqueue a request; returns its output queue (see _Request.out
         for the token/None/Exception protocol). `temperature` (0 =
@@ -729,7 +849,8 @@ class ServingEngine:
                 raise EngineOverloadedError(depth, self._retry_after(depth))
             self._pending.put(
                 _Request(list(tokens), max_new_tokens, out,
-                         float(temperature), float(top_p), time.monotonic())
+                         float(temperature), float(top_p), time.monotonic(),
+                         request_id)
             )
             self._inflight.add(out)
         self._wake.set()
@@ -837,6 +958,22 @@ class ServingEngine:
             # Bucketed TTFT ({"buckets": [(le, cumulative)...], "sum",
             # "count"}) — prometheus_metrics renders the histogram series.
             "ttft_hist": self._ttft_hist.to_dict(),
+            # Disaggregation: which half of the split this engine is
+            # (TTFT/TPT series carry it as a role label — the legs of a
+            # split request are different quantities and must not be
+            # aggregated into one distribution), plus the KV handoff
+            # counters on both sides of the transfer seam.
+            "role": self.role,
+            "handoff_epoch": self.handoff_epoch,
+            "kv_handoffs_sent_total": self._handoffs_sent,
+            "kv_handoffs_received_total": self._handoffs_received,
+            "kv_handoffs_stale_rejected_total": self._handoff_stale_rejected,
+            "kv_transfer_bytes_total": self._kv_transfer_bytes,
+            "kv_transfer_hist": self._kv_transfer_hist.to_dict(),
+            "kv_transfer_queue_depth": (
+                self._handoff_q.qsize() + len(self._prefilled_pending)
+            ),
+            "tpt_hist": self._tpt_hist.to_dict(),
             # Speculative decoding: per-round draft/verify wall time,
             # token fate counters (proposed = accepted + rejected; the
             # bonus/correction token the target emits each round is NOT
@@ -875,6 +1012,9 @@ class ServingEngine:
         self._thread.join(timeout=10)
         self._deliver_q.put(None)
         self._deliver_thread.join(timeout=10)
+        if self._handoff_thread is not None:
+            self._handoff_q.put(None)
+            self._handoff_thread.join(timeout=10)
         # Requests still in flight get an exception, not the clean-end
         # None: a consumer must not mistake a truncated generation for a
         # complete one (same principle _flush_all states for failures).
@@ -900,6 +1040,11 @@ class ServingEngine:
             self._admitting.clear()
             self._tasks.clear()
             self._pending_activation.clear()
+            # Handoffs queued but not yet admitted (decode role): their
+            # consumers are waiting on the stream too.
+            for _h, h_out, _t in self._prefilled_pending:
+                h_out.put(sentinel)
+            self._prefilled_pending.clear()
             while True:
                 try:
                     self._pending.get_nowait().out.put(sentinel)
@@ -914,7 +1059,9 @@ class ServingEngine:
         to block or spy on chunk dispatches."""
         fn = self._chunk_cache.get(n_padded)
         if fn is None:
-            fn = make_chunk_prefill(self.config, n_padded)
+            fn = make_chunk_prefill(
+                self.config, n_padded, shardings=self._shardings
+            )
             self._chunk_cache[n_padded] = fn
         return fn
 
@@ -923,21 +1070,28 @@ class ServingEngine:
         own bucket entries)."""
         fn = self._draft_chunk_cache.get(n_padded)
         if fn is None:
-            fn = make_chunk_prefill(self._draft_config, n_padded)
+            fn = make_chunk_prefill(
+                self._draft_config, n_padded,
+                shardings=self._draft_shardings,
+            )
             self._draft_chunk_cache[n_padded] = fn
         return fn
 
     def _spec_draft_fn(self, k: int):
         fn = self._spec_draft_fns.get(k)
         if fn is None:
-            fn = make_spec_draft(self._draft_config, k)
+            fn = make_spec_draft(
+                self._draft_config, k, shardings=self._draft_shardings
+            )
             self._spec_draft_fns[k] = fn
         return fn
 
     def _spec_verify_fn(self, k: int):
         fn = self._spec_verify_fns.get(k)
         if fn is None:
-            fn = make_spec_verify(self.config, k)
+            fn = make_spec_verify(
+                self.config, k, shardings=self._shardings
+            )
             self._spec_verify_fns[k] = fn
         return fn
 
@@ -1095,13 +1249,21 @@ class ServingEngine:
             if final:
                 task.first = first
                 task.finalized = True
+                # Prefill role: requests with decode budget left never go
+                # live here — they divert to the handoff queue and decode
+                # on the other worker. One-token requests complete
+                # locally (their budget is spent by the sampled first
+                # token; shipping KV that nothing will decode from is
+                # pure transfer waste).
+                handoff = (self.role == "prefill"
+                           and task.req.max_new_tokens > 1)
                 with self._lock:
                     # Publish the prompt's full blocks NOW (dispatch
                     # order guarantees the writes precede any later
                     # matcher's gather), so a burst of shared-prefix
                     # requests hits from the second admission on.
                     self._alloc.insert_full(task.req.tokens, task.table)
-                    if task.req.max_new_tokens > 1:
+                    if task.req.max_new_tokens > 1 and not handoff:
                         self._live[task.slot] = task.req
                         self._admitting.remove(task.req)
                         self._lengths_host[task.slot] = len(task.req.tokens)
@@ -1116,8 +1278,19 @@ class ServingEngine:
                     # stay in _admitting until then so capacity
                     # accounting and _flush_all keep seeing them.
                 self._tasks.remove(task)
-                self._pending_activation.append(task)
-                self._deliver_q.put(task)
+                if handoff:
+                    # Gather the finished blocks NOW, on the loop thread:
+                    # later chunk dispatches donate self.state, so a
+                    # reference held by the sender thread could point at
+                    # deleted buffers. The gathered copies are
+                    # donation-free; the sender only reads them back.
+                    # The request stays in _admitting (capacity +
+                    # _flush_all) until the handoff resolves.
+                    task.kv_payload = self._gather_task_blocks(task)
+                    self._handoff_q.put(task)
+                else:
+                    self._pending_activation.append(task)
+                    self._deliver_q.put(task)
         return progressed
 
     def _deliver_loop(self) -> None:
@@ -1180,6 +1353,415 @@ class ServingEngine:
         for task in self._pending_activation:
             task.delivered.wait(timeout=60)
         self._pending_activation.clear()
+
+    # -- prefill/decode disaggregation ----------------------------------------
+
+    def _gather_blocks_fn(self, n_pad: int):
+        """Jitted per-block gather out of a pool: (L, NB, bs, KV, hd) x
+        (n_pad,) ids -> (L, n_pad, bs, KV, hd). One compile per pow-2
+        bucket; pad ids carry the out-of-range sentinel (mode="clip"
+        duplicates the last block — sliced off host-side). Output is
+        replicated (the payload leaves the mesh through the host)."""
+        fn = self._gather_fns.get(n_pad)
+        if fn is None:
+            kw: Dict[str, Any] = {}
+            if self._shardings is not None:
+                kw = dict(
+                    in_shardings=(self._shardings.pool,
+                                  self._shardings.replicated),
+                    out_shardings=self._shardings.replicated,
+                )
+            fn = jax.jit(
+                lambda pool, ids: jnp.take(pool, ids, axis=1, mode="clip"),
+                **kw,
+            )
+            self._gather_fns[n_pad] = fn
+        return fn
+
+    def _gather_task_blocks(self, task: _PrefillTask) -> Dict[str, Any]:
+        """Dispatch (async) gathers of a finalized task's blocks from the
+        target pool — and the drafter pool when speculation is on, so the
+        decode worker's drafter starts from real KV instead of zeros."""
+        n = len(task.table)
+        n_pad = 1 << max(0, (n - 1).bit_length())
+        ids = jnp.asarray(
+            task.table + [self._num_blocks] * (n_pad - n), jnp.int32
+        )
+        fn = self._gather_blocks_fn(n_pad)
+        payload: Dict[str, Any] = {
+            "n": n,
+            "k": fn(self.state.k, ids),
+            "v": fn(self.state.v, ids),
+        }
+        if self._spec:
+            payload["draft_k"] = fn(self._draft_state.k, ids)
+            payload["draft_v"] = fn(self._draft_state.v, ids)
+        return payload
+
+    def _handoff_loop(self) -> None:
+        """Prefill-role sender thread: ships each finalized task's KV
+        payload to the decode side, then releases its blocks. Decoupled
+        from the loop thread so transfer latency (network + readback)
+        never stalls the next admission boundary."""
+        while True:
+            task = self._handoff_q.get()
+            if task is None:
+                return
+            try:
+                self._do_handoff(task)
+            except BaseException:
+                import logging
+
+                logging.getLogger(__name__).exception("kv handoff failed")
+                task.delivered.set()
+
+    def _do_handoff(self, task: _PrefillTask) -> None:
+        req = task.req
+
+        def _finish(result: object) -> None:
+            # Handoff resolved (shipped, cancelled, or failed): the
+            # prefill side's claim on the blocks ends here either way —
+            # zero residue is the invariant the disagg drills pin.
+            with self._lock:
+                for b in task.table:
+                    self._alloc.release(b)
+                task.table.clear()
+                self._cancelled.discard(req.out)
+                self._inflight.discard(req.out)
+                if req in self._admitting:
+                    self._admitting.remove(req)
+            req.out.put(result)
+            task.delivered.set()
+
+        if self._stop or self._failed is not None:
+            task.delivered.set()  # _flush_all answers the consumer
+            return
+        try:
+            first = int(task.first)  # blocks until the final chunk lands
+        except Exception:
+            # Poisoned by an engine failure mid-flight: the loop's own
+            # sync fails too and _flush_all answers the consumer.
+            task.delivered.set()
+            return
+        with self._lock:
+            dead = req.out in self._cancelled
+        if dead:
+            # Cancel mid-handoff: release everything, ship nothing.
+            _finish(None)
+            return
+        pay = task.kv_payload
+        n = pay["n"]
+        t0 = time.monotonic()
+        try:
+            k_np = np.asarray(jax.device_get(pay["k"]))[:, :n]
+            v_np = np.asarray(jax.device_get(pay["v"]))[:, :n]
+            dk = dv = None
+            if "draft_k" in pay:
+                dk = np.asarray(jax.device_get(pay["draft_k"]))[:, :n]
+                dv = np.asarray(jax.device_get(pay["draft_v"]))[:, :n]
+            if req.request_id is not None:
+                rid = req.request_id
+            else:
+                with self._lock:
+                    self._handoff_seq += 1
+                    rid = self._handoff_seq
+            h = KVHandoff(
+                request_id=rid,
+                epoch=0,  # the transfer client stamps the live epoch
+                prompt=list(req.tokens),
+                first_token=first,
+                max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature,
+                top_p=req.top_p,
+                k=k_np, v=v_np, draft_k=dk, draft_v=dv,
+            )
+            self._kv_transfer.send(h)
+        except Exception as e:
+            # Transfer failed (decode side gone, epoch churn with
+            # retry_stale off): fail THIS request loudly — the consumer
+            # must not mistake "prefilled but never decoded" for a
+            # complete empty generation.
+            _finish(e)
+            return
+        dt = time.monotonic() - t0
+        now = time.monotonic()
+        with self._lock:
+            self._handoffs_sent += 1
+            self._kv_transfer_bytes += h.payload_bytes
+            self._kv_transfer_hist.observe(dt)
+            # Prefill-role TTFT: submit -> handoff acked (the token was
+            # sampled here; "first token is safely owned downstream" is
+            # this worker's responsibility boundary).
+            self._ttft_s = self._ewma_seed(self._ttft_s, now - req.t_submit)
+            self._n_admitted += 1
+            self._sum_ttft += now - req.t_submit
+            self._ttft_hist.observe(now - req.t_submit)
+        # Consumer protocol on the prefill worker: no tokens, just the
+        # clean end — the DECODE worker streams tokens to ITS consumers.
+        _finish(None)
+
+    def submit_prefilled(self, handoff: KVHandoff) -> "queue.Queue[object]":
+        """Decode-role admission: accept a prefill worker's finished KV
+        blocks + metadata; returns the token stream queue (same protocol
+        as submit(), first token delivered from the handoff header).
+
+        Epoch-fenced: a payload stamped with anything other than the
+        engine's current `handoff_epoch` raises StaleEpochError (the
+        transfer server turns that into a reject reply carrying the
+        current epoch) — after bump_handoff_epoch() the old generation's
+        payloads must never be absorbed into the fresh pool state.
+
+        Thread-safe (called from transfer-server connection threads):
+        only queues; the loop thread allocates blocks and injects."""
+        if self.role != "decode":
+            raise RuntimeError(
+                f"submit_prefilled requires role='decode', engine has"
+                f" role={self.role!r}"
+            )
+        prompt = list(handoff.prompt)
+        if not prompt:
+            raise ValueError("empty handoff prompt")
+        if handoff.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {handoff.max_new_tokens}"
+            )
+        if len(prompt) + handoff.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens"
+                f" {handoff.max_new_tokens} must not exceed max_len"
+                f" {self.max_len}"
+            )
+        c = self.config
+        want = (c.n_layers, self._block_size, c.n_kv_heads, c.head_dim)
+        got = (handoff.k.shape[0],) + tuple(handoff.k.shape[2:])
+        if got != want or handoff.k.shape != handoff.v.shape:
+            raise ValueError(
+                f"handoff KV geometry {handoff.k.shape} does not match"
+                f" this engine's pool (L, n, bs, KV, hd) ="
+                f" ({c.n_layers}, n, {self._block_size}, {c.n_kv_heads},"
+                f" {c.head_dim})"
+            )
+        expected = (len(prompt) - 1) // self._block_size + 1
+        if handoff.n_blocks != expected:
+            raise ValueError(
+                f"handoff carries {handoff.n_blocks} blocks but the"
+                f" prompt needs {expected}"
+            )
+        out: "queue.Queue[object]" = queue.Queue()
+        with self._lock:
+            if self._failed is not None:
+                raise RuntimeError(f"serving engine failed: {self._failed}")
+            if self._stop:
+                raise RuntimeError("serving engine is closed")
+            if handoff.epoch != self.handoff_epoch:
+                self._handoff_stale_rejected += 1
+                raise StaleEpochError(handoff.epoch, self.handoff_epoch)
+            self._prefilled_pending.append((handoff, out, time.monotonic()))
+            self._inflight.add(out)
+        self._wake.set()
+        return out
+
+    def bump_handoff_epoch(self) -> int:
+        """Start a new handoff generation (decode role): payloads stamped
+        before the bump are rejected on arrival. Call whenever pool state
+        is reset out from under in-flight prefills; a co-located
+        kv_transfer.TransferServer must bump in lockstep (it announces
+        the epoch in its hello)."""
+        with self._lock:
+            self.handoff_epoch += 1
+            return self.handoff_epoch
+
+    def _inject_blocks_fn(self, n_pad: int, draft: bool):
+        """Jitted scatter of a handoff payload into a pool: pad ids
+        carry the out-of-range sentinel and mode="drop" discards their
+        rows. Donates the pool (in-place update); payload arrives
+        replicated and lands under the pool's sharding."""
+        key = (n_pad, draft)
+        fn = self._inject_fns.get(key)
+        if fn is None:
+            sh = self._draft_shardings if draft else self._shardings
+            kw: Dict[str, Any] = {}
+            if sh is not None:
+                kw = dict(
+                    in_shardings=(sh.pool, sh.replicated, sh.replicated),
+                    out_shardings=sh.pool,
+                )
+            fn = jax.jit(
+                lambda pool, ids, payload: pool.at[:, ids].set(
+                    payload, mode="drop"
+                ),
+                donate_argnums=0, **kw,
+            )
+            self._inject_fns[key] = fn
+        return fn
+
+    def _pad_payload(self, arr: np.ndarray, n_pad: int) -> np.ndarray:
+        if arr.shape[1] == n_pad:
+            return arr
+        pad = np.zeros(
+            (arr.shape[0], n_pad - arr.shape[1]) + arr.shape[2:], arr.dtype
+        )
+        return np.concatenate([arr, pad], axis=1)
+
+    def _inject_handoff(self, h: KVHandoff, table: List[int]) -> None:
+        n = len(table)
+        n_pad = 1 << max(0, (n - 1).bit_length())
+        ids = jnp.asarray(
+            table + [self._num_blocks] * (n_pad - n), jnp.int32
+        )
+        fn = self._inject_blocks_fn(n_pad, draft=False)
+        self.state = self.state._replace(
+            k=fn(self.state.k, ids, self._pad_payload(h.k, n_pad)),
+            v=fn(self.state.v, ids, self._pad_payload(h.v, n_pad)),
+        )
+        if self._spec and h.draft_k is not None:
+            dfn = self._inject_blocks_fn(n_pad, draft=True)
+            self._draft_state = self._draft_state._replace(
+                k=dfn(self._draft_state.k, ids,
+                      self._pad_payload(h.draft_k, n_pad)),
+                v=dfn(self._draft_state.v, ids,
+                      self._pad_payload(h.draft_v, n_pad)),
+            )
+        # Spec on but no drafter payload (the prefill worker ran spec
+        # off): the drafter decodes from zero KV for this slot — verify
+        # stays exact (correctness never depends on the drafter), the
+        # acceptance EWMA just sinks and fallback bounds the perf loss.
+
+    def _activate_prefilled(self, slot: int, table: List[int], length: int,
+                            first: int, h: KVHandoff) -> None:
+        """Device half of handoff admission: the state update the final
+        prefill chunk would have applied had it run here — table row,
+        cache length, the prefill-sampled first token as last_token, the
+        remaining decode budget, and the request's sampling params."""
+        fn = self._activate_prefilled_fn
+        if fn is None:
+            def _activate(state, slot, row, length, first, budget, temp,
+                          top_p):
+                sel = (jnp.arange(state.lengths.shape[0], dtype=jnp.int32)
+                       == slot)
+                return state._replace(
+                    block_tables=state.block_tables.at[slot].set(row),
+                    lengths=jnp.where(sel, length, state.lengths),
+                    last_token=jnp.where(sel, first, state.last_token),
+                    active=jnp.where(sel, budget > 0, state.active),
+                    remaining=jnp.where(sel, budget, state.remaining),
+                    temperature=jnp.where(sel, temp, state.temperature),
+                    top_p=jnp.where(sel, top_p, state.top_p),
+                )
+
+            kw: Dict[str, Any] = {}
+            if self._shardings is not None:
+                kw = dict(
+                    in_shardings=(self._shardings.state,)
+                    + (self._shardings.replicated,) * 7,
+                    out_shardings=self._shardings.state,
+                )
+            fn = jax.jit(_activate, donate_argnums=0, **kw)
+            self._activate_prefilled_fn = fn
+        self.state = fn(
+            self.state,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(self._pad_table(table), jnp.int32),
+            jnp.asarray(length, jnp.int32),
+            jnp.asarray(first, jnp.int32),
+            jnp.asarray(h.max_new_tokens - 1, jnp.int32),
+            jnp.asarray(h.temperature, jnp.float32),
+            jnp.asarray(h.top_p, jnp.float32),
+        )
+
+    def _admit_prefilled(self) -> bool:
+        """Decode-role admission boundary (loop thread): drain queued
+        handoffs in arrival order into free slots — fresh blocks from
+        THIS pool's allocator, payload scattered in, prompt published to
+        the prefix cache, slot activated on device, first token (sampled
+        by the prefill worker) delivered immediately. A starved
+        allocation leaves the handoff queued and retries next boundary;
+        refcounts stay coherent through the same release paths as local
+        requests."""
+        progressed = False
+        while True:
+            with self._lock:
+                if not self._prefilled_pending:
+                    return progressed
+                h, out, t_recv = self._prefilled_pending[0]
+                dead = out in self._cancelled
+                if dead:
+                    self._prefilled_pending.pop(0)
+                    self._cancelled.discard(out)
+                    self._inflight.discard(out)
+            if dead:
+                out.put(None)
+                progressed = True
+                continue
+            busy = {t.slot for t in self._tasks}
+            free = [s for s in range(self.slots)
+                    if self._live[s] is None and s not in busy]
+            if not free:
+                return progressed
+            n = h.n_blocks
+            with self._lock:
+                table: List[int] = []
+                for _ in range(n):
+                    b = self._alloc.alloc()
+                    if b is None:
+                        break
+                    table.append(b)
+                if len(table) < n:
+                    for b in table:
+                        self._alloc.release(b)
+                    return progressed  # pool starved: retry next boundary
+                self._prefilled_pending.pop(0)
+            self._inject_handoff(h, table)
+            prompt = list(h.prompt)
+            first = int(h.first_token)
+            slot = free[0]
+            req = _Request(prompt, h.max_new_tokens, out,
+                           float(h.temperature), float(h.top_p), t_recv,
+                           h.request_id)
+            with self._lock:
+                self._alloc.insert_full(prompt, table)
+                self._handoffs_received += 1
+                self._kv_transfer_bytes += h.payload_bytes
+                if h.max_new_tokens > 1:
+                    self._live[slot] = req
+                    self._lengths_host[slot] = len(prompt)
+                    self._slot_tables[slot] = table
+                    self._slot_k[slot] = self._spec_init_k
+                    self._accept_ewma[slot] = None
+                    self._slot_t0[slot] = t_recv
+                else:
+                    # Defensive: the prefill role completes one-token
+                    # requests locally, but a direct submit_prefilled
+                    # caller may not — budget spent by the first token.
+                    for b in table:
+                        self._alloc.release(b)
+                    self._inflight.discard(out)
+            if h.max_new_tokens > 1:
+                self._activate_prefilled(slot, table, len(prompt), first, h)
+            now = time.monotonic()
+            with self._lock:
+                still_wanted = out not in self._cancelled
+                if still_wanted:
+                    out.put(first)
+                    if h.max_new_tokens <= 1:
+                        out.put(None)
+                elif h.max_new_tokens <= 1:
+                    # Cancelled inside the admission window: blocks were
+                    # already released above; answer the consumer here
+                    # (a live slot instead gets the fan-out cancel path).
+                    self._cancelled.discard(out)
+                    out.put(None)
+                # Decode-role TTFT: handoff receipt -> first delivery
+                # (admission wait + injection; the submit->handoff leg is
+                # the prefill worker's TTFT).
+                self._ttft_s = self._ewma_seed(self._ttft_s, now - t_recv)
+                self._n_admitted += 1
+                self._sum_ttft += now - t_recv
+                self._ttft_hist.observe(now - t_recv)
+                if not self._first_token_emitted:
+                    self._first_token_emitted = True
+                    auto_stage("first_token")
+            progressed = True
 
     # -- decode ---------------------------------------------------------------
 
@@ -1336,7 +1918,9 @@ class ServingEngine:
             try:
                 has_live = any(r is not None for r in self._live)
                 if not has_live and not self._tasks:
-                    if self._pending.empty():
+                    with self._lock:
+                        queued_handoffs = bool(self._prefilled_pending)
+                    if self._pending.empty() and not queued_handoffs:
                         t_w = time.monotonic()
                         self._wake.wait(timeout=0.2)
                         self._wake.clear()
@@ -1348,6 +1932,7 @@ class ServingEngine:
                     # freshly activated slots.
                     t_p = time.monotonic()
                     progressed = self._advance_prefills()
+                    progressed |= self._admit_prefilled()
                     self._wait_activations()
                     self._t_prefill += time.monotonic() - t_p
                     if not progressed and self._tasks:
@@ -1365,6 +1950,7 @@ class ServingEngine:
                 #    pad sentinel and silently drop.
                 t0 = time.monotonic()
                 self._advance_prefills()
+                self._admit_prefilled()
                 spec_now = self._spec and self._spec_cooldown == 0
                 if spec_now:
                     toks, still, t_pf = self._spec_round(t0)
@@ -1384,6 +1970,7 @@ class ServingEngine:
                     t_sync = time.monotonic()
                     self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
                     self._t_decode += t_sync - t_pf
+                    self._last_chunk_s = t_sync - t_pf
                     if self._spec and self._spec_cooldown > 0:
                         self._spec_fallback_rounds += 1
                         self._spec_cooldown -= 1
@@ -1455,6 +2042,7 @@ class ServingEngine:
         self._attn_dispatch[self._attn_path] += 2  # draft + verify programs
         self._chunk_s = self._ewma(self._chunk_s, t_sync - t_pf)
         self._t_decode += t_sync - t_pf
+        self._last_chunk_s = t_sync - t_pf
         self._t_spec_draft += t_draft - t_pf
         self._t_spec_verify += t_sync - t_draft
         # Acceptance bookkeeping + per-slot draft-length adaptation.
@@ -1505,11 +2093,13 @@ class ServingEngine:
         that finished or were cancelled."""
         with self._lock:
             cancelled = set(self._cancelled)
+        total_emitted = 0
         for slot, req in enumerate(self._live):
             if req is None:
                 continue
             n_emitted = int((toks[slot] >= 0).sum())
             self._lengths_host[slot] += n_emitted
+            total_emitted += n_emitted
             if req.out in cancelled:
                 # consumer is gone: free the slot now, skip the
                 # chunk's tokens (nobody reads them)
@@ -1550,6 +2140,11 @@ class ServingEngine:
             for tok in toks[slot]:
                 if tok >= 0:
                     req.out.put(int(tok))
+        if total_emitted:
+            # One TPT sample per chunk: decode wall time amortized over
+            # the tokens it emitted (the decode-isolation measurement
+            # the disaggregation bench reads, labeled by engine role).
+            self._tpt_hist.observe(self._last_chunk_s / total_emitted)
 
 
 def prometheus_metrics(stats: Dict[str, Any]) -> str:
@@ -1599,6 +2194,18 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
          stats.get("spec_accept_rate_ewma", 0.0)),
         ("dstack_tpu_serving_spec_draft_len_mean", "gauge",
          stats.get("spec_draft_len_mean", 0.0)),
+        # Prefill/decode disaggregation (all zero on a unified engine;
+        # .get defaults keep pre-disaggregation snapshots renderable).
+        ("dstack_tpu_serving_kv_handoffs_sent_total", "counter",
+         stats.get("kv_handoffs_sent_total", 0)),
+        ("dstack_tpu_serving_kv_handoffs_received_total", "counter",
+         stats.get("kv_handoffs_received_total", 0)),
+        ("dstack_tpu_serving_kv_handoffs_stale_rejected_total", "counter",
+         stats.get("kv_handoffs_stale_rejected_total", 0)),
+        ("dstack_tpu_serving_kv_transfer_bytes_total", "counter",
+         stats.get("kv_transfer_bytes_total", 0)),
+        ("dstack_tpu_serving_kv_transfer_queue_depth", "gauge",
+         stats.get("kv_transfer_queue_depth", 0)),
     ]
     lines = []
     for name, mtype, value in series:
@@ -1613,19 +2220,41 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
             f'{attn}{{path="{path}"}}'
             f' {stats.get(f"attn_dispatch_{path}_total", 0)}'
         )
-    # TTFT as a real histogram (declared base dstack_tpu_serving_ttft_seconds;
-    # the _bucket/_sum/_count series derive from it). Older stats snapshots
+    # Latency histograms, labeled with the engine role: a split
+    # request's prefill leg (submit -> handoff acked), decode leg
+    # (receipt -> first delivery) and a unified engine's full TTFT are
+    # different quantities — the label keeps scrapers from aggregating
+    # them into one meaningless distribution. Older stats snapshots
     # without ttft_hist degrade to the sum/count pair.
-    hist = stats.get("ttft_hist") or {
-        "buckets": [],
-        "sum": stats["ttft_seconds_sum"],
-        "count": stats["admitted_total"],
-    }
-    base = "dstack_tpu_serving_ttft_seconds"
-    lines.append(f"# TYPE {base} histogram")
-    for le, cumulative in hist["buckets"]:
-        lines.append(f'{base}_bucket{{le="{le}"}} {cumulative}')
-    lines.append(f'{base}_bucket{{le="+Inf"}} {hist["count"]}')
-    lines.append(f"{base}_sum {hist['sum']}")
-    lines.append(f"{base}_count {hist['count']}")
+    role = stats.get("role", "unified")
+
+    def _render_hist(base: str, hist: Dict[str, Any]) -> None:
+        lines.append(f"# TYPE {base} histogram")
+        for le, cumulative in hist["buckets"]:
+            lines.append(
+                f'{base}_bucket{{le="{le}",role="{role}"}} {cumulative}'
+            )
+        lines.append(
+            f'{base}_bucket{{le="+Inf",role="{role}"}} {hist["count"]}'
+        )
+        lines.append(f'{base}_sum{{role="{role}"}} {hist["sum"]}')
+        lines.append(f'{base}_count{{role="{role}"}} {hist["count"]}')
+
+    _render_hist(
+        "dstack_tpu_serving_ttft_seconds",
+        stats.get("ttft_hist") or {
+            "buckets": [],
+            "sum": stats["ttft_seconds_sum"],
+            "count": stats["admitted_total"],
+        },
+    )
+    _render_hist(
+        "dstack_tpu_serving_tpt_seconds",
+        stats.get("tpt_hist") or {"buckets": [], "sum": 0.0, "count": 0},
+    )
+    _render_hist(
+        "dstack_tpu_serving_kv_transfer_seconds",
+        stats.get("kv_transfer_hist")
+        or {"buckets": [], "sum": 0.0, "count": 0},
+    )
     return "\n".join(lines) + "\n"

@@ -17,6 +17,7 @@ import argparse
 import codecs
 import itertools
 import json
+import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -43,7 +44,9 @@ class Engine:
                  max_prefills_per_chunk: int = 4,
                  prefill_chunk_tokens: int = 128, kv_block_size: int = 16,
                  spec_enable: bool = False, spec_max_draft: int = 4,
-                 spec_draft_preset: str = "int8", kv_budget_mb: int = 0):
+                 spec_draft_preset: str = "int8", kv_budget_mb: int = 0,
+                 role: str = "unified", mesh_model: int = 1,
+                 kv_transfer_connect: str = ""):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -51,6 +54,7 @@ class Engine:
                 f" max_seq_len {self.config.max_seq_len} for {preset}"
             )
         self.max_new_tokens = max_new_tokens
+        self._handoff_ids = itertools.count(1)
         if checkpoint_dir:
             from dstack_tpu.workloads import checkpoint as ckpt
             from dstack_tpu.workloads.transformer import init_params as _init
@@ -99,6 +103,42 @@ class Engine:
         if spec_enable and spec_draft_preset != "int8":
             draft_config = PRESETS[spec_draft_preset]
             draft_params = init_params(draft_config, jax.random.PRNGKey(1))
+        # Tensor parallelism: shard the target (and drafter) weights plus
+        # the paged KV pools over a `model` mesh axis. The column-parallel
+        # specs keep contractions replicated, so a sharded server is
+        # token-bit-exact with the single-device one (no logic forks).
+        mesh = None
+        if mesh_model > 1:
+            from dstack_tpu.workloads.sharding import make_mesh
+
+            devs = jax.devices()
+            if len(devs) < mesh_model:
+                raise SystemExit(
+                    f"--mesh-model {mesh_model} needs that many devices,"
+                    f" have {len(devs)}"
+                )
+            mesh = make_mesh(devs[:mesh_model], model=mesh_model)
+        # Prefill/decode disaggregation: a prefill-tier server computes
+        # chunked prefill on its own devices and ships finished KV blocks
+        # to the decode tier over the kv_transfer seam; its chat API acks
+        # with finish_reason "kv_handoff" (tokens stream from the decode
+        # tier — see /v1/handoffs/<id> there).
+        kv_transfer = None
+        if role == "prefill":
+            if not kv_transfer_connect:
+                raise SystemExit(
+                    "--role prefill requires --kv-transfer-connect host:port"
+                )
+            from dstack_tpu.workloads.kv_transfer import TransferClient
+
+            host, _, port = kv_transfer_connect.rpartition(":")
+            try:
+                kv_transfer = TransferClient(host or "127.0.0.1", int(port))
+            except ValueError:
+                raise SystemExit(
+                    f"--kv-transfer-connect {kv_transfer_connect!r} is not"
+                    " host:port"
+                )
         try:
             self.serving = ServingEngine(
                 self.config, self.params, slots=slots, temperature=0.8,
@@ -110,6 +150,7 @@ class Engine:
                 spec_draft_params=draft_params,
                 spec_draft_config=draft_config,
                 kv_budget_bytes=kv_budget_mb * (1 << 20) or None,
+                mesh=mesh, role=role, kv_transfer=kv_transfer,
             )
         except ValueError as e:
             raise SystemExit(f"invalid serving configuration: {e}")
@@ -185,9 +226,17 @@ class Engine:
             # re-tokenization guess (byte vocab: one token per byte).
             usage_out["prompt_tokens"] = int(tokens.shape[1])
             usage_out["completion_tokens"] = 0
+        rid = None
+        if self.serving.role == "prefill":
+            # Correlation id carried on the KV handoff: the front-end
+            # fetches the stream from the decode tier at
+            # GET /v1/handoffs/<id>.
+            rid = next(self._handoff_ids)
+            if usage_out is not None:
+                usage_out["handoff_id"] = rid
         out = self.serving.submit(
             [int(t) for t in tokens[0]], max_new_tokens=budget,
-            temperature=temp, top_p=nucleus,
+            temperature=temp, top_p=nucleus, request_id=rid,
         )
         dec = codecs.getincrementaldecoder("utf-8")("replace")
         # Streaming stop matching: text already sent cannot be unsent, so
@@ -215,6 +264,13 @@ class Engine:
                     buf += dec.decode(b"", True)
                     if buf:
                         yield buf  # incomplete stop prefix at end: emit
+                    if (self.serving.role == "prefill" and budget > 1
+                            and usage_out is not None
+                            and not usage_out.get("completion_tokens")):
+                        # Handed off: the prefill tier never streams
+                        # tokens (the sampled first token travels inside
+                        # the KV handoff); this response is the ack.
+                        usage_out["finish_reason"] = "kv_handoff"
                     return
                 if usage_out is not None:
                     usage_out["completion_tokens"] += 1
@@ -290,6 +346,22 @@ def main() -> None:
     parser.add_argument("--spec-draft-preset", default="int8",
                         help="drafter model: 'int8' (quantized copy of the"
                              " target) or a smaller preset name")
+    parser.add_argument("--role", default="unified",
+                        choices=["unified", "prefill", "decode"],
+                        help="serving tier: unified (default) runs prefill"
+                             " and decode in-process; prefill ships finished"
+                             " KV blocks to the decode tier; decode admits"
+                             " handed-off requests on --kv-transfer-port")
+    parser.add_argument("--mesh-model", type=int, default=1,
+                        help="tensor-parallel shards over a `model` mesh"
+                             " axis (weights + paged KV pools; bit-exact"
+                             " with 1)")
+    parser.add_argument("--kv-transfer-port", type=int, default=0,
+                        help="decode role: port the KV transfer server"
+                             " listens on for prefill-tier handoffs")
+    parser.add_argument("--kv-transfer-connect", default="",
+                        help="prefill role: host:port of the decode tier's"
+                             " KV transfer server")
     parser.add_argument("--kv-budget-mb", type=int, default=0,
                         help="KV pool memory budget in MiB (0 = unlimited);"
                              " with --spec-enable the target AND drafter"
@@ -320,6 +392,8 @@ def main() -> None:
             f" {args.preset}'s max_seq_len {max_len}"
         )
 
+    if args.role == "decode" and not args.kv_transfer_port:
+        raise SystemExit("--role decode requires --kv-transfer-port")
     engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir,
                     quantize=args.quantize, max_pending=args.max_pending,
                     slots=args.slots, steps_per_sync=args.steps_per_sync,
@@ -329,7 +403,29 @@ def main() -> None:
                     spec_enable=args.spec_enable,
                     spec_max_draft=args.spec_max_draft,
                     spec_draft_preset=args.spec_draft_preset,
-                    kv_budget_mb=args.kv_budget_mb)
+                    kv_budget_mb=args.kv_budget_mb,
+                    role=args.role, mesh_model=args.mesh_model,
+                    kv_transfer_connect=args.kv_transfer_connect)
+
+    # Decode tier: admit prefill-tier handoffs and expose each admitted
+    # stream at GET /v1/handoffs/<request_id> (SSE) for the front-end to
+    # collect. Streams are parked until claimed; a claim is exclusive.
+    handoff_streams = {}
+    handoff_lock = threading.Lock()
+    transfer_server = None
+    if args.role == "decode":
+        from dstack_tpu.workloads.kv_transfer import TransferServer
+
+        def _on_handoff(h):
+            out = engine.serving.submit_prefilled(h)
+            with handoff_lock:
+                handoff_streams[h.request_id] = out
+
+        transfer_server = TransferServer(
+            "0.0.0.0", args.kv_transfer_port, _on_handoff,
+            epoch=engine.serving.handoff_epoch,
+        )
+        print(f"kv transfer server on :{transfer_server.port}", flush=True)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -429,7 +525,43 @@ def main() -> None:
                     self.wfile.write(body)
                     return
                 return self._send(200, stats)
+            if path.rstrip("/").startswith("/v1/handoffs/"):
+                return self._stream_handoff(path.rstrip("/"))
             self._send(404, {"error": "not found"})
+
+        def _stream_handoff(self, path: str) -> None:
+            """Decode tier: stream a handed-off request's tokens (SSE).
+
+            The claim is exclusive — the queue is popped so two readers
+            cannot interleave one stream."""
+            try:
+                rid = int(path.rsplit("/", 1)[1])
+            except ValueError:
+                return self._send(400, {"error": "handoff id must be int"})
+            with handoff_lock:
+                out = handoff_streams.pop(rid, None)
+            if out is None:
+                return self._send(404, {"error": f"no handoff {rid}"})
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            try:
+                while True:
+                    tok = out.get()
+                    if tok is None:
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        return
+                    if isinstance(tok, BaseException):
+                        return  # truncate without [DONE]: SSE "broken"
+                    ev = {"id": rid, "token": int(tok),
+                          "text": engine.decode([tok])}
+                    self.wfile.write(
+                        b"data: " + json.dumps(ev).encode() + b"\n\n"
+                    )
+                    self.wfile.flush()
+            except OSError:
+                engine.serving.cancel(out)  # reader gone: free the slot
 
         def do_POST(self):
             if self.path.rstrip("/") != "/v1/chat/completions":
@@ -451,6 +583,7 @@ def main() -> None:
             except Exception as e:  # surface engine errors as API errors
                 return self._send(500, {"error": str(e)})
             finish = usage.pop("finish_reason", "length")
+            handoff_id = usage.pop("handoff_id", None)
             self._send(200, {
                 "id": "chatcmpl-native",
                 "object": "chat.completion",
@@ -463,6 +596,8 @@ def main() -> None:
                 }],
                 "usage": {**usage,
                           "total_tokens": sum(usage.values())} if usage else {},
+                **({"handoff_id": handoff_id}
+                   if handoff_id is not None else {}),
             })
 
     class ModelHTTPServer(ThreadingHTTPServer):

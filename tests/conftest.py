@@ -35,3 +35,34 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def run_in_device_subprocess(source: str, *, device_count: int = 2,
+                             timeout: float = 420.0):
+    """Run a Python snippet in a fresh interpreter pinned to a virtual
+    CPU platform with exactly `device_count` devices.
+
+    XLA fixes the host-platform device count at first jax import, so
+    tests that need a specific mesh extent (rather than this process's
+    8) must run in a subprocess with the flag in the environment. Used
+    by the sharded-serving bit-exactness tests and the disaggregation
+    drill smoke. Returns the CompletedProcess; callers usually
+    `json.loads` the snippet's stdout.
+    """
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={device_count}"
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p
+    )
+    return subprocess.run(
+        [sys.executable, "-c", source], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=timeout,
+    )

@@ -102,6 +102,32 @@ def test_use_flash_dispatch_rules():
         del os.environ["DSTACK_TPU_FLASH_ATTENTION"]
 
 
+def test_use_flash_per_shard_head_rules():
+    """The rule judges the PER-SHARD geometry a partitioned program sees,
+    not the global one — callers pass global head counts + model_shards
+    and the division happens inside."""
+    # Unsharded with integral GQA: eligible.
+    assert use_flash(1024, 128, interpret=True,
+                     num_heads=4, num_kv_heads=2, model_shards=1)
+    # Fractional per-shard n_rep (3 q heads over 2 kv heads): fall back.
+    assert not use_flash(1024, 128, interpret=True,
+                         num_heads=3, num_kv_heads=2, model_shards=1)
+    # Any model sharding: the lax fallback is what GSPMD partitions —
+    # pallas_call has no SPMD partitioning rule.
+    assert not use_flash(1024, 128, interpret=True,
+                         num_heads=4, num_kv_heads=2, model_shards=2)
+    # Head counts must divide the shard count (engine validates the same
+    # thing at construction; the rule refuses to silently mis-judge).
+    with pytest.raises(ValueError):
+        use_flash(1024, 128, interpret=True,
+                  num_heads=4, num_kv_heads=3, model_shards=2)
+    # Both-or-neither head counts.
+    with pytest.raises(ValueError):
+        use_flash(1024, 128, interpret=True, num_heads=4)
+    with pytest.raises(ValueError):
+        use_flash(1024, 128, interpret=True, model_shards=0)
+
+
 def test_ring_block_matches_block_attend():
     """The fused ring-step kernel == attention._block_attend for both the
     diagonal (tril) and earlier-shard (full) mask modes."""

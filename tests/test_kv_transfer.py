@@ -1,0 +1,186 @@
+"""KV handoff seam: framing round-trips, epoch fencing, loopback
+client/server delivery with ack-after-admission semantics.
+
+No engine here — the seam is plain sockets + numpy, so these tests pin
+the wire protocol independently of serving.py (test_serving_disagg.py
+covers the engine integration; the two-process drill covers the whole
+path)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import free_port
+from dstack_tpu.workloads.kv_transfer import (
+    KVHandoff,
+    StaleEpochError,
+    TransferClient,
+    TransferServer,
+    pack_handoff,
+    recv_msg,
+    send_msg,
+    unpack_handoff,
+)
+
+
+def _handoff(epoch=1, rid=7, blocks=3, draft=False):
+    shape = (2, blocks, 16, 2, 32)  # (L, n_blocks, bs, KV, hd)
+    rng = np.random.default_rng(rid)
+    k = rng.standard_normal(shape, dtype=np.float32)
+    v = rng.standard_normal(shape, dtype=np.float32)
+    return KVHandoff(
+        request_id=rid, epoch=epoch, prompt=list(range(1, 40)),
+        first_token=11, max_new_tokens=8, temperature=0.0, top_p=1.0,
+        k=k, v=v,
+        draft_k=k * 2 if draft else None,
+        draft_v=v * 2 if draft else None,
+    )
+
+
+def test_framing_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    h = _handoff(draft=True)
+    header, payloads = pack_handoff(h)
+    t = threading.Thread(target=send_msg, args=(a, header, payloads))
+    t.start()
+    got = unpack_handoff(recv_msg(b))
+    t.join()
+    a.close(), b.close()
+    assert got.request_id == h.request_id and got.epoch == h.epoch
+    assert got.prompt == h.prompt
+    assert got.first_token == h.first_token
+    np.testing.assert_array_equal(got.k, h.k)
+    np.testing.assert_array_equal(got.v, h.v)
+    np.testing.assert_array_equal(got.draft_k, h.draft_k)
+    assert got.payload_bytes == h.payload_bytes
+    assert got.n_blocks == 3
+
+
+def test_framing_roundtrip_bf16_and_no_draft():
+    import jax.numpy as jnp  # registers ml_dtypes' bfloat16 with numpy
+
+    a, b = socket.socketpair()
+    h = _handoff()
+    h = h._replace(k=h.k.astype(jnp.bfloat16), v=h.v.astype(jnp.bfloat16))
+    header, payloads = pack_handoff(h)
+    t = threading.Thread(target=send_msg, args=(a, header, payloads))
+    t.start()
+    got = unpack_handoff(recv_msg(b))
+    t.join()
+    a.close(), b.close()
+    assert got.k.dtype == h.k.dtype
+    np.testing.assert_array_equal(got.k, h.k)
+    assert got.draft_k is None and got.draft_v is None
+
+
+def test_loopback_delivery_and_counters():
+    received = []
+    server = TransferServer("127.0.0.1", free_port(),
+                            lambda h: received.append(h))
+    client = TransferClient("127.0.0.1", server.port)
+    try:
+        h = _handoff(epoch=1)
+        client.send(h)  # blocking: returns only after the ack
+        assert len(received) == 1
+        np.testing.assert_array_equal(received[0].k, h.k)
+        assert client.handoffs_sent == 1
+        assert server.handoffs_accepted == 1
+        assert server.bytes_received >= h.payload_bytes
+        assert client.bytes_sent >= h.payload_bytes
+        assert client.epoch == 1  # learned from the hello
+    finally:
+        client.close()
+        server.close()
+
+
+def test_stale_epoch_reject_then_refresh_retry():
+    """A bump between stamp and delivery rejects ONCE; the client learns
+    the new epoch from the reject and its single retry lands."""
+    received = []
+    server = TransferServer("127.0.0.1", free_port(),
+                            lambda h: received.append(h), epoch=1)
+    client = TransferClient("127.0.0.1", server.port)
+    try:
+        client.send(_handoff(epoch=1, rid=1))  # learns epoch 1
+        server.bump_epoch()
+        client.send(_handoff(epoch=1, rid=2))  # stale stamp -> retried
+        assert [h.request_id for h in received] == [1, 2]
+        assert received[1].epoch == 2          # restamped on retry
+        assert server.stale_rejected == 1
+        assert client.stale_rejects_seen == 1
+        assert client.epoch == 2
+    finally:
+        client.close()
+        server.close()
+
+
+def test_stale_epoch_raises_without_retry():
+    """A client learns the live epoch from the connect-time hello, so
+    staleness needs a bump AFTER the connection is up."""
+    server = TransferServer("127.0.0.1", free_port(), lambda h: None,
+                            epoch=1)
+    client = TransferClient("127.0.0.1", server.port, retry_stale=False)
+    try:
+        client._connect()  # hello: learns epoch 1
+        server.bump_epoch()
+        with pytest.raises(StaleEpochError) as e:
+            client.send(_handoff())
+        assert e.value.got == 1 and e.value.current == 2
+        assert server.handoffs_accepted == 0
+        assert server.stale_rejected == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_callback_stale_raise_is_rejected_not_crashed():
+    """submit_prefilled can itself raise StaleEpochError (the engine owns
+    a second fence, bumped in lockstep with the server's); the server
+    must turn that into a reject, count it, and keep serving the
+    connection."""
+    calls = []
+    srv = {}
+
+    def cb(h):
+        calls.append(h.request_id)
+        if len(calls) == 1:
+            # Mimic the engine fence losing a race: the epoch moved
+            # between the wire check and admission.
+            srv["s"].bump_epoch()
+            raise StaleEpochError(h.epoch, srv["s"].epoch)
+
+    server = TransferServer("127.0.0.1", free_port(), cb, epoch=1)
+    srv["s"] = server
+    client = TransferClient("127.0.0.1", server.port, retry_stale=False)
+    try:
+        with pytest.raises(StaleEpochError):
+            client.send(_handoff(rid=1))
+        assert server.stale_rejected == 1
+        assert client.epoch == 2       # reject carried the new epoch
+        client.send(_handoff(rid=2))   # same connection still serves
+        assert calls == [1, 2]
+        assert server.handoffs_accepted == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_client_reconnects_after_server_side_drop():
+    received = []
+    server = TransferServer("127.0.0.1", free_port(),
+                            lambda h: received.append(h.request_id))
+    client = TransferClient("127.0.0.1", server.port)
+    try:
+        client.send(_handoff(rid=1))
+        # Sever the transport under the client; the next send must
+        # redial instead of failing the handoff.
+        client._sock.close()
+        time.sleep(0.05)
+        client.send(_handoff(rid=2))
+        assert received == [1, 2]
+    finally:
+        client.close()
+        server.close()

@@ -159,6 +159,29 @@ def test_env_kill_switch_forces_fallback(monkeypatch):
     assert dispatch_path(72, 128, 8, interpret=True) == "lax_ragged"
 
 
+def test_dispatch_path_per_shard_heads():
+    """Sharded engines pass GLOBAL head counts + the mesh's `model`
+    extent; the path choice must reflect the per-shard geometry each
+    partitioned program actually runs."""
+    # Unsharded, integral per-shard GQA: the kernel path stands.
+    assert dispatch_path(72, 128, 8, interpret=True,
+                         num_heads=4, num_kv_heads=2,
+                         model_shards=1) == "pallas"
+    # model-sharded: always the lax fallback (GSPMD partitions it; the
+    # pallas kernel would force a full gather of the sharded pools).
+    assert dispatch_path(72, 128, 8, interpret=True,
+                         num_heads=4, num_kv_heads=2,
+                         model_shards=2) == "lax_ragged"
+    # Per-shard n_rep must stay integral.
+    assert dispatch_path(72, 128, 8, interpret=True,
+                         num_heads=3, num_kv_heads=2,
+                         model_shards=1) == "lax_ragged"
+    # Indivisible head counts are a config error, not a silent fallback.
+    with pytest.raises(ValueError):
+        dispatch_path(72, 128, 8, interpret=True,
+                      num_heads=6, num_kv_heads=2, model_shards=4)
+
+
 # ------------------------------------------------- engine-level exactness
 
 

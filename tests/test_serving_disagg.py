@@ -1,0 +1,262 @@
+"""Prefill/decode disaggregation at the engine level.
+
+A role="prefill" engine chunk-prefills on its own pools and ships
+finished KV blocks through the kv_transfer seam; a role="decode" engine
+admits them into fresh blocks and streams tokens. At temperature 0 the
+split must be BIT-exact with a unified engine — including prompts that
+end mid-chunk and mid-block, decodes that cross block boundaries, and a
+full speculation round — and both pools must drain to zero residue
+after clean ends, cancels, and stale-epoch rejections.
+
+These tests bridge the two engines in-process (the seam's send() is the
+only coupling point); the two-OS-process path with real sockets is
+covered by the drill (workloads/serving_disagg.py, `make drill-disagg`)
+and its smoke test in test_serving_sharded.py.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.kv_transfer import KVHandoff, StaleEpochError
+from dstack_tpu.workloads.serving import ServingEngine, prometheus_metrics
+from dstack_tpu.workloads.sharding import make_mesh
+from dstack_tpu.workloads.transformer import init_params
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+# Awkward on purpose: 29 ends mid-block (16-blocks), 32 is exactly two
+# blocks with a budget crossing the next boundary mid-decode, 37 leaves
+# a 5-token remainder after a 32-token prefill chunk, 17/1 completes on
+# the prefill side without a handoff.
+SCENARIOS = [
+    (list(range(1, 30)), 20),
+    (list(range(3, 35)), 33),
+    (list(range(5, 42)), 12),
+    (list(range(7, 24)), 1),
+]
+ENGINE_KW = dict(slots=4, max_len=128, kv_block_size=16,
+                 prefill_chunk_tokens=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _drain(out):
+    toks = []
+    while True:
+        t = out.get(timeout=120)
+        if t is None:
+            return toks
+        if isinstance(t, BaseException):
+            raise t
+        toks.append(t)
+
+
+def _unified_streams(params, **kw):
+    eng = ServingEngine(CFG, params, **ENGINE_KW, **kw)
+    try:
+        return [_drain(eng.submit(p, b)) for p, b in SCENARIOS]
+    finally:
+        eng.close()
+
+
+class Bridge:
+    """In-process stand-in for TransferClient: stamps the decode
+    engine's live epoch and calls submit_prefilled directly."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.outs = {}
+
+    def send(self, h: KVHandoff) -> None:
+        h = h._replace(epoch=self.engine.handoff_epoch)
+        self.outs[h.request_id] = self.engine.submit_prefilled(h)
+
+
+def _run_disagg(params, *, mesh=None, **kw):
+    dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode",
+                        mesh=mesh, **kw)
+    bridge = Bridge(dec)
+    pre = ServingEngine(CFG, params, **ENGINE_KW, role="prefill",
+                        kv_transfer=bridge, mesh=mesh, **kw)
+    try:
+        outs = [pre.submit(p, b, request_id=i)
+                for i, (p, b) in enumerate(SCENARIOS)]
+        got = {}
+        for i, out in enumerate(outs):
+            r = _drain(out)
+            if SCENARIOS[i][1] <= 1:
+                got[i] = r  # completed locally on the prefill side
+            else:
+                assert r == [], f"prefill-side stream must be empty: {r}"
+        for rid, out in bridge.outs.items():
+            got[rid] = _drain(out)
+        streams = [got[i] for i in range(len(SCENARIOS))]
+        ps, ds = pre.stats(), dec.stats()
+        return streams, ps, ds
+    finally:
+        pre.close()
+        dec.close()
+
+
+def _assert_zero_residue(stats):
+    # The prefix cache legitimately holds blocks at refcount 1, so
+    # in_use == cached is the no-leak condition after all streams end.
+    assert stats["kv_blocks_in_use"] == stats["kv_blocks_cached"], stats
+
+
+def test_disagg_bitexact_and_zero_residue(params):
+    ref = _unified_streams(params)
+    streams, ps, ds = _run_disagg(params)
+    assert streams == ref
+    _assert_zero_residue(ps)
+    _assert_zero_residue(ds)
+    handed = sum(1 for _, b in SCENARIOS if b > 1)
+    assert ps["kv_handoffs_sent_total"] == handed
+    assert ds["kv_handoffs_received_total"] == handed
+    assert ps["kv_transfer_bytes_total"] > 0
+    assert ds["kv_transfer_bytes_total"] == ps["kv_transfer_bytes_total"]
+    assert ps["role"] == "prefill" and ds["role"] == "decode"
+
+
+def test_disagg_sharded_bitexact(params):
+    """Both tiers tensor-parallel over a 2-way `model` mesh: still
+    token-bit-exact with the unsharded unified engine (column-parallel
+    specs keep every contraction replicated)."""
+    mesh = make_mesh(jax.devices()[:2], model=2)
+    ref = _unified_streams(params)
+    streams, ps, ds = _run_disagg(params, mesh=mesh)
+    assert streams == ref
+    _assert_zero_residue(ps)
+    _assert_zero_residue(ds)
+
+
+@pytest.mark.slow
+def test_disagg_spec_round_bitexact(params):
+    """Speculative decoding across the split: drafter KV rides the
+    handoff, and the decode side's spec rounds stay bit-exact with a
+    unified spec engine (budgets cover several full draft+verify
+    rounds)."""
+    ref = _unified_streams(params, spec_enable=True)
+    streams, ps, ds = _run_disagg(params, spec_enable=True)
+    assert streams == ref
+    _assert_zero_residue(ps)
+    _assert_zero_residue(ds)
+    assert ds["spec_rounds_total"] > 0
+
+
+def test_stale_epoch_rejected_with_zero_residue(params):
+    dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode")
+    try:
+        before = dec.stats()
+        dec.bump_handoff_epoch()
+        shape = (CFG.n_layers, 1, 16, CFG.n_kv_heads, CFG.head_dim)
+        stale = KVHandoff(
+            request_id=99, epoch=1, prompt=list(range(10)), first_token=3,
+            max_new_tokens=4, temperature=0.0, top_p=1.0,
+            k=np.zeros(shape, np.float32), v=np.zeros(shape, np.float32),
+        )
+        with pytest.raises(StaleEpochError) as e:
+            dec.submit_prefilled(stale)
+        assert e.value.got == 1 and e.value.current == 2
+        after = dec.stats()
+        assert after["kv_handoffs_stale_rejected_total"] == 1
+        assert after["kv_blocks_in_use"] == before["kv_blocks_in_use"]
+        assert after["handoff_epoch"] == 2
+    finally:
+        dec.close()
+
+
+def test_submit_prefilled_validates_geometry(params):
+    dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode")
+    try:
+        shape = (CFG.n_layers, 2, 16, CFG.n_kv_heads, CFG.head_dim)
+        good = dict(request_id=1, epoch=1, prompt=list(range(20)),
+                    first_token=3, max_new_tokens=4, temperature=0.0,
+                    top_p=1.0, k=np.zeros(shape, np.float32),
+                    v=np.zeros(shape, np.float32))
+        # Wrong block count for the prompt (20 tokens -> 2 blocks, not 1).
+        with pytest.raises(ValueError):
+            dec.submit_prefilled(KVHandoff(**{
+                **good, "k": good["k"][:, :1], "v": good["v"][:, :1]}))
+        # Wrong KV geometry (block size mismatch).
+        with pytest.raises(ValueError):
+            dec.submit_prefilled(KVHandoff(**{
+                **good, "k": good["k"][:, :, :8], "v": good["v"][:, :, :8]}))
+        # Budget past the pool's row capacity.
+        with pytest.raises(ValueError):
+            dec.submit_prefilled(KVHandoff(**{
+                **good, "max_new_tokens": 1000}))
+        # A unified engine refuses handoffs outright.
+        uni = ServingEngine(CFG, params, **ENGINE_KW)
+        try:
+            with pytest.raises(RuntimeError):
+                uni.submit_prefilled(KVHandoff(**good))
+        finally:
+            uni.close()
+    finally:
+        dec.close()
+
+
+def test_prefill_role_requires_transfer(params):
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, **ENGINE_KW, role="prefill")
+
+
+def test_cancel_mid_handoff_leaves_no_residue(params):
+    dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode")
+    bridge = Bridge(dec)
+    pre = ServingEngine(CFG, params, **ENGINE_KW, role="prefill",
+                        kv_transfer=bridge)
+    try:
+        out = pre.submit(list(range(11, 90)), 20, request_id=50)
+        pre.cancel(out)
+        r = out.get(timeout=60)
+        assert r is None or isinstance(r, int)
+        if 50 in bridge.outs:  # the handoff raced ahead of the cancel
+            _drain(bridge.outs[50])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ps, ds = pre.stats(), dec.stats()
+            if (ps["kv_blocks_in_use"] == ps["kv_blocks_cached"]
+                    and ds["kv_blocks_in_use"] == ds["kv_blocks_cached"]):
+                break
+            time.sleep(0.1)
+        _assert_zero_residue(pre.stats())
+        _assert_zero_residue(dec.stats())
+    finally:
+        pre.close()
+        dec.close()
+
+
+def test_role_metrics_render(params):
+    dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode")
+    bridge = Bridge(dec)
+    pre = ServingEngine(CFG, params, **ENGINE_KW, role="prefill",
+                        kv_transfer=bridge)
+    try:
+        _drain(pre.submit(list(range(1, 40)), 8, request_id=0))
+        _drain(bridge.outs[0])
+        pm = prometheus_metrics(pre.stats())
+        dm = prometheus_metrics(dec.stats())
+        assert 'dstack_tpu_serving_kv_handoffs_sent_total 1' in pm
+        assert 'dstack_tpu_serving_kv_handoffs_received_total 1' in dm
+        assert 'dstack_tpu_serving_kv_transfer_bytes_total' in pm
+        assert 'dstack_tpu_serving_kv_transfer_queue_depth 0' in pm
+        # Role-labeled latency series: the prefill leg's TTFT and the
+        # decode leg's TTFT/TPT are different quantities and must not
+        # aggregate into one distribution.
+        assert 'role="prefill"' in pm
+        assert 'role="decode"' in dm
+        assert "dstack_tpu_serving_kv_transfer_seconds_count" in pm
+        assert "dstack_tpu_serving_tpt_seconds_bucket" in dm
+        assert "dstack_tpu_serving_ttft_seconds_count" in dm
+    finally:
+        pre.close()
+        dec.close()

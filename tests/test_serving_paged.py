@@ -224,20 +224,27 @@ def test_prometheus_metrics_matches_registry(params):
             assert name in METRICS, f"undeclared series {name}"
             assert METRICS[name][0] == mtype, name
             # Serving series carry no labels, except the r12 attention
-            # dispatch counter (path=pallas|lax_ragged) — its samples are
-            # checked against the declared label set below.
-            if name != "dstack_tpu_serving_attn_dispatch_total":
+            # dispatch counter (path=pallas|lax_ragged) and the r13
+            # role-labeled latency histograms — their samples are
+            # checked against the declared label sets below.
+            if name not in ("dstack_tpu_serving_attn_dispatch_total",
+                            "dstack_tpu_serving_ttft_seconds",
+                            "dstack_tpu_serving_tpt_seconds",
+                            "dstack_tpu_serving_kv_transfer_seconds"):
                 assert METRICS[name][1] == (), name
             seen.add(name)
         else:
             name, _, value = line.partition(" ")
             base = name.partition("{")[0]
-            assert base in seen or histogram_base(base) in seen, \
-                f"sample before TYPE: {name}"
+            decl = histogram_base(base) or base
+            assert decl in seen, f"sample before TYPE: {name}"
             if base == "dstack_tpu_serving_attn_dispatch_total":
                 assert name in (
                     base + '{path="pallas"}', base + '{path="lax_ragged"}'
                 ), name
+            if METRICS.get(decl, ("", ()))[1] == ("role",):
+                # a unified engine's whole distribution is one role
+                assert 'role="unified"' in name, name
             sampled.add(base)
             float(value)
     for expected in ("dstack_tpu_serving_kv_blocks_in_use",

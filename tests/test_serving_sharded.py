@@ -1,0 +1,164 @@
+"""Tensor-parallel serving: spec-table coverage and bit-exactness.
+
+The serving layout is COLUMN-parallel on purpose: "model" rides only
+output dims and every contraction stays replicated, so the sharded
+engine is token- and KV-pool-bit-exact with the unsharded one (a
+standard row+column TP layout reduces with psum and drifts in the last
+float bit — which temp-0 greedy sampling then amplifies into different
+tokens). These tests pin the spec tables for every leaf family the
+engine loads — float target, LoRA A/B adapters, int8 QTensor drafter —
+and the bit-exactness claim itself, in-process on this suite's virtual
+8-device CPU platform and in a subprocess pinned to exactly 2 devices
+via the conftest helper.
+"""
+
+import json
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import run_in_device_subprocess
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.kv_blocks import init_paged_state
+from dstack_tpu.workloads.lora import lora_init
+from dstack_tpu.workloads.quant import QTensor, quantize_params
+from dstack_tpu.workloads.sharding import (
+    SERVING_KV_POOL_SPEC,
+    make_mesh,
+    make_serving_shardings,
+    serving_param_shardings,
+    serving_state_shardings,
+)
+from dstack_tpu.workloads.transformer import init_params
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(jax.devices()[:2], model=2)
+
+
+def test_serving_param_specs_cover_target_tree(params, mesh):
+    sh = serving_param_shardings(mesh, params)
+    # Column-parallel: projections shard their OUTPUT dim over "model";
+    # contractions (embed rows, inputs) are replicated.
+    assert sh["layers"]["wq"].spec == P(None, None, "model")
+    assert sh["layers"]["wo"].spec == P(None, None, "model")
+    assert sh["layers"]["w_down"].spec == P(None, None, "model")
+    assert sh["embed"].spec == P(None, None)
+    assert sh["lm_head"].spec == P(None, "model")
+    # Every leaf got a sharding (an uncovered weight raises instead of
+    # silently replicating).
+    leaves = jax.tree_util.tree_leaves(sh)
+    assert len(leaves) == len(jax.tree_util.tree_leaves(params))
+
+
+def test_serving_lora_specs(params, mesh):
+    """LoRA under serving TP: the x@A contraction (over d_model) stays
+    replicated like every other serving contraction; only B's output dim
+    rides "model", matching the base weight's shard."""
+    lora = lora_init(CFG, params, jax.random.PRNGKey(1), rank=4)
+    sh = serving_param_shardings(mesh, lora)
+    assert sh["layers"]["wq_a"].spec == P(None, None, None)
+    assert sh["layers"]["wq_b"].spec == P(None, None, "model")
+    assert sh["layers"]["wv_a"].spec == P(None, None, None)
+    assert sh["layers"]["wv_b"].spec == P(None, None, "model")
+
+
+def test_serving_qtensor_specs(params, mesh):
+    """int8 drafter weights: the q payload has its float parent's
+    shape/layout and inherits the parent's spec; the per-output-channel
+    scale is (..., 1, out) f32 and replicates."""
+    q = quantize_params(params)
+    assert isinstance(q["layers"]["wq"], QTensor)
+    sh = serving_param_shardings(mesh, q)
+    assert sh["layers"]["wq"].q.spec == P(None, None, "model")
+    assert sh["layers"]["wq"].scale.spec == P()
+    assert sh["layers"]["w_up"].q.spec == P(None, None, "model")
+    assert sh["layers"]["w_up"].scale.spec == P()
+    # Unquantized leaves (norms, embed) keep their float rules.
+    assert sh["layers"]["attn_norm"].spec == P(None, None)
+
+
+def test_serving_state_shardings(mesh):
+    state = init_paged_state(CFG, batch=4, max_len=128, block_size=16,
+                             num_blocks=32)
+    sh = serving_state_shardings(mesh, state)
+    # KV pools (L, NB, bs, KV, hd) shard the KV-head dim over "model",
+    # matching the column-parallel wk/wv output shard.
+    assert sh.k.spec == SERVING_KV_POOL_SPEC
+    assert sh.v.spec == SERVING_KV_POOL_SPEC
+    # Host-driven control state is replicated.
+    assert sh.block_tables.spec == P()
+    assert sh.lengths.spec == P()
+    full = make_serving_shardings(mesh, {}, state)
+    assert full.pool.spec == SERVING_KV_POOL_SPEC
+    assert full.replicated.spec == P()
+
+
+def test_sharded_engine_rejects_indivisible_heads(params):
+    """tiny has 2 KV heads: a 4-way model mesh cannot shard them."""
+    from dstack_tpu.workloads.serving import ServingEngine
+
+    mesh4 = make_mesh(jax.devices()[:4], model=4)
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, slots=2, max_len=128, mesh=mesh4)
+
+
+_SUBPROCESS_BITEXACT = """
+import json
+import jax
+
+from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.serving import ServingEngine
+from dstack_tpu.workloads.sharding import make_mesh
+from dstack_tpu.workloads.transformer import init_params
+
+assert len(jax.devices()) == 2, jax.devices()
+cfg = PRESETS["tiny"].with_(remat=False)
+params = init_params(cfg, jax.random.PRNGKey(0))
+scenarios = [(list(range(1, 30)), 20), (list(range(3, 35)), 18)]
+
+
+def drain(out):
+    toks = []
+    while True:
+        t = out.get(timeout=120)
+        if t is None:
+            return toks
+        if isinstance(t, BaseException):
+            raise t
+        toks.append(int(t))
+
+
+def run(mesh):
+    eng = ServingEngine(cfg, params, slots=2, max_len=128,
+                        kv_block_size=16, mesh=mesh)
+    try:
+        return [drain(eng.submit(p, b)) for p, b in scenarios]
+    finally:
+        eng.close()
+
+
+base = run(None)
+sharded = run(make_mesh(jax.devices(), model=2))
+print(json.dumps({"bit_exact": base == sharded, "base": base}))
+"""
+
+
+def test_sharded_serving_bitexact_subprocess():
+    """The claim end-to-end on a mesh whose extent this test controls:
+    a 2-way model-sharded engine in a 2-device subprocess produces the
+    SAME tokens as the unsharded engine."""
+    proc = run_in_device_subprocess(_SUBPROCESS_BITEXACT, device_count=2)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["bit_exact"] is True
+    assert all(result["base"])  # non-empty streams actually compared
