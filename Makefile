@@ -73,12 +73,15 @@ bench-proxy:
 
 # Serving-engine benchmark: chunked prefill + paged KV with prefix
 # sharing, speculative-decoding arms, the r12 ragged-paged-attention
-# cells, and the r13 sharded (tensor-parallel bit-exactness/overhead)
-# and disaggregation (prefill-flood decode-isolation) arms. Results
-# land in BENCH_serving_r13.json; see docs/guides/serving-tuning.md
+# cells, the r13 sharded (tensor-parallel bit-exactness/overhead) and
+# disaggregation (prefill-flood decode-isolation) arms, and the r14
+# multi-tenant arms (mixed-adapter LoRA batch vs merged-engine token
+# equality + empty-pool overhead; noisy-neighbor steady-tenant TTFT
+# with QoS on/off/no-flood). Results land in BENCH_serving_r14.json;
+# see docs/guides/serving-tuning.md and docs/guides/multi-tenant.md
 # for how to read them.
 bench-serving:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r13.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r14.json
 
 # Prefill/decode disaggregation drill: two real worker processes over a
 # 2-way model mesh each, KV handoffs over a socket. Asserts token

@@ -60,6 +60,19 @@ class DataPlaneContext:
             tracer=self.tracer,
         )
         self.service_stats = ServiceStatsCollector()
+        # Per-tenant QoS gate (model route): opt-in via
+        # DSTACK_TPU_QOS_TENANT_RATE > 0. The worker tier is the natural
+        # enforcement point — shedding here keeps a flooding tenant's
+        # requests off the engine queue entirely.
+        self.qos_gate = None
+        if settings.QOS_TENANT_RATE > 0:
+            from dstack_tpu.dataplane.qos import QoSGate
+
+            self.qos_gate = QoSGate(
+                rate=settings.QOS_TENANT_RATE,
+                burst=settings.QOS_TENANT_BURST,
+                tenant_cap=settings.QOS_TENANT_CAP,
+            )
         self.poll_interval = (
             settings.DATAPLANE_EPOCH_POLL if poll_interval is None else poll_interval
         )

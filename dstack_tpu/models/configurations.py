@@ -81,7 +81,12 @@ def _parse_ports(items: List[Any]) -> List[PortMapping]:
 
 
 class ScalingSpec(CoreModel):
-    metric: Literal["rps"]
+    # "rps": target requests/s per replica (RPSAutoscaler).
+    # "ttft_p95" / "tpt_p95": target SECONDS for the windowed p95 of
+    # time-to-first-token / time-per-token (SLOAutoscaler) — the target
+    # states what users experience instead of requiring the operator to
+    # know each model's capacity curve.
+    metric: Literal["rps", "ttft_p95", "tpt_p95"]
     target: float
     scale_up_delay: Duration = Duration.parse("5m")
     scale_down_delay: Duration = Duration.parse("10m")

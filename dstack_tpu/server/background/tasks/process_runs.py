@@ -189,7 +189,16 @@ async def _maybe_autoscale(ctx: ServerContext, row: sqlite3.Row, jobs) -> None:
     rps = ctx.service_stats.get_rps(project["name"], row["run_name"])
     rejected = ctx.service_stats.get_rejection_rps(project["name"], row["run_name"])
     last_scaled = parse_dt(row["last_scaled_at"]) if row["last_scaled_at"] else None
-    decision = scaler.scale(current, rps, utcnow(), last_scaled, rejected_rps=rejected)
+    extra = {}
+    if getattr(scaler, "wants_latency", False):
+        # SLO scaler: feed it the windowed latency distribution the
+        # proxy records at TTFB (services/stats.py).
+        extra["latency_hist"] = ctx.service_stats.get_latency_hist(
+            project["name"], row["run_name"], scaler.stat_metric
+        )
+    decision = scaler.scale(
+        current, rps, utcnow(), last_scaled, rejected_rps=rejected, **extra
+    )
     if decision.desired == current:
         return
     logger.info(

@@ -81,6 +81,13 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # prefix-cache effectiveness, chunked-prefill accounting, and the
     # admission counters behind the TTFT histogram.
     "dstack_tpu_serving_admitted_total": ("counter", ()),
+    # Multi-tenant LoRA serving (workloads/lora_serving.py + the native
+    # server's QoS layer): adapter-pool occupancy plus per-tenant
+    # request/shed counters and TTFT. The tenant label is
+    # bounded-cardinality by construction (dataplane/qos.TenantLabels
+    # collapses tenants past the cap into "overflow") — client-chosen
+    # ids never mint unbounded series.
+    "dstack_tpu_serving_adapters_loaded": ("gauge", ()),
     # Ragged paged attention: jitted-program dispatches per
     # implementation (path = "pallas" | "lax_ragged").
     "dstack_tpu_serving_attn_dispatch_total": ("counter", ("path",)),
@@ -117,6 +124,13 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_spec_tokens_proposed_total": ("counter", ()),
     "dstack_tpu_serving_spec_tokens_rejected_total": ("counter", ()),
     "dstack_tpu_serving_spec_verify_seconds_total": ("counter", ()),
+    # Per-tenant QoS (dataplane/qos.py via the native server): admission
+    # and shed counts, and the per-tenant TTFT distribution the
+    # noisy-neighbor bench reads. See the cardinality note on
+    # dstack_tpu_serving_adapters_loaded.
+    "dstack_tpu_serving_tenant_requests_total": ("counter", ("tenant",)),
+    "dstack_tpu_serving_tenant_shed_total": ("counter", ("tenant",)),
+    "dstack_tpu_serving_tenant_ttft_seconds": ("histogram", ("tenant",)),
     # Decode time per emitted token, one sample per decode chunk / spec
     # round (chunk wall time over tokens emitted) — the series the
     # disaggregation bench's decode-isolation check reads.

@@ -20,6 +20,7 @@ from typing import Any, Dict, List
 
 import httpx
 
+from dstack_tpu.dataplane.qos import DEFAULT_TENANT, TenantShedError
 from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
 from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Router
@@ -29,6 +30,21 @@ from dstack_tpu.server.routers.services_proxy import pick_replica
 logger = logging.getLogger(__name__)
 
 router = Router(prefix="/proxy/models")
+
+
+def _tenant_of(request: Request, model_name: str) -> str:
+    """Tenant identity for QoS + metrics: the API key when the caller
+    sent one, else the adapter name (`base:adapter` model ids), else
+    the shared default bucket. Matches the identity the engine's prefix
+    cache namespaces KV blocks by."""
+    auth = request.headers.get("authorization", "")
+    if auth.lower().startswith("bearer "):
+        token = auth[7:].strip()
+        if token:
+            return token
+    if ":" in (model_name or ""):
+        return model_name.split(":", 1)[1]
+    return DEFAULT_TENANT
 
 
 async def _service_models(ctx, project_name: str) -> List[Dict[str, Any]]:
@@ -66,6 +82,27 @@ async def chat_completions(request: Request, project_name: str):
     if match is None:
         raise ResourceNotExistsError(f"Model {model_name} not found")
     ctx.tracer.inc("proxy_requests", kind="model")
+    tenant = _tenant_of(request, model_name)
+    gate = getattr(ctx, "qos_gate", None)
+    label = (
+        gate.labels.label(tenant) if gate is not None else DEFAULT_TENANT
+    )
+    ctx.tracer.inc("serving_tenant_requests", tenant=label)
+    if gate is not None:
+        try:
+            # Non-blocking rate check: a flooding tenant sheds HERE, at
+            # the proxy, before its requests can queue in front of
+            # other tenants' at the replica.
+            gate.check(tenant)
+        except TenantShedError as e:
+            ctx.tracer.inc("serving_tenant_shed", tenant=label)
+            ctx.service_stats.record_rejection(project_name, match["run_name"])
+            return Response(
+                {"detail": str(e)},
+                status=429,
+                headers={"retry-after": str(max(1, int(e.retry_after + 0.5)))},
+            )
+    t0 = time.monotonic()
     try:
         target = await pick_replica(ctx, project_name, match["run_name"])
     except Exception:
@@ -84,9 +121,19 @@ async def chat_completions(request: Request, project_name: str):
         # Count it ONLY as a rejection — the autoscaler folds shed
         # demand back into RPS itself; counting it in both streams
         # would double the scale-up pressure.
+        ctx.tracer.inc("serving_tenant_shed", tenant=label)
         ctx.service_stats.record_rejection(project_name, match["run_name"])
     else:
+        elapsed = time.monotonic() - t0
         ctx.service_stats.record(project_name, match["run_name"])
+        # TTFT approximation at the proxy: request -> upstream headers
+        # (streams return the moment TTFB lands, buffered bodies add
+        # generation time — both are what the user waited). Feeds the
+        # SLO autoscaler's windowed p95 and the per-tenant histogram.
+        ctx.service_stats.observe_latency(
+            project_name, match["run_name"], elapsed, metric="ttft"
+        )
+        ctx.tracer.observe("serving_tenant_ttft_seconds", elapsed, tenant=label)
     return resp
 
 

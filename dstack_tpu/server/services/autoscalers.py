@@ -7,7 +7,7 @@ Parity: src/dstack/_internal/server/services/services/autoscalers.py:24-126
 import math
 from dataclasses import dataclass
 from datetime import datetime, timedelta
-from typing import Optional
+from typing import Any, Dict, Optional
 
 from dstack_tpu.models.configurations import ScalingSpec, ServiceConfiguration
 
@@ -16,6 +16,28 @@ from dstack_tpu.models.configurations import ScalingSpec, ServiceConfiguration
 class ScalingDecision:
     desired: int
     reason: str = ""
+
+
+def quantile_from_buckets(hist: Dict[str, Any], q: float) -> Optional[float]:
+    """Quantile estimate from a cumulative-bucket histogram snapshot
+    ({"buckets": [(le, cumulative), ...], "count": N} — the form
+    tracing.HistogramData.to_dict and ServiceStatsCollector emit), with
+    linear interpolation inside the straddling bucket. Returns None on
+    an empty histogram; observations past the last bucket clamp to its
+    upper edge (a p95 of "somewhere above 69min" still reads as
+    69min — far past any sane SLO target, so the decision is the same)."""
+    count = hist.get("count", 0)
+    buckets = hist.get("buckets") or []
+    if not count or not buckets:
+        return None
+    rank = q * count
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in buckets:
+        if cum >= rank:
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return prev_le + (le - prev_le) * frac
+    return buckets[-1][0]
 
 
 class ManualScaler:
@@ -86,12 +108,117 @@ class RPSAutoscaler:
         )
 
 
+class SLOAutoscaler:
+    """Scale on a latency SLO instead of throughput: the p95 of the
+    service's TTFT (or TPT) over the stats collector's window, against
+    a target in seconds.
+
+    RPS targets require the operator to know each model's capacity
+    curve; an SLO target states what users actually experience. The
+    decision rule is deliberately a stepper, not a proportional law —
+    latency is nonlinear in replica count (queueing collapse near
+    saturation, flat under it), so the controller moves one replica at
+    a time and lets the asymmetric delays provide damping:
+
+    - p95 > target (or any shed traffic — overload a 429 hid from the
+      latency of admitted requests): +1 replica after scale_up_delay;
+    - p95 < headroom x target with nothing shed: -1 replica after
+      scale_down_delay (headroom keeps the controller from oscillating
+      across the target);
+    - no latency data: hold, except scale-to-zero idle (no rps either)
+      when min_replicas == 0.
+
+    `wants_latency` tells the autoscale hook to fetch the histogram
+    snapshot; `scale(...)` keeps the RPSAutoscaler signature plus the
+    trailing `latency_hist` kwarg."""
+
+    wants_latency = True
+
+    def __init__(
+        self,
+        min_replicas: int,
+        max_replicas: int,
+        metric: str,
+        target: float,
+        scale_up_delay: float,
+        scale_down_delay: float,
+        quantile: float = 0.95,
+        headroom: float = 0.6,
+    ):
+        if metric not in ("ttft_p95", "tpt_p95"):
+            raise ValueError(f"unknown SLO metric: {metric}")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.metric = metric
+        self.target = target
+        self.scale_up_delay = scale_up_delay
+        self.scale_down_delay = scale_down_delay
+        self.quantile = quantile
+        self.headroom = headroom
+
+    @property
+    def stat_metric(self) -> str:
+        """ServiceStatsCollector metric key behind this SLO."""
+        return "ttft" if self.metric == "ttft_p95" else "tpt"
+
+    def scale(
+        self,
+        current: int,
+        avg_rps: float,
+        now: datetime,
+        last_scaled_at: Optional[datetime],
+        rejected_rps: float = 0.0,
+        latency_hist: Optional[Dict[str, Any]] = None,
+    ) -> ScalingDecision:
+        p95 = (
+            None if latency_hist is None
+            else quantile_from_buckets(latency_hist, self.quantile)
+        )
+        desired = current
+        reason = ""
+        if (p95 is not None and p95 > self.target) or rejected_rps > 0:
+            desired = current + 1
+            reason = (
+                f"{self.metric}={p95:.3f}s > target={self.target}s"
+                if p95 is not None and p95 > self.target
+                else f"shedding {rejected_rps:.2f} rps"
+            )
+        elif p95 is not None and p95 < self.headroom * self.target:
+            desired = current - 1
+            reason = (
+                f"{self.metric}={p95:.3f}s < "
+                f"{self.headroom:.0%} of target={self.target}s"
+            )
+        elif p95 is None and avg_rps == 0 and self.min_replicas == 0:
+            desired = 0
+            reason = "idle (scale to zero)"
+        desired = min(max(desired, self.min_replicas), self.max_replicas)
+        if desired == current:
+            return ScalingDecision(desired=current)
+        delay = self.scale_up_delay if desired > current else self.scale_down_delay
+        if last_scaled_at is not None and (now - last_scaled_at) < timedelta(seconds=delay):
+            return ScalingDecision(
+                desired=current,
+                reason=f"waiting out {'up' if desired > current else 'down'}-delay",
+            )
+        return ScalingDecision(desired=desired, reason=f"{reason} -> {desired} replicas")
+
+
 def get_service_scaler(conf: ServiceConfiguration):
     min_r = conf.replicas.min if conf.replicas.min is not None else 1
     max_r = conf.replicas.max if conf.replicas.max is not None else min_r
     scaling: Optional[ScalingSpec] = conf.scaling
     if scaling is None:
         return ManualScaler(min_r, max_r)
+    if scaling.metric in ("ttft_p95", "tpt_p95"):
+        return SLOAutoscaler(
+            min_replicas=min_r,
+            max_replicas=max_r,
+            metric=scaling.metric,
+            target=scaling.target,
+            scale_up_delay=float(scaling.scale_up_delay),
+            scale_down_delay=float(scaling.scale_down_delay),
+        )
     return RPSAutoscaler(
         min_replicas=min_r,
         max_replicas=max_r,

@@ -210,15 +210,28 @@ class RoutingCache:
             spec = json.loads(row["service_spec"])
             model = spec.get("model")
             if model:
-                models.append(
-                    {
-                        "run_id": row["id"],
-                        "run_name": row["run_name"],
-                        "name": model["name"],
-                        "format": model.get("format", "openai"),
-                        "prefix": model.get("prefix", "/v1"),
-                    }
-                )
+                base = {
+                    "run_id": row["id"],
+                    "run_name": row["run_name"],
+                    "name": model["name"],
+                    "format": model.get("format", "openai"),
+                    "prefix": model.get("prefix", "/v1"),
+                }
+                models.append(base)
+                # LoRA adapters register as models in their own right:
+                # `base-model:adapter-name` in the OpenAI `model` field
+                # routes to the same replica set; the replica's serving
+                # engine multiplexes the adapter per slot. The full
+                # composite name rides through to the backend untouched
+                # so the native server can split it back apart.
+                for adapter in model.get("adapters", ()) or ():
+                    models.append(
+                        {
+                            **base,
+                            "name": f"{model['name']}:{adapter}",
+                            "adapter": adapter,
+                        }
+                    )
         return models, project_row["id"]
 
     # ----------------------------------------------------------- selection

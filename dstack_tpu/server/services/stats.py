@@ -8,7 +8,7 @@ here directly, and gateways push their per-window counters through
 
 import time
 from collections import defaultdict, deque
-from typing import Deque, Dict, Tuple
+from typing import Any, Deque, Dict, Optional, Tuple
 
 WINDOW_SECONDS = 60.0
 
@@ -21,6 +21,13 @@ class ServiceStatsCollector:
         # counter never saw because it was rejected — the autoscaler adds
         # it back in so shed load still creates scale-up pressure.
         self._rejections: Dict[Tuple[str, str], Deque[Tuple[float, int]]] = defaultdict(deque)
+        # Latency samples per (project, run, metric) — metric is "ttft"
+        # (request -> first upstream byte) or "tpt". Same trimmed-window
+        # discipline as the RPS events: the SLO autoscaler reads a p95
+        # over the LAST window, not over the service's lifetime, so a
+        # latency regression shows up within one window instead of being
+        # averaged away by history.
+        self._latency: Dict[Tuple[str, str, str], Deque[Tuple[float, float]]] = defaultdict(deque)
 
     def record(self, project_name: str, run_name: str, count: int = 1) -> None:
         key = (project_name, run_name)
@@ -58,6 +65,33 @@ class ServiceStatsCollector:
         self._trim(key)
         total = sum(c for _, c in self._events.get(key, ()))
         return total / self.window
+
+    def observe_latency(
+        self, project_name: str, run_name: str, seconds: float,
+        metric: str = "ttft",
+    ) -> None:
+        key = (project_name, run_name, metric)
+        self._latency[key].append((time.monotonic(), seconds))
+        self._trim_q(self._latency, key)
+
+    def get_latency_hist(
+        self, project_name: str, run_name: str, metric: str = "ttft"
+    ) -> Optional[Dict[str, Any]]:
+        """Windowed latency distribution in the tracing module's
+        cumulative-bucket snapshot form ({"buckets": [(le, cum), ...],
+        "sum", "count"}), or None before any sample lands. The SLO
+        autoscaler feeds this to `quantile_from_buckets`."""
+        from dstack_tpu.server.tracing import HistogramData
+
+        key = (project_name, run_name, metric)
+        self._trim_q(self._latency, key)
+        q = self._latency.get(key)
+        if not q:
+            return None
+        hist = HistogramData()
+        for _, seconds in q:
+            hist.observe(seconds)
+        return hist.to_dict()
 
     def _trim(self, key: Tuple[str, str]) -> None:
         self._trim_q(self._events, key)

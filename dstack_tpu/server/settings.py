@@ -145,6 +145,13 @@ PROXY_BREAKER_COOLDOWN = float(os.getenv("DSTACK_TPU_PROXY_BREAKER_COOLDOWN", "5
 DATAPLANE_EPOCH_POLL = float(os.getenv("DSTACK_TPU_DATAPLANE_EPOCH_POLL", "1.0"))
 DATAPLANE_SYNC_DEADLINE = float(os.getenv("DSTACK_TPU_DATAPLANE_SYNC_DEADLINE", "5.0"))
 DATAPLANE_ROUTING_TTL = float(os.getenv("DSTACK_TPU_DATAPLANE_ROUTING_TTL", "30.0"))
+# Per-tenant QoS on the model route (dataplane/qos.py): token-bucket
+# rate/burst per tenant (tenant = API key, else adapter name). Rate 0
+# disables the gate entirely (no shedding). The tenant cap bounds metric
+# cardinality — tenants past it share the "overflow" label.
+QOS_TENANT_RATE = float(os.getenv("DSTACK_TPU_QOS_TENANT_RATE", "0"))
+QOS_TENANT_BURST = float(os.getenv("DSTACK_TPU_QOS_TENANT_BURST", "20"))
+QOS_TENANT_CAP = int(os.getenv("DSTACK_TPU_QOS_TENANT_CAP", "64"))
 
 ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); identity if unset
 

@@ -84,6 +84,7 @@ class PagedDecodeState(NamedTuple):
     remaining: jnp.ndarray    # (B,) new tokens still budgeted
     temperature: jnp.ndarray  # (B,) f32; 0 = greedy
     top_p: jnp.ndarray        # (B,) f32; 1 = no filtering
+    adapter_ix: jnp.ndarray   # (B,) int32 LoRA pool slot; -1 = no adapter
 
 
 def init_paged_state(
@@ -110,6 +111,7 @@ def init_paged_state(
         remaining=jnp.zeros((batch,), jnp.int32),
         temperature=jnp.zeros((batch,), jnp.float32),
         top_p=jnp.ones((batch,), jnp.float32),
+        adapter_ix=jnp.full((batch,), -1, jnp.int32),
     )
 
 
@@ -134,6 +136,13 @@ class BlockAllocator:
     through that block), `("P", h, tail_tokens)` for a partial tail
     whose parent chain is h. Evicting a parent leaves children
     unreachable (the match walk stops at the gap); they age out via LRU.
+
+    Multi-tenancy: `match`/`insert_full`/`insert_tail` take a `namespace`
+    (adapter identity). A non-empty namespace seeds the hash chain, so
+    two tenants with byte-identical prompts but different adapters can
+    NEVER share a prefix block — an adapter changes the KV contents, and
+    a cross-tenant hit would serve tenant A's attention over tenant B's
+    cache (poisoning). Same-namespace re-runs still hit normally.
     """
 
     def __init__(self, num_blocks: int, block_size: int, cache: bool = True):
@@ -200,7 +209,9 @@ class BlockAllocator:
         self.cow_copies += 1
         return nb, True
 
-    def match(self, tokens: List[int]) -> Tuple[List[int], int]:
+    def match(
+        self, tokens: List[int], namespace: bytes = b""
+    ) -> Tuple[List[int], int]:
         """Longest cached prefix of `tokens`: full blocks down the hash
         chain, then the longest partial tail. Matched blocks are
         RETAINED for the caller (released like any table block). At
@@ -212,7 +223,7 @@ class BlockAllocator:
         bs = self.block_size
         limit = len(tokens) - 1
         blocks: List[int] = []
-        h = b""
+        h = self._ns_seed(namespace)
         matched = 0
         while (len(blocks) + 1) * bs <= limit:
             h2 = _chain_hash(h, tokens[matched:matched + bs])
@@ -240,7 +251,18 @@ class BlockAllocator:
         self.tokens_reused += matched
         return blocks, matched
 
-    def insert_full(self, tokens: List[int], table: List[int]) -> None:
+    @staticmethod
+    def _ns_seed(namespace: bytes) -> bytes:
+        """Chain seed for a tenant namespace. Hashed (not raw) so a crafted
+        adapter name can't alias another namespace's 20-byte chain digest;
+        empty namespace keeps the legacy un-namespaced chain."""
+        if not namespace:
+            return b""
+        return hashlib.sha1(b"ns:" + namespace).digest()
+
+    def insert_full(
+        self, tokens: List[int], table: List[int], namespace: bytes = b""
+    ) -> None:
         """Publish every complete prompt block of a finalized prefill.
         Called at finalize DISPATCH time: device program order guarantees
         the chunk writes complete before any later matcher's gather runs,
@@ -248,7 +270,7 @@ class BlockAllocator:
         if not self.cache_enabled:
             return
         bs = self.block_size
-        h = b""
+        h = self._ns_seed(namespace)
         for i in range(len(tokens) // bs):
             h = _chain_hash(h, tokens[i * bs:(i + 1) * bs])
             key = ("F", h)
@@ -262,7 +284,9 @@ class BlockAllocator:
             self._block_key[b] = key
             self._ref[b] += 1
 
-    def insert_tail(self, tokens: List[int], table: List[int]) -> None:
+    def insert_tail(
+        self, tokens: List[int], table: List[int], namespace: bytes = b""
+    ) -> None:
         """Publish the partial-tail prompt block at RETIRE time (no live
         writer left). The block also holds this request's decode KV past
         the tail — harmless: a matcher's valid region ends at the tail,
@@ -274,7 +298,7 @@ class BlockAllocator:
         f = len(tokens) - nfull * bs
         if f == 0 or nfull >= len(table):
             return
-        h = b""
+        h = self._ns_seed(namespace)
         for i in range(nfull):
             h = _chain_hash(h, tokens[i * bs:(i + 1) * bs])
         key = ("P", h, tuple(tokens[nfull * bs:]))
@@ -314,7 +338,8 @@ def _jit_shardings(in_shardings, out_shardings):
     return {"in_shardings": in_shardings, "out_shardings": out_shardings}
 
 
-def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None):
+def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None,
+                       lora: bool = False):
     """chunk_prefill(params, state, slot, table_row (MB,), tokens (1, C),
     n_valid, start, budget, temp, top_p, rng, finalize) ->
     (state, first_token ()).
@@ -328,18 +353,24 @@ def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None):
     position's logits exactly like the dense `make_prefill`. Finalize
     also flips the slot live on device (lengths/last_token/active/...)
     so no separate insert program is needed.
+
+    With `lora=True` the program takes two trailing args — the request's
+    adapter pool slot (scalar int32, -1 = none) and the adapter bank —
+    and applies the per-request LoRA delta unmerged inside the qkv
+    projection (lora_serving.project_qkv_lora). `lora=False` traces a
+    program byte-identical to the pre-multitenant one.
     """
     c = config
     sh = shardings
     kw = _jit_shardings(
-        None if sh is None else (sh.params, sh.state) + (sh.replicated,) * 10,
+        None if sh is None
+        else (sh.params, sh.state) + (sh.replicated,) * (12 if lora else 10),
         None if sh is None else (sh.state, sh.replicated),
     )
 
-    @functools.partial(jax.jit, donate_argnums=1, **kw)
-    def chunk_prefill(params, state: PagedDecodeState, slot, table_row,
-                      tokens, n_valid, start, budget, temp, top_p, rng,
-                      finalize):
+    def _impl(params, state: PagedDecodeState, slot, table_row,
+              tokens, n_valid, start, budget, temp, top_p, rng,
+              finalize, aix, bank):
         C = tokens.shape[1]
         bs = state.k.shape[2]
         nb = state.k.shape[1]
@@ -358,9 +389,28 @@ def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None):
 
         x = jnp.take(params["embed"], tokens, axis=0)  # (1, C, d)
 
+        if bank is None:
+            qkv = lambda x, p: project_qkv(c, x, p, positions)
+            ops = (params["layers"], state.k, state.v)
+        else:
+            from dstack_tpu.workloads.lora_serving import project_qkv_lora
+
+            pool = bank["scale"].shape[0] - 1        # the all-zero slot
+            safe = jnp.where(aix >= 0, aix, pool).astype(jnp.int32)
+            scale = bank["scale"][safe]
+            has_lora = aix >= 0
+            qkv = lambda x, layer: project_qkv_lora(
+                c, x, layer[0], positions, layer[1], safe, scale, has_lora
+            )
+            ops = (params["layers"], bank["layers"], state.k, state.v)
+
         def body(x, layer):
-            p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
-            q, k, v = project_qkv(c, x, p, positions)
+            if bank is None:
+                p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
+                q, k, v = qkv(x, p)
+            else:
+                p, lp, ck, cv = layer
+                q, k, v = qkv(x, (p, lp))
             # Write the chunk's rows into the pool FIRST, then attend
             # raggedly over the slot's blocks: row i sees cache
             # positions <= start + i, including the rows just written.
@@ -378,7 +428,7 @@ def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None):
                 x = mlp_block(c, x, p)
             return x, (ck, cv)
 
-        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
+        x, (new_k, new_v) = lax.scan(body, x, ops)
         h = rms_norm(x, params["final_norm"], c.norm_eps)
         h_last = jnp.take(
             h[0], jnp.clip(n_valid - 1, 0, C - 1), axis=0, mode="clip"
@@ -399,16 +449,43 @@ def make_chunk_prefill(config: ModelConfig, chunk: int, shardings=None):
             remaining=jnp.where(sel, budget - 1, state.remaining),
             temperature=jnp.where(sel, temp, state.temperature),
             top_p=jnp.where(sel, top_p, state.top_p),
+            # Finalize claims the slot for this request's adapter; a slot
+            # reused by an adapter-free request resets to -1 here.
+            adapter_ix=jnp.where(sel, aix, state.adapter_ix),
         )
         return new_state, first
+
+    if lora:
+        @functools.partial(jax.jit, donate_argnums=1, **kw)
+        def chunk_prefill_lora(params, state: PagedDecodeState, slot,
+                               table_row, tokens, n_valid, start, budget,
+                               temp, top_p, rng, finalize, adapter_ix,
+                               lora_bank):
+            return _impl(params, state, slot, table_row, tokens, n_valid,
+                         start, budget, temp, top_p, rng, finalize,
+                         adapter_ix, lora_bank)
+
+        return chunk_prefill_lora
+
+    @functools.partial(jax.jit, donate_argnums=1, **kw)
+    def chunk_prefill(params, state: PagedDecodeState, slot, table_row,
+                      tokens, n_valid, start, budget, temp, top_p, rng,
+                      finalize):
+        return _impl(params, state, slot, table_row, tokens, n_valid,
+                     start, budget, temp, top_p, rng, finalize,
+                     jnp.int32(-1), None)
 
     return chunk_prefill
 
 
-def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None):
+def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None,
+                           lora: bool = False):
     """decode_steps(params, state, rng) -> (state, tokens (B, steps),
     active) over a PagedDecodeState — the paged twin of
-    serving.make_decode_step.
+    serving.make_decode_step. With `lora=True` the program takes a
+    trailing adapter-bank arg and each slot gathers its own A/B pair by
+    `state.adapter_ix` (lora_serving.project_qkv_lora); a batch with no
+    live adapters skips the LoRA math behind one `lax.cond`.
 
     Each of the `steps` per-token iterations writes the new row's K/V
     straight into the slot's current block — one O(B)-row scatter — and
@@ -433,7 +510,7 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None):
 
     c = config
 
-    def one_step(params, state: PagedDecodeState, rng):
+    def one_step(params, state: PagedDecodeState, rng, bank=None):
         nb, bs = state.k.shape[1], state.k.shape[2]
         B, mb = state.block_tables.shape
         ml = mb * bs
@@ -448,9 +525,24 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None):
         off = state.lengths % bs
         valid_len = (state.lengths + 1)[:, None]     # (B, 1)
 
+        if bank is not None:
+            from dstack_tpu.workloads.lora_serving import project_qkv_lora
+
+            pool = bank["scale"].shape[0] - 1        # the all-zero slot
+            aix = state.adapter_ix
+            safe = jnp.where(aix >= 0, aix, pool).astype(jnp.int32)
+            scale = jnp.take(bank["scale"], safe)
+            has_lora = jnp.any(state.active & (aix >= 0))
+
         def body(x, layer):
-            p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
-            q, k, v = project_qkv(c, x, p, positions)
+            if bank is None:
+                p, ck, cv = layer  # ck/cv: (num_blocks, block_size, KV, hd)
+                q, k, v = project_qkv(c, x, p, positions)
+            else:
+                p, lp, ck, cv = layer
+                q, k, v = project_qkv_lora(
+                    c, x, p, positions, lp, safe, scale, has_lora
+                )
             ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype), mode="drop")
             cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype), mode="drop")
             attn = ragged_attention(q, ck, cv, state.block_tables, valid_len)
@@ -463,7 +555,12 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None):
                 x = mlp_block(c, x, p)
             return x, (ck, cv)
 
-        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
+        ops = (
+            (params["layers"], state.k, state.v)
+            if bank is None
+            else (params["layers"], bank["layers"], state.k, state.v)
+        )
+        x, (new_k, new_v) = lax.scan(body, x, ops)
         h = rms_norm(x, params["final_norm"], c.norm_eps)
         logits = logits_linear(h[:, -1], params["lm_head"])
         next_token = _serving._select_next_token(state, logits, rng)
@@ -481,14 +578,32 @@ def make_paged_decode_step(config: ModelConfig, steps: int = 1, shardings=None):
             remaining=remaining,
             temperature=state.temperature,
             top_p=state.top_p,
+            adapter_ix=state.adapter_ix,
         )
         return new_state, jnp.where(act, next_token, -1), new_active
 
     sh = shardings
     kw = _jit_shardings(
-        None if sh is None else (sh.params, sh.state, sh.replicated),
+        None if sh is None
+        else (sh.params, sh.state, sh.replicated)
+        + ((sh.replicated,) if lora else ()),
         None if sh is None else (sh.state, sh.replicated, sh.replicated),
     )
+
+    if lora:
+        @functools.partial(jax.jit, donate_argnums=1, **kw)
+        def decode_steps_lora(params, state: PagedDecodeState, rng, lora_bank):
+            def body(carry, step_rng):
+                st, _ = carry
+                st, toks, active = one_step(params, st, step_rng, lora_bank)
+                return (st, active), toks
+
+            (state, active), toks = lax.scan(
+                body, (state, state.active), jax.random.split(rng, steps)
+            )
+            return state, toks.T, active
+
+        return decode_steps_lora
 
     @functools.partial(jax.jit, donate_argnums=1, **kw)
     def decode_steps(params, state: PagedDecodeState, rng):
@@ -618,9 +733,18 @@ def make_spec_draft(config: ModelConfig, k: int, shardings=None):
     return spec_draft
 
 
-def make_spec_verify(config: ModelConfig, k: int, shardings=None):
+def make_spec_verify(config: ModelConfig, k: int, shardings=None,
+                     lora: bool = False):
     """spec_verify(params, state, drafts (B, k), qlogits (B, k, V), rng)
     -> (state', emitted (B, k+1), accepted (B,), active (B,)).
+
+    With `lora=True` the program takes a trailing adapter-bank arg: the
+    TARGET applies each slot's LoRA delta (state.adapter_ix) so the
+    accept test scores the tenant's actual distribution. The drafter
+    stays adapter-free — a base-model drafter only lowers acceptance,
+    never correctness (greedy slots accept the leading run matching the
+    LoRA'd target argmax; sampling slots rejection-sample against the
+    LoRA'd p).
 
     The target's half of a speculation round, shaped like a chunked
     prefill over every slot at once: feed [last_token, d_1..d_k] at
@@ -657,12 +781,11 @@ def make_spec_verify(config: ModelConfig, k: int, shardings=None):
     sh = shardings
     kw = _jit_shardings(
         None if sh is None
-        else (sh.params, sh.state) + (sh.replicated,) * 3,
+        else (sh.params, sh.state) + (sh.replicated,) * (4 if lora else 3),
         None if sh is None else (sh.state,) + (sh.replicated,) * 3,
     )
 
-    @functools.partial(jax.jit, donate_argnums=1, **kw)
-    def spec_verify(params, state: PagedDecodeState, drafts, qlogits, rng):
+    def _impl(params, state: PagedDecodeState, drafts, qlogits, rng, bank):
         nb, bs = state.k.shape[1], state.k.shape[2]
         B, mb = state.block_tables.shape
         ml = mb * bs
@@ -682,9 +805,24 @@ def make_spec_verify(config: ModelConfig, k: int, shardings=None):
 
         x = jnp.take(params["embed"], tokens, axis=0)        # (B, S, d)
 
+        if bank is not None:
+            from dstack_tpu.workloads.lora_serving import project_qkv_lora
+
+            pool = bank["scale"].shape[0] - 1        # the all-zero slot
+            aix = state.adapter_ix
+            safe = jnp.where(aix >= 0, aix, pool).astype(jnp.int32)
+            scale = jnp.take(bank["scale"], safe)
+            has_lora = jnp.any(act0 & (aix >= 0))
+
         def body(x, layer):
-            p, ck, cv = layer                    # ck (num_blocks, bs, KV, hd)
-            q, kk, vv = project_qkv(c, x, p, positions)
+            if bank is None:
+                p, ck, cv = layer                # ck (num_blocks, bs, KV, hd)
+                q, kk, vv = project_qkv(c, x, p, positions)
+            else:
+                p, lp, ck, cv = layer
+                q, kk, vv = project_qkv_lora(
+                    c, x, p, positions, lp, safe, scale, has_lora
+                )
             ck = ck.at[blk, off].set(kk.astype(ck.dtype), mode="drop")
             cv = cv.at[blk, off].set(vv.astype(cv.dtype), mode="drop")
             attn = ragged_attention(
@@ -699,7 +837,12 @@ def make_spec_verify(config: ModelConfig, k: int, shardings=None):
                 x = mlp_block(c, x, p)
             return x, (ck, cv)
 
-        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], state.k, state.v))
+        ops = (
+            (params["layers"], state.k, state.v)
+            if bank is None
+            else (params["layers"], bank["layers"], state.k, state.v)
+        )
+        x, (new_k, new_v) = lax.scan(body, x, ops)
         h = rms_norm(x, params["final_norm"], c.norm_eps)
         logits = logits_linear(h, params["lm_head"])         # (B, S, V)
 
@@ -773,9 +916,22 @@ def make_spec_verify(config: ModelConfig, k: int, shardings=None):
             remaining=new_rem,
             temperature=state.temperature,
             top_p=state.top_p,
+            adapter_ix=state.adapter_ix,
         )
         accepted = jnp.where(act0, m, 0)
         return new_state, emitted, accepted, new_act
+
+    if lora:
+        @functools.partial(jax.jit, donate_argnums=1, **kw)
+        def spec_verify_lora(params, state: PagedDecodeState, drafts,
+                             qlogits, rng, lora_bank):
+            return _impl(params, state, drafts, qlogits, rng, lora_bank)
+
+        return spec_verify_lora
+
+    @functools.partial(jax.jit, donate_argnums=1, **kw)
+    def spec_verify(params, state: PagedDecodeState, drafts, qlogits, rng):
+        return _impl(params, state, drafts, qlogits, rng, None)
 
     return spec_verify
 

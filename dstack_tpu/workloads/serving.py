@@ -369,6 +369,11 @@ class _Request(NamedTuple):
     # disaggregated front-end can match decode-side streams back to the
     # prompts it submitted to the prefill worker. None = engine-assigned.
     request_id: Optional[int] = None
+    # Multi-tenant LoRA: the adapter this request selected (None = base
+    # model) and its device pool slot at submit time. The name doubles as
+    # the prefix-cache namespace so tenants never share poisoned blocks.
+    adapter: Optional[str] = None
+    adapter_ix: int = -1
 
 
 class _PrefillTask:
@@ -431,6 +436,9 @@ class ServingEngine:
         mesh: Optional[Any] = None,
         role: str = "unified",
         kv_transfer: Optional[Any] = None,
+        lora_max_adapters: int = 0,
+        lora_rank: int = 8,
+        lora_targets: Optional[Tuple[str, ...]] = None,
     ):
         self.config = config
         self.params = params
@@ -517,9 +525,44 @@ class ServingEngine:
                 mesh, self.params, self.state
             )
             self.state = jax.device_put(self.state, self._shardings.state)
+        # -- multi-tenant LoRA (lora_max_adapters > 0) --------------------
+        # A refcounted host registry over a device-side adapter pool; the
+        # jitted programs below are built with lora=True so every batched
+        # step gathers each slot's A/B pair by state.adapter_ix and
+        # applies the delta unmerged (lora_serving.project_qkv_lora).
+        # Disabled engines trace programs identical to pre-multitenant
+        # ones — the base path pays nothing.
+        self._lora: Optional[Any] = None
+        if lora_max_adapters > 0:
+            if role != "unified":
+                raise ValueError(
+                    "adapter multiplexing requires role='unified' (KV"
+                    " handoffs do not carry adapter identity yet)"
+                )
+            from dstack_tpu.workloads.lora_serving import AdapterRegistry
+
+            self._lora = AdapterRegistry(
+                config, self.params,
+                max_adapters=lora_max_adapters, rank=lora_rank,
+                targets=lora_targets or ("wq", "wv"), mesh=mesh,
+            )
+        # out-queue -> adapter name for every in-flight adapter request;
+        # _release_adapter pops exactly once per request (guarded by
+        # _lock like all scheduler state).
+        self._adapter_holds: Dict[Any, str] = {}
         self._step = make_paged_decode_step(
-            config, steps=steps_per_sync, shardings=self._shardings
+            config, steps=steps_per_sync, shardings=self._shardings,
+            lora=self._lora is not None,
         )
+        # Plain twin for LoRA engines: while no request holds an adapter
+        # ref the loop dispatches this instead — the LoRA program's
+        # per-layer lax.cond skips the adapter math at runtime but still
+        # breaks XLA fusion across the projection, a real per-step cost
+        # the adapter-free path shouldn't pay.
+        self._step_base = self._step if self._lora is None else \
+            make_paged_decode_step(
+                config, steps=steps_per_sync, shardings=self._shardings,
+            )
         self._copy_block = make_copy_block(shardings=self._shardings)
         # Which ragged-attention implementation this engine's geometry
         # dispatches (static per engine: shape + backend decide), and
@@ -788,12 +831,16 @@ class ServingEngine:
         temperature: Optional[float] = None,
         top_p: float = 1.0,
         request_id: Optional[int] = None,
+        adapter: Optional[str] = None,
     ) -> "queue.Queue[object]":
         """Enqueue a request; returns its output queue (see _Request.out
         for the token/None/Exception protocol). `temperature` (0 =
         greedy) and `top_p` (nucleus cutoff, 1 = no filtering) override
         the engine defaults for THIS request — requests with different
-        sampling params share one decode batch."""
+        sampling params share one decode batch. `adapter` selects a
+        loaded LoRA adapter by name (multi-tenant engines only); the
+        request holds a registry ref until it retires, so the adapter
+        cannot be evicted or unloaded under it."""
         if not tokens:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -847,10 +894,22 @@ class ServingEngine:
             if self.max_pending is not None and backlog >= self.max_pending:
                 self.rejected += 1
                 raise EngineOverloadedError(depth, self._retry_after(depth))
+            adapter_ix = -1
+            if adapter is not None:
+                if self._lora is None:
+                    raise ValueError(
+                        "engine has no adapter support"
+                        " (construct with lora_max_adapters > 0)"
+                    )
+                # Raises KeyError for unknown adapters BEFORE anything is
+                # queued; the ref pins the pool slot until the request
+                # retires (_release_adapter at every terminal path).
+                adapter_ix = self._lora.acquire(adapter)
+                self._adapter_holds[out] = adapter
             self._pending.put(
                 _Request(list(tokens), max_new_tokens, out,
                          float(temperature), float(top_p), time.monotonic(),
-                         request_id)
+                         request_id, adapter, adapter_ix)
             )
             self._inflight.add(out)
         self._wake.set()
@@ -894,10 +953,51 @@ class ServingEngine:
                 self._pending.put(r)
             if found:
                 self._inflight.discard(out)
+                self._release_adapter(out)
                 out.put(None)
                 return
             self._cancelled.add(out)
         self._wake.set()
+
+    # -- multi-tenant adapters ----------------------------------------------
+
+    @property
+    def lora_enabled(self) -> bool:
+        return self._lora is not None
+
+    def _require_lora(self):
+        if self._lora is None:
+            raise RuntimeError(
+                "engine has no adapter support"
+                " (construct with lora_max_adapters > 0)"
+            )
+        return self._lora
+
+    def load_adapter(self, name: str, adapter: Params, *,
+                     alpha: float = 16.0) -> int:
+        """Install (or replace) a LoRA adapter under `name`; returns its
+        device pool slot. May LRU-evict an idle adapter under slot
+        pressure; raises AdapterBusyError / AdapterPoolFullError when
+        in-flight refs forbid it (lora_serving)."""
+        with self._lock:
+            return self._require_lora().load(name, adapter, alpha=alpha)
+
+    def unload_adapter(self, name: str) -> None:
+        with self._lock:
+            self._require_lora().unload(name)
+
+    def adapters(self) -> Dict[str, Dict[str, Any]]:
+        """Loaded adapters: name -> {slot, refs, alpha, rank}."""
+        with self._lock:
+            return {} if self._lora is None else self._lora.loaded()
+
+    def _release_adapter(self, out) -> None:
+        """Drop a request's adapter ref (idempotent; caller holds _lock).
+        Every terminal path — retire, cancel, drop, force-retire, flush —
+        funnels through here so refcounts cannot leak and pin pool slots."""
+        name = self._adapter_holds.pop(out, None)
+        if name is not None and self._lora is not None:
+            self._lora.release(name)
 
     def stats(self) -> Dict[str, Any]:
         """Live load snapshot (feeds /metrics and autoscaler signals).
@@ -1003,6 +1103,15 @@ class ServingEngine:
             "attn_dispatch_pallas_total": self._attn_dispatch["pallas"],
             "attn_dispatch_lax_ragged_total":
                 self._attn_dispatch["lax_ragged"],
+            # Multi-tenant LoRA: pool occupancy for the adapters_loaded
+            # gauge and capacity dashboards.
+            "lora_enabled": self._lora is not None,
+            "lora_max_adapters": (
+                0 if self._lora is None else self._lora.max_adapters
+            ),
+            "adapters_loaded": (
+                0 if self._lora is None else self._lora.loaded_count
+            ),
         }
 
     def close(self) -> None:
@@ -1028,6 +1137,11 @@ class ServingEngine:
         with self._lock:
             self._cancelled.clear()
             self._inflight.clear()
+            # Every in-flight adapter ref dies with its consumer.
+            if self._lora is not None:
+                for name in self._adapter_holds.values():
+                    self._lora.release(name)
+            self._adapter_holds.clear()
             for slot, req in enumerate(self._live):
                 if req is not None:
                     req.out.put(sentinel)
@@ -1053,16 +1167,19 @@ class ServingEngine:
 
     # -- chunked prefill admission -------------------------------------------
 
-    def _chunk_fn(self, n_padded: int):
+    def _chunk_fn(self, n_padded: int, lora: bool = False):
         """The jitted chunk-prefill program for padded chunk length
-        `n_padded` (one compile per pow-2 bucket). Tests monkeypatch this
-        to block or spy on chunk dispatches."""
-        fn = self._chunk_cache.get(n_padded)
+        `n_padded` (one compile per pow-2 bucket, per LoRA flavor —
+        prefill is per-request, so an adapter-free request on a LoRA
+        engine uses the plain program). Tests monkeypatch this to block
+        or spy on chunk dispatches."""
+        fn = self._chunk_cache.get((n_padded, lora))
         if fn is None:
             fn = make_chunk_prefill(
-                self.config, n_padded, shardings=self._shardings
+                self.config, n_padded, shardings=self._shardings,
+                lora=lora,
             )
-            self._chunk_cache[n_padded] = fn
+            self._chunk_cache[(n_padded, lora)] = fn
         return fn
 
     def _draft_chunk_fn(self, n_padded: int):
@@ -1086,13 +1203,14 @@ class ServingEngine:
             self._spec_draft_fns[k] = fn
         return fn
 
-    def _spec_verify_fn(self, k: int):
-        fn = self._spec_verify_fns.get(k)
+    def _spec_verify_fn(self, k: int, lora: bool = False):
+        fn = self._spec_verify_fns.get((k, lora))
         if fn is None:
             fn = make_spec_verify(
-                self.config, k, shardings=self._shardings
+                self.config, k, shardings=self._shardings,
+                lora=lora,
             )
-            self._spec_verify_fns[k] = fn
+            self._spec_verify_fns[(k, lora)] = fn
         return fn
 
     def _pad_chunk(self, n: int) -> int:
@@ -1120,6 +1238,7 @@ class ServingEngine:
             self._inflight.discard(task.req.out)
             if task.req in self._admitting:
                 self._admitting.remove(task.req)
+            self._release_adapter(task.req.out)
         self._tasks.remove(task)
         task.req.out.put(None)
 
@@ -1184,11 +1303,14 @@ class ServingEngine:
                     # abandoned while queued: never occupy a slot
                     self._cancelled.discard(req.out)
                     self._inflight.discard(req.out)
+                    self._release_adapter(req.out)
                     req.out.put(None)
                     progressed = True
                     continue
                 self._admitting.append(req)
-                blocks, matched = self._alloc.match(req.tokens)
+                blocks, matched = self._alloc.match(
+                    req.tokens, namespace=(req.adapter or "").encode()
+                )
             slot = free[0]
             t_pop = time.monotonic()
             self._slot_t0[slot] = t_pop
@@ -1226,10 +1348,19 @@ class ServingEngine:
                 jnp.asarray(task.req.temperature, jnp.float32),
                 jnp.asarray(task.req.top_p, jnp.float32),
             )
-            self.state, first = self._chunk_fn(n_padded)(
-                self.params, self.state, *chunk_args, sub,
-                jnp.asarray(final, bool),
-            )
+            if self._lora is not None and task.req.adapter_ix >= 0:
+                # Target-only: the drafter below never applies LoRA.
+                self.state, first = self._chunk_fn(n_padded, lora=True)(
+                    self.params, self.state, *chunk_args, sub,
+                    jnp.asarray(final, bool),
+                    jnp.asarray(task.req.adapter_ix, jnp.int32),
+                    self._lora.bank,
+                )
+            else:
+                self.state, first = self._chunk_fn(n_padded)(
+                    self.params, self.state, *chunk_args, sub,
+                    jnp.asarray(final, bool),
+                )
             self._attn_dispatch[self._attn_path] += 1
             if self._spec:
                 # The drafter prefills the same chunk into ITS pool
@@ -1262,7 +1393,10 @@ class ServingEngine:
                     # order guarantees the writes precede any later
                     # matcher's gather), so a burst of shared-prefix
                     # requests hits from the second admission on.
-                    self._alloc.insert_full(task.req.tokens, task.table)
+                    self._alloc.insert_full(
+                        task.req.tokens, task.table,
+                        namespace=(task.req.adapter or "").encode(),
+                    )
                     if task.req.max_new_tokens > 1 and not handoff:
                         self._live[task.slot] = task.req
                         self._admitting.remove(task.req)
@@ -1337,6 +1471,7 @@ class ServingEngine:
                     for b in task.table:
                         self._alloc.release(b)
                     task.table.clear()
+                    self._release_adapter(req.out)
                     req.out.put(None)
                 elif dead:
                     # Cancelled between finalize and delivery: the loop's
@@ -1430,6 +1565,7 @@ class ServingEngine:
                 self._inflight.discard(req.out)
                 if req in self._admitting:
                     self._admitting.remove(req)
+                self._release_adapter(req.out)
             req.out.put(result)
             task.delivered.set()
 
@@ -1647,6 +1783,11 @@ class ServingEngine:
                     remaining=jnp.where(sel, budget, state.remaining),
                     temperature=jnp.where(sel, temp, state.temperature),
                     top_p=jnp.where(sel, top_p, state.top_p),
+                    # Handoffs never carry adapter identity (LoRA engines
+                    # must be role="unified"): clear any stale slot value.
+                    adapter_ix=jnp.where(
+                        sel, jnp.int32(-1), state.adapter_ix
+                    ),
                 )
 
             kw: Dict[str, Any] = {}
@@ -1876,21 +2017,25 @@ class ServingEngine:
                 self._cancelled.discard(req.out)
                 self._inflight.discard(req.out)
             self._release_slot_blocks(slot, cache_tail=False)
+            if req is not None:
+                self._release_adapter(req.out)
         self.state = self._retire(slot)
         if req is not None:
             req.out.put(error)
 
     def _release_slot_blocks(self, slot: int, cache_tail: bool,
-                             prompt: Optional[List[int]] = None) -> None:
+                             prompt: Optional[List[int]] = None,
+                             namespace: bytes = b"") -> None:
         """Return a retired slot's blocks to the pool (caller holds
         _lock). With `cache_tail`, first publish the prompt's partial
         tail block for future prefix hits — full blocks were already
-        published at finalize."""
+        published at finalize. `namespace` keys the tail entry to the
+        request's adapter so tenants never share cached KV."""
         table = self._slot_tables[slot]
         if table is None:
             return
         if cache_tail and prompt is not None:
-            self._alloc.insert_tail(prompt, table)
+            self._alloc.insert_tail(prompt, table, namespace=namespace)
         for b in table:
             self._alloc.release(b)
         self._slot_tables[slot] = None
@@ -1901,6 +2046,7 @@ class ServingEngine:
         return s._replace(
             active=s.active.at[slot].set(False),
             remaining=s.remaining.at[slot].set(0),
+            adapter_ix=s.adapter_ix.at[slot].set(-1),
         )
 
     def _ewma(self, prev: float, sample: float, alpha: float = 0.2) -> float:
@@ -1961,9 +2107,14 @@ class ServingEngine:
                     t_pf = time.monotonic()
                     # 2) Dispatch the decode chunk (async), sync on it.
                     self._rng, sub = jax.random.split(self._rng)
-                    self.state, tokens, active = self._step(
-                        self.params, self.state, sub
-                    )
+                    if self._lora is not None and self._lora.inflight > 0:
+                        self.state, tokens, active = self._step(
+                            self.params, self.state, sub, self._lora.bank
+                        )
+                    else:
+                        self.state, tokens, active = self._step_base(
+                            self.params, self.state, sub
+                        )
                     self._attn_dispatch[self._attn_path] += 1
                     toks = jax.device_get(tokens)  # (B, steps_per_sync)
                     still = jax.device_get(active)
@@ -2032,9 +2183,15 @@ class ServingEngine:
         self._draft_state = self._draft_state._replace(k=dk, v=dv)
         drafts.block_until_ready()  # draft/verify timing split
         t_draft = time.monotonic()
-        self.state, emitted, accepted, active = self._spec_verify_fn(k_cur)(
-            self.params, self.state, drafts, qlogits, vsub,
-        )
+        if self._lora is not None and self._lora.inflight > 0:
+            self.state, emitted, accepted, active = self._spec_verify_fn(
+                k_cur, lora=True
+            )(self.params, self.state, drafts, qlogits, vsub,
+              self._lora.bank)
+        else:
+            self.state, emitted, accepted, active = self._spec_verify_fn(
+                k_cur
+            )(self.params, self.state, drafts, qlogits, vsub)
         toks = jax.device_get(emitted)     # (B, k_cur + 1), -1 padded
         still = jax.device_get(active)
         acc = jax.device_get(accepted)
@@ -2108,8 +2265,10 @@ class ServingEngine:
                     self._inflight.discard(req.out)
                     self._live[slot] = None
                     self._release_slot_blocks(
-                        slot, cache_tail=True, prompt=req.tokens
+                        slot, cache_tail=True, prompt=req.tokens,
+                        namespace=(req.adapter or "").encode(),
                     )
+                    self._release_adapter(req.out)
                 self.state = self._retire(slot)
                 req.out.put(None)
                 continue
@@ -2126,8 +2285,10 @@ class ServingEngine:
                     self._cancelled.discard(req.out)
                     self._inflight.discard(req.out)
                     self._release_slot_blocks(
-                        slot, cache_tail=True, prompt=req.tokens
+                        slot, cache_tail=True, prompt=req.tokens,
+                        namespace=(req.adapter or "").encode(),
                     )
+                    self._release_adapter(req.out)
                 for tok in toks[slot]:
                     if tok >= 0:
                         req.out.put(int(tok))
@@ -2206,6 +2367,10 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
          stats.get("kv_transfer_bytes_total", 0)),
         ("dstack_tpu_serving_kv_transfer_queue_depth", "gauge",
          stats.get("kv_transfer_queue_depth", 0)),
+        # Multi-tenant LoRA (zero when lora_max_adapters is 0; .get
+        # defaults keep pre-LoRA snapshots renderable).
+        ("dstack_tpu_serving_adapters_loaded", "gauge",
+         stats.get("adapters_loaded", 0)),
     ]
     lines = []
     for name, mtype, value in series:

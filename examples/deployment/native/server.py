@@ -19,12 +19,23 @@ import itertools
 import json
 import threading
 import time
+from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import jax.numpy as jnp
 
+from dstack_tpu.dataplane.qos import (
+    DEFAULT_TENANT,
+    QoSGate,
+    TenantShedError,
+)
+from dstack_tpu.server.tracing import HistogramData
 from dstack_tpu.workloads.config import PRESETS
+from dstack_tpu.workloads.lora_serving import (
+    AdapterBusyError,
+    AdapterPoolFullError,
+)
 from dstack_tpu.workloads.serving import (
     EngineOverloadedError,
     ServingEngine,
@@ -46,7 +57,10 @@ class Engine:
                  spec_enable: bool = False, spec_max_draft: int = 4,
                  spec_draft_preset: str = "int8", kv_budget_mb: int = 0,
                  role: str = "unified", mesh_model: int = 1,
-                 kv_transfer_connect: str = ""):
+                 kv_transfer_connect: str = "",
+                 lora_max_adapters: int = 0, lora_rank: int = 8,
+                 adapters=None, qos_rate: float = 0.0,
+                 qos_burst: float = 20.0, qos_tenant_cap: int = 64):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -151,9 +165,111 @@ class Engine:
                 spec_draft_config=draft_config,
                 kv_budget_bytes=kv_budget_mb * (1 << 20) or None,
                 mesh=mesh, role=role, kv_transfer=kv_transfer,
+                lora_max_adapters=lora_max_adapters, lora_rank=lora_rank,
             )
         except ValueError as e:
             raise SystemExit(f"invalid serving configuration: {e}")
+        # --adapter name=path entries: "random" makes a demo adapter in
+        # process (tests, zero-egress environments); anything else is a
+        # save_adapter npz carrying its own rank/alpha.
+        self.lora_rank = lora_rank
+        for entry in adapters or ():
+            name, _, path = entry.partition("=")
+            if not name or not path:
+                raise SystemExit(f"--adapter {entry!r} is not name=path")
+            try:
+                self.load_adapter(name, path)
+            except (ValueError, RuntimeError, OSError) as e:
+                raise SystemExit(f"--adapter {entry!r}: {e}")
+        # Per-tenant QoS in front of submit: token buckets shed floods
+        # (429 + Retry-After), the DRR queue orders admission under
+        # contention for the decode slots. Off unless --qos-rate > 0.
+        self.qos = None
+        if qos_rate > 0:
+            self.qos = QoSGate(
+                rate=qos_rate, burst=qos_burst, tenant_cap=qos_tenant_cap,
+                concurrency=max(slots, max_pending),
+            )
+        # Per-tenant observability (bounded cardinality via the gate's
+        # TenantLabels when QoS is on, else a private mapping).
+        from dstack_tpu.dataplane.qos import TenantLabels
+
+        self.tenant_labels = (
+            self.qos.labels if self.qos is not None
+            else TenantLabels(cap=qos_tenant_cap)
+        )
+        self._tenant_lock = threading.Lock()
+        self.tenant_requests = defaultdict(int)
+        self.tenant_shed = defaultdict(int)
+        self.tenant_ttft = defaultdict(HistogramData)
+
+    def load_adapter(self, name: str, path: str, alpha: float = 16.0) -> int:
+        """Load a LoRA adapter into the pool: `path` is a save_adapter
+        npz, or the literal "random" for an in-process demo adapter.
+        Returns the device pool slot the adapter landed in."""
+        from dstack_tpu.workloads.lora_serving import (
+            demo_adapter, load_adapter_file,
+        )
+
+        if path == "random":
+            seed = abs(hash(name)) % (2 ** 31)
+            tree = demo_adapter(
+                self.config, self.params, jax.random.PRNGKey(seed),
+                rank=self.lora_rank, targets=("wq", "wv"),
+            )
+            return self.serving.load_adapter(name, tree, alpha=alpha)
+        tree, rank, file_alpha = load_adapter_file(path)
+        if rank != self.lora_rank:
+            raise ValueError(
+                f"adapter {name!r} has rank {rank}, engine pool is"
+                f" rank {self.lora_rank}"
+            )
+        return self.serving.load_adapter(name, tree, alpha=file_alpha)
+
+    def record_tenant(self, tenant: str, *, shed: bool = False,
+                      ttft: float = None) -> None:
+        label = self.tenant_labels.label(tenant or DEFAULT_TENANT)
+        with self._tenant_lock:
+            if shed:
+                self.tenant_shed[label] += 1
+            else:
+                self.tenant_requests[label] += 1
+            if ttft is not None:
+                self.tenant_ttft[label].observe(ttft)
+
+    def tenant_metrics_lines(self) -> list:
+        """Per-tenant Prometheus series appended to the engine's
+        exposition (series declared in server/metrics_registry.py)."""
+        lines = []
+        with self._tenant_lock:
+            req = sorted(self.tenant_requests.items())
+            shed = sorted(self.tenant_shed.items())
+            ttft = sorted(
+                (t, h.to_dict()) for t, h in self.tenant_ttft.items()
+            )
+        lines.append("# TYPE dstack_tpu_serving_tenant_requests_total counter")
+        for t, n in req:
+            lines.append(
+                f'dstack_tpu_serving_tenant_requests_total{{tenant="{t}"}} {n}'
+            )
+        lines.append("# TYPE dstack_tpu_serving_tenant_shed_total counter")
+        for t, n in shed:
+            lines.append(
+                f'dstack_tpu_serving_tenant_shed_total{{tenant="{t}"}} {n}'
+            )
+        base = "dstack_tpu_serving_tenant_ttft_seconds"
+        lines.append(f"# TYPE {base} histogram")
+        for t, h in ttft:
+            for le, cum in h["buckets"]:
+                lines.append(
+                    f'{base}_bucket{{le="{le}",tenant="{t}"}} {cum}'
+                )
+            lines.append(
+                f'{base}_bucket{{le="+Inf",tenant="{t}"}} {h["count"]}'
+            )
+            lines.append(f'{base}_sum{{tenant="{t}"}} {h["sum"]}')
+            lines.append(f'{base}_count{{tenant="{t}"}} {h["count"]}')
+        return lines
 
     def encode(self, text: str) -> jnp.ndarray:
         ids = [min(b, self.config.vocab_size - 1) for b in text.encode()] or [0]
@@ -175,7 +291,8 @@ class Engine:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
 
     def chat_stream(self, messages, max_tokens=None, temperature=None,
-                    top_p=None, stop=None, usage_out=None):
+                    top_p=None, stop=None, usage_out=None,
+                    adapter=None, tenant=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
         `max_tokens` and `temperature` are the per-request OpenAI fields:
@@ -234,10 +351,25 @@ class Engine:
             rid = next(self._handoff_ids)
             if usage_out is not None:
                 usage_out["handoff_id"] = rid
-        out = self.serving.submit(
-            [int(t) for t in tokens[0]], max_new_tokens=budget,
-            temperature=temp, top_p=nucleus, request_id=rid,
-        )
+        granted = False
+        if self.qos is not None:
+            # Sheds (TenantShedError -> 429) or blocks for the tenant's
+            # DRR turn at a grant permit; the permit frees in `finally`.
+            self.qos.admit(tenant or DEFAULT_TENANT)
+            granted = True
+        t_submit = time.monotonic()
+        ttft_seen = False
+        try:
+            out = self.serving.submit(
+                [int(t) for t in tokens[0]], max_new_tokens=budget,
+                temperature=temp, top_p=nucleus, request_id=rid,
+                adapter=adapter,
+            )
+        except BaseException:
+            if granted:
+                self.qos.release()
+            raise
+        self.record_tenant(tenant)
         dec = codecs.getincrementaldecoder("utf-8")("replace")
         # Streaming stop matching: text already sent cannot be unsent, so
         # hold back any suffix that is a PREFIX of a stop sequence until
@@ -272,6 +404,11 @@ class Engine:
                         # the KV handoff); this response is the ack.
                         usage_out["finish_reason"] = "kv_handoff"
                     return
+                if not ttft_seen:
+                    ttft_seen = True
+                    self.record_tenant(
+                        tenant, ttft=time.monotonic() - t_submit
+                    )
                 if usage_out is not None:
                     usage_out["completion_tokens"] += 1
                 piece = dec.decode(bytes([int(tok) % 256]))
@@ -305,11 +442,14 @@ class Engine:
             # generator) or stop hit: the engine must not keep decoding
             # into a queue nobody reads. Idempotent after clean end.
             self.serving.cancel(out)
+            if granted:
+                self.qos.release()
 
     def chat(self, messages, max_tokens=None, temperature=None, top_p=None,
-             stop=None, usage_out=None) -> str:
+             stop=None, usage_out=None, adapter=None, tenant=None) -> str:
         return "".join(self.chat_stream(messages, max_tokens, temperature,
-                                        top_p, stop, usage_out=usage_out))
+                                        top_p, stop, usage_out=usage_out,
+                                        adapter=adapter, tenant=tenant))
 
 
 def main() -> None:
@@ -366,7 +506,30 @@ def main() -> None:
                         help="KV pool memory budget in MiB (0 = unlimited);"
                              " with --spec-enable the target AND drafter"
                              " pools must both fit")
+    parser.add_argument("--adapter", action="append", default=[],
+                        metavar="NAME=PATH",
+                        help="preload a LoRA adapter (repeatable);"
+                             " PATH is an .npz from save_adapter, or"
+                             " 'random' for a demo adapter. Request it"
+                             " via model='<model-name>:<NAME>'")
+    parser.add_argument("--lora-max-adapters", type=int, default=0,
+                        help="device adapter-pool slots; 0 disables LoRA"
+                             " multiplexing (defaults to len(--adapter)"
+                             " when adapters are given)")
+    parser.add_argument("--lora-rank", type=int, default=8,
+                        help="rank of the device adapter pool; every"
+                             " loaded adapter must match it")
+    parser.add_argument("--qos-rate", type=float, default=0.0,
+                        help="per-tenant token-bucket refill rate"
+                             " (requests/s); 0 disables QoS admission")
+    parser.add_argument("--qos-burst", type=float, default=20.0,
+                        help="per-tenant token-bucket capacity")
+    parser.add_argument("--qos-tenant-cap", type=int, default=64,
+                        help="distinct tenant labels before metrics"
+                             " collapse into the overflow label")
     args = parser.parse_args()
+    if args.adapter and args.lora_max_adapters <= 0:
+        args.lora_max_adapters = len(args.adapter)
     if args.spec_max_draft <= 0:
         raise SystemExit(
             f"--spec-max-draft must be positive, got {args.spec_max_draft}"
@@ -405,7 +568,11 @@ def main() -> None:
                     spec_draft_preset=args.spec_draft_preset,
                     kv_budget_mb=args.kv_budget_mb,
                     role=args.role, mesh_model=args.mesh_model,
-                    kv_transfer_connect=args.kv_transfer_connect)
+                    kv_transfer_connect=args.kv_transfer_connect,
+                    lora_max_adapters=args.lora_max_adapters,
+                    lora_rank=args.lora_rank, adapters=args.adapter,
+                    qos_rate=args.qos_rate, qos_burst=args.qos_burst,
+                    qos_tenant_cap=args.qos_tenant_cap)
 
     # Decode tier: admit prefill-tier handoffs and expose each admitted
     # stream at GET /v1/handoffs/<request_id> (SSE) for the front-end to
@@ -449,21 +616,54 @@ def main() -> None:
                 headers=[("Retry-After", str(int(e.retry_after + 0.5) or 1))],
             )
 
+        def _send_shed(self, e: TenantShedError) -> None:
+            engine.record_tenant(e.tenant, shed=True)
+            self._send(
+                429,
+                {"error": {"message": str(e), "type": "rate_limited",
+                           "tenant": e.tenant,
+                           "retry_after": e.retry_after}},
+                headers=[("Retry-After", str(max(1, int(e.retry_after + 0.5))))],
+            )
+
+        def _request_identity(self, req):
+            """(adapter, tenant) for this request: the OpenAI `model`
+            field selects the adapter (`base:adapter`); tenancy is the
+            API key when one was sent, else the adapter name, else the
+            shared default bucket — the same identity the engine's
+            prefix cache namespaces KV by."""
+            model = req.get("model") or ""
+            adapter = None
+            if ":" in model:
+                adapter = model.split(":", 1)[1] or None
+            auth = self.headers.get("Authorization", "")
+            tenant = None
+            if auth.lower().startswith("bearer "):
+                tenant = auth[7:].strip() or None
+            return adapter, tenant or adapter or DEFAULT_TENANT
+
         def _stream(self, req) -> None:
             """OpenAI-style SSE: one delta chunk per generated token."""
             # Pull the first piece BEFORE committing the 200/SSE headers, so
             # submit-time errors surface as a clean JSON 500 instead of a
             # second status line spliced into the event stream.
+            adapter, tenant = self._request_identity(req)
             try:
                 pieces = engine.chat_stream(
                     req.get("messages", []), req.get("max_tokens"),
                     req.get("temperature"), req.get("top_p"), req.get("stop"),
+                    adapter=adapter, tenant=tenant,
                 )
                 first = next(pieces)
             except StopIteration:
                 first = ""
+            except TenantShedError as e:
+                return self._send_shed(e)
             except EngineOverloadedError as e:
+                engine.record_tenant(tenant, shed=True)
                 return self._send_overloaded(e)
+            except KeyError as e:  # unknown adapter
+                return self._send(404, {"error": f"unknown adapter: {e}"})
             except ValueError as e:  # bad request field (e.g. temperature)
                 return self._send(400, {"error": str(e)})
             except Exception as e:
@@ -500,11 +700,19 @@ def main() -> None:
 
         def do_GET(self):
             if self.path.rstrip("/") == "/v1/models":
-                return self._send(200, {
-                    "object": "list",
-                    "data": [{"id": args.model_name, "object": "model",
-                              "created": 0, "owned_by": "dstack-tpu"}],
-                })
+                # Loaded adapters list as models in their own right
+                # (`base:adapter`), mirroring the control-plane proxy's
+                # routing-cache expansion.
+                data = [{"id": args.model_name, "object": "model",
+                         "created": 0, "owned_by": "dstack-tpu"}]
+                if engine.serving.lora_enabled:
+                    for name in sorted(engine.serving.adapters()):
+                        data.append({
+                            "id": f"{args.model_name}:{name}",
+                            "object": "model", "created": 0,
+                            "owned_by": "dstack-tpu",
+                        })
+                return self._send(200, {"object": "list", "data": data})
             path, _, query = self.path.partition("?")
             if path.rstrip("/") == "/metrics":
                 # Queue depth, shed counters, and paged-KV pool gauges
@@ -515,7 +723,12 @@ def main() -> None:
                 stats = engine.serving.stats()
                 accept = self.headers.get("Accept", "")
                 if "format=prometheus" in query or "text/plain" in accept:
-                    body = prometheus_metrics(stats).encode()
+                    text = prometheus_metrics(stats)
+                    tenant_lines = engine.tenant_metrics_lines()
+                    if tenant_lines:
+                        text = text.rstrip("\n") + "\n" + \
+                            "\n".join(tenant_lines) + "\n"
+                    body = text.encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
@@ -524,6 +737,8 @@ def main() -> None:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if engine.qos is not None:
+                    stats = {**stats, "qos": engine.qos.stats()}
                 return self._send(200, stats)
             if path.rstrip("/").startswith("/v1/handoffs/"):
                 return self._stream_handoff(path.rstrip("/"))
@@ -563,21 +778,75 @@ def main() -> None:
             except OSError:
                 engine.serving.cancel(out)  # reader gone: free the slot
 
+        def _load_adapter_route(self) -> None:
+            """POST /v1/adapters {"name", "path", "alpha"?}: runtime
+            adapter load/replace. 409 when pool slots are all pinned by
+            in-flight requests (retryable); 400 on shape/rank mismatch."""
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                return self._send(400, {"error": f"bad json: {e}"})
+            name, path = req.get("name"), req.get("path")
+            if not name or not path:
+                return self._send(
+                    400, {"error": "`name` and `path` are required"}
+                )
+            try:
+                slot = engine.load_adapter(
+                    name, path, alpha=float(req.get("alpha", 16.0))
+                )
+            except (AdapterPoolFullError, AdapterBusyError) as e:
+                return self._send(409, {"error": str(e)})
+            except (ValueError, FileNotFoundError) as e:
+                return self._send(400, {"error": str(e)})
+            except RuntimeError as e:  # engine built without LoRA
+                return self._send(400, {"error": str(e)})
+            self._send(200, {"name": name, "slot": slot,
+                             "model": f"{args.model_name}:{name}"})
+
+        def do_DELETE(self):
+            path = self.path.rstrip("/")
+            prefix = "/v1/adapters/"
+            if not path.startswith(prefix):
+                return self._send(404, {"error": "not found"})
+            name = path[len(prefix):]
+            try:
+                engine.serving.unload_adapter(name)
+            except AdapterBusyError as e:
+                return self._send(409, {"error": str(e)})
+            except KeyError:
+                return self._send(404, {"error": f"unknown adapter: {name}"})
+            except RuntimeError as e:
+                return self._send(400, {"error": str(e)})
+            self._send(200, {"name": name, "unloaded": True})
+
         def do_POST(self):
-            if self.path.rstrip("/") != "/v1/chat/completions":
+            path = self.path.rstrip("/")
+            if path == "/v1/adapters":
+                return self._load_adapter_route()
+            if path != "/v1/chat/completions":
                 return self._send(404, {"error": "not found"})
             length = int(self.headers.get("Content-Length", 0))
+            tenant = DEFAULT_TENANT
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if req.get("stream"):
                     return self._stream(req)
+                adapter, tenant = self._request_identity(req)
                 usage = {}
                 text = engine.chat(req.get("messages", []),
                                    req.get("max_tokens"), req.get("temperature"),
                                    req.get("top_p"), req.get("stop"),
-                                   usage_out=usage)
+                                   usage_out=usage,
+                                   adapter=adapter, tenant=tenant)
+            except TenantShedError as e:
+                return self._send_shed(e)
             except EngineOverloadedError as e:
+                engine.record_tenant(tenant, shed=True)
                 return self._send_overloaded(e)
+            except KeyError as e:  # unknown adapter
+                return self._send(404, {"error": f"unknown adapter: {e}"})
             except ValueError as e:  # bad request field (e.g. temperature)
                 return self._send(400, {"error": str(e)})
             except Exception as e:  # surface engine errors as API errors

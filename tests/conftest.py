@@ -7,6 +7,22 @@ from dstack_tpu.utils.jaxenv import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
 
+# Persistent XLA compilation cache for THIS process. Most of the suite's
+# wall time is XLA recompiling the same tiny-model programs: each
+# make_*() call produces a fresh jitted closure, so JAX's in-memory
+# cache never dedupes across engines or test files — the on-disk cache
+# keys on the HLO itself and does (~40% off a cold full run, far more on
+# re-runs). Deliberately NOT exported to the environment: subprocess
+# trainers (drills, examples) segfault deserializing executables cached
+# by another process on this jaxlib, and they compile little anyway.
+# Set JAX_COMPILATION_CACHE_DIR yourself to relocate or pre-empt this.
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/dstack_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
 import asyncio
 import inspect
 

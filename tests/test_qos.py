@@ -1,0 +1,242 @@
+"""Per-tenant QoS dataplane primitives (dstack_tpu/dataplane/qos.py):
+token buckets on a frozen clock, deficit-round-robin fairness, bounded
+metric cardinality, and the composed QoSGate's shed/admit semantics."""
+
+import threading
+import time
+
+import pytest
+
+from dstack_tpu.dataplane.qos import (
+    DEFAULT_TENANT,
+    OVERFLOW_TENANT,
+    DRRQueue,
+    QoSGate,
+    TenantLabels,
+    TenantShedError,
+    TokenBucket,
+)
+
+
+class FrozenClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# --- token bucket ------------------------------------------------------------
+
+
+def test_token_bucket_burst_then_refill():
+    clk = FrozenClock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clk)
+    # Full burst is available immediately.
+    for _ in range(4):
+        assert b.try_take()
+    assert not b.try_take()
+    # 2 tokens/s: after 1.5s exactly 3 tokens have refilled.
+    clk.advance(1.5)
+    assert b.tokens == pytest.approx(3.0)
+    assert b.try_take(3.0)
+    assert not b.try_take(0.5)
+
+
+def test_token_bucket_caps_at_burst():
+    clk = FrozenClock()
+    b = TokenBucket(rate=100.0, burst=5.0, clock=clk)
+    clk.advance(3600.0)
+    assert b.tokens == pytest.approx(5.0)
+
+
+def test_token_bucket_retry_after_is_exact():
+    clk = FrozenClock()
+    b = TokenBucket(rate=2.0, burst=2.0, clock=clk)
+    assert b.try_take(2.0)
+    # Empty: 1 token refills in 0.5s at 2/s.
+    assert b.retry_after(1.0) == pytest.approx(0.5)
+    assert b.retry_after(2.0) == pytest.approx(1.0)
+    # A compliant client that waits exactly retry_after is admitted.
+    clk.advance(0.5)
+    assert b.retry_after(1.0) == 0.0
+    assert b.try_take(1.0)
+
+
+def test_token_bucket_rejects_bad_params():
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0, burst=1)
+    with pytest.raises(ValueError):
+        TokenBucket(rate=1, burst=0)
+
+
+# --- deficit round robin -----------------------------------------------------
+
+
+def test_drr_alternates_under_asymmetric_burst():
+    """A tenant with 10 queued items and one with 2 alternate: the
+    burst depth cannot push the small tenant to the back of the line."""
+    q = DRRQueue()
+    for i in range(10):
+        q.push("flood", f"f{i}")
+    q.push("steady", "s0")
+    q.push("steady", "s1")
+    order = [q.pop()[0] for _ in range(12)]
+    # Both steady items are served within the first four grants.
+    assert order[:4].count("steady") == 2
+    assert len(q) == 0
+    assert q.pop() is None
+
+
+def test_drr_weights_bias_throughput():
+    q = DRRQueue(quantum=1.0, weights={"gold": 2.0})
+    for i in range(8):
+        q.push("gold", f"g{i}")
+        q.push("best-effort", f"b{i}")
+    first8 = [q.pop()[0] for _ in range(8)]
+    # Weight 2 earns two pops per round vs one: ~2/3 of early grants.
+    assert first8.count("gold") > first8.count("best-effort")
+
+
+def test_drr_remove_and_depth():
+    q = DRRQueue()
+    item = object()
+    q.push("a", item)
+    q.push("a", "other")
+    assert q.depth("a") == 2
+    assert q.remove("a", item)
+    assert not q.remove("a", item)  # already gone
+    assert q.depth("a") == 1
+    assert q.pop() == ("a", "other")
+    assert q.depth("a") == 0
+
+
+def test_drr_returning_tenant_starts_fresh():
+    """Deficit does not accrue while a tenant has nothing queued — an
+    idle tenant cannot bank credit and burst past the others later."""
+    q = DRRQueue()
+    q.push("a", "a0")
+    assert q.pop() == ("a", "a0")
+    for i in range(4):
+        q.push("b", f"b{i}")
+    q.push("a", "a1")
+    order = [q.pop()[0] for _ in range(5)]
+    # "a" gets exactly its one item, interleaved, not a banked run.
+    assert order.count("a") == 1
+
+
+# --- tenant label cardinality ------------------------------------------------
+
+
+def test_tenant_labels_cap_collapses_to_overflow():
+    labels = TenantLabels(cap=3)
+    assert labels.label("t1") == "t1"
+    assert labels.label("t2") == "t2"
+    assert labels.label("t3") == "t3"
+    # Cap reached: client-chosen ids can no longer mint new series.
+    assert labels.label("t4") == OVERFLOW_TENANT
+    assert labels.label("t999") == OVERFLOW_TENANT
+    # Known tenants keep their own label even after the cap is hit.
+    assert labels.label("t2") == "t2"
+    assert labels.known_count == 5
+
+
+def test_tenant_labels_default_for_empty():
+    labels = TenantLabels(cap=4)
+    assert labels.label("") == DEFAULT_TENANT
+    assert labels.label(None) == DEFAULT_TENANT
+
+
+# --- composed gate -----------------------------------------------------------
+
+
+def test_gate_check_sheds_with_retry_after():
+    clk = FrozenClock()
+    gate = QoSGate(rate=1.0, burst=2.0, clock=clk)
+    gate.check("t")
+    gate.check("t")
+    with pytest.raises(TenantShedError) as ei:
+        gate.check("t")
+    assert ei.value.tenant == "t"
+    assert ei.value.retry_after == pytest.approx(1.0)
+    # Other tenants have their own bucket — unaffected by t's flood.
+    gate.check("u")
+    # After the advertised wait, t is admitted again.
+    clk.advance(1.0)
+    gate.check("t")
+    s = gate.stats()
+    assert s["shed_total"] == {"t": 1}
+    assert s["admitted_total"] == {"t": 3, "u": 1}
+
+
+def test_gate_per_tenant_rate_overrides():
+    clk = FrozenClock()
+    gate = QoSGate(rate=1.0, burst=1.0, rates={"gold": (100.0, 50.0)}, clock=clk)
+    for _ in range(50):
+        gate.check("gold")
+    gate.check("plain")
+    with pytest.raises(TenantShedError):
+        gate.check("plain")
+
+
+def test_gate_admit_unbounded_is_rate_only():
+    clk = FrozenClock()
+    gate = QoSGate(rate=5.0, burst=5.0, clock=clk)  # concurrency=None
+    for _ in range(5):
+        gate.admit("t", timeout=0.0)
+    with pytest.raises(TenantShedError):
+        gate.admit("t", timeout=0.0)
+    gate.release()  # no-op when unbounded
+
+
+def test_gate_admit_drr_fairness_under_contention():
+    """With one grant permit held, a flood of queued tenant-a admits and
+    one tenant-b admit interleave in DRR order: b is granted among the
+    first two permits released, regardless of arrival order."""
+    gate = QoSGate(rate=1000.0, burst=1000.0, concurrency=1)
+    gate.admit("a")  # takes the only permit; everyone below queues
+
+    done = []
+    lock = threading.Lock()
+
+    def worker(tenant):
+        gate.admit(tenant, timeout=10.0)
+        with lock:
+            done.append(tenant)
+
+    threads = [threading.Thread(target=worker, args=("a",)) for _ in range(5)]
+    threads.append(threading.Thread(target=worker, args=("b",)))
+    for t in threads[:5]:
+        t.start()
+    deadline = time.time() + 5.0
+    while gate.stats()["queued"] < 5 and time.time() < deadline:
+        time.sleep(0.01)
+    threads[5].start()  # b arrives LAST, behind a 5-deep a-burst
+    while gate.stats()["queued"] < 6 and time.time() < deadline:
+        time.sleep(0.01)
+    assert gate.stats()["queued"] == 6
+
+    for _ in range(6):
+        gate.release()
+        time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=5.0)
+    assert len(done) == 6
+    grants = list(gate.grant_log)[1:]  # drop the unqueued first admit
+    assert "b" in grants[:2], f"DRR should interleave b early, got {grants}"
+
+
+def test_gate_admit_timeout_sheds():
+    gate = QoSGate(rate=1000.0, burst=1000.0, concurrency=1)
+    gate.admit("a")  # permit taken
+    t0 = time.monotonic()
+    with pytest.raises(TenantShedError):
+        gate.admit("b", timeout=0.2)
+    assert time.monotonic() - t0 < 5.0
+    gate.release()
+    # The timed-out ticket was withdrawn: the freed permit goes to a
+    # fresh admit, not a ghost.
+    gate.admit("c", timeout=1.0)

@@ -19,7 +19,9 @@ from dstack_tpu.server.http import TestClient, response_json
 from dstack_tpu.server.services.autoscalers import (
     ManualScaler,
     RPSAutoscaler,
+    SLOAutoscaler,
     get_service_scaler,
+    quantile_from_buckets,
 )
 from dstack_tpu.server.services.stats import ServiceStatsCollector
 from dstack_tpu.utils.common import utcnow
@@ -99,6 +101,123 @@ def test_stats_collector_window():
         c.record("p", "r")
     assert c.get_rps("p", "r") == pytest.approx(2.0)
     assert c.get_rps("p", "other") == 0.0
+
+
+# --- SLO (latency-target) autoscaler ----------------------------------------
+
+
+def _hist(samples):
+    """Cumulative-bucket snapshot from raw samples, the same shape
+    HistogramData.to_dict / get_latency_hist emit."""
+    from dstack_tpu.server.tracing import HistogramData
+
+    h = HistogramData()
+    for s in samples:
+        h.observe(s)
+    return h.to_dict()
+
+
+def test_quantile_from_buckets_interpolates():
+    hist = _hist([0.1] * 50 + [0.9] * 50)
+    p95 = quantile_from_buckets(hist, 0.95)
+    assert 0.5 < p95 <= 1.1  # in the bucket holding the 0.9s mass
+    # Median lands in the low mode.
+    assert quantile_from_buckets(hist, 0.25) < 0.2
+
+
+def test_quantile_from_buckets_edge_cases():
+    assert quantile_from_buckets({"buckets": [], "count": 0}, 0.95) is None
+    assert quantile_from_buckets({}, 0.95) is None
+    # Everything past the last bucket clamps to its upper edge.
+    hist = {"buckets": [(1.0, 0), (2.0, 0)], "count": 10, "sum": 1e9}
+    assert quantile_from_buckets(hist, 0.95) == 2.0
+
+
+def test_slo_autoscaler_steps_up_on_latency():
+    s = SLOAutoscaler(1, 4, metric="ttft_p95", target=0.5,
+                      scale_up_delay=0, scale_down_delay=0)
+    # p95 ~ 2s against a 0.5s target: one step, not a proportional jump
+    # (latency is nonlinear in replica count).
+    d = s.scale(2, 10.0, utcnow(), None, latency_hist=_hist([2.0] * 100))
+    assert d.desired == 3
+
+
+def test_slo_autoscaler_holds_in_hysteresis_band():
+    s = SLOAutoscaler(1, 4, metric="ttft_p95", target=1.0,
+                      scale_up_delay=0, scale_down_delay=0)
+    # Between headroom*target and target: no move in either direction.
+    d = s.scale(2, 10.0, utcnow(), None, latency_hist=_hist([0.8] * 100))
+    assert d.desired == 2
+
+
+def test_slo_autoscaler_steps_down_under_headroom():
+    s = SLOAutoscaler(1, 4, metric="ttft_p95", target=4.0,
+                      scale_up_delay=0, scale_down_delay=0)
+    d = s.scale(3, 10.0, utcnow(), None, latency_hist=_hist([0.1] * 100))
+    assert d.desired == 2
+
+
+def test_slo_autoscaler_shed_pressure_forces_up():
+    """429s hide overload from admitted-request latency: shed traffic
+    must create scale-up pressure even when the p95 looks healthy."""
+    s = SLOAutoscaler(1, 4, metric="ttft_p95", target=10.0,
+                      scale_up_delay=0, scale_down_delay=0)
+    d = s.scale(1, 5.0, utcnow(), None, rejected_rps=3.0,
+                latency_hist=_hist([0.1] * 100))
+    assert d.desired == 2
+
+
+def test_slo_autoscaler_respects_asymmetric_delays():
+    now = utcnow()
+    s = SLOAutoscaler(1, 4, metric="ttft_p95", target=0.5,
+                      scale_up_delay=300, scale_down_delay=600)
+    slow = _hist([2.0] * 100)
+    fast = _hist([0.05] * 100)
+    recently = now - timedelta(seconds=60)
+    assert s.scale(2, 1.0, now, recently, latency_hist=slow).desired == 2
+    long_ago = now - timedelta(seconds=400)
+    assert s.scale(2, 1.0, now, long_ago, latency_hist=slow).desired == 3
+    # 400s clears the up-delay but not the 600s down-delay.
+    assert s.scale(2, 1.0, now, long_ago, latency_hist=fast).desired == 2
+
+
+def test_slo_autoscaler_scale_to_zero_when_idle():
+    s = SLOAutoscaler(0, 4, metric="ttft_p95", target=0.5,
+                      scale_up_delay=0, scale_down_delay=0)
+    # No latency data + no traffic + min 0 -> release the slice.
+    assert s.scale(1, 0.0, utcnow(), None, latency_hist=None).desired == 0
+    # No data but traffic flowing: hold, do not flap on a metrics gap.
+    s2 = SLOAutoscaler(1, 4, metric="ttft_p95", target=0.5,
+                       scale_up_delay=0, scale_down_delay=0)
+    assert s2.scale(2, 3.0, utcnow(), None, latency_hist=None).desired == 2
+
+
+def test_get_service_scaler_picks_slo_impl():
+    conf = ServiceConfiguration(
+        name="svc", port=8000, commands=["serve"], replicas="1..4",
+        scaling={"metric": "ttft_p95", "target": 0.5},
+    )
+    s = get_service_scaler(conf)
+    assert isinstance(s, SLOAutoscaler)
+    assert s.wants_latency
+    assert s.stat_metric == "ttft"
+    conf2 = ServiceConfiguration(
+        name="svc", port=8000, commands=["serve"], replicas="1..4",
+        scaling={"metric": "tpt_p95", "target": 0.05},
+    )
+    assert get_service_scaler(conf2).stat_metric == "tpt"
+
+
+def test_stats_collector_latency_window():
+    c = ServiceStatsCollector(window=60)
+    assert c.get_latency_hist("p", "r") is None
+    for _ in range(20):
+        c.observe_latency("p", "r", 0.25)
+    hist = c.get_latency_hist("p", "r")
+    assert hist["count"] == 20
+    assert hist["sum"] == pytest.approx(5.0)
+    # Metrics are separate streams: tpt is still empty.
+    assert c.get_latency_hist("p", "r", metric="tpt") is None
 
 
 # --- nginx rendering --------------------------------------------------------
@@ -836,4 +955,121 @@ async def test_service_run_scales_up_on_shed_pressure():
         # demand = 0.5 served + 1.5 shed = 2 rps -> 2 replicas
         assert run["desired_replica_count"] == 2
     finally:
+        await fx.app.shutdown()
+
+
+async def test_service_run_scales_up_on_ttft_slo():
+    """SLO-driven autoscaling end to end: a ttft_p95 scaling spec makes
+    _maybe_autoscale fetch the windowed latency histogram and the
+    SLOAutoscaler step replicas up when the p95 breaches the target."""
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        run_id = await _make_service_run(fx, "slo-svc", None, 8000)
+        row = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        spec = json.loads(row["run_spec"])
+        spec["configuration"]["replicas"] = "1..4"
+        spec["configuration"]["scaling"] = {"metric": "ttft_p95",
+                                            "target": 0.5,
+                                            "scale_up_delay": "0s",
+                                            "scale_down_delay": "0s"}
+        from dstack_tpu.models.runs import RunSpec
+
+        await ctx.db.execute(
+            "UPDATE runs SET run_spec = ? WHERE id = ?",
+            (RunSpec.model_validate(spec).model_dump_json(), run_id),
+        )
+        # Traffic is light (no RPS pressure) but slow: p95 ~ 2s >> 0.5s.
+        for _ in range(10):
+            ctx.service_stats.record("main", "slo-svc")
+            ctx.service_stats.observe_latency("main", "slo-svc", 2.0)
+
+        from dstack_tpu.server.background.tasks.process_runs import process_runs
+
+        await process_runs(ctx)
+        run = await ctx.db.fetchone("SELECT * FROM runs WHERE id = ?", (run_id,))
+        assert run["desired_replica_count"] == 2  # stepper: +1, not ceil()
+    finally:
+        await fx.app.shutdown()
+
+
+async def test_model_proxy_lists_adapters_as_models():
+    """LoRA adapters in the service spec register as `base:adapter`
+    model ids routed to the same replica set."""
+    stub = _StubModelServer()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        run_id = await _make_service_run(fx, "lora-svc", "llama-3-8b", port)
+        svc = await fx.ctx.db.fetchone(
+            "SELECT service_spec FROM runs WHERE id = ?", (run_id,)
+        )
+        spec = json.loads(svc["service_spec"])
+        spec["model"]["adapters"] = ["sql", "support"]
+        await fx.ctx.db.execute(
+            "UPDATE runs SET service_spec = ? WHERE id = ?",
+            (json.dumps(spec), run_id),
+        )
+        r = await fx.client.get("/proxy/models/main/models")
+        ids = [m["id"] for m in response_json(r)["data"]]
+        assert ids == ["llama-3-8b", "llama-3-8b:sql", "llama-3-8b:support"]
+
+        # The composite id routes like the base model (same replicas).
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions",
+            {"model": "llama-3-8b:sql",
+             "messages": [{"role": "user", "content": "hi"}]},
+        )
+        assert r.status == 200
+    finally:
+        stub.stop()
+        await fx.app.shutdown()
+
+
+async def test_model_proxy_qos_sheds_flooding_tenant():
+    """Per-tenant QoS at the proxy: a tenant past its token bucket gets
+    429 + Retry-After BEFORE its request reaches a replica, while other
+    tenants' buckets are untouched."""
+    from dstack_tpu.dataplane.qos import QoSGate
+
+    stub = _StubModelServer()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        await _make_service_run(fx, "qos-svc", "llama-3-8b", port)
+        clock = [0.0]
+        fx.ctx.qos_gate = QoSGate(rate=1.0, burst=2.0,
+                                  clock=lambda: clock[0])
+        body = {"model": "llama-3-8b",
+                "messages": [{"role": "user", "content": "hi"}]}
+        hdr_a = {"Authorization": "Bearer tenant-a"}
+        hdr_b = {"Authorization": "Bearer tenant-b"}
+        for _ in range(2):
+            r = await fx.client.post(
+                "/proxy/models/main/chat/completions", body, headers=hdr_a
+            )
+            assert r.status == 200
+        upstream_before = len(stub.requests)
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions", body, headers=hdr_a
+        )
+        assert r.status == 429
+        assert int(r.headers["retry-after"]) >= 1
+        # Shed at the gate: the replica never saw the request.
+        assert len(stub.requests) == upstream_before
+        # Tenant b's bucket is its own.
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions", body, headers=hdr_b
+        )
+        assert r.status == 200
+        # Sheds count as rejections -> autoscale pressure.
+        assert fx.ctx.service_stats.get_rejection_rps("main", "qos-svc") > 0
+        # After the advertised wait the tenant is admitted again.
+        clock[0] += 1.0
+        r = await fx.client.post(
+            "/proxy/models/main/chat/completions", body, headers=hdr_a
+        )
+        assert r.status == 200
+    finally:
+        stub.stop()
         await fx.app.shutdown()
