@@ -77,16 +77,20 @@ bench-proxy:
 # disaggregation (prefill-flood decode-isolation) arms, and the r14
 # multi-tenant arms (mixed-adapter LoRA batch vs merged-engine token
 # equality + empty-pool overhead; noisy-neighbor steady-tenant TTFT
-# with QoS on/off/no-flood). Results land in BENCH_serving_r14.json;
-# see docs/guides/serving-tuning.md and docs/guides/multi-tenant.md
-# for how to read them.
+# with QoS on/off/no-flood), and the r15 flight-recorder overhead arm
+# (recorder-on vs recorder-off, the <2% tracing-always-on claim; run it
+# alone with --arms recorder). Results land in BENCH_serving_r15.json;
+# see docs/guides/serving-tuning.md, docs/guides/multi-tenant.md and
+# docs/guides/observability.md for how to read them.
 bench-serving:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r14.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r15.json
 
 # Prefill/decode disaggregation drill: two real worker processes over a
 # 2-way model mesh each, KV handoffs over a socket. Asserts token
-# bit-exactness vs a unified engine, clean cancel mid-handoff,
-# stale-epoch reject + client refresh, and zero KV-block residue.
+# bit-exactness vs a unified engine, end-to-end trace continuity (one
+# trace_id spanning both tiers, phases telescoping per tier), clean
+# cancel mid-handoff, stale-epoch reject + client refresh, and zero
+# KV-block residue.
 drill-disagg:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.workloads.serving_disagg
 

@@ -1,0 +1,111 @@
+"""TRC01: upstream HTTP call in a dataplane handler without trace
+propagation.
+
+The per-request trace (utils/tracecontext.py) only survives a hop if the
+hop forwards it: a proxy handler under `dataplane/` or `server/routers/`
+(or the native model server) that calls an upstream client without
+stamping `TRACEPARENT_HEADER` on the outbound request silently severs
+the trace — the replica's spans and the engine flight recorder start a
+fresh trace_id and a slow request can no longer be followed end to end.
+
+A function is compliant when it references `TRACEPARENT_HEADER` itself
+(builds the outbound headers inline) or calls a module-local helper
+that does (`_fwd_headers`, `request_headers` — the audited pattern).
+The heuristic for "upstream call" is an HTTP verb/send method invoked
+on a receiver whose name ends in `client` — the pooled-client naming
+convention the proxy layer uses everywhere.
+"""
+
+import ast
+from typing import Iterable, Iterator, Set
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, call_name, dotted_name
+from dstack_tpu.analysis.checkers.async_hygiene import _functions
+from dstack_tpu.analysis.core import Checker, Finding, Module
+
+# Methods that put bytes on the wire (or build the request that will).
+UPSTREAM_METHODS: Set[str] = {
+    "get", "post", "put", "patch", "delete", "head", "options",
+    "request", "send", "stream", "build_request",
+}
+
+SCOPE_MARKERS = ("dataplane/", "server/routers/", "examples/deployment/native/")
+
+_HEADER_CONST = "TRACEPARENT_HEADER"
+
+
+def _own_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk `func` without descending into nested defs — each def is
+    checked once, under its own qualname."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FUNC_NODES):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _references_traceparent(func: ast.AST) -> bool:
+    for node in _own_nodes(func):
+        if isinstance(node, ast.Name) and node.id == _HEADER_CONST:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == _HEADER_CONST:
+            return True
+    return False
+
+
+class TracePropagationChecker(Checker):
+    codes = ("TRC01",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not any(marker in module.rel for marker in SCOPE_MARKERS):
+            return
+        funcs = _functions(module)
+        # Module-local helpers that build propagating headers: calling one
+        # makes the caller compliant (the helper owns the header names).
+        helpers: Set[str] = {
+            qualname.split(".")[-1]
+            for qualname, func in funcs
+            if _references_traceparent(func)
+        }
+        for qualname, func in funcs:
+            if _references_traceparent(func):
+                continue
+            called = {
+                name.split(".")[-1]
+                for name in (
+                    call_name(node)
+                    for node in _own_nodes(func)
+                    if isinstance(node, ast.Call)
+                )
+                if name
+            }
+            if called & helpers:
+                continue
+            for node in _own_nodes(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                if node.func.attr not in UPSTREAM_METHODS:
+                    continue
+                recv = dotted_name(node.func.value)
+                if recv is None:
+                    continue
+                terminal = recv.split(".")[-1].lower()
+                if not terminal.endswith("client"):
+                    continue
+                yield Finding(
+                    code="TRC01",
+                    message=f"upstream `{recv}.{node.func.attr}(...)` in"
+                    f" `{qualname}` without forwarding TRACEPARENT_HEADER"
+                    " — the request trace is severed at this hop; build"
+                    " outbound headers with a traceparent-forwarding"
+                    " helper (e.g. services_proxy.request_headers)",
+                    rel=module.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    symbol=qualname,
+                    key=f"{recv.split('.')[-1]}.{node.func.attr}",
+                )

@@ -150,6 +150,9 @@ def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.pool import PoolChecker
     from dstack_tpu.analysis.checkers.shard import ShardScanChecker
     from dstack_tpu.analysis.checkers.sql import SqlChecker
+    from dstack_tpu.analysis.checkers.trace_propagation import (
+        TracePropagationChecker,
+    )
 
     return [
         AsyncHygieneChecker(),
@@ -160,6 +163,7 @@ def default_checkers() -> List[Checker]:
         PagedGatherChecker(),
         PoolChecker(),
         ShardScanChecker(),
+        TracePropagationChecker(),
     ]
 
 

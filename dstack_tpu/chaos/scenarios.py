@@ -1182,6 +1182,7 @@ async def _dataplane_worker_kill(report, seed, tmp: Path) -> None:
                 async with hc.stream(
                     "GET",
                     f"http://127.0.0.1:{port}/proxy/services/main/chaos-sse/stream",
+                    headers={"X-Request-ID": f"chaos-stream-{idx}"},
                 ) as r:
                     async for chunk in r.aiter_raw():
                         buf += chunk
@@ -1224,6 +1225,25 @@ async def _dataplane_worker_kill(report, seed, tmp: Path) -> None:
         r = await hc.get(f"http://127.0.0.1:{port2}/readyz")
         _expect(report, r.status_code == 200,
                 f"survivor /readyz = {r.status_code} after the kill, want 200")
+        # Trace continuity through the chaos: the survivor's flight
+        # recorder must still serve its stream's trace after the sibling
+        # died — observability that evaporates under failure is not
+        # observability.
+        tr = await hc.get(
+            f"http://127.0.0.1:{port2}/v1/requests/chaos-stream-2/trace"
+        )
+        trace_ok = (
+            tr.status_code == 200
+            and tr.json().get("x_request_id") == "chaos-stream-2"
+            and tr.json().get("status") == "ok"
+            and [p["phase"] for p in tr.json().get("phases", [])] == ["proxy"]
+        )
+        _expect(report, trace_ok,
+                f"survivor trace lookup failed: {tr.status_code}"
+                f" {tr.text[:200]}")
+        report["details"]["survivor_trace"] = (
+            tr.json() if tr.status_code == 200 else None
+        )
         report["details"]["killed_stream_ended_after_s"] = (
             round(killed_end, 3) if killed_end is not None else None
         )

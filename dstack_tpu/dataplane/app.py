@@ -22,6 +22,12 @@ from dstack_tpu.server.db import Database
 from dstack_tpu.server.http import App, Request, Response, Router
 from dstack_tpu.server.metrics_registry import counter_name, histogram_name
 from dstack_tpu.server.routers.metrics import _Exposition
+from dstack_tpu.utils.flight_recorder import FlightRecorder
+from dstack_tpu.utils.tracecontext import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    ensure_request_trace,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +70,14 @@ class DataPlaneContext:
         # DSTACK_TPU_QOS_TENANT_RATE > 0. The worker tier is the natural
         # enforcement point — shedding here keeps a flooding tenant's
         # requests off the engine queue entirely.
+        # Worker-tier flight recorder: QoS sheds get a terminal trace here
+        # (they never reach an engine), and /v1/requests/{id}/trace serves
+        # whatever this worker recorded.
+        self.flight_recorder = FlightRecorder(
+            capacity=settings.TRACE_RING,
+            slow_ms=settings.TRACE_SLOW_MS,
+            role="dataplane",
+        )
         self.qos_gate = None
         if settings.QOS_TENANT_RATE > 0:
             from dstack_tpu.dataplane.qos import QoSGate
@@ -184,9 +198,40 @@ def create_dataplane_app(
 
     async def _inject_ctx(request: Request) -> Optional[Response]:
         request.state["ctx"] = ctx
+        # Establish the request's trace identity at ingress: parse/mint
+        # the traceparent and X-Request-ID once so every consumer on the
+        # request path (proxy forwarding, QoS shed recording, the echo
+        # hook) sees the same pair.
+        tp, rid = ensure_request_trace(request.state, request.headers)
+        # Proxied requests get a worker-tier trace (single "proxy" phase,
+        # ingress -> upstream response headers). Health/metrics/trace
+        # probes are deliberately NOT recorded — they would churn the
+        # ring without telling anyone anything.
+        if request.path.startswith("/proxy/"):
+            request.state["trace_rec"] = ctx.flight_recorder.begin(
+                rid, x_request_id=rid, traceparent=tp, first_phase="proxy"
+            )
         return None
 
     app.add_middleware(_inject_ctx)
+
+    def _echo_trace(request: Request, resp: Response) -> None:
+        identity = request.state.get("trace_identity")
+        if identity is None:
+            return
+        tp, rid = identity
+        resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+        resp.headers.setdefault(TRACEPARENT_HEADER, tp)
+        rec = request.state.get("trace_rec")
+        if rec is not None:
+            # For streaming responses this closes at header time (the
+            # upstream leg), which is the proxy's own contribution to
+            # latency; body relay time belongs to the upstream trace.
+            status = ("shed" if resp.status == 429
+                      else "error" if resp.status >= 500 else "ok")
+            ctx.flight_recorder.finish(rec, status)
+
+    app.add_response_hook(_echo_trace)
 
     from dstack_tpu.server.routers import model_proxy, services_proxy
 
@@ -216,6 +261,15 @@ def create_dataplane_app(
             {"status": "waiting for first epoch sync"}, status=503
         )
 
+    @router.get("/v1/requests/{request_id}/trace")
+    async def request_trace(request: Request, request_id: str):
+        trace = ctx.flight_recorder.get(request_id)
+        if trace is None:
+            return Response(
+                {"detail": f"No trace for request {request_id}"}, status=404
+            )
+        return trace
+
     @router.get("/metrics")
     async def metrics(request: Request):
         exp = _Exposition()
@@ -239,6 +293,14 @@ def create_dataplane_app(
             exp.add_histogram(
                 histogram_name(h["name"]), h["labels"],
                 h["buckets"], h["sum"], h["count"],
+            )
+        for phase, hist in sorted(
+            ctx.flight_recorder.phase_histograms().items()
+        ):
+            exp.add_histogram(
+                "dstack_tpu_serving_phase_seconds",
+                {"phase": phase, "role": "dataplane"},
+                hist["buckets"], hist["sum"], hist["count"],
             )
         return Response(
             "\n".join(exp.lines) + "\n", media_type="text/plain; version=0.0.4"

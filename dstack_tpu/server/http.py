@@ -206,6 +206,7 @@ class Router:
 
 
 Middleware = Callable[[Request], Awaitable[Optional[Response]]]
+ResponseHook = Callable[[Request, Response], None]
 
 
 class App:
@@ -214,6 +215,11 @@ class App:
     def __init__(self):
         self.routers: List[Router] = []
         self.middleware: List[Middleware] = []
+        # Middleware is PRE-only (short-circuit or pass); response hooks are
+        # the POST side — synchronous header stampers (request-id echo,
+        # traceparent) that run on every response, including middleware
+        # short-circuits and error responses.
+        self.response_hooks: List[ResponseHook] = []
         self.on_startup: List[Callable[[], Awaitable[None]]] = []
         self.on_shutdown: List[Callable[[], Awaitable[None]]] = []
         self.state: Dict[str, Any] = {}
@@ -223,6 +229,17 @@ class App:
 
     def add_middleware(self, mw: Middleware) -> None:
         self.middleware.append(mw)
+
+    def add_response_hook(self, hook: ResponseHook) -> None:
+        self.response_hooks.append(hook)
+
+    def _apply_response_hooks(self, request: Request, resp: Response) -> Response:
+        for hook in self.response_hooks:
+            try:
+                hook(request, resp)
+            except Exception:
+                logger.exception("response hook failed")
+        return resp
 
     def _find_route(self, method: str, path: str) -> Tuple[Optional[Route], Dict[str, str], bool]:
         path_matched = False
@@ -239,7 +256,9 @@ class App:
         request.app = self  # handlers that introspect the route table (docs)
         tracer = self.state.get("tracer")
         if tracer is None:
-            return await self._dispatch(request)
+            return self._apply_response_hooks(
+                request, await self._dispatch(request)
+            )
         # Span name uses the route *pattern* — bounded cardinality: raw
         # paths would let unauthenticated garbage requests grow the stats
         # table without limit. One route lookup, shared with _dispatch.
@@ -256,7 +275,7 @@ class App:
             error_name=f"http_{resp.status}" if resp.status >= 500 else None,
             status=resp.status,
         )
-        return resp
+        return self._apply_response_hooks(request, resp)
 
     async def _dispatch(self, request: Request, match=None) -> Response:
         try:

@@ -106,6 +106,10 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_kv_transfer_queue_depth": ("gauge", ()),
     "dstack_tpu_serving_kv_transfer_seconds": ("histogram", ("role",)),
     "dstack_tpu_serving_pending_requests": ("gauge", ()),
+    # Per-request phase breakdown (PR 15 flight recorder): telescoping
+    # phase durations — queue_wait/prefill/kv_ship/kv_adopt/decode/... —
+    # labeled by the engine role they were observed on.
+    "dstack_tpu_serving_phase_seconds": ("histogram", ("phase", "role")),
     "dstack_tpu_serving_prefill_chunks_total": ("counter", ()),
     "dstack_tpu_serving_prefill_tokens_total": ("counter", ()),
     "dstack_tpu_serving_prefix_cache_hits_total": ("counter", ()),

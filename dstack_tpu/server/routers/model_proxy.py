@@ -26,6 +26,12 @@ from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Router
 from dstack_tpu.server.routers.deps import get_ctx
 from dstack_tpu.server.routers.services_proxy import pick_replica
+from dstack_tpu.utils.tracecontext import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    child_traceparent,
+    ensure_request_trace,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -97,6 +103,22 @@ async def chat_completions(request: Request, project_name: str):
         except TenantShedError as e:
             ctx.tracer.inc("serving_tenant_shed", tenant=label)
             ctx.service_stats.record_rejection(project_name, match["run_name"])
+            recorder = getattr(ctx, "flight_recorder", None)
+            if recorder is not None:
+                # Shed requests are exactly the tail the capture exists
+                # for. The dataplane middleware may already hold an open
+                # trace for this request — close that one rather than
+                # burning a second ring slot on the same id.
+                rec = request.state.get("trace_rec")
+                if rec is not None:
+                    recorder.finish(rec, "shed")
+                else:
+                    tp, rid = ensure_request_trace(
+                        request.state, request.headers
+                    )
+                    recorder.record_dropped(
+                        rid, x_request_id=rid, traceparent=tp
+                    )
             return Response(
                 {"detail": str(e)},
                 status=429,
@@ -111,10 +133,10 @@ async def chat_completions(request: Request, project_name: str):
         ctx.service_stats.record(project_name, match["run_name"])
         raise
     if match["format"] == "tgi":
-        resp = await _tgi_chat(ctx, target, target.base_url, body)
+        resp = await _tgi_chat(ctx, request, target, target.base_url, body)
     else:
         resp = await _openai_passthrough(
-            ctx, target, target.base_url + match["prefix"], body
+            ctx, request, target, target.base_url + match["prefix"], body
         )
     if resp.status in (429, 503):
         # Replica shed the request (serving-engine admission control).
@@ -137,6 +159,15 @@ async def chat_completions(request: Request, project_name: str):
     return resp
 
 
+def _fwd_headers(request: Request) -> Dict[str, str]:
+    """Trace propagation headers for an upstream call: a child of the
+    request's traceparent (same trace_id, this hop's span_id) plus the
+    client-correlatable X-Request-ID — so replica-side spans and the
+    engine flight recorder join the trace that entered the proxy."""
+    tp, rid = ensure_request_trace(request.state, request.headers)
+    return {TRACEPARENT_HEADER: child_traceparent(tp), REQUEST_ID_HEADER: rid}
+
+
 def _proxy_headers(upstream) -> Dict[str, str]:
     """Headers an upstream error/response must keep through the proxy:
     content-type, and the Retry-After backpressure hint on sheds."""
@@ -155,15 +186,19 @@ def _upstream_error(ctx, target, e: Exception) -> Response:
     return Response({"detail": f"Model backend unreachable: {e}"}, status=502)
 
 
-async def _openai_passthrough(ctx, target, base: str, body: Dict[str, Any]) -> Response:
+async def _openai_passthrough(
+    ctx, request: Request, target, base: str, body: Dict[str, Any]
+) -> Response:
     if body.get("stream"):
-        return await _openai_stream(ctx, target, base, body)
+        return await _openai_stream(ctx, request, target, base, body)
     client = ctx.proxy_pool.acquire(base)
     ctx.routing_cache.start(target.job_id)
     start = time.monotonic()
     try:
         upstream = await client.post(
-            f"{base}/chat/completions", json=body, timeout=settings.PROXY_MODEL_TIMEOUT
+            f"{base}/chat/completions", json=body,
+            headers=_fwd_headers(request),
+            timeout=settings.PROXY_MODEL_TIMEOUT,
         )
     except httpx.HTTPError as e:
         return _upstream_error(ctx, target, e)
@@ -179,7 +214,9 @@ async def _openai_passthrough(ctx, target, base: str, body: Dict[str, Any]) -> R
     )
 
 
-async def _openai_stream(ctx, target, base: str, body: Dict[str, Any]) -> Response:
+async def _openai_stream(
+    ctx, request: Request, target, base: str, body: Dict[str, Any]
+) -> Response:
     """Token-by-token SSE relay: forward upstream chunks as they arrive
     instead of buffering the full generation (reference model proxy streams).
     Upstream errors keep their status/body rather than masquerading as a
@@ -193,6 +230,7 @@ async def _openai_stream(ctx, target, base: str, body: Dict[str, Any]) -> Respon
                 "POST",
                 f"{base}/chat/completions",
                 json=body,
+                headers=_fwd_headers(request),
                 timeout=settings.PROXY_MODEL_TIMEOUT,
             ),
             stream=True,
@@ -245,7 +283,9 @@ def _messages_to_prompt(messages: List[Dict[str, Any]]) -> str:
     return "\n".join(parts)
 
 
-async def _tgi_chat(ctx, target, base: str, body: Dict[str, Any]) -> Response:
+async def _tgi_chat(
+    ctx, request: Request, target, base: str, body: Dict[str, Any]
+) -> Response:
     if body.get("stream"):
         # TGI translation is request/response; a buffered body dressed up as
         # a chat.completion would break SSE-iterating SDKs, so be explicit.
@@ -267,7 +307,9 @@ async def _tgi_chat(ctx, target, base: str, body: Dict[str, Any]) -> Response:
     start = time.monotonic()
     try:
         upstream = await client.post(
-            f"{base}/generate", json=tgi_body, timeout=settings.PROXY_MODEL_TIMEOUT
+            f"{base}/generate", json=tgi_body,
+            headers=_fwd_headers(request),
+            timeout=settings.PROXY_MODEL_TIMEOUT,
         )
     except httpx.HTTPError as e:
         return _upstream_error(ctx, target, e)

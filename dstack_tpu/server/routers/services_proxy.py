@@ -25,6 +25,12 @@ from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Route, Router
 from dstack_tpu.server.routers.deps import get_ctx
 from dstack_tpu.server.services.routing_cache import ReplicaTarget
+from dstack_tpu.utils.tracecontext import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    child_traceparent,
+    ensure_request_trace,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -67,12 +73,19 @@ async def pick_replica_ex(
 def request_headers(request: Request):
     """Forwardable request headers: hop-by-hop stripped case-insensitively
     (the framework lowercases parsed headers, but a hand-built Request —
-    tests, internal calls — may not)."""
-    return {
+    tests, internal calls — may not), plus trace propagation — the
+    upstream hop gets a child of this request's traceparent (minted here
+    when the client sent none) and its X-Request-ID, so replica-side
+    spans join the trace that entered the proxy."""
+    headers = {
         k.lower(): v
         for k, v in request.headers.items()
         if k.lower() not in _HOP_HEADERS
     }
+    tp, rid = ensure_request_trace(request.state, request.headers)
+    headers[TRACEPARENT_HEADER] = child_traceparent(tp)
+    headers[REQUEST_ID_HEADER] = rid
+    return headers
 
 
 async def _relay_body(ctx, upstream, base_url: str, job_id: str):

@@ -152,6 +152,14 @@ DATAPLANE_ROUTING_TTL = float(os.getenv("DSTACK_TPU_DATAPLANE_ROUTING_TTL", "30.
 QOS_TENANT_RATE = float(os.getenv("DSTACK_TPU_QOS_TENANT_RATE", "0"))
 QOS_TENANT_BURST = float(os.getenv("DSTACK_TPU_QOS_TENANT_BURST", "20"))
 QOS_TENANT_CAP = int(os.getenv("DSTACK_TPU_QOS_TENANT_CAP", "64"))
+# Per-request flight recorder (utils/flight_recorder.py): TRACE_RING
+# bounds retained request traces (0 disables recording entirely);
+# TRACE_SLOW_MS enables tail-based capture — full trace snapshots
+# persist only for requests at/above the threshold or ending in
+# error/shed. Empty/unset TRACE_SLOW_MS means no tail capture.
+TRACE_RING = int(os.getenv("DSTACK_TPU_TRACE_RING", "256"))
+_slow = os.getenv("DSTACK_TPU_TRACE_SLOW_MS", "")
+TRACE_SLOW_MS = float(_slow) if _slow else None
 
 ENCRYPTION_KEY = os.getenv("DSTACK_TPU_ENCRYPTION_KEY")  # AES key (base64); identity if unset
 

@@ -15,10 +15,11 @@ the forbidden `ff`, matching the spec's forward-compat rule.
 
 import re
 import secrets
-from typing import NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional, Tuple
 
 TRACEPARENT_HEADER = "traceparent"
 TRACEPARENT_ENV = "DSTACK_TPU_TRACEPARENT"
+REQUEST_ID_HEADER = "x-request-id"
 
 _TRACEPARENT_RE = re.compile(
     r"^(?P<version>[0-9a-f]{2})-(?P<trace_id>[0-9a-f]{32})"
@@ -60,6 +61,35 @@ def parse_traceparent(value: Optional[str]) -> Optional[TraceContext]:
     if ctx.version == "ff" or ctx.trace_id == "0" * 32 or ctx.span_id == "0" * 16:
         return None
     return ctx
+
+
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+
+def ensure_request_trace(
+    state: Dict[str, object], headers: Dict[str, str]
+) -> Tuple[str, str]:
+    """Per-request trace identity at an HTTP ingress: parse the inbound
+    `traceparent` (minting a fresh root when absent or malformed — the
+    spec's restart rule) and the client's `X-Request-ID` (generating one
+    when absent or junk), cached in the request's `state` dict so every
+    consumer on the request path sees the same pair.
+
+    Returns (traceparent, request_id)."""
+    cached = state.get("trace_identity")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    inbound = headers.get(TRACEPARENT_HEADER)
+    if parse_traceparent(inbound) is not None:
+        tp = inbound.strip().lower()
+    else:
+        tp = generate_traceparent()
+    rid = headers.get(REQUEST_ID_HEADER, "").strip()
+    if not _REQUEST_ID_RE.match(rid):
+        # A hostile/garbage id never reaches logs or response headers.
+        rid = secrets.token_hex(8)
+    state["trace_identity"] = (tp, rid)
+    return tp, rid
 
 
 def child_traceparent(parent: str) -> str:

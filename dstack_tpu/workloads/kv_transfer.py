@@ -58,6 +58,10 @@ class KVHandoff(NamedTuple):
     v: np.ndarray
     draft_k: Optional[np.ndarray] = None   # drafter pool rows (spec only)
     draft_v: Optional[np.ndarray] = None
+    # W3C trace context minted at ingress: the decode side continues the
+    # SAME trace_id, so a split request's prefill and decode spans join
+    # one end-to-end trace across OS processes.
+    traceparent: Optional[str] = None
 
     @property
     def n_blocks(self) -> int:
@@ -152,6 +156,8 @@ def pack_handoff(h: KVHandoff) -> Tuple[Dict[str, Any], Tuple[np.ndarray, ...]]:
         "top_p": float(h.top_p),
         "arrays": _manifest(named),
     }
+    if h.traceparent is not None:
+        header["traceparent"] = h.traceparent
     return header, tuple(a for _, a in named)
 
 
@@ -172,6 +178,7 @@ def unpack_handoff(header: Dict[str, Any]) -> KVHandoff:
         v=by_name["v"],
         draft_k=by_name.get("draft_k"),
         draft_v=by_name.get("draft_v"),
+        traceparent=header.get("traceparent"),
     )
 
 

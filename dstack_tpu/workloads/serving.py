@@ -53,6 +53,7 @@ import numpy as np
 from jax import lax
 
 from dstack_tpu.server.tracing import HistogramData
+from dstack_tpu.utils.flight_recorder import FlightRecorder
 from dstack_tpu.utils.stagemarkers import auto_stage
 from dstack_tpu.workloads.attention import decode_attention
 from dstack_tpu.workloads.config import ModelConfig
@@ -374,6 +375,12 @@ class _Request(NamedTuple):
     # the prefix-cache namespace so tenants never share poisoned blocks.
     adapter: Optional[str] = None
     adapter_ix: int = -1
+    # Per-request observability: the W3C traceparent this request rides
+    # (propagated onto the KV handoff) and its flight-recorder timeline
+    # (None when the recorder is off). Appended with defaults — callers
+    # construct _Request positionally.
+    traceparent: Optional[str] = None
+    trace: Optional[Any] = None
 
 
 class _PrefillTask:
@@ -439,6 +446,8 @@ class ServingEngine:
         lora_max_adapters: int = 0,
         lora_rank: int = 8,
         lora_targets: Optional[Tuple[str, ...]] = None,
+        trace_ring: int = 256,
+        trace_slow_ms: Optional[float] = None,
     ):
         self.config = config
         self.params = params
@@ -449,6 +458,13 @@ class ServingEngine:
                 f"role must be unified/prefill/decode, got {role!r}"
             )
         self.role = role
+        # Per-request flight recorder (PR 15): bounded ring of phase
+        # timelines, trace_ring=0 disables it entirely. Tail capture
+        # (full snapshots of slow/error/shed requests) is opt-in via
+        # trace_slow_ms.
+        self.recorder = FlightRecorder(
+            capacity=trace_ring, slow_ms=trace_slow_ms, role=role
+        )
         if max_prefills_per_chunk < 1:
             raise ValueError(
                 f"max_prefills_per_chunk must be >= 1, got {max_prefills_per_chunk}"
@@ -832,6 +848,9 @@ class ServingEngine:
         top_p: float = 1.0,
         request_id: Optional[int] = None,
         adapter: Optional[str] = None,
+        traceparent: Optional[str] = None,
+        x_request_id: Optional[str] = None,
+        t_arrival: Optional[float] = None,
     ) -> "queue.Queue[object]":
         """Enqueue a request; returns its output queue (see _Request.out
         for the token/None/Exception protocol). `temperature` (0 =
@@ -840,7 +859,12 @@ class ServingEngine:
         sampling params share one decode batch. `adapter` selects a
         loaded LoRA adapter by name (multi-tenant engines only); the
         request holds a registry ref until it retires, so the adapter
-        cannot be evicted or unloaded under it."""
+        cannot be evicted or unloaded under it.
+
+        `traceparent`/`x_request_id` thread the caller's trace identity
+        into the flight recorder (and onto the KV handoff for split
+        requests); `t_arrival` backdates the timeline to HTTP arrival so
+        server-side admission (QoS gate) shows up as its own phase."""
         if not tokens:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -873,6 +897,25 @@ class ServingEngine:
                 f" {self._num_blocks} (raise kv_pool_blocks)"
             )
         out: "queue.Queue[object]" = queue.Queue()
+        # Open the request's timeline before admission so a shed request
+        # still leaves a (terminal) trace for tail capture. With a
+        # backdated arrival the gap to submit is the qos_admission phase.
+        t_sub = time.monotonic()
+        rec = None
+        if self.recorder.enabled:
+            first = ("qos_admission" if t_arrival is not None
+                     else "adapter_acquire" if adapter is not None
+                     else "queue_wait")
+            rec = self.recorder.begin(
+                request_id, x_request_id=x_request_id,
+                traceparent=traceparent, first_phase=first,
+                t0=t_sub if t_arrival is None else t_arrival,
+            )
+            if t_arrival is not None:
+                rec.mark(
+                    "adapter_acquire" if adapter is not None
+                    else "queue_wait", t_sub,
+                )
         with self._lock:
             if self._failed is not None:
                 raise RuntimeError(f"serving engine failed: {self._failed}")
@@ -893,6 +936,7 @@ class ServingEngine:
             backlog = depth - free
             if self.max_pending is not None and backlog >= self.max_pending:
                 self.rejected += 1
+                self.recorder.finish(rec, "shed")
                 raise EngineOverloadedError(depth, self._retry_after(depth))
             adapter_ix = -1
             if adapter is not None:
@@ -906,10 +950,12 @@ class ServingEngine:
                 # retires (_release_adapter at every terminal path).
                 adapter_ix = self._lora.acquire(adapter)
                 self._adapter_holds[out] = adapter
+                if rec is not None:
+                    rec.mark("queue_wait")  # adapter_acquire closes here
             self._pending.put(
                 _Request(list(tokens), max_new_tokens, out,
                          float(temperature), float(top_p), time.monotonic(),
-                         request_id, adapter, adapter_ix)
+                         request_id, adapter, adapter_ix, traceparent, rec)
             )
             self._inflight.add(out)
         self._wake.set()
@@ -939,21 +985,22 @@ class ServingEngine:
             # queue.Queue is internally locked, so draining interleaves
             # safely with the loop thread's get_nowait; order of the
             # survivors is preserved.
-            drained, found = [], False
+            drained, found = [], None
             while True:
                 try:
                     r = self._pending.get_nowait()
                 except queue.Empty:
                     break
                 if r.out is out:
-                    found = True
+                    found = r
                 else:
                     drained.append(r)
             for r in drained:
                 self._pending.put(r)
-            if found:
+            if found is not None:
                 self._inflight.discard(out)
                 self._release_adapter(out)
+                self.recorder.finish(found.trace, "cancelled")
                 out.put(None)
                 return
             self._cancelled.add(out)
@@ -1112,7 +1159,18 @@ class ServingEngine:
             "adapters_loaded": (
                 0 if self._lora is None else self._lora.loaded_count
             ),
+            # Per-request flight recorder (PR 15): ring occupancy/tail
+            # counters plus the per-phase latency histograms behind
+            # dstack_tpu_serving_phase_seconds.
+            "trace": self.recorder.stats(),
+            "phase_hists": self.recorder.phase_histograms(),
         }
+
+    def request_trace(self, key: Any) -> Optional[Dict[str, Any]]:
+        """Phase-timeline snapshot for one request, by engine request id
+        or client X-Request-ID (None when unknown, recycled, or the
+        recorder is off) — the payload behind GET /v1/requests/<id>/trace."""
+        return self.recorder.get(key)
 
     def close(self) -> None:
         with self._lock:
@@ -1144,26 +1202,31 @@ class ServingEngine:
             self._adapter_holds.clear()
             for slot, req in enumerate(self._live):
                 if req is not None:
+                    self.recorder.finish(req.trace, "error")
                     req.out.put(sentinel)
                     self._live[slot] = None
             # Requests caught mid-chunked-prefill (popped from _pending,
             # not yet live) must get the sentinel too, or their consumers
             # hang forever on a dead engine.
             for req in self._admitting:
+                self.recorder.finish(req.trace, "error")
                 req.out.put(sentinel)
             self._admitting.clear()
             self._tasks.clear()
             self._pending_activation.clear()
             # Handoffs queued but not yet admitted (decode role): their
             # consumers are waiting on the stream too.
-            for _h, h_out, _t in self._prefilled_pending:
+            for _h, h_out, _t, h_rec in self._prefilled_pending:
+                self.recorder.finish(h_rec, "error")
                 h_out.put(sentinel)
             self._prefilled_pending.clear()
             while True:
                 try:
-                    self._pending.get_nowait().out.put(sentinel)
+                    r = self._pending.get_nowait()
                 except queue.Empty:
                     return
+                self.recorder.finish(r.trace, "error")
+                r.out.put(sentinel)
 
     # -- chunked prefill admission -------------------------------------------
 
@@ -1240,6 +1303,7 @@ class ServingEngine:
                 self._admitting.remove(task.req)
             self._release_adapter(task.req.out)
         self._tasks.remove(task)
+        self.recorder.finish(task.req.trace, "cancelled")
         task.req.out.put(None)
 
     def _ensure_task_blocks(self, task: _PrefillTask, upto: int) -> bool:
@@ -1304,6 +1368,7 @@ class ServingEngine:
                     self._cancelled.discard(req.out)
                     self._inflight.discard(req.out)
                     self._release_adapter(req.out)
+                    self.recorder.finish(req.trace, "cancelled")
                     req.out.put(None)
                     progressed = True
                     continue
@@ -1318,6 +1383,8 @@ class ServingEngine:
                 self._queue_wait_s, t_pop - req.t_submit
             )
             self._sum_queue_wait += t_pop - req.t_submit
+            if req.trace is not None:
+                req.trace.mark("prefill", t_pop)  # queue_wait closes here
             self._tasks.append(_PrefillTask(req, slot, matched, blocks, t_pop))
             progressed = True
         # Dispatch chunks under the shared token budget.
@@ -1376,6 +1443,9 @@ class ServingEngine:
             budget -= n
             self._prefill_chunks += 1
             self._prefill_tokens_computed += n
+            if task.req.trace is not None:
+                task.req.trace.prefill_chunks += 1
+                task.req.trace.prefill_tokens += n
             progressed = True
             if final:
                 task.first = first
@@ -1451,6 +1521,11 @@ class ServingEngine:
                 dead = req.out in self._cancelled
                 if not dead:
                     req.out.put(first)
+                    if req.trace is not None and req.max_new_tokens > 1:
+                        # Prefill ends at first delivery; the decode
+                        # phase runs to the last token (prefill-role
+                        # handoffs never pass through here).
+                        req.trace.mark("decode", now)
                 self._ttft_s = self._ewma_seed(self._ttft_s, now - req.t_submit)
                 self._prefill_s = self._ewma_seed(self._prefill_s, now - task.t_pop)
                 self._n_admitted += 1
@@ -1472,6 +1547,9 @@ class ServingEngine:
                         self._alloc.release(b)
                     task.table.clear()
                     self._release_adapter(req.out)
+                    self.recorder.finish(
+                        req.trace, "cancelled" if dead else "ok", now
+                    )
                     req.out.put(None)
                 elif dead:
                     # Cancelled between finalize and delivery: the loop's
@@ -1583,11 +1661,14 @@ class ServingEngine:
             dead = req.out in self._cancelled
         if dead:
             # Cancel mid-handoff: release everything, ship nothing.
+            self.recorder.finish(req.trace, "cancelled")
             _finish(None)
             return
         pay = task.kv_payload
         n = pay["n"]
         t0 = time.monotonic()
+        if req.trace is not None:
+            req.trace.mark("kv_ship", t0)  # prefill closes here
         try:
             k_np = np.asarray(jax.device_get(pay["k"]))[:, :n]
             v_np = np.asarray(jax.device_get(pay["v"]))[:, :n]
@@ -1610,6 +1691,7 @@ class ServingEngine:
                 temperature=req.temperature,
                 top_p=req.top_p,
                 k=k_np, v=v_np, draft_k=dk, draft_v=dv,
+                traceparent=req.traceparent,
             )
             self._kv_transfer.send(h)
         except Exception as e:
@@ -1617,6 +1699,7 @@ class ServingEngine:
             # retry_stale off): fail THIS request loudly — the consumer
             # must not mistake "prefilled but never decoded" for a
             # complete empty generation.
+            self.recorder.finish(req.trace, "error")
             _finish(e)
             return
         dt = time.monotonic() - t0
@@ -1632,6 +1715,9 @@ class ServingEngine:
             self._n_admitted += 1
             self._sum_ttft += now - req.t_submit
             self._ttft_hist.observe(now - req.t_submit)
+        if req.trace is not None:
+            req.trace.kv_payload_bytes += h.payload_bytes
+            self.recorder.finish(req.trace, "ok", now)
         # Consumer protocol on the prefill worker: no tokens, just the
         # clean end — the DECODE worker streams tokens to ITS consumers.
         _finish(None)
@@ -1692,7 +1778,17 @@ class ServingEngine:
             if handoff.epoch != self.handoff_epoch:
                 self._handoff_stale_rejected += 1
                 raise StaleEpochError(handoff.epoch, self.handoff_epoch)
-            self._prefilled_pending.append((handoff, out, time.monotonic()))
+            t_recv = time.monotonic()
+            # Decode-side leg of the request's trace: the handoff frame
+            # carries the traceparent minted at ingress, so this trace
+            # shares the prefill worker's trace_id across processes.
+            rec = None
+            if self.recorder.enabled:
+                rec = self.recorder.begin(
+                    handoff.request_id, traceparent=handoff.traceparent,
+                    first_phase="queue_wait", t0=t_recv,
+                )
+            self._prefilled_pending.append((handoff, out, t_recv, rec))
             self._inflight.add(out)
         self._wake.set()
         return out
@@ -1824,13 +1920,14 @@ class ServingEngine:
             with self._lock:
                 if not self._prefilled_pending:
                     return progressed
-                h, out, t_recv = self._prefilled_pending[0]
+                h, out, t_recv, rec = self._prefilled_pending[0]
                 dead = out in self._cancelled
                 if dead:
                     self._prefilled_pending.pop(0)
                     self._cancelled.discard(out)
                     self._inflight.discard(out)
             if dead:
+                self.recorder.finish(rec, "cancelled")
                 out.put(None)
                 progressed = True
                 continue
@@ -1852,17 +1949,21 @@ class ServingEngine:
                         self._alloc.release(b)
                     return progressed  # pool starved: retry next boundary
                 self._prefilled_pending.pop(0)
+            if rec is not None:
+                rec.mark("kv_adopt")  # queue_wait closes here
             self._inject_handoff(h, table)
             prompt = list(h.prompt)
             first = int(h.first_token)
             slot = free[0]
             req = _Request(prompt, h.max_new_tokens, out,
                            float(h.temperature), float(h.top_p), t_recv,
-                           h.request_id)
+                           h.request_id, None, -1, h.traceparent, rec)
             with self._lock:
                 self._alloc.insert_full(prompt, table)
                 self._handoffs_received += 1
                 self._kv_transfer_bytes += h.payload_bytes
+                if rec is not None:
+                    rec.kv_payload_bytes += h.payload_bytes
                 if h.max_new_tokens > 1:
                     self._live[slot] = req
                     self._lengths_host[slot] = len(prompt)
@@ -1884,6 +1985,11 @@ class ServingEngine:
                 still_wanted = out not in self._cancelled
                 if still_wanted:
                     out.put(first)
+                    if rec is not None:
+                        if h.max_new_tokens > 1:
+                            rec.mark("decode", now)  # kv_adopt closes here
+                        else:
+                            self.recorder.finish(rec, "ok", now)
                     if h.max_new_tokens <= 1:
                         out.put(None)
                 elif h.max_new_tokens <= 1:
@@ -1891,6 +1997,7 @@ class ServingEngine:
                     # already released above; answer the consumer here
                     # (a live slot instead gets the fan-out cancel path).
                     self._cancelled.discard(out)
+                    self.recorder.finish(rec, "cancelled", now)
                     out.put(None)
                 # Decode-role TTFT: handoff receipt -> first delivery
                 # (admission wait + injection; the submit->handoff leg is
@@ -2016,6 +2123,7 @@ class ServingEngine:
             if req is not None:
                 self._cancelled.discard(req.out)
                 self._inflight.discard(req.out)
+                self.recorder.finish(req.trace, "error")
             self._release_slot_blocks(slot, cache_tail=False)
             if req is not None:
                 self._release_adapter(req.out)
@@ -2214,6 +2322,12 @@ class ServingEngine:
             self._spec_accepted += a
             self._spec_rejected += k_cur - a
             n_round_tokens += int((toks[slot] >= 0).sum())
+            tr = self._live[slot].trace
+            if tr is not None:
+                tr.spec_rounds += 1
+                tr.spec_drafted += k_cur
+                tr.spec_accepted += a
+                tr.spec_rejected += k_cur - a
             rate = a / k_cur
             prev = self._accept_ewma[slot]
             ewma = rate if prev is None else prev + 0.3 * (rate - prev)
@@ -2257,6 +2371,11 @@ class ServingEngine:
             n_emitted = int((toks[slot] >= 0).sum())
             self._lengths_host[slot] += n_emitted
             total_emitted += n_emitted
+            if req.trace is not None:
+                # Hot-path bookkeeping is attribute increments on the
+                # preallocated trace slot — no allocation per chunk.
+                req.trace.decode_steps += 1
+                req.trace.decode_tokens += n_emitted
             if req.out in cancelled:
                 # consumer is gone: free the slot now, skip the
                 # chunk's tokens (nobody reads them)
@@ -2270,6 +2389,7 @@ class ServingEngine:
                     )
                     self._release_adapter(req.out)
                 self.state = self._retire(slot)
+                self.recorder.finish(req.trace, "cancelled")
                 req.out.put(None)
                 continue
             if not still[slot]:
@@ -2292,10 +2412,11 @@ class ServingEngine:
                 for tok in toks[slot]:
                     if tok >= 0:
                         req.out.put(int(tok))
+                t_done = time.monotonic()
+                self.recorder.finish(req.trace, "ok", t_done)
                 req.out.put(None)
                 self._turn_s = self._ewma(
-                    self._turn_s,
-                    time.monotonic() - self._slot_t0[slot],
+                    self._turn_s, t_done - self._slot_t0[slot],
                 )
                 continue
             for tok in toks[slot]:
@@ -2422,4 +2543,24 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
         stats.get("kv_transfer_hist")
         or {"buckets": [], "sum": 0.0, "count": 0},
     )
+    # Per-request phase breakdown (PR 15 flight recorder): one histogram
+    # per phase the recorder observed, labeled {phase, role}. Engines
+    # with the recorder off (or older snapshots) emit nothing — scrapers
+    # treat an absent series as zero, and MET01 only pins declared names.
+    phase_hists = stats.get("phase_hists") or {}
+    if phase_hists:
+        base = "dstack_tpu_serving_phase_seconds"
+        lines.append(f"# TYPE {base} histogram")
+        for phase in sorted(phase_hists):
+            hist = phase_hists[phase]
+            labels = f'phase="{phase}",role="{role}"'
+            for le, cumulative in hist["buckets"]:
+                lines.append(
+                    f'{base}_bucket{{le="{le}",{labels}}} {cumulative}'
+                )
+            lines.append(
+                f'{base}_bucket{{le="+Inf",{labels}}} {hist["count"]}'
+            )
+            lines.append(f'{base}_sum{{{labels}}} {hist["sum"]}')
+            lines.append(f'{base}_count{{{labels}}} {hist["count"]}')
     return "\n".join(lines) + "\n"

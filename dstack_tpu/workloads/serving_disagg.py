@@ -181,6 +181,10 @@ def run_decode_worker(args) -> None:
                 epoch = engine.bump_handoff_epoch()
                 server.bump_epoch()
                 ctrl.send({"kind": "bump_reply", "epoch": epoch})
+            elif kind == "trace":
+                ctrl.send({"kind": "trace_reply", "id": msg.get("id"),
+                           "trace": _jsonable(
+                               engine.request_trace(msg.get("id")))})
             elif kind == "close":
                 ctrl.send({"kind": "bye"})
                 return
@@ -246,6 +250,8 @@ def run_prefill_worker(args) -> None:
                     temperature=float(msg.get("temperature", 0.0)),
                     top_p=float(msg.get("top_p", 1.0)),
                     request_id=rid,
+                    traceparent=msg.get("traceparent"),
+                    x_request_id=msg.get("x_request_id"),
                 )
                 outs[rid] = out
                 threading.Thread(
@@ -268,6 +274,10 @@ def run_prefill_worker(args) -> None:
                         "epoch": client.epoch,
                     },
                 })
+            elif kind == "trace":
+                ctrl.send({"kind": "trace_reply", "id": msg.get("id"),
+                           "trace": _jsonable(
+                               engine.request_trace(msg.get("id")))})
             elif kind == "close":
                 ctrl.send({"kind": "bye"})
                 return
@@ -503,9 +513,14 @@ def run_drill(mesh_model: int = 2, spec: bool = False,
         pre.connect()
         log("workers up; running scenarios")
         for rid, sc in enumerate(scenarios):
+            # Every scenario carries a distinct caller-minted traceparent
+            # so the continuity check below can pin that BOTH tiers kept
+            # the caller's trace_id rather than minting their own.
             pre.send({"kind": "generate", "id": rid,
                       "prompt": sc["prompt"],
-                      "max_new_tokens": sc["max_new"]})
+                      "max_new_tokens": sc["max_new"],
+                      "traceparent": f"00-{rid + 1:032x}-{rid + 1:016x}-01",
+                      "x_request_id": f"drill-{rid}"})
         got: List[Optional[List[int]]] = [None] * len(scenarios)
         for rid, sc in enumerate(scenarios):
             res = wait_prefill(pre, rid)
@@ -522,6 +537,34 @@ def run_drill(mesh_model: int = 2, spec: bool = False,
             (i, a[:6], b[:6])
             for i, (a, b) in enumerate(zip(got, ref)) if a != b
         ]
+
+        # Trace continuity: a handed-off request must leave ONE trace
+        # spanning both OS processes — same caller trace_id on each tier,
+        # kv_ship on the prefill side ending where the decode side's
+        # kv_adopt picks up, and each tier's phases telescoping exactly
+        # to its measured total.
+        log("trace continuity across tiers")
+        pt = pre.request({"kind": "trace", "id": 0})["trace"]
+        dt = dec.request({"kind": "trace", "id": 0})["trace"]
+        assert pt is not None and dt is not None, (pt, dt)
+        assert pt["trace_id"] == dt["trace_id"] == f"{1:032x}", (
+            pt["trace_id"], dt["trace_id"])
+        assert pt["x_request_id"] == "drill-0"
+        p_phases = [p["phase"] for p in pt["phases"]]
+        d_phases = [p["phase"] for p in dt["phases"]]
+        assert p_phases == ["queue_wait", "prefill", "kv_ship"], p_phases
+        assert d_phases == ["queue_wait", "kv_adopt", "decode"], d_phases
+        for tier, tr in (("prefill", pt), ("decode", dt)):
+            assert tr["status"] == "ok", (tier, tr["status"])
+            drift = abs(sum(p["duration_s"] for p in tr["phases"])
+                        - tr["total_seconds"])
+            assert drift < 1e-9, (tier, drift)
+        assert pt["counters"]["kv_payload_bytes"] == (
+            dt["counters"]["kv_payload_bytes"]) > 0
+        assert dt["counters"]["decode_steps"] >= 1
+        report["checks"]["trace_continuity"] = True
+        report["trace_prefill"] = pt
+        report["trace_decode"] = dt
 
         # Cancel mid-handoff: fire a long prompt and cancel immediately.
         log("cancel mid-handoff")

@@ -31,6 +31,7 @@ from dstack_tpu.dataplane.qos import (
     TenantShedError,
 )
 from dstack_tpu.server.tracing import HistogramData
+from dstack_tpu.utils.tracecontext import ensure_request_trace
 from dstack_tpu.workloads.config import PRESETS
 from dstack_tpu.workloads.lora_serving import (
     AdapterBusyError,
@@ -60,7 +61,8 @@ class Engine:
                  kv_transfer_connect: str = "",
                  lora_max_adapters: int = 0, lora_rank: int = 8,
                  adapters=None, qos_rate: float = 0.0,
-                 qos_burst: float = 20.0, qos_tenant_cap: int = 64):
+                 qos_burst: float = 20.0, qos_tenant_cap: int = 64,
+                 trace_ring: int = 256, trace_slow_ms=None):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
             raise SystemExit(
@@ -166,6 +168,7 @@ class Engine:
                 kv_budget_bytes=kv_budget_mb * (1 << 20) or None,
                 mesh=mesh, role=role, kv_transfer=kv_transfer,
                 lora_max_adapters=lora_max_adapters, lora_rank=lora_rank,
+                trace_ring=trace_ring, trace_slow_ms=trace_slow_ms,
             )
         except ValueError as e:
             raise SystemExit(f"invalid serving configuration: {e}")
@@ -292,7 +295,8 @@ class Engine:
 
     def chat_stream(self, messages, max_tokens=None, temperature=None,
                     top_p=None, stop=None, usage_out=None,
-                    adapter=None, tenant=None):
+                    adapter=None, tenant=None,
+                    traceparent=None, x_request_id=None):
         """Yield decoded text fragments as tokens land (continuous batch).
 
         `max_tokens` and `temperature` are the per-request OpenAI fields:
@@ -351,11 +355,23 @@ class Engine:
             rid = next(self._handoff_ids)
             if usage_out is not None:
                 usage_out["handoff_id"] = rid
+        # Arrival timestamp BEFORE QoS admission, so the flight recorder
+        # can attribute gate time to its own `qos_admission` phase.
+        t_arrival = time.monotonic()
         granted = False
         if self.qos is not None:
             # Sheds (TenantShedError -> 429) or blocks for the tenant's
             # DRR turn at a grant permit; the permit frees in `finally`.
-            self.qos.admit(tenant or DEFAULT_TENANT)
+            try:
+                self.qos.admit(tenant or DEFAULT_TENANT)
+            except TenantShedError:
+                # Shed before the engine ever saw it: a one-shot terminal
+                # trace so the tail capture still records the rejection.
+                self.serving.recorder.record_dropped(
+                    x_request_id, x_request_id=x_request_id,
+                    traceparent=traceparent, t0=t_arrival,
+                )
+                raise
             granted = True
         t_submit = time.monotonic()
         ttft_seen = False
@@ -363,7 +379,9 @@ class Engine:
             out = self.serving.submit(
                 [int(t) for t in tokens[0]], max_new_tokens=budget,
                 temperature=temp, top_p=nucleus, request_id=rid,
-                adapter=adapter,
+                adapter=adapter, traceparent=traceparent,
+                x_request_id=x_request_id,
+                t_arrival=t_arrival if self.qos is not None else None,
             )
         except BaseException:
             if granted:
@@ -446,10 +464,13 @@ class Engine:
                 self.qos.release()
 
     def chat(self, messages, max_tokens=None, temperature=None, top_p=None,
-             stop=None, usage_out=None, adapter=None, tenant=None) -> str:
+             stop=None, usage_out=None, adapter=None, tenant=None,
+             traceparent=None, x_request_id=None) -> str:
         return "".join(self.chat_stream(messages, max_tokens, temperature,
                                         top_p, stop, usage_out=usage_out,
-                                        adapter=adapter, tenant=tenant))
+                                        adapter=adapter, tenant=tenant,
+                                        traceparent=traceparent,
+                                        x_request_id=x_request_id))
 
 
 def main() -> None:
@@ -527,6 +548,14 @@ def main() -> None:
     parser.add_argument("--qos-tenant-cap", type=int, default=64,
                         help="distinct tenant labels before metrics"
                              " collapse into the overflow label")
+    parser.add_argument("--trace-ring", type=int, default=256,
+                        help="flight-recorder ring size (retained request"
+                             " traces); 0 disables per-request tracing")
+    parser.add_argument("--trace-slow-ms", type=float, default=None,
+                        help="tail-based capture threshold: full traces"
+                             " persist only for requests at/above this"
+                             " many ms or ending in error/shed (unset"
+                             " disables tail capture)")
     args = parser.parse_args()
     if args.adapter and args.lora_max_adapters <= 0:
         args.lora_max_adapters = len(args.adapter)
@@ -572,7 +601,9 @@ def main() -> None:
                     lora_max_adapters=args.lora_max_adapters,
                     lora_rank=args.lora_rank, adapters=args.adapter,
                     qos_rate=args.qos_rate, qos_burst=args.qos_burst,
-                    qos_tenant_cap=args.qos_tenant_cap)
+                    qos_tenant_cap=args.qos_tenant_cap,
+                    trace_ring=args.trace_ring,
+                    trace_slow_ms=args.trace_slow_ms)
 
     # Decode tier: admit prefill-tier handoffs and expose each admitted
     # stream at GET /v1/handoffs/<request_id> (SSE) for the front-end to
@@ -598,11 +629,21 @@ def main() -> None:
         def log_message(self, *a):
             pass
 
+        def _trace_identity(self):
+            """(traceparent, request_id) for this request: the inbound
+            headers when valid, minted otherwise. Computed per call — a
+            handler instance has no per-request state to cache in."""
+            hdrs = {k.lower(): v for k, v in self.headers.items()}
+            return ensure_request_trace({}, hdrs)
+
         def _send(self, code: int, obj, headers=()) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            tp, req_id = self._trace_identity()
+            self.send_header("X-Request-ID", req_id)
+            self.send_header("Traceparent", tp)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
@@ -648,11 +689,13 @@ def main() -> None:
             # submit-time errors surface as a clean JSON 500 instead of a
             # second status line spliced into the event stream.
             adapter, tenant = self._request_identity(req)
+            tp, req_id = self._trace_identity()
             try:
                 pieces = engine.chat_stream(
                     req.get("messages", []), req.get("max_tokens"),
                     req.get("temperature"), req.get("top_p"), req.get("stop"),
                     adapter=adapter, tenant=tenant,
+                    traceparent=tp, x_request_id=req_id,
                 )
                 first = next(pieces)
             except StopIteration:
@@ -671,16 +714,18 @@ def main() -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            self.send_header("X-Request-ID", req_id)
+            self.send_header("Traceparent", tp)
             self.end_headers()
             try:
-                self._stream_body(first, pieces)
+                self._stream_body(first, pieces, req_id)
             except Exception:
                 # Headers are committed: a 500 here would splice a second
                 # status line into the event stream. Truncating WITHOUT the
                 # [DONE] terminator is the SSE convention for "broken".
                 return
 
-        def _stream_body(self, first, pieces) -> None:
+        def _stream_body(self, first, pieces, req_id=None) -> None:
             for i, piece in enumerate(itertools.chain([first], pieces)):
                 chunk = {
                     "id": "chatcmpl-native",
@@ -695,6 +740,34 @@ def main() -> None:
                     }],
                 }
                 self.wfile.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                self.wfile.flush()
+            # Final usage-style block: the flight recorder's phase summary
+            # for this stream, so the client sees where its latency went
+            # without a second round trip to the trace endpoint.
+            trace = (engine.serving.request_trace(req_id)
+                     if req_id is not None else None)
+            if trace is not None:
+                summary = {
+                    "id": "chatcmpl-native",
+                    "object": "chat.completion.chunk",
+                    "created": int(time.time()),
+                    "model": args.model_name,
+                    # An empty-delta choice rather than `"choices": []`:
+                    # clients that index choices[0] unconditionally (the
+                    # common SSE-consumer shape) must survive this chunk.
+                    "choices": [{"index": 0, "delta": {},
+                                 "finish_reason": None}],
+                    "phase_summary": {
+                        "request_id": trace["request_id"],
+                        "trace_id": trace["trace_id"],
+                        "total_seconds": trace["total_seconds"],
+                        "phases": trace["phases"],
+                        "counters": trace["counters"],
+                    },
+                }
+                self.wfile.write(
+                    b"data: " + json.dumps(summary).encode() + b"\n\n"
+                )
                 self.wfile.flush()
             self.wfile.write(b"data: [DONE]\n\n")
 
@@ -742,6 +815,17 @@ def main() -> None:
                 return self._send(200, stats)
             if path.rstrip("/").startswith("/v1/handoffs/"):
                 return self._stream_handoff(path.rstrip("/"))
+            clean = path.rstrip("/")
+            if clean.startswith("/v1/requests/") and clean.endswith("/trace"):
+                # Phase timeline by engine request id or client
+                # X-Request-ID (live ring first, then the tail store).
+                rid = clean[len("/v1/requests/"):-len("/trace")]
+                trace = engine.serving.request_trace(rid)
+                if trace is None:
+                    return self._send(
+                        404, {"error": f"no trace for request {rid!r}"}
+                    )
+                return self._send(200, trace)
             self._send(404, {"error": "not found"})
 
         def _stream_handoff(self, path: str) -> None:
@@ -834,12 +918,14 @@ def main() -> None:
                 if req.get("stream"):
                     return self._stream(req)
                 adapter, tenant = self._request_identity(req)
+                tp, req_id = self._trace_identity()
                 usage = {}
                 text = engine.chat(req.get("messages", []),
                                    req.get("max_tokens"), req.get("temperature"),
                                    req.get("top_p"), req.get("stop"),
                                    usage_out=usage,
-                                   adapter=adapter, tenant=tenant)
+                                   adapter=adapter, tenant=tenant,
+                                   traceparent=tp, x_request_id=req_id)
             except TenantShedError as e:
                 return self._send_shed(e)
             except EngineOverloadedError as e:

@@ -1,0 +1,18 @@
+"""TRC01 fixture: dataplane handlers calling upstream without forwarding
+the trace context — each hop here severs the request trace."""
+
+
+async def relay(ctx, request, base):
+    client = ctx.proxy_pool.acquire(base)
+    try:
+        return await client.post(base + "/chat/completions", json=request.json())
+    finally:
+        ctx.proxy_pool.release(base)
+
+
+async def relay_stream(ctx, request, base):
+    client = ctx.proxy_pool.acquire(base)
+    try:
+        return await client.stream("GET", base + "/events")
+    finally:
+        ctx.proxy_pool.release(base)

@@ -1,0 +1,32 @@
+"""TRC01-clean fixture: upstream calls that forward the trace context,
+either via a module-local header helper or inline."""
+
+from dstack_tpu.utils.tracecontext import TRACEPARENT_HEADER, child_traceparent
+
+
+def _fwd_headers(request):
+    tp = request.headers.get(TRACEPARENT_HEADER, "")
+    return {TRACEPARENT_HEADER: child_traceparent(tp)}
+
+
+async def relay(ctx, request, base):
+    client = ctx.proxy_pool.acquire(base)
+    try:
+        return await client.post(
+            base + "/chat/completions",
+            json=request.json(),
+            headers=_fwd_headers(request),
+        )
+    finally:
+        ctx.proxy_pool.release(base)
+
+
+async def relay_inline(ctx, request, base):
+    client = ctx.proxy_pool.acquire(base)
+    headers = {
+        TRACEPARENT_HEADER: request.headers.get(TRACEPARENT_HEADER, "")
+    }
+    try:
+        return await client.stream("GET", base + "/events", headers=headers)
+    finally:
+        ctx.proxy_pool.release(base)

@@ -1,0 +1,200 @@
+"""Flight recorder invariants under a frozen clock.
+
+The recorder's contract is structural, so every test drives it with a
+hand-stepped fake clock: phase durations must telescope exactly to the
+total, the ring must overwrite oldest-first at capacity (index evicted
+with the slot), a disabled recorder must retain nothing, and the
+tail-capture threshold must be inclusive at the boundary.
+"""
+
+from dstack_tpu.utils.flight_recorder import (
+    PHASES,
+    FlightRecorder,
+    RequestTrace,
+    TailStore,
+)
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_phase_durations_telescope_to_total():
+    clock = Clock()
+    rec = FlightRecorder(capacity=4, clock=clock)
+    tr = rec.begin(1, traceparent=TP, first_phase="queue_wait", t0=0.0)
+    clock.t = 0.125
+    tr.mark("prefill")
+    clock.t = 0.5
+    tr.mark("decode")
+    clock.t = 1.75
+    rec.finish(tr, "ok")
+    d = tr.to_dict()
+    assert d["status"] == "ok"
+    assert d["total_seconds"] == 1.75
+    assert [p["phase"] for p in d["phases"]] == [
+        "queue_wait", "prefill", "decode",
+    ]
+    assert sum(p["duration_s"] for p in d["phases"]) == d["total_seconds"]
+    # Offsets are starts relative to t0, consistent with durations.
+    assert [p["start_s"] for p in d["phases"]] == [0.0, 0.125, 0.5]
+
+
+def test_every_phase_name_is_canonical():
+    # Engine mark sites use literals; pin them to the shared vocabulary.
+    for phase in ("qos_admission", "adapter_acquire", "queue_wait",
+                  "prefill", "kv_ship", "kv_adopt", "decode"):
+        assert phase in PHASES
+
+
+def test_ring_overwrites_oldest_and_evicts_index():
+    clock = Clock()
+    rec = FlightRecorder(capacity=2, clock=clock)
+    t1 = rec.begin("a", t0=0.0)
+    t2 = rec.begin("b", t0=0.0)
+    rec.finish(t1, "ok")
+    rec.finish(t2, "ok")
+    assert rec.get("a") is not None and rec.get("b") is not None
+    # Third begin recycles the oldest slot ("a"): its trace is gone.
+    t3 = rec.begin("c", t0=1.0)
+    assert rec.get("a") is None
+    assert rec.get("b") is not None
+    assert rec.get("c")["status"] == "in_flight"
+    rec.finish(t3, "ok")
+    assert rec.stats()["recycled_total"] == 1
+
+
+def test_recycled_slot_state_resets():
+    clock = Clock()
+    rec = FlightRecorder(capacity=1, clock=clock)
+    t1 = rec.begin("a", t0=0.0)
+    t1.decode_steps = 7
+    t1.mark("decode", 0.5)
+    rec.finish(t1, "ok", t_end=1.0)
+    t2 = rec.begin("b", t0=2.0)
+    assert t2 is t1  # same preallocated slot, recycled
+    assert t2.decode_steps == 0
+    assert t2.status is None and t2.t_end is None
+    assert len(t2.marks) == 1
+
+
+def test_disabled_recorder_retains_nothing():
+    rec = FlightRecorder(capacity=0, slow_ms=0.0)
+    assert not rec.enabled
+    assert rec.begin("a", t0=0.0) is None
+    rec.finish(None, "ok")  # no-op, no crash
+    rec.record_dropped("b")
+    assert rec.get("a") is None and rec.get("b") is None
+    assert rec.stats()["started_total"] == 0
+    assert rec.phase_histograms() == {}
+
+
+def test_finish_is_idempotent_first_terminal_wins():
+    clock = Clock()
+    rec = FlightRecorder(capacity=2, clock=clock)
+    tr = rec.begin(1, t0=0.0)
+    clock.t = 1.0
+    rec.finish(tr, "cancelled")
+    clock.t = 2.0
+    rec.finish(tr, "ok")  # late racing path: ignored
+    assert tr.status == "cancelled"
+    assert tr.t_end == 1.0
+    assert rec.stats()["finished_total"] == 1
+
+
+def test_tail_threshold_is_inclusive_at_boundary():
+    store = TailStore(slow_ms=100.0)
+    assert store.should_capture(0.100, "ok") is True  # exactly at: slow
+    assert store.should_capture(0.0999, "ok") is False
+    assert store.should_capture(0.0, "error") is True
+    assert store.should_capture(0.0, "shed") is True
+    assert store.should_capture(0.0, "cancelled") is False
+    # slow_ms=None disables capture entirely, even for errors.
+    off = TailStore(slow_ms=None)
+    assert not off.enabled
+    assert off.should_capture(10.0, "error") is False
+
+
+def test_tail_capture_outlives_ring_recycling():
+    clock = Clock()
+    rec = FlightRecorder(capacity=1, slow_ms=50.0, clock=clock)
+    tr = rec.begin("slow-1", x_request_id="xrid-1", traceparent=TP, t0=0.0)
+    clock.t = 0.2  # 200ms: above the 50ms threshold
+    rec.finish(tr, "ok")
+    rec.begin("next", t0=1.0)  # recycles slow-1's ring slot
+    snap = rec.get("slow-1")
+    assert snap is not None, "tail store should keep the slow trace"
+    assert snap["total_seconds"] == 0.2
+    assert rec.get("xrid-1") == snap  # x-request-id lookup hits too
+    assert rec.stats()["tail_captured_total"] == 1
+
+
+def test_tail_store_is_bounded_overwrite_oldest():
+    clock = Clock()
+    rec = FlightRecorder(capacity=8, slow_ms=0.0, tail_capacity=2,
+                         clock=clock)
+    for i in range(4):
+        tr = rec.begin(f"r{i}", t0=float(i))
+        clock.t = i + 1.0
+        rec.finish(tr, "ok")
+    snaps = rec.tail.snapshots()
+    assert len(snaps) == 2
+    assert {s["request_id"] for s in snaps} == {"r2", "r3"}
+
+
+def test_record_dropped_is_terminal_and_captured():
+    clock = Clock()
+    rec = FlightRecorder(capacity=4, slow_ms=1000.0, clock=clock)
+    rec.record_dropped("shed-1", traceparent=TP)
+    d = rec.get("shed-1")
+    assert d["status"] == "shed"
+    assert [p["phase"] for p in d["phases"]] == ["qos_admission"]
+    assert rec.stats()["tail_captured_total"] == 1  # shed => captured
+
+
+def test_phase_histograms_feed_per_phase():
+    clock = Clock()
+    rec = FlightRecorder(capacity=4, clock=clock)
+    tr = rec.begin(1, t0=0.0)
+    clock.t = 0.01
+    tr.mark("prefill")
+    clock.t = 0.03
+    rec.finish(tr, "ok")
+    hists = rec.phase_histograms()
+    assert set(hists) == {"queue_wait", "prefill"}
+    assert hists["queue_wait"]["count"] == 1
+    assert abs(hists["queue_wait"]["sum"] - 0.01) < 1e-12
+    assert abs(hists["prefill"]["sum"] - 0.02) < 1e-12
+
+
+def test_trace_id_parsed_from_traceparent():
+    rec = FlightRecorder(capacity=2)
+    tr = rec.begin(1, traceparent=TP, t0=0.0)
+    assert tr.trace_id == "ab" * 16
+    bad = rec.begin(2, traceparent="garbage", t0=0.0)
+    assert bad.trace_id is None
+    assert bad.traceparent == "garbage"  # kept verbatim for debugging
+
+
+def test_in_flight_snapshot_uses_live_clock():
+    clock = Clock()
+    rec = FlightRecorder(capacity=2, clock=clock)
+    rec.begin(1, t0=0.0)
+    clock.t = 3.0
+    d = rec.get(1)
+    assert d["status"] == "in_flight"
+    assert d["total_seconds"] == 3.0
+
+
+def test_get_coerces_digit_strings():
+    # HTTP path params arrive as strings; engine handoff ids are ints.
+    rec = FlightRecorder(capacity=2)
+    tr = rec.begin(42, t0=0.0)
+    rec.finish(tr, "ok", t_end=1.0)
+    assert rec.get("42")["request_id"] == 42

@@ -235,6 +235,58 @@ def test_cancel_mid_handoff_leaves_no_residue(params):
         dec.close()
 
 
+def test_trace_continuity_across_tiers(params):
+    """One request, one trace: the prefill tier's flight-recorder trace
+    and the decode tier's share the trace_id carried on the KVHandoff
+    frame, the ship/adopt spans land on their own tiers in order, and
+    the decode tier's phase durations telescope to its measured total —
+    the PR's end-to-end acceptance shape, at the engine seam."""
+    tp = "00-" + "5a" * 16 + "-" + "1b" * 8 + "-01"
+    dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode")
+    bridge = Bridge(dec)
+    pre = ServingEngine(CFG, params, **ENGINE_KW, role="prefill",
+                        kv_transfer=bridge)
+    try:
+        out = pre.submit(list(range(1, 40)), 8, request_id=7,
+                         traceparent=tp, x_request_id="cli-7")
+        assert _drain(out) == []  # handed off: tokens stream decode-side
+        toks = _drain(bridge.outs[7])
+        assert len(toks) == 8
+        pt = pre.request_trace(7)
+        dt = dec.request_trace(7)
+        assert pt is not None and dt is not None
+        # Single trace spanning both OS-process stand-ins.
+        assert pt["trace_id"] == dt["trace_id"] == "5a" * 16
+        assert pt["x_request_id"] == "cli-7"
+        assert pre.request_trace("cli-7") == pt
+        # Prefill tier ends at the ship; decode tier starts at adoption.
+        p_phases = [p["phase"] for p in pt["phases"]]
+        d_phases = [p["phase"] for p in dt["phases"]]
+        assert p_phases == ["queue_wait", "prefill", "kv_ship"]
+        assert d_phases == ["queue_wait", "kv_adopt", "decode"]
+        assert pt["status"] == "ok" and dt["status"] == "ok"
+        # Telescoping on both tiers: phase durations sum to the total.
+        for t in (pt, dt):
+            assert abs(
+                sum(p["duration_s"] for p in t["phases"])
+                - t["total_seconds"]
+            ) < 1e-9
+        # Counters attribute to the tier that did the work.
+        assert pt["counters"]["prefill_chunks"] >= 1
+        assert pt["counters"]["kv_payload_bytes"] > 0
+        assert dt["counters"]["kv_payload_bytes"] == \
+            pt["counters"]["kv_payload_bytes"]
+        assert dt["counters"]["decode_steps"] >= 1
+        # Phase histograms land on the role that observed the phase.
+        assert "kv_ship" in pre.recorder.phase_histograms()
+        assert "kv_adopt" in dec.recorder.phase_histograms()
+        pm = prometheus_metrics(pre.stats())
+        assert 'phase="kv_ship",role="prefill"' in pm
+    finally:
+        pre.close()
+        dec.close()
+
+
 def test_role_metrics_render(params):
     dec = ServingEngine(CFG, params, **ENGINE_KW, role="decode")
     bridge = Bridge(dec)

@@ -288,6 +288,85 @@ def test_native_server_spec_flags_and_prometheus(tmp_path):
         log.close()
 
 
+def test_native_server_trace_surfaces(tmp_path):
+    """Per-request tracing over the wire: the server echoes X-Request-ID
+    and Traceparent, serves the flight-recorder trace at
+    /v1/requests/<id>/trace (keyed by the caller's X-Request-ID), keeps
+    the caller's trace_id end to end, and streams a phase_summary chunk
+    before [DONE]. --trace-slow-ms 0 forces tail capture for everything
+    so the lookup can't race ring recycling."""
+    proc, log, port = _boot_server(
+        tmp_path, "--max-new-tokens", "8",
+        "--trace-ring", "64", "--trace-slow-ms", "0",
+    )
+    trace_id = "f0" * 16
+    tp = f"00-{trace_id}-{'1b' * 8}-01"
+
+    def chat(body, rid):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": rid, "traceparent": tp},
+        )
+        return urllib.request.urlopen(req, timeout=120)
+
+    try:
+        # Non-stream: identity echoed on the response, trace retrievable.
+        rid = "trace-test-1"
+        resp = chat({"messages": [{"role": "user", "content": "hi"}]}, rid)
+        assert resp.status == 200
+        assert resp.headers["X-Request-ID"] == rid
+        assert resp.headers["Traceparent"] == tp
+        json.load(resp)
+
+        trace = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/requests/{rid}/trace", timeout=5
+        ))
+        assert trace["x_request_id"] == rid
+        assert trace["trace_id"] == trace_id  # caller's trace, not a new one
+        assert trace["status"] == "ok"
+        phases = [p["phase"] for p in trace["phases"]]
+        assert phases[0] == "queue_wait" and "decode" in phases, phases
+        assert abs(sum(p["duration_s"] for p in trace["phases"])
+                   - trace["total_seconds"]) < 1e-9
+        assert trace["counters"]["decode_steps"] >= 1
+
+        # Unknown id: 404, not a stack trace.
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/requests/nope/trace", timeout=5
+            )
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # Stream: headers echoed on the SSE response and a phase_summary
+        # chunk rides after the tokens, before the [DONE] sentinel.
+        rid2 = "trace-test-2"
+        resp = chat({"messages": [{"role": "user", "content": "go"}],
+                     "stream": True}, rid2)
+        assert resp.status == 200
+        assert resp.headers["X-Request-ID"] == rid2
+        assert resp.headers["Traceparent"] == tp
+        raw = resp.read().decode()
+        chunks = [json.loads(line[len("data: "):])
+                  for line in raw.splitlines()
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        assert raw.rstrip().endswith("data: [DONE]")
+        summaries = [c for c in chunks if "phase_summary" in c]
+        assert len(summaries) == 1
+        ps = summaries[-1]["phase_summary"]
+        assert chunks.index(summaries[0]) == len(chunks) - 1  # last chunk
+        assert ps["trace_id"] == trace_id
+        assert abs(sum(p["duration_s"] for p in ps["phases"])
+                   - ps["total_seconds"]) < 1e-9
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
+
+
 def test_native_server_stop_sequences(tmp_path):
     """The OpenAI `stop` field truncates the output before the stop
     string; greedy decode makes the check deterministic."""

@@ -230,7 +230,8 @@ def test_prometheus_metrics_matches_registry(params):
             if name not in ("dstack_tpu_serving_attn_dispatch_total",
                             "dstack_tpu_serving_ttft_seconds",
                             "dstack_tpu_serving_tpt_seconds",
-                            "dstack_tpu_serving_kv_transfer_seconds"):
+                            "dstack_tpu_serving_kv_transfer_seconds",
+                            "dstack_tpu_serving_phase_seconds"):
                 assert METRICS[name][1] == (), name
             seen.add(name)
         else:
@@ -242,6 +243,10 @@ def test_prometheus_metrics_matches_registry(params):
                 assert name in (
                     base + '{path="pallas"}', base + '{path="lax_ragged"}'
                 ), name
+            if base.startswith("dstack_tpu_serving_phase_seconds"):
+                # r15 flight-recorder histograms: every sample carries
+                # the declared (phase, role) pair.
+                assert 'phase="' in name and 'role="unified"' in name, name
             if METRICS.get(decl, ("", ()))[1] == ("role",):
                 # a unified engine's whole distribution is one role
                 assert 'role="unified"' in name, name
@@ -255,6 +260,10 @@ def test_prometheus_metrics_matches_registry(params):
         assert expected in seen, expected
     # TTFT is a real histogram now: derived series, declared base.
     assert "dstack_tpu_serving_ttft_seconds" in seen
+    # The default-on flight recorder must have fed the phase histograms
+    # for the request served above — silence here would mean the r15
+    # phase clock quietly stopped.
+    assert "dstack_tpu_serving_phase_seconds" in seen
     for derived in ("dstack_tpu_serving_ttft_seconds_bucket",
                     "dstack_tpu_serving_ttft_seconds_sum",
                     "dstack_tpu_serving_ttft_seconds_count"):

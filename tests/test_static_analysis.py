@@ -44,12 +44,14 @@ def test_bad_fixtures_trip_every_checker():
     assert report.errors == []
     assert _codes(report) == [
         "ASY01", "ASY02", "KVB01", "LCK01", "LCK02", "LCK03", "MET01", "POOL01",
-        "SHD01", "SQL01",
+        "SHD01", "SQL01", "TRC01",
     ]
     assert _keys(report, "SHD01") == ["runs"]
     # The whole-table pool gather in workloads/kv_blocks.py.
     assert _keys(report, "KVB01") == ["take:block_tables"]
     assert _keys(report, "POOL01") == ["httpx.AsyncClient"]
+    # The two trace-severing upstream calls in dataplane/trace_bad.py.
+    assert _keys(report, "TRC01") == ["client.post", "client.stream"]
     assert _keys(report, "ASY01") == [".read_text", "requests.get", "time.sleep"]
     assert _keys(report, "ASY02") == ["create_task", "notify"]
     # One from the unguarded write in lock_bad.py, one from the
@@ -207,10 +209,10 @@ def test_cli_json_contract(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert payload["exit_code"] == 1
-    assert payload["files_scanned"] == 9
+    assert payload["files_scanned"] == 10
     assert set(payload["checkers"]) >= {
         "ASY01", "ASY02", "KVB01", "LCK01", "LCK02", "LCK03", "SQL01", "MET01",
-        "POOL01", "SHD01",
+        "POOL01", "SHD01", "TRC01",
     }
     sample = payload["findings"][0]
     assert {"code", "message", "path", "line", "fingerprint"} <= set(sample)
