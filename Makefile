@@ -77,13 +77,16 @@ bench-proxy:
 # disaggregation (prefill-flood decode-isolation) arms, and the r14
 # multi-tenant arms (mixed-adapter LoRA batch vs merged-engine token
 # equality + empty-pool overhead; noisy-neighbor steady-tenant TTFT
-# with QoS on/off/no-flood), and the r15 flight-recorder overhead arm
+# with QoS on/off/no-flood), the r15 flight-recorder overhead arm
 # (recorder-on vs recorder-off, the <2% tracing-always-on claim; run it
-# alone with --arms recorder). Results land in BENCH_serving_r15.json;
-# see docs/guides/serving-tuning.md, docs/guides/multi-tenant.md and
-# docs/guides/observability.md for how to read them.
+# alone with --arms recorder), and the r16 hierarchical-KV overcommit
+# arm (host-RAM spill tier + slot preemption at 4x residency
+# overcommit; run it alone with --arms overcommit). Results land in
+# BENCH_serving_r16.json; see docs/guides/serving-tuning.md,
+# docs/guides/multi-tenant.md and docs/guides/observability.md for how
+# to read them.
 bench-serving:
-	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r15.json
+	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r16.json
 
 # Prefill/decode disaggregation drill: two real worker processes over a
 # 2-way model mesh each, KV handoffs over a socket. Asserts token

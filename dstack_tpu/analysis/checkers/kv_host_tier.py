@@ -1,0 +1,90 @@
+"""KVB02: the host KV tier must hold host arrays, never device arrays.
+
+The whole point of `workloads/kv_host_tier.py` is that spilled KV blocks
+and swapped-out slot payloads leave HBM: its buffers are numpy arrays /
+raw bytes that on a real TPU host would be pinned (page-locked) host
+allocations. Constructing a jax array there (`jnp.asarray`,
+`jax.device_put`, `jnp.zeros`, ...) silently re-materializes the payload
+ON DEVICE — the tier would then "offload" KV into the very HBM it exists
+to relieve, and the overcommit math (host budget vs device pool) becomes
+a lie. This checker bans the jax surface from the module outright: any
+`import jax` / `from jax import ...` and any call that resolves to a
+`jax.*` function is flagged. The device<->host conversion belongs to the
+engine's gather/inject seam in serving.py, not to the tier.
+"""
+
+import ast
+from typing import Iterable
+
+from dstack_tpu.analysis.astutil import call_name, outer_functions
+from dstack_tpu.analysis.core import Checker, Finding, Module
+
+# The file the ban applies to (real tree and test fixtures).
+SCOPE_SUFFIX = "workloads/kv_host_tier.py"
+
+
+def _is_jax(name: str) -> bool:
+    return name == "jax" or name.startswith("jax.")
+
+
+class HostTierChecker(Checker):
+    codes = ("KVB02",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not module.rel.endswith(SCOPE_SUFFIX):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if _is_jax(alias.name):
+                        yield Finding(
+                            code="KVB02",
+                            message=(
+                                f"`import {alias.name}` in the host KV tier:"
+                                " this module must stay device-free — jax"
+                                " arrays here put 'offloaded' KV back in HBM"
+                            ),
+                            rel=module.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            key=f"import:{alias.name}",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level == 0 and _is_jax(mod):
+                    yield Finding(
+                        code="KVB02",
+                        message=(
+                            f"`from {mod} import ...` in the host KV tier:"
+                            " this module must stay device-free — jax"
+                            " arrays here put 'offloaded' KV back in HBM"
+                        ),
+                        rel=module.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        key=f"import:{mod}",
+                    )
+        for qualname, func in outer_functions(module.tree):
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                canon = module.aliases.canonical(name)
+                if not _is_jax(canon):
+                    continue
+                yield Finding(
+                    code="KVB02",
+                    message=(
+                        f"`{name}(...)` resolves to `{canon}` — a device-"
+                        "array construction inside the host KV tier; keep"
+                        " payloads as numpy/bytes and leave device<->host"
+                        " conversion to the engine's gather/inject seam"
+                    ),
+                    rel=module.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    symbol=qualname,
+                    key=f"call:{canon}",
+                )

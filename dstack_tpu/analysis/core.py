@@ -144,6 +144,7 @@ def load_project(paths: Sequence[str], root: Optional[str] = None) -> Tuple[Proj
 def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.async_hygiene import AsyncHygieneChecker
     from dstack_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
+    from dstack_tpu.analysis.checkers.kv_host_tier import HostTierChecker
     from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
     from dstack_tpu.analysis.checkers.multi_replica import MultiReplicaLockChecker
     from dstack_tpu.analysis.checkers.paged_gather import PagedGatherChecker
@@ -161,6 +162,7 @@ def default_checkers() -> List[Checker]:
         SqlChecker(),
         MetricsRegistryChecker(),
         PagedGatherChecker(),
+        HostTierChecker(),
         PoolChecker(),
         ShardScanChecker(),
         TracePropagationChecker(),

@@ -102,6 +102,16 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_kv_handoffs_received_total": ("counter", ()),
     "dstack_tpu_serving_kv_handoffs_sent_total": ("counter", ()),
     "dstack_tpu_serving_kv_handoffs_stale_rejected_total": ("counter", ()),
+    # Hierarchical KV cache (PR 16, workloads/kv_host_tier.py): host-tier
+    # occupancy (spilled blocks + bytes including pinned swapped-slot
+    # payloads), spill/eviction churn, block swap-ins, and the swap-in
+    # latency to weigh against a cold re-prefill of the same prefix.
+    "dstack_tpu_serving_kv_host_blocks": ("gauge", ()),
+    "dstack_tpu_serving_kv_host_bytes": ("gauge", ()),
+    "dstack_tpu_serving_kv_host_evictions_total": ("counter", ()),
+    "dstack_tpu_serving_kv_spills_total": ("counter", ()),
+    "dstack_tpu_serving_kv_swap_in_seconds": ("histogram", ("role",)),
+    "dstack_tpu_serving_kv_swap_ins_total": ("counter", ()),
     "dstack_tpu_serving_kv_transfer_bytes_total": ("counter", ()),
     "dstack_tpu_serving_kv_transfer_queue_depth": ("gauge", ()),
     "dstack_tpu_serving_kv_transfer_seconds": ("histogram", ("role",)),
@@ -112,11 +122,21 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_serving_phase_seconds": ("histogram", ("phase", "role")),
     "dstack_tpu_serving_prefill_chunks_total": ("counter", ()),
     "dstack_tpu_serving_prefill_tokens_total": ("counter", ()),
+    # Tiered prefix-cache hit split: device hits served straight from the
+    # pool, host hits resurrected from the spill tier (each also counts a
+    # kv_swap_in). hits_total stays as the sum for dashboard continuity.
+    "dstack_tpu_serving_prefix_cache_device_hits_total": ("counter", ()),
     "dstack_tpu_serving_prefix_cache_hits_total": ("counter", ()),
+    "dstack_tpu_serving_prefix_cache_host_hits_total": ("counter", ()),
     "dstack_tpu_serving_prefix_cache_misses_total": ("counter", ()),
     "dstack_tpu_serving_prefix_tokens_reused_total": ("counter", ()),
     "dstack_tpu_serving_rejected_total": ("counter", ()),
+    # Slot preemption under overcommit: currently-swapped-out slots, how
+    # many preemptions have fired, and how many slots were readmitted.
+    "dstack_tpu_serving_slot_preemptions_total": ("counter", ()),
+    "dstack_tpu_serving_slot_swap_ins_total": ("counter", ()),
     "dstack_tpu_serving_slots_active": ("gauge", ()),
+    "dstack_tpu_serving_slots_swapped": ("gauge", ()),
     # Speculative decoding (PR 10): draft/verify wall time, token fate
     # counters, and the acceptance signals behind adaptive draft length.
     "dstack_tpu_serving_spec_accept_rate_ewma": ("gauge", ()),

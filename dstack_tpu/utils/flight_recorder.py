@@ -45,6 +45,8 @@ PHASES = (
     "prefill",          # admission -> first token finalized
     "kv_ship",          # prefill role: gather + wire + decode-side ack
     "kv_adopt",         # decode role: pop -> payload scattered into pool
+    "kv_swap_out",      # preemption: chain gathered + parked host-side
+    "kv_swap_in",       # readmission: chain scattered back into the pool
     "decode",           # first token delivered -> last token
     "proxy",            # dataplane worker: ingress -> upstream headers
 )

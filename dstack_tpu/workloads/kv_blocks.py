@@ -143,21 +143,37 @@ class BlockAllocator:
     NEVER share a prefix block — an adapter changes the KV contents, and
     a cross-tenant hit would serve tenant A's attention over tenant B's
     cache (poisoning). Same-namespace re-runs still hit normally.
+
+    Host tier (optional): `spill(key, block)` is called at the eviction
+    seam in `alloc()` while the victim block's device contents are still
+    intact, so the owner can ship the KV payload to host memory before
+    the block is recycled. `swap_in(key) -> Optional[block]` is called
+    on a cache miss in `match()`: the owner pulls the payload back from
+    the host tier into a freshly allocated device block and returns it
+    (with ref=1, which becomes the cache's hold), or None when the
+    payload isn't spilled / no device block frees up. Both hooks may
+    reenter `alloc()` (a swap-in can itself trigger a spill); they never
+    reenter `match()`.
     """
 
-    def __init__(self, num_blocks: int, block_size: int, cache: bool = True):
+    def __init__(self, num_blocks: int, block_size: int, cache: bool = True,
+                 spill=None, swap_in=None):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.cache_enabled = cache
+        self._spill = spill
+        self._swap_in = swap_in
         self._free: List[int] = list(range(num_blocks))
         self._ref = [0] * num_blocks
         self._cache: "OrderedDict[tuple, int]" = OrderedDict()
         self._block_key: Dict[int, tuple] = {}
         self.hits = 0
         self.misses = 0
+        self.host_hits = 0       # matches that pulled >=1 block from host
         self.tokens_reused = 0
         self.cow_copies = 0
         self.evictions = 0
+        self._last_lookup_swapped = False
 
     @property
     def in_use(self) -> int:
@@ -181,6 +197,10 @@ class BlockAllocator:
             b = self._cache.pop(victim)
             del self._block_key[b]
             self.evictions += 1
+            if self._spill is not None:
+                # Device contents are still intact here — nothing has
+                # written to block b since the cache published it.
+                self._spill(victim, b)
             self._ref[b] -= 1
             self._free.append(b)
         b = self._free.pop()
@@ -225,31 +245,54 @@ class BlockAllocator:
         blocks: List[int] = []
         h = self._ns_seed(namespace)
         matched = 0
+        swapped_in = False
         while (len(blocks) + 1) * bs <= limit:
             h2 = _chain_hash(h, tokens[matched:matched + bs])
-            b = self._cache.get(("F", h2))
+            b = self._lookup(("F", h2))
             if b is None:
                 break
-            self._cache.move_to_end(("F", h2))
+            swapped_in = swapped_in or self._last_lookup_swapped
             self._ref[b] += 1
             blocks.append(b)
             matched += bs
             h = h2
         for f in range(min(limit - matched, bs - 1), 0, -1):
             key = ("P", h, tuple(tokens[matched:matched + f]))
-            b = self._cache.get(key)
+            b = self._lookup(key)
             if b is not None:
-                self._cache.move_to_end(key)
+                swapped_in = swapped_in or self._last_lookup_swapped
                 self._ref[b] += 1
                 blocks.append(b)
                 matched += f
                 break
         if matched:
             self.hits += 1
+            if swapped_in:
+                self.host_hits += 1
         else:
             self.misses += 1
         self.tokens_reused += matched
         return blocks, matched
+
+    def _lookup(self, key: tuple) -> Optional[int]:
+        """Cache probe with host-tier fallback: a device hit bumps LRU;
+        a miss asks `swap_in` to resurrect the block from host memory
+        and republishes it under `key` (the swap-in's ref=1 becomes the
+        cache's hold)."""
+        self._last_lookup_swapped = False
+        b = self._cache.get(key)
+        if b is not None:
+            self._cache.move_to_end(key)
+            return b
+        if self._swap_in is None:
+            return None
+        b = self._swap_in(key)
+        if b is None:
+            return None
+        self._cache[key] = b
+        self._block_key[b] = key
+        self._last_lookup_swapped = True
+        return b
 
     @staticmethod
     def _ns_seed(namespace: bytes) -> bytes:
@@ -316,6 +359,7 @@ class BlockAllocator:
             "blocks_cached": self.cached,
             "hits": self.hits,
             "misses": self.misses,
+            "host_hits": self.host_hits,
             "tokens_reused": self.tokens_reused,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,

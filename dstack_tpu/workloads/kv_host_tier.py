@@ -1,0 +1,154 @@
+"""Host-memory KV block tier: the spill target behind `BlockAllocator`.
+
+When the device pool runs dry, the allocator's LRU eviction used to
+destroy the victim's prefix-cache entry — a later request with the same
+prefix re-prefilled from scratch. With a host tier attached, the evicted
+block's KV payload ships to host RAM instead (same array-manifest frames
+as `kv_transfer.py`, minus the socket) and its chain key stays
+matchable: a prefix hit on a spilled key swaps the block back onto the
+device, which beats re-prefill whenever PCIe/DMA bandwidth beats a
+prefill chunk through the model.
+
+The tier also pins whole swapped-out SLOTS for engine preemption: a
+preempted request's live block chain (KV + sampling state) parks here
+until readmission. Pinned bytes are reserved capacity — spilled
+prefix-cache entries are best-effort LRU and may be dropped to make
+room, but a pinned slot is never evicted (dropping it would corrupt a
+live request), so `reserve` refuses when spill eviction can't free
+enough.
+
+IMPORTANT: every buffer in this module lives in host memory. On a real
+TPU host these would be pinned (page-locked) allocations for DMA; here
+they are plain numpy arrays / bytes. Constructing device arrays (jax /
+jax.numpy) in this module defeats the entire point — the KVB02 static
+checker enforces that.
+"""
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .kv_transfer import pack_arrays, unpack_arrays
+
+
+class HostKVTier:
+    """Budgeted LRU store of spilled KV blocks, keyed by allocator
+    prefix-cache chain keys, plus a reservation ledger for pinned
+    swapped-slot payloads. Not thread-safe on its own: every call site
+    is the engine loop thread under the engine lock (or a test)."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("host tier budget must be positive")
+        self.budget_bytes = int(budget_bytes)
+        # key -> (manifest, buffers, nbytes); insertion order is LRU.
+        self._spilled: "OrderedDict[Any, Tuple[List, Tuple[bytes, ...], int]]" = (
+            OrderedDict()
+        )
+        self.spill_bytes = 0
+        self.pinned_bytes = 0
+        self.spills_total = 0        # blocks accepted into the tier
+        self.swap_ins_total = 0      # blocks pulled back to device
+        self.evictions_total = 0     # spilled blocks LRU-dropped
+        self.dropped_total = 0       # put() refused (payload over budget)
+
+    # -- spilled prefix-cache blocks -------------------------------------
+
+    def _evict_lru(self) -> bool:
+        if not self._spilled:
+            return False
+        _, (_, _, nbytes) = self._spilled.popitem(last=False)
+        self.spill_bytes -= nbytes
+        self.evictions_total += 1
+        return True
+
+    def _make_room(self, nbytes: int) -> bool:
+        while self.spill_bytes + self.pinned_bytes + nbytes > self.budget_bytes:
+            if not self._evict_lru():
+                return False
+        return True
+
+    def put(self, key: Any, named: List[Tuple[str, np.ndarray]]) -> bool:
+        """Spill one block's arrays under `key`. Returns False (and
+        counts a drop) when the payload can't fit even after evicting
+        every unpinned entry; the block then just dies, as it did
+        before the tier existed."""
+        manifest, buffers = pack_arrays(named)
+        nbytes = sum(len(b) for b in buffers)
+        if key in self._spilled:
+            self._drop(key)
+        if not self._make_room(nbytes):
+            self.dropped_total += 1
+            return False
+        self._spilled[key] = (manifest, buffers, nbytes)
+        self.spill_bytes += nbytes
+        self.spills_total += 1
+        return True
+
+    def has(self, key: Any) -> bool:
+        return key in self._spilled
+
+    def get(self, key: Any) -> Optional[Dict[str, np.ndarray]]:
+        """Peek a spilled payload (marks it most-recently-used). The
+        entry stays in the tier until `pop` — a swap-in that fails to
+        find a device block must not lose the data."""
+        entry = self._spilled.get(key)
+        if entry is None:
+            return None
+        self._spilled.move_to_end(key)
+        manifest, buffers, _ = entry
+        return unpack_arrays(manifest, buffers)
+
+    def pop(self, key: Any) -> None:
+        """Drop a spilled entry after a successful swap-in."""
+        if self._drop(key):
+            self.swap_ins_total += 1
+
+    def discard(self, key: Any) -> None:
+        """Drop a spilled entry without counting a swap-in (the device
+        copy was invalidated, e.g. the allocator recycled the key)."""
+        self._drop(key)
+
+    def _drop(self, key: Any) -> bool:
+        entry = self._spilled.pop(key, None)
+        if entry is None:
+            return False
+        self.spill_bytes -= entry[2]
+        return True
+
+    # -- pinned swapped-slot payloads ------------------------------------
+
+    def reserve(self, nbytes: int) -> bool:
+        """Claim `nbytes` of pinned capacity for a swapped-out slot,
+        evicting spilled entries to make room. Refuses (False) when the
+        budget can't cover it — the caller must then fall back to
+        retiring the slot instead of preempting it."""
+        nbytes = int(nbytes)
+        if not self._make_room(nbytes):
+            return False
+        self.pinned_bytes += nbytes
+        return True
+
+    def unreserve(self, nbytes: int) -> None:
+        self.pinned_bytes -= int(nbytes)
+        if self.pinned_bytes < 0:
+            raise AssertionError("host tier pinned bytes went negative")
+
+    # -- observability ---------------------------------------------------
+
+    @property
+    def blocks(self) -> int:
+        return len(self._spilled)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "blocks": len(self._spilled),
+            "spill_bytes": self.spill_bytes,
+            "pinned_bytes": self.pinned_bytes,
+            "spills_total": self.spills_total,
+            "swap_ins_total": self.swap_ins_total,
+            "evictions_total": self.evictions_total,
+            "dropped_total": self.dropped_total,
+        }

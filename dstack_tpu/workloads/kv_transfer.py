@@ -86,6 +86,55 @@ class StaleEpochError(RuntimeError):
         self.current = current
 
 
+# -- array manifests ----------------------------------------------------------
+#
+# The manifest (name / shape / dtype) plus contiguous raw bytes is the
+# ship format for KV payloads everywhere, not just on the socket: the
+# host-memory offload tier (kv_host_tier.py) stores spilled blocks as
+# exactly these frames, minus the length prefix and the TCP stream.
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a manifest dtype string. `bfloat16` only parses once
+    ml_dtypes has registered it with numpy — jax does that on import,
+    but the pack/unpack helpers must work without jax in the process."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        if name == "bfloat16":
+            import ml_dtypes  # registers the dtype with numpy
+
+            return np.dtype(ml_dtypes.bfloat16)
+        raise
+
+
+def pack_arrays(
+    named: List[Tuple[str, np.ndarray]],
+) -> Tuple[List[Dict[str, Any]], Tuple[bytes, ...]]:
+    """Arrays -> (manifest, raw buffers) in manifest order. The inverse
+    of `unpack_arrays`; `send_msg` puts the same buffers on the wire."""
+    manifest = [
+        {"name": name, "shape": list(a.shape), "dtype": str(a.dtype)}
+        for name, a in named
+    ]
+    buffers = tuple(np.ascontiguousarray(a).tobytes() for _, a in named)
+    return manifest, buffers
+
+
+def unpack_arrays(
+    manifest: List[Dict[str, Any]], buffers: Tuple[bytes, ...],
+) -> Dict[str, np.ndarray]:
+    """(manifest, raw buffers) -> arrays by name. Zero-copy views over
+    the buffers, so the result is read-only; callers that mutate copy."""
+    out: Dict[str, np.ndarray] = {}
+    for spec, raw in zip(manifest, buffers):
+        shape = tuple(int(d) for d in spec["shape"])
+        out[spec["name"]] = np.frombuffer(
+            raw, _np_dtype(spec["dtype"])
+        ).reshape(shape)
+    return out
+
+
 # -- framing ------------------------------------------------------------------
 
 
@@ -118,33 +167,27 @@ def recv_msg(sock: socket.socket) -> Dict[str, Any]:
     if n > MAX_MSG_BYTES:
         raise ConnectionError(f"kv_transfer header length {n} over limit")
     header = json.loads(_read_exact(sock, n).decode())
-    arrays = []
-    for spec in header.get("arrays", ()):
+    manifest = header.get("arrays", ())
+    buffers = []
+    for spec in manifest:
         shape = tuple(int(d) for d in spec["shape"])
-        dtype = np.dtype(spec["dtype"])
+        dtype = _np_dtype(spec["dtype"])
         nbytes = int(np.prod(shape)) * dtype.itemsize
         if nbytes > MAX_MSG_BYTES:
             raise ConnectionError(
                 f"kv_transfer array {spec.get('name')} length over limit"
             )
-        arrays.append(
-            np.frombuffer(_read_exact(sock, nbytes), dtype).reshape(shape)
-        )
-    header["_arrays"] = arrays
+        buffers.append(_read_exact(sock, nbytes))
+    by_name = unpack_arrays(manifest, tuple(buffers))
+    header["_arrays"] = [by_name[spec["name"]] for spec in manifest]
     return header
-
-
-def _manifest(named: List[Tuple[str, np.ndarray]]) -> List[Dict[str, Any]]:
-    return [
-        {"name": name, "shape": list(a.shape), "dtype": str(a.dtype)}
-        for name, a in named
-    ]
 
 
 def pack_handoff(h: KVHandoff) -> Tuple[Dict[str, Any], Tuple[np.ndarray, ...]]:
     named: List[Tuple[str, np.ndarray]] = [("k", h.k), ("v", h.v)]
     if h.draft_k is not None:
         named += [("draft_k", h.draft_k), ("draft_v", h.draft_v)]
+    manifest, _ = pack_arrays(named)
     header = {
         "kind": "handoff",
         "request_id": h.request_id,
@@ -154,7 +197,7 @@ def pack_handoff(h: KVHandoff) -> Tuple[Dict[str, Any], Tuple[np.ndarray, ...]]:
         "max_new_tokens": int(h.max_new_tokens),
         "temperature": float(h.temperature),
         "top_p": float(h.top_p),
-        "arrays": _manifest(named),
+        "arrays": manifest,
     }
     if h.traceparent is not None:
         header["traceparent"] = h.traceparent

@@ -72,6 +72,7 @@ from dstack_tpu.workloads.kv_blocks import (
     make_spec_draft,
     make_spec_verify,
 )
+from dstack_tpu.workloads.kv_host_tier import HostKVTier
 from dstack_tpu.workloads.kv_transfer import KVHandoff, StaleEpochError
 from dstack_tpu.workloads.paged_attention import (
     dispatch_path as attn_dispatch_path,
@@ -381,6 +382,37 @@ class _Request(NamedTuple):
     # construct _Request positionally.
     traceparent: Optional[str] = None
     trace: Optional[Any] = None
+    # QoS identity: keys the engine's qos_weights map (same weights the
+    # dataplane DRR scheduler uses), deciding who preempts whom when the
+    # host tier lets admitted streams overcommit residency. None = the
+    # default weight (1.0).
+    tenant: Optional[str] = None
+
+
+class _SwappedSlot:
+    """A preempted request parked in host memory: the gathered KV of its
+    whole block chain (target + drafter pools) plus the device sampling
+    scalars at the chunk boundary — everything readmission needs to
+    resume decode bit-exactly at temperature 0. The request's adapter
+    ref is NOT released across the swap (the registry hold must outlive
+    the preemption or the adapter could be evicted under it); `nbytes`
+    is pinned in the HostKVTier budget until readmission or a terminal
+    path unreserves it."""
+
+    __slots__ = ("req", "length", "last_token", "remaining", "arrays",
+                 "nbytes", "t_swap", "t0")
+
+    def __init__(self, req: _Request, length: int, last_token: int,
+                 remaining: int, arrays: Dict[str, np.ndarray],
+                 nbytes: int, t_swap: float, t0: float):
+        self.req = req
+        self.length = length          # filled cache positions at swap
+        self.last_token = last_token  # next token to feed
+        self.remaining = remaining    # decode budget left
+        self.arrays = arrays          # k/v (+draft_k/draft_v), (L,n,bs,KV,hd)
+        self.nbytes = nbytes          # reserved against the host budget
+        self.t_swap = t_swap
+        self.t0 = t0                  # original slot admission time
 
 
 class _PrefillTask:
@@ -448,6 +480,9 @@ class ServingEngine:
         lora_targets: Optional[Tuple[str, ...]] = None,
         trace_ring: int = 256,
         trace_slow_ms: Optional[float] = None,
+        kv_host_budget_bytes: Optional[int] = None,
+        max_resident_slots: Optional[int] = None,
+        qos_weights: Optional[Dict[str, float]] = None,
     ):
         self.config = config
         self.params = params
@@ -500,8 +535,51 @@ class ServingEngine:
                 f"kv_pool_blocks {self._num_blocks} must fit one max_len"
                 f" request ({self._max_blocks} blocks)"
             )
+        # -- hierarchical KV: host-memory tier + slot preemption ----------
+        # With a host budget, LRU-evicted prefix-cache blocks spill to
+        # host RAM instead of dying (a later prefix hit swaps them back
+        # in — cheaper than re-prefill), and whole slots can swap out
+        # under pressure or QoS preemption. Off (None/0) the engine is
+        # byte-for-byte the pre-tier engine.
+        self._host_tier: Optional[HostKVTier] = None
+        if kv_host_budget_bytes:
+            self._host_tier = HostKVTier(kv_host_budget_bytes)
+        if max_resident_slots is None:
+            self._max_resident = slots
+        else:
+            if not (1 <= max_resident_slots <= slots):
+                raise ValueError(
+                    f"max_resident_slots {max_resident_slots} must be in"
+                    f" [1, slots={slots}]"
+                )
+            if max_resident_slots < slots and self._host_tier is None:
+                raise ValueError(
+                    "max_resident_slots < slots requires a host tier to"
+                    " park swapped slots in (set kv_host_budget_bytes)"
+                )
+            self._max_resident = max_resident_slots
+        self._qos_weights: Dict[str, float] = dict(qos_weights or {})
+        # Preempted requests parked in the host tier, readmitted
+        # highest-weight-first at admission boundaries. Guarded by _lock.
+        self._swapped: List[_SwappedSlot] = []
+        # One-slot peek buffer for the pending queue's head: a request
+        # popped for admission that found no free slot (and could not
+        # queue-jump) waits here instead of being re-queued behind
+        # later arrivals. Loop thread only, but counted by submit()'s
+        # backlog accounting under _lock.
+        self._next_req: Optional[_Request] = None
+        # Out-queues whose live slot should be preempted at the next
+        # boundary (the preempt() API; guarded by _lock).
+        self._preempt_requests: set = set()
+        self._preemptions = 0       # slots swapped out, monotonic
+        self._slot_swap_ins = 0     # slots swapped back in, monotonic
+        self._swap_in_hist = HistogramData()
         self._alloc = BlockAllocator(
-            self._num_blocks, kv_block_size, cache=prefix_cache
+            self._num_blocks, kv_block_size, cache=prefix_cache,
+            spill=(self._spill_block if self._host_tier is not None
+                   else None),
+            swap_in=(self._swap_in_block if self._host_tier is not None
+                     else None),
         )
         self.prefill_chunk_tokens = prefill_chunk_tokens
         self._chunk_cache: Dict[int, Any] = {}
@@ -826,7 +904,7 @@ class ServingEngine:
         self._last_chunk_s = 0.0
         self._gather_fns: Dict[int, Any] = {}
         self._inject_fns: Dict[Tuple[int, bool], Any] = {}
-        self._activate_prefilled_fn: Optional[Any] = None
+        self._place_slot_fn: Optional[Any] = None
         self._deliver_thread = threading.Thread(
             target=self._deliver_loop, daemon=True
         )
@@ -851,6 +929,7 @@ class ServingEngine:
         traceparent: Optional[str] = None,
         x_request_id: Optional[str] = None,
         t_arrival: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> "queue.Queue[object]":
         """Enqueue a request; returns its output queue (see _Request.out
         for the token/None/Exception protocol). `temperature` (0 =
@@ -864,7 +943,12 @@ class ServingEngine:
         `traceparent`/`x_request_id` thread the caller's trace identity
         into the flight recorder (and onto the KV handoff for split
         requests); `t_arrival` backdates the timeline to HTTP arrival so
-        server-side admission (QoS gate) shows up as its own phase."""
+        server-side admission (QoS gate) shows up as its own phase.
+
+        `tenant` keys the engine's qos_weights map: on a host-tier
+        engine a heavier tenant's request may preempt a lighter one's
+        live slot (swap-out to host, resume later) instead of queueing
+        behind it."""
         if not tokens:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -921,7 +1005,7 @@ class ServingEngine:
                 raise RuntimeError(f"serving engine failed: {self._failed}")
             if self._stop:
                 raise RuntimeError("serving engine is closed")
-            depth = self._pending.qsize()
+            depth = self._pending.qsize() + (self._next_req is not None)
             # Shed on the WAITING backlog, not raw queue depth: a request
             # that will land in a currently-free slot is not overload
             # (and max_pending=0 then means "serve, never queue" instead
@@ -955,7 +1039,8 @@ class ServingEngine:
             self._pending.put(
                 _Request(list(tokens), max_new_tokens, out,
                          float(temperature), float(top_p), time.monotonic(),
-                         request_id, adapter, adapter_ix, traceparent, rec)
+                         request_id, adapter, adapter_ix, traceparent, rec,
+                         tenant)
             )
             self._inflight.add(out)
         self._wake.set()
@@ -1003,7 +1088,44 @@ class ServingEngine:
                 self.recorder.finish(found.trace, "cancelled")
                 out.put(None)
                 return
+            # Swapped-out slot (cancel mid-swap): purge the parked
+            # payload and unpin its host bytes right here — zero residue
+            # on the host tier is the same invariant as zero device
+            # blocks for a retired slot.
+            for i, sw in enumerate(self._swapped):
+                if sw.req.out is out:
+                    self._swapped.pop(i)
+                    if self._host_tier is not None:
+                        self._host_tier.unreserve(sw.nbytes)
+                    self._inflight.discard(out)
+                    self._release_adapter(out)
+                    self.recorder.finish(sw.req.trace, "cancelled")
+                    out.put(None)
+                    return
+            if self._next_req is not None and self._next_req.out is out:
+                req = self._next_req
+                self._next_req = None
+                self._inflight.discard(out)
+                self._release_adapter(out)
+                self.recorder.finish(req.trace, "cancelled")
+                out.put(None)
+                return
             self._cancelled.add(out)
+        self._wake.set()
+
+    def preempt(self, out: "queue.Queue[object]") -> None:
+        """Ask the engine to preempt the LIVE request whose submit()
+        returned `out` at the next chunk boundary: its block chain swaps
+        out to the host tier and the request readmits later (resuming
+        bit-exact at temperature 0). Advisory — a request that is not
+        live, an engine without a host tier, or a host budget that can't
+        pin the payload leaves the request running. Safe from any
+        thread; idempotent."""
+        if self._host_tier is None:
+            return
+        with self._lock:
+            if out in self._inflight:
+                self._preempt_requests.add(out)
         self._wake.set()
 
     # -- multi-tenant adapters ----------------------------------------------
@@ -1062,10 +1184,13 @@ class ServingEngine:
         compute saved by sharing)."""
         busy = self._t_decode + self._t_prefill + self._t_idle
         a = self._alloc
+        tier = (
+            self._host_tier.stats() if self._host_tier is not None else {}
+        )
         return {
             "slots": self.slots,
             "active": sum(r is not None for r in self._live),
-            "pending": self._pending.qsize(),
+            "pending": self._pending.qsize() + (self._next_req is not None),
             "max_pending": self.max_pending,
             "rejected_total": self.rejected,
             "chunk_seconds_ewma": round(self._chunk_s, 4),
@@ -1079,9 +1204,33 @@ class ServingEngine:
             "kv_blocks_cached": a.cached,
             "prefix_cache_hits_total": a.hits,
             "prefix_cache_misses_total": a.misses,
+            # Hit-tier split: a "host hit" is a prefix match that pulled
+            # at least one block back from the host tier (swap-in); the
+            # remainder of `hits` served entirely from device blocks.
+            # device + host + misses partitions every match() probe.
+            "prefix_cache_device_hits_total": a.hits - a.host_hits,
+            "prefix_cache_host_hits_total": a.host_hits,
             "prefix_tokens_reused_total": a.tokens_reused,
             "kv_cow_copies_total": a.cow_copies,
             "kv_block_evictions_total": a.evictions,
+            # Hierarchical KV: host-tier occupancy + flow counters (all
+            # zero without kv_host_budget_bytes) and the slot-preemption
+            # view — swapped slots are admitted streams NOT currently
+            # resident in HBM, the overcommit the tier buys.
+            "kv_host_enabled": self._host_tier is not None,
+            "kv_host_budget_bytes": tier.get("budget_bytes", 0),
+            "kv_host_blocks": tier.get("blocks", 0),
+            "kv_host_bytes": (
+                tier.get("spill_bytes", 0) + tier.get("pinned_bytes", 0)
+            ),
+            "kv_spills_total": tier.get("spills_total", 0),
+            "kv_host_evictions_total": tier.get("evictions_total", 0),
+            "kv_swap_ins_total": tier.get("swap_ins_total", 0),
+            "max_resident_slots": self._max_resident,
+            "slots_swapped": len(self._swapped),
+            "slot_preemptions_total": self._preemptions,
+            "slot_swap_ins_total": self._slot_swap_ins,
+            "swap_in_hist": self._swap_in_hist.to_dict(),
             "prefill_chunks_total": self._prefill_chunks,
             "prefill_tokens_computed_total": self._prefill_tokens_computed,
             "ttft_seconds_ewma": round(self._ttft_s, 4),
@@ -1214,6 +1363,19 @@ class ServingEngine:
             self._admitting.clear()
             self._tasks.clear()
             self._pending_activation.clear()
+            # Swapped-out slots and the admission peek buffer hold
+            # consumers too (their requests are neither pending nor live).
+            for sw in self._swapped:
+                self.recorder.finish(sw.req.trace, "error")
+                sw.req.out.put(sentinel)
+                if self._host_tier is not None:
+                    self._host_tier.unreserve(sw.nbytes)
+            self._swapped.clear()
+            self._preempt_requests.clear()
+            if self._next_req is not None:
+                self.recorder.finish(self._next_req.trace, "error")
+                self._next_req.out.put(sentinel)
+                self._next_req = None
             # Handoffs queued but not yet admitted (decode role): their
             # consumers are waiting on the stream too.
             for _h, h_out, _t, h_rec in self._prefilled_pending:
@@ -1354,24 +1516,53 @@ class ServingEngine:
         # Admit new requests into the task window.
         while len(self._tasks) < self.max_prefills_per_chunk:
             busy = {t.slot for t in self._tasks}
-            free = [s for s in range(self.slots)
-                    if self._live[s] is None and s not in busy]
-            if not free:
-                break
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                break
             with self._lock:
-                if req.out in self._cancelled:
+                req = self._next_req
+                self._next_req = None
+            if req is None:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+            with self._lock:
+                dead = req.out in self._cancelled
+                if dead:
                     # abandoned while queued: never occupy a slot
                     self._cancelled.discard(req.out)
                     self._inflight.discard(req.out)
                     self._release_adapter(req.out)
-                    self.recorder.finish(req.trace, "cancelled")
-                    req.out.put(None)
+            if dead:
+                self.recorder.finish(req.trace, "cancelled")
+                req.out.put(None)
+                progressed = True
+                continue
+
+            def _room():
+                # Residency cap: a prefilling task goes live the moment
+                # it finalizes, so it counts against max_resident_slots
+                # now. Swapped-out slots deliberately do NOT count —
+                # their KV lives host-side.
+                live_n = sum(r is not None for r in self._live)
+                if live_n + len(busy) >= self._max_resident:
+                    return []
+                return [s for s in range(self.slots)
+                        if self._live[s] is None and s not in busy]
+
+            free = _room()
+            if not free:
+                # Every resident slot taken: a heavier tenant may
+                # queue-jump by swapping the lightest live slot out
+                # (freeing both the slot and its residency); otherwise
+                # the head request parks in the peek buffer (still
+                # counted as backlog) until a slot frees.
+                if self._try_queue_jump(req):
                     progressed = True
-                    continue
+                    free = _room()
+                if not free:
+                    with self._lock:
+                        self._next_req = req
+                    break
+            with self._lock:
                 self._admitting.append(req)
                 blocks, matched = self._alloc.match(
                     req.tokens, namespace=(req.adapter or "").encode()
@@ -1859,51 +2050,63 @@ class ServingEngine:
         # stays exact (correctness never depends on the drafter), the
         # acceptance EWMA just sinks and fallback bounds the perf loss.
 
-    def _activate_prefilled(self, slot: int, table: List[int], length: int,
-                            first: int, h: KVHandoff) -> None:
-        """Device half of handoff admission: the state update the final
-        prefill chunk would have applied had it run here — table row,
-        cache length, the prefill-sampled first token as last_token, the
-        remaining decode budget, and the request's sampling params."""
-        fn = self._activate_prefilled_fn
+    def _place_slot(self, slot: int, table: List[int], length: int,
+                    last_token: int, remaining: int, temperature: float,
+                    top_p: float, adapter_ix: int) -> None:
+        """Device half of placing externally-prepared KV into a slot:
+        the state update the final prefill chunk would have applied had
+        it run here — table row, cache length, next token to feed, the
+        remaining decode budget, sampling params, adapter identity.
+        Shared by handoff admission (_activate_prefilled) and swapped-
+        slot readmission (_readmit_swapped), so a resumed request steps
+        through exactly the state an uninterrupted run would hold."""
+        fn = self._place_slot_fn
         if fn is None:
-            def _activate(state, slot, row, length, first, budget, temp,
-                          top_p):
+            def _place(state, slot, row, length, last, budget, temp,
+                       top_p, aix):
                 sel = (jnp.arange(state.lengths.shape[0], dtype=jnp.int32)
                        == slot)
                 return state._replace(
                     block_tables=state.block_tables.at[slot].set(row),
                     lengths=jnp.where(sel, length, state.lengths),
-                    last_token=jnp.where(sel, first, state.last_token),
+                    last_token=jnp.where(sel, last, state.last_token),
                     active=jnp.where(sel, budget > 0, state.active),
                     remaining=jnp.where(sel, budget, state.remaining),
                     temperature=jnp.where(sel, temp, state.temperature),
                     top_p=jnp.where(sel, top_p, state.top_p),
-                    # Handoffs never carry adapter identity (LoRA engines
-                    # must be role="unified"): clear any stale slot value.
-                    adapter_ix=jnp.where(
-                        sel, jnp.int32(-1), state.adapter_ix
-                    ),
+                    adapter_ix=jnp.where(sel, aix, state.adapter_ix),
                 )
 
             kw: Dict[str, Any] = {}
             if self._shardings is not None:
                 kw = dict(
                     in_shardings=(self._shardings.state,)
-                    + (self._shardings.replicated,) * 7,
+                    + (self._shardings.replicated,) * 8,
                     out_shardings=self._shardings.state,
                 )
-            fn = jax.jit(_activate, donate_argnums=0, **kw)
-            self._activate_prefilled_fn = fn
+            fn = jax.jit(_place, donate_argnums=0, **kw)
+            self._place_slot_fn = fn
         self.state = fn(
             self.state,
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(self._pad_table(table), jnp.int32),
             jnp.asarray(length, jnp.int32),
-            jnp.asarray(first, jnp.int32),
-            jnp.asarray(h.max_new_tokens - 1, jnp.int32),
-            jnp.asarray(h.temperature, jnp.float32),
-            jnp.asarray(h.top_p, jnp.float32),
+            jnp.asarray(last_token, jnp.int32),
+            jnp.asarray(remaining, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(adapter_ix, jnp.int32),
+        )
+
+    def _activate_prefilled(self, slot: int, table: List[int], length: int,
+                            first: int, h: KVHandoff) -> None:
+        """Handoff flavor of _place_slot: the prefill-sampled first
+        token becomes last_token, the budget drops by the token already
+        delivered, and adapter identity clears (handoffs never carry it
+        — LoRA engines must be role='unified')."""
+        self._place_slot(
+            slot, table, length, first, h.max_new_tokens - 1,
+            h.temperature, h.top_p, -1,
         )
 
     def _admit_prefilled(self) -> bool:
@@ -1932,9 +2135,10 @@ class ServingEngine:
                 progressed = True
                 continue
             busy = {t.slot for t in self._tasks}
+            live_n = sum(r is not None for r in self._live)
             free = [s for s in range(self.slots)
                     if self._live[s] is None and s not in busy]
-            if not free:
+            if not free or live_n + len(busy) >= self._max_resident:
                 return progressed
             n = h.n_blocks
             with self._lock:
@@ -2011,6 +2215,244 @@ class ServingEngine:
                     auto_stage("first_token")
             progressed = True
 
+    # -- hierarchical KV: host tier + slot preemption -------------------------
+
+    def _weight(self, req: _Request) -> float:
+        """QoS weight for preemption decisions — the same weights map
+        the dataplane DRR scheduler uses (unknown tenants weigh 1.0)."""
+        return float(self._qos_weights.get(req.tenant, 1.0))
+
+    def _gather_chain(self, table: List[int]) -> Dict[str, np.ndarray]:
+        """Device->host ship of a block chain: gathered per block out
+        of the pool(s) and read back as numpy — the same array frames
+        kv_transfer puts on the socket, minus the socket."""
+        n = len(table)
+        n_pad = 1 << max(0, (n - 1).bit_length())
+        ids = jnp.asarray(
+            table + [self._num_blocks] * (n_pad - n), jnp.int32
+        )
+        fn = self._gather_blocks_fn(n_pad)
+        out = {
+            "k": np.asarray(jax.device_get(fn(self.state.k, ids)))[:, :n],
+            "v": np.asarray(jax.device_get(fn(self.state.v, ids)))[:, :n],
+        }
+        if self._spec:
+            out["draft_k"] = np.asarray(
+                jax.device_get(fn(self._draft_state.k, ids))
+            )[:, :n]
+            out["draft_v"] = np.asarray(
+                jax.device_get(fn(self._draft_state.v, ids))
+            )[:, :n]
+        return out
+
+    def _inject_chain(self, arrays: Dict[str, np.ndarray],
+                      table: List[int]) -> None:
+        """Host->device ship: scatter a gathered chain into freshly
+        allocated blocks (byte-lossless inverse of _gather_chain)."""
+        n = len(table)
+        n_pad = 1 << max(0, (n - 1).bit_length())
+        ids = jnp.asarray(
+            table + [self._num_blocks] * (n_pad - n), jnp.int32
+        )
+        fn = self._inject_blocks_fn(n_pad, draft=False)
+        self.state = self.state._replace(
+            k=fn(self.state.k, ids, self._pad_payload(arrays["k"], n_pad)),
+            v=fn(self.state.v, ids, self._pad_payload(arrays["v"], n_pad)),
+        )
+        if self._spec and "draft_k" in arrays:
+            dfn = self._inject_blocks_fn(n_pad, draft=True)
+            self._draft_state = self._draft_state._replace(
+                k=dfn(self._draft_state.k, ids,
+                      self._pad_payload(arrays["draft_k"], n_pad)),
+                v=dfn(self._draft_state.v, ids,
+                      self._pad_payload(arrays["draft_v"], n_pad)),
+            )
+
+    def _spill_block(self, key: tuple, b: int) -> None:
+        """BlockAllocator eviction hook (loop thread): ship the victim
+        block's KV to the host tier before the block recycles, keyed by
+        its prefix-chain key so match() can resurrect it. A payload the
+        budget can't hold is dropped — the block then just dies, as it
+        did before the tier existed."""
+        arrays = self._gather_chain([b])
+        self._host_tier.put(key, list(arrays.items()))
+
+    def _swap_in_block(self, key: tuple) -> Optional[int]:
+        """BlockAllocator miss hook: resurrect a spilled block from the
+        host tier into a fresh device block. The alloc may itself evict
+        and spill an LRU victim (depth-one reentry; a spill never
+        allocates). None when the key isn't spilled or no device block
+        frees up — the payload then stays host-side for a later probe
+        instead of being lost."""
+        tier = self._host_tier
+        payload = tier.get(key)
+        if payload is None:
+            return None
+        t0 = time.monotonic()
+        b = self._alloc.alloc()
+        if b is None:
+            return None
+        self._inject_chain(payload, [b])
+        tier.pop(key)
+        self._swap_in_hist.observe(time.monotonic() - t0)
+        return b
+
+    def _preempt_slot(self, slot: int) -> bool:
+        """Swap a live slot's whole block chain out to the host tier
+        (loop thread, chunk boundary): KV + sampling scalars park
+        host-side, the slot and its device blocks free immediately, and
+        readmission resumes the request bit-exact at temperature 0. The
+        adapter ref is NOT released — it must survive the swap. False
+        (the slot keeps decoding) when the host budget can't pin the
+        payload even after evicting every spilled block."""
+        req = self._live[slot]
+        table = self._slot_tables[slot]
+        if req is None or table is None or self._host_tier is None:
+            return False
+        t0 = time.monotonic()
+        if req.trace is not None:
+            req.trace.mark("kv_swap_out", t0)  # decode closes here
+        # Scalars from DEVICE state, not the host mirrors: resume must
+        # restart from exactly the boundary state the decode program
+        # left behind.
+        length, last, rem = (
+            int(x) for x in jax.device_get((
+                self.state.lengths[slot],
+                self.state.last_token[slot],
+                self.state.remaining[slot],
+            ))
+        )
+        # Only the filled chain ships; lookahead blocks past `length`
+        # hold no KV yet and re-grow after readmission.
+        n_keep = (length - 1) // self._block_size + 1
+        arrays = self._gather_chain(table[:n_keep])
+        nbytes = sum(a.nbytes for a in arrays.values())
+        if not self._host_tier.reserve(nbytes):
+            if req.trace is not None:
+                req.trace.mark("decode")  # denied: keep decoding
+            return False
+        sw = _SwappedSlot(req, length, last, rem, arrays, nbytes,
+                          time.monotonic(), self._slot_t0[slot])
+        with self._lock:
+            self._live[slot] = None
+            self._release_slot_blocks(slot, cache_tail=False)
+            self._swapped.append(sw)
+            self._preempt_requests.discard(req.out)
+        self.state = self._retire(slot)
+        self._preemptions += 1
+        if req.trace is not None:
+            req.trace.mark("queue_wait")  # kv_swap_out closes here
+        return True
+
+    def _try_queue_jump(self, req: _Request) -> bool:
+        """QoS preemption at admission: when every slot is busy, a
+        pending request whose tenant weight STRICTLY exceeds the
+        lightest live request's swaps that victim out mid-generation
+        instead of waiting for a natural retire. Ties go to the
+        resident (no churn between equals); among equal-weight victims
+        the longest-resident one is taken."""
+        if self._host_tier is None or not self._qos_weights:
+            return False
+        w = self._weight(req)
+        victim: Optional[int] = None
+        vw = 0.0
+        for slot, r in enumerate(self._live):
+            if r is None:
+                continue
+            rw = self._weight(r)
+            if (victim is None or rw < vw
+                    or (rw == vw
+                        and self._slot_t0[slot] < self._slot_t0[victim])):
+                victim, vw = slot, rw
+        if victim is None or not (w > vw):
+            return False
+        return self._preempt_slot(victim)
+
+    def _process_preempt_requests(self) -> None:
+        """Boundary service of preempt() asks: swap out any live slot
+        whose consumer requested it. Asks for requests no longer in
+        flight are dropped; asks for requests not yet live persist
+        until they are (or terminate)."""
+        with self._lock:
+            self._preempt_requests &= self._inflight
+            wanted = set(self._preempt_requests)
+        if not wanted:
+            return
+        for slot, req in enumerate(self._live):
+            if req is not None and req.out in wanted:
+                self._preempt_slot(slot)
+
+    def _readmit_swapped(self) -> bool:
+        """Admission boundary for swapped-out requests: heaviest tenant
+        first (FIFO within a weight class), each into a free slot +
+        fresh device blocks — allocation may itself evict+spill LRU
+        cache blocks, which is the point. Entries stay parked (and
+        retry next boundary) while slots, residency headroom, or device
+        blocks are short."""
+        progressed = False
+        while True:
+            with self._lock:
+                # Cancelled while parked: answer + unpin, no device work.
+                keep = []
+                for sw in self._swapped:
+                    if sw.req.out in self._cancelled:
+                        self._cancelled.discard(sw.req.out)
+                        self._inflight.discard(sw.req.out)
+                        self._release_adapter(sw.req.out)
+                        self._host_tier.unreserve(sw.nbytes)
+                        self.recorder.finish(sw.req.trace, "cancelled")
+                        sw.req.out.put(None)
+                        progressed = True
+                    else:
+                        keep.append(sw)
+                self._swapped[:] = keep
+                if not self._swapped:
+                    return progressed
+                busy = {t.slot for t in self._tasks}
+                live_n = sum(r is not None for r in self._live)
+                free = [s for s in range(self.slots)
+                        if self._live[s] is None and s not in busy]
+                if not free or live_n + len(busy) >= self._max_resident:
+                    return progressed
+                pick = min(
+                    range(len(self._swapped)),
+                    key=lambda i: (-self._weight(self._swapped[i].req), i),
+                )
+                sw = self._swapped[pick]
+                n = int(sw.arrays["k"].shape[1])
+                table: List[int] = []
+                for _ in range(n):
+                    b = self._alloc.alloc()
+                    if b is None:
+                        break
+                    table.append(b)
+                if len(table) < n:
+                    for b in table:
+                        self._alloc.release(b)
+                    return progressed  # pool starved; retry next boundary
+                self._swapped.pop(pick)
+            slot = free[0]
+            t0 = time.monotonic()
+            if sw.req.trace is not None:
+                sw.req.trace.mark("kv_swap_in", t0)  # queue_wait closes
+            self._inject_chain(sw.arrays, table)
+            self._place_slot(slot, table, sw.length, sw.last_token,
+                             sw.remaining, sw.req.temperature,
+                             sw.req.top_p, sw.req.adapter_ix)
+            with self._lock:
+                self._live[slot] = sw.req
+                self._lengths_host[slot] = sw.length
+                self._slot_tables[slot] = table
+                self._slot_k[slot] = self._spec_init_k
+                self._accept_ewma[slot] = None
+                self._slot_t0[slot] = sw.t0
+                self._host_tier.unreserve(sw.nbytes)
+            self._slot_swap_ins += 1
+            self._swap_in_hist.observe(time.monotonic() - t0)
+            if sw.req.trace is not None:
+                sw.req.trace.mark("decode")  # kv_swap_in closes here
+            progressed = True
+
     # -- decode ---------------------------------------------------------------
 
     def _ensure_decode_blocks(self, lookahead: Optional[int] = None) -> None:
@@ -2044,6 +2486,14 @@ class ServingEngine:
                 table.append(b)
                 grew = True
             if starved:
+                # With a host tier the starved slot parks instead of
+                # dying: its chain swaps out, freeing its blocks for
+                # the slots that stay resident, and it readmits when
+                # pressure clears — the overcommit path. Without one
+                # (or when the host budget is full) the old contract
+                # stands: fail loudly, never drop KV writes.
+                if self._host_tier is not None and self._preempt_slot(slot):
+                    continue
                 self._force_retire(
                     slot,
                     RuntimeError(
@@ -2089,6 +2539,9 @@ class ServingEngine:
                 with self._lock:
                     b, needs_copy = self._alloc.ensure_writable(table[idx])
                 if b is None:
+                    if (self._host_tier is not None
+                            and self._preempt_slot(slot)):
+                        break
                     self._force_retire(
                         slot,
                         RuntimeError(
@@ -2174,7 +2627,10 @@ class ServingEngine:
                 if not has_live and not self._tasks:
                     with self._lock:
                         queued_handoffs = bool(self._prefilled_pending)
-                    if self._pending.empty() and not queued_handoffs:
+                        waiting = (bool(self._swapped)
+                                   or self._next_req is not None)
+                    if (self._pending.empty() and not queued_handoffs
+                            and not waiting):
                         t_w = time.monotonic()
                         self._wake.wait(timeout=0.2)
                         self._wake.clear()
@@ -2183,13 +2639,15 @@ class ServingEngine:
                 if not has_live:
                     # Nothing decoding: admission runs alone; the next
                     # iteration dispatches the first decode chunk for the
-                    # freshly activated slots.
+                    # freshly activated slots. Swapped-out requests get
+                    # first claim on the free capacity.
                     t_p = time.monotonic()
-                    progressed = self._advance_prefills()
+                    progressed = self._readmit_swapped()
+                    progressed |= self._advance_prefills()
                     progressed |= self._admit_prefilled()
                     self._wait_activations()
                     self._t_prefill += time.monotonic() - t_p
-                    if not progressed and self._tasks:
+                    if not progressed and (self._tasks or self._swapped):
                         time.sleep(0.001)  # pool starved, nothing live
                     continue
                 # 1) Dispatch PREFILL chunks FIRST: their programs run
@@ -2203,6 +2661,8 @@ class ServingEngine:
                 #    chunk's writes past the last prompt block hit the
                 #    pad sentinel and silently drop.
                 t0 = time.monotonic()
+                self._readmit_swapped()
+                self._process_preempt_requests()
                 self._advance_prefills()
                 self._admit_prefilled()
                 spec_now = self._spec and self._spec_cooldown == 0
@@ -2444,10 +2904,36 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
          stats["prefix_cache_hits_total"]),
         ("dstack_tpu_serving_prefix_cache_misses_total", "counter",
          stats["prefix_cache_misses_total"]),
+        # Hit-tier split (device + host + misses partitions every probe;
+        # .get defaults keep pre-tier snapshots renderable, where every
+        # hit was a device hit).
+        ("dstack_tpu_serving_prefix_cache_device_hits_total", "counter",
+         stats.get("prefix_cache_device_hits_total",
+                   stats["prefix_cache_hits_total"])),
+        ("dstack_tpu_serving_prefix_cache_host_hits_total", "counter",
+         stats.get("prefix_cache_host_hits_total", 0)),
         ("dstack_tpu_serving_prefix_tokens_reused_total", "counter",
          stats["prefix_tokens_reused_total"]),
         ("dstack_tpu_serving_kv_cow_copies_total", "counter",
          stats["kv_cow_copies_total"]),
+        # Hierarchical KV host tier + slot preemption (all zero without
+        # kv_host_budget_bytes).
+        ("dstack_tpu_serving_kv_host_blocks", "gauge",
+         stats.get("kv_host_blocks", 0)),
+        ("dstack_tpu_serving_kv_host_bytes", "gauge",
+         stats.get("kv_host_bytes", 0)),
+        ("dstack_tpu_serving_kv_spills_total", "counter",
+         stats.get("kv_spills_total", 0)),
+        ("dstack_tpu_serving_kv_host_evictions_total", "counter",
+         stats.get("kv_host_evictions_total", 0)),
+        ("dstack_tpu_serving_kv_swap_ins_total", "counter",
+         stats.get("kv_swap_ins_total", 0)),
+        ("dstack_tpu_serving_slots_swapped", "gauge",
+         stats.get("slots_swapped", 0)),
+        ("dstack_tpu_serving_slot_preemptions_total", "counter",
+         stats.get("slot_preemptions_total", 0)),
+        ("dstack_tpu_serving_slot_swap_ins_total", "counter",
+         stats.get("slot_swap_ins_total", 0)),
         ("dstack_tpu_serving_prefill_chunks_total", "counter",
          stats["prefill_chunks_total"]),
         ("dstack_tpu_serving_prefill_tokens_total", "counter",
@@ -2541,6 +3027,14 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
     _render_hist(
         "dstack_tpu_serving_kv_transfer_seconds",
         stats.get("kv_transfer_hist")
+        or {"buckets": [], "sum": 0.0, "count": 0},
+    )
+    # Host-tier swap-in latency (block resurrections + whole-slot
+    # readmissions): the number to compare against a cold re-prefill of
+    # the same prefix when tuning kv_host_budget_bytes.
+    _render_hist(
+        "dstack_tpu_serving_kv_swap_in_seconds",
+        stats.get("swap_in_hist")
         or {"buckets": [], "sum": 0.0, "count": 0},
     )
     # Per-request phase breakdown (PR 15 flight recorder): one histogram

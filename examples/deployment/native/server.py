@@ -62,6 +62,8 @@ class Engine:
                  lora_max_adapters: int = 0, lora_rank: int = 8,
                  adapters=None, qos_rate: float = 0.0,
                  qos_burst: float = 20.0, qos_tenant_cap: int = 64,
+                 qos_weights=None, kv_host_budget_mb: int = 0,
+                 max_resident_slots: int = 0,
                  trace_ring: int = 256, trace_slow_ms=None):
         self.config = PRESETS[preset]
         if max_new_tokens >= self.config.max_seq_len:
@@ -169,6 +171,13 @@ class Engine:
                 mesh=mesh, role=role, kv_transfer=kv_transfer,
                 lora_max_adapters=lora_max_adapters, lora_rank=lora_rank,
                 trace_ring=trace_ring, trace_slow_ms=trace_slow_ms,
+                # Hierarchical KV: LRU-evicted prefix blocks spill to a
+                # host-RAM tier instead of dying, and admitted streams may
+                # overcommit the HBM-resident slot count (preempted slots
+                # swap their whole KV chain to host and resume later).
+                kv_host_budget_bytes=kv_host_budget_mb * (1 << 20) or None,
+                max_resident_slots=max_resident_slots or None,
+                qos_weights=qos_weights or None,
             )
         except ValueError as e:
             raise SystemExit(f"invalid serving configuration: {e}")
@@ -191,6 +200,7 @@ class Engine:
         if qos_rate > 0:
             self.qos = QoSGate(
                 rate=qos_rate, burst=qos_burst, tenant_cap=qos_tenant_cap,
+                weights=qos_weights or None,
                 concurrency=max(slots, max_pending),
             )
         # Per-tenant observability (bounded cardinality via the gate's
@@ -382,6 +392,10 @@ class Engine:
                 adapter=adapter, traceparent=traceparent,
                 x_request_id=x_request_id,
                 t_arrival=t_arrival if self.qos is not None else None,
+                # On a host-tier engine with --qos-weight, a heavier
+                # tenant's request may preempt a lighter tenant's live
+                # slot (KV swap-out) instead of queueing behind it.
+                tenant=tenant or DEFAULT_TENANT,
             )
         except BaseException:
             if granted:
@@ -527,6 +541,25 @@ def main() -> None:
                         help="KV pool memory budget in MiB (0 = unlimited);"
                              " with --spec-enable the target AND drafter"
                              " pools must both fit")
+    parser.add_argument("--kv-host-budget-mb", type=int, default=0,
+                        help="host-RAM KV tier budget in MiB (0 = no host"
+                             " tier): LRU-evicted prefix-cache blocks spill"
+                             " here instead of dying, and preempted slots"
+                             " park their live KV chain here until resume")
+    parser.add_argument("--max-resident-slots", type=int, default=0,
+                        help="HBM-resident decode slot cap (0 = --slots):"
+                             " setting it below --slots overcommits"
+                             " admission — the engine round-robins more"
+                             " admitted streams than fit in HBM by swapping"
+                             " slot KV through the host tier (requires"
+                             " --kv-host-budget-mb)")
+    parser.add_argument("--qos-weight", action="append", default=[],
+                        metavar="TENANT=WEIGHT",
+                        help="per-tenant DRR weight (repeatable; default"
+                             " 1.0): orders admission under contention and,"
+                             " with --kv-host-budget-mb, lets a heavier"
+                             " tenant preempt a lighter tenant's live slot"
+                             " (KV swap-out) mid-generation")
     parser.add_argument("--adapter", action="append", default=[],
                         metavar="NAME=PATH",
                         help="preload a LoRA adapter (repeatable);"
@@ -586,6 +619,23 @@ def main() -> None:
 
     if args.role == "decode" and not args.kv_transfer_port:
         raise SystemExit("--role decode requires --kv-transfer-port")
+    if args.max_resident_slots and not args.kv_host_budget_mb:
+        raise SystemExit(
+            "--max-resident-slots overcommit needs --kv-host-budget-mb"
+            " (swapped-out slots park their KV in the host tier)"
+        )
+    qos_weights = {}
+    for entry in args.qos_weight:
+        tenant, _, weight = entry.partition("=")
+        try:
+            qos_weights[tenant] = float(weight)
+        except ValueError:
+            weight = ""
+        if not tenant or not weight or qos_weights[tenant] <= 0:
+            raise SystemExit(
+                f"--qos-weight {entry!r} is not TENANT=WEIGHT"
+                " with a positive weight"
+            )
     engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir,
                     quantize=args.quantize, max_pending=args.max_pending,
                     slots=args.slots, steps_per_sync=args.steps_per_sync,
@@ -602,6 +652,9 @@ def main() -> None:
                     lora_rank=args.lora_rank, adapters=args.adapter,
                     qos_rate=args.qos_rate, qos_burst=args.qos_burst,
                     qos_tenant_cap=args.qos_tenant_cap,
+                    qos_weights=qos_weights,
+                    kv_host_budget_mb=args.kv_host_budget_mb,
+                    max_resident_slots=args.max_resident_slots,
                     trace_ring=args.trace_ring,
                     trace_slow_ms=args.trace_slow_ms)
 

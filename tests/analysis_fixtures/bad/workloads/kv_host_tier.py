@@ -1,0 +1,17 @@
+"""KVB02 fixture: device arrays constructed inside the host KV tier.
+
+Importing jax and materializing spilled payloads as jnp arrays puts the
+"offloaded" KV straight back into HBM — the budget math the tier exists
+for becomes a lie.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def spill_block(store, key, payload):
+    store[key] = jnp.asarray(payload)
+
+
+def pin_slot(store, key, arrays):
+    store[key] = [jax.device_put(a) for a in arrays]

@@ -1,0 +1,18 @@
+"""KVB02-clean: the host tier keeps payloads as numpy arrays / bytes.
+
+Device<->host conversion happens at the engine's gather/inject seam;
+the tier itself only ever sees host memory.
+"""
+
+import numpy as np
+
+
+def spill_block(store, key, payload):
+    store[key] = np.ascontiguousarray(payload).tobytes()
+
+
+def resurrect(store, key, shape, dtype):
+    raw = store.get(key)
+    if raw is None:
+        return None
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)

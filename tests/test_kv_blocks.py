@@ -140,3 +140,112 @@ def test_init_paged_state_validates_block_size():
                           num_blocks=16)
     assert st.block_tables.shape == (2, 4)
     assert int(st.block_tables.min()) == 16  # pad sentinel == num_blocks
+
+
+# -------------------------------------------- host-tier hooks (PR 16)
+
+
+def test_spill_hook_fires_at_eviction_with_device_contents_intact():
+    spilled = []
+    a = BlockAllocator(num_blocks=2, block_size=BS,
+                       spill=lambda key, b: spilled.append((key, b)))
+    p1, p2 = [1, 2, 3, 4, 9], [5, 6, 7, 8, 9]
+    t1, t2 = [a.alloc()], [a.alloc()]
+    a.insert_full(p1, t1)
+    a.insert_full(p2, t2)
+    a.release(t1[0])
+    a.release(t2[0])
+    b = a.alloc()  # p1's block is LRU: evicted AND handed to the hook
+    assert b == t1[0]
+    assert len(spilled) == 1
+    key, blk = spilled[0]
+    assert blk == t1[0] and key[0] == "F"
+    # The hook saw the block BEFORE it returned to the free list — by
+    # the time alloc() hands it out it is no longer cache-indexed.
+    assert blk not in a._block_key
+
+
+def test_live_referenced_blocks_never_spill():
+    """The spill invariant: a block any slot still references (ref > 1,
+    cache hold + table hold) must not leave the device — alloc() returns
+    None rather than spilling it."""
+    spilled = []
+    a = BlockAllocator(num_blocks=2, block_size=BS,
+                       spill=lambda key, b: spilled.append(key))
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    table = [a.alloc(), a.alloc()]
+    a.insert_full(prompt, table)  # both blocks: table ref + cache ref
+    assert a.alloc() is None
+    assert spilled == []
+    a.release(table[0])  # first block now cache-held only
+    assert a.alloc() == table[0]
+    assert [k[0] for k in spilled] == ["F"]
+
+
+def test_partial_tail_aliasing_full_chain_evicts_independently():
+    """A partial-tail key shares its parent chain hash with the full
+    blocks it extends. Eviction must treat the alias as its own LRU
+    entry: touching the FULL chain via match() must not keep the tail
+    alive, and spill keys must come out in true LRU order."""
+    spilled = []
+    a = BlockAllocator(num_blocks=3, block_size=BS,
+                       spill=lambda key, b: spilled.append(key))
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # 2 full blocks + tail [9, 10]
+    table = [a.alloc(), a.alloc(), a.alloc()]
+    a.insert_full(prompt, table)
+    a.insert_tail(prompt, table)
+    for b in table:
+        a.release(b)
+    assert a.cached == 3
+    # Longest-prefix match retains and LRU-bumps all three entries, tail
+    # included; release the matcher's holds so everything is evictable.
+    blocks, matched = a.match(prompt + [11, 12, 13])
+    assert blocks == table and matched == 10
+    for b in blocks:
+        a.release(b)
+    # Bump ONLY the full chain: a shorter probe never reaches the tail.
+    blocks, matched = a.match(prompt[:8] + [99])
+    assert matched == 8
+    for b in blocks:
+        a.release(b)
+    # Drain the pool: the tail (now the true LRU) must evict FIRST even
+    # though its parent hash equals the full chain's, then the full
+    # blocks in chain order.
+    assert [a.alloc() for _ in range(3)] == [table[2], table[0], table[1]]
+    assert [k[0] for k in spilled] == ["P", "F", "F"]
+    assert spilled[0][2] == (9, 10)  # the tail's token key rode along
+
+
+def test_swap_in_hook_resurrects_chain_and_counts_host_hits():
+    """A match() miss probes the swap_in hook; a resurrected block is
+    republished under its key (hook's ref=1 becomes the cache hold) and
+    the whole match counts as a host hit, not a device hit."""
+    host = {}
+    a = BlockAllocator(num_blocks=4, block_size=BS,
+                       spill=lambda key, b: host.setdefault(key, b),
+                       swap_in=None)
+    # Wire swap_in after construction so the hook can reenter a.alloc().
+    def swap_in(key):
+        if key not in host:
+            return None
+        del host[key]
+        return a.alloc()
+    a._swap_in = swap_in
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    table = [a.alloc(), a.alloc()]
+    a.insert_full(prompt, table)
+    a.release(table[0])
+    a.release(table[1])
+    # Evict both cached blocks into the fake host store.
+    held = [a.alloc() for _ in range(4)]
+    assert len(host) == 2
+    for b in held:
+        a.release(b)
+    blocks, matched = a.match(prompt)
+    assert matched == 8 and len(blocks) == 2
+    assert a.hits == 1 and a.host_hits == 1
+    assert host == {}  # both keys resurrected
+    # Each resurrected block: cache hold + matcher hold.
+    assert all(a._ref[b] == 2 for b in blocks)
+    st = a.stats()
+    assert st["host_hits"] == 1

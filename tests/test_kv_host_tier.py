@@ -1,0 +1,328 @@
+"""Hierarchical KV cache: host-RAM spill tier + engine slot preemption.
+
+Three layers under test, bottom-up:
+
+- `pack_arrays`/`unpack_arrays` (kv_transfer.py): the socket-free array
+  manifest the TransferServer framing AND the host tier both ship KV
+  through — round-trip must be byte-exact, bf16 included.
+- `HostKVTier` (kv_host_tier.py): budgeted LRU of spilled blocks plus
+  the pinned-reservation ledger for swapped-out slots.
+- The engine seam (serving.py): LRU-evicted prefix blocks spill instead
+  of dying and swap back on a later prefix hit; a preempted slot's live
+  chain parks host-side and resumes bit-exactly at temperature 0; both
+  tiers drain to zero residue on clean end and on cancel-mid-swap.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dstack_tpu.workloads.kv_host_tier import HostKVTier
+from dstack_tpu.workloads.kv_transfer import pack_arrays, unpack_arrays
+
+
+# ------------------------------------------------- array manifests (no jax)
+
+
+def test_pack_unpack_roundtrip_multi_dtype():
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    named = [
+        ("k", rng.standard_normal((2, 3, 4)).astype(np.float32)),
+        ("v", rng.standard_normal((2, 3, 4))
+             .astype(ml_dtypes.bfloat16)),  # the serving activation dtype
+        ("lengths", np.arange(7, dtype=np.int32)),
+    ]
+    manifest, buffers = pack_arrays(named)
+    assert [m["name"] for m in manifest] == ["k", "v", "lengths"]
+    assert all(isinstance(b, bytes) for b in buffers)
+    out = unpack_arrays(manifest, buffers)
+    for name, a in named:
+        b = out[name]
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert a.tobytes() == b.tobytes()  # byte-exact, bf16 included
+
+
+def test_pack_arrays_handles_noncontiguous_input():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]  # strided view
+    manifest, buffers = pack_arrays([("x", a)])
+    out = unpack_arrays(manifest, buffers)
+    np.testing.assert_array_equal(out["x"], a)
+
+
+def test_unpack_arrays_returns_readonly_views():
+    manifest, buffers = pack_arrays([("x", np.ones(3, np.float32))])
+    out = unpack_arrays(manifest, buffers)
+    with pytest.raises((ValueError, RuntimeError)):
+        out["x"][0] = 2.0
+
+
+# ------------------------------------------------------------ HostKVTier
+
+
+def _payload(n_floats: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return [("k", rng.standard_normal(n_floats).astype(np.float32))]
+
+
+def test_tier_put_get_pop_and_counters():
+    tier = HostKVTier(budget_bytes=1 << 20)
+    assert tier.put("a", _payload(16)) is True
+    assert tier.has("a") and tier.blocks == 1
+    got = tier.get("a")  # peek: entry must survive until pop
+    np.testing.assert_array_equal(got["k"], _payload(16)[0][1])
+    assert tier.has("a")
+    tier.pop("a")
+    assert not tier.has("a") and tier.get("a") is None
+    s = tier.stats()
+    assert s["spills_total"] == 1 and s["swap_ins_total"] == 1
+    assert s["spill_bytes"] == 0 and s["blocks"] == 0
+
+
+def test_tier_lru_eviction_under_budget_pressure():
+    one = 64 * 4  # 64 float32s
+    tier = HostKVTier(budget_bytes=3 * one)
+    for key in ("a", "b", "c"):
+        assert tier.put(key, _payload(64))
+    tier.get("a")  # bump: "b" becomes LRU
+    assert tier.put("d", _payload(64))
+    assert not tier.has("b") and tier.has("a") and tier.has("c")
+    assert tier.stats()["evictions_total"] == 1
+    # A payload that cannot fit even after evicting everything is dropped.
+    assert tier.put("huge", _payload(64 * 4)) is False
+    assert tier.stats()["dropped_total"] == 1
+
+
+def test_tier_pinned_reservations_evict_spills_but_never_pins():
+    one = 64 * 4
+    tier = HostKVTier(budget_bytes=3 * one)
+    for key in ("a", "b", "c"):
+        tier.put(key, _payload(64))
+    # Reserving 2 blocks' worth of pinned space evicts 2 spilled LRUs.
+    assert tier.reserve(2 * one) is True
+    assert tier.blocks == 1 and tier.pinned_bytes == 2 * one
+    # Pinned bytes are NOT evictable: a reservation over the remainder
+    # fails even though the ledger could fit it by dropping pins.
+    assert tier.reserve(2 * one) is False
+    assert tier.pinned_bytes == 2 * one
+    # Spills can no longer displace pinned capacity either.
+    assert tier.put("big", _payload(128)) is False
+    tier.unreserve(2 * one)
+    assert tier.pinned_bytes == 0
+    with pytest.raises(AssertionError):
+        tier.unreserve(1)
+
+
+def test_tier_replace_existing_key_keeps_accounting_exact():
+    tier = HostKVTier(budget_bytes=1 << 16)
+    tier.put("a", _payload(16, seed=1))
+    tier.put("a", _payload(32, seed=2))
+    assert tier.blocks == 1
+    assert tier.stats()["spill_bytes"] == 32 * 4
+    got = tier.get("a")
+    assert got["k"].shape == (32,)
+
+
+# ----------------------------------------------------- engine integration
+
+jax = pytest.importorskip("jax")
+
+from dstack_tpu.workloads.config import PRESETS  # noqa: E402
+from dstack_tpu.workloads.generate import generate  # noqa: E402
+from dstack_tpu.workloads.serving import (  # noqa: E402
+    ServingEngine,
+    prometheus_metrics,
+)
+from dstack_tpu.workloads.transformer import init_params  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+CFG = PRESETS["tiny"].with_(remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _drain(q):
+    out = []
+    while True:
+        tok = q.get(timeout=60)
+        if isinstance(tok, BaseException):
+            raise tok
+        if tok is None:
+            return out
+        out.append(tok)
+
+
+def _reference(params, prompt, n):
+    toks = generate(
+        CFG, params, jnp.asarray([prompt], dtype=jnp.int32),
+        max_new_tokens=n, temperature=0.0,
+    )
+    return [int(t) for t in toks[0]]
+
+
+def _prompt(seed, n):
+    return [(i * 37 + seed * 13 + 5) % 100 + 1 for i in range(n)]
+
+
+def _assert_no_residue(engine):
+    """Zero residue on BOTH tiers: every in-use device block is a prefix
+    cache retention (no leaked table refs), no slot parked host-side,
+    and no pinned host bytes left behind."""
+    st = engine.stats()
+    assert st["kv_blocks_in_use"] == st["kv_blocks_cached"], st
+    assert st["slots_swapped"] == 0, st
+    if engine._host_tier is not None:
+        assert engine._host_tier.pinned_bytes == 0, engine._host_tier.stats()
+
+
+def test_spilled_prefix_swaps_back_as_host_hit(params):
+    """Churn a 16-block pool until the first prompt's cached chain is
+    LRU-evicted (spilled), then resubmit it: the prefix probe must
+    resurrect the blocks from host RAM (host hit, not a miss) and the
+    output must stay bit-identical to the first run."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=64,
+                           prefill_chunk_tokens=16, kv_block_size=8,
+                           kv_pool_blocks=16,
+                           kv_host_budget_bytes=32 << 20)
+    try:
+        p0 = _prompt(1, 24)
+        first = _drain(engine.submit(p0, max_new_tokens=8, temperature=0.0))
+        assert first == _reference(params, p0, 8)
+        for s in range(2, 10):  # 8 distinct prompts > 16-block pool
+            _drain(engine.submit(_prompt(s, 24), max_new_tokens=8,
+                                 temperature=0.0))
+        st = engine.stats()
+        assert st["kv_spills_total"] > 0, st
+        assert st["kv_host_blocks"] > 0, st
+
+        again = _drain(engine.submit(p0, max_new_tokens=8, temperature=0.0))
+        assert again == first
+        st = engine.stats()
+        assert st["prefix_cache_host_hits_total"] >= 1, st
+        assert st["kv_swap_ins_total"] >= 1, st
+        # The tiered split telescopes: device + host == total hits.
+        assert (st["prefix_cache_device_hits_total"]
+                + st["prefix_cache_host_hits_total"]
+                == st["prefix_cache_hits_total"]), st
+        text = prometheus_metrics(st)
+        assert "dstack_tpu_serving_prefix_cache_host_hits_total 1" in text
+        assert "dstack_tpu_serving_kv_swap_in_seconds_count" in text
+    finally:
+        engine.close()
+    _assert_no_residue(engine)
+
+
+def test_preempt_and_resume_is_bit_exact_at_temp0(params):
+    """Swap a live slot out mid-generation and back in: the resumed
+    stream must produce exactly the tokens an uninterrupted greedy run
+    produces — KV chain, sampling state, and position all survive the
+    host round trip."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=96,
+                           prefill_chunk_tokens=16, kv_block_size=8,
+                           kv_host_budget_bytes=32 << 20)
+    try:
+        prompt = _prompt(11, 20)
+        ref = _reference(params, prompt, 24)
+        out = engine.submit(prompt, max_new_tokens=24, temperature=0.0)
+        got = [out.get(timeout=60) for _ in range(4)]  # mid-generation
+        engine.preempt(out)
+        toks = got + _drain(out)
+        assert toks == ref
+        st = engine.stats()
+        assert st["slot_preemptions_total"] >= 1, st
+        assert st["slot_swap_ins_total"] >= 1, st
+        assert st["swap_in_hist"]["count"] >= 1, st
+    finally:
+        engine.close()
+    _assert_no_residue(engine)
+
+
+def test_overcommit_admits_past_resident_capacity(params):
+    """max_resident_slots=2 under 6 slots: six concurrent streams admit
+    and ALL finish bit-exactly even though only two chains fit in the
+    'HBM-resident' cap — the rest round-robin through the host tier."""
+    engine = ServingEngine(CFG, params, slots=6, max_len=64,
+                           prefill_chunk_tokens=16, kv_block_size=8,
+                           kv_host_budget_bytes=64 << 20,
+                           max_resident_slots=2)
+    try:
+        outs = [(s, engine.submit(_prompt(30 + s, 16), max_new_tokens=10,
+                                  temperature=0.0))
+                for s in range(6)]
+        for s, q in outs:
+            assert _drain(q) == _reference(params, _prompt(30 + s, 16), 10), s
+        st = engine.stats()
+        assert st["admitted_total"] == 6, st
+        assert st["max_resident_slots"] == 2, st
+    finally:
+        engine.close()
+    _assert_no_residue(engine)
+
+
+def test_heavier_tenant_queue_jumps_lighter_live_slot(params):
+    """DRR-weighted preemption: with one slot held by a best-effort
+    stream, a paying tenant's request must swap the victim out instead
+    of queueing behind it — and the victim still finishes bit-exactly
+    after readmission."""
+    engine = ServingEngine(CFG, params, slots=1, max_len=96,
+                           prefill_chunk_tokens=16, kv_block_size=8,
+                           kv_host_budget_bytes=32 << 20,
+                           qos_weights={"paid": 8.0})
+    try:
+        slow_prompt = _prompt(41, 20)
+        slow = engine.submit(slow_prompt, max_new_tokens=32,
+                             temperature=0.0, tenant="besteffort")
+        first = [slow.get(timeout=60) for _ in range(2)]  # live mid-decode
+        fast_prompt = _prompt(42, 16)
+        fast = engine.submit(fast_prompt, max_new_tokens=6,
+                             temperature=0.0, tenant="paid")
+        assert _drain(fast) == _reference(params, fast_prompt, 6)
+        st = engine.stats()
+        assert st["slot_preemptions_total"] >= 1, st
+        assert first + _drain(slow) == _reference(params, slow_prompt, 32)
+        assert engine.stats()["slot_swap_ins_total"] >= 1
+    finally:
+        engine.close()
+    _assert_no_residue(engine)
+
+
+def test_cancel_while_swapped_out_leaves_zero_residue(params):
+    """Cancel a request whose chain is parked host-side: the pinned
+    reservation must release, the queue must terminate, and neither
+    tier may leak — the overcommit residency test for the cancel path."""
+    engine = ServingEngine(CFG, params, slots=2, max_len=96,
+                           prefill_chunk_tokens=16, kv_block_size=8,
+                           kv_host_budget_bytes=32 << 20,
+                           max_resident_slots=1)
+    try:
+        q1 = engine.submit(_prompt(51, 20), max_new_tokens=40,
+                           temperature=0.0)
+        got1 = [q1.get(timeout=60) for _ in range(2)]
+        assert got1  # decoding
+        # Second stream is admitted the moment the first swaps out
+        # (residency 1), which then HOLDS the first out host-side.
+        q2 = engine.submit(_prompt(52, 16), max_new_tokens=24,
+                           temperature=0.0)
+        engine.preempt(q1)
+        deadline = time.monotonic() + 30
+        while engine.stats()["slots_swapped"] != 1:
+            assert time.monotonic() < deadline, engine.stats()
+            time.sleep(0.01)
+        engine.cancel(q1)
+        # Tokens decoded between the preempt call and the swap boundary
+        # legitimately reach the queue; after the cancel it terminates
+        # unfinished, still a clean prefix of the uninterrupted run.
+        ref1 = _reference(params, _prompt(51, 20), 40)
+        toks1 = got1 + _drain(q1)
+        assert toks1 == ref1[:len(toks1)] and len(toks1) < 40
+        assert _drain(q2) == _reference(params, _prompt(52, 16), 24)
+        assert engine.stats()["slots_swapped"] == 0
+    finally:
+        engine.close()
+    _assert_no_residue(engine)

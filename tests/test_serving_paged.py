@@ -224,13 +224,14 @@ def test_prometheus_metrics_matches_registry(params):
             assert name in METRICS, f"undeclared series {name}"
             assert METRICS[name][0] == mtype, name
             # Serving series carry no labels, except the r12 attention
-            # dispatch counter (path=pallas|lax_ragged) and the r13
+            # dispatch counter (path=pallas|lax_ragged) and the r13/r16
             # role-labeled latency histograms — their samples are
             # checked against the declared label sets below.
             if name not in ("dstack_tpu_serving_attn_dispatch_total",
                             "dstack_tpu_serving_ttft_seconds",
                             "dstack_tpu_serving_tpt_seconds",
                             "dstack_tpu_serving_kv_transfer_seconds",
+                            "dstack_tpu_serving_kv_swap_in_seconds",
                             "dstack_tpu_serving_phase_seconds"):
                 assert METRICS[name][1] == (), name
             seen.add(name)

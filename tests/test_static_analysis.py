@@ -43,12 +43,18 @@ def test_bad_fixtures_trip_every_checker():
     report = run_analysis([BAD], root=BAD)
     assert report.errors == []
     assert _codes(report) == [
-        "ASY01", "ASY02", "KVB01", "LCK01", "LCK02", "LCK03", "MET01", "POOL01",
-        "SHD01", "SQL01", "TRC01",
+        "ASY01", "ASY02", "KVB01", "KVB02", "LCK01", "LCK02", "LCK03", "MET01",
+        "POOL01", "SHD01", "SQL01", "TRC01",
     ]
     assert _keys(report, "SHD01") == ["runs"]
     # The whole-table pool gather in workloads/kv_blocks.py.
     assert _keys(report, "KVB01") == ["take:block_tables"]
+    # Device-array construction in workloads/kv_host_tier.py: both jax
+    # imports and both device-materializing calls.
+    assert _keys(report, "KVB02") == [
+        "call:jax.device_put", "call:jax.numpy.asarray",
+        "import:jax", "import:jax.numpy",
+    ]
     assert _keys(report, "POOL01") == ["httpx.AsyncClient"]
     # The two trace-severing upstream calls in dataplane/trace_bad.py.
     assert _keys(report, "TRC01") == ["client.post", "client.stream"]
@@ -209,10 +215,10 @@ def test_cli_json_contract(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert payload["exit_code"] == 1
-    assert payload["files_scanned"] == 10
+    assert payload["files_scanned"] == 11
     assert set(payload["checkers"]) >= {
-        "ASY01", "ASY02", "KVB01", "LCK01", "LCK02", "LCK03", "SQL01", "MET01",
-        "POOL01", "SHD01", "TRC01",
+        "ASY01", "ASY02", "KVB01", "KVB02", "LCK01", "LCK02", "LCK03", "SQL01",
+        "MET01", "POOL01", "SHD01", "TRC01",
     }
     sample = payload["findings"][0]
     assert {"code", "message", "path", "line", "fingerprint"} <= set(sample)
