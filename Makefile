@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-serving drill-disagg
+.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-serving drill-disagg drill-rl bench-rl
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -96,6 +96,21 @@ bench-serving:
 # KV-block residue.
 drill-disagg:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.workloads.serving_disagg
+
+# Podracer RL drill (docs/guides/rl.md): Sebulba-style actor gang
+# (2 actor subprocesses) feeding an in-process learner, weight refresh
+# over the framed-socket channel. Kills one actor mid-rollout, resolves
+# it via elastic gang resize (accum-step rescale, zero learner
+# restarts), then grows back to full width; asserts epoch convergence,
+# the stage-marker timeline, and the RL /metrics series.
+drill-rl:
+	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.workloads.rl_drill
+
+# RL throughput benchmark: colocated (Anakin) loop, socket weight
+# refresh vs a checkpoint-file refresh baseline. Records env-steps/s,
+# learner step time, and weight-refresh latency in BENCH_rl_r17.json.
+bench-rl:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_rl.py --out BENCH_rl_r17.json
 
 # CI-sized variant: 40 runs in-process, asserts 0 failures + telemetry.
 capacity-smoke:

@@ -1,0 +1,150 @@
+"""RL workload benchmark: socket weight refresh vs checkpoint-file baseline.
+
+Two arms, both the colocated (Anakin) actor+learner loop from
+`dstack_tpu.workloads.rl.run_anakin` with an identical seed, so the
+reward/loss trajectories are bit-identical and the only difference is
+the weight-refresh channel:
+
+1. socket — `WeightRefreshServer` over loopback: the same versioned,
+   epoch-fenced frames the Sebulba actor gang pulls over the
+   kv_transfer framed-socket layer.
+2. checkpoint — npz file + JSON sidecar per publish, poll by mtime/epoch:
+   the "just write a checkpoint and have actors reload it" baseline the
+   Podracer paper's weight-distribution path replaces.
+
+A third reference arm (direct, in-process snapshot swap) bounds the
+channel overhead from below.
+
+Per arm: env-steps/s, learner step time (mean over the jitted PPO
+updates), weight-refresh latency (actor-side poll+adopt, includes the
+engine's idle-boundary param swap + prefix-cache drop), and the reward
+trajectory. The summary compares refresh latency and end-to-end
+throughput across channels.
+
+Emits ONE JSON document (BENCH_rl_r17.json via --out).
+
+Run: JAX_PLATFORMS=cpu python bench_rl.py [--updates 10] [--out ...]
+"""
+
+import argparse
+import json
+import platform
+import tempfile
+import time
+
+import jax
+
+from dstack_tpu.workloads.rl import run_anakin, tiny_rl_config
+
+ARMS = ("socket", "checkpoint", "direct")
+
+
+def run_arm(mode: str, args) -> dict:
+    config = tiny_rl_config()
+    kwargs = dict(
+        updates=args.updates, batch_size=args.batch,
+        prompt_len=args.prompt_len, horizon=args.horizon,
+        seed=args.seed, learning_rate=2e-2, gamma=0.7,
+        publish_every=1, refresh=mode,
+    )
+    if mode == "checkpoint":
+        with tempfile.TemporaryDirectory(prefix="bench_rl_ckpt_") as d:
+            out = run_anakin(config, checkpoint_dir=d, **kwargs)
+    else:
+        out = run_anakin(config, **kwargs)
+    return {
+        "refresh_mode": mode,
+        "updates": args.updates,
+        "env_steps_total": out["env_steps_total"],
+        "elapsed_s": round(out["elapsed_s"], 4),
+        "env_steps_per_s": round(out["env_steps_per_s"], 2),
+        "learn_step_s_mean": round(out["learn_step_s_mean"], 6),
+        "refresh_s_mean": round(out["refresh_s_mean"], 6),
+        "refresh_count": len(out["refresh_s"]),
+        "refresh_s_max": round(max(out["refresh_s"]), 6) if out["refresh_s"] else 0.0,
+        "final_weight_epoch": out["final_weight_epoch"],
+        "learner_epoch": out["learner_epoch"],
+        "reward_first": out["rewards"][0],
+        "reward_last": out["rewards"][-1],
+        "rewards": [round(r, 6) for r in out["rewards"]],
+        "losses": [round(l, 6) for l in out["losses"]],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--updates", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=4)
+    ap.add_argument("--horizon", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_rl_r17.json")
+    args = ap.parse_args()
+
+    # One throwaway update so XLA compilation (shared across arms via
+    # the in-process executable cache) is not billed to the first arm.
+    print("[bench-rl] warmup ...", flush=True)
+    run_anakin(
+        tiny_rl_config(), updates=1, batch_size=args.batch,
+        prompt_len=args.prompt_len, horizon=args.horizon,
+        seed=args.seed, refresh="direct",
+    )
+
+    arms = {}
+    for mode in ARMS:
+        t0 = time.monotonic()
+        print(f"[bench-rl] arm={mode} ...", flush=True)
+        arms[mode] = run_arm(mode, args)
+        print(
+            f"[bench-rl] arm={mode} done in {time.monotonic() - t0:.1f}s: "
+            f"{arms[mode]['env_steps_per_s']} env-steps/s, "
+            f"refresh {arms[mode]['refresh_s_mean'] * 1e3:.2f} ms mean",
+            flush=True,
+        )
+
+    # Same seed + synchronous loop => the learning trajectory must be
+    # channel-independent; a divergence means a refresh channel leaked
+    # into the math (torn weights, stale adoption) and the numbers above
+    # are comparing different workloads.
+    trajectories = {m: arms[m]["rewards"] for m in ARMS}
+    identical = len({tuple(t) for t in trajectories.values()}) == 1
+    doc = {
+        "bench": "rl_weight_refresh",
+        "revision": "r17",
+        "platform": platform.platform(),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "config": {
+            "updates": args.updates, "batch": args.batch,
+            "prompt_len": args.prompt_len, "horizon": args.horizon,
+            "seed": args.seed,
+        },
+        "arms": arms,
+        "summary": {
+            "trajectories_identical_across_channels": identical,
+            "refresh_ms_socket": round(arms["socket"]["refresh_s_mean"] * 1e3, 3),
+            "refresh_ms_checkpoint": round(
+                arms["checkpoint"]["refresh_s_mean"] * 1e3, 3
+            ),
+            "refresh_ms_direct": round(arms["direct"]["refresh_s_mean"] * 1e3, 3),
+            "socket_vs_checkpoint_refresh_speedup": round(
+                arms["checkpoint"]["refresh_s_mean"]
+                / max(arms["socket"]["refresh_s_mean"], 1e-9), 2,
+            ),
+            "env_steps_per_s": {m: arms[m]["env_steps_per_s"] for m in ARMS},
+            "reward_improved": all(
+                arms[m]["reward_last"] > arms[m]["reward_first"] for m in ARMS
+            ),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"[bench-rl] wrote {args.out}")
+    print(json.dumps(doc["summary"], indent=2))
+    if not identical:
+        raise SystemExit("reward trajectories diverged across refresh channels")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,10 @@ from dstack_tpu.models.runs import ClusterInfo
 
 DEFAULT_COORDINATOR_PORT = 8476
 DEFAULT_MEGASCALE_PORT = 8576
+# Weight-refresh channel for Podracer RL gangs (workloads/rl.py): the
+# learner binds its WeightRefreshServer on the master host at this
+# port; actor processes on every other host read the address from env.
+DEFAULT_RL_REFRESH_PORT = 8676
 
 
 def make_cluster_env(
@@ -58,6 +62,11 @@ def make_cluster_env(
         # Chips-first aliases.
         "DSTACK_CHIPS_PER_HOST": str(cluster.chips_per_host),
         "DSTACK_CHIPS_NUM": str(cluster.chips_per_host * n),
+        # RL actor/learner gangs (workloads/rl.py): where actors pull
+        # fresh policy weights from. Harmless for non-RL workloads —
+        # nothing binds the port unless an RL learner starts.
+        "DSTACK_TPU_RL_REFRESH_ADDR":
+            f"{cluster.master_job_ip}:{DEFAULT_RL_REFRESH_PORT}",
     }
     if cluster.tpu_slice is not None:
         env["DSTACK_TPU_ACCELERATOR_TYPE"] = cluster.tpu_slice.accelerator_type

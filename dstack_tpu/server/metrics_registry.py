@@ -76,6 +76,25 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_proxy_routing_cache_hit_rate": ("gauge", ()),
     "dstack_tpu_proxy_ttfb_seconds": ("histogram", ("kind",)),
     "dstack_tpu_proxy_upstream_errors_total": ("counter", ("kind",)),
+    # Podracer RL workload (workloads/rl.py `rl_prometheus_metrics`,
+    # exposed by the drill's learner /metrics): rollout throughput,
+    # learner cadence, and the weight-refresh channel. weight_refreshes
+    # is role-split (learner publishes vs actor adoptions) so a stuck
+    # refresh path shows as the two legs diverging; weight_epoch{actor}
+    # is the MINIMUM across live actors (the laggard), with per-actor
+    # lag in refresh_staleness_epochs. The actor label is gang-rank
+    # sized — bounded by the run's width, never client-chosen.
+    "dstack_tpu_rl_env_steps_total": ("counter", ()),
+    "dstack_tpu_rl_episodes_total": ("counter", ()),
+    "dstack_tpu_rl_gang_resizes_total": ("counter", ()),
+    "dstack_tpu_rl_learn_step_seconds": ("histogram", ()),
+    "dstack_tpu_rl_learn_steps_total": ("counter", ()),
+    "dstack_tpu_rl_refresh_seconds": ("histogram", ()),
+    "dstack_tpu_rl_refresh_staleness_epochs": ("gauge", ("actor",)),
+    "dstack_tpu_rl_reward_mean": ("gauge", ()),
+    "dstack_tpu_rl_rollout_seconds": ("histogram", ()),
+    "dstack_tpu_rl_weight_epoch": ("gauge", ("role",)),
+    "dstack_tpu_rl_weight_refreshes_total": ("counter", ("role",)),
     # Serving engine (workloads/serving.py `prometheus_metrics`, exposed
     # by the native model server's /metrics): paged-KV pool occupancy,
     # prefix-cache effectiveness, chunked-prefill accounting, and the

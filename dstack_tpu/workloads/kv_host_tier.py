@@ -110,6 +110,17 @@ class HostKVTier:
         copy was invalidated, e.g. the allocator recycled the key)."""
         self._drop(key)
 
+    def clear(self) -> int:
+        """Drop every spilled prefix block (the blocks became worthless
+        wholesale, e.g. a weight refresh invalidated all cached KV).
+        Reserved swapped-slot bytes are untouched — those belong to
+        live requests, not the prefix cache. Returns entries dropped."""
+        n = 0
+        for key in list(self._spilled.keys()):
+            if self._drop(key):
+                n += 1
+        return n
+
     def _drop(self, key: Any) -> bool:
         entry = self._spilled.pop(key, None)
         if entry is None:

@@ -30,6 +30,8 @@ cross trust boundaries and versions.
 """
 
 import json
+import math
+import os
 import socket
 import struct
 import threading
@@ -40,8 +42,44 @@ import numpy as np
 _LEN = struct.Struct(">Q")
 # A single handoff is bounded by pool-geometry arrays (L, n_blocks, bs,
 # KV, hd); 1 GiB headroom rejects garbage/hostile lengths before any
-# allocation.
+# allocation. Deployments running this framing over a seam with a
+# different natural payload size (e.g. the RL weight-refresh channel's
+# full-params frames) can raise or lower the budget per call
+# (`recv_msg(..., max_bytes=...)`) or process-wide via
+# DSTACK_TPU_KV_MAX_FRAME_BYTES.
 MAX_MSG_BYTES = 1 << 30
+MAX_FRAME_ENV = "DSTACK_TPU_KV_MAX_FRAME_BYTES"
+
+
+class FrameTooLargeError(ConnectionError):
+    """A length prefix or manifest entry exceeds the frame budget.
+
+    Subclasses ConnectionError deliberately: every framing consumer
+    already treats ConnectionError as 'this stream is poisoned, drop
+    it' — a corrupt or hostile length must tear the connection down,
+    never retry on the same bytes."""
+
+    def __init__(self, what: str, nbytes: int, limit: int):
+        super().__init__(
+            f"kv_transfer {what} of {nbytes} bytes exceeds the"
+            f" {limit}-byte frame limit (set {MAX_FRAME_ENV} or pass"
+            f" max_bytes to raise it)"
+        )
+        self.nbytes = nbytes
+        self.limit = limit
+
+
+def max_frame_bytes(override: Optional[int] = None) -> int:
+    """Effective frame budget: explicit override > env > default."""
+    if override is not None:
+        return int(override)
+    raw = os.environ.get(MAX_FRAME_ENV)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return MAX_MSG_BYTES
 
 
 class KVHandoff(NamedTuple):
@@ -138,7 +176,10 @@ def unpack_arrays(
 # -- framing ------------------------------------------------------------------
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
+def _read_exact(sock: socket.socket, n: int,
+                limit: Optional[int] = None) -> bytes:
+    if limit is not None and n > limit:
+        raise FrameTooLargeError("read", n, limit)
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(n - len(buf), 1 << 20))
@@ -160,22 +201,32 @@ def send_msg(sock: socket.socket, header: Dict[str, Any],
     return len(blob)
 
 
-def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+def recv_msg(sock: socket.socket, *,
+             max_bytes: Optional[int] = None) -> Dict[str, Any]:
     """Read one framed header; array payloads (if any) are attached
-    under `_arrays` as numpy views in manifest order."""
+    under `_arrays` as numpy views in manifest order.
+
+    Every length that could trigger an allocation — the header prefix
+    and each manifest entry's byte count — is checked against the frame
+    budget (`max_bytes` > DSTACK_TPU_KV_MAX_FRAME_BYTES > 1 GiB default)
+    BEFORE any read, raising FrameTooLargeError on a corrupt or hostile
+    prefix instead of attempting an unbounded allocation. Array sizes
+    are computed with exact Python ints (math.prod), so a crafted shape
+    cannot wrap around a fixed-width product into a small 'valid' size."""
+    limit = max_frame_bytes(max_bytes)
     (n,) = _LEN.unpack(_read_exact(sock, _LEN.size))
-    if n > MAX_MSG_BYTES:
-        raise ConnectionError(f"kv_transfer header length {n} over limit")
+    if n > limit:
+        raise FrameTooLargeError("header", n, limit)
     header = json.loads(_read_exact(sock, n).decode())
     manifest = header.get("arrays", ())
     buffers = []
     for spec in manifest:
         shape = tuple(int(d) for d in spec["shape"])
         dtype = _np_dtype(spec["dtype"])
-        nbytes = int(np.prod(shape)) * dtype.itemsize
-        if nbytes > MAX_MSG_BYTES:
-            raise ConnectionError(
-                f"kv_transfer array {spec.get('name')} length over limit"
+        nbytes = math.prod(shape) * dtype.itemsize
+        if nbytes > limit:
+            raise FrameTooLargeError(
+                f"array {spec.get('name')!r}", nbytes, limit
             )
         buffers.append(_read_exact(sock, nbytes))
     by_name = unpack_arrays(manifest, tuple(buffers))

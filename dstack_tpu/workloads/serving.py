@@ -863,6 +863,7 @@ class ServingEngine:
         self._cancelled: set = set()
         self._inflight: set = set()
         self._wake = threading.Event()
+        self._hold_admission = False
         self._stop = False
         self._failed: Optional[BaseException] = None
         # Guards the submit-vs-close/failure window: a request must never
@@ -917,6 +918,83 @@ class ServingEngine:
             self._handoff_thread.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def hold_admission(self) -> None:
+        """Gate new-request admission (in-flight work continues).
+
+        A gang-synchronous caller (the RL actor, workloads/rl.py) wraps
+        each rollout round's submits in hold/release so the whole round
+        enters prefill as ONE admission wave. Without the gate the loop
+        thread races the submitting thread: a round may split across
+        admission waves, which changes how many prefill/decode chunks —
+        and therefore how many sampler rng splits — the round consumes,
+        the difference between a bit-reproducible seeded rollout and
+        not. submit() keeps enqueueing normally while held."""
+        self._hold_admission = True
+
+    def release_admission(self) -> None:
+        self._hold_admission = False
+        self._wake.set()
+
+    def refresh_params(self, params: Params) -> int:
+        """Atomically adopt a fresh parameter pytree (RL weight refresh).
+
+        Legal only at an idle boundary: a live slot's KV (and any
+        finalized prefill's first token) was computed under the old
+        weights, so decoding its continuation under new ones yields a
+        sequence that belongs to NEITHER policy — the RL actor's
+        post-hoc behavior-logprob scorer would silently mis-score it.
+        Raises RuntimeError while anything is in flight; callers drain
+        first (the RL actor refreshes between rollout rounds, where the
+        engine is idle by construction).
+
+        The prefix cache is dropped on both tiers — device entries and
+        host-RAM spills — because cached KV embeds the old weights and
+        a post-swap prefix hit would graft stale keys/values under the
+        new policy. LoRA engines refuse: the AdapterRegistry holds
+        base-param references fixed at load time. Returns the number of
+        cache entries dropped."""
+        if self._lora is not None:
+            raise RuntimeError(
+                "refresh_params on a LoRA engine would orphan the"
+                " adapter registry's base-param bindings; rebuild the"
+                " engine instead"
+            )
+        new_leaves, new_tree = jax.tree_util.tree_flatten(params)
+        old_leaves, old_tree = jax.tree_util.tree_flatten(self.params)
+        if new_tree != old_tree or any(
+            tuple(a.shape) != tuple(b.shape)
+            or jnp.dtype(a.dtype) != jnp.dtype(b.dtype)
+            for a, b in zip(new_leaves, old_leaves)
+        ):
+            raise ValueError(
+                "refreshed params do not match the engine's parameter"
+                " tree (structure / leaf shapes / dtypes must be equal)"
+            )
+        with self._lock:
+            busy = (
+                any(r is not None for r in self._live)
+                or self._tasks or self._admitting or self._swapped
+                or self._pending_activation or self._prefilled_pending
+                or self._next_req is not None
+                or not self._pending.empty()
+            )
+            if busy:
+                raise RuntimeError(
+                    "refresh_params requires an idle engine: drain"
+                    " in-flight requests first (a mid-request swap"
+                    " would decode a continuation no single policy"
+                    " generated)"
+                )
+            if self.mesh is not None:
+                params = jax.device_put(
+                    params, serving_param_shardings(self.mesh, params)
+                )
+            self.params = params
+            dropped = self._alloc.drop_cache()
+            if self._host_tier is not None:
+                dropped += self._host_tier.clear()
+        return dropped
 
     def submit(
         self,
@@ -1513,8 +1591,11 @@ class ServingEngine:
         lands. Returns True if anything moved (admission, dispatch, or
         cancel processing)."""
         progressed = False
-        # Admit new requests into the task window.
-        while len(self._tasks) < self.max_prefills_per_chunk:
+        # Admit new requests into the task window (unless a gang-
+        # synchronous caller is holding admission to batch a round of
+        # submits into one wave; in-flight tasks keep dispatching).
+        while (not self._hold_admission
+               and len(self._tasks) < self.max_prefills_per_chunk):
             busy = {t.slot for t in self._tasks}
             with self._lock:
                 req = self._next_req

@@ -1,0 +1,162 @@
+"""Podracer-style RL on dstack-tpu: colocated (Anakin) or split-slice
+(Sebulba) actor/learner gangs on the serving engine.
+
+Two modes:
+
+  --mode anakin    One process: actor and learner alternate on the same
+                   devices (Podracer's Anakin architecture). Runs
+                   anywhere, including CPU — this is the smoke mode.
+
+  --mode sebulba   One process per gang member, role picked by node
+                   rank (Podracer's Sebulba architecture):
+                     rank 0          learner — consumes trajectory
+                                     batches, runs the PPO update,
+                                     publishes weights
+                     ranks 1..N-1    actors — generate rollouts through
+                                     the ServingEngine, pull fresh
+                                     weights between rollouts
+                   The weight-refresh address comes from
+                   DSTACK_TPU_RL_REFRESH_ADDR, which the runner injects
+                   into every gang member (parallel/env.py); the
+                   trajectory sink listens on the next port up on the
+                   same host.
+
+The task toy environment rewards emitting one target token, so the
+reward curve visibly climbs within ~10 updates — enough to watch the
+full actor -> learner -> weight-refresh loop work end to end. Swap
+`TargetTokenEnv` + `tiny_rl_config` for a real env/model to scale up;
+every other moving part (epoch-fenced refresh, gang resize, metrics)
+stays the same. See docs/guides/rl.md.
+"""
+
+import argparse
+import json
+import os
+import time
+
+from dstack_tpu.workloads.rl import (
+    Actor,
+    Learner,
+    RLStats,
+    TargetTokenEnv,
+    TrajectoryClient,
+    TrajectorySink,
+    WeightRefreshClient,
+    WeightRefreshServer,
+    refresh_addr_from_env,
+    rl_prometheus_metrics,
+    run_anakin,
+    tiny_rl_config,
+)
+
+
+def anakin_main(args) -> int:
+    out = run_anakin(
+        tiny_rl_config(),
+        updates=args.updates,
+        batch_size=args.batch,
+        horizon=args.horizon,
+        seed=args.seed,
+        refresh="direct",
+    )
+    print(json.dumps({
+        "mode": "anakin",
+        "rewards": out["rewards"],
+        "env_steps_per_s": round(out["env_steps_per_s"], 2),
+        "learn_step_s_mean": round(out["learn_step_s_mean"], 6),
+        "final_weight_epoch": out["final_weight_epoch"],
+    }, indent=2))
+    return 0
+
+
+def learner_main(args, host: str, port: int) -> int:
+    config = tiny_rl_config()
+    stats = RLStats()
+    gang = max(args.gang_width, 1)
+    refresh = WeightRefreshServer(host="0.0.0.0", port=port)
+    learner = Learner(
+        config, seed=args.seed, learning_rate=2e-2,
+        accum_per_actor=1, gang_width=gang, refresh=refresh, stats=stats,
+    )
+    sink = TrajectorySink("0.0.0.0", port + 1, on_batch=learner.ingest)
+    learner.publish()
+    try:
+        for u in range(args.updates):
+            metrics = learner.update_once(timeout=args.timeout)
+            learner.publish()
+            print(
+                f"update {u}: reward={metrics['reward_mean']:.3f} "
+                f"loss={metrics['loss']:.4f} epoch={learner.weight_epoch}",
+                flush=True,
+            )
+        print(rl_prometheus_metrics(stats.snapshot()))
+    finally:
+        sink.close()
+        refresh.close()
+    return 0
+
+
+def actor_main(args, host: str, port: int, rank: int) -> int:
+    config = tiny_rl_config()
+    stats = RLStats()
+    env = TargetTokenEnv(config.vocab_size, horizon=args.horizon,
+                         seed=args.seed + rank)
+    refresh = WeightRefreshClient(host, port)
+    # Same epoch-0 init as the learner (same seed), so rollouts before
+    # the first refresh already run the learner's policy.
+    from dstack_tpu.workloads.train import init_params
+    import jax
+
+    params = init_params(config, jax.random.PRNGKey(args.seed))
+    actor = Actor(
+        config, params, env, actor_id=rank, batch_size=args.batch,
+        seed=args.seed + 100 * rank, refresh=refresh, stats=stats,
+    )
+    traj = TrajectoryClient(host, port + 1)
+    try:
+        r = 0
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            try:
+                actor.maybe_refresh()
+                traj.send(actor.rollout(round_ix=r))
+            except (ConnectionError, OSError):
+                break  # learner finished (or was resized away) — done
+            r += 1
+    finally:
+        traj.close()
+        actor.close()
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("anakin", "sebulba"), default="anakin")
+    ap.add_argument("--updates", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--horizon", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--gang-width", type=int,
+                    default=int(os.environ.get("DSTACK_NODES_NUM", "2")) - 1,
+                    help="actor count the learner folds per update")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    if args.mode == "anakin":
+        return anakin_main(args)
+
+    addr = refresh_addr_from_env()
+    if addr is None:
+        raise SystemExit(
+            "sebulba mode needs DSTACK_TPU_RL_REFRESH_ADDR (set by the "
+            "runner for gang runs; export host:port manually for local use)"
+        )
+    host, port = addr
+    rank = int(os.environ.get("DSTACK_NODE_RANK", "0"))
+    if rank == 0:
+        return learner_main(args, host, port)
+    return actor_main(args, host, port, rank)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -249,3 +249,31 @@ def test_swap_in_hook_resurrects_chain_and_counts_host_hits():
     assert all(a._ref[b] == 2 for b in blocks)
     st = a.stats()
     assert st["host_hits"] == 1
+
+
+def test_drop_cache_releases_cache_only_holds():
+    """Weight refresh drops the whole prefix cache: cache-only blocks
+    return to the free list, table-held blocks just lose their entry."""
+    a = BlockAllocator(num_blocks=4, block_size=BS)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]  # exactly 2 full blocks
+    table = [a.alloc(), a.alloc()]
+    a.insert_full(prompt, table)
+    a.release(table[0])  # cache-only hold now
+    # table[1] stays table-held (a live request still points at it).
+    assert a.cached == 2
+    dropped = a.drop_cache()
+    assert dropped == 2
+    assert a.cached == 0
+    assert a._ref[table[0]] == 0  # returned to the free list
+    assert a._ref[table[1]] == 1  # the live hold survives
+    # Post-drop, the same prompt must MISS — stale KV never grafts.
+    blocks, matched = a.match(prompt + [9, 10])
+    assert blocks == [] and matched == 0
+    # And the freed block is allocatable again.
+    assert a.alloc() is not None
+
+
+def test_drop_cache_empty_is_noop():
+    a = BlockAllocator(num_blocks=2, block_size=BS)
+    assert a.drop_cache() == 0
+    assert a.drop_cache() == 0  # idempotent

@@ -326,3 +326,22 @@ def test_cancel_while_swapped_out_leaves_zero_residue(params):
     finally:
         engine.close()
     _assert_no_residue(engine)
+
+
+def test_clear_drops_spills_but_keeps_slot_reservations():
+    """Weight refresh wipes the spill tier wholesale; pinned swapped-slot
+    bytes belong to live requests and must survive."""
+    tier = HostKVTier(budget_bytes=1 << 20)
+    for i in range(3):
+        tier.put(("F", bytes([i])),
+                 [("k", np.full((2, 4), i, np.float32))])
+    assert tier.blocks == 3
+    assert tier.reserve(4096)  # a swapped-out slot's pinned payload
+    spill_before = tier.spill_bytes
+    assert spill_before > 0
+    assert tier.clear() == 3
+    assert tier.blocks == 0 and tier.spill_bytes == 0
+    assert tier.get(("F", b"\x00")) is None
+    st = tier.stats()
+    assert st["pinned_bytes"] == 4096  # untouched by clear
+    assert tier.clear() == 0  # idempotent

@@ -15,13 +15,19 @@ import pytest
 
 from conftest import free_port
 from dstack_tpu.workloads.kv_transfer import (
+    MAX_FRAME_ENV,
+    MAX_MSG_BYTES,
+    FrameTooLargeError,
     KVHandoff,
     StaleEpochError,
     TransferClient,
     TransferServer,
+    max_frame_bytes,
+    pack_arrays,
     pack_handoff,
     recv_msg,
     send_msg,
+    unpack_arrays,
     unpack_handoff,
 )
 
@@ -184,3 +190,150 @@ def test_client_reconnects_after_server_side_drop():
     finally:
         client.close()
         server.close()
+
+
+class TestFrameSizeGuard:
+    """A corrupt or hostile length prefix must raise a clean protocol
+    error BEFORE any allocation is attempted — never a MemoryError or a
+    multi-GB read loop (the weight-refresh channel reuses this framing,
+    so a garbage header from a confused peer must not take out a
+    learner or actor)."""
+
+    def test_garbage_header_over_loopback(self):
+        import struct
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli = socket.create_connection(srv.getsockname())
+        conn, _ = srv.accept()
+        try:
+            # 8 random-looking bytes: as a big-endian length this is
+            # ~5.2 exabytes. The reader must refuse it outright.
+            cli.sendall(b"\x48\x65\x6c\x6c\x6f\x21\x21\x21")
+            with pytest.raises(FrameTooLargeError) as e:
+                recv_msg(conn)
+            (expect,) = struct.unpack(">Q", b"\x48\x65\x6c\x6c\x6f\x21\x21\x21")
+            assert e.value.nbytes == expect
+            assert e.value.limit == MAX_MSG_BYTES
+        finally:
+            cli.close(), conn.close(), srv.close()
+
+    def test_oversized_manifest_entry_rejected_before_read(self):
+        """A plausible header can still declare an absurd array. The
+        per-entry check fires before any payload byte is read."""
+        a, b = socket.socketpair()
+        header = {"arrays": [
+            {"name": "w", "shape": [1 << 20, 1 << 20], "dtype": "float32"},
+        ]}
+        t = threading.Thread(target=send_msg, args=(a, header))
+        t.start()
+        try:
+            with pytest.raises(FrameTooLargeError, match="'w'"):
+                recv_msg(b)
+        finally:
+            t.join()
+            a.close(), b.close()
+
+    def test_explicit_limit_param_rejects_small_frames(self):
+        a, b = socket.socketpair()
+        h = _handoff()
+        header, payloads = pack_handoff(h)
+        t = threading.Thread(target=send_msg, args=(a, header, payloads))
+        t.start()
+        try:
+            with pytest.raises(FrameTooLargeError):
+                recv_msg(b, max_bytes=1024)  # k/v arrays are way bigger
+        finally:
+            t.join()
+            a.close(), b.close()
+
+    def test_env_knob_and_precedence(self, monkeypatch):
+        assert max_frame_bytes() == MAX_MSG_BYTES
+        monkeypatch.setenv(MAX_FRAME_ENV, "4096")
+        assert max_frame_bytes() == 4096
+        assert max_frame_bytes(override=128) == 128  # param beats env
+        monkeypatch.setenv(MAX_FRAME_ENV, "not-a-number")
+        assert max_frame_bytes() == MAX_MSG_BYTES  # garbage env ignored
+
+    def test_within_limit_frames_still_flow(self):
+        a, b = socket.socketpair()
+        h = _handoff()
+        header, payloads = pack_handoff(h)
+        t = threading.Thread(target=send_msg, args=(a, header, payloads))
+        t.start()
+        got = unpack_handoff(recv_msg(b, max_bytes=64 << 20))
+        t.join()
+        a.close(), b.close()
+        np.testing.assert_array_equal(got.k, h.k)
+
+
+class TestPackArraysBeyondKV:
+    """pack_arrays/unpack_arrays carry more than KV blocks now: the
+    weight-refresh channel ships whole policy pytrees through them, so
+    mixed dtypes, zero-length arrays, and many-entry manifests must
+    round-trip exactly."""
+
+    def test_mixed_dtype_tree_roundtrip(self):
+        import jax.numpy as jnp  # registers bfloat16 with numpy
+
+        named = [
+            ("f32", np.arange(12, dtype=np.float32).reshape(3, 4)),
+            ("bf16", np.linspace(-2, 2, 8).astype(jnp.bfloat16).reshape(2, 4)),
+            ("i32", np.array([[1, -2], [3, -4]], dtype=np.int32)),
+            ("scalar", np.float32(3.5).reshape(())),
+        ]
+        manifest, buffers = pack_arrays(named)
+        got = unpack_arrays(manifest, buffers)
+        assert list(got) == ["f32", "bf16", "i32", "scalar"]
+        for name, a in named:
+            assert got[name].dtype == a.dtype, name
+            assert got[name].shape == a.shape, name
+            np.testing.assert_array_equal(got[name], a)
+
+    def test_zero_length_arrays(self):
+        named = [
+            ("empty1d", np.zeros((0,), dtype=np.float32)),
+            ("empty2d", np.zeros((4, 0), dtype=np.int32)),
+            ("after", np.ones((2,), dtype=np.float32)),
+        ]
+        manifest, buffers = pack_arrays(named)
+        assert buffers[0] == b"" and buffers[1] == b""
+        got = unpack_arrays(manifest, buffers)
+        assert got["empty1d"].shape == (0,)
+        assert got["empty2d"].shape == (4, 0)
+        np.testing.assert_array_equal(got["after"], [1.0, 1.0])
+
+    def test_policy_pytree_manifest_roundtrip_over_socket(self):
+        """A realistic policy checkpoint (the weight-refresh payload):
+        flatten to named leaves, ship as one frame, rebuild by name."""
+        import jax
+
+        from dstack_tpu.workloads.rl import (
+            named_params,
+            params_from_named,
+            tiny_rl_config,
+        )
+        from dstack_tpu.workloads.train import init_params
+
+        params = init_params(tiny_rl_config(), jax.random.PRNGKey(0))
+        named = named_params(params)
+        manifest, _ = pack_arrays(named)
+        a, b = socket.socketpair()
+        t = threading.Thread(
+            target=send_msg,
+            args=(a, {"kind": "weights", "epoch": 3, "arrays": manifest},
+                  tuple(arr for _, arr in named)),
+        )
+        t.start()
+        got = recv_msg(b)
+        t.join()
+        a.close(), b.close()
+        assert got["epoch"] == 3
+        by_name = dict(zip([s["name"] for s in got["arrays"]], got["_arrays"]))
+        rebuilt = params_from_named(params, by_name)
+        flat_a = jax.tree_util.tree_leaves(params)
+        flat_b = jax.tree_util.tree_leaves(rebuilt)
+        assert len(flat_a) == len(flat_b) and len(flat_a) > 4
+        for x, y in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

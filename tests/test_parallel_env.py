@@ -61,6 +61,26 @@ class TestClusterEnv:
         assert kw["process_id"] == 3
         assert kw["num_processes"] == 4
 
+    def test_rl_refresh_addr_published_to_every_rank(self):
+        """Actors find the learner's weight-refresh channel from env alone
+        — master host, well-known port, same value on every rank."""
+        for rank in range(4):
+            env = make_cluster_env(_cluster(), node_rank=rank)
+            assert env["DSTACK_TPU_RL_REFRESH_ADDR"] == "10.0.0.0:8676"
+
+    def test_rl_refresh_addr_parses_back(self):
+        from dstack_tpu.workloads.rl import refresh_addr_from_env
+
+        env = make_cluster_env(_cluster(), node_rank=1)
+        assert refresh_addr_from_env(env) == ("10.0.0.0", 8676)
+        assert refresh_addr_from_env({}) is None
+
+    def test_rl_refresh_addr_survives_elastic_resize(self):
+        """Rank 0 (the learner host) is never elastically removed, so the
+        refresh address must be identical before and after a shrink."""
+        env = make_elastic_env(_cluster(), node_rank=3, active_ranks=[0, 1, 3])
+        assert env["DSTACK_TPU_RL_REFRESH_ADDR"] == "10.0.0.0:8676"
+
 
 class TestElasticEnv:
     def test_survivors_get_dense_ranks(self):
@@ -98,6 +118,33 @@ class TestRescaleAccum:
     def test_nonpositive_width_raises(self):
         with pytest.raises(ValueError, match="positive"):
             rescale_accum_steps(3, 0, 2)
+        with pytest.raises(ValueError, match="positive"):
+            rescale_accum_steps(3, 4, 0)
+        with pytest.raises(ValueError, match="positive"):
+            rescale_accum_steps(3, 4, -2)
+
+    def test_identity_resize_is_always_legal(self):
+        # Documented contract: old_width == new_width never raises, even
+        # when the width does not divide accum_steps * width evenly for
+        # OTHER widths.
+        for accum, width in [(1, 1), (1, 7), (3, 5), (1000, 13)]:
+            assert rescale_accum_steps(accum, width, width) == accum
+
+    def test_no_rounding_ever(self):
+        # Growing 2 -> 4 with accum=1 would need 0.5 steps; floor (0) or
+        # ceil (1) would silently change the global batch — must raise.
+        with pytest.raises(ValueError, match="divide"):
+            rescale_accum_steps(1, 2, 4)
+        # The exact-quotient neighbours are fine.
+        assert rescale_accum_steps(2, 2, 4) == 1
+        assert rescale_accum_steps(1, 4, 2) == 2
+
+    def test_round_trip_is_identity(self):
+        # shrink-then-grow (the RL drill's preempt + re-admit cycle) must
+        # restore the original accumulation exactly.
+        for accum, old, new in [(3, 4, 2), (1, 2, 1), (6, 4, 8), (5, 3, 15)]:
+            there = rescale_accum_steps(accum, old, new)
+            assert rescale_accum_steps(there, new, old) == accum
 
 
 class TestMeshPlan:
