@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-serving drill-disagg drill-rl bench-rl
+.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-routing bench-serving drill-disagg drill-rl bench-rl
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -70,6 +70,9 @@ capacity-multi:
 # docs/guides/multi-replica.md for how to read them.
 bench-proxy:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_proxy.py --out BENCH_proxy_r09.json
+
+bench-routing:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_routing.py --out BENCH_routing_r18.json
 
 # Serving-engine benchmark: chunked prefill + paged KV with prefix
 # sharing, speculative-decoding arms, the r12 ragged-paged-attention

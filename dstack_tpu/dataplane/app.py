@@ -126,7 +126,11 @@ async def sync_epochs(ctx: DataPlaneContext) -> int:
             changed += 1
     for run_id, (_epoch, run_name, project_id) in ctx.epochs.items():
         if run_id not in seen:
-            ctx.routing_cache.invalidate_run(run_name, project_id=project_id)
+            # The run is gone, not merely re-provisioned: retire its
+            # outage-fallback routes and per-job selection state too.
+            ctx.routing_cache.invalidate_run(
+                run_name, project_id=project_id, retire=True
+            )
             changed += 1
     ctx.epochs = seen
     ctx.last_sync = time.monotonic()
@@ -162,9 +166,37 @@ async def sync_with_retries(ctx: DataPlaneContext) -> bool:
             delay = min(delay * 2, 1.0)
 
 
+async def refresh_sketches(ctx: DataPlaneContext) -> int:
+    """Affinity-sketch gossip leg of the poll cycle: fetch `/v1/affinity`
+    from every replica this worker currently routes to, piggybacking on
+    the epoch-poll cadence so sketch staleness is bounded by one poll
+    interval. Only runs that have actually seen traffic are covered —
+    `sketch_targets()` reflects the lazily populated routing cache, which
+    is exactly the set affinity scoring can ever be asked about. Fetch
+    failures are ignored per replica: a missing sketch just means that
+    replica competes on least-outstanding only."""
+    if not ctx.routing_cache.affinity_enabled:
+        return 0
+    from dstack_tpu.server.services.affinity import fetch_sketch
+
+    updated = 0
+    for job_id, base_url in ctx.routing_cache.sketch_targets().items():
+        payload = await fetch_sketch(
+            ctx.proxy_pool, base_url, settings.ROUTING_SKETCH_TIMEOUT
+        )
+        if payload is not None:
+            ctx.routing_cache.update_sketch(job_id, payload)
+            updated += 1
+    return updated
+
+
 async def _poll_loop(ctx: DataPlaneContext) -> None:
     while True:
         await sync_with_retries(ctx)
+        try:
+            await refresh_sketches(ctx)
+        except Exception:
+            logger.warning("sketch gossip pass failed", exc_info=True)
         await asyncio.sleep(ctx.poll_interval)
 
 
@@ -289,6 +321,22 @@ def create_dataplane_app(
             )
         routing = ctx.routing_cache.stats()
         exp.add("dstack_tpu_proxy_routing_cache_hit_rate", {}, routing["hit_rate"])
+        exp.add(
+            "dstack_tpu_routing_affinity_hits_total", {}, routing["affinity_hits"]
+        )
+        exp.add(
+            "dstack_tpu_routing_affinity_misses_total", {},
+            routing["affinity_misses"],
+        )
+        exp.add(
+            "dstack_tpu_routing_sketch_age_seconds", {},
+            routing["sketch_age_seconds"],
+        )
+        scores = routing["affinity_scores"]
+        exp.add_histogram(
+            "dstack_tpu_routing_affinity_score", {},
+            scores["buckets"], scores["sum"], scores["count"],
+        )
         for h in ctx.tracer.histogram_snapshot():
             exp.add_histogram(
                 histogram_name(h["name"]), h["labels"],

@@ -95,6 +95,17 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_rl_rollout_seconds": ("histogram", ()),
     "dstack_tpu_rl_weight_epoch": ("gauge", ("role",)),
     "dstack_tpu_rl_weight_refreshes_total": ("counter", ("role",)),
+    # Prefix-affinity fleet routing (PR 18, services/routing_cache.py):
+    # affinity pick outcomes (hit = the scoring pass chose the replica,
+    # miss = no fresh sketch matched or the imbalance escape hatch
+    # rejected the winner), the per-decision winning-score distribution
+    # (expected matched blocks + adapter bonus, freshness-decayed), and
+    # the age of the oldest gossiped sketch — the staleness bound the
+    # one-poll gossip cadence promises.
+    "dstack_tpu_routing_affinity_hits_total": ("counter", ()),
+    "dstack_tpu_routing_affinity_misses_total": ("counter", ()),
+    "dstack_tpu_routing_affinity_score": ("histogram", ()),
+    "dstack_tpu_routing_sketch_age_seconds": ("gauge", ()),
     # Serving engine (workloads/serving.py `prometheus_metrics`, exposed
     # by the native model server's /metrics): paged-KV pool occupancy,
     # prefix-cache effectiveness, chunked-prefill accounting, and the

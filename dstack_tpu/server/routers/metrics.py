@@ -104,6 +104,20 @@ async def prometheus_metrics(request: Request):
         )
     routing = ctx.routing_cache.stats()
     exp.add("dstack_tpu_proxy_routing_cache_hit_rate", {}, routing["hit_rate"])
+    # Prefix-affinity routing: pick outcomes, oldest gossiped sketch age,
+    # and the winning-score distribution (matched blocks + adapter bonus).
+    exp.add("dstack_tpu_routing_affinity_hits_total", {}, routing["affinity_hits"])
+    exp.add(
+        "dstack_tpu_routing_affinity_misses_total", {}, routing["affinity_misses"]
+    )
+    exp.add(
+        "dstack_tpu_routing_sketch_age_seconds", {}, routing["sketch_age_seconds"]
+    )
+    scores = routing["affinity_scores"]
+    exp.add_histogram(
+        "dstack_tpu_routing_affinity_score", {},
+        scores["buckets"], scores["sum"], scores["count"],
+    )
     # Sharded FSM: how many lease shards this replica's processors scan.
     # 0 on an inactive (single-replica) shard map; the chaos shard-kill
     # drill asserts the survivors' sum returns to FSM_SHARDS.

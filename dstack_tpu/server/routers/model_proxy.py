@@ -26,6 +26,7 @@ from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Router
 from dstack_tpu.server.routers.deps import get_ctx
 from dstack_tpu.server.routers.services_proxy import pick_replica
+from dstack_tpu.server.services.affinity import AffinityRequest
 from dstack_tpu.utils.tracecontext import (
     REQUEST_ID_HEADER,
     TRACEPARENT_HEADER,
@@ -125,8 +126,19 @@ async def chat_completions(request: Request, project_name: str):
                 headers={"retry-after": str(max(1, int(e.retry_after + 0.5)))},
             )
     t0 = time.monotonic()
+    # Cache-affinity selection: the router hashes the request's prompt
+    # into the engine's prefix chain keys and prefers a replica whose
+    # gossiped sketch shows those blocks resident. `base:adapter` model
+    # ids additionally steer toward adapter-resident replicas so a pick
+    # never forces an adapter swap another replica could avoid.
+    affinity = AffinityRequest(
+        messages=body.get("messages", ()) or (),
+        adapter=match.get("adapter"),
+    )
     try:
-        target = await pick_replica(ctx, project_name, match["run_name"])
+        target = await pick_replica(
+            ctx, project_name, match["run_name"], affinity=affinity
+        )
     except Exception:
         # Demand against a service with no live replica still counts as
         # RPS — it is exactly the scale-from-zero wake signal.

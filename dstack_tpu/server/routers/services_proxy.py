@@ -14,6 +14,7 @@ circuit breaker and, for idempotent methods (no bytes reached the app),
 is retried once on the next replica.
 """
 
+import asyncio
 import logging
 import re
 import time
@@ -48,15 +49,20 @@ _IDEMPOTENT_METHODS = {"GET", "HEAD", "OPTIONS"}
 _CONNECT_ERRORS = (httpx.ConnectError, httpx.ConnectTimeout)
 
 
-async def pick_replica(ctx, project_name: str, run_name: str, exclude=()) -> ReplicaTarget:
+async def pick_replica(
+    ctx, project_name: str, run_name: str, exclude=(), affinity=None
+) -> ReplicaTarget:
     """A RUNNING replica of the service, via the routing cache
-    (least-outstanding, circuit-breaker aware)."""
-    target, _stale = await pick_replica_ex(ctx, project_name, run_name, exclude=exclude)
+    (least-outstanding, circuit-breaker aware; cache-affinity scored when
+    the caller passes an `AffinityRequest`)."""
+    target, _stale = await pick_replica_ex(
+        ctx, project_name, run_name, exclude=exclude, affinity=affinity
+    )
     return target
 
 
 async def pick_replica_ex(
-    ctx, project_name: str, run_name: str, exclude=()
+    ctx, project_name: str, run_name: str, exclude=(), affinity=None
 ) -> "tuple[ReplicaTarget, bool]":
     """pick_replica plus the routing-cache staleness flag: True means the
     control plane was unreachable and the target comes from the last-known
@@ -64,10 +70,47 @@ async def pick_replica_ex(
     targets, stale = await ctx.routing_cache.get_replicas_ex(
         ctx, project_name, run_name
     )
+    if affinity is not None and ctx.routing_cache.affinity_enabled:
+        _spawn_sketch_refresh(ctx, targets)
     return (
-        ctx.routing_cache.select(project_name, run_name, targets, exclude=exclude),
+        ctx.routing_cache.select(
+            project_name, run_name, targets, exclude=exclude, affinity=affinity
+        ),
         stale,
     )
+
+
+# Strong references to in-flight refresh tasks: asyncio only weakly
+# holds tasks, and a GC'd refresh would silently never land.
+_REFRESH_TASKS = set()
+
+
+def _spawn_sketch_refresh(ctx, targets) -> None:
+    """Lazy gossip for surfaces without a poll loop (the in-server
+    control-plane proxy): fire-and-forget sketch fetches for replicas
+    whose sketch is absent or past half its max age. The pick that
+    triggered the refresh proceeds on whatever sketches exist — a sketch
+    fetch must never sit on the request path. `sketch_refresh_due`
+    rate-limits so concurrent picks do not stampede a replica."""
+    from dstack_tpu.server.services.affinity import fetch_sketch
+
+    if len(targets) < 2:
+        return  # a 1-replica pool never reaches the scoring pass
+    due = [t for t in targets if ctx.routing_cache.sketch_refresh_due(t.job_id)]
+    if not due:
+        return
+
+    async def _refresh():
+        for t in due:
+            payload = await fetch_sketch(
+                ctx.proxy_pool, t.base_url, settings.ROUTING_SKETCH_TIMEOUT
+            )
+            if payload is not None:
+                ctx.routing_cache.update_sketch(t.job_id, payload)
+
+    task = asyncio.get_event_loop().create_task(_refresh())
+    _REFRESH_TASKS.add(task)
+    task.add_done_callback(_REFRESH_TASKS.discard)
 
 
 def request_headers(request: Request):

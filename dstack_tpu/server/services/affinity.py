@@ -1,0 +1,160 @@
+"""Router-side cache-affinity keys: the request's prefix chain digests.
+
+The serving engine's prefix cache keys full KV blocks by a sha1 chain
+over block-size token windows, seeded with the tenant namespace
+(workloads/kv_blocks.py: `_chain_hash` / `BlockAllocator._ns_seed`).
+Replicas export the digests of their RESIDENT chain heads as an
+**affinity sketch** (engine `affinity_sketch()`, served on the native
+server's `GET /v1/affinity`); a router that recomputes the same chain
+over the same block boundaries can score each replica by how many
+leading blocks of a request's prompt it would serve from cache —
+without a round trip to any engine.
+
+Tokenizer consistency is the whole game: the digests only align if the
+router renders the prompt and tokenizes it EXACTLY like the engine. The
+native server uses a byte-level tokenizer with power-of-two prompt
+bucketing; its `/v1/affinity` payload carries the parameters
+(`vocab_size`, `prompt_limit`, `min_bucket`) so this module can mirror
+`Engine.encode` byte-for-byte. The hash helpers here are deliberate
+mirrors of workloads/kv_blocks.py rather than imports — the dataplane
+worker must not pull jax in just to hash bytes — and
+tests/server/test_routing_affinity.py pins them against the allocator's
+own chain so the two cannot drift silently.
+"""
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+# Hex chars of sha1 kept per digest; mirrors BlockAllocator.DIGEST_HEX.
+DIGEST_HEX = 16
+
+
+def ns_seed(namespace: bytes) -> bytes:
+    """Chain seed for a tenant namespace — mirror of
+    BlockAllocator._ns_seed (hashed so a crafted adapter name cannot
+    alias another namespace's digest; empty keeps the legacy chain)."""
+    if not namespace:
+        return b""
+    return hashlib.sha1(b"ns:" + namespace).digest()
+
+
+def chain_hash(parent: bytes, block_tokens: Sequence[int]) -> bytes:
+    """sha1 chain over block contents — mirror of kv_blocks._chain_hash
+    (a block's key commits to every token before it)."""
+    return hashlib.sha1(
+        parent + repr(tuple(block_tokens)).encode()
+    ).digest()
+
+
+def render_prompt(messages: Sequence[Dict[str, Any]]) -> str:
+    """The native server's chat prompt rendering, byte-for-byte
+    (examples/deployment/native/server.py `chat_stream`)."""
+    prompt = "\n".join(
+        f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages
+    )
+    return prompt + "\nassistant:"
+
+
+def encode_bytes(
+    text: str, vocab_size: int, prompt_limit: int, min_bucket: int
+) -> List[int]:
+    """The native server's byte tokenizer + power-of-two prompt
+    bucketing, mirrored from `Engine.encode`: bytes clamped to the
+    vocab, truncated to the prompt budget keeping the NEWEST bytes,
+    short prompts left-padded with newline bytes up to the bucket."""
+    ids = [min(b, vocab_size - 1) for b in text.encode()] or [0]
+    ids = ids[-prompt_limit:] if prompt_limit > 0 else ids[:1]
+    bucket = min_bucket
+    while bucket * 2 <= len(ids):
+        bucket *= 2
+    bucket = min(bucket, prompt_limit if prompt_limit > 0 else bucket)
+    if len(ids) < bucket:
+        ids = [10] * (bucket - len(ids)) + ids
+    else:
+        ids = ids[-bucket:]
+    return ids
+
+
+def chain_digests(
+    tokens: Sequence[int],
+    block_size: int,
+    namespace: bytes = b"",
+    digest_hex: int = DIGEST_HEX,
+) -> List[str]:
+    """Full-block chain-head digests of a token sequence, in chain
+    order. Only blocks the engine's `match()` could actually serve are
+    emitted: at least one trailing token always stays uncovered (the
+    prefill must compute the last position's logits to sample)."""
+    if block_size < 1:
+        return []
+    limit = len(tokens) - 1
+    h = ns_seed(namespace)
+    digests: List[str] = []
+    matched = 0
+    while matched + block_size <= limit:
+        h = chain_hash(h, tokens[matched:matched + block_size])
+        digests.append(h.hex()[:digest_hex])
+        matched += block_size
+    return digests
+
+
+@dataclass
+class AffinityRequest:
+    """What the proxy knows about a request before selection: the chat
+    messages (to render + hash once per candidate parameter set) and
+    the adapter the `base:adapter` model id names, if any. Digest
+    computation is deferred to selection time because the chain depends
+    on per-replica sketch parameters (block size, tokenizer)."""
+
+    messages: Sequence[Dict[str, Any]] = ()
+    adapter: Optional[str] = None
+    # (block_size, vocab_size, prompt_limit, min_bucket) -> digests;
+    # replicas of one run share parameters, so this memoizes to one
+    # chain computation per request in practice.
+    _digest_cache: Dict[tuple, List[str]] = field(default_factory=dict)
+
+    def digests(
+        self,
+        block_size: int,
+        vocab_size: int,
+        prompt_limit: int,
+        min_bucket: int,
+    ) -> List[str]:
+        key = (block_size, vocab_size, prompt_limit, min_bucket)
+        cached = self._digest_cache.get(key)
+        if cached is None:
+            tokens = encode_bytes(
+                render_prompt(self.messages),
+                vocab_size, prompt_limit, min_bucket,
+            )
+            cached = chain_digests(
+                tokens, block_size,
+                namespace=(self.adapter or "").encode(),
+            )
+            self._digest_cache[key] = cached
+        return cached
+
+
+async def fetch_sketch(
+    proxy_pool, base_url: str, timeout: float
+) -> Optional[Dict[str, Any]]:
+    """One replica's affinity sketch off `GET /v1/affinity`, via the
+    shared keep-alive pool. Any failure returns None — a missing
+    sketch only means the router falls back to least-outstanding for
+    that replica, so sketch fetches must never fail a request path."""
+    import httpx
+
+    client = proxy_pool.acquire(base_url)
+    try:
+        resp = await client.get(f"{base_url}/v1/affinity", timeout=timeout)
+        if resp.status_code != 200:
+            return None
+        payload = resp.json()
+        if not isinstance(payload, dict) or "digests" not in payload:
+            return None
+        return payload
+    except (httpx.HTTPError, ValueError):
+        return None
+    finally:
+        proxy_pool.release(base_url)

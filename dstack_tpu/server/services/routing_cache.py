@@ -26,14 +26,35 @@ per-run least-outstanding-requests (long SSE generations pin a replica;
 new requests flow to the idlest one) with a per-run rotation tie-break
 and a connect-error circuit breaker: a replica that just refused a
 connection is skipped for a cooldown unless every replica tripped.
+
+Prefix-affinity routing (PR 18) rides on top: replicas gossip their
+**affinity sketch** — resident prefix chain-head digests + the loaded
+adapter set (`update_sketch`, fed by the dataplane epoch-poll loop and
+the in-server refresh hook) — and `select()` scores candidates by the
+expected number of prompt blocks each would serve from its prefix cache
+(`services/affinity.py` recomputes the engine's chain keys router-side)
+plus adapter residency. Scores decay linearly with sketch age (a
+restarted replica's stale sketch stops attracting traffic within
+`ROUTING_SKETCH_MAX_AGE`), and a load-imbalance escape hatch abandons
+the affinity winner for plain least-outstanding once it runs
+`ROUTING_IMBALANCE_MAX` requests hotter than the idlest candidate — a
+hot prefix must never stack onto an overloaded replica. With no sketch,
+no match, or affinity disabled, selection is bit-for-bit the old
+least-outstanding policy.
 """
 
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.server.tracing import HistogramData
+
+# Score-histogram ladder in expected-matched-block units (not seconds):
+# 0 = adapter-only or empty wins, the top buckets are long shared
+# prefixes and adapter-residency bonuses.
+_SCORE_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 
 @dataclass(frozen=True)
@@ -77,13 +98,32 @@ class RoutingCache:
         # served (flagged stale) when the control-plane DB is unreachable so
         # a data-plane worker keeps routing live traffic through an outage.
         self._fallback: Dict[Tuple[str, str], List[ReplicaTarget]] = {}
+        # project -> last successfully loaded model list, never expired:
+        # same outage policy as `_fallback` for the /models surface.
+        self._models_fallback: Dict[str, List[Dict[str, Any]]] = {}
         self._outstanding: Dict[str, int] = {}  # job_id -> in-flight requests
         self._breaker: Dict[str, float] = {}  # job_id -> skip until (monotonic)
         self._rr: Dict[Tuple[str, str], int] = {}  # per-run tie-break rotation
+        # job_id -> (fetched_at monotonic, digest frozenset, adapter
+        # frozenset, chain params dict) — the gossiped affinity sketches.
+        self._sketches: Dict[
+            str, Tuple[float, FrozenSet[str], FrozenSet[str], Dict[str, int]]
+        ] = {}
+        # job_id -> last refresh attempt (monotonic); rate-limits the lazy
+        # fire-and-forget gossip the control-plane pick path triggers.
+        self._sketch_attempts: Dict[str, float] = {}
+        self.affinity_enabled = settings.ROUTING_AFFINITY
+        self.imbalance_max = settings.ROUTING_IMBALANCE_MAX
+        self.sketch_max_age = settings.ROUTING_SKETCH_MAX_AGE
+        self.sketch_limit = settings.ROUTING_SKETCH_LIMIT
+        self.adapter_bonus = settings.ROUTING_ADAPTER_BONUS
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
         self.stale_serves = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self._affinity_scores = HistogramData(buckets=_SCORE_BUCKETS)
 
     # ------------------------------------------------------------- lookups
 
@@ -174,21 +214,42 @@ class RoutingCache:
         return targets, project_row["id"]
 
     async def get_models(self, ctx, project_name: str) -> List[Dict[str, Any]]:
+        models, _stale = await self.get_models_ex(ctx, project_name)
+        return models
+
+    async def get_models_ex(
+        self, ctx, project_name: str
+    ) -> Tuple[List[Dict[str, Any]], bool]:
+        """Model list plus a staleness flag — the same outage policy as
+        `get_replicas_ex`: authoritative answers (no such project)
+        propagate, infrastructure failures serve the last-known list so
+        /models and model-name resolution survive a control-plane blip."""
         now = time.monotonic()
         with self._lock:
             entry = self._models.get(project_name)
             if entry is not None and entry[0] > now:
                 self.hits += 1
-                return entry[1]
+                return entry[1], False
             self.misses += 1
-        models, project_id = await self._load_models(ctx, project_name)
+        try:
+            models, project_id = await self._load_models(ctx, project_name)
+        except (BadRequestError, ResourceNotExistsError):
+            raise
+        except Exception:
+            with self._lock:
+                fallback = self._models_fallback.get(project_name)
+                if fallback is not None:
+                    self.stale_serves += 1
+                    return fallback, True
+            raise
         with self._lock:
             self._models[project_name] = (
                 time.monotonic() + self.ttl,
                 models,
                 project_id,
             )
-        return models
+            self._models_fallback[project_name] = models
+        return models, False
 
     async def _load_models(
         self, ctx, project_name: str
@@ -242,8 +303,11 @@ class RoutingCache:
         run_name: str,
         targets: Sequence[ReplicaTarget],
         exclude: Sequence[str] = (),
+        affinity=None,
     ) -> ReplicaTarget:
-        """Least-outstanding replica, per-run rotation tie-break.
+        """Least-outstanding replica, per-run rotation tie-break — with a
+        cache-affinity scoring pass in front when the request carries an
+        `AffinityRequest` and sketches are known.
 
         `exclude` removes replicas already tried this request (the
         idempotent-retry path). Circuit-broken replicas are skipped
@@ -260,11 +324,134 @@ class RoutingCache:
                 del self._breaker[job_id]
             live = [t for t in candidates if t.job_id not in self._breaker]
             pool = live or candidates
+            if affinity is not None and self.affinity_enabled and len(pool) > 1:
+                choice = self._select_affinity(pool, affinity, now)
+                if choice is not None:
+                    return choice
             lowest = min(self._outstanding.get(t.job_id, 0) for t in pool)
             tied = [t for t in pool if self._outstanding.get(t.job_id, 0) == lowest]
             key = (project_name, run_name)
             self._rr[key] = self._rr.get(key, -1) + 1
             return tied[self._rr[key] % len(tied)]
+
+    def _select_affinity(self, pool, affinity, now) -> Optional[ReplicaTarget]:
+        """Affinity winner, or None to fall through to least-outstanding.
+        Caller holds the lock.
+
+        Score = consecutive leading prompt blocks resident on the replica
+        (chain digests recomputed router-side, matched against the
+        gossiped sketch) plus an adapter-residency bonus, the whole thing
+        scaled by a linear freshness decay so a sketch at
+        `sketch_max_age` is worth nothing. Ties prefer the idler replica.
+        The imbalance escape hatch rejects a winner running more than
+        `imbalance_max` requests hotter than the idlest candidate."""
+        best = None
+        best_key = (0.0, 0)
+        for t in pool:
+            entry = self._sketches.get(t.job_id)
+            if entry is None:
+                continue
+            fetched_at, digests, adapters, params = entry
+            age = now - fetched_at
+            if age < 0 or age >= self.sketch_max_age:
+                continue
+            score = 0.0
+            for d in affinity.digests(**params):
+                if d not in digests:
+                    break
+                score += 1.0
+            if affinity.adapter is not None and affinity.adapter in adapters:
+                score += self.adapter_bonus
+            score *= 1.0 - age / self.sketch_max_age
+            if score <= 0.0:
+                continue
+            key = (score, -self._outstanding.get(t.job_id, 0))
+            if key > best_key:
+                best_key, best = key, t
+        if best is None:
+            self.affinity_misses += 1
+            return None
+        lowest = min(self._outstanding.get(t.job_id, 0) for t in pool)
+        if self._outstanding.get(best.job_id, 0) - lowest > self.imbalance_max:
+            # Hot-prefix flood: the cache winner is already running way
+            # hotter than the idlest replica — spread instead of stack.
+            self.affinity_misses += 1
+            return None
+        self.affinity_hits += 1
+        self._affinity_scores.observe(best_key[0])
+        return best
+
+    # ------------------------------------------------------------- sketches
+
+    def update_sketch(self, job_id: str, payload: Dict[str, Any]) -> None:
+        """Install a replica's gossiped affinity sketch. Unusable payloads
+        (non-byte tokenizer, missing chain parameters) are dropped — the
+        replica simply never wins the affinity pass."""
+        tok = payload.get("tokenizer") or {}
+        if tok.get("kind", "byte") != "byte":
+            return
+        try:
+            params = {
+                "block_size": int(payload.get("block_size") or 0),
+                "vocab_size": int(tok.get("vocab_size") or 0),
+                "prompt_limit": int(tok.get("prompt_limit") or 0),
+                "min_bucket": int(tok.get("min_bucket") or 0),
+            }
+        except (TypeError, ValueError):
+            return
+        if min(params.values()) < 1:
+            return
+        raw = list(payload.get("digests") or ())
+        # MRU digests ride at the tail of the export; keep those when the
+        # router's bound is tighter than the replica's.
+        digests = frozenset(
+            d for d in raw[-self.sketch_limit:] if isinstance(d, str)
+        )
+        adapters = frozenset(
+            a for a in (payload.get("adapters") or ()) if isinstance(a, str)
+        )
+        with self._lock:
+            self._sketches[job_id] = (time.monotonic(), digests, adapters, params)
+
+    def sketch_targets(self) -> Dict[str, str]:
+        """job_id -> base_url for every replica this cache can currently
+        route to (live entries plus outage fallbacks): the refresh set
+        the gossip loop fetches sketches for."""
+        with self._lock:
+            out: Dict[str, str] = {}
+            for _, targets, _ in self._replicas.values():
+                for t in targets:
+                    out[t.job_id] = t.base_url
+            for targets in self._fallback.values():
+                for t in targets:
+                    out.setdefault(t.job_id, t.base_url)
+            return out
+
+    def sketch_age(self, job_id: str) -> Optional[float]:
+        """Seconds since the replica's sketch was fetched, None if absent."""
+        with self._lock:
+            entry = self._sketches.get(job_id)
+            if entry is None:
+                return None
+            return max(0.0, time.monotonic() - entry[0])
+
+    def sketch_refresh_due(self, job_id: str) -> bool:
+        """True when the replica's sketch should be (re)fetched: absent or
+        past half its max age, and no attempt in the last second (the
+        floor keeps concurrent picks from stampeding one replica and
+        bounds retries against a replica whose endpoint is failing).
+        Recording the attempt here, under the lock, is what makes the
+        fire-and-forget refresh path race-free."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._sketches.get(job_id)
+            if entry is not None and now - entry[0] < self.sketch_max_age / 2:
+                return False
+            last = self._sketch_attempts.get(job_id)
+            if last is not None and now - last < 1.0:
+                return False
+            self._sketch_attempts[job_id] = now
+            return True
 
     def start(self, job_id: str) -> None:
         with self._lock:
@@ -290,7 +477,7 @@ class RoutingCache:
     # --------------------------------------------------------- maintenance
 
     def invalidate_run(
-        self, run_name: str, project_id: Optional[str] = None
+        self, run_name: str, project_id: Optional[str] = None, retire: bool = False
     ) -> None:
         """FSM/epoch hook: a job of `run_name` changed status. Replica
         entries for that run are dropped, and the model list of the run's
@@ -299,7 +486,17 @@ class RoutingCache:
         `project_id` scopes the drop: without it a same-named run in
         another project would lose its (perfectly valid) routes and every
         project's model list would rebuild. Callers that do not know the
-        project (legacy) still get the old clear-everything behavior."""
+        project (legacy) still get the old clear-everything behavior.
+
+        Selection state is pruned with the routes: the run's `_rr`
+        rotation counters always go (they are mere tie-breaks, rebuilt on
+        demand), and `_outstanding` / `_breaker` / sketch entries go for
+        any job_id no surviving route references — a long-lived dataplane
+        worker must not accrete per-job state for replicas the FSM
+        retired long ago. `retire=True` (the run disappeared entirely,
+        e.g. deleted — dataplane sync passes it) additionally drops the
+        run's outage fallback routes; a plain epoch bump keeps them so an
+        outage mid-redeploy still has somewhere to send traffic."""
         with self._lock:
             stale = [
                 k
@@ -307,8 +504,30 @@ class RoutingCache:
                 if k[1] == run_name
                 and (project_id is None or entry[2] == project_id)
             ]
+            dropped_jobs = set()
             for key in stale:
+                dropped_jobs.update(t.job_id for t in self._replicas[key][1])
                 del self._replicas[key]
+            # Rotation counters are keyed (project NAME, run) while entries
+            # carry project IDs, so prune by run name alone: resetting a
+            # same-named run's tie-break in another project is harmless.
+            for key in [k for k in self._rr if k[1] == run_name]:
+                del self._rr[key]
+            if retire:
+                fb_stale = [k for k in self._fallback if k[1] == run_name]
+                for key in fb_stale:
+                    dropped_jobs.update(t.job_id for t in self._fallback[key])
+                    del self._fallback[key]
+            survivors = set()
+            for _, targets, _ in self._replicas.values():
+                survivors.update(t.job_id for t in targets)
+            for targets in self._fallback.values():
+                survivors.update(t.job_id for t in targets)
+            for job_id in dropped_jobs - survivors:
+                self._outstanding.pop(job_id, None)
+                self._breaker.pop(job_id, None)
+                self._sketches.pop(job_id, None)
+                self._sketch_attempts.pop(job_id, None)
             if project_id is None:
                 dropped_models = bool(self._models)
                 self._models.clear()
@@ -324,8 +543,9 @@ class RoutingCache:
             if stale or dropped_models:
                 self.invalidations += 1
 
-    def stats(self) -> Dict[str, float]:
+    def stats(self) -> Dict[str, Any]:
         with self._lock:
+            now = time.monotonic()
             total = self.hits + self.misses
             return {
                 "replica_entries": len(self._replicas),
@@ -337,4 +557,12 @@ class RoutingCache:
                 "invalidations": self.invalidations,
                 "stale_serves": self.stale_serves,
                 "hit_rate": (self.hits / total) if total else 0.0,
+                "affinity_hits": self.affinity_hits,
+                "affinity_misses": self.affinity_misses,
+                "sketch_entries": len(self._sketches),
+                # Oldest sketch age — the gauge the staleness bound pins.
+                "sketch_age_seconds": max(
+                    (now - e[0] for e in self._sketches.values()), default=0.0
+                ),
+                "affinity_scores": self._affinity_scores.to_dict(),
             }

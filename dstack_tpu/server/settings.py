@@ -136,6 +136,41 @@ PROXY_ROUTING_TTL = float(os.getenv("DSTACK_TPU_PROXY_ROUTING_TTL", "3.0"))
 # selection (circuit breaker; it is retried once all replicas trip).
 PROXY_BREAKER_COOLDOWN = float(os.getenv("DSTACK_TPU_PROXY_BREAKER_COOLDOWN", "5.0"))
 
+# Prefix-affinity fleet routing (services/affinity.py + routing_cache):
+# score replicas by resident-prefix chain digests + adapter residency
+# before falling back to least-outstanding. Off ("0") restores the pure
+# least-outstanding policy bit-for-bit.
+ROUTING_AFFINITY = (
+    os.getenv("DSTACK_TPU_ROUTING_AFFINITY", "1").lower()
+    in ("1", "true", "yes")
+)
+# Load-imbalance escape hatch: the affinity winner is abandoned for
+# least-outstanding once it carries this many more in-flight requests
+# than the idlest candidate — affinity must never starve a replica or
+# stack a hot prefix onto an overloaded one.
+ROUTING_IMBALANCE_MAX = int(os.getenv("DSTACK_TPU_ROUTING_IMBALANCE", "4"))
+# A sketch's score decays linearly with its age and reaches zero here:
+# a restarted replica's stale sketch stops attracting traffic within
+# this bound even if gossip stalls. Keep it a few × the refresh cadence
+# (the epoch-poll interval on dataplane workers).
+ROUTING_SKETCH_MAX_AGE = float(
+    os.getenv("DSTACK_TPU_ROUTING_SKETCH_MAX_AGE", "10.0")
+)
+# Digests kept per replica sketch (engines bound the export the same
+# way: most-recently-used chain heads win).
+ROUTING_SKETCH_LIMIT = int(os.getenv("DSTACK_TPU_ROUTING_SKETCH_LIMIT", "512"))
+# Adapter-residency weight, in expected-matched-block equivalents: a
+# replica with the request's adapter already loaded outscores a forced
+# `POST /v1/adapters` load unless another replica beats it by this many
+# cached blocks.
+ROUTING_ADAPTER_BONUS = float(
+    os.getenv("DSTACK_TPU_ROUTING_ADAPTER_BONUS", "64")
+)
+# Per-replica GET /v1/affinity budget during sketch gossip.
+ROUTING_SKETCH_TIMEOUT = float(
+    os.getenv("DSTACK_TPU_ROUTING_SKETCH_TIMEOUT", "2.0")
+)
+
 # Standalone data-plane workers (dstack_tpu/dataplane). The epoch poll
 # interval is the route-staleness bound after an FSM transition on any
 # replica; the sync deadline caps how long one poll cycle retries the

@@ -367,6 +367,28 @@ class BlockAllocator:
         self._cache.clear()
         return n
 
+    # Affinity-sketch digest width: 16 hex chars (64 bits) of the sha1
+    # chain hash — far beyond collision range for the few hundred
+    # resident blocks a sketch carries, at a fifth of the wire size.
+    DIGEST_HEX = 16
+
+    def affinity_digests(self, limit: int = 512) -> List[str]:
+        """Resident full-block chain-head digests for the routing
+        affinity sketch, most-recently-used last, bounded to the `limit`
+        hottest entries (OrderedDict insertion/move order IS the LRU
+        order). Partial-tail entries are excluded — a router cannot
+        reconstruct their tail-token keys, and a tail never anchors a
+        longer chain anyway. Digests already commit to the tenant
+        namespace (insert_full seeds the chain with _ns_seed), so a
+        sketch can be published without leaking cross-tenant equality:
+        equal digests require equal namespace AND equal tokens."""
+        digests = [
+            key[1].hex()[: self.DIGEST_HEX]
+            for key in self._cache
+            if key[0] == "F"
+        ]
+        return digests[-limit:]
+
     def stats(self) -> Dict[str, int]:
         return {
             "blocks_total": self.num_blocks,

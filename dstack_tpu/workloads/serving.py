@@ -1238,6 +1238,33 @@ class ServingEngine:
         with self._lock:
             return {} if self._lora is None else self._lora.loaded()
 
+    def affinity_sketch(self, limit: int = 512) -> Dict[str, Any]:
+        """Cache-affinity sketch for fleet routing: the bounded set of
+        resident prefix chain-head digests (device pool + host tier,
+        namespace-seeded exactly as BlockAllocator._ns_seed chains them)
+        plus the loaded-adapter set. A router that recomputes the same
+        chain over the same block-size boundaries can score this replica
+        by expected matched blocks without touching the engine. Bounded
+        and O(cached blocks); taken under the engine lock so the digest
+        set is a consistent snapshot of the allocator."""
+        with self._lock:
+            device = self._alloc.affinity_digests(limit)
+            host = (
+                self._host_tier.affinity_digests(limit)
+                if self._host_tier is not None else []
+            )
+            adapters = [] if self._lora is None else sorted(self._lora.loaded())
+        # Device digests win the bound (they serve a match without a
+        # swap-in); host-tier digests fill whatever room remains. Order
+        # is irrelevant to the router — it scores by set membership.
+        seen = set(device)
+        merged = (device + [d for d in host if d not in seen])[:limit]
+        return {
+            "block_size": self._block_size,
+            "digests": merged,
+            "adapters": adapters,
+        }
+
     def _release_adapter(self, out) -> None:
         """Drop a request's adapter ref (idempotent; caller holds _lock).
         Every terminal path — retire, cancel, drop, force-retire, flush —
@@ -1391,6 +1418,10 @@ class ServingEngine:
             # dstack_tpu_serving_phase_seconds.
             "trace": self.recorder.stats(),
             "phase_hists": self.recorder.phase_histograms(),
+            # Cache-affinity sketch (PR 18): resident prefix chain-head
+            # digests + loaded adapters, the payload fleet routers score
+            # replicas by (also served on GET /v1/affinity).
+            "affinity": self.affinity_sketch(),
         }
 
     def request_trace(self, key: Any) -> Optional[Dict[str, Any]]:

@@ -663,6 +663,18 @@ def main() -> None:
     # collect. Streams are parked until claimed; a claim is exclusive.
     handoff_streams = {}
     handoff_lock = threading.Lock()
+
+    # Affinity-sketch gossip is pull-based: every data-plane worker
+    # fetches /v1/affinity once per epoch poll, so the cost of serving
+    # it scales with fleet-wide worker count x poll rate. A short TTL
+    # cache bounds that cost at one sketch build per TTL no matter how
+    # many workers poll, and keeps gossip from contending with
+    # generation steps on a busy engine. Staleness it adds (<= the TTL)
+    # is far inside the one-poll-interval staleness bound routers
+    # already tolerate.
+    sketch_cache = {"at": 0.0, "body": None}
+    sketch_cache_ttl = 0.25
+    sketch_lock = threading.Lock()
     transfer_server = None
     if args.role == "decode":
         from dstack_tpu.workloads.kv_transfer import TransferServer
@@ -840,6 +852,35 @@ def main() -> None:
                         })
                 return self._send(200, {"object": "list", "data": data})
             path, _, query = self.path.partition("?")
+            if path.rstrip("/") == "/v1/affinity":
+                # Cache-affinity sketch for fleet routing: resident
+                # prefix chain-head digests + loaded adapters, plus the
+                # tokenizer parameters a router needs to recompute the
+                # SAME chain keys over the SAME block boundaries
+                # (tokenizer-consistency is what makes the scores mean
+                # "expected matched blocks"). Cheap: no device work,
+                # one pass over the host-side cache index, served from a
+                # short TTL cache so N polling workers cost one build.
+                with sketch_lock:
+                    now = time.monotonic()
+                    if (sketch_cache["body"] is None
+                            or now - sketch_cache["at"] > sketch_cache_ttl):
+                        sketch_cache["body"] = {
+                            **engine.serving.affinity_sketch(),
+                            "model": args.model_name,
+                            "tokenizer": {
+                                "kind": "byte",
+                                "vocab_size": engine.config.vocab_size,
+                                "prompt_limit": (
+                                    engine.config.max_seq_len
+                                    - engine.max_new_tokens
+                                ),
+                                "min_bucket": Engine.MIN_BUCKET,
+                            },
+                        }
+                        sketch_cache["at"] = now
+                    body = sketch_cache["body"]
+                return self._send(200, body)
             if path.rstrip("/") == "/metrics":
                 # Queue depth, shed counters, and paged-KV pool gauges
                 # for scrapers and the control plane's autoscaler

@@ -189,3 +189,83 @@ async def test_worker_app_readiness_and_degraded_serving(tmp_path):
         await app.shutdown()
         upstream.close()
         await upstream.wait_closed()
+
+
+async def test_sketch_gossip_rides_epoch_poll(tmp_path):
+    """Affinity-sketch gossip (PR 18): the worker's poll loop fetches
+    `/v1/affinity` from every replica it routes to, so sketch staleness
+    is bounded by one poll interval — and the worker's /metrics exposes
+    the affinity series. The replica here answers the sketch endpoint
+    the way the native server does (digests + tokenizer parameters)."""
+    from dstack_tpu.server.services.affinity import AffinityRequest
+
+    messages = [{"role": "user", "content": "gossip corpus " * 30}]
+    req = AffinityRequest(messages=messages)
+    digests = req.digests(
+        block_size=16, vocab_size=512, prompt_limit=224, min_bucket=32
+    )
+    sketch = json.dumps({
+        "block_size": 16, "digests": digests, "adapters": ["ad-1"],
+        "tokenizer": {"kind": "byte", "vocab_size": 512,
+                      "prompt_limit": 224, "min_bucket": 32},
+    }).encode()
+    payload = b"dp-payload"
+
+    async def _handle(reader, writer):
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line or request_line in (b"\r\n", b"\n"):
+                    break
+                target = request_line.decode().split(" ")[1]
+                await reader.readuntil(b"\r\n\r\n")
+                body = sketch if target.startswith("/v1/affinity") else payload
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                    b"content-length: %d\r\n\r\n" % len(body) + body
+                )
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    upstream = await asyncio.start_server(_handle, "127.0.0.1", 0)
+    uport = upstream.sockets[0].getsockname()[1]
+    db_path, _ = await _seed(tmp_path, port=uport)
+
+    app = create_dataplane_app(str(db_path), poll_interval=0.05)
+    await app.startup()
+    ctx = app.state["ctx"]
+    client = TestClient(app)
+    try:
+        deadline = asyncio.get_event_loop().time() + 10
+        while not ctx.synced_once:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.01)
+
+        # Gossip only covers replicas the worker routes to: before any
+        # traffic the routing cache is empty, so no sketches yet.
+        assert ctx.routing_cache.stats()["sketch_entries"] == 0
+        resp = await client.get("/proxy/services/main/dp-svc/data")
+        if resp.stream is not None:
+            async for _ in resp.stream:
+                pass
+
+        # Within one poll interval the replica's sketch lands.
+        deadline = asyncio.get_event_loop().time() + 10
+        while ctx.routing_cache.stats()["sketch_entries"] == 0:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        (entry,) = ctx.routing_cache._sketches.values()
+        assert set(digests) <= entry[1]
+        assert "ad-1" in entry[2]
+
+        text = (await client.get("/metrics")).body.decode()
+        assert "dstack_tpu_routing_affinity_hits_total" in text
+        assert "dstack_tpu_routing_sketch_age_seconds" in text
+        assert "# TYPE dstack_tpu_routing_affinity_score histogram" in text
+    finally:
+        await app.shutdown()
+        upstream.close()
+        await upstream.wait_closed()

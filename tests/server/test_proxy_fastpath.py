@@ -408,6 +408,13 @@ async def test_metrics_expose_proxy_series():
         assert 'dstack_tpu_proxy_ttfb_seconds_sum{kind="service"}' in metrics
         assert 'dstack_tpu_proxy_ttfb_seconds_count{kind="model"} 1' in metrics
         assert "dstack_tpu_proxy_routing_cache_hit_rate" in metrics
+        # Affinity routing series (PR 18): counters + sketch-age gauge +
+        # the per-decision score histogram, declared in the registry.
+        assert "# TYPE dstack_tpu_routing_affinity_hits_total counter" in metrics
+        assert "dstack_tpu_routing_affinity_misses_total" in metrics
+        assert "dstack_tpu_routing_sketch_age_seconds" in metrics
+        assert "# TYPE dstack_tpu_routing_affinity_score histogram" in metrics
+        assert "dstack_tpu_routing_affinity_score_count" in metrics
     finally:
         stub.stop()
         await fx.app.shutdown()

@@ -1,0 +1,365 @@
+"""Prefix-affinity fleet routing (PR 18): chain-key consistency between
+the router and the engine's prefix cache, affinity scoring in
+`RoutingCache.select()`, the imbalance escape hatch, stale-sketch decay,
+selection-state pruning on invalidation, and the /models outage fallback.
+
+The load-bearing property is tokenizer/hash consistency: the router's
+`services/affinity.py` deliberately re-implements the engine's sha1
+chain and the native server's byte tokenizer rather than importing them
+(the dataplane worker must stay jax-free), so the first two tests pin
+the mirrors against the real `BlockAllocator` and `Engine.encode` — if
+either side drifts, these fail before any routing bench notices a
+cold-cache regression.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+from dstack_tpu.server.services import affinity as aff
+from dstack_tpu.server.services.routing_cache import ReplicaTarget, RoutingCache
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+TOK = {"kind": "byte", "vocab_size": 512, "prompt_limit": 224, "min_bucket": 32}
+PARAMS = dict(
+    block_size=16,
+    vocab_size=TOK["vocab_size"],
+    prompt_limit=TOK["prompt_limit"],
+    min_bucket=TOK["min_bucket"],
+)
+
+
+def _target(n: int) -> ReplicaTarget:
+    return ReplicaTarget(
+        job_id=f"job-{n}", replica_num=n, hostname=f"h{n}", port=8000
+    )
+
+
+def _sketch(digests, adapters=(), block_size=16):
+    return {
+        "block_size": block_size,
+        "digests": list(digests),
+        "adapters": list(adapters),
+        "tokenizer": dict(TOK),
+    }
+
+
+def _request(text: str, adapter=None) -> aff.AffinityRequest:
+    return aff.AffinityRequest(
+        messages=[{"role": "user", "content": text}], adapter=adapter
+    )
+
+
+# ------------------------------------------------------- mirror pinning
+
+
+def test_router_chain_digests_match_allocator_residency():
+    """The digests `chain_digests` emits for a token sequence must all be
+    resident in a BlockAllocator that prefilled the same sequence, and
+    must count exactly the full blocks `match()` would serve — for the
+    empty namespace and an adapter namespace alike."""
+    from dstack_tpu.workloads.kv_blocks import BlockAllocator
+
+    for ns in (b"", b"lora-a"):
+        alloc = BlockAllocator(num_blocks=64, block_size=16)
+        tokens = [(i * 7 + 3) % 500 for i in range(83)]
+        table = [alloc.alloc() for _ in range(6)]
+        alloc.insert_full(tokens, table, namespace=ns)
+
+        router_digests = aff.chain_digests(tokens, 16, namespace=ns)
+        resident = set(alloc.affinity_digests())
+        assert router_digests, "chain must cover at least one block"
+        assert all(d in resident for d in router_digests)
+
+        blocks, matched = alloc.match(tokens, namespace=ns)
+        # Router emits one digest per full block match() consumes.
+        assert len(router_digests) == len(blocks)
+        assert matched == len(router_digests) * 16
+        # Namespacing really isolates: the other namespace matches nothing.
+        other = aff.chain_digests(tokens, 16, namespace=ns + b"x")
+        assert not set(other) & set(router_digests)
+
+
+def test_router_tokenizer_mirrors_engine_encode():
+    """`encode_bytes` must reproduce the native server's `Engine.encode`
+    byte-for-byte (clamping, newest-bytes truncation, pow-2 bucketing,
+    newline left-pad) — exercised across short, bucket-boundary, long,
+    and non-ASCII prompts without building a model."""
+    spec = importlib.util.spec_from_file_location(
+        "native_server_under_test",
+        REPO / "examples" / "deployment" / "native" / "server.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    from dstack_tpu.workloads.config import PRESETS
+
+    engine = mod.Engine.__new__(mod.Engine)  # no weights, just encode()
+    engine.config = PRESETS["tiny"]
+    engine.max_new_tokens = 32
+    limit = engine.config.max_seq_len - engine.max_new_tokens
+
+    prompts = [
+        "",
+        "hi",
+        "x" * 31,
+        "x" * 32,
+        "x" * 33,
+        "user: tell me a story\nassistant:",
+        "long " * 200,  # past the prompt budget: newest bytes win
+        "naïve prompt with ünïcode ✓",
+    ]
+    for text in prompts:
+        expected = [int(t) for t in engine.encode(text)[0]]
+        got = aff.encode_bytes(
+            text, engine.config.vocab_size, limit, mod.Engine.MIN_BUCKET
+        )
+        assert got == expected, text
+
+
+# ---------------------------------------------------------- select() scoring
+
+
+def test_affinity_prefers_sketch_resident_replica():
+    rc = RoutingCache(ttl=30)
+    t1, t2 = _target(1), _target(2)
+    req = _request("shared system preamble " * 20)
+    digests = req.digests(**PARAMS)
+    assert len(digests) >= 2
+
+    rc.update_sketch(t2.job_id, _sketch(digests))
+    picks = [rc.select("p", "r", [t1, t2], affinity=req).job_id for _ in range(6)]
+    assert picks == [t2.job_id] * 6  # no rotation: cache wins every time
+    stats = rc.stats()
+    assert stats["affinity_hits"] == 6
+    assert stats["affinity_scores"]["count"] == 6
+    # Winning scores are whole matched-block counts (fresh sketch).
+    assert stats["affinity_scores"]["sum"] >= 6 * len(digests) * 0.9
+
+
+def test_adapter_request_routes_to_resident_replica():
+    """`base:adapter` traffic must land on a replica that already has the
+    adapter loaded (zero forced `POST /v1/adapters`) even with no prefix
+    overlap at all."""
+    rc = RoutingCache(ttl=30)
+    t1, t2 = _target(1), _target(2)
+    rc.update_sketch(t1.job_id, _sketch([], adapters=["other"]))
+    rc.update_sketch(t2.job_id, _sketch([], adapters=["fr-lora"]))
+    req = _request("bonjour", adapter="fr-lora")
+    for _ in range(5):
+        assert rc.select("p", "r", [t1, t2], affinity=req).job_id == t2.job_id
+    assert rc.stats()["affinity_hits"] == 5
+
+
+def test_imbalance_escape_hatch_under_hot_prefix_flood():
+    """A hot prefix must spread once the cache winner runs
+    `imbalance_max` hotter than the idlest replica: affinity yields to
+    least-outstanding instead of stacking the flood on one engine."""
+    rc = RoutingCache(ttl=30)
+    rc.imbalance_max = 3
+    t1, t2 = _target(1), _target(2)
+    req = _request("hot shared prefix " * 30)
+    rc.update_sketch(t2.job_id, _sketch(req.digests(**PARAMS)))
+
+    in_flight = []
+    picks = []
+    for _ in range(12):
+        t = rc.select("p", "r", [t1, t2], affinity=req)
+        rc.start(t.job_id)  # long generations: nothing finishes
+        in_flight.append(t.job_id)
+        picks.append(t.job_id)
+    # The first imbalance_max+1 picks ride the cache; past the hatch the
+    # flood spills to the idle replica instead of queueing forever.
+    assert picks[: rc.imbalance_max + 1] == [t2.job_id] * (rc.imbalance_max + 1)
+    assert t1.job_id in picks
+    spread = max(in_flight.count(t1.job_id), in_flight.count(t2.job_id))
+    assert spread - min(
+        in_flight.count(t1.job_id), in_flight.count(t2.job_id)
+    ) <= rc.imbalance_max + 1
+    assert rc.stats()["affinity_misses"] > 0
+
+
+def test_stale_sketch_decays_then_expires():
+    """A restarted replica's sketch still advertises blocks it no longer
+    has: the freshness decay shrinks its pull, and past max age the
+    sketch is ignored entirely — selection returns to least-outstanding
+    rotation, and requests keep completing either way."""
+    rc = RoutingCache(ttl=30)
+    t1, t2 = _target(1), _target(2)
+    req = _request("preamble " * 40)
+    digests = req.digests(**PARAMS)
+    rc.update_sketch(t2.job_id, _sketch(digests))
+
+    # Half-aged: still preferred, but the observed score is decayed.
+    fetched_at, dg, ad, params = rc._sketches[t2.job_id]
+    rc._sketches[t2.job_id] = (fetched_at - rc.sketch_max_age / 2, dg, ad, params)
+    assert rc.select("p", "r", [t1, t2], affinity=req).job_id == t2.job_id
+    decayed = rc.stats()["affinity_scores"]["sum"]
+    assert 0 < decayed <= len(digests) * 0.55  # ~half the fresh score
+
+    # Past max age: the lying sketch attracts nothing.
+    rc._sketches[t2.job_id] = (fetched_at - 2 * rc.sketch_max_age, dg, ad, params)
+    picks = {rc.select("p", "r", [t1, t2], affinity=req).job_id for _ in range(4)}
+    assert picks == {t1.job_id, t2.job_id}  # legacy rotation resumed
+    assert rc.stats()["affinity_hits"] == 1  # only the decayed pick scored
+
+
+def test_cache_cold_uniform_selection_identical_to_legacy():
+    """With no sketches (or affinity disabled), passing an
+    AffinityRequest must not perturb selection by a single pick: same
+    rotation, same least-outstanding decisions as the old policy."""
+    legacy = RoutingCache(ttl=30)
+    legacy.affinity_enabled = False
+    cold = RoutingCache(ttl=30)
+    targets = [_target(1), _target(2), _target(3)]
+
+    legacy_picks, cold_picks = [], []
+    for i in range(30):
+        req = _request(f"uniform request {i} " * 10)
+        a = legacy.select("p", "r", targets, affinity=req)
+        b = cold.select("p", "r", targets, affinity=req)
+        legacy_picks.append(a.job_id)
+        cold_picks.append(b.job_id)
+        if i % 3 == 0:  # some requests stay in flight
+            legacy.start(a.job_id)
+            cold.start(b.job_id)
+        if i % 7 == 0:
+            legacy.finish(a.job_id)
+            cold.finish(b.job_id)
+    assert cold_picks == legacy_picks
+    assert cold.stats()["affinity_misses"] == 30  # scored, matched nothing
+    assert legacy.stats()["affinity_misses"] == 0  # never entered the pass
+
+
+# ----------------------------------------------------- maintenance paths
+
+
+def test_invalidate_run_prunes_selection_state():
+    """Satellite: a long-lived worker must not accrete `_rr` /
+    `_outstanding` / `_breaker` / sketch entries for retired replicas."""
+    rc = RoutingCache(ttl=30)
+    t1, t2 = _target(1), _target(2)
+    rc._replicas[("main", "svc")] = (float("inf"), [t1, t2], "pid-1")
+    rc._fallback[("main", "svc")] = [t1, t2]
+    rc.select("main", "svc", [t1, t2])
+    rc.start(t1.job_id)
+    rc.mark_failure(t2.job_id)
+    rc.update_sketch(t1.job_id, _sketch(["aa" * 8]))
+
+    # Epoch bump (redeploy): routes + rotation drop, but the outage
+    # fallback — and the per-job state of the jobs it references — stays.
+    rc.invalidate_run("svc", project_id="pid-1")
+    assert not rc._replicas and not rc._rr
+    assert rc._fallback and rc._outstanding and rc._breaker and rc._sketches
+
+    # Retirement (run gone from the epoch poll): everything goes.
+    rc.invalidate_run("svc", project_id="pid-1", retire=True)
+    assert not rc._fallback
+    assert not rc._outstanding and not rc._breaker and not rc._sketches
+    assert not rc._sketch_attempts
+
+
+def test_invalidate_run_keeps_state_shared_with_surviving_runs():
+    rc = RoutingCache(ttl=30)
+    shared = _target(1)
+    rc._replicas[("main", "svc-a")] = (float("inf"), [shared], "pid-1")
+    rc._replicas[("main", "svc-b")] = (float("inf"), [shared], "pid-1")
+    rc.start(shared.job_id)
+    rc.update_sketch(shared.job_id, _sketch([]))
+    rc.invalidate_run("svc-a", project_id="pid-1", retire=True)
+    # svc-b still routes through the same job: its state must survive.
+    assert shared.job_id in rc._outstanding
+    assert shared.job_id in rc._sketches
+
+
+async def test_get_models_outage_fallback(tmp_path):
+    """Satellite: `get_models` gets the `_fallback` + `stale_serves`
+    treatment `get_replicas_ex` always had — a control-plane blip must
+    not take model-name resolution down with it."""
+    from tests.server.conftest import make_server
+    from tests.server.test_dataplane import _DeadDB
+    from tests.server.test_proxy_fastpath import _make_service_run
+
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        await _make_service_run(fx, "m-svc", [18099], model="m1")
+        models, stale = await ctx.routing_cache.get_models_ex(ctx, "main")
+        assert [m["name"] for m in models] == ["m1"] and not stale
+
+        ctx.db = _DeadDB(ctx.db)
+        ctx.routing_cache._models.clear()  # force a reload attempt
+        models, stale = await ctx.routing_cache.get_models_ex(ctx, "main")
+        assert [m["name"] for m in models] == ["m1"] and stale
+        assert ctx.routing_cache.stats()["stale_serves"] == 1
+
+        # Unknown project has no fallback: the outage still surfaces.
+        try:
+            await ctx.routing_cache.get_models_ex(ctx, "ghost")
+        except Exception:
+            pass
+        else:
+            raise AssertionError("outage without fallback must raise")
+    finally:
+        await fx.app.shutdown()
+
+
+# ------------------------------------------------ end-to-end (control plane)
+
+
+async def test_stale_sketch_request_still_completes_and_traffic_rebalances():
+    """Integration: a sketch claiming residency steers traffic to one
+    replica; requests complete regardless of whether the engine actually
+    hits (routing is a preference, never a correctness gate), and once
+    the sketch ages out the fleet rebalances."""
+    from tests.server.conftest import make_server
+    from tests.server.test_proxy_fastpath import (
+        StubUpstream,
+        _drain,
+        _make_service_run,
+    )
+
+    stub1, stub2 = StubUpstream(), StubUpstream()
+    p1, p2 = await stub1.start(), await stub2.start()
+    fx = await make_server(run_background_tasks=False)
+    try:
+        ctx = fx.ctx
+        await _make_service_run(fx, "aff-svc", [p1, p2], model="m1")
+        targets = await ctx.routing_cache.get_replicas(ctx, "main", "aff-svc")
+        by_port = {t.port: t for t in targets}
+
+        body = {
+            "model": "m1",
+            "messages": [{"role": "user", "content": "shared corpus " * 30}],
+        }
+        req = aff.AffinityRequest(messages=body["messages"])
+        ctx.routing_cache.update_sketch(
+            by_port[p2].job_id, _sketch(req.digests(**PARAMS))
+        )
+
+        def _chats(stub):
+            return [r for r in stub.requests if r["method"] == "POST"]
+
+        for _ in range(4):
+            r = await fx.client.post("/proxy/models/main/chat/completions", body)
+            assert r.status == 200
+            await _drain(r)
+        # The sketch is a lie — stub replicas have no prefix cache — yet
+        # every request completed, all pinned to the advertised replica.
+        assert len(_chats(stub2)) == 4 and len(_chats(stub1)) == 0
+
+        # Age the sketch out: the same traffic spreads again.
+        fetched_at, dg, ad, params = ctx.routing_cache._sketches[by_port[p2].job_id]
+        ctx.routing_cache._sketches[by_port[p2].job_id] = (
+            fetched_at - 2 * ctx.routing_cache.sketch_max_age, dg, ad, params,
+        )
+        for _ in range(4):
+            r = await fx.client.post("/proxy/models/main/chat/completions", body)
+            assert r.status == 200
+            await _drain(r)
+        assert len(_chats(stub1)) == 2 and len(_chats(stub2)) == 6
+    finally:
+        stub1.stop()
+        stub2.stop()
+        await fx.app.shutdown()
