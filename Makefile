@@ -41,11 +41,12 @@ dataplane:
 	JAX_PLATFORMS=cpu $(PYTHON) -m dstack_tpu.dataplane --workers $(or $(WORKERS),1)
 
 # Static analysis (docs/guides/static-analysis.md) + bytecode compile.
-# The second analysis invocation is the self-check: the analyzer's own
-# package must be clean with the baseline ignored entirely.
+# --gate runs the whole pipeline in one process (shared parsed ASTs):
+# main tree against the committed baseline, the analyzer's own package
+# with the baseline ignored, good fixture tree clean, and the seeded
+# bad fixture tree tripping every checker (exit 1 expected there).
 lint:
-	$(PYTHON) -m dstack_tpu.analysis dstack_tpu/
-	$(PYTHON) -m dstack_tpu.analysis dstack_tpu/analysis --no-baseline
+	$(PYTHON) -m dstack_tpu.analysis --gate --jobs 4
 	$(PYTHON) -m compileall -q dstack_tpu
 
 lint-json:

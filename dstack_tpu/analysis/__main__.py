@@ -37,11 +37,85 @@ def build_parser() -> argparse.ArgumentParser:
         help="write current findings into the baseline and exit 0",
     )
     p.add_argument("--root", default=None, help="path findings are reported relative to (default: cwd)")
+    p.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help="run checkers in N threads (shared parsed ASTs; deterministic output)",
+    )
+    p.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="report findings only for files changed vs git HEAD (the whole"
+        " tree is still parsed for cross-module context; stale-baseline"
+        " detection is skipped)",
+    )
+    p.add_argument(
+        "--gate",
+        action="store_true",
+        help="run the full make-lint gate in one process (main tree with"
+        " the baseline, analyzer self-check, good fixtures clean, bad"
+        " fixtures must trip); parsed ASTs are shared across the runs",
+    )
     return p
+
+
+def _git_changed_rels(root) -> set:
+    """Repo-relative paths changed vs HEAD (staged, unstaged, untracked)."""
+    import subprocess
+
+    root = os.path.abspath(root or os.getcwd())
+    rels = set()
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain", "--untracked-files=all"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError) as e:
+        raise RuntimeError(f"--changed-only needs a git checkout: {e}")
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: report the new side
+            path = path.split(" -> ", 1)[1]
+        rels.add(path.strip('"'))
+    return rels
+
+
+def _run_gate(args) -> int:
+    """All four make-lint passes in one process so parsed ASTs (and one
+    interpreter start) are shared: the separate-invocation form re-parsed
+    the tree from scratch each time."""
+    import contextlib
+    import io
+
+    jobs = str(max(1, args.jobs))
+    rc = main(["dstack_tpu", "--baseline", args.baseline, "--jobs", jobs])
+    rc = max(rc, main(["dstack_tpu/analysis", "--no-baseline"]))
+    good = "tests/analysis_fixtures/good"
+    bad = "tests/analysis_fixtures/bad"
+    rc = max(rc, main([good, "--root", good, "--no-baseline"]))
+    # The bad tree must trip (exit 1): the checkers themselves are gated.
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bad_rc = main([bad, "--root", bad, "--no-baseline"])
+    if bad_rc != 1:
+        print(f"gate: bad fixture tree should exit 1, got {bad_rc}", file=sys.stderr)
+        rc = max(rc, 1)
+    else:
+        print("gate: bad fixture tree trips as expected")
+    return rc
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.gate:
+        return _run_gate(args)
     paths = args.paths or ["dstack_tpu"]
     for p in paths:
         if not os.path.exists(p):
@@ -56,7 +130,21 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
 
-    report = run_analysis(paths, root=args.root, baseline_fingerprints=fingerprints)
+    only_rels = None
+    if args.changed_only:
+        try:
+            only_rels = _git_changed_rels(args.root)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    report = run_analysis(
+        paths,
+        root=args.root,
+        baseline_fingerprints=fingerprints,
+        jobs=max(1, args.jobs),
+        only_rels=only_rels,
+    )
 
     if args.update_baseline:
         keep = [f.fingerprint for f in report.findings if f.code != "BASE01"]

@@ -10,6 +10,18 @@ FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 INTERP = "\x00"
 
 
+def cached_walk(node: ast.AST) -> List[ast.AST]:
+    """Preorder walk of `node`, memoized on the node itself — passes that
+    re-scan the same function body (fixed-point rounds, per-acquire
+    escape analysis) share one traversal. The memo's lifetime is the AST
+    node's, so a re-parsed module never sees a stale list."""
+    cached = getattr(node, "_cached_walk", None)
+    if cached is None:
+        cached = list(ast.walk(node))
+        node._cached_walk = cached  # type: ignore[attr-defined]
+    return cached
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """`a.b.c` for a Name/Attribute chain; None for anything dynamic."""
     parts: List[str] = []

@@ -42,3 +42,29 @@ def save(path: str, fingerprints: Iterable[str]) -> None:
 
 def merge(existing: Set[str], new_fps: Iterable[str]) -> List[str]:
     return sorted(existing | set(new_fps))
+
+
+def split_fingerprint(fp: str):
+    """(code, rel, symbol, key) for a well-formed fingerprint, else None.
+
+    `key` may itself contain `::`-free text only by convention; the split
+    is bounded so a malformed entry degrades to None instead of lying.
+    """
+    parts = fp.split("::", 3)
+    if len(parts) != 4 or not parts[0] or not parts[1]:
+        return None
+    return parts[0], parts[1], parts[2], parts[3]
+
+
+def describe_stale(fp: str) -> str:
+    """Actionable BASE01 message: name the file and code the stale entry
+    was grandfathering so it can be deleted without bisecting."""
+    parts = split_fingerprint(fp)
+    if parts is None:
+        return f"stale baseline entry (finding no longer fires): {fp}"
+    code, rel, symbol, key = parts
+    where = f"{rel} [{symbol}]" if symbol else rel
+    return (
+        f"stale baseline entry: {code} in {where} (key: {key}) no longer"
+        f" fires — delete `{fp}` from the baseline file"
+    )

@@ -105,7 +105,7 @@ class AsyncHygieneChecker(Checker):
     def check(self, module: Module) -> Iterable[Finding]:
         findings: List[Finding] = []
         coro_names: Set[str] = {
-            n.name for n in ast.walk(module.tree)
+            n.name for n in module.nodes
             if isinstance(n, ast.AsyncFunctionDef)
         }
         for qualname, func in _functions(module):

@@ -1,0 +1,142 @@
+"""SYN01: device sync under the scheduler lock.
+
+The serving/RL hot path serializes admission, retire, and preemption
+through `with self._lock:`. A host<->device sync inside one of those
+bodies (`.item()`, `jax.device_get`, `block_until_ready`, `np.asarray`
+of a device array, `int()`/`float()` of a device scalar) stalls every
+other thread at the lock for a full device round-trip — the exact
+failure mode behind the r06 first-chunk residual, where one `.item()`
+under the lock flattened admission throughput. Dispatch is fine:
+`jnp.asarray` and jit calls enqueue asynchronously and return
+immediately; only *waiting* on the device is flagged.
+
+Scope: lock bodies in `workloads/serving.py`, `workloads/kv_blocks.py`,
+`workloads/rl.py` (per-file; helpers they call may live anywhere in
+`workloads/`). Detection is two-layer via `effects.py`: a direct sync
+site lexically inside the lock body, or a call to a function whose
+transitive effect summary syncs — propagated through the call graph, so
+a sync buried two helpers deep still trips at the lock site.
+"""
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, attr_name, call_name, dotted_name
+from dstack_tpu.analysis.core import Checker, Finding, Module, Project
+from dstack_tpu.analysis.effects import get_effects, in_scope
+
+_SYN_FILES = ("serving.py", "kv_blocks.py", "rl.py")
+
+
+def _syn_scoped(rel: str) -> bool:
+    return in_scope(rel) and rel.rsplit("/", 1)[-1] in _SYN_FILES
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    d = dotted_name(expr)
+    if d is None:
+        return False
+    last = d.split(".")[-1].lstrip("_").lower()
+    return "lock" in last
+
+
+def _body_lines(stmts: List[ast.stmt]) -> Set[int]:
+    lines: Set[int] = set()
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            line = getattr(sub, "lineno", None)
+            if line is not None:
+                lines.add(line)
+    return lines
+
+
+def _calls_in(stmts: List[ast.stmt]) -> Iterable[ast.Call]:
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
+class DeviceSyncChecker(Checker):
+    codes = ("SYN01",)
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        effects = get_effects(project)
+        findings: List[Finding] = []
+        for module in project.modules:
+            if not _syn_scoped(module.rel):
+                continue
+            for (rel, qualname), fe in effects.functions.items():
+                if rel != module.rel:
+                    continue
+                self._check_function(module, qualname, fe, effects, findings)
+        return findings
+
+    def _check_function(self, module, qualname, fe, effects, findings) -> None:
+        sync_lines = {s.line: s for s in fe.direct_syncs}
+        for node in ast.walk(fe.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_is_lock_expr(item.context_expr) for item in node.items):
+                continue
+            lock_desc = self._lock_desc(node)
+            lines = _body_lines(node.body)
+            reported: Set[str] = set()
+            # Direct sync sites lexically inside the lock body.
+            for line in sorted(lines & set(sync_lines)):
+                site = sync_lines[line]
+                key = f"sync:{site.kind}"
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        code="SYN01",
+                        message=f"device sync `{site.detail}` inside"
+                        f" `with {lock_desc}:` — every thread contending"
+                        " for the lock stalls on the device round-trip;"
+                        " hoist the sync out of the locked region",
+                        rel=module.rel,
+                        line=site.line,
+                        symbol=qualname,
+                        key=key,
+                    )
+                )
+            # Calls whose transitive summary syncs.
+            for call in _calls_in(node.body):
+                if call.lineno in sync_lines:
+                    continue
+                name = call_name(call)
+                bare = name.split(".")[-1] if name else attr_name(call)
+                if not bare:
+                    continue
+                hit = None
+                for callee in effects.resolve(fe, bare):
+                    if callee is not fe and callee.syncs:
+                        hit = callee
+                        break
+                if hit is None:
+                    continue
+                key = f"call:{bare}"
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        code="SYN01",
+                        message=f"`{bare}()` called inside `with {lock_desc}:`"
+                        f" reaches a device sync ({hit.sync_chain()}) —"
+                        " hoist the syncing work out of the locked region",
+                        rel=module.rel,
+                        line=call.lineno,
+                        symbol=qualname,
+                        key=key,
+                    )
+                )
+
+    @staticmethod
+    def _lock_desc(node) -> str:
+        for item in node.items:
+            if _is_lock_expr(item.context_expr):
+                return dotted_name(item.context_expr) or "lock"
+        return "lock"

@@ -1,0 +1,275 @@
+"""DON01: use after donation.
+
+`jax.jit(..., donate_argnums=...)` hands the argument's buffer to XLA —
+after the call the Python name still exists but its buffer may already
+be overwritten. Reading it again is undefined behaviour that happens to
+work on CPU (where donation is a no-op) and corrupts data on TPU, which
+is exactly the class of bug the carried-view cache in r10 had to dance
+around: it never reproduces in tier-1 CPU tests.
+
+The checker poisons every pure dotted path (`state`, `self.state`)
+passed in a donated position of a donating callable — known via the
+`effects.py` summaries: decorated defs, `functools.partial(jax.jit,
+...)` aliases, `self.attr = jax.jit(...)` bindings, and the
+call-of-call idiom `self._chunk_fn(n)(params, state, ...)` where the
+getter's summary says it returns a donating callable. A later read of
+the poisoned path (or any descendant) before a reassignment trips the
+finding. The canonical safe idiom clears itself: in
+`self.state, tok = step(self.params, self.state, x)` the donated path
+is reassigned by the same statement, so nothing stays poisoned.
+
+Branches merge pessimistically (poisoned-if-either), and loop bodies
+are scanned twice so a donation at the bottom of an iteration poisons a
+read at the top of the next one.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, cached_walk, call_name
+from dstack_tpu.analysis.core import Checker, Finding, Module, Project
+from dstack_tpu.analysis.effects import (
+    Effects,
+    donating_expr_positions,
+    get_effects,
+    in_scope,
+)
+
+Path = Tuple[str, ...]
+
+
+def _expr_path(expr: ast.AST) -> Optional[Path]:
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _covers(stored: Path, poisoned: Path) -> bool:
+    """A store to `stored` re-materializes `poisoned` (equal or prefix)."""
+    return poisoned[: len(stored)] == stored
+
+
+def _reads(read: Path, poisoned: Path) -> bool:
+    """Reading `read` observes `poisoned` (equal or descendant)."""
+    return read[: len(poisoned)] == poisoned
+
+
+class _Poison:
+    __slots__ = ("path", "line", "callee", "reported")
+
+    def __init__(self, path: Path, line: int, callee: str):
+        self.path = path
+        self.line = line
+        self.callee = callee
+        self.reported = False
+
+
+class DonationChecker(Checker):
+    codes = ("DON01",)
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        effects = get_effects(project)
+        findings: List[Finding] = []
+        for (rel, qualname), fe in sorted(effects.functions.items()):
+            module = fe.module
+            local = self._local_donating(module, fe.node, effects)
+            state: Dict[Path, _Poison] = {}
+            self._scan(module, qualname, fe.node.body, local, effects, state, findings)
+        return findings
+
+    # -- donation resolution -------------------------------------------------
+
+    def _local_donating(
+        self, module: Module, node: ast.AST, effects: Effects
+    ) -> Dict[str, Tuple[int, ...]]:
+        local: Dict[str, Tuple[int, ...]] = {}
+        for _ in range(2):
+            grew = False
+            for sub in cached_walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt = sub.targets[0]
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    pos = donating_expr_positions(module, sub.value, local, effects)
+                    if pos is not None and local.get(tgt.id) != pos:
+                        local[tgt.id] = pos
+                        grew = True
+            if not grew:
+                break
+        return local
+
+    def _donated_args(
+        self,
+        module: Module,
+        call: ast.Call,
+        local: Dict[str, Tuple[int, ...]],
+        effects: Effects,
+    ) -> List[Tuple[Path, str]]:
+        """(donated path, callee description) for each pure donated arg."""
+        positions = donating_expr_positions(module, call.func, local, effects)
+        callee = None
+        if positions is not None:
+            if isinstance(call.func, ast.Call):
+                callee = call_name(call.func) or "<factory>"
+            else:
+                callee = call_name(call) or "<jit>"
+        if positions is None:
+            return []
+        out: List[Tuple[Path, str]] = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break  # positions past a splat are unknowable
+            if i in positions:
+                path = _expr_path(arg)
+                if path is not None:
+                    out.append((path, callee))
+        return out
+
+    # -- abstract scan -------------------------------------------------------
+
+    def _scan(
+        self,
+        module: Module,
+        qualname: str,
+        body: List[ast.stmt],
+        local: Dict[str, Tuple[int, ...]],
+        effects: Effects,
+        state: Dict[Path, _Poison],
+        findings: List[Finding],
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+                continue  # nested defs: closure timing is not lexical
+            if isinstance(stmt, ast.If):
+                self._check_reads(module, qualname, stmt.test, state, findings)
+                then_state = dict(state)
+                else_state = dict(state)
+                self._scan(module, qualname, stmt.body, local, effects, then_state, findings)
+                self._scan(module, qualname, stmt.orelse, local, effects, else_state, findings)
+                state.clear()
+                state.update(else_state)
+                state.update(then_state)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_reads(module, qualname, stmt.iter, state, findings)
+                self._apply_stores(stmt.target, state)
+                loop_state = dict(state)
+                for _ in range(2):  # wraparound: bottom-of-body poisons top
+                    self._scan(module, qualname, stmt.body, local, effects, loop_state, findings)
+                self._scan(module, qualname, stmt.orelse, local, effects, loop_state, findings)
+                state.update(loop_state)
+                continue
+            if isinstance(stmt, ast.While):
+                loop_state = dict(state)
+                for _ in range(2):
+                    self._check_reads(module, qualname, stmt.test, loop_state, findings)
+                    self._scan(module, qualname, stmt.body, local, effects, loop_state, findings)
+                self._scan(module, qualname, stmt.orelse, local, effects, loop_state, findings)
+                state.update(loop_state)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._scan(module, qualname, stmt.body, local, effects, state, findings)
+                for handler in stmt.handlers:
+                    h_state = dict(state)
+                    self._scan(module, qualname, handler.body, local, effects, h_state, findings)
+                    state.update(h_state)
+                self._scan(module, qualname, stmt.orelse, local, effects, state, findings)
+                self._scan(module, qualname, stmt.finalbody, local, effects, state, findings)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._check_reads(module, qualname, item.context_expr, state, findings)
+                    if item.optional_vars is not None:
+                        self._apply_stores(item.optional_vars, state)
+                self._scan(module, qualname, stmt.body, local, effects, state, findings)
+                continue
+
+            # Simple statement: reads of existing poisons first, then new
+            # donations, then stores — so a same-statement reassignment of
+            # the donated path clears it without a self-report.
+            self._check_reads(module, qualname, stmt, state, findings)
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    for path, callee in self._donated_args(module, sub, local, effects):
+                        state[path] = _Poison(path, sub.lineno, callee)
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    self._apply_stores(tgt, state)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._apply_stores(stmt.target, state)
+            elif isinstance(stmt, ast.AugAssign):
+                # read already flagged above; the store re-materializes.
+                self._apply_stores(stmt.target, state)
+            elif isinstance(stmt, (ast.Return, ast.Raise)):
+                state.clear()
+
+    def _apply_stores(self, tgt: ast.AST, state: Dict[Path, _Poison]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._apply_stores(elt, state)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._apply_stores(tgt.value, state)
+            return
+        path = _expr_path(tgt)
+        if path is None:
+            return
+        for p in [p for p in state if _covers(path, p)]:
+            del state[p]
+
+    def _check_reads(
+        self,
+        module: Module,
+        qualname: str,
+        node: ast.AST,
+        state: Dict[Path, _Poison],
+        findings: List[Finding],
+    ) -> None:
+        if not state:
+            return
+        # Collect store-target node ids so an Assign's LHS names are not
+        # treated as reads (they are handled by _apply_stores).
+        skip = set()
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    skip.add(id(sub))
+        elif isinstance(node, (ast.AnnAssign,)):
+            for sub in ast.walk(node.target):
+                skip.add(id(sub))
+        for sub in cached_walk(node):
+            if id(sub) in skip:
+                continue
+            if not isinstance(sub, (ast.Name, ast.Attribute)):
+                continue
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                continue
+            path = _expr_path(sub)
+            if path is None:
+                continue
+            for poison in state.values():
+                if poison.reported:
+                    continue
+                # Only the exact path or an extension of it is a read of
+                # the donated buffer; a parent read is not.
+                if _reads(path, poison.path) and len(path) >= len(poison.path):
+                    poison.reported = True
+                    findings.append(
+                        Finding(
+                            code="DON01",
+                            message=f"`{'.'.join(path)}` read after being"
+                            f" donated to `{poison.callee}` (line"
+                            f" {poison.line}) — the buffer may already be"
+                            " overwritten on TPU; reassign the name from"
+                            " the call result or pass a copy",
+                            rel=module.rel,
+                            line=sub.lineno,
+                            symbol=qualname,
+                            key=f"{poison.callee}:{'.'.join(poison.path)}",
+                        )
+                    )

@@ -33,7 +33,7 @@ class HostTierChecker(Checker):
     def check(self, module: Module) -> Iterable[Finding]:
         if not module.rel.endswith(SCOPE_SUFFIX):
             return
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     if _is_jax(alias.name):

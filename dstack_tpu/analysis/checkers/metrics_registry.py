@@ -128,10 +128,36 @@ class MetricsRegistryChecker(Checker):
         for module in project.modules:
             if module is registry_module:
                 continue
-            findings.extend(self._check_inc_sites(module, registry))
-            findings.extend(self._check_observe_sites(module, registry))
-            findings.extend(self._check_literals(module, registry))
+            sites, literals = self._collect_sites(module)
+            findings.extend(self._check_inc_sites(module, registry, sites["inc"]))
+            findings.extend(
+                self._check_observe_sites(module, registry, sites["observe"])
+            )
+            findings.extend(self._check_literals(module, registry, literals))
         return findings
+
+    @staticmethod
+    def _collect_sites(module: Module):
+        """One tree pass: tracer inc/observe calls paired with their
+        innermost owning function, plus every string constant. The
+        per-method `ast.walk(func)`-inside-`ast.walk(tree)` shape this
+        replaces revisited nested-function bodies once per enclosing
+        def, per rule."""
+        sites: Dict[str, List[Tuple[ast.AST, ast.Call]]] = {"inc": [], "observe": []}
+        literals: List[ast.Constant] = []
+
+        def visit(node: ast.AST, func: Optional[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call) and func is not None:
+                    m = attr_name(child)
+                    if m in sites and child.args:
+                        sites[m].append((func, child))
+                elif isinstance(child, ast.Constant) and isinstance(child.value, str):
+                    literals.append(child)
+                visit(child, child if isinstance(child, FUNC_NODES) else func)
+
+        visit(module.tree, None)
+        return sites, literals
 
     def _check_hygiene(self, module: Module, registry: Registry) -> Iterable[Finding]:
         for name, (mtype, _labels) in registry.items():
@@ -173,105 +199,97 @@ class MetricsRegistryChecker(Checker):
                     key=f"le:{name}",
                 )
 
-    def _check_inc_sites(self, module: Module, registry: Registry) -> Iterable[Finding]:
-        funcs = [n for n in ast.walk(module.tree) if isinstance(n, FUNC_NODES)]
-        for func in funcs:
-            for node in ast.walk(func):
-                if not isinstance(node, ast.Call) or attr_name(node) != "inc":
+    def _check_inc_sites(
+        self, module: Module, registry: Registry,
+        sites: List[Tuple[ast.AST, ast.Call]],
+    ) -> Iterable[Finding]:
+        for func, node in sites:
+            names = _counter_names(node.args[0])
+            if not names:
+                continue  # dynamic name; cannot check statically
+            labels = self._site_labels(module, func, node)
+            for cname in names:
+                series = f"{PREFIX}{cname}_total"
+                decl = registry.get(series)
+                if decl is None:
+                    yield Finding(
+                        code="MET01",
+                        message=f"tracer counter `{cname}` emits"
+                        f" undeclared series `{series}` — add it to"
+                        " server/metrics_registry.py or rename",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"undeclared:{series}",
+                    )
                     continue
-                if not node.args:
-                    continue
-                names = _counter_names(node.args[0])
-                if not names:
-                    continue  # dynamic name; cannot check statically
-                labels = self._site_labels(module, func, node)
-                for cname in names:
-                    series = f"{PREFIX}{cname}_total"
-                    decl = registry.get(series)
-                    if decl is None:
-                        yield Finding(
-                            code="MET01",
-                            message=f"tracer counter `{cname}` emits"
-                            f" undeclared series `{series}` — add it to"
-                            " server/metrics_registry.py or rename",
-                            rel=module.rel,
-                            line=node.lineno,
-                            key=f"undeclared:{series}",
-                        )
-                        continue
-                    mtype, decl_labels = decl
-                    if mtype != "counter":
-                        yield Finding(
-                            code="MET01",
-                            message=f"`{series}` is declared {mtype} but"
-                            " emitted via tracer.inc (a counter)",
-                            rel=module.rel,
-                            line=node.lineno,
-                            key=f"type:{series}",
-                        )
-                    if labels is not None and labels != set(decl_labels):
-                        yield Finding(
-                            code="MET01",
-                            message=f"label drift on `{series}`: emitted"
-                            f" {sorted(labels)} but registry declares"
-                            f" {sorted(decl_labels)}",
-                            rel=module.rel,
-                            line=node.lineno,
-                            key=f"labels:{series}",
-                        )
+                mtype, decl_labels = decl
+                if mtype != "counter":
+                    yield Finding(
+                        code="MET01",
+                        message=f"`{series}` is declared {mtype} but"
+                        " emitted via tracer.inc (a counter)",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"type:{series}",
+                    )
+                if labels is not None and labels != set(decl_labels):
+                    yield Finding(
+                        code="MET01",
+                        message=f"label drift on `{series}`: emitted"
+                        f" {sorted(labels)} but registry declares"
+                        f" {sorted(decl_labels)}",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"labels:{series}",
+                    )
 
     def _check_observe_sites(
-        self, module: Module, registry: Registry
+        self, module: Module, registry: Registry,
+        sites: List[Tuple[ast.AST, ast.Call]],
     ) -> Iterable[Finding]:
         """`tracer.observe("name", value, **labels)` emits histogram
         series under `dstack_tpu_<name>` (no suffix — _bucket/_sum/
         _count derive at exposition). HistogramData.observe(value) sites
         pass a number first, so the constant-string filter skips them."""
-        funcs = [n for n in ast.walk(module.tree) if isinstance(n, FUNC_NODES)]
-        for func in funcs:
-            for node in ast.walk(func):
-                if not isinstance(node, ast.Call) or attr_name(node) != "observe":
+        for func, node in sites:
+            names = _counter_names(node.args[0])
+            if not names:
+                continue  # dynamic (or non-tracer) observe site
+            labels = self._site_labels(module, func, node)
+            for hname in names:
+                series = f"{PREFIX}{hname}"
+                decl = registry.get(series)
+                if decl is None:
+                    yield Finding(
+                        code="MET01",
+                        message=f"tracer histogram `{hname}` emits"
+                        f" undeclared series `{series}` — add it to"
+                        " server/metrics_registry.py or rename",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"undeclared:{series}",
+                    )
                     continue
-                if not node.args:
-                    continue
-                names = _counter_names(node.args[0])
-                if not names:
-                    continue  # dynamic (or non-tracer) observe site
-                labels = self._site_labels(module, func, node)
-                for hname in names:
-                    series = f"{PREFIX}{hname}"
-                    decl = registry.get(series)
-                    if decl is None:
-                        yield Finding(
-                            code="MET01",
-                            message=f"tracer histogram `{hname}` emits"
-                            f" undeclared series `{series}` — add it to"
-                            " server/metrics_registry.py or rename",
-                            rel=module.rel,
-                            line=node.lineno,
-                            key=f"undeclared:{series}",
-                        )
-                        continue
-                    mtype, decl_labels = decl
-                    if mtype != "histogram":
-                        yield Finding(
-                            code="MET01",
-                            message=f"`{series}` is declared {mtype} but"
-                            " emitted via tracer.observe (a histogram)",
-                            rel=module.rel,
-                            line=node.lineno,
-                            key=f"type:{series}",
-                        )
-                    if labels is not None and labels != set(decl_labels):
-                        yield Finding(
-                            code="MET01",
-                            message=f"label drift on `{series}`: emitted"
-                            f" {sorted(labels)} but registry declares"
-                            f" {sorted(decl_labels)}",
-                            rel=module.rel,
-                            line=node.lineno,
-                            key=f"labels:{series}",
-                        )
+                mtype, decl_labels = decl
+                if mtype != "histogram":
+                    yield Finding(
+                        code="MET01",
+                        message=f"`{series}` is declared {mtype} but"
+                        " emitted via tracer.observe (a histogram)",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"type:{series}",
+                    )
+                if labels is not None and labels != set(decl_labels):
+                    yield Finding(
+                        code="MET01",
+                        message=f"label drift on `{series}`: emitted"
+                        f" {sorted(labels)} but registry declares"
+                        f" {sorted(decl_labels)}",
+                        rel=module.rel,
+                        line=node.lineno,
+                        key=f"labels:{series}",
+                    )
 
     def _site_labels(
         self, module: Module, func: ast.AST, call: ast.Call
@@ -294,10 +312,10 @@ class MetricsRegistryChecker(Checker):
                 return None
         return labels
 
-    def _check_literals(self, module: Module, registry: Registry) -> Iterable[Finding]:
-        for node in ast.walk(module.tree):
-            if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
-                continue
+    def _check_literals(
+        self, module: Module, registry: Registry, literals: List[ast.Constant]
+    ) -> Iterable[Finding]:
+        for node in literals:
             for match in _NAME_RE.finditer(node.value):
                 name = match.group(0)
                 # Trim label-suffix junk is unnecessary (regex stops at

@@ -1,0 +1,489 @@
+"""RCB01: refcount balance for pooled resources.
+
+The engine's pooled resources are refcounted by convention, not by RAII:
+`self._lora.acquire(name)` / `release(name)` for adapter slots,
+`BlockAllocator.alloc()` / `match()` / `ensure_writable()` with
+`release(b)` for KV blocks, `HostKVTier.reserve(n)` / `unreserve(n)`
+for host-tier bytes. A path that acquires and then returns or raises
+without releasing leaks the ref forever — blocks pin HBM, adapter slots
+pin bank rows — and the leak only shows under load, long after the
+guilty request retired.
+
+Per function, every acquire-classified call must either:
+
+- **transfer ownership** — the handle (or a value built from it) is
+  stored into an attribute/subscript, returned, yielded, or pushed into
+  an engine-owned container: the release happens at a different
+  terminal site by design (the submit->retire lifecycle). Detected
+  structurally; for handoffs the analysis cannot see (e.g. the disagg
+  ship-after-ack path) the explicit pragma
+  `# analysis: transfer(RCB01)` on the acquire line documents it; or
+- **balance every exit** — a matching release (same receiver, paired
+  method) reached on the fall-through path, with exception arms covered
+  by a `finally:`/`except:` release when a call between acquire and
+  release can raise.
+
+Receivers that are bare `self` are exempt (that's the pool implementing
+itself), as are lock-like receivers.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, attr_name, cached_walk, call_name, dotted_name
+from dstack_tpu.analysis.core import Checker, Finding, Module, Project
+from dstack_tpu.analysis.effects import get_effects, in_scope
+
+_PAIRS = {
+    "acquire": "release",
+    "alloc": "release",
+    "match": "release",
+    "ensure_writable": "release",
+    "reserve": "unreserve",
+}
+
+# Container methods that take ownership of their argument (the engine
+# releases from whatever structure now holds it).
+_SINK_METHODS = {
+    "put",
+    "put_nowait",
+    "append",
+    "appendleft",
+    "add",
+    "extend",
+    "insert",
+    "setdefault",
+    "push",
+    "register",
+    "send",
+}
+
+
+def _receiver(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return dotted_name(call.func.value)
+    return None
+
+
+def _is_acquire(call: ast.Call) -> Optional[Tuple[str, str, str]]:
+    """(receiver, method, release method) when `call` grabs a pooled ref."""
+    method = attr_name(call)
+    if method not in _PAIRS:
+        return None
+    recv = _receiver(call)
+    if recv is None or recv == "self":
+        return None
+    if "lock" in recv.split(".")[-1].lower():
+        return None
+    return recv, method, _PAIRS[method]
+
+
+class _Acq:
+    __slots__ = ("line", "recv", "method", "release", "handle", "reported")
+
+    def __init__(self, line: int, recv: str, method: str, release: str,
+                 handle: Optional[str]):
+        self.line = line
+        self.recv = recv
+        self.method = method
+        self.release = release
+        self.handle = handle  # local name bound to the grant, if any
+        self.reported = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.method}:{self.recv}"
+
+
+def _first_target_name(stmt: ast.stmt) -> Optional[str]:
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        tgt = stmt.targets[0]
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        if isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts:
+            first = tgt.elts[0]
+            if isinstance(first, ast.Name):
+                return first.id
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class RefcountChecker(Checker):
+    codes = ("RCB01",)
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        effects = get_effects(project)
+        findings: List[Finding] = []
+        for (rel, qualname), fe in sorted(effects.functions.items()):
+            module = fe.module
+            acqs = self._collect_acquires(module, fe.node)
+            if not acqs:
+                continue
+            transferred = self._transferred(fe.node, acqs)
+            live: Dict[int, _Acq] = {}
+            self._walk(
+                module, qualname, fe.node.body, acqs, transferred,
+                live, [], set(), effects, fe, findings,
+            )
+            # Fall off the end of the function with a live ref.
+            for acq in live.values():
+                self._report_leak(
+                    module, qualname, acq, findings,
+                    f"no release of `{acq.recv}.{acq.release}(...)` reaches"
+                    " the end of the function",
+                )
+        return findings
+
+    # -- acquisition collection ---------------------------------------------
+
+    def _collect_acquires(self, module: Module, node: ast.AST) -> Dict[int, _Acq]:
+        """id(call node) -> _Acq for every pooled acquire in the function."""
+        acqs: Dict[int, _Acq] = {}
+        handle_by_call: Dict[int, Optional[str]] = {}
+        for sub in cached_walk(node):
+            if isinstance(sub, ast.stmt):
+                name = _first_target_name(sub)
+                if name is not None and isinstance(getattr(sub, "value", None), ast.Call):
+                    handle_by_call[id(sub.value)] = name
+        for sub in cached_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            hit = _is_acquire(sub)
+            if hit is None:
+                continue
+            if module.transferred("RCB01", sub.lineno):
+                continue
+            recv, method, release = hit
+            handle = handle_by_call.get(id(sub))
+            if handle is None and sub.args and isinstance(sub.args[0], ast.Name):
+                # Bool-style (`reserve(nbytes)`): track the argument —
+                # recording it in an owning structure is the handoff.
+                handle = sub.args[0].id
+            acqs[id(sub)] = _Acq(sub.lineno, recv, method, release, handle)
+        return acqs
+
+    def _transferred(self, node: ast.AST, acqs: Dict[int, _Acq]) -> Set[int]:
+        """Acquire sites whose handle (or a value derived from it) escapes
+        into an engine-owned structure — ownership moved, no local release
+        required."""
+        out: Set[int] = set()
+        for acq_id, acq in acqs.items():
+            if acq.handle is None:
+                continue
+            derived: Set[str] = {acq.handle}
+            for _ in range(4):
+                grew = False
+                for sub in cached_walk(node):
+                    if isinstance(sub, ast.Assign):
+                        if _names_in(sub.value) & derived:
+                            for tgt in sub.targets:
+                                for n in ast.walk(tgt):
+                                    if isinstance(n, ast.Name) and n.id not in derived:
+                                        derived.add(n.id)
+                                        grew = True
+                    elif isinstance(sub, ast.Call):
+                        # `table.append(b)` — the container now holds the
+                        # ref; track the container.
+                        method = attr_name(sub)
+                        if (
+                            method in _SINK_METHODS
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                        ):
+                            args_names: Set[str] = set()
+                            for a in sub.args:
+                                args_names |= _names_in(a)
+                            if args_names & derived and sub.func.value.id not in derived:
+                                derived.add(sub.func.value.id)
+                                grew = True
+                if not grew:
+                    break
+            if self._escapes(node, acq, derived):
+                out.add(acq_id)
+        return out
+
+    def _escapes(self, node: ast.AST, acq: _Acq, derived: Set[str]) -> bool:
+        for sub in cached_walk(node):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                val = getattr(sub, "value", None)
+                if val is not None and _names_in(val) & derived:
+                    return True
+            elif isinstance(sub, ast.Assign):
+                # A derived container that is itself an alias of engine
+                # state (`table = self._slot_tables[slot]`) already holds
+                # the ref on the engine's behalf.
+                if (
+                    isinstance(sub.value, (ast.Attribute, ast.Subscript))
+                    and "self" in _names_in(sub.value)
+                    and any(
+                        isinstance(t, ast.Name) and t.id in derived
+                        for t in sub.targets
+                    )
+                ):
+                    return True
+                if not (_names_in(sub.value) & derived):
+                    continue
+                for tgt in sub.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        return True
+                    if isinstance(tgt, (ast.Tuple, ast.List)) and any(
+                        isinstance(e, (ast.Attribute, ast.Subscript)) for e in tgt.elts
+                    ):
+                        return True
+            elif isinstance(sub, ast.Call):
+                method = attr_name(sub)
+                if method in _SINK_METHODS and isinstance(sub.func, ast.Attribute):
+                    args_names: Set[str] = set()
+                    for a in sub.args:
+                        args_names |= _names_in(a)
+                    if args_names & derived:
+                        # Pushing into a container owned by an attribute
+                        # (self._queue.append) hands the ref to the engine;
+                        # a local scratch list is not a handoff by itself.
+                        owner = dotted_name(sub.func.value)
+                        if owner is None or "." in owner or owner == "self":
+                            return True
+                        if owner not in derived:
+                            # plain-name container that itself escapes is
+                            # covered by the derived-closure above.
+                            continue
+        return False
+
+    # -- path walk -----------------------------------------------------------
+
+    def _walk(
+        self,
+        module: Module,
+        qualname: str,
+        body: Sequence[ast.stmt],
+        acqs: Dict[int, _Acq],
+        transferred: Set[int],
+        live: Dict[int, _Acq],
+        finally_protect: List[Set[Tuple[str, str]]],
+        handler_protect: Set[Tuple[str, str]],
+        effects,
+        fe,
+        findings: List[Finding],
+    ) -> None:
+        for stmt in body:
+            if isinstance(stmt, FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+                continue
+            if isinstance(stmt, ast.Try):
+                fin = self._releases_in(stmt.finalbody)
+                hand = set(handler_protect)
+                for handler in stmt.handlers:
+                    hand |= self._releases_in(handler.body)
+                entry = dict(live)
+                self._walk(module, qualname, stmt.body, acqs, transferred, live,
+                           finally_protect + [fin], hand, effects, fe, findings)
+                self._walk(module, qualname, stmt.orelse, acqs, transferred, live,
+                           finally_protect + [fin], hand, effects, fe, findings)
+                for handler in stmt.handlers:
+                    h_live = dict(entry)
+                    h_live.update(live)
+                    self._walk(module, qualname, handler.body, acqs, transferred,
+                               h_live, finally_protect + [fin], handler_protect,
+                               effects, fe, findings)
+                    live.update(h_live)
+                # finally releases apply to whatever is still live.
+                for pair in fin:
+                    self._clear(live, pair)
+                self._walk(module, qualname, stmt.finalbody, acqs, transferred,
+                           live, finally_protect, handler_protect, effects, fe,
+                           findings)
+                continue
+            if isinstance(stmt, ast.If):
+                # `if recv.reserve(n):` / `if not recv.reserve(n):` — the
+                # grant only exists on the success arm.
+                guard = self._guard_acquire(stmt.test, acqs, transferred)
+                self._visit_expr(module, qualname, stmt.test, acqs, transferred,
+                                 live, finally_protect, handler_protect,
+                                 effects, fe, findings,
+                                 skip={id(guard[0])} if guard else None)
+                then_live = dict(live)
+                else_live = dict(live)
+                if guard is not None:
+                    node_g, success = guard
+                    target = then_live if success == "then" else else_live
+                    target[id(node_g)] = acqs[id(node_g)]
+                # `if h is None:` after `h = alloc()` — the failed-grant arm
+                # holds nothing.
+                failed = self._none_test_handle(stmt.test)
+                if failed is not None:
+                    handle, none_arm = failed
+                    target = then_live if none_arm == "then" else else_live
+                    for acq_id in [i for i, a in target.items()
+                                   if a.handle == handle]:
+                        del target[acq_id]
+                then_exits = self._walk_branch(
+                    module, qualname, stmt.body, acqs, transferred, then_live,
+                    finally_protect, handler_protect, effects, fe, findings)
+                else_exits = self._walk_branch(
+                    module, qualname, stmt.orelse, acqs, transferred, else_live,
+                    finally_protect, handler_protect, effects, fe, findings)
+                live.clear()
+                if not then_exits:
+                    live.update(then_live)
+                if not else_exits:
+                    live.update(else_live)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                head = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+                self._visit_expr(module, qualname, head, acqs, transferred, live,
+                                 finally_protect, handler_protect, effects, fe,
+                                 findings)
+                loop_live = dict(live)
+                self._walk(module, qualname, stmt.body, acqs, transferred,
+                           loop_live, finally_protect, handler_protect,
+                           effects, fe, findings)
+                self._walk(module, qualname, stmt.orelse, acqs, transferred,
+                           loop_live, finally_protect, handler_protect,
+                           effects, fe, findings)
+                # The body both acquires and releases; its net effect
+                # (including a rollback loop releasing earlier grants)
+                # replaces the pre-loop state.
+                live.clear()
+                live.update(loop_live)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._visit_expr(module, qualname, item.context_expr, acqs,
+                                     transferred, live, finally_protect,
+                                     handler_protect, effects, fe, findings)
+                self._walk(module, qualname, stmt.body, acqs, transferred, live,
+                           finally_protect, handler_protect, effects, fe,
+                           findings)
+                continue
+
+            self._visit_expr(module, qualname, stmt, acqs, transferred, live,
+                             finally_protect, handler_protect, effects, fe,
+                             findings)
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                exit_live = dict(live)
+                for fin in finally_protect:
+                    for pair in fin:
+                        self._clear(exit_live, pair)
+                kind = "return" if isinstance(stmt, ast.Return) else "raise"
+                for acq in exit_live.values():
+                    self._report_leak(
+                        module, qualname, acq, findings,
+                        f"the `{kind}` at line {stmt.lineno} exits without"
+                        f" `{acq.recv}.{acq.release}(...)`",
+                    )
+                live.clear()
+
+    def _walk_branch(self, module, qualname, body, acqs, transferred, live,
+                     finally_protect, handler_protect, effects, fe,
+                     findings) -> bool:
+        """Walk a branch; True if it always exits (ends in return/raise)."""
+        self._walk(module, qualname, body, acqs, transferred, live,
+                   finally_protect, handler_protect, effects, fe, findings)
+        return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise))
+
+    @staticmethod
+    def _guard_acquire(test: ast.AST, acqs, transferred):
+        """(acquire node, arm holding the grant) for `if [not] acq():`."""
+        inner = test
+        negate = False
+        if isinstance(inner, ast.UnaryOp) and isinstance(inner.op, ast.Not):
+            inner = inner.operand
+            negate = True
+        if isinstance(inner, ast.Call) and id(inner) in acqs and id(inner) not in transferred:
+            return inner, ("else" if negate else "then")
+        return None
+
+    @staticmethod
+    def _none_test_handle(test: ast.AST):
+        """(handle name, arm where it is None) for `if h is [not] None:`."""
+        if (
+            isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and len(test.ops) == 1
+            and len(test.comparators) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            if isinstance(test.ops[0], ast.Is):
+                return test.left.id, "then"
+            if isinstance(test.ops[0], ast.IsNot):
+                return test.left.id, "else"
+        return None
+
+    def _visit_expr(self, module, qualname, node, acqs, transferred, live,
+                    finally_protect, handler_protect, effects, fe,
+                    findings, skip=None) -> None:
+        if node is None:
+            return
+        protect: Set[Tuple[str, str]] = set(handler_protect)
+        for fin in finally_protect:
+            protect |= fin
+        for sub in cached_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            # Release clears every live grant on the same receiver+pair.
+            method = attr_name(sub)
+            recv = _receiver(sub)
+            if method is not None and recv is not None:
+                for acq_id in [i for i, a in live.items()
+                               if a.release == method and a.recv == recv]:
+                    del live[acq_id]
+            acq = acqs.get(id(sub))
+            if acq is not None:
+                if id(sub) in transferred or (skip and id(sub) in skip):
+                    continue
+                live[id(sub)] = acq
+                continue
+            # A live ref crossing a call into project code that can raise,
+            # with no finally/handler release covering the pair, leaks on
+            # the exception arm.
+            if not live:
+                continue
+            name = call_name(sub)
+            bare = name.split(".")[-1] if name else method
+            if not bare or not effects.resolve(fe, bare):
+                continue
+            for acq in list(live.values()):
+                if (acq.recv, acq.release) in protect:
+                    continue
+                self._report_leak(
+                    module, qualname, acq, findings,
+                    f"an exception in `{bare}()` at line {sub.lineno} leaks"
+                    " the ref — release in a `finally:`/`except` arm or"
+                    " mark the handoff with `# analysis: transfer(RCB01)`",
+                )
+
+    def _releases_in(self, body: Sequence[ast.stmt]) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    method = attr_name(sub)
+                    recv = _receiver(sub)
+                    if method in set(_PAIRS.values()) and recv is not None:
+                        out.add((recv, method))
+        return out
+
+    def _clear(self, live: Dict[int, _Acq], pair: Tuple[str, str]) -> None:
+        recv, method = pair
+        for acq_id in [i for i, a in live.items()
+                       if a.recv == recv and a.release == method]:
+            del live[acq_id]
+
+    def _report_leak(self, module, qualname, acq: _Acq, findings, why: str) -> None:
+        if acq.reported:
+            return
+        acq.reported = True
+        findings.append(
+            Finding(
+                code="RCB01",
+                message=f"`{acq.recv}.{acq.method}(...)` at line {acq.line}"
+                f" is not balanced: {why}",
+                rel=module.rel,
+                line=acq.line,
+                symbol=qualname,
+                key=acq.key,
+            )
+        )

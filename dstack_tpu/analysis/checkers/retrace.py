@@ -1,0 +1,152 @@
+"""JIT01: retrace hazard — jit construction on a hot path.
+
+`jax.jit` keys its compilation cache on the *callable object*, not the
+function source: building a fresh `jax.jit(f)` (or a fresh
+`functools.partial(jax.jit, ...)`-wrapped callable) inside a function
+body throws away every previous trace and recompiles on each call. On a
+serving hot path that is a silent multi-second stall per request that
+never shows up in CPU tests, where tracing is cheap.
+
+Construction is fine at the blessed seams, which are exempt:
+
+- `make_*` / `_make_*` factory functions (construct once, hand out);
+- `__init__` / `__post_init__` (construct once per engine);
+- memoized bucket seams — construction lexically under an
+  `if fn is None:` / `if key not in cache:` probe, or assigned straight
+  into a subscripted cache (`self._fns[n_pad] = jax.jit(...)`);
+- decorator position (that's a def-time construction).
+"""
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, call_name, dotted_name
+from dstack_tpu.analysis.core import Checker, Finding, Module, Project
+from dstack_tpu.analysis.effects import in_scope
+
+_FACTORY_PREFIXES = ("make_", "_make_", "build_", "_build_")
+_CTOR_NAMES = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+
+
+def _outer_functions(module: Module):
+    for node in module.tree.body:
+        if isinstance(node, FUNC_NODES):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, FUNC_NODES):
+                    yield f"{node.name}.{item.name}", item
+
+
+def _is_jit_ctor(module: Module, call: ast.Call) -> bool:
+    name = call_name(call)
+    if name is not None and module.aliases.canonical(name) == "jax.jit":
+        return True
+    # functools.partial(jax.jit, ...) — with or without donate kwargs.
+    if module.aliases.canonical(name or "") == "functools.partial" and call.args:
+        head = dotted_name(call.args[0])
+        if head is not None and module.aliases.canonical(head) == "jax.jit":
+            return True
+    return False
+
+
+def _is_memo_probe(test: ast.AST) -> bool:
+    """`x is None` / `not x` / `key not in cache` — a memoized-bucket miss."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        if isinstance(test.ops[0], ast.Is) and isinstance(
+            test.comparators[0], ast.Constant
+        ) and test.comparators[0].value is None:
+            return True
+        if isinstance(test.ops[0], ast.NotIn):
+            return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return isinstance(test.operand, (ast.Name, ast.Attribute, ast.Call))
+    return False
+
+
+def _jitted_name(call: ast.Call) -> str:
+    if call.args:
+        inner = dotted_name(call.args[0])
+        if inner is not None:
+            return inner.split(".")[-1]
+        if isinstance(call.args[0], ast.Lambda):
+            return "<lambda>"
+        if isinstance(call.args[0], ast.Call):
+            inner = call_name(call.args[0])
+            if inner is not None:
+                return inner.split(".")[-1]
+    return "<jit>"
+
+
+class RetraceChecker(Checker):
+    codes = ("JIT01",)
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        if not in_scope(module.rel):
+            return ()
+        findings: List[Finding] = []
+        for qualname, func in _outer_functions(module):
+            bare = qualname.split(".")[-1]
+            if bare.startswith(_FACTORY_PREFIXES) or bare in _CTOR_NAMES:
+                continue
+            self._scan(module, qualname, func.body, memo_guard=False,
+                       findings=findings)
+        return findings
+
+    def _scan(self, module: Module, qualname: str, body, memo_guard: bool,
+              findings: List[Finding]) -> None:
+        for stmt in body:
+            if isinstance(stmt, FUNC_NODES):
+                # A nested factory def only runs when called; its own jit
+                # constructions follow the nested def's discipline. Nested
+                # `make_*` defs are exempt like top-level ones.
+                if stmt.name.startswith(_FACTORY_PREFIXES):
+                    continue
+                self._scan(module, f"{qualname}.{stmt.name}", stmt.body,
+                           memo_guard, findings)
+                continue
+            if isinstance(stmt, ast.If):
+                self._scan(module, qualname, stmt.body,
+                           memo_guard or _is_memo_probe(stmt.test), findings)
+                self._scan(module, qualname, stmt.orelse, memo_guard, findings)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._scan(module, qualname, stmt.body, memo_guard, findings)
+                self._scan(module, qualname, stmt.orelse, memo_guard, findings)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._scan(module, qualname, stmt.body, memo_guard, findings)
+                for handler in stmt.handlers:
+                    self._scan(module, qualname, handler.body, memo_guard, findings)
+                self._scan(module, qualname, stmt.orelse, memo_guard, findings)
+                self._scan(module, qualname, stmt.finalbody, memo_guard, findings)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._scan(module, qualname, stmt.body, memo_guard, findings)
+                continue
+            if memo_guard:
+                continue
+            # Direct `cache[key] = jax.jit(...)` is a memo seam too.
+            subscript_store = isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Subscript) for t in stmt.targets
+            )
+            if subscript_store:
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and _is_jit_ctor(module, sub):
+                    inner = _jitted_name(sub)
+                    findings.append(
+                        Finding(
+                            code="JIT01",
+                            message=f"`jax.jit` constructed around `{inner}`"
+                            f" inside `{qualname}` — a fresh jit object"
+                            " retraces and recompiles on every call; build"
+                            " it once in a `make_*` factory, `__init__`, or"
+                            " a memoized bucket seam",
+                            rel=module.rel,
+                            line=sub.lineno,
+                            symbol=qualname,
+                            key=f"jit:{inner}",
+                        )
+                    )
+        return None

@@ -58,7 +58,7 @@ class ShardScanChecker(Checker):
     def check(self, module: Module) -> Iterable[Finding]:
         if SCOPE_MARKER not in module.rel:
             return
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             if attr_name(node) not in ("fetchall", "fetchone"):

@@ -38,7 +38,7 @@ SQL_SINKS: Set[str] = {
 def _safe_names(module: Module) -> Set[str]:
     """Local names assigned from placeholder-expansion expressions."""
     safe: Set[str] = set()
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             if _safe_value(node.value, safe):
                 for target in node.targets:
@@ -88,7 +88,7 @@ class SqlChecker(Checker):
     def check(self, module: Module) -> Iterable[Finding]:
         findings: List[Finding] = []
         safe = _safe_names(module)
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             if attr_name(node) not in SQL_SINKS:

@@ -21,6 +21,10 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 _ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([A-Z0-9, ]+)\)")
 _ALLOW_FILE_RE = re.compile(r"#\s*analysis:\s*allow-file\(([A-Z0-9, ]+)\)")
+# Ownership-handoff pragma (RCB01): the acquired ref is released at a
+# different terminal site by design; unlike allow() this is consumed by
+# the checker itself so the handoff is documented at the acquire site.
+_TRANSFER_RE = re.compile(r"#\s*analysis:\s*transfer\(([A-Z0-9, ]+)\)")
 
 
 @dataclass
@@ -68,9 +72,31 @@ class Module:
                 # style).
                 self.allow_lines.setdefault(i, set()).update(codes)
                 self.allow_lines.setdefault(i + 1, set()).update(codes)
+        self.transfer_lines: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _TRANSFER_RE.search(text)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",")}
+                self.transfer_lines.setdefault(i, set()).update(codes)
+                self.transfer_lines.setdefault(i + 1, set()).update(codes)
+
+        self._nodes: Optional[List[ast.AST]] = None
+
+    @property
+    def nodes(self) -> List[ast.AST]:
+        """Cached preorder walk of the whole tree. Full-tree scans should
+        iterate this instead of re-running `ast.walk(module.tree)` — with
+        14 checkers the repeated walks dominate a cold run, and the cache
+        lives as long as the Module (i.e. across runs via _MODULE_CACHE)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     def suppressed(self, code: str, line: int) -> bool:
         return code in self.allow_file or code in self.allow_lines.get(line, set())
+
+    def transferred(self, code: str, line: int) -> bool:
+        return code in self.transfer_lines.get(line, set())
 
 
 class Project:
@@ -123,6 +149,13 @@ def _iter_py_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+# (abspath, rel) -> (mtime_ns, size, Module). Parsing + pragma scanning
+# dominate cold-start cost; every checker shares the one parsed Module,
+# and repeat invocations in the same process (self-check after the main
+# run, tests, --jobs workers) reuse it for unchanged files.
+_MODULE_CACHE: Dict[Tuple[str, str], Tuple[int, int, Module]] = {}
+
+
 def load_project(paths: Sequence[str], root: Optional[str] = None) -> Tuple[Project, List[str]]:
     root = os.path.abspath(root or os.getcwd())
     modules: List[Module] = []
@@ -131,24 +164,35 @@ def load_project(paths: Sequence[str], root: Optional[str] = None) -> Tuple[Proj
         apath = os.path.abspath(path)
         rel = os.path.relpath(apath, root).replace(os.sep, "/")
         try:
+            st = os.stat(apath)
+            cached = _MODULE_CACHE.get((apath, rel))
+            if cached is not None and cached[0] == st.st_mtime_ns and cached[1] == st.st_size:
+                modules.append(cached[2])
+                continue
             with open(apath, "r", encoding="utf-8") as f:
                 source = f.read()
             tree = ast.parse(source, filename=rel)
         except (OSError, SyntaxError, ValueError) as e:
             errors.append(f"{rel}: unparseable: {e}")
             continue
-        modules.append(Module(apath, rel, source, tree))
+        module = Module(apath, rel, source, tree)
+        _MODULE_CACHE[(apath, rel)] = (st.st_mtime_ns, st.st_size, module)
+        modules.append(module)
     return Project(root, modules), errors
 
 
 def default_checkers() -> List[Checker]:
     from dstack_tpu.analysis.checkers.async_hygiene import AsyncHygieneChecker
+    from dstack_tpu.analysis.checkers.device_sync import DeviceSyncChecker
+    from dstack_tpu.analysis.checkers.donation import DonationChecker
     from dstack_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
     from dstack_tpu.analysis.checkers.kv_host_tier import HostTierChecker
     from dstack_tpu.analysis.checkers.metrics_registry import MetricsRegistryChecker
     from dstack_tpu.analysis.checkers.multi_replica import MultiReplicaLockChecker
     from dstack_tpu.analysis.checkers.paged_gather import PagedGatherChecker
     from dstack_tpu.analysis.checkers.pool import PoolChecker
+    from dstack_tpu.analysis.checkers.refcount import RefcountChecker
+    from dstack_tpu.analysis.checkers.retrace import RetraceChecker
     from dstack_tpu.analysis.checkers.shard import ShardScanChecker
     from dstack_tpu.analysis.checkers.sql import SqlChecker
     from dstack_tpu.analysis.checkers.trace_propagation import (
@@ -166,7 +210,19 @@ def default_checkers() -> List[Checker]:
         PoolChecker(),
         ShardScanChecker(),
         TracePropagationChecker(),
+        DonationChecker(),
+        DeviceSyncChecker(),
+        RefcountChecker(),
+        RetraceChecker(),
     ]
+
+
+def _run_checker(checker: Checker, project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for module in project.modules:
+        out.extend(checker.check(module))
+    out.extend(checker.finalize(project))
+    return out
 
 
 def run_analysis(
@@ -174,23 +230,49 @@ def run_analysis(
     root: Optional[str] = None,
     checkers: Optional[List[Checker]] = None,
     baseline_fingerprints: Optional[Set[str]] = None,
+    jobs: int = 1,
+    only_rels: Optional[Set[str]] = None,
 ) -> Report:
+    """Drive all checkers over `paths`.
+
+    `jobs > 1` runs checkers concurrently in threads (they only read the
+    shared parsed Modules; results are merged in checker order so output
+    stays deterministic). `only_rels` restricts *reported* findings to
+    the given repo-relative paths — the whole project is still parsed so
+    cross-module passes (LCK01, the effect summaries) see full context —
+    and disables stale-baseline detection, which is only meaningful for
+    a full run.
+    """
     checkers = checkers if checkers is not None else default_checkers()
     project, errors = load_project(paths, root)
     report = Report(errors=errors, files_scanned=len(project.modules))
     report.checker_codes = sorted({c for ch in checkers for c in ch.codes})
 
     raw: List[Finding] = []
-    for checker in checkers:
-        for module in project.modules:
-            raw.extend(checker.check(module))
-        raw.extend(checker.finalize(project))
+    if jobs > 1 and len(checkers) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # The shared effect summaries are built lazily on first use;
+        # materialize them before fan-out so worker threads don't race
+        # on the project-level cache.
+        from dstack_tpu.analysis.effects import get_effects
+
+        get_effects(project)
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            futures = [pool.submit(_run_checker, ch, project) for ch in checkers]
+            for fut in futures:
+                raw.extend(fut.result())
+    else:
+        for checker in checkers:
+            raw.extend(_run_checker(checker, project))
 
     # Pragma suppression (needs the owning module for line-level pragmas).
     visible: List[Finding] = []
     for f in raw:
         mod = project.by_rel.get(f.rel)
         if mod is not None and mod.suppressed(f.code, f.line):
+            continue
+        if only_rels is not None and f.rel not in only_rels:
             continue
         visible.append(f)
     visible.sort(key=lambda f: (f.rel, f.line, f.code, f.key))
@@ -206,17 +288,20 @@ def run_analysis(
 
     # A baseline entry whose finding no longer fires is stale: the defect
     # was fixed, so the grandfather clause must be retired with it (BASE01).
-    for fp in sorted(baseline - seen_fps):
-        report.stale_baseline.append(fp)
-        report.findings.append(
-            Finding(
-                code="BASE01",
-                message=f"stale baseline entry (finding no longer fires): {fp}",
-                rel=fp.split("::", 2)[1] if fp.count("::") >= 2 else "<baseline>",
-                line=0,
-                key=fp,
+    if only_rels is None:
+        from dstack_tpu.analysis import baseline as baseline_mod
+
+        for fp in sorted(baseline - seen_fps):
+            report.stale_baseline.append(fp)
+            report.findings.append(
+                Finding(
+                    code="BASE01",
+                    message=baseline_mod.describe_stale(fp),
+                    rel=fp.split("::", 2)[1] if fp.count("::") >= 2 else "<baseline>",
+                    line=0,
+                    key=fp,
+                )
             )
-        )
     return report
 
 

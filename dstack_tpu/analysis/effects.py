@@ -1,0 +1,541 @@
+"""Interprocedural JAX effect summaries for `workloads/` modules.
+
+One pass over the project classifies every function in a `workloads/`
+module along the axes the hot-path checkers care about:
+
+- **device syncs** — calls that force the host to wait on the device
+  (`.item()`, `.block_until_ready()`, `jax.device_get`,
+  `jax.block_until_ready`, and `int()`/`float()`/`np.asarray` applied to
+  a device-valued expression). Direct sites are recorded per function
+  and then propagated through the call graph with the same bare-name /
+  same-module-preferred fixed point LCK01 uses, so a lock body that
+  calls a helper that calls a syncing helper still trips SYN01 two hops
+  away.
+- **donation** — which locally visible callables were built with
+  `jax.jit(..., donate_argnums=...)` (decorated defs, including the
+  `@functools.partial(jax.jit, ...)` spelling, module/local assignments,
+  `self.attr = jax.jit(...)` bindings) and which functions *return* a
+  donating callable (`make_*` factories, memoized getter seams) so the
+  call-of-call idiom `self._chunk_fn(n)(params, state, ...)` resolves to
+  donated positions.
+
+Device-ness is a deliberately conservative syntactic taint: canonical
+`jnp.*`/`lax.*`/`jax.device_put` call results, locals assigned from
+them, and attributes whose annotation names `jnp.ndarray`/`jax.Array`
+anywhere in the module. Metadata reads (`.shape`, `.dtype`, ...) are
+exempt — `int(x.shape[1])` never touches the device. `jnp.asarray` and
+jit dispatch are *not* syncs: they enqueue work, they don't wait for it.
+
+Summaries are built once per `Project` and cached on it; all four JAX
+checkers share the same pass.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dstack_tpu.analysis.astutil import FUNC_NODES, attr_name, cached_walk, call_name, dotted_name
+from dstack_tpu.analysis.core import Module, Project
+
+# Attribute reads on an array that stay on the host: metadata, not data.
+METADATA_ATTRS = {"shape", "ndim", "size", "dtype", "nbytes", "itemsize", "sharding"}
+
+# Canonical call prefixes whose results live on the device.
+_DEVICE_CALL_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.")
+_DEVICE_CALLS = {"jax.device_put", "jax.jit", "jax.pmap", "jax.vmap"}
+
+# Canonical calls that are themselves a host<->device barrier.
+_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "block_until_ready",
+    "jax.effects_barrier": "effects_barrier",
+}
+
+# numpy converters that materialize their argument on the host.
+_HOST_CONVERTERS = {
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "numpy.copy",
+}
+
+_ANNOT_DEVICE_MARKERS = ("jnp.ndarray", "jax.Array", "jnp.DeviceArray")
+
+
+def in_scope(rel: str) -> bool:
+    """Effect summaries cover the workloads tree (and fixture mirrors)."""
+    return "workloads/" in rel
+
+
+class SyncSite:
+    """One direct host-blocking call site."""
+
+    __slots__ = ("line", "kind", "detail")
+
+    def __init__(self, line: int, kind: str, detail: str):
+        self.line = line
+        self.kind = kind  # stable key fragment, e.g. "item", "device_get"
+        self.detail = detail  # human-readable, e.g. ".item()"
+
+
+class FuncEffects:
+    __slots__ = (
+        "module",
+        "qualname",
+        "node",
+        "direct_syncs",
+        "calls",
+        "sync_via",
+    )
+
+    def __init__(self, module: Module, qualname: str, node: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.direct_syncs: List[SyncSite] = []
+        # (line, bare callee name) — resolution happens at fixed-point time.
+        self.calls: List[Tuple[int, str]] = []
+        # (callee FuncEffects) when the sync is inherited from a callee.
+        self.sync_via: Optional["FuncEffects"] = None
+
+    @property
+    def syncs(self) -> bool:
+        return bool(self.direct_syncs) or self.sync_via is not None
+
+    def sync_chain(self, limit: int = 4) -> str:
+        """`_drain -> _sync -> jax.device_get (rl.py:120)` style trail."""
+        hops: List[str] = []
+        fe: Optional[FuncEffects] = self
+        while fe is not None and len(hops) < limit:
+            if fe.direct_syncs:
+                s = fe.direct_syncs[0]
+                hops.append(f"{s.detail} ({fe.module.rel}:{s.line})")
+                break
+            nxt = fe.sync_via
+            if nxt is None:
+                break
+            hops.append(nxt.qualname.split(".")[-1])
+            fe = nxt
+        return " -> ".join(hops)
+
+
+class Effects:
+    """Project-wide summaries, keyed for the checkers' lookups."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[Tuple[str, str], FuncEffects] = {}
+        self.by_bare: Dict[str, List[FuncEffects]] = {}
+        # rel -> {bare name -> donated positions} for module-visible
+        # donating callables (decorated defs, module/local jit assigns).
+        self.module_donating: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+        # rel -> {attr name -> donated positions} for `self.X = jit(...)`.
+        self.attr_donating: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+        # rel -> {bare function name -> donated positions of the callable
+        # it returns} for factory / memoized-getter seams.
+        self.returns_donating: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+        # rel -> attr/field names whose annotation or assignment marks
+        # them device-valued.
+        self.device_attrs: Dict[str, Set[str]] = {}
+
+    def resolve(self, caller: FuncEffects, bare: str) -> List[FuncEffects]:
+        candidates = self.by_bare.get(bare, [])
+        same = [c for c in candidates if c.module is caller.module]
+        return same or candidates
+
+    def lookup(self, module: Module, bare: str) -> List[FuncEffects]:
+        candidates = self.by_bare.get(bare, [])
+        same = [c for c in candidates if c.module is module]
+        return same or candidates
+
+
+def _outer_functions(module: Module) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for node in module.tree.body:
+        if isinstance(node, FUNC_NODES):
+            out.append((node.name, node))
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, FUNC_NODES):
+                    out.append((f"{node.name}.{item.name}", item))
+    return out
+
+
+def _canonical(module: Module, call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    return module.aliases.canonical(name) if name else None
+
+
+# ---------------------------------------------------------------------------
+# Donation knowledge
+# ---------------------------------------------------------------------------
+
+
+def _const_positions(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _jit_donate_positions(module: Module, call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """`jax.jit(f, donate_argnums=...)` -> donated positions, else None."""
+    if _canonical(module, call) != "jax.jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _const_positions(kw.value)
+    return None
+
+
+def _partial_jit_positions(module: Module, call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """`functools.partial(jax.jit, donate_argnums=...)` -> positions."""
+    if _canonical(module, call) != "functools.partial" or not call.args:
+        return None
+    head = call.args[0]
+    if dotted_name(head) is None:
+        return None
+    if module.aliases.canonical(dotted_name(head)) != "jax.jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _const_positions(kw.value)
+    return None
+
+
+def donating_expr_positions(
+    module: Module,
+    expr: ast.AST,
+    local: Dict[str, Tuple[int, ...]],
+    effects: "Effects",
+) -> Optional[Tuple[int, ...]]:
+    """Donated positions of the callable `expr` evaluates to, if known.
+
+    Covers: a `jax.jit(..., donate_argnums=...)` call, the
+    `functools.partial(jax.jit, donate_argnums=...)(f)` spelling, a name
+    aliasing either, and a call to a function whose summary says it
+    returns a donating callable (factory / memoized getter).
+    """
+    if isinstance(expr, ast.Call):
+        pos = _jit_donate_positions(module, expr)
+        if pos is not None:
+            return pos
+        if isinstance(expr.func, ast.Call):
+            pos = _partial_jit_positions(module, expr.func)
+            if pos is not None:
+                return pos
+        name = call_name(expr)
+        if name is not None:
+            bare = name.split(".")[-1]
+            pos = effects.returns_donating.get(module.rel, {}).get(bare)
+            if pos is not None:
+                return pos
+    if isinstance(expr, ast.Name):
+        if expr.id in local:
+            return local[expr.id]
+        return effects.module_donating.get(module.rel, {}).get(expr.id)
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self":
+            return effects.attr_donating.get(module.rel, {}).get(expr.attr)
+    return None
+
+
+def _decorated_positions(module: Module, node: ast.AST) -> Optional[Tuple[int, ...]]:
+    for dec in getattr(node, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            pos = _partial_jit_positions(module, dec)
+            if pos is None:
+                pos = _jit_donate_positions(module, dec)
+            if pos is not None:
+                return pos
+    return None
+
+
+def _collect_donation(module: Module, effects: Effects) -> bool:
+    """One round of donation-knowledge collection; True if anything grew."""
+    mod_map = effects.module_donating.setdefault(module.rel, {})
+    attr_map = effects.attr_donating.setdefault(module.rel, {})
+    ret_map = effects.returns_donating.setdefault(module.rel, {})
+    grew = False
+
+    def record(target: Dict[str, Tuple[int, ...]], key: str, pos: Tuple[int, ...]) -> None:
+        nonlocal grew
+        if target.get(key) != pos:
+            target[key] = pos
+            grew = True
+
+    # Decorated defs (module level and methods).
+    for qualname, node in _outer_functions(module):
+        pos = _decorated_positions(module, node)
+        if pos is not None:
+            record(mod_map, qualname.split(".")[-1], pos)
+
+    # Module-level `name = jax.jit(...)` assigns.
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Name):
+                pos = donating_expr_positions(module, stmt.value, {}, effects)
+                if pos is not None:
+                    record(mod_map, tgt.id, pos)
+
+    # Per-function: local aliases, `self.X = ...` bindings, returns.
+    for qualname, node in _outer_functions(module):
+        local: Dict[str, Tuple[int, ...]] = {}
+        returns_pos: Optional[Tuple[int, ...]] = None
+        for sub in cached_walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                pos = donating_expr_positions(module, sub.value, local, effects)
+                if pos is None:
+                    continue
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Name):
+                    local[tgt.id] = pos
+                elif (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    record(attr_map, tgt.attr, pos)
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                pos = donating_expr_positions(module, sub.value, local, effects)
+                if pos is not None:
+                    returns_pos = pos
+        if returns_pos is not None:
+            record(ret_map, qualname.split(".")[-1], returns_pos)
+    return grew
+
+
+# ---------------------------------------------------------------------------
+# Device-ness and sync sites
+# ---------------------------------------------------------------------------
+
+
+def _annotation_is_device(ann: ast.AST) -> bool:
+    try:
+        text = ast.unparse(ann)
+    except Exception:  # pragma: no cover - defensive
+        return False
+    return any(marker in text for marker in _ANNOT_DEVICE_MARKERS)
+
+
+def _collect_device_attrs(module: Module) -> Set[str]:
+    """Attribute/field names the module marks device-valued: annotated
+    `X: jnp.ndarray` (class fields, NamedTuples, dataclasses) and
+    `self.X = <device expr>` assignments."""
+    attrs: Set[str] = set()
+    for node in module.nodes:
+        if isinstance(node, ast.AnnAssign) and _annotation_is_device(node.annotation):
+            tgt = node.target
+            if isinstance(tgt, ast.Name):
+                attrs.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                attrs.add(tgt.attr)
+    # Second pass needs attrs for is_device; self.X = device-expr.
+    for node in module.nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and is_device(module, node.value, set(), attrs)
+            ):
+                attrs.add(tgt.attr)
+    return attrs
+
+
+def is_device(
+    module: Module,
+    expr: ast.AST,
+    device_locals: Set[str],
+    device_attrs: Set[str],
+) -> bool:
+    """Conservative syntactic taint: True only when the expression is
+    recognizably device-valued. Metadata attribute reads are host."""
+    if isinstance(expr, ast.Name):
+        # Bare names are only device when tainted within THIS function —
+        # a field named `tokens: jnp.ndarray` elsewhere in the module must
+        # not taint every local that happens to share the name.
+        return expr.id in device_locals
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in METADATA_ATTRS:
+            return False
+        if expr.attr in device_attrs:
+            return True
+        return is_device(module, expr.value, device_locals, device_attrs)
+    if isinstance(expr, ast.Subscript):
+        return is_device(module, expr.value, device_locals, device_attrs)
+    if isinstance(expr, ast.Call):
+        canon = _canonical(module, expr)
+        if canon is not None:
+            if canon == "jax.device_get":
+                return False  # result is a host array
+            if canon in _DEVICE_CALLS or canon.startswith(_DEVICE_CALL_PREFIXES):
+                return True
+        # Method chain on a device value (x.astype(...), x.reshape(...)).
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr not in METADATA_ATTRS:
+            return is_device(module, expr.func.value, device_locals, device_attrs)
+        return False
+    if isinstance(expr, ast.BinOp):
+        return is_device(module, expr.left, device_locals, device_attrs) or is_device(
+            module, expr.right, device_locals, device_attrs
+        )
+    if isinstance(expr, ast.UnaryOp):
+        return is_device(module, expr.operand, device_locals, device_attrs)
+    if isinstance(expr, ast.IfExp):
+        return is_device(module, expr.body, device_locals, device_attrs) or is_device(
+            module, expr.orelse, device_locals, device_attrs
+        )
+    return False
+
+
+def classify_sync(
+    module: Module,
+    call: ast.Call,
+    device_locals: Set[str],
+    device_attrs: Set[str],
+) -> Optional[SyncSite]:
+    """SyncSite if `call` blocks the host on device work, else None."""
+    method = attr_name(call)
+    if method == "item" and not call.args:
+        return SyncSite(call.lineno, "item", ".item()")
+    if method == "block_until_ready" and not call.args:
+        return SyncSite(call.lineno, "block_until_ready", ".block_until_ready()")
+    canon = _canonical(module, call)
+    if canon in _SYNC_CALLS:
+        return SyncSite(call.lineno, _SYNC_CALLS[canon], canon)
+    if canon in _HOST_CONVERTERS and call.args:
+        if is_device(module, call.args[0], device_locals, device_attrs):
+            return SyncSite(call.lineno, "np_asarray", f"{canon}(<device array>)")
+    if (
+        isinstance(call.func, ast.Name)
+        and call.func.id in ("int", "float")
+        and len(call.args) == 1
+        and is_device(module, call.args[0], device_locals, device_attrs)
+    ):
+        return SyncSite(call.lineno, call.func.id, f"{call.func.id}(<device array>)")
+    return None
+
+
+def function_device_locals(
+    module: Module, node: ast.AST, device_attrs: Set[str]
+) -> Set[str]:
+    """Names assigned from device expressions anywhere in the function
+    (flow-insensitive; two rounds pick up one level of chaining). The
+    function's own parameters count when annotated device-typed."""
+    locals_: Set[str] = set()
+    args = getattr(node, "args", None)
+    if args is not None:
+        all_args = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        for a in all_args:
+            if a.annotation is not None and _annotation_is_device(a.annotation):
+                locals_.add(a.arg)
+    for _ in range(2):
+        grew = False
+        for sub in cached_walk(node):
+            if isinstance(sub, ast.Assign):
+                if not is_device(module, sub.value, locals_, device_attrs):
+                    continue
+                for tgt in sub.targets:
+                    for name in _target_names(tgt):
+                        if name not in locals_:
+                            locals_.add(name)
+                            grew = True
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                if isinstance(sub.target, ast.Name) and (
+                    _annotation_is_device(sub.annotation)
+                    or is_device(module, sub.value, locals_, device_attrs)
+                ):
+                    if sub.target.id not in locals_:
+                        locals_.add(sub.target.id)
+                        grew = True
+        if not grew:
+            break
+    return locals_
+
+
+def _target_names(tgt: ast.AST) -> Iterable[str]:
+    if isinstance(tgt, ast.Name):
+        yield tgt.id
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _target_names(elt)
+
+
+def _scan_function(module: Module, fe: FuncEffects, device_attrs: Set[str]) -> None:
+    device_locals = function_device_locals(module, fe.node, device_attrs)
+    for sub in cached_walk(fe.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        site = classify_sync(module, sub, device_locals, device_attrs)
+        if site is not None:
+            fe.direct_syncs.append(site)
+            continue
+        name = call_name(sub)
+        bare = name.split(".")[-1] if name else attr_name(sub)
+        if bare:
+            fe.calls.append((sub.lineno, bare))
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def get_effects(project: Project) -> Effects:
+    cached = getattr(project, "_jax_effects", None)
+    if cached is not None:
+        return cached
+
+    effects = Effects()
+    scoped = [m for m in project.modules if in_scope(m.rel)]
+
+    # Donation knowledge first (returns_donating feeds on itself through
+    # factory chains — iterate to a small fixed point).
+    for _ in range(4):
+        grew = False
+        for module in scoped:
+            grew = _collect_donation(module, effects) or grew
+        if not grew:
+            break
+
+    for module in scoped:
+        effects.device_attrs[module.rel] = _collect_device_attrs(module)
+
+    for module in scoped:
+        dev_attrs = effects.device_attrs[module.rel]
+        for qualname, node in _outer_functions(module):
+            fe = FuncEffects(module, qualname, node)
+            _scan_function(module, fe, dev_attrs)
+            effects.functions[(module.rel, qualname)] = fe
+            effects.by_bare.setdefault(qualname.split(".")[-1], []).append(fe)
+
+    # Transitive sync propagation (callee syncs -> caller syncs).
+    changed = True
+    rounds = 0
+    all_fns = list(effects.functions.values())
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for fe in all_fns:
+            if fe.syncs:
+                continue
+            for _line, bare in fe.calls:
+                hit = None
+                for callee in effects.resolve(fe, bare):
+                    if callee is not fe and callee.syncs:
+                        hit = callee
+                        break
+                if hit is not None:
+                    fe.sync_via = hit
+                    changed = True
+                    break
+
+    project._jax_effects = effects
+    return effects

@@ -1,0 +1,50 @@
+"""DON01 bad fixture: reads after donation.
+
+Seeds: a decorated donating step whose input is read after the call, a
+`functools.partial(jax.jit, ...)` alias donation, and a donating
+`self.attr` jit read through a stale local.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def step(state, x):
+    return state + x
+
+
+def advance(state, x):
+    new = step(state, x)
+    # BAD: `state` was donated to `step`; its buffer may be gone.
+    return state + new
+
+
+def make_scale(factor):
+    return jax.jit(lambda s, u: s * factor + u, donate_argnums=0)
+
+
+def drive(state, u):
+    fn = make_scale(2.0)
+    out = fn(state, u)
+    # BAD: donated through the factory-built callable.
+    norm = state.sum()
+    return out, norm
+
+
+class Engine:
+    def __init__(self):
+        self.buf = jnp.zeros((4,))
+        self._inject = jax.jit(lambda buf, row: buf.at[0].set(row),
+                               donate_argnums=0)
+
+    def put_row(self, row):
+        old = self.buf
+        self.buf = self._inject(self.buf, row)
+        # BAD: `old` aliases the donated buffer... but aliases are not
+        # tracked; the direct re-read below is.
+        _ = self._inject(self.buf, row)
+        # BAD: self.buf donated on the line above and not reassigned.
+        return self.buf.sum()

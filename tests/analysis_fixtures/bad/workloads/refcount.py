@@ -1,0 +1,41 @@
+"""RCB01 bad fixture: unbalanced pooled-resource refcounts.
+
+Seeds: an early return that skips the release, an exception-path leak
+(a project call between acquire and release with no finally), and a
+bool-style reserve with no unreserve on the success path.
+"""
+
+
+class Worker:
+    def __init__(self, alloc, tier, lora):
+        self._alloc = alloc
+        self._tier = tier
+        self._lora = lora
+        self.count = 0
+
+    def _touch(self, b):
+        self.count += b
+
+    def skip_release(self, want):
+        b = self._alloc.alloc()
+        if b is None:
+            return False
+        if want > 4:
+            # BAD: returns with the block ref still held.
+            return True
+        self._alloc.release(b)
+        return True
+
+    def leak_on_raise(self, name):
+        ix = self._lora.acquire(name)
+        # BAD: if _touch raises, the adapter ref leaks — no finally.
+        self._touch(ix)
+        self._lora.release(name)
+        return True
+
+    def forget_unreserve(self, nbytes):
+        if not self._tier.reserve(nbytes):
+            return False
+        self.count += 1
+        # BAD: success path never unreserves and never records nbytes.
+        return True

@@ -1,0 +1,22 @@
+"""JIT01 bad fixture: jit constructed on the hot path.
+
+Seeds: a fresh `jax.jit` per call in a plain method, and the
+`functools.partial(jax.jit, ...)` spelling inside a free function.
+"""
+
+import functools
+
+import jax
+
+
+class Decoder:
+    def step(self, state, x):
+        # BAD: fresh jit object every call — retraces each time.
+        fn = jax.jit(lambda s, u: s + u)
+        return fn(state, x)
+
+
+def score_batch(params, batch):
+    # BAD: partial(jax.jit, ...) built per invocation.
+    jitted = functools.partial(jax.jit, static_argnums=0)(len)
+    return jitted(params, batch)

@@ -1,0 +1,46 @@
+"""SYN01 bad fixture: device syncs under the scheduler lock.
+
+Seeds: a direct `.item()` in a lock body, a `jax.device_get` reached
+two call hops below a locked region (summary propagation), and an
+`int()` of a device value inside the lock.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tokens = jnp.zeros((8,), jnp.int32)
+        self.count = 0
+
+    def admit(self, tok):
+        with self._lock:
+            # BAD: direct device sync while every submitter waits.
+            self.count += int(self.tokens.sum().item())
+            self.tokens = self.tokens.at[0].set(tok)
+
+    def _pull(self):
+        # Host copy: a sync, one hop down.
+        return jax.device_get(self.tokens)
+
+    def _drain(self):
+        # Second hop: calls the syncing helper.
+        vals = self._pull()
+        return list(vals)
+
+    def retire(self):
+        with self._lock:
+            # BAD: reaches jax.device_get two hops down the call graph.
+            drained = self._drain()
+        return drained
+
+    def peek(self):
+        first = jnp.argmax(self.tokens)
+        with self._lock:
+            # BAD: int() of a device value forces a blocking transfer.
+            self.count = int(first)
+        return self.count

@@ -1,0 +1,45 @@
+"""DON01 good fixture: the blessed donation idioms.
+
+The donated name is reassigned by the same statement (or never read
+again), so nothing stays poisoned.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def step(state, x):
+    return state + x
+
+
+def advance(state, x):
+    # OK: donated and reassigned in one statement.
+    state = step(state, x)
+    return state
+
+
+def advance_pair(state, x):
+    # OK: tuple target re-materializes the donated name.
+    state, aux = step(state, x), x
+    return state + aux
+
+
+class Engine:
+    def __init__(self):
+        self.buf = jnp.zeros((4,))
+        self._inject = jax.jit(lambda buf, row: buf.at[0].set(row),
+                               donate_argnums=0)
+
+    def put_row(self, row):
+        # OK: the canonical self-state update.
+        self.buf = self._inject(self.buf, row)
+        return self.buf.sum()
+
+    def put_twice(self, row):
+        for _ in range(2):
+            # OK even in a loop: each iteration reassigns before reading.
+            self.buf = self._inject(self.buf, row)
+        return self.buf.sum()

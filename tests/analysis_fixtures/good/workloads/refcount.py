@@ -1,0 +1,61 @@
+"""RCB01 good fixture: balanced, transferred, and pragma'd refs.
+
+Every acquire either releases on all exits (finally), hands the ref to
+an engine-owned structure (store/sink transfer), or documents the
+handoff with the transfer pragma.
+"""
+
+
+class Worker:
+    def __init__(self, alloc, tier, lora):
+        self._alloc = alloc
+        self._tier = tier
+        self._lora = lora
+        self._holds = {}
+        self._queue = []
+        self.count = 0
+
+    def _touch(self, b):
+        self.count += b
+
+    def balanced(self, want):
+        b = self._alloc.alloc()
+        if b is None:
+            return False
+        try:
+            # OK: the finally arm releases on every path, raise included.
+            self._touch(b)
+            return want > 4
+        finally:
+            self._alloc.release(b)
+
+    def handoff(self, name):
+        ix = self._lora.acquire(name)
+        # OK: stored into an engine-owned map — released at retire time.
+        self._holds[name] = ix
+        return True
+
+    def enqueue(self, name):
+        ix = self._lora.acquire(name)
+        # OK: pushed into an engine-owned queue (sink transfer).
+        self._queue.append(ix)
+        return True
+
+    def rollback_loop(self, n):
+        got = []
+        for _ in range(n):
+            b = self._alloc.alloc()
+            if b is None:
+                for x in got:
+                    self._alloc.release(x)
+                return False
+            got.append(b)
+        # OK: the batch lands in engine state.
+        self._holds["batch"] = got
+        return True
+
+    def ship(self, nbytes):
+        ok = self._tier.reserve(nbytes)  # analysis: transfer(RCB01)
+        # OK: the remote side owns the reservation after the ack
+        # (documented handoff — the pragma covers it).
+        return ok

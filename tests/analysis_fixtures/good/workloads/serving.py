@@ -1,0 +1,40 @@
+"""SYN01 good fixture: syncs hoisted, async dispatch under the lock.
+
+Device work under the lock is fine as long as nothing *waits*:
+`jnp.asarray` and jit calls enqueue and return; `.shape`/`.dtype` are
+host metadata; the host copy happens before the lock is taken.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tokens = jnp.zeros((8,), jnp.int32)
+        self.count = 0
+
+    def admit(self, tok):
+        # OK: the sync happens before the lock is taken.
+        total = int(self.tokens.sum())
+        with self._lock:
+            self.count += total
+            # OK: dispatch only — enqueues, does not wait.
+            self.tokens = self.tokens.at[0].set(tok)
+
+    def snapshot(self):
+        # OK: device_get outside any lock.
+        host = jax.device_get(self.tokens)
+        with self._lock:
+            self.count += 1
+            # OK: numpy on a host array is not a device sync.
+            return np.asarray(host).copy()
+
+    def sizes(self):
+        with self._lock:
+            # OK: metadata reads never touch the device.
+            return int(self.tokens.shape[0])

@@ -43,8 +43,8 @@ def test_bad_fixtures_trip_every_checker():
     report = run_analysis([BAD], root=BAD)
     assert report.errors == []
     assert _codes(report) == [
-        "ASY01", "ASY02", "KVB01", "KVB02", "LCK01", "LCK02", "LCK03", "MET01",
-        "POOL01", "SHD01", "SQL01", "TRC01",
+        "ASY01", "ASY02", "DON01", "JIT01", "KVB01", "KVB02", "LCK01", "LCK02",
+        "LCK03", "MET01", "POOL01", "RCB01", "SHD01", "SQL01", "SYN01", "TRC01",
     ]
     assert _keys(report, "SHD01") == ["runs"]
     # The whole-table pool gather in workloads/kv_blocks.py.
@@ -71,6 +71,15 @@ def test_bad_fixtures_trip_every_checker():
         "dialect:INSERT OR REPLACE/IGNORE/ABORT",
         "interp:fetchone",
     ]
+    # JAX hot-path codes (workloads/ fixtures).
+    assert _keys(report, "DON01") == [
+        "fn:state", "self._inject:self.buf", "step:state",
+    ]
+    assert _keys(report, "SYN01") == ["call:_drain", "sync:int", "sync:item"]
+    assert _keys(report, "RCB01") == [
+        "acquire:self._lora", "alloc:self._alloc", "reserve:self._tier",
+    ]
+    assert _keys(report, "JIT01") == ["jit:<lambda>", "jit:jit"]
     assert _keys(report, "MET01") == [
         "labels:dstack_tpu_widget_latency_seconds",
         "labels:dstack_tpu_widget_spins_total",
@@ -181,6 +190,212 @@ def test_suppression_pragmas(tmp_path):
     assert report.findings == []
 
 
+# ------------------------------------------- JAX hot-path effect analysis
+
+
+def test_syn01_two_hop_summary_propagation(tmp_path):
+    """A device sync two calls below the lock body still trips SYN01 —
+    the interprocedural summary carries `_pull`'s sync up through
+    `_drain` into the locked caller."""
+    _write(
+        tmp_path,
+        "workloads/rl.py",
+        '''
+        import threading
+
+        import jax
+
+
+        class Loop:
+            def __init__(self, params):
+                self._lock = threading.Lock()
+                self.params = params
+
+            def _pull(self):
+                return jax.device_get(self.params)
+
+            def _drain(self):
+                return list(self._pull())
+
+            def tick(self):
+                with self._lock:
+                    return self._drain()
+        ''',
+    )
+    report = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert _keys(report, "SYN01") == ["call:_drain"]
+    (finding,) = [f for f in report.findings if f.code == "SYN01"]
+    # The message carries the propagation trail so the fix site is clear.
+    assert "_pull" in finding.message
+
+
+def test_don01_through_partial_alias(tmp_path):
+    """Donation knowledge flows through functools.partial application
+    and a plain-name alias of the jitted function."""
+    _write(
+        tmp_path,
+        "workloads/don.py",
+        '''
+        import functools
+
+        import jax
+
+
+        def _step(state, x):
+            return state + x
+
+
+        step = functools.partial(jax.jit, donate_argnums=0)(_step)
+        alias = step
+
+
+        def advance(state, x):
+            out = alias(state, x)
+            return state + out
+        ''',
+    )
+    report = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert _keys(report, "DON01") == ["alias:state"]
+
+
+def test_rcb01_transfer_pragma(tmp_path):
+    """The transfer pragma documents an ownership handoff at the acquire
+    site; an identical acquire without it still leaks."""
+    _write(
+        tmp_path,
+        "workloads/tier.py",
+        '''
+        class Shipper:
+            def __init__(self, tier):
+                self._tier = tier
+                self.count = 0
+
+            def ship(self, nbytes):
+                if not self._tier.reserve(nbytes):  # analysis: transfer(RCB01)
+                    return False
+                self.count += nbytes
+                return True
+
+            def leak(self, nbytes):
+                if not self._tier.reserve(nbytes):
+                    return False
+                self.count += nbytes
+                return True
+        ''',
+    )
+    report = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert [f.symbol for f in report.findings if f.code == "RCB01"] == [
+        "Shipper.leak"
+    ]
+
+
+def test_jax_fingerprints_survive_line_shifts(tmp_path):
+    """All four hot-path codes key on symbol + semantic key, not line."""
+    body = '''
+    import functools
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(state, x):
+        return state + x
+
+
+    def bad_don(state, x):
+        y = step(state, x)
+        return state + y
+
+
+    class Eng:
+        def __init__(self, alloc):
+            self._alloc = alloc
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bad_sync(self):
+            with self._lock:
+                self.n = int(jnp.ones(3).sum().item())
+
+        def bad_alloc(self):
+            b = self._alloc.alloc()
+            if b is None:
+                return False
+            return True
+
+        def bad_jit(self, x):
+            f = jax.jit(lambda s: s * 2)
+            return f(x)
+    '''
+    _write(tmp_path, "workloads/serving.py", body)
+    before = run_analysis([str(tmp_path)], root=str(tmp_path))
+    _write(
+        tmp_path,
+        "workloads/serving.py",
+        "# shifted\n# down\n# by comments\n" + textwrap.dedent(body),
+    )
+    after = run_analysis([str(tmp_path)], root=str(tmp_path))
+    assert _codes(before) == ["DON01", "JIT01", "RCB01", "SYN01"]
+    fps_before = {f.fingerprint for f in before.findings}
+    fps_after = {f.fingerprint for f in after.findings}
+    assert fps_before == fps_after
+    lines = {(f.code, f.line) for f in before.findings}
+    assert lines != {(f.code, f.line) for f in after.findings}
+
+
+def test_jobs_parallel_scan_is_deterministic():
+    serial = run_analysis([BAD], root=BAD)
+    threaded = run_analysis([BAD], root=BAD, jobs=4)
+    assert [f.fingerprint for f in threaded.findings] == [
+        f.fingerprint for f in serial.findings
+    ]
+    assert threaded.exit_code == serial.exit_code
+
+
+def test_changed_only_scopes_to_dirty_files(tmp_path, capsys):
+    import subprocess
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+             "-c", "user.name=t", *argv],
+            check=True, capture_output=True,
+        )
+
+    _write(
+        tmp_path,
+        "committed.py",
+        '''
+        import time
+
+        async def f():
+            time.sleep(1)
+        ''',
+    )
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    _write(
+        tmp_path,
+        "dirty.py",
+        '''
+        import time
+
+        async def g():
+            time.sleep(1)
+        ''',
+    )
+    rc = cli_main(
+        [str(tmp_path), "--root", str(tmp_path), "--no-baseline",
+         "--changed-only", "--json"]
+    )
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["path"] for f in payload["findings"]] == ["dirty.py"]
+
+
 # ------------------------------------------------------ baseline round-trip
 
 
@@ -208,6 +423,10 @@ def test_baseline_round_trip(tmp_path, capsys):
     assert rc == 1
     assert payload["stale_baseline"] == sorted(entries)
     assert all(f["code"] == "BASE01" for f in payload["findings"])
+    # Stale messages name the original code + file, not just the raw
+    # fingerprint, so the cleanup edit is obvious.
+    assert any("ASY01 in " in f["message"] for f in payload["findings"])
+    assert all("delete `" in f["message"] for f in payload["findings"])
 
 
 def test_cli_json_contract(capsys):
@@ -215,10 +434,10 @@ def test_cli_json_contract(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert payload["exit_code"] == 1
-    assert payload["files_scanned"] == 11
+    assert payload["files_scanned"] == 15
     assert set(payload["checkers"]) >= {
-        "ASY01", "ASY02", "KVB01", "KVB02", "LCK01", "LCK02", "LCK03", "SQL01",
-        "MET01", "POOL01", "SHD01", "TRC01",
+        "ASY01", "ASY02", "DON01", "JIT01", "KVB01", "KVB02", "LCK01", "LCK02",
+        "LCK03", "RCB01", "SQL01", "MET01", "POOL01", "SHD01", "SYN01", "TRC01",
     }
     sample = payload["findings"][0]
     assert {"code", "message", "path", "line", "fingerprint"} <= set(sample)
@@ -244,6 +463,19 @@ def test_tree_has_zero_findings():
     )
     assert report.errors == []
     assert report.findings == [], "\n".join(f.render() for f in report.findings)
+
+
+def test_cli_clean_on_committed_tree(capsys):
+    """`python -m dstack_tpu.analysis --json` against the committed tree
+    exits 0 with the (empty) committed baseline — the make-lint gate."""
+    rc = cli_main(
+        [str(REPO / "dstack_tpu"), "--root", str(REPO),
+         "--baseline", str(REPO / "analysis_baseline.json"), "--json"]
+    )
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["findings"] == []
+    assert payload["stale_baseline"] == []
 
 
 def test_analyzer_self_check():
