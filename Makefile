@@ -4,7 +4,7 @@
 PYTHON ?= python
 PYTEST_ARGS ?= -q -m 'not slow' -p no:cacheprovider
 
-.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-routing bench-serving drill-disagg drill-rl bench-rl
+.PHONY: test test-all chaos chaos-fast chaos-replica-kill chaos-worker-kill chaos-outage chaos-shard-kill dataplane lint lint-json capacity capacity-smoke capacity-multi bench-proxy bench-routing bench-serving bench-coldstart drill-disagg drill-rl bench-rl
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ $(PYTEST_ARGS)
@@ -91,6 +91,17 @@ bench-routing:
 # to read them.
 bench-serving:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_serving.py --out BENCH_serving_r16.json
+
+# Scale-from-zero cold-start decomposition: boots the native server as a
+# fresh subprocess per arm (no cache / warm persistent compile cache /
+# warm cache + packed parallel weight load / warm standby) and splits
+# submit->first-token into stages from the ::dstack-tpu-stage:: markers.
+# Asserts the warm-cache compile stage is >=5x smaller than cold and
+# that the first post-/readyz request pays zero compiles (per-process
+# compile-counter diff over /metrics). Results land in
+# BENCH_coldstart_r20.json; see docs/guides/serving-tuning.md.
+bench-coldstart:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_coldstart.py --out BENCH_coldstart_r20.json
 
 # Prefill/decode disaggregation drill: two real worker processes over a
 # 2-way model mesh each, KV handoffs over a socket. Asserts token

@@ -1,0 +1,359 @@
+"""Scale-from-zero cold-start benchmark: submit -> first-token, by stage.
+
+Each arm boots the native model server (examples/deployment/native) as a
+fresh subprocess — the same thing a scale-from-zero replica does — and
+decomposes its time-to-first-token into the stages the cold-start fast
+path attacks:
+
+    spawn .. weights_start   process boot + imports + backend init
+    weights                  checkpoint restore (or in-process init)
+    compile                  warmup's compile_start .. compile_end
+    warmup_tail              compile_end .. warmup_end (device warm calls)
+    ready_wait               warmup_end .. the driver seeing /readyz 200
+    first_token              post-ready request submit -> first SSE token
+
+Stage boundaries come from the ::dstack-tpu-stage:: markers the workload
+already emits for the orchestrator's run timeline (utils/stagemarkers.py)
+— the driver sets DSTACK_RUN_NAME in the child env and timestamps each
+marker line as it arrives on the pipe, so the decomposition here is the
+same waterfall the control plane records for a real run.
+
+Arms (levers accumulate left to right):
+
+1. no_cache          — empty compile-cache dir, weights initialized
+                       in-process: the worst-case cold boot.
+2. warm_cache        — second boot against the same cache dir: every
+                       warmup program is retrieved from disk, not built.
+3. warm_cache_packed — warm cache + a save_packed checkpoint export
+                       (mmap + parallel device_put weight load).
+4. warm_standby      — the arm-3 server, already ready: request-only
+                       latency, the floor the boot arms chase.
+
+The wall-clock compile stage conflates two very different costs: Python
+tracing + lowering (paid on EVERY boot — no cache can remove it) and
+backend XLA compilation (what the persistent cache turns into a disk
+read). The headline compile-stage comparison therefore uses the
+engine's `compile_seconds_total` counter (/metrics — accumulated from
+jax's per-build duration events), with the wall spans reported
+alongside for the full budget picture.
+
+Asserts (exit nonzero on regression):
+
+- warm_cache's backend-compile seconds are >= 5x smaller than
+  no_cache's;
+- the first post-/readyz request pays ZERO compiles on every booted arm
+  (per-process `compiles_total` off /metrics, before vs after — the
+  counter moves on every XLA program build, cache hits included).
+
+Emits ONE JSON document (BENCH_coldstart_r20.json via --out) with the
+per-arm per-stage budget table and a summary of ratios + pass/fail.
+
+Run: JAX_PLATFORMS=cpu python bench_coldstart.py [--out ...]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import httpx
+
+REPO = Path(__file__).resolve().parent
+SERVER = REPO / "examples" / "deployment" / "native" / "server.py"
+STAGE_PREFIX = "::dstack-tpu-stage::"
+
+# Small engine so a full 4-arm sweep stays CI-sized: the stage structure
+# (and the cache-retrieval ratio) is what's being measured, not absolute
+# seconds on a laptop CPU backend. Speculative decoding is ON so the
+# warmup set includes the draft/verify ladder — the program mix a real
+# latency-tuned deployment boots with.
+SERVER_FLAGS = [
+    "--preset", "tiny", "--slots", "2", "--max-new-tokens", "8",
+    "--prefill-chunk-tokens", "128", "--kv-block-size", "8",
+    "--spec-enable", "--spec-max-draft", "4",
+]
+BOOT_TIMEOUT = 300.0
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ServerProc:
+    """A native-server subprocess plus the stage timeline read off its
+    stdout. Marker timestamps are the DRIVER's clock at pipe readout —
+    adds pipe latency (well under a millisecond) but keeps every stage
+    and the HTTP measurements on one clock."""
+
+    def __init__(self, port: int, cache_dir: str, checkpoint_dir: str = ""):
+        self.port = port
+        cmd = [sys.executable, str(SERVER), "--port", str(port),
+               "--compile-cache-dir", cache_dir, *SERVER_FLAGS]
+        if checkpoint_dir:
+            cmd += ["--checkpoint-dir", checkpoint_dir]
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(REPO),
+            "JAX_PLATFORMS": "cpu",
+            # auto_stage() only emits inside an orchestrated run; the
+            # bench impersonates one to get the marker timeline.
+            "DSTACK_RUN_NAME": "bench-coldstart",
+        }
+        self.t_spawn = time.perf_counter()
+        self.proc = subprocess.Popen(
+            cmd, env=env, cwd=REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        self.stages = {}  # stage name -> driver perf_counter
+        self.lines = []
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+
+    def _pump(self) -> None:
+        for line in self.proc.stdout:
+            now = time.perf_counter()
+            text = line.strip()
+            if text.startswith(STAGE_PREFIX):
+                self.stages.setdefault(text[len(STAGE_PREFIX):], now)
+            else:
+                self.lines.append(text)
+
+    def wait_ready(self) -> float:
+        deadline = self.t_spawn + BOOT_TIMEOUT
+        with httpx.Client(timeout=5.0) as hc:
+            while time.perf_counter() < deadline:
+                if self.proc.poll() is not None:
+                    raise RuntimeError(
+                        "server died during boot:\n" + "\n".join(self.lines)
+                    )
+                try:
+                    if hc.get(self._url("/readyz")).status_code == 200:
+                        return time.perf_counter()
+                except httpx.HTTPError:
+                    pass
+                time.sleep(0.05)
+        raise RuntimeError("server never became ready")
+
+    def _url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def metrics(self) -> dict:
+        with httpx.Client(timeout=10.0) as hc:
+            return hc.get(self._url("/metrics")).json()
+
+    def first_token_seconds(self) -> float:
+        """One streamed chat request; submit -> first content delta."""
+        body = {
+            "model": "bench", "stream": True, "max_tokens": 4,
+            "messages": [{"role": "user", "content": "cold start probe"}],
+        }
+        t0 = time.perf_counter()
+        with httpx.Client(timeout=60.0) as hc:
+            with hc.stream(
+                "POST", self._url("/v1/chat/completions"), json=body
+            ) as resp:
+                resp.raise_for_status()
+                for line in resp.iter_lines():
+                    if line.startswith("data: ") and "content" in line:
+                        return time.perf_counter() - t0
+        raise RuntimeError("stream ended without a token")
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+
+def stage_budget(sp: ServerProc, t_ready: float, first_token: float) -> dict:
+    """The per-stage table; `None` for any stage the arm never emitted
+    (a missing marker is a finding, not a KeyError)."""
+    s = sp.stages
+
+    def gap(a, b):
+        if a not in s or b not in s:
+            return None
+        return round(s[b] - s[a], 4)
+
+    return {
+        "spawn_to_weights_start": (
+            round(s["weights_start"] - sp.t_spawn, 4)
+            if "weights_start" in s else None
+        ),
+        "weights": gap("weights_start", "weights_end"),
+        "compile": gap("compile_start", "compile_end"),
+        "warmup_tail": gap("compile_end", "warmup_end"),
+        "ready_wait": (
+            round(t_ready - s["warmup_end"], 4)
+            if "warmup_end" in s else None
+        ),
+        "first_token": round(first_token, 4),
+        "total_spawn_to_first_token": round(
+            (t_ready - sp.t_spawn) + first_token, 4
+        ),
+    }
+
+
+def run_boot_arm(name: str, cache_dir: str, checkpoint_dir: str = "",
+                 keep: bool = False):
+    print(f"[{name}] booting ...", flush=True)
+    sp = ServerProc(free_port(), cache_dir, checkpoint_dir)
+    try:
+        t_ready = sp.wait_ready()
+        at_ready = sp.metrics()
+        first_token = sp.first_token_seconds()
+        after_first = sp.metrics()
+    except BaseException:
+        sp.stop()
+        raise
+    arm = {
+        "stages": stage_budget(sp, t_ready, first_token),
+        "weights_via": next(
+            (ln.split(" via ")[-1] for ln in sp.lines
+             if ln.startswith("weights: loaded")), None,
+        ),
+        "compiles_total_at_ready": at_ready.get("compiles_total"),
+        "compile_cache_hits_at_ready": at_ready.get(
+            "compile_cache_hits_total"
+        ),
+        # Backend-compile seconds at ready: the XLA-build share of the
+        # wall-clock `compile` stage. The remainder is Python tracing +
+        # lowering, which every boot pays and no cache can remove — so
+        # THIS is the number the persistent cache is judged on.
+        "backend_compile_seconds_at_ready": at_ready.get(
+            "compile_seconds_total"
+        ),
+        "post_ready_first_request_compiles": (
+            after_first.get("compiles_total", 0)
+            - at_ready.get("compiles_total", 0)
+        ),
+    }
+    print(f"[{name}] {json.dumps(arm['stages'])}", flush=True)
+    if keep:
+        return arm, sp
+    sp.stop()
+    return arm, None
+
+
+def make_packed_checkpoint(directory: str) -> None:
+    """The same tiny-preset params the server would init, exported in
+    the save_packed single-file layout the parallel loader mmaps."""
+    import jax
+
+    from dstack_tpu.workloads import checkpoint as ckpt
+    from dstack_tpu.workloads.config import PRESETS
+    from dstack_tpu.workloads.transformer import init_params
+
+    params = init_params(PRESETS["tiny"], jax.random.PRNGKey(0))
+    ckpt.save_packed(directory, params)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="BENCH_coldstart_r20.json")
+    parser.add_argument("--standby-requests", type=int, default=5)
+    parser.add_argument("--warm-repeats", type=int, default=2,
+                        help="warm_cache boots; the best (min compile"
+                             " stage) is reported — warm boots are cheap"
+                             " and min-of-N estimates the noise floor")
+    args = parser.parse_args()
+
+    work = tempfile.mkdtemp(prefix="bench_coldstart_")
+    cache_dir = os.path.join(work, "compile-cache")
+    ckpt_dir = os.path.join(work, "ckpt")
+    arms = {}
+    standby_server = None
+    try:
+        arms["no_cache"], _ = run_boot_arm("no_cache", cache_dir)
+        warm_runs = [
+            run_boot_arm(f"warm_cache#{i + 1}", cache_dir)[0]
+            for i in range(max(1, args.warm_repeats))
+        ]
+        arms["warm_cache"] = min(
+            warm_runs,
+            key=lambda a: a["backend_compile_seconds_at_ready"]
+            or float("inf"),
+        )
+        arms["warm_cache"]["backend_compile_samples"] = [
+            a["backend_compile_seconds_at_ready"] for a in warm_runs
+        ]
+        make_packed_checkpoint(ckpt_dir)
+        arms["warm_cache_packed"], standby_server = run_boot_arm(
+            "warm_cache_packed", cache_dir, ckpt_dir, keep=True,
+        )
+        # Warm standby: the arm-3 server again, now hot — in-memory jit
+        # dispatch, no boot at all. The floor every boot arm chases.
+        samples = sorted(
+            standby_server.first_token_seconds()
+            for _ in range(args.standby_requests)
+        )
+        arms["warm_standby"] = {
+            "stages": {
+                "first_token": round(samples[len(samples) // 2], 4),
+            },
+            "first_token_samples": [round(x, 4) for x in samples],
+        }
+        print(f"[warm_standby] {json.dumps(arms['warm_standby'])}",
+              flush=True)
+    finally:
+        if standby_server is not None:
+            standby_server.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+    cold_compile = arms["no_cache"]["backend_compile_seconds_at_ready"]
+    warm_compile = arms["warm_cache"]["backend_compile_seconds_at_ready"]
+    compile_speedup = (
+        cold_compile / warm_compile
+        if cold_compile and warm_compile else None
+    )
+    zero_post_ready = all(
+        arms[a]["post_ready_first_request_compiles"] == 0
+        for a in ("no_cache", "warm_cache", "warm_cache_packed")
+    )
+    summary = {
+        "compile_stage_cold_seconds": cold_compile,
+        "compile_stage_warm_seconds": warm_compile,
+        "compile_stage_speedup": (
+            round(compile_speedup, 2) if compile_speedup else None
+        ),
+        "compile_wall_cold_seconds": arms["no_cache"]["stages"]["compile"],
+        "compile_wall_warm_seconds": arms["warm_cache"]["stages"]["compile"],
+        "pass_compile_speedup_5x": bool(
+            compile_speedup and compile_speedup >= 5.0
+        ),
+        "pass_zero_post_ready_compiles": zero_post_ready,
+        "total_cold_seconds": arms["no_cache"]["stages"][
+            "total_spawn_to_first_token"
+        ],
+        "total_warm_packed_seconds": arms["warm_cache_packed"]["stages"][
+            "total_spawn_to_first_token"
+        ],
+    }
+    doc = {
+        "bench": "coldstart",
+        "revision": "r20",
+        "config": {"server_flags": SERVER_FLAGS,
+                   "standby_requests": args.standby_requests},
+        "arms": arms,
+        "summary": summary,
+    }
+    Path(args.out).write_text(json.dumps(doc, indent=2) + "\n")
+    print(json.dumps(summary, indent=2))
+    ok = summary["pass_compile_speedup_5x"] and zero_post_ready
+    print("PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,10 @@ Construction is fine at the blessed seams, which are exempt:
 
 - `make_*` / `_make_*` factory functions (construct once, hand out);
 - `__init__` / `__post_init__` (construct once per engine);
+- `warmup` / `_warmup` methods — the readiness-gating warmup pass
+  exists precisely to pay construction + compile before the first
+  request, so jit built there is the fix for a retrace hazard, not an
+  instance of one;
 - memoized bucket seams — construction lexically under an
   `if fn is None:` / `if key not in cache:` probe, or assigned straight
   into a subscripted cache (`self._fns[n_pad] = jax.jit(...)`);
@@ -26,6 +30,10 @@ from dstack_tpu.analysis.effects import in_scope
 
 _FACTORY_PREFIXES = ("make_", "_make_", "build_", "_build_")
 _CTOR_NAMES = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+# The warmup seam runs once, before /readyz flips: construction there is
+# the cold-start fast path doing its job (pre-building every program the
+# hot path will dispatch), never a per-request retrace.
+_WARMUP_NAMES = {"warmup", "_warmup"}
 
 
 def _outer_functions(module: Module):
@@ -87,7 +95,11 @@ class RetraceChecker(Checker):
         findings: List[Finding] = []
         for qualname, func in _outer_functions(module):
             bare = qualname.split(".")[-1]
-            if bare.startswith(_FACTORY_PREFIXES) or bare in _CTOR_NAMES:
+            if (
+                bare.startswith(_FACTORY_PREFIXES)
+                or bare in _CTOR_NAMES
+                or bare in _WARMUP_NAMES
+            ):
                 continue
             self._scan(module, qualname, func.body, memo_guard=False,
                        findings=findings)

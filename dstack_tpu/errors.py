@@ -105,6 +105,17 @@ class BadRequestError(ApiError):
     status = 400
 
 
+class NoReplicasError(BadRequestError):
+    """A service exists but has zero running replicas right now.
+
+    Subclasses BadRequestError so every existing handler keeps working;
+    the model proxy catches it specifically to answer 503 + Retry-After
+    during a scale-from-zero warmup instead of a bare client error."""
+
+    def __init__(self, msg: str = "No running replicas", **kwargs):
+        super().__init__(msg, **kwargs)
+
+
 class ConflictError(ApiError):
     code = "conflict"
     status = 409

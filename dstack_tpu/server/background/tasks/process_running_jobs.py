@@ -543,9 +543,15 @@ async def _submit_to_runner(
     # stage 5, docs/guides/multihost.md) because the cache outlives
     # the container AND the instance — an instance mount would die
     # with the VM, silently re-paying the compile on re-provision.
-    # User-set value always wins; without a volume there is nowhere
-    # durable to put it.
-    if "JAX_COMPILATION_CACHE_DIR" not in env:
+    # The server exports the BASE path via DSTACK_TPU_COMPILE_CACHE:
+    # the workload side (workloads/compile_cache.py) nests its actual
+    # cache under a jax+jaxlib+backend-keyed leaf it computes from its
+    # OWN runtime, because the server cannot know the worker's versions
+    # and an unkeyed shared dir segfaults on foreign entries (PR 14
+    # addendum). User-set cache env (either variable) always wins;
+    # without a volume there is nowhere durable to put it.
+    if ("JAX_COMPILATION_CACHE_DIR" not in env
+            and "DSTACK_TPU_COMPILE_CACHE" not in env):
         from dstack_tpu.models.volumes import VolumeMountPoint
 
         durable = next(
@@ -553,7 +559,7 @@ async def _submit_to_runner(
              if isinstance(m, VolumeMountPoint)), None,
         )
         if durable is not None:
-            env["JAX_COMPILATION_CACHE_DIR"] = (
+            env["DSTACK_TPU_COMPILE_CACHE"] = (
                 durable.path.rstrip("/") + "/.jax-compile-cache"
             )
     job_spec = job_spec.model_copy(update={"env": env})

@@ -106,6 +106,17 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "dstack_tpu_routing_affinity_misses_total": ("counter", ()),
     "dstack_tpu_routing_affinity_score": ("histogram", ()),
     "dstack_tpu_routing_sketch_age_seconds": ("gauge", ()),
+    # Cold-start fast path (PR 20, workloads/compile_cache.py): programs
+    # retrieved from vs written to the persistent XLA compile cache.
+    # hits+misses move only when the persistent cache is enabled; a warm
+    # boot shows hits ~= the engine's program count and a near-zero
+    # compile stage (docs/guides/serving-tuning.md, "cold start").
+    "dstack_tpu_compile_cache_hits_total": ("counter", ()),
+    "dstack_tpu_compile_cache_misses_total": ("counter", ()),
+    # Seconds inside backend compilation (retrievals report their own,
+    # much smaller, durations) — the cost the cache removes; wall-clock
+    # warmup also pays tracing/lowering, which it cannot.
+    "dstack_tpu_compile_seconds_total": ("counter", ()),
     # Serving engine (workloads/serving.py `prometheus_metrics`, exposed
     # by the native model server's /metrics): paged-KV pool occupancy,
     # prefix-cache effectiveness, chunked-prefill accounting, and the
@@ -195,6 +206,12 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # (receipt -> first delivery) and a unified engine's full TTFT —
     # different quantities that must not aggregate into one distribution.
     "dstack_tpu_serving_ttft_seconds": ("histogram", ("role",)),
+    # Warmup pass wall time (engine.warmup(): pre-building every jitted
+    # program before /readyz flips ready). One sample per boot; the
+    # cold/warm-cache gap IS the persistent cache's win. The cold_start
+    # role value on the TTFT histogram above tags first tokens delivered
+    # by a warmup-less boot's first-ever request.
+    "dstack_tpu_serving_warmup_seconds": ("histogram", ()),
     # Spec cache (PR 3).
     "dstack_tpu_spec_cache_entries": ("gauge", ()),
     "dstack_tpu_spec_cache_hit_rate": ("gauge", ()),

@@ -21,7 +21,11 @@ from typing import Any, Dict, List
 import httpx
 
 from dstack_tpu.dataplane.qos import DEFAULT_TENANT, TenantShedError
-from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.errors import (
+    BadRequestError,
+    NoReplicasError,
+    ResourceNotExistsError,
+)
 from dstack_tpu.server import settings
 from dstack_tpu.server.http import Request, Response, Router
 from dstack_tpu.server.routers.deps import get_ctx
@@ -139,11 +143,30 @@ async def chat_completions(request: Request, project_name: str):
         target = await pick_replica(
             ctx, project_name, match["run_name"], affinity=affinity
         )
-    except Exception:
+    except NoReplicasError:
         # Demand against a service with no live replica still counts as
-        # RPS — it is exactly the scale-from-zero wake signal.
+        # RPS — it is exactly the scale-from-zero wake signal. The
+        # routing cache never caches this answer, so the next request
+        # re-checks; meanwhile the caller gets a retryable 503 with a
+        # Retry-After sized from the service's last OBSERVED cold-start
+        # budget (stats.py), not a bare client error — "warming up" is
+        # the server's condition, not the caller's mistake.
+        ctx.service_stats.record(project_name, match["run_name"])
+        ctx.service_stats.note_no_replicas(project_name, match["run_name"])
+        retry_after = ctx.service_stats.get_retry_after(
+            project_name, match["run_name"]
+        )
+        return Response(
+            {"detail": f"Service {match['run_name']} has no running"
+                       " replicas yet (scaling from zero); retry after"
+                       f" {int(retry_after + 0.5)}s"},
+            status=503,
+            headers={"retry-after": str(max(1, int(retry_after + 0.5)))},
+        )
+    except Exception:
         ctx.service_stats.record(project_name, match["run_name"])
         raise
+    ctx.service_stats.note_replicas_available(project_name, match["run_name"])
     if match["format"] == "tgi":
         resp = await _tgi_chat(ctx, request, target, target.base_url, body)
     else:

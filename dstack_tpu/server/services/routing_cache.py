@@ -48,7 +48,11 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
-from dstack_tpu.errors import BadRequestError, ResourceNotExistsError
+from dstack_tpu.errors import (
+    BadRequestError,
+    NoReplicasError,
+    ResourceNotExistsError,
+)
 from dstack_tpu.server.tracing import HistogramData
 
 # Score-histogram ladder in expected-matched-block units (not seconds):
@@ -210,7 +214,7 @@ class RoutingCache:
         # "No running replicas" is NOT cached: scale-from-zero wants the
         # next request to see a replica the moment the FSM brings one up.
         if not targets:
-            raise BadRequestError("No running replicas")
+            raise NoReplicasError()
         return targets, project_row["id"]
 
     async def get_models(self, ctx, project_name: str) -> List[Dict[str, Any]]:
@@ -317,7 +321,7 @@ class RoutingCache:
         """
         candidates = [t for t in targets if t.job_id not in set(exclude)]
         if not candidates:
-            raise BadRequestError("No running replicas")
+            raise NoReplicasError()
         with self._lock:
             now = time.monotonic()
             for job_id in [j for j, until in self._breaker.items() if until <= now]:

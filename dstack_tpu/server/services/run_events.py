@@ -4,9 +4,9 @@ Every layer that observes a run changing stage appends one event here:
 the submit router (`submitted`), the run FSM (`provisioning`, `preempt`,
 `resume`, `resize`), the running-jobs processor (`instance_ready`,
 `pulling`, `env_ready`), the runner agent (`drain`), and the workload
-itself (`tpu_init`, `compile_start`, `compile_end`, `first_step`,
-`first_token` — via stage markers relayed through the runner report
-channel). `GET /api/project/{p}/runs/{run}/timeline` turns the table
+itself (`tpu_init`, `weights_start`, `weights_end`, `compile_start`,
+`compile_end`, `warmup_end`, `first_step`, `first_token` — via stage
+markers relayed through the runner report channel). `GET /api/project/{p}/runs/{run}/timeline` turns the table
 into a per-host waterfall, and every recorded transition feeds the
 `dstack_tpu_run_stage_seconds` histogram, so the cold-start breakdown
 (arXiv:2312.07220's dominant serverless overhead) is measurable per
@@ -36,8 +36,11 @@ STAGES = (
     "pulling",
     "env_ready",
     "tpu_init",
+    "weights_start",
+    "weights_end",
     "compile_start",
     "compile_end",
+    "warmup_end",
     "first_step",
     "first_token",
     "drain",

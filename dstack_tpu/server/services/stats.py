@@ -28,6 +28,15 @@ class ServiceStatsCollector:
         # latency regression shows up within one window instead of being
         # averaged away by history.
         self._latency: Dict[Tuple[str, str, str], Deque[Tuple[float, float]]] = defaultdict(deque)
+        # Scale-from-zero episodes: the proxy marks when it first finds a
+        # service replica-less and when a replica next answers a pick.
+        # The gap is the OBSERVED cold-start budget for that service —
+        # provision + pull + weights + compile as the proxy experienced
+        # it — and it sizes the Retry-After on 503s during the next
+        # episode. Not windowed: the last completed budget stays
+        # meaningful however rarely the service scales to zero.
+        self._cold_since: Dict[Tuple[str, str], float] = {}
+        self._cold_budget: Dict[Tuple[str, str], float] = {}
 
     def record(self, project_name: str, run_name: str, count: int = 1) -> None:
         key = (project_name, run_name)
@@ -92,6 +101,36 @@ class ServiceStatsCollector:
         for _, seconds in q:
             hist.observe(seconds)
         return hist.to_dict()
+
+    DEFAULT_COLD_START = 30.0
+
+    def note_no_replicas(self, project_name: str, run_name: str) -> None:
+        """A request found the service replica-less: open a cold-start
+        episode (idempotent while the episode lasts)."""
+        self._cold_since.setdefault(
+            (project_name, run_name), time.monotonic()
+        )
+
+    def note_replicas_available(self, project_name: str, run_name: str) -> None:
+        """A pick succeeded: close any open episode and record its length
+        as the service's observed cold-start budget."""
+        since = self._cold_since.pop((project_name, run_name), None)
+        if since is not None:
+            self._cold_budget[(project_name, run_name)] = (
+                time.monotonic() - since
+            )
+
+    def get_retry_after(self, project_name: str, run_name: str) -> float:
+        """Seconds a caller should wait before retrying a replica-less
+        service: the remainder of the last observed cold-start budget
+        (budget minus how long this episode has already run), floored at
+        1s so late retries poll gently instead of hammering. Before any
+        budget has ever been observed, a conservative default."""
+        key = (project_name, run_name)
+        budget = self._cold_budget.get(key, self.DEFAULT_COLD_START)
+        since = self._cold_since.get(key)
+        elapsed = 0.0 if since is None else time.monotonic() - since
+        return max(1.0, budget - elapsed)
 
     def _trim(self, key: Tuple[str, str]) -> None:
         self._trim_q(self._events, key)

@@ -1,0 +1,168 @@
+"""Persistent XLA compile cache + compile-event counters.
+
+The scale-from-zero cold-start budget (docs/guides/serving-tuning.md,
+"cold start") is dominated by XLA compiling the engine's jitted program
+set on first boot. JAX's persistent compilation cache keys entries on
+the HLO, so a repeat boot of the same model retrieves executables from
+disk instead of recompiling — IF the cache directory survives the
+container. The server's volume plumbing mounts one per durable volume
+(`JAX_COMPILATION_CACHE_DIR`, process_running_jobs.py); workloads opt in
+locally with `DSTACK_TPU_COMPILE_CACHE` or the native server's
+`--compile-cache-dir`.
+
+VERSION KEYING IS LOAD-BEARING: the serialized executables are jaxlib-
+and backend-specific, and deserializing a foreign entry does not fail
+cleanly — it segfaults (observed on the PR 14 subprocess drills, which
+is why tests/conftest.py long refused to export its cache to children).
+`cache_dir_for` therefore nests every cache under a
+``jax<ver>-jaxlib<ver>-<backend>`` leaf, so one shared volume (or one
+shared /tmp dir) can serve heterogeneous workers: a version bump lands
+in a fresh leaf instead of poisoning the old one.
+
+Counters ride JAX's monitoring seam and power the warmup-gated
+readiness contract (`ServingEngine.warmup`): `/jax/core/compile/
+backend_compile_duration` fires once per program BUILD — fresh compile
+or persistent-cache retrieval — and never on an in-memory jit dispatch
+hit, so "zero compile events after /readyz" is exactly the property
+"the first request re-traces nothing". The cache_hits/cache_misses
+events split builds into disk retrievals vs real XLA compiles.
+"""
+
+import os
+import threading
+from typing import Dict, Optional
+
+ENV_VAR = "DSTACK_TPU_COMPILE_CACHE"
+
+# Monitoring event names (stable across jax 0.4.x; verified against the
+# pinned jaxlib). backend_compile_duration fires for fresh compiles AND
+# persistent-cache retrievals; the hit/miss events only fire when the
+# persistent cache is enabled.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+# compile_seconds accumulates the reported durations: time actually
+# spent inside backend compilation (disk retrieval counts its own, much
+# smaller, duration). It is the denominator that makes cache wins
+# measurable — wall-clock warmup spans conflate it with Python tracing
+# and lowering, which a warm cache cannot remove.
+_counts = {
+    "compiles": 0, "cache_hits": 0, "cache_misses": 0,
+    "compile_seconds": 0.0,
+}
+_installed = False
+_enabled_dir: Optional[str] = None
+
+
+def backend_name() -> str:
+    """The platform token that keys the cache dir. Prefer the pinned
+    JAX_PLATFORMS (orchestrated runs always set it) so keying never has
+    to initialize the backend; fall back to asking JAX."""
+    pinned = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if pinned:
+        return pinned
+    import jax
+
+    return jax.default_backend()
+
+
+def cache_dir_for(base: str, backend: Optional[str] = None) -> str:
+    """`base`/jax<ver>-jaxlib<ver>-<backend>: the version+backend-keyed
+    leaf a process may actually read executables from."""
+    import jax
+    import jaxlib
+
+    return os.path.join(
+        base,
+        f"jax{jax.__version__}-jaxlib{jaxlib.__version__}"
+        f"-{backend or backend_name()}",
+    )
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        with _lock:
+            _counts["cache_hits"] += 1
+    elif event == _MISS_EVENT:
+        with _lock:
+            _counts["cache_misses"] += 1
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _counts["compiles"] += 1
+            _counts["compile_seconds"] += duration
+
+
+def install_counters() -> None:
+    """Register the monitoring listeners once per process. Idempotent;
+    cheap enough to call from every engine constructor."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    from jax._src import monitoring
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def enable(base_dir: str, backend: Optional[str] = None) -> str:
+    """Point JAX's persistent compilation cache at the version-keyed
+    leaf under `base_dir` (created if absent) and install the counters.
+    min_compile_time is forced to 0 so even the tiny programs (table-row
+    setters, block copies) cache — a warm boot must retrieve the WHOLE
+    program set or the first request still pays a compile. Returns the
+    leaf directory."""
+    import jax
+
+    global _enabled_dir
+    d = cache_dir_for(base_dir, backend)
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    install_counters()
+    with _lock:
+        _enabled_dir = d
+    return d
+
+
+def enable_from_env() -> Optional[str]:
+    """`enable()` from DSTACK_TPU_COMPILE_CACHE when set (no-op
+    otherwise). JAX_COMPILATION_CACHE_DIR wins if the user exported it —
+    that path is already live inside JAX and is NOT version-keyed by us;
+    we leave it exactly as configured."""
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        install_counters()
+        with _lock:
+            return _enabled_dir
+    base = os.environ.get(ENV_VAR)
+    if not base:
+        return None
+    return enable(base)
+
+
+def enabled_dir() -> Optional[str]:
+    """The active version-keyed cache leaf, or None when this module
+    never enabled one (a user-exported JAX_COMPILATION_CACHE_DIR does
+    not count — it is not ours to report as version-keyed)."""
+    with _lock:
+        return _enabled_dir
+
+
+def compile_count() -> int:
+    """Programs BUILT so far in this process (fresh compile or
+    persistent-cache retrieval — both mean the in-memory jit cache
+    missed). The warmup readiness assert is `compile_count()` not
+    moving across a post-ready request."""
+    with _lock:
+        return _counts["compiles"]
+
+
+def snapshot() -> Dict[str, float]:
+    with _lock:
+        return dict(_counts)

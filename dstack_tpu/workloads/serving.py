@@ -55,6 +55,7 @@ from jax import lax
 from dstack_tpu.server.tracing import HistogramData
 from dstack_tpu.utils.flight_recorder import FlightRecorder
 from dstack_tpu.utils.stagemarkers import auto_stage
+from dstack_tpu.workloads import compile_cache
 from dstack_tpu.workloads.attention import decode_attention
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.generate import (
@@ -484,6 +485,14 @@ class ServingEngine:
         max_resident_slots: Optional[int] = None,
         qos_weights: Optional[Dict[str, float]] = None,
     ):
+        # Persistent compile cache (workloads/compile_cache.py): honors
+        # DSTACK_TPU_COMPILE_CACHE before any jitted program below is
+        # built, so a repeat boot of the same model retrieves its whole
+        # program set from disk instead of recompiling. The monitoring
+        # counters back warmup()'s zero-post-ready-compile contract and
+        # are installed even when no cache dir is configured.
+        self._compile_cache_dir = compile_cache.enable_from_env()
+        compile_cache.install_counters()
         self.config = config
         self.params = params
         self.slots = slots
@@ -819,6 +828,19 @@ class ServingEngine:
         # exposes dstack_tpu_serving_ttft_seconds as a real histogram so
         # scrapers get quantiles, not just per-window means.
         self._ttft_hist = HistogramData()
+        # Cold-start TTFT split: until warmup() has run OR a first token
+        # has been delivered, TTFT samples land under role="cold_start" —
+        # the sample that paid compilation on a warmup-less boot. A
+        # warmup-gated boot keeps this bucket empty, which is the point.
+        self._ttft_cold_hist = HistogramData()
+        self._cold_over = False
+        # warmup() bookkeeping: whether the full jitted program set has
+        # been pre-built, how long that took, and how many programs it
+        # covered (stats()/prometheus surface all three).
+        self._warmup_done = False
+        self._warmup_seconds: Optional[float] = None
+        self._warmup_programs = 0
+        self._warmup_hist = HistogramData()
         # One first_token timeline marker per engine lifetime (stage
         # markers ride stdout; see utils/stagemarkers.py).
         self._first_token_emitted = False
@@ -995,6 +1017,234 @@ class ServingEngine:
             if self._host_tier is not None:
                 dropped += self._host_tier.clear()
         return dropped
+
+    def _observe_ttft(self, dt: float) -> None:
+        """TTFT histogram sample, split by cold start: the first token an
+        engine that never ran warmup() ever delivers paid the jit
+        trace+compile for its whole dispatch chain — a different
+        distribution that must not pollute the steady-state one."""
+        if self._cold_over:
+            self._ttft_hist.observe(dt)
+        else:
+            self._ttft_cold_hist.observe(dt)
+            self._cold_over = True
+
+    def _warmup_idle_check(self) -> None:
+        """Raise unless the engine is at the idle boundary warmup needs
+        (same invariant as refresh_params: warmup invokes the real
+        donated-state programs, which must not race in-flight work)."""
+        busy = (
+            any(r is not None for r in self._live)
+            or self._tasks or self._admitting or self._swapped
+            or self._pending_activation or self._prefilled_pending
+            or self._next_req is not None
+            or not self._pending.empty()
+        )
+        if busy:
+            raise RuntimeError(
+                "warmup requires an idle engine: call it before serving"
+                " traffic (readiness gating) or after a drain"
+            )
+
+    def warmup(self) -> Dict[str, Any]:
+        """Pre-build every jitted program the scheduler can dispatch, so
+        the first post-ready request provably pays zero compile.
+
+        The warmup INVOKES the real jitted callables rather than AOT-
+        compiling them: `.lower().compile()` would leave jit's in-memory
+        dispatch cache cold, and the first live call would still re-trace
+        and (at best) re-retrieve from the persistent cache — a compile
+        event the readiness contract forbids. Every invocation is a
+        semantic no-op on an idle engine: a chunk prefill with n_valid=0
+        and finalize=False routes all KV writes to the pad sentinel block
+        and leaves every scalar field untouched (only slot 0's table row
+        is set — to the all-sentinel padding admission always overwrites);
+        a decode step / spec round over an all-inactive batch points its
+        write lanes at the sentinel and emits nothing; block copies copy
+        block 0 onto itself. Donated state is reassigned exactly like the
+        live call sites do.
+
+        Coverage: every pow-2 prefill bucket `_pad_chunk` can produce
+        (plus the LoRA-indexed flavor and the drafter's twin), the decode
+        step (LoRA and base), the spec draft/verify ladder for every
+        draft length 1..spec_max_draft, the table-row setter, the CoW
+        block copies, and the role's KV-transfer programs (pow-2 gathers
+        on the prefill tier; injects + slot placement on decode).
+
+        Emits the `compile_start`/`compile_end`/`warmup_end` stage
+        markers for the run timeline, and reports the compile-counter
+        delta (workloads/compile_cache.py) so callers can tell fresh
+        compiles from persistent-cache retrievals. Only legal on an idle
+        engine (RuntimeError otherwise); admission stays held for the
+        duration. Returns {"seconds", "programs", "compiles",
+        "cache_hits", "cache_misses", "compile_seconds"}.
+        """
+        with self._lock:
+            if self._failed is not None:
+                raise RuntimeError("engine already failed") from self._failed
+            self._warmup_idle_check()
+            self._hold_admission = True
+        t0 = time.monotonic()
+        before = compile_cache.snapshot()
+        auto_stage("compile_start")
+        programs = 0
+        try:
+            # Decode step(s): all-inactive batch, write lane -> sentinel.
+            self._rng, sub = jax.random.split(self._rng)
+            if self._lora is not None:
+                self.state, toks, _ = self._step(
+                    self.params, self.state, sub, self._lora.bank
+                )
+                programs += 1
+                self._rng, sub = jax.random.split(self._rng)
+            self.state, toks, _ = self._step_base(
+                self.params, self.state, sub
+            )
+            programs += 1
+            # Chunked-prefill buckets: every value _pad_chunk can return.
+            row = jnp.asarray(self._pad_table([]), jnp.int32)
+            buckets = sorted(
+                {self._pad_chunk(n)
+                 for n in range(1, self.prefill_chunk_tokens + 1)}
+            )
+            for b in buckets:
+                chunk_args = (
+                    jnp.asarray(0, jnp.int32),          # slot
+                    row,                                 # all-sentinel table
+                    # Built exactly like the live dispatch site (python
+                    # list -> asarray): the weak-type strip is its own
+                    # tiny convert_element_type program per bucket shape,
+                    # and it must be warm too.
+                    jnp.asarray([[0] * b], jnp.int32),   # tokens
+                    jnp.asarray(0, jnp.int32),           # n_valid: no writes
+                    jnp.asarray(0, jnp.int32),           # start
+                    jnp.asarray(0, jnp.int32),           # budget
+                    jnp.asarray(1.0, jnp.float32),
+                    jnp.asarray(1.0, jnp.float32),
+                )
+                self._rng, sub = jax.random.split(self._rng)
+                self.state, _ = self._chunk_fn(b)(
+                    self.params, self.state, *chunk_args, sub,
+                    jnp.asarray(False, bool),
+                )
+                programs += 1
+                if self._lora is not None:
+                    self._rng, sub = jax.random.split(self._rng)
+                    self.state, _ = self._chunk_fn(b, lora=True)(
+                        self.params, self.state, *chunk_args, sub,
+                        jnp.asarray(False, bool),
+                        jnp.asarray(0, jnp.int32), self._lora.bank,
+                    )
+                    programs += 1
+                if self._spec:
+                    self._rng_draft, dsub = jax.random.split(self._rng_draft)
+                    self._draft_state, _ = self._draft_chunk_fn(b)(
+                        self._draft_params, self._draft_state, *chunk_args,
+                        dsub, jnp.asarray(False, bool),
+                    )
+                    programs += 1
+            # Speculation ladder: every draft length the per-slot
+            # adaptation can reach.
+            if self._spec:
+                for k in range(1, self._spec_max_draft + 1):
+                    self._rng_draft, dsub = jax.random.split(self._rng_draft)
+                    dk, dv, drafts, qlogits = self._spec_draft_fn(k)(
+                        self._draft_params, self._draft_state.k,
+                        self._draft_state.v, self.state.block_tables,
+                        self.state.lengths, self.state.last_token,
+                        self.state.active, self.state.temperature,
+                        self.state.top_p, dsub,
+                    )
+                    self._draft_state = self._draft_state._replace(k=dk, v=dv)
+                    self._rng, vsub = jax.random.split(self._rng)
+                    self.state, *_ = self._spec_verify_fn(k)(
+                        self.params, self.state, drafts, qlogits, vsub
+                    )
+                    programs += 2
+                    if self._lora is not None:
+                        self._rng, vsub = jax.random.split(self._rng)
+                        self.state, *_ = self._spec_verify_fn(k, lora=True)(
+                            self.params, self.state, drafts, qlogits, vsub,
+                            self._lora.bank,
+                        )
+                        programs += 1
+                self._draft_state = self._copy_draft_block(
+                    self._draft_state, 0, 0
+                )
+                programs += 1
+            # Table-row setter + CoW block copy (block 0 onto itself).
+            self.state = self.state._replace(
+                block_tables=self._set_table_row(
+                    self.state.block_tables, jnp.asarray(0, jnp.int32), row
+                )
+            )
+            self.state = self._copy_block(self.state, 0, 0)
+            programs += 2
+            # KV-transfer programs for this role's side of the seam.
+            blk_pads = []
+            n_pad = 1
+            while n_pad < self._max_blocks:
+                blk_pads.append(n_pad)
+                n_pad <<= 1
+            blk_pads.append(n_pad)
+            if self.role == "prefill":
+                for n_pad in blk_pads:
+                    ids = jnp.full((n_pad,), self._num_blocks, jnp.int32)
+                    toks = self._gather_blocks_fn(n_pad)(self.state.k, ids)
+                    programs += 1
+            if self.role == "decode":
+                for n_pad in blk_pads:
+                    ids = jnp.full((n_pad,), self._num_blocks, jnp.int32)
+                    payload = jnp.zeros(
+                        self.state.k.shape[:1] + (n_pad,)
+                        + self.state.k.shape[2:], self.state.k.dtype,
+                    )
+                    self.state = self.state._replace(
+                        k=self._inject_blocks_fn(n_pad, draft=False)(
+                            self.state.k, ids, payload
+                        )
+                    )
+                    programs += 1
+                    if self._spec:
+                        dpayload = jnp.zeros(
+                            self._draft_state.k.shape[:1] + (n_pad,)
+                            + self._draft_state.k.shape[2:],
+                            self._draft_state.k.dtype,
+                        )
+                        self._draft_state = self._draft_state._replace(
+                            k=self._inject_blocks_fn(n_pad, draft=True)(
+                                self._draft_state.k, ids, dpayload
+                            )
+                        )
+                        programs += 1
+                self._place_slot(0, [], 0, 0, 0, 1.0, 1.0, -1)
+                programs += 1
+            jax.block_until_ready(self.state.lengths)
+            if self._spec:
+                jax.block_until_ready(self._draft_state.k)
+            auto_stage("compile_end")
+        finally:
+            with self._lock:
+                self._hold_admission = False
+            self._wake.set()
+        dt = time.monotonic() - t0
+        after = compile_cache.snapshot()
+        self._warmup_seconds = dt
+        self._warmup_programs = programs
+        self._warmup_hist.observe(dt)
+        self._warmup_done = True
+        self._cold_over = True
+        auto_stage("warmup_end")
+        return {
+            "seconds": dt,
+            "programs": programs,
+            "compiles": after["compiles"] - before["compiles"],
+            "cache_hits": after["cache_hits"] - before["cache_hits"],
+            "cache_misses": after["cache_misses"] - before["cache_misses"],
+            "compile_seconds": round(
+                after["compile_seconds"] - before["compile_seconds"], 4
+            ),
+        }
 
     def submit(
         self,
@@ -1292,6 +1542,7 @@ class ServingEngine:
         tier = (
             self._host_tier.stats() if self._host_tier is not None else {}
         )
+        cc = compile_cache.snapshot()
         return {
             "slots": self.slots,
             "active": sum(r is not None for r in self._live),
@@ -1359,6 +1610,29 @@ class ServingEngine:
             # Bucketed TTFT ({"buckets": [(le, cumulative)...], "sum",
             # "count"}) — prometheus_metrics renders the histogram series.
             "ttft_hist": self._ttft_hist.to_dict(),
+            # Cold-start split of the same series (role="cold_start"):
+            # the first token a warmup-less boot delivered, i.e. the
+            # sample that paid compilation. Empty on warmup-gated boots.
+            "ttft_cold_hist": self._ttft_cold_hist.to_dict(),
+            # Cold-start fast path (PR 20): warmup coverage + the
+            # process-wide compile/persistent-cache counters behind the
+            # zero-post-ready-compile readiness contract.
+            "warmup_done": self._warmup_done,
+            "warmup_seconds": (
+                None if self._warmup_seconds is None
+                else round(self._warmup_seconds, 4)
+            ),
+            "warmup_programs": self._warmup_programs,
+            "warmup_hist": self._warmup_hist.to_dict(),
+            "compile_cache_dir": self._compile_cache_dir,
+            "compiles_total": cc["compiles"],
+            "compile_cache_hits_total": cc["cache_hits"],
+            "compile_cache_misses_total": cc["cache_misses"],
+            # Seconds actually spent inside backend compilation (cache
+            # retrievals report their own, much smaller, durations): the
+            # cost the persistent cache removes. Wall-clock warmup spans
+            # conflate it with tracing/lowering, which no cache can skip.
+            "compile_seconds_total": round(cc["compile_seconds"], 4),
             # Disaggregation: which half of the split this engine is
             # (TTFT/TPT series carry it as a role label — the legs of a
             # split request are different quantities and must not be
@@ -1834,7 +2108,7 @@ class ServingEngine:
                 self._n_admitted += 1
                 self._sum_ttft += now - req.t_submit
                 self._sum_prefill += now - task.t_pop
-                self._ttft_hist.observe(now - req.t_submit)
+                self._observe_ttft(now - req.t_submit)
                 if not self._first_token_emitted:
                     self._first_token_emitted = True
                     # Serving cold-start boundary: submit -> first_token is
@@ -2017,7 +2291,7 @@ class ServingEngine:
             self._ttft_s = self._ewma_seed(self._ttft_s, now - req.t_submit)
             self._n_admitted += 1
             self._sum_ttft += now - req.t_submit
-            self._ttft_hist.observe(now - req.t_submit)
+            self._observe_ttft(now - req.t_submit)
         if req.trace is not None:
             req.trace.kv_payload_bytes += h.payload_bytes
             self.recorder.finish(req.trace, "ok", now)
@@ -2321,7 +2595,7 @@ class ServingEngine:
                 self._ttft_s = self._ewma_seed(self._ttft_s, now - t_recv)
                 self._n_admitted += 1
                 self._sum_ttft += now - t_recv
-                self._ttft_hist.observe(now - t_recv)
+                self._observe_ttft(now - t_recv)
                 if not self._first_token_emitted:
                     self._first_token_emitted = True
                     auto_stage("first_token")
@@ -3090,6 +3364,17 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
         # defaults keep pre-LoRA snapshots renderable).
         ("dstack_tpu_serving_adapters_loaded", "gauge",
          stats.get("adapters_loaded", 0)),
+        # Cold-start fast path (PR 20): process-wide jitted-program
+        # builds (fresh compiles + persistent-cache retrievals — an
+        # in-memory jit dispatch hit counts in neither) and the
+        # persistent compile cache's hit/miss split. "Zero compile after
+        # /readyz" means compiles_total not moving across a request.
+        ("dstack_tpu_compile_cache_hits_total", "counter",
+         stats.get("compile_cache_hits_total", 0)),
+        ("dstack_tpu_compile_cache_misses_total", "counter",
+         stats.get("compile_cache_misses_total", 0)),
+        ("dstack_tpu_compile_seconds_total", "counter",
+         stats.get("compile_seconds_total", 0)),
     ]
     lines = []
     for name, mtype, value in series:
@@ -3112,17 +3397,20 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
     # without ttft_hist degrade to the sum/count pair.
     role = stats.get("role", "unified")
 
-    def _render_hist(base: str, hist: Dict[str, Any]) -> None:
-        lines.append(f"# TYPE {base} histogram")
+    def _render_hist(base: str, hist: Dict[str, Any], hist_role: str = "",
+                     emit_type: bool = True) -> None:
+        r = hist_role or role
+        if emit_type:
+            lines.append(f"# TYPE {base} histogram")
         for le, cumulative in hist["buckets"]:
             lines.append(
-                f'{base}_bucket{{le="{le}",role="{role}"}} {cumulative}'
+                f'{base}_bucket{{le="{le}",role="{r}"}} {cumulative}'
             )
         lines.append(
-            f'{base}_bucket{{le="+Inf",role="{role}"}} {hist["count"]}'
+            f'{base}_bucket{{le="+Inf",role="{r}"}} {hist["count"]}'
         )
-        lines.append(f'{base}_sum{{role="{role}"}} {hist["sum"]}')
-        lines.append(f'{base}_count{{role="{role}"}} {hist["count"]}')
+        lines.append(f'{base}_sum{{role="{r}"}} {hist["sum"]}')
+        lines.append(f'{base}_count{{role="{r}"}} {hist["count"]}')
 
     _render_hist(
         "dstack_tpu_serving_ttft_seconds",
@@ -3132,6 +3420,16 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
             "count": stats["admitted_total"],
         },
     )
+    # Cold-start leg of the same series: the first token a warmup-less
+    # boot delivered (the sample that paid compilation). Same base name,
+    # so the TYPE line above already covers it; warmup-gated boots keep
+    # this bucket empty by construction.
+    cold = stats.get("ttft_cold_hist")
+    if cold:
+        _render_hist(
+            "dstack_tpu_serving_ttft_seconds", cold,
+            hist_role="cold_start", emit_type=False,
+        )
     _render_hist(
         "dstack_tpu_serving_tpt_seconds",
         stats.get("tpt_hist") or {"buckets": [], "sum": 0.0, "count": 0},
@@ -3149,6 +3447,17 @@ def prometheus_metrics(stats: Dict[str, Any]) -> str:
         stats.get("swap_in_hist")
         or {"buckets": [], "sum": 0.0, "count": 0},
     )
+    # Warmup wall time (one sample per warmup() call — engines usually
+    # warm once per boot, so count doubles as "did this engine warm").
+    # Label-less: warmup happens before any request exists to attribute.
+    wh = stats.get("warmup_hist") or {"buckets": [], "sum": 0.0, "count": 0}
+    wb = "dstack_tpu_serving_warmup_seconds"
+    lines.append(f"# TYPE {wb} histogram")
+    for le, cumulative in wh["buckets"]:
+        lines.append(f'{wb}_bucket{{le="{le}"}} {cumulative}')
+    lines.append(f'{wb}_bucket{{le="+Inf"}} {wh["count"]}')
+    lines.append(f'{wb}_sum {wh["sum"]}')
+    lines.append(f'{wb}_count {wh["count"]}')
     # Per-request phase breakdown (PR 15 flight recorder): one histogram
     # per phase the recorder observed, labeled {phase, role}. Engines
     # with the recorder off (or older snapshots) emit nothing — scrapers

@@ -18,6 +18,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.utils.stagemarkers import auto_stage, emit_stage  # noqa: F401
+from dstack_tpu.workloads import compile_cache
 from dstack_tpu.workloads.attention import make_attention_fn
 from dstack_tpu.workloads.config import ModelConfig
 from dstack_tpu.workloads.sharding import (
@@ -72,6 +73,9 @@ def init_train_state(
     # a different opt-state structure than a constant-lr one.
     # First touch of the accelerator in a typical trainer: the timeline's
     # env_ready -> tpu_init gap is import + device-discovery cost.
+    # Persistent-cache opt-in must land before anything compiles, so the
+    # train_step build below can be a disk retrieval on a repeat boot.
+    compile_cache.enable_from_env()
     auto_stage("tpu_init")
     params = init_params(config, key)
     opt_state = make_optimizer(

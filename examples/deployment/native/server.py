@@ -23,7 +23,6 @@ from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
-import jax.numpy as jnp
 
 from dstack_tpu.dataplane.qos import (
     DEFAULT_TENANT,
@@ -31,7 +30,9 @@ from dstack_tpu.dataplane.qos import (
     TenantShedError,
 )
 from dstack_tpu.server.tracing import HistogramData
+from dstack_tpu.utils.stagemarkers import auto_stage
 from dstack_tpu.utils.tracecontext import ensure_request_trace
+from dstack_tpu.workloads import compile_cache
 from dstack_tpu.workloads.config import PRESETS
 from dstack_tpu.workloads.lora_serving import (
     AdapterBusyError,
@@ -73,23 +74,48 @@ class Engine:
             )
         self.max_new_tokens = max_new_tokens
         self._handoff_ids = itertools.count(1)
+        auto_stage("weights_start")
+        t_weights = time.monotonic()
+        weights_via = "init"
         if checkpoint_dir:
             from dstack_tpu.workloads import checkpoint as ckpt
-            from dstack_tpu.workloads.transformer import init_params as _init
 
-            template = _init(self.config, jax.random.PRNGKey(0))
-            # Prefer the params-only serving export (no optimizer moments
-            # in memory); fall back to a full train-state restore.
-            params = ckpt.restore_exported_params(checkpoint_dir, template)
-            if params is None:
-                from dstack_tpu.workloads.train import init_train_state
+            # Cold-start order: packed export first (mmap + parallel
+            # device_put — the scale-from-zero fast path), then the
+            # params-only Orbax export, then a full train-state restore.
+            params = ckpt.load_packed(checkpoint_dir)
+            if params is not None:
+                weights_via = "packed-parallel"
+            else:
+                from dstack_tpu.workloads.transformer import init_params as _init
 
-                state_tpl = init_train_state(self.config, jax.random.PRNGKey(0))
-                restored = ckpt.restore_latest(checkpoint_dir, state_tpl)
-                params = restored.params if restored is not None else template
+                template = _init(self.config, jax.random.PRNGKey(0))
+                params = ckpt.restore_exported_params(checkpoint_dir, template)
+                if params is not None:
+                    weights_via = "orbax-export"
+                else:
+                    from dstack_tpu.workloads.train import init_train_state
+
+                    state_tpl = init_train_state(
+                        self.config, jax.random.PRNGKey(0)
+                    )
+                    restored = ckpt.restore_latest(checkpoint_dir, state_tpl)
+                    if restored is not None:
+                        params = restored.params
+                        weights_via = "orbax-train"
+                    else:
+                        params = template
             self.params = params
         else:
             self.params = init_params(self.config, jax.random.PRNGKey(0))
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.params)[0])
+        auto_stage("weights_end")
+        self.weights_seconds = time.monotonic() - t_weights
+        self.weights_via = weights_via
+        print(
+            f"weights: loaded in {self.weights_seconds:.2f}s"
+            f" via {weights_via}", flush=True,
+        )
         if quantize == "int8":
             # Weight-only int8: decode is weight-bandwidth-bound, so the
             # smaller HBM reads buy ~1.25x decode throughput (measured on
@@ -284,7 +310,7 @@ class Engine:
             lines.append(f'{base}_count{{tenant="{t}"}} {h["count"]}')
         return lines
 
-    def encode(self, text: str) -> jnp.ndarray:
+    def encode(self, text: str):
         ids = [min(b, self.config.vocab_size - 1) for b in text.encode()] or [0]
         limit = self.config.max_seq_len - self.max_new_tokens
         ids = ids[-limit:] if limit > 0 else ids[:1]
@@ -298,7 +324,11 @@ class Engine:
             ids = [10] * (bucket - len(ids)) + ids
         else:
             ids = ids[-bucket:]
-        return jnp.asarray([ids], dtype=jnp.int32)
+        # Host-side (1, bucket) nested list, NOT a device array: the
+        # engine takes a token list, and a device round-trip here would
+        # build four tiny jit programs per novel bucket — compiles the
+        # warmup pass can't see, breaking the zero-post-ready contract.
+        return [ids]
 
     def decode(self, ids) -> str:
         return bytes(int(t) % 256 for t in ids).decode("utf-8", errors="replace")
@@ -355,7 +385,7 @@ class Engine:
         if usage_out is not None:
             # OpenAI usage accounting: real engine token counts, not a
             # re-tokenization guess (byte vocab: one token per byte).
-            usage_out["prompt_tokens"] = int(tokens.shape[1])
+            usage_out["prompt_tokens"] = len(tokens[0])
             usage_out["completion_tokens"] = 0
         rid = None
         if self.serving.role == "prefill":
@@ -387,7 +417,7 @@ class Engine:
         ttft_seen = False
         try:
             out = self.serving.submit(
-                [int(t) for t in tokens[0]], max_new_tokens=budget,
+                list(tokens[0]), max_new_tokens=budget,
                 temperature=temp, top_p=nucleus, request_id=rid,
                 adapter=adapter, traceparent=traceparent,
                 x_request_id=x_request_id,
@@ -494,7 +524,23 @@ def main() -> None:
     parser.add_argument("--model-name", default="dstack-tpu-native")
     parser.add_argument("--max-new-tokens", type=int, default=64)
     parser.add_argument("--checkpoint-dir", default="",
-                        help="volume path with an Orbax checkpoint to serve")
+                        help="volume path with a checkpoint to serve: a"
+                             " save_packed export (mmap + parallel load,"
+                             " the cold-start fast path) or an Orbax"
+                             " checkpoint")
+    parser.add_argument("--compile-cache-dir", default="",
+                        help="persistent XLA compile-cache base dir (a"
+                             " durable volume path): repeat boots retrieve"
+                             " compiled programs from disk instead of"
+                             " recompiling. Keyed by jax+jaxlib version"
+                             " and backend under the base, so one volume"
+                             " serves heterogeneous workers. Defaults to"
+                             " $DSTACK_TPU_COMPILE_CACHE when unset")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip the warmup pass that pre-compiles every"
+                             " jitted engine program before /readyz flips"
+                             " ready (warmup is on by default; without it"
+                             " the first unlucky requests pay compilation)")
     parser.add_argument("--quantize", default="none", choices=["none", "int8"],
                         help="weight-only int8 for ~1.25x decode throughput")
     parser.add_argument("--max-pending", type=int, default=16,
@@ -636,6 +682,14 @@ def main() -> None:
                 f"--qos-weight {entry!r} is not TENANT=WEIGHT"
                 " with a positive weight"
             )
+    # The cache must be live before the Engine constructor touches the
+    # accelerator — weight init and the warmup pass below both compile.
+    if args.compile_cache_dir:
+        leaf = compile_cache.enable(args.compile_cache_dir)
+    else:
+        leaf = compile_cache.enable_from_env()
+    if leaf:
+        print(f"compile cache: {leaf}", flush=True)
     engine = Engine(args.preset, args.max_new_tokens, args.checkpoint_dir,
                     quantize=args.quantize, max_pending=args.max_pending,
                     slots=args.slots, steps_per_sync=args.steps_per_sync,
@@ -657,6 +711,13 @@ def main() -> None:
                     max_resident_slots=args.max_resident_slots,
                     trace_ring=args.trace_ring,
                     trace_slow_ms=args.trace_slow_ms)
+
+    # Warmup-gated readiness: /readyz answers 503 until the engine's
+    # warmup pass has built every jitted program, so an orchestrator that
+    # waits for ready before routing guarantees no request ever pays a
+    # compile (docs/guides/serving-tuning.md, "cold start"). /healthz is
+    # liveness only and is green the moment the socket is up.
+    ready = threading.Event()
 
     # Decode tier: admit prefill-tier handoffs and expose each admitted
     # stream at GET /v1/handoffs/<request_id> (SSE) for the front-end to
@@ -837,6 +898,22 @@ def main() -> None:
             self.wfile.write(b"data: [DONE]\n\n")
 
         def do_GET(self):
+            if self.path.rstrip("/") == "/healthz":
+                return self._send(200, {"ok": True})
+            if self.path.rstrip("/") == "/readyz":
+                if ready.is_set():
+                    stats = engine.serving.stats()
+                    return self._send(200, {
+                        "ready": True,
+                        "warmup_seconds": stats.get("warmup_seconds"),
+                        "weights_seconds": round(engine.weights_seconds, 3),
+                        "weights_via": engine.weights_via,
+                    })
+                return self._send(
+                    503,
+                    {"ready": False, "phase": "warmup"},
+                    headers=[("Retry-After", "2")],
+                )
             if self.path.rstrip("/") == "/v1/models":
                 # Loaded adapters list as models in their own right
                 # (`base:adapter`), mirroring the control-plane proxy's
@@ -1058,6 +1135,30 @@ def main() -> None:
 
     server = ModelHTTPServer(("0.0.0.0", args.port), Handler)
     print(f"native model server: {args.model_name} on :{args.port}", flush=True)
+    if args.no_warmup:
+        ready.set()
+    else:
+        # Warm in the background so /healthz (and early traffic, which
+        # simply pays its own compiles) answer while programs build;
+        # /readyz flips only after warmup_end.
+        def _warm() -> None:
+            try:
+                r = engine.serving.warmup()
+            except RuntimeError as e:
+                # A request raced admission before warmup started (the
+                # idle-check refused). Readiness still flips — the racer
+                # is paying the compiles warmup would have.
+                print(f"warmup skipped: {e}", flush=True)
+            else:
+                print(
+                    f"warmup: {r['programs']} programs in"
+                    f" {r['seconds']:.2f}s ({r['compiles']} built,"
+                    f" {r['cache_hits']} from persistent cache)",
+                    flush=True,
+                )
+            ready.set()
+
+        threading.Thread(target=_warm, daemon=True, name="warmup").start()
     server.serve_forever()
 
 

@@ -36,6 +36,12 @@ class Decoder:
             self._place = jax.jit(lambda s: s + 1)
         return self._place(x)
 
+    def warmup(self):
+        # OK: warmup seam — runs once before readiness flips, paying
+        # construction + compile so the first request doesn't.
+        probe = jax.jit(lambda s: s * 2)
+        return probe(0)
+
 
 def pad_to(n, s):
     return s

@@ -7,20 +7,32 @@ from dstack_tpu.utils.jaxenv import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
 
-# Persistent XLA compilation cache for THIS process. Most of the suite's
-# wall time is XLA recompiling the same tiny-model programs: each
-# make_*() call produces a fresh jitted closure, so JAX's in-memory
-# cache never dedupes across engines or test files — the on-disk cache
-# keys on the HLO itself and does (~40% off a cold full run, far more on
-# re-runs). Deliberately NOT exported to the environment: subprocess
-# trainers (drills, examples) segfault deserializing executables cached
-# by another process on this jaxlib, and they compile little anyway.
+# Persistent XLA compilation cache. Most of the suite's wall time is XLA
+# recompiling the same tiny-model programs: each make_*() call produces
+# a fresh jitted closure, so JAX's in-memory cache never dedupes across
+# engines or test files — the on-disk cache keys on the HLO itself and
+# does (~40% off a cold full run, far more on re-runs). The directory is
+# keyed by jax+jaxlib version and backend (workloads/compile_cache.py):
+# a foreign-version entry segfaults on deserialize rather than failing
+# cleanly, which is why this cache historically could NOT be shared with
+# subprocess children. Version-keying makes that structurally impossible
+# (children in this container run the same jaxlib, so they land in the
+# same leaf; any mismatch lands in a different leaf), so the leaf IS now
+# exported to `run_in_device_subprocess` children — subprocess drills
+# and server boots retrieve instead of recompiling.
 # Set JAX_COMPILATION_CACHE_DIR yourself to relocate or pre-empt this.
-if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+_SHARED_CACHE_LEAF = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+if not _SHARED_CACHE_LEAF:
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      "/tmp/dstack_tpu_jax_cache")
+    from dstack_tpu.workloads import compile_cache
+
+    _SHARED_CACHE_LEAF = compile_cache.cache_dir_for(
+        "/tmp/dstack_tpu_jax_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", _SHARED_CACHE_LEAF)
+    # 0.2s floor (not compile_cache.enable()'s 0): caching every trivial
+    # test program would churn disk for nothing.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 
 import asyncio
@@ -78,6 +90,12 @@ def run_in_device_subprocess(source: str, *, device_count: int = 2,
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo, env.get("PYTHONPATH")) if p
     )
+    # Share the suite's version-keyed compile-cache leaf: the child runs
+    # the same jaxlib (same container), so retrieval is safe — and the
+    # heavyweight subprocess drills (disagg, sharded bit-exactness)
+    # retrieve their programs instead of recompiling them every run.
+    if _SHARED_CACHE_LEAF and "JAX_COMPILATION_CACHE_DIR" not in env:
+        env["JAX_COMPILATION_CACHE_DIR"] = _SHARED_CACHE_LEAF
     return subprocess.run(
         [sys.executable, "-c", source], env=env, cwd=repo,
         capture_output=True, text=True, timeout=timeout,

@@ -15,6 +15,7 @@ import sys
 from pathlib import Path
 
 from dstack_tpu.server.http import response_json
+from tests.conftest import _SHARED_CACHE_LEAF
 from tests.server.conftest import make_server
 
 REPO = Path(__file__).resolve().parent.parent.parent
@@ -39,10 +40,21 @@ async def test_native_model_serving_end_to_end():
                             f"{sys.executable} {REPO}/examples/deployment/native/server.py"
                             f" --preset tiny --port {PORT}"
                             " --model-name tiny-native --max-new-tokens 8"
+                            # Warmup-less boot: this test's subject is the
+                            # orchestration path, and the readiness gate
+                            # pays seconds of tracing per boot either way
+                            # (tests/test_serving_http.py covers the gate).
+                            " --no-warmup"
                         ],
                         "env": {
                             "PYTHONPATH": str(REPO),
                             "JAX_PLATFORMS": "cpu",
+                            # Warm the replica's warmup pass from the
+                            # suite's shared compile cache: a cold one
+                            # holds admission ~30s (tests/conftest.py).
+                            **({"JAX_COMPILATION_CACHE_DIR":
+                                _SHARED_CACHE_LEAF}
+                               if _SHARED_CACHE_LEAF else {}),
                         },
                         "resources": {"cpu": "1..", "memory": "0.1.."},
                     },

@@ -418,3 +418,44 @@ async def test_metrics_expose_proxy_series():
     finally:
         stub.stop()
         await fx.app.shutdown()
+
+
+async def test_no_replicas_answers_503_with_cold_start_retry_after():
+    """Scale-from-zero seam: a model request against a service with no
+    live replica is a retryable 503 + Retry-After (the server's
+    condition, not the caller's mistake), still counts toward RPS (the
+    wake signal), and is never cached by the routing cache — the next
+    request after a replica appears must route, not replay the miss."""
+    stub = StubUpstream()
+    port = await stub.start()
+    fx = await make_server(run_background_tasks=False)
+    ctx = fx.ctx
+    try:
+        await _make_service_run(fx, "zero-svc", [port], model="mz")
+        await ctx.db.execute(
+            "UPDATE jobs SET status = 'failed' WHERE run_name = 'zero-svc'"
+        )
+        body = {"model": "mz",
+                "messages": [{"role": "user", "content": "wake up"}]}
+        r = await fx.client.post("/proxy/models/main/chat/completions", body)
+        assert r.status == 503
+        assert int(r.headers["retry-after"]) >= 1
+        assert b"scaling from zero" in await _drain(r)
+        # Demand the replica never saw still registered as RPS — exactly
+        # the signal the scale-from-zero autoscaler wakes on — and the
+        # proxy opened a cold-start episode for Retry-After sizing.
+        assert ctx.service_stats.get_rps("main", "zero-svc") > 0
+        assert ctx.service_stats._cold_since  # episode open
+
+        # Replica back: the very next request routes (no cached miss)
+        # and closes the episode, recording the observed budget.
+        await ctx.db.execute(
+            "UPDATE jobs SET status = 'running' WHERE run_name = 'zero-svc'"
+        )
+        r = await fx.client.post("/proxy/models/main/chat/completions", body)
+        assert r.status == 200 and await _drain(r) is not None
+        assert not ctx.service_stats._cold_since
+        assert ("main", "zero-svc") in ctx.service_stats._cold_budget
+    finally:
+        stub.stop()
+        await fx.app.shutdown()

@@ -430,7 +430,11 @@ async def test_missing_secret_fails_run_with_message():
 
 async def test_volume_run_gets_compile_cache_env(tmp_path):
     """A run with a mounted volume is handed a persistent XLA compile
-    cache on it (cold-start budget stage 5); a user-set value wins."""
+    cache BASE on it (cold-start budget stage 5) via
+    DSTACK_TPU_COMPILE_CACHE — the workload keys the actual leaf by its
+    own jax+jaxlib+backend (workloads/compile_cache.py), because the
+    server cannot know the worker's versions. A user-set value (either
+    cache variable) wins and suppresses the default."""
     fx = await make_server()
     try:
         resp = await fx.client.post(
@@ -442,14 +446,18 @@ async def test_volume_run_gets_compile_cache_env(tmp_path):
         )
         assert resp.status == 200, resp.body
 
-        mnt = None  # set below; expect values are the FULL env value
+        mnt = None  # set below; expect values are the FULL marker line
         for run_name, env, expect in (
-            ("cc-default", None, None),  # -> <mnt>/.jax-compile-cache
-            ("cc-custom", {"JAX_COMPILATION_CACHE_DIR": "/custom/cache"},
-             "/custom/cache"),
+            ("cc-default", None, None),  # -> cache=<mnt>/.jax-compile-cache
+            ("cc-custom", {"DSTACK_TPU_COMPILE_CACHE": "/custom/cache"},
+             "cache=/custom/cache end"),
+            # A raw JAX_COMPILATION_CACHE_DIR also counts as user intent:
+            # the server must not stack its base on top of it.
+            ("cc-jaxvar", {"JAX_COMPILATION_CACHE_DIR": "/raw/jax-cache"},
+             "cache= end"),
         ):
             body = _task_body(
-                ["echo cache=$JAX_COMPILATION_CACHE_DIR"], run_name, env=env
+                ["echo cache=$DSTACK_TPU_COMPILE_CACHE end"], run_name, env=env
             )
             mnt = tmp_path / "mnt"
             body["run_spec"]["configuration"]["volumes"] = [
@@ -470,6 +478,7 @@ async def test_volume_run_gets_compile_cache_env(tmp_path):
                 base64.b64decode(e["message"])
                 for e in response_json(resp)["logs"]
             ).decode()
-            assert f"cache={expect or f'{mnt}/.jax-compile-cache'}" in text, text
+            expected = expect or f"cache={mnt}/.jax-compile-cache end"
+            assert expected in text, (expected, text)
     finally:
         await fx.app.shutdown()

@@ -71,3 +71,86 @@ def test_keeps_only_max_checkpoints(tmp_path):
     # max_to_keep=3: early steps were pruned from the volume.
     kept = {p.name for p in (tmp_path / "ckpt").iterdir() if p.name.isdigit()}
     assert len(kept) <= 3 and "5" in kept
+
+
+# ---------------------------------------------------------------- packed
+# save_packed/load_packed: the scale-from-zero serving export — one
+# aligned binary + manifest, mmapped and device_put leaf-parallel at
+# boot. Bit-exactness across dtypes is the whole contract: a loader
+# that round-trips through a lossy cast would silently change the model.
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _packed_round_trip(tmp_path, params):
+    checkpoint.save_packed(tmp_path / "packed", params)
+    for parallel in (True, False):
+        loaded = checkpoint.load_packed(tmp_path / "packed", parallel=parallel)
+        assert loaded is not None
+        _assert_tree_equal(params, loaded)
+
+
+def test_packed_round_trip_f32(tmp_path):
+    from dstack_tpu.workloads.transformer import init_params
+
+    params = init_params(PRESETS["tiny"], jax.random.PRNGKey(0))
+    _packed_round_trip(tmp_path, params)
+
+
+def test_packed_round_trip_bf16(tmp_path):
+    import jax.numpy as jnp
+
+    from dstack_tpu.workloads.transformer import init_params
+
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16),
+        init_params(PRESETS["tiny"], jax.random.PRNGKey(1)),
+    )
+    _packed_round_trip(tmp_path, params)
+
+
+def test_packed_round_trip_int8_qtensor(tmp_path):
+    """Quantized trees carry QTensor leaves (int8 q + f32 scale): the
+    packed format flattens them to paired entries and the loader must
+    regroup them into QTensors, not bare arrays."""
+    from dstack_tpu.workloads.quant import QTensor, quantize_params
+    from dstack_tpu.workloads.transformer import init_params
+
+    params = quantize_params(
+        init_params(PRESETS["tiny"], jax.random.PRNGKey(2))
+    )
+    checkpoint.save_packed(tmp_path / "packed", params)
+    loaded = checkpoint.load_packed(tmp_path / "packed")
+    assert loaded is not None
+    flat_orig = jax.tree_util.tree_leaves_with_path(
+        params, is_leaf=lambda x: isinstance(x, QTensor)
+    )
+    flat_load = jax.tree_util.tree_leaves_with_path(
+        loaded, is_leaf=lambda x: isinstance(x, QTensor)
+    )
+    qtensors = 0
+    for (pa, a), (pb, b) in zip(flat_orig, flat_load):
+        assert pa == pb
+        assert isinstance(b, QTensor) == isinstance(a, QTensor)
+        if isinstance(a, QTensor):
+            qtensors += 1
+            np.testing.assert_array_equal(np.asarray(a.q), np.asarray(b.q))
+            np.testing.assert_array_equal(
+                np.asarray(a.scale), np.asarray(b.scale)
+            )
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert qtensors > 0  # the fixture tree really exercised the pairing
+
+
+def test_packed_absent_dir_returns_none(tmp_path):
+    # The server's restore ladder relies on None (fall through to the
+    # Orbax paths), not an exception.
+    assert checkpoint.load_packed(tmp_path / "nothing-here") is None

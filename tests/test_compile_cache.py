@@ -1,0 +1,97 @@
+"""Persistent compile cache: version keying, env precedence, counters.
+
+The version-keyed leaf is the load-bearing piece (workloads/
+compile_cache.py): a foreign-jaxlib cache entry segfaults on
+deserialize, so the keying is what makes a shared cache volume (and the
+test suite's subprocess-exported cache) safe at all.
+"""
+
+import jax
+import jax.numpy as jnp
+import jaxlib
+import pytest
+
+from dstack_tpu.workloads import compile_cache
+
+
+@pytest.fixture
+def restore_cache_config():
+    """enable() mutates process-global jax config; put the suite's
+    shared-cache settings back so later test files keep retrieving."""
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_enabled = compile_cache._enabled_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+    with compile_cache._lock:
+        compile_cache._enabled_dir = prev_enabled
+
+
+def test_cache_dir_is_version_and_backend_keyed(tmp_path):
+    leaf = compile_cache.cache_dir_for(str(tmp_path))
+    assert leaf.startswith(str(tmp_path))
+    tail = leaf[len(str(tmp_path)) + 1:]
+    # One path segment carrying all three key components: a jax OR
+    # jaxlib bump (or a backend switch) must land in a DIFFERENT leaf.
+    assert "/" not in tail
+    assert f"jax{jax.__version__}" in tail
+    assert f"jaxlib{jaxlib.__version__}" in tail
+    assert tail.endswith(f"-{compile_cache.backend_name()}")
+    # Explicit backend overrides detection (server-side keying for a
+    # worker pool whose backend the caller knows).
+    assert compile_cache.cache_dir_for(str(tmp_path), "tpu").endswith("-tpu")
+
+
+def test_enable_creates_leaf_and_reports_it(tmp_path, restore_cache_config):
+    leaf = compile_cache.enable(str(tmp_path / "base"))
+    assert leaf == compile_cache.cache_dir_for(str(tmp_path / "base"))
+    import os
+
+    assert os.path.isdir(leaf)
+    assert compile_cache.enabled_dir() == leaf
+    assert jax.config.jax_compilation_cache_dir == leaf
+
+
+def test_enable_from_env_precedence(tmp_path, monkeypatch,
+                                    restore_cache_config):
+    # User-exported JAX_COMPILATION_CACHE_DIR wins: that path is already
+    # live inside JAX and is NOT ours to re-point or version-key.
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "raw"))
+    monkeypatch.setenv(compile_cache.ENV_VAR, str(tmp_path / "managed"))
+    prev = jax.config.jax_compilation_cache_dir
+    compile_cache.enable_from_env()
+    assert jax.config.jax_compilation_cache_dir == prev
+
+    # DSTACK_TPU_COMPILE_CACHE alone: enable under the version-keyed leaf.
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    leaf = compile_cache.enable_from_env()
+    assert leaf == compile_cache.cache_dir_for(str(tmp_path / "managed"))
+
+    # Neither set: a no-op, not an accidental /tmp cache.
+    monkeypatch.delenv(compile_cache.ENV_VAR)
+    with compile_cache._lock:
+        compile_cache._enabled_dir = None
+    assert compile_cache.enable_from_env() is None
+
+
+def test_counters_move_on_build_not_on_dispatch():
+    compile_cache.install_counters()
+    # A closure over a fresh object is a novel jit callable: guaranteed
+    # in-memory cache miss, so the first call BUILDS (the persistent
+    # cache may serve the executable — that still counts as a build).
+    salt = jnp.asarray(3.0)
+    fn = jax.jit(lambda x: x * salt + 1)
+    arg = jnp.arange(7, dtype=jnp.float32)
+    before = compile_cache.snapshot()
+    fn(arg).block_until_ready()
+    mid = compile_cache.snapshot()
+    assert mid["compiles"] == before["compiles"] + 1
+    assert mid["compile_seconds"] > before["compile_seconds"]
+    # Second call with the same shapes: in-memory jit dispatch hit —
+    # NO counter movement. This is the exact property the warmup
+    # readiness contract rests on ("zero compiles after /readyz").
+    fn(arg).block_until_ready()
+    after = compile_cache.snapshot()
+    assert after["compiles"] == mid["compiles"]
+    assert after["compile_seconds"] == mid["compile_seconds"]

@@ -1073,3 +1073,43 @@ async def test_model_proxy_qos_sheds_flooding_tenant():
     finally:
         stub.stop()
         await fx.app.shutdown()
+
+
+def test_stats_collector_cold_start_budget(monkeypatch):
+    """Scale-from-zero Retry-After sizing: remaining budget = last
+    OBSERVED cold start minus how long this episode has already run."""
+    import dstack_tpu.server.services.stats as stats_mod
+
+    now = [1000.0]
+    monkeypatch.setattr(stats_mod.time, "monotonic", lambda: now[0])
+    c = ServiceStatsCollector(window=60)
+
+    # Never seen a cold start: conservative default, no open episode.
+    assert c.get_retry_after("p", "r") == c.DEFAULT_COLD_START
+
+    # Open an episode; elapsed time counts the default budget down.
+    c.note_no_replicas("p", "r")
+    now[0] += 10.0
+    assert c.get_retry_after("p", "r") == pytest.approx(20.0)
+    # Re-noting mid-episode must NOT restart the clock (every 503'd
+    # request notes it; the episode began at the first sighting).
+    c.note_no_replicas("p", "r")
+    now[0] += 8.0
+    assert c.get_retry_after("p", "r") == pytest.approx(12.0)
+
+    # Budget overrun: floor at 1s — late retries poll gently.
+    now[0] += 100.0
+    assert c.get_retry_after("p", "r") == 1.0
+
+    # A successful pick closes the episode and records its length
+    # (118s) as the service's observed budget for the NEXT episode.
+    c.note_replicas_available("p", "r")
+    assert c.get_retry_after("p", "r") == pytest.approx(118.0)
+    c.note_no_replicas("p", "r")
+    now[0] += 100.0
+    assert c.get_retry_after("p", "r") == pytest.approx(18.0)
+
+    # Closing with no open episode is a no-op, not a zero-budget write.
+    c.note_replicas_available("p", "r")
+    c.note_replicas_available("p", "r")
+    assert c.get_retry_after("p", "r") == pytest.approx(100.0)

@@ -36,11 +36,19 @@ def _post(port, body, timeout=120):
     return urllib.request.urlopen(req, timeout=timeout)
 
 
-def _boot_server(tmp_path, *flags):
+def _boot_server(tmp_path, *flags, warmup=False):
     """Start the example model server (CPU-pinned) and wait for /v1/models.
     Returns (proc, log_handle, port); raises with the log tail if the
-    process dies or never binds."""
+    process dies or never binds.
+
+    Boots `--no-warmup` by default: these tests target the HTTP surface,
+    and even a cache-warm warmup pass pays several seconds of Python
+    tracing per boot — across every boot in this file that would
+    dominate the suite's budget. The readiness tests, whose subject IS
+    the warmup gate, opt in with warmup=True."""
     port = free_port()
+    if not warmup and "--no-warmup" not in flags:
+        flags = (*flags, "--no-warmup")
     env = {
         **os.environ,
         # CPU-pinned regardless of what accelerator plumbing the host
@@ -50,6 +58,14 @@ def _boot_server(tmp_path, *flags):
         "PYTHONPATH": str(REPO),
         "JAX_PLATFORMS": "cpu",
     }
+    # Share the suite's version-keyed persistent compile cache: the
+    # server warms up before admitting traffic now, and a cold warmup
+    # would add ~30s of XLA compilation to EVERY boot here. Same-jaxlib
+    # children are safe by construction (tests/conftest.py).
+    from tests.conftest import _SHARED_CACHE_LEAF
+
+    if _SHARED_CACHE_LEAF and "JAX_COMPILATION_CACHE_DIR" not in env:
+        env["JAX_COMPILATION_CACHE_DIR"] = _SHARED_CACHE_LEAF
     log = open(tmp_path / "server.log", "ab")
     proc = subprocess.Popen(
         [sys.executable, str(SERVER), "--preset", "tiny", "--port", str(port),
@@ -388,6 +404,88 @@ def test_native_server_stop_sequences(tmp_path):
             assert stopped == full[:full.index(stop)], (full, stop, stopped)
         # malformed stop: lenient, full output
         assert chat({"stop": 5}) == full
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
+
+
+def _get_json(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def test_readyz_gated_on_warmup_and_first_request_compiles_nothing(tmp_path):
+    """The cold-start readiness contract over real HTTP: /healthz green
+    at socket-up, /readyz 503 while warmup builds programs, and the
+    first post-ready request moves the process compile counter by ZERO
+    — including the host-side tokenize/convert seams a naive engine
+    warmup can't see."""
+    # Narrow geometry (--slots 2, 16-token chunks) keeps the warmup's
+    # program set small: batch width and bucket count scale CPU
+    # trace+compile time and the gate's semantics depend on neither.
+    proc, log, port = _boot_server(
+        tmp_path, "--max-new-tokens", "8", "--slots", "2",
+        "--prefill-chunk-tokens", "16", warmup=True,
+    )
+    try:
+        # _boot_server returns at socket-up, which is before the warmup
+        # thread (several seconds even cache-warm) finishes: liveness
+        # green, readiness 503 + Retry-After.
+        assert _get_json(port, "/healthz") == {"ok": True}
+        try:
+            _get_json(port, "/readyz")
+            raise AssertionError("/readyz answered 200 before warmup_end")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers["Retry-After"]
+            assert json.load(e)["ready"] is False
+
+        deadline = time.time() + 120
+        while True:
+            try:
+                ready = _get_json(port, "/readyz")
+                break
+            except urllib.error.HTTPError:
+                assert time.time() < deadline, "never became ready"
+                time.sleep(0.5)
+        assert ready["ready"] is True
+        assert ready["warmup_seconds"] > 0
+        assert ready["weights_via"] == "init"
+
+        before = _get_json(port, "/metrics")
+        assert before["warmup_done"] is True
+        assert before["compiles_total"] > 0
+        r = _post(port, {"messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 4})
+        assert json.load(r)["choices"][0]["message"]["content"]
+        after = _get_json(port, "/metrics")
+        assert after["compiles_total"] == before["compiles_total"], (
+            "first post-ready request built XLA programs"
+        )
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        log.close()
+
+
+def test_no_warmup_flag_skips_the_gate(tmp_path):
+    """--no-warmup trades the zero-compile guarantee for instant
+    readiness (dev loops): /readyz is green with no warmup stats."""
+    proc, log, port = _boot_server(tmp_path, "--no-warmup")
+    try:
+        deadline = time.time() + 30
+        while True:
+            try:
+                ready = _get_json(port, "/readyz")
+                break
+            except urllib.error.HTTPError:
+                assert time.time() < deadline
+                time.sleep(0.2)
+        assert ready["ready"] is True
+        assert ready["warmup_seconds"] is None
     finally:
         proc.kill()
         proc.wait(timeout=10)

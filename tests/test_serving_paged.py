@@ -249,8 +249,14 @@ def test_prometheus_metrics_matches_registry(params):
                 # the declared (phase, role) pair.
                 assert 'phase="' in name and 'role="unified"' in name, name
             if METRICS.get(decl, ("", ()))[1] == ("role",):
-                # a unified engine's whole distribution is one role
-                assert 'role="unified"' in name, name
+                # a unified engine's whole distribution is one role —
+                # except TTFT, whose r20 cold_start split carries each
+                # boot's first-ever delivery under its own role.
+                if base.startswith("dstack_tpu_serving_ttft_seconds"):
+                    assert ('role="unified"' in name
+                            or 'role="cold_start"' in name), name
+                else:
+                    assert 'role="unified"' in name, name
             sampled.add(base)
             float(value)
     for expected in ("dstack_tpu_serving_kv_blocks_in_use",
@@ -297,15 +303,21 @@ def test_spec_disabled_surface_is_inert(params):
 def test_ttft_histogram_tracks_deliveries(params):
     """Each admitted request's first token lands one TTFT observation;
     the stats snapshot carries the cumulative-bucket dict the exposition
-    renders."""
+    renders. On a warmup-less engine the first-ever delivery paid the
+    jit trace+compile for its dispatch chain, so it lands in the
+    role="cold_start" split, keeping the steady-state distribution
+    clean (r20)."""
     engine = ServingEngine(CFG, params, slots=2, max_len=32)
     try:
         _drain(engine.submit([5, 7, 11], max_new_tokens=3))
         _drain(engine.submit([5, 7, 13], max_new_tokens=3))
-        hist = engine.stats()["ttft_hist"]
+        stats = engine.stats()
+        hist = stats["ttft_hist"]
+        cold = stats["ttft_cold_hist"]
     finally:
         engine.close()
-    assert hist["count"] == 2
+    assert cold["count"] == 1
+    assert hist["count"] == 1
     assert hist["sum"] > 0
     counts = [c for _, c in hist["buckets"]]
-    assert counts == sorted(counts) and counts[-1] <= 2
+    assert counts == sorted(counts) and counts[-1] <= 1
